@@ -1,0 +1,76 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's capabilities.
+
+Not a port: the reference's C++ PHI kernel library / executors / NCCL stack
+(see /root/repo/SURVEY.md) is re-designed on jax/XLA/Pallas — ops lower to
+StableHLO, the executor is XLA+PJRT, parallelism is GSPMD mesh sharding, and
+hand-written kernels are Pallas. The public surface mirrors `import paddle`.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    Tensor,
+    bfloat16,
+    bool_ as bool,  # noqa: A004
+    complex64,
+    complex128,
+    device_count,
+    enable_grad,
+    float16,
+    float32,
+    float64,
+    get_device,
+    get_flags,
+    get_rng_state,
+    int8,
+    int16,
+    int32,
+    int64,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    is_grad_enabled,
+    no_grad,
+    seed,
+    set_device,
+    set_flags,
+    set_rng_state,
+    to_tensor,
+    uint8,
+)
+from .core.autograd import set_grad_enabled  # noqa: F401
+from .core.dtype import DType as dtype  # noqa: F401
+from .core.tensor import Parameter  # noqa: F401
+from .ops import *  # noqa: F401,F403
+from .ops import sum, max, min, all, any, abs, slice  # noqa: F401,A004
+from .ops.logic import is_tensor  # noqa: F401
+
+# Subsystem namespaces land here as they are built out (nn, optimizer, io,
+# distributed, jit, ...). Each addition extends this import block.
+from . import autograd  # noqa: F401,E402
+
+# paddle.grad
+from .core.autograd import grad  # noqa: F401,E402
+
+
+def get_default_dtype():
+    from .core.flags import flag_value
+
+    return flag_value("default_dtype")
+
+
+def set_default_dtype(d):
+    from .core.dtype import convert_dtype
+
+    set_flags({"default_dtype": convert_dtype(d)})
+
+
+def set_printoptions(**kwargs):
+    import numpy as np
+
+    np.set_printoptions(**{k: v for k, v in kwargs.items() if k in ("precision", "threshold", "edgeitems", "linewidth")})

@@ -1,0 +1,143 @@
+"""paddle.autograd namespace (python/paddle/autograd analog).
+
+backward/grad ride the eager tape (core/autograd.py); the functional transforms
+(vjp/jvp/jacobian/hessian) compose jax's native transforms over pure functions
+extracted from Tensor-land — the TPU-native replacement for the reference's
+numeric double-backward machinery.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.autograd import backward, grad, no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .core.tensor import Tensor
+
+
+def _pure(func):
+    """Lift a Tensor->Tensor function to arrays->arrays for jax transforms."""
+
+    def fn(*arrays):
+        with no_grad():
+            out = func(*[Tensor(a) for a in arrays])
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value for o in out)
+        return out._value
+
+    return fn
+
+
+def vjp(func, xs, v=None):
+    xs = xs if isinstance(xs, (tuple, list)) else [xs]
+    vals = [x._value for x in xs]
+    out, vjp_fn = jax.vjp(_pure(func), *vals)
+    if v is None:
+        cot = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(jnp.ones_like(o) for o in out)
+    else:
+        v = v if isinstance(v, (tuple, list)) else [v]
+        cot = tuple(t._value for t in v)
+        if not isinstance(out, tuple):
+            cot = cot[0]
+    grads = vjp_fn(cot)
+    wrap = lambda o: Tensor(o) if not isinstance(o, tuple) else tuple(Tensor(i) for i in o)
+    return wrap(out), [Tensor(g) for g in grads]
+
+
+def jvp(func, xs, v=None):
+    xs = xs if isinstance(xs, (tuple, list)) else [xs]
+    vals = [x._value for x in xs]
+    if v is None:
+        tangents = tuple(jnp.ones_like(val) for val in vals)
+    else:
+        v = v if isinstance(v, (tuple, list)) else [v]
+        tangents = tuple(t._value for t in v)
+    out, tangent_out = jax.jvp(_pure(func), tuple(vals), tangents)
+    wrap = lambda o: Tensor(o) if not isinstance(o, tuple) else tuple(Tensor(i) for i in o)
+    return wrap(out), wrap(tangent_out)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    single = not isinstance(xs, (tuple, list))
+    xs = xs if not single else [xs]
+    vals = [x._value for x in xs]
+    jac = jax.jacobian(_pure(func), argnums=tuple(range(len(vals))))(*vals)
+    if single:
+        return Tensor(jac[0]) if isinstance(jac, tuple) else Tensor(jac)
+    return tuple(Tensor(j) for j in jac)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    single = not isinstance(xs, (tuple, list))
+    xs = xs if not single else [xs]
+    vals = [x._value for x in xs]
+    hes = jax.hessian(_pure(func), argnums=tuple(range(len(vals))))(*vals)
+    if single:
+        h = hes[0][0] if isinstance(hes, tuple) else hes
+        return Tensor(h)
+    return tuple(tuple(Tensor(h) for h in row) for row in hes)
+
+
+class PyLayerContext:
+    """Context passed to PyLayer.forward/backward (eager custom autograd fn)."""
+
+    def __init__(self):
+        self._saved = []
+        self.non_differentiable = []
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_non_differentiable(self, *tensors):
+        self.non_differentiable.extend(tensors)
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError("PyLayer subclasses are used via .apply(), not instantiated")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op (paddle.autograd.PyLayer, fluid/pybind/eager_py_layer.cc).
+
+    Subclass with static forward(ctx, *args) and backward(ctx, *grads); apply()
+    records a tape node whose vjp calls the user's backward.
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from .core.autograd import Node, is_grad_enabled
+        import jax.tree_util as jtu
+
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        out = cls.forward(ctx, *args, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        needs = is_grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+        if not needs:
+            return out
+
+        def vjp_fn(cotangents):
+            cots = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            grads = cls.backward(ctx, *[Tensor(c) for c in jtu.tree_leaves(cots)])
+            grads = grads if isinstance(grads, (tuple, list)) else [grads]
+            return tuple(g._value if isinstance(g, Tensor) else g for g in grads)
+
+        out_avals = [(tuple(t.shape), t._jdtype()) for t in outs]
+        out_tree = jtu.tree_structure(tuple(range(len(outs))) if len(outs) > 1 else 0)
+        node = Node(cls.__name__, tensor_inputs, vjp_fn, out_avals, out_tree)
+        for i, t in enumerate(outs):
+            if not any(t is nd for nd in ctx.non_differentiable):
+                t._attach(node, i)
+        return out
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError

@@ -1,0 +1,54 @@
+"""Core runtime: dtype/place/flags/random/Tensor/autograd/op registry.
+
+TPU-native analog of the reference PHI core (paddle/phi/core): where PHI has
+DenseTensor + KernelFactory + DeviceContext (phi/core/dense_tensor.h:38,
+kernel_factory.h:314, device_context.h), this core wraps jax.Array in a
+mutable Tensor facade, registers ops in a declarative table lowered to
+jnp/lax/StableHLO, and maps Place onto jax devices and meshes.
+"""
+
+from .dtype import (  # noqa: F401
+    DType,
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    convert_dtype,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+    is_floating_dtype,
+    is_integer_dtype,
+)
+from .place import (  # noqa: F401
+    CPUPlace,
+    Place,
+    TPUPlace,
+    CUDAPlace,
+    XPUPlace,
+    CustomPlace,
+    get_device,
+    set_device,
+    device_count,
+    is_compiled_with_tpu,
+    is_compiled_with_cuda,
+)
+from .flags import get_flags, set_flags, register_flag  # noqa: F401
+from .errors import (  # noqa: F401
+    EnforceNotMet,
+    InvalidArgumentError,
+    NotFoundError,
+    OutOfRangeError,
+    PreconditionNotMetError,
+    UnimplementedError,
+    enforce,
+)
+from .random import Generator, default_generator, get_rng_state, seed, set_rng_state  # noqa: F401
+from .tensor import Parameter, Tensor, to_tensor  # noqa: F401
+from .autograd import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .op_registry import OpDef, get_op, list_ops, register_op  # noqa: F401

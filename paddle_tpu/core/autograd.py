@@ -1,0 +1,263 @@
+"""Eager autograd: a tape of jax.vjp nodes.
+
+Analog of the reference eager engine (paddle/fluid/eager/): GradNodeBase graph +
+queue-driven RunBackward (backward.cc:104) with per-node input buffers
+(node_input_buffers_dict, backward.cc:143). Here every recorded op is a Node
+holding the jax.vjp closure of its pure lowering, so per-op grad kernels
+(MatmulGradKernel etc.) are replaced by XLA-differentiated VJPs; backward() is
+a reverse-topological sweep accumulating cotangents per (node, output) — the
+node_input_buffers analog — and depositing leaf grads on Tensor.grad where the
+reference's GradNodeAccumulation would.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+_tls = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+def set_grad_enabled(enabled: bool):
+    _tls.grad_enabled = bool(enabled)
+
+
+class _GradMode:
+    def __init__(self, target: bool):
+        self._target = target
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(self._target)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with type(self)():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class no_grad(_GradMode):
+    def __init__(self):
+        super().__init__(False)
+
+
+class enable_grad(_GradMode):
+    def __init__(self):
+        super().__init__(True)
+
+
+class Node:
+    """One recorded op: inputs, output avals/treedef, and the vjp closure."""
+
+    __slots__ = ("op_name", "inputs", "vjp_fn", "out_avals", "out_tree", "hooks", "released")
+
+    def __init__(self, op_name: str, inputs: Sequence, vjp_fn: Callable, out_avals: List, out_tree):
+        self.op_name = op_name
+        self.inputs = list(inputs)  # Tensors feeding this op (recorded order)
+        self.vjp_fn = vjp_fn
+        self.out_avals = out_avals  # [(shape, dtype)] per output leaf
+        self.out_tree = out_tree  # treedef of the op's output pytree
+        self.hooks = {}  # out_index -> [hook]
+        self.released = False
+
+    def add_hook(self, out_index: int, hook: Callable):
+        self.hooks.setdefault(out_index, []).append(hook)
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = []
+        self.released = True
+
+
+def _zero_cotangent(shape, dtype):
+    if np.issubdtype(np.dtype(dtype) if not hasattr(dtype, "name") else dtype, np.inexact) or str(dtype) == "bfloat16":
+        import jax.numpy as jnp
+
+        return jnp.zeros(shape, dtype)
+    # Integer/bool outputs take float0 cotangents in jax's vjp convention.
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False):
+    """Run reverse-mode from output ``tensors`` (paddle.autograd.backward)."""
+    from .tensor import Tensor  # local import to avoid cycle
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    import jax.numpy as jnp
+
+    # Seed cotangents keyed by (node, out_index); leaf roots get grads directly.
+    cotangents = {}
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            gv = jnp.ones(t.shape, t._jdtype())
+        else:
+            gv = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._grad_node
+        if node is None:
+            if not t.stop_gradient:
+                t._accumulate_grad(gv)
+            continue
+        key = (id(node), t._out_index)
+        if key in cotangents:
+            cotangents[key] = (node, t._out_index, cotangents[key][2] + gv)
+        else:
+            cotangents[key] = (node, t._out_index, gv)
+        roots.append(node)
+
+    if not roots:
+        return
+
+    # Topological order over the consumer->producer DAG (DFS postorder reversed)
+    order, visited, stack = [], set(), [(n, False) for n in dict.fromkeys(roots)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for inp in node.inputs:
+            pnode = inp._grad_node
+            if pnode is not None and not pnode.released and id(pnode) not in visited:
+                stack.append((pnode, False))
+    order.reverse()  # consumers first
+
+    for node in order:
+        if node.released:
+            raise RuntimeError(
+                f"Trying to backward through op '{node.op_name}' a second time; "
+                "set retain_graph=True to keep the graph."
+            )
+        # Assemble full output cotangent tuple (zeros where nothing flowed in).
+        cots = []
+        for idx, (shape, dtype) in enumerate(node.out_avals):
+            entry = cotangents.pop((id(node), idx), None)
+            cot = entry[2] if entry is not None else _zero_cotangent(shape, dtype)
+            for hook in node.hooks.get(idx, []):
+                out = hook(Tensor(cot, stop_gradient=True))
+                if out is not None:
+                    cot = out._value if isinstance(out, Tensor) else jnp.asarray(out)
+            cots.append(cot)
+        cot_pytree = jax.tree_util.tree_unflatten(node.out_tree, cots)
+        in_cots = node.vjp_fn(cot_pytree)
+        for inp, g in zip(node.inputs, in_cots):
+            if g is None or (isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0):
+                continue
+            pnode = inp._grad_node
+            if pnode is not None and not pnode.released:
+                key = (id(pnode), inp._out_index)
+                if key in cotangents:
+                    cotangents[key] = (pnode, inp._out_index, cotangents[key][2] + g)
+                else:
+                    cotangents[key] = (pnode, inp._out_index, g)
+                if getattr(inp, "_tape_requires", False):
+                    inp._accumulate_grad(g)
+            elif not inp.stop_gradient:
+                inp._accumulate_grad(g)
+        if not retain_graph:
+            node.release()
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph: Optional[bool] = None,
+    create_graph: bool = False,
+    allow_unused: bool = False,
+):
+    """paddle.grad analog: grads of outputs w.r.t. inputs without touching .grad.
+
+    Implemented by running the tape backward with grads redirected into a side
+    table (the reference's GeneralGrad path, fluid/eager/general_grad.h).
+    create_graph (higher-order) is served by re-running the pure function under
+    jax.grad in the functional API; the eager tape records first-order only.
+    """
+    from .tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True on the eager tape is unsupported; use "
+            "paddle_tpu.incubate.autograd (jax.grad composition) for higher order."
+        )
+    saved = [(t, t.grad) for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t._tape_requires = True
+    try:
+        backward(outputs, grad_tensors=grad_outputs, retain_graph=bool(retain_graph))
+        results = []
+        for t, _ in saved:
+            if t.grad is None and not allow_unused:
+                raise RuntimeError("One of the differentiated tensors appears unused; pass allow_unused=True")
+            results.append(t.grad)
+    finally:
+        for t, old in saved:
+            t._tape_requires = False
+        # grads captured in results; restore .grad to pre-call values
+    for (t, old), _ in zip(saved, results):
+        t.grad = old
+    return results
+
+
+def run_op(op_name: str, pure_fn: Callable, tensor_inputs: Sequence):
+    """Execute ``pure_fn(*arrays)`` and record a tape node if grads are needed.
+
+    Returns the raw output pytree of arrays plus the Node (or None). The op
+    layer wraps arrays back into Tensors and attaches (node, index).
+    """
+    from .tensor import Tensor
+    from .flags import flag_value
+
+    vals = [t._value for t in tensor_inputs]
+    needs_grad = is_grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+    if needs_grad:
+        out, vjp_fn = jax.vjp(pure_fn, *vals)
+    else:
+        out = pure_fn(*vals)
+        vjp_fn = None
+
+    leaves = jax.tree_util.tree_leaves(out)
+    if flag_value("check_nan_inf") and not any(isinstance(v, jax.core.Tracer) for v in leaves):
+        import jax.numpy as jnp
+
+        for leaf in leaves:
+            if jnp.issubdtype(leaf.dtype, jnp.inexact) and not bool(jnp.all(jnp.isfinite(leaf))):
+                raise FloatingPointError(f"Op '{op_name}' produced NaN/Inf (FLAGS_check_nan_inf)")
+
+    node = None
+    if needs_grad:
+        out_avals = [(tuple(v.shape), v.dtype) for v in leaves]
+        out_tree = jax.tree_util.tree_structure(out)
+        node = Node(op_name, tensor_inputs, vjp_fn, out_avals, out_tree)
+    return out, node

@@ -1,0 +1,142 @@
+"""Dtype system.
+
+Analog of the reference's phi::DataType (paddle/phi/common/data_type.h) and the
+python-side dtype conversion helpers (python/paddle/framework/dtype.py): a small
+registry mapping paddle-style names onto numpy/jax dtypes, with promotion rules
+delegated to jax.numpy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import jax.numpy as jnp
+
+    _BFLOAT16 = jnp.bfloat16
+except Exception:  # pragma: no cover - jax is a hard dep in practice
+    _BFLOAT16 = None
+
+
+class DType:
+    """A named dtype wrapper comparable with strings and numpy dtypes."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype) if np_dtype is not None else None
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or str(self.np_dtype) == other
+        try:
+            return self.np_dtype == np.dtype(other)
+        except Exception:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+    @property
+    def itemsize(self) -> int:
+        return self.np_dtype.itemsize
+
+    @property
+    def is_floating(self) -> bool:
+        return self.name in ("float16", "bfloat16", "float32", "float64")
+
+    @property
+    def is_integer(self) -> bool:
+        return self.name in ("int8", "int16", "int32", "int64", "uint8")
+
+    @property
+    def is_complex(self) -> bool:
+        return self.name in ("complex64", "complex128")
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", _BFLOAT16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALL = {
+    d.name: d
+    for d in (
+        bool_,
+        uint8,
+        int8,
+        int16,
+        int32,
+        int64,
+        float16,
+        bfloat16,
+        float32,
+        float64,
+        complex64,
+        complex128,
+    )
+}
+_ALL["bool"] = bool_
+
+
+def convert_dtype(dtype) -> str:
+    """Normalize any dtype spec (DType, str, numpy/jax dtype) to a canonical name."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, DType):
+        return dtype.name
+    if isinstance(dtype, str):
+        name = dtype
+        if name in _ALL:
+            return name
+        # numpy-style aliases
+        alias = {"float": "float32", "double": "float64", "int": "int32", "long": "int64", "half": "float16"}
+        if name in alias:
+            return alias[name]
+        raise ValueError(f"Unknown dtype string: {dtype!r}")
+    if _BFLOAT16 is not None and dtype == _BFLOAT16:
+        return "bfloat16"
+    np_name = np.dtype(dtype).name
+    if np_name in _ALL:
+        return np_name
+    raise ValueError(f"Unsupported dtype: {dtype!r}")
+
+
+def to_jax_dtype(dtype):
+    """Map a dtype spec to the numpy/jax dtype object used for array creation."""
+    name = convert_dtype(dtype)
+    if name is None:
+        return None
+    if name == "bfloat16":
+        return _BFLOAT16
+    return _ALL[name].np_dtype
+
+
+def from_jax_dtype(jdtype) -> DType:
+    """Map a jax array dtype back to the registry DType."""
+    if _BFLOAT16 is not None and jdtype == _BFLOAT16:
+        return bfloat16
+    name = np.dtype(jdtype).name
+    return _ALL[name]
+
+
+def is_floating_dtype(dtype) -> bool:
+    return _ALL[convert_dtype(dtype)].is_floating
+
+
+def is_integer_dtype(dtype) -> bool:
+    return _ALL[convert_dtype(dtype)].is_integer

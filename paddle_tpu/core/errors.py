@@ -1,0 +1,78 @@
+"""Enforce-style error checking.
+
+Analog of PADDLE_ENFORCE* / phi::errors (paddle/phi/core/enforce.h, errors.h):
+typed exceptions with a uniform error-summary format so user code can catch the
+same categories the reference exposes.
+"""
+
+from __future__ import annotations
+
+
+class EnforceNotMet(RuntimeError):
+    """Base class for all framework errors (the PADDLE_ENFORCE umbrella)."""
+
+    error_type = "Error"
+
+    def __init__(self, message: str):
+        super().__init__(f"({self.error_type}) {message}")
+        self.message = message
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    error_type = "InvalidArgument"
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    error_type = "NotFound"
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    error_type = "OutOfRange"
+
+
+class AlreadyExistsError(EnforceNotMet):
+    error_type = "AlreadyExists"
+
+
+class PermissionDeniedError(EnforceNotMet):
+    error_type = "PermissionDenied"
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    error_type = "PreconditionNotMet"
+
+
+class ResourceExhaustedError(EnforceNotMet):
+    error_type = "ResourceExhausted"
+
+
+class UnavailableError(EnforceNotMet):
+    error_type = "Unavailable"
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    error_type = "Unimplemented"
+
+
+class FatalError(EnforceNotMet):
+    error_type = "Fatal"
+
+
+class ExecutionTimeoutError(EnforceNotMet):
+    error_type = "ExecutionTimeout"
+
+
+def enforce(condition, message: str = "Enforce failed", error_cls=InvalidArgumentError):
+    """PADDLE_ENFORCE analog: raise a typed error when ``condition`` is falsy."""
+    if not condition:
+        raise error_cls(message)
+
+
+def enforce_eq(a, b, message: str = None, error_cls=InvalidArgumentError):
+    if a != b:
+        raise error_cls(message or f"Expected {a!r} == {b!r}")
+
+
+def enforce_shape_match(shape_a, shape_b, message: str = None):
+    if tuple(shape_a) != tuple(shape_b):
+        raise InvalidArgumentError(message or f"Shape mismatch: {tuple(shape_a)} vs {tuple(shape_b)}")

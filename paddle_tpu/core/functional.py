@@ -1,0 +1,55 @@
+"""Functional overlay: run stateful Layers as pure functions.
+
+The reference's eager layers mutate C++ tensors in place; under jit we need the
+same objects to behave functionally. The overlay is a thread-local map from
+Tensor uid -> traced jax array. While active, Tensor reads resolve through the
+overlay and Tensor writes land in the overlay instead of the wrapper, so a
+single Layer object can be traced with externally supplied parameter/buffer
+values (the analog of the reference's dygraph->static program capture in
+python/paddle/jit/dy2static).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+@contextlib.contextmanager
+def overlay(mapping: dict):
+    """Activate an overlay mapping {tensor_uid: array} for the current thread."""
+    stack = _stack()
+    stack.append(mapping)
+    try:
+        yield mapping
+    finally:
+        stack.pop()
+
+
+def current_overlay():
+    stack = _stack()
+    return stack[-1] if stack else None
+
+
+def overlay_get(uid):
+    for mapping in reversed(_stack()):
+        if uid in mapping:
+            return mapping[uid]
+    return None
+
+
+def overlay_set(uid, value) -> bool:
+    """Write into the innermost overlay that holds uid. Returns True if written."""
+    for mapping in reversed(_stack()):
+        if uid in mapping:
+            mapping[uid] = value
+            return True
+    return False

@@ -1,0 +1,68 @@
+"""Declarative op registry — the PHI KernelFactory analog.
+
+Where the reference registers kernels per (name, backend, layout, dtype)
+(PD_REGISTER_KERNEL, phi/core/kernel_registry.h:406) and resolves them at
+dispatch time (KernelFactory::SelectKernelOrThrowError, kernel_factory.h:324),
+a TPU-native framework needs exactly one lowering per op — a pure jax function
+traced into StableHLO — so the registry is a flat name -> OpDef table. It keeps
+the YAML-registry roles that still matter here: introspection, Tensor-method
+binding, and a seam where Pallas kernels can override the jnp lowering
+(variant='pallas').
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "variants", "tensor_method", "inplace_of", "doc")
+
+    def __init__(self, name: str, fn: Callable, tensor_method: Optional[str] = None, doc: str = ""):
+        self.name = name
+        self.fn = fn
+        self.variants: Dict[str, Callable] = {"default": fn}
+        self.tensor_method = tensor_method
+        self.inplace_of = None
+        self.doc = doc
+
+
+_OPS: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, tensor_method: Optional[str] = None):
+    """Decorator registering a python-level op implementation."""
+
+    def deco(fn):
+        _OPS[name] = OpDef(name, fn, tensor_method=tensor_method, doc=fn.__doc__ or "")
+        return fn
+
+    return deco
+
+
+def register_variant(name: str, variant: str):
+    """Attach an alternative lowering (e.g. a Pallas kernel) to an op."""
+
+    def deco(fn):
+        if name not in _OPS:
+            _OPS[name] = OpDef(name, fn)
+        _OPS[name].variants[variant] = fn
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    if name not in _OPS:
+        from .errors import NotFoundError
+
+        raise NotFoundError(f"Op '{name}' is not registered")
+    return _OPS[name]
+
+
+def has_op(name: str) -> bool:
+    return name in _OPS
+
+
+def list_ops():
+    return sorted(_OPS)

@@ -1,0 +1,143 @@
+"""Place / device addressing.
+
+Analog of phi::Place (paddle/phi/common/place.h) and paddle.device: places name
+jax devices. On TPU there is no per-op device dispatch — placement is realized
+through jax default_device / shardings — so Place is a thin addressing record
+kept for API parity plus a handle to the backing jax device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+class Place:
+    """Base device address: a backend kind plus a device index."""
+
+    kind = "undefined"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.device_id})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and self.kind == other.kind and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((self.kind, self.device_id))
+
+    def jax_device(self):
+        """Resolve to a jax.Device, falling back to the default backend."""
+        devs = _devices_for_kind(self.kind)
+        if not devs:
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+    def is_cpu_place(self):
+        return self.kind == "cpu"
+
+    def is_tpu_place(self):
+        return self.kind == "tpu"
+
+    def is_gpu_place(self):  # API parity; never true on this stack
+        return self.kind == "gpu"
+
+
+class CPUPlace(Place):
+    kind = "cpu"
+
+
+class TPUPlace(Place):
+    kind = "tpu"
+
+
+class CUDAPlace(Place):
+    """Accepted for API compatibility; resolves to the default accelerator."""
+
+    kind = "gpu"
+
+
+class XPUPlace(Place):
+    kind = "xpu"
+
+
+class CustomPlace(Place):
+    def __init__(self, dev_type: str, device_id: int = 0):
+        super().__init__(device_id)
+        self.kind = dev_type
+
+
+def _devices_for_kind(kind: str):
+    try:
+        if kind == "cpu":
+            return jax.devices("cpu")
+        if kind == "tpu":
+            for backend in ("tpu", "axon"):
+                try:
+                    return jax.devices(backend)
+                except RuntimeError:
+                    continue
+            return []
+        return jax.devices(kind)
+    except RuntimeError:
+        return []
+
+
+@functools.lru_cache(maxsize=None)
+def _default_place() -> Place:
+    plat = jax.default_backend()
+    if plat in ("tpu", "axon"):
+        return TPUPlace(0)
+    if plat == "gpu":
+        return CUDAPlace(0)
+    return CPUPlace(0)
+
+
+_current_place = None
+
+
+def set_device(device) -> Place:
+    """paddle.set_device analog: 'tpu', 'tpu:0', 'cpu', Place instance."""
+    global _current_place
+    if isinstance(device, Place):
+        _current_place = device
+        return device
+    name = str(device)
+    idx = 0
+    if ":" in name:
+        name, idx_s = name.split(":", 1)
+        idx = int(idx_s)
+    kind_map = {"cpu": CPUPlace, "tpu": TPUPlace, "gpu": CUDAPlace, "cuda": CUDAPlace, "xpu": XPUPlace}
+    cls = kind_map.get(name)
+    if cls is None:
+        _current_place = CustomPlace(name, idx)
+    else:
+        _current_place = cls(idx)
+    return _current_place
+
+
+def get_device() -> str:
+    place = _current_place or _default_place()
+    return f"{place.kind}:{place.device_id}"
+
+
+def current_place() -> Place:
+    return _current_place or _default_place()
+
+
+def device_count(kind: str = None) -> int:
+    if kind is None:
+        kind = (_current_place or _default_place()).kind
+    return len(_devices_for_kind(kind)) or 1
+
+
+def is_compiled_with_tpu() -> bool:
+    return len(_devices_for_kind("tpu")) > 0
+
+
+def is_compiled_with_cuda() -> bool:
+    return False

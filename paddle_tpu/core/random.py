@@ -1,0 +1,105 @@
+"""Random number generation.
+
+Analog of phi::Generator (paddle/phi/core/generator.h) — the per-device
+(seed, offset) RNG state used by dropout/random ops — rebuilt on jax's
+counter-based PRNG: a Generator holds a base seed and a monotonically
+increasing offset; every draw folds the offset into the key.
+
+Two execution regimes:
+  * eager: the global default_generator advances its offset per call.
+  * traced (inside a jitted functional step): a seed *array* is threaded in via
+    ``rng_scope``; draws fold a per-trace Python counter into the traced key so
+    each op gets a distinct stream and a fresh seed value each step re-randomizes
+    every mask. This mirrors the reference's RNG-tracker replay discipline
+    (fleet/layers/mpu/random.py) and maps it onto jax.random.fold_in.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Stateful RNG: seed + offset, producing fresh jax PRNG keys."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._offset = 0
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._offset = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def get_state(self):
+        return {"seed": self._seed, "offset": self._offset}
+
+    def set_state(self, state):
+        self._seed = int(state["seed"])
+        self._offset = int(state["offset"])
+
+    def random(self) -> int:
+        """Draw a fresh int seed (used to spawn child generators/workers)."""
+        key = self.next_key()
+        return int(jax.random.randint(key, (), 0, np.iinfo(np.int32).max))
+
+    def next_key(self):
+        """Next jax PRNG key; advances the offset."""
+        self._offset += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed), self._offset)
+
+
+default_generator = Generator(0)
+
+_tls = threading.local()
+
+
+def seed(value: int) -> Generator:
+    """paddle.seed analog: reset the global generator."""
+    default_generator.manual_seed(value)
+    return default_generator
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)
+
+
+@contextlib.contextmanager
+def rng_scope(seed_array):
+    """Thread a traced seed through a functional/jitted region.
+
+    ``seed_array`` is a scalar (possibly traced) int32; draws inside the scope
+    derive keys as fold_in(key(seed_array), counter). The counter is Python-side
+    and therefore static per trace position — distinct ops get distinct streams,
+    and varying the seed array per step re-randomizes all of them.
+    """
+    prev = getattr(_tls, "rng", None)
+    _tls.rng = [jax.random.PRNGKey(seed_array), 0]
+    try:
+        yield
+    finally:
+        _tls.rng = prev
+
+
+def in_rng_scope() -> bool:
+    return getattr(_tls, "rng", None) is not None
+
+
+def next_key():
+    """Fresh PRNG key from the active scope (traced) or the global generator."""
+    state = getattr(_tls, "rng", None)
+    if state is not None:
+        state[1] += 1
+        return jax.random.fold_in(state[0], state[1])
+    return default_generator.next_key()

@@ -1,0 +1,143 @@
+"""Op surface: functional ops over Tensors + Tensor method/operator binding.
+
+The binding step is the analog of the reference's generated pybind method table
+(paddle/fluid/pybind/eager_method.cc + tensor_patch_methods): every registered
+op that makes sense as a method lands on Tensor, and the arithmetic dunders map
+onto the same ops so `x + y` records on the tape exactly like paddle_tpu.add.
+"""
+
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from . import creation, linalg, logic, manipulation, math, search  # noqa: F401
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+
+# names that are python builtins are still exported (paddle does the same)
+from .math import sum, max, min, all, any, abs  # noqa: F401,A004
+from .manipulation import slice  # noqa: F401,A004
+
+_METHOD_SOURCES = (math, linalg, manipulation, logic, search, creation)
+
+_METHOD_NAMES = """
+add subtract multiply divide floor_divide mod remainder pow maximum minimum fmax fmin
+exp expm1 log log2 log10 log1p sqrt rsqrt abs sign floor ceil round trunc frac square
+reciprocal neg sin cos tan asin acos atan sinh cosh tanh asinh acosh atanh erf erfinv
+digamma lgamma angle conj real imag deg2rad rad2deg clip lerp logit scale addmm inner
+outer kron trace diagonal sum mean prod max min amax amin nansum nanmean logsumexp std
+var median nanmedian quantile count_nonzero all any cumsum cumprod logcumsumexp argmax
+argmin matmul mm bmm mv dot t transpose norm dist cross cholesky inverse pinv det
+slogdet matrix_power svd qr eig eigvals solve lstsq histogram bincount cast reshape
+reshape_ flatten squeeze unsqueeze concat unstack unbind split chunk tile expand
+expand_as broadcast_to gather gather_nd scatter scatter_ scatter_nd_add index_select
+index_sample index_add masked_select masked_fill where nonzero roll flip rot90
+repeat_interleave take_along_axis put_along_axis take pad slice strided_slice moveaxis
+swapaxes as_strided unique unique_consecutive as_complex as_real tensor_split equal
+not_equal greater_than greater_equal less_than less_equal logical_and logical_or
+logical_xor logical_not bitwise_and bitwise_or bitwise_xor bitwise_not equal_all
+allclose isclose isnan isinf isfinite is_empty topk sort argsort searchsorted
+bucketize kthvalue mode zeros_like ones_like full_like clone numel multiplex
+diag tril triu atan2 heaviside trunc stanh
+""".split()
+
+
+def _lookup(name):
+    for mod in _METHOD_SOURCES:
+        if hasattr(mod, name):
+            return getattr(mod, name)
+    return None
+
+
+def _bind_tensor_methods():
+    reg = Tensor._method_registry
+    for name in _METHOD_NAMES:
+        fn = _lookup(name)
+        if fn is not None:
+            reg[name] = fn
+    # required internals
+    reg["astype"] = manipulation.cast
+    reg["__getitem__"] = manipulation.getitem
+    reg["__setitem__"] = manipulation.setitem
+    reg["t"] = linalg.t
+
+    # paddle-style trailing-underscore in-place variants for the common math ops
+    def _make_inplace(fname):
+        base = reg[fname]
+
+        def inplace(self, *args, **kwargs):
+            return self._inplace_from(base(self, *args, **kwargs))
+
+        return inplace
+
+    for fname in (
+        "add",
+        "subtract",
+        "multiply",
+        "divide",
+        "clip",
+        "scale",
+        "exp",
+        "sqrt",
+        "rsqrt",
+        "reciprocal",
+        "round",
+        "floor",
+        "ceil",
+        "tanh",
+        "abs",
+        "cast",
+    ):
+        if fname in reg:
+            reg[fname + "_"] = _make_inplace(fname)
+
+    def zero_(self):
+        import jax.numpy as jnp
+
+        self._set_value_raw(jnp.zeros_like(self._value))
+        return self
+
+    def fill_(self, value):
+        import jax.numpy as jnp
+
+        self._set_value_raw(jnp.full_like(self._value, value))
+        return self
+
+    reg["zero_"] = zero_
+    reg["fill_"] = fill_
+
+    # arithmetic dunders -> tape-recorded ops
+    Tensor.__add__ = lambda self, o: math.add(self, o)
+    Tensor.__radd__ = lambda self, o: math.add(o, self)
+    Tensor.__sub__ = lambda self, o: math.subtract(self, o)
+    Tensor.__rsub__ = lambda self, o: math.subtract(o, self)
+    Tensor.__mul__ = lambda self, o: math.multiply(self, o)
+    Tensor.__rmul__ = lambda self, o: math.multiply(o, self)
+    Tensor.__truediv__ = lambda self, o: math.divide(self, o)
+    Tensor.__rtruediv__ = lambda self, o: math.divide(o, self)
+    Tensor.__floordiv__ = lambda self, o: math.floor_divide(self, o)
+    Tensor.__rfloordiv__ = lambda self, o: math.floor_divide(o, self)
+    Tensor.__mod__ = lambda self, o: math.mod(self, o)
+    Tensor.__rmod__ = lambda self, o: math.mod(o, self)
+    Tensor.__pow__ = lambda self, o: math.pow(self, o)
+    Tensor.__rpow__ = lambda self, o: math.pow(o, self)
+    Tensor.__matmul__ = lambda self, o: linalg.matmul(self, o)
+    Tensor.__rmatmul__ = lambda self, o: linalg.matmul(o, self)
+    Tensor.__neg__ = lambda self: math.neg(self)
+    Tensor.__abs__ = lambda self: math.abs(self)
+    Tensor.__invert__ = lambda self: logic.logical_not(self)
+    Tensor.__eq__ = lambda self, o: logic.equal(self, o)
+    Tensor.__ne__ = lambda self, o: logic.not_equal(self, o)
+    Tensor.__lt__ = lambda self, o: logic.less_than(self, o)
+    Tensor.__le__ = lambda self, o: logic.less_equal(self, o)
+    Tensor.__gt__ = lambda self, o: logic.greater_than(self, o)
+    Tensor.__ge__ = lambda self, o: logic.greater_equal(self, o)
+    Tensor.__and__ = lambda self, o: logic.logical_and(self, o)
+    Tensor.__or__ = lambda self, o: logic.logical_or(self, o)
+    Tensor.__xor__ = lambda self, o: logic.logical_xor(self, o)
+
+
+_bind_tensor_methods()

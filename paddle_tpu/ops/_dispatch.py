@@ -1,0 +1,112 @@
+"""Op dispatch helpers: Tensor-in/Tensor-out wrapping around pure jnp lowerings.
+
+The per-op pipeline mirrors the reference's generated C++ API (phi/api/yaml/
+generator/api_gen.py): coerce inputs, run the pure lowering (recording a tape
+node when grads are required — see core/autograd.run_op), wrap outputs. Unlike
+the reference there is no kernel-key resolution or DataTransform: placement and
+layout belong to XLA.
+"""
+
+from __future__ import annotations
+
+import numbers
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import run_op
+from ..core.dtype import convert_dtype, to_jax_dtype
+from ..core.tensor import Tensor
+
+Number = numbers.Number
+
+
+def as_tensor(x) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x))
+
+
+def is_scalar(x) -> bool:
+    return isinstance(x, Number) and not isinstance(x, bool) or isinstance(x, (bool, np.generic))
+
+
+def wrap_outputs(out, node):
+    """Wrap an output pytree of arrays into Tensors attached to the tape node."""
+    leaves, tree = jax.tree_util.tree_flatten(out)
+    wrapped = []
+    for i, leaf in enumerate(leaves):
+        t = Tensor(leaf, stop_gradient=node is None)
+        if node is not None:
+            t._attach(node, i)
+        wrapped.append(t)
+    return jax.tree_util.tree_unflatten(tree, wrapped)
+
+
+def apply(op_name: str, pure_fn, *tensors: Tensor):
+    """Run a pure function of the tensor values; returns wrapped output pytree."""
+    out, node = run_op(op_name, pure_fn, tensors)
+    return wrap_outputs(out, node)
+
+
+def unary(op_name: str, jfn):
+    """Factory for f(x, name=None) elementwise/unary ops."""
+
+    def op(x, name=None):
+        x = as_tensor(x)
+        return apply(op_name, jfn, x)
+
+    op.__name__ = op_name
+    op.__qualname__ = op_name
+    op.__doc__ = f"Elementwise/unary op '{op_name}' lowered to {getattr(jfn, '__name__', jfn)!s}."
+    return op
+
+
+def binary(op_name: str, jfn):
+    """Factory for f(x, y) ops with paddle scalar semantics.
+
+    Python scalars stay weakly typed (closed over, not materialized) so
+    ``bf16_tensor + 2`` keeps bfloat16 instead of promoting through int32.
+    """
+
+    def op(x, y, name=None):
+        x_is_t, y_is_t = isinstance(x, Tensor), isinstance(y, Tensor)
+        if x_is_t and not y_is_t and isinstance(y, Number):
+            return apply(op_name, lambda xv: jfn(xv, y), x)
+        if y_is_t and not x_is_t and isinstance(x, Number):
+            return apply(op_name, lambda yv: jfn(x, yv), y)
+        return apply(op_name, jfn, as_tensor(x), as_tensor(y))
+
+    op.__name__ = op_name
+    op.__qualname__ = op_name
+    op.__doc__ = f"Elementwise binary op '{op_name}'."
+    return op
+
+
+def jdtype(dtype, default=None):
+    if dtype is None:
+        if default is None:
+            from ..core.flags import flag_value
+
+            return to_jax_dtype(flag_value("default_dtype"))
+        return default
+    return to_jax_dtype(convert_dtype(dtype))
+
+
+def normalize_axis(axis, ndim):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) % ndim if a < 0 else int(a) for a in axis)
+    axis = int(axis)
+    return axis % ndim if axis < 0 else axis
+
+
+def int_or_tuple(v):
+    """IntArray-style attribute: scalar/list/Tensor -> concrete python ints."""
+    if isinstance(v, Tensor):
+        return tuple(int(i) for i in np.asarray(v._value).reshape(-1))
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i._value) if isinstance(i, Tensor) else int(i) for i in v)
+    return int(v)

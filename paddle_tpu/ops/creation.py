@@ -1,0 +1,321 @@
+"""Tensor creation ops.
+
+Reference surface: python/paddle/tensor/creation.py (zeros/ones/full/arange/
+eye/...) and random.py (rand/randn/uniform/...). Random ops draw keys from the
+core Generator so eager calls advance the global (seed, offset) state and
+traced calls thread through rng_scope (core/random.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+from ..core.dtype import convert_dtype, to_jax_dtype
+from ..core.op_registry import register_op
+from ..core.tensor import Tensor, to_tensor  # noqa: F401  (re-exported)
+from ._dispatch import apply, as_tensor, jdtype
+
+
+@register_op("zeros")
+def zeros(shape, dtype=None, name=None):
+    from ._dispatch import int_or_tuple
+
+    shape = int_or_tuple(shape)
+    shape = (shape,) if isinstance(shape, int) else shape
+    return Tensor(jnp.zeros(shape, jdtype(dtype)))
+
+
+@register_op("ones")
+def ones(shape, dtype=None, name=None):
+    from ._dispatch import int_or_tuple
+
+    shape = int_or_tuple(shape)
+    shape = (shape,) if isinstance(shape, int) else shape
+    return Tensor(jnp.ones(shape, jdtype(dtype)))
+
+
+@register_op("full")
+def full(shape, fill_value, dtype=None, name=None):
+    from ._dispatch import int_or_tuple
+
+    shape = int_or_tuple(shape)
+    shape = (shape,) if isinstance(shape, int) else shape
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        return Tensor(jnp.full(shape, fill_value))
+    return Tensor(jnp.full(shape, fill_value, jdtype(dtype)))
+
+
+@register_op("empty")
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+@register_op("zeros_like", tensor_method=None)
+def zeros_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.zeros_like(x._value, dtype=None if dtype is None else jdtype(dtype)))
+
+
+@register_op("ones_like")
+def ones_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.ones_like(x._value, dtype=None if dtype is None else jdtype(dtype)))
+
+
+@register_op("full_like")
+def full_like(x, fill_value, dtype=None, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.full_like(x._value, fill_value, dtype=None if dtype is None else jdtype(dtype)))
+
+
+@register_op("empty_like")
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+@register_op("arange")
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _c(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = _c(start), _c(end), _c(step)
+    if end is None:
+        start, end = 0, start
+    return Tensor(jnp.arange(start, end, step, dtype=None if dtype is None else jdtype(dtype)))
+
+
+@register_op("linspace")
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=None if dtype is None else jdtype(dtype)))
+
+
+@register_op("logspace")
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=None if dtype is None else jdtype(dtype)))
+
+
+@register_op("eye")
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=jdtype(dtype)))
+
+
+@register_op("diag")
+def diag(x, offset=0, padding_value=0, name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        if xv.ndim == 1:
+            out = jnp.diag(xv, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+            return out
+        return jnp.diagonal(xv, offset=offset)
+
+    return apply("diag", fn, x)
+
+
+@register_op("diagflat")
+def diagflat(x, offset=0, name=None):
+    x = as_tensor(x)
+    return apply("diagflat", lambda xv: jnp.diagflat(xv, k=offset), x)
+
+
+@register_op("diag_embed")
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        out = jnp.zeros(xv.shape + (xv.shape[-1] + abs(offset),), xv.dtype)
+        idx = jnp.arange(xv.shape[-1])
+        row = idx + max(-offset, 0)
+        col = idx + max(offset, 0)
+        out = out.at[..., row, col].set(xv)
+        return jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+
+    return apply("diag_embed", fn, x)
+
+
+@register_op("tril")
+def tril(x, diagonal=0, name=None):
+    x = as_tensor(x)
+    return apply("tril", lambda xv: jnp.tril(xv, k=diagonal), x)
+
+
+@register_op("triu")
+def triu(x, diagonal=0, name=None):
+    x = as_tensor(x)
+    return apply("triu", lambda xv: jnp.triu(xv, k=diagonal), x)
+
+
+@register_op("tril_indices")
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), jdtype(dtype)))
+
+
+@register_op("triu_indices")
+def triu_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), jdtype(dtype)))
+
+
+@register_op("meshgrid")
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    tensors = [as_tensor(a) for a in args]
+    return apply("meshgrid", lambda *vals: tuple(jnp.meshgrid(*vals, indexing="ij")), *tensors)
+
+
+@register_op("assign")
+def assign(x, output=None):
+    x = as_tensor(x) if not isinstance(x, (list, tuple, np.ndarray, float, int)) else Tensor(jnp.asarray(x))
+    result = apply("assign", lambda v: v, x) if isinstance(x, Tensor) else x
+    if output is not None:
+        output._inplace_from(result if isinstance(result, Tensor) else Tensor(result))
+        return output
+    return result
+
+
+@register_op("clone")
+def clone(x, name=None):
+    x = as_tensor(x)
+    return apply("clone", lambda v: v + 0, x)
+
+
+@register_op("numel")
+def numel(x, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+@register_op("complex")
+def complex_(real, imag, name=None):
+    return apply("complex", jax.lax.complex, as_tensor(real), as_tensor(imag))
+
+
+# ---- random creation ----
+
+
+def _key():
+    return _random.next_key()
+
+
+@register_op("rand")
+def rand(shape, dtype=None, name=None):
+    from ._dispatch import int_or_tuple
+
+    shape = int_or_tuple(shape)
+    shape = (shape,) if isinstance(shape, int) else shape
+    return Tensor(jax.random.uniform(_key(), shape, jdtype(dtype)))
+
+
+@register_op("randn")
+def randn(shape, dtype=None, name=None):
+    from ._dispatch import int_or_tuple
+
+    shape = int_or_tuple(shape)
+    shape = (shape,) if isinstance(shape, int) else shape
+    return Tensor(jax.random.normal(_key(), shape, jdtype(dtype)))
+
+
+@register_op("standard_normal")
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype, name)
+
+
+@register_op("randint")
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    from ._dispatch import int_or_tuple
+
+    if high is None:
+        low, high = 0, low
+    shape = int_or_tuple(shape)
+    shape = (shape,) if isinstance(shape, int) else shape
+    return Tensor(jax.random.randint(_key(), shape, low, high, jdtype(dtype)))
+
+
+@register_op("randint_like")
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = as_tensor(x)
+    return randint(low, high, tuple(x.shape), dtype or x.dtype.name)
+
+
+@register_op("randperm")
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_key(), int(n)).astype(jdtype(dtype)))
+
+
+@register_op("uniform")
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    from ._dispatch import int_or_tuple
+
+    shape = int_or_tuple(shape)
+    shape = (shape,) if isinstance(shape, int) else shape
+    key = jax.random.PRNGKey(seed) if seed else _key()
+    return Tensor(jax.random.uniform(key, shape, jdtype(dtype), minval=min, maxval=max))
+
+
+@register_op("uniform_like")
+def uniform_like(x, min=-1.0, max=1.0, name=None):
+    x = as_tensor(x)
+    return Tensor(jax.random.uniform(_key(), tuple(x.shape), x._jdtype(), minval=min, maxval=max))
+
+
+@register_op("normal")
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = as_tensor(mean)._value if isinstance(mean, Tensor) else mean
+        s = as_tensor(std)._value if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(
+            jnp.shape(m) if hasattr(m, "shape") else (), jnp.shape(s) if hasattr(s, "shape") else ()
+        )
+        return Tensor(jax.random.normal(_key(), out_shape) * s + m)
+    from ._dispatch import int_or_tuple
+
+    shape = int_or_tuple(shape) if shape is not None else (1,)
+    shape = (shape,) if isinstance(shape, int) else shape
+    return Tensor(jax.random.normal(_key(), shape) * std + mean)
+
+
+@register_op("bernoulli")
+def bernoulli(x, name=None):
+    x = as_tensor(x)
+    return Tensor(jax.random.bernoulli(_key(), np.asarray(x._value)).astype(x._jdtype()))
+
+
+@register_op("poisson")
+def poisson(x, name=None):
+    x = as_tensor(x)
+    return Tensor(jax.random.poisson(_key(), x._value).astype(x._jdtype()))
+
+
+@register_op("multinomial")
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = as_tensor(x)
+    probs = x._value / jnp.sum(x._value, axis=-1, keepdims=True)
+    logits = jnp.log(jnp.maximum(probs, 1e-30))
+    if replacement:
+        out = jax.random.categorical(_key(), logits, axis=-1, shape=(num_samples,) + logits.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(_key(), logits.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+@register_op("gaussian")
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    from ._dispatch import int_or_tuple
+
+    shape = int_or_tuple(shape)
+    shape = (shape,) if isinstance(shape, int) else shape
+    key = jax.random.PRNGKey(seed) if seed else _key()
+    return Tensor(jax.random.normal(key, shape, jdtype(dtype)) * std + mean)

@@ -1,0 +1,290 @@
+"""Linear algebra ops.
+
+Reference surface: python/paddle/tensor/linalg.py (matmul at linalg.py:140
+routing to _C_ops.matmul) and phi kernels (matmul_kernel.h:24). Matmuls lower
+straight to dot_general so XLA tiles them onto the MXU; bf16 inputs keep
+float32 accumulation via preferred_element_type.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op_registry import register_op
+from ..core.tensor import Tensor
+from ._dispatch import apply, as_tensor
+
+
+def _pref(dtype):
+    # bf16/f16 matmuls accumulate in f32 on the MXU; keep output dtype bf16.
+    return jnp.float32 if dtype in (jnp.bfloat16, jnp.float16) else None
+
+
+@register_op("matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+
+    def fn(xv, yv):
+        if transpose_x:
+            xv = jnp.swapaxes(xv, -1, -2) if xv.ndim > 1 else xv
+        if transpose_y:
+            yv = jnp.swapaxes(yv, -1, -2) if yv.ndim > 1 else yv
+        out = jnp.matmul(xv, yv, preferred_element_type=_pref(xv.dtype))
+        if _pref(xv.dtype) is not None:
+            out = out.astype(xv.dtype)
+        return out
+
+    return apply("matmul", fn, x, y)
+
+
+@register_op("mm")
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+@register_op("bmm")
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+@register_op("mv")
+def mv(x, vec, name=None):
+    return apply("mv", lambda xv, vv: jnp.matmul(xv, vv), as_tensor(x), as_tensor(vec))
+
+
+@register_op("dot")
+def dot(x, y, name=None):
+    def fn(xv, yv):
+        return jnp.sum(xv * yv, axis=-1)
+
+    return apply("dot", fn, as_tensor(x), as_tensor(y))
+
+
+@register_op("t")
+def t(input, name=None):
+    x = as_tensor(input)
+    return apply("t", lambda xv: xv.T if xv.ndim == 2 else xv, x)
+
+
+@register_op("transpose")
+def transpose(x, perm, name=None):
+    x = as_tensor(x)
+    return apply("transpose", lambda xv: jnp.transpose(xv, axes=list(perm)), x)
+
+
+@register_op("einsum")
+def einsum(equation, *operands):
+    tensors = [as_tensor(o) for o in operands]
+    return apply("einsum", lambda *vals: jnp.einsum(equation, *vals), *tensors)
+
+
+@register_op("tensordot")
+def tensordot(x, y, axes=2, name=None):
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), as_tensor(x), as_tensor(y))
+
+
+@register_op("multi_dot")
+def multi_dot(x, name=None):
+    tensors = [as_tensor(t_) for t_ in x]
+    return apply("multi_dot", lambda *vals: jnp.linalg.multi_dot(vals), *tensors)
+
+
+@register_op("norm")
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        if p == "fro" and axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(xv)))
+        if axis is None:
+            return jnp.linalg.norm(xv.reshape(-1), ord=p)
+        if isinstance(axis, (list, tuple)):
+            return jnp.linalg.norm(xv, ord="fro" if p == "fro" else p, axis=tuple(axis), keepdims=keepdim)
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(xv), axis=axis, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(xv), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(xv), axis=axis, keepdims=keepdim)
+        return jnp.sum(jnp.abs(xv) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+    return apply("norm", fn, x)
+
+
+@register_op("dist")
+def dist(x, y, p=2, name=None):
+    def fn(xv, yv):
+        d = (xv - yv).reshape(-1)
+        if p == 0:
+            return jnp.sum(d != 0).astype(xv.dtype)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+    return apply("dist", fn, as_tensor(x), as_tensor(y))
+
+
+@register_op("cross")
+def cross(x, y, axis=9, name=None):
+    def fn(xv, yv):
+        ax = axis if axis != 9 else next(i for i, s in enumerate(xv.shape) if s == 3)
+        return jnp.cross(xv, yv, axis=ax)
+
+    return apply("cross", fn, as_tensor(x), as_tensor(y))
+
+
+@register_op("cholesky")
+def cholesky(x, upper=False, name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        lower = jnp.linalg.cholesky(xv)
+        return jnp.swapaxes(lower, -1, -2) if upper else lower
+
+    return apply("cholesky", fn, x)
+
+
+@register_op("cholesky_solve")
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(bv, lv):
+        lo = jnp.swapaxes(lv, -1, -2) if upper else lv
+        z = jax.scipy.linalg.solve_triangular(lo, bv, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(lo, -1, -2), z, lower=False)
+
+    return apply("cholesky_solve", fn, as_tensor(x), as_tensor(y))
+
+
+@register_op("inverse")
+def inverse(x, name=None):
+    return apply("inverse", jnp.linalg.inv, as_tensor(x))
+
+
+inv = inverse
+
+
+@register_op("pinv")
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", lambda xv: jnp.linalg.pinv(xv, rtol=rcond, hermitian=hermitian), as_tensor(x))
+
+
+@register_op("det")
+def det(x, name=None):
+    return apply("det", jnp.linalg.det, as_tensor(x))
+
+
+@register_op("slogdet")
+def slogdet(x, name=None):
+    def fn(xv):
+        sign, logdet = jnp.linalg.slogdet(xv)
+        return jnp.stack([sign, logdet])
+
+    return apply("slogdet", fn, as_tensor(x))
+
+
+@register_op("matrix_rank")
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.linalg.matrix_rank(x._value, rtol=tol).astype(jnp.int64))
+
+
+@register_op("matrix_power")
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", lambda xv: jnp.linalg.matrix_power(xv, n), as_tensor(x))
+
+
+@register_op("svd")
+def svd(x, full_matrices=False, name=None):
+    def fn(xv):
+        u, s, vh = jnp.linalg.svd(xv, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2)  # paddle returns V not V^H
+
+    return apply("svd", fn, as_tensor(x))
+
+
+@register_op("qr")
+def qr(x, mode="reduced", name=None):
+    return apply("qr", lambda xv: tuple(jnp.linalg.qr(xv, mode=mode)), as_tensor(x))
+
+
+@register_op("lu")
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = as_tensor(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(x._value)
+    outs = (Tensor(lu_), Tensor(piv.astype(jnp.int32) + 1))
+    if get_infos:
+        return outs + (Tensor(jnp.zeros((), jnp.int32)),)
+    return outs
+
+
+@register_op("eig")
+def eig(x, name=None):
+    x = as_tensor(x)
+    w, v = np.linalg.eig(np.asarray(x._value))  # general eig is host-side (no TPU lowering)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+@register_op("eigh")
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh", lambda xv: tuple(jnp.linalg.eigh(xv, symmetrize_input=True)), as_tensor(x))
+
+
+@register_op("eigvals")
+def eigvals(x, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(x._value))))
+
+
+@register_op("eigvalsh")
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", jnp.linalg.eigvalsh, as_tensor(x))
+
+
+@register_op("solve")
+def solve(x, y, name=None):
+    return apply("solve", jnp.linalg.solve, as_tensor(x), as_tensor(y))
+
+
+@register_op("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(av, bv):
+        return jax.scipy.linalg.solve_triangular(
+            av, bv, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return apply("triangular_solve", fn, as_tensor(x), as_tensor(y))
+
+
+@register_op("lstsq")
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(x._value, y._value, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank.astype(jnp.int64)), Tensor(sv)
+
+
+@register_op("corrcoef")
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef", lambda xv: jnp.corrcoef(xv, rowvar=rowvar), as_tensor(x))
+
+
+@register_op("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply("cov", lambda xv: jnp.cov(xv, rowvar=rowvar, ddof=1 if ddof else 0), as_tensor(x))
+
+
+@register_op("histogram")
+def histogram(input, bins=100, min=0, max=0, name=None):
+    x = as_tensor(input)
+    lo, hi = (None, None) if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(x._value, bins=bins, range=None if lo is None else (lo, hi))
+    return Tensor(hist.astype(jnp.int64))
+
+
+@register_op("bincount")
+def bincount(x, weights=None, minlength=0, name=None):
+    x = as_tensor(x)
+    w = as_tensor(weights)._value if weights is not None else None
+    return Tensor(jnp.bincount(x._value, weights=w, minlength=minlength))

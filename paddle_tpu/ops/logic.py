@@ -1,0 +1,93 @@
+"""Comparison / logical / bitwise ops (python/paddle/tensor/logic.py analog)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op_registry import register_op
+from ..core.tensor import Tensor
+from ._dispatch import apply, as_tensor, binary
+
+_g = globals()
+_CMP = {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "greater_than": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "less_than": jnp.less,
+    "less_equal": jnp.less_equal,
+    "logical_and": jnp.logical_and,
+    "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and,
+    "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "bitwise_left_shift": jnp.left_shift,
+    "bitwise_right_shift": jnp.right_shift,
+}
+for _name, _fn in _CMP.items():
+    _g[_name] = register_op(_name)(binary(_name, _fn))
+
+
+@register_op("logical_not")
+def logical_not(x, name=None):
+    return apply("logical_not", jnp.logical_not, as_tensor(x))
+
+
+@register_op("bitwise_not")
+def bitwise_not(x, name=None):
+    return apply("bitwise_not", jnp.bitwise_not, as_tensor(x))
+
+
+@register_op("equal_all")
+def equal_all(x, y, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    return Tensor(jnp.array_equal(x._value, y._value))
+
+
+@register_op("allclose")
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    return Tensor(jnp.allclose(x._value, y._value, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+@register_op("isclose")
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    return Tensor(jnp.isclose(x._value, y._value, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+@register_op("isnan")
+def isnan(x, name=None):
+    return Tensor(jnp.isnan(as_tensor(x)._value))
+
+
+@register_op("isinf")
+def isinf(x, name=None):
+    return Tensor(jnp.isinf(as_tensor(x)._value))
+
+
+@register_op("isfinite")
+def isfinite(x, name=None):
+    return Tensor(jnp.isfinite(as_tensor(x)._value))
+
+
+@register_op("isreal")
+def isreal(x, name=None):
+    return Tensor(jnp.isreal(as_tensor(x)._value))
+
+
+@register_op("is_empty")
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(as_tensor(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+@register_op("in1d")
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    x, test_x = as_tensor(x), as_tensor(test_x)
+    return Tensor(jnp.isin(x._value, test_x._value, assume_unique=assume_unique, invert=invert))

@@ -1,0 +1,613 @@
+"""Shape / layout / indexing manipulation ops.
+
+Reference surface: python/paddle/tensor/manipulation.py + phi kernels
+(reshape/concat/gather/scatter/...). Gather/scatter map to jnp take/.at ops —
+XLA lowers them to TPU gather/scatter HLOs; boolean-mask ops (masked_select,
+nonzero, unique) are eager-only since their shapes are data-dependent.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype, to_jax_dtype
+from ..core.op_registry import register_op
+from ..core.tensor import Tensor
+from ._dispatch import apply, as_tensor, int_or_tuple
+
+
+@register_op("cast", tensor_method="astype")
+def cast(x, dtype):
+    x = as_tensor(x)
+    jdt = to_jax_dtype(convert_dtype(dtype))
+    return apply("cast", lambda xv: xv.astype(jdt), x)
+
+
+astype = cast
+
+
+@register_op("reshape")
+def reshape(x, shape, name=None):
+    x = as_tensor(x)
+    shape = int_or_tuple(shape)
+    shape = (shape,) if isinstance(shape, int) else shape
+    return apply("reshape", lambda xv: jnp.reshape(xv, shape), x)
+
+
+@register_op("reshape_")
+def reshape_(x, shape, name=None):
+    return x._inplace_from(reshape(x, shape))
+
+
+@register_op("flatten")
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = as_tensor(x)
+    nd = x.ndim
+    s = start_axis % nd if start_axis < 0 else start_axis
+    e = stop_axis % nd if stop_axis < 0 else stop_axis
+
+    def fn(xv):
+        new_shape = xv.shape[:s] + (-1,) + xv.shape[e + 1 :]
+        return jnp.reshape(xv, new_shape)
+
+    return apply("flatten", fn, x)
+
+
+@register_op("squeeze")
+def squeeze(x, axis=None, name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        if axis is None:
+            return jnp.squeeze(xv)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a % xv.ndim for a in axes)
+        axes = tuple(a for a in axes if xv.shape[a] == 1)
+        return jnp.squeeze(xv, axis=axes) if axes else xv
+
+    return apply("squeeze", fn, x)
+
+
+@register_op("unsqueeze")
+def unsqueeze(x, axis, name=None):
+    x = as_tensor(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a._value) if isinstance(a, Tensor) else int(a) for a in axes]
+
+    def fn(xv):
+        out = xv
+        for a in sorted([a % (out.ndim + 1 + len(axes) - 1) if a < 0 else a for a in axes]):
+            out = jnp.expand_dims(out, a)
+        return out
+
+    return apply("unsqueeze", fn, x)
+
+
+@register_op("concat")
+def concat(x, axis=0, name=None):
+    tensors = [as_tensor(t) for t in x]
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    return apply("concat", lambda *vals: jnp.concatenate(vals, axis=ax), *tensors)
+
+
+@register_op("stack")
+def stack(x, axis=0, name=None):
+    tensors = [as_tensor(t) for t in x]
+    return apply("stack", lambda *vals: jnp.stack(vals, axis=axis), *tensors)
+
+
+@register_op("unstack")
+def unstack(x, axis=0, num=None, name=None):
+    x = as_tensor(x)
+    n = num or x.shape[axis]
+    outs = apply("unstack", lambda xv: tuple(jnp.moveaxis(xv, axis, 0)[i] for i in range(n)), x)
+    return list(outs)
+
+
+@register_op("unbind")
+def unbind(input, axis=0):
+    return unstack(input, axis)
+
+
+@register_op("split")
+def split(x, num_or_sections, axis=0, name=None):
+    x = as_tensor(x)
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+
+    def fn(xv):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(xv, num_or_sections, axis=ax))
+        sections = [s if s != -1 else xv.shape[ax] - sum(v for v in num_or_sections if v != -1) for s in num_or_sections]
+        idx = np.cumsum(sections)[:-1].tolist()
+        return tuple(jnp.split(xv, idx, axis=ax))
+
+    return list(apply("split", fn, x))
+
+
+@register_op("chunk")
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+@register_op("tile")
+def tile(x, repeat_times, name=None):
+    x = as_tensor(x)
+    reps = int_or_tuple(repeat_times)
+    reps = (reps,) if isinstance(reps, int) else reps
+    return apply("tile", lambda xv: jnp.tile(xv, reps), x)
+
+
+@register_op("expand")
+def expand(x, shape, name=None):
+    x = as_tensor(x)
+    shape = int_or_tuple(shape)
+    shape = (shape,) if isinstance(shape, int) else shape
+
+    def fn(xv):
+        tgt = [xv.shape[i - (len(shape) - xv.ndim)] if s == -1 else s for i, s in enumerate(shape)]
+        return jnp.broadcast_to(xv, tgt)
+
+    return apply("expand", fn, x)
+
+
+@register_op("expand_as")
+def expand_as(x, y, name=None):
+    return expand(x, as_tensor(y).shape)
+
+
+@register_op("broadcast_to")
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+@register_op("broadcast_tensors")
+def broadcast_tensors(input, name=None):
+    tensors = [as_tensor(t) for t in input]
+    return list(apply("broadcast_tensors", lambda *vals: tuple(jnp.broadcast_arrays(*vals)), *tensors))
+
+
+@register_op("broadcast_shape")
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@register_op("gather")
+def gather(x, index, axis=0, name=None):
+    x, index = as_tensor(x), as_tensor(index)
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+
+    def fn(xv, iv):
+        return jnp.take(xv, iv.reshape(-1) if iv.ndim > 1 else iv, axis=ax)
+
+    return apply("gather", fn, x, index)
+
+
+@register_op("gather_nd")
+def gather_nd(x, index, name=None):
+    x, index = as_tensor(x), as_tensor(index)
+
+    def fn(xv, iv):
+        idx_tuple = tuple(jnp.moveaxis(iv, -1, 0))
+        return xv[idx_tuple]
+
+    return apply("gather_nd", fn, x, index)
+
+
+@register_op("scatter")
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = as_tensor(x), as_tensor(index), as_tensor(updates)
+
+    def fn(xv, iv, uv):
+        iv = iv.reshape(-1)
+        if overwrite:
+            return xv.at[iv].set(uv)
+        # paddle overwrite=False: zero the target rows then scatter-add
+        zeroed = xv.at[iv].set(jnp.zeros_like(uv))
+        return zeroed.at[iv].add(uv)
+
+    return apply("scatter", fn, x, index, updates)
+
+
+@register_op("scatter_")
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._inplace_from(scatter(x, index, updates, overwrite))
+
+
+@register_op("scatter_nd")
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = as_tensor(index), as_tensor(updates)
+    shape = int_or_tuple(shape)
+
+    def fn(iv, uv):
+        zeros = jnp.zeros(shape, uv.dtype)
+        idx_tuple = tuple(jnp.moveaxis(iv, -1, 0))
+        return zeros.at[idx_tuple].add(uv)
+
+    return apply("scatter_nd", fn, index, updates)
+
+
+@register_op("scatter_nd_add")
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = as_tensor(x), as_tensor(index), as_tensor(updates)
+
+    def fn(xv, iv, uv):
+        idx_tuple = tuple(jnp.moveaxis(iv, -1, 0))
+        return xv.at[idx_tuple].add(uv)
+
+    return apply("scatter_nd_add", fn, x, index, updates)
+
+
+@register_op("index_select")
+def index_select(x, index, axis=0, name=None):
+    x, index = as_tensor(x), as_tensor(index)
+    return apply("index_select", lambda xv, iv: jnp.take(xv, iv, axis=axis), x, index)
+
+
+@register_op("index_sample")
+def index_sample(x, index):
+    x, index = as_tensor(x), as_tensor(index)
+
+    def fn(xv, iv):
+        rows = jnp.arange(xv.shape[0])[:, None]
+        return xv[rows, iv]
+
+    return apply("index_sample", fn, x, index)
+
+
+@register_op("index_add")
+def index_add(x, index, axis, value, name=None):
+    x, index, value = as_tensor(x), as_tensor(index), as_tensor(value)
+
+    def fn(xv, iv, vv):
+        moved = jnp.moveaxis(xv, axis, 0)
+        vmoved = jnp.moveaxis(vv, axis, 0)
+        return jnp.moveaxis(moved.at[iv].add(vmoved), 0, axis)
+
+    return apply("index_add", fn, x, index, value)
+
+
+@register_op("index_put")
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = as_tensor(x)
+    value = as_tensor(value)
+    idx = tuple(as_tensor(i)._value for i in indices)
+
+    def fn(xv, vv):
+        return xv.at[idx].add(vv) if accumulate else xv.at[idx].set(vv)
+
+    return apply("index_put", fn, x, value)
+
+
+@register_op("masked_select")
+def masked_select(x, mask, name=None):
+    x, mask = as_tensor(x), as_tensor(mask)
+    xv, mv = np.asarray(x._value), np.asarray(mask._value)
+    return Tensor(jnp.asarray(np.broadcast_to(xv, np.broadcast_shapes(xv.shape, mv.shape))[np.broadcast_to(mv, np.broadcast_shapes(xv.shape, mv.shape))]))
+
+
+@register_op("masked_fill")
+def masked_fill(x, mask, value, name=None):
+    x, mask = as_tensor(x), as_tensor(mask)
+    v = value._value if isinstance(value, Tensor) else value
+    return apply("masked_fill", lambda xv, mv: jnp.where(mv, jnp.asarray(v, xv.dtype), xv), x, mask)
+
+
+@register_op("where")
+def where(condition, x=None, y=None, name=None):
+    condition = as_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply("where", lambda cv, xv, yv: jnp.where(cv, xv, yv), condition, as_tensor(x), as_tensor(y))
+
+
+@register_op("nonzero")
+def nonzero(x, as_tuple=False):
+    x = as_tensor(x)
+    nz = np.nonzero(np.asarray(x._value))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(v[:, None], jnp.int64)) for v in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+@register_op("roll")
+def roll(x, shifts, axis=None, name=None):
+    x = as_tensor(x)
+    return apply("roll", lambda xv: jnp.roll(xv, shifts, axis=axis), x)
+
+
+@register_op("flip")
+def flip(x, axis, name=None):
+    x = as_tensor(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply("flip", lambda xv: jnp.flip(xv, axis=tuple(axes)), x)
+
+
+@register_op("rot90")
+def rot90(x, k=1, axes=(0, 1), name=None):
+    x = as_tensor(x)
+    return apply("rot90", lambda xv: jnp.rot90(xv, k=k, axes=tuple(axes)), x)
+
+
+@register_op("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = as_tensor(x)
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._value)
+        return Tensor(jnp.asarray(np.repeat(np.asarray(x._value), reps, axis=axis)))
+    return apply("repeat_interleave", lambda xv: jnp.repeat(xv, repeats, axis=axis), x)
+
+
+@register_op("take_along_axis")
+def take_along_axis(arr, indices, axis, broadcast=True):
+    arr, indices = as_tensor(arr), as_tensor(indices)
+    return apply("take_along_axis", lambda av, iv: jnp.take_along_axis(av, iv, axis=axis), arr, indices)
+
+
+@register_op("put_along_axis")
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True):
+    arr, indices = as_tensor(arr), as_tensor(indices)
+    values = as_tensor(values)
+
+    def fn(av, iv, vv):
+        vv = jnp.broadcast_to(vv, iv.shape) if broadcast else vv
+        mode = {"assign": "none", "add": "add", "multiply": "mul", "mul": "mul"}[reduce]
+        if mode == "none":
+            return jnp.put_along_axis(av, iv, vv.astype(av.dtype), axis=axis, inplace=False)
+        moved_a, moved_i, moved_v = jnp.moveaxis(av, axis, 0), jnp.moveaxis(iv, axis, 0), jnp.moveaxis(vv, axis, 0)
+        grid = jnp.indices(moved_i.shape)
+        idx = (moved_i,) + tuple(grid[1:])
+        updated = moved_a.at[idx].add(moved_v) if mode == "add" else moved_a.at[idx].multiply(moved_v)
+        return jnp.moveaxis(updated, 0, axis)
+
+    return apply("put_along_axis", fn, arr, indices, values)
+
+
+@register_op("take")
+def take(x, index, mode="raise", name=None):
+    x, index = as_tensor(x), as_tensor(index)
+    jmode = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return apply("take", lambda xv, iv: jnp.take(xv.reshape(-1), iv, mode=jmode), x, index)
+
+
+@register_op("pad")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    pad = int_or_tuple(pad)
+    pad = (pad,) if isinstance(pad, int) else list(pad)
+
+    def fn(xv):
+        nd = xv.ndim
+        if len(pad) == 2 * nd:
+            width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle convention: pad applies to the trailing spatial dims,
+            # ordered last-dim-first pairs (like torch.nn.functional.pad)
+            npairs = len(pad) // 2
+            width = [(0, 0)] * (nd - npairs) + [
+                (pad[2 * (npairs - 1 - i)], pad[2 * (npairs - 1 - i) + 1]) for i in range(npairs)
+            ]
+            if len(pad) == 4 and nd == 4 and data_format == "NCHW":
+                width = [(0, 0), (0, 0), (pad[2], pad[3]), (pad[0], pad[1])]
+            elif len(pad) == 4 and nd == 4 and data_format == "NHWC":
+                width = [(0, 0), (pad[2], pad[3]), (pad[0], pad[1]), (0, 0)]
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(xv, width, mode="constant", constant_values=value)
+        return jnp.pad(xv, width, mode=jmode)
+
+    return apply("pad", fn, x)
+
+
+@register_op("slice")
+def slice(input, axes, starts, ends):  # noqa: A001
+    x = as_tensor(input)
+    starts = [int(s._value) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e._value) if isinstance(e, Tensor) else int(e) for e in ends]
+
+    def fn(xv):
+        import builtins
+
+        idx = [builtins.slice(None)] * xv.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(s, e)
+        return xv[tuple(idx)]
+
+    return apply("slice", fn, x)
+
+
+@register_op("strided_slice")
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        import builtins
+
+        idx = [builtins.slice(None)] * xv.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(s, e, st)
+        return xv[tuple(idx)]
+
+    return apply("strided_slice", fn, x)
+
+
+@register_op("moveaxis")
+def moveaxis(x, source, destination, name=None):
+    x = as_tensor(x)
+    return apply("moveaxis", lambda xv: jnp.moveaxis(xv, source, destination), x)
+
+
+@register_op("swapaxes")
+def swapaxes(x, axis0, axis1, name=None):
+    x = as_tensor(x)
+    return apply("swapaxes", lambda xv: jnp.swapaxes(xv, axis0, axis1), x)
+
+
+transpose_ = swapaxes
+
+
+@register_op("as_strided")
+def as_strided(x, shape, stride, offset=0, name=None):
+    x = as_tensor(x)
+    out = np.lib.stride_tricks.as_strided(
+        np.asarray(x._value).reshape(-1)[offset:],
+        shape=tuple(shape),
+        strides=tuple(s * x._value.dtype.itemsize for s in stride),
+    )
+    return Tensor(jnp.asarray(out.copy()))
+
+
+@register_op("unique")
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    res = np.unique(
+        np.asarray(x._value), return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis
+    )
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r.astype(np.int64) if i > 0 else r)) for i, r in enumerate(res)]
+    return tuple(outs)
+
+
+@register_op("unique_consecutive")
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = np.asarray(as_tensor(x)._value)
+    if axis is None:
+        x = x.reshape(-1)
+        change = np.concatenate([[True], x[1:] != x[:-1]])
+    else:
+        diff = x.take(range(1, x.shape[axis]), axis=axis) != x.take(range(0, x.shape[axis] - 1), axis=axis)
+        reduce_axes = tuple(i for i in range(diff.ndim) if i != axis)
+        change = np.concatenate([[True], diff.any(axis=reduce_axes) if reduce_axes else diff])
+    vals = x[change] if axis is None else np.compress(change, x, axis=axis)
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(np.cumsum(change) - 1, dtype=np.int64)))
+    if return_counts:
+        idx = np.flatnonzero(change)
+        counts = np.diff(np.concatenate([idx, [len(change)]]))
+        outs.append(Tensor(jnp.asarray(counts, dtype=np.int64)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+@register_op("flip_")  # alias group for the handful of trailing-underscore mutators
+def flip_(x, axis, name=None):
+    return x._inplace_from(flip(x, axis))
+
+
+@register_op("as_complex")
+def as_complex(x, name=None):
+    x = as_tensor(x)
+    return apply("as_complex", lambda xv: jax.lax.complex(xv[..., 0], xv[..., 1]), x)
+
+
+@register_op("as_real")
+def as_real(x, name=None):
+    x = as_tensor(x)
+    return apply("as_real", lambda xv: jnp.stack([jnp.real(xv), jnp.imag(xv)], axis=-1), x)
+
+
+@register_op("tensor_split")
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = as_tensor(x)
+    return [Tensor(v) for v in jnp.array_split(x._value, num_or_indices, axis=axis)]
+
+
+@register_op("hsplit")
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1)
+
+
+@register_op("vsplit")
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+@register_op("hstack")
+def hstack(x, name=None):
+    tensors = [as_tensor(t) for t in x]
+    return apply("hstack", lambda *vals: jnp.hstack(vals), *tensors)
+
+
+@register_op("vstack")
+def vstack(x, name=None):
+    tensors = [as_tensor(t) for t in x]
+    return apply("vstack", lambda *vals: jnp.vstack(vals), *tensors)
+
+
+@register_op("dstack")
+def dstack(x, name=None):
+    tensors = [as_tensor(t) for t in x]
+    return apply("dstack", lambda *vals: jnp.dstack(vals), *tensors)
+
+
+@register_op("atleast_1d")
+def atleast_1d(*inputs, name=None):
+    outs = [apply("atleast_1d", jnp.atleast_1d, as_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+@register_op("atleast_2d")
+def atleast_2d(*inputs, name=None):
+    outs = [apply("atleast_2d", jnp.atleast_2d, as_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+@register_op("atleast_3d")
+def atleast_3d(*inputs, name=None):
+    outs = [apply("atleast_3d", jnp.atleast_3d, as_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+@register_op("crop")
+def crop(x, shape=None, offsets=None, name=None):
+    x = as_tensor(x)
+    shape = int_or_tuple(shape) if shape is not None else tuple(x.shape)
+    offsets = int_or_tuple(offsets) if offsets is not None else tuple([0] * x.ndim)
+
+    def fn(xv):
+        import builtins
+
+        idx = tuple(
+            builtins.slice(o, o + (s if s != -1 else xv.shape[i] - o)) for i, (o, s) in enumerate(zip(offsets, shape))
+        )
+        return xv[idx]
+
+    return apply("crop", fn, x)
+
+
+# ---- __getitem__/__setitem__ support ----
+
+
+def _convert_index(idx):
+    """Convert paddle-style index (may contain Tensors) into jnp-compatible index."""
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(_convert_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx))
+    return idx
+
+
+@register_op("__getitem__")
+def getitem(x, idx):
+    x = as_tensor(x)
+    jidx = _convert_index(idx)
+    # Boolean-mask indexing is data dependent: resolve eagerly.
+    has_bool = isinstance(jidx, jax.Array) and jidx.dtype == jnp.bool_
+    if has_bool:
+        return Tensor(jnp.asarray(np.asarray(x._value)[np.asarray(jidx)]))
+    return apply("getitem", lambda xv: xv[jidx], x)
+
+
+@register_op("__setitem__")
+def setitem(x, idx, value):
+    jidx = _convert_index(idx)
+    if isinstance(value, Tensor):
+        result = apply("setitem", lambda xv, vv: xv.at[jidx].set(vv.astype(xv.dtype)), x, as_tensor(value))
+    else:
+        result = apply("setitem", lambda xv: xv.at[jidx].set(jnp.asarray(value).astype(xv.dtype)), x)
+    x._inplace_from(result)
+    return x

@@ -1,0 +1,356 @@
+"""Elementwise math + reductions.
+
+Reference surface: python/paddle/tensor/math.py (+ phi CPU/GPU kernels under
+paddle/phi/kernels/). Each op is one pure jnp lowering; XLA fuses chains of
+them into single TPU VPU loops, which is the whole point of not hand-writing
+per-op kernels here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op_registry import register_op
+from ..core.tensor import Tensor
+from ._dispatch import apply, as_tensor, binary, normalize_axis, unary
+
+# ---- table-driven unary ops ----
+_UNARY = {
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt,
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+    "trunc": jnp.trunc,
+    "frac": lambda x: x - jnp.trunc(x),
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh,
+    "acosh": jnp.arccosh,
+    "atanh": jnp.arctanh,
+    "erf": jax.lax.erf,
+    "erfinv": jax.lax.erf_inv,
+    "square": jnp.square,
+    "reciprocal": lambda x: 1.0 / x,
+    "neg": jnp.negative,
+    "digamma": jax.lax.digamma,
+    "lgamma": jax.lax.lgamma,
+    "i0": lambda x: jax.scipy.special.i0(x),
+    "i1": lambda x: jax.scipy.special.i1(x),
+    "angle": jnp.angle,
+    "conj": jnp.conj,
+    "real": jnp.real,
+    "imag": jnp.imag,
+    "deg2rad": jnp.deg2rad,
+    "rad2deg": jnp.rad2deg,
+}
+
+_g = globals()
+for _name, _fn in _UNARY.items():
+    _g[_name] = register_op(_name)(unary(_name, _fn))
+
+# ---- table-driven binary ops ----
+_BINARY = {
+    "add": jnp.add,
+    "subtract": jnp.subtract,
+    "multiply": jnp.multiply,
+    "divide": jnp.true_divide,
+    "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod,
+    "remainder": jnp.remainder,
+    "floor_mod": jnp.mod,
+    "pow": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "fmax": jnp.fmax,
+    "fmin": jnp.fmin,
+    "atan2": jnp.arctan2,
+    "logaddexp": jnp.logaddexp,
+    "hypot": jnp.hypot,
+    "copysign": jnp.copysign,
+    "nextafter": jnp.nextafter,
+    "ldexp": jnp.ldexp,
+    "heaviside": jnp.heaviside,
+    "gcd": jnp.gcd,
+    "lcm": jnp.lcm,
+}
+for _name, _fn in _BINARY.items():
+    _g[_name] = register_op(_name)(binary(_name, _fn))
+
+# paddle-style aliases
+sub = subtract  # noqa: F821
+mul = multiply  # noqa: F821
+div = divide  # noqa: F821
+
+
+@register_op("clip")
+def clip(x, min=None, max=None, name=None):
+    x = as_tensor(x)
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply("clip", lambda xv: jnp.clip(xv, lo, hi), x)
+
+
+@register_op("lerp")
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply("lerp", lambda xv, yv, wv: xv + wv * (yv - xv), as_tensor(x), as_tensor(y), weight)
+    return apply("lerp", lambda xv, yv: xv + weight * (yv - xv), as_tensor(x), as_tensor(y))
+
+
+@register_op("logit")
+def logit(x, eps=None, name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        v = jnp.clip(xv, eps, 1 - eps) if eps else xv
+        return jnp.log(v / (1 - v))
+
+    return apply("logit", fn, x)
+
+
+@register_op("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    x = as_tensor(x)
+    return apply("stanh", lambda xv: scale_b * jnp.tanh(scale_a * xv), x)
+
+
+@register_op("multiplex")
+def multiplex(inputs, index, name=None):
+    tensors = [as_tensor(t) for t in inputs] + [as_tensor(index)]
+
+    def fn(*vals):
+        *ins, idx = vals
+        stacked = jnp.stack(ins, axis=0)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1), rows]
+
+    return apply("multiplex", fn, *tensors)
+
+
+@register_op("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = as_tensor(x)
+    s = scale.item() if isinstance(scale, Tensor) else scale
+
+    def fn(xv):
+        out = xv * s + bias if bias_after_scale else (xv + bias) * s
+        return out
+
+    return apply("scale", fn, x)
+
+
+@register_op("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(
+        "addmm",
+        lambda iv, xv, yv: beta * iv + alpha * jnp.matmul(xv, yv),
+        as_tensor(input),
+        as_tensor(x),
+        as_tensor(y),
+    )
+
+
+@register_op("inner")
+def inner(x, y, name=None):
+    return apply("inner", jnp.inner, as_tensor(x), as_tensor(y))
+
+
+@register_op("outer")
+def outer(x, y, name=None):
+    return apply("outer", lambda a, b: jnp.outer(a, b), as_tensor(x), as_tensor(y))
+
+
+@register_op("kron")
+def kron(x, y, name=None):
+    return apply("kron", jnp.kron, as_tensor(x), as_tensor(y))
+
+
+@register_op("trace")
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = as_tensor(x)
+    return apply("trace", lambda xv: jnp.trace(xv, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+@register_op("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    x = as_tensor(x)
+    return apply("diagonal", lambda xv: jnp.diagonal(xv, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+# ---- reductions ----
+
+
+def _reduction(op_name, jfn, int_promote=False):
+    def op(x, axis=None, keepdim=False, name=None):
+        x = as_tensor(x)
+        ax = normalize_axis(axis, x.ndim)
+
+        def fn(xv):
+            out = jfn(xv, axis=ax, keepdims=keepdim)
+            if int_promote and jnp.issubdtype(xv.dtype, jnp.integer):
+                out = out.astype(jnp.int64)
+            return out
+
+        return apply(op_name, fn, x)
+
+    op.__name__ = op_name
+    op.__doc__ = f"Reduction '{op_name}' over axis."
+    return op
+
+
+sum = register_op("sum")(_reduction("sum", jnp.sum, int_promote=True))  # noqa: A001
+mean = register_op("mean")(_reduction("mean", jnp.mean))
+prod = register_op("prod")(_reduction("prod", jnp.prod, int_promote=True))
+max = register_op("max")(_reduction("max", jnp.max))  # noqa: A001
+min = register_op("min")(_reduction("min", jnp.min))  # noqa: A001
+amax = register_op("amax")(_reduction("amax", jnp.max))
+amin = register_op("amin")(_reduction("amin", jnp.min))
+nansum = register_op("nansum")(_reduction("nansum", jnp.nansum))
+nanmean = register_op("nanmean")(_reduction("nanmean", jnp.nanmean))
+logsumexp = register_op("logsumexp")(_reduction("logsumexp", jax.scipy.special.logsumexp))
+
+
+@register_op("std")
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = as_tensor(x)
+    ax = normalize_axis(axis, x.ndim)
+    return apply("std", lambda xv: jnp.std(xv, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x)
+
+
+@register_op("var")
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = as_tensor(x)
+    ax = normalize_axis(axis, x.ndim)
+    return apply("var", lambda xv: jnp.var(xv, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x)
+
+
+@register_op("median")
+def median(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    ax = normalize_axis(axis, x.ndim)
+    return apply("median", lambda xv: jnp.median(xv, axis=ax, keepdims=keepdim), x)
+
+
+@register_op("nanmedian")
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    ax = normalize_axis(axis, x.ndim)
+    return apply("nanmedian", lambda xv: jnp.nanmedian(xv, axis=ax, keepdims=keepdim), x)
+
+
+@register_op("quantile")
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    ax = normalize_axis(axis, x.ndim)
+    return apply("quantile", lambda xv: jnp.quantile(xv, jnp.asarray(q), axis=ax, keepdims=keepdim), x)
+
+
+@register_op("count_nonzero")
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    ax = normalize_axis(axis, x.ndim)
+    return Tensor(jnp.count_nonzero(x._value, axis=ax, keepdims=keepdim).astype(jnp.int64))
+
+
+@register_op("all")
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    x = as_tensor(x)
+    ax = normalize_axis(axis, x.ndim)
+    return Tensor(jnp.all(x._value, axis=ax, keepdims=keepdim))
+
+
+@register_op("any")
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    x = as_tensor(x)
+    ax = normalize_axis(axis, x.ndim)
+    return Tensor(jnp.any(x._value, axis=ax, keepdims=keepdim))
+
+
+@register_op("cumsum")
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        if axis is None:
+            return jnp.cumsum(xv.reshape(-1))
+        return jnp.cumsum(xv, axis=axis)
+
+    return apply("cumsum", fn, x)
+
+
+@register_op("cumprod")
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        if dim is None:
+            return jnp.cumprod(xv.reshape(-1))
+        return jnp.cumprod(xv, axis=dim)
+
+    return apply("cumprod", fn, x)
+
+
+@register_op("cummax")
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    ax = 0 if axis is None else axis
+    xv = x._value.reshape(-1) if axis is None else x._value
+    vals = jax.lax.associative_scan(jnp.maximum, xv, axis=ax)
+    iota = jnp.arange(xv.shape[ax]).reshape([-1 if i == ax else 1 for i in range(xv.ndim)])
+    idx = jax.lax.associative_scan(jnp.maximum, jnp.where(xv == vals, iota, -1), axis=ax)
+    return Tensor(vals), Tensor(idx.astype(jnp.int64))
+
+
+@register_op("cummin")
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    ax = 0 if axis is None else axis
+    xv = x._value.reshape(-1) if axis is None else x._value
+    vals = jax.lax.associative_scan(jnp.minimum, xv, axis=ax)
+    iota = jnp.arange(xv.shape[ax]).reshape([-1 if i == ax else 1 for i in range(xv.ndim)])
+    idx = jax.lax.associative_scan(jnp.maximum, jnp.where(xv == vals, iota, -1), axis=ax)
+    return Tensor(vals), Tensor(idx.astype(jnp.int64))
+
+
+@register_op("logcumsumexp")
+def logcumsumexp(x, axis=None, name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        v = xv.reshape(-1) if axis is None else xv
+        ax = 0 if axis is None else axis
+        return jax.lax.associative_scan(jnp.logaddexp, v, axis=ax)
+
+    return apply("logcumsumexp", fn, x)
+
+
+@register_op("argmax")
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = as_tensor(x)
+    out = jnp.argmax(x._value, axis=axis, keepdims=keepdim if axis is not None else False)
+    return Tensor(out.astype(jnp.int64))
+
+
+@register_op("argmin")
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = as_tensor(x)
+    out = jnp.argmin(x._value, axis=axis, keepdims=keepdim if axis is not None else False)
+    return Tensor(out.astype(jnp.int64))

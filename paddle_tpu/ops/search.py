@@ -1,0 +1,115 @@
+"""Search/sort ops (python/paddle/tensor/search.py analog)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op_registry import register_op
+from ..core.tensor import Tensor
+from ._dispatch import apply, as_tensor
+
+
+@register_op("topk")
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
+    x = as_tensor(x)
+    kk = int(k._value) if isinstance(k, Tensor) else int(k)
+    ax = -1 if axis is None else axis
+
+    def fn(xv):
+        moved = jnp.moveaxis(xv, ax, -1)
+        vals, idx = jax.lax.top_k(moved if largest else -moved, kk)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+
+    vals, idx = apply("topk", fn, x)
+    idx._v = idx._value.astype(jnp.int64)
+    return vals, idx
+
+
+@register_op("sort")
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        out = jnp.sort(xv, axis=axis, stable=True)
+        return jnp.flip(out, axis=axis) if descending else out
+
+    return apply("sort", fn, x)
+
+
+@register_op("argsort")
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    x = as_tensor(x)
+    out = jnp.argsort(x._value, axis=axis, stable=True, descending=descending)
+    return Tensor(out.astype(jnp.int64))
+
+
+@register_op("msort")
+def msort(x, name=None):
+    return sort(x, axis=0)
+
+
+@register_op("searchsorted")
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    s, v = as_tensor(sorted_sequence), as_tensor(values)
+
+    def fn(sv, vv):
+        side = "right" if right else "left"
+        if sv.ndim == 1:
+            out = jnp.searchsorted(sv, vv, side=side)
+        else:
+            out = jax.vmap(lambda srow, vrow: jnp.searchsorted(srow, vrow, side=side))(
+                sv.reshape(-1, sv.shape[-1]), vv.reshape(-1, vv.shape[-1])
+            ).reshape(vv.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return Tensor(fn(s._value, v._value))
+
+
+@register_op("bucketize")
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+@register_op("kthvalue")
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        moved = jnp.moveaxis(xv, axis, -1)
+        srt = jnp.sort(moved, axis=-1)
+        arg = jnp.argsort(moved, axis=-1)
+        vals = srt[..., k - 1]
+        idx = arg[..., k - 1]
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, axis), jnp.expand_dims(idx, axis)
+        return vals, idx
+
+    vals, idx = apply("kthvalue", fn, x)
+    idx._v = idx._value.astype(jnp.int64)
+    return vals, idx
+
+
+@register_op("mode")
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = as_tensor(x)
+    xv = np.asarray(x._value)
+    moved = np.moveaxis(xv, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals, idxs = [], []
+    for row in flat:
+        uniq, counts = np.unique(row, return_counts=True)
+        # paddle returns the largest value among ties; np.unique sorts ascending
+        best = uniq[counts == counts.max()][-1]
+        idx = np.where(row == best)[0][-1]
+        vals.append(best)
+        idxs.append(idx)
+    shape = moved.shape[:-1]
+    vals = np.asarray(vals).reshape(shape)
+    idxs = np.asarray(idxs, dtype=np.int64).reshape(shape)
+    if keepdim:
+        vals, idxs = np.expand_dims(vals, axis), np.expand_dims(idxs, axis)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))

@@ -1,0 +1,33 @@
+"""Test harness config: force CPU platform with 8 virtual devices.
+
+The analog of the reference's subprocess+env distributed-test trick
+(test_dist_base.py): XLA's host-platform device-count flag gives us an
+8-device mesh on CPU so every sharding/collective path is exercised without
+TPU hardware (SURVEY.md §4).
+
+Note: a sitecustomize may have pre-registered an accelerator PJRT plugin and
+pre-imported jax before this file runs, so env vars alone are not enough —
+jax.config.update after import is the authoritative override.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reseed():
+    import paddle_tpu
+
+    paddle_tpu.seed(2024)
+    yield

@@ -1,0 +1,75 @@
+"""OpTest base: per-op numeric check vs numpy + grad check vs jax numeric grads.
+
+Models the reference's OpTest pattern (python/paddle/fluid/tests/unittests/
+eager_op_test.py:324): declare inputs and a numpy reference, check_output
+compares forward results, check_grad compares tape gradients against central
+finite differences.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+class OpTest:
+    rtol = 1e-5
+    atol = 1e-6
+
+    def check_output(self, op_fn, np_fn, inputs, rtol=None, atol=None, **attrs):
+        """Run op_fn(Tensors, **attrs) and np_fn(arrays, **attrs); compare."""
+        tensors = [paddle.to_tensor(x) for x in inputs]
+        got = op_fn(*tensors, **attrs)
+        want = np_fn(*inputs, **attrs)
+        self._compare(got, want, rtol or self.rtol, atol or self.atol)
+        return got
+
+    def _compare(self, got, want, rtol, atol):
+        if isinstance(got, (tuple, list)):
+            for g, w in zip(got, want):
+                self._compare(g, w, rtol, atol)
+            return
+        got_np = got.numpy() if isinstance(got, Tensor) else np.asarray(got)
+        np.testing.assert_allclose(
+            np.asarray(got_np, dtype=np.float64) if np.issubdtype(got_np.dtype, np.floating) else got_np,
+            np.asarray(want, dtype=np.float64) if np.issubdtype(np.asarray(want).dtype, np.floating) else want,
+            rtol=rtol,
+            atol=atol,
+        )
+
+    def check_grad(self, op_fn, inputs, rtol=1e-3, atol=1e-3, eps=1e-4, **attrs):
+        """Compare tape .backward() grads against central finite differences."""
+        tensors = [paddle.to_tensor(np.asarray(x, np.float64), dtype='float64', stop_gradient=False) for x in inputs]
+        out = op_fn(*tensors, **attrs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        loss = out.sum() if out.ndim > 0 else out
+        loss.backward()
+        for i, (t, x) in enumerate(zip(tensors, inputs)):
+            x = np.asarray(x, np.float64)
+            num = np.zeros_like(x)
+            flat = x.reshape(-1)
+            num_flat = num.reshape(-1)
+            for j in range(flat.size):
+                xp, xm = flat.copy(), flat.copy()
+                xp[j] += eps
+                xm[j] -= eps
+
+                def run(arr):
+                    args = [
+                        paddle.to_tensor(
+                            arr.reshape(x.shape) if k == i else np.asarray(inputs[k], np.float64),
+                            dtype="float64",
+                        )
+                        for k in range(len(inputs))
+                    ]
+                    o = op_fn(*args, **attrs)
+                    if isinstance(o, (tuple, list)):
+                        o = o[0]
+                    return float(o.sum().numpy()) if o.ndim > 0 else float(o.numpy())
+
+                num_flat[j] = (run(xp) - run(xm)) / (2 * eps)
+            assert t.grad is not None, f"missing grad for input {i}"
+            np.testing.assert_allclose(t.grad.numpy().astype(np.float64), num, rtol=rtol, atol=atol)

@@ -1,0 +1,208 @@
+"""Eager tape autograd: backward semantics matching the reference eager engine."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+
+class TestBackward(OpTest):
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_clear_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_fanout(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        a = x * 3
+        b = x * 4
+        (a + b).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+    def test_reuse_same_input(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * x  # both operands are the same tensor
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_deep_chain(self):
+        x = paddle.to_tensor([1.5], stop_gradient=False)
+        y = x
+        for _ in range(10):
+            y = y * 1.1
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.1**10], rtol=1e-5)
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([2.0], stop_gradient=True)
+        (x * y).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_detach_blocks(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).detach() * 3
+        assert y.stop_gradient
+        z = x * 2
+        (z.detach() * z).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_matmul_grad(self):
+        self.check_grad(paddle.matmul, [np.random.rand(3, 4), np.random.rand(4, 2)])
+
+    def test_elementwise_grads(self):
+        x = np.random.rand(3, 3) + 0.5
+        self.check_grad(paddle.exp, [x])
+        self.check_grad(paddle.log, [x])
+        self.check_grad(paddle.sqrt, [x])
+        self.check_grad(paddle.tanh, [x])
+
+    def test_reduction_grads(self):
+        x = np.random.rand(3, 4)
+        self.check_grad(lambda t: paddle.mean(t, axis=1), [x])
+        self.check_grad(lambda t: paddle.max(t, axis=0), [x])
+
+    def test_broadcast_grad(self):
+        self.check_grad(paddle.add, [np.random.rand(3, 1), np.random.rand(1, 4)])
+
+    def test_non_scalar_backward_defaults_to_ones(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+    def test_backward_with_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        y.backward(paddle.to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 5
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [10.0])
+
+    def test_double_backward_raises(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 5
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_getitem_grad(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3), stop_gradient=False)
+        x[0].sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[1, 1, 1], [0, 0, 0]])
+
+    def test_concat_grad(self):
+        a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        b = paddle.to_tensor([3.0], stop_gradient=False)
+        paddle.concat([a, b]).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), [1, 1])
+        np.testing.assert_allclose(b.grad.numpy(), [1])
+
+
+class TestNoGrad:
+    def test_no_grad_context(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+        assert y._grad_node is None
+
+    def test_no_grad_decorator(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+
+        @paddle.no_grad()
+        def f(t):
+            return t * 2
+
+        assert f(x).stop_gradient
+
+    def test_enable_grad_nested(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            with paddle.enable_grad():
+                y = x * 2
+        assert not y.stop_gradient
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        (g,) = paddle.grad(y, x)
+        np.testing.assert_allclose(g.numpy(), [4.0])
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_grad_intermediate(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        h = x * 3
+        y = h * h
+        (gh,) = paddle.grad(y, h)
+        np.testing.assert_allclose(gh.numpy(), [12.0])
+
+    def test_hooks(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+
+        def hook(g):
+            seen.append(g.numpy().copy())
+            return g * 2
+
+        x.register_hook(hook)
+        (x * 3).backward()
+        np.testing.assert_allclose(seen[0], [3.0])
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+class TestFunctionalTransforms:
+    def test_vjp(self):
+        out, (g,) = paddle.autograd.vjp(lambda t: t * t, paddle.to_tensor([3.0]))
+        np.testing.assert_allclose(g.numpy(), [6.0])
+
+    def test_jacobian(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        jac = paddle.autograd.jacobian(lambda t: t * t, x)
+        np.testing.assert_allclose(jac.numpy(), np.diag([2.0, 4.0]))
+
+    def test_hessian(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        hes = paddle.autograd.hessian(lambda t: (t * t * t).sum(), x)
+        np.testing.assert_allclose(hes.numpy(), np.diag([6.0, 12.0]))
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Double(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return grad * 2
+
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = Double.apply(x)
+        np.testing.assert_allclose(y.numpy(), [6.0])
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])

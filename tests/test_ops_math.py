@@ -1,0 +1,292 @@
+"""Numeric checks for math/linalg/manipulation/logic/search ops vs numpy."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+rng = np.random.RandomState(7)
+
+
+class TestElementwise(OpTest):
+    def test_binary_table(self):
+        x = rng.rand(3, 4).astype(np.float32) + 0.5
+        y = rng.rand(3, 4).astype(np.float32) + 0.5
+        for pfn, nfn in [
+            (paddle.add, np.add),
+            (paddle.subtract, np.subtract),
+            (paddle.multiply, np.multiply),
+            (paddle.divide, np.divide),
+            (paddle.maximum, np.maximum),
+            (paddle.minimum, np.minimum),
+            (paddle.pow, np.power),
+            (paddle.atan2, np.arctan2),
+        ]:
+            self.check_output(pfn, nfn, [x, y])
+
+    def test_unary_table(self):
+        x = rng.rand(3, 4).astype(np.float32) * 0.8 + 0.1
+        for pfn, nfn in [
+            (paddle.exp, np.exp),
+            (paddle.log, np.log),
+            (paddle.sqrt, np.sqrt),
+            (paddle.abs, np.abs),
+            (paddle.sin, np.sin),
+            (paddle.cos, np.cos),
+            (paddle.tanh, np.tanh),
+            (paddle.floor, np.floor),
+            (paddle.ceil, np.ceil),
+            (paddle.square, np.square),
+            (paddle.log1p, np.log1p),
+            (paddle.expm1, np.expm1),
+        ]:
+            self.check_output(pfn, nfn, [x], rtol=2e-4, atol=1e-5)
+
+    def test_scalar_operands(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        np.testing.assert_allclose((x + 1).numpy(), [2, 3])
+        np.testing.assert_allclose((2 * x).numpy(), [2, 4])
+        np.testing.assert_allclose((1 - x).numpy(), [0, -1])
+        np.testing.assert_allclose((x / 2).numpy(), [0.5, 1.0])
+        np.testing.assert_allclose((x**2).numpy(), [1, 4])
+
+    def test_scalar_keeps_dtype(self):
+        x = paddle.ones([2], dtype="bfloat16")
+        assert (x + 1).dtype.name == "bfloat16"
+        assert (x * 2.5).dtype.name == "bfloat16"
+
+    def test_clip(self):
+        x = np.array([-2.0, 0.5, 3.0], np.float32)
+        self.check_output(paddle.clip, lambda v, **k: np.clip(v, 0.0, 1.0), [x], min=0.0, max=1.0)
+
+    def test_broadcasting(self):
+        x = rng.rand(3, 1).astype(np.float32)
+        y = rng.rand(1, 4).astype(np.float32)
+        self.check_output(paddle.add, np.add, [x, y])
+
+
+class TestReductions(OpTest):
+    def test_reductions(self):
+        x = rng.rand(3, 4, 5).astype(np.float32)
+        self.check_output(paddle.sum, lambda v: np.sum(v), [x])
+        self.check_output(lambda t: paddle.sum(t, axis=1), lambda v: v.sum(axis=1), [x])
+        self.check_output(lambda t: paddle.mean(t, axis=[0, 2]), lambda v: v.mean(axis=(0, 2)), [x])
+        self.check_output(lambda t: paddle.max(t, axis=-1), lambda v: v.max(axis=-1), [x])
+        self.check_output(lambda t: paddle.min(t, axis=0, keepdim=True), lambda v: v.min(axis=0, keepdims=True), [x])
+        self.check_output(paddle.prod, lambda v: np.prod(v), [x])
+
+    def test_std_var(self):
+        x = rng.rand(10, 5).astype(np.float32)
+        self.check_output(paddle.std, lambda v: np.std(v, ddof=1), [x], rtol=1e-4)
+        self.check_output(lambda t: paddle.var(t, axis=0), lambda v: np.var(v, axis=0, ddof=1), [x], rtol=1e-4)
+
+    def test_argmax_argmin(self):
+        x = rng.rand(4, 6).astype(np.float32)
+        self.check_output(paddle.argmax, lambda v: np.argmax(v), [x])
+        self.check_output(lambda t: paddle.argmax(t, axis=1), lambda v: np.argmax(v, axis=1), [x])
+        self.check_output(lambda t: paddle.argmin(t, axis=0), lambda v: np.argmin(v, axis=0), [x])
+
+    def test_cumsum_cumprod(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        self.check_output(lambda t: paddle.cumsum(t, axis=1), lambda v: np.cumsum(v, axis=1), [x])
+        self.check_output(lambda t: paddle.cumprod(t, dim=0), lambda v: np.cumprod(v, axis=0), [x])
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as np_lse
+
+        x = rng.rand(3, 4).astype(np.float32)
+        self.check_output(lambda t: paddle.logsumexp(t, axis=1), lambda v: np_lse(v, axis=1), [x], rtol=1e-5)
+
+
+class TestLinalg(OpTest):
+    def test_matmul(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(4, 5).astype(np.float32)
+        self.check_output(paddle.matmul, np.matmul, [x, y], rtol=1e-4)
+
+    def test_matmul_transpose(self):
+        x = rng.rand(4, 3).astype(np.float32)
+        y = rng.rand(5, 4).astype(np.float32)
+        got = paddle.matmul(paddle.to_tensor(x), paddle.to_tensor(y), transpose_x=True, transpose_y=True)
+        np.testing.assert_allclose(got.numpy(), x.T @ y.T, rtol=1e-4)
+
+    def test_batched_matmul(self):
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        y = rng.rand(2, 4, 5).astype(np.float32)
+        self.check_output(paddle.bmm, np.matmul, [x, y], rtol=1e-4)
+
+    def test_einsum(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(4, 5).astype(np.float32)
+        got = paddle.einsum("ij,jk->ik", paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(got.numpy(), np.einsum("ij,jk->ik", x, y), rtol=1e-4)
+
+    def test_transpose_t(self):
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        self.check_output(lambda t: paddle.transpose(t, [2, 0, 1]), lambda v: v.transpose(2, 0, 1), [x])
+        x2 = rng.rand(3, 4).astype(np.float32)
+        self.check_output(paddle.t, lambda v: v.T, [x2])
+
+    def test_norm(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        self.check_output(paddle.norm, lambda v: np.linalg.norm(v), [x], rtol=1e-4)
+        self.check_output(lambda t: paddle.norm(t, p=1, axis=1), lambda v: np.abs(v).sum(axis=1), [x], rtol=1e-4)
+
+    def test_solve_inverse_det(self):
+        a = (rng.rand(3, 3) + 3 * np.eye(3)).astype(np.float32)
+        b = rng.rand(3, 2).astype(np.float32)
+        self.check_output(paddle.inverse, np.linalg.inv, [a], rtol=1e-3)
+        self.check_output(paddle.solve, np.linalg.solve, [a, b], rtol=1e-3)
+        self.check_output(paddle.det, np.linalg.det, [a], rtol=1e-3)
+
+    def test_cholesky_svd(self):
+        a = rng.rand(3, 3).astype(np.float32)
+        spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        self.check_output(paddle.cholesky, np.linalg.cholesky, [spd], rtol=1e-3)
+        x = rng.rand(4, 3).astype(np.float32)
+        u, s, v = paddle.svd(paddle.to_tensor(x))
+        np.testing.assert_allclose((u.numpy() * s.numpy()) @ v.numpy().T, x, atol=1e-4)
+
+
+class TestManipulation(OpTest):
+    def test_reshape_flatten(self):
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        self.check_output(lambda t: paddle.reshape(t, [6, 4]), lambda v: v.reshape(6, 4), [x])
+        self.check_output(lambda t: paddle.flatten(t, 1, 2), lambda v: v.reshape(2, 12), [x])
+
+    def test_squeeze_unsqueeze(self):
+        x = rng.rand(2, 1, 3).astype(np.float32)
+        self.check_output(paddle.squeeze, lambda v: np.squeeze(v), [x])
+        self.check_output(lambda t: paddle.unsqueeze(t, 0), lambda v: v[None], [x])
+
+    def test_concat_stack_split(self):
+        x = rng.rand(2, 3).astype(np.float32)
+        y = rng.rand(2, 3).astype(np.float32)
+        np.testing.assert_array_equal(
+            paddle.concat([paddle.to_tensor(x), paddle.to_tensor(y)], axis=0).numpy(), np.concatenate([x, y], 0)
+        )
+        np.testing.assert_array_equal(
+            paddle.stack([paddle.to_tensor(x), paddle.to_tensor(y)], axis=1).numpy(), np.stack([x, y], 1)
+        )
+        parts = paddle.split(paddle.to_tensor(x), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        parts = paddle.split(paddle.to_tensor(x), [1, 2], axis=1)
+        assert parts[1].shape == [2, 2]
+
+    def test_gather_scatter(self):
+        x = rng.rand(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        self.check_output(
+            lambda t, i: paddle.gather(t, i), lambda v, i: v[i], [x, idx]
+        )
+        upd = np.ones((2, 3), np.float32)
+        got = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(np.array([1, 3])), paddle.to_tensor(upd))
+        want = x.copy()
+        want[[1, 3]] = 1
+        np.testing.assert_array_equal(got.numpy(), want)
+
+    def test_gather_nd(self):
+        x = rng.rand(3, 4, 5).astype(np.float32)
+        idx = np.array([[0, 1], [2, 3]])
+        got = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_array_equal(got.numpy(), x[[0, 2], [1, 3]])
+
+    def test_where_masked(self):
+        x = rng.rand(3, 3).astype(np.float32)
+        y = rng.rand(3, 3).astype(np.float32)
+        cond = x > 0.5
+        self.check_output(
+            lambda c, a, b: paddle.where(c, a, b), lambda c, a, b: np.where(c, a, b), [cond, x, y]
+        )
+        np.testing.assert_array_equal(
+            paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(cond)).numpy(), x[cond]
+        )
+
+    def test_tile_expand(self):
+        x = rng.rand(1, 3).astype(np.float32)
+        self.check_output(lambda t: paddle.tile(t, [2, 2]), lambda v: np.tile(v, (2, 2)), [x])
+        self.check_output(lambda t: paddle.expand(t, [4, 3]), lambda v: np.broadcast_to(v, (4, 3)), [x])
+
+    def test_pad(self):
+        x = rng.rand(2, 3).astype(np.float32)
+        # len(pad) == 2*ndim: paddle pads from the FIRST dimension onward
+        got = paddle.pad(paddle.to_tensor(x), [1, 1, 2, 2], value=0.5)
+        want = np.pad(x, [(1, 1), (2, 2)], constant_values=0.5)
+        np.testing.assert_array_equal(got.numpy(), want)
+        # 4-element pad on a 4-D NCHW tensor: [left, right, top, bottom] on H/W
+        x4 = rng.rand(1, 1, 2, 2).astype(np.float32)
+        got4 = paddle.pad(paddle.to_tensor(x4), [1, 0, 0, 1])
+        want4 = np.pad(x4, [(0, 0), (0, 0), (0, 1), (1, 0)])
+        np.testing.assert_array_equal(got4.numpy(), want4)
+
+    def test_roll_flip(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        self.check_output(lambda t: paddle.roll(t, 1, axis=0), lambda v: np.roll(v, 1, axis=0), [x])
+        self.check_output(lambda t: paddle.flip(t, axis=1), lambda v: np.flip(v, 1), [x])
+
+    def test_unique_nonzero(self):
+        x = np.array([3, 1, 2, 1, 3])
+        np.testing.assert_array_equal(paddle.unique(paddle.to_tensor(x)).numpy(), [1, 2, 3])
+        nz = paddle.nonzero(paddle.to_tensor(np.array([0, 1, 0, 2])))
+        np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+
+    def test_take_along_put_along(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        idx = np.argsort(x, axis=1)
+        got = paddle.take_along_axis(paddle.to_tensor(x), paddle.to_tensor(idx), axis=1)
+        np.testing.assert_array_equal(got.numpy(), np.take_along_axis(x, idx, 1))
+
+
+class TestLogic(OpTest):
+    def test_comparisons(self):
+        x = np.array([1, 2, 3])
+        y = np.array([2, 2, 2])
+        np.testing.assert_array_equal(paddle.equal(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(), x == y)
+        np.testing.assert_array_equal((paddle.to_tensor(x) > paddle.to_tensor(y)).numpy(), x > y)
+        np.testing.assert_array_equal((paddle.to_tensor(x) <= 2).numpy(), x <= 2)
+
+    def test_allclose_isnan(self):
+        x = np.array([1.0, np.nan, np.inf])
+        np.testing.assert_array_equal(paddle.isnan(paddle.to_tensor(x)).numpy(), np.isnan(x))
+        np.testing.assert_array_equal(paddle.isinf(paddle.to_tensor(x)).numpy(), np.isinf(x))
+        assert bool(paddle.allclose(paddle.to_tensor([1.0]), paddle.to_tensor([1.0 + 1e-9])).numpy())
+
+    def test_logical(self):
+        a = np.array([True, False, True])
+        b = np.array([True, True, False])
+        np.testing.assert_array_equal(paddle.logical_and(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(), a & b)
+        np.testing.assert_array_equal(paddle.logical_not(paddle.to_tensor(a)).numpy(), ~a)
+
+
+class TestSearch(OpTest):
+    def test_topk(self):
+        x = rng.rand(3, 10).astype(np.float32)
+        vals, idx = paddle.topk(paddle.to_tensor(x), k=3, axis=1)
+        want = np.sort(x, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), want, rtol=1e-6)
+        np.testing.assert_array_equal(np.take_along_axis(x, idx.numpy(), 1), want)
+
+    def test_sort_argsort(self):
+        x = rng.rand(4, 5).astype(np.float32)
+        self.check_output(lambda t: paddle.sort(t, axis=1), lambda v: np.sort(v, 1), [x])
+        self.check_output(
+            lambda t: paddle.sort(t, axis=0, descending=True), lambda v: -np.sort(-v, 0), [x]
+        )
+        np.testing.assert_array_equal(paddle.argsort(paddle.to_tensor(x), axis=1).numpy(), np.argsort(x, 1))
+
+    def test_searchsorted(self):
+        s = np.array([1.0, 3.0, 5.0, 7.0])
+        v = np.array([2.0, 5.0, 8.0])
+        got = paddle.searchsorted(paddle.to_tensor(s), paddle.to_tensor(v))
+        np.testing.assert_array_equal(got.numpy(), np.searchsorted(s, v))
+
+
+class TestDtypes(OpTest):
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16", "float16"])
+    def test_matmul_dtypes(self, dtype):
+        x = paddle.ones([4, 4], dtype=dtype)
+        y = paddle.ones([4, 4], dtype=dtype)
+        out = paddle.matmul(x, y)
+        assert out.dtype.name == dtype
+        np.testing.assert_allclose(out.astype("float32").numpy(), np.full((4, 4), 4.0), rtol=1e-2)

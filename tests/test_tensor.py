@@ -1,0 +1,135 @@
+"""Tensor facade basics: creation, metadata, mutation, interop."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Parameter, Tensor
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert t.dtype == paddle.float32
+        np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+    def test_to_tensor_dtype(self):
+        t = paddle.to_tensor([1, 2, 3], dtype="float32")
+        assert t.dtype.name == "float32"
+        t64 = paddle.to_tensor([1, 2, 3])
+        assert t64.dtype.name == "int64" or t64.dtype.name == "int32"
+
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_array_equal(paddle.full([2], 7).numpy(), [7, 7])
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+
+    def test_random_reproducible(self):
+        paddle.seed(42)
+        a = paddle.randn([4, 4])
+        paddle.seed(42)
+        b = paddle.randn([4, 4])
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        c = paddle.randn([4, 4])
+        assert not np.array_equal(b.numpy(), c.numpy())
+
+    def test_like_variants(self):
+        x = paddle.ones([2, 2], dtype="float32")
+        assert paddle.zeros_like(x).numpy().sum() == 0
+        assert paddle.ones_like(x).shape == [2, 2]
+        np.testing.assert_array_equal(paddle.full_like(x, 3).numpy(), np.full((2, 2), 3, np.float32))
+
+
+class TestMetadata:
+    def test_shape_ndim_size(self):
+        t = paddle.zeros([2, 3, 4])
+        assert t.shape == [2, 3, 4]
+        assert t.ndim == 3
+        assert t.size == 24
+
+    def test_item(self):
+        assert paddle.to_tensor(3.5).item() == pytest.approx(3.5)
+        assert paddle.to_tensor([7]).item() == 7
+
+    def test_numpy_interop(self):
+        t = paddle.to_tensor([1.0, 2.0])
+        assert np.asarray(t).tolist() == [1.0, 2.0]
+        assert (np.array(t) + 1).tolist() == [2.0, 3.0]
+
+    def test_len_iter(self):
+        t = paddle.arange(6).reshape([3, 2])
+        assert len(t) == 3
+        rows = [r.numpy().tolist() for r in t]
+        assert rows == [[0, 1], [2, 3], [4, 5]]
+
+
+class TestMutation:
+    def test_set_value(self):
+        t = paddle.zeros([2, 2])
+        t.set_value(np.ones((2, 2), np.float32))
+        assert t.numpy().sum() == 4
+
+    def test_setitem(self):
+        t = paddle.zeros([3, 3])
+        t[0, 0] = 5.0
+        t[1] = np.ones(3)
+        assert t.numpy()[0, 0] == 5
+        assert t.numpy()[1].sum() == 3
+
+    def test_getitem(self):
+        t = paddle.arange(12).reshape([3, 4])
+        assert t[1, 2].item() == 6
+        np.testing.assert_array_equal(t[0].numpy(), [0, 1, 2, 3])
+        np.testing.assert_array_equal(t[:, 1].numpy(), [1, 5, 9])
+        np.testing.assert_array_equal(t[::2].numpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+
+    def test_getitem_tensor_index(self):
+        t = paddle.arange(10)
+        idx = paddle.to_tensor([1, 3, 5])
+        np.testing.assert_array_equal(t[idx].numpy(), [1, 3, 5])
+
+    def test_bool_mask(self):
+        t = paddle.arange(6)
+        mask = t > 3
+        np.testing.assert_array_equal(t[mask].numpy(), [4, 5])
+
+    def test_inplace_ops(self):
+        t = paddle.ones([2])
+        t.add_(paddle.ones([2]))
+        np.testing.assert_array_equal(t.numpy(), [2, 2])
+        t.zero_()
+        assert t.numpy().sum() == 0
+        t.fill_(3)
+        assert t.numpy().sum() == 6
+
+
+class TestParameter:
+    def test_parameter_trainable(self):
+        p = Parameter(np.zeros((2, 2), np.float32))
+        assert not p.stop_gradient
+        assert p.persistable
+
+    def test_detach(self):
+        p = Parameter(np.ones((2,), np.float32))
+        d = p.detach()
+        assert d.stop_gradient
+        # detach shares value semantics (functional arrays: same buffer)
+        np.testing.assert_array_equal(d.numpy(), p.numpy())
+
+    def test_astype_cast(self):
+        t = paddle.to_tensor([1.7, 2.3])
+        i = t.astype("int32")
+        assert i.dtype.name == "int32"
+        np.testing.assert_array_equal(i.numpy(), [1, 2])
+
+    def test_clone_preserves_grad_flow(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x.clone() * 3
+        y.backward()
+        np.testing.assert_array_equal(x.grad.numpy(), [3.0])
