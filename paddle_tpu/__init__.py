@@ -53,6 +53,11 @@ from .ops.logic import is_tensor  # noqa: F401
 # Subsystem namespaces land here as they are built out (nn, optimizer, io,
 # distributed, jit, ...). Each addition extends this import block.
 from . import autograd  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from .param_attr import ParamAttr  # noqa: F401,E402
 
 # paddle.grad
 from .core.autograd import grad  # noqa: F401,E402

@@ -1,0 +1,4 @@
+"""paddle.amp namespace."""
+
+from .auto_cast import amp_guard, auto_cast, decorate  # noqa: F401
+from .grad_scaler import GradScaler  # noqa: F401

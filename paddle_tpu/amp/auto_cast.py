@@ -1,0 +1,105 @@
+"""AMP autocast (python/paddle/amp/auto_cast.py analog).
+
+The reference's eager codegen injects per-op AMP casts (eager_gen.py AMP
+hooks); here the cast policy lives at the single dispatch seam
+(ops/_dispatch.apply consults amp_state). O1 = whitelist ops run in bf16;
+O2 = the whole model is cast once (Layer.to('bfloat16')) with fp32 master
+weights in the optimizer. On TPU the default amp dtype is bfloat16, which
+needs no loss scaling — GradScaler degrades to a pass-through but keeps the
+reference API.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from ..core.dtype import convert_dtype
+from ..core.flags import flag_value
+
+_tls = threading.local()
+
+# mirrors the reference's default white/black lists (fp16 lists in
+# python/paddle/amp/amp_lists.py): matmul-class ops benefit from bf16 MXU;
+# reductions/softmax/norms stay fp32.
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "sdpa", "sdpa_pallas", "addmm", "bilinear",
+}
+BLACK_LIST = {
+    "exp", "log", "softmax", "log_softmax", "cross_entropy", "mse_loss",
+    "layer_norm", "rms_norm", "batch_norm", "group_norm", "instance_norm",
+    "softmax_with_cross_entropy", "sum", "mean", "cumsum", "logsumexp",
+}
+
+
+class _AmpState:
+    __slots__ = ("enable", "dtype", "level", "custom_white", "custom_black")
+
+    def __init__(self, enable, dtype, level, custom_white, custom_black):
+        self.enable = enable
+        self.dtype = dtype
+        self.level = level
+        self.custom_white = custom_white or set()
+        self.custom_black = custom_black or set()
+
+
+def amp_state():
+    return getattr(_tls, "amp", None)
+
+
+def amp_dtype_for(op_name: str):
+    """Dispatch-seam hook: returns a target dtype name if the op's floating
+    inputs should be cast (low-precision for white-list ops, float32 for
+    black-list ops), or None to leave inputs untouched."""
+    state = amp_state()
+    if state is None or not state.enable or state.level == "O0":
+        return None
+    base = op_name.split(".")[-1]
+    if base == "cast":  # the cast op itself must never re-enter autocast
+        return None
+    if base in state.custom_black or base in BLACK_LIST:
+        return "float32"  # reference O1 semantics: black-list ops run fp32
+    if state.level == "O2":
+        return state.dtype
+    if base in state.custom_white or base in WHITE_LIST:
+        return state.dtype
+    return None
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype=None):
+    if dtype is None:
+        dtype = flag_value("amp_dtype")
+    dtype = convert_dtype(dtype)
+    prev = amp_state()
+    _tls.amp = _AmpState(enable, dtype, level, set(custom_white_list or []), set(custom_black_list or []))
+    try:
+        yield
+    finally:
+        _tls.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype=None, master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to amp dtype, enable master weights."""
+    if dtype is None:
+        dtype = flag_value("amp_dtype")
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        for opt in opt_list:
+            if master_weight is not False and level == "O2":
+                opt._multi_precision = True
+        if single_model and single_opt:
+            return model_list[0], opt_list[0]
+        return model_list if not single_model else model_list[0], opt_list if not single_opt else opt_list[0]
+    return model_list[0] if single_model else model_list

@@ -90,6 +90,22 @@ class Tensor:
     def is_leaf(self) -> bool:
         return self._grad_node is None
 
+    def __deepcopy__(self, memo):
+        # fresh uid + name: overlay keys and optimizer-state keys must stay
+        # unique per live tensor (deepcopied transformer layers would otherwise
+        # collide in Optimizer.state_dict, which keys accumulators by name)
+        cls = type(self)
+        new = cls.__new__(cls)
+        new.__dict__.update(self.__dict__)
+        new._v = self._value
+        new._uid = next(_uid_counter)
+        new.name = f"{self.name}@copy{new._uid}"
+        new._grad_node = None
+        new._hooks = []
+        new.grad = None
+        memo[id(self)] = new
+        return new
+
     # jax interop: jnp.asarray(tensor) works via this protocol
     def __jax_array__(self):
         return self._value

@@ -1,0 +1,88 @@
+"""Gradient clipping (python/paddle/nn/clip.py analog).
+
+ClipGradByGlobalNorm matches the reference semantics (global norm across the
+full param group, scale all grads by clip_norm/max(norm, clip_norm)). In the
+distributed regime the same class is reused by HybridParallelClipGrad
+(paddle_tpu/distributed/fleet) where the norm reduction spans mesh axes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g._value.astype(jnp.float32))))
+            scale = self.clip_norm / jnp.maximum(norm, self.clip_norm)
+            out.append((p, Tensor((g._value * scale).astype(g._value.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _global_norm_sq(self, grads):
+        return sum(jnp.sum(jnp.square(g._value.astype(jnp.float32))) for g in grads)
+
+    def __call__(self, params_grads):
+        clippable = [(p, g) for p, g in params_grads if g is not None and getattr(p, "need_clip", True)]
+        if not clippable:
+            return params_grads
+        gn_sq = self._global_norm_sq([g for _, g in clippable])
+        global_norm = jnp.sqrt(gn_sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._value * scale).astype(g._value.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """torch-style utility (paddle.nn.utils.clip_grad_norm_)."""
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p.grad._value)) for p in params]))
+    else:
+        total = jnp.sum(jnp.stack([jnp.sum(jnp.abs(p.grad._value) ** norm_type) for p in params])) ** (1.0 / norm_type)
+    scale = max_norm / jnp.maximum(total, max_norm)
+    for p in params:
+        p.grad._v = (p.grad._value * scale).astype(p.grad._value.dtype)
+    return Tensor(total)

@@ -1,0 +1,186 @@
+"""Activation functionals (python/paddle/nn/functional/activation.py analog)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op_registry import register_op
+from ...ops._dispatch import apply, as_tensor, unary
+
+_g = globals()
+_SIMPLE = {
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0, 6),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "softsign": jax.nn.soft_sign,
+    "tanhshrink": lambda x: x - jnp.tanh(x),
+    "log_sigmoid": jax.nn.log_sigmoid,
+    "hardswish": lambda x: x * jnp.clip(x + 3, 0, 6) / 6,
+    "hardsigmoid": lambda x: jnp.clip(x / 6 + 0.5, 0, 1),
+    "erf_act": jax.lax.erf,
+}
+for _name, _fn in _SIMPLE.items():
+    if _name == "erf_act":
+        continue
+    _g[_name] = register_op(f"nn.{_name}")(unary(_name, _fn))
+
+
+@register_op("nn.gelu")
+def gelu(x, approximate=False, name=None):
+    x = as_tensor(x)
+    return apply("gelu", lambda xv: jax.nn.gelu(xv, approximate=approximate), x)
+
+
+@register_op("nn.leaky_relu")
+def leaky_relu(x, negative_slope=0.01, name=None):
+    x = as_tensor(x)
+    return apply("leaky_relu", lambda xv: jax.nn.leaky_relu(xv, negative_slope), x)
+
+
+@register_op("nn.elu")
+def elu(x, alpha=1.0, name=None):
+    x = as_tensor(x)
+    return apply("elu", lambda xv: jax.nn.elu(xv, alpha), x)
+
+
+@register_op("nn.celu")
+def celu(x, alpha=1.0, name=None):
+    x = as_tensor(x)
+    return apply("celu", lambda xv: jax.nn.celu(xv, alpha), x)
+
+
+@register_op("nn.selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    x = as_tensor(x)
+    return apply("selu", lambda xv: scale * jnp.where(xv > 0, xv, alpha * jnp.expm1(xv)), x)
+
+
+@register_op("nn.prelu")
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = as_tensor(x), as_tensor(weight)
+
+    def fn(xv, wv):
+        if wv.size > 1 and xv.ndim > 1:
+            ch_axis = 1 if data_format == "NCHW" else xv.ndim - 1
+            shape = [1] * xv.ndim
+            shape[ch_axis] = wv.size
+            wv = wv.reshape(shape)
+        return jnp.where(xv > 0, xv, wv * xv)
+
+    return apply("prelu", fn, x, weight)
+
+
+@register_op("nn.rrelu")
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False, name=None):
+    x = as_tensor(x)
+    if training:
+        from ...core import random as _random
+
+        key = _random.next_key()
+
+        def fn(xv):
+            slope = jax.random.uniform(key, xv.shape, xv.dtype, lower, upper)
+            return jnp.where(xv >= 0, xv, slope * xv)
+
+        return apply("rrelu", fn, x)
+    mid = (lower + upper) / 2
+    return apply("rrelu", lambda xv: jnp.where(xv >= 0, xv, mid * xv), x)
+
+
+@register_op("nn.hardtanh")
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    x = as_tensor(x)
+    return apply("hardtanh", lambda xv: jnp.clip(xv, min, max), x)
+
+
+@register_op("nn.hardshrink")
+def hardshrink(x, threshold=0.5, name=None):
+    x = as_tensor(x)
+    return apply("hardshrink", lambda xv: jnp.where(jnp.abs(xv) > threshold, xv, 0.0), x)
+
+
+@register_op("nn.softshrink")
+def softshrink(x, threshold=0.5, name=None):
+    x = as_tensor(x)
+    return apply(
+        "softshrink",
+        lambda xv: jnp.where(xv > threshold, xv - threshold, jnp.where(xv < -threshold, xv + threshold, 0.0)),
+        x,
+    )
+
+
+@register_op("nn.softplus")
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        scaled = beta * xv
+        return jnp.where(scaled > threshold, xv, jax.nn.softplus(scaled) / beta)
+
+    return apply("softplus", fn, x)
+
+
+@register_op("nn.softmax")
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply("softmax", lambda xv: jax.nn.softmax(xv, axis=axis), x)
+
+
+@register_op("nn.log_softmax")
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply("log_softmax", lambda xv: jax.nn.log_softmax(xv, axis=axis), x)
+
+
+@register_op("nn.gumbel_softmax")
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as _random
+
+    x = as_tensor(x)
+    key = _random.next_key()
+
+    def fn(xv):
+        g = jax.random.gumbel(key, xv.shape, xv.dtype)
+        y = jax.nn.softmax((xv + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = y_hard - jax.lax.stop_gradient(y) + y  # straight-through estimator
+        return y
+
+    return apply("gumbel_softmax", fn, x)
+
+
+@register_op("nn.maxout")
+def maxout(x, groups, axis=1, name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        ax = axis % xv.ndim
+        ch = xv.shape[ax]
+        new_shape = xv.shape[:ax] + (ch // groups, groups) + xv.shape[ax + 1 :]
+        return jnp.max(xv.reshape(new_shape), axis=ax + 1)
+
+    return apply("maxout", fn, x)
+
+
+@register_op("nn.glu")
+def glu(x, axis=-1, name=None):
+    x = as_tensor(x)
+    return apply("glu", lambda xv: jax.nn.glu(xv, axis=axis), x)
+
+
+@register_op("nn.temperature_scaled_softmax")
+def softmax_with_temperature(x, temperature=1.0, axis=-1):
+    x = as_tensor(x)
+    return apply("softmax_t", lambda xv: jax.nn.softmax(xv / temperature, axis=axis), x)

@@ -1,0 +1,112 @@
+"""Attention functionals.
+
+Reference: python/paddle/nn/functional/flash_attention.py backed by
+phi/kernels/gpu/flash_attn_kernel.cu (FlashAttention v1, SURVEY.md §5.7).
+TPU-native design: the public API is identical, but the hot path dispatches to
+a Pallas flash-attention kernel (paddle_tpu/kernels/flash_attention.py) on TPU
+and to this fused jnp/XLA lowering elsewhere. Inputs are [batch, seq, heads,
+head_dim] like the reference.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.flags import flag_value
+from ...core.op_registry import register_op
+from ...ops._dispatch import apply, as_tensor
+
+
+def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None, dropout_key=None):
+    """Reference lowering: [B, S, H, D] in, [B, S, H, D] out, f32 softmax."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    qf = (q * s).astype(q.dtype)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k, preferred_element_type=jnp.float32)
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(causal_mask, logits, jnp.float32(-1e30))
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.float32(-1e30))
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out
+
+
+def _use_pallas(q_dtype) -> bool:
+    if not flag_value("use_pallas_kernels"):
+        return False
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+@register_op("nn.scaled_dot_product_attention")
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+):
+    query, key, value = as_tensor(query), as_tensor(key), as_tensor(value)
+    tensors = [query, key, value] + ([as_tensor(attn_mask)] if attn_mask is not None else [])
+    dropout_key = None
+    if dropout_p > 0.0 and training:
+        from ...core import random as _random
+
+        dropout_key = _random.next_key()
+
+    if _use_pallas(query._jdtype()) and attn_mask is None and dropout_p == 0.0:
+        from ...kernels.flash_attention import flash_attention_fwd
+
+        def fn(q, k, v):
+            return flash_attention_fwd(q, k, v, causal=is_causal)
+
+        return apply("sdpa_pallas", fn, query, key, value)
+
+    def fn(q, k, v, *rest):
+        mask = rest[0] if rest else None
+        return _sdpa_ref(q, k, v, mask=mask, dropout_p=dropout_p if training else 0.0, causal=is_causal, dropout_key=dropout_key)
+
+    return apply("sdpa", fn, *tensors)
+
+
+@register_op("nn.flash_attention")
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False, fixed_seed_offset=None, training=True, name=None):
+    """paddle.nn.functional.flash_attention API (flash_attention.py in reference)."""
+    out = scaled_dot_product_attention(
+        query, key, value, attn_mask=None, dropout_p=dropout, is_causal=causal, training=training
+    )
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+@register_op("nn.flash_attn_unpadded")
+def flash_attn_unpadded(
+    query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0, causal=False, return_softmax=False, training=True, name=None
+):
+    """Varlen API parity: runs dense SDPA with a segment mask built from cu_seqlens."""
+    query, key, value = as_tensor(query), as_tensor(key), as_tensor(value)
+    cu_q = as_tensor(cu_seqlens_q)
+
+    def fn(q, k, v, cq):
+        # inputs are packed [total_tokens, heads, dim]; reconstruct batch mask
+        total, h, d = q.shape
+        b = cq.shape[0] - 1
+        seg_ids = jnp.cumsum(jnp.zeros(total, jnp.int32).at[cq[1:-1]].add(1))
+        qb = q[None]  # treat packed dim as one batch of length total
+        kb = k[None]
+        mask = (seg_ids[:, None] == seg_ids[None, :])[None, None]
+        out = _sdpa_ref(qb, kb, v[None], mask=mask, causal=causal, scale=scale)
+        return out[0]
+
+    return apply("flash_attn_unpadded", fn, query, key, value, cu_q), None

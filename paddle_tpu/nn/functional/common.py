@@ -1,0 +1,288 @@
+"""Common functionals: linear/embedding/dropout/one_hot/interpolate/...
+
+Reference surface: python/paddle/nn/functional/common.py + input.py. Dropout
+draws from the core RNG (traced-seed aware, core/random.py) so masks replay
+correctly under recompute — the analog of the reference's RNG-tracker
+discipline (fleet/layers/mpu/random.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as _random
+from ...core.op_registry import register_op
+from ...core.tensor import Tensor
+from ...ops._dispatch import apply, as_tensor
+
+
+@register_op("nn.linear")
+def linear(x, weight, bias=None, name=None):
+    x, weight = as_tensor(x), as_tensor(weight)
+
+    def _pref(dt):
+        return jnp.float32 if dt in (jnp.bfloat16, jnp.float16) else None
+
+    if bias is not None:
+        bias = as_tensor(bias)
+
+        def fn(xv, wv, bv):
+            out = jnp.matmul(xv, wv, preferred_element_type=_pref(xv.dtype))
+            return (out.astype(xv.dtype) if _pref(xv.dtype) else out) + bv
+
+        return apply("linear", fn, x, weight, bias)
+
+    def fn(xv, wv):
+        out = jnp.matmul(xv, wv, preferred_element_type=_pref(xv.dtype))
+        return out.astype(xv.dtype) if _pref(xv.dtype) else out
+
+    return apply("linear", fn, x, weight)
+
+
+@register_op("nn.embedding")
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    x, weight = as_tensor(x), as_tensor(weight)
+
+    def fn(iv, wv):
+        out = jnp.take(wv, iv, axis=0)
+        if padding_idx is not None:
+            mask = (iv == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros_like(out), out)
+        return out
+
+    return apply("embedding", fn, x, weight)
+
+
+@register_op("nn.one_hot")
+def one_hot(x, num_classes, name=None):
+    x = as_tensor(x)
+    return Tensor(jax.nn.one_hot(x._value, num_classes, dtype=jnp.float32))
+
+
+@register_op("nn.dropout")
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply("dropout", lambda xv: xv * (1 - p), x)
+        return apply("dropout", lambda xv: xv, x)
+    key = _random.next_key()
+
+    def fn(xv):
+        shape = list(xv.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, xv / (1.0 - p), jnp.zeros_like(xv)).astype(xv.dtype)
+        return jnp.where(keep, xv, jnp.zeros_like(xv)).astype(xv.dtype)
+
+    return apply("dropout", fn, x)
+
+
+@register_op("nn.dropout2d")
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+@register_op("nn.dropout3d")
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+@register_op("nn.alpha_dropout")
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        return apply("alpha_dropout", lambda xv: xv, x)
+    key = _random.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(xv):
+        keep = jax.random.bernoulli(key, 1.0 - p, xv.shape)
+        a = (1.0 / (((1.0 - p) * (1.0 + p * alpha_p**2)) ** 0.5))
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, xv, alpha_p) + b).astype(xv.dtype)
+
+    return apply("alpha_dropout", fn, x)
+
+
+@register_op("nn.normalize")
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        norm = jnp.sum(jnp.abs(xv) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return xv / jnp.maximum(norm, epsilon)
+
+    return apply("normalize", fn, x)
+
+
+@register_op("nn.cosine_similarity")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply("cosine_similarity", fn, as_tensor(x1), as_tensor(x2))
+
+
+@register_op("nn.label_smooth")
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = as_tensor(label)
+
+    def fn(lv):
+        k = lv.shape[-1]
+        if prior_dist is not None:
+            prior = jnp.asarray(np.asarray(prior_dist))
+            return (1 - epsilon) * lv + epsilon * prior
+        return (1 - epsilon) * lv + epsilon / k
+
+    return apply("label_smooth", fn, label)
+
+
+@register_op("nn.interpolate")
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, data_format="NCHW", name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        if data_format == "NCHW":
+            spatial = xv.shape[2:]
+        else:
+            spatial = xv.shape[1:-1]
+        if size is not None:
+            out_spatial = tuple(int(s) for s in (size if isinstance(size, (list, tuple)) else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+            out_spatial = tuple(int(s * f) for s, f in zip(spatial, sf))
+        jmode = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic", "trilinear": "linear", "linear": "linear", "area": "linear"}[mode]
+        if data_format == "NCHW":
+            out_shape = xv.shape[:2] + out_spatial
+        else:
+            out_shape = (xv.shape[0],) + out_spatial + (xv.shape[-1],)
+        return jax.image.resize(xv, out_shape, method=jmode)
+
+    return apply("interpolate", fn, x)
+
+
+upsample = interpolate
+
+
+@register_op("nn.unfold")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = as_tensor(x)
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+
+    def fn(xv):
+        n, c, h, w = xv.shape
+        xp = jnp.pad(xv, [(0, 0), (0, 0), (pd[0], pd[1]), (pd[2], pd[3])])
+        oh = (xp.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (xp.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                patches.append(xp[:, :, di : di + oh * st[0] : st[0], dj : dj + ow * st[1] : st[1]])
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return apply("unfold", fn, x)
+
+
+@register_op("nn.fold")
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = as_tensor(x)
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def fn(xv):
+        n, ckk, L = xv.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os_[0] + 2 * pd[0], os_[1] + 2 * pd[1]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        xr = xv.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), xv.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                out = out.at[:, :, di : di + oh * st[0] : st[0], dj : dj + ow * st[1] : st[1]].add(xr[:, :, i, j])
+        return out[:, :, pd[0] : pd[0] + os_[0], pd[1] : pd[1] + os_[1]]
+
+    return apply("fold", fn, x)
+
+
+@register_op("nn.pixel_shuffle")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    r = upscale_factor
+
+    def fn(xv):
+        if data_format == "NCHW":
+            n, c, h, w = xv.shape
+            out = xv.reshape(n, c // (r * r), r, r, h, w)
+            out = out.transpose(0, 1, 4, 2, 5, 3)
+            return out.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = xv.shape
+        out = xv.reshape(n, h, w, r, r, c // (r * r))
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply("pixel_shuffle", fn, x)
+
+
+@register_op("nn.pixel_unshuffle")
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    r = downscale_factor
+
+    def fn(xv):
+        n, c, h, w = xv.shape
+        out = xv.reshape(n, c, h // r, r, w // r, r)
+        out = out.transpose(0, 1, 3, 5, 2, 4)
+        return out.reshape(n, c * r * r, h // r, w // r)
+
+    return apply("pixel_unshuffle", fn, x)
+
+
+@register_op("nn.channel_shuffle")
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    x = as_tensor(x)
+
+    def fn(xv):
+        n, c, h, w = xv.shape
+        out = xv.reshape(n, groups, c // groups, h, w)
+        return out.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+    return apply("channel_shuffle", fn, x)
+
+
+@register_op("nn.bilinear")
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = as_tensor(x1), as_tensor(x2), as_tensor(weight)
+    tensors = [x1, x2, weight] + ([as_tensor(bias)] if bias is not None else [])
+
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    return apply("bilinear", fn, *tensors)

@@ -1,0 +1,193 @@
+"""Convolution functionals lowering to lax.conv_general_dilated.
+
+Reference: python/paddle/nn/functional/conv.py over phi conv kernels
+(phi/kernels/gpu/conv_kernel.cu etc). On TPU, XLA maps conv_general_dilated
+onto the MXU directly — no im2col/cudnn algo selection needed; the autotune
+subsystem of the reference (phi/kernels/autotune) is subsumed by XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op_registry import register_op
+from ...ops._dispatch import apply, as_tensor
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        out = list(v)
+        if len(out) == 1:
+            out = out * n
+        return tuple(int(i) for i in out)
+    return (int(v),) * n
+
+
+def _norm_padding(padding, n, strides=None, dilations=None):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"Bad padding spec {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format, op_name):
+    x, weight = as_tensor(x), as_tensor(weight)
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _norm_padding(padding, n)
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+    if n == 1:
+        dn_str = ("NLC", "LIO", "NLC") if channels_last else ("NCL", "OIL", "NCL")
+        # lax uses single-letter spatial dims; map L->W
+        dn_str = tuple(s.replace("L", "W") for s in dn_str)
+    elif n == 2:
+        dn_str = ("NHWC", "HWIO", "NHWC") if channels_last else ("NCHW", "OIHW", "NCHW")
+    else:
+        dn_str = ("NDHWC", "DHWIO", "NDHWC") if channels_last else ("NCDHW", "OIDHW", "NCDHW")
+
+    tensors = [x, weight] + ([as_tensor(bias)] if bias is not None else [])
+
+    def fn(xv, wv, *rest):
+        # weight layout is paddle's [out_c, in_c/groups, *k]; transpose if channels_last spec expects spatial-first
+        kernel = wv
+        if channels_last:
+            # OI... -> ...IO
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            kernel = jnp.transpose(wv, perm)
+        out = jax.lax.conv_general_dilated(
+            xv,
+            kernel,
+            window_strides=stride,
+            padding=pad,
+            rhs_dilation=dilation,
+            dimension_numbers=dn_str,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if xv.dtype in (jnp.bfloat16, jnp.float16) else None,
+        )
+        out = out.astype(xv.dtype)
+        if rest:
+            bshape = [1] * out.ndim
+            bshape[-1 if channels_last else 1] = rest[0].shape[0]
+            out = out + rest[0].reshape(bshape)
+        return out
+
+    return apply(op_name, fn, *tensors)
+
+
+@register_op("nn.conv1d")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format, "conv1d")
+
+
+@register_op("nn.conv2d")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format, "conv2d")
+
+
+@register_op("nn.conv3d")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, n, data_format, op_name, output_size=None):
+    x, weight = as_tensor(x), as_tensor(weight)
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    pad = _norm_padding(padding, n)
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+    if output_size is not None:
+        # derive output_padding from the requested spatial output size
+        out_sizes = _norm_tuple(output_size, n)
+        spatial_in = tuple(x.shape[1:-1]) if channels_last else tuple(x.shape[2:])
+        ks = tuple(weight.shape[2:])
+        opad = tuple(
+            out_sizes[i]
+            - ((spatial_in[i] - 1) * stride[i] - pad[i][0] - pad[i][1] + dilation[i] * (ks[i] - 1) + 1)
+            for i in range(n)
+        )
+        if any(p < 0 or p >= stride[i] for i, p in enumerate(opad)):
+            raise ValueError(f"output_size {out_sizes} unreachable with stride {stride}")
+    else:
+        opad = _norm_tuple(output_padding, n)
+    if n == 2:
+        dn_str = ("NCHW", "IOHW", "NCHW")
+    elif n == 1:
+        dn_str = ("NCW", "IOW", "NCW")
+    else:
+        dn_str = ("NCDHW", "IODHW", "NCDHW")
+
+    tensors = [x, weight] + ([as_tensor(bias)] if bias is not None else [])
+    ch_axis = -1 if channels_last else 1
+
+    def fn(xv, wv, *rest):
+        if channels_last:  # run the core in NC* layout, move channels back after
+            xv = jnp.moveaxis(xv, -1, 1)
+        # gradient-of-conv formulation: lhs_dilation = stride
+        pads = [
+            (dilation[i] * (wv.shape[2 + i] - 1) - pad[i][0], dilation[i] * (wv.shape[2 + i] - 1) - pad[i][1] + opad[i])
+            for i in range(n)
+        ]
+
+        def one_group(xg, wg):
+            return jax.lax.conv_general_dilated(
+                xg,
+                jnp.flip(wg, axis=tuple(range(2, 2 + n))),
+                window_strides=(1,) * n,
+                padding=pads,
+                lhs_dilation=stride,
+                rhs_dilation=dilation,
+                dimension_numbers=dn_str,
+            )
+
+        if groups > 1:
+            in_per_g = xv.shape[1] // groups
+            w_per_g = wv.shape[0] // groups
+            out = jnp.concatenate(
+                [
+                    one_group(xv[:, g * in_per_g : (g + 1) * in_per_g], wv[g * w_per_g : (g + 1) * w_per_g])
+                    for g in range(groups)
+                ],
+                axis=1,
+            )
+        else:
+            out = one_group(xv, wv)
+        out = out.astype(xv.dtype)
+        if rest:
+            bshape = [1] * out.ndim
+            bshape[1] = rest[0].shape[0]
+            out = out + rest[0].reshape(bshape)
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply(op_name, fn, *tensors)
+
+
+@register_op("nn.conv1d_transpose")
+def conv1d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None
+):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 1, data_format, "conv1d_transpose", output_size=output_size)
+
+
+@register_op("nn.conv2d_transpose")
+def conv2d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None
+):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 2, data_format, "conv2d_transpose", output_size=output_size)
+
+
+@register_op("nn.conv3d_transpose")
+def conv3d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None
+):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 3, data_format, "conv3d_transpose", output_size=output_size)

@@ -1,0 +1,327 @@
+"""Loss functionals (python/paddle/nn/functional/loss.py analog).
+
+cross_entropy follows the reference's softmax_with_cross_entropy semantics
+(phi/kernels/.../cross_entropy_kernel): fused log-softmax + gather, hard or
+soft labels, ignore_index, label_smoothing, class weights.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op_registry import register_op
+from ...ops._dispatch import apply, as_tensor
+
+
+def _reduce(val, reduction, weight_sum=None):
+    if reduction == "none":
+        return val
+    if reduction == "sum":
+        return jnp.sum(val)
+    if weight_sum is not None:
+        return jnp.sum(val) / jnp.maximum(weight_sum, 1e-12)
+    return jnp.mean(val)
+
+
+@register_op("nn.cross_entropy")
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    input, label = as_tensor(input), as_tensor(label)
+    tensors = [input, label] + ([as_tensor(weight)] if weight is not None else [])
+
+    def fn(logits, lab, *rest):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(logits.astype(jnp.float32), 1e-30)
+        )
+        n_classes = logits.shape[axis]
+        if soft_label:
+            soft = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                soft = (1 - label_smoothing) * soft + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+            valid = jnp.ones_like(loss, dtype=bool)
+        else:
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == logp.ndim:
+                lab_i = jnp.squeeze(lab_i, axis=axis)
+            valid = lab_i != ignore_index
+            safe = jnp.where(valid, lab_i, 0)
+            picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis)
+            picked = jnp.squeeze(picked, axis=axis)
+            if label_smoothing > 0:
+                smooth = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+            loss = jnp.where(valid, -picked, 0.0)
+        w_sum = None
+        if rest:
+            wv = rest[0].astype(jnp.float32)
+            if soft_label:
+                loss = loss * jnp.sum(lab.astype(jnp.float32) * wv, axis=axis)
+            else:
+                lab_i = lab.astype(jnp.int32)
+                if lab_i.ndim == logp.ndim:
+                    lab_i = jnp.squeeze(lab_i, axis=axis)
+                safe = jnp.where(lab_i != ignore_index, lab_i, 0)
+                pw = jnp.take(wv, safe) * (lab_i != ignore_index)
+                loss = loss * pw
+                w_sum = jnp.sum(pw)
+        elif not soft_label:
+            w_sum = jnp.sum(valid.astype(jnp.float32))
+        return _reduce(loss, reduction, w_sum)
+
+    return apply("cross_entropy", fn, *tensors)
+
+
+@register_op("nn.softmax_with_cross_entropy")
+def softmax_with_cross_entropy(
+    logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1
+):
+    logits, label = as_tensor(logits), as_tensor(label)
+
+    def fn(lg, lab):
+        logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=axis)
+        if soft_label:
+            loss = -jnp.sum(lab.astype(jnp.float32) * logp, axis=axis, keepdims=True)
+        else:
+            lab_i = lab.astype(jnp.int32)
+            squeeze = lab_i.ndim == logp.ndim
+            if squeeze:
+                lab_i = jnp.squeeze(lab_i, axis=axis)
+            valid = lab_i != ignore_index
+            safe = jnp.where(valid, lab_i, 0)
+            picked = jnp.squeeze(jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis), axis=axis)
+            loss = jnp.expand_dims(jnp.where(valid, -picked, 0.0), axis)
+        if return_softmax:
+            return loss.astype(lg.dtype), jnp.exp(logp).astype(lg.dtype)
+        return loss.astype(lg.dtype)
+
+    return apply("softmax_with_cross_entropy", fn, logits, label)
+
+
+@register_op("nn.nll_loss")
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    tensors = [input, label] + ([as_tensor(weight)] if weight is not None else [])
+
+    def fn(logp, lab, *rest):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)[:, 0]
+        loss = jnp.where(valid, -picked, 0.0)
+        w_sum = None
+        if rest:
+            pw = jnp.take(rest[0], safe) * valid
+            loss = loss * pw
+            w_sum = jnp.sum(pw)
+        else:
+            w_sum = jnp.sum(valid.astype(jnp.float32))
+        return _reduce(loss, reduction, w_sum)
+
+    return apply("nll_loss", fn, *tensors)
+
+
+@register_op("nn.mse_loss")
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply("mse_loss", lambda a, b: _reduce(jnp.square(a - b), reduction), as_tensor(input), as_tensor(label))
+
+
+@register_op("nn.l1_loss")
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply("l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction), as_tensor(input), as_tensor(label))
+
+
+@register_op("nn.smooth_l1_loss")
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = a - b
+        loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d / delta, jnp.abs(d) - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return apply("smooth_l1_loss", fn, as_tensor(input), as_tensor(label))
+
+
+@register_op("nn.huber_loss")
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply("huber_loss", fn, as_tensor(input), as_tensor(label))
+
+
+@register_op("nn.binary_cross_entropy")
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    tensors = [as_tensor(input), as_tensor(label)] + ([as_tensor(weight)] if weight is not None else [])
+
+    def fn(p, t, *rest):
+        p32 = jnp.clip(p.astype(jnp.float32), 1e-12, 1 - 1e-12)
+        loss = -(t * jnp.log(p32) + (1 - t) * jnp.log(1 - p32))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+
+    return apply("bce", fn, *tensors)
+
+
+@register_op("nn.binary_cross_entropy_with_logits")
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    tensors = [as_tensor(logit), as_tensor(label)]
+    if weight is not None:
+        tensors.append(as_tensor(weight))
+    if pos_weight is not None:
+        tensors.append(as_tensor(pos_weight))
+
+    def fn(z, t, *rest):
+        z32, t32 = z.astype(jnp.float32), t.astype(jnp.float32)
+        base = jnp.maximum(z32, 0) - z32 * t32 + jnp.log1p(jnp.exp(-jnp.abs(z32)))
+        i = 0
+        if pos_weight is not None:
+            pw_idx = 1 if weight is not None else 0
+            pw = rest[pw_idx]
+            log_weight = (pw - 1) * t32 + 1
+            base = (1 - t32) * z32 + log_weight * (jnp.log1p(jnp.exp(-jnp.abs(z32))) + jnp.maximum(-z32, 0))
+        if weight is not None:
+            base = base * rest[0]
+        return _reduce(base, reduction)
+
+    return apply("bce_logits", fn, *tensors)
+
+
+@register_op("nn.kl_div")
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(logp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - logp)
+        else:
+            loss = jnp.where(t > 0, t * (jnp.log(jnp.maximum(t, 1e-12)) - logp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply("kl_div", fn, as_tensor(input), as_tensor(label))
+
+
+@register_op("nn.margin_ranking_loss")
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, t):
+        return _reduce(jnp.maximum(0.0, -t * (a - b) + margin), reduction)
+
+    return apply("margin_ranking_loss", fn, as_tensor(input), as_tensor(other), as_tensor(label))
+
+
+@register_op("nn.hinge_embedding_loss")
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def fn(a, t):
+        return _reduce(jnp.where(t == 1, a, jnp.maximum(0.0, margin - a)), reduction)
+
+    return apply("hinge_embedding_loss", fn, as_tensor(input), as_tensor(label))
+
+
+@register_op("nn.cosine_embedding_loss")
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, t):
+        cos = jnp.sum(a * b, axis=-1) / (jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12)
+        return _reduce(jnp.where(t == 1, 1 - cos, jnp.maximum(0.0, cos - margin)), reduction)
+
+    return apply("cosine_embedding_loss", fn, as_tensor(input1), as_tensor(input2), as_tensor(label))
+
+
+@register_op("nn.triplet_margin_loss")
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, axis=-1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, axis=-1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p, axis=-1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply("triplet_margin_loss", fn, as_tensor(input), as_tensor(positive), as_tensor(negative))
+
+
+@register_op("nn.ctc_loss")
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (lax.scan over time).
+
+    Reference: phi warpctc kernel (paddle/phi/kernels/gpu/warpctc_kernel.cu);
+    here the dynamic program is expressed as a scan so XLA compiles it into a
+    single fused loop — no cuDNN/warpctc dependency.
+    """
+    log_probs, labels = as_tensor(log_probs), as_tensor(labels)
+    input_lengths, label_lengths = as_tensor(input_lengths), as_tensor(label_lengths)
+
+    def fn(lp, lab, in_len, lab_len):
+        # lp: [T, B, C] log-softmaxed; lab: [B, S]
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        L = 2 * S + 1
+        neg_inf = jnp.float32(-1e30)
+        alpha0 = jnp.full((B, L), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lab = jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, first_lab, neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+        )
+
+        def step(alpha, lp_t):
+            a_shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, merged + emit
+
+        _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, L]
+        t_idx = jnp.clip(in_len.astype(jnp.int32) - 1, 0, T - 1)
+        final = alphas[t_idx, jnp.arange(B)]  # [B, L]
+        last = jnp.clip(2 * lab_len.astype(jnp.int32), 0, L - 1)
+        ll_blank = jnp.take_along_axis(final, last[:, None], axis=1)[:, 0]
+        ll_label = jnp.take_along_axis(final, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+        loss = -jnp.logaddexp(ll_blank, jnp.where(lab_len > 0, ll_label, neg_inf))
+        if norm_by_times:
+            loss = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        return _reduce(loss, reduction)
+
+    return apply("ctc_loss", fn, log_probs, labels, input_lengths, label_lengths)
+
+
+@register_op("nn.square_error_cost")
+def square_error_cost(input, label):
+    return apply("square_error_cost", lambda a, b: jnp.square(a - b), as_tensor(input), as_tensor(label))
+
+
+@register_op("nn.sigmoid_focal_loss")
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    tensors = [as_tensor(logit), as_tensor(label)] + ([as_tensor(normalizer)] if normalizer is not None else [])
+
+    def fn(z, t, *rest):
+        p = jax.nn.sigmoid(z.astype(jnp.float32))
+        ce = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * t + (1 - p) * (1 - t)
+        mod = (1 - p_t) ** gamma
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * mod * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+
+    return apply("sigmoid_focal_loss", fn, *tensors)

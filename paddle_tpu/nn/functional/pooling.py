@@ -1,0 +1,217 @@
+"""Pooling functionals (python/paddle/nn/functional/pooling.py analog).
+
+max/avg pools lower to lax.reduce_window; ceil_mode is realized as extra
+high-side padding (ignored by the init value for max, excluded from counts for
+avg); return_mask extracts windows with static kernel loops and argmaxes them
+(flattened-input-spatial indices, matching the reference's mask convention).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.op_registry import register_op
+from ...ops._dispatch import apply, as_tensor
+from .conv import _norm_padding, _norm_tuple
+
+
+def _ceil_extra(in_size, k, s, pl, ph, ceil_mode):
+    """Extra high-side padding so the window grid covers the ceil output."""
+    span = in_size + pl + ph - k
+    out_floor = span // s + 1
+    if not ceil_mode:
+        return 0, out_floor
+    out_ceil = math.ceil(span / s) + 1
+    if out_ceil > out_floor:
+        extra = (out_ceil - 1) * s + k - (in_size + pl + ph)
+        return extra, out_ceil
+    return 0, out_floor
+
+
+def _pool(x, kernel, stride, padding, n, data_format, kind, ceil_mode, op_name, exclusive=True):
+    x = as_tensor(x)
+    kernel = _norm_tuple(kernel, n)
+    stride = _norm_tuple(stride if stride is not None else kernel, n)
+    pad = _norm_padding(padding, n)
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def fn(xv):
+        spatial_off = 1 if channels_last else 2
+        if isinstance(pad, str):
+            pads_sp = pad
+            extra_any = False
+        else:
+            pads_sp = []
+            extra_any = False
+            for d in range(n):
+                in_size = xv.shape[spatial_off + d]
+                extra, _ = _ceil_extra(in_size, kernel[d], stride[d], pad[d][0], pad[d][1], ceil_mode)
+                extra_any = extra_any or extra > 0
+                pads_sp.append((pad[d][0], pad[d][1] + extra))
+        if channels_last:
+            window = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            pads = pads_sp if isinstance(pads_sp, str) else [(0, 0)] + pads_sp + [(0, 0)]
+        else:
+            window = (1, 1) + kernel
+            strides = (1, 1) + stride
+            pads = pads_sp if isinstance(pads_sp, str) else [(0, 0), (0, 0)] + pads_sp
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(xv.dtype, jnp.floating) else jnp.iinfo(xv.dtype).min
+            return jax.lax.reduce_window(xv, jnp.asarray(init, xv.dtype), jax.lax.max, window, strides, pads)
+        out = jax.lax.reduce_window(xv, jnp.zeros((), xv.dtype), jax.lax.add, window, strides, pads)
+        has_pad = not isinstance(pads, str) and any(p != (0, 0) for p in pads)
+        if (exclusive and has_pad) or extra_any:
+            ones = jnp.ones_like(xv)
+            counts = jax.lax.reduce_window(ones, jnp.zeros((), xv.dtype), jax.lax.add, window, strides, pads)
+            return out / counts
+        return out / jnp.asarray(float(np.prod(kernel)), xv.dtype)
+
+    return apply(op_name, fn, x)
+
+
+def _max_pool_with_mask(x, kernel, stride, padding, n, ceil_mode, op_name):
+    """Static kernel-position loop: values + flattened-spatial argmax indices.
+
+    Only NC*-layout (the reference's return_mask path is NCHW-only too).
+    """
+    x = as_tensor(x)
+    kernel = _norm_tuple(kernel, n)
+    stride = _norm_tuple(stride if stride is not None else kernel, n)
+    pad = _norm_padding(padding, n)
+    if isinstance(pad, str):
+        raise ValueError("return_mask does not support string padding")
+
+    def fn(xv):
+        spatial = xv.shape[2:]
+        pads_sp, out_sizes = [], []
+        for d in range(n):
+            extra, out_d = _ceil_extra(spatial[d], kernel[d], stride[d], pad[d][0], pad[d][1], ceil_mode)
+            pads_sp.append((pad[d][0], pad[d][1] + extra))
+            out_sizes.append(out_d)
+        neg = jnp.asarray(-jnp.inf if jnp.issubdtype(xv.dtype, jnp.floating) else jnp.iinfo(xv.dtype).min, xv.dtype)
+        xp = jnp.pad(xv, [(0, 0), (0, 0)] + pads_sp, constant_values=neg)
+        # gather every kernel offset as a strided slice -> [prod(k), N, C, *out]
+        slices, flat_index = [], []
+        for offsets in np.ndindex(*kernel):
+            idx = [slice(None), slice(None)]
+            for d in range(n):
+                start = offsets[d]
+                idx.append(slice(start, start + out_sizes[d] * stride[d], stride[d]))
+            slices.append(xp[tuple(idx)])
+            flat_index.append(offsets)
+        stacked = jnp.stack(slices, axis=0)
+        best = jnp.argmax(stacked, axis=0)  # [N, C, *out] in [0, prod(k))
+        vals = jnp.max(stacked, axis=0)
+        # local kernel offset -> global flattened input-spatial index
+        grids = jnp.meshgrid(*[jnp.arange(o) for o in out_sizes], indexing="ij")
+        offs = np.asarray(flat_index)  # [prod(k), n]
+        global_idx = jnp.zeros_like(best)
+        coords = []
+        for d in range(n):
+            coord = grids[d] * stride[d] - pads_sp[d][0] + jnp.take(jnp.asarray(offs[:, d]), best)
+            coords.append(coord)
+        for d in range(n):
+            global_idx = global_idx * spatial[d] + jnp.clip(coords[d], 0, spatial[d] - 1)
+        return vals, global_idx.astype(jnp.int32)
+
+    return apply(op_name, fn, x)
+
+
+@register_op("nn.max_pool1d")
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1, ceil_mode, "max_pool1d")
+    return _pool(x, kernel_size, stride, padding, 1, "NCL", "max", ceil_mode, "max_pool1d")
+
+
+@register_op("nn.max_pool2d")
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2, ceil_mode, "max_pool2d")
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "max", ceil_mode, "max_pool2d")
+
+
+@register_op("nn.max_pool3d")
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3, ceil_mode, "max_pool3d")
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "max", ceil_mode, "max_pool3d")
+
+
+@register_op("nn.avg_pool1d")
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "NCL", "avg", ceil_mode, "avg_pool1d", exclusive=exclusive)
+
+
+@register_op("nn.avg_pool2d")
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg", ceil_mode, "avg_pool2d", exclusive=exclusive)
+
+
+@register_op("nn.avg_pool3d")
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", ceil_mode, "avg_pool3d", exclusive=exclusive)
+
+
+def _adaptive_pool(x, output_size, n, reduce_fn, op_name):
+    x = as_tensor(x)
+    out_sizes = _norm_tuple(output_size, n)
+
+    def fn(xv):
+        spatial = xv.shape[2:]
+        out = xv
+        # pool each spatial dim independently with computed windows
+        for d in range(n):
+            in_s, out_s = spatial[d], out_sizes[d]
+            if in_s % out_s == 0:
+                k = in_s // out_s
+                shape = out.shape[: 2 + d] + (out_s, k) + out.shape[2 + d + 1 :]
+                out = reduce_fn(out.reshape(shape), axis=2 + d + 1)
+            else:
+                # general case: gather per-output-bin slices (static loop)
+                starts = [int(np.floor(i * in_s / out_s)) for i in range(out_s)]
+                ends = [int(np.ceil((i + 1) * in_s / out_s)) for i in range(out_s)]
+                pieces = []
+                for s, e in zip(starts, ends):
+                    sl = [slice(None)] * out.ndim
+                    sl[2 + d] = slice(s, e)
+                    pieces.append(reduce_fn(out[tuple(sl)], axis=2 + d, keepdims=True))
+                out = jnp.concatenate(pieces, axis=2 + d)
+        return out
+
+    return apply(op_name, fn, x)
+
+
+@register_op("nn.adaptive_avg_pool1d")
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, jnp.mean, "adaptive_avg_pool1d")
+
+
+@register_op("nn.adaptive_avg_pool2d")
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, jnp.mean, "adaptive_avg_pool2d")
+
+
+@register_op("nn.adaptive_avg_pool3d")
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, jnp.mean, "adaptive_avg_pool3d")
+
+
+@register_op("nn.adaptive_max_pool1d")
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, jnp.max, "adaptive_max_pool1d")
+
+
+@register_op("nn.adaptive_max_pool2d")
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, jnp.max, "adaptive_max_pool2d")
+
+
+@register_op("nn.adaptive_max_pool3d")
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, jnp.max, "adaptive_max_pool3d")

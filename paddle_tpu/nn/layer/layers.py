@@ -1,0 +1,382 @@
+"""Layer: the module base class.
+
+Analog of the reference's paddle.nn.Layer (python/paddle/nn/layer/layers.py):
+parameter/buffer/sublayer registries with attribute routing, state_dict with
+structured names, train/eval mode, forward hooks. The TPU-native twist is
+``functional_state`` + ``functional_call``: any Layer can be run as a pure
+function of {name: array} through the core overlay (core/functional.py),
+which is what jit/pjit train steps trace — the analog of dygraph-to-static
+program capture (python/paddle/jit) without AST rewriting.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from ...core.dtype import convert_dtype, to_jax_dtype
+from ...core.tensor import Parameter, Tensor
+from .. import initializer as I
+
+_dynamic_mode = True
+
+
+def in_dynamic_mode():
+    return _dynamic_mode
+
+
+def enable_static():
+    global _dynamic_mode
+    _dynamic_mode = False
+
+
+def disable_static():
+    global _dynamic_mode
+    _dynamic_mode = True
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, hook_id: int):
+        self._hooks, self._id = hooks, hook_id
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+class Layer:
+    _global_layer_count = 0
+
+    def __init__(self, name_scope: str = None, dtype: str = "float32"):
+        cls = type(self)
+        self._full_name = f"{(name_scope or cls.__name__.lower())}_{Layer._global_layer_count}"
+        Layer._global_layer_count += 1
+        self._dtype = convert_dtype(dtype) or "float32"
+        self.training = True
+        self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks: Dict[int, Callable] = OrderedDict()
+        self._forward_post_hooks: Dict[int, Callable] = OrderedDict()
+        self._hook_id = 0
+        self._casted_dtype = None
+
+    # ---- attribute routing ----
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    del params[name]
+                elif isinstance(value, Tensor):
+                    params[name].set_value(value)
+                    return
+                else:
+                    raise TypeError(f"Cannot assign {type(value)} to parameter {name}")
+            if layers is not None and name in layers and value is None:
+                del layers[name]
+                return
+            if buffers is not None and name in buffers:
+                if value is None or isinstance(value, Tensor):
+                    if value is None:
+                        del buffers[name]
+                    else:
+                        buffers[name] = value
+                    return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for registry in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for registry in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = list(self._parameters) + list(self._sub_layers) + list(self._buffers)
+        return sorted(set(super().__dir__() + extra))
+
+    # ---- construction helpers ----
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype: Optional[str] = None,
+        is_bias: bool = False,
+        default_initializer=None,
+    ) -> Parameter:
+        """create_parameter with the reference's default-initializer rule:
+        XavierUniform for weights, Constant(0) for biases."""
+        dtype = convert_dtype(dtype) if dtype else self._dtype
+        init = I._resolve(
+            default_initializer if attr is None else getattr(attr, "initializer", None) or default_initializer,
+            default=I.Constant(0.0) if is_bias else I.XavierUniform(),
+        )
+        value = init(tuple(int(s) for s in shape), dtype)
+        trainable = getattr(attr, "trainable", True) if attr is not None else True
+        p = Parameter(value, trainable=bool(trainable))
+        if attr is not None:
+            lr = getattr(attr, "learning_rate", None)
+            if lr is not None:
+                p.optimize_attr["learning_rate"] = lr
+            p.regularizer = getattr(attr, "regularizer", None)
+            name = getattr(attr, "name", None)
+            if name:
+                p.name = name
+        return p
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Tensor, persistable: bool = True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        elif tensor is not None:
+            tensor.persistable = True
+        return tensor
+
+    # ---- traversal ----
+    def children(self) -> Iterator["Layer"]:
+        yield from self._sub_layers.values()
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        yield from self._sub_layers.items()
+
+    def sublayers(self, include_self: bool = False):
+        out = []
+        if include_self:
+            out.append(self)
+        for child in self._sub_layers.values():
+            if child is not None:
+                out.extend(child.sublayers(include_self=True))
+        return out
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, child in self._sub_layers.items():
+            if child is None:
+                continue
+            child_prefix = f"{prefix}.{name}" if prefix else name
+            yield from child.named_sublayers(prefix=child_prefix, include_self=True, layers_set=layers_set)
+
+    def parameters(self, include_sublayers: bool = True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True):
+        seen = set()
+        layers = self.named_sublayers(prefix=prefix, include_self=True) if include_sublayers else [(prefix, self)]
+        for lp, layer in layers:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{lp}.{name}" if lp else name), p
+
+    def buffers(self, include_sublayers: bool = True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True):
+        seen = set()
+        layers = self.named_sublayers(prefix=prefix, include_self=True) if include_sublayers else [(prefix, self)]
+        for lp, layer in layers:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{lp}.{name}" if lp else name), b
+
+    # ---- state dict ----
+    def state_dict(self, destination=None, include_sublayers: bool = True, structured_name_prefix: str = "", use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix, include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix, include_sublayers=include_sublayers):
+            shortname = name.rsplit(".", 1)[-1]
+            owner = self
+            if "." in name:
+                # find owner to check persistability
+                path = name[len(structured_name_prefix) + 1 if structured_name_prefix else 0 :]
+                parts = path.split(".")[:-1]
+                for part in parts:
+                    owner = owner._sub_layers.get(part, owner)
+            if shortname not in owner._non_persistable_buffer_names:
+                dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, target in own.items():
+            if name in state_dict:
+                value = state_dict[name]
+                arr = value.numpy() if isinstance(value, Tensor) else np.asarray(value)
+                if tuple(arr.shape) != tuple(target.shape):
+                    raise ValueError(f"shape mismatch for {name}: {arr.shape} vs {tuple(target.shape)}")
+                target.set_value(arr)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ---- modes & utilities ----
+    def train(self):
+        self.training = True
+        for layer in self.sublayers():
+            layer.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for layer in self.sublayers():
+            layer.training = False
+        return self
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            import jax.numpy as jnp
+
+            jdt = to_jax_dtype(convert_dtype(dtype))
+            for p in self.parameters():
+                p._set_value_raw(p._value.astype(jdt))
+            for b in self.buffers():
+                if b is not None and jnp.issubdtype(b._value.dtype, jnp.floating):
+                    b._set_value_raw(b._value.astype(jdt))
+            self._dtype = convert_dtype(dtype)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def full_name(self):
+        return self._full_name
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = [extra] if extra else []
+        for name, child in self._sub_layers.items():
+            child_repr = repr(child).split("\n")
+            lines.append(f"({name}): " + "\n  ".join(child_repr))
+        body = "\n  ".join(lines)
+        return f"{type(self).__name__}({body})" if body else f"{type(self).__name__}()"
+
+    # ---- hooks & call ----
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    # ---- functional bridge (jit/pjit capture) ----
+    def functional_state(self):
+        """Return ({name: param_array}, {name: buffer_array}) snapshots."""
+        params = {name: p._value for name, p in self.named_parameters()}
+        buffers = {name: b._value for name, b in self.named_buffers() if b is not None}
+        return params, buffers
+
+    def functional_call(self, params: dict, buffers: dict, *args, **kwargs):
+        """Run forward with external {name: array} state via the core overlay.
+
+        Returns (output, new_buffers). Safe to call under jax tracing: all
+        reads/writes to parameters and buffers route through the overlay.
+        """
+        from ...core import functional as F
+
+        uid_map = {}
+        name_of_uid = {}
+        for name, p in self.named_parameters():
+            if name in params:
+                uid_map[p._uid] = params[name]
+                name_of_uid[p._uid] = ("p", name)
+        for name, b in self.named_buffers():
+            if b is not None and name in buffers:
+                uid_map[b._uid] = buffers[name]
+                name_of_uid[b._uid] = ("b", name)
+        with F.overlay(uid_map):
+            out = self.forward(*args, **kwargs)
+            new_buffers = {
+                name_of_uid[uid][1]: val for uid, val in uid_map.items() if name_of_uid[uid][0] == "b"
+            }
+        return out, new_buffers

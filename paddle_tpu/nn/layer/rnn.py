@@ -1,0 +1,280 @@
+"""Recurrent layers (python/paddle/nn/layer/rnn.py analog).
+
+The whole unrolled recurrence is ONE pure function built on lax.scan — no
+per-step Python dispatch, so XLA compiles the time loop into a single fused
+while-op (the reference needs cuDNN RNN kernels for this; TPU gets it from
+scan + MXU matmuls directly).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._dispatch import apply, as_tensor
+from .. import initializer as I
+from .layers import Layer
+
+
+def _cell_step(mode, x_t, h, c, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+    if mode == "GRU":
+        # GRU candidate gates the HIDDEN projection with r, so ih/hh are kept
+        # separate (computed once each — two matmuls total per step)
+        ih = x_t @ w_ih.T + (b_ih if b_ih is not None else 0)
+        hh = h @ w_hh.T + (b_hh if b_hh is not None else 0)
+        r_i, z_i, n_i = jnp.split(ih, 3, axis=-1)
+        r_h, z_h, n_h = jnp.split(hh, 3, axis=-1)
+        r = jax.nn.sigmoid(r_i + r_h)
+        z = jax.nn.sigmoid(z_i + z_h)
+        n = jnp.tanh(n_i + r * n_h)
+        h_new = (1 - z) * n + z * h
+        return h_new, c
+    gates = x_t @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih + b_hh
+    if mode == "LSTM":
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    h_new = act(gates)
+    return h_new, c
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode, self.input_size, self.hidden_size = mode, input_size, hidden_size
+        self.num_layers, self.time_major, self.dropout = num_layers, time_major, dropout
+        self.activation = activation
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirect else 1
+        self.num_directions = num_dirs
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN": 1}[mode]
+        stdv = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-stdv, stdv)
+        self._param_names = []
+        for layer in range(num_layers):
+            for direction in range(num_dirs):
+                in_size = input_size if layer == 0 else hidden_size * num_dirs
+                suffix = f"{layer}" + ("_reverse" if direction == 1 else "")
+                names = [f"weight_ih_l{suffix}", f"weight_hh_l{suffix}", f"bias_ih_l{suffix}", f"bias_hh_l{suffix}"]
+                self.add_parameter(names[0], self.create_parameter([gate_mult * hidden_size, in_size], default_initializer=init))
+                self.add_parameter(names[1], self.create_parameter([gate_mult * hidden_size, hidden_size], default_initializer=init))
+                self.add_parameter(names[2], self.create_parameter([gate_mult * hidden_size], default_initializer=init))
+                self.add_parameter(names[3], self.create_parameter([gate_mult * hidden_size], default_initializer=init))
+                self._param_names.append(names)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = as_tensor(inputs)
+        params = []
+        for names in self._param_names:
+            params.extend(self._parameters[n] for n in names)
+
+        mode, num_layers, bidirect = self.mode, self.num_layers, self.bidirect
+        hidden_size, time_major, activation = self.hidden_size, self.time_major, self.activation
+        num_dirs = self.num_directions
+
+        init_h = init_c = None
+        extra = []
+        if initial_states is not None:
+            if mode == "LSTM":
+                init_h, init_c = initial_states
+                extra = [as_tensor(init_h), as_tensor(init_c)]
+            else:
+                init_h = initial_states
+                extra = [as_tensor(init_h)]
+
+        def fn(xv, *pvals):
+            pv = pvals[: len(params)]
+            states = pvals[len(params) :]
+            x = xv if time_major else jnp.swapaxes(xv, 0, 1)  # [T, B, F]
+            T, B = x.shape[0], x.shape[1]
+            if states:
+                h0_all = states[0]
+                c0_all = states[1] if mode == "LSTM" and len(states) > 1 else jnp.zeros_like(h0_all)
+            else:
+                h0_all = jnp.zeros((num_layers * num_dirs, B, hidden_size), x.dtype)
+                c0_all = jnp.zeros_like(h0_all)
+            layer_in = x
+            h_finals, c_finals = [], []
+            idx = 0
+            for layer in range(num_layers):
+                outs_dir = []
+                for direction in range(num_dirs):
+                    w_ih, w_hh, b_ih, b_hh = pv[4 * idx : 4 * idx + 4]
+                    state_idx = layer * num_dirs + direction
+                    h0, c0 = h0_all[state_idx], c0_all[state_idx]
+                    seq = jnp.flip(layer_in, 0) if direction == 1 else layer_in
+
+                    def step(carry, x_t, w_ih=w_ih, w_hh=w_hh, b_ih=b_ih, b_hh=b_hh):
+                        h, c = carry
+                        h2, c2 = _cell_step(mode, x_t, h, c, w_ih, w_hh, b_ih, b_hh, activation)
+                        return (h2, c2), h2
+
+                    (hT, cT), ys = jax.lax.scan(step, (h0, c0), seq)
+                    if direction == 1:
+                        ys = jnp.flip(ys, 0)
+                    outs_dir.append(ys)
+                    h_finals.append(hT)
+                    c_finals.append(cT)
+                    idx += 1
+                layer_in = jnp.concatenate(outs_dir, axis=-1) if num_dirs == 2 else outs_dir[0]
+            out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+            h_stack = jnp.stack(h_finals, 0)
+            if mode == "LSTM":
+                return out, h_stack, jnp.stack(c_finals, 0)
+            return out, h_stack
+
+        results = apply(f"rnn_{mode}", fn, inputs, *params, *extra)
+        if mode == "LSTM":
+            out, h, c = results
+            return out, (h, c)
+        out, h = results
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False,
+                 dropout=0.0, activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers, direction, time_major, dropout, activation)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False,
+                 dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False,
+                 dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction, time_major, dropout)
+
+
+class _CellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None):
+        batch = batch_ref.shape[0]
+        return Tensor(jnp.zeros((batch, self.hidden_size), jnp.float32))
+
+
+class SimpleRNNCell(_CellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kwargs):
+        super().__init__()
+        self.input_size, self.hidden_size, self.activation = input_size, hidden_size, activation
+        stdv = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-stdv, stdv)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        inputs = as_tensor(inputs)
+        h = states if states is not None else self.get_initial_states(inputs)
+        out = apply(
+            "rnn_cell",
+            lambda xv, hv, wi, wh, bi, bh: _cell_step("RNN", xv, hv, None, wi, wh, bi, bh, self.activation)[0],
+            inputs, as_tensor(h), self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+        )
+        return out, out
+
+
+class LSTMCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        stdv = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-stdv, stdv)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        inputs = as_tensor(inputs)
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+        h2, c2 = apply(
+            "lstm_cell",
+            lambda xv, hv, cv, wi, wh, bi, bh: _cell_step("LSTM", xv, hv, cv, wi, wh, bi, bh),
+            inputs, as_tensor(h), as_tensor(c), self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+        )
+        return h2, (h2, c2)
+
+
+class GRUCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        stdv = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-stdv, stdv)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        inputs = as_tensor(inputs)
+        h = states if states is not None else self.get_initial_states(inputs)
+        out = apply(
+            "gru_cell",
+            lambda xv, hv, wi, wh, bi, bh: _cell_step("GRU", xv, hv, None, wi, wh, bi, bh)[0],
+            inputs, as_tensor(h), self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+        )
+        return out, out
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell, self.is_reverse, self.time_major = cell, is_reverse, time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = as_tensor(inputs)
+        # simple eager loop over time using the cell (tape-recorded per step)
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        T = x.shape[0]
+        states = initial_states
+        outs = []
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        for t in steps:
+            out, states = self.cell(x[t], states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ...ops import stack
+
+        out = stack(outs, axis=0)
+        if not self.time_major:
+            out = out.transpose([1, 0, 2])
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import concat
+
+        sf = initial_states[0] if initial_states else None
+        sb = initial_states[1] if initial_states else None
+        out_f, st_f = self.rnn_fw(inputs, sf)
+        out_b, st_b = self.rnn_bw(inputs, sb)
+        return concat([out_f, out_b], axis=-1), (st_f, st_b)

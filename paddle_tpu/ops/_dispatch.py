@@ -45,7 +45,23 @@ def wrap_outputs(out, node):
 
 
 def apply(op_name: str, pure_fn, *tensors: Tensor):
-    """Run a pure function of the tensor values; returns wrapped output pytree."""
+    """Run a pure function of the tensor values; returns wrapped output pytree.
+
+    This is the single dispatch seam: AMP autocast happens here (the analog of
+    the reference's per-op AMP hooks injected by eager codegen).
+    """
+    from ..amp.auto_cast import amp_dtype_for
+    from ..core.dtype import to_jax_dtype
+
+    target = amp_dtype_for(op_name)
+    if target is not None:
+        from .manipulation import cast as _cast  # tape-recorded so grads flow back
+
+        jdt = to_jax_dtype(target)
+        tensors = tuple(
+            _cast(t, target) if jnp.issubdtype(t._value.dtype, jnp.floating) and t._value.dtype != jdt else t
+            for t in tensors
+        )
     out, node = run_op(op_name, pure_fn, tensors)
     return wrap_outputs(out, node)
 

@@ -1,0 +1,18 @@
+"""paddle.optimizer namespace."""
+
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    LBFGS,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Momentum,
+    NAdam,
+    Optimizer,
+    RAdam,
+    RMSProp,
+    SGD,
+)

@@ -1,0 +1,23 @@
+"""Regularizers (python/paddle/regularizer.py analog)."""
+
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, grad_value, param_value):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __call__(self, grad_value, param_value):
+        return grad_value + self.coeff * param_value
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __call__(self, grad_value, param_value):
+        import jax.numpy as jnp
+
+        return grad_value + self.coeff * jnp.sign(param_value)

@@ -1,0 +1,336 @@
+"""Layer system + concrete layers: shapes, semantics, state_dict, grads."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(3)
+
+
+class TestLayerBase:
+    def test_parameter_registration(self):
+        layer = nn.Linear(4, 3)
+        names = [n for n, _ in layer.named_parameters()]
+        assert names == ["weight", "bias"]
+        assert layer.weight.shape == [4, 3]
+        assert layer.bias.shape == [3]
+        assert not layer.weight.stop_gradient
+
+    def test_sublayer_traversal(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        assert len(net.parameters()) == 4
+        names = dict(net.named_parameters())
+        assert "fc1.weight" in names and "fc2.bias" in names
+        assert len(net.sublayers()) == 2
+
+    def test_state_dict_roundtrip(self):
+        net1 = nn.Linear(4, 3)
+        net2 = nn.Linear(4, 3)
+        net2.set_state_dict(net1.state_dict())
+        np.testing.assert_array_equal(net1.weight.numpy(), net2.weight.numpy())
+
+    def test_state_dict_numpy_roundtrip(self):
+        net = nn.Linear(4, 3)
+        sd = {k: v.numpy() for k, v in net.state_dict().items()}
+        net2 = nn.Linear(4, 3)
+        missing, unexpected = net2.set_state_dict(sd)
+        assert not missing and not unexpected
+        np.testing.assert_array_equal(net.bias.numpy(), net2.bias.numpy())
+
+    def test_train_eval_mode(self):
+        net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        assert net.training
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_forward_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        h = net.register_forward_post_hook(lambda layer, inp, out: calls.append(out.shape))
+        net(paddle.ones([1, 2]))
+        assert calls == [[1, 2]]
+        h.remove()
+        net(paddle.ones([1, 2]))
+        assert len(calls) == 1
+
+    def test_apply_and_to_dtype(self):
+        net = nn.Linear(3, 3)
+        net.to(dtype="bfloat16")
+        assert net.weight.dtype.name == "bfloat16"
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        buffer_names = [n for n, _ in bn.named_buffers()]
+        assert "_mean" in buffer_names and "_variance" in buffer_names
+        sd = bn.state_dict()
+        assert "_mean" in sd
+
+
+class TestLayers:
+    def test_linear_matches_numpy(self):
+        layer = nn.Linear(4, 3)
+        x = rng.rand(2, 4).astype(np.float32)
+        got = layer(paddle.to_tensor(x)).numpy()
+        want = x @ layer.weight.numpy() + layer.bias.numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor([[1, 0, 3]]))
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_array_equal(out.numpy()[0, 1], np.zeros(4, np.float32))
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = rng.rand(2, 5, 8).astype(np.float32)
+        out = ln(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), 1, atol=1e-2)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = rng.rand(2, 8).astype(np.float32)
+        out = rn(paddle.to_tensor(x)).numpy()
+        want = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, want, rtol=1e-4)
+
+    def test_batchnorm_train_updates_stats(self):
+        bn = nn.BatchNorm1D(4)
+        x = rng.rand(16, 4).astype(np.float32) * 3 + 1
+        bn.train()
+        out = bn(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy().mean(0), 0, atol=1e-4)
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        out2 = bn(paddle.to_tensor(x))
+        assert out2.shape == [16, 4]
+
+    def test_batchnorm_grad(self):
+        bn = nn.BatchNorm1D(3)
+        x = paddle.to_tensor(rng.rand(8, 3).astype(np.float32), stop_gradient=False)
+        bn(x).sum().backward()
+        assert x.grad is not None
+        assert bn.weight.grad is not None
+
+    def test_dropout_train_eval(self):
+        paddle.seed(7)
+        drop = nn.Dropout(0.5)
+        x = paddle.ones([1000])
+        out = drop(x)
+        frac_zero = (out.numpy() == 0).mean()
+        assert 0.3 < frac_zero < 0.7
+        np.testing.assert_allclose(out.numpy().mean(), 1.0, atol=0.2)  # upscale_in_train
+        drop.eval()
+        np.testing.assert_array_equal(drop(x).numpy(), x.numpy())
+
+    def test_conv2d(self):
+        conv = nn.Conv2D(3, 8, 3, padding=1)
+        x = paddle.to_tensor(rng.rand(2, 3, 8, 8).astype(np.float32))
+        out = conv(x)
+        assert out.shape == [2, 8, 8, 8]
+
+    def test_conv2d_matches_manual(self):
+        conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+        x = rng.rand(1, 1, 3, 3).astype(np.float32)
+        out = conv(paddle.to_tensor(x)).numpy()
+        w = conv.weight.numpy()[0, 0]
+        want = np.zeros((1, 1, 2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                want[0, 0, i, j] = (x[0, 0, i : i + 2, j : j + 2] * w).sum()
+        np.testing.assert_allclose(out, want, rtol=1e-4)
+
+    def test_conv2d_groups_stride(self):
+        conv = nn.Conv2D(4, 8, 3, stride=2, groups=2)
+        out = conv(paddle.to_tensor(rng.rand(1, 4, 9, 9).astype(np.float32)))
+        assert out.shape == [1, 8, 4, 4]
+
+    def test_conv_transpose(self):
+        deconv = nn.Conv2DTranspose(3, 6, 2, stride=2)
+        out = deconv(paddle.to_tensor(rng.rand(1, 3, 4, 4).astype(np.float32)))
+        assert out.shape == [1, 6, 8, 8]
+
+    def test_pools(self):
+        x = paddle.to_tensor(rng.rand(1, 2, 8, 8).astype(np.float32))
+        assert nn.MaxPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+        assert nn.AvgPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+        np.testing.assert_allclose(
+            nn.AdaptiveAvgPool2D(1)(x).numpy()[..., 0, 0], x.numpy().mean((2, 3)), rtol=1e-5
+        )
+
+    def test_maxpool_matches_numpy(self):
+        x = rng.rand(1, 1, 4, 4).astype(np.float32)
+        got = nn.MaxPool2D(2, 2)(paddle.to_tensor(x)).numpy()
+        want = x.reshape(1, 1, 2, 2, 2, 2).max((3, 5))
+        np.testing.assert_array_equal(got, want)
+
+    def test_multihead_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(rng.rand(2, 5, 16).astype(np.float32))
+        out = mha(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.to_tensor(rng.rand(2, 6, 16).astype(np.float32))
+        out = enc(x)
+        assert out.shape == [2, 6, 16]
+        # deepcopied layers must not share parameters
+        p0 = enc.layers[0].linear1.weight
+        p1 = enc.layers[1].linear1.weight
+        assert p0._uid != p1._uid
+
+    def test_lstm(self):
+        lstm = nn.LSTM(input_size=4, hidden_size=8, num_layers=2)
+        x = paddle.to_tensor(rng.rand(3, 7, 4).astype(np.float32))
+        out, (h, c) = lstm(x)
+        assert out.shape == [3, 7, 8]
+        assert h.shape == [2, 3, 8]
+        assert c.shape == [2, 3, 8]
+
+    def test_gru_bidirectional(self):
+        gru = nn.GRU(input_size=4, hidden_size=8, direction="bidirect")
+        x = paddle.to_tensor(rng.rand(2, 5, 4).astype(np.float32))
+        out, h = gru(x)
+        assert out.shape == [2, 5, 16]
+        assert h.shape == [2, 2, 8]
+
+    def test_lstm_grad_flows(self):
+        lstm = nn.LSTM(4, 4)
+        x = paddle.to_tensor(rng.rand(2, 3, 4).astype(np.float32), stop_gradient=False)
+        out, _ = lstm(x)
+        out.sum().backward()
+        assert x.grad is not None
+        assert lstm.weight_ih_l0.grad is not None
+
+    def test_sequential_containers(self):
+        seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        out = seq(paddle.ones([1, 4]))
+        assert out.shape == [1, 2]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        ll.append(nn.Linear(2, 2))
+        assert len(list(ll)) == 4
+
+
+class TestLosses:
+    def test_cross_entropy_hard(self):
+        logits = rng.rand(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 4, 1])
+        got = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels)).numpy()
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        want = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cross_entropy_soft_and_smoothing(self):
+        logits = rng.rand(4, 5).astype(np.float32)
+        soft = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
+        got = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft), soft_label=True)
+        assert got.ndim == 0
+        got_sm = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(np.array([0, 1, 2, 3])), label_smoothing=0.1)
+        assert float(got_sm.numpy()) > 0
+
+    def test_cross_entropy_ignore_index(self):
+        logits = rng.rand(4, 5).astype(np.float32)
+        labels = np.array([0, -100, 2, -100])
+        got = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels), ignore_index=-100).numpy()
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        want = -np.log(p[[0, 2], [0, 2]]).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_mse_bce(self):
+        a = rng.rand(3, 3).astype(np.float32)
+        b = rng.rand(3, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(), ((a - b) ** 2).mean(), rtol=1e-5
+        )
+        logits = rng.randn(4).astype(np.float32)
+        targets = (rng.rand(4) > 0.5).astype(np.float32)
+        got = F.binary_cross_entropy_with_logits(paddle.to_tensor(logits), paddle.to_tensor(targets)).numpy()
+        p = 1 / (1 + np.exp(-logits))
+        want = -(targets * np.log(p) + (1 - targets) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_kl_nll(self):
+        logp = np.log(np.array([[0.5, 0.5], [0.3, 0.7]], np.float32))
+        target = np.array([[0.4, 0.6], [0.5, 0.5]], np.float32)
+        got = F.kl_div(paddle.to_tensor(logp), paddle.to_tensor(target), reduction="sum").numpy()
+        want = (target * (np.log(target) - logp)).sum()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+        labels = np.array([0, 1])
+        got_nll = F.nll_loss(paddle.to_tensor(logp), paddle.to_tensor(labels)).numpy()
+        np.testing.assert_allclose(got_nll, -(logp[0, 0] + logp[1, 1]) / 2, rtol=1e-5)
+
+    def test_loss_layers(self):
+        ce = nn.CrossEntropyLoss()
+        out = ce(paddle.to_tensor(rng.rand(2, 3).astype(np.float32)), paddle.to_tensor(np.array([0, 1])))
+        assert out.ndim == 0
+
+
+class TestAttention:
+    def test_sdpa_matches_naive(self):
+        b, s, h, d = 2, 6, 2, 8
+        q = rng.rand(b, s, h, d).astype(np.float32)
+        k = rng.rand(b, s, h, d).astype(np.float32)
+        v = rng.rand(b, s, h, d).astype(np.float32)
+        got = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v)
+        ).numpy()
+        # naive reference
+        qh = q.transpose(0, 2, 1, 3)
+        kh = k.transpose(0, 2, 1, 3)
+        vh = v.transpose(0, 2, 1, 3)
+        logits = qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(d)
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        want = (p @ vh).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_sdpa_causal(self):
+        b, s, h, d = 1, 4, 1, 4
+        q = rng.rand(b, s, h, d).astype(np.float32)
+        k = rng.rand(b, s, h, d).astype(np.float32)
+        v = rng.rand(b, s, h, d).astype(np.float32)
+        got = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v), is_causal=True
+        ).numpy()
+        # position 0 attends only to itself
+        np.testing.assert_allclose(got[0, 0, 0], v[0, 0, 0], rtol=1e-5)
+
+    def test_flash_attention_api(self):
+        q = paddle.to_tensor(rng.rand(1, 4, 2, 8).astype(np.float32))
+        out, _ = F.flash_attention(q, q, q, causal=True)
+        assert out.shape == [1, 4, 2, 8]
+
+
+class TestGradClip:
+    def test_global_norm_clip(self):
+        p1 = paddle.nn.Parameter(np.zeros(3, np.float32))
+        p2 = paddle.nn.Parameter(np.zeros(2, np.float32))
+        g1 = paddle.to_tensor(np.array([3.0, 0.0, 0.0], np.float32))
+        g2 = paddle.to_tensor(np.array([0.0, 4.0], np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        out = clip([(p1, g1), (p2, g2)])
+        total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+    def test_clip_by_value(self):
+        p = paddle.nn.Parameter(np.zeros(3, np.float32))
+        g = paddle.to_tensor(np.array([-5.0, 0.5, 5.0], np.float32))
+        (out,) = nn.ClipGradByValue(1.0)([(p, g)])
+        np.testing.assert_array_equal(out[1].numpy(), [-1, 0.5, 1])

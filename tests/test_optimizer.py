@@ -1,0 +1,280 @@
+"""Optimizers: convergence, state, schedulers, amp scaler."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import SGD, Adam, AdamW, Lamb, Momentum, RMSProp, lr as lr_mod
+
+rng = np.random.RandomState(11)
+
+
+def _fit(opt_cls, steps=150, **kwargs):
+    paddle.seed(5)
+    net = nn.Linear(2, 1)
+    X = rng.rand(32, 2).astype(np.float32)
+    Y = (X @ np.array([[2.0], [-1.0]], np.float32)) + 0.5
+    xs, ys = paddle.to_tensor(X), paddle.to_tensor(Y)
+    opt = opt_cls(parameters=net.parameters(), **kwargs)
+    for _ in range(steps):
+        loss = ((net(xs) - ys) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(loss.numpy()), net, opt
+
+
+class TestConvergence:
+    def test_sgd(self):
+        loss, _, _ = _fit(SGD, learning_rate=0.2)
+        assert loss < 1e-2
+
+    def test_momentum(self):
+        loss, _, _ = _fit(Momentum, learning_rate=0.05, momentum=0.9)
+        assert loss < 1e-2
+
+    def test_adam(self):
+        loss, _, _ = _fit(Adam, steps=400, learning_rate=0.05)
+        assert loss < 1e-2
+
+    def test_adamw(self):
+        loss, _, _ = _fit(AdamW, steps=400, learning_rate=0.05, weight_decay=0.001)
+        assert loss < 1e-2
+
+    def test_rmsprop(self):
+        loss, _, _ = _fit(RMSProp, steps=400, learning_rate=0.05)
+        assert loss < 5e-2
+
+    def test_lamb(self):
+        loss, _, _ = _fit(Lamb, learning_rate=0.03, steps=300)
+        assert loss < 5e-2
+
+
+class TestOptimizerState:
+    def test_state_dict_roundtrip(self):
+        _, net, opt = _fit(Adam, steps=5, learning_rate=0.01)
+        sd = opt.state_dict()
+        assert any("moment1" in k for k in sd)
+        opt2 = Adam(parameters=net.parameters(), learning_rate=0.01)
+        # touch state so accumulators exist, then load
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == opt._step_count
+
+    def test_adamw_decoupled_decay(self):
+        # with zero grads, AdamW must still shrink weights; Adam must not
+        p = paddle.nn.Parameter(np.ones(4, np.float32))
+        p.grad = paddle.to_tensor(np.zeros(4, np.float32))
+        opt = AdamW(parameters=[p], learning_rate=0.1, weight_decay=0.5)
+        opt.step()
+        assert (p.numpy() < 1.0).all()
+        p2 = paddle.nn.Parameter(np.ones(4, np.float32))
+        p2.grad = paddle.to_tensor(np.zeros(4, np.float32))
+        Adam(parameters=[p2], learning_rate=0.1).step()
+        np.testing.assert_array_equal(p2.numpy(), np.ones(4, np.float32))
+
+    def test_grad_clip_in_optimizer(self):
+        p = paddle.nn.Parameter(np.zeros(2, np.float32))
+        p.grad = paddle.to_tensor(np.array([30.0, 40.0], np.float32))
+        opt = SGD(learning_rate=1.0, parameters=[p], grad_clip=nn.ClipGradByGlobalNorm(5.0))
+        opt.step()
+        np.testing.assert_allclose(np.sqrt((p.numpy() ** 2).sum()), 5.0, rtol=1e-5)
+
+    def test_multi_precision_master_weights(self):
+        p = paddle.nn.Parameter(np.ones(4, np.float32))
+        p._set_value_raw(p._value.astype("bfloat16"))
+        p.grad = paddle.to_tensor(np.full(4, 1e-3, np.float32)).astype("bfloat16")
+        opt = SGD(learning_rate=0.001, parameters=[p], multi_precision=True)
+        for _ in range(10):
+            opt.step()
+        master = opt._accumulators[p._uid]["master_weight"]
+        # master accumulates updates too small for bf16 resolution
+        assert abs(float(master[0]) - (1 - 10 * 1e-6)) < 5e-6  # grad itself is bf16-rounded
+
+    def test_functional_apply_gradients(self):
+        import jax.numpy as jnp
+
+        opt = Adam(learning_rate=0.1)
+        params = {"w": jnp.ones((3,), jnp.float32)}
+        grads = {"w": jnp.ones((3,), jnp.float32)}
+        state = opt.init_state_pytree(params)
+        new_params, new_state = opt.apply_gradients(params, grads, state)
+        assert float(new_params["w"][0]) < 1.0
+        assert float(new_state["w"]["beta1_pow"]) == pytest.approx(0.9)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sched = lr_mod.StepDecay(learning_rate=1.0, step_size=2, gamma=0.1)
+        lrs = [sched()]
+        for _ in range(4):
+            sched.step()
+            lrs.append(sched())
+        np.testing.assert_allclose(lrs[:5], [1.0, 1.0, 0.1, 0.1, 0.01], rtol=1e-6)
+
+    def test_cosine(self):
+        sched = lr_mod.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        assert sched() == pytest.approx(1.0)
+        for _ in range(10):
+            sched.step()
+        assert sched() == pytest.approx(0.0, abs=1e-6)
+
+    def test_warmup(self):
+        sched = lr_mod.LinearWarmup(learning_rate=0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+        sched.step(5)
+        assert sched() == pytest.approx(0.05)
+        sched.step(20)
+        assert sched() == pytest.approx(0.1)
+
+    def test_noam(self):
+        sched = lr_mod.NoamDecay(d_model=512, warmup_steps=100)
+        vals = []
+        for _ in range(200):
+            sched.step()
+            vals.append(sched())
+        assert np.argmax(vals) == pytest.approx(99, abs=2)
+
+    def test_scheduler_with_optimizer(self):
+        sched = lr_mod.StepDecay(learning_rate=0.5, step_size=1, gamma=0.5)
+        p = paddle.nn.Parameter(np.zeros(1, np.float32))
+        opt = SGD(learning_rate=sched, parameters=[p])
+        assert opt.get_lr() == pytest.approx(0.5)
+        sched.step()
+        assert opt.get_lr() == pytest.approx(0.25)
+
+    def test_reduce_on_plateau(self):
+        sched = lr_mod.ReduceOnPlateau(learning_rate=1.0, patience=1, factor=0.5)
+        for loss in [1.0, 1.0, 1.0, 1.0]:
+            sched.step(loss)
+        assert sched() < 1.0
+
+
+class TestAmp:
+    def test_autocast_matmul_bf16(self):
+        x = paddle.ones([4, 4])
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = paddle.matmul(x, x)
+        assert out.dtype.name == "bfloat16"
+        out2 = paddle.matmul(x, x)
+        assert out2.dtype.name == "float32"
+
+    def test_autocast_blacklist_stays_fp32(self):
+        x = paddle.ones([4])
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = paddle.exp(x)
+        assert out.dtype.name == "float32"
+
+    def test_autocast_grad_flows(self):
+        w = paddle.nn.Parameter(np.ones((4, 4), np.float32))
+        x = paddle.ones([2, 4])
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = paddle.matmul(x, w)
+        out.sum().backward()
+        assert w.grad is not None
+        assert w.grad.dtype.name == "float32"  # grad lands in param dtype
+
+    def test_grad_scaler_happy_path(self):
+        net = nn.Linear(2, 1)
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        opt = SGD(learning_rate=0.1, parameters=net.parameters())
+        loss = ((net(paddle.ones([4, 2]))) ** 2).mean()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        assert net.weight.grad is None or True  # step consumed grads without error
+
+    def test_grad_scaler_skips_on_inf(self):
+        p = paddle.nn.Parameter(np.ones(2, np.float32))
+        p.grad = paddle.to_tensor(np.array([np.inf, 1.0], np.float32))
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        opt = SGD(learning_rate=1.0, parameters=[p])
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_array_equal(p.numpy(), [1.0, 1.0])  # update skipped
+        assert scaler._scale < 4.0  # scale backed off
+
+    def test_decorate_o2(self):
+        net = nn.Linear(2, 2)
+        opt = Adam(parameters=net.parameters())
+        net, opt = paddle.amp.decorate(net, opt, level="O2", dtype="bfloat16")
+        assert net.weight.dtype.name == "bfloat16"
+        assert opt._multi_precision
+
+
+class TestReviewRegressions:
+    """Regressions for code-review findings on the nn/optimizer/amp milestone."""
+
+    def test_amp_o2_no_recursion(self):
+        x = paddle.ones([4, 4])
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            out = paddle.matmul(x, x)
+        assert out.dtype.name == "bfloat16"
+
+    def test_amp_blacklist_upcasts_bf16_input(self):
+        x = paddle.ones([4], dtype="bfloat16")
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = paddle.exp(x)
+        assert out.dtype.name == "float32"
+
+    def test_param_regularizer_applied(self):
+        p = paddle.nn.Parameter(np.ones(4, np.float32))
+        p.regularizer = paddle.regularizer.L2Decay(0.5)
+        p.grad = paddle.to_tensor(np.zeros(4, np.float32))
+        SGD(learning_rate=0.1, parameters=[p]).step()
+        np.testing.assert_allclose(p.numpy(), np.full(4, 0.95), rtol=1e-6)
+
+    def test_deepcopy_unique_names(self):
+        import copy
+
+        l1 = nn.Linear(2, 2)
+        l2 = copy.deepcopy(l1)
+        assert l1.weight.name != l2.weight.name
+        opt = Adam(parameters=[l1.weight, l2.weight], learning_rate=0.1)
+        l1.weight.grad = paddle.to_tensor(np.ones((2, 2), np.float32))
+        l2.weight.grad = paddle.to_tensor(np.ones((2, 2), np.float32))
+        opt.step()
+        assert len([k for k in opt.state_dict() if "moment1" in k]) == 2
+
+    def test_warmup_nested_scheduler_roundtrip(self):
+        inner = lr_mod.CosineAnnealingDecay(learning_rate=1.0, T_max=100)
+        sched = lr_mod.LinearWarmup(inner, warmup_steps=5, start_lr=0.0, end_lr=1.0)
+        for _ in range(20):
+            sched.step()
+        saved = sched.state_dict()
+        inner2 = lr_mod.CosineAnnealingDecay(learning_rate=1.0, T_max=100)
+        sched2 = lr_mod.LinearWarmup(inner2, warmup_steps=5, start_lr=0.0, end_lr=1.0)
+        sched2.set_state_dict(saved)
+        assert sched2.lr_sched.last_epoch == sched.lr_sched.last_epoch
+
+    def test_maxpool_ceil_mode_and_mask(self):
+        import paddle_tpu.nn.functional as F
+
+        x = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+        out = F.max_pool2d(paddle.to_tensor(x), 2, stride=2, ceil_mode=True)
+        assert out.shape == [1, 1, 3, 3]
+        out2, mask = F.max_pool2d(paddle.to_tensor(x), 2, stride=2, return_mask=True)
+        assert out2.shape == [1, 1, 2, 2]
+        np.testing.assert_array_equal(out2.numpy()[0, 0], [[6, 8], [16, 18]])
+        np.testing.assert_array_equal(mask.numpy()[0, 0], [[6, 8], [16, 18]])
+
+    def test_conv_transpose_nhwc(self):
+        import paddle_tpu.nn.functional as F
+
+        rng2 = np.random.RandomState(0)
+        x = rng2.rand(1, 4, 4, 3).astype(np.float32)
+        w = rng2.rand(3, 6, 2, 2).astype(np.float32)
+        out = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w), stride=2, data_format="NHWC")
+        assert out.shape == [1, 8, 8, 6]
+        want = F.conv2d_transpose(
+            paddle.to_tensor(x.transpose(0, 3, 1, 2)), paddle.to_tensor(w), stride=2
+        ).numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-5)
+
+    def test_conv_transpose_output_size(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.ones([1, 3, 4, 4])
+        w = paddle.ones([3, 6, 2, 2])
+        out = F.conv2d_transpose(x, w, stride=2, output_size=[9, 9])
+        assert out.shape == [1, 6, 9, 9]
