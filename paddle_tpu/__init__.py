@@ -55,6 +55,8 @@ from .ops.logic import is_tensor  # noqa: F401
 from . import autograd  # noqa: F401,E402
 from . import amp  # noqa: F401,E402
 from . import nn  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from .distributed.parallel import DataParallel  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from . import regularizer  # noqa: F401,E402
 from .param_attr import ParamAttr  # noqa: F401,E402

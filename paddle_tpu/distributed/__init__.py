@@ -1,0 +1,72 @@
+"""paddle_tpu.distributed: collectives, topology, fleet hybrid parallelism.
+
+TPU-native redesign of the reference's distributed stack (SURVEY.md §2.6):
+NCCL ProcessGroups -> mesh-axis Group handles, c_* collective ops -> XLA
+collectives, HybridCommunicateGroup -> named jax Mesh, fleet wrappers ->
+sharding-annotated layers compiled by GSPMD.
+"""
+
+from .collective import (  # noqa: F401
+    Group,
+    destroy_process_group,
+    get_group,
+    is_initialized,
+    new_group,
+)
+from .communication import (  # noqa: F401
+    ReduceOp,
+    Task,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    all_to_all_in_trace,
+    alltoall,
+    all_gather_in_trace,
+    axis_index,
+    barrier,
+    broadcast,
+    irecv,
+    isend,
+    pmax,
+    pmean,
+    pmin,
+    ppermute,
+    psum,
+    rank_slices,
+    recv,
+    reduce,
+    reduce_scatter,
+    reduce_scatter_in_trace,
+    scatter,
+    send,
+    to_per_rank,
+)
+from .mesh import (  # noqa: F401
+    build_mesh,
+    get_global_mesh,
+    set_global_mesh,
+)
+from .parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+)
+from .topology import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+
+from . import fleet  # noqa: F401,E402
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """paddle.distributed.spawn parity. Single-controller SPMD does not fork
+    per-device workers — the one process drives every device — so spawn runs
+    `func` once in-process (multi-host launch is `paddle_tpu.distributed.launch`)."""
+    init_parallel_env()
+    return func(*args)

@@ -1,0 +1,128 @@
+"""Process groups as mesh handles.
+
+The reference's ProcessGroup (fluid/distributed/collective/process_group.h:53)
+owns an NCCL communicator per device and issues async collectives on a comm
+stream. The TPU-native Group is a handle onto a (sub-)Mesh + axis name: eager
+collectives `shard_map` over it, traced code references `group.axis_name`
+inside an enclosing pjit/shard_map, and XLA owns scheduling — there is no
+stream to sync (the c_sync_calc/comm_stream ops have no equivalent and no
+purpose here).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import get_global_mesh
+
+_group_counter = itertools.count()
+_groups = {}
+_default_group: Optional["Group"] = None
+
+
+class Group:
+    """A set of ranks with a mesh to communicate over.
+
+    `axis_name` is the mesh axis collectives run along — the ring_id analog
+    (SURVEY.md §5.8: ring_id -> axis-name mapping lives here).
+    """
+
+    def __init__(self, ranks: Sequence[int], mesh: Mesh, axis_name: str, gid: int = None, name: str = None):
+        self.ranks = list(ranks)
+        self.nranks = len(self.ranks)
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.id = gid if gid is not None else next(_group_counter)
+        self.name = name or f"_default_pg{self.id}"
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, global_rank: int) -> int:
+        return self.ranks.index(global_rank) if global_rank in self.ranks else -1
+
+    @property
+    def rank(self) -> int:
+        from .parallel import get_rank
+
+        return self.get_group_rank(get_rank())
+
+    def is_member(self) -> bool:
+        from .parallel import get_rank
+
+        return get_rank() in self.ranks
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis_name!r}, ranks={self.ranks})"
+
+
+def _make_default_group() -> "Group":
+    mesh = get_global_mesh()
+    axis = mesh.axis_names[0] if mesh.axis_names else "world"
+    n = int(np.prod(mesh.devices.shape)) if mesh.devices.size else 1
+    flat_mesh = Mesh(mesh.devices.reshape(n), (axis,)) if len(mesh.axis_names) != 1 else mesh
+    return Group(list(range(n)), flat_mesh, axis, gid=0, name="_default_pg")
+
+
+def _get_global_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        _default_group = _make_default_group()
+        _groups[0] = _default_group
+    return _default_group
+
+
+def _set_default_group(g: Group):
+    global _default_group
+    _default_group = g
+    _groups[g.id] = g
+
+
+def _resolve_group(group) -> Group:
+    if group is None:
+        return _get_global_group()
+    if isinstance(group, int):
+        return _groups[group]
+    return group
+
+
+def new_group(ranks: Optional[List[int]] = None, backend: str = None, timeout=None) -> Group:
+    """paddle.distributed.new_group analog (collective.py:175): a sub-mesh group."""
+    devices = list(jax.devices())
+    if ranks is None:
+        ranks = list(range(len(devices)))
+    ranks = sorted(ranks)
+    axis = f"pg{next(_group_counter)}"
+    sub = np.array([devices[r % len(devices)] for r in ranks])
+    g = Group(ranks, Mesh(sub, (axis,)), axis, name=axis)
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _get_global_group()
+    return _groups.get(gid)
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+    else:
+        _groups.pop(_resolve_group(group).id, None)
+
+
+def is_initialized() -> bool:
+    return _default_group is not None

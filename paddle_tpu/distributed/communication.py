@@ -1,0 +1,291 @@
+"""Collective communication API (paddle.distributed.* analog).
+
+Reference: fluid/distributed/collective/process_group.h:53 async collectives +
+fluid/operators/collective/ (c_allreduce_*, c_allgather, ...). TPU-native
+redesign, two faces:
+
+1. **Traced face** (the production path): inside a pjit/shard_map-traced train
+   step, collectives are `jax.lax.psum/all_gather/...` over a mesh axis; XLA
+   compiles them onto ICI/DCN. Thin wrappers at the bottom of this module.
+
+2. **Eager face** (this module's API): single-controller SPMD has no
+   "per-process local tensor", so the eager API adopts the *per-rank stack*
+   convention: a distributed tensor for an N-rank group is a Tensor of shape
+   [N, *S] sharded over the group's mesh axis (built with `to_per_rank`);
+   slice i is rank i's value. Collectives transform the stack — `all_reduce`
+   really runs a shard_map psum over the sharded buffer, so on a pod the bytes
+   really move over ICI. A plain (unstacked) Tensor is treated as replicated:
+   every rank holds the same value (so all_reduce(SUM) -> x * nranks).
+
+Every call returns a Task with `.wait()`; XLA's async dispatch makes every
+collective effectively `sync_op=False` until the value is read back.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .collective import Group, _resolve_group
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Task:
+    """Parity with ProcessGroup's async Task (process_group.h:73): XLA arrays
+    are futures already, so wait() just blocks on the buffer."""
+
+    def __init__(self, tensor=None):
+        self._tensor = tensor
+
+    def wait(self):
+        if self._tensor is not None:
+            self._tensor._value.block_until_ready()
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _is_per_rank(t: Tensor, g: Group) -> bool:
+    return getattr(t, "_dist_group_id", None) == g.id
+
+
+def _mark(t: Tensor, g: Group) -> Tensor:
+    object.__setattr__(t, "_dist_group_id", g.id)
+    return t
+
+
+def to_per_rank(values, group=None, stop_gradient: bool = True) -> Tensor:
+    """Build the per-rank stacked view: values = list of N per-rank arrays (or
+    an [N, *S] array). The stack is laid out over the group's mesh axis so
+    each rank's slice physically lives on that rank's device."""
+    g = _resolve_group(group)
+    if isinstance(values, (list, tuple)):
+        arr = jnp.stack([v._value if isinstance(v, Tensor) else jnp.asarray(v) for v in values])
+    else:
+        arr = values._value if isinstance(values, Tensor) else jnp.asarray(values)
+    if arr.shape[0] != g.nranks:
+        raise ValueError(f"per-rank stack needs leading dim {g.nranks}, got {arr.shape}")
+    arr = jax.device_put(arr, NamedSharding(g.mesh, P(g.axis_name)))
+    return _mark(Tensor(arr, stop_gradient=stop_gradient), g)
+
+
+def rank_slices(t: Tensor):
+    """Split a per-rank stack back into the list-of-per-rank-tensors view."""
+    return [Tensor(t._value[i]) for i in range(t._value.shape[0])]
+
+
+@functools.lru_cache(maxsize=None)
+def _allreduce_fn(mesh: Mesh, axis: str, op: str):
+    red = {
+        ReduceOp.SUM: lax.psum,
+        ReduceOp.AVG: lax.pmean,
+        ReduceOp.MAX: lax.pmax,
+        ReduceOp.MIN: lax.pmin,
+        ReduceOp.PROD: lambda x, a: jnp.exp(lax.psum(jnp.log(jnp.abs(x)), a))
+        * jnp.prod(jnp.sign(lax.psum(jnp.sign(x)[None], a))),  # rarely used; sign-safe prod
+    }[op]
+    if op == ReduceOp.PROD:
+        # exact prod via log-trick is lossy; do an all_gather + prod instead
+        def f(x):
+            full = lax.all_gather(x, axis, tiled=True)
+            return jnp.broadcast_to(jnp.prod(full, axis=0, keepdims=True), x.shape)
+
+    else:
+        def f(x):
+            return red(x, axis)
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis)))
+
+
+def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group=None, sync_op: bool = True) -> Task:
+    g = _resolve_group(group)
+    if _is_per_rank(tensor, g):
+        out = _allreduce_fn(g.mesh, g.axis_name, op)(tensor._value)
+    else:  # replicated emulation
+        x = tensor._value
+        out = {
+            ReduceOp.SUM: lambda: x * g.nranks,
+            ReduceOp.AVG: lambda: x,
+            ReduceOp.MAX: lambda: x,
+            ReduceOp.MIN: lambda: x,
+            ReduceOp.PROD: lambda: x**g.nranks,
+        }[op]()
+    tensor._set_value_raw(out)
+    return Task(tensor)
+
+
+def reduce(tensor: Tensor, dst: int = 0, op: str = ReduceOp.SUM, group=None, sync_op: bool = True) -> Task:
+    """Result lands on every rank's slice (a superset of the contract — the
+    reference only guarantees dst; XLA reduce is all-reduce shaped anyway)."""
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def all_gather(tensor_list: list, tensor: Tensor, group=None, sync_op: bool = True) -> Task:
+    g = _resolve_group(group)
+    if _is_per_rank(tensor, g):
+        tensor_list.extend(Tensor(tensor._value[i]) for i in range(g.nranks))
+    else:
+        tensor_list.extend(Tensor(tensor._value) for _ in range(g.nranks))
+    return Task(tensor)
+
+
+def all_gather_object(object_list: list, obj, group=None) -> Task:
+    g = _resolve_group(group)
+    object_list.extend(obj for _ in range(g.nranks))
+    return Task()
+
+
+def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op: bool = True) -> Task:
+    g = _resolve_group(group)
+    if _is_per_rank(tensor, g):
+        src_slice = tensor._value[g.get_group_rank(src) if src in g.ranks else src]
+        out = jnp.broadcast_to(src_slice[None], tensor._value.shape)
+        out = jax.device_put(out, NamedSharding(g.mesh, P(g.axis_name)))
+        tensor._set_value_raw(out)
+    return Task(tensor)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group=None, sync_op: bool = True) -> Task:
+    """tensor becomes the per-rank stack of tensor_list (rank i gets slice i)."""
+    g = _resolve_group(group)
+    if tensor_list:
+        stacked = to_per_rank(tensor_list, g)
+        tensor._set_value_raw(stacked._value)
+        _mark(tensor, g)
+    return Task(tensor)
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op: bool = True) -> Task:
+    """global_scatter/global_gather's building block (SURVEY §2.2): rank i's
+    j-th chunk goes to rank j's i-th slot. Per-rank stacks [N, N, *S] swap
+    their leading axes."""
+    g = _resolve_group(group)
+    if isinstance(in_tensor_list, Tensor):  # stacked form [N, N, *S]
+        out = jnp.swapaxes(in_tensor_list._value, 0, 1)
+        out = jax.device_put(out, NamedSharding(g.mesh, P(g.axis_name)))
+        res = _mark(Tensor(out), g)
+        if isinstance(out_tensor_list, Tensor):
+            out_tensor_list._set_value_raw(res._value)
+            _mark(out_tensor_list, g)
+            return Task(out_tensor_list)
+        out_tensor_list.extend(rank_slices(res))
+        return Task(res)
+    stacked = jnp.stack([t._value if isinstance(t, Tensor) else jnp.asarray(t) for t in in_tensor_list])
+    out_tensor_list.extend(Tensor(stacked[:, i] if stacked.ndim > 1 else stacked[i]) for i in range(g.nranks))
+    return Task()
+
+
+def all_to_all(in_tensor_list, out_tensor_list, group=None, sync_op: bool = True) -> Task:
+    return alltoall(in_tensor_list, out_tensor_list, group=group, sync_op=sync_op)
+
+
+@functools.lru_cache(maxsize=None)
+def _reduce_scatter_fn(mesh: Mesh, axis: str):
+    def f(x):  # per shard: [1, N, *S] -> this rank's summed chunk [1, *S]
+        return lax.psum_scatter(x, axis, scatter_dimension=1, tiled=False)
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis)))
+
+
+def reduce_scatter(tensor: Tensor, tensor_list, op: str = ReduceOp.SUM, group=None, sync_op: bool = True) -> Task:
+    """Per-rank input: each rank holds N chunks ([N, N, *S] stacked); rank i
+    receives sum_j chunk[j][i] -> per-rank stack [N, *S] written into tensor."""
+    g = _resolve_group(group)
+    if isinstance(tensor_list, Tensor) and _is_per_rank(tensor_list, g):
+        out = _reduce_scatter_fn(g.mesh, g.axis_name)(tensor_list._value)
+    else:
+        stacked = jnp.stack(
+            [
+                (t._value if isinstance(t, Tensor) else jnp.asarray(t))
+                for t in (tensor_list if isinstance(tensor_list, (list, tuple)) else [tensor_list])
+            ]
+        )
+        out = stacked.sum(axis=0) if op == ReduceOp.SUM else stacked.mean(axis=0)
+        out = jnp.broadcast_to(out[None], (g.nranks,) + out.shape) if out.ndim < 2 else out
+    tensor._set_value_raw(out)
+    _mark(tensor, g)
+    return Task(tensor)
+
+
+# ---- p2p: a controller-side mailbox (send_v2/recv_v2 analog). Real pipelines
+# use ppermute inside shard_map (see fleet.meta_parallel.pipeline) — eager p2p
+# exists for API parity and host-driven schedules. ----
+_mailbox: dict = {}
+
+
+def send(tensor: Tensor, dst: int = 0, group=None, sync_op: bool = True) -> Task:
+    g = _resolve_group(group)
+    _mailbox.setdefault((g.id, dst), []).append(tensor._value)
+    return Task(tensor)
+
+
+def recv(tensor: Tensor, src: int = 0, group=None, sync_op: bool = True) -> Task:
+    g = _resolve_group(group)
+    queue = None
+    for k, v in _mailbox.items():  # single-controller: sends precede the recv
+        if k[0] == g.id and v:
+            queue = v
+            break
+    if queue:
+        tensor._set_value_raw(queue.pop(0).astype(tensor._value.dtype).reshape(tensor._value.shape))
+    return Task(tensor)
+
+
+isend = send
+irecv = recv
+
+
+def barrier(group=None) -> Task:
+    g = _resolve_group(group)
+    jax.effects_barrier()
+    return Task()
+
+
+# ---- traced-face wrappers: use inside shard_map/pjit-traced functions ----
+def psum(x, axis_name):
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    return lax.pmean(x, axis_name)
+
+
+def pmax(x, axis_name):
+    return lax.pmax(x, axis_name)
+
+
+def pmin(x, axis_name):
+    return lax.pmin(x, axis_name)
+
+
+def ppermute(x, axis_name, perm):
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def all_gather_in_trace(x, axis_name, axis: int = 0, tiled: bool = False):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter_in_trace(x, axis_name, scatter_dimension: int = 0, tiled: bool = True):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+def all_to_all_in_trace(x, axis_name, split_axis: int, concat_axis: int, tiled: bool = True):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)

@@ -1,0 +1,109 @@
+"""fleet: the hybrid-parallel training facade (fleet/fleet.py analog).
+
+`fleet.init` (reference fleet.py:168) reads DistributedStrategy.hybrid_configs
+(distributed_strategy.py:1657) and builds the HybridCommunicateGroup — here
+that means building THE device mesh with named dp/pp/sharding/mp axes.
+`distributed_model` (model.py:30) picks the wrapper; `distributed_optimizer`
+(fleet.py:1058) wraps with HybridParallelOptimizer. The wrappers carry far
+less machinery than the reference because GSPMD compiles the parallelism the
+reference's wrappers executed by hand.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..parallel import get_rank, get_world_size, init_parallel_env
+from ..topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from .distributed_strategy import DistributedStrategy
+from .hybrid_parallel_optimizer import HybridParallelClipGrad, HybridParallelOptimizer
+from . import meta_parallel  # noqa: F401
+from .meta_parallel import (  # noqa: F401
+    ColumnParallelLinear,
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+    RowParallelLinear,
+    TensorParallel,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)
+from .recompute import recompute, recompute_hybrid, recompute_sequential  # noqa: F401
+
+_fleet_initialized = False
+_strategy: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective: bool = True, strategy: Optional[DistributedStrategy] = None):
+    """fleet.init (fleet.py:168): build the hybrid mesh from the strategy."""
+    global _fleet_initialized, _strategy
+    init_parallel_env()
+    _strategy = strategy or DistributedStrategy()
+    cfg = _strategy.hybrid_configs
+    topo = CommunicateTopology(
+        hybrid_group_names=["data", "pipe", "sharding", "model"],
+        dims=[
+            cfg.get("dp_degree", 1),
+            cfg.get("pp_degree", 1),
+            cfg.get("sharding_degree", 1),
+            cfg.get("mp_degree", 1),
+        ],
+    )
+    hcg = HybridCommunicateGroup(topo, global_rank=get_rank())
+    set_hybrid_communicate_group(hcg)
+    from .meta_parallel.random import model_parallel_random_seed
+
+    seed = _strategy.tensor_parallel_configs.get("tensor_init_seed", -1)
+    model_parallel_random_seed(None if seed in (-1, None) else seed)
+    _fleet_initialized = True
+    return None
+
+
+def distributed_model(model):
+    """fleet/model.py:30: wrap per parallel mode."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return model
+    mode = hcg.get_parallel_mode()
+    from ..parallel import DataParallel
+    from .meta_parallel import PipelineParallel, ShardingParallel, TensorParallel
+    from .meta_parallel.pp_layers import PipelineLayer
+
+    if hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
+        return PipelineParallel(model, hcg=hcg, strategy=_strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg=hcg, strategy=_strategy)
+    if mode == "sharding":
+        return ShardingParallel(model, hcg=hcg, strategy=_strategy)
+    if mode == "data":
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """fleet.py:1058."""
+    hcg = get_hybrid_communicate_group()
+    return HybridParallelOptimizer(optimizer, hcg=hcg, strategy=strategy or _strategy)
+
+
+def worker_num() -> int:
+    return get_world_size()
+
+
+def worker_index() -> int:
+    return get_rank()
+
+
+def is_first_worker() -> bool:
+    return get_rank() == 0
+
+
+def barrier_worker():
+    from ..communication import barrier
+
+    barrier()

@@ -1,0 +1,43 @@
+"""DistributedStrategy (fleet/base/distributed_strategy.py analog).
+
+The reference backs this with a protobuf (framework/distributed_strategy.proto)
+because static-graph meta-optimizers rewrite programs from it. Here it is a
+plain config object: the only consumer is the mesh builder + wrapper chooser,
+since GSPMD replaces the program-rewriting meta-optimizers (SURVEY §2.6).
+"""
+
+from __future__ import annotations
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1, "schedule": "1F1B"}
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0, "use_pure_bf16": False, "custom_white_list": [], "custom_black_list": []}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "offload": False}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True  # parity no-op: XLA fuses collectives
+        self.tensor_parallel_configs = {"tensor_init_seed": -1}
+
+    def __setattr__(self, key, value):
+        if key == "hybrid_configs" and hasattr(self, "hybrid_configs"):
+            merged = dict(self.__dict__["hybrid_configs"])
+            merged.update(value)
+            self.__dict__[key] = merged
+        else:
+            self.__dict__[key] = value
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"

@@ -1,0 +1,57 @@
+"""HybridParallelOptimizer (fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py analog).
+
+The reference's wrapper (:238) does three jobs before the inner step: fuse +
+allreduce grads of shared params across the mp group, allreduce across
+sharding/dp groups, and HybridParallelClipGrad (:49) — a global-norm clip
+whose norm is psum'd across every parallel axis.
+
+TPU-native: gradients come out of the compiled step already globally reduced
+(GSPMD inserts the psum over dp and the partial-reduction over mp where
+annotations say so), so jobs 1-2 vanish. Global-norm clip needs no cross-axis
+allreduce either: single-controller grad arrays are global arrays — summing
+their squares IS the global norm; under a mesh XLA partitions that reduction
+into the per-axis psums the reference wrote by hand.
+"""
+
+from __future__ import annotations
+
+from ...nn.clip import ClipGradByGlobalNorm
+from ...optimizer.optimizer import Optimizer
+
+
+class HybridParallelClipGrad(ClipGradByGlobalNorm):
+    """Cross-axis global-norm clip (:49): the base class already computes the
+    norm over global arrays, which is the cross-axis norm by construction."""
+
+    def __init__(self, clip, hcg=None):
+        clip_norm = clip.clip_norm if hasattr(clip, "clip_norm") else float(clip)
+        super().__init__(clip_norm)
+        self._hcg = hcg
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer: Optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        if optimizer._grad_clip is not None and not isinstance(optimizer._grad_clip, HybridParallelClipGrad):
+            optimizer._grad_clip = HybridParallelClipGrad(optimizer._grad_clip, hcg)
+
+    def step(self):
+        return self._inner_opt.step()
+
+    def clear_grad(self, *args, **kwargs):
+        return self._inner_opt.clear_grad(*args, **kwargs)
+
+    def minimize(self, loss, *args, **kwargs):
+        return self._inner_opt.minimize(loss, *args, **kwargs)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)

@@ -1,0 +1,155 @@
+"""Tensor-parallel layers (fleet/layers/mpu/mp_layers.py analog).
+
+The reference implements TP with explicit collectives: ColumnParallelLinear
+(:173) allgathers outputs, RowParallelLinear (:343) allreduces via
+mp_allreduce_sum, VocabParallelEmbedding (:35) masks + allreduces, and
+ParallelCrossEntropy (:524) calls the fused c_softmax_with_cross_entropy op.
+
+TPU-native, the same layers are *sharding annotations*: weights carry a
+PartitionSpec over the `mp` mesh axis, activations get with_sharding_constraint
+hints, and XLA's SPMD partitioner inserts the identical collectives (allgather
+for column, psum for row, masked-psum for vocab) — compiled into the step,
+fused, and overlapped. Each layer computes plainly when no mesh is active, so
+the same model runs on one chip or a pod unchanged.
+
+Explicit shard_map building blocks (for manual-SPMD code paths like ring
+attention) live in mp_ops.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....nn.layer.layers import Layer
+from ...sharding_utils import annotate_parameter, maybe_shard
+from ...topology import get_hybrid_communicate_group
+
+MP_AXIS = "mp"
+
+
+def _mp_world_size() -> int:
+    hcg = get_hybrid_communicate_group()
+    return hcg.get_model_parallel_world_size() if hcg is not None else 1
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over mp (mp_layers.py:35).
+
+    GSPMD lowers the lookup on a P('mp', None) table to exactly the
+    reference's c_embedding + allreduce: each shard serves its vocab range,
+    out-of-range rows contribute zeros, psum combines.
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings, self.embedding_dim = num_embeddings, embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim],
+            attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0) if weight_attr in (None, True) else getattr(weight_attr, "initializer", None),
+        )
+        annotate_parameter(self.weight, P(MP_AXIS, None))
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return maybe_shard(out, P())  # output replicated across mp (post-psum)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with out_features sharded over mp (mp_layers.py:173): y = XW,
+    W: [in, out/mp]. gather_output=False keeps y sharded P(..., 'mp') for a
+    following RowParallelLinear (the Megatron MLP pairing)."""
+
+    def __init__(
+        self,
+        in_features,
+        out_features,
+        weight_attr=None,
+        has_bias=True,
+        gather_output=True,
+        fuse_matmul_bias=False,
+        mp_group=None,
+        name=None,
+    ):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.gather_output = gather_output
+        ws = _mp_world_size()
+        if out_features % max(ws, 1) != 0:
+            raise ValueError(f"out_features {out_features} not divisible by mp degree {ws}")
+        self.weight = self.create_parameter(
+            [in_features, out_features],
+            attr=None if weight_attr in (None, True) else weight_attr,
+        )
+        annotate_parameter(self.weight, P(None, MP_AXIS))
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            annotate_parameter(self.bias, P(MP_AXIS))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return maybe_shard(out, P())  # allgather over mp
+        return maybe_shard(out, P(*([None] * (len(out.shape) - 1) + [MP_AXIS])))
+
+
+class RowParallelLinear(Layer):
+    """Linear with in_features sharded over mp (mp_layers.py:343): input
+    arrives sharded on its last dim (from a ColumnParallelLinear with
+    gather_output=False), each shard computes a partial product, psum
+    combines — GSPMD emits the mp_allreduce_sum from the annotations."""
+
+    def __init__(
+        self,
+        in_features,
+        out_features,
+        weight_attr=None,
+        has_bias=True,
+        input_is_parallel=False,
+        fuse_matmul_bias=False,
+        mp_group=None,
+        name=None,
+    ):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.input_is_parallel = input_is_parallel
+        ws = _mp_world_size()
+        if in_features % max(ws, 1) != 0:
+            raise ValueError(f"in_features {in_features} not divisible by mp degree {ws}")
+        self.weight = self.create_parameter(
+            [in_features, out_features],
+            attr=None if weight_attr in (None, True) else weight_attr,
+        )
+        annotate_parameter(self.weight, P(MP_AXIS, None))
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            annotate_parameter(self.bias, P(None))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = maybe_shard(x, P(*([None] * (len(x.shape) - 1) + [MP_AXIS])))
+        out = F.linear(x, self.weight, self.bias)
+        return maybe_shard(out, P())  # psum over mp
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax cross-entropy (mp_layers.py:524 →
+    c_softmax_with_cross_entropy). Under GSPMD the stable log-softmax on
+    P(..., 'mp')-sharded logits partitions into the reference's fused
+    pmax/psum algorithm automatically; the explicit shard_map version is
+    mp_ops.parallel_cross_entropy."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        input = maybe_shard(input, P(*([None] * (len(input.shape) - 1) + [MP_AXIS])))
+        return F.cross_entropy(input, label, reduction="none", ignore_index=self.ignore_index)

@@ -1,0 +1,108 @@
+"""Explicit-SPMD tensor-parallel primitives (fleet/layers/mpu/mp_ops.py analog).
+
+These are pure jnp functions over *local shards*, written to run inside a
+`shard_map` over the mp axis — the manual-SPMD escape hatch the reference
+implements as PyLayers (_c_identity: forward copy / backward allreduce,
+_mp_allreduce: forward allreduce / backward copy) plus fused CUDA ops
+(c_softmax_with_cross_entropy). Autodiff of lax collectives gives the same
+forward/backward transfer pairs for free (psum <-> identity are mutual
+transposes), so no custom VJPs are needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def c_identity(x, axis_name: str):
+    """Forward identity, backward psum — the entry to a column-parallel
+    region (mp_ops.py _c_identity)."""
+
+    @jax.custom_vjp
+    def f(v):
+        return v
+
+    f.defvjp(lambda v: (v, None), lambda _, g: (lax.psum(g, axis_name),))
+    return f(x)
+
+
+def mp_allreduce(x, axis_name: str):
+    """Forward psum, backward identity — the exit of a row-parallel region
+    (mp_ops.py _mp_allreduce)."""
+
+    @jax.custom_vjp
+    def f(v):
+        return lax.psum(v, axis_name)
+
+    f.defvjp(lambda v: (lax.psum(v, axis_name), None), lambda _, g: (g,))
+    return f(x)
+
+
+def c_split(x, axis_name: str):
+    """Keep this rank's chunk of the last dim (mp_ops.py _c_split)."""
+    rank = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    chunk = x.shape[-1] // n
+    return lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=-1)
+
+def c_concat(x, axis_name: str):
+    """Allgather chunks along the last dim (mp_ops.py _c_concat)."""
+    return lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
+
+
+def vocab_parallel_embedding(ids, table_shard, axis_name: str):
+    """Local-shard embedding lookup + psum (c_embedding semantics): shard r
+    owns rows [r*V_local, (r+1)*V_local); out-of-range ids contribute zeros."""
+    v_local = table_shard.shape[0]
+    start = lax.axis_index(axis_name) * v_local
+    local_ids = ids - start
+    in_range = (local_ids >= 0) & (local_ids < v_local)
+    looked = jnp.take(table_shard, jnp.clip(local_ids, 0, v_local - 1), axis=0)
+    looked = jnp.where(in_range[..., None], looked, 0)
+    return lax.psum(looked, axis_name)
+
+
+def column_parallel_linear(x, w_shard, b_shard=None, axis_name: str = "mp", gather_output: bool = False):
+    """x @ W_shard (+ b_shard); optionally allgather the sharded last dim."""
+    y = c_identity(x, axis_name) @ w_shard
+    if b_shard is not None:
+        y = y + b_shard
+    return c_concat(y, axis_name) if gather_output else y
+
+
+def row_parallel_linear(x_shard, w_shard, bias=None, axis_name: str = "mp"):
+    """Partial product on the sharded contraction dim, then psum; bias added
+    once (post-reduce), matching RowParallelLinear."""
+    y = mp_allreduce(x_shard @ w_shard, axis_name)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def parallel_cross_entropy(logits_shard, labels, axis_name: str, ignore_index: int = -100):
+    """Vocab-parallel softmax cross entropy over mp-sharded logits — the
+    c_softmax_with_cross_entropy algorithm (SURVEY §2.2) in five collectives-
+    aware lines: global max (pmax), global logsumexp (psum), and the label's
+    logit fetched via masked psum from whichever shard owns it."""
+    v_local = logits_shard.shape[-1]
+    start = lax.axis_index(axis_name) * v_local
+    # stop_gradient: the max shift is stability-only (and pmax has no VJP)
+    gmax = lax.pmax(lax.stop_gradient(jnp.max(logits_shard, axis=-1)), axis_name)
+    shifted = logits_shard - gmax[..., None]
+    lse = jnp.log(lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis_name)) + gmax
+    local_label = labels - start
+    owned = (local_label >= 0) & (local_label < v_local)
+    label_logit = lax.psum(
+        jnp.where(
+            owned,
+            jnp.take_along_axis(
+                logits_shard, jnp.clip(local_label, 0, v_local - 1)[..., None], axis=-1
+            ).squeeze(-1),
+            0.0,
+        ),
+        axis_name,
+    )
+    loss = lse - label_logit
+    return jnp.where(labels == ignore_index, 0.0, loss)

@@ -1,0 +1,162 @@
+"""Pipeline layer description & partitioning (fleet/meta_parallel/pp_layers.py).
+
+Reference: LayerDesc (:56) defers construction, SegmentLayers (:92) splits the
+layer list into stages (uniform or by parameter count), PipelineLayer (:240)
+builds only this stage's segment. Single-controller TPU builds *all* stages
+(the controller owns every device) and records the stage boundaries; each
+stage's params are placed on its pp mesh slice so stage-local compute runs on
+stage-local chips.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ....nn.layer.layers import Layer
+
+
+class LayerDesc:
+    """Deferred layer construction (pp_layers.py:56)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(f"LayerDesc expects a Layer subclass, got {layer_cls}")
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer appearing in several stages (pp_layers.py:78) — e.g.
+    tied embeddings. The single-controller build constructs it once and every
+    referencing stage shares the instance (tying is free; the reference needs
+    an extra allreduce group for tied grads)."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight", *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Partition N layer descs into `num_parts` stages (pp_layers.py:92)."""
+
+    def __init__(self, layers_desc, num_parts: int, method: str = "uniform"):
+        self.descs = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+        if len(layers_desc) < num_parts:
+            raise ValueError(f"{len(layers_desc)} layers cannot fill {num_parts} stages")
+
+    def do_segment(self) -> List[int]:
+        n = len(self.descs)
+        if self.method == "uniform":
+            return self.uniform(n, self.num_parts)
+        if self.method.startswith("layer:"):
+            # segment so layers of the named class are evenly spread
+            name = self.method.split(":", 1)[1]
+            weights = [1 if type(d).__name__ == name or getattr(d, "layer_cls", type(None)).__name__ == name else 0 for d in self.descs]
+            total = sum(weights)
+            if total == 0:
+                return self.uniform(n, self.num_parts)
+            per = total / self.num_parts
+            bounds, acc, target = [0], 0.0, per
+            for i, w in enumerate(weights):
+                acc += w
+                if acc >= target and len(bounds) < self.num_parts:
+                    bounds.append(i + 1)
+                    target += per
+            bounds += [n] * (self.num_parts + 1 - len(bounds))
+            bounds[-1] = n
+            return bounds
+        raise ValueError(f"unknown seg_method {self.method}")
+
+    @staticmethod
+    def uniform(num_items: int, num_parts: int) -> List[int]:
+        return [int(round(i * num_items / num_parts)) for i in range(num_parts + 1)]
+
+
+class PipelineLayer(Layer):
+    """Stage-partitioned sequential model (pp_layers.py:240).
+
+    `layers` is a list of LayerDesc / Layer / callables executed in order.
+    All stages are constructed; `segment_bounds` records the cut points and
+    `stage_params(i)` returns stage i's parameters for pp-axis placement.
+    """
+
+    def __init__(
+        self,
+        layers: Sequence,
+        num_stages: Optional[int] = None,
+        topology=None,
+        loss_fn: Optional[Callable] = None,
+        seg_method: str = "uniform",
+        recompute_interval: int = 0,
+        **kwargs,
+    ):
+        super().__init__()
+        from ...topology import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        if num_stages is None:
+            num_stages = hcg.get_pipe_parallel_world_size() if hcg is not None else 1
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.recompute_interval = recompute_interval
+        self._descs = list(layers)
+        self.segment_bounds = SegmentLayers(self._descs, num_stages, seg_method).do_segment()
+
+        self._shared_instances = {}
+        built = []
+        for d in self._descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared_instances:
+                    self._shared_instances[d.layer_name] = d.build_layer()
+                built.append((self._shared_instances[d.layer_name], d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            else:
+                built.append((d, None))
+        self.run_function = built
+        for i, (sub, _) in enumerate(built):
+            if isinstance(sub, Layer):
+                self.add_sublayer(str(i), sub)
+
+    def stage_of_index(self, idx: int) -> int:
+        for s in range(self.num_stages):
+            if self.segment_bounds[s] <= idx < self.segment_bounds[s + 1]:
+                return s
+        return self.num_stages - 1
+
+    def stage_layers(self, stage: int):
+        lo, hi = self.segment_bounds[stage], self.segment_bounds[stage + 1]
+        return self.run_function[lo:hi]
+
+    def stage_params(self, stage: int):
+        out = []
+        for sub, _ in self.stage_layers(stage):
+            if isinstance(sub, Layer):
+                out.extend(p for _, p in sub.named_parameters() if p is not None)
+        return out
+
+    def forward(self, x, stage: Optional[int] = None):
+        seq = self.run_function if stage is None else self.stage_layers(stage)
+        for i, (sub, fwd) in enumerate(seq):
+            if fwd is not None:
+                x = fwd(sub, x)
+            elif self.recompute_interval and isinstance(sub, Layer) and i % self.recompute_interval == 0:
+                from ..recompute import recompute
+
+                x = recompute(sub, x)
+            else:
+                x = sub(x)
+        return x

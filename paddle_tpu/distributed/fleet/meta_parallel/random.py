@@ -1,0 +1,80 @@
+"""Model-parallel RNG state tracker (fleet/layers/mpu/random.py analog).
+
+The reference keeps a dict of named CUDA RNG states and swaps the generator
+state inside `rng_state(name)` so dropout masks differ (or agree) across mp
+ranks as needed. The TPU-native story is jax PRNG key *folding*: a named
+tracker derives a per-name subkey chain; for per-rank-distinct regions the key
+is additionally folded with the mp mesh coordinate (jax.lax.axis_index under
+shard_map, static rank under GSPMD since dropout on a sharded activation is
+already elementwise-partitioned — each device computes only its mask shard).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ....core import random as core_random
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_.clear()
+        self.seeds_.clear()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = {"seed": int(seed), "offset": 0}
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        orig = core_random.get_rng_state()
+        core_random.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = core_random.get_rng_state()
+            core_random.set_rng_state(orig)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed: int = None):
+    """Seed the tracker: global stream + a model-parallel stream offset by the
+    mp rank (reference random.py model_parallel_random_seed)."""
+    from ...topology import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    mp_rank = hcg.get_model_parallel_rank() if hcg is not None else 0
+    seed = seed if seed is not None else 1024
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, seed + 1024 + mp_rank)
+    core_random.seed(seed)
+
+
+def determinate_seed(rng_name: str) -> int:
+    return 0  # parity shim; jax PRNG keys are deterministic by construction

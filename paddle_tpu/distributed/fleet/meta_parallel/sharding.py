@@ -1,0 +1,153 @@
+"""ZeRO-style group sharding (meta_parallel/sharding/group_sharded_*.py analog).
+
+The reference implements three stages with explicit bookkeeping: stage 1
+shards optimizer states across the sharding group
+(GroupShardedOptimizerStage2, group_sharded_optimizer_stage2.py:53), stage 2
+additionally shards gradients with grad-storage buffers (stage2.py:46), stage
+3 slices parameters and re-gathers them in forward/backward hooks
+(group_sharded_stage3.py:59, hooks :486).
+
+TPU-native, every stage is a *sharding spec*, not a runtime: params/grads/
+optimizer-state arrays get a NamedSharding over the `sharding` mesh axis and
+GSPMD emits the reduce-scatter + allgather pattern ZeRO describes (grads
+reduce-scattered into the shard each rank owns, params allgathered on use).
+The classes below annotate; the pjit train-step builder consumes the
+annotations (see fleet.utils.build_sharded_specs).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ....nn.layer.layers import Layer
+from ....optimizer.optimizer import Optimizer
+from ...sharding_utils import annotate_parameter
+
+SHARDING_AXIS = "sharding"
+
+
+def _first_divisible_dim(shape, degree: int) -> Optional[int]:
+    for i, d in enumerate(shape):
+        if d % degree == 0 and d >= degree:
+            return i
+    return None
+
+
+def shard_spec_for(shape, degree: int, axis: str = SHARDING_AXIS) -> P:
+    """ZeRO-3 placement for one param: shard the first divisible dim."""
+    dim = _first_divisible_dim(shape, degree)
+    if dim is None:
+        return P()
+    entries = [None] * len(shape)
+    entries[dim] = axis
+    return P(*entries)
+
+
+class GroupShardedStage3(Layer):
+    """Parameter-sharding wrapper: annotates every param with a sharding-axis
+    spec (unless it already carries an mp spec). Forward just runs the inner
+    layer — the allgather-on-use happens inside the compiled step."""
+
+    def __init__(self, layer: Layer, optimizer=None, group=None, sync_buffers=False, segment_size=2**20, offload=False):
+        super().__init__()
+        self._layers = layer
+        self._group = group
+        from ...topology import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        degree = (
+            group.nranks
+            if group is not None
+            else (hcg.get_sharding_parallel_world_size() if hcg is not None else 1)
+        )
+        self._degree = max(degree, 1)
+        for _, p in layer.named_parameters():
+            if p is None or getattr(p, "dist_spec", None) not in (None, P()):
+                continue
+            annotate_parameter(p, shard_spec_for(p.shape, self._degree))
+        if optimizer is not None:
+            optimizer._shard_state_axis = SHARDING_AXIS
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)
+
+
+class GroupShardedStage2(Layer):
+    """Grad + optimizer-state sharding: params stay replicated; grads carry a
+    sharded reduce target so GSPMD reduce-scatters instead of all-reducing."""
+
+    def __init__(self, layer: Layer, sharding_optimizer=None, group=None, sync_buffers=False, buffer_max_size=2**23):
+        super().__init__()
+        self._layers = layer
+        opts = sharding_optimizer if isinstance(sharding_optimizer, (list, tuple)) else [sharding_optimizer]
+        for opt in opts:
+            if opt is not None:
+                opt._shard_state_axis = SHARDING_AXIS
+        for _, p in layer.named_parameters():
+            if p is not None:
+                p.grad_sharded = True
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)
+
+
+class GroupShardedOptimizerStage2(Optimizer):
+    """Optimizer-state sharding (stage 1/2): wraps an inner optimizer and
+    marks its state pytree for sharding-axis placement."""
+
+    def __init__(self, params, optim: Optimizer, group=None, offload=False, **kwargs):
+        self._inner = optim
+        self._inner._shard_state_axis = SHARDING_AXIS
+        self.__dict__.update({k: v for k, v in optim.__dict__.items() if k not in self.__dict__})
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner"], name)
+
+    def step(self):
+        return self._inner.step()
+
+    def clear_grad(self, *a, **k):
+        return self._inner.clear_grad(*a, **k)
+
+
+def group_sharded_parallel(model, optimizer, level: str, scaler=None, group=None, offload=False, sync_buffers=False, **kwargs):
+    """distributed/sharding/group_sharded.py:33 analog.
+
+    level: 'os' (stage 1) | 'os_g' (stage 2) | 'p_g_os' (stage 3).
+    """
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(f"level must be os|os_g|p_g_os, got {level!r}")
+    if level == "os":
+        optimizer = GroupShardedOptimizerStage2(None, optimizer, group=group, offload=offload)
+    elif level == "os_g":
+        optimizer = GroupShardedOptimizerStage2(None, optimizer, group=group, offload=offload)
+        model = GroupShardedStage2(model, optimizer, group=group, sync_buffers=sync_buffers)
+    else:
+        model = GroupShardedStage3(model, optimizer=optimizer, group=group, sync_buffers=sync_buffers, offload=offload)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """distributed/sharding/group_sharded.py:179: single-controller arrays are
+    already global, so this is plain save."""
+    from ....framework import io as fio
+
+    inner = getattr(model, "_layers", model)
+    fio.save(inner.state_dict(), output if output.endswith(".pdparams") else output + ".pdparams")
+    if optimizer is not None:
+        fio.save(optimizer.state_dict(), output.replace(".pdparams", "") + ".pdopt")

@@ -1,0 +1,42 @@
+"""TensorParallel / ShardingParallel model wrappers
+(fleet/meta_parallel/tensor_parallel.py, sharding_parallel.py analogs).
+
+The reference wrappers broadcast initial parameters across the mp/sharding
+groups (hybrid_parallel_util.py) so every rank starts identical. Single-
+controller arrays are born global — there is nothing to broadcast — so these
+wrappers only carry the API and ensure the model's mp-annotated params are in
+place (annotations were set by the mp_layers at construction).
+"""
+
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers: Layer, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)
+
+
+class TensorParallel(MetaParallelBase):
+    """mp wrapper (tensor_parallel.py:21)."""
+
+
+class ShardingParallel(MetaParallelBase):
+    """sharding wrapper (sharding_parallel.py:20)."""

@@ -1,0 +1,76 @@
+"""Activation recomputation (fleet/recompute/recompute.py analog).
+
+The reference implements recompute as a PyLayer that stashes RNG state and
+replays the forward under `rng_state` in backward (recompute_hybrid for the
+mp-aware variant). TPU-native this is `jax.checkpoint`: the region becomes a
+single tape node whose vjp rematerializes the forward; PRNG keys are baked
+into the replayed jaxpr at trace time, so dropout masks replay identically
+with no RNG-tracker bookkeeping.
+
+Parameters reached through a Layer are passed explicitly (not closed over) so
+eager `.backward()` still reaches them through the single recompute node.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ...core.autograd import run_op
+from ...core.functional import overlay
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+
+
+def _find_layer(function):
+    if isinstance(function, Layer):
+        return function
+    owner = getattr(function, "__self__", None)
+    return owner if isinstance(owner, Layer) else None
+
+
+def recompute(function, *args, use_reentrant: bool = True, preserve_rng_state: bool = True, **kwargs):
+    """Checkpoint `function(*args, **kwargs)`: store inputs + params, replay
+    the forward during backward instead of keeping intermediates."""
+    layer = _find_layer(function)
+    params = []
+    if layer is not None:
+        params = [p for _, p in layer.named_parameters() if p is not None and not p.stop_gradient]
+
+    flat_args, args_tree = jax.tree_util.tree_flatten((args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    tensor_idx = [i for i, a in enumerate(flat_args) if isinstance(a, Tensor)]
+    tensor_inputs = [flat_args[i] for i in tensor_idx]
+    n_params = len(params)
+
+    def pure_fn(*vals):
+        param_vals, input_vals = vals[:n_params], vals[n_params:]
+        mapping = {p._uid: v for p, v in zip(params, param_vals)}
+        rebuilt = list(flat_args)
+        for slot, v in zip(tensor_idx, input_vals):
+            t = Tensor(v, stop_gradient=flat_args[slot].stop_gradient)
+            rebuilt[slot] = t
+        new_args, new_kwargs = jax.tree_util.tree_unflatten(args_tree, rebuilt)
+        with overlay(mapping):
+            out = function(*new_args, **new_kwargs)
+        return jax.tree_util.tree_map(
+            lambda o: o._value if isinstance(o, Tensor) else o, out, is_leaf=lambda x: isinstance(x, Tensor)
+        )
+
+    ckpt_fn = jax.checkpoint(pure_fn)
+    out, node = run_op("recompute", ckpt_fn, [*params, *tensor_inputs])
+    from ...ops._dispatch import wrap_outputs
+
+    return wrap_outputs(out, node)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """paddle.incubate.distributed.fleet.recompute_sequential analog."""
+    out = args
+    for fn in functions:
+        out = (recompute(fn, *out, **kwargs),) if not isinstance(out, tuple) else (recompute(fn, *out, **kwargs),)
+    return out[0]
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """mp-aware variant: jax PRNG folding makes the RNG bookkeeping moot —
+    delegate to recompute (kept for API parity)."""
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,12 @@
+"""`python -m paddle_tpu.distributed.launch` (distributed/launch analog).
+
+The reference's launcher (launch/main.py + controllers/) spawns one worker
+process per GPU and runs an HTTP/etcd master for rendezvous. On TPU the unit
+is the *host*: one process per host drives all its chips (single-controller
+per host, multi-controller across hosts via jax.distributed). The launcher
+therefore spawns one process per host entry — on a single machine that is
+exactly one worker — and fills the same PADDLE_* env contract so ParallelEnv
+parses identically.
+"""
+
+from .main import launch, main  # noqa: F401

@@ -1,0 +1,80 @@
+"""Launch controller (launch/main.py + controllers/collective.py analog)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=str, default="1", help="number of hosts (or lo:hi elastic range)")
+    p.add_argument("--nproc_per_node", type=int, default=1, help="processes per host (1 = one controller per host)")
+    p.add_argument("--master", type=str, default=None, help="coordinator addr host:port (jax.distributed)")
+    p.add_argument("--rank", type=int, default=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--devices", "--gpus", type=str, default=None, help="visible device ids")
+    p.add_argument("--max_restart", type=int, default=3, help="elastic: restarts before giving up")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _worker_env(args, local_rank: int, world: int) -> dict:
+    env = dict(os.environ)
+    rank = args.rank * args.nproc_per_node + local_rank
+    env.update(
+        PADDLE_TRAINER_ID=str(rank),
+        PADDLE_TRAINERS_NUM=str(world),
+        PADDLE_JOB_ID=args.job_id,
+    )
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+        env["MASTER_ADDR"] = args.master
+    if args.devices:
+        env["TPU_VISIBLE_DEVICES"] = args.devices
+    return env
+
+
+def launch(args=None):
+    args = args if args is not None else _parse_args()
+    nnodes = int(str(args.nnodes).split(":")[0])
+    world = nnodes * args.nproc_per_node
+    os.makedirs(args.log_dir, exist_ok=True)
+
+    procs = []
+    restarts = 0
+    while True:
+        for lr in range(args.nproc_per_node):
+            log = open(os.path.join(args.log_dir, f"workerlog.{lr}"), "a")
+            cmd = [sys.executable, args.training_script, *args.training_script_args]
+            procs.append(
+                (subprocess.Popen(cmd, env=_worker_env(args, lr, world), stdout=log, stderr=subprocess.STDOUT), log)
+            )
+        # watch children (controllers/controller.py:167 watch loop)
+        codes = [p.wait() for p, _ in procs]
+        for _, log in procs:
+            log.close()
+        if all(c == 0 for c in codes):
+            return 0
+        restarts += 1
+        if restarts > args.max_restart:
+            print(f"workers failed with {codes} after {restarts - 1} restarts", file=sys.stderr)
+            return max(codes)
+        print(f"worker failure {codes}; elastic restart {restarts}/{args.max_restart}", file=sys.stderr)
+        procs = []
+        time.sleep(1)
+
+
+def main():
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

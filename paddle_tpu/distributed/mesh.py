@@ -1,0 +1,79 @@
+"""Global device-mesh registry — the TPU-native root of all parallelism.
+
+The reference bootstraps NCCL communicators per ring (c_gen_nccl_id_op.cc +
+platform/collective_helper.h NCCLCommContext, keyed by ring_id). On TPU there
+are no rings and no comm streams: a `jax.sharding.Mesh` over ICI/DCN is the
+communicator, mesh *axis names* are the ring_id analog, and XLA compiles the
+collectives into the program. This module owns the process-global mesh that
+groups/topology/fleet all hang off.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_global_mesh: Optional[Mesh] = None
+
+# Canonical hybrid axis order, outermost -> innermost. Innermost axes vary
+# fastest over the device list, so `mp` (the bandwidth-hungriest axis) lands on
+# physically adjacent chips — same rank-assignment rule as the reference's
+# CommunicateTopology (fleet/base/topology.py:54, model axis fastest).
+HYBRID_AXES = ("dp", "pp", "sharding", "mp")
+
+
+def build_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a named Mesh from {axis_name: size}, C-order over the device list."""
+    devices = list(devices) if devices is not None else list(jax.devices())
+    sizes = list(axes.values())
+    n = int(np.prod(sizes)) if sizes else 1
+    if n > len(devices):
+        raise ValueError(f"mesh {axes} needs {n} devices, only {len(devices)} available")
+    grid = np.array(devices[:n]).reshape(sizes)
+    return Mesh(grid, tuple(axes.keys()))
+
+
+def set_global_mesh(mesh: Mesh) -> Mesh:
+    global _global_mesh
+    _global_mesh = mesh
+    return mesh
+
+
+def get_global_mesh() -> Mesh:
+    """The process-global mesh; lazily a 1-D world mesh over all devices."""
+    global _global_mesh
+    if _global_mesh is None:
+        _global_mesh = build_mesh({"world": len(jax.devices())})
+    return _global_mesh
+
+
+def reset_global_mesh():
+    global _global_mesh
+    _global_mesh = None
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def init_distributed_runtime():
+    """Multi-host bootstrap (the TCPStore + c_comm_init analog).
+
+    Single-controller JAX needs `jax.distributed.initialize` once per process
+    when spanning hosts; the coordination service plays the role of the
+    reference's TCP Store rendezvous (phi/core/distributed/store/). Reads the
+    same env contract as `paddle.distributed.launch` sets for the reference
+    (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER).
+    """
+    if int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1 and jax.process_count() == 1:
+        coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+        if coord:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(os.environ["PADDLE_TRAINERS_NUM"]),
+                process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+            )

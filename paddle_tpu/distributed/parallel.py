@@ -1,0 +1,120 @@
+"""Parallel environment + DataParallel (distributed/parallel.py analog).
+
+`init_parallel_env` (reference parallel.py:915) bootstraps NCCL and builds the
+default process group. Here it initializes the JAX distributed runtime when
+multi-host, builds the world mesh, and registers the default Group. Rank =
+`jax.process_index()` under multi-controller; under single-controller SPMD the
+controller owns every "rank" (ranks are mesh coordinates) and get_rank() is 0.
+
+DataParallel (reference parallel.py:186 + the C++ EagerReducer reducer.h:89)
+needed gradient bucketing + fused allreduce overlapped with backward. On TPU
+the reducer does not exist: batch-axis sharding via NamedSharding makes XLA
+emit the gradient all-reduce inside the compiled step, already overlapped.
+DataParallel here only annotates the model and scales losses for parity.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .collective import Group, _get_global_group
+from .mesh import get_global_mesh, init_distributed_runtime
+
+
+class ParallelEnv:
+    """Env-derived rank info (the PaddleCloudRoleMaker / ParallelEnv analog)."""
+
+    def __init__(self):
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", jax.process_count()))
+        self.device_id = int(os.environ.get("FLAGS_selected_tpus", "0").split(",")[0])
+        self.trainer_endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def dev_id(self):
+        return self.device_id
+
+
+_parallel_env: Optional[ParallelEnv] = None
+
+
+def init_parallel_env() -> ParallelEnv:
+    global _parallel_env
+    if _parallel_env is None:
+        init_distributed_runtime()
+        get_global_mesh()
+        _get_global_group()
+        _parallel_env = ParallelEnv()
+    return _parallel_env
+
+
+def get_rank(group: Group = None) -> int:
+    if group is not None:
+        return group.get_group_rank(get_rank())
+    if _parallel_env is not None:
+        return _parallel_env.rank
+    return jax.process_index()
+
+
+def get_world_size(group: Group = None) -> int:
+    if group is not None:
+        return group.nranks
+    if _parallel_env is not None:
+        return _parallel_env.world_size
+    return max(jax.process_count(), 1)
+
+
+class DataParallel(Layer):
+    """paddle.DataParallel analog. Pure annotation on TPU: the wrapped layer's
+    parameters are replicated, inputs are expected batch-sharded over the dp
+    axis, and GSPMD inserts the gradient psum the EagerReducer used to do."""
+
+    def __init__(
+        self,
+        layers: Layer,
+        strategy=None,
+        comm_buffer_size: int = 25,
+        last_comm_buffer_size: int = 1,
+        find_unused_parameters: bool = False,
+        group: Group = None,
+    ):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+        init_parallel_env()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss: Tensor) -> Tensor:
+        return loss  # GSPMD mean-reduces grads; no manual scaling needed
+
+    def apply_collective_grads(self):
+        pass  # grads all-reduced inside the compiled step by XLA
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__.get("_sub_layers", {}).get("_layers"), name)

@@ -1,0 +1,59 @@
+"""Sharding-annotation helpers shared by fleet layers and auto_parallel.
+
+The one primitive everything rests on: `maybe_shard(x, spec)` applies
+`with_sharding_constraint` when the ambient mesh (jax.set_mesh /
+pjit-enclosing mesh) carries the spec's axes, and is a no-op otherwise — so
+the same layer code runs unannotated on one chip and GSPMD-partitioned under
+a mesh. This replaces the reference's entire partitioner/resharder machinery
+(auto_parallel/partitioner.py:38, reshard.py:1008): XLA's SPMD partitioner
+does the program rewriting the reference did by hand.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+
+
+def ambient_axis_names():
+    try:
+        return jax.sharding.get_abstract_mesh().axis_names
+    except Exception:
+        return ()
+
+
+def _spec_axes(spec: P):
+    axes = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            axes.add(a)
+    return axes
+
+
+def maybe_shard(x, spec: P):
+    """with_sharding_constraint(x, spec) iff the ambient mesh has the axes.
+
+    Tensor inputs route through the op-dispatch seam so the tape records the
+    (gradient-transparent) constraint and eager backward still flows.
+    """
+    names = ambient_axis_names()
+    if not names or not _spec_axes(spec).issubset(set(names)):
+        return x
+    if isinstance(x, Tensor):
+        from ..ops._dispatch import apply
+
+        return apply("shard_constraint", lambda v: jax.lax.with_sharding_constraint(v, spec), x)
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def annotate_parameter(param, spec: P):
+    """Record the GSPMD placement on a Parameter (dims_mapping analog —
+    fluid/distributed/auto_parallel dist_attr). Consumed when building the
+    pjit in/out shardings of a train step."""
+    param.dist_spec = spec
+    param.is_distributed = any(s is not None for s in spec)
+    return param

@@ -1,0 +1,217 @@
+"""Hybrid-parallel topology over a named device mesh.
+
+Reference: fleet/base/topology.py:54 `CommunicateTopology` (rank = coordinate
+in a 4-D [data, pipe, sharding, model] grid) and :140 `HybridCommunicateGroup`
+(carves the world into per-axis process groups via new_group). That 4-D grid
+IS a GSPMD mesh — so here the topology directly owns a `jax.sharding.Mesh`
+with named axes, and "process groups" are handles onto mesh axes. Sharding
+specs written against these axis names compile to ICI collectives.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+from jax.sharding import Mesh
+
+from .collective import Group
+from .mesh import build_mesh, set_global_mesh
+
+# paddle axis naming -> our mesh axis names
+_AXIS_ALIAS = {"data": "dp", "pipe": "pp", "sharding": "sharding", "model": "mp", "sep": "sep"}
+
+
+class CommunicateTopology:
+    """N-D cartesian rank grid with named axes (fleet/base/topology.py:54)."""
+
+    def __init__(
+        self,
+        hybrid_group_names: Sequence[str] = ("data", "pipe", "sharding", "model"),
+        dims: Sequence[int] = (1, 1, 1, 1),
+    ):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(*(range(d) for d in dims)))
+        self._rank2coord = {self._coord_rank(c): c for c in self.coordinate}
+        self._coord2rank = {c: r for r, c in self._rank2coord.items()}
+
+    def _coord_rank(self, coord) -> int:
+        return int(np.ravel_multi_index(coord, self._dims))
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank: int):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All ranks whose coordinate on `axis_name` equals index."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(self._coord2rank[c] for c in self.coordinate if c[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """Groups of ranks that vary only along `axis_name` (topology.py:120)."""
+        axis = self._parallel_names.index(axis_name)
+        other = [i for i in range(len(self._dims)) if i != axis]
+        groups = {}
+        for c in self.coordinate:
+            key = tuple(c[i] for i in other)
+            groups.setdefault(key, []).append(self._coord2rank[c])
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+
+class HybridCommunicateGroup:
+    """The hybrid mesh + per-axis group handles (fleet/base/topology.py:140).
+
+    TPU-native: builds ONE `jax.sharding.Mesh` with axes (dp, pp, sharding,
+    mp[, sep]); per-axis "process groups" are Group handles onto that mesh's
+    axes, and `get_mesh()` is what pjit/shard_map train steps run under.
+    """
+
+    def __init__(self, topology: CommunicateTopology, global_rank: int = 0):
+        self._topo = topology
+        self.global_rank = global_rank
+        self.nranks = topology.world_size()
+
+        names = topology.get_hybrid_group_names()
+        self._axes: Dict[str, int] = {_AXIS_ALIAS.get(n, n): topology.get_dim(n) for n in names}
+        # mesh axes in topology order: data outermost ... model innermost
+        self.mesh: Mesh = build_mesh(self._axes)
+        set_global_mesh(self.mesh)
+
+        self._dp_degree = self._axes.get("dp", 1)
+        self._pp_degree = self._axes.get("pp", 1)
+        self._sharding_degree = self._axes.get("sharding", 1)
+        self._mp_degree = self._axes.get("mp", 1)
+        self._sep_degree = self._axes.get("sep", 1)
+
+        coord = topology.get_coord(global_rank)
+        self._coord = dict(zip(names, coord))
+        self._groups: Dict[str, Group] = {}
+        for paddle_name in names:
+            axis = _AXIS_ALIAS.get(paddle_name, paddle_name)
+            my_index = self._coord[paddle_name]
+            ranks = topology.get_axis_list(paddle_name, my_index) if topology.get_dim(paddle_name) > 1 else [global_rank]
+            # ranks varying along this axis that include global_rank:
+            for grp in topology.get_comm_list(paddle_name):
+                if global_rank in grp:
+                    ranks = grp
+                    break
+            self._groups[axis] = Group(ranks, self.mesh, axis, name=f"{axis}_group")
+
+    # ---- topology accessors (topology.py:348-404 parity) ----
+    def get_parallel_mode(self):
+        from . import fleet as _fleet
+
+        if self._mp_degree > 1 or self._pp_degree > 1:
+            return "hybrid"
+        if self._sharding_degree > 1:
+            return "sharding"
+        return "data" if self._dp_degree > 1 else "single"
+
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_global_rank(self) -> int:
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_rank(self) -> int:
+        return self._coord.get("data", 0)
+
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp_degree
+
+    def get_data_parallel_group(self) -> Group:
+        return self._groups.get("dp")
+
+    def get_data_parallel_group_src_rank(self) -> int:
+        return self._groups["dp"].ranks[0]
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self) -> int:
+        return self._coord.get("model", 0)
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp_degree
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups.get("mp")
+
+    def get_model_parallel_group_src_rank(self) -> int:
+        return self._groups["mp"].ranks[0]
+
+    # pipeline parallel
+    def get_stage_id(self) -> int:
+        return self._coord.get("pipe", 0)
+
+    def get_pipe_parallel_rank(self) -> int:
+        return self._coord.get("pipe", 0)
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups.get("pp")
+
+    def is_first_stage(self) -> bool:
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self) -> bool:
+        return self.get_stage_id() == self._pp_degree - 1
+
+    # sharding
+    def get_sharding_parallel_rank(self) -> int:
+        return self._coord.get("sharding", 0)
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups.get("sharding")
+
+    def get_sharding_parallel_group_src_rank(self) -> int:
+        return self._groups["sharding"].ranks[0]
+
+    # sep (sequence parallel axis, ours — absent in the reference §5.7)
+    def get_sep_parallel_rank(self) -> int:
+        return self._coord.get("sep", 0)
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._sep_degree
+
+    def get_sep_parallel_group(self) -> Optional[Group]:
+        return self._groups.get("sep")
+
+    # mesh accessors (TPU-native additions)
+    def get_mesh(self) -> Mesh:
+        return self.mesh
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return dict(self._axes)
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg
