@@ -1,0 +1,78 @@
+"""Benchmark: GPT causal-LM training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no in-repo numbers (SURVEY §6); the driver-set north
+star is GPT pretrain MFU >= 0.40, so vs_baseline = model_flops_utilization / 0.40.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+
+    # sized for a single v5e chip; tiny on CPU so the harness still runs
+    if on_tpu:
+        cfg = GPTConfig(
+            vocab_size=32768, hidden_size=1024, num_layers=12, num_heads=16, max_seq_len=1024, dropout=0.0
+        )
+        bsz, seq, iters = 8, 1024, 20
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4, max_seq_len=128, dropout=0.0)
+        bsz, seq, iters = 4, 64, 3
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model = model.astype("bfloat16")  # MXU-native activations/weights
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(), multi_precision=True)
+    step = make_sharded_train_step(model, opt)
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, size=(bsz, seq))
+    y = np.roll(x, -1, axis=1)
+
+    step(x, y)  # compile + warmup
+    jax.effects_barrier()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    _ = float(loss)  # block
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = bsz * seq * iters / dt
+
+    # 6 * N * tokens/sec fwd+bwd FLOPs (attention term included via 12*L*h*s)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    attn_flops = 12 * cfg.num_layers * cfg.hidden_size * seq  # per token
+    flops_per_token = 6 * n_params + attn_flops
+    achieved = flops_per_token * tokens_per_sec
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
+    mfu = achieved / peak
+
+    print(
+        json.dumps(
+            {
+                "metric": "gpt_train_tokens_per_sec",
+                "value": round(tokens_per_sec, 1),
+                "unit": f"tokens/sec/chip ({backend}, {n_params/1e6:.0f}M params, MFU={mfu:.3f})",
+                "vs_baseline": round(mfu / 0.40, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

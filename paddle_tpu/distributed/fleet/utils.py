@@ -1,0 +1,175 @@
+"""Sharded train-step builder: where all the annotations become a program.
+
+The reference's hybrid path assembles a training step at runtime — wrappers,
+reducer hooks, pipeline schedulers, hybrid optimizer sync (SURVEY §3.4). Here
+the step is one pjit-compiled pure function: parameters/optimizer state carry
+NamedShardings derived from each Parameter's dist_spec (mp/sharding axes),
+the batch is sharded over dp, and XLA emits + overlaps every collective. This
+module is the single seam the GPT fixture, __graft_entry__ dry-run, bench.py
+and the hapi/auto-parallel engines all compile through.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core import random as _random
+from ...core.autograd import no_grad
+from ...core.tensor import Tensor
+from ...nn.clip import ClipGradByGlobalNorm
+from ...nn.layer.layers import Layer
+from ...optimizer.optimizer import Optimizer
+from ..sharding_utils import ambient_axis_names
+
+
+def resolve_spec(spec: Optional[P], mesh: Mesh) -> P:
+    """Drop spec axes the mesh doesn't have (mp spec on a dp-only mesh -> P())."""
+    if spec is None:
+        return P()
+    names = set(mesh.axis_names)
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in names)
+            out.append(kept if kept else None)
+        else:
+            out.append(entry if entry in names else None)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def param_shardings(model: Layer, mesh: Mesh):
+    """{name: NamedSharding} from each Parameter's dist_spec annotation."""
+    out = {}
+    for name, p in model.named_parameters():
+        if p is None:
+            continue
+        out[name] = NamedSharding(mesh, resolve_spec(getattr(p, "dist_spec", None), mesh))
+    return out
+
+
+def _state_sharding_like(param_sharding: NamedSharding, leaf, mesh: Mesh, shard_axis: Optional[str]):
+    if leaf.ndim == 0:
+        return NamedSharding(mesh, P())
+    spec = param_sharding.spec
+    if shard_axis and shard_axis in mesh.axis_names and not any(spec):
+        from .meta_parallel.sharding import shard_spec_for
+
+        return NamedSharding(mesh, shard_spec_for(leaf.shape, mesh.shape[shard_axis], shard_axis))
+    return NamedSharding(mesh, spec if len(spec) <= leaf.ndim else P())
+
+
+class ShardedTrainStep:
+    """Holds device state (params, opt state) and the compiled step.
+
+    step(batch) -> loss. Batch = (x, y) numpy/jax arrays; x sharded over the
+    dp axis on dim 0. `sync_to_model()` writes params back into the Layer.
+    """
+
+    def __init__(
+        self,
+        model: Layer,
+        optimizer: Optimizer,
+        loss_fn: Optional[Callable] = None,
+        mesh: Optional[Mesh] = None,
+        batch_spec: P = P("dp"),
+        donate: bool = True,
+        seed: int = 0,
+    ):
+        from ..topology import get_hybrid_communicate_group
+
+        if mesh is None:
+            hcg = get_hybrid_communicate_group()
+            import numpy as _np
+
+            mesh = hcg.get_mesh() if hcg is not None else Mesh(_np.array(jax.devices()[:1]), ("dp",))
+        self.mesh = mesh
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn if loss_fn is not None else getattr(model, "loss")
+        self._step_i = 0
+        self._seed = seed
+
+        params0, buffers0 = model.functional_state()
+        self._buffers = buffers0
+        opt_state0 = optimizer.init_state_pytree(params0)
+
+        p_shard = param_shardings(model, mesh)
+        shard_axis = getattr(optimizer, "_shard_state_axis", None)
+        s_shard = {
+            name: jax.tree_util.tree_map(
+                lambda leaf: _state_sharding_like(p_shard[name], leaf, mesh, shard_axis), opt_state0[name]
+            )
+            for name in opt_state0
+        }
+        self.params = jax.tree_util.tree_map(
+            lambda v, s: jax.device_put(v, s), params0, {k: p_shard[k] for k in params0}
+        )
+        self.opt_state = jax.tree_util.tree_map(jax.device_put, opt_state0, s_shard)
+
+        batch_sharding = NamedSharding(mesh, resolve_spec(batch_spec, mesh))
+        clip = optimizer._grad_clip if isinstance(optimizer._grad_clip, ClipGradByGlobalNorm) else None
+        clip_norm = clip.clip_norm if clip is not None else None
+        loss_fn_ = self.loss_fn
+        mdl = model
+
+        def step(params, opt_state, x, y, lr, seed):
+            def loss_of(pvals):
+                with no_grad(), _random.rng_scope(seed):
+                    out, _ = mdl.functional_call(pvals, buffers0, Tensor(x))
+                    loss = loss_fn_(out, Tensor(y))
+                return loss._value.astype(jnp.float32)
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            if clip_norm is not None:
+                gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree_util.tree_leaves(grads))
+                scale = clip_norm / jnp.maximum(jnp.sqrt(gsq), clip_norm)
+                grads = jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), grads)
+            new_params, new_state = optimizer.apply_gradients(params, grads, opt_state, lr=lr)
+            return new_params, new_state, loss
+
+        donate_args = (0, 1) if donate else ()
+        self._compiled = jax.jit(
+            step,
+            in_shardings=(p_shard, s_shard, batch_sharding, batch_sharding, None, None),
+            out_shardings=(p_shard, s_shard, NamedSharding(mesh, P())),
+            donate_argnums=donate_args,
+        )
+
+    def __call__(self, x, y, lr: Optional[float] = None):
+        lr = self.optimizer.get_lr() if lr is None else lr
+        self._step_i += 1
+        with jax.set_mesh(self.mesh):
+            self.params, self.opt_state, loss = self._compiled(
+                self.params,
+                self.opt_state,
+                jnp.asarray(x if not isinstance(x, Tensor) else x._value),
+                jnp.asarray(y if not isinstance(y, Tensor) else y._value),
+                jnp.float32(lr),
+                jnp.uint32(self._seed + self._step_i),
+            )
+        return loss
+
+    step = __call__
+
+    def sync_to_model(self):
+        named = dict(self.model.named_parameters())
+        for name, v in self.params.items():
+            named[name]._set_value_raw(v)
+
+    def lower_compiled(self, x, y):
+        """AOT-lower (for compile checks without executing)."""
+        return self._compiled.lower(
+            self.params, self.opt_state, jnp.asarray(x), jnp.asarray(y), jnp.float32(1e-3), jnp.uint32(0)
+        )
+
+
+def make_sharded_train_step(model, optimizer, loss_fn=None, mesh=None, **kwargs) -> ShardedTrainStep:
+    return ShardedTrainStep(model, optimizer, loss_fn=loss_fn, mesh=mesh, **kwargs)

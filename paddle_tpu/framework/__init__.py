@@ -1,0 +1,4 @@
+"""paddle.framework analog: io + core re-exports."""
+
+from .io import load, load_sharded, save, save_async, save_sharded, wait_async_saves  # noqa: F401
+from .random import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401

@@ -1,0 +1,12 @@
+"""framework.random parity shims (CUDA RNG naming maps to the device PRNG)."""
+
+from ..core import random as _random
+
+
+def get_cuda_rng_state():
+    return [_random.get_rng_state()]
+
+
+def set_cuda_rng_state(states):
+    if states:
+        _random.set_rng_state(states[0])

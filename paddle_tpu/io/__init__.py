@@ -1,0 +1,32 @@
+"""paddle_tpu.io: datasets + DataLoader (python/paddle/io analog).
+
+The reference feeds GPUs through multiprocess workers pushing LoDTensors into
+a C++ LoDTensorBlockingQueue (fluid/dataloader/, reader ops). The TPU-native
+pipeline is host-side: worker threads fill a bounded prefetch queue with
+batched numpy arrays; the device transfer happens inside the jitted step (or
+via device_put with the batch sharding), so the queue only moves host memory.
+A C++ pipeline core (paddle_tpu/lib/data_pipeline) accelerates the hot loop
+when built — transparently, same API.
+"""
+
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    RandomSplitDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401

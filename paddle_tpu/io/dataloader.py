@@ -1,0 +1,210 @@
+"""DataLoader (python/paddle/io/dataloader + fluid/reader.py analog).
+
+The reference moves batches through multiprocess workers into a C++
+LoDTensorBlockingQueue read by reader ops. Here the pipeline is
+threads + a bounded queue: map-style datasets are indexed by worker threads
+(numpy work releases the GIL for the hot paths: decode/augment/stack), and the
+prefetch depth keeps the accelerator fed while the current step runs — the
+role StreamSafeCUDAAllocator + pinned-memory staging played for CUDA is
+subsumed by XLA's async dispatch.
+
+Threads instead of processes is deliberate for TPU hosts: the heavy lifting
+(tokenization/augment) is numpy/C; fork-based workers would break the JAX
+runtime and multiprocess pickling costs more than it saves at TPU batch sizes.
+When the native pipeline library is built (paddle_tpu/lib), batch assembly
+drops into C++ (see paddle_tpu.io.native).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+_worker_info_tls = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id: int, num_workers: int, seed: int, dataset=None):
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+
+def get_worker_info() -> Optional[WorkerInfo]:
+    return getattr(_worker_info_tls, "info", None)
+
+
+def default_collate_fn(batch):
+    """List of samples -> batched arrays (dataloader/collate.py analog)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s._value) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.generic)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (tuple, list)):
+        return type(sample)(default_collate_fn([s[i] for s in batch]) for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    raise TypeError(f"cannot collate {type(sample)}")
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset: Dataset,
+        feed_list=None,
+        places=None,
+        return_list: bool = True,
+        batch_sampler: Optional[BatchSampler] = None,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+        num_workers: int = 0,
+        use_buffer_reader: bool = True,
+        prefetch_factor: int = 2,
+        use_shared_memory: bool = True,
+        timeout: float = 0,
+        worker_init_fn: Optional[Callable] = None,
+        persistent_workers: bool = False,
+    ):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = max(0, int(num_workers))
+        self.prefetch_factor = max(1, int(prefetch_factor))
+        self.timeout = timeout or None
+        self.worker_init_fn = worker_init_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    # ---- iteration ----
+    def _fetch(self, indices):
+        return self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_single(self):
+        if self._iterable_mode:
+            it = iter(self.dataset)
+            while True:
+                chunk = list(itertools.islice(it, self.batch_size))
+                if not chunk:
+                    return
+                if len(chunk) < self.batch_size and self.drop_last:
+                    return
+                yield self.collate_fn(chunk)
+        else:
+            for indices in self.batch_sampler:
+                yield self._fetch(indices)
+
+    def _iter_workers(self):
+        """Thread pool + ordered bounded prefetch queue."""
+        n = self.num_workers
+        depth = n * self.prefetch_factor
+        task_q: "queue.Queue" = queue.Queue()
+        done = object()
+        results = {}
+        results_lock = threading.Condition()
+        stop = threading.Event()
+
+        if self._iterable_mode:
+            # one worker streams; others idle (iterable split is dataset's job)
+            batches = self._iter_single()
+
+            def produce():
+                for i, b in enumerate(batches):
+                    if stop.is_set():
+                        return
+                    with results_lock:
+                        while len(results) >= depth and not stop.is_set():
+                            results_lock.wait(0.1)
+                        results[i] = b
+                        results_lock.notify_all()
+                with results_lock:
+                    results[-1] = done
+                    results_lock.notify_all()
+
+            t = threading.Thread(target=produce, daemon=True)
+            t.start()
+            i = 0
+            while True:
+                with results_lock:
+                    while i not in results and -1 not in results:
+                        results_lock.wait(0.1)
+                    if i in results:
+                        b = results.pop(i)
+                        results_lock.notify_all()
+                    else:
+                        return
+                yield b
+                i += 1
+            return
+
+        indices_list = list(self.batch_sampler)
+        for i, idx in enumerate(indices_list):
+            task_q.put((i, idx))
+
+        def worker(wid):
+            _worker_info_tls.info = WorkerInfo(wid, n, wid, self.dataset)
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(wid)
+            while not stop.is_set():
+                try:
+                    i, idx = task_q.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    b = self._fetch(idx)
+                except Exception as e:  # propagate to consumer
+                    b = e
+                with results_lock:
+                    while len(results) >= depth and not stop.is_set():
+                        results_lock.wait(0.1)
+                    results[i] = b
+                    results_lock.notify_all()
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True) for w in range(n)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(len(indices_list)):
+                with results_lock:
+                    while i not in results:
+                        results_lock.wait(0.1)
+                    b = results.pop(i)
+                    results_lock.notify_all()
+                if isinstance(b, Exception):
+                    raise b
+                yield b
+        finally:
+            stop.set()
+
+    def __iter__(self):
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_workers()
+
+    def __call__(self):
+        return self.__iter__()

@@ -1,0 +1,162 @@
+"""Samplers (python/paddle/io/dataloader/sampler.py, batch_sampler.py analogs)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import random as _random
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement: bool = False, num_samples: int = None, generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples if self._num_samples is not None else len(self.data_source)
+
+    def _rng(self):
+        seed = (
+            self.generator.random()
+            if self.generator is not None
+            else _random.default_generator.random()
+        )
+        return np.random.RandomState(seed % (2**32))
+
+    def __iter__(self):
+        n = len(self.data_source)
+        rng = self._rng()
+        if self.replacement:
+            return iter(rng.randint(0, n, size=self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    def __init__(self, indices, generator=None):
+        super().__init__(None)
+        self.indices = list(indices)
+        self.generator = generator
+
+    def __iter__(self):
+        seed = _random.default_generator.random()
+        perm = np.random.RandomState(seed % (2**32)).permutation(len(self.indices))
+        return iter(self.indices[i] for i in perm)
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples: int, replacement: bool = True):
+        super().__init__(None)
+        self.weights = np.asarray(weights, dtype=np.float64)
+        if (self.weights < 0).any():
+            raise ValueError("weights must be non-negative")
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        seed = _random.default_generator.random()
+        rng = np.random.RandomState(seed % (2**32))
+        p = self.weights / self.weights.sum()
+        idx = rng.choice(len(self.weights), size=self.num_samples, replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle: bool = False, batch_size: int = 1, drop_last: bool = False):
+        super().__init__(dataset)
+        if sampler is not None:
+            self.sampler = sampler
+        elif shuffle:
+            self.sampler = RandomSampler(dataset)
+        else:
+            self.sampler = SequenceSampler(dataset)
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Per-rank batch sampler (distributed/fleet dataloader analog). Under
+    single-controller SPMD the "rank shard" is usually unnecessary (the global
+    batch is sharded over dp by the step), but multi-host input pipelines use
+    this to read disjoint data per host."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None, shuffle=False, drop_last=False):
+        from ..distributed.parallel import get_rank, get_world_size
+
+        self.num_replicas = num_replicas if num_replicas is not None else max(get_world_size(), 1)
+        self.rank = rank if rank is not None else get_rank()
+        self.shuffle = shuffle
+        self.epoch = 0
+        super().__init__(dataset, None, shuffle, batch_size, drop_last)
+        self.num_samples = int(np.ceil(len(dataset) / self.num_replicas))
+        self.total_size = self.num_samples * self.num_replicas
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.shuffle:
+            seed = (_random.default_generator.initial_seed() + self.epoch) % (2**32)
+            indices = np.random.RandomState(seed).permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        indices += indices[: self.total_size - len(indices)]
+        indices = indices[self.rank : self.total_size : self.num_replicas]
+        batch = []
+        for idx in indices:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size

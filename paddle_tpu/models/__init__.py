@@ -1,0 +1,3 @@
+"""Model zoo: flagship training fixtures (PaddleNLP / test-fixture analogs)."""
+
+from .gpt import GPT3_1p3B, GPT_TINY, GPTConfig, GPTForCausalLM, GPTModel, gpt_tiny  # noqa: F401

@@ -1,0 +1,206 @@
+"""GPT: the flagship decoder-only LM (PaddleNLP gpt-3 / test fixture
+auto_parallel_gpt_model.py analog — SURVEY.md §4, §6 north-star configs).
+
+TPU-first design choices:
+- Every projection is a fleet mp layer (ColumnParallel qkv+fc1, RowParallel
+  proj+fc2, VocabParallelEmbedding): on one chip they are plain dense layers;
+  under a mesh the P(*, 'mp') annotations make GSPMD emit Megatron TP with
+  exactly two collectives per block.
+- Attention runs through nn.functional.scaled_dot_product_attention, the seam
+  where the Pallas flash kernel plugs in on TPU ([B, S, H, D] layout).
+- `sequence_parallel=True` re-shards the residual stream P(dp, mp, None)
+  between blocks, sharding LayerNorm/dropout work along seq over the mp axis
+  (Megatron-SP — absent in the reference, SURVEY §5.7; the allgather/
+  reduce-scatter seams fall out of the GSPMD annotations).
+- bf16-friendly: params stay f32 (master copy lives in the optimizer),
+  activations cast by amp or the caller.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..distributed.sharding_utils import annotate_parameter, maybe_shard
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    intermediate_size: int = None
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = True
+    sequence_parallel: bool = False
+    use_recompute: bool = False
+    initializer_range: float = 0.02
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+        if self.hidden_size % self.num_heads:
+            raise ValueError("hidden_size must divide num_heads")
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+# GPT-3 1.3B — the BASELINE.json pretrain config
+GPT3_1p3B = dict(vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16, max_seq_len=2048)
+GPT_TINY = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4, max_seq_len=64)
+
+
+def _seq_spec(cfg: GPTConfig) -> P:
+    # residual stream sharding between blocks: batch over dp, and seq over mp
+    # when sequence-parallel (Megatron-SP)
+    return P("dp", "mp", None) if cfg.sequence_parallel else P("dp", None, None)
+
+
+class GPTAttention(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.qkv = ColumnParallelLinear(cfg.hidden_size, 3 * cfg.hidden_size, gather_output=False)
+        self.proj = RowParallelLinear(cfg.hidden_size, cfg.hidden_size, input_is_parallel=True)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        B, S = x.shape[0], x.shape[1]
+        cfg = self.cfg
+        qkv = self.qkv(x)  # [B, S, 3H/mp] sharded on last dim
+        qkv = qkv.reshape([B, S, 3, cfg.num_heads, cfg.head_dim])
+        qkv = maybe_shard(qkv, P("dp", None, None, "mp", None))  # heads over mp
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, S, H, D]
+        out = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=cfg.dropout, is_causal=True, training=self.training
+        )
+        out = out.reshape([B, S, cfg.hidden_size])
+        return self.dropout(self.proj(out))
+
+
+class GPTMLP(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.fc1 = ColumnParallelLinear(cfg.hidden_size, cfg.intermediate_size, gather_output=False)
+        self.fc2 = RowParallelLinear(cfg.intermediate_size, cfg.hidden_size, input_is_parallel=True)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.mlp = GPTMLP(cfg)
+
+    def forward(self, x):
+        x = maybe_shard(x, _seq_spec(self.cfg))
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        return maybe_shard(x, _seq_spec(self.cfg))
+
+
+class GPTEmbeddings(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.word_embeddings = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids, position_ids=None):
+        import paddle_tpu as paddle
+
+        if position_ids is None:
+            position_ids = paddle.arange(input_ids.shape[1]).unsqueeze(0)
+        h = self.word_embeddings(input_ids) + self.position_embeddings(position_ids)
+        return self.dropout(h)
+
+
+class GPTModel(Layer):
+    """Transformer trunk: embeddings -> blocks -> final LN."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = GPTEmbeddings(cfg)
+        self.layers = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.final_ln = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self._init_weights()
+
+    def _init_weights(self):
+        import jax.numpy as jnp
+
+        from ..core import random as _random
+
+        std = self.cfg.initializer_range
+        import jax
+
+        for name, p in self.named_parameters():
+            if p is None:
+                continue
+            if p._value.ndim >= 2:
+                key = _random.default_generator.next_key()
+                p._set_value_raw(std * jax.random.normal(key, p._value.shape, p._value.dtype))
+            elif "bias" in name:
+                p._set_value_raw(jnp.zeros_like(p._value))
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.embeddings(input_ids, position_ids)
+        for i, block in enumerate(self.layers):
+            if self.cfg.use_recompute and self.training:
+                from ..distributed.fleet.recompute import recompute
+
+                h = recompute(block, h)
+            else:
+                h = block(h)
+        return self.final_ln(h)
+
+
+class GPTForCausalLM(Layer):
+    """Trunk + (tied) LM head + causal-LM loss."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size, has_bias=False, gather_output=False)
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.gpt(input_ids, position_ids)
+        if self.cfg.tie_word_embeddings:
+            logits = h.matmul(self.gpt.embeddings.word_embeddings.weight, transpose_y=True)
+            logits = maybe_shard(logits, P("dp", None, "mp"))
+        else:
+            logits = self.lm_head(h)
+        return logits
+
+    def loss(self, logits, labels):
+        """Next-token CE, labels already shifted by the data pipeline."""
+        V = logits.shape[-1]
+        return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1])).mean()
+
+
+def gpt_tiny(**overrides) -> GPTForCausalLM:
+    cfg = {**GPT_TINY, **overrides}
+    return GPTForCausalLM(GPTConfig(**cfg))

@@ -1,0 +1,184 @@
+"""io (DataLoader family) + checkpoint save/load tests (SURVEY §2.7, §5.4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (
+    BatchSampler,
+    ChainDataset,
+    ConcatDataset,
+    DataLoader,
+    Dataset,
+    DistributedBatchSampler,
+    IterableDataset,
+    RandomSampler,
+    SequenceSampler,
+    Subset,
+    TensorDataset,
+    WeightedRandomSampler,
+    random_split,
+)
+
+
+class _Square(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.float32(i * i)
+
+    def __len__(self):
+        return self.n
+
+
+class _Stream(IterableDataset):
+    def __init__(self, n=10):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield np.float32(i)
+
+
+def test_tensor_dataset_and_loader():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    y = np.arange(6, dtype=np.int64)
+    ds = TensorDataset([x, y])
+    assert len(ds) == 6
+    dl = DataLoader(ds, batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 2
+    bx, by = batches[0]
+    assert bx.shape == [4, 2] and by.shape == [4]
+    np.testing.assert_allclose(bx.numpy(), x[:4])
+
+
+def test_loader_shuffle_drop_last():
+    dl = DataLoader(_Square(10), batch_size=3, shuffle=True, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 3
+    seen = sorted(int(v) for b in batches for v in b[0].numpy())
+    assert len(seen) == 9
+
+
+def test_loader_workers_ordered():
+    dl = DataLoader(_Square(32), batch_size=4, num_workers=3)
+    xs = [b[0].numpy() for b in dl]
+    np.testing.assert_allclose(np.concatenate(xs), np.arange(32, dtype=np.float32))
+
+
+def test_loader_worker_exception_propagates():
+    class Bad(Dataset):
+        def __getitem__(self, i):
+            raise RuntimeError("boom")
+
+        def __len__(self):
+            return 4
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(DataLoader(Bad(), batch_size=2, num_workers=2))
+
+
+def test_iterable_dataset_loader():
+    dl = DataLoader(_Stream(10), batch_size=4)
+    sizes = [b.shape[0] for b in dl]
+    assert sizes == [4, 4, 2]
+    dl2 = DataLoader(_Stream(10), batch_size=4, drop_last=True, num_workers=2)
+    assert [b.shape[0] for b in dl2] == [4, 4]
+
+
+def test_samplers():
+    ds = _Square(10)
+    assert list(SequenceSampler(ds)) == list(range(10))
+    rs = list(RandomSampler(ds))
+    assert sorted(rs) == list(range(10))
+    ws = list(WeightedRandomSampler([0.0, 1.0, 0.0], 5))
+    assert all(i == 1 for i in ws)
+    bs = BatchSampler(ds, batch_size=4, drop_last=False)
+    assert [len(b) for b in bs] == [4, 4, 2]
+
+
+def test_distributed_batch_sampler_partitions():
+    ds = _Square(16)
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert sorted(i0 + i1) == list(range(16))
+    assert not set(i0) & set(i1)
+
+
+def test_concat_subset_split():
+    a, b = _Square(5), _Square(7)
+    cat = ConcatDataset([a, b])
+    assert len(cat) == 12
+    assert cat[6][0] == np.float32(1)
+    sub = Subset(a, [1, 3])
+    assert sub[1][0] == np.float32(3)
+    left, right = random_split(_Square(10), [7, 3])
+    assert len(left) == 7 and len(right) == 3
+    chain = ChainDataset([_Stream(2), _Stream(3)])
+    assert len(list(chain)) == 5
+
+
+def test_save_load_roundtrip(tmp_path):
+    m = paddle.nn.Linear(4, 3)
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(m.state_dict(), path)
+    sd = paddle.load(path)
+    m2 = paddle.nn.Linear(4, 3)
+    m2.set_state_dict(sd)
+    x = paddle.randn([2, 4])
+    np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_save_load_optimizer_state(tmp_path):
+    m = paddle.nn.Linear(4, 3)
+    opt = paddle.optimizer.AdamW(parameters=m.parameters())
+    m(paddle.randn([2, 4])).mean().backward()
+    opt.step()
+    path = str(tmp_path / "opt.pdopt")
+    paddle.save(opt.state_dict(), path)
+    restored = paddle.load(path)
+    opt2 = paddle.optimizer.AdamW(parameters=m.parameters())
+    opt2.set_state_dict(restored)
+    assert opt2.state_dict().keys() == opt.state_dict().keys()
+
+
+def test_save_nested_and_numpy(tmp_path):
+    obj = {"a": paddle.to_tensor([1.0, 2.0]), "b": [paddle.to_tensor(3), {"c": "str"}], "d": 7}
+    p = str(tmp_path / "nest.pd")
+    paddle.save(obj, p)
+    back = paddle.load(p)
+    np.testing.assert_allclose(back["a"].numpy(), [1.0, 2.0])
+    assert back["b"][1]["c"] == "str" and back["d"] == 7
+    back_np = paddle.load(p, return_numpy=True)
+    assert isinstance(back_np["a"], np.ndarray)
+
+
+def test_save_async(tmp_path):
+    from paddle_tpu.framework.io import save_async, wait_async_saves
+
+    p = str(tmp_path / "async.pd")
+    save_async({"x": paddle.to_tensor([1.0])}, p)
+    wait_async_saves()
+    assert os.path.exists(p)
+    np.testing.assert_allclose(paddle.load(p)["x"].numpy(), [1.0])
+
+
+def test_sharded_checkpoint_reshard(tmp_path):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.framework.io import load_sharded, save_sharded
+
+    state = {"w": paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(8, 2))}
+    d = str(tmp_path / "ckpt")
+    save_sharded(state, d)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+    back = load_sharded(d, {"w": NamedSharding(mesh, P("x", None))})
+    np.testing.assert_allclose(np.asarray(back["w"]), state["w"].numpy())
+    assert back["w"].sharding.spec == P("x", None)
