@@ -1,0 +1,14 @@
+"""Pallas TPU kernels — the CUDA-kernel-family replacement (SURVEY §2.2).
+
+Where the reference hand-writes CUDA (flash_attn_kernel.cu, fused_adam,
+fused layer_norm in phi/kernels/gpu + fusion/), the TPU build hand-writes
+Pallas/Mosaic. Every kernel here:
+- computes in f32 on the MXU/VPU regardless of storage dtype,
+- has a jnp fallback + interpret mode so tests run on CPU,
+- is wired behind the op-registry variant seam (ops use it when
+  FLAGS_use_pallas_kernels and the backend is TPU).
+"""
+
+from .flash_attention import flash_attention_fwd  # noqa: F401
+from .norms import fused_layer_norm, fused_rms_norm  # noqa: F401
+from .fused_optim import fused_adamw_update  # noqa: F401

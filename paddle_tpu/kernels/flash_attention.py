@@ -1,0 +1,254 @@
+"""Flash attention, Pallas TPU (phi/kernels/gpu/flash_attn_kernel.cu analog).
+
+Blockwise-softmax attention with O(S) memory: forward keeps running
+(max, sum, acc) per query block while streaming key blocks through VMEM;
+backward is the standard two-kernel split (dq; dk+dv) recomputing P from the
+saved logsumexp. Layout is paddle's flash layout [B, S, H, D]; heads fold
+into the grid's leading axis so each program owns one (batch, head) pair and
+the MXU sees [block_q, D] x [D, block_k] tiles.
+
+Causal masking skips fully-masked key blocks via the loop bound (not just a
+mask), halving causal FLOPs — same trick as the CUDA kernel's early exit.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+# ---------------- forward ----------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, scale: float):
+    q = q_ref[0].astype(jnp.float32)  # [bq, d]
+    bq, d = q.shape
+    S = k_ref.shape[1]
+    qi = pl.program_id(1)
+    num_kb = S // block_k
+    if causal:
+        # process key blocks up to (and including) the diagonal block
+        last = (qi + 1) * bq  # first key index past this q block
+        kb_hi = (last + jnp.int32(block_k - 1)) // jnp.int32(block_k)
+    else:
+        kb_hi = num_kb
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l, acc
+
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, kb_hi, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int):
+    B, S, H, D = q.shape
+    qt = jnp.swapaxes(q, 1, 2).reshape(B * H, S, D)
+    kt = jnp.swapaxes(k, 1, 2).reshape(B * H, S, D)
+    vt = jnp.swapaxes(v, 1, 2).reshape(B * H, S, D)
+    grid = (B * H, S // block_q)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_k=block_k, causal=causal, scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, S), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qt, kt, vt)
+    return o, lse, (qt, kt, vt)
+
+
+# ---------------- backward ----------------
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k, causal, scale):
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    bq, d = q.shape
+    S = k_ref.shape[1]
+    qi = pl.program_id(1)
+    kb_hi = ((qi + 1) * bq + jnp.int32(block_k - 1)) // jnp.int32(block_k) if causal else S // block_k
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, kb_hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q, causal, scale):
+    k = k_ref[0].astype(jnp.float32)  # [bk, d]
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    S = q_ref.shape[1]
+    ki = pl.program_id(1)
+    # causal: query blocks at or after this key block contribute
+    qb_lo = (ki * bk) // block_q if causal else 0
+    num_qb = S // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qb_lo, num_qb, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(causal, scale, block_q, block_k, res, g):
+    qt, kt, vt, o, lse = res
+    BH, S, D = qt.shape
+    do = jnp.swapaxes(g, 1, 2).reshape(BH, S, D)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, S]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, causal=causal, scale=scale),
+        grid=(BH, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), qt.dtype),
+        interpret=_interpret(),
+    )(qt, kt, vt, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, causal=causal, scale=scale),
+        grid=(BH, S // block_k),
+        in_specs=[
+            pl.BlockSpec((1, S, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, S), lambda bh, ki: (bh, 0)),
+            pl.BlockSpec((1, S), lambda bh, ki: (bh, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), kt.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), vt.dtype),
+        ],
+        interpret=_interpret(),
+    )(qt, kt, vt, do, lse, delta)
+
+    def unfold(x, B, H):
+        return jnp.swapaxes(x.reshape(B, H, S, D), 1, 2)
+
+    B = g.shape[0]
+    H = g.shape[2]
+    return unfold(dq, B, H), unfold(dk, B, H), unfold(dv, B, H)
+
+
+def _pick_blocks(S: int):
+    for b in (512, 256, 128, 64, 32, 16, 8):
+        if S % b == 0:
+            return min(b, S), min(b, S)
+    return None, None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, scale):
+    B, S, H, D = q.shape
+    bq, bk = _pick_blocks(S)
+    o, _, _ = _fwd(q, k, v, causal, scale, bq, bk)
+    return jnp.swapaxes(o.reshape(B, H, S, D), 1, 2)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale):
+    B, S, H, D = q.shape
+    bq, bk = _pick_blocks(S)
+    o, lse, (qt, kt, vt) = _fwd(q, k, v, causal, scale, bq, bk)
+    out = jnp.swapaxes(o.reshape(B, H, S, D), 1, 2)
+    return out, (qt, kt, vt, o, lse)
+
+
+def _flash_bwd_rule(causal, scale, res, g):
+    S = res[0].shape[1]
+    bq, bk = _pick_blocks(S)
+    return _bwd(causal, scale, bq, bk, res, g)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_fwd(q, k, v, causal: bool = False, scale: float = None):
+    """[B, S, H, D] flash attention; falls back to None-signal if unsupported
+    (caller uses the jnp reference path)."""
+    B, S, H, D = q.shape
+    if _pick_blocks(S)[0] is None:
+        raise ValueError(f"flash_attention: seq len {S} not divisible by a supported block")
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    return _flash(q, k, v, causal, scale)

@@ -1,0 +1,148 @@
+"""Fused LayerNorm / RMSNorm Pallas kernels (phi/kernels/gpu/layer_norm_kernel.cu
+and rms_norm fusion analogs): one HBM pass computes stats + normalizes +
+applies affine; backward recomputes from saved (mean, rstd)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _interpret() -> bool:
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _ln_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)  # [rows, H]
+    mean = jnp.mean(x, axis=-1)
+    var = jnp.mean(jnp.square(x - mean[:, None]), axis=-1)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = (x - mean[:, None]) * rstd[:, None] * w_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    y_ref[:] = y.astype(y_ref.dtype)
+    mean_ref[:] = mean
+    rstd_ref[:] = rstd
+
+
+def _rms_kernel(x_ref, w_ref, y_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    rstd = jax.lax.rsqrt(jnp.mean(jnp.square(x), axis=-1) + eps)
+    y_ref[:] = (x * rstd[:, None] * w_ref[:].astype(jnp.float32)).astype(y_ref.dtype)
+    rstd_ref[:] = rstd
+
+
+def _rows_block(n_rows: int) -> int:
+    for b in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if n_rows % b == 0:
+            return b
+    return 1
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_layer_norm(x, weight, bias, eps: float = 1e-5):
+    return _ln_fwd(x, weight, bias, eps)[0]
+
+
+def _ln_fwd(x, weight, bias, eps):
+    orig_shape = x.shape
+    H = orig_shape[-1]
+    x2 = x.reshape(-1, H)
+    R = x2.shape[0]
+    br = _rows_block(R)
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps),
+        grid=(R // br,),
+        in_specs=[
+            pl.BlockSpec((br, H), lambda i: (i, 0)),
+            pl.BlockSpec((H,), lambda i: (0,)),
+            pl.BlockSpec((H,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, H), lambda i: (i, 0)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, H), x.dtype),
+            jax.ShapeDtypeStruct((R,), jnp.float32),
+            jax.ShapeDtypeStruct((R,), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2, weight, bias)
+    return y.reshape(orig_shape), (x2, weight, mean, rstd, orig_shape)
+
+
+def _ln_fwd_rule(x, weight, bias, eps):
+    y, res = _ln_fwd(x, weight, bias, eps)
+    return y, res
+
+
+def _ln_bwd_rule(eps, res, g):
+    x2, weight, mean, rstd, orig_shape = res
+    H = x2.shape[1]
+    g2 = g.reshape(-1, H).astype(jnp.float32)
+    xf = x2.astype(jnp.float32)
+    xhat = (xf - mean[:, None]) * rstd[:, None]
+    wg = g2 * weight.astype(jnp.float32)
+    dx = (
+        wg - jnp.mean(wg, axis=-1, keepdims=True) - xhat * jnp.mean(wg * xhat, axis=-1, keepdims=True)
+    ) * rstd[:, None]
+    dw = jnp.sum(g2 * xhat, axis=0)
+    db = jnp.sum(g2, axis=0)
+    return dx.reshape(orig_shape).astype(x2.dtype), dw.astype(weight.dtype), db.astype(weight.dtype)
+
+
+fused_layer_norm.defvjp(_ln_fwd_rule, _ln_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fused_rms_norm(x, weight, eps: float = 1e-6):
+    return _rms_fwd(x, weight, eps)[0]
+
+
+def _rms_fwd(x, weight, eps):
+    orig_shape = x.shape
+    H = orig_shape[-1]
+    x2 = x.reshape(-1, H)
+    R = x2.shape[0]
+    br = _rows_block(R)
+    y, rstd = pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        grid=(R // br,),
+        in_specs=[
+            pl.BlockSpec((br, H), lambda i: (i, 0)),
+            pl.BlockSpec((H,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, H), lambda i: (i, 0)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, H), x.dtype),
+            jax.ShapeDtypeStruct((R,), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2, weight)
+    return y.reshape(orig_shape), (x2, weight, rstd, orig_shape)
+
+
+def _rms_fwd_rule(x, weight, eps):
+    y, res = _rms_fwd(x, weight, eps)
+    return y, res
+
+
+def _rms_bwd_rule(eps, res, g):
+    x2, weight, rstd, orig_shape = res
+    H = x2.shape[1]
+    g2 = g.reshape(-1, H).astype(jnp.float32)
+    xf = x2.astype(jnp.float32)
+    xhat = xf * rstd[:, None]
+    wg = g2 * weight.astype(jnp.float32)
+    dx = (wg - xhat * jnp.mean(wg * xhat, axis=-1, keepdims=True)) * rstd[:, None]
+    dw = jnp.sum(g2 * xhat, axis=0)
+    return dx.reshape(orig_shape).astype(x2.dtype), dw.astype(weight.dtype)
+
+
+fused_rms_norm.defvjp(_rms_fwd_rule, _rms_bwd_rule)

@@ -1,0 +1,15 @@
+"""Single gate for routing ops to Pallas kernels (the PHI kernel-key
+backend-selection analog — one bit instead of a registry lookup)."""
+
+import jax
+
+from ...core.flags import flag_value
+
+
+def use_pallas() -> bool:
+    if not flag_value("use_pallas_kernels"):
+        return False
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False

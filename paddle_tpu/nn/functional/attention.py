@@ -65,12 +65,14 @@ def scaled_dot_product_attention(
         dropout_key = _random.next_key()
 
     if _use_pallas(query._jdtype()) and attn_mask is None and dropout_p == 0.0:
-        from ...kernels.flash_attention import flash_attention_fwd
+        from ...kernels.flash_attention import _pick_blocks, flash_attention_fwd
 
-        def fn(q, k, v):
-            return flash_attention_fwd(q, k, v, causal=is_causal)
+        if _pick_blocks(query.shape[1])[0] is not None:
 
-        return apply("sdpa_pallas", fn, query, key, value)
+            def fn(q, k, v):
+                return flash_attention_fwd(q, k, v, causal=is_causal)
+
+            return apply("sdpa_pallas", fn, query, key, value)
 
     def fn(q, k, v, *rest):
         mask = rest[0] if rest else None

@@ -25,6 +25,15 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
     if bias is not None:
         tensors.append(as_tensor(bias))
 
+    # fused Pallas path (fused layer_norm CUDA-kernel analog): single trailing
+    # axis with affine, on TPU
+    from ._pallas_gate import use_pallas
+
+    if use_pallas() and len(nshape) == 1 and weight is not None and bias is not None:
+        from ...kernels.norms import fused_layer_norm
+
+        return apply("layer_norm_pallas", lambda xv, wv, bv: fused_layer_norm(xv, wv, bv, epsilon), *tensors)
+
     def fn(xv, *rest):
         x32 = xv.astype(jnp.float32)
         mean = jnp.mean(x32, axis=axes, keepdims=True)
@@ -45,6 +54,13 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     x = as_tensor(x)
     tensors = [x] + ([as_tensor(weight)] if weight is not None else [])
+
+    from ._pallas_gate import use_pallas
+
+    if use_pallas() and weight is not None:
+        from ...kernels.norms import fused_rms_norm
+
+        return apply("rms_norm_pallas", lambda xv, wv: fused_rms_norm(xv, wv, epsilon), *tensors)
 
     def fn(xv, *rest):
         x32 = xv.astype(jnp.float32)

@@ -1,0 +1,121 @@
+"""Pallas kernel numerics vs jnp references (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels import fused_adamw_update, fused_layer_norm, fused_rms_norm
+from paddle_tpu.kernels.flash_attention import flash_attention_fwd
+
+
+def _sdpa_np(q, k, v, causal=False):
+    d = q.shape[-1]
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        S = s.shape[-1]
+        mask = np.tril(np.ones((S, S), bool))
+        s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_forward(causal):
+    rng = np.random.RandomState(0)
+    B, S, H, D = 2, 64, 3, 16
+    q = rng.randn(B, S, H, D).astype(np.float32)
+    k = rng.randn(B, S, H, D).astype(np.float32)
+    v = rng.randn(B, S, H, D).astype(np.float32)
+    out = flash_attention_fwd(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal)
+    ref = _sdpa_np(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grads(causal):
+    rng = np.random.RandomState(1)
+    B, S, H, D = 1, 32, 2, 8
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+
+    def ref_fn(q, k, v):
+        d = q.shape[-1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.float32(d))
+        if causal:
+            m = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(m, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v).sum()
+
+    def flash_fn(q, k, v):
+        return flash_attention_fwd(q, k, v, causal=causal).sum()
+
+    g_ref = jax.grad(ref_fn, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(flash_fn, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5)
+
+
+def test_flash_attention_long_seq_block_selection():
+    rng = np.random.RandomState(2)
+    B, S, H, D = 1, 256, 2, 8
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    out = flash_attention_fwd(q, q, q, causal=True)
+    assert out.shape == (B, S, H, D)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_fused_layer_norm_matches():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(6, 4, 32).astype(np.float32))
+    w = jnp.asarray(rng.randn(32).astype(np.float32))
+    b = jnp.asarray(rng.randn(32).astype(np.float32))
+    y = fused_layer_norm(x, w, b, 1e-5)
+    xm = x - x.mean(-1, keepdims=True)
+    ref = xm / jnp.sqrt(x.var(-1, keepdims=True) + 1e-5) * w + b
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+    def loss_f(fn):
+        return lambda x, w, b: (fn(x, w, b) ** 2).sum()
+
+    g1 = jax.grad(loss_f(lambda x, w, b: fused_layer_norm(x, w, b, 1e-5)), argnums=(0, 1, 2))(x, w, b)
+    ref_fn = lambda x, w, b: ((x - x.mean(-1, keepdims=True)) / jnp.sqrt(x.var(-1, keepdims=True) + 1e-5) * w + b)
+    g2 = jax.grad(loss_f(ref_fn), argnums=(0, 1, 2))(x, w, b)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-5)
+
+
+def test_fused_rms_norm_matches():
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    w = jnp.asarray(rng.randn(16).astype(np.float32))
+    y = fused_rms_norm(x, w, 1e-6)
+    ref = x / jnp.sqrt((x**2).mean(-1, keepdims=True) + 1e-6) * w
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-6)
+    g1 = jax.grad(lambda x, w: (fused_rms_norm(x, w, 1e-6) ** 3).sum(), argnums=(0, 1))(x, w)
+    g2 = jax.grad(lambda x, w: ((x / jnp.sqrt((x**2).mean(-1, keepdims=True) + 1e-6) * w) ** 3).sum(), argnums=(0, 1))(x, w)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-5)
+
+
+def test_fused_adamw_matches_reference():
+    rng = np.random.RandomState(5)
+    p = rng.randn(33).astype(np.float32)
+    g = rng.randn(33).astype(np.float32)
+    m = np.zeros(33, np.float32)
+    v = np.zeros(33, np.float32)
+    lr, b1, b2, eps, wd = 1e-3, 0.9, 0.999, 1e-8, 0.01
+    b1p, b2p = b1, b2  # step 1
+    new_p, new_m, new_v = fused_adamw_update(
+        jnp.asarray(p), jnp.asarray(g), jnp.asarray(m), jnp.asarray(v),
+        lr=lr, beta1=b1, beta2=b2, eps=eps, weight_decay=wd, beta1_pow=b1p, beta2_pow=b2p,
+    )
+    m_ref = b1 * m + (1 - b1) * g
+    v_ref = b2 * v + (1 - b2) * g * g
+    p_ref = p * (1 - lr * wd) - lr * (m_ref / (1 - b1p)) / (np.sqrt(v_ref / (1 - b2p)) + eps)
+    np.testing.assert_allclose(np.asarray(new_p), p_ref, rtol=1e-4, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(new_m), m_ref, rtol=1e-4, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(new_v), v_ref, rtol=1e-4, atol=1e-7)
