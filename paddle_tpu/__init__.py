@@ -61,6 +61,7 @@ from . import io  # noqa: F401,E402
 from . import framework  # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
 from . import models  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from . import regularizer  # noqa: F401,E402
 from .param_attr import ParamAttr  # noqa: F401,E402

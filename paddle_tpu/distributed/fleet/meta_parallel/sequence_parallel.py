@@ -1,0 +1,128 @@
+"""Sequence/context parallelism: ring attention + Ulysses (SURVEY §5.7).
+
+The reference snapshot has NO sequence parallelism — its long-context story
+is flash attention + recompute. This module is where the TPU build exceeds
+it, with the two standard context-parallel schemes as shard_map-level
+functions over local sequence shards:
+
+- `ring_attention`: K/V chunks rotate around the ICI ring via
+  `lax.ppermute` while each device folds one block into a running
+  flash-style (max, sum, acc) accumulator — attention memory O(S_local),
+  comm fully overlappable with the block matmuls.
+- `ulysses_attention`: `lax.all_to_all` reshards seq <-> heads so each
+  device runs full-sequence attention on H/n heads, then reshards back.
+  Cheaper comm than ring for moderate S, needs H % n == 0.
+
+Both take [B, S_local, H, D] local shards (paddle flash layout) inside a
+shard_map over the context axis. Megatron-SP (activation sharding over mp in
+the LN/dropout regions) is handled by GSPMD annotations in the model
+(models/gpt.py `sequence_parallel`), not here.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, mask=None):
+    """One attention block in f32: returns (scores_max, exp_sum, acc)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # [b,h,q]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m, l, acc
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale: float = None):
+    """Blockwise ring attention over the `axis_name` mesh axis.
+
+    q, k, v: [B, S_local, H, D] — this device's sequence shard.
+    Returns [B, S_local, H, D] attention output for the local queries.
+    """
+    B, Sl, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D**0.5)
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    qf = q.astype(jnp.float32)
+    m0 = jnp.full((B, H, Sl), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sl), jnp.float32)
+    acc0 = jnp.zeros((B, Sl, H, D), jnp.float32)
+
+    def step(carry, t):
+        m, l, acc, kc, vc = carry
+        src = (my - t) % n  # which rank's K/V chunk we currently hold
+        if causal:
+            # chunk fully in the future -> skip; same chunk -> lower-tri mask
+            qpos = my * Sl + jax.lax.broadcasted_iota(jnp.int32, (Sl, Sl), 0)
+            kpos = src * Sl + jax.lax.broadcasted_iota(jnp.int32, (Sl, Sl), 1)
+            mask = (qpos >= kpos)[None, None]
+            bm, bl, bacc = _block_attn(qf, kc, vc, scale, mask=mask)
+            skip = src > my
+            bm = jnp.where(skip, NEG_INF, bm)
+            bl = jnp.where(skip, 0.0, bl)
+            bacc = jnp.where(skip, 0.0, bacc)
+        else:
+            bm, bl, bacc = _block_attn(qf, kc, vc, scale)
+        m_new = jnp.maximum(m, bm)
+        a_old = jnp.exp(m - m_new)
+        a_blk = jnp.exp(bm - m_new)
+        l_new = l * a_old + bl * a_blk
+        # acc layout [B,S,H,D]; scalers are [B,H,S]
+        sc_old = jnp.transpose(a_old, (0, 2, 1))[..., None]
+        sc_blk = jnp.transpose(a_blk, (0, 2, 1))[..., None]
+        acc_new = acc * sc_old + bacc * sc_blk
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (m_new, l_new, acc_new, kc, vc), None
+
+    (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v), jnp.arange(n))
+    l_safe = jnp.where(l == 0, 1.0, l)
+    out = acc / jnp.transpose(l_safe, (0, 2, 1))[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, scale: float = None, attn_fn=None):
+    """All-to-all context parallelism (DeepSpeed-Ulysses):
+    [B, S/n, H, D] -> a2a -> [B, S, H/n, D] -> full attention -> a2a back."""
+    B, Sl, H, D = q.shape
+    n = lax.axis_size(axis_name)
+    if H % n != 0:
+        raise ValueError(f"ulysses needs heads {H} divisible by axis size {n}")
+
+    def seq_to_heads(x):  # [B, S/n, H, D] -> [B, S, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):  # inverse
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if attn_fn is None:
+        S = qg.shape[1]
+        sc = scale if scale is not None else 1.0 / (D**0.5)
+        mask = jnp.tril(jnp.ones((S, S), bool))[None, None] if causal else None
+        m, l, acc = _block_attn(qg.astype(jnp.float32), kg, vg, sc, mask=mask)
+        og = (acc / jnp.transpose(jnp.where(l == 0, 1.0, l), (0, 2, 1))[..., None]).astype(q.dtype)
+    else:
+        og = attn_fn(qg, kg, vg)
+    return heads_to_seq(og)
+
+
+def sp_allgather_seq(x, axis_name: str):
+    """Megatron-SP boundary: gather the sequence shards (enter TP region)."""
+    return lax.all_gather(x, axis_name, axis=1, tiled=True)
+
+
+def sp_reduce_scatter_seq(x, axis_name: str):
+    """Megatron-SP boundary: reduce partial sums + scatter back over seq."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=1, tiled=True)

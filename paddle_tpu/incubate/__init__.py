@@ -1,0 +1,4 @@
+"""paddle.incubate analog: experimental APIs (MoE, fused ops)."""
+
+from . import distributed  # noqa: F401
+from . import nn  # noqa: F401

@@ -1,0 +1,2 @@
+from .gate import GShardGate, SwitchGate, gshard_gating, switch_gating  # noqa: F401
+from .moe_layer import ExpertMLP, MoELayer, global_gather, global_scatter  # noqa: F401

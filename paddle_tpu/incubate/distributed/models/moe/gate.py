@@ -1,0 +1,83 @@
+"""MoE gates (incubate/distributed/models/moe/gate/ analog): GShard top-2 and
+Switch top-1 as pure capacity-based dense dispatch — the einsum/one-hot
+formulation XLA partitions into all-to-all instead of the reference's
+index-based global_scatter CUDA op."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _positions_in_expert(mask):
+    """mask: [T, E] 0/1 -> position of each token within its expert queue."""
+    return (jnp.cumsum(mask, axis=0) - 1) * mask
+
+
+def switch_gating(logits, capacity: int):
+    """Top-1 (Switch) gate. Returns (dispatch [T,E,C] f32, combine [T,E,C] f32, aux_loss)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [T]
+    mask = jax.nn.one_hot(expert, E, dtype=jnp.float32)
+    # load-balancing aux loss (Switch eq. 4)
+    density = mask.mean(axis=0)
+    density_proxy = probs.mean(axis=0)
+    aux = (density * density_proxy).sum() * E
+    pos = _positions_in_expert(mask)
+    keep = (pos < capacity) * mask
+    gate_w = (probs * keep).sum(axis=-1)  # [T]
+    disp = keep[..., None] * jax.nn.one_hot(pos.sum(axis=-1).astype(jnp.int32), capacity, dtype=jnp.float32)[:, None, :]
+    dispatch = disp * keep[..., None]
+    combine = dispatch * gate_w[:, None, None]
+    return dispatch, combine, aux
+
+
+def gshard_gating(logits, capacity: int):
+    """Top-2 (GShard) gate."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    g1 = jnp.argmax(probs, axis=-1)
+    mask1 = jax.nn.one_hot(g1, E, dtype=jnp.float32)
+    probs2 = probs * (1 - mask1)
+    g2 = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(g2, E, dtype=jnp.float32)
+
+    density = mask1.mean(axis=0)
+    density_proxy = probs.mean(axis=0)
+    aux = (density * density_proxy).sum() * E
+
+    pos1 = _positions_in_expert(mask1)
+    used1 = mask1.sum(axis=0, keepdims=True)  # tokens ahead from top-1 round
+    pos2 = _positions_in_expert(mask2) + used1 * mask2
+    keep1 = (pos1 < capacity) * mask1
+    keep2 = (pos2 < capacity) * mask2
+
+    w1 = (probs * keep1).sum(axis=-1)
+    w2 = (probs * keep2).sum(axis=-1)
+    denom = jnp.maximum(w1 + w2, 1e-9)
+    w1, w2 = w1 / denom, w2 / denom
+
+    def disp(keep, pos):
+        return keep[..., None] * jax.nn.one_hot((pos * keep).sum(axis=-1).astype(jnp.int32), capacity, dtype=jnp.float32)[:, None, :] * keep[..., None]
+
+    d1, d2 = disp(keep1, pos1), disp(keep2, pos2)
+    dispatch = jnp.clip(d1 + d2, 0.0, 1.0)
+    combine = d1 * w1[:, None, None] + d2 * w2[:, None, None]
+    return dispatch, combine, aux
+
+
+class BaseGate:
+    def __init__(self, d_model: int, num_experts: int):
+        self.d_model = d_model
+        self.num_experts = num_experts
+
+
+class SwitchGate(BaseGate):
+    top_k = 1
+    gating = staticmethod(switch_gating)
+
+
+class GShardGate(BaseGate):
+    top_k = 2
+    gating = staticmethod(gshard_gating)

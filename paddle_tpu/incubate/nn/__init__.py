@@ -1,0 +1,4 @@
+"""paddle.incubate.nn analog: fused transformer blocks built on the Pallas
+seams (fused_attention / fused_feedforward op analogs, SURVEY §2.2)."""
+
+from .fused_transformer import FusedFeedForward, FusedMultiHeadAttention, FusedTransformerEncoderLayer  # noqa: F401

@@ -1,0 +1,111 @@
+"""Fused transformer layers (fluid/operators/fused/fused_attention_op.cu,
+fused_feedforward analogs). "Fused" on TPU means: route through the flash
+attention Pallas kernel + let XLA fuse the elementwise chain; the API carries
+the reference's pre/post-LN contract."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...nn import functional as F
+from ...nn.layer.layers import Layer
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(
+        self,
+        embed_dim: int,
+        num_heads: int,
+        dropout_rate: float = 0.0,
+        attn_dropout_rate: float = 0.0,
+        normalize_before: bool = False,
+        need_weights: bool = False,
+        qkv_weight_attr=None,
+        epsilon: float = 1e-5,
+        name=None,
+    ):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim must divide num_heads")
+        self.embed_dim, self.num_heads = embed_dim, num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.attn_dropout_rate = attn_dropout_rate
+        self.qkv = nn.Linear(embed_dim, 3 * embed_dim)
+        self.proj = nn.Linear(embed_dim, embed_dim)
+        self.ln = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = nn.Dropout(dropout_rate)
+
+    def forward(self, x, attn_mask=None):
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        B, S = x.shape[0], x.shape[1]
+        qkv = self.qkv(x).reshape([B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_rate, training=self.training
+        )
+        out = self.dropout(self.proj(out.reshape([B, S, self.embed_dim])))
+        out = residual + out
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    def __init__(
+        self,
+        d_model: int,
+        dim_feedforward: int,
+        dropout_rate: float = 0.1,
+        activation: str = "relu",
+        epsilon: float = 1e-5,
+        normalize_before: bool = False,
+        name=None,
+    ):
+        super().__init__()
+        self.fc1 = nn.Linear(d_model, dim_feedforward)
+        self.fc2 = nn.Linear(dim_feedforward, d_model)
+        self.ln = nn.LayerNorm(d_model, epsilon=epsilon)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.act = getattr(F, activation)
+        self.normalize_before = normalize_before
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        out = self.fc2(self.dropout(self.act(self.fc1(x))))
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(
+        self,
+        d_model: int,
+        nhead: int,
+        dim_feedforward: int,
+        dropout_rate: float = 0.1,
+        activation: str = "relu",
+        attn_dropout_rate=None,
+        act_dropout_rate=None,
+        normalize_before: bool = False,
+        name=None,
+    ):
+        super().__init__()
+        self.attn = FusedMultiHeadAttention(
+            d_model,
+            nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate if attn_dropout_rate is not None else dropout_rate,
+            normalize_before=normalize_before,
+        )
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate, activation=activation, normalize_before=normalize_before
+        )
+
+    def forward(self, src, src_mask=None):
+        return self.ffn(self.attn(src, attn_mask=src_mask))
