@@ -59,8 +59,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale: float =
     l0 = jnp.zeros((B, H, Sl), jnp.float32)
     acc0 = jnp.zeros((B, Sl, H, D), jnp.float32)
 
-    def step(carry, t):
-        m, l, acc, kc, vc = carry
+    def fold(carry, kc, vc, t):
+        m, l, acc = carry
         src = (my - t) % n  # which rank's K/V chunk we currently hold
         if causal:
             # chunk fully in the future -> skip; same chunk -> lower-tri mask
@@ -82,11 +82,18 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale: float =
         sc_old = jnp.transpose(a_old, (0, 2, 1))[..., None]
         sc_blk = jnp.transpose(a_blk, (0, 2, 1))[..., None]
         acc_new = acc * sc_old + bacc * sc_blk
+        return m_new, l_new, acc_new
+
+    # local block first, then n-1 rotate-and-fold steps (no wasted final rotation)
+    def step(carry, t):
+        m, l, acc, kc, vc = carry
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
-        return (m_new, l_new, acc_new, kc, vc), None
+        m, l, acc = fold((m, l, acc), kc, vc, t)
+        return (m, l, acc, kc, vc), None
 
-    (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v), jnp.arange(n))
+    carry0 = fold((m0, l0, acc0), k, v, 0)
+    (m, l, acc, _, _), _ = lax.scan(step, carry0 + (k, v), jnp.arange(1, n))
     l_safe = jnp.where(l == 0, 1.0, l)
     out = acc / jnp.transpose(l_safe, (0, 2, 1))[..., None]
     return out.astype(q.dtype)
@@ -114,7 +121,8 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, scale: floa
         m, l, acc = _block_attn(qg.astype(jnp.float32), kg, vg, sc, mask=mask)
         og = (acc / jnp.transpose(jnp.where(l == 0, 1.0, l), (0, 2, 1))[..., None]).astype(q.dtype)
     else:
-        og = attn_fn(qg, kg, vg)
+        # attn_fn contract: (q, k, v, causal=..., scale=...) on full-seq shards
+        og = attn_fn(qg, kg, vg, causal=causal, scale=scale)
     return heads_to_seq(og)
 
 

@@ -27,8 +27,7 @@ def switch_gating(logits, capacity: int):
     pos = _positions_in_expert(mask)
     keep = (pos < capacity) * mask
     gate_w = (probs * keep).sum(axis=-1)  # [T]
-    disp = keep[..., None] * jax.nn.one_hot(pos.sum(axis=-1).astype(jnp.int32), capacity, dtype=jnp.float32)[:, None, :]
-    dispatch = disp * keep[..., None]
+    dispatch = keep[..., None] * jax.nn.one_hot(pos.sum(axis=-1).astype(jnp.int32), capacity, dtype=jnp.float32)[:, None, :]
     combine = dispatch * gate_w[:, None, None]
     return dispatch, combine, aux
 
@@ -59,7 +58,7 @@ def gshard_gating(logits, capacity: int):
     w1, w2 = w1 / denom, w2 / denom
 
     def disp(keep, pos):
-        return keep[..., None] * jax.nn.one_hot((pos * keep).sum(axis=-1).astype(jnp.int32), capacity, dtype=jnp.float32)[:, None, :] * keep[..., None]
+        return keep[..., None] * jax.nn.one_hot((pos * keep).sum(axis=-1).astype(jnp.int32), capacity, dtype=jnp.float32)[:, None, :]
 
     d1, d2 = disp(keep1, pos1), disp(keep2, pos2)
     dispatch = jnp.clip(d1 + d2, 0.0, 1.0)

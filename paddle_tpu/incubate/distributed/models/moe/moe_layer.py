@@ -10,7 +10,6 @@ hand. `aux_loss` carries the load-balancing term (reference's gate loss).
 
 from __future__ import annotations
 
-import math
 from typing import List, Optional, Sequence
 
 import jax.numpy as jnp
@@ -18,8 +17,8 @@ from jax.sharding import PartitionSpec as P
 
 from .....core.tensor import Tensor
 from .....nn.layer.layers import Layer
-from .....distributed.sharding_utils import annotate_parameter, maybe_shard
-from .....ops._dispatch import apply, as_tensor
+from .....distributed.sharding_utils import maybe_shard
+from .....ops._dispatch import apply
 from .gate import GShardGate, SwitchGate, gshard_gating, switch_gating
 
 EP_AXIS = "ep"
@@ -51,17 +50,17 @@ class MoELayer(Layer):
         for i, e in enumerate(experts):
             self.add_sublayer(f"expert_{i}", e)
         self.capacity_factor = capacity_factor
-        if isinstance(gate, str):
+        if top_k is not None:
+            if top_k not in (1, 2):
+                raise ValueError(f"top_k must be 1 (switch) or 2 (gshard), got {top_k}")
+            self.gate_type = "switch" if top_k == 1 else "gshard"
+        elif isinstance(gate, str):
             self.gate_type = gate
         else:
             self.gate_type = "gshard" if getattr(gate, "top_k", 2) == 2 else "switch"
+        self.top_k = 1 if self.gate_type == "switch" else 2
         self.gate_weight = self.create_parameter([d_model, self.num_experts])
         self.aux_loss = None
-        # expert params live on their ep shard
-        for i, e in enumerate(experts):
-            for _, p in e.named_parameters():
-                if p is not None and getattr(p, "dist_spec", None) in (None, P()):
-                    p.expert_idx = i
 
     def _gating(self, logits, capacity):
         fn = gshard_gating if self.gate_type == "gshard" else switch_gating
@@ -122,20 +121,71 @@ class ExpertMLP(Layer):
         return self.fc2(self.act(self.fc1(x)))
 
 
-def global_scatter(x, local_count, global_count, group=None):
-    """API-parity analog of operators/collective/global_scatter_op: in the
-    dense formulation this is the dispatch einsum + all_to_all; kept as a thin
-    named wrapper over communication.alltoall for migrating users."""
-    from .....distributed.communication import alltoall
+def _host_counts(c):
+    import numpy as np
 
+    if isinstance(c, Tensor):
+        c = c.numpy()
+    return np.asarray(c).astype(np.int64)
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Count-routed token exchange (operators/collective/global_scatter_op.cu.cc
+    analog). x: [T, d] rows grouped in chunks sized local_count[i] (i over
+    world*n_local global experts, rank-major: chunk i targets rank
+    i // n_local's local expert i % n_local). The receiver's rows are ordered
+    local-expert-major then source-rank — the layout global_gather inverts.
+
+    Eager single-controller form: with world==1 this is the identity routing;
+    with the per-rank stacked convention ([N, T, d] + [N, E] counts) the
+    routing runs host-side on the gathered views. The performant jit path is
+    the dense dispatch-einsum in MoELayer (XLA emits the all-to-all)."""
+    import numpy as np
+
+    from .....distributed.communication import _resolve_group, rank_slices
+
+    g = _resolve_group(group)
+    if g.nranks == 1:
+        return x
+    lcs = _host_counts(local_count).reshape(g.nranks, -1)  # [N, world*n_local] per-rank
+    n_local = lcs.shape[1] // g.nranks
+    xs = [np.asarray(t.numpy()) for t in (rank_slices(x) if isinstance(x, Tensor) else x)]
+    # split each sender's rows into per-(dest rank, local expert) chunks
+    chunks = []
+    for r in range(g.nranks):
+        offs = np.concatenate([[0], np.cumsum(lcs[r])])
+        chunks.append([xs[r][offs[i] : offs[i + 1]] for i in range(lcs.shape[1])])
     out: List = []
-    alltoall(x, out, group=group)
+    for q in range(g.nranks):
+        rows = [chunks[s][q * n_local + e] for e in range(n_local) for s in range(g.nranks)]
+        out.append(Tensor(jnp.asarray(np.concatenate(rows, axis=0))))
     return out
 
 
 def global_gather(x, local_count, global_count, group=None):
-    from .....distributed.communication import alltoall
+    """Inverse of global_scatter: returns each rank's rows to their source in
+    original chunk order (global_gather_op.cu.cc analog)."""
+    import numpy as np
 
+    from .....distributed.communication import _resolve_group, rank_slices
+
+    g = _resolve_group(group)
+    if g.nranks == 1:
+        return x
+    lcs = _host_counts(local_count).reshape(g.nranks, -1)
+    n_local = lcs.shape[1] // g.nranks
+    xs = [np.asarray(t.numpy()) for t in (rank_slices(x) if isinstance(x, Tensor) else x)]
+    # receiver q's buffer is ordered (e, s) with sizes lcs[s, q*n_local+e]
+    recv_chunks: dict = {}
+    for q in range(g.nranks):
+        off = 0
+        for e in range(n_local):
+            for s in range(g.nranks):
+                sz = int(lcs[s, q * n_local + e])
+                recv_chunks[(s, q * n_local + e)] = xs[q][off : off + sz]
+                off += sz
     out: List = []
-    alltoall(x, out, group=group)
+    for r in range(g.nranks):
+        rows = [recv_chunks[(r, i)] for i in range(lcs.shape[1])]
+        out.append(Tensor(jnp.asarray(np.concatenate(rows, axis=0))))
     return out

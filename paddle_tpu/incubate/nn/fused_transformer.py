@@ -25,7 +25,7 @@ class FusedMultiHeadAttention(Layer):
     ):
         super().__init__()
         if embed_dim % num_heads:
-            raise ValueError("embed_dim must divide num_heads")
+            raise ValueError(f"num_heads ({num_heads}) must evenly divide embed_dim ({embed_dim})")
         self.embed_dim, self.num_heads = embed_dim, num_heads
         self.head_dim = embed_dim // num_heads
         self.normalize_before = normalize_before
@@ -59,6 +59,7 @@ class FusedFeedForward(Layer):
         dim_feedforward: int,
         dropout_rate: float = 0.1,
         activation: str = "relu",
+        act_dropout_rate=None,
         epsilon: float = 1e-5,
         normalize_before: bool = False,
         name=None,
@@ -68,6 +69,7 @@ class FusedFeedForward(Layer):
         self.fc2 = nn.Linear(dim_feedforward, d_model)
         self.ln = nn.LayerNorm(d_model, epsilon=epsilon)
         self.dropout = nn.Dropout(dropout_rate)
+        self.act_dropout = nn.Dropout(dropout_rate if act_dropout_rate is None else act_dropout_rate)
         self.act = getattr(F, activation)
         self.normalize_before = normalize_before
 
@@ -75,7 +77,7 @@ class FusedFeedForward(Layer):
         residual = x
         if self.normalize_before:
             x = self.ln(x)
-        out = self.fc2(self.dropout(self.act(self.fc1(x))))
+        out = self.fc2(self.act_dropout(self.act(self.fc1(x))))
         out = residual + self.dropout(out)
         if not self.normalize_before:
             out = self.ln(out)
@@ -104,7 +106,12 @@ class FusedTransformerEncoderLayer(Layer):
             normalize_before=normalize_before,
         )
         self.ffn = FusedFeedForward(
-            d_model, dim_feedforward, dropout_rate=dropout_rate, activation=activation, normalize_before=normalize_before
+            d_model,
+            dim_feedforward,
+            dropout_rate=dropout_rate,
+            activation=activation,
+            act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before,
         )
 
     def forward(self, src, src_mask=None):

@@ -21,6 +21,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
+# TPU vector lanes: scalar-per-row outputs (lse, delta) are broadcast across a
+# 128-wide trailing dim so their blocks satisfy Mosaic's (8, 128) tiling rule —
+# same layout as jax.experimental.pallas.ops.tpu.flash_attention (MIN_BLOCK_SIZE).
+LANES = 128
 
 
 def _interpret() -> bool:
@@ -67,7 +71,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bo
     m, l, acc = jax.lax.fori_loop(0, kb_hi, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0, 1.0, l)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    lse_ref[0] = jax.lax.broadcast_in_dim(m + jnp.log(l_safe), (q.shape[0], LANES), (0,))
 
 
 def _fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int):
@@ -86,23 +90,23 @@ def _fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, S), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, S, LANES), jnp.float32),
         ],
         interpret=_interpret(),
     )(qt, kt, vt)
-    return o, lse, (qt, kt, vt)
+    return o, lse[..., 0], (qt, kt, vt)
 
 
 # ---------------- backward ----------------
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k, causal, scale):
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0][:, :1]  # [bq, 1] (lanes-broadcast layout)
+    delta = delta_ref[0][:, :1]
     bq, d = q.shape
     S = k_ref.shape[1]
     qi = pl.program_id(1)
@@ -116,9 +120,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             kpos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, kb_hi, body, jnp.zeros((bq, d), jnp.float32))
@@ -139,17 +143,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q)]
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :1]  # [bq, 1]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q), :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
         if causal:
             qpos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
             kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        p = jnp.exp(s - lse)  # [bq, bk]
         dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
@@ -165,6 +169,9 @@ def _bwd(causal, scale, block_q, block_k, res, g):
     BH, S, D = qt.shape
     do = jnp.swapaxes(g, 1, 2).reshape(BH, S, D)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, S]
+    # lanes-broadcast layout for the per-row scalars (see LANES above)
+    lse = jnp.broadcast_to(lse[..., None], (BH, S, LANES))
+    delta = jnp.broadcast_to(delta[..., None], (BH, S, LANES))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_k=block_k, causal=causal, scale=scale),
@@ -174,8 +181,8 @@ def _bwd(causal, scale, block_q, block_k, res, g):
             pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), qt.dtype),
@@ -190,8 +197,8 @@ def _bwd(causal, scale, block_q, block_k, res, g):
             pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, S, D), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, S), lambda bh, ki: (bh, 0)),
-            pl.BlockSpec((1, S), lambda bh, ki: (bh, 0)),
+            pl.BlockSpec((1, S, LANES), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, S, LANES), lambda bh, ki: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),

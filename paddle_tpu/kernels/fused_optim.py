@@ -1,32 +1,36 @@
 """Fused AdamW Pallas kernel (phi/kernels/gpu/fused_adam_kernel.cu analog):
 moment update + bias correction + decoupled decay + param update in one HBM
 pass per tensor. XLA fuses most of this already; the kernel removes the
-remaining intermediate materializations for the biggest params."""
+remaining intermediate materializations for the biggest params.
+
+Layout: the flat tensor is padded to a (rows, 128)-lane grid and streamed
+through VMEM in row blocks; hyperparameters ride in SMEM as scalars."""
 
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_BLOCK_ROWS = 512  # 512*128*4B = 256KB per operand; 7 operands ≈ 1.8MB VMEM
 
 
 def _interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
-def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, hyp_ref, p_out, m_out, v_out):
-    lr = hyp_ref[0]
-    b1, b2, eps, wd, b1p, b2p = hyp_ref[1], hyp_ref[2], hyp_ref[3], hyp_ref[4], hyp_ref[5]
+def _adamw_kernel(hyp_ref, p_ref, g_ref, m_ref, v_ref, p_out, m_out, v_out):
+    lr, b1, b2 = hyp_ref[0], hyp_ref[1], hyp_ref[2]
+    eps, wd, b1p, b2p = hyp_ref[3], hyp_ref[4], hyp_ref[5], hyp_ref[6]
     p = p_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
     m = b1 * m_ref[:] + (1 - b1) * g
     v = b2 * v_ref[:] + (1 - b2) * g * g
     m_hat = m / (1 - b1p)
     v_hat = v / (1 - b2p)
-    p = p * (1.0 - lr * wd)
-    p = p - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+    p = p * (1.0 - lr * wd) - lr * m_hat / (jnp.sqrt(v_hat) + eps)
     p_out[:] = p.astype(p_out.dtype)
     m_out[:] = m
     v_out[:] = v
@@ -36,11 +40,19 @@ def fused_adamw_update(param, grad, m, v, *, lr, beta1, beta2, eps, weight_decay
     """One fused step for a single tensor; returns (new_param, new_m, new_v).
     beta*_pow are the *new* accumulated powers (beta^t)."""
     shape = param.shape
-    flat = lambda a: a.reshape(-1)
     n = param.size
+    rows = -(-n // _LANES)
+    pad = rows * _LANES - n
+
+    def to2d(a, dtype):
+        a = a.reshape(-1).astype(dtype)
+        if pad:
+            a = jnp.pad(a, (0, pad))
+        return a.reshape(rows, _LANES)
+
     hyp = jnp.stack(
         [
-            jnp.float32(lr),
+            jnp.asarray(lr, jnp.float32).reshape(()),
             jnp.float32(beta1),
             jnp.float32(beta2),
             jnp.float32(eps),
@@ -50,27 +62,26 @@ def fused_adamw_update(param, grad, m, v, *, lr, beta1, beta2, eps, weight_decay
         ]
     )
 
-    def kernel(p_ref, g_ref, m_ref, v_ref, hyp_ref, p_out, m_out, v_out):
-        lr_, b1, b2 = hyp_ref[0], hyp_ref[1], hyp_ref[2]
-        eps_, wd, b1p, b2p = hyp_ref[3], hyp_ref[4], hyp_ref[5], hyp_ref[6]
-        p = p_ref[:].astype(jnp.float32)
-        g = g_ref[:].astype(jnp.float32)
-        mm = b1 * m_ref[:] + (1 - b1) * g
-        vv = b2 * v_ref[:] + (1 - b2) * g * g
-        m_hat = mm / (1 - b1p)
-        v_hat = vv / (1 - b2p)
-        p = p * (1.0 - lr_ * wd) - lr_ * m_hat / (jnp.sqrt(v_hat) + eps_)
-        p_out[:] = p.astype(p_out.dtype)
-        m_out[:] = mm
-        v_out[:] = vv
-
+    br = min(_BLOCK_ROWS, rows)
+    blk = lambda: pl.BlockSpec((br, _LANES), lambda i: (i, 0))
     new_p, new_m, new_v = pl.pallas_call(
-        kernel,
+        _adamw_kernel,
+        grid=(pl.cdiv(rows, br),),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            blk(),
+            blk(),
+            blk(),
+            blk(),
+        ],
+        out_specs=[blk(), blk(), blk()],
         out_shape=[
-            jax.ShapeDtypeStruct((n,), param.dtype),
-            jax.ShapeDtypeStruct((n,), jnp.float32),
-            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((rows, _LANES), param.dtype),
+            jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
         ],
         interpret=_interpret(),
-    )(flat(param), flat(grad), flat(m).astype(jnp.float32), flat(v).astype(jnp.float32), hyp)
-    return new_p.reshape(shape), new_m.reshape(shape), new_v.reshape(shape)
+    )(hyp, to2d(param, param.dtype), to2d(grad, grad.dtype), to2d(m, jnp.float32), to2d(v, jnp.float32))
+
+    unflat = lambda a: a.reshape(-1)[:n].reshape(shape)
+    return unflat(new_p), unflat(new_m), unflat(new_v)

@@ -1,6 +1,8 @@
 """Fused LayerNorm / RMSNorm Pallas kernels (phi/kernels/gpu/layer_norm_kernel.cu
 and rms_norm fusion analogs): one HBM pass computes stats + normalizes +
-applies affine; backward recomputes from saved (mean, rstd)."""
+applies affine. Backward recomputes stats from the saved input — on TPU the
+stat recompute fuses into the dx elementwise pipeline, which is cheaper than
+materializing (mean, rstd) through HBM with Mosaic's (8, 128)-tile layout."""
 
 from __future__ import annotations
 
@@ -15,29 +17,31 @@ def _interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
-def _ln_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
+def _ln_kernel(x_ref, w_ref, b_ref, y_ref, *, eps):
     x = x_ref[:].astype(jnp.float32)  # [rows, H]
     mean = jnp.mean(x, axis=-1)
     var = jnp.mean(jnp.square(x - mean[:, None]), axis=-1)
     rstd = jax.lax.rsqrt(var + eps)
     y = (x - mean[:, None]) * rstd[:, None] * w_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
     y_ref[:] = y.astype(y_ref.dtype)
-    mean_ref[:] = mean
-    rstd_ref[:] = rstd
 
 
-def _rms_kernel(x_ref, w_ref, y_ref, rstd_ref, *, eps):
+def _rms_kernel(x_ref, w_ref, y_ref, *, eps):
     x = x_ref[:].astype(jnp.float32)
     rstd = jax.lax.rsqrt(jnp.mean(jnp.square(x), axis=-1) + eps)
     y_ref[:] = (x * rstd[:, None] * w_ref[:].astype(jnp.float32)).astype(y_ref.dtype)
-    rstd_ref[:] = rstd
 
 
 def _rows_block(n_rows: int) -> int:
-    for b in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+    """Mosaic tiling: the rows block must be a multiple of 8 or span all rows.
+    Non-dividing blocks are fine (pl.cdiv grid pads the tail; padded rows are
+    row-independent garbage the out-of-bounds write discards)."""
+    if n_rows <= 256:
+        return n_rows
+    for b in (256, 128, 64, 32, 16, 8):
         if n_rows % b == 0:
             return b
-    return 1
+    return 8  # non-dividing: grid pads the tail block
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -50,28 +54,20 @@ def _ln_fwd(x, weight, bias, eps):
     H = orig_shape[-1]
     x2 = x.reshape(-1, H)
     R = x2.shape[0]
-    br = _rows_block(R)
-    y, mean, rstd = pl.pallas_call(
+    br = min(_rows_block(R), R)
+    y = pl.pallas_call(
         functools.partial(_ln_kernel, eps=eps),
-        grid=(R // br,),
+        grid=(pl.cdiv(R, br),),
         in_specs=[
             pl.BlockSpec((br, H), lambda i: (i, 0)),
             pl.BlockSpec((H,), lambda i: (0,)),
             pl.BlockSpec((H,), lambda i: (0,)),
         ],
-        out_specs=[
-            pl.BlockSpec((br, H), lambda i: (i, 0)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((R, H), x.dtype),
-            jax.ShapeDtypeStruct((R,), jnp.float32),
-            jax.ShapeDtypeStruct((R,), jnp.float32),
-        ],
+        out_specs=pl.BlockSpec((br, H), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, H), x.dtype),
         interpret=_interpret(),
     )(x2, weight, bias)
-    return y.reshape(orig_shape), (x2, weight, mean, rstd, orig_shape)
+    return y.reshape(orig_shape), (x2, weight, orig_shape)
 
 
 def _ln_fwd_rule(x, weight, bias, eps):
@@ -80,15 +76,17 @@ def _ln_fwd_rule(x, weight, bias, eps):
 
 
 def _ln_bwd_rule(eps, res, g):
-    x2, weight, mean, rstd, orig_shape = res
+    x2, weight, orig_shape = res
     H = x2.shape[1]
     g2 = g.reshape(-1, H).astype(jnp.float32)
     xf = x2.astype(jnp.float32)
-    xhat = (xf - mean[:, None]) * rstd[:, None]
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True) + eps)
+    xhat = (xf - mean) * rstd
     wg = g2 * weight.astype(jnp.float32)
     dx = (
         wg - jnp.mean(wg, axis=-1, keepdims=True) - xhat * jnp.mean(wg * xhat, axis=-1, keepdims=True)
-    ) * rstd[:, None]
+    ) * rstd
     dw = jnp.sum(g2 * xhat, axis=0)
     db = jnp.sum(g2, axis=0)
     return dx.reshape(orig_shape).astype(x2.dtype), dw.astype(weight.dtype), db.astype(weight.dtype)
@@ -107,25 +105,19 @@ def _rms_fwd(x, weight, eps):
     H = orig_shape[-1]
     x2 = x.reshape(-1, H)
     R = x2.shape[0]
-    br = _rows_block(R)
-    y, rstd = pl.pallas_call(
+    br = min(_rows_block(R), R)
+    y = pl.pallas_call(
         functools.partial(_rms_kernel, eps=eps),
-        grid=(R // br,),
+        grid=(pl.cdiv(R, br),),
         in_specs=[
             pl.BlockSpec((br, H), lambda i: (i, 0)),
             pl.BlockSpec((H,), lambda i: (0,)),
         ],
-        out_specs=[
-            pl.BlockSpec((br, H), lambda i: (i, 0)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((R, H), x.dtype),
-            jax.ShapeDtypeStruct((R,), jnp.float32),
-        ],
+        out_specs=pl.BlockSpec((br, H), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, H), x.dtype),
         interpret=_interpret(),
     )(x2, weight)
-    return y.reshape(orig_shape), (x2, weight, rstd, orig_shape)
+    return y.reshape(orig_shape), (x2, weight, orig_shape)
 
 
 def _rms_fwd_rule(x, weight, eps):
@@ -134,13 +126,14 @@ def _rms_fwd_rule(x, weight, eps):
 
 
 def _rms_bwd_rule(eps, res, g):
-    x2, weight, rstd, orig_shape = res
+    x2, weight, orig_shape = res
     H = x2.shape[1]
     g2 = g.reshape(-1, H).astype(jnp.float32)
     xf = x2.astype(jnp.float32)
-    xhat = xf * rstd[:, None]
+    rstd = jax.lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1, keepdims=True) + eps)
+    xhat = xf * rstd
     wg = g2 * weight.astype(jnp.float32)
-    dx = (wg - xhat * jnp.mean(wg * xhat, axis=-1, keepdims=True)) * rstd[:, None]
+    dx = (wg - xhat * jnp.mean(wg * xhat, axis=-1, keepdims=True)) * rstd
     dw = jnp.sum(g2 * xhat, axis=0)
     return dx.reshape(orig_shape).astype(x2.dtype), dw.astype(weight.dtype)
 

@@ -192,3 +192,30 @@ def test_fused_transformer_layers():
     y = layer(x)
     assert y.shape == [2, 8, 16]
     y.mean().backward()
+
+
+def test_global_scatter_gather_roundtrip():
+    """Count-routed exchange (global_scatter_op analog): gather inverts scatter,
+    and scattered rows land on the rank owning the target expert."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.communication import to_per_rank
+    from paddle_tpu.incubate.distributed.models.moe import global_gather, global_scatter
+
+    dist.init_parallel_env()
+    world = len(jax.devices())
+    n_local = 2
+    E = world * n_local
+    d = 4
+    rng = np.random.RandomState(0)
+    counts = rng.randint(0, 3, size=(world, E))
+    xs = [rng.randn(int(counts[r].sum()), d).astype(np.float32) for r in range(world)]
+    x = to_per_rank([np.pad(a, ((0, int(counts.sum(1).max()) - a.shape[0]), (0, 0))) for r, a in enumerate(xs)])
+    # use the ragged list form directly
+    scattered = global_scatter([paddle.to_tensor(a) for a in xs], counts.reshape(-1), None)
+    assert len(scattered) == world
+    for q in range(world):
+        expect_rows = int(counts[:, q * n_local : (q + 1) * n_local].sum())
+        assert scattered[q].shape[0] == expect_rows
+    back = global_gather(scattered, counts.reshape(-1), None)
+    for r in range(world):
+        np.testing.assert_allclose(back[r].numpy(), xs[r], rtol=1e-6)
