@@ -62,6 +62,8 @@ from .topology import (  # noqa: F401
 )
 
 from . import fleet  # noqa: F401,E402
+from . import auto_parallel  # noqa: F401,E402
+from .auto_parallel import Engine, ProcessMesh, shard_op, shard_tensor  # noqa: F401,E402
 
 
 def spawn(func, args=(), nprocs=-1, **kwargs):

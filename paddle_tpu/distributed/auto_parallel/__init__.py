@@ -1,0 +1,27 @@
+"""Semi-automatic parallelism (distributed/auto_parallel analog).
+
+The reference's completion/partitioner/resharder pipeline (SURVEY §2.6
+auto-parallel) collapses into XLA's GSPMD partitioner: the user-facing
+ProcessMesh / shard_tensor / Engine API survives, the propagation machinery
+is the compiler's job. `shard_spec` lists map 1:1 onto
+`jax.sharding.PartitionSpec` axes; `Engine` compiles one pjit train step.
+"""
+
+from .process_mesh import ProcessMesh, get_current_process_mesh
+from .interface import shard_tensor, shard_op, recompute, fetch
+from .strategy import Strategy
+from .engine import Engine
+from .dist_attribute import DistAttr, TensorDistAttr
+
+__all__ = [
+    "ProcessMesh",
+    "get_current_process_mesh",
+    "shard_tensor",
+    "shard_op",
+    "recompute",
+    "fetch",
+    "Strategy",
+    "Engine",
+    "DistAttr",
+    "TensorDistAttr",
+]

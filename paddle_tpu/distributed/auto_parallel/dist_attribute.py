@@ -1,0 +1,51 @@
+"""Tensor distributed attributes (auto_parallel/dist_attribute.py analog).
+
+dims_mapping[i] = index of the mesh dim tensor-dim i is split over, or -1 for
+replicated — exactly a PartitionSpec written with integers. Conversions both
+ways live here so shard_tensor / Engine / checkpoint reshard all agree.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from jax.sharding import PartitionSpec as P
+
+from .process_mesh import ProcessMesh
+
+
+class TensorDistAttr:
+    def __init__(self, process_mesh: Optional[ProcessMesh] = None, dims_mapping: Optional[List[int]] = None):
+        self.process_mesh = process_mesh
+        self.dims_mapping = list(dims_mapping) if dims_mapping is not None else []
+
+    def to_partition_spec(self) -> P:
+        if self.process_mesh is None:
+            return P()
+        names = self.process_mesh.dim_names
+        entries = [None if d == -1 else names[d] for d in self.dims_mapping]
+        while entries and entries[-1] is None:
+            entries.pop()
+        return P(*entries)
+
+    @staticmethod
+    def from_shard_spec(process_mesh: ProcessMesh, shard_spec, ndim: int) -> "TensorDistAttr":
+        names = process_mesh.dim_names
+        dims = []
+        spec = list(shard_spec) if shard_spec is not None else [None] * ndim
+        spec = spec + [None] * (ndim - len(spec))
+        for entry in spec:
+            if entry is None:
+                dims.append(-1)
+            else:
+                if entry not in names:
+                    raise ValueError(f"shard_spec axis {entry!r} not in mesh dims {names}")
+                dims.append(names.index(entry))
+        return TensorDistAttr(process_mesh, dims)
+
+    def __repr__(self):
+        return f"TensorDistAttr(mesh={self.process_mesh}, dims_mapping={self.dims_mapping})"
+
+
+# reference exposes an op-level DistAttr too; keep the name
+DistAttr = TensorDistAttr

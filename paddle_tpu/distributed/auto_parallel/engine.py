@@ -1,0 +1,263 @@
+"""Auto-parallel Engine (auto_parallel/engine.py:55 analog).
+
+The reference Engine drives plan → complete → partition → reshard → execute
+over per-rank programs. Here `prepare()` compiles ONE pjit train/eval/predict
+step over the ProcessMesh — GSPMD is the planner/partitioner/resharder
+(SURVEY §2.6 TPU mapping) — and fit/evaluate/predict iterate the data
+pipeline through it.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core import random as _random
+from ...core.autograd import no_grad
+from ...core.tensor import Tensor
+from .process_mesh import ProcessMesh, get_current_process_mesh
+from .strategy import Strategy
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None, cluster=None, strategy=None):
+        from ...nn.layer.layers import Layer
+
+        if model and not isinstance(model, Layer) and not callable(model):
+            raise TypeError("'model' must be a paddle.nn.Layer subclass or callable")
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = _to_list(metrics)
+        self._strategy = strategy or Strategy()
+        self._train_step = None
+        self._fwd_jit = None
+        self._mesh: Optional[Mesh] = None
+        self.history = {"loss": []}
+
+    # ---------- plumbing ----------
+    def _resolve_mesh(self) -> Mesh:
+        if self._mesh is not None:
+            return self._mesh
+        pm = get_current_process_mesh()
+        if pm is not None:
+            self._mesh = pm.to_jax_mesh()
+        else:
+            from ..topology import get_hybrid_communicate_group
+
+            hcg = get_hybrid_communicate_group()
+            if hcg is not None:
+                self._mesh = hcg.get_mesh()
+            else:
+                self._mesh = Mesh(np.array(jax.devices()).reshape(-1), ("dp",))
+        return self._mesh
+
+    def _batch_spec(self) -> P:
+        mesh = self._resolve_mesh()
+        return P(mesh.axis_names[0]) if self._strategy.split_data else P()
+
+    def prepare(self, inputs_spec=None, labels_spec=None, main_program=None, startup_program=None, mode="train"):
+        """Compile the step for `mode`. inputs_spec/labels_spec are InputSpec
+        analogs (shape/dtype carriers) — unused for shape inference since jit
+        re-specializes per concrete batch."""
+        mesh = self._resolve_mesh()
+        if mode == "train":
+            if self._train_step is None:
+                from ..fleet.utils import make_sharded_train_step
+
+                if self._optimizer is None:
+                    raise ValueError("Engine needs an optimizer for train mode")
+                self._train_step = make_sharded_train_step(
+                    self._model,
+                    self._optimizer,
+                    loss_fn=self._loss,
+                    mesh=mesh,
+                    batch_spec=self._batch_spec(),
+                )
+        else:
+            self._build_forward(mesh)
+        return self
+
+    def _build_forward(self, mesh: Mesh):
+        if self._fwd_jit is not None:
+            return
+        model = self._model
+        params0, buffers0 = model.functional_state()
+        from ..fleet.utils import param_shardings
+
+        p_shard = param_shardings(model, mesh)
+        self._fwd_params = jax.tree_util.tree_map(
+            lambda v, s: jax.device_put(v, s), params0, {k: p_shard[k] for k in params0}
+        )
+        batch_sharding = NamedSharding(mesh, self._batch_spec())
+
+        def fwd(params, x):
+            with no_grad(), _random.rng_scope(jnp.uint32(0)):
+                out, _ = model.functional_call(params, buffers0, Tensor(x))
+            return out._value if isinstance(out, Tensor) else out
+
+        self._fwd_jit = jax.jit(fwd, in_shardings=(p_shard, batch_sharding))
+
+    # ---------- data ----------
+    def dataloader(self, dataset, batch_size=1, shuffle=False, collate_fn=None, mode="train"):
+        from ...io import DataLoader
+
+        if hasattr(dataset, "__iter__") and not hasattr(dataset, "__getitem__"):
+            return dataset
+        if isinstance(dataset, DataLoader):
+            return dataset
+        return DataLoader(dataset, batch_size=batch_size, shuffle=shuffle, collate_fn=collate_fn, drop_last=True)
+
+    @staticmethod
+    def _split_batch(batch, sample_split):
+        items = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        k = sample_split if sample_split is not None else max(1, len(items) - 1)
+        ins, labs = items[:k], items[k:]
+        pick = lambda xs: xs[0] if len(xs) == 1 else xs
+        return pick(ins) if ins else None, pick(labs) if labs else None
+
+    # ---------- modes ----------
+    def fit(
+        self,
+        train_data,
+        train_sample_split=None,
+        batch_size=1,
+        epochs=1,
+        steps_per_epoch=None,
+        log_freq=10,
+        save_dir=None,
+        save_freq=1,
+        valid_data=None,
+        valid_sample_split=None,
+        valid_freq=1,
+        valid_steps=None,
+        collate_fn=None,
+        callbacks=None,
+        verbose=2,
+    ):
+        self.prepare(mode="train")
+        loader = self.dataloader(train_data, batch_size=batch_size, shuffle=True, collate_fn=collate_fn)
+        for epoch in range(epochs):
+            t0 = time.time()
+            n = 0
+            for step_i, batch in enumerate(loader):
+                if steps_per_epoch is not None and step_i >= steps_per_epoch:
+                    break
+                x, y = self._split_batch(batch, train_sample_split)
+                loss = self._train_step(_np(x), _np(y))
+                n += 1
+                if verbose and step_i % log_freq == 0:
+                    print(f"epoch {epoch} step {step_i} loss {float(loss):.6f}")
+                self.history["loss"].append(float(loss))
+            if valid_data is not None and (epoch + 1) % valid_freq == 0:
+                self.evaluate(valid_data, valid_sample_split, batch_size, steps=valid_steps, verbose=0)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, f"epoch{epoch}"))
+            if verbose:
+                print(f"epoch {epoch}: {n} steps in {time.time() - t0:.2f}s")
+        self._train_step.sync_to_model()
+        return self.history
+
+    def evaluate(self, valid_data, valid_sample_split=None, batch_size=1, steps=None, log_freq=10, collate_fn=None, callbacks=None, verbose=2):
+        mesh = self._resolve_mesh()
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+            self._fwd_jit = None  # params may have moved; rebuild
+        self._build_forward(mesh)
+        loader = self.dataloader(valid_data, batch_size=batch_size, collate_fn=collate_fn, mode="eval")
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        with jax.set_mesh(mesh):
+            for step_i, batch in enumerate(loader):
+                if steps is not None and step_i >= steps:
+                    break
+                x, y = self._split_batch(batch, valid_sample_split)
+                out = self._fwd_jit(self._fwd_params, _np(x))
+                if self._loss is not None and y is not None:
+                    losses.append(float(np.asarray(self._loss(Tensor(out), Tensor(_np(y)))._value)))
+                for m in self._metrics:
+                    if hasattr(m, "compute"):
+                        m.update(*_to_list(m.compute(Tensor(out), Tensor(_np(y)))))
+                    else:
+                        m.update(out, _np(y))
+        logs = {"eval_loss": float(np.mean(losses)) if losses else None}
+        for m in self._metrics:
+            logs[f"eval_{m.name()}" if callable(getattr(m, "name", None)) else "metric"] = m.accumulate()
+        if verbose:
+            print("eval:", logs)
+        return logs
+
+    def predict(self, test_data, test_sample_split=None, batch_size=1, steps=None, collate_fn=None, callbacks=None, verbose=2):
+        mesh = self._resolve_mesh()
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+            self._fwd_jit = None
+        self._build_forward(mesh)
+        loader = self.dataloader(test_data, batch_size=batch_size, collate_fn=collate_fn, mode="predict")
+        outs = []
+        with jax.set_mesh(mesh):
+            for step_i, batch in enumerate(loader):
+                if steps is not None and step_i >= steps:
+                    break
+                x, _ = self._split_batch(batch, test_sample_split)
+                outs.append(np.asarray(self._fwd_jit(self._fwd_params, _np(x))))
+        return outs
+
+    # ---------- save/load/cost ----------
+    def save(self, path, training=True):
+        from ...framework import io as fio
+
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        state = {"model": self._model.state_dict()}
+        if training and self._optimizer is not None:
+            state["optimizer"] = self._optimizer.state_dict()
+        fio.save(state, path + ".pdparams")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        from ...framework import io as fio
+
+        state = fio.load(path + ".pdparams")
+        self._model.set_state_dict(state["model"])
+        if load_optimizer and "optimizer" in state and self._optimizer is not None:
+            self._optimizer.set_state_dict(state["optimizer"])
+        self._train_step = None  # params changed; recompile lazily
+        self._fwd_jit = None
+
+    def cost(self, inputs_spec=None, labels_spec=None, mode="train"):
+        """Static cost estimate via XLA's cost analysis on the lowered step
+        (planner/cost_model analog)."""
+        if self._train_step is None or inputs_spec is None:
+            return None
+        x = np.zeros(inputs_spec.shape, dtype=inputs_spec.dtype or np.float32)
+        y = np.zeros(labels_spec.shape, dtype=labels_spec.dtype or np.float32) if labels_spec else x
+        compiled = self._train_step.lower_compiled(x, y).compile()
+        ca = compiled.cost_analysis()
+        return ca[0] if isinstance(ca, (list, tuple)) else ca
+
+    @property
+    def main_program(self):
+        return None  # no static Program; the jaxpr/HLO is the program
+
+    @property
+    def mesh(self):
+        return self._resolve_mesh()
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._value)
+    return np.asarray(x)

@@ -1,0 +1,99 @@
+"""User annotation API (auto_parallel/interface.py:28 shard_tensor analog).
+
+`shard_tensor(x, mesh, spec)` both physically places a concrete tensor
+(jax.device_put with a NamedSharding) and records the annotation
+(dist_spec/dist_attr) for the Engine's pjit shardings — the two things the
+reference's DistributedTensor + completion pass conspire to do.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding
+
+from ...core.tensor import Tensor
+from .dist_attribute import TensorDistAttr
+from .process_mesh import ProcessMesh, get_current_process_mesh
+
+
+def _resolve_mesh(process_mesh: Optional[ProcessMesh]) -> ProcessMesh:
+    if process_mesh is not None:
+        if not isinstance(process_mesh, ProcessMesh):
+            raise TypeError(f"process_mesh must be a ProcessMesh, got {type(process_mesh)}")
+        return process_mesh
+    cur = get_current_process_mesh()
+    if cur is None:
+        raise ValueError("Specify the process mesh argument or use ProcessMesh context manager first.")
+    return cur
+
+
+def shard_tensor(x, process_mesh: Optional[ProcessMesh] = None, shard_spec=None):
+    """Annotate (and, for concrete tensors, physically reshard) `x` so dim i
+    is split over mesh dim shard_spec[i] (None = replicated)."""
+    mesh = _resolve_mesh(process_mesh)
+    ndim = len(x.shape)
+    if shard_spec is not None and not isinstance(shard_spec, list):
+        raise TypeError(f"shard_spec must be a list, got {type(shard_spec)}")
+    attr = TensorDistAttr.from_shard_spec(mesh, shard_spec, ndim)
+    spec = attr.to_partition_spec()
+
+    # divisibility check mirrors verify_shard_spec
+    for dim, mdim in enumerate(attr.dims_mapping):
+        if mdim != -1 and x.shape[dim] % mesh.shape[mdim] != 0:
+            raise ValueError(
+                f"tensor dim {dim} (size {x.shape[dim]}) is not divisible by mesh dim "
+                f"{mesh.dim_names[mdim]} (size {mesh.shape[mdim]})"
+            )
+
+    if isinstance(x, Tensor):
+        x.dist_attr = attr
+        x.dist_spec = spec
+        x.is_distributed = any(d != -1 for d in attr.dims_mapping)
+        if x._value is not None:
+            sharding = NamedSharding(mesh.to_jax_mesh(), spec)
+            x._set_value_raw(jax.device_put(x._value, sharding))
+        return x
+    return jax.device_put(x, NamedSharding(mesh.to_jax_mesh(), spec))
+
+
+def shard_op(op, process_mesh: Optional[ProcessMesh] = None, in_shard_specs=None, out_shard_specs=None):
+    """Wrap a callable so its outputs get sharding constraints — the GSPMD
+    propagator handles the interior (interface.py:117 analog)."""
+    mesh = _resolve_mesh(process_mesh)
+
+    def wrapped(*args, **kwargs):
+        args = list(args)
+        if in_shard_specs is not None:
+            for i, sspec in enumerate(in_shard_specs):
+                if sspec is not None and i < len(args):
+                    args[i] = shard_tensor(args[i], mesh, list(sspec))
+        out = op(*args, **kwargs)
+        if out_shard_specs is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            outs = [
+                shard_tensor(o, mesh, list(s)) if s is not None else o
+                for o, s in zip(outs, out_shard_specs)
+            ]
+            out = type(out)(outs) if isinstance(out, (list, tuple)) else outs[0]
+        return out
+
+    return wrapped
+
+
+def recompute(op):
+    """Annotate a callable for activation rematerialization (the dist-pass
+    `auto_parallel_recompute` analog): jax.checkpoint at trace time."""
+    from ...distributed.fleet.recompute import recompute as _rc
+
+    def wrapped(*args, **kwargs):
+        return _rc(op, *args, **kwargs)
+
+    return wrapped
+
+
+def fetch(tensor, name=None, logging=False):
+    """Parity stub: in the reference this registers a fetch var for the
+    executor; eagerly the value is already host-reachable."""
+    return tensor

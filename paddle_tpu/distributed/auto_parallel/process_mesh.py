@@ -1,0 +1,119 @@
+"""ProcessMesh (auto_parallel/process_mesh.py:71 analog) over jax.sharding.Mesh.
+
+The reference's ProcessMesh is an n-D array of process ranks with named dims —
+isomorphic to a jax Mesh (SURVEY §2.6: "ProcessMesh → Mesh, dims_mapping →
+PartitionSpec"). Here process ids index `jax.devices()`; `to_jax_mesh()` is
+the bridge every consumer (shard_tensor, Engine) compiles against.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_mesh_stack: List["ProcessMesh"] = []
+
+
+class ProcessMesh:
+    """Cartesian topology of logical processes.
+
+    mesh: n-D list/ndarray of unique process ids (indices into the device
+    list); dim_names: one name per mesh dim (default d0, d1, ...).
+    Usable as a context manager to set the "current" mesh that
+    `shard_tensor(..., process_mesh=None)` picks up.
+    """
+
+    def __init__(self, mesh=None, dim_names=None, shape=None, process_ids=None):
+        if mesh is None:
+            if shape is None or process_ids is None:
+                raise ValueError("give either mesh or (shape, process_ids)")
+            mesh = np.array(process_ids).reshape(shape)
+        if isinstance(mesh, list):
+            mesh = np.array(mesh)
+        if not isinstance(mesh, np.ndarray):
+            raise ValueError("The mesh must be an instance of list or np.ndarray.")
+        self._mesh = mesh.astype(np.int64)
+        self._shape = list(self._mesh.shape)
+        self._process_ids = self._mesh.flatten().tolist()
+        if len(set(self._process_ids)) != len(self._process_ids):
+            raise ValueError("All elements of the mesh must be unique.")
+        if min(self._process_ids) < 0:
+            raise ValueError("All elements of the mesh must be >= 0.")
+        if dim_names is not None:
+            if not isinstance(dim_names, list) or len(dim_names) != len(self._shape):
+                raise ValueError("dim_names must be a list matching the mesh rank.")
+            self._dim_names = copy.deepcopy(dim_names)
+        else:
+            self._dim_names = [f"d{i}" for i in range(len(self._shape))]
+
+    # -- reference API surface --
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def unique_id(self):
+        return hash((tuple(self._shape), tuple(self._process_ids)))
+
+    def __getitem__(self, index):
+        sub = self._mesh[index]
+        if sub.ndim == 0:
+            sub = sub.reshape(1)
+            return ProcessMesh(sub, dim_names=[self._dim_names[-1]])
+        names = self._dim_names[-sub.ndim :]
+        return ProcessMesh(sub, dim_names=list(names))
+
+    def __enter__(self):
+        _mesh_stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        _mesh_stack.pop()
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self._shape == other._shape
+            and self._process_ids == other._process_ids
+        )
+
+    def __ne__(self, other):
+        return not self == other
+
+    def __str__(self):
+        return f"ProcessMesh(shape={self._shape}, process_ids={self._process_ids}, dim_names={self._dim_names})"
+
+    # -- TPU bridge --
+    def to_jax_mesh(self) -> Mesh:
+        devices = jax.devices()
+        if max(self._process_ids) >= len(devices):
+            raise ValueError(
+                f"ProcessMesh references process {max(self._process_ids)} but only "
+                f"{len(devices)} devices are visible"
+            )
+        grid = np.array([devices[i] for i in self._process_ids]).reshape(self._shape)
+        return Mesh(grid, tuple(self._dim_names))
+
+
+def get_current_process_mesh() -> Optional[ProcessMesh]:
+    return _mesh_stack[-1] if _mesh_stack else None

@@ -1,0 +1,66 @@
+"""Auto-parallel Strategy (auto_parallel/strategy.py + constants.py analog):
+nested config groups with the reference's field names; consumed by Engine."""
+
+from __future__ import annotations
+
+
+class _ConfigGroup:
+    _fields = {}
+
+    def __init__(self, **kwargs):
+        for k, v in self._fields.items():
+            setattr(self, k, kwargs.get(k, v))
+        for k, v in kwargs.items():
+            if k not in self._fields:
+                setattr(self, k, v)
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in self._fields}
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.to_dict()})"
+
+
+class AMPConfig(_ConfigGroup):
+    _fields = {
+        "enable": False,
+        "dtype": "bfloat16",  # TPU-native default (reference: float16)
+        "level": "o1",
+        "init_loss_scaling": 32768.0,
+        "custom_black_list": [],
+        "custom_white_list": [],
+        "use_master_weights": True,
+    }
+
+
+class RecomputeConfig(_ConfigGroup):
+    _fields = {"enable": False, "checkpoints": None, "no_recompute_segments": []}
+
+
+class ShardingConfig(_ConfigGroup):
+    _fields = {"enable": False, "stage": 1, "degree": 8, "overlap_grad_comm": True}
+
+
+class GradientMergeConfig(_ConfigGroup):
+    _fields = {"enable": False, "k_steps": 1, "avg": True}
+
+
+class PipelineConfig(_ConfigGroup):
+    _fields = {"enable": False, "schedule_mode": "1F1B", "micro_batch_size": 1, "accumulate_steps": 1}
+
+
+class FusedPassesConfig(_ConfigGroup):
+    _fields = {"enable": False, "fused_passes_list": []}
+
+
+class Strategy(_ConfigGroup):
+    _fields = {"auto_mode": "semi", "split_data": True, "seed": None, "gradient_scale": True}
+
+    def __init__(self, config=None):
+        super().__init__(**(config or {}))
+        self.amp = AMPConfig(**(config or {}).get("amp", {}) if isinstance(config, dict) else {})
+        self.recompute = RecomputeConfig()
+        self.sharding = ShardingConfig()
+        self.gradient_merge = GradientMergeConfig()
+        self.pipeline = PipelineConfig()
+        self.fused_passes = FusedPassesConfig()

@@ -107,3 +107,6 @@ def barrier_worker():
     from ..communication import barrier
 
     barrier()
+
+# fleet.auto namespace (reference: paddle.distributed.fleet import auto)
+from .. import auto_parallel as auto  # noqa: F401,E402

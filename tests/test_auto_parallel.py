@@ -1,0 +1,110 @@
+"""auto_parallel tests: ProcessMesh, shard_tensor placement, Engine fit/eval
+on the 8-device CPU mesh (the auto_parallel test-fixture pattern, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.auto_parallel import (
+    Engine,
+    ProcessMesh,
+    Strategy,
+    TensorDistAttr,
+    get_current_process_mesh,
+    shard_op,
+    shard_tensor,
+)
+
+
+def test_process_mesh_basics():
+    mesh = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+    assert mesh.shape == [2, 4]
+    assert mesh.process_ids == list(range(8))
+    assert mesh.dim_names == ["x", "y"]
+    jm = mesh.to_jax_mesh()
+    assert jm.axis_names == ("x", "y")
+    assert jm.devices.shape == (2, 4)
+    with pytest.raises(ValueError):
+        ProcessMesh([[0, 0]])
+
+
+def test_process_mesh_context():
+    mesh = ProcessMesh([0, 1], dim_names=["dp"])
+    assert get_current_process_mesh() is None
+    with mesh:
+        assert get_current_process_mesh() is mesh
+    assert get_current_process_mesh() is None
+
+
+def test_dist_attr_spec_roundtrip():
+    mesh = ProcessMesh([[0, 1], [2, 3]], dim_names=["x", "y"])
+    attr = TensorDistAttr.from_shard_spec(mesh, ["y", None, "x"], 3)
+    assert attr.dims_mapping == [1, -1, 0]
+    assert attr.to_partition_spec() == P("y", None, "x")
+
+
+def test_shard_tensor_places_data():
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+    x = paddle.ones([4, 8])
+    shard_tensor(x, mesh, ["x", "y"])
+    assert x.is_distributed
+    assert x.dist_spec == P("x", "y")
+    shards = x._value.addressable_shards
+    assert len(shards) == 8
+    assert shards[0].data.shape == (2, 2)
+    # replicated when spec omitted
+    y = paddle.ones([4, 8])
+    shard_tensor(y, mesh, [None, None])
+    assert not y.is_distributed
+
+
+def test_shard_tensor_divisibility_error():
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+    x = paddle.ones([3, 8])
+    with pytest.raises(ValueError):
+        shard_tensor(x, mesh, ["x", None])
+
+
+def test_shard_op_wraps():
+    mesh = ProcessMesh(list(range(8)), dim_names=["x"])
+    f = shard_op(lambda a, b: a + b, mesh, in_shard_specs=[["x"], ["x"]])
+    a = paddle.ones([8, 2])
+    b = paddle.ones([8, 2])
+    out = f(a, b)
+    np.testing.assert_allclose(out.numpy(), 2.0)
+
+
+class _RandomDataset(paddle.io.Dataset):
+    def __init__(self, n=64, d=8):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(n, d).astype(np.float32)
+        w = rng.randn(d, 1).astype(np.float32)
+        self.y = (self.x @ w).astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def test_engine_fit_eval_predict(tmp_path):
+    paddle.seed(0)
+    model = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 1))
+    loss = paddle.nn.MSELoss()
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=model.parameters())
+    engine = Engine(model, loss, opt, strategy=Strategy())
+
+    ds = _RandomDataset()
+    with ProcessMesh(list(range(8)), dim_names=["dp"]):
+        hist = engine.fit(ds, batch_size=16, epochs=3, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+        logs = engine.evaluate(ds, batch_size=16, verbose=0)
+        assert logs["eval_loss"] is not None and np.isfinite(logs["eval_loss"])
+        preds = engine.predict(ds, batch_size=16)
+        assert preds[0].shape == (16, 1)
+        engine.save(str(tmp_path / "ckpt"))
+        engine.load(str(tmp_path / "ckpt"))
