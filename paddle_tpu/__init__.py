@@ -64,6 +64,10 @@ from . import models  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from . import regularizer  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from .hapi import Model, summary  # noqa: F401,E402
+from .hapi import callbacks  # noqa: F401,E402
 from .param_attr import ParamAttr  # noqa: F401,E402
 
 # paddle.grad

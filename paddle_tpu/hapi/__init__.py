@@ -1,0 +1,4 @@
+from . import callbacks  # noqa: F401
+from .model import Model, summary  # noqa: F401
+
+__all__ = ["Model", "summary", "callbacks"]

@@ -1,0 +1,255 @@
+"""hapi callbacks (python/paddle/hapi/callbacks.py analog): the training-loop
+event hooks Model.fit drives. Same event order as the reference:
+train_begin -> (epoch_begin -> [batch_begin, batch_end]* -> epoch_end)* ->
+train_end, with eval_* nested at eval points."""
+
+from __future__ import annotations
+
+import numbers
+import os
+import time
+from typing import List, Optional
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    # train
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    # eval
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    # predict
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_predict_batch_begin(self, step, logs=None):
+        pass
+
+    def on_predict_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+
+            def call(*args, **kwargs):
+                for c in self.callbacks:
+                    getattr(c, name)(*args, **kwargs)
+
+            return call
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    """Per-epoch progress/metric logger (reference prints a progbar; here a
+    compact line every log_freq steps)."""
+
+    def __init__(self, log_freq: int = 1, verbose: int = 2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = self.params.get("steps")
+        self._t0 = time.time()
+
+    def _fmt(self, logs):
+        parts = []
+        for k, v in (logs or {}).items():
+            if isinstance(v, numbers.Number):
+                parts.append(f"{k}: {v:.4f}")
+        return " - ".join(parts)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            total = f"/{self.steps}" if self.steps else ""
+            print(f"Epoch {self.epoch}: step {step}{total} - {self._fmt(logs)}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            print(f"Epoch {epoch} done in {time.time() - self._t0:.2f}s - {self._fmt(logs)}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            print(f"Eval - {self._fmt(logs)}")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and self.save_dir and epoch % self.save_freq == 0:
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+    def on_train_end(self, logs=None):
+        if self.model is not None and self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (by_step or by_epoch)."""
+
+    def __init__(self, by_step: bool = True, by_epoch: bool = False):
+        super().__init__()
+        if by_step and by_epoch:
+            raise ValueError("by_step and by_epoch are mutually exclusive")
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None) if self.model else None
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch and self._sched() is not None:
+            self._sched().step()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step and self._sched() is not None:
+            self._sched().step()
+
+
+class EarlyStopping(Callback):
+    def __init__(
+        self,
+        monitor: str = "loss",
+        mode: str = "auto",
+        patience: int = 0,
+        verbose: int = 1,
+        min_delta: float = 0.0,
+        baseline=None,
+        save_best_model: bool = True,
+    ):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.reset()
+
+    def reset(self):
+        import numpy as np
+
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.best = -float("inf") if self.mode == "max" else float("inf")
+        if self.baseline is not None:
+            self.best = self.baseline
+        self._np = np
+
+    def _improved(self, cur):
+        if self.mode == "max":
+            return cur > self.best + self.min_delta
+        return cur < self.best - self.min_delta
+
+    def on_train_begin(self, logs=None):
+        self.reset()
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        if self._improved(float(cur)):
+            self.best = float(cur)
+            self.wait = 0
+            if self.save_best_model and self.model is not None and getattr(self.model, "_save_dir", None):
+                self.model.save(os.path.join(self.model._save_dir, "best_model"))
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                if self.model is not None:
+                    self.model.stop_training = True
+                if self.verbose:
+                    print(f"Early stopping: {self.monitor} did not improve for {self.wait} evals")
+
+
+class VisualDL(Callback):
+    """Scalar logger (VisualDL writer analog): appends metric scalars to a
+    jsonl file under log_dir — no visualdl dependency in this environment."""
+
+    def __init__(self, log_dir: str = "./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        rec = {"tag": tag, "step": self._step}
+        rec.update({k: float(v) for k, v in (logs or {}).items() if isinstance(v, numbers.Number)})
+        with open(os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)

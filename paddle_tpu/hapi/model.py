@@ -1,0 +1,291 @@
+"""paddle.Model — the hapi high-level trainer (hapi/model.py:1018 analog).
+
+The reference dispatches fit through DynamicGraphAdapter (eager) or
+StaticGraphAdapter (program). TPU-native there is one adapter: the eager
+tape drives `loss.backward()` + `optimizer.step()` per batch, and everything
+under it is jit-compiled op-level; the jitted whole-step path lives in
+fleet.utils.ShardedTrainStep / auto_parallel.Engine for the perf-critical
+loops. hapi's value is the loop + callbacks + metrics contract, kept intact.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..metric.metrics import Metric
+from .callbacks import Callback, CallbackList, ModelCheckpoint, ProgBarLogger
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _np(x):
+    return np.asarray(x._value) if isinstance(x, Tensor) else np.asarray(x)
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+        self._save_dir = None
+
+    # ---------- setup ----------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        if loss is not None and not callable(loss):
+            raise TypeError("loss must be a callable or nn.Layer")
+        self._loss = loss
+        for m in _to_list(metrics):
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle.metric.Metric")
+        self._metrics = _to_list(metrics)
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    # ---------- batch-level ----------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outputs = self.network(*[_as_tensor(v) for v in inputs])
+        losses = self._loss(*(_to_list(outputs) + [_as_tensor(v) for v in labels]))
+        losses = _to_list(losses)
+        total = losses[0]
+        for extra in losses[1:]:
+            total = total + extra
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            out0 = _to_list(outputs)[0]
+            metrics.append(m.update(*_to_list(m.compute(out0, *[_as_tensor(v) for v in labels]))))
+        return ([float(_np(l)) for l in losses], metrics) if metrics else [float(_np(l)) for l in losses]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outputs = self.network(*[_as_tensor(v) for v in inputs])
+        losses = []
+        if self._loss is not None and labels:
+            losses = [float(_np(l)) for l in _to_list(self._loss(*(_to_list(outputs) + [_as_tensor(v) for v in labels])))]
+        metrics = []
+        for m in self._metrics:
+            out0 = _to_list(outputs)[0]
+            metrics.append(m.update(*_to_list(m.compute(out0, *[_as_tensor(v) for v in labels]))))
+        return (losses, metrics) if metrics else losses
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        outputs = self.network(*[_as_tensor(v) for v in _to_list(inputs)])
+        return [_np(o) for o in _to_list(outputs)]
+
+    # ---------- loops ----------
+    def _loader(self, data, batch_size, shuffle, num_workers):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(data, DataLoader) or (hasattr(data, "__iter__") and not isinstance(data, Dataset)):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle, num_workers=num_workers, drop_last=False)
+
+    def fit(
+        self,
+        train_data=None,
+        eval_data=None,
+        batch_size=1,
+        epochs=1,
+        eval_freq=1,
+        log_freq=10,
+        save_dir=None,
+        save_freq=1,
+        verbose=2,
+        drop_last=False,
+        shuffle=True,
+        num_workers=0,
+        callbacks=None,
+        accumulate_grad_batches=1,
+        num_iters=None,
+    ):
+        assert train_data is not None
+        self._save_dir = save_dir
+        loader = self._loader(train_data, batch_size, shuffle, num_workers)
+        cbks = CallbackList(_to_list(callbacks))
+        if verbose:
+            cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+        if save_dir:
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        cbks.set_model(self)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks.set_params({"epochs": epochs, "steps": steps, "verbose": verbose, "metrics": self._metrics_names()})
+
+        self.stop_training = False
+        cbks.on_train_begin()
+        logs = {}
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                if num_iters is not None and step >= num_iters:
+                    break
+                inputs, labels = self._split(batch)
+                cbks.on_train_batch_begin(step)
+                res = self.train_batch(inputs, labels, update=(step + 1) % accumulate_grad_batches == 0)
+                logs = self._pack_logs(res)
+                cbks.on_train_batch_end(step, logs)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=0, callbacks=cbks, _nested=True)
+        cbks.on_train_end(logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0, callbacks=None, num_iters=None, _nested=False):
+        loader = self._loader(eval_data, batch_size, False, num_workers)
+        cbks = callbacks if _nested else CallbackList(_to_list(callbacks))
+        if not _nested:
+            cbks.set_model(self)
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        losses = []
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            inputs, labels = self._split(batch)
+            cbks.on_eval_batch_begin(step)
+            res = self.eval_batch(inputs, labels)
+            ls = res[0] if isinstance(res, tuple) else res
+            if ls:
+                losses.append(ls[0] if isinstance(ls, list) else ls)
+            cbks.on_eval_batch_end(step, self._pack_logs(res, prefix="eval_"))
+        logs = {}
+        if losses:
+            logs["eval_loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[f"eval_{_name_of(m)}"] = m.accumulate()
+            logs[_name_of(m)] = m.accumulate()
+        cbks.on_eval_end(logs)
+        if verbose:
+            print("Eval:", logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._loader(test_data, batch_size, False, num_workers)
+        cbks = CallbackList(_to_list(callbacks))
+        cbks.set_model(self)
+        cbks.on_predict_begin()
+        outs = []
+        for step, batch in enumerate(loader):
+            inputs, _ = self._split(batch, labeled=False)
+            cbks.on_predict_batch_begin(step)
+            outs.append(self.predict_batch(inputs))
+            cbks.on_predict_batch_end(step)
+        cbks.on_predict_end()
+        n_out = len(outs[0]) if outs else 0
+        grouped = [[o[i] for o in outs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g, axis=0) for g in grouped]
+        return grouped
+
+    # ---------- persistence ----------
+    def save(self, path, training=True):
+        from ..framework import io as fio
+
+        if training:
+            state = {"model": self.network.state_dict()}
+            if self._optimizer is not None:
+                state["optimizer"] = self._optimizer.state_dict()
+            fio.save(state, path + ".pdparams")
+        else:
+            from .. import jit
+
+            jit.save(self.network, path, input_spec=self._inputs)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import io as fio
+
+        state = fio.load(path + ".pdparams")
+        self.network.set_state_dict(state["model"] if "model" in state else state)
+        if not reset_optimizer and self._optimizer is not None and "optimizer" in state:
+            self._optimizer.set_state_dict(state["optimizer"])
+        return self
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtypes=dtype)
+
+    # ---------- helpers ----------
+    def _metrics_names(self):
+        return ["loss"] + [_name_of(m) for m in self._metrics]
+
+    def _split(self, batch, labeled=True):
+        items = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        if len(items) == 1:
+            return items, []
+        if not labeled and self._loss is None and not self._labels:
+            return items, []  # genuinely unlabeled multi-input batch
+        n_in = len(self._inputs) if self._inputs else max(1, len(items) - (len(self._labels) if self._labels else 1))
+        return items[:n_in], items[n_in:]
+
+    def _pack_logs(self, res, prefix=""):
+        logs = {}
+        if isinstance(res, tuple):
+            losses, metrics = res
+        else:
+            losses, metrics = res, []
+        if losses:
+            logs[prefix + "loss"] = losses[0] if isinstance(losses, list) else losses
+        for m, val in zip(self._metrics, metrics):
+            logs[prefix + _name_of(m)] = val
+        return logs
+
+
+def _name_of(m):
+    n = m.name()
+    return n if isinstance(n, str) else str(n)
+
+
+def _as_tensor(v):
+    return v if isinstance(v, Tensor) else Tensor(np.asarray(v))
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """paddle.summary: parameter table + counts (hapi/model_summary analog)."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        if p is None:
+            continue
+        n = int(np.prod(p.shape)) if len(p.shape) else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, list(p.shape), n))
+    width = max([len(r[0]) for r in rows], default=10) + 2
+    lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Param #':>12}"]
+    lines.append("-" * (width + 32))
+    for name, shape, n in rows:
+        lines.append(f"{name:<{width}}{str(shape):<20}{n:>12,}")
+    lines.append("-" * (width + 32))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    lines.append(f"Non-trainable params: {total - trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

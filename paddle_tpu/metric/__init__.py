@@ -1,0 +1,3 @@
+from .metrics import Accuracy, Auc, Metric, Precision, Recall, accuracy
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]

@@ -1,0 +1,191 @@
+"""paddle.metric (metric/metrics.py analog): streaming metrics with the
+reference's update/accumulate/reset/compute contract. `compute` is the
+in-graph preprocessing half (runs under jit on device); `update` accumulates
+host-side numpy — the same split the reference uses to keep metric state out
+of the program."""
+
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._value)
+    return np.asarray(x)
+
+
+class Metric(metaclass=abc.ABCMeta):
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def name(self):
+        ...
+
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    def compute(self, *args):
+        """Default: identity passthrough (subclasses override to move work
+        in-graph)."""
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy. compute(pred, label) -> correct [B, max(topk)] mask."""
+
+    def __init__(self, topk=(1,), name=None, *args, **kwargs):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred_np = _np(pred)
+        label_np = _np(label)
+        if label_np.ndim == pred_np.ndim and label_np.shape[-1] == 1:
+            label_np = label_np[..., 0]
+        # one-hot labels -> indices
+        if label_np.ndim == pred_np.ndim and label_np.shape == pred_np.shape:
+            label_np = label_np.argmax(-1)
+        topk_idx = np.argsort(-pred_np, axis=-1)[..., : self.maxk]
+        correct = topk_idx == label_np[..., None]
+        return Tensor(np.cumsum(correct, axis=-1).astype(np.float32))
+
+    def update(self, correct, *args):
+        correct = _np(correct)
+        accs = []
+        for k in self.topk:
+            num_corrects = correct[..., k - 1].sum()
+            num_samples = int(np.prod(correct.shape[:-1]))
+            self.total[self.topk.index(k)] += num_corrects
+            self.count[self.topk.index(k)] += num_samples
+            accs.append(float(num_corrects) / max(num_samples, 1))
+        return accs[0] if len(self.topk) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(self.topk) == 1 else res
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    """Binary precision: TP / (TP + FP). preds are probabilities or 0/1."""
+
+    def __init__(self, name="precision", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = (_np(preds) > 0.5).astype(np.int64).reshape(-1)
+        labels = _np(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        return self.tp / max(self.tp + self.fp, 1)
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall: TP / (TP + FN)."""
+
+    def __init__(self, name="recall", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = (_np(preds) > 0.5).astype(np.int64).reshape(-1)
+        labels = _np(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        return self.tp / max(self.tp + self.fn, 1)
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """Bucketed ROC-AUC (the reference's threshold-histogram formulation)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = _np(labels).astype(np.int64).reshape(-1)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64), 0, self.num_thresholds)
+        np.add.at(self._stat_pos, idx, labels == 1)
+        np.add.at(self._stat_neg, idx, labels == 0)
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, np.float64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, np.float64)
+
+    def accumulate(self):
+        tot_pos = np.cumsum(self._stat_pos[::-1])
+        tot_neg = np.cumsum(self._stat_neg[::-1])
+        area = 0.0
+        prev_fp = 0.0
+        prev_tp = 0.0
+        for fp, tp in zip(tot_neg, tot_pos):
+            area += (fp - prev_fp) * (tp + prev_tp) / 2.0
+            prev_fp, prev_tp = fp, tp
+        P = tot_pos[-1]
+        N = tot_neg[-1]
+        return float(area / max(P * N, 1e-12)) if P and N else 0.0
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (paddle.metric.accuracy)."""
+    pred = _np(input)
+    lab = _np(label)
+    if lab.ndim == pred.ndim and lab.shape[-1] == 1:
+        lab = lab[..., 0]
+    topk_idx = np.argsort(-pred, axis=-1)[..., :k]
+    correct_mask = (topk_idx == lab[..., None]).any(-1)
+    return Tensor(np.asarray(correct_mask.mean(), np.float32))

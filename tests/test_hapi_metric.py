@@ -1,0 +1,118 @@
+"""hapi Model.fit + metric tests (hapi/model.py:1018, metric/metrics.py
+analogs): loop/callback/metric contract on a synthetic classification task."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi.callbacks import Callback, EarlyStopping
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall, accuracy
+
+
+def test_accuracy_metric():
+    m = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]], np.float32)
+    label = np.array([[1], [2]])
+    correct = m.compute(pred, label)
+    m.update(correct)
+    acc1, acc2 = m.accumulate()
+    assert acc1 == pytest.approx(0.5)  # first sample top1 correct
+    assert acc2 == pytest.approx(0.5)  # label 2 not in top2 of second? top2 = {0, 1or2}
+    m.reset()
+    assert m.accumulate() == [0.0, 0.0]
+
+
+def test_accuracy_functional():
+    out = accuracy(np.array([[0.1, 0.9], [0.9, 0.1]]), np.array([[1], [1]]), k=1)
+    assert float(out.numpy()) == pytest.approx(0.5)
+
+
+def test_precision_recall():
+    p, r = Precision(), Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.6])
+    labels = np.array([1, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    # predicted positive: idx 0,1,3 -> TP=2 FP=1; FN: idx2 -> 1
+    assert p.accumulate() == pytest.approx(2 / 3)
+    assert r.accumulate() == pytest.approx(2 / 3)
+
+
+def test_auc_perfect_and_random():
+    auc = Auc()
+    preds = np.array([0.9, 0.8, 0.2, 0.1])
+    labels = np.array([1, 1, 0, 0])
+    auc.update(preds, labels)
+    assert auc.accumulate() == pytest.approx(1.0)
+    auc.reset()
+    auc.update(np.array([0.5, 0.5, 0.5, 0.5]), labels)
+    assert auc.accumulate() == pytest.approx(0.5, abs=0.01)
+
+
+class _ClsDataset(paddle.io.Dataset):
+    def __init__(self, n=128):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(n, 4).astype(np.float32)
+        self.y = (self.x.sum(axis=1) > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _make_model():
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 2))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=0.05, parameters=net.parameters()),
+        loss=paddle.nn.CrossEntropyLoss(),
+        metrics=Accuracy(),
+    )
+    return model
+
+
+def test_model_fit_evaluate_predict(tmp_path):
+    model = _make_model()
+    ds = _ClsDataset()
+    events = []
+
+    class Recorder(Callback):
+        def on_train_begin(self, logs=None):
+            events.append("train_begin")
+
+        def on_epoch_end(self, epoch, logs=None):
+            events.append(("epoch_end", epoch))
+
+        def on_train_end(self, logs=None):
+            events.append("train_end")
+
+    model.fit(ds, batch_size=32, epochs=3, verbose=0, callbacks=[Recorder()])
+    logs = model.evaluate(ds, batch_size=32, verbose=0)
+    assert logs["eval_acc"] > 0.9
+    assert "train_begin" in events and "train_end" in events and ("epoch_end", 2) in events
+    preds = model.predict(ds, batch_size=32, stack_outputs=True)
+    assert preds[0].shape == (128, 2)
+    model.save(str(tmp_path / "m"))
+    m2 = _make_model()
+    m2.load(str(tmp_path / "m"))
+    logs2 = m2.evaluate(ds, batch_size=32, verbose=0)
+    assert logs2["eval_acc"] == pytest.approx(logs["eval_acc"])
+
+
+def test_model_summary(capsys):
+    net = paddle.nn.Linear(4, 2)
+    info = paddle.summary(net)
+    assert info["total_params"] == 4 * 2 + 2
+    assert "Total params" in capsys.readouterr().out
+
+
+def test_early_stopping():
+    model = _make_model()
+    ds = _ClsDataset()
+    es = EarlyStopping(monitor="eval_loss", patience=0, verbose=0, save_best_model=False)
+    # patience=0: stops after first non-improving eval
+    model.fit(ds, eval_data=ds, batch_size=32, epochs=10, verbose=0, callbacks=[es])
+    assert model.stop_training or es.wait == 0  # converged fast or stopped
