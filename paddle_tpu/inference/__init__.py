@@ -1,0 +1,10 @@
+from .predictor import Config, Predictor, PredictorPool, convert_to_mixed_precision, create_predictor, get_version
+
+__all__ = [
+    "Config",
+    "Predictor",
+    "PredictorPool",
+    "create_predictor",
+    "get_version",
+    "convert_to_mixed_precision",
+]

@@ -1,0 +1,177 @@
+"""Inference predictor (fluid/inference/api/analysis_predictor.h:94 analog).
+
+The reference's AnalysisPredictor loads a program, runs 100+ IR fusion
+passes, and executes on NaiveExecutor — on TPU the saved artifact is already
+compiled-form StableHLO (paddle.jit.save), "analysis" is XLA's job, and Run()
+executes the AOT-compiled executable via PJRT. The ZeroCopy handle API is
+kept verbatim so reference serving code ports 1:1.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Config:
+    """paddle_infer.Config analog. GPU/TRT/MKLDNN toggles are accepted and
+    recorded but inert — device policy on TPU is jax's."""
+
+    def __init__(self, prog_file: Optional[str] = None, params_file: Optional[str] = None):
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+        self._params_file = params_file
+        self._options: Dict = {}
+        self._memory_pool_mb = None
+        self._device_id = 0
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        if prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+        self._params_file = params_file
+
+    def model_dir(self):
+        return os.path.dirname(self._prefix or "")
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return self._params_file or (self._prefix or "") + ".pdiparams"
+
+    # accepted-but-inert toggles (recorded for introspection)
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._memory_pool_mb = memory_pool_init_size_mb
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._options["use_gpu"] = False
+
+    def enable_memory_optim(self, *a, **k):
+        self._options["memory_optim"] = True
+
+    def enable_tensorrt_engine(self, *a, **k):
+        self._options["tensorrt"] = True
+
+    def enable_mkldnn(self, *a, **k):
+        self._options["mkldnn"] = True
+
+    def switch_ir_optim(self, flag=True):
+        self._options["ir_optim"] = flag
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._options["cpu_threads"] = n
+
+    def summary(self):
+        return {"model": self.prog_file(), **self._options}
+
+
+class _IOHandle:
+    """ZeroCopy tensor handle (paddle_infer.Tensor analog)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._array: Optional[np.ndarray] = None
+
+    def reshape(self, shape):
+        self._array = np.zeros(shape, self._array.dtype if self._array is not None else np.float32)
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._array = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._array)
+
+    def shape(self):
+        return list(self._array.shape) if self._array is not None else []
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        self._config = config
+        self._layer = jit_load(config._prefix)
+        specs = self._layer._input_specs
+        self._input_names = [s.get("name") or f"input_{i}" for i, s in enumerate(specs)]
+        self._inputs = {n: _IOHandle(n) for n in self._input_names}
+        self._output_names: List[str] = []
+        self._outputs: Dict[str, _IOHandle] = {}
+        self._compiled_cache = {}
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> _IOHandle:
+        return self._inputs[name]
+
+    def get_input_tensor(self, name: str) -> _IOHandle:
+        return self.get_input_handle(name)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute. Either pass arrays positionally or pre-fill input handles.
+        Returns list of output arrays (also readable via output handles)."""
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        args = [jnp.asarray(self._inputs[n]._array) for n in self._input_names]
+        key = tuple((a.shape, str(a.dtype)) for a in args)
+        call = self._compiled_cache.get(key)
+        if call is None:
+            # AOT-compile the deserialized StableHLO for these shapes
+            call = jax.jit(self._layer._call).lower(*args).compile()
+            self._compiled_cache[key] = call
+        outs = call(*args)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        self._output_names = [f"output_{i}" for i in range(len(outs))]
+        self._outputs = {}
+        results = []
+        for n, o in zip(self._output_names, outs):
+            h = _IOHandle(n)
+            h.copy_from_cpu(np.asarray(o))
+            self._outputs[n] = h
+            results.append(np.asarray(o))
+        return results
+
+    def get_output_names(self):
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> _IOHandle:
+        return self._outputs[name]
+
+    def get_output_tensor(self, name: str) -> _IOHandle:
+        return self.get_output_handle(name)
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        self._compiled_cache.clear()
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+class PredictorPool:
+    def __init__(self, config: Config, size: int = 1):
+        self._predictors = [Predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._predictors[idx]
+
+
+def get_version() -> str:
+    from .. import __version__
+
+    return __version__
+
+
+def convert_to_mixed_precision(*args, **kwargs):
+    raise NotImplementedError("use bfloat16 layers at save time; XLA handles mixed precision")

@@ -1,0 +1,3 @@
+from .api import TranslatedLayer, ignore_module, load, not_to_static, save, to_static
+
+__all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer", "ignore_module"]

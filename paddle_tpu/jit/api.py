@@ -1,0 +1,282 @@
+"""paddle.jit — dy2static (jit/api.py:232 to_static, :792 save, :1274 load).
+
+The reference converts Python AST into ProgramDesc; on TPU jax.jit IS the
+converter (trace once, compile). `to_static` wraps a function/Layer method in
+a cached jit with the tape disabled inside; `save` exports the traced
+program as serialized StableHLO (weights baked, jax.export) + a state_dict;
+`load` rebuilds a TranslatedLayer executing the deserialized artifact —
+runnable without the original Python class, the TranslatedLayer contract.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from ..core import random as _random
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from ..static.input_spec import InputSpec
+
+_MODEL_SUFFIX = ".pdmodel"
+_PARAMS_SUFFIX = ".pdiparams"
+_META_SUFFIX = ".pdmeta"
+
+
+def _leaf_to_raw(v):
+    if isinstance(v, Tensor):
+        return v._value
+    return v
+
+
+def _is_arraylike(v):
+    return isinstance(v, (Tensor, np.ndarray, jnp.ndarray, float, int, bool)) or hasattr(v, "__jax_array__")
+
+
+class StaticFunction:
+    """to_static-wrapped callable: jit cache + original-fn access (parity with
+    dy2static StaticFunction: .code/.concrete_program reduced to the jaxpr)."""
+
+    def __init__(self, function, input_spec=None, build_strategy=None, backend=None, **kwargs):
+        functools.update_wrapper(self, function)
+        self._function = function
+        self._input_spec = input_spec
+        self._layer = None  # bound Layer for methods
+        self._jit_cache = {}
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = StaticFunction(self._function.__get__(instance, owner), self._input_spec)
+        bound._layer = instance
+        # cache the bound wrapper on the instance so the jit cache persists
+        name = self._function.__name__
+        instance.__dict__[name] = bound
+        return bound
+
+    @property
+    def dygraph_function(self):
+        return self._function
+
+    def _traced(self, layer, n_args):
+        key = ("layer", n_args) if layer is not None else ("fn", n_args)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        fn = self._function
+
+        if layer is not None:
+            # inline the functional_call overlay but invoke the ORIGINAL
+            # function (layer.forward may now BE this StaticFunction)
+            def traced(params, buffers, seed, *raw_args):
+                from ..core import functional as F
+
+                uid_map = {}
+                buf_name = {}
+                for name, p in layer.named_parameters():
+                    if name in params:
+                        uid_map[p._uid] = params[name]
+                for name, b in layer.named_buffers():
+                    if b is not None and name in buffers:
+                        uid_map[b._uid] = buffers[name]
+                        buf_name[b._uid] = name
+                with F.overlay(uid_map), no_grad(), _random.rng_scope(seed):
+                    out = fn(*[Tensor(a) for a in raw_args])
+                    new_buffers = {buf_name[uid]: val for uid, val in uid_map.items() if uid in buf_name}
+                return jax.tree_util.tree_map(_leaf_to_raw, out), new_buffers
+
+            jitted = jax.jit(traced)
+        else:
+
+            def traced(seed, *raw_args):
+                with no_grad(), _random.rng_scope(seed):
+                    out = fn(*[Tensor(a) for a in raw_args])
+                return jax.tree_util.tree_map(_leaf_to_raw, out)
+
+            jitted = jax.jit(traced)
+        self._jit_cache[key] = jitted
+        return jitted
+
+    def __call__(self, *args, **kwargs):
+        if kwargs or not all(_is_arraylike(a) for a in args):
+            # non-array args force the eager path (still correct, not cached)
+            return self._function(*args, **kwargs)
+        if any(isinstance(getattr(a, "_value", a), jax.core.Tracer) for a in args):
+            return self._function(*args, **kwargs)  # already under a trace: inline
+        raw = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        self._seed_counter = getattr(self, "_seed_counter", 0) + 1
+        seed = jnp.uint32(self._seed_counter)
+        if self._layer is not None:
+            params, buffers = self._layer.functional_state()
+            jitted = self._traced(self._layer, len(raw))
+            out, new_buffers = jitted(params, buffers, seed, *raw)
+            named = dict(self._layer.named_buffers())
+            for name, val in new_buffers.items():
+                if name in named and named[name] is not None:
+                    named[name]._set_value_raw(val)
+        else:
+            jitted = self._traced(None, len(raw))
+            out = jitted(seed, *raw)
+        return jax.tree_util.tree_map(
+            lambda v: Tensor(v) if isinstance(v, jnp.ndarray) else v, out
+        )
+
+    def concrete_program(self, *args):
+        raw = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        if self._layer is not None:
+            params, buffers = self._layer.functional_state()
+            return self._traced(self._layer, len(raw)).lower(params, buffers, jnp.uint32(0), *raw)
+        return self._traced(None, len(raw)).lower(jnp.uint32(0), *raw)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """Decorator: compile a function or Layer.forward via jax.jit."""
+
+    def decorate(fn):
+        from ..nn.layer.layers import Layer
+
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, input_spec=input_spec)
+            sf._layer = fn
+            fn.forward = sf
+            fn._to_static_spec = input_spec
+            return fn
+        return StaticFunction(fn, input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(function):
+    """Mark a function to stay eager (dy2static skip-list analog)."""
+    function._not_to_static = True
+    return function
+
+
+def ignore_module(modules):
+    """Parity no-op: jax tracing has no module skip list."""
+    return None
+
+
+# ---------------- save / load ----------------
+def _specs_from(input_spec, layer):
+    if input_spec is None:
+        input_spec = getattr(layer, "_to_static_spec", None)
+    if input_spec is None:
+        raise ValueError("paddle.jit.save needs input_spec (list of InputSpec or example Tensors)")
+    specs = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            specs.append(s)
+        elif isinstance(s, Tensor):
+            specs.append(InputSpec.from_tensor(s))
+        else:
+            a = np.asarray(s)
+            specs.append(InputSpec.from_numpy(a))
+    return specs
+
+
+def _sds_of(spec: InputSpec, scope):
+    dims = []
+    sym = []
+    for i, d in enumerate(spec.shape):
+        if d is None or (isinstance(d, int) and d < 0):
+            dims.append(f"b{len(sym)}")
+            sym.append(dims[-1])
+        else:
+            dims.append(str(d))
+    if sym:
+        shape = jax_export.symbolic_shape(",".join(dims), scope=scope)
+    else:
+        shape = tuple(int(d) for d in spec.shape)
+    return jax.ShapeDtypeStruct(shape, spec._np_dtype())
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export layer.forward at `input_spec` to serialized StableHLO (+ params).
+
+    Files: {path}.pdmodel (portable program, weights baked),
+    {path}.pdiparams (state_dict for re-training), {path}.pdmeta (signature).
+    """
+    from ..framework import io as fio
+
+    specs = _specs_from(input_spec, layer)
+    layer.eval()
+    params, buffers = layer.functional_state()
+    # export must trace the original forward, not a to_static wrapper
+    sf = layer.forward if isinstance(getattr(layer, "forward", None), StaticFunction) else None
+    if sf is not None:
+        layer.forward = sf._function
+    try:
+
+        def fwd(*raw_args):
+            with no_grad(), _random.rng_scope(jnp.uint32(0)):
+                out, _ = layer.functional_call(params, buffers, *[Tensor(a) for a in raw_args])
+            return jax.tree_util.tree_map(_leaf_to_raw, out)
+
+        scope = jax_export.SymbolicScope()
+        sds = [_sds_of(s, scope) for s in specs]
+        exported = jax_export.export(jax.jit(fwd))(*sds)
+        blob = exported.serialize()
+    finally:
+        if sf is not None:
+            layer.forward = sf
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    with open(path + _MODEL_SUFFIX, "wb") as f:
+        f.write(blob)
+    fio.save(layer.state_dict(), path + _PARAMS_SUFFIX)
+    meta = {
+        "input_specs": [{"shape": [d if d is None else int(d) for d in s.shape], "dtype": s.dtype, "name": s.name} for s in specs],
+        "format": "stablehlo-jax-export-v1",
+    }
+    with open(path + _META_SUFFIX, "w") as f:
+        json.dump(meta, f)
+
+
+class TranslatedLayer:
+    """Deserialized inference program (jit/translated_layer.py analog): call
+    it like the original Layer; weights are baked into the program."""
+
+    def __init__(self, exported, state_dict, meta):
+        self._exported = exported
+        self._state_dict = state_dict
+        self._input_specs = meta["input_specs"]
+        self._call = exported.call
+
+    def __call__(self, *args):
+        raw = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        out = self._call(*raw)
+        return jax.tree_util.tree_map(lambda v: Tensor(v) if isinstance(v, jnp.ndarray) else v, out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only (weights are baked into the program)")
+
+    def state_dict(self):
+        return dict(self._state_dict)
+
+    def parameters(self):
+        return [Tensor(np.asarray(v)) for v in self._state_dict.values()]
+
+
+def load(path, **configs) -> TranslatedLayer:
+    from ..framework import io as fio
+
+    with open(path + _MODEL_SUFFIX, "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    state = fio.load(path + _PARAMS_SUFFIX) if os.path.exists(path + _PARAMS_SUFFIX) else {}
+    with open(path + _META_SUFFIX) as f:
+        meta = json.load(f)
+    return TranslatedLayer(exported, state, meta)

@@ -1,0 +1,33 @@
+"""paddle.static compat surface (SURVEY §2.7 static).
+
+The reference's static graph (Program/Executor) is subsumed by jax tracing:
+`paddle.jit.to_static` IS program capture, the HLO module IS the Program.
+This package keeps the names user code imports — InputSpec (real), plus
+inference-model save/load delegating to paddle.jit.
+"""
+
+from .input_spec import InputSpec
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kwargs):
+    """static.save_inference_model parity: `fetch_vars` must be produced by a
+    jit-captured layer; delegates to paddle.jit.save."""
+    layer = kwargs.get("layer")
+    if layer is None:
+        raise NotImplementedError(
+            "TPU build has no Program objects; pass layer= (a paddle.nn.Layer) "
+            "or use paddle.jit.save directly"
+        )
+    from .. import jit
+
+    jit.save(layer, path_prefix, input_spec=feed_vars)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from .. import jit
+
+    layer = jit.load(path_prefix)
+    in_names = [s["name"] or f"x{i}" for i, s in enumerate(layer._input_specs)]
+    return layer, in_names, None

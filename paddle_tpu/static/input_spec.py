@@ -1,0 +1,53 @@
+"""InputSpec (python/paddle/static/input.py analog): shape/dtype signature
+for program capture. `None` dims become jax.export symbolic dimensions so one
+saved program serves any batch size — the dy2static dynamic-shape contract."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class InputSpec:
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32", name: Optional[str] = None, stop_gradient: bool = True):
+        self.shape = tuple(shape)
+        self.dtype = str(dtype).replace("paddle.", "")
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), str(tensor.dtype), name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def batch(self, batch_size):
+        return InputSpec((batch_size,) + self.shape, self.dtype, self.name)
+
+    def unbatch(self):
+        return InputSpec(self.shape[1:], self.dtype, self.name)
+
+    def _np_dtype(self):
+        from ..core.dtype import convert_dtype
+
+        try:
+            return np.dtype(convert_dtype(self.dtype))
+        except Exception:
+            return np.dtype(self.dtype)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, InputSpec)
+            and self.shape == other.shape
+            and self.dtype == other.dtype
+            and self.name == other.name
+        )
+
+    def __hash__(self):
+        return hash((self.shape, self.dtype, self.name))
