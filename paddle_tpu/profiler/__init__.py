@@ -1,0 +1,23 @@
+from .profiler import (
+    Profiler,
+    ProfilerState,
+    ProfilerTarget,
+    RecordEvent,
+    SummaryView,
+    export_chrome_tracing,
+    export_protobuf,
+    load_profiler_result,
+    make_scheduler,
+)
+
+__all__ = [
+    "Profiler",
+    "ProfilerState",
+    "ProfilerTarget",
+    "RecordEvent",
+    "SummaryView",
+    "make_scheduler",
+    "export_chrome_tracing",
+    "export_protobuf",
+    "load_profiler_result",
+]

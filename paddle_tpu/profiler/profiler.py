@@ -1,0 +1,303 @@
+"""paddle.profiler (python/paddle/profiler/profiler.py:340 analog).
+
+Host spans are recorded by a lightweight in-process recorder (the HostTracer
+/ RecordEvent analog, SURVEY §5.1); device-side tracing delegates to
+jax.profiler (XPlane -> TensorBoard), started/stopped by the same
+ProfilerState scheduler the reference drives CUPTI with. Chrome-trace export
+writes the host spans; the XPlane dump lands in the same log dir.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional, Union
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+class _HostEventRecorder:
+    """Process-global span recorder (host_event_recorder.h analog)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+        self.enabled = False
+
+    def record(self, name: str, start: float, end: float):
+        if not self.enabled:
+            return
+        with self._lock:
+            self.events.append(
+                {
+                    "name": name,
+                    "ts": start * 1e6,
+                    "dur": (end - start) * 1e6,
+                    "tid": threading.get_ident() % 100000,
+                }
+            )
+
+    def drain(self):
+        with self._lock:
+            ev, self.events = self.events, []
+        return ev
+
+
+_recorder = _HostEventRecorder()
+
+
+class RecordEvent:
+    """User-instrumentation span (platform/profiler/event_tracing.h
+    RecordEvent analog) — also usable as a decorator; nests with
+    jax.named_scope so spans appear in the XPlane device trace too."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+        self._scope = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+        try:
+            import jax
+
+            self._scope = jax.named_scope(self.name)
+            self._scope.__enter__()
+        except Exception:
+            self._scope = None
+
+    def end(self):
+        if self._scope is not None:
+            self._scope.__exit__(None, None, None)
+            self._scope = None
+        if self._t0 is not None:
+            _recorder.record(self.name, self._t0, time.perf_counter())
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0, skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """State machine: skip_first CLOSED steps, then cycles of
+    closed/ready/record (last record step returns RECORD_AND_RETURN),
+    repeating `repeat` times (0 = forever)."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None) -> Callable:
+    """on_trace_ready callback writing chrome://tracing JSON
+    (ChromeTracingLogger analog)."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}.paddle_trace.json")
+        events = [
+            {"name": e["name"], "ph": "X", "ts": e["ts"], "dur": e["dur"], "pid": os.getpid(), "tid": e["tid"]}
+            for e in prof._events
+        ]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        prof._last_export = path
+
+    return handler
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None) -> Callable:
+    """The reference dumps a protobuf NodeTree; the TPU-native equivalent is
+    the XPlane protobuf jax.profiler already wrote. Falls back to chrome JSON
+    for host spans."""
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+def load_profiler_result(filename: str):
+    with open(filename) as f:
+        return json.load(f)
+
+
+class Profiler:
+    def __init__(
+        self,
+        *,
+        targets: Optional[Iterable[ProfilerTarget]] = None,
+        scheduler: Union[Callable, tuple, None] = None,
+        on_trace_ready: Optional[Callable] = None,
+        record_shapes: bool = False,
+        profile_memory: bool = False,
+        timer_only: bool = False,
+        emit_nvtx: bool = False,
+        custom_device_types: Optional[list] = None,
+        with_flops: bool = False,
+    ):
+        self.targets = list(targets) if targets else [ProfilerTarget.CPU, ProfilerTarget.TPU]
+        if scheduler is None:
+            self._scheduler = _default_scheduler
+        elif isinstance(scheduler, tuple):
+            start, end = scheduler
+            self._scheduler = make_scheduler(closed=start, ready=0, record=end - start, repeat=1)
+        else:
+            self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready or export_chrome_tracing("./profiler_log")
+        self.timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._events = []
+        self._step_times = []
+        self._device_tracing = False
+        self._last_export = None
+        self._log_dir = "./profiler_log"
+
+    # -- lifecycle --
+    def start(self):
+        self._state = self._scheduler(self._step)
+        self._apply_state()
+        self._t_step = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._collect()
+            self._on_trace_ready(self)
+        self._stop_device_trace()
+        _recorder.enabled = False
+        self._state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        self._step_times.append(now - self._t_step)
+        self._t_step = now
+        prev = self._state
+        if prev == ProfilerState.RECORD_AND_RETURN:
+            self._collect()
+            self._on_trace_ready(self)
+        self._step += 1
+        self._state = self._scheduler(self._step)
+        self._apply_state()
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        last = self._step_times[-1]
+        return f"step {self._step}: {last*1000:.2f} ms/step"
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- internals --
+    def _apply_state(self):
+        recording = self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        _recorder.enabled = recording and not self.timer_only
+        if recording and not self.timer_only:
+            self._start_device_trace()
+        else:
+            self._stop_device_trace()
+
+    def _start_device_trace(self):
+        if self._device_tracing or ProfilerTarget.TPU not in self.targets:
+            return
+        try:
+            import jax
+
+            os.makedirs(self._log_dir, exist_ok=True)
+            jax.profiler.start_trace(self._log_dir)
+            self._device_tracing = True
+        except Exception:
+            self._device_tracing = False
+
+    def _stop_device_trace(self):
+        if not self._device_tracing:
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        self._device_tracing = False
+
+    def _collect(self):
+        self._events.extend(_recorder.drain())
+
+    # -- reporting --
+    def summary(self, sorted_by=None, op_detail: bool = True, thread_sep: bool = False, time_unit: str = "ms", views=None):
+        stats = {}
+        for e in self._events:
+            s = stats.setdefault(e["name"], {"calls": 0, "total": 0.0, "max": 0.0, "min": float("inf")})
+            d = e["dur"] / 1e3  # ms
+            s["calls"] += 1
+            s["total"] += d
+            s["max"] = max(s["max"], d)
+            s["min"] = min(s["min"], d)
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}{'Max(ms)':>12}{'Min(ms)':>12}"]
+        lines.append("-" * 96)
+        for name, s in sorted(stats.items(), key=lambda kv: -kv[1]["total"]):
+            lines.append(
+                f"{name[:39]:<40}{s['calls']:>8}{s['total']:>12.3f}{s['total']/s['calls']:>12.3f}"
+                f"{s['max']:>12.3f}{s['min']:>12.3f}"
+            )
+        if self._step_times:
+            import numpy as np
+
+            st = np.array(self._step_times[1:] or self._step_times)
+            lines.append("-" * 96)
+            lines.append(f"steps: {len(self._step_times)}  avg {st.mean()*1000:.3f} ms  p50 {np.percentile(st,50)*1000:.3f} ms")
+        table = "\n".join(lines)
+        print(table)
+        return stats

@@ -1,0 +1,71 @@
+"""profiler tests (SURVEY §5.1): scheduler state machine, RecordEvent spans,
+chrome-trace export, summary aggregation."""
+
+import glob
+import json
+import os
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler as profiler_mod
+from paddle_tpu.profiler import (
+    Profiler,
+    ProfilerState,
+    RecordEvent,
+    export_chrome_tracing,
+    make_scheduler,
+)
+
+
+def test_make_scheduler_states():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=2, skip_first=1)
+    states = [sched(i) for i in range(10)]
+    assert states[0] == ProfilerState.CLOSED  # skip_first
+    assert states[1] == ProfilerState.CLOSED
+    assert states[2] == ProfilerState.READY
+    assert states[3] == ProfilerState.RECORD
+    assert states[4] == ProfilerState.RECORD_AND_RETURN
+    assert states[5] == ProfilerState.CLOSED  # cycle 2
+    assert states[8] == ProfilerState.RECORD_AND_RETURN
+    assert states[9] == ProfilerState.CLOSED  # repeat exhausted
+
+
+def test_profiler_records_and_exports(tmp_path):
+    logdir = str(tmp_path / "trace")
+    p = Profiler(
+        targets=[profiler_mod.ProfilerTarget.CPU],  # no device trace on CPU tests
+        scheduler=(0, 3),
+        on_trace_ready=export_chrome_tracing(logdir),
+    )
+    p.start()
+    x = paddle.randn([16, 16])
+    for i in range(3):
+        with RecordEvent("forward"):
+            y = (x @ x).sum()
+        with RecordEvent("backward"):
+            _ = float(y.numpy())
+        p.step()
+    p.stop()
+    traces = glob.glob(os.path.join(logdir, "*.json"))
+    assert traces, "no chrome trace written"
+    data = json.load(open(traces[0]))
+    names = {e["name"] for e in data["traceEvents"]}
+    assert "forward" in names and "backward" in names
+
+
+def test_profiler_summary(capsys):
+    p = Profiler(targets=[profiler_mod.ProfilerTarget.CPU], scheduler=(0, 2), on_trace_ready=lambda prof: None)
+    p.start()
+    for _ in range(2):
+        with RecordEvent("op_x"):
+            pass
+        p.step()
+    p.stop()
+    stats = p.summary()
+    out = capsys.readouterr().out
+    assert "op_x" in stats and stats["op_x"]["calls"] == 2
+    assert "op_x" in out
+
+
+def test_record_event_outside_profiler_is_noop():
+    with RecordEvent("ignored"):
+        pass  # recorder disabled -> nothing accumulates
