@@ -1,0 +1,15 @@
+from . import datasets, models, ops, transforms  # noqa: F401
+
+__all__ = ["datasets", "models", "ops", "transforms", "set_image_backend", "get_image_backend"]
+
+_image_backend = "numpy"
+
+
+def set_image_backend(backend: str):
+    """Reference supports pil/cv2; this build is numpy-native (no PIL dep)."""
+    global _image_backend
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend

@@ -1,0 +1,64 @@
+"""Cifar10/100 (vision/datasets/cifar.py analog). Reads the standard python
+pickle batches from data_file when present; synthetic fallback otherwise
+(zero-egress environment — see mnist.py)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from typing import Optional
+
+import numpy as np
+
+from ...io import Dataset
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+    _TRAIN_MEMBERS = [f"data_batch_{i}" for i in range(1, 6)]
+    _TEST_MEMBERS = ["test_batch"]
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train", transform=None, download: bool = False, backend=None, n_synthetic: int = 256):
+        self.mode = mode
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            self.images, self.labels = self._load(data_file, mode)
+        else:
+            if download:
+                raise RuntimeError("downloads unavailable; pass data_file to a local cifar tar.gz")
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.labels = rng.randint(0, self.NUM_CLASSES, size=n_synthetic).astype(np.int64)
+            base = rng.rand(self.NUM_CLASSES, 32, 32, 3) * 128
+            self.images = np.stack(
+                [(base[l] + rng.rand(32, 32, 3) * 64).astype(np.uint8) for l in self.labels]
+            )
+
+    def _load(self, data_file, mode):
+        members = self._TRAIN_MEMBERS if mode == "train" else self._TEST_MEMBERS
+        images, labels = [], []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if os.path.basename(m.name) in members:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    images.append(d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+                    labels.extend(d.get(b"labels", d.get(b"fine_labels")))
+        return np.concatenate(images), np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = np.int64(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.transpose(2, 0, 1).astype(np.float32) / 255.0
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+    _TRAIN_MEMBERS = ["train"]
+    _TEST_MEMBERS = ["test"]

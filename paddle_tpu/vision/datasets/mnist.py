@@ -1,0 +1,88 @@
+"""MNIST/FashionMNIST datasets (vision/datasets/mnist.py analog).
+
+Zero-egress environment: no downloads. Reads the standard IDX files from
+`image_path`/`label_path` if given; otherwise generates a deterministic
+synthetic set (mode="synthetic") so examples/tests run hermetically — the
+same role as the reference's fake-data reader in test/book."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+from ...io import Dataset
+
+
+def _read_idx_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad magic {magic} in {path}"
+        return np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad magic {magic} in {path}"
+        return np.frombuffer(f.read(), np.uint8)
+
+
+class MNIST(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(
+        self,
+        image_path: Optional[str] = None,
+        label_path: Optional[str] = None,
+        mode: str = "train",
+        transform=None,
+        download: bool = False,
+        backend: Optional[str] = None,
+        n_synthetic: int = 256,
+    ):
+        self.mode = mode
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            self.images = _read_idx_images(image_path)
+            self.labels = _read_idx_labels(label_path)
+        else:
+            if download:
+                raise RuntimeError(
+                    "downloads are unavailable in this environment; pass image_path/label_path "
+                    "to local IDX files or use the synthetic fallback (download=False)"
+                )
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.labels = rng.randint(0, self.NUM_CLASSES, size=n_synthetic).astype(np.uint8)
+            # digits as deterministic blobs: class-dependent gaussian bumps
+            xs, ys = np.meshgrid(np.arange(28), np.arange(28))
+            self.images = np.stack(
+                [
+                    (
+                        np.exp(-((xs - 6 - 2 * (l % 5)) ** 2 + (ys - 6 - 2 * (l // 5)) ** 2) / 18.0) * 255
+                        + rng.rand(28, 28) * 32
+                    ).astype(np.uint8)
+                    for l in self.labels
+                ]
+            )
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = np.int64(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img[None].astype(np.float32) / 255.0
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass

@@ -1,0 +1,25 @@
+from .alexnet import AlexNet, alexnet
+from .lenet import LeNet
+from .mobilenetv2 import MobileNetV2, mobilenet_v2
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152, wide_resnet50_2
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+
+__all__ = [
+    "ResNet",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "resnet152",
+    "wide_resnet50_2",
+    "LeNet",
+    "VGG",
+    "vgg11",
+    "vgg13",
+    "vgg16",
+    "vgg19",
+    "MobileNetV2",
+    "mobilenet_v2",
+    "AlexNet",
+    "alexnet",
+]

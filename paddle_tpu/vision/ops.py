@@ -1,0 +1,152 @@
+"""Vision ops (python/paddle/vision/ops.py analog): nms, roi_align, roi_pool.
+
+nms is host-side numpy (dynamic output size — inherently untraceable, the
+reference runs it as a CPU/GPU kernel with dynamic shape too). roi_align is
+pure jnp bilinear gather — static shapes, jittable, MXU-adjacent work stays
+on device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _np(x):
+    return np.asarray(x._value) if isinstance(x, Tensor) else np.asarray(x)
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None, category_idxs=None, categories=None, top_k: int = None):
+    """Greedy hard-NMS. boxes [N,4] (x1,y1,x2,y2); returns kept indices
+    (descending score order), int64 Tensor."""
+    b = _np(boxes).astype(np.float32)
+    n = b.shape[0]
+    s = _np(scores).astype(np.float32) if scores is not None else np.arange(n, 0, -1, dtype=np.float32)
+
+    def _nms_single(idxs):
+        order = idxs[np.argsort(-s[idxs])]
+        keep = []
+        suppressed = np.zeros(n, bool)
+        areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        for i in order:
+            if suppressed[i]:
+                continue
+            keep.append(i)
+            xx1 = np.maximum(b[i, 0], b[order, 0])
+            yy1 = np.maximum(b[i, 1], b[order, 1])
+            xx2 = np.minimum(b[i, 2], b[order, 2])
+            yy2 = np.minimum(b[i, 3], b[order, 3])
+            inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+            iou = inter / np.maximum(areas[i] + areas[order] - inter, 1e-9)
+            suppressed[order[iou > iou_threshold]] = True
+            suppressed[i] = False
+        return np.asarray(keep, np.int64)
+
+    if category_idxs is None:
+        keep = _nms_single(np.arange(n))
+    else:
+        cats = _np(category_idxs)
+        kept = []
+        for c in categories if categories is not None else np.unique(cats):
+            idxs = np.nonzero(cats == c)[0]
+            if idxs.size:
+                kept.append(_nms_single(idxs))
+        keep = np.concatenate(kept) if kept else np.zeros(0, np.int64)
+        keep = keep[np.argsort(-s[keep])]
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (phi roi_align kernel analog): bilinear-sampled pooling.
+    x: [N,C,H,W]; boxes: [R,4]; boxes_num: [N] rois per image."""
+    import jax.numpy as jnp
+
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    bx = jnp.asarray(_np(boxes), jnp.float32)
+    bn = _np(boxes_num).astype(np.int64)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    N, C, H, W = xv.shape
+    batch_of_roi = np.repeat(np.arange(len(bn)), bn)
+
+    off = 0.5 if aligned else 0.0
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    def one_roi(feat, box):
+        x1, y1, x2, y2 = box[0] * spatial_scale - off, box[1] * spatial_scale - off, box[2] * spatial_scale - off, box[3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid: sr x sr points per bin
+        gy = y1 + (jnp.arange(ph)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr) * bin_h  # [ph, sr]
+        gx = x1 + (jnp.arange(pw)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr) * bin_w  # [pw, sr]
+        gy = gy.reshape(-1)  # [ph*sr]
+        gx = gx.reshape(-1)  # [pw*sr]
+
+        def bilinear(yy, xx):
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(yy, 0, H - 1) - y0
+            wx = jnp.clip(xx, 0, W - 1) - x0
+            y0i, x0i, y1i, x1i = y0.astype(int), x0.astype(int), y1_.astype(int), x1_.astype(int)
+            # feat: [C,H,W]; gather on the sample grid
+            v00 = feat[:, y0i[:, None], x0i[None, :]]
+            v01 = feat[:, y0i[:, None], x1i[None, :]]
+            v10 = feat[:, y1i[:, None], x0i[None, :]]
+            v11 = feat[:, y1i[:, None], x1i[None, :]]
+            wy_ = wy[:, None]
+            wx_ = wx[None, :]
+            return v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_ + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_
+
+        samples = bilinear(gy, gx)  # [C, ph*sr, pw*sr]
+        samples = samples.reshape(C, ph, sr, pw, sr)
+        return samples.mean(axis=(2, 4))  # [C, ph, pw]
+
+    outs = [one_roi(xv[batch_of_roi[r]], bx[r]) for r in range(bx.shape[0])]
+    res = jnp.stack(outs) if outs else jnp.zeros((0, C, ph, pw), xv.dtype)
+    return Tensor(res)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Max-pool RoI (quantized bins, the pre-Align op)."""
+    import jax.numpy as jnp
+
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    bx = _np(boxes).astype(np.float32)
+    bn = _np(boxes_num).astype(np.int64)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    N, C, H, W = xv.shape
+    batch_of_roi = np.repeat(np.arange(len(bn)), bn)
+    outs = []
+    for r in range(bx.shape[0]):
+        feat = xv[batch_of_roi[r]]
+        x1, y1, x2, y2 = np.round(bx[r] * spatial_scale).astype(int)
+        x2 = max(x2, x1 + 1)
+        y2 = max(y2, y1 + 1)
+        hh = np.linspace(y1, y2, ph + 1).astype(int)
+        ww = np.linspace(x1, x2, pw + 1).astype(int)
+        pooled = jnp.stack(
+            [
+                jnp.stack(
+                    [
+                        feat[:, hh[i] : max(hh[i + 1], hh[i] + 1), ww[j] : max(ww[j + 1], ww[j] + 1)].max(axis=(1, 2))
+                        for j in range(pw)
+                    ],
+                    axis=-1,
+                )
+                for i in range(ph)
+            ],
+            axis=-2,
+        )
+        outs.append(pooled)
+    res = jnp.stack(outs) if outs else jnp.zeros((0, C, ph, pw), xv.dtype)
+    return Tensor(res)

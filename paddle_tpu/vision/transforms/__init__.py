@@ -1,0 +1,29 @@
+from .transforms import (
+    BaseTransform,
+    CenterCrop,
+    Compose,
+    Normalize,
+    Pad,
+    RandomCrop,
+    RandomHorizontalFlip,
+    RandomVerticalFlip,
+    Resize,
+    ToTensor,
+    Transpose,
+)
+from . import functional  # noqa: F401
+
+__all__ = [
+    "BaseTransform",
+    "Compose",
+    "Resize",
+    "Normalize",
+    "ToTensor",
+    "Transpose",
+    "CenterCrop",
+    "RandomCrop",
+    "RandomHorizontalFlip",
+    "RandomVerticalFlip",
+    "Pad",
+    "functional",
+]

@@ -1,0 +1,101 @@
+"""Functional image transforms (vision/transforms/functional.py analog),
+numpy-native (HWC uint8/float arrays) — no PIL/cv2 dependency in this image.
+Resize uses jax.image for device-quality interpolation."""
+
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+
+def _hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def resize(img, size, interpolation="bilinear"):
+    import jax
+    import jax.numpy as jnp
+
+    img = _hwc(img)
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        # shorter edge -> size, keep aspect (reference semantics)
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic"}.get(interpolation, "linear")
+    out = jax.image.resize(jnp.asarray(img, jnp.float32), (oh, ow, img.shape[2]), method=method)
+    out = np.asarray(out)
+    if np.issubdtype(np.asarray(img).dtype, np.integer):
+        out = np.clip(np.rint(out), 0, 255).astype(np.uint8)
+    return out
+
+
+def center_crop(img, output_size):
+    img = _hwc(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = img.shape[:2]
+    th, tw = output_size
+    i = max(0, (h - th) // 2)
+    j = max(0, (w - tw) // 2)
+    return img[i : i + th, j : j + tw]
+
+
+def crop(img, top, left, height, width):
+    return _hwc(img)[top : top + height, left : left + width]
+
+
+def hflip(img):
+    return _hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _hwc(img)
+    if isinstance(padding, numbers.Number):
+        padding = (padding,) * 4
+    if len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    left, top, right, bottom = padding
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kwargs = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(img, ((top, bottom), (left, right), (0, 0)), mode=mode, **kwargs)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    from ...core.tensor import Tensor
+
+    was_tensor = isinstance(img, Tensor)
+    arr = np.asarray(img._value if was_tensor else img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        shape = (-1, 1, 1)
+    else:
+        shape = (1, 1, -1)
+    out = (arr - mean.reshape(shape)) / std.reshape(shape)
+    return Tensor(out) if was_tensor else out
+
+
+def to_tensor(img, data_format="CHW"):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] paddle Tensor."""
+    from ...core.tensor import Tensor
+
+    img = _hwc(img)
+    arr = np.asarray(img, np.float32)
+    if np.issubdtype(np.asarray(img).dtype, np.integer):
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(arr)

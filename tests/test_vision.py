@@ -1,0 +1,116 @@
+"""vision tests: transforms numerics, dataset contract, model forward/train
+shapes, nms/roi_align vs hand-computed references."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import datasets, models, ops, transforms
+
+
+def test_transforms_pipeline():
+    img = (np.random.RandomState(0).rand(32, 48, 3) * 255).astype(np.uint8)
+    t = transforms.Compose(
+        [
+            transforms.Resize(40),  # shorter edge
+            transforms.CenterCrop(36),
+            transforms.RandomHorizontalFlip(prob=0.0),
+            transforms.ToTensor(),
+            transforms.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5]),
+        ]
+    )
+    out = t(img)
+    assert list(out.shape) == [3, 36, 36]
+    arr = out.numpy()
+    assert arr.min() >= -1.01 and arr.max() <= 1.01
+
+
+def test_transform_functional_resize_aspect():
+    from paddle_tpu.vision.transforms import functional as F
+
+    img = np.zeros((20, 40, 3), np.uint8)
+    out = F.resize(img, 10)
+    assert out.shape[:2] == (10, 20)  # shorter edge 10, aspect kept
+
+
+def test_mnist_dataset_synthetic():
+    ds = datasets.MNIST(mode="train", n_synthetic=32)
+    assert len(ds) == 32
+    img, label = ds[0]
+    assert img.shape == (1, 28, 28) and 0 <= int(label) < 10
+    with pytest.raises(RuntimeError):
+        datasets.MNIST(download=True)
+
+
+def test_cifar_dataset_synthetic():
+    ds = datasets.Cifar10(mode="test", n_synthetic=16)
+    img, label = ds[3]
+    assert img.shape == (3, 32, 32)
+
+
+@pytest.mark.parametrize(
+    "ctor,num_out",
+    [
+        (lambda: models.resnet18(num_classes=10), 10),
+        (lambda: models.LeNet(num_classes=10), 10),
+        (lambda: models.mobilenet_v2(num_classes=7), 7),
+    ],
+)
+def test_model_forward_shapes(ctor, num_out):
+    paddle.seed(0)
+    m = ctor()
+    size = 28 if isinstance(m, models.LeNet) else 64
+    ch = 1 if isinstance(m, models.LeNet) else 3
+    x = paddle.randn([2, ch, size, size])
+    y = m(x)
+    assert list(y.shape) == [2, num_out]
+
+
+def test_resnet_trains_one_step():
+    paddle.seed(0)
+    m = models.resnet18(num_classes=4)
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+    x = paddle.randn([2, 3, 32, 32])
+    y = paddle.to_tensor(np.array([1, 3]))
+    loss = paddle.nn.CrossEntropyLoss()(m(x), y)
+    loss.backward()
+    opt.step()
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_nms():
+    boxes = np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30], [21, 21, 29, 29]],
+        np.float32,
+    )
+    scores = np.array([0.9, 0.8, 0.7, 0.95], np.float32)
+    keep = ops.nms(paddle.to_tensor(boxes), iou_threshold=0.5, scores=paddle.to_tensor(scores))
+    kept = keep.numpy().tolist()
+    assert 3 in kept and 0 in kept  # highest scorers of each cluster
+    assert 1 not in kept  # suppressed by box 0
+
+
+def test_nms_categories():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    cats = np.array([0, 1])
+    keep = ops.nms(
+        paddle.to_tensor(boxes), iou_threshold=0.5, scores=paddle.to_tensor(scores), category_idxs=paddle.to_tensor(cats), categories=[0, 1]
+    )
+    assert len(keep.numpy()) == 2  # different categories: both survive
+
+
+def test_roi_align_uniform_feature():
+    # constant feature map -> every pooled value equals that constant
+    x = paddle.ones([1, 2, 16, 16])
+    boxes = paddle.to_tensor(np.array([[2, 2, 10, 10]], np.float32))
+    out = ops.roi_align(x, boxes, paddle.to_tensor(np.array([1])), output_size=4)
+    assert list(out.shape) == [1, 2, 4, 4]
+    np.testing.assert_allclose(out.numpy(), 1.0, rtol=1e-5)
+
+
+def test_roi_pool_shape():
+    x = paddle.randn([1, 3, 16, 16])
+    boxes = paddle.to_tensor(np.array([[0, 0, 8, 8], [4, 4, 12, 12]], np.float32))
+    out = ops.roi_pool(x, boxes, paddle.to_tensor(np.array([2])), output_size=2)
+    assert list(out.shape) == [2, 3, 2, 2]
