@@ -1,3 +1,13 @@
 """Model zoo: flagship training fixtures (PaddleNLP / test-fixture analogs)."""
 
 from .gpt import GPT3_1p3B, GPT_TINY, GPTConfig, GPTForCausalLM, GPTModel, gpt_tiny  # noqa: F401
+from .bert import (  # noqa: F401
+    BERT_BASE,
+    BERT_TINY,
+    BertConfig,
+    BertForMaskedLM,
+    BertForSequenceClassification,
+    BertModel,
+    bert_base,
+    bert_tiny,
+)

@@ -1,0 +1,211 @@
+"""BERT family (BASELINE config 1: BERT-base SST-2 fine-tune; the PaddleNLP
+bert modeling surface re-built TPU-native).
+
+Same TP-aware layer composition as gpt.py: Column/RowParallelLinear +
+VocabParallelEmbedding so one definition runs single-chip or sharded under a
+mesh (GSPMD inserts the collectives). Bidirectional attention (is_causal
+False) via the flash kernel; post-LN residuals per the original BERT."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..distributed.fleet.meta_parallel.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..distributed.sharding_utils import maybe_shard
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = None
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    num_labels: int = 2
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+        if self.hidden_size % self.num_heads:
+            raise ValueError("hidden_size must divide num_heads")
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+BERT_BASE = dict(vocab_size=30522, hidden_size=768, num_layers=12, num_heads=12)
+BERT_TINY = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4, max_position_embeddings=64)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        import paddle_tpu as paddle
+
+        if position_ids is None:
+            position_ids = paddle.arange(input_ids.shape[1]).unsqueeze(0)
+        h = self.word_embeddings(input_ids) + self.position_embeddings(position_ids)
+        if token_type_ids is not None:
+            h = h + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(h))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.qkv = ColumnParallelLinear(cfg.hidden_size, 3 * cfg.hidden_size, gather_output=False)
+        self.proj = RowParallelLinear(cfg.hidden_size, cfg.hidden_size, input_is_parallel=True)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, attn_mask=None):
+        B, S = x.shape[0], x.shape[1]
+        cfg = self.cfg
+        qkv = self.qkv(x).reshape([B, S, 3, cfg.num_heads, cfg.head_dim])
+        qkv = maybe_shard(qkv, P("dp", None, None, "mp", None))
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=cfg.attention_dropout, is_causal=False, training=self.training
+        )
+        out = out.reshape([B, S, cfg.hidden_size])
+        return self.dropout(self.proj(out))
+
+
+class BertLayer(Layer):
+    """Post-LN transformer block (original BERT residual order)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attn = BertSelfAttention(cfg)
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.fc1 = ColumnParallelLinear(cfg.hidden_size, cfg.intermediate_size, gather_output=False)
+        self.fc2 = RowParallelLinear(cfg.intermediate_size, cfg.hidden_size, input_is_parallel=True)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, attn_mask=None):
+        x = maybe_shard(x, P("dp", None, None))
+        x = self.ln1(x + self.attn(x, attn_mask))
+        h = self.fc2(F.gelu(self.fc1(x), approximate=True))
+        return self.ln2(x + self.dropout(h))
+
+
+class BertPooler(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, hidden):
+        return F.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.layers = nn.LayerList([BertLayer(cfg) for _ in range(cfg.num_layers)])
+        self.pooler = BertPooler(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, position_ids=None):
+        if attention_mask is not None and len(attention_mask.shape) == 2:
+            # [B, S] padding mask -> additive-compatible bool [B, 1, 1, S]
+            attention_mask = attention_mask.astype("bool").unsqueeze(1).unsqueeze(1)
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.layers:
+            h = layer(h, attention_mask)
+        return h, self.pooler(h)
+
+
+class BertForSequenceClassification(Layer):
+    """The SST-2 fine-tune head (BASELINE config 1)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, cfg.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+    def loss(self, logits, labels):
+        return F.cross_entropy(logits, labels)
+
+
+class BertLMHead(Layer):
+    def __init__(self, cfg: BertConfig, word_embeddings):
+        super().__init__()
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self._tied = word_embeddings  # weight tying with the input embedding
+
+    def forward(self, h):
+        h = self.layer_norm(F.gelu(self.transform(h), approximate=True))
+        return h.matmul(self._tied.weight, transpose_y=True)
+
+
+class BertForMaskedLM(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = BertModel(cfg)
+        self.lm_head = BertLMHead(cfg, self.bert.embeddings.word_embeddings)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.lm_head(h)
+
+    def loss(self, logits, labels, ignore_index: int = -100):
+        """Masked-LM loss: positions with label == ignore_index contribute 0."""
+        import jax.numpy as jnp
+
+        from ..ops._dispatch import apply
+
+        def f(lg, lb):
+            V = lg.shape[-1]
+            lg2 = lg.reshape(-1, V).astype(jnp.float32)
+            lb2 = lb.reshape(-1)
+            valid = lb2 != ignore_index
+            lb_safe = jnp.where(valid, lb2, 0)
+            logp = jax.nn.log_softmax(lg2, axis=-1)
+            nll = -jnp.take_along_axis(logp, lb_safe[:, None], axis=-1)[:, 0]
+            nll = jnp.where(valid, nll, 0.0)
+            return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+        import jax
+
+        return apply("masked_lm_loss", f, logits, labels)
+
+
+def bert_base(**overrides) -> BertForSequenceClassification:
+    return BertForSequenceClassification(BertConfig(**{**BERT_BASE, **overrides}))
+
+
+def bert_tiny(**overrides) -> BertForSequenceClassification:
+    return BertForSequenceClassification(BertConfig(**{**BERT_TINY, **overrides}))
