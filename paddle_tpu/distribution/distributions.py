@@ -1,0 +1,458 @@
+"""paddle.distribution (python/paddle/distribution/ analog): the reference's
+probability-distribution API over jax.random draws and jnp math. Sampling
+routes through the framework PRNG (core.random.next_key) so it is
+reproducible under paddle.seed and traceable under jit."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+from ..core.tensor import Tensor
+
+
+def _raw(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jnp.ndarray) else x
+
+
+def _wrap(v):
+    return Tensor(v)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(_raw(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _extend(self, shape):
+        return tuple(shape) + self._batch_shape
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _raw(loc).astype(jnp.float32)
+        self.scale = _raw(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale**2, self._batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(self.scale, self._batch_shape))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(_random.next_key(), self._extend(shape), jnp.float32)
+        return _wrap(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _raw(value)
+        var = self.scale**2
+        return _wrap(-((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale), self._batch_shape))
+
+    def cdf(self, value):
+        return _wrap(0.5 * (1 + jax.scipy.special.erf((_raw(value) - self.loc) / (self.scale * math.sqrt(2)))))
+
+    def icdf(self, value):
+        return _wrap(self.loc + self.scale * math.sqrt(2) * jax.scipy.special.erfinv(2 * _raw(value) - 1))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _raw(low).astype(jnp.float32)
+        self.high = _raw(high).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to((self.low + self.high) / 2, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to((self.high - self.low) ** 2 / 12, self._batch_shape))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_random.next_key(), self._extend(shape), jnp.float32)
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _raw(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.high - self.low), self._batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("give exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _raw(probs).astype(jnp.float32)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _raw(logits).astype(jnp.float32)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_random.next_key(), self._extend(shape))
+        return _wrap((u < self.probs).astype(jnp.float32))
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax relaxation (reparameterized)."""
+        u = jax.random.uniform(_random.next_key(), self._extend(shape), minval=1e-6, maxval=1 - 1e-6)
+        g = jnp.log(u) - jnp.log1p(-u)
+        return _wrap(jax.nn.sigmoid((self.logits + g) / temperature))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return _wrap(v * jax.nn.log_sigmoid(self.logits) + (1 - v) * jax.nn.log_sigmoid(-self.logits))
+
+    def entropy(self):
+        p = self.probs
+        return _wrap(-(p * jnp.log(jnp.clip(p, 1e-12)) + (1 - p) * jnp.log(jnp.clip(1 - p, 1e-12))))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("give logits or probs")
+        if logits is not None:
+            self.logits = _raw(logits).astype(jnp.float32)
+        else:
+            self.logits = jnp.log(jnp.clip(_raw(probs).astype(jnp.float32), 1e-30))
+        self.probs = jax.nn.softmax(self.logits, axis=-1)
+        super().__init__(self.probs.shape[:-1], (self.probs.shape[-1],))
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(_random.next_key(), self.logits, shape=tuple(shape) + self._batch_shape)
+        return _wrap(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        lp = jax.nn.log_softmax(self.logits, axis=-1)
+        idx = _raw(value).astype(jnp.int32)
+        return _wrap(jnp.take_along_axis(lp, idx[..., None], axis=-1)[..., 0])
+
+    def probabilities(self):
+        return _wrap(self.probs)
+
+    def entropy(self):
+        lp = jax.nn.log_softmax(self.logits, axis=-1)
+        return _wrap(-(self.probs * lp).sum(-1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count: int, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _raw(probs).astype(jnp.float32)
+        self.probs = self.probs / self.probs.sum(-1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], (self.probs.shape[-1],))
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.clip(self.probs, 1e-30))
+        draws = jax.random.categorical(
+            _random.next_key(), logits, shape=(self.total_count,) + tuple(shape) + self._batch_shape
+        )
+        K = self.probs.shape[-1]
+        counts = jax.nn.one_hot(draws, K).sum(axis=0)
+        return _wrap(counts)
+
+    def log_prob(self, value):
+        v = _raw(value)
+        lgamma = jax.scipy.special.gammaln
+        logp = jnp.log(jnp.clip(self.probs, 1e-30))
+        return _wrap(lgamma(v.sum(-1) + 1) - lgamma(v + 1).sum(-1) + (v * logp).sum(-1))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _raw(loc).astype(jnp.float32)
+        self.scale = _raw(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(2 * self.scale**2, self._batch_shape))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_random.next_key(), self._extend(shape), minval=-0.5 + 1e-7, maxval=0.5 - 1e-7)
+        return _wrap(self.loc - self.scale * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u)))
+
+    def log_prob(self, value):
+        return _wrap(-jnp.abs(_raw(value) - self.loc) / self.scale - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(1 + jnp.log(2 * self.scale), self._batch_shape))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _raw(loc).astype(jnp.float32)
+        self.scale = _raw(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    _euler = 0.5772156649015329
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc + self.scale * self._euler, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to((math.pi**2 / 6) * self.scale**2, self._batch_shape))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        g = jax.random.gumbel(_random.next_key(), self._extend(shape))
+        return _wrap(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        z = (_raw(value) - self.loc) / self.scale
+        return _wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.scale) + 1 + self._euler, self._batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.base = Normal(loc, scale)
+        super().__init__(self.base._batch_shape)
+
+    @property
+    def mean(self):
+        return _wrap(jnp.exp(self.base.loc + self.base.scale**2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.base.scale**2
+        return _wrap((jnp.exp(s2) - 1) * jnp.exp(2 * self.base.loc + s2))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        return _wrap(jnp.exp(_raw(self.base.rsample(shape))))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return _wrap(_raw(self.base.log_prob(jnp.log(v))) - jnp.log(v))
+
+    def entropy(self):
+        return _wrap(_raw(self.base.entropy()) + self.base.loc)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _raw(alpha).astype(jnp.float32)
+        self.beta = _raw(beta).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _wrap(self.alpha * self.beta / (s**2 * (s + 1)))
+
+    def sample(self, shape=()):
+        return _wrap(jax.random.beta(_random.next_key(), self.alpha, self.beta, self._extend(shape)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _raw(value)
+        betaln = jax.scipy.special.betaln(self.alpha, self.beta)
+        return _wrap((self.alpha - 1) * jnp.log(v) + (self.beta - 1) * jnp.log1p(-v) - betaln)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        return _wrap(jax.scipy.special.betaln(a, b) - (a - 1) * dg(a) - (b - 1) * dg(b) + (a + b - 2) * dg(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _raw(concentration).astype(jnp.float32)
+        super().__init__(self.concentration.shape[:-1], (self.concentration.shape[-1],))
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / self.concentration.sum(-1, keepdims=True))
+
+    def sample(self, shape=()):
+        return _wrap(jax.random.dirichlet(_random.next_key(), self.concentration, tuple(shape) + self._batch_shape))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _raw(value)
+        a = self.concentration
+        lgamma = jax.scipy.special.gammaln
+        return _wrap(((a - 1) * jnp.log(v)).sum(-1) + lgamma(a.sum(-1)) - lgamma(a).sum(-1))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _raw(probs).astype(jnp.float32)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _wrap((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return _wrap((1 - self.probs) / self.probs**2)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_random.next_key(), self._extend(shape), minval=1e-7, maxval=1 - 1e-7)
+        return _wrap(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return _wrap(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+    def entropy(self):
+        p = self.probs
+        return _wrap(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+
+# ---------------- KL registry (distribution/kl.py analog) ----------------
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(type_p: Type, type_q: Type):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return _wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return _wrap(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    pr, qr = p.probs, q.probs
+    t1 = pr * (jnp.log(jnp.clip(pr, 1e-12)) - jnp.log(jnp.clip(qr, 1e-12)))
+    t2 = (1 - pr) * (jnp.log(jnp.clip(1 - pr, 1e-12)) - jnp.log(jnp.clip(1 - qr, 1e-12)))
+    return _wrap(t1 + t2)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    lp = jax.nn.log_softmax(p.logits, -1)
+    lq = jax.nn.log_softmax(q.logits, -1)
+    return _wrap((jnp.exp(lp) * (lp - lq)).sum(-1))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    loc_abs = jnp.abs(p.loc - q.loc) / q.scale
+    return _wrap(-jnp.log(scale_ratio) + scale_ratio * jnp.exp(-loc_abs / scale_ratio) + loc_abs - 1)

@@ -1,0 +1,104 @@
+"""distribution tests: sampling moments, log_prob vs closed form, KL registry
+(distribution/ analog, checked against scipy where available)."""
+
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (
+    Bernoulli,
+    Beta,
+    Categorical,
+    Dirichlet,
+    Geometric,
+    Gumbel,
+    Laplace,
+    LogNormal,
+    Multinomial,
+    Normal,
+    Uniform,
+    kl_divergence,
+)
+
+
+def test_normal_logprob_entropy_cdf():
+    d = Normal(1.0, 2.0)
+    x = np.array([0.0, 1.0, 3.0], np.float32)
+    np.testing.assert_allclose(d.log_prob(x).numpy(), st.norm(1, 2).logpdf(x), rtol=1e-5)
+    np.testing.assert_allclose(float(d.entropy().numpy()), st.norm(1, 2).entropy(), rtol=1e-5)
+    np.testing.assert_allclose(d.cdf(x).numpy(), st.norm(1, 2).cdf(x), rtol=1e-5, atol=1e-6)
+
+
+def test_normal_sampling_moments():
+    paddle.seed(0)
+    d = Normal(np.float32(-2.0), np.float32(0.5))
+    s = d.sample([20000]).numpy()
+    assert abs(s.mean() + 2.0) < 0.02
+    assert abs(s.std() - 0.5) < 0.02
+
+
+def test_uniform():
+    d = Uniform(-1.0, 3.0)
+    np.testing.assert_allclose(float(d.mean.numpy()), 1.0)
+    x = np.array([-2.0, 0.0], np.float32)
+    lp = d.log_prob(x).numpy()
+    assert lp[0] == -np.inf and np.isclose(lp[1], -np.log(4))
+    paddle.seed(1)
+    s = d.sample([10000]).numpy()
+    assert s.min() >= -1 and s.max() < 3
+
+
+def test_bernoulli_categorical():
+    b = Bernoulli(probs=np.array([0.3], np.float32))
+    np.testing.assert_allclose(b.log_prob(np.array([1.0], np.float32)).numpy(), np.log(0.3), rtol=1e-5)
+    c = Categorical(logits=np.log(np.array([[0.2, 0.8]], np.float32)))
+    np.testing.assert_allclose(c.log_prob(np.array([1])).numpy(), np.log(0.8), rtol=1e-5)
+    paddle.seed(0)
+    s = c.sample([5000]).numpy()
+    assert abs(s.mean() - 0.8) < 0.03
+    np.testing.assert_allclose(float(c.entropy().numpy()), st.entropy([0.2, 0.8]), rtol=1e-4)
+
+
+def test_multinomial():
+    m = Multinomial(10, np.array([0.5, 0.5], np.float32))
+    v = np.array([4.0, 6.0], np.float32)
+    np.testing.assert_allclose(m.log_prob(v).numpy(), st.multinomial(10, [0.5, 0.5]).logpmf(v), rtol=1e-4)
+    paddle.seed(0)
+    s = m.sample([200]).numpy()
+    assert s.shape == (200, 2) and np.all(s.sum(-1) == 10)
+
+
+def test_laplace_gumbel_lognormal_beta():
+    np.testing.assert_allclose(
+        Laplace(0.0, 1.0).log_prob(np.float32(0.5)).numpy(), st.laplace.logpdf(0.5), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        Gumbel(0.0, 1.0).log_prob(np.float32(0.5)).numpy(), st.gumbel_r.logpdf(0.5), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        LogNormal(0.0, 1.0).log_prob(np.float32(2.0)).numpy(), st.lognorm(1.0).logpdf(2.0), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        Beta(2.0, 3.0).log_prob(np.float32(0.4)).numpy(), st.beta(2, 3).logpdf(0.4), rtol=1e-5
+    )
+
+
+def test_dirichlet_geometric():
+    d = Dirichlet(np.array([2.0, 3.0, 4.0], np.float32))
+    x = np.array([0.2, 0.3, 0.5], np.float32)
+    np.testing.assert_allclose(d.log_prob(x).numpy(), st.dirichlet([2, 3, 4]).logpdf(x), rtol=1e-4)
+    g = Geometric(np.float32(0.25))
+    np.testing.assert_allclose(g.log_prob(np.float32(3)).numpy(), st.geom(0.25, loc=-1).logpmf(3), rtol=1e-5)
+    np.testing.assert_allclose(float(g.mean.numpy()), 3.0, rtol=1e-6)
+
+
+def test_kl_divergence():
+    p, q = Normal(0.0, 1.0), Normal(1.0, 2.0)
+    expect = np.log(2) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(float(kl_divergence(p, q).numpy()), expect, rtol=1e-5)
+    b1, b2 = Bernoulli(probs=np.float32(0.3)), Bernoulli(probs=np.float32(0.6))
+    expect_b = 0.3 * np.log(0.3 / 0.6) + 0.7 * np.log(0.7 / 0.4)
+    np.testing.assert_allclose(float(kl_divergence(b1, b2).numpy()), expect_b, rtol=1e-4)
+    with pytest.raises(NotImplementedError):
+        kl_divergence(p, b1)
