@@ -1,0 +1,52 @@
+// Bounded MPMC blocking queue — the LoDTensorBlockingQueue analog
+// (fluid/operators/reader/blocking_queue.h): producers block when full,
+// consumers block when empty, Close() wakes everyone for shutdown.
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+
+template <typename T>
+class BlockingQueue {
+ public:
+  explicit BlockingQueue(size_t capacity) : capacity_(capacity), closed_(false) {}
+
+  bool Push(T&& item) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [&] { return queue_.size() < capacity_ || closed_; });
+    if (closed_) return false;
+    queue_.push_back(std::move(item));
+    not_empty_.notify_one();
+    return true;
+  }
+
+  bool Pop(T* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&] { return !queue_.empty() || closed_; });
+    if (queue_.empty()) return false;  // closed and drained
+    *out = std::move(queue_.front());
+    queue_.pop_front();
+    not_full_.notify_one();
+    return true;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    not_full_.notify_all();
+    not_empty_.notify_all();
+  }
+
+  size_t Size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return queue_.size();
+  }
+
+ private:
+  size_t capacity_;
+  bool closed_;
+  std::deque<T> queue_;
+  std::mutex mu_;
+  std::condition_variable not_full_, not_empty_;
+};

@@ -1,0 +1,163 @@
+// Native checkpoint tensor I/O (the C++ serialization behind paddle.save —
+// framework/io tensor payloads, SURVEY §5.4 / §7 "checkpoint tensor I/O").
+//
+// Format (PTCK v1, little-endian):
+//   magic "PTCK" | u32 version | u64 count
+//   per tensor: u32 name_len | name | i32 dtype_code | i32 ndim |
+//               i64 shape[ndim] | u64 nbytes | raw data | u64 fnv1a(data)
+//
+// Writes stream through a 1 MiB buffered FILE*; reads mmap the file and
+// memcpy straight into caller buffers (zero intermediate copies).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[4] = {'P', 'T', 'C', 'K'};
+constexpr uint32_t kVersion = 1;
+
+uint64_t Fnv1a(const uint8_t* data, uint64_t n) {
+  uint64_t h = 1469598103934665603ull;
+  for (uint64_t i = 0; i < n; ++i) {
+    h ^= data[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+struct TensorMeta {
+  std::string name;
+  int32_t dtype;
+  std::vector<int64_t> shape;
+  uint64_t nbytes;
+  const uint8_t* data;  // into the mmap
+};
+
+struct Reader {
+  void* map_addr = nullptr;
+  size_t map_len = 0;
+  std::vector<TensorMeta> tensors;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- writing ----
+void* ckpt_writer_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  setvbuf(f, nullptr, _IOFBF, 1 << 20);
+  fwrite(kMagic, 1, 4, f);
+  fwrite(&kVersion, 4, 1, f);
+  uint64_t count = 0;  // patched on close
+  fwrite(&count, 8, 1, f);
+  return f;
+}
+
+int ckpt_writer_add(void* handle, const char* name, int32_t dtype,
+                    const int64_t* shape, int32_t ndim, const uint8_t* data,
+                    uint64_t nbytes) {
+  FILE* f = static_cast<FILE*>(handle);
+  uint32_t name_len = static_cast<uint32_t>(strlen(name));
+  if (fwrite(&name_len, 4, 1, f) != 1) return -1;
+  fwrite(name, 1, name_len, f);
+  fwrite(&dtype, 4, 1, f);
+  fwrite(&ndim, 4, 1, f);
+  fwrite(shape, 8, ndim, f);
+  fwrite(&nbytes, 8, 1, f);
+  if (nbytes && fwrite(data, 1, nbytes, f) != nbytes) return -1;
+  uint64_t checksum = Fnv1a(data, nbytes);
+  fwrite(&checksum, 8, 1, f);
+  return 0;
+}
+
+int ckpt_writer_close(void* handle, uint64_t count) {
+  FILE* f = static_cast<FILE*>(handle);
+  if (fseek(f, 8, SEEK_SET) != 0) { fclose(f); return -1; }
+  fwrite(&count, 8, 1, f);
+  return fclose(f);
+}
+
+// ---- reading ----
+void* ckpt_open(const char* path) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 16) { close(fd); return nullptr; }
+  void* addr = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (addr == MAP_FAILED) return nullptr;
+  const uint8_t* p = static_cast<const uint8_t*>(addr);
+  const uint8_t* end = p + st.st_size;
+  if (memcmp(p, kMagic, 4) != 0) { munmap(addr, st.st_size); return nullptr; }
+  uint32_t version;
+  memcpy(&version, p + 4, 4);
+  uint64_t count;
+  memcpy(&count, p + 8, 8);
+  p += 16;
+
+  auto* r = new Reader();
+  r->map_addr = addr;
+  r->map_len = st.st_size;
+  for (uint64_t i = 0; i < count && p < end; ++i) {
+    TensorMeta m;
+    uint32_t name_len;
+    memcpy(&name_len, p, 4); p += 4;
+    m.name.assign(reinterpret_cast<const char*>(p), name_len); p += name_len;
+    memcpy(&m.dtype, p, 4); p += 4;
+    int32_t ndim;
+    memcpy(&ndim, p, 4); p += 4;
+    m.shape.resize(ndim);
+    memcpy(m.shape.data(), p, 8 * ndim); p += 8 * ndim;
+    memcpy(&m.nbytes, p, 8); p += 8;
+    m.data = p; p += m.nbytes;
+    uint64_t checksum;
+    memcpy(&checksum, p, 8); p += 8;
+    if (checksum != Fnv1a(m.data, m.nbytes)) { delete r; munmap(addr, st.st_size); return nullptr; }
+    r->tensors.push_back(std::move(m));
+  }
+  return r;
+}
+
+int64_t ckpt_count(void* handle) {
+  return static_cast<int64_t>(static_cast<Reader*>(handle)->tensors.size());
+}
+
+// name_buf must hold >= 256 bytes; shape_buf >= 16 dims.
+int ckpt_meta(void* handle, int64_t idx, char* name_buf, int32_t* dtype,
+              int32_t* ndim, int64_t* shape_buf, uint64_t* nbytes) {
+  auto* r = static_cast<Reader*>(handle);
+  if (idx < 0 || idx >= static_cast<int64_t>(r->tensors.size())) return -1;
+  const auto& m = r->tensors[idx];
+  snprintf(name_buf, 256, "%s", m.name.c_str());
+  *dtype = m.dtype;
+  *ndim = static_cast<int32_t>(m.shape.size());
+  memcpy(shape_buf, m.shape.data(), 8 * m.shape.size());
+  *nbytes = m.nbytes;
+  return 0;
+}
+
+int ckpt_read(void* handle, int64_t idx, uint8_t* out) {
+  auto* r = static_cast<Reader*>(handle);
+  if (idx < 0 || idx >= static_cast<int64_t>(r->tensors.size())) return -1;
+  const auto& m = r->tensors[idx];
+  memcpy(out, m.data, m.nbytes);
+  return 0;
+}
+
+void ckpt_close(void* handle) {
+  auto* r = static_cast<Reader*>(handle);
+  munmap(r->map_addr, r->map_len);
+  delete r;
+}
+
+}  // extern "C"

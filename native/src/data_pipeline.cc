@@ -1,0 +1,179 @@
+// Native host data pipeline (DataFeed/DataLoader worker analog, SURVEY §2.5
+// "Data pipeline (native)" + §7 hard-part (5): C++ prefetcher so the TPU
+// doesn't starve on host batching).
+//
+// Model: the dataset is a memory-mapped file of fixed-size records (or an
+// in-memory buffer copied once). Worker threads assemble shuffled batches
+// into contiguous buffers and push them through a bounded BlockingQueue;
+// the Python side pops with the GIL released (ctypes) and wraps the buffer
+// in numpy. Exposed as a plain C ABI for ctypes binding — no pybind11 in
+// this environment.
+
+#include <atomic>
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <memory>
+#include <random>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+#include "blocking_queue.h"
+
+namespace {
+
+struct Batch {
+  std::unique_ptr<uint8_t[]> data;
+  int64_t n;        // records in this batch
+  int64_t epoch;    // which epoch produced it
+};
+
+struct Pipeline {
+  // dataset
+  const uint8_t* base = nullptr;   // mmap or owned copy
+  std::unique_ptr<uint8_t[]> owned;
+  void* map_addr = nullptr;
+  size_t map_len = 0;
+  int64_t record_bytes = 0;
+  int64_t n_records = 0;
+  // batching
+  int64_t batch_size = 0;
+  bool shuffle = false;
+  bool drop_last = true;
+  uint64_t seed = 0;
+  int64_t epochs = -1;  // -1 = infinite
+  // runtime
+  std::unique_ptr<BlockingQueue<Batch>> queue;
+  std::vector<std::thread> workers;
+  std::atomic<int64_t> next_chunk{0};
+  std::atomic<bool> stop{false};
+  // producer bookkeeping: one producer thread builds order; workers gather
+  std::thread producer;
+};
+
+// Worker-parallel gather: the producer shards each epoch's shuffled index
+// list into batch-sized chunks; `n_workers` gatherers copy records into
+// batch buffers concurrently (memcpy-bound, scales with memory channels).
+void ProducerLoop(Pipeline* p, int n_workers) {
+  std::mt19937_64 rng(p->seed);
+  std::vector<int64_t> order(p->n_records);
+  for (int64_t i = 0; i < p->n_records; ++i) order[i] = i;
+
+  int64_t n_batches = p->drop_last ? p->n_records / p->batch_size
+                                   : (p->n_records + p->batch_size - 1) / p->batch_size;
+  for (int64_t epoch = 0; p->epochs < 0 || epoch < p->epochs; ++epoch) {
+    if (p->stop.load()) break;
+    if (p->shuffle) std::shuffle(order.begin(), order.end(), rng);
+
+    std::atomic<int64_t> batch_idx{0};
+    auto gather = [&]() {
+      for (;;) {
+        int64_t b = batch_idx.fetch_add(1);
+        if (b >= n_batches || p->stop.load()) return;
+        int64_t start = b * p->batch_size;
+        int64_t n = std::min(p->batch_size, p->n_records - start);
+        Batch batch;
+        batch.n = n;
+        batch.epoch = epoch;
+        batch.data.reset(new uint8_t[n * p->record_bytes]);
+        for (int64_t i = 0; i < n; ++i) {
+          std::memcpy(batch.data.get() + i * p->record_bytes,
+                      p->base + order[start + i] * p->record_bytes,
+                      p->record_bytes);
+        }
+        if (!p->queue->Push(std::move(batch))) return;  // closed
+      }
+    };
+    std::vector<std::thread> gatherers;
+    for (int w = 0; w < n_workers; ++w) gatherers.emplace_back(gather);
+    for (auto& t : gatherers) t.join();
+    if (p->stop.load()) break;
+    // epoch barrier marker: zero-record batch
+    Batch marker;
+    marker.n = 0;
+    marker.epoch = epoch;
+    if (!p->queue->Push(std::move(marker))) break;
+  }
+  p->queue->Close();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create from an in-memory buffer (copied once — Python may free its copy).
+void* dp_create(const uint8_t* data, int64_t n_records, int64_t record_bytes,
+                int64_t batch_size, int shuffle, int drop_last, uint64_t seed,
+                int64_t epochs, int n_workers, int64_t queue_capacity) {
+  auto* p = new Pipeline();
+  p->owned.reset(new uint8_t[n_records * record_bytes]);
+  std::memcpy(p->owned.get(), data, n_records * record_bytes);
+  p->base = p->owned.get();
+  p->record_bytes = record_bytes;
+  p->n_records = n_records;
+  p->batch_size = batch_size;
+  p->shuffle = shuffle != 0;
+  p->drop_last = drop_last != 0;
+  p->seed = seed;
+  p->epochs = epochs;
+  p->queue.reset(new BlockingQueue<Batch>(queue_capacity > 0 ? queue_capacity : 8));
+  p->producer = std::thread(ProducerLoop, p, n_workers > 0 ? n_workers : 2);
+  return p;
+}
+
+// Create from a file via mmap (no copy; page cache feeds the gatherers).
+void* dp_create_from_file(const char* path, int64_t record_bytes,
+                          int64_t batch_size, int shuffle, int drop_last,
+                          uint64_t seed, int64_t epochs, int n_workers,
+                          int64_t queue_capacity) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+  void* addr = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (addr == MAP_FAILED) return nullptr;
+  auto* p = new Pipeline();
+  p->map_addr = addr;
+  p->map_len = st.st_size;
+  p->base = static_cast<const uint8_t*>(addr);
+  p->record_bytes = record_bytes;
+  p->n_records = st.st_size / record_bytes;
+  p->batch_size = batch_size;
+  p->shuffle = shuffle != 0;
+  p->drop_last = drop_last != 0;
+  p->seed = seed;
+  p->epochs = epochs;
+  p->queue.reset(new BlockingQueue<Batch>(queue_capacity > 0 ? queue_capacity : 8));
+  p->producer = std::thread(ProducerLoop, p, n_workers > 0 ? n_workers : 2);
+  return p;
+}
+
+// Pop the next batch into out (caller-allocated, batch_size*record_bytes).
+// Returns records copied; 0 = epoch end marker; -1 = pipeline exhausted.
+int64_t dp_next(void* handle, uint8_t* out) {
+  auto* p = static_cast<Pipeline*>(handle);
+  Batch b;
+  if (!p->queue->Pop(&b)) return -1;
+  if (b.n > 0) std::memcpy(out, b.data.get(), b.n * p->record_bytes);
+  return b.n;
+}
+
+int64_t dp_queue_size(void* handle) {
+  return static_cast<int64_t>(static_cast<Pipeline*>(handle)->queue->Size());
+}
+
+void dp_destroy(void* handle) {
+  auto* p = static_cast<Pipeline*>(handle);
+  p->stop.store(true);
+  p->queue->Close();
+  if (p->producer.joinable()) p->producer.join();
+  if (p->map_addr) munmap(p->map_addr, p->map_len);
+  delete p;
+}
+
+}  // extern "C"

@@ -1,0 +1,289 @@
+"""ctypes bindings for the C++ runtime layer (native/src).
+
+The reference's native runtime (data_feed.cc workers, C++ tensor
+serialization — SURVEY §2.5/§5.4) maps to two C-ABI libraries here, built on
+first use with the system toolchain (no pybind11 in this image):
+
+- data pipeline: mmap/shared-buffer record datasets, worker-thread batch
+  gather, bounded blocking queue (GIL released while popping).
+- checkpoint I/O: PTCK tensor container with mmap reads + checksums.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "..", "..", "native", "src")
+_BUILD = os.path.join(_HERE, "..", "..", "native", "build")
+_LIB_PATH = os.path.join(_BUILD, "libpaddle_tpu_native.so")
+_lock = threading.Lock()
+_lib = None
+
+_DTYPE_CODES = {
+    "float32": 0,
+    "float64": 1,
+    "float16": 2,
+    "bfloat16": 3,
+    "int8": 4,
+    "uint8": 5,
+    "int16": 6,
+    "int32": 7,
+    "int64": 8,
+    "bool": 9,
+}
+_CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def build(force: bool = False) -> str:
+    """Compile the native library if missing/stale. Returns the .so path."""
+    srcs = [os.path.join(_SRC, f) for f in ("data_pipeline.cc", "checkpoint.cc")]
+    hdrs = [os.path.join(_SRC, "blocking_queue.h")]
+    if not force and os.path.exists(_LIB_PATH):
+        newest_src = max(os.path.getmtime(p) for p in srcs + hdrs)
+        if os.path.getmtime(_LIB_PATH) >= newest_src:
+            return _LIB_PATH
+    os.makedirs(_BUILD, exist_ok=True)
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread", "-o", _LIB_PATH] + srcs
+    subprocess.run(cmd, check=True, capture_output=True)
+    return _LIB_PATH
+
+
+def is_available() -> bool:
+    try:
+        _load()
+        return True
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(build())
+        # data pipeline
+        lib.dp_create.restype = ctypes.c_void_p
+        lib.dp_create.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_uint64,
+            ctypes.c_int64,
+            ctypes.c_int,
+            ctypes.c_int64,
+        ]
+        lib.dp_create_from_file.restype = ctypes.c_void_p
+        lib.dp_create_from_file.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_uint64,
+            ctypes.c_int64,
+            ctypes.c_int,
+            ctypes.c_int64,
+        ]
+        lib.dp_next.restype = ctypes.c_int64
+        lib.dp_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.dp_queue_size.restype = ctypes.c_int64
+        lib.dp_queue_size.argtypes = [ctypes.c_void_p]
+        lib.dp_destroy.argtypes = [ctypes.c_void_p]
+        # checkpoint
+        lib.ckpt_writer_open.restype = ctypes.c_void_p
+        lib.ckpt_writer_open.argtypes = [ctypes.c_char_p]
+        lib.ckpt_writer_add.restype = ctypes.c_int
+        lib.ckpt_writer_add.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
+        lib.ckpt_writer_close.restype = ctypes.c_int
+        lib.ckpt_writer_close.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ckpt_open.restype = ctypes.c_void_p
+        lib.ckpt_open.argtypes = [ctypes.c_char_p]
+        lib.ckpt_count.restype = ctypes.c_int64
+        lib.ckpt_count.argtypes = [ctypes.c_void_p]
+        lib.ckpt_meta.restype = ctypes.c_int
+        lib.ckpt_meta.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.ckpt_read.restype = ctypes.c_int
+        lib.ckpt_read.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p]
+        lib.ckpt_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class NativeDataPipeline:
+    """C++-prefetched batches over a fixed-record dataset.
+
+    data: a single numpy array interpreted as [N, *record_shape] — batches
+    come back as [B, *record_shape] arrays gathered off-thread. Use
+    `from_file` for datasets bigger than RAM (mmap)."""
+
+    def __init__(
+        self,
+        data: np.ndarray,
+        batch_size: int,
+        shuffle: bool = False,
+        drop_last: bool = True,
+        seed: int = 0,
+        epochs: int = -1,
+        num_workers: int = 2,
+        queue_capacity: int = 8,
+    ):
+        lib = _load()
+        data = np.ascontiguousarray(data)
+        self._record_shape = data.shape[1:]
+        self._dtype = data.dtype
+        self._record_bytes = int(np.prod(self._record_shape, dtype=np.int64)) * data.itemsize
+        self.batch_size = batch_size
+        self._handle = lib.dp_create(
+            data.tobytes(),
+            data.shape[0],
+            self._record_bytes,
+            batch_size,
+            int(shuffle),
+            int(drop_last),
+            seed,
+            epochs,
+            num_workers,
+            queue_capacity,
+        )
+        self._buf = ctypes.create_string_buffer(batch_size * self._record_bytes)
+        self._lib = lib
+
+    @classmethod
+    def from_file(cls, path: str, record_shape, dtype, batch_size: int, **kwargs):
+        self = cls.__new__(cls)
+        lib = _load()
+        self._record_shape = tuple(record_shape)
+        self._dtype = np.dtype(dtype)
+        self._record_bytes = int(np.prod(record_shape, dtype=np.int64)) * self._dtype.itemsize
+        self.batch_size = batch_size
+        self._handle = lib.dp_create_from_file(
+            path.encode(),
+            self._record_bytes,
+            batch_size,
+            int(kwargs.get("shuffle", False)),
+            int(kwargs.get("drop_last", True)),
+            kwargs.get("seed", 0),
+            kwargs.get("epochs", -1),
+            kwargs.get("num_workers", 2),
+            kwargs.get("queue_capacity", 8),
+        )
+        if not self._handle:
+            raise OSError(f"cannot open dataset file {path}")
+        self._buf = ctypes.create_string_buffer(batch_size * self._record_bytes)
+        self._lib = lib
+        return self
+
+    def next(self) -> Optional[np.ndarray]:
+        """Next batch; None at an epoch boundary; raises StopIteration when
+        the pipeline is exhausted (epochs limit reached)."""
+        n = self._lib.dp_next(self._handle, self._buf)
+        if n < 0:
+            raise StopIteration
+        if n == 0:
+            return None
+        arr = np.frombuffer(self._buf.raw, self._dtype, count=n * self._record_bytes // self._dtype.itemsize)
+        return arr.reshape((n,) + self._record_shape).copy()
+
+    def __iter__(self):
+        while True:
+            try:
+                b = self.next()
+            except StopIteration:
+                return
+            if b is None:
+                return  # one epoch per iterator pass
+            yield b
+
+    def queue_size(self) -> int:
+        return self._lib.dp_queue_size(self._handle)
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.dp_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def save_tensors(path: str, tensors: Dict[str, np.ndarray]):
+    """Write a {name: array} dict as a PTCK container."""
+    lib = _load()
+    h = lib.ckpt_writer_open(path.encode())
+    if not h:
+        raise OSError(f"cannot open {path} for writing")
+    count = 0
+    try:
+        for name, arr in tensors.items():
+            arr = np.ascontiguousarray(arr)
+            dtype_name = arr.dtype.name if arr.dtype.name in _DTYPE_CODES else str(arr.dtype)
+            code = _DTYPE_CODES[dtype_name]
+            shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+            rc = lib.ckpt_writer_add(h, name.encode(), code, shape, arr.ndim, arr.tobytes(), arr.nbytes)
+            if rc != 0:
+                raise OSError(f"write failed for {name}")
+            count += 1
+    finally:
+        lib.ckpt_writer_close(h, count)
+
+
+def load_tensors(path: str) -> Dict[str, np.ndarray]:
+    lib = _load()
+    h = lib.ckpt_open(path.encode())
+    if not h:
+        raise OSError(f"cannot open/verify {path} (missing or checksum mismatch)")
+    try:
+        out = {}
+        name_buf = ctypes.create_string_buffer(256)
+        dtype = ctypes.c_int32()
+        ndim = ctypes.c_int32()
+        shape_buf = (ctypes.c_int64 * 16)()
+        nbytes = ctypes.c_uint64()
+        for i in range(lib.ckpt_count(h)):
+            lib.ckpt_meta(h, i, name_buf, ctypes.byref(dtype), ctypes.byref(ndim), shape_buf, ctypes.byref(nbytes))
+            buf = ctypes.create_string_buffer(nbytes.value)
+            lib.ckpt_read(h, i, buf)
+            dt = _np_dtype(_CODE_DTYPES[dtype.value])
+            shape = tuple(shape_buf[j] for j in range(ndim.value))
+            out[name_buf.value.decode()] = np.frombuffer(buf.raw, dt).reshape(shape).copy()
+        return out
+    finally:
+        lib.ckpt_close(h)

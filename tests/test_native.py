@@ -1,0 +1,93 @@
+"""Native C++ layer tests: build, data pipeline batching/shuffling/prefetch,
+checkpoint container roundtrip + corruption detection (SURVEY §2.5/§5.4
+native analogs)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.is_available(), reason="no C++ toolchain")
+
+
+def test_pipeline_batches_cover_dataset():
+    data = np.arange(40, dtype=np.float32).reshape(10, 4)
+    p = native.NativeDataPipeline(data, batch_size=2, shuffle=False, epochs=1, num_workers=2)
+    seen = []
+    for batch in p:
+        assert batch.shape == (2, 4)
+        seen.extend(batch[:, 0].tolist())
+    p.close()
+    assert sorted(seen) == [0.0, 4.0, 8.0, 12.0, 16.0, 20.0, 24.0, 28.0, 32.0, 36.0]
+
+
+def test_pipeline_shuffle_is_permutation():
+    data = np.arange(64, dtype=np.int64).reshape(64, 1)
+    p = native.NativeDataPipeline(data, batch_size=8, shuffle=True, seed=7, epochs=1)
+    seen = np.concatenate([b[:, 0] for b in p])
+    p.close()
+    assert sorted(seen.tolist()) == list(range(64))
+    assert seen.tolist() != list(range(64))  # actually shuffled
+
+
+def test_pipeline_multi_epoch_and_exhaustion():
+    data = np.zeros((4, 2), np.float32)
+    p = native.NativeDataPipeline(data, batch_size=2, epochs=2)
+    epochs = 0
+    while True:
+        try:
+            b = p.next()
+        except StopIteration:
+            break
+        if b is None:
+            epochs += 1
+    p.close()
+    assert epochs == 2
+
+
+def test_pipeline_from_file(tmp_path):
+    data = np.random.RandomState(0).randn(32, 3).astype(np.float32)
+    f = str(tmp_path / "records.bin")
+    data.tofile(f)
+    p = native.NativeDataPipeline.from_file(f, (3,), np.float32, batch_size=8, epochs=1)
+    batches = list(p)
+    p.close()
+    got = np.concatenate(batches)
+    np.testing.assert_allclose(np.sort(got[:, 0]), np.sort(data[:, 0]))
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    path = str(tmp_path / "model.ptck")
+    tensors = {
+        "w": np.random.RandomState(0).randn(4, 8).astype(np.float32),
+        "b": np.arange(8, dtype=np.int64),
+        "scalar": np.float32(3.5).reshape(()),
+    }
+    native.save_tensors(path, tensors)
+    back = native.load_tensors(path)
+    assert set(back) == set(tensors)
+    for k in tensors:
+        np.testing.assert_array_equal(back[k], tensors[k])
+        assert back[k].dtype == np.asarray(tensors[k]).dtype
+
+
+def test_checkpoint_bfloat16(tmp_path):
+    import ml_dtypes
+
+    path = str(tmp_path / "bf16.ptck")
+    w = np.random.RandomState(0).randn(16).astype(ml_dtypes.bfloat16)
+    native.save_tensors(path, {"w": w})
+    back = native.load_tensors(path)
+    np.testing.assert_array_equal(back["w"].view(np.uint16), w.view(np.uint16))
+
+
+def test_checkpoint_detects_corruption(tmp_path):
+    path = str(tmp_path / "bad.ptck")
+    native.save_tensors(path, {"w": np.ones(64, np.float32)})
+    raw = bytearray(open(path, "rb").read())
+    raw[-16] ^= 0xFF  # flip a data byte
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(OSError):
+        native.load_tensors(path)
