@@ -72,6 +72,10 @@ from . import inference  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
+from . import fft  # noqa: F401,E402
+from . import signal  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import device  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
 from .param_attr import ParamAttr  # noqa: F401,E402

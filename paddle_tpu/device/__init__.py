@@ -1,0 +1,105 @@
+"""paddle.device (python/paddle/device + device/cuda analog): device
+selection and memory stats over jax/PJRT. `gpu`-named APIs are kept as
+aliases onto the accelerator (TPU) so ported scripts keep working."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from . import cuda  # noqa: F401
+
+_current = None
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return [p for p in get_all_device_type() if p not in ("cpu", "gpu", "tpu")]
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices() if d.platform not in ("cpu", "gpu", "tpu")]
+
+
+def set_device(device: str):
+    """'cpu' | 'tpu' | 'tpu:0' | 'gpu:0' (alias for the accelerator)."""
+    global _current
+    name = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    if name == "gpu":
+        name = "tpu" if any(d.platform == "tpu" for d in jax.devices()) else jax.devices()[0].platform
+    matches = [d for d in jax.devices() if d.platform == name]
+    if not matches:
+        matches = [d for d in jax.devices()]
+    _current = matches[min(idx, len(matches) - 1)]
+    try:
+        jax.config.update("jax_default_device", _current)
+    except Exception:
+        pass
+    return _current
+
+
+def get_device() -> str:
+    d = _current or jax.devices()[0]
+    platform = "gpu" if d.platform == "cuda" else d.platform
+    return f"{platform}:{d.id}"
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: Optional[str] = None) -> bool:
+    # the TPU plugin IS a custom/pluggable device in PJRT terms
+    return any(d.platform not in ("cpu",) for d in jax.devices())
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes (cudaDeviceSynchronize
+    analog): XLA arrays are futures, so an effects barrier is the sync."""
+    jax.effects_barrier()
+
+
+class Stream:
+    """API-parity stub: XLA owns scheduling; there are no user streams."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        jax.effects_barrier()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def synchronize(self):
+        jax.effects_barrier()

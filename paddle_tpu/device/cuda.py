@@ -1,0 +1,73 @@
+"""paddle.device.cuda-compatible memory-stat API served by PJRT device stats
+(fluid/memory/stats.h analog — SURVEY §5.5 "device memory via PJRT stats").
+Named `cuda` for ported-code compatibility; it reports the accelerator."""
+
+from __future__ import annotations
+
+import jax
+
+
+def _dev(device=None):
+    if isinstance(device, int):
+        return jax.devices()[device]
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    return (accel or jax.devices())[0]
+
+
+def _stats(device=None):
+    d = _dev(device)
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def device_count() -> int:
+    return len([d for d in jax.devices() if d.platform != "cpu"]) or len(jax.devices())
+
+
+def max_memory_allocated(device=None) -> int:
+    return int(_stats(device).get("peak_bytes_in_use", 0))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = _stats(device)
+    return int(s.get("peak_pool_bytes", s.get("peak_bytes_in_use", 0)))
+
+
+def memory_allocated(device=None) -> int:
+    return int(_stats(device).get("bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    s = _stats(device)
+    return int(s.get("pool_bytes", s.get("bytes_limit", 0)))
+
+
+def empty_cache():
+    """No pooled host-side cache to drop; XLA owns device memory."""
+
+
+def synchronize(device=None):
+    jax.effects_barrier()
+
+
+def get_device_properties(device=None):
+    d = _dev(device)
+
+    class _Props:
+        name = d.device_kind
+        total_memory = int(_stats(device).get("bytes_limit", 0))
+        major = 0
+        minor = 0
+        multi_processor_count = getattr(d, "core_count", 1) or 1
+
+    return _Props()
+
+
+def get_device_name(device=None) -> str:
+    return _dev(device).device_kind
+
+
+def get_device_capability(device=None):
+    return (0, 0)

@@ -1,0 +1,76 @@
+"""paddle.fft (python/paddle/fft.py analog): full FFT family over jnp.fft —
+XLA lowers these to the TPU FFT HLO. Norm semantics ("backward"/"ortho"/
+"forward") match the reference."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops._dispatch import apply, as_tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _wrap1(name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name_arg=None):
+        return apply(name, lambda v: fn(v, n=n, axis=axis, norm=norm), as_tensor(x))
+
+    op.__name__ = name
+    return op
+
+
+def _wrap2(name, fn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name_arg=None):
+        return apply(name, lambda v: fn(v, s=s, axes=axes, norm=norm), as_tensor(x))
+
+    op.__name__ = name
+    return op
+
+
+def _wrapn(name, fn):
+    def op(x, s=None, axes=None, norm="backward", name_arg=None):
+        return apply(name, lambda v: fn(v, s=s, axes=axes, norm=norm), as_tensor(x))
+
+    op.__name__ = name
+    return op
+
+
+fft = _wrap1("fft", jnp.fft.fft)
+ifft = _wrap1("ifft", jnp.fft.ifft)
+rfft = _wrap1("rfft", jnp.fft.rfft)
+irfft = _wrap1("irfft", jnp.fft.irfft)
+hfft = _wrap1("hfft", jnp.fft.hfft)
+ihfft = _wrap1("ihfft", jnp.fft.ihfft)
+fft2 = _wrap2("fft2", jnp.fft.fft2)
+ifft2 = _wrap2("ifft2", jnp.fft.ifft2)
+rfft2 = _wrap2("rfft2", jnp.fft.rfft2)
+irfft2 = _wrap2("irfft2", jnp.fft.irfft2)
+fftn = _wrapn("fftn", jnp.fft.fftn)
+ifftn = _wrapn("ifftn", jnp.fft.ifftn)
+rfftn = _wrapn("rfftn", jnp.fft.rfftn)
+irfftn = _wrapn("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply("fftshift", lambda v: jnp.fft.fftshift(v, axes=axes), as_tensor(x))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes), as_tensor(x))

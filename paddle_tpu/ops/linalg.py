@@ -288,3 +288,30 @@ def bincount(x, weights=None, minlength=0, name=None):
     x = as_tensor(x)
     w = as_tensor(weights)._value if weights is not None else None
     return Tensor(jnp.bincount(x._value, weights=w, minlength=minlength))
+
+
+@register_op("householder_product")
+def householder_product(x, tau, name=None):
+    """Accumulate the Q of a QR from Householder reflectors (geqrf layout):
+    Q = H_0 H_1 ... H_{k-1}, H_i = I - tau_i v_i v_i^T (torch.orgqr analog)."""
+    x = as_tensor(x)
+    tau = as_tensor(tau)
+
+    def f(a, t):
+        *batch, m, n = a.shape
+        k = t.shape[-1]
+        eye = jnp.broadcast_to(jnp.eye(m, n, dtype=a.dtype), (*batch, m, n))
+
+        def body(j, q):
+            i = k - 1 - j  # Q = H_0 (H_1 (... H_{k-1} I)): apply in reverse
+            v = a[..., :, i]
+            rows = jnp.arange(m)
+            v = jnp.where(rows < i, 0.0, jnp.where(rows == i, 1.0, v))
+            tv = t[..., i]
+            # q <- q - tau * v (v^T q)
+            vq = jnp.einsum("...m,...mn->...n", v, q)
+            return q - tv[..., None, None] * v[..., :, None] * vq[..., None, :]
+
+        return jax.lax.fori_loop(0, k, body, eye)
+
+    return apply("householder_product", f, x, tau)

@@ -354,3 +354,65 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
     x = as_tensor(x)
     out = jnp.argmin(x._value, axis=axis, keepdims=keepdim if axis is not None else False)
     return Tensor(out.astype(jnp.int64))
+
+
+@register_op("diff")
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = as_tensor(x)
+    pre = as_tensor(prepend)._value if prepend is not None else None
+    app = as_tensor(append)._value if append is not None else None
+    return apply("diff", lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre, append=app), x)
+
+
+@register_op("trapezoid")
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = as_tensor(y)
+    if x is not None:
+        return apply("trapezoid", lambda yv, xv: jax.scipy.integrate.trapezoid(yv, x=xv, axis=axis), y, as_tensor(x))
+    return apply("trapezoid", lambda yv: jax.scipy.integrate.trapezoid(yv, dx=dx if dx is not None else 1.0, axis=axis), y)
+
+
+@register_op("cumulative_trapezoid")
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = as_tensor(y)
+
+    def f(yv, xv=None):
+        yv = jnp.moveaxis(yv, axis, -1)
+        if xv is not None:
+            d = jnp.diff(jnp.moveaxis(xv, axis, -1), axis=-1)
+        else:
+            d = dx if dx is not None else 1.0
+        avg = (yv[..., 1:] + yv[..., :-1]) / 2.0
+        return jnp.moveaxis(jnp.cumsum(avg * d, axis=-1), -1, axis)
+
+    if x is not None:
+        return apply("cumulative_trapezoid", f, y, as_tensor(x))
+    return apply("cumulative_trapezoid", f, y)
+
+
+@register_op("renorm")
+def renorm(x, p, axis, max_norm, name=None):
+    x = as_tensor(x)
+
+    def f(v):
+        moved = jnp.moveaxis(v, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.power(jnp.power(jnp.abs(flat), p).sum(-1), 1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return jnp.moveaxis(moved * scale.reshape((-1,) + (1,) * (moved.ndim - 1)), 0, axis)
+
+    return apply("renorm", f, x)
+
+
+def frexp(x, name=None):
+    x = as_tensor(x)
+    m, e = jnp.frexp(x._value)
+    return Tensor(m), Tensor(e.astype(jnp.int32))
+
+
+@register_op("polygamma")
+def polygamma(x, n, name=None):
+    x = as_tensor(x)
+    if n == 0:
+        return apply("polygamma", jax.scipy.special.digamma, x)
+    return apply("polygamma", lambda v: jax.scipy.special.polygamma(n, v), x)

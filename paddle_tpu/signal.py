@@ -1,0 +1,78 @@
+"""paddle.signal (python/paddle/signal.py analog): STFT/iSTFT via framed FFT.
+Framing is a gather + window multiply + batched FFT — all MXU/VPU-friendly
+static-shape work under jit."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops._dispatch import apply, as_tensor
+
+__all__ = ["stft", "istft"]
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True, pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """x: [B, T] (or [T]) -> [B, n_fft//2+1, frames] complex (reference layout)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wv = as_tensor(window)._value if window is not None else None
+
+    def f(v):
+        squeeze = v.ndim == 1
+        if squeeze:
+            v = v[None]
+        if center:
+            pad = n_fft // 2
+            v = jnp.pad(v, ((0, 0), (pad, pad)), mode=pad_mode)
+        B, T = v.shape
+        w = wv if wv is not None else jnp.ones(win_length, v.dtype)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        n_frames = 1 + (T - n_fft) // hop_length
+        idx = jnp.arange(n_fft)[None, :] + hop_length * jnp.arange(n_frames)[:, None]  # [F, n_fft]
+        frames = v[:, idx] * w  # [B, F, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        out = jnp.swapaxes(spec, 1, 2)  # [B, bins, F]
+        return out[0] if squeeze else out
+
+    return apply("stft", f, as_tensor(x))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True, normalized=False, onesided=True, length=None, return_complex=False, name=None):
+    """Inverse STFT by weighted overlap-add."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wv = as_tensor(window)._value if window is not None else None
+
+    def f(v):
+        squeeze = v.ndim == 2
+        if squeeze:
+            v = v[None]
+        spec = jnp.swapaxes(v, 1, 2)  # [B, F, bins]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided else jnp.fft.ifft(spec, axis=-1).real
+        B, F, _ = frames.shape
+        w = wv if wv is not None else jnp.ones(win_length, frames.dtype)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        frames = frames * w
+        T = n_fft + hop_length * (F - 1)
+        out = jnp.zeros((B, T), frames.dtype)
+        wsum = jnp.zeros((T,), frames.dtype)
+        idx = jnp.arange(n_fft)[None, :] + hop_length * jnp.arange(F)[:, None]
+        out = out.at[:, idx.reshape(-1)].add(frames.reshape(B, -1))
+        wsum = wsum.at[idx.reshape(-1)].add(jnp.broadcast_to(w**2, (F, n_fft)).reshape(-1))
+        out = out / jnp.where(wsum > 1e-11, wsum, 1.0)
+        if center:
+            pad = n_fft // 2
+            out = out[:, pad : T - pad]
+        if length is not None:
+            out = out[:, :length]
+        return out[0] if squeeze else out
+
+    return apply("istft", f, as_tensor(x))

@@ -1,0 +1,198 @@
+"""paddle.sparse (python/paddle/sparse + phi sparse kernels analog).
+
+SparseCooTensor/SparseCsrTensor re-built over jax.experimental.sparse.BCOO —
+XLA lowers sparse matmul to gather/scatter + dot on TPU. The reference's
+separate kernel families (phi/kernels/sparse/) collapse into BCOO ops plus
+dense round-trips; `is_sparse_coo`-style predicates and the nn functional
+surface stay API-compatible.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "sparse_coo_tensor",
+    "sparse_csr_tensor",
+    "SparseCooTensor",
+    "is_same_shape",
+    "add",
+    "subtract",
+    "multiply",
+    "matmul",
+    "masked_matmul",
+    "transpose",
+    "sum",
+    "nn",
+]
+
+
+class SparseCooTensor(Tensor):
+    """COO sparse tensor: a Tensor facade whose value is a BCOO."""
+
+    def __init__(self, bcoo: jsparse.BCOO, stop_gradient=True):
+        # keep the BCOO payload; the dense `_v` slot stays a placeholder
+        self._bcoo = bcoo
+        super().__init__(jnp.zeros((), jnp.float32), stop_gradient=stop_gradient)
+
+    # Tensor surface
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        from ..core.dtype import DType
+
+        return DType.from_jnp(self._bcoo.dtype) if hasattr(DType, "from_jnp") else self._bcoo.dtype
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)  # paddle layout: [ndim, nnz]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return self
+
+    def numpy(self):
+        return np.asarray(self._bcoo.todense())
+
+    def __repr__(self):
+        return f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()})"
+
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None, dtype=None, place=None, stop_gradient=True):
+    """indices: [ndim, nnz] (paddle layout); values: [nnz, ...]."""
+    idx = np.asarray(indices._value if isinstance(indices, Tensor) else indices)
+    val = jnp.asarray(values._value if isinstance(values, Tensor) else values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        val = val.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    bcoo = jsparse.BCOO((val, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+    """CSR input surface; stored as BCOO internally (one kernel family on TPU)."""
+    crows_np = np.asarray(crows._value if isinstance(crows, Tensor) else crows)
+    cols_np = np.asarray(cols._value if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    idx = np.stack([rows, cols_np])
+    return sparse_coo_tensor(idx, values, shape, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def _bcoo(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    raise TypeError(f"expected a sparse tensor, got {type(x)}")
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def add(x, y, name=None):
+    # concat-nnz add then sum_duplicates: valid COO may hold duplicate indices
+    bx, by = _bcoo(x), _bcoo(y)
+    data = jnp.concatenate([bx.data, by.data])
+    idx = jnp.concatenate([bx.indices, by.indices])
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=bx.shape).sum_duplicates(nse=bx.nse + by.nse))
+
+
+def subtract(x, y, name=None):
+    by = _bcoo(y)
+    neg = SparseCooTensor(jsparse.BCOO((-by.data, by.indices), shape=by.shape))
+    return add(x, neg)
+
+
+def multiply(x, y, name=None):
+    """Elementwise; dense operand broadcasts over the sparse pattern."""
+    bx = _bcoo(x)
+    if isinstance(y, SparseCooTensor):
+        return SparseCooTensor(jsparse.bcoo_multiply_sparse(bx, _bcoo(y)))
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    return SparseCooTensor(jsparse.bcoo_multiply_dense(bx, yv) if hasattr(jsparse, "bcoo_multiply_dense") else jsparse.BCOO((bx.data * yv[tuple(bx.indices.T)], bx.indices), shape=bx.shape))
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (phi sparse matmul kernel analog)."""
+    bx = _bcoo(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor(bx @ yv)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense, sampled at mask's sparsity pattern (SDDMM)."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    bm = _bcoo(mask)
+    rows = bm.indices[:, 0]
+    cols = bm.indices[:, 1]
+    vals = (xv[rows] * yv[:, cols].T).sum(-1)
+    return SparseCooTensor(jsparse.BCOO((vals, bm.indices), shape=bm.shape))
+
+
+def transpose(x, perm, name=None):
+    bx = _bcoo(x)
+    new_idx = bx.indices[:, jnp.asarray(perm)]
+    new_shape = tuple(bx.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((bx.data, new_idx), shape=new_shape))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    bx = _bcoo(x)
+    if axis is None:
+        return Tensor(bx.data.sum())
+    return Tensor(bx.todense().sum(axis=axis, keepdims=keepdim))
+
+
+class _SparseNNFunctional:
+    @staticmethod
+    def relu(x):
+        bx = _bcoo(x)
+        return SparseCooTensor(jsparse.BCOO((jnp.maximum(bx.data, 0), bx.indices), shape=bx.shape))
+
+    @staticmethod
+    def softmax(x, axis=-1):
+        # softmax over the last dense axis of a 2-D COO matrix, per row
+        bx = _bcoo(x)
+        dense = bx.todense()
+        mask = (jsparse.BCOO((jnp.ones_like(bx.data), bx.indices), shape=bx.shape)).todense() > 0
+        masked = jnp.where(mask, dense, -jnp.inf)
+        sm = jax.nn.softmax(masked, axis=axis)
+        sm = jnp.where(mask, sm, 0)
+        vals = sm[tuple(bx.indices.T)]
+        return SparseCooTensor(jsparse.BCOO((vals, bx.indices), shape=bx.shape))
+
+
+class _SparseNN:
+    functional = _SparseNNFunctional()
+
+
+nn = _SparseNN()

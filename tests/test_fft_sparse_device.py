@@ -1,0 +1,106 @@
+"""fft/signal/sparse/device namespace tests vs numpy/scipy references."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_fft_matches_numpy():
+    x = np.random.RandomState(0).randn(16).astype(np.float32)
+    np.testing.assert_allclose(paddle.fft.fft(paddle.to_tensor(x)).numpy(), np.fft.fft(x), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(paddle.fft.rfft(paddle.to_tensor(x)).numpy(), np.fft.rfft(x), rtol=1e-4, atol=1e-5)
+    X = paddle.fft.fft(paddle.to_tensor(x))
+    np.testing.assert_allclose(paddle.fft.ifft(X).numpy().real, x, rtol=1e-4, atol=1e-5)
+    x2 = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+    np.testing.assert_allclose(paddle.fft.fft2(paddle.to_tensor(x2)).numpy(), np.fft.fft2(x2), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        paddle.fft.fftshift(paddle.to_tensor(x)).numpy(), np.fft.fftshift(x), rtol=1e-6
+    )
+    np.testing.assert_allclose(paddle.fft.fftfreq(8, 0.5).numpy(), np.fft.fftfreq(8, 0.5), rtol=1e-6)
+
+
+def test_fft_norm_modes():
+    x = np.random.RandomState(0).randn(8).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.fft.fft(paddle.to_tensor(x), norm="ortho").numpy(), np.fft.fft(x, norm="ortho"), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_stft_istft_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 256).astype(np.float32)
+    win = np.hanning(64).astype(np.float32)
+    spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=64, hop_length=16, window=paddle.to_tensor(win))
+    assert list(spec.shape) == [2, 33, 17]  # [B, bins, frames]
+    back = paddle.signal.istft(
+        spec, n_fft=64, hop_length=16, window=paddle.to_tensor(win), length=256
+    ).numpy()
+    # interior samples reconstruct (edges lose energy without COLA padding)
+    np.testing.assert_allclose(back[:, 32:-32], x[:, 32:-32], atol=1e-3)
+
+
+def test_sparse_coo_basics():
+    idx = np.array([[0, 1, 2], [1, 2, 0]])
+    vals = np.array([1.0, 2.0, 3.0], np.float32)
+    s = paddle.sparse.sparse_coo_tensor(idx, vals, shape=[3, 3])
+    assert s.is_sparse_coo() and s.nnz() == 3
+    dense = s.to_dense().numpy()
+    expect = np.zeros((3, 3), np.float32)
+    expect[0, 1], expect[1, 2], expect[2, 0] = 1, 2, 3
+    np.testing.assert_array_equal(dense, expect)
+    np.testing.assert_array_equal(s.indices().numpy(), idx)
+
+
+def test_sparse_add_matmul_relu():
+    idx = np.array([[0, 1], [1, 0]])
+    a = paddle.sparse.sparse_coo_tensor(idx, np.array([2.0, -3.0], np.float32), shape=[2, 2])
+    b = paddle.sparse.sparse_coo_tensor(idx, np.array([1.0, 1.0], np.float32), shape=[2, 2])
+    c = paddle.sparse.add(a, b)
+    np.testing.assert_allclose(c.to_dense().numpy(), a.to_dense().numpy() + b.to_dense().numpy())
+    y = paddle.sparse.matmul(a, paddle.to_tensor(np.eye(2, dtype=np.float32)))
+    np.testing.assert_allclose(y.numpy(), a.to_dense().numpy())
+    r = paddle.sparse.nn.functional.relu(a)
+    assert r.to_dense().numpy().min() == 0.0
+
+
+def test_sparse_csr_and_transpose():
+    # csr for [[0,1],[2,0]]
+    s = paddle.sparse.sparse_csr_tensor(np.array([0, 1, 2]), np.array([1, 0]), np.array([1.0, 2.0], np.float32), shape=[2, 2])
+    np.testing.assert_array_equal(s.to_dense().numpy(), np.array([[0, 1], [2, 0]], np.float32))
+    t = paddle.sparse.transpose(s, [1, 0])
+    np.testing.assert_array_equal(t.to_dense().numpy(), np.array([[0, 2], [1, 0]], np.float32))
+
+
+def test_device_api():
+    assert paddle.device.get_device()
+    assert paddle.device.cuda.device_count() >= 1
+    paddle.device.synchronize()
+    props = paddle.device.cuda.get_device_properties()
+    assert props.name
+    # memory stats are ints (0 on CPU backend)
+    assert isinstance(paddle.device.cuda.max_memory_allocated(), int)
+
+
+def test_new_math_ops():
+    import scipy.special as ss
+
+    x = np.array([1.0, 2.0, 4.0, 7.0], np.float32)
+    np.testing.assert_allclose(paddle.diff(paddle.to_tensor(x)).numpy(), np.diff(x))
+    np.testing.assert_allclose(float(paddle.trapezoid(paddle.to_tensor(x)).numpy()), np.trapz(x))
+    m, e = paddle.frexp(paddle.to_tensor(np.array([8.0, 0.5])))
+    np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy().astype(np.float32), [8.0, 0.5])
+    np.testing.assert_allclose(
+        float(paddle.polygamma(paddle.to_tensor(np.array(2.0)), 1).numpy()), ss.polygamma(1, 2.0), rtol=1e-4
+    )
+    v = paddle.renorm(paddle.to_tensor(np.ones((2, 4), np.float32) * 3), 2.0, 0, 1.0)
+    np.testing.assert_allclose(np.linalg.norm(v.numpy(), axis=1), 1.0, rtol=1e-5)
+
+
+def test_householder_product():
+    import scipy.linalg
+
+    A = np.random.RandomState(0).randn(6, 4)
+    (h, tau), _ = scipy.linalg.qr(A, mode="raw")
+    Q = paddle.householder_product(paddle.to_tensor(np.asarray(h)), paddle.to_tensor(np.asarray(tau))).numpy()
+    np.testing.assert_allclose(Q[:, :4], np.linalg.qr(A)[0], rtol=1e-5, atol=1e-6)
