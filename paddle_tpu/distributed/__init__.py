@@ -63,6 +63,8 @@ from .topology import (  # noqa: F401
 
 from . import fleet  # noqa: F401,E402
 from . import auto_parallel  # noqa: F401,E402
+from . import launch  # noqa: F401,E402
+from . import rpc  # noqa: F401,E402
 from .auto_parallel import Engine, ProcessMesh, shard_op, shard_tensor  # noqa: F401,E402
 
 

@@ -1,0 +1,58 @@
+"""Shared length-prefixed-pickle framing for the control-plane sockets.
+
+Used by distributed.rpc and fleet.elastic (the brpc transport analog,
+fluid/distributed/rpc + ps/service). One 8-byte big-endian length header, then
+a pickle payload, with an optional shared-secret preamble: when
+PADDLE_RPC_SECRET is set, every connection must open with the secret bytes or
+the server drops it — pickle from unauthenticated peers is never loaded.
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+import pickle
+import socket
+import struct
+
+_MAX_FRAME = 1 << 30  # 1 GiB sanity cap
+
+
+def secret() -> bytes:
+    return os.environ.get("PADDLE_RPC_SECRET", "").encode()
+
+
+def send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj)
+    if len(payload) > _MAX_FRAME:
+        raise ValueError(f"frame too large: {len(payload)}")
+    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def recv_msg(sock: socket.socket):
+    (n,) = struct.unpack("!Q", _recv_exact(sock, 8))
+    if n > _MAX_FRAME:
+        raise ConnectionError(f"oversized frame: {n}")
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def client_handshake(sock: socket.socket) -> None:
+    tok = secret()
+    sock.sendall(struct.pack("!H", len(tok)) + tok)
+
+
+def server_handshake(sock: socket.socket) -> bool:
+    """Read the client's token; True iff it matches ours (constant-time)."""
+    (n,) = struct.unpack("!H", _recv_exact(sock, 2))
+    tok = _recv_exact(sock, n) if n else b""
+    return hmac.compare_digest(tok, secret())

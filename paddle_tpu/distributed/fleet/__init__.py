@@ -34,6 +34,8 @@ from .meta_parallel import (  # noqa: F401
     get_rng_state_tracker,
 )
 from .recompute import recompute, recompute_hybrid, recompute_sequential  # noqa: F401
+from . import elastic  # noqa: F401
+from .elastic import ElasticManager  # noqa: F401
 
 _fleet_initialized = False
 _strategy: Optional[DistributedStrategy] = None

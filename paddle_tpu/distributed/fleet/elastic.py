@@ -1,0 +1,198 @@
+"""Elastic training manager (reference fleet/elastic/manager.py:124 analog).
+
+The reference registers each trainer host in etcd under a TTL lease, watches
+for joins/exits, and relaunches the job with new ranks when the world changes.
+Same design here minus etcd: a KVMaster (tiny TCP key-value server with lease
+expiry, the etcd/HTTP-Master analog from launch/controllers/master.py) owned by
+rank 0, an ElasticManager that heartbeats this host's key and polls the host
+set, and the ELASTIC_AUTO_PARALLEL_EXIT_CODE contract the launch controller
+uses to trigger a rescale-restart instead of a failure exit.
+
+On TPU the unit of elasticity is the host (slice membership changes arrive as
+preemptions); pairing this with preemption-aware checkpointing in
+paddle_tpu.io gives scale-down-resume.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .._wire import client_handshake, recv_msg, send_msg, server_handshake
+
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 101
+
+
+class KVMaster:
+    """Lease-aware KV store served over TCP — the rendezvous master."""
+
+    def __init__(self, port: int = 0):
+        self._data: Dict[str, Tuple[object, float]] = {}  # key -> (value, expiry)
+        self._lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("0.0.0.0", port))
+        self._srv.listen(64)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            with conn:
+                if not server_handshake(conn):
+                    return
+                req = recv_msg(conn)
+                op, key = req.get("op"), req.get("key", "")
+                now = time.time()
+                with self._lock:
+                    expired = [k for k, (_, exp) in self._data.items() if exp and exp < now]
+                    for k in expired:
+                        del self._data[k]
+                    if op == "put":
+                        ttl = req.get("ttl", 0)
+                        self._data[key] = (req.get("value"), now + ttl if ttl else 0)
+                        send_msg(conn, {"ok": True})
+                    elif op == "get":
+                        val = self._data.get(key)
+                        send_msg(conn, {"ok": True, "value": val[0] if val else None})
+                    elif op == "scan":
+                        out = {k: v for k, (v, _) in self._data.items() if k.startswith(key)}
+                        send_msg(conn, {"ok": True, "value": out})
+                    elif op == "delete":
+                        self._data.pop(key, None)
+                        send_msg(conn, {"ok": True})
+                    else:
+                        send_msg(conn, {"ok": False, "error": f"bad op {op}"})
+        except (ConnectionError, EOFError, OSError):
+            pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class KVClient:
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
+
+    def _call(self, req):
+        with socket.create_connection(self._addr, timeout=10) as sock:
+            client_handshake(sock)
+            send_msg(sock, req)
+            resp = recv_msg(sock)
+        if not resp.get("ok"):
+            raise RuntimeError(f"kv master error: {resp.get('error')}")
+        return resp.get("value")
+
+    def put(self, key, value, ttl: float = 0):
+        return self._call({"op": "put", "key": key, "value": value, "ttl": ttl})
+
+    def get(self, key):
+        return self._call({"op": "get", "key": key})
+
+    def scan(self, prefix):
+        return self._call({"op": "scan", "key": prefix})
+
+    def delete(self, key):
+        return self._call({"op": "delete", "key": key})
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Host membership tracker driving elastic restarts.
+
+    np may be a fixed int or a "lo:hi" range (reference manager.py np parse);
+    enabled only when a range is given and a master endpoint exists.
+    """
+
+    def __init__(self, np: str = None, host: str = None, master: str = None, job_id: str = None, heartbeat_s: float = 2.0):
+        np = np if np is not None else os.environ.get("PADDLE_ELASTIC_NP", "1")
+        parts = str(np).split(":")
+        self.np_lo = int(parts[0] or 1)
+        self.np_hi = int(parts[-1] or self.np_lo)
+        self.host = host or os.environ.get("POD_IP", socket.gethostname())
+        self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default")
+        self.heartbeat_s = heartbeat_s
+        endpoint = master or os.environ.get("PADDLE_ELASTIC_SERVER")
+        self._client = KVClient(endpoint) if endpoint else None
+        # elastic needs both a resizable world AND a master to track it
+        self.enable = self.np_hi > self.np_lo and self._client is not None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._prefix = f"/elastic/{self.job_id}/hosts/"
+
+    # -- registration & heartbeat (etcd lease analog) --
+    def register(self):
+        if not self._client:
+            return
+        self._client.put(self._prefix + self.host, {"host": self.host, "ts": time.time()}, ttl=self.heartbeat_s * 3)
+        if self._thread is None or not self._thread.is_alive():
+            # re-registering after exit(): reset the stop latch so the fresh
+            # heartbeat thread actually renews the lease
+            self._stop = threading.Event()
+            self._thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
+            self._thread.start()
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._client.put(self._prefix + self.host, {"host": self.host, "ts": time.time()}, ttl=self.heartbeat_s * 3)
+            except (OSError, RuntimeError, ConnectionError):
+                pass
+            self._stop.wait(self.heartbeat_s)
+
+    def hosts(self) -> List[str]:
+        if not self._client:
+            return [self.host]
+        return sorted(k[len(self._prefix):] for k in self._client.scan(self._prefix))
+
+    # -- scale decisions (manager.py need_scale / wait analog) --
+    def world_ready(self) -> bool:
+        n = len(self.hosts())
+        return self.np_lo <= n <= self.np_hi
+
+    def need_scale(self, current_np: int) -> bool:
+        n = len(self.hosts())  # single snapshot for both checks
+        return self.np_lo <= n <= self.np_hi and n != current_np
+
+    def wait_for_world(self, timeout_s: float = 120.0) -> List[str]:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            hosts = self.hosts()
+            if self.np_lo <= len(hosts) <= self.np_hi:
+                return hosts
+            time.sleep(self.heartbeat_s)
+        raise TimeoutError(f"elastic world not ready: have {len(self.hosts())}, want [{self.np_lo},{self.np_hi}]")
+
+    def exit(self, completed: bool = True):
+        self._stop.set()
+        if self._client:
+            try:
+                self._client.delete(self._prefix + self.host)
+            except (OSError, RuntimeError, ConnectionError):
+                pass
+        return ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR

@@ -62,11 +62,19 @@ def launch(args=None):
             log.close()
         if all(c == 0 for c in codes):
             return 0
-        restarts += 1
-        if restarts > args.max_restart:
-            print(f"workers failed with {codes} after {restarts - 1} restarts", file=sys.stderr)
-            return max(codes)
-        print(f"worker failure {codes}; elastic restart {restarts}/{args.max_restart}", file=sys.stderr)
+        from ..fleet.elastic import ELASTIC_AUTO_PARALLEL_EXIT_CODE
+
+        if any(c == ELASTIC_AUTO_PARALLEL_EXIT_CODE for c in codes):
+            # rescale request, not a failure: relaunch with the current world
+            # (workers re-read membership from the elastic master) and do not
+            # burn a restart credit
+            print(f"rescale requested (exit {ELASTIC_AUTO_PARALLEL_EXIT_CODE}); relaunching", file=sys.stderr)
+        else:
+            restarts += 1
+            if restarts > args.max_restart:
+                print(f"workers failed with {codes} after {restarts - 1} restarts", file=sys.stderr)
+                return max(codes)
+            print(f"worker failure {codes}; elastic restart {restarts}/{args.max_restart}", file=sys.stderr)
         procs = []
         time.sleep(1)
 

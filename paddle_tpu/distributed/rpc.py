@@ -1,0 +1,193 @@
+"""paddle.distributed.rpc analog (reference python/paddle/distributed/rpc/).
+
+The reference layers rpc_sync/rpc_async on a brpc transport (fluid/distributed/
+rpc/). A TPU framework has no brpc; the same worker-to-worker control-plane RPC
+is served by the shared length-prefixed-pickle protocol (distributed/_wire.py)
+over TCP, with one daemon server thread per worker. Data-plane traffic
+(tensors) should ride XLA collectives, not RPC — this is for orchestration
+(eval loops, metric gathers, small-state lookups).
+
+Security: servers bind the loopback interface unless the worker's registered
+endpoint names a routable IP, and when PADDLE_RPC_SECRET is set every
+connection must pass the shared-secret handshake before any pickle is loaded.
+
+API parity: init_rpc, rpc_sync, rpc_async, shutdown, get_worker_info,
+get_all_worker_infos, get_current_worker_info.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from struct import error as struct_error
+from typing import Dict, List, Optional
+
+from ._wire import client_handshake, recv_msg, send_msg, server_handshake
+
+
+class WorkerInfo:
+    def __init__(self, name: str, rank: int, ip: str, port: int):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name}, rank={self.rank}, ip={self.ip}, port={self.port})"
+
+
+_lock = threading.Lock()
+_workers: Dict[str, WorkerInfo] = {}
+_current: Optional[WorkerInfo] = None
+_server: Optional[socket.socket] = None
+_server_thread: Optional[threading.Thread] = None
+_pool: Optional[ThreadPoolExecutor] = None
+_master = None  # KVClient used to exchange custom worker names
+_shutdown = threading.Event()
+
+
+def _serve_conn(conn: socket.socket):
+    try:
+        with conn:
+            if not server_handshake(conn):
+                return  # unauthenticated peer: drop before touching pickle
+            req = recv_msg(conn)
+            if req.get("kind") == "call":
+                fn = req["fn"]
+                try:
+                    result = fn(*req.get("args", ()), **req.get("kwargs", {}))
+                    send_msg(conn, {"ok": True, "result": result})
+                except Exception as exc:  # mirrored to caller
+                    send_msg(conn, {"ok": False, "error": repr(exc)})
+            elif req.get("kind") == "ping":
+                send_msg(conn, {"ok": True, "result": _current.name if _current else None})
+    except (ConnectionError, EOFError, OSError, struct_error):
+        pass
+
+
+def _server_loop(srv: socket.socket):
+    while not _shutdown.is_set():
+        try:
+            conn, _ = srv.accept()
+        except OSError:
+            return
+        threading.Thread(target=_serve_conn, args=(conn,), daemon=True).start()
+
+
+def init_rpc(name: str, rank: int = None, world_size: int = None, master_endpoint: str = None):
+    """Start this worker's RPC server and register the worker table.
+
+    Single-host form: every worker is addressed as 127.0.0.1:<base_port+rank>.
+    The PADDLE_WORKER_ENDPOINTS env (comma-separated host:port, index = rank)
+    overrides that for multi-host runs. Custom names are exchanged through the
+    elastic KV master when one is configured (master_endpoint arg or
+    PADDLE_ELASTIC_SERVER env); without a master, peers are addressed by the
+    default "worker<rank>" names.
+    """
+    global _current, _server, _server_thread, _pool, _master
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) if world_size is None else world_size
+    endpoints = os.environ.get("PADDLE_WORKER_ENDPOINTS", "")
+    base_port = int(os.environ.get("PADDLE_RPC_BASE_PORT", "29710"))
+    with _lock:
+        _shutdown.clear()
+        _workers.clear()
+        eps: List[str] = endpoints.split(",") if endpoints else [f"127.0.0.1:{base_port + r}" for r in range(world_size)]
+        for r, ep in enumerate(eps[:world_size]):
+            ip, port = ep.rsplit(":", 1)
+            _workers[f"worker{r}"] = WorkerInfo(f"worker{r}", r, ip, int(port))
+        me = _workers[f"worker{rank}"]
+        me.name = name
+        _workers[name] = me
+        _current = me
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # bind only the interface peers will dial — loopback in the single-host
+        # default — never the wildcard address
+        srv.bind((me.ip, me.port))
+        srv.listen(64)
+        _server = srv
+        _server_thread = threading.Thread(target=_server_loop, args=(srv,), daemon=True)
+        _server_thread.start()
+        _pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="rpc-client")
+        master_ep = master_endpoint or os.environ.get("PADDLE_ELASTIC_SERVER")
+        if master_ep:
+            from .fleet.elastic import KVClient
+
+            _master = KVClient(master_ep)
+            _master.put(f"/rpc/names/{name}", rank)
+    return _current
+
+
+def _resolve(to: str) -> WorkerInfo:
+    if to in _workers:
+        return _workers[to]
+    if _master is not None:
+        rank = _master.get(f"/rpc/names/{to}")
+        if rank is not None and f"worker{rank}" in _workers:
+            info = _workers[f"worker{rank}"]
+            _workers[to] = info
+            return info
+    raise ValueError(f"unknown rpc worker {to!r}; known: {sorted(set(w.name for w in _workers.values()))}")
+
+
+def _invoke(to: str, fn, args, kwargs, timeout: float):
+    info = _resolve(to)
+    with socket.create_connection((info.ip, info.port), timeout=timeout if timeout > 0 else None) as sock:
+        client_handshake(sock)
+        send_msg(sock, {"kind": "call", "fn": fn, "args": args, "kwargs": kwargs})
+        resp = recv_msg(sock)
+    if not resp["ok"]:
+        raise RuntimeError(f"rpc call to {to} failed: {resp['error']}")
+    return resp["result"]
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 180.0):
+    return _invoke(to, fn, tuple(args), dict(kwargs or {}), timeout)
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout: float = 180.0) -> Future:
+    if _pool is None:
+        raise RuntimeError("init_rpc must be called before rpc_async")
+    fut = _pool.submit(_invoke, to, fn, tuple(args), dict(kwargs or {}), timeout)
+    fut.wait = fut.result  # paddle Future API spells result() as wait()
+    return fut
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _resolve(name)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    if _current is None:
+        raise RuntimeError("rpc is not initialized")
+    return _current
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    seen, out = set(), []
+    for info in _workers.values():
+        if id(info) not in seen:
+            seen.add(id(info))
+            out.append(info)
+    return sorted(out, key=lambda w: w.rank)
+
+
+def shutdown():
+    global _server, _server_thread, _pool, _current, _master
+    _shutdown.set()
+    with _lock:
+        if _server is not None:
+            try:
+                _server.close()
+            except OSError:
+                pass
+            _server = None
+        if _pool is not None:
+            _pool.shutdown(wait=False)
+            _pool = None
+        _workers.clear()
+        _current = None
+        _master = None
