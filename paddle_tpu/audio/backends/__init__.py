@@ -1,0 +1,20 @@
+"""paddle.audio.backends (reference audio/backends/__init__.py)."""
+
+from .wave_backend import (  # noqa: F401
+    AudioInfo,
+    get_current_audio_backend,
+    info,
+    list_available_backends,
+    load,
+    save,
+    set_backend,
+)
+
+__all__ = [
+    "info",
+    "load",
+    "save",
+    "get_current_audio_backend",
+    "list_available_backends",
+    "set_backend",
+]

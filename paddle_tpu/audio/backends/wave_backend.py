@@ -1,0 +1,105 @@
+"""WAV I/O via the stdlib wave module (reference audio/backends/wave_backend.py).
+
+The reference ships this exact fallback backend (no soundfile dependency):
+16-bit PCM read/write. API parity: info/load/save.
+"""
+
+from __future__ import annotations
+
+import wave
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+class AudioInfo:
+    def __init__(self, sample_rate: int, num_samples: int, num_channels: int, bits_per_sample: int, encoding: str):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (
+            f"AudioInfo(sample_rate={self.sample_rate}, num_samples={self.num_samples}, "
+            f"num_channels={self.num_channels}, bits_per_sample={self.bits_per_sample}, encoding={self.encoding})"
+        )
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(
+            sample_rate=f.getframerate(),
+            num_samples=f.getnframes(),
+            num_channels=f.getnchannels(),
+            bits_per_sample=f.getsampwidth() * 8,
+            encoding="PCM_S",
+        )
+
+
+def load(
+    filepath: str,
+    frame_offset: int = 0,
+    num_frames: int = -1,
+    normalize: bool = True,
+    channels_first: bool = True,
+) -> Tuple[Tensor, int]:
+    """Returns (waveform [C, N] if channels_first else [N, C], sample_rate)."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        if width != 2:
+            raise NotImplementedError("wave backend supports 16-bit PCM only")
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    data = np.frombuffer(raw, dtype=np.int16).reshape(-1, nch)
+    if normalize:
+        arr = (data / 32768.0).astype(np.float32)
+    else:
+        arr = data
+    if channels_first:
+        arr = arr.T
+    return Tensor(arr), sr
+
+
+def save(
+    filepath: str,
+    src,
+    sample_rate: int,
+    channels_first: bool = True,
+    encoding: Optional[str] = None,
+    bits_per_sample: int = 16,
+):
+    if bits_per_sample != 16:
+        raise NotImplementedError("wave backend writes 16-bit PCM only")
+    arr = np.asarray(src.numpy() if isinstance(src, Tensor) else src)
+    if arr.ndim == 1:
+        arr = arr[None, :] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T  # -> [N, C]
+    if arr.dtype.kind == "f":
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype(np.int16)
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(sample_rate)
+        f.writeframes(arr.astype("<i2").tobytes())
+
+
+def get_current_audio_backend() -> str:
+    return "wave_backend"
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def set_backend(backend_name: str):
+    if backend_name != "wave_backend":
+        raise NotImplementedError("only the builtin wave backend is available (zero-egress image)")

@@ -1,0 +1,120 @@
+"""Audio datasets (reference audio/datasets/esc50.py, tess.py).
+
+Feature-extracting datasets: each item is (feature, label) where feature is
+raw waveform or a configured mel/mfcc feature. Synthetic waveform fallback in
+this zero-egress environment; pass archive_path for real data laid out as the
+reference expects.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..io import Dataset
+from .features import LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram
+
+_FEATURES = {
+    "raw": None,
+    "spectrogram": Spectrogram,
+    "melspectrogram": MelSpectrogram,
+    "logmelspectrogram": LogMelSpectrogram,
+    "mfcc": MFCC,
+}
+
+
+class AudioClassificationDataset(Dataset):
+    """Base: waveform clips + integer labels (audio/datasets/dataset.py)."""
+
+    def __init__(self, files=None, labels=None, feat_type: str = "raw", sample_rate: int = 16000, duration: float = 1.0, n_classes: int = 10, n_synthetic: int = 64, seed: int = 0, **feat_kwargs):
+        if feat_type not in _FEATURES:
+            raise ValueError(f"feat_type must be one of {sorted(_FEATURES)}")
+        self.sample_rate = sample_rate
+        n = int(sample_rate * duration)
+        if files:
+            from .backends import load
+
+            self.waveforms = []
+            self.labels = list(labels)
+            for f in files:
+                wav, _ = load(f)
+                self.waveforms.append(np.asarray(wav.numpy())[0][:n])
+        else:
+            rng = np.random.RandomState(seed)
+            self.labels = rng.randint(0, n_classes, size=n_synthetic).tolist()
+            t = np.arange(n) / sample_rate
+            self.waveforms = [
+                (0.5 * np.sin(2 * np.pi * (200 + 100 * l) * t) + 0.05 * rng.randn(n)).astype(np.float32)
+                for l in self.labels
+            ]
+        if _FEATURES[feat_type] is None:
+            self._extract = None
+        else:
+            if feat_type != "spectrogram":  # Spectrogram is sr-agnostic
+                feat_kwargs.setdefault("sr", sample_rate)
+            self._extract = _FEATURES[feat_type](**feat_kwargs)
+
+    def __getitem__(self, idx):
+        wav = self.waveforms[idx]
+        if self._extract is not None:
+            feat = self._extract(wav[None, :]).numpy()[0]
+        else:
+            feat = wav
+        return feat, np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.waveforms)
+
+
+class ESC50(AudioClassificationDataset):
+    """50-class environmental sounds (esc50.py)."""
+
+    def __init__(self, mode: str = "train", split: int = 1, feat_type: str = "raw", archive_path: Optional[str] = None, **kwargs):
+        kwargs.setdefault("n_classes", 50)
+        kwargs.setdefault("seed", 0 if mode == "train" else 1)
+        kwargs.setdefault("sample_rate", 44100)
+        files, labels = None, None
+        if archive_path and os.path.isdir(archive_path):
+            files, labels = self._scan(archive_path, mode, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type, **kwargs)
+
+    @staticmethod
+    def _scan(root, mode, split):
+        import csv
+
+        files, labels = [], []
+        meta = os.path.join(root, "meta", "esc50.csv")
+        with open(meta) as f:
+            for row in csv.DictReader(f):
+                in_fold = int(row["fold"]) == split
+                if (mode == "train") != in_fold:
+                    files.append(os.path.join(root, "audio", row["filename"]))
+                    labels.append(int(row["target"]))
+        return files, labels
+
+
+class TESS(AudioClassificationDataset):
+    """7-emotion speech (tess.py)."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, mode: str = "train", n_folds: int = 5, split: int = 1, feat_type: str = "raw", archive_path: Optional[str] = None, **kwargs):
+        kwargs.setdefault("n_classes", len(self.EMOTIONS))
+        kwargs.setdefault("seed", 0 if mode == "train" else 1)
+        kwargs.setdefault("sample_rate", 24414)
+        files, labels = None, None
+        if archive_path and os.path.isdir(archive_path):
+            files, labels = [], []
+            for dirpath, _, names in os.walk(archive_path):
+                for nm in sorted(names):
+                    if nm.endswith(".wav"):
+                        emo = nm.rsplit("_", 1)[-1][:-4].lower()
+                        if emo in self.EMOTIONS:
+                            files.append(os.path.join(dirpath, nm))
+                            labels.append(self.EMOTIONS.index(emo))
+            fold = np.arange(len(files)) % n_folds + 1
+            keep = [(f, l) for f, l, fd in zip(files, labels, fold) if (fd == split) != (mode == "train")]
+            files, labels = [f for f, _ in keep], [l for _, l in keep]
+        super().__init__(files=files, labels=labels, feat_type=feat_type, **kwargs)

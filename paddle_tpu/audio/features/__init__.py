@@ -1,0 +1,5 @@
+"""paddle.audio.features (reference audio/features/__init__.py)."""
+
+from .layers import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401
+
+__all__ = ["LogMelSpectrogram", "MelSpectrogram", "MFCC", "Spectrogram"]

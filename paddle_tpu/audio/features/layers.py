@@ -1,0 +1,157 @@
+"""Audio feature layers (reference python/paddle/audio/features/layers.py).
+
+Each layer precomputes its static operator (window, fbank, DCT) at build time
+and runs a pure jnp pipeline in forward, so a feature extractor inside a
+jitted data/compute graph fuses into the surrounding XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ..functional import compute_fbank_matrix, create_dct, get_window, power_to_db
+
+
+def _stft_power(x, n_fft, hop_length, win_length, window_t, center, pad_mode, power):
+    from ...signal import stft
+
+    spec = stft(
+        x if isinstance(x, Tensor) else Tensor(x),
+        n_fft=n_fft,
+        hop_length=hop_length,
+        win_length=win_length,
+        window=window_t,
+        center=center,
+        pad_mode=pad_mode,
+    )
+    mag = jnp.abs(spec._value)
+    if power == 1.0:
+        return mag
+    return mag**power
+
+
+class Spectrogram(Layer):
+    """STFT magnitude^power [.., n_fft//2+1, frames] (layers.py:24)."""
+
+    def __init__(
+        self,
+        n_fft: int = 512,
+        hop_length: Optional[int] = 512,
+        win_length: Optional[int] = None,
+        window: str = "hann",
+        power: float = 1.0,
+        center: bool = True,
+        pad_mode: str = "reflect",
+        dtype: str = "float32",
+    ):
+        super().__init__()
+        if win_length is None:
+            win_length = n_fft
+        self.n_fft = n_fft
+        self.hop_length = hop_length if hop_length is not None else win_length // 4
+        self.win_length = win_length
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = get_window(window, win_length, fftbins=True, dtype=dtype)
+
+    def forward(self, x):
+        return Tensor(
+            _stft_power(x, self.n_fft, self.hop_length, self.win_length, self.fft_window, self.center, self.pad_mode, self.power)
+        )
+
+
+class MelSpectrogram(Layer):
+    """Mel-projected power spectrogram (layers.py:106)."""
+
+    def __init__(
+        self,
+        sr: int = 22050,
+        n_fft: int = 512,
+        hop_length: Optional[int] = 512,
+        win_length: Optional[int] = None,
+        window: str = "hann",
+        power: float = 2.0,
+        center: bool = True,
+        pad_mode: str = "reflect",
+        n_mels: int = 64,
+        f_min: float = 50.0,
+        f_max: Optional[float] = None,
+        htk: bool = False,
+        norm: Union[str, float] = "slaney",
+        dtype: str = "float32",
+    ):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length, window, power, center, pad_mode, dtype)
+        self.n_mels = n_mels
+        self.fbank_matrix = compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk, norm=norm, dtype=dtype
+        )
+
+    def forward(self, x):
+        spect = self._spectrogram(x)  # [..., n_bins, frames]
+        mel = jnp.matmul(self.fbank_matrix._value, spect._value)
+        return Tensor(mel)
+
+
+class LogMelSpectrogram(Layer):
+    """dB-scaled mel spectrogram (layers.py:206)."""
+
+    def __init__(
+        self,
+        sr: int = 22050,
+        n_fft: int = 512,
+        hop_length: Optional[int] = 512,
+        win_length: Optional[int] = None,
+        window: str = "hann",
+        power: float = 2.0,
+        center: bool = True,
+        pad_mode: str = "reflect",
+        n_mels: int = 64,
+        f_min: float = 50.0,
+        f_max: Optional[float] = None,
+        htk: bool = False,
+        norm: Union[str, float] = "slaney",
+        ref_value: float = 1.0,
+        amin: float = 1e-10,
+        top_db: Optional[float] = None,
+        dtype: str = "float32",
+    ):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center, pad_mode, n_mels, f_min, f_max, htk, norm, dtype
+        )
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return power_to_db(mel, ref_value=self.ref_value, amin=self.amin, top_db=self.top_db)
+
+
+class MFCC(Layer):
+    """Mel-frequency cepstral coefficients (layers.py:309)."""
+
+    def __init__(
+        self,
+        sr: int = 22050,
+        n_mfcc: int = 40,
+        norm: str = "ortho",
+        dtype: str = "float32",
+        **melkwargs,
+    ):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(sr=sr, dtype=dtype, **melkwargs)
+        n_mels = self._log_melspectrogram._melspectrogram.n_mels
+        assert n_mfcc <= n_mels, "n_mfcc cannot be larger than n_mels"
+        self.dct_matrix = create_dct(n_mfcc=n_mfcc, n_mels=n_mels, norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        log_mel = self._log_melspectrogram(x)  # [..., n_mels, frames]
+        mfcc = jnp.einsum("...mf,mk->...kf", log_mel._value, self.dct_matrix._value)
+        return Tensor(mfcc)

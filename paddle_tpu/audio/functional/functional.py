@@ -1,0 +1,132 @@
+"""Audio DSP helpers (reference python/paddle/audio/functional/functional.py).
+
+librosa/slaney-compatible mel math on jnp; everything here is pure and
+jit-traceable so feature layers compile into single XLA programs.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dtype import to_jax_dtype, convert_dtype
+
+
+def _as_array(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Hz -> mel (functional.py:22). Slaney by default, HTK optional."""
+    is_tensor = isinstance(freq, Tensor)
+    f = _as_array(freq)
+    if htk:
+        if is_tensor:
+            return Tensor(2595.0 * jnp.log10(1.0 + f / 700.0))
+        return 2595.0 * math.log10(1.0 + f / 700.0)
+    f_sp = 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    if is_tensor:
+        mels = jnp.where(f >= min_log_hz, min_log_mel + jnp.log(jnp.maximum(f, min_log_hz) / min_log_hz) / logstep, f / f_sp)
+        return Tensor(mels)
+    if f >= min_log_hz:
+        return min_log_mel + math.log(f / min_log_hz) / logstep
+    return f / f_sp
+
+
+def mel_to_hz(mel, htk: bool = False):
+    """mel -> Hz (functional.py:78)."""
+    is_tensor = isinstance(mel, Tensor)
+    m = _as_array(mel)
+    if htk:
+        if is_tensor:
+            return Tensor(700.0 * (10.0 ** (m / 2595.0) - 1.0))
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    f_sp = 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    if is_tensor:
+        hz = jnp.where(m >= min_log_mel, min_log_hz * jnp.exp(logstep * (m - min_log_mel)), f_sp * m)
+        return Tensor(hz)
+    if m >= min_log_mel:
+        return min_log_hz * math.exp(logstep * (m - min_log_mel))
+    return f_sp * m
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0, f_max: float = 11025.0, htk: bool = False, dtype: str = "float32") -> Tensor:
+    """n_mels+2-free center frequencies (functional.py:123)."""
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(lo, hi, n_mels).astype(to_jax_dtype(convert_dtype(dtype)))
+    return mel_to_hz(Tensor(mels), htk)
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32") -> Tensor:
+    """rfft bin centers (functional.py:163)."""
+    return Tensor(jnp.linspace(0, float(sr) / 2, 1 + n_fft // 2).astype(to_jax_dtype(convert_dtype(dtype))))
+
+
+def compute_fbank_matrix(
+    sr: int,
+    n_fft: int,
+    n_mels: int = 64,
+    f_min: float = 0.0,
+    f_max: Optional[float] = None,
+    htk: bool = False,
+    norm: Union[str, float] = "slaney",
+    dtype: str = "float32",
+) -> Tensor:
+    """Mel filterbank [n_mels, n_fft//2+1] (functional.py:186)."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    jdt = to_jax_dtype(convert_dtype(dtype))
+    fftfreqs = fft_frequencies(sr, n_fft, dtype)._value
+    lo, hi = hz_to_mel(f_min, htk), hz_to_mel(f_max, htk)
+    mel_f = mel_to_hz(Tensor(jnp.linspace(lo, hi, n_mels + 2)), htk)._value
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]  # [n_mels+2, n_bins]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2 : n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        weights = weights / jnp.maximum(jnp.linalg.norm(weights, ord=norm, axis=-1, keepdims=True), 1e-10)
+    return Tensor(weights.astype(jdt))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10, top_db: Optional[float] = 80.0) -> Tensor:
+    """Power spectrogram -> dB (functional.py:259)."""
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if ref_value <= 0:
+        raise ValueError("ref_value must be strictly positive")
+    x = _as_array(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho", dtype: str = "float32") -> Tensor:
+    """DCT-II matrix [n_mels, n_mfcc] (functional.py:303)."""
+    jdt = to_jax_dtype(convert_dtype(dtype))
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[:, None]
+    dct = jnp.cos(math.pi / float(n_mels) * (n + 0.5) * k)  # [n_mfcc, n_mels]
+    if norm is None:
+        dct = dct * 2.0
+    else:
+        assert norm == "ortho"
+        dct = dct * jnp.where(k == 0, math.sqrt(1.0 / (4.0 * n_mels)) * 2, math.sqrt(1.0 / (2.0 * n_mels)) * 2)
+    return Tensor(dct.T.astype(jdt))

@@ -1,0 +1,191 @@
+"""Window functions (reference python/paddle/audio/functional/window.py).
+
+scipy.signal.windows-consistent shapes, computed with numpy at layer-build
+time (windows are static per layer, so device placement happens once when the
+feature layer jits its first call).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple, Union
+
+import numpy as np
+
+from ...core.dtype import convert_dtype, to_jax_dtype
+from ...core.tensor import Tensor
+
+
+def _extend(M: int, sym: bool):
+    return (M, False) if sym else (M + 1, True)
+
+
+def _truncate(w, needs_trunc):
+    return w[:-1] if needs_trunc else w
+
+
+def _general_cosine(M, a, sym):
+    if M <= 0:
+        return np.zeros(0)
+    M, needs_trunc = _extend(M, sym)
+    fac = np.linspace(-math.pi, math.pi, M)
+    w = np.zeros(M)
+    for k, coef in enumerate(a):
+        w += coef * np.cos(k * fac)
+    return _truncate(w, needs_trunc)
+
+
+def _general_hamming(M, alpha, sym):
+    return _general_cosine(M, [alpha, 1.0 - alpha], sym)
+
+
+def _hamming(M, sym=True):
+    return _general_hamming(M, 0.54, sym)
+
+
+def _hann(M, sym=True):
+    return _general_hamming(M, 0.5, sym)
+
+
+def _blackman(M, sym=True):
+    return _general_cosine(M, [0.42, 0.50, 0.08], sym)
+
+
+def _cosine(M, sym=True):
+    if M <= 0:
+        return np.zeros(0)
+    M, needs_trunc = _extend(M, sym)
+    w = np.sin(math.pi / M * (np.arange(0, M) + 0.5))
+    return _truncate(w, needs_trunc)
+
+
+def _triang(M, sym=True):
+    if M <= 0:
+        return np.zeros(0)
+    M, needs_trunc = _extend(M, sym)
+    n = np.arange(1, (M + 1) // 2 + 1)
+    if M % 2 == 0:
+        w = (2 * n - 1.0) / M
+        w = np.concatenate([w, w[::-1]])
+    else:
+        w = 2 * n / (M + 1.0)
+        w = np.concatenate([w, w[-2::-1]])
+    return _truncate(w, needs_trunc)
+
+
+def _bohman(M, sym=True):
+    if M <= 0:
+        return np.zeros(0)
+    M, needs_trunc = _extend(M, sym)
+    fac = np.abs(np.linspace(-1, 1, M)[1:-1])
+    w = (1 - fac) * np.cos(math.pi * fac) + 1.0 / math.pi * np.sin(math.pi * fac)
+    w = np.concatenate([[0], w, [0]])
+    return _truncate(w, needs_trunc)
+
+
+def _gaussian(M, std, sym=True):
+    if M <= 0:
+        return np.zeros(0)
+    M, needs_trunc = _extend(M, sym)
+    n = np.arange(0, M) - (M - 1.0) / 2.0
+    w = np.exp(-(n**2) / (2 * std * std))
+    return _truncate(w, needs_trunc)
+
+
+def _general_gaussian(M, p, sig, sym=True):
+    if M <= 0:
+        return np.zeros(0)
+    M, needs_trunc = _extend(M, sym)
+    n = np.arange(0, M) - (M - 1.0) / 2.0
+    w = np.exp(-0.5 * np.abs(n / sig) ** (2 * p))
+    return _truncate(w, needs_trunc)
+
+
+def _exponential(M, center=None, tau=1.0, sym=True):
+    if sym and center is not None:
+        raise ValueError("If sym==True, center must be None.")
+    if M <= 0:
+        return np.zeros(0)
+    M, needs_trunc = _extend(M, sym)
+    if center is None:
+        center = (M - 1) / 2
+    n = np.arange(0, M)
+    w = np.exp(-np.abs(n - center) / tau)
+    return _truncate(w, needs_trunc)
+
+
+def _tukey(M, alpha=0.5, sym=True):
+    if M <= 0:
+        return np.zeros(0)
+    if alpha <= 0:
+        return np.ones(M)
+    if alpha >= 1.0:
+        return _hann(M, sym)
+    M, needs_trunc = _extend(M, sym)
+    n = np.arange(0, M)
+    width = int(np.floor(alpha * (M - 1) / 2.0))
+    n1, n2, n3 = n[: width + 1], n[width + 1 : M - width - 1], n[M - width - 1 :]
+    w1 = 0.5 * (1 + np.cos(math.pi * (-1 + 2.0 * n1 / alpha / (M - 1))))
+    w2 = np.ones(n2.shape)
+    w3 = 0.5 * (1 + np.cos(math.pi * (-2.0 / alpha + 1 + 2.0 * n3 / alpha / (M - 1))))
+    w = np.concatenate([w1, w2, w3])
+    return _truncate(w, needs_trunc)
+
+
+def _taylor(M, nbar=4, sll=30, norm=True, sym=True):
+    if M <= 0:
+        return np.zeros(0)
+    M, needs_trunc = _extend(M, sym)
+    B = 10 ** (sll / 20)
+    A = math.acosh(B) / math.pi
+    s2 = nbar**2 / (A**2 + (nbar - 0.5) ** 2)
+    ma = np.arange(1, nbar)
+    Fm = np.zeros(nbar - 1)
+    signs = np.empty_like(ma)
+    signs[::2] = 1
+    signs[1::2] = -1
+    m2 = ma * ma
+    for mi, _ in enumerate(ma):
+        numer = signs[mi] * np.prod(1 - m2[mi] / s2 / (A**2 + (ma - 0.5) ** 2))
+        denom = 2 * np.prod(1 - m2[mi] / m2[:mi]) * np.prod(1 - m2[mi] / m2[mi + 1 :])
+        Fm[mi] = numer / denom
+
+    def W(n):
+        return 1 + 2 * np.dot(Fm, np.cos(2 * math.pi * ma[:, None] * (n - M / 2.0 + 0.5) / M))
+
+    w = W(np.arange(0, M))
+    if norm:
+        w = w / W((M - 1) / 2)
+    return _truncate(w, needs_trunc)
+
+
+_WINDOWS = {
+    "hamming": _hamming,
+    "hann": _hann,
+    "blackman": _blackman,
+    "cosine": _cosine,
+    "triang": _triang,
+    "bohman": _bohman,
+    "gaussian": _gaussian,
+    "general_gaussian": _general_gaussian,
+    "exponential": _exponential,
+    "tukey": _tukey,
+    "taylor": _taylor,
+}
+
+
+def get_window(window: Union[str, Tuple], win_length: int, fftbins: bool = True, dtype: str = "float64") -> Tensor:
+    """scipy-style window dispatch (window.py:335)."""
+    sym = not fftbins
+    if isinstance(window, tuple):
+        name, args = window[0], tuple(window[1:])
+    elif isinstance(window, str):
+        name, args = window, ()
+        if name in ("gaussian", "exponential", "general_gaussian"):
+            raise ValueError(f"The '{name}' window needs one or more parameters -- pass a tuple.")
+    else:
+        raise ValueError(f"The window type {type(window)} is not supported")
+    if name not in _WINDOWS:
+        raise ValueError(f"Unknown window type: {name}")
+    w = _WINDOWS[name](win_length, *args, sym=sym)
+    return Tensor(w.astype(np.dtype(str(to_jax_dtype(convert_dtype(dtype))))))

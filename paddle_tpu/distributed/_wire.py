@@ -51,8 +51,22 @@ def client_handshake(sock: socket.socket) -> None:
     sock.sendall(struct.pack("!H", len(tok)) + tok)
 
 
+def _peer_is_loopback(sock: socket.socket) -> bool:
+    try:
+        host = sock.getpeername()[0]
+    except OSError:
+        return False
+    return host == "::1" or host.startswith("127.")
+
+
 def server_handshake(sock: socket.socket) -> bool:
-    """Read the client's token; True iff it matches ours (constant-time)."""
+    """Read the client's token; True iff it matches ours (constant-time).
+
+    With no secret configured, only loopback peers are accepted — an empty
+    token must never open the pickle channel to the network at large.
+    """
     (n,) = struct.unpack("!H", _recv_exact(sock, 2))
     tok = _recv_exact(sock, n) if n else b""
+    if not secret():
+        return not tok and _peer_is_loopback(sock)
     return hmac.compare_digest(tok, secret())

@@ -29,12 +29,15 @@ ELASTIC_AUTO_PARALLEL_EXIT_CODE = 101
 class KVMaster:
     """Lease-aware KV store served over TCP — the rendezvous master."""
 
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        # loopback by default; multi-host deployments must pass a routable
+        # bind host AND set PADDLE_RPC_SECRET (unauthenticated non-loopback
+        # peers are rejected at handshake)
         self._data: Dict[str, Tuple[object, float]] = {}  # key -> (value, expiry)
         self._lock = threading.Lock()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind(("0.0.0.0", port))
+        self._srv.bind((host, port))
         self._srv.listen(64)
         self.port = self._srv.getsockname()[1]
         self._stop = threading.Event()
@@ -52,30 +55,31 @@ class KVMaster:
     def _handle(self, conn):
         try:
             with conn:
+                conn.settimeout(30)  # stalled/scanner peers must not pin a thread
                 if not server_handshake(conn):
                     return
                 req = recv_msg(conn)
                 op, key = req.get("op"), req.get("key", "")
                 now = time.time()
-                with self._lock:
+                with self._lock:  # compute under lock, send after releasing it
                     expired = [k for k, (_, exp) in self._data.items() if exp and exp < now]
                     for k in expired:
                         del self._data[k]
                     if op == "put":
                         ttl = req.get("ttl", 0)
                         self._data[key] = (req.get("value"), now + ttl if ttl else 0)
-                        send_msg(conn, {"ok": True})
+                        resp = {"ok": True}
                     elif op == "get":
                         val = self._data.get(key)
-                        send_msg(conn, {"ok": True, "value": val[0] if val else None})
+                        resp = {"ok": True, "value": val[0] if val else None}
                     elif op == "scan":
-                        out = {k: v for k, (v, _) in self._data.items() if k.startswith(key)}
-                        send_msg(conn, {"ok": True, "value": out})
+                        resp = {"ok": True, "value": {k: v for k, (v, _) in self._data.items() if k.startswith(key)}}
                     elif op == "delete":
                         self._data.pop(key, None)
-                        send_msg(conn, {"ok": True})
+                        resp = {"ok": True}
                     else:
-                        send_msg(conn, {"ok": False, "error": f"bad op {op}"})
+                        resp = {"ok": False, "error": f"bad op {op}"}
+                send_msg(conn, resp)
         except (ConnectionError, EOFError, OSError):
             pass
 

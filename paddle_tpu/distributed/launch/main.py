@@ -41,15 +41,32 @@ def _worker_env(args, local_rank: int, world: int) -> dict:
     return env
 
 
+def _current_nnodes(args) -> int:
+    """Host count for the next launch round: elastic master wins when present."""
+    master = os.environ.get("PADDLE_ELASTIC_SERVER")
+    if master:
+        try:
+            from ..fleet.elastic import KVClient
+
+            job = os.environ.get("PADDLE_JOB_ID", args.job_id)
+            hosts = KVClient(master).scan(f"/elastic/{job}/hosts/")
+            if hosts:
+                return len(hosts)
+        except (OSError, RuntimeError, ConnectionError):
+            pass
+    return int(str(args.nnodes).split(":")[0])
+
+
 def launch(args=None):
     args = args if args is not None else _parse_args()
-    nnodes = int(str(args.nnodes).split(":")[0])
-    world = nnodes * args.nproc_per_node
     os.makedirs(args.log_dir, exist_ok=True)
 
     procs = []
     restarts = 0
     while True:
+        # recompute the world each round so a rescale relaunch sees the
+        # post-rescale membership, not the original --nnodes
+        world = _current_nnodes(args) * args.nproc_per_node
         for lr in range(args.nproc_per_node):
             log = open(os.path.join(args.log_dir, f"workerlog.{lr}"), "a")
             cmd = [sys.executable, args.training_script, *args.training_script_args]
@@ -64,17 +81,19 @@ def launch(args=None):
             return 0
         from ..fleet.elastic import ELASTIC_AUTO_PARALLEL_EXIT_CODE
 
-        if any(c == ELASTIC_AUTO_PARALLEL_EXIT_CODE for c in codes):
-            # rescale request, not a failure: relaunch with the current world
-            # (workers re-read membership from the elastic master) and do not
-            # burn a restart credit
-            print(f"rescale requested (exit {ELASTIC_AUTO_PARALLEL_EXIT_CODE}); relaunching", file=sys.stderr)
-        else:
+        failures = [c for c in codes if c not in (0, ELASTIC_AUTO_PARALLEL_EXIT_CODE)]
+        if failures:
+            # real failures burn restart credits even if a sibling asked for a
+            # rescale in the same round
             restarts += 1
             if restarts > args.max_restart:
                 print(f"workers failed with {codes} after {restarts - 1} restarts", file=sys.stderr)
-                return max(codes)
+                return max(failures)
             print(f"worker failure {codes}; elastic restart {restarts}/{args.max_restart}", file=sys.stderr)
+        else:
+            # pure rescale request: relaunch with the recomputed world, no
+            # restart credit burned
+            print(f"rescale requested (exit {ELASTIC_AUTO_PARALLEL_EXIT_CODE}); relaunching", file=sys.stderr)
         procs = []
         time.sleep(1)
 

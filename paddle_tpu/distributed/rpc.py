@@ -51,6 +51,7 @@ _shutdown = threading.Event()
 def _serve_conn(conn: socket.socket):
     try:
         with conn:
+            conn.settimeout(30)  # stalled peers must not pin a thread
             if not server_handshake(conn):
                 return  # unauthenticated peer: drop before touching pickle
             req = recv_msg(conn)

@@ -1,0 +1,351 @@
+"""Text datasets (reference python/paddle/text/datasets/: conll05.py, imdb.py,
+imikolov.py, movielens.py, uci_housing.py, wmt14.py, wmt16.py).
+
+The reference streams tarballs from paddle's dataset CDN. This environment has
+zero egress, so each dataset reads a local `data_file` when given one and
+otherwise synthesizes a deterministic corpus with the same record schema
+(field count, dtypes, vocab behavior) — the same hermetic-fallback contract as
+paddle_tpu.vision.datasets.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import tarfile
+from typing import Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class UCIHousing(Dataset):
+    """13 float features -> 1 float target (uci_housing.py analog)."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train", download: bool = False, n_synthetic: int = 404):
+        mode = mode.lower()
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+        else:
+            if download:
+                raise RuntimeError("downloads unavailable; pass data_file")
+            rng = np.random.RandomState(0)
+            w = rng.rand(self.FEATURE_DIM).astype(np.float32)
+            X = rng.rand(n_synthetic + 102, self.FEATURE_DIM).astype(np.float32)
+            y = X @ w + 0.1 * rng.randn(len(X)).astype(np.float32)
+            raw = np.concatenate([X, y[:, None]], axis=1)
+        # reference normalizes features then splits 8:2
+        feats = raw[:, :-1]
+        feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-8)
+        raw = np.concatenate([feats, raw[:, -1:]], axis=1)
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1].astype(np.float32), row[-1:].astype(np.float32)
+
+    def __len__(self):
+        return len(self.data)
+
+
+def _synthetic_docs(rng, n_docs, vocab_size, lo=10, hi=120):
+    return [rng.randint(2, vocab_size, size=rng.randint(lo, hi)).astype(np.int64) for _ in range(n_docs)]
+
+
+class Imdb(Dataset):
+    """Binary sentiment docs as word-id arrays (imdb.py analog)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train", cutoff: int = 150, download: bool = False, n_synthetic: int = 256):
+        mode = mode.lower()
+        if data_file and os.path.exists(data_file):
+            self.docs, self.labels, self.word_idx = self._load(data_file, mode, cutoff)
+        else:
+            if download:
+                raise RuntimeError("downloads unavailable; pass data_file")
+            vocab = 2000
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.docs = _synthetic_docs(rng, n_synthetic, vocab)
+            self.labels = rng.randint(0, 2, size=n_synthetic).astype(np.int64)
+            self.word_idx = {f"w{i}": i for i in range(vocab)}
+
+    def _load(self, data_file, mode, cutoff):
+        import re
+
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        tok = re.compile(r"[A-Za-z]+")
+        freq: dict = {}
+        texts, labels = [], []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                match = pat.match(m.name)
+                if match:
+                    words = [w.lower() for w in tok.findall(tf.extractfile(m).read().decode("utf-8", "ignore"))]
+                    texts.append(words)
+                    labels.append(1 if match.group(1) == "pos" else 0)
+                    for w in words:
+                        freq[w] = freq.get(w, 0) + 1
+        kept = sorted((w for w, c in freq.items() if c >= cutoff), key=lambda w: (-freq[w], w))
+        word_idx = {w: i + 2 for i, w in enumerate(kept)}  # 0=pad, 1=oov
+        docs = [np.asarray([word_idx.get(w, 1) for w in ws], np.int64) for ws in texts]
+        return docs, np.asarray(labels, np.int64), word_idx
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram tuples (imikolov.py analog)."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type: str = "NGRAM", window_size: int = 5, mode: str = "train", min_word_freq: int = 50, download: bool = False, n_synthetic: int = 512):
+        mode = mode.lower()
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        if data_file and os.path.exists(data_file):
+            sents, self.word_idx = self._load(data_file, mode, min_word_freq)
+        else:
+            if download:
+                raise RuntimeError("downloads unavailable; pass data_file")
+            vocab = 500
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            sents = _synthetic_docs(rng, n_synthetic // 4, vocab, lo=window_size + 1, hi=40)
+            self.word_idx = {f"w{i}": i for i in range(vocab)}
+        self.data = []
+        for s in sents:
+            if self.data_type == "NGRAM":
+                for i in range(window_size, len(s)):
+                    self.data.append(np.asarray(s[i - window_size : i + 1], np.int64))
+            else:  # SEQ
+                self.data.append((np.asarray(s[:-1], np.int64), np.asarray(s[1:], np.int64)))
+
+    def _load(self, data_file, mode, min_word_freq):
+        member = f"./simple-examples/data/ptb.{'train' if mode == 'train' else 'valid'}.txt"
+        with tarfile.open(data_file) as tf:
+            names = tf.getnames()
+            name = member if member in names else member[2:]
+            lines = tf.extractfile(name).read().decode().splitlines()
+        freq: dict = {}
+        for ln in lines:
+            for w in ln.split():
+                freq[w] = freq.get(w, 0) + 1
+        kept = sorted((w for w, c in freq.items() if c >= min_word_freq), key=lambda w: (-freq[w], w))
+        word_idx = {w: i + 1 for i, w in enumerate(kept)}  # 0 = <unk>
+        sents = [np.asarray([word_idx.get(w, 0) for w in ln.split()], np.int64) for ln in lines if ln.strip()]
+        return sents, word_idx
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """(user_feats, movie_feats, rating) records (movielens.py analog)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train", test_ratio: float = 0.1, rand_seed: int = 0, download: bool = False, n_synthetic: int = 1024):
+        mode = mode.lower()
+        rng = np.random.RandomState(rand_seed)
+        if data_file and os.path.exists(data_file):
+            records = self._load(data_file)
+        else:
+            if download:
+                raise RuntimeError("downloads unavailable; pass data_file")
+            records = []
+            for _ in range(n_synthetic):
+                user = [rng.randint(1, 6041), rng.randint(0, 2), rng.randint(0, 7), rng.randint(0, 21)]
+                movie = [rng.randint(1, 3953), rng.randint(0, 18), rng.randint(0, 5000)]
+                records.append((np.asarray(user, np.int64), np.asarray(movie, np.int64), np.float32(rng.randint(1, 6))))
+        is_test = rng.rand(len(records)) < test_ratio
+        self.data = [r for r, t in zip(records, is_test) if t == (mode == "test")]
+
+    def _load(self, data_file):
+        records = []
+        with tarfile.open(data_file) as tf:
+            ratings = [m for m in tf.getnames() if m.endswith("ratings.dat")][0]
+            for ln in tf.extractfile(ratings).read().decode("latin1").splitlines():
+                u, m, r, _ = ln.split("::")
+                records.append(
+                    (np.asarray([int(u), 0, 0, 0], np.int64), np.asarray([int(m), 0, 0], np.int64), np.float32(r))
+                )
+        return records
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """SRL records: (words, predicate, marks, labels) (conll05.py analog).
+
+    Real-data path: ``data_file`` is a CoNLL-style text file — one token per
+    line as "word<TAB>label", a "1" in a third column marking the predicate,
+    blank line between sentences.
+    """
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train", download: bool = False, n_synthetic: int = 128):
+        if download and not (data_file and os.path.exists(data_file)):
+            raise RuntimeError("downloads unavailable; pass data_file")
+        if data_file and os.path.exists(data_file):
+            self.data, self.word_dict, self.label_dict = self._load(data_file)
+            self.predicate_dict = dict(self.word_dict)
+            return
+        vocab, n_labels = 800, 20
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.data = []
+        for _ in range(n_synthetic):
+            n = rng.randint(5, 40)
+            words = rng.randint(2, vocab, size=n).astype(np.int64)
+            pred_pos = rng.randint(0, n)
+            marks = np.zeros(n, np.int64)
+            marks[pred_pos] = 1
+            labels = rng.randint(0, n_labels, size=n).astype(np.int64)
+            self.data.append((words, np.int64(words[pred_pos]), marks, labels))
+        self.word_dict = {f"w{i}": i for i in range(vocab)}
+        self.label_dict = {f"L{i}": i for i in range(n_labels)}
+        self.predicate_dict = dict(self.word_dict)
+
+    @staticmethod
+    def _load(data_file):
+        opener = gzip.open if data_file.endswith(".gz") else open
+        sents, sent = [], []
+        with opener(data_file, "rt") as f:
+            for ln in f:
+                ln = ln.rstrip("\n")
+                if not ln.strip():
+                    if sent:
+                        sents.append(sent)
+                        sent = []
+                    continue
+                cols = ln.split("\t") if "\t" in ln else ln.split()
+                word, label = cols[0], cols[1] if len(cols) > 1 else "O"
+                is_pred = len(cols) > 2 and cols[2] == "1"
+                sent.append((word, label, is_pred))
+        if sent:
+            sents.append(sent)
+        word_dict: dict = {}
+        label_dict: dict = {}
+        data = []
+        for s in sents:
+            for w, l, _ in s:
+                word_dict.setdefault(w, len(word_dict))
+                label_dict.setdefault(l, len(label_dict))
+            words = np.asarray([word_dict[w] for w, _, _ in s], np.int64)
+            labels = np.asarray([label_dict[l] for _, l, _ in s], np.int64)
+            marks = np.asarray([1 if p else 0 for _, _, p in s], np.int64)
+            pred_pos = int(marks.argmax()) if marks.any() else 0
+            marks = np.zeros(len(s), np.int64)
+            marks[pred_pos] = 1
+            data.append((words, np.int64(words[pred_pos]), marks, labels))
+        return data, word_dict, label_dict
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _WMTBase(Dataset):
+    """Parallel-corpus records (src_ids, trg_in_ids, trg_out_ids).
+
+    Real-data path: ``data_file`` is a plain (optionally .gz) text file of
+    tab-separated parallel lines "src sentence<TAB>trg sentence"; vocabularies
+    are built by frequency and truncated to the requested dict sizes.
+    """
+
+    BOS, EOS, UNK = 0, 1, 2
+    _SPECIALS = ["<s>", "<e>", "<unk>"]
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train", src_dict_size: int = 1000, trg_dict_size: int = 1000, download: bool = False, n_synthetic: int = 256, lang: str = "en"):
+        mode = mode.lower()
+        self.lang = lang
+        if download and not (data_file and os.path.exists(data_file)):
+            raise RuntimeError("downloads unavailable; pass data_file")
+        src_dict_size = max(src_dict_size, 10)
+        trg_dict_size = max(trg_dict_size, 10)
+        if data_file and os.path.exists(data_file):
+            self.data, self.src_dict, self.trg_dict = self._load(data_file, src_dict_size, trg_dict_size)
+            return
+        self.src_dict = {(self._SPECIALS[i] if i < 3 else f"s{i}"): i for i in range(src_dict_size)}
+        self.trg_dict = {(self._SPECIALS[i] if i < 3 else f"t{i}"): i for i in range(trg_dict_size)}
+        rng = np.random.RandomState({"train": 0, "test": 1, "dev": 2, "val": 2}.get(mode, 3))
+        self.data = []
+        for _ in range(n_synthetic):
+            ns, nt = rng.randint(4, 30), rng.randint(4, 30)
+            src = rng.randint(3, src_dict_size, size=ns).astype(np.int64)
+            trg = rng.randint(3, trg_dict_size, size=nt).astype(np.int64)
+            trg_in = np.concatenate([[self.BOS], trg])
+            trg_out = np.concatenate([trg, [self.EOS]])
+            self.data.append((src, trg_in.astype(np.int64), trg_out.astype(np.int64)))
+
+    @classmethod
+    def _build_vocab(cls, freq, size):
+        kept = sorted(freq, key=lambda w: (-freq[w], w))[: size - 3]
+        vocab = {s: i for i, s in enumerate(cls._SPECIALS)}
+        for w in kept:
+            vocab[w] = len(vocab)
+        return vocab
+
+    @classmethod
+    def _load(cls, data_file, src_dict_size, trg_dict_size):
+        opener = gzip.open if data_file.endswith(".gz") else open
+        pairs = []
+        src_freq: dict = {}
+        trg_freq: dict = {}
+        with opener(data_file, "rt") as f:
+            for ln in f:
+                if "\t" not in ln:
+                    continue
+                s, t = ln.rstrip("\n").split("\t", 1)
+                sw, tw = s.split(), t.split()
+                pairs.append((sw, tw))
+                for w in sw:
+                    src_freq[w] = src_freq.get(w, 0) + 1
+                for w in tw:
+                    trg_freq[w] = trg_freq.get(w, 0) + 1
+        src_dict = cls._build_vocab(src_freq, src_dict_size)
+        trg_dict = cls._build_vocab(trg_freq, trg_dict_size)
+        data = []
+        for sw, tw in pairs:
+            src = np.asarray([src_dict.get(w, cls.UNK) for w in sw], np.int64)
+            trg = [trg_dict.get(w, cls.UNK) for w in tw]
+            data.append(
+                (src, np.asarray([cls.BOS] + trg, np.int64), np.asarray(trg + [cls.EOS], np.int64))
+            )
+        return data, src_dict, trg_dict
+
+    def get_dict(self, lang=None, reverse=False):
+        d = self.src_dict if (lang or self.lang) == "en" else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(_WMTBase):
+    """EN->FR pairs (wmt14.py analog)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size: int = 1000, download: bool = False, n_synthetic: int = 256, lang: str = "en"):
+        super().__init__(data_file, mode, dict_size, dict_size, download, n_synthetic, lang)
+
+
+class WMT16(_WMTBase):
+    """EN->DE pairs (wmt16.py analog)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=1000, trg_dict_size=1000, lang="en", download: bool = False, n_synthetic: int = 256):
+        super().__init__(data_file, mode, src_dict_size, trg_dict_size, download, n_synthetic, lang)

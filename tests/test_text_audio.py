@@ -1,0 +1,224 @@
+"""text + audio package tests (reference test/legacy_test/test_viterbi_decode_op.py,
+test_audio_functions.py style: numeric parity vs numpy/scipy references)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np_viterbi(pot, trans, lengths, with_tags):
+    """Plain-python reference decoder."""
+    B, L, C = pot.shape
+    scores, paths = [], []
+    for b in range(B):
+        n = int(lengths[b])
+        alpha = pot[b, 0] + (trans[C - 2] if with_tags else 0.0)
+        bps = []
+        for t in range(1, n):
+            m = alpha[:, None] + trans
+            bps.append(m.argmax(0))
+            alpha = m.max(0) + pot[b, t]
+        final = alpha + (trans[:, C - 1] if with_tags else 0.0)
+        last = int(final.argmax())
+        scores.append(final.max())
+        path = [last]
+        for bp in reversed(bps):
+            path.append(int(bp[path[-1]]))
+        paths.append(list(reversed(path)))
+    maxlen = max(int(x) for x in lengths)
+    out = np.zeros((B, maxlen), np.int64)
+    for b, p in enumerate(paths):
+        out[b, : len(p)] = p
+    return np.asarray(scores, np.float32), out
+
+
+class TestViterbi:
+    @pytest.mark.parametrize("with_tags", [True, False])
+    def test_matches_reference(self, with_tags):
+        rng = np.random.RandomState(3)
+        B, L, C = 4, 7, 6
+        pot = rng.randn(B, L, C).astype(np.float32)
+        trans = rng.randn(C, C).astype(np.float32)
+        lengths = np.array([7, 3, 1, 5], np.int64)
+        ref_s, ref_p = _np_viterbi(pot, trans, lengths, with_tags)
+        s, p = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans), paddle.to_tensor(lengths), with_tags
+        )
+        np.testing.assert_allclose(s.numpy(), ref_s, rtol=1e-5)
+        np.testing.assert_array_equal(p.numpy(), ref_p)
+
+    def test_layer(self):
+        rng = np.random.RandomState(0)
+        trans = paddle.to_tensor(rng.randn(5, 5).astype(np.float32))
+        dec = paddle.text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+        pot = paddle.to_tensor(rng.randn(2, 4, 5).astype(np.float32))
+        lens = paddle.to_tensor(np.array([4, 2], np.int64))
+        s, p = dec(pot, lens)
+        assert list(s.shape) == [2] and list(p.shape) == [2, 4]
+
+
+class TestTextDatasets:
+    def test_uci_housing(self):
+        ds = paddle.text.UCIHousing(mode="train")
+        x, y = ds[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert len(paddle.text.UCIHousing(mode="test")) > 0
+
+    def test_imdb(self):
+        ds = paddle.text.Imdb(mode="train")
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        assert len(ds.word_idx) > 100
+
+    def test_imikolov_ngram(self):
+        ds = paddle.text.Imikolov(window_size=3)
+        assert ds[0].shape == (4,)
+
+    def test_movielens(self):
+        ds = paddle.text.Movielens(mode="train")
+        user, movie, rating = ds[0]
+        assert user.shape == (4,) and movie.shape == (3,) and 1 <= rating <= 5
+
+    def test_conll05(self):
+        ds = paddle.text.Conll05st()
+        words, pred, marks, labels = ds[0]
+        assert words.shape == marks.shape == labels.shape
+        assert marks.sum() == 1
+
+    def test_wmt(self):
+        for cls in (paddle.text.WMT14, paddle.text.WMT16):
+            ds = cls(mode="train")
+            src, trg_in, trg_out = ds[0]
+            assert trg_in[0] == 0 and trg_out[-1] == 1  # BOS / EOS
+
+    def test_wmt16_distinct_dict_sizes(self):
+        ds = paddle.text.WMT16(src_dict_size=64, trg_dict_size=128)
+        assert len(ds.get_dict("en")) == 64
+        assert len(ds.get_dict("de")) == 128
+
+    def test_wmt_real_file(self, tmp_path):
+        p = tmp_path / "pairs.txt"
+        p.write_text("the cat sat\tdie katze sass\nthe dog ran\tder hund lief\n")
+        ds = paddle.text.WMT16(data_file=str(p), src_dict_size=32, trg_dict_size=32)
+        assert len(ds) == 2
+        src, trg_in, trg_out = ds[0]
+        assert "the" in ds.src_dict and "katze" in ds.trg_dict
+        assert trg_in[0] == 0 and trg_out[-1] == 1
+
+    def test_conll_real_file(self, tmp_path):
+        p = tmp_path / "srl.txt"
+        p.write_text("He\tO\nate\tB-V\t1\npie\tB-A1\n\nShe\tO\nran\tB-V\t1\n")
+        ds = paddle.text.Conll05st(data_file=str(p))
+        assert len(ds) == 2
+        words, pred, marks, labels = ds[0]
+        assert len(words) == 3 and marks.tolist() == [0, 1, 0]
+        assert pred == ds.word_dict["ate"]
+
+
+class TestAudioFunctional:
+    def test_mel_roundtrip(self):
+        for htk in (True, False):
+            f = 440.0
+            mel = paddle.audio.functional.hz_to_mel(f, htk)
+            back = paddle.audio.functional.mel_to_hz(mel, htk)
+            assert abs(back - f) < 1e-3
+            t = paddle.to_tensor(np.array([100.0, 440.0, 8000.0], np.float32))
+            back_t = paddle.audio.functional.mel_to_hz(paddle.audio.functional.hz_to_mel(t, htk), htk)
+            np.testing.assert_allclose(back_t.numpy(), t.numpy(), rtol=1e-3)
+
+    def test_fft_frequencies(self):
+        got = paddle.audio.functional.fft_frequencies(16000, 512).numpy()
+        np.testing.assert_allclose(got, np.fft.rfftfreq(512, 1 / 16000), rtol=1e-5)
+
+    def test_fbank_shape_and_rows(self):
+        fb = paddle.audio.functional.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all() and fb.sum(axis=1).min() > 0  # every filter non-empty
+
+    def test_power_to_db(self):
+        x = paddle.to_tensor(np.array([1.0, 0.1, 1e-12], np.float32))
+        db = paddle.audio.functional.power_to_db(x, top_db=None).numpy()
+        np.testing.assert_allclose(db[:2], [0.0, -10.0], atol=1e-4)
+        assert db[2] == pytest.approx(-100.0, abs=1e-3)  # amin floor
+
+    def test_create_dct_ortho(self):
+        d = paddle.audio.functional.create_dct(13, 40).numpy()
+        assert d.shape == (40, 13)
+        # ortho DCT columns are orthonormal
+        np.testing.assert_allclose(d.T @ d, np.eye(13), atol=1e-4)
+
+    def test_get_window_scipy_parity(self):
+        try:
+            from scipy.signal import get_window as sp_get_window
+        except ImportError:
+            pytest.skip("scipy unavailable")
+        for name in ("hann", "hamming", "blackman", "triang", "bohman", "cosine"):
+            got = paddle.audio.functional.get_window(name, 64).numpy()
+            np.testing.assert_allclose(got, sp_get_window(name, 64, fftbins=True), atol=1e-8)
+        got = paddle.audio.functional.get_window(("gaussian", 7), 32).numpy()
+        np.testing.assert_allclose(got, sp_get_window(("gaussian", 7), 32, fftbins=True), atol=1e-8)
+
+    def test_get_window_param_required(self):
+        with pytest.raises(ValueError):
+            paddle.audio.functional.get_window("gaussian", 32)
+
+
+class TestAudioFeatures:
+    def test_spectrogram_shape(self):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4000).astype(np.float32))
+        layer = paddle.audio.features.Spectrogram(n_fft=256, hop_length=128)
+        out = layer(x)
+        assert out.shape[0] == 2 and out.shape[1] == 129
+
+    def test_melspectrogram_pure_tone(self):
+        sr, n_fft = 16000, 512
+        t = np.arange(sr) / sr
+        tone = np.sin(2 * np.pi * 1000 * t).astype(np.float32)
+        layer = paddle.audio.features.MelSpectrogram(sr=sr, n_fft=n_fft, hop_length=256, n_mels=40, f_min=0.0)
+        mel = layer(paddle.to_tensor(tone[None, :])).numpy()[0]
+        # energy concentrates at the mel bin whose center is nearest 1 kHz
+        centers = paddle.audio.functional.mel_frequencies(42, 0.0, sr / 2).numpy()[1:-1]
+        assert abs(centers[mel.mean(axis=1).argmax()] - 1000) < 200
+
+    def test_logmel_and_mfcc_shapes(self):
+        x = paddle.to_tensor(np.random.RandomState(1).randn(1, 8000).astype(np.float32))
+        lm = paddle.audio.features.LogMelSpectrogram(sr=8000, n_fft=256, hop_length=128, n_mels=32, f_min=0.0)(x)
+        assert lm.shape[1] == 32
+        mf = paddle.audio.features.MFCC(sr=8000, n_mfcc=13, n_fft=256, hop_length=128, n_mels=32, f_min=0.0)(x)
+        assert mf.shape[1] == 13
+
+
+class TestAudioBackend:
+    def test_save_load_roundtrip(self, tmp_path):
+        sr = 8000
+        t = np.arange(sr // 4) / sr
+        wav = (0.5 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)[None, :]
+        p = str(tmp_path / "tone.wav")
+        paddle.audio.save(p, paddle.to_tensor(wav), sr)
+        meta = paddle.audio.info(p)
+        assert meta.sample_rate == sr and meta.num_channels == 1 and meta.bits_per_sample == 16
+        loaded, sr2 = paddle.audio.load(p)
+        assert sr2 == sr
+        np.testing.assert_allclose(loaded.numpy(), wav, atol=1e-3)
+
+    def test_backend_listing(self):
+        assert paddle.audio.backends.get_current_audio_backend() == "wave_backend"
+        assert "wave_backend" in paddle.audio.backends.list_available_backends()
+
+
+class TestAudioDatasets:
+    def test_esc50_synthetic(self):
+        ds = paddle.audio.datasets.ESC50(mode="train", feat_type="raw", n_synthetic=8, duration=0.1)
+        wav, label = ds[0]
+        assert wav.ndim == 1 and 0 <= label < 50
+
+    def test_spectrogram_feat_type(self):
+        ds = paddle.audio.datasets.ESC50(mode="train", feat_type="spectrogram", n_synthetic=4, duration=0.05, n_fft=256, hop_length=128)
+        feat, _ = ds[0]
+        assert feat.shape[0] == 129
+
+    def test_tess_mfcc(self):
+        ds = paddle.audio.datasets.TESS(mode="train", feat_type="mfcc", n_synthetic=4, duration=0.1, n_mfcc=13, n_fft=256, hop_length=128, n_mels=32, f_min=0.0)
+        feat, label = ds[0]
+        assert feat.shape[0] == 13 and 0 <= label < 7
