@@ -78,6 +78,7 @@ from . import sparse  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import audio  # noqa: F401,E402
+from . import geometric  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
 from .param_attr import ParamAttr  # noqa: F401,E402

@@ -1,0 +1,28 @@
+"""Graph-learning ops (reference: python/paddle/geometric/__init__.py).
+
+The reference backs these with phi segment/graph kernels
+(phi/kernels/gpu/segment_pool_kernel.cu, graph_send_recv_kernel.cu,
+graph_sample_neighbors_kernel.cu). TPU-native split: message passing and
+segment reductions lower to jnp scatter/segment primitives (differentiable,
+jit-able when sizes are static); neighbor sampling and graph reindexing are
+host-side data-prep ops on numpy, matching their CPU-kernel role.
+"""
+
+from .math import segment_max, segment_mean, segment_min, segment_sum
+from .message_passing import send_u_recv, send_ue_recv, send_uv
+from .reindex import reindex_graph, reindex_heter_graph
+from .sampling import sample_neighbors, weighted_sample_neighbors
+
+__all__ = [
+    "send_u_recv",
+    "send_ue_recv",
+    "send_uv",
+    "segment_sum",
+    "segment_mean",
+    "segment_min",
+    "segment_max",
+    "reindex_graph",
+    "reindex_heter_graph",
+    "sample_neighbors",
+    "weighted_sample_neighbors",
+]

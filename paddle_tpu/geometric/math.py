@@ -1,0 +1,74 @@
+"""Segment reductions (reference: python/paddle/geometric/math.py, backed by
+phi/kernels/.../segment_pool_kernel).
+
+Lowering: jax.ops.segment_* — an XLA scatter-reduce, which TPU handles natively.
+`num_segments` must be static for jit; in eager mode it is read off the concrete
+ids (the reference's kernels do the same max()+1 scan on device).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.ops
+
+from ..core.op_registry import register_op
+from ..ops._dispatch import apply, as_tensor
+
+
+def segment_reduce(data, ids, n, reduce_op):
+    """Pure scatter-reduce of `data` rows into `n` segments by `ids`.
+
+    Single home for the reduction-identity conventions shared by segment_* and
+    the message-passing ops: empty segments yield 0 for every reduce_op, and
+    mean divides by max(count, 1).
+    """
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(data, ids, num_segments=n)
+    if reduce_op == "mean":
+        total = jax.ops.segment_sum(data, ids, num_segments=n)
+        counts = jax.ops.segment_sum(jnp.ones((ids.shape[0],), data.dtype), ids, num_segments=n)
+        shape = (n,) + (1,) * (data.ndim - 1)
+        return total / jnp.maximum(counts, 1).reshape(shape)
+    if reduce_op in ("min", "max"):
+        fn = jax.ops.segment_min if reduce_op == "min" else jax.ops.segment_max
+        out = fn(data, ids, num_segments=n)
+        # empty segments come back +/-inf from the identity; reference zeros them
+        counts = jax.ops.segment_sum(jnp.ones((ids.shape[0],), jnp.int32), ids, num_segments=n)
+        shape = (n,) + (1,) * (data.ndim - 1)
+        return jnp.where(counts.reshape(shape) > 0, out, jnp.zeros_like(out))
+    raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+
+
+def _num_segments(ids_t, num_segments):
+    if num_segments is not None:
+        return int(num_segments)
+    idv = ids_t._value
+    if idv.size == 0:
+        return 0
+    return int(jnp.max(idv)) + 1
+
+
+def _segment(op_name, reduce_op, data, segment_ids, num_segments):
+    data_t, ids_t = as_tensor(data), as_tensor(segment_ids)
+    n = _num_segments(ids_t, num_segments)
+    return apply(op_name, lambda dv, iv: segment_reduce(dv, iv, n, reduce_op), data_t, ids_t)
+
+
+@register_op("geometric_segment_sum")
+def segment_sum(data, segment_ids, num_segments=None, name=None):
+    return _segment("segment_sum", "sum", data, segment_ids, num_segments)
+
+
+@register_op("geometric_segment_mean")
+def segment_mean(data, segment_ids, num_segments=None, name=None):
+    return _segment("segment_mean", "mean", data, segment_ids, num_segments)
+
+
+@register_op("geometric_segment_min")
+def segment_min(data, segment_ids, num_segments=None, name=None):
+    return _segment("segment_min", "min", data, segment_ids, num_segments)
+
+
+@register_op("geometric_segment_max")
+def segment_max(data, segment_ids, num_segments=None, name=None):
+    return _segment("segment_max", "max", data, segment_ids, num_segments)

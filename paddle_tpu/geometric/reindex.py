@@ -1,0 +1,60 @@
+"""Graph reindexing (reference: python/paddle/geometric/reindex.py, backed by
+phi graph_reindex kernels).
+
+Host-side data-prep: compacts a sampled subgraph's global node ids to dense
+local ids (centers first, then neighbors in first-appearance order). Runs on
+numpy — this op feeds the input pipeline, not the compiled step, exactly the
+role the reference's CPU kernel plays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops._dispatch import as_tensor
+
+
+def _to_np(x):
+    return np.asarray(as_tensor(x).numpy())
+
+
+def _reindex(x, neighbors_list, count_list):
+    x = _to_np(x).astype(np.int64)
+    id_map = {int(n): i for i, n in enumerate(x)}
+    out_nodes = list(x)
+
+    def local(node):
+        node = int(node)
+        idx = id_map.get(node)
+        if idx is None:
+            idx = len(out_nodes)
+            id_map[node] = idx
+            out_nodes.append(node)
+        return idx
+
+    src_list, dst_list = [], []
+    for neighbors, count in zip(neighbors_list, count_list):
+        neighbors = _to_np(neighbors).astype(np.int64)
+        count = _to_np(count).astype(np.int64)
+        src_list.append(np.fromiter((local(n) for n in neighbors), np.int64, len(neighbors)))
+        dst_list.append(np.repeat(np.arange(len(count), dtype=np.int64), count))
+    reindex_src = np.concatenate(src_list) if src_list else np.zeros((0,), np.int64)
+    reindex_dst = np.concatenate(dst_list) if dst_list else np.zeros((0,), np.int64)
+    return (
+        Tensor(reindex_src, stop_gradient=True),
+        Tensor(reindex_dst, stop_gradient=True),
+        Tensor(np.asarray(out_nodes, np.int64), stop_gradient=True),
+    )
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None, name=None):
+    """Returns (reindex_src, reindex_dst, out_nodes). Buffers are accepted for
+    API parity; the hashmap path they enable on GPU is irrelevant host-side."""
+    return _reindex(x, [neighbors], [count])
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None, index_buffer=None, name=None):
+    """Heterogeneous variant: neighbors/count are per-edge-type lists sharing
+    one output id space."""
+    return _reindex(x, list(neighbors), list(count))

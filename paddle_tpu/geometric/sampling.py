@@ -1,0 +1,80 @@
+"""Neighbor sampling (reference: python/paddle/geometric/sampling/, backed by
+phi graph_sample_neighbors kernels).
+
+CSC-format graph: ``row`` holds row indices, ``colptr`` the per-node offsets.
+Host-side numpy op seeded from the framework Generator (phi::Generator analog)
+so runs are reproducible under paddle.seed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import random as _random
+from ..core.tensor import Tensor
+from ..ops._dispatch import as_tensor
+
+
+def _to_np(x):
+    return np.asarray(as_tensor(x).numpy())
+
+
+def _np_rng():
+    return np.random.default_rng(_random.default_generator.random())
+
+
+def _sample(row, colptr, input_nodes, sample_size, eids, return_eids, weight=None):
+    row = _to_np(row).astype(np.int64)
+    colptr = _to_np(colptr).astype(np.int64)
+    nodes = _to_np(input_nodes).astype(np.int64)
+    eid_arr = _to_np(eids).astype(np.int64) if eids is not None else None
+    if return_eids and eid_arr is None:
+        raise ValueError("return_eids=True requires eids")
+    w = _to_np(weight).astype(np.float64) if weight is not None else None
+
+    rng = _np_rng()
+    out_neighbors, out_eids, counts = [], [], np.zeros(len(nodes), np.int64)
+    for i, node in enumerate(nodes):
+        beg, end = colptr[node], colptr[node + 1]
+        deg = end - beg
+        if deg <= 0:
+            continue
+        if w is not None:
+            # weighted draws only ever touch nonzero-weight edges
+            nz = np.flatnonzero(w[beg:end])
+            if len(nz) == 0:
+                continue
+            if sample_size < 0 or len(nz) <= sample_size:
+                pick = beg + nz
+            else:
+                p = w[beg + nz]
+                pick = beg + rng.choice(nz, size=sample_size, replace=False, p=p / p.sum())
+        elif sample_size < 0 or deg <= sample_size:
+            pick = np.arange(beg, end)
+        else:
+            pick = beg + rng.choice(deg, size=sample_size, replace=False)
+        counts[i] = len(pick)
+        out_neighbors.append(row[pick])
+        if eid_arr is not None:
+            out_eids.append(eid_arr[pick])
+
+    neighbors = np.concatenate(out_neighbors) if out_neighbors else np.zeros((0,), np.int64)
+    result = [Tensor(neighbors, stop_gradient=True), Tensor(counts, stop_gradient=True)]
+    if return_eids:
+        eout = np.concatenate(out_eids) if out_eids else np.zeros((0,), np.int64)
+        result.append(Tensor(eout, stop_gradient=True))
+    return tuple(result)
+
+
+def sample_neighbors(
+    row, colptr, input_nodes, sample_size=-1, eids=None, return_eids=False, perm_buffer=None, name=None
+):
+    """Uniform neighbor sampling; returns (out_neighbors, out_count[, out_eids])."""
+    return _sample(row, colptr, input_nodes, sample_size, eids, return_eids)
+
+
+def weighted_sample_neighbors(
+    row, colptr, edge_weight, input_nodes, sample_size=-1, eids=None, return_eids=False, name=None
+):
+    """Weight-proportional sampling without replacement."""
+    return _sample(row, colptr, input_nodes, sample_size, eids, return_eids, weight=edge_weight)

@@ -18,6 +18,7 @@ from .common import (  # noqa: F401
     dropout3d,
     embedding,
     fold,
+    grid_sample,
     interpolate,
     label_smooth,
     linear,

@@ -286,3 +286,71 @@ def bilinear(x1, x2, weight, bias=None, name=None):
         return out
 
     return apply("bilinear", fn, *tensors)
+
+
+@register_op("nn.grid_sample")
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    """Spatial sampling by normalized flow field (reference:
+    python/paddle/nn/functional/vision.py grid_sample, phi grid_sample kernel).
+    4-D only: x NCHW, grid N,Hg,Wg,2 in [-1,1]. The gather vectorizes over the
+    full output plane so XLA emits one batched gather per corner.
+    """
+    x, grid = as_tensor(x), as_tensor(grid)
+    if len(x.shape) != 4:
+        raise NotImplementedError("grid_sample supports 4-D inputs (NCHW)")
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"mode must be 'bilinear' or 'nearest', got {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"padding_mode must be 'zeros', 'border' or 'reflection', got {padding_mode!r}")
+
+    def unnorm(coord, size):
+        if align_corners:
+            return (coord + 1.0) * 0.5 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) * 0.5
+
+    def reflect(coord, size):
+        if size <= 1:
+            return jnp.zeros_like(coord)
+        if align_corners:
+            span = 2.0 * (size - 1)
+            c = jnp.abs(jnp.mod(coord, span))
+            return jnp.where(c > size - 1, span - c, c)
+        span = 2.0 * size
+        c = jnp.mod(coord + 0.5, span)
+        c = jnp.abs(c)
+        c = jnp.where(c > size, span - c, c) - 0.5
+        return jnp.clip(c, 0, size - 1)
+
+    def fn(xv, gv):
+        n, c, h, w = xv.shape
+        ix = unnorm(gv[..., 0], w)
+        iy = unnorm(gv[..., 1], h)
+        if padding_mode == "reflection":
+            ix, iy = reflect(ix, w), reflect(iy, h)
+
+        def sample(iy_i, ix_i):
+            # per-corner validity BEFORE clipping drives the zeros mask
+            valid = (ix_i >= 0) & (ix_i <= w - 1) & (iy_i >= 0) & (iy_i <= h - 1)
+            ixc = jnp.clip(ix_i, 0, w - 1).astype(jnp.int32)
+            iyc = jnp.clip(iy_i, 0, h - 1).astype(jnp.int32)
+            bidx = jnp.arange(n).reshape(n, 1, 1)
+            vals = xv[bidx, :, iyc, ixc]  # n,Hg,Wg,c
+            if padding_mode == "zeros":
+                vals = jnp.where(valid[..., None], vals, 0.0)
+            return vals
+
+        if mode == "nearest":
+            out = sample(jnp.round(iy), jnp.round(ix))
+        else:
+            x0, y0 = jnp.floor(ix), jnp.floor(iy)
+            x1, y1 = x0 + 1, y0 + 1
+            wx, wy = ix - x0, iy - y0
+            out = (
+                sample(y0, x0) * ((1 - wy) * (1 - wx))[..., None]
+                + sample(y0, x1) * ((1 - wy) * wx)[..., None]
+                + sample(y1, x0) * (wy * (1 - wx))[..., None]
+                + sample(y1, x1) * (wy * wx)[..., None]
+            )
+        return jnp.transpose(out, (0, 3, 1, 2))
+
+    return apply("grid_sample", fn, x, grid)

@@ -315,3 +315,32 @@ def householder_product(x, tau, name=None):
         return jax.lax.fori_loop(0, k, body, eye)
 
     return apply("householder_product", f, x, tau)
+
+
+@register_op("cdist")
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    """Batched pairwise distance (reference: paddle.cdist / phi cdist kernel).
+
+    p==2 uses the gram-matrix expansion so the inner product runs on the MXU;
+    other p fall back to the broadcast |x-y|^p reduction.
+    """
+    if p < 0:
+        raise ValueError(f"cdist requires p >= 0, got {p}")
+    x, y = as_tensor(x), as_tensor(y)
+
+    def f(xv, yv):
+        if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
+            x2 = jnp.sum(xv * xv, -1)[..., :, None]
+            y2 = jnp.sum(yv * yv, -1)[..., None, :]
+            xy = jnp.matmul(xv, jnp.swapaxes(yv, -1, -2), preferred_element_type=_pref(xv.dtype))
+            if _pref(xv.dtype) is not None:
+                xy = xy.astype(xv.dtype)
+            return jnp.sqrt(jnp.maximum(x2 + y2 - 2 * xy, 0.0))
+        diff = jnp.abs(xv[..., :, None, :] - yv[..., None, :, :])
+        if p == 0:
+            return jnp.sum((diff != 0).astype(xv.dtype), -1)
+        if jnp.isinf(p):
+            return jnp.max(diff, -1)
+        return jnp.power(jnp.sum(jnp.power(diff, p), -1), 1.0 / p)
+
+    return apply("cdist", f, x, y)

@@ -416,3 +416,18 @@ def polygamma(x, n, name=None):
     if n == 0:
         return apply("polygamma", jax.scipy.special.digamma, x)
     return apply("polygamma", lambda v: jax.scipy.special.polygamma(n, v), x)
+
+
+@register_op("vander")
+def vander(x, n=None, increasing=False, name=None):
+    """Vandermonde matrix (reference: python/paddle/tensor/math.py vander)."""
+    x = as_tensor(x)
+    cols = x.shape[0] if n is None else int(n)
+
+    def f(v):
+        powers = jnp.arange(cols, dtype=v.dtype)
+        if not increasing:
+            powers = powers[::-1]
+        return v[:, None] ** powers[None, :]
+
+    return apply("vander", f, x)

@@ -115,7 +115,8 @@ class Optimizer:
                 cwd = self._coupled_wd()
                 if cwd:
                     gv = gv.astype(value.dtype) + cwd * value
-            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            # plain trainable Tensors (not Parameter) carry no optimize_attr
+            plr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             new_value, new_state = self._update(value, gv.astype(value.dtype), state, plr, param_meta=p)
             if "master_weight" in state:
                 new_state["master_weight"] = new_value

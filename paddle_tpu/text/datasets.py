@@ -75,7 +75,8 @@ class Imdb(Dataset):
     def _load(self, data_file, mode, cutoff):
         import re
 
-        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        # tolerate './'-prefixed member names (tar -czf x.tgz ./aclImdb)
+        pat = re.compile(rf"(?:\./)?aclImdb/{mode}/(pos|neg)/.*\.txt$")
         tok = re.compile(r"[A-Za-z]+")
         freq: dict = {}
         texts, labels = [], []

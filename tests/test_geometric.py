@@ -1,0 +1,147 @@
+"""Geometric (graph) ops: segment reductions, message passing, sampling,
+reindex — numeric checks vs numpy references (OpTest pattern, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(x, dtype=None):
+    a = np.asarray(x, dtype=dtype)
+    return paddle.to_tensor(a)
+
+
+def test_segment_reductions():
+    data = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0], [7.0, 8.0]], np.float32)
+    ids = np.array([0, 0, 1, 3], np.int32)  # segment 2 empty
+    out = paddle.geometric.segment_sum(_t(data), _t(ids))
+    np.testing.assert_allclose(out.numpy(), [[4, 6], [5, 6], [0, 0], [7, 8]])
+    out = paddle.geometric.segment_mean(_t(data), _t(ids))
+    np.testing.assert_allclose(out.numpy(), [[2, 3], [5, 6], [0, 0], [7, 8]])
+    out = paddle.geometric.segment_min(_t(data), _t(ids))
+    np.testing.assert_allclose(out.numpy(), [[1, 2], [5, 6], [0, 0], [7, 8]])
+    out = paddle.geometric.segment_max(_t(data), _t(ids))
+    np.testing.assert_allclose(out.numpy(), [[3, 4], [5, 6], [0, 0], [7, 8]])
+
+
+def test_segment_sum_grad():
+    data = _t(np.arange(8, dtype=np.float32).reshape(4, 2))
+    data.stop_gradient = False
+    out = paddle.geometric.segment_sum(data, _t(np.array([0, 1, 1, 0], np.int32)))
+    out.sum().backward()
+    np.testing.assert_allclose(data.grad.numpy(), np.ones((4, 2), np.float32))
+
+
+def test_send_u_recv():
+    x = _t(np.array([[0.0, 2.0], [1.0, 3.0], [2.0, 4.0]], np.float32))
+    src = _t(np.array([0, 1, 2, 0], np.int32))
+    dst = _t(np.array([1, 2, 1, 0], np.int32))
+    out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum")
+    np.testing.assert_allclose(out.numpy(), [[0, 2], [2, 6], [1, 3]])
+    out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="max")
+    np.testing.assert_allclose(out.numpy(), [[0, 2], [2, 4], [1, 3]])
+    # out_size larger than max id pads with zeros
+    out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum", out_size=5)
+    assert out.shape == [5, 2]
+    np.testing.assert_allclose(out.numpy()[3:], np.zeros((2, 2)))
+
+
+def test_send_ue_recv_and_uv():
+    x = _t(np.array([[1.0], [2.0], [3.0]], np.float32))
+    y = _t(np.array([[10.0], [20.0], [30.0]], np.float32))
+    e = _t(np.array([[0.5], [0.5], [2.0]], np.float32))
+    src = _t(np.array([0, 1, 2], np.int32))
+    dst = _t(np.array([2, 0, 1], np.int32))
+    out = paddle.geometric.send_ue_recv(x, e, src, dst, message_op="mul", reduce_op="sum")
+    np.testing.assert_allclose(out.numpy(), [[1.0], [6.0], [0.5]])
+    out = paddle.geometric.send_uv(x, y, src, dst, message_op="add")
+    np.testing.assert_allclose(out.numpy(), [[31.0], [12.0], [23.0]])
+
+
+def test_message_passing_grad():
+    x = _t(np.ones((3, 2), np.float32))
+    x.stop_gradient = False
+    src = _t(np.array([0, 1, 2, 0], np.int32))
+    dst = _t(np.array([1, 2, 1, 2], np.int32))
+    paddle.geometric.send_u_recv(x, src, dst).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[2, 2], [1, 1], [1, 1]])
+
+
+def test_reindex_graph():
+    x = _t(np.array([0, 5, 9], np.int64))
+    neighbors = _t(np.array([5, 9, 7, 0, 8], np.int64))
+    count = _t(np.array([2, 2, 1], np.int64))
+    src, dst, nodes = paddle.geometric.reindex_graph(x, neighbors, count)
+    np.testing.assert_array_equal(nodes.numpy(), [0, 5, 9, 7, 8])
+    np.testing.assert_array_equal(src.numpy(), [1, 2, 3, 0, 4])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 2])
+
+
+def test_reindex_heter_graph():
+    x = _t(np.array([2, 4], np.int64))
+    n1, c1 = _t(np.array([4, 6], np.int64)), _t(np.array([1, 1], np.int64))
+    n2, c2 = _t(np.array([6, 2], np.int64)), _t(np.array([1, 1], np.int64))
+    src, dst, nodes = paddle.geometric.reindex_heter_graph(x, [n1, n2], [c1, c2])
+    np.testing.assert_array_equal(nodes.numpy(), [2, 4, 6])
+    np.testing.assert_array_equal(src.numpy(), [1, 2, 2, 0])
+    np.testing.assert_array_equal(dst.numpy(), [0, 1, 0, 1])
+
+
+def test_sample_neighbors():
+    # CSC: node i's neighbors are row[colptr[i]:colptr[i+1]]
+    row = _t(np.array([1, 2, 3, 0, 2, 0, 1, 0], np.int64))
+    colptr = _t(np.array([0, 3, 5, 7, 8], np.int64))
+    nodes = _t(np.array([0, 2], np.int64))
+    paddle.seed(7)
+    neighbors, counts = paddle.geometric.sample_neighbors(row, colptr, nodes, sample_size=2)
+    np.testing.assert_array_equal(counts.numpy(), [2, 2])
+    assert set(neighbors.numpy()[:2]) <= {1, 2, 3}
+    assert set(neighbors.numpy()[2:]) <= {0, 1}
+    # full neighborhood when sample_size=-1
+    neighbors, counts = paddle.geometric.sample_neighbors(row, colptr, nodes, sample_size=-1)
+    np.testing.assert_array_equal(counts.numpy(), [3, 2])
+    # eids passthrough
+    eids = _t(np.arange(8, dtype=np.int64))
+    neighbors, counts, out_eids = paddle.geometric.sample_neighbors(
+        row, colptr, nodes, sample_size=-1, eids=eids, return_eids=True
+    )
+    np.testing.assert_array_equal(out_eids.numpy(), [0, 1, 2, 5, 6])
+
+
+def test_weighted_sample_neighbors():
+    row = _t(np.array([1, 2, 3], np.int64))
+    colptr = _t(np.array([0, 3], np.int64))
+    w = _t(np.array([0.0, 0.0, 1.0], np.float32))
+    paddle.seed(3)
+    neighbors, counts = paddle.geometric.weighted_sample_neighbors(row, colptr, w, _t(np.array([0], np.int64)), sample_size=1)
+    np.testing.assert_array_equal(neighbors.numpy(), [3])  # only nonzero-weight neighbor
+
+
+def test_vander_cdist_grid_sample():
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(paddle.vander(_t(x)).numpy(), np.vander(x))
+    np.testing.assert_allclose(paddle.vander(_t(x), n=2, increasing=True).numpy(), np.vander(x, 2, True))
+
+    a = np.random.RandomState(0).randn(2, 4, 3).astype(np.float32)
+    b = np.random.RandomState(1).randn(2, 5, 3).astype(np.float32)
+    got = paddle.cdist(_t(a), _t(b)).numpy()
+    want = np.linalg.norm(a[:, :, None, :] - b[:, None, :, :], axis=-1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    got = paddle.cdist(_t(a), _t(b), p=1.0).numpy()
+    want = np.abs(a[:, :, None, :] - b[:, None, :, :]).sum(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    torch = pytest.importorskip("torch")
+    xi = np.random.RandomState(2).randn(2, 3, 4, 5).astype(np.float32)
+    gi = np.random.RandomState(3).uniform(-1.2, 1.2, (2, 6, 7, 2)).astype(np.float32)
+    for mode in ("bilinear", "nearest"):
+        for pad in ("zeros", "border", "reflection"):
+            for ac in (True, False):
+                got = paddle.nn.functional.grid_sample(
+                    _t(xi), _t(gi), mode=mode, padding_mode=pad, align_corners=ac
+                ).numpy()
+                want = torch.nn.functional.grid_sample(
+                    torch.tensor(xi), torch.tensor(gi), mode=mode, padding_mode=pad, align_corners=ac
+                ).numpy()
+                np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5, err_msg=f"{mode}/{pad}/{ac}")
