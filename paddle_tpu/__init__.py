@@ -79,6 +79,17 @@ from . import device  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import audio  # noqa: F401,E402
 from . import geometric  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from . import hub  # noqa: F401,E402
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Forward-FLOPs of a Layer (reference: python/paddle/hapi/dynamic_flops.py)."""
+    from .utils.flops import dynamic_flops
+
+    return dynamic_flops(net, input_size, custom_ops=custom_ops, print_detail=print_detail)
 from .hapi import Model, summary  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
 from .param_attr import ParamAttr  # noqa: F401,E402

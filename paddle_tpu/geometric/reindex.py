@@ -21,30 +21,26 @@ def _to_np(x):
 
 def _reindex(x, neighbors_list, count_list):
     x = _to_np(x).astype(np.int64)
-    id_map = {int(n): i for i, n in enumerate(x)}
-    out_nodes = list(x)
-
-    def local(node):
-        node = int(node)
-        idx = id_map.get(node)
-        if idx is None:
-            idx = len(out_nodes)
-            id_map[node] = idx
-            out_nodes.append(node)
-        return idx
-
-    src_list, dst_list = [], []
-    for neighbors, count in zip(neighbors_list, count_list):
-        neighbors = _to_np(neighbors).astype(np.int64)
-        count = _to_np(count).astype(np.int64)
-        src_list.append(np.fromiter((local(n) for n in neighbors), np.int64, len(neighbors)))
-        dst_list.append(np.repeat(np.arange(len(count), dtype=np.int64), count))
-    reindex_src = np.concatenate(src_list) if src_list else np.zeros((0,), np.int64)
+    neighbors_np = [_to_np(n).astype(np.int64) for n in neighbors_list]
+    dst_list = [
+        np.repeat(np.arange(len(_to_np(c)), dtype=np.int64), _to_np(c).astype(np.int64))
+        for c in count_list
+    ]
+    # vectorized first-appearance compaction (centers first): np.unique sorts,
+    # so re-rank the unique values by their first occurrence in the concat
+    all_ids = np.concatenate([x] + neighbors_np) if neighbors_np else x
+    uniq, first_idx, inverse = np.unique(all_ids, return_index=True, return_inverse=True)
+    order = np.argsort(first_idx, kind="stable")
+    rank = np.empty(len(order), np.int64)
+    rank[order] = np.arange(len(order), dtype=np.int64)
+    local = rank[inverse]
+    out_nodes = uniq[order]
+    reindex_src = local[len(x):]
     reindex_dst = np.concatenate(dst_list) if dst_list else np.zeros((0,), np.int64)
     return (
         Tensor(reindex_src, stop_gradient=True),
         Tensor(reindex_dst, stop_gradient=True),
-        Tensor(np.asarray(out_nodes, np.int64), stop_gradient=True),
+        Tensor(out_nodes, stop_gradient=True),
     )
 
 

@@ -1,0 +1,66 @@
+"""paddle.hub: discover and load models from a hubconf.py entry-point file.
+
+Reference surface: python/paddle/hub.py (list/help/load with github/gitee/
+local sources). This build has no network egress, so the local-directory
+source is fully supported and remote sources raise with guidance.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+MODULE_VARS = "_load_entry"
+
+
+def _import_hubconf(directory: str):
+    hubconf = os.path.join(directory, "hubconf.py")
+    if not os.path.exists(hubconf):
+        raise FileNotFoundError(f"no hubconf.py found under {directory}")
+    spec = importlib.util.spec_from_file_location("hubconf", hubconf)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, directory)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.pop(0)
+    deps = getattr(module, "dependencies", [])
+    for d in deps:
+        importlib.import_module(d)
+    return module
+
+
+def _resolve(repo_dir: str, source: str):
+    if source != "local":
+        raise RuntimeError(
+            f"source={source!r} needs network access, which this build does not have; "
+            "clone the repo and use source='local'."
+        )
+    return _import_hubconf(repo_dir)
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):
+    """Entrypoint names exposed by the repo's hubconf.py."""
+    module = _resolve(repo_dir, source)
+    return [name for name, v in vars(module).items() if callable(v) and not name.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local", force_reload: bool = False):
+    """Docstring of one entrypoint."""
+    module = _resolve(repo_dir, source)
+    fn = getattr(module, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"hubconf has no entrypoint {model!r}")
+    return fn.__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "local", force_reload: bool = False, **kwargs):
+    """Instantiate an entrypoint: hubconf.<model>(**kwargs)."""
+    module = _resolve(repo_dir, source)
+    fn = getattr(module, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"hubconf has no entrypoint {model!r}")
+    return fn(**kwargs)

@@ -1,0 +1,35 @@
+"""ASP: automatic 2:4 structured sparsity (n:m sparse pruning).
+
+Reference surface: python/paddle/incubate/asp/ (asp.py prune_model/decorate/
+set_excluded_layers, utils.py mask generators & checkers). On TPU the mask is
+a plain elementwise multiply fused into the matmul by XLA; sparse-tensor-core
+style acceleration is not modeled, but mask semantics, optimizer guarantees,
+and checkers match the reference.
+"""
+
+from .asp import (  # noqa: F401
+    ASPHelper,
+    decorate,
+    prune_model,
+    reset_excluded_layers,
+    set_excluded_layers,
+)
+from .utils import (  # noqa: F401
+    CheckMethod,
+    MaskAlgo,
+    calculate_density,
+    check_mask_1d,
+    check_mask_2d,
+    check_sparsity,
+    create_mask,
+    get_mask_1d,
+    get_mask_2d_greedy,
+)
+
+__all__ = [
+    "calculate_density",
+    "decorate",
+    "prune_model",
+    "set_excluded_layers",
+    "reset_excluded_layers",
+]

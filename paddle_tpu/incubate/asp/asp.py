@@ -1,0 +1,108 @@
+"""ASP pruning workflow: prune_model + optimizer decoration.
+
+Reference surface: python/paddle/incubate/asp/asp.py — ASPHelper keeps a
+per-parameter mask registry; ``prune_model`` computes n:m masks for supported
+layers (Linear/Conv2D weights) and applies them in place; ``decorate`` wraps
+an optimizer so masks are re-applied after every step (the sparsity
+guarantee); ``set_excluded_layers`` opts layers out by name.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .utils import CheckMethod, MaskAlgo, create_mask
+
+_EXCLUDED = set()
+
+
+class ASPHelper:
+    MASK_APPENDDED_NAME = "asp_mask"
+    _masks: dict = {}  # param name -> numpy mask
+
+    @classmethod
+    def _supported(cls, model, param, param_name: str) -> bool:
+        if param_name in _EXCLUDED:
+            return False
+        for ex in _EXCLUDED:
+            if param_name.startswith(ex + ".") or param_name.split(".")[0] == ex:
+                return False
+        # weights of Linear (2-D) and Conv (4-D); skip biases / norms / embeddings
+        shape = param.shape
+        if len(shape) not in (2, 4):
+            return False
+        flat_cols = int(np.prod(shape[1:]))
+        return shape[0] >= 4 and flat_cols >= 4 and "embed" not in param_name.lower()
+
+    @classmethod
+    def prune_model(cls, model, n: int = 2, m: int = 4, mask_algo: MaskAlgo = MaskAlgo.MASK_1D, with_mask: bool = True):
+        from ...ops.creation import to_tensor
+
+        masks = {}
+        for name, param in model.named_parameters():
+            if not cls._supported(model, param, name):
+                continue
+            w = np.asarray(param._value, dtype=np.float32)
+            mask = create_mask(w, func_name=mask_algo, n=n, m=m)
+            param._set_value_raw(to_tensor((w * mask).astype(w.dtype))._value)
+            if with_mask:
+                masks[name] = mask
+        cls._masks = masks
+        return masks
+
+    @classmethod
+    def decorate(cls, optimizer):
+        return OptimizerWithSparsityGuarantee(optimizer)
+
+
+class OptimizerWithSparsityGuarantee:
+    """After each optimizer step, re-multiply masked params by their mask so
+    pruned weights stay exactly zero through training."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self):
+        self._optimizer.step()
+        if not ASPHelper._masks:
+            return
+        from ...ops.creation import to_tensor
+
+        params = (getattr(self._optimizer, "_parameter_list", None)
+                  or getattr(self._optimizer, "_parameters", None) or [])
+        for p in params:
+            key = getattr(p, "_asp_mask_key", None)
+            if key is not None and key in ASPHelper._masks:
+                mask = ASPHelper._masks[key]
+                w = np.asarray(p._value)
+                p._set_value_raw(to_tensor((w * mask).astype(w.dtype))._value)
+
+
+def set_excluded_layers(param_names, main_program=None):
+    for n in param_names:
+        _EXCLUDED.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d", with_mask: bool = True):
+    algo = {
+        "mask_1d": MaskAlgo.MASK_1D,
+        "mask_2d_greedy": MaskAlgo.MASK_2D_GREEDY,
+        "mask_2d_best": MaskAlgo.MASK_2D_BEST,
+    }[mask_algo]
+    masks = ASPHelper.prune_model(model, n=n, m=m, mask_algo=algo, with_mask=with_mask)
+    # tag parameters so the decorated optimizer can find their masks
+    for name, param in model.named_parameters():
+        if name in masks:
+            param._asp_mask_key = name
+    return masks
+
+
+def decorate(optimizer):
+    return ASPHelper.decorate(optimizer)

@@ -1,0 +1,138 @@
+"""n:m sparsity mask generation and validation (host-side numpy).
+
+Reference surface: python/paddle/incubate/asp/utils.py — get_mask_1d
+(keep the n largest of every m contiguous elements along rows),
+get_mask_2d_greedy, check_mask_1d/2d, create_mask, check_sparsity,
+calculate_density. Mask computation is an offline pruning pass, so it stays
+in numpy; only the masked multiply runs on device.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from itertools import permutations
+
+import numpy as np
+
+__all__ = [
+    "MaskAlgo",
+    "CheckMethod",
+    "calculate_density",
+    "get_mask_1d",
+    "get_mask_2d_greedy",
+    "check_mask_1d",
+    "check_mask_2d",
+    "create_mask",
+    "check_sparsity",
+]
+
+
+class MaskAlgo(Enum):
+    MASK_1D = "get_mask_1d"
+    MASK_2D_GREEDY = "get_mask_2d_greedy"
+    MASK_2D_BEST = "get_mask_2d_best"
+
+
+class CheckMethod(Enum):
+    CHECK_1D = "check_mask_1d"
+    CHECK_2D = "check_mask_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo: MaskAlgo):
+        return CheckMethod.CHECK_1D if mask_algo == MaskAlgo.MASK_1D else CheckMethod.CHECK_2D
+
+
+def calculate_density(x) -> float:
+    a = np.asarray(x)
+    return float(np.count_nonzero(a)) / a.size
+
+
+def _reshape_1d(mat: np.ndarray, m: int):
+    """Pad the row length up to a multiple of m and view as groups of m."""
+    rows, cols = mat.shape
+    pad = (m - cols % m) % m
+    padded = np.concatenate([mat, np.zeros((rows, pad), mat.dtype)], axis=1) if pad else mat
+    return padded.reshape(-1, m), padded.shape
+
+
+def get_mask_1d(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest-|.|-valued of every m contiguous elements per row."""
+    mat = np.asarray(mat)
+    groups, padded_shape = _reshape_1d(mat, m)
+    mask = np.zeros_like(groups)
+    idx = np.argsort(np.abs(groups), axis=1)[:, -n:]
+    np.put_along_axis(mask, idx, 1.0, axis=1)
+    mask = mask.reshape(padded_shape)[: mat.shape[0], : mat.shape[1]]
+    return mask.astype(mat.dtype)
+
+
+def check_mask_1d(mat: np.ndarray, n: int, m: int) -> bool:
+    """True iff every m-contiguous group per row has at most (m-n) nonzeros...
+    i.e. at least (m-n) zeros — the n:m sparse property along rows."""
+    mat = np.asarray(mat)
+    groups, _ = _reshape_1d(mat, m)
+    return bool(np.all((groups != 0).sum(axis=1) <= n))
+
+
+def get_mask_2d_greedy(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Greedy 2-D n:m mask: in every m x m tile keep entries maximizing
+    magnitude subject to <=n nonzeros per row AND per column of the tile."""
+    mat = np.asarray(mat)
+    rows, cols = mat.shape
+    pr, pc = (m - rows % m) % m, (m - cols % m) % m
+    padded = np.pad(np.abs(mat), ((0, pr), (0, pc)))
+    mask = np.zeros_like(padded)
+    for r0 in range(0, padded.shape[0], m):
+        for c0 in range(0, padded.shape[1], m):
+            tile = padded[r0:r0 + m, c0:c0 + m]
+            sub_mask = np.zeros((m, m))
+            order = np.argsort(-tile, axis=None)
+            row_cnt, col_cnt = np.zeros(m, int), np.zeros(m, int)
+            for flat in order:
+                i, j = divmod(int(flat), m)
+                if row_cnt[i] < n and col_cnt[j] < n:
+                    sub_mask[i, j] = 1.0
+                    row_cnt[i] += 1
+                    col_cnt[j] += 1
+            mask[r0:r0 + m, c0:c0 + m] = sub_mask
+    return mask[:rows, :cols].astype(mat.dtype)
+
+
+def check_mask_2d(mat: np.ndarray, n: int, m: int) -> bool:
+    mat = np.asarray(mat)
+    rows, cols = mat.shape
+    pr, pc = (m - rows % m) % m, (m - cols % m) % m
+    padded = np.pad(mat, ((0, pr), (0, pc)))
+    for r0 in range(0, padded.shape[0], m):
+        for c0 in range(0, padded.shape[1], m):
+            tile = padded[r0:r0 + m, c0:c0 + m] != 0
+            if tile.sum(axis=0).max(initial=0) > n or tile.sum(axis=1).max(initial=0) > n:
+                return False
+    return True
+
+
+def _as_2d(t: np.ndarray):
+    """Collapse leading dims: conv [oc,ic,kh,kw] -> [oc, ic*kh*kw]; keep 2-D."""
+    if t.ndim == 1:
+        return t.reshape(1, -1), t.shape
+    if t.ndim > 2:
+        return t.reshape(t.shape[0], -1), t.shape
+    return t, t.shape
+
+
+def create_mask(tensor, func_name: MaskAlgo = MaskAlgo.MASK_1D, n: int = 2, m: int = 4) -> np.ndarray:
+    t = np.asarray(tensor)
+    mat, orig_shape = _as_2d(t)
+    if func_name == MaskAlgo.MASK_1D:
+        mask = get_mask_1d(mat, n, m)
+    elif func_name in (MaskAlgo.MASK_2D_GREEDY, MaskAlgo.MASK_2D_BEST):
+        mask = get_mask_2d_greedy(mat, n, m)
+    else:
+        raise ValueError(f"unknown mask algo {func_name}")
+    return mask.reshape(orig_shape)
+
+
+def check_sparsity(tensor, func_name: CheckMethod = CheckMethod.CHECK_1D, n: int = 2, m: int = 4) -> bool:
+    t = np.asarray(tensor)
+    mat, _ = _as_2d(t)
+    return check_mask_1d(mat, n, m) if func_name == CheckMethod.CHECK_1D else check_mask_2d(mat, n, m)

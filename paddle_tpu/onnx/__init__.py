@@ -1,0 +1,8 @@
+"""paddle.onnx: model export for interchange.
+
+Reference surface: python/paddle/onnx/export.py (delegates to paddle2onnx).
+"""
+
+from .export import export  # noqa: F401
+
+__all__ = ["export"]

@@ -1,0 +1,87 @@
+"""ONNX export: layer -> .onnx (when the onnx package is present) with a
+StableHLO sidecar as the TPU-native interchange format.
+
+Reference surface: python/paddle/onnx/export.py:22 — paddle.onnx.export
+delegates to paddle2onnx over a traced program. Here the traced program IS a
+StableHLO module (jit.save's serialization), and when the optional ``onnx``
+dependency is installed we additionally emit a real ONNX graph for the
+supported layer set.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9, **configs):
+    """Export ``layer`` for interchange.
+
+    Always writes ``<path>.stablehlo`` (portable XLA program, the TPU-native
+    analog of an ONNX graph). If the optional ``onnx`` package is available,
+    also writes ``<path>.onnx``. Returns the path of the primary artifact.
+    """
+    if path.endswith(".onnx"):
+        path = path[:-5]
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    from ..jit.api import save as jit_save
+
+    jit_save(layer, path, input_spec=input_spec)
+
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        import warnings
+
+        warnings.warn(
+            "the 'onnx' package is not installed; exported the StableHLO "
+            f"program only ({path}.*). Install onnx to emit {path}.onnx.",
+            stacklevel=2,
+        )
+        return path
+
+    return _export_onnx(layer, path, input_spec, opset_version)
+
+
+def _export_onnx(layer, path, input_spec, opset_version):
+    """Minimal ONNX emission for Linear/activation chains (optional path)."""
+    import numpy as np
+    import onnx
+    from onnx import TensorProto, helper, numpy_helper
+
+    from ..nn.layer import common
+
+    nodes, initializers = [], []
+    cur = "input"
+    shape = list(input_spec[0].shape) if input_spec else [1, getattr(layer, "in_features", 1)]
+    shape = [d if isinstance(d, int) and d > 0 else "N" for d in shape]
+    idx = 0
+    for name, sub in layer.named_sublayers() if hasattr(layer, "named_sublayers") else []:
+        if isinstance(sub, common.Linear):
+            wname, bname, oname = f"w{idx}", f"b{idx}", f"h{idx}"
+            initializers.append(numpy_helper.from_array(np.asarray(sub.weight._value, np.float32), wname))
+            nodes.append(helper.make_node("MatMul", [cur, wname], [oname + "_mm"]))
+            if sub.bias is not None:
+                initializers.append(numpy_helper.from_array(np.asarray(sub.bias._value, np.float32), bname))
+                nodes.append(helper.make_node("Add", [oname + "_mm", bname], [oname]))
+            else:
+                oname = oname + "_mm"
+            cur = oname
+            idx += 1
+        elif type(sub).__name__ in ("ReLU", "Sigmoid", "Tanh"):
+            oname = f"h{idx}"
+            nodes.append(helper.make_node(type(sub).__name__ if type(sub).__name__ != "ReLU" else "Relu", [cur], [oname]))
+            cur = oname
+            idx += 1
+    graph = helper.make_graph(
+        nodes,
+        "paddle_tpu_model",
+        [helper.make_tensor_value_info("input", TensorProto.FLOAT, shape)],
+        [helper.make_tensor_value_info(cur, TensorProto.FLOAT, None)],
+        initializer=initializers,
+    )
+    model = helper.make_model(graph, opset_imports=[helper.make_opsetid("", opset_version)])
+    onnx.save(model, path + ".onnx")
+    return path + ".onnx"

@@ -329,7 +329,14 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=
     x, y = as_tensor(x), as_tensor(y)
 
     def f(xv, yv):
-        if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
+        # "if_necessary" matches the reference/torch policy: the gram expansion
+        # x2+y2-2xy suffers catastrophic cancellation for near-equal rows, so
+        # small feature dims (<=25) take the exact |x-y| path instead.
+        use_mm = p == 2.0 and (
+            compute_mode == "use_mm_for_euclid_dist"
+            or (compute_mode == "use_mm_for_euclid_dist_if_necessary" and xv.shape[-1] > 25)
+        )
+        if use_mm:
             x2 = jnp.sum(xv * xv, -1)[..., :, None]
             y2 = jnp.sum(yv * yv, -1)[..., None, :]
             xy = jnp.matmul(xv, jnp.swapaxes(yv, -1, -2), preferred_element_type=_pref(xv.dtype))
@@ -337,6 +344,8 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=
                 xy = xy.astype(xv.dtype)
             return jnp.sqrt(jnp.maximum(x2 + y2 - 2 * xy, 0.0))
         diff = jnp.abs(xv[..., :, None, :] - yv[..., None, :, :])
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, -1))
         if p == 0:
             return jnp.sum((diff != 0).astype(xv.dtype), -1)
         if jnp.isinf(p):

@@ -1,0 +1,64 @@
+"""Quantization: QAT/PTQ with TPU-friendly fake-quant lowering.
+
+Reference surface: python/paddle/quantization/ (QuantConfig, QAT, PTQ,
+observers, quanters). The fake-quant chain is pure jnp and fuses into the
+adjacent matmul/conv under jit; int8 inference export hands XLA an
+int8-weight + dequant-scale representation (aqt-style).
+"""
+
+from .base import BaseObserver, BaseQuanter
+from .config import QuantConfig, SingleLayerConfig
+from .factory import ObserverFactory, QuanterFactory, quanter
+from .observers import (
+    AbsMaxObserver,
+    AbsmaxObserver,
+    EMAObserver,
+    HistObserver,
+    KLObserver,
+    PerChannelAbsMaxObserver,
+)
+from .ptq import PTQ
+from .qat import QAT
+from .quanters import (  # noqa: F401
+    FakeQuanterChannelWiseAbsMaxObserver,
+    FakeQuanterChannelWiseAbsMaxObserverLayer,
+    FakeQuanterWithAbsMaxObserver,
+    FakeQuanterWithAbsMaxObserverLayer,
+)
+from .wrapper import ObserveWrapper, QuantedConv2D, QuantedLinear
+
+
+def _observer_factory(cls):
+    def factory(**kwargs):
+        return ObserverFactory(cls, **kwargs)
+
+    factory.__name__ = cls.__name__ + "Factory"
+    return factory
+
+
+# factory-style constructors for handing observers to QuantConfig
+AbsMaxObserverFactory = _observer_factory(AbsMaxObserver)
+PerChannelAbsMaxObserverFactory = _observer_factory(PerChannelAbsMaxObserver)
+
+__all__ = [
+    "QuantConfig",
+    "SingleLayerConfig",
+    "BaseQuanter",
+    "BaseObserver",
+    "quanter",
+    "ObserverFactory",
+    "QuanterFactory",
+    "QAT",
+    "PTQ",
+    "AbsMaxObserver",
+    "AbsmaxObserver",
+    "PerChannelAbsMaxObserver",
+    "EMAObserver",
+    "HistObserver",
+    "KLObserver",
+    "FakeQuanterWithAbsMaxObserver",
+    "FakeQuanterChannelWiseAbsMaxObserver",
+    "ObserveWrapper",
+    "QuantedLinear",
+    "QuantedConv2D",
+]

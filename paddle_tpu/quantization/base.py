@@ -1,0 +1,55 @@
+"""Quantization base classes.
+
+Reference surface: python/paddle/quantization/base_observer.py and
+base_quanter.py. Both are Layers inserted into the model graph: observers
+watch tensors flowing through them during calibration (PTQ) and quanters
+simulate quantization during training (QAT, straight-through estimator).
+
+TPU-native twist: fake-quantization is a pure jnp chain
+(scale -> round -> clip -> dequant) that XLA fuses into the surrounding
+matmul; the straight-through estimator is expressed compositionally as
+``x + (qdq(x) - x).detach()`` through the eager tape, so no custom VJP
+registration is needed.
+"""
+
+from __future__ import annotations
+
+import abc
+
+from ..nn.layer.layers import Layer
+
+
+class BaseObserver(Layer, metaclass=abc.ABCMeta):
+    """Built-in observers watch min/max statistics of activations/weights.
+
+    Subclasses implement ``forward`` (identity pass that records statistics)
+    and the ``scales``/``zero_points`` accessors used at convert time.
+    """
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    @property
+    def quant_bits(self) -> int:
+        return self._quant_bits
+
+    @property
+    def qmin(self) -> int:
+        return -(2 ** (self._quant_bits - 1))
+
+    @property
+    def qmax(self) -> int:
+        return 2 ** (self._quant_bits - 1) - 1
+
+    @abc.abstractmethod
+    def scales(self):
+        """Quantization scale(s) derived from observed statistics."""
+
+    @abc.abstractmethod
+    def zero_points(self):
+        """Zero point(s); symmetric observers return 0."""
+
+
+class BaseQuanter(BaseObserver, metaclass=abc.ABCMeta):
+    """A fake-quantizer: forward simulates quant->dequant with STE gradients."""

@@ -1,0 +1,89 @@
+"""QuantConfig: which layers get quantized, and with what quanters/observers.
+
+Reference surface: python/paddle/quantization/config.py — configs can be
+attached by layer instance, by layer full name, or by layer type; each entry
+carries (activation, weight) factories. ``default_qat_layer_mapping`` decides
+which Quanted* wrapper replaces each source layer type.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..nn.layer.layers import Layer
+from .factory import ClassWithKwargs
+
+
+@dataclass
+class SingleLayerConfig:
+    activation: Optional[ClassWithKwargs] = None
+    weight: Optional[ClassWithKwargs] = None
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._global_config = SingleLayerConfig(activation, weight) if (activation or weight) else None
+        self._layer_configs = []  # (predicate, SingleLayerConfig)
+        self._qat_layer_mapping = dict(_default_qat_layer_mapping())
+        self._customized_leaves = []
+
+    # ---- config registration ----
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for lyr in layers:
+            self._layer_configs.append((("instance", id(lyr)), SingleLayerConfig(activation, weight)))
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) else [layer_name]
+        for n in names:
+            self._layer_configs.append((("name", n), SingleLayerConfig(activation, weight)))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            self._layer_configs.append((("type", t), SingleLayerConfig(activation, weight)))
+
+    def add_qat_layer_mapping(self, source, target):
+        self._qat_layer_mapping[source] = target
+
+    def add_customized_leaves(self, layer_type):
+        self._customized_leaves.append(layer_type)
+
+    @property
+    def customized_leaves(self):
+        return self._customized_leaves
+
+    @property
+    def qat_layer_mappings(self):
+        return self._qat_layer_mapping
+
+    # ---- lookup ----
+    def _get_config_by_layer(self, layer: Layer, full_name: str = None) -> Optional[SingleLayerConfig]:
+        for key, cfg in self._layer_configs:
+            kind, val = key
+            if kind == "instance" and id(layer) == val:
+                return cfg
+            if kind == "name" and full_name is not None and full_name == val:
+                return cfg
+            if kind == "type" and isinstance(layer, val):
+                return cfg
+        return self._global_config
+
+    def _is_quantifiable(self, layer: Layer, full_name: str = None) -> bool:
+        return self._get_config_by_layer(layer, full_name) is not None and type(layer) in self._qat_layer_mapping
+
+
+def _default_qat_layer_mapping():
+    from ..nn.layer.common import Linear
+    from .wrapper import QuantedLinear
+
+    mapping = {Linear: QuantedLinear}
+    try:
+        from ..nn.layer.conv import Conv2D
+        from .wrapper import QuantedConv2D
+
+        mapping[Conv2D] = QuantedConv2D
+    except ImportError:
+        pass
+    return mapping

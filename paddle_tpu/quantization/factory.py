@@ -1,0 +1,50 @@
+"""Factories binding observer/quanter classes to constructor kwargs.
+
+Reference: python/paddle/quantization/factory.py — QuantConfig stores
+*factories*, not instances; each quantified tensor gets a fresh instance via
+``_instance()``. The ``quanter`` decorator registers a custom quanter class
+and returns its factory wrapper.
+"""
+
+from __future__ import annotations
+
+
+class ClassWithKwargs:
+    def __init__(self, cls, **kwargs):
+        self._cls, self._kwargs = cls, kwargs
+
+    @property
+    def partial_class(self):
+        return self._cls
+
+    def _instance(self):
+        return self._cls(**self._kwargs)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._cls.__name__}, {self._kwargs})"
+
+
+class ObserverFactory(ClassWithKwargs):
+    pass
+
+
+class QuanterFactory(ClassWithKwargs):
+    pass
+
+
+def quanter(class_name: str):
+    """Decorator: register a BaseQuanter subclass and expose a factory with
+    the given name in the caller's module (reference factory.py:quanter)."""
+
+    def wrapper(cls):
+        import sys
+
+        def factory(**kwargs):
+            return QuanterFactory(cls, **kwargs)
+
+        factory.__name__ = class_name
+        mod = sys.modules[cls.__module__]
+        setattr(mod, class_name, factory)
+        return cls
+
+    return wrapper

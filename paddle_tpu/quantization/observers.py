@@ -1,0 +1,189 @@
+"""Calibration observers for post-training quantization.
+
+Reference surface: python/paddle/quantization/observers/abs_max.py plus the
+imperative PTQ quantizer family (quantization/imperative/ptq_quantizer.py:
+AbsmaxQuantizer, PerChannelAbsmaxQuantizer, HistQuantizer, KLQuantizer).
+Statistics are accumulated host-side in numpy — calibration is a one-off,
+offline pass, so it stays off the TPU hot path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BaseObserver
+from .factory import ObserverFactory
+
+
+def _np(x):
+    v = x._value if hasattr(x, "_value") else x
+    return np.asarray(v, dtype=np.float32)
+
+
+class AbsMaxObserver(BaseObserver):
+    """Per-tensor abs-max range observer (running max over calibration batches)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__(quant_bits=quant_bits)
+        self._max = 0.0
+
+    def forward(self, x):
+        self._max = max(self._max, float(np.abs(_np(x)).max(initial=0.0)))
+        return x
+
+    def scales(self):
+        return max(self._max, 1e-8) / self.qmax
+
+    def zero_points(self):
+        return 0
+
+
+class PerChannelAbsMaxObserver(BaseObserver):
+    """Per-channel abs-max observer, for weights (channel axis = last by default,
+    matching this framework's [in, out] Linear weight layout)."""
+
+    def __init__(self, quant_bits: int = 8, channel_axis: int = -1):
+        super().__init__(quant_bits=quant_bits)
+        self.channel_axis = channel_axis
+        self._max = None
+
+    def forward(self, x):
+        a = np.abs(_np(x))
+        axis = self.channel_axis % a.ndim
+        reduce_axes = tuple(i for i in range(a.ndim) if i != axis)
+        m = a.max(axis=reduce_axes, initial=0.0)
+        self._max = m if self._max is None else np.maximum(self._max, m)
+        return x
+
+    def scales(self):
+        return np.maximum(self._max, 1e-8) / self.qmax
+
+    def zero_points(self):
+        return np.zeros_like(self._max, dtype=np.int32)
+
+
+class EMAObserver(BaseObserver):
+    """Exponential-moving-average abs-max (smoother than running max)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits=quant_bits)
+        self.moving_rate = moving_rate
+        self._state = None
+
+    def forward(self, x):
+        m = float(np.abs(_np(x)).max(initial=0.0))
+        self._state = m if self._state is None else self.moving_rate * self._state + (1 - self.moving_rate) * m
+        return x
+
+    def scales(self):
+        return max(self._state or 0.0, 1e-8) / self.qmax
+
+    def zero_points(self):
+        return 0
+
+
+class HistObserver(BaseObserver):
+    """Histogram observer: picks the range covering ``percent`` of mass.
+
+    Analog of the reference's HistQuantizer (ptq_quantizer.py): accumulates a
+    histogram of |x| across batches, then selects the bin edge at the given
+    percentile as the clipping threshold.
+    """
+
+    def __init__(self, quant_bits: int = 8, bins_count: int = 2048, percent: float = 0.99999):
+        super().__init__(quant_bits=quant_bits)
+        self.bins_count, self.percent = bins_count, percent
+        self._hist = None
+        self._edge = 0.0
+
+    def forward(self, x):
+        a = np.abs(_np(x)).ravel()
+        m = float(a.max(initial=0.0))
+        if self._hist is None:
+            self._edge = max(m, 1e-8)
+            self._hist = np.histogram(a, bins=self.bins_count, range=(0, self._edge))[0].astype(np.float64)
+        else:
+            if m > self._edge:
+                # stretch the histogram to the new range by rebinning
+                old_edges = np.linspace(0, self._edge, self.bins_count + 1)
+                centers = (old_edges[:-1] + old_edges[1:]) / 2
+                new_hist = np.histogram(centers, bins=self.bins_count, range=(0, m), weights=self._hist)[0]
+                self._hist, self._edge = new_hist, m
+            self._hist += np.histogram(a, bins=self.bins_count, range=(0, self._edge))[0]
+        return x
+
+    def _threshold(self):
+        total = self._hist.sum()
+        if total == 0:
+            return 1e-8
+        cum = np.cumsum(self._hist) / total
+        idx = int(np.searchsorted(cum, self.percent))
+        return (idx + 0.5) * self._edge / self.bins_count
+
+    def scales(self):
+        return max(self._threshold(), 1e-8) / self.qmax
+
+    def zero_points(self):
+        return 0
+
+
+class KLObserver(BaseObserver):
+    """KL-divergence calibration (TensorRT-style): choose the clipping threshold
+    minimizing KL(P || Q) between the fp32 histogram P and its quantized
+    projection Q. Analog of the reference's KLQuantizer."""
+
+    def __init__(self, quant_bits: int = 8, bins_count: int = 2048):
+        super().__init__(quant_bits=quant_bits)
+        self._hist_obs = HistObserver(quant_bits=quant_bits, bins_count=bins_count)
+
+    def forward(self, x):
+        return self._hist_obs.forward(x)
+
+    def _kl_threshold(self):
+        hist, edge = self._hist_obs._hist, self._hist_obs._edge
+        if hist is None or hist.sum() == 0:
+            return 1e-8
+        bins = len(hist)
+        levels = 2 ** self.quant_bits  # e.g. 256
+        if bins <= levels:
+            return edge
+        best_div, best_i = np.inf, bins
+        for i in range(levels, bins + 1, max(1, (bins - levels) // 64)):
+            p = hist[:i].copy()
+            p[i - 1] += hist[i:].sum()  # clip outliers into last bin
+            p_sum = p.sum()
+            if p_sum == 0:
+                continue
+            # project onto `levels` quantized bins, then expand back
+            chunk = i / levels
+            q = np.zeros(i)
+            for j in range(levels):
+                lo, hi = int(j * chunk), max(int((j + 1) * chunk), int(j * chunk) + 1)
+                seg = hist[lo:hi]
+                nonzero = (seg > 0).sum()
+                if nonzero:
+                    q[lo:hi] = np.where(seg > 0, seg.sum() / nonzero, 0)
+            q_sum = q.sum()
+            if q_sum == 0:
+                continue
+            pn, qn = p / p_sum, q / q_sum
+            mask = pn > 0
+            div = float(np.sum(pn[mask] * np.log(pn[mask] / np.maximum(qn[mask], 1e-12))))
+            if div < best_div:
+                best_div, best_i = div, i
+        return (best_i + 0.5) * edge / bins
+
+    def scales(self):
+        return max(self._kl_threshold(), 1e-8) / self.qmax
+
+    def zero_points(self):
+        return 0
+
+
+# Partial-binding factories (reference: observers are handed to QuantConfig as
+# factory(**kwargs) and instantiated once per quantified tensor).
+def _factory(cls):
+    return lambda **kw: ObserverFactory(cls, **kw)
+
+
+AbsmaxObserver = AbsMaxObserver  # alias matching imperative PTQ naming

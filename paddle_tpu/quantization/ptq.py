@@ -1,0 +1,65 @@
+"""Post-training quantization entry point.
+
+Reference surface: python/paddle/quantization/ptq.py — ``PTQ(config)``,
+``quantize(model)`` inserts observers around quantifiable layers; the user
+then streams calibration batches through the model, and ``convert(model)``
+computes scales from observed statistics and bakes them in.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+from .qat import _freeze, _walk_replace
+from .wrapper import QuantedConv2D, QuantedLinear
+
+
+class PTQ:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        mapping = self._config.qat_layer_mappings
+
+        def replace(layer, full_name):
+            cfg = self._config._get_config_by_layer(layer, full_name)
+            wrapper_cls = mapping.get(type(layer))
+            if cfg is not None and wrapper_cls is not None:
+                wrapped = wrapper_cls(layer, cfg)
+                # calibration mode: quanters act as pure observers (eval mode
+                # freezes EMA updates in QAT quanters; observers always record)
+                return wrapped
+            return None
+
+        _walk_replace(model, replace)
+        model.eval()
+        # PTQ calibration must still record statistics in eval mode
+        for lyr in _iter_quanted(model):
+            for q in (lyr.activation_quanter, lyr.weight_quanter):
+                if q is not None:
+                    q.training = True
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def replace(layer, full_name):
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+                return _freeze(layer)
+            return None
+
+        _walk_replace(model, replace)
+        model.eval()
+        return model
+
+
+def _iter_quanted(model):
+    if isinstance(model, (QuantedLinear, QuantedConv2D)):
+        yield model
+    for sub in model._sub_layers.values():
+        yield from _iter_quanted(sub)

@@ -1,0 +1,92 @@
+"""Fake quanters for quantization-aware training.
+
+Reference surface: python/paddle/quantization/quanters/abs_max.py
+(FakeQuanterWithAbsMaxObserver — EMA abs-max range tracking + fake
+quant-dequant in the forward, straight-through estimator in the backward).
+
+TPU-native design: the quant->clip->round->dequant chain is plain tensor
+arithmetic (lowered to a handful of fused VPU ops), and the STE is written
+compositionally: ``x + (qdq(x) - x).detach()`` — the tape sees an identity
+w.r.t. x, which IS the straight-through gradient. No custom VJP needed, and
+the whole thing remains jit-traceable inside a functional_call.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import math as _m
+from .base import BaseQuanter
+from .factory import quanter
+
+
+def _fake_quant_dequant(x, scale, qmin, qmax):
+    q = _m.clip(_m.round(x * (1.0 / scale)), float(qmin), float(qmax))
+    return q * scale
+
+
+@quanter("FakeQuanterWithAbsMaxObserver")
+class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
+    """EMA abs-max fake quanter (per tensor, symmetric)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9, dtype: str = "float32"):
+        super().__init__(quant_bits=quant_bits)
+        self.moving_rate = moving_rate
+        self._scale_state = None  # running abs-max (python float, host-side)
+
+    def forward(self, x):
+        if self.training:
+            cur = float(np.abs(np.asarray(x.detach()._value, dtype=np.float32)).max(initial=0.0))
+            if self._scale_state is None:
+                self._scale_state = max(cur, 1e-8)
+            else:
+                self._scale_state = self.moving_rate * self._scale_state + (1 - self.moving_rate) * cur
+        absmax = max(self._scale_state or 1e-8, 1e-8)
+        scale = absmax / self.qmax
+        qdq = _fake_quant_dequant(x, scale, self.qmin, self.qmax)
+        # straight-through: identity gradient w.r.t. x
+        return x + (qdq - x).detach()
+
+    def scales(self):
+        return max(self._scale_state or 1e-8, 1e-8) / self.qmax
+
+    def zero_points(self):
+        return 0
+
+
+@quanter("FakeQuanterChannelWiseAbsMaxObserver")
+class FakeQuanterChannelWiseAbsMaxObserverLayer(BaseQuanter):
+    """Per-channel abs-max fake quanter, for weights.
+
+    channel_axis defaults to the output-feature axis of this framework's
+    Linear weight layout ([in, out] -> axis -1).
+    """
+
+    def __init__(self, quant_bits: int = 8, channel_axis: int = -1, dtype: str = "float32"):
+        super().__init__(quant_bits=quant_bits)
+        self.channel_axis = channel_axis
+        self._scale_state = None
+
+    def forward(self, x):
+        a = np.abs(np.asarray(x.detach()._value, dtype=np.float32))
+        axis = self.channel_axis % a.ndim
+        reduce_axes = tuple(i for i in range(a.ndim) if i != axis)
+        cur = a.max(axis=reduce_axes, initial=0.0)
+        if self.training or self._scale_state is None:
+            self._scale_state = cur if self._scale_state is None else np.maximum(self._scale_state, cur)
+        absmax = np.maximum(self._scale_state, 1e-8)
+        shape = [1] * a.ndim
+        shape[axis] = -1
+        from ..ops.creation import to_tensor
+
+        scale = to_tensor((absmax / self.qmax).reshape(shape).astype(np.float32))
+        inv = to_tensor((self.qmax / absmax).reshape(shape).astype(np.float32))
+        q = _m.clip(_m.round(x * inv), float(self.qmin), float(self.qmax))
+        qdq = q * scale
+        return x + (qdq - x).detach()
+
+    def scales(self):
+        return np.maximum(self._scale_state, 1e-8) / self.qmax
+
+    def zero_points(self):
+        return np.zeros_like(self._scale_state, dtype=np.int32)

@@ -1,0 +1,77 @@
+"""Quanted layer wrappers inserted by QAT/PTQ.
+
+Reference surface: python/paddle/quantization/wrapper.py (ObserveWrapper) and
+paddle/nn/quant/qat/ (QuantedLinear/QuantedConv2D analogs). Each wrapper owns
+the source layer plus per-tensor activation/weight quanters; forward runs
+act_quanter(x) and weight_quanter(w) before the original compute, so the
+fake-quant chain fuses into the matmul/conv under jit.
+"""
+
+from __future__ import annotations
+
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+
+
+def _instantiate(factory):
+    if factory is None:
+        return None
+    if hasattr(factory, "_instance"):  # ObserverFactory / QuanterFactory
+        return factory._instance()
+    import copy
+
+    return copy.deepcopy(factory)  # a pre-built observer/quanter Layer
+
+
+class ObserveWrapper(Layer):
+    """Wrap any layer with a single observer watching its output (PTQ)."""
+
+    def __init__(self, observer, observed, observe_input: bool = False):
+        super().__init__()
+        self._observer = observer
+        self._observed = observed
+        self._observe_input = observe_input
+
+    def forward(self, *args, **kwargs):
+        if self._observe_input and args:
+            args = (self._observer(args[0]),) + args[1:]
+            return self._observed(*args, **kwargs)
+        out = self._observed(*args, **kwargs)
+        return self._observer(out)
+
+
+class QuantedLinear(Layer):
+    def __init__(self, layer, q_config):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self.activation_quanter = _instantiate(q_config.activation)
+        self.weight_quanter = _instantiate(q_config.weight)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, layer, q_config):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self._stride, self._padding = layer._stride, layer._padding
+        self._dilation, self._groups = layer._dilation, layer._groups
+        self._data_format = layer._data_format
+        self.activation_quanter = _instantiate(q_config.activation)
+        self.weight_quanter = _instantiate(q_config.weight)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.conv2d(x, w, self.bias, self._stride, self._padding, self._dilation, self._groups, self._data_format)

@@ -1,0 +1,38 @@
+"""Utility surface: unique_name, deprecated, dlpack, download, flops, try_import.
+
+Reference surface: python/paddle/utils/ — the subset with TPU-relevant
+behavior; image_util/gast belong to the legacy static stack and are omitted.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from . import cpp_extension, dlpack, download, flops, unique_name  # noqa: F401
+from .deprecated import deprecated  # noqa: F401
+from .download import get_path_from_url, get_weights_path_from_url  # noqa: F401
+
+__all__ = ["deprecated", "download", "dlpack", "unique_name", "cpp_extension", "flops", "try_import", "run_check"]
+
+
+def try_import(module_name: str, err_msg: str = None):
+    """Import an optional dependency, raising an informative error if absent
+    (reference: python/paddle/utils/lazy_import.py)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"Optional dependency '{module_name}' is required for this API; it is not installed in this environment.")
+
+
+def run_check():
+    """Smoke-check the install: one jit-compiled matmul on the default device
+    (reference: python/paddle/utils/install_check.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((4, 4), jnp.float32)
+    y = jax.jit(lambda a: a @ a)(x)
+    y.block_until_ready()
+    dev = jax.devices()[0]
+    print(f"paddle_tpu is installed successfully on {dev.platform}:{dev.id}.")
+    return True

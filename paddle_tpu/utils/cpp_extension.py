@@ -1,0 +1,48 @@
+"""Build-and-load for user C++ extensions (custom host ops).
+
+Reference surface: python/paddle/utils/cpp_extension/ (CppExtension/
+CUDAExtension + JIT `load`). The TPU-native analog compiles a C++ source
+with g++ into a shared object and returns a ctypes handle; custom *device*
+ops belong in Pallas, so this path covers host-side ops only (tokenizers,
+data feeds, IO) — the same split as SURVEY.md §7's C++ component list.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+__all__ = ["load", "CppExtension", "get_build_directory"]
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_TPU_EXTENSION_DIR", os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    def __init__(self, sources, extra_compile_args=None, include_dirs=None, **kwargs):
+        self.sources = sources
+        self.extra_compile_args = extra_compile_args or []
+        self.include_dirs = include_dirs or []
+
+
+def load(name: str, sources, extra_cxx_cflags=None, extra_include_paths=None, build_directory: str = None, verbose: bool = False):
+    """JIT-compile C++ sources into <build_dir>/<name>.so and load via ctypes."""
+    sources = [sources] if isinstance(sources, str) else list(sources)
+    build_dir = build_directory or get_build_directory()
+    tag = hashlib.sha1("".join(open(s, "rb").read().decode(errors="ignore") for s in sources).encode()).hexdigest()[:10]
+    so_path = os.path.join(build_dir, f"{name}_{tag}.so")
+    if not os.path.exists(so_path):
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", so_path, *sources]
+        for inc in extra_include_paths or []:
+            cmd += ["-I", inc]
+        cmd += extra_cxx_cflags or []
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(so_path)

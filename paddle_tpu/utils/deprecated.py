@@ -1,0 +1,35 @@
+"""@deprecated decorator emitting DeprecationWarning with since/update_to info.
+
+Reference surface: python/paddle/utils/deprecated.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import warnings
+
+__all__ = ["deprecated"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "", level: int = 1):
+    def decorator(func):
+        msg = f"API '{func.__module__}.{func.__name__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use '{update_to}' instead"
+        if reason:
+            msg += f". Reason: {reason}"
+        if level == 2:
+            raise RuntimeError(msg)
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level > 0:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        wrapper.__doc__ = (f"\n    .. deprecated:: {since or 'now'}\n        {msg}\n\n" + (func.__doc__ or ""))
+        return wrapper
+
+    return decorator

@@ -1,0 +1,43 @@
+"""DLPack interop: zero-copy exchange with torch/numpy/other frameworks.
+
+Reference surface: python/paddle/utils/dlpack.py (to_dlpack/from_dlpack over
+the C++ DLPack bridge). Here the bridge is jax.dlpack; host-side exchange
+with torch-cpu works out of the box.
+"""
+
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Export a Tensor to a DLPack capsule."""
+    from ..core.tensor import Tensor
+
+    if not isinstance(x, Tensor):
+        raise TypeError(f"to_dlpack expects a paddle_tpu Tensor, got {type(x)}")
+    return x._value.__dlpack__()
+
+
+def from_dlpack(dlpack):
+    """Import a DLPack capsule (or any object with __dlpack__) as a Tensor."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    if hasattr(dlpack, "__dlpack__"):
+        arr = jnp.from_dlpack(dlpack)
+    else:
+        # raw capsule: wrap it in a shim exposing the DLPack protocol
+        class _Capsule:
+            def __init__(self, cap):
+                self._cap = cap
+
+            def __dlpack__(self, stream=None):
+                return self._cap
+
+            def __dlpack_device__(self):
+                return (1, 0)  # kDLCPU
+
+        arr = jnp.from_dlpack(_Capsule(dlpack))
+    return Tensor(arr)

@@ -1,0 +1,81 @@
+"""Dataset/weights fetch-and-cache with md5 validation.
+
+Reference surface: python/paddle/utils/download.py (get_weights_path_from_url,
+get_path_from_url with md5 check, decompress, DOWNLOAD_RETRY_LIMIT).
+
+This build runs with zero network egress: local paths and file:// URLs are
+served from cache; remote URLs raise unless the file is already cached
+(populated out-of-band), keeping the API contract without network access.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+import shutil
+import tarfile
+import zipfile
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = osp.expanduser("~/.cache/paddle_tpu/weights")
+DOWNLOAD_RETRY_LIMIT = 3
+
+
+def _md5check(fullname, md5sum=None):
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def is_url(path: str) -> bool:
+    return path.startswith(("http://", "https://", "file://"))
+
+
+def _decompress(fname: str) -> str:
+    dirpath = osp.dirname(fname)
+    if tarfile.is_tarfile(fname):
+        with tarfile.open(fname) as f:
+            names = f.getnames()
+            f.extractall(dirpath, filter="data")
+        root = names[0].split("/")[0] if names else ""
+        return osp.join(dirpath, root)
+    if zipfile.is_zipfile(fname):
+        with zipfile.ZipFile(fname) as f:
+            names = f.namelist()
+            f.extractall(dirpath)
+        root = names[0].split("/")[0] if names else ""
+        return osp.join(dirpath, root)
+    return fname
+
+
+def get_path_from_url(url: str, root_dir: str = WEIGHTS_HOME, md5sum: str = None, check_exist: bool = True, decompress: bool = True) -> str:
+    if not is_url(url):
+        if osp.exists(url):
+            return url
+        raise FileNotFoundError(f"{url} is neither a URL nor an existing path")
+    if url.startswith("file://"):
+        src = url[len("file://"):]
+        fullname = osp.join(root_dir, osp.basename(src))
+        os.makedirs(root_dir, exist_ok=True)
+        if not (check_exist and osp.exists(fullname) and _md5check(fullname, md5sum)):
+            shutil.copy(src, fullname)
+    else:
+        fullname = osp.join(root_dir, osp.basename(url.split("?")[0]))
+        if not (osp.exists(fullname) and _md5check(fullname, md5sum)):
+            raise RuntimeError(
+                f"cannot fetch {url}: this build has no network egress. "
+                f"Place the file at {fullname} to populate the cache out-of-band."
+            )
+    if decompress and (tarfile.is_tarfile(fullname) or zipfile.is_zipfile(fullname)):
+        return _decompress(fullname)
+    return fullname
+
+
+def get_weights_path_from_url(url: str, md5sum: str = None) -> str:
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -1,0 +1,169 @@
+"""FLOPs accounting: static per-op table + dynamic model walker.
+
+Reference surface: python/paddle/utils/flops.py (op-level `flops(op_type,
+input_shapes, attrs)` with a registry) and python/paddle/hapi/dynamic_flops.py
+(`paddle.flops(net, input_size)` via forward hooks).
+"""
+
+from __future__ import annotations
+
+from functools import reduce
+
+import numpy as np
+
+__all__ = ["flops", "register_flops", "dynamic_flops"]
+
+_FLOPS_COMPUTE_FUNC_MAP = {}
+
+
+def prod(s):
+    return reduce(lambda a, b: a * b, s, 1)
+
+
+def flops(op_type: str, input_shapes: dict, attrs: dict) -> int:
+    """FLOPs of one op given its input shapes and attributes; 0 if unknown."""
+    fn = _FLOPS_COMPUTE_FUNC_MAP.get(op_type)
+    return 0 if fn is None else fn(input_shapes, attrs)
+
+
+def register_flops(op_type: str):
+    def register(func):
+        _FLOPS_COMPUTE_FUNC_MAP[op_type] = func
+        return func
+
+    return register
+
+
+@register_flops("matmul")
+@register_flops("matmul_v2")
+def _matmul_flops(input_shapes, attrs):
+    x, y = input_shapes.get("X", input_shapes.get("x")), input_shapes.get("Y", input_shapes.get("y"))
+    x, y = list(x[0] if isinstance(x[0], (list, tuple)) else x), list(y[0] if isinstance(y[0], (list, tuple)) else y)
+    if attrs.get("transpose_X") or attrs.get("trans_x"):
+        x[-1], x[-2] = x[-2], x[-1]
+    if attrs.get("transpose_Y") or attrs.get("trans_y"):
+        y[-1], y[-2] = y[-2], y[-1]
+    batch = prod(x[:-2])
+    return 2 * batch * x[-2] * x[-1] * y[-1]
+
+
+@register_flops("conv2d")
+def _conv2d_flops(input_shapes, attrs):
+    inp = input_shapes.get("Input", input_shapes.get("x"))
+    w = input_shapes.get("Filter", input_shapes.get("weight"))
+    inp = inp[0] if isinstance(inp[0], (list, tuple)) else inp
+    w = w[0] if isinstance(w[0], (list, tuple)) else w
+    oc, ic_g, kh, kw = w
+    n, _, h, win = inp
+    stride = attrs.get("strides", [1, 1])
+    pad = attrs.get("paddings", [0, 0])
+    dil = attrs.get("dilations", [1, 1])
+    oh = (h + 2 * pad[0] - dil[0] * (kh - 1) - 1) // stride[0] + 1
+    ow = (win + 2 * pad[1] - dil[1] * (kw - 1) - 1) // stride[1] + 1
+    return 2 * n * oc * oh * ow * ic_g * kh * kw
+
+
+@register_flops("relu")
+@register_flops("relu6")
+@register_flops("leaky_relu")
+@register_flops("dropout")
+@register_flops("elementwise_add")
+@register_flops("elementwise_mul")
+@register_flops("elementwise_div")
+def _elementwise_flops(input_shapes, attrs):
+    key = next(iter(input_shapes))
+    s = input_shapes[key]
+    s = s[0] if isinstance(s[0], (list, tuple)) else s
+    return prod(s)
+
+
+@register_flops("softmax")
+def _softmax_flops(input_shapes, attrs):
+    key = next(iter(input_shapes))
+    s = input_shapes[key]
+    s = s[0] if isinstance(s[0], (list, tuple)) else s
+    return 3 * prod(s)
+
+
+@register_flops("layer_norm")
+def _layer_norm_flops(input_shapes, attrs):
+    key = next(iter(input_shapes))
+    s = input_shapes[key]
+    s = s[0] if isinstance(s[0], (list, tuple)) else s
+    return 8 * prod(s)
+
+
+@register_flops("gelu")
+def _gelu_flops(input_shapes, attrs):
+    key = next(iter(input_shapes))
+    s = input_shapes[key]
+    s = s[0] if isinstance(s[0], (list, tuple)) else s
+    return 8 * prod(s)
+
+
+# ---- dynamic model walker (hapi/dynamic_flops.py analog) ----
+
+def _count_linear(layer, x, out):
+    return 2 * prod(x.shape) // x.shape[-1] * layer.in_features * layer.out_features // 2 * 2 // 2
+
+
+def dynamic_flops(net, input_size, custom_ops=None, print_detail: bool = False) -> int:
+    """Estimate total forward FLOPs of a Layer by running a zeros batch through
+    it with per-layer hooks. ``paddle.flops`` routes here."""
+    from ..core.tensor import Tensor
+    from ..nn.layer import common, conv, norm
+    from ..ops.creation import zeros
+
+    counts = {}
+    handles = []
+    custom_ops = custom_ops or {}
+
+    def make_hook(kind):
+        def hook(layer, inputs, output):
+            x = inputs[0]
+            xs = list(x.shape)
+            n = 0
+            if kind == "linear":
+                n = 2 * prod(xs) // xs[-1] * layer.in_features * layer.out_features
+            elif kind == "conv2d":
+                w = layer.weight.shape
+                os_ = list(output.shape)
+                n = 2 * prod(os_) * w[1] * w[2] * w[3]
+            elif kind == "norm":
+                n = 8 * prod(xs)
+            elif kind == "act":
+                n = prod(xs)
+            counts[id(layer)] = (type(layer).__name__, n)
+
+        return hook
+
+    from ..nn.layer import activation as act_mod
+
+    for lyr in net.sublayers(include_self=True):
+        if type(lyr) in custom_ops:
+            fn = custom_ops[type(lyr)]
+            handles.append(lyr.register_forward_post_hook(
+                lambda l, i, o, fn=fn: counts.__setitem__(id(l), (type(l).__name__, fn(l, i, o)))))
+        elif isinstance(lyr, common.Linear):
+            handles.append(lyr.register_forward_post_hook(make_hook("linear")))
+        elif isinstance(lyr, conv.Conv2D):
+            handles.append(lyr.register_forward_post_hook(make_hook("conv2d")))
+        elif isinstance(lyr, (norm.LayerNorm, norm.RMSNorm, norm._BatchNormBase, norm.GroupNorm)):
+            handles.append(lyr.register_forward_post_hook(make_hook("norm")))
+        elif type(lyr).__name__ in ("ReLU", "GELU", "Sigmoid", "Tanh", "ReLU6", "LeakyReLU", "Softmax"):
+            handles.append(lyr.register_forward_post_hook(make_hook("act")))
+
+    was_training = net.training
+    net.eval()
+    x = zeros(list(input_size), dtype="float32")
+    net(x)
+    if was_training:
+        net.train()
+    for h in handles:
+        h.remove()
+    total = sum(n for _, n in counts.values())
+    if print_detail:
+        for name, n in counts.values():
+            print(f"{name:24s} {n:>16,d}")
+        print(f"{'Total':24s} {total:>16,d}")
+    return total

@@ -1,0 +1,154 @@
+"""Quantization: observers, QAT fake-quant with STE, PTQ calibrate+convert.
+
+Mirrors the reference's test/quantization/ pattern: quantize a small model,
+check wrapper insertion, numeric behavior of fake-quant, and that convert
+produces a runnable inference model with int8 weight payloads.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import (
+    QAT,
+    PTQ,
+    AbsMaxObserver,
+    AbsMaxObserverFactory,
+    FakeQuanterWithAbsMaxObserver,
+    FakeQuanterChannelWiseAbsMaxObserver,
+    HistObserver,
+    KLObserver,
+    PerChannelAbsMaxObserver,
+    PerChannelAbsMaxObserverFactory,
+    QuantConfig,
+    QuantedConv2D,
+    QuantedLinear,
+)
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def test_absmax_observer_scale():
+    obs = AbsMaxObserver(quant_bits=8)
+    x = paddle.to_tensor(np.array([-3.0, 1.0, 2.5], np.float32))
+    obs(x)
+    assert np.isclose(obs.scales(), 3.0 / 127, rtol=1e-6)
+    assert obs.zero_points() == 0
+
+
+def test_per_channel_observer():
+    obs = PerChannelAbsMaxObserver(quant_bits=8, channel_axis=-1)
+    w = paddle.to_tensor(np.array([[1.0, -4.0], [2.0, 3.0]], np.float32))
+    obs(w)
+    np.testing.assert_allclose(obs.scales(), np.array([2.0, 4.0]) / 127, rtol=1e-6)
+
+
+def test_hist_and_kl_observers_produce_positive_scale():
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(size=(1024,)).astype(np.float32))
+    for obs in (HistObserver(bins_count=256), KLObserver(bins_count=512)):
+        obs(x)
+        obs(x * 0.5)
+        assert obs.scales() > 0
+
+
+def test_qat_quantize_swaps_layers_and_runs():
+    cfg = QuantConfig(
+        activation=FakeQuanterWithAbsMaxObserver(moving_rate=0.9),
+        weight=FakeQuanterChannelWiseAbsMaxObserver(),
+    )
+    model = MLP()
+    q_model = QAT(cfg).quantize(model)
+    assert isinstance(q_model.fc1, QuantedLinear)
+    assert isinstance(q_model.fc2, QuantedLinear)
+    x = paddle.to_tensor(np.random.default_rng(1).normal(size=(4, 8)).astype(np.float32))
+    out = q_model(x)
+    assert out.shape == [4, 4]
+    # fake-quant output should be close to (but measurably different from) fp32
+    ref = model(x)
+    assert np.abs(out.numpy() - ref.numpy()).max() < 0.5
+
+
+def test_qat_ste_gradient_is_identity():
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(), weight=None)
+    lin = nn.Linear(4, 4)
+    q = QAT(cfg).quantize(lin)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32), stop_gradient=False)
+    out = q(x)
+    out.sum().backward()
+    # STE: d(sum(xW+b))/dx = rowsum of W — gradient must flow through fake-quant
+    expected = np.asarray(q.weight._value).sum(axis=1)
+    np.testing.assert_allclose(x.grad.numpy()[0], expected, rtol=1e-4, atol=1e-4)
+
+
+def test_qat_convert_bakes_int8():
+    cfg = QuantConfig(
+        activation=FakeQuanterWithAbsMaxObserver(),
+        weight=FakeQuanterChannelWiseAbsMaxObserver(),
+    )
+    q_model = QAT(cfg).quantize(MLP())
+    x = paddle.to_tensor(np.random.default_rng(2).normal(size=(4, 8)).astype(np.float32))
+    q_model(x)  # one step to populate scales
+    inf_model = QAT(cfg).convert(q_model)
+    assert isinstance(inf_model.fc1, nn.Linear)
+    assert inf_model.fc1._quant_weight_int8.dtype == np.int8
+    out = inf_model(x)
+    assert out.shape == [4, 4]
+
+
+def test_ptq_calibrate_convert():
+    cfg = QuantConfig(
+        activation=AbsMaxObserverFactory(quant_bits=8),
+        weight=PerChannelAbsMaxObserverFactory(quant_bits=8),
+    )
+    model = MLP()
+    ptq = PTQ(cfg)
+    calib = ptq.quantize(model)
+    rng = np.random.default_rng(3)
+    for _ in range(4):
+        calib(paddle.to_tensor(rng.normal(size=(8, 8)).astype(np.float32)))
+    inf = ptq.convert(calib)
+    x = paddle.to_tensor(rng.normal(size=(4, 8)).astype(np.float32))
+    # quantized inference stays close to fp32 on in-distribution data
+    err = np.abs(inf(x).numpy() - model(x).numpy()).max()
+    assert err < 0.25, err
+
+
+def test_type_and_name_config():
+    cfg = QuantConfig()
+    cfg.add_type_config(nn.Linear, weight=FakeQuanterWithAbsMaxObserver())
+    model = MLP()
+    q = QAT(cfg).quantize(model)
+    assert isinstance(q.fc1, QuantedLinear)
+    assert q.fc1.activation_quanter is None
+    assert q.fc1.weight_quanter is not None
+
+
+def test_quanted_conv2d():
+    cfg = QuantConfig(
+        activation=FakeQuanterWithAbsMaxObserver(),
+        weight=FakeQuanterWithAbsMaxObserver(),
+    )
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(3, 8, 3, padding=1)
+
+        def forward(self, x):
+            return self.conv(x)
+
+    q = QAT(cfg).quantize(Net())
+    assert isinstance(q.conv, QuantedConv2D)
+    x = paddle.to_tensor(np.random.default_rng(4).normal(size=(2, 3, 8, 8)).astype(np.float32))
+    assert q(x).shape == [2, 8, 8, 8]

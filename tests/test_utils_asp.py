@@ -1,0 +1,154 @@
+"""utils (unique_name, deprecated, dlpack, flops, cpp_extension), hub, onnx
+export, and ASP 2:4 sparsity."""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate import asp
+
+
+def test_unique_name_generate_and_guard():
+    from paddle_tpu.utils import unique_name
+
+    a = unique_name.generate("fc")
+    b = unique_name.generate("fc")
+    assert a != b
+    with unique_name.guard():
+        c = unique_name.generate("fc")
+        assert c.endswith("_0")
+
+
+def test_deprecated_warns():
+    from paddle_tpu.utils import deprecated
+
+    @deprecated(update_to="paddle.new_api", since="2.5")
+    def old_api():
+        return 42
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_api() == 42
+        assert any(issubclass(x.category, DeprecationWarning) for x in w)
+
+
+def test_dlpack_roundtrip():
+    from paddle_tpu.utils.dlpack import from_dlpack, to_dlpack
+
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    cap = to_dlpack(x)
+    y = from_dlpack(paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))._value)
+    np.testing.assert_allclose(y.numpy(), x.numpy())
+
+
+def test_flops_linear():
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    total = paddle.flops(net, [2, 16])
+    # 2*(2*16*32) + 2*32 + 2*(2*32*8)
+    assert total == 2 * 2 * 16 * 32 + 2 * 32 + 2 * 2 * 32 * 8
+
+
+def test_op_flops_table():
+    from paddle_tpu.utils.flops import flops
+
+    n = flops("matmul", {"X": [[4, 8]], "Y": [[8, 16]]}, {})
+    assert n == 2 * 4 * 8 * 16
+    assert flops("unknown_op", {}, {}) == 0
+
+
+def test_cpp_extension_load(tmp_path):
+    src = tmp_path / "ext.cc"
+    src.write_text('extern "C" int add_ints(int a, int b) { return a + b; }\n')
+    from paddle_tpu.utils import cpp_extension
+
+    lib = cpp_extension.load("t_ext", [str(src)], build_directory=str(tmp_path))
+    assert lib.add_ints(2, 3) == 5
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = []\n"
+        "def tiny_model(width=4):\n"
+        "    '''A tiny model.'''\n"
+        "    import paddle_tpu.nn as nn\n"
+        "    return nn.Linear(width, width)\n"
+    )
+    names = paddle.hub.list(str(tmp_path), source="local")
+    assert "tiny_model" in names
+    assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model")
+    m = paddle.hub.load(str(tmp_path), "tiny_model", width=6)
+    assert m.in_features == 6
+    with pytest.raises(RuntimeError):
+        paddle.hub.list("owner/repo", source="github")
+
+
+def test_onnx_export_writes_stablehlo(tmp_path):
+    net = nn.Sequential(nn.Linear(4, 4), nn.ReLU())
+    from paddle_tpu.static import InputSpec
+
+    path = str(tmp_path / "model")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = paddle.onnx.export(net, path, input_spec=[InputSpec([None, 4], "float32")])
+    written = os.listdir(tmp_path)
+    assert any(f.startswith("model") for f in written), written
+
+
+# ---- ASP ----
+
+def test_mask_1d_property():
+    from paddle_tpu.incubate.asp import check_mask_1d, get_mask_1d
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(8, 16)).astype(np.float32)
+    mask = get_mask_1d(w, 2, 4)
+    assert mask.shape == w.shape
+    assert check_mask_1d(w * mask, 2, 4)
+    # exactly half the entries survive
+    assert mask.sum() == w.size // 2
+
+
+def test_mask_2d_greedy():
+    from paddle_tpu.incubate.asp import check_mask_2d, get_mask_2d_greedy
+
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(8, 8)).astype(np.float32)
+    mask = get_mask_2d_greedy(w, 2, 4)
+    assert check_mask_2d(w * mask, 2, 4)
+
+
+def test_prune_model_and_decorate():
+    from paddle_tpu.incubate.asp import calculate_density, check_sparsity
+
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    masks = asp.prune_model(net, mask_algo="mask_1d")
+    assert len(masks) == 2
+    for name, p in net.named_parameters():
+        if name in masks:
+            assert abs(calculate_density(np.asarray(p._value)) - 0.5) < 1e-6
+            assert check_sparsity(np.asarray(p._value))
+
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    opt = asp.decorate(opt)
+    x = paddle.to_tensor(np.random.default_rng(2).normal(size=(4, 16)).astype(np.float32))
+    loss = net(x).sum()
+    loss.backward()
+    opt.step()
+    # sparsity survives the update
+    for name, p in net.named_parameters():
+        if name in masks:
+            assert check_sparsity(np.asarray(p._value)), name
+
+
+def test_excluded_layers():
+    net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+    names = [n for n, _ in net.named_parameters()]
+    asp.reset_excluded_layers()
+    asp.set_excluded_layers([names[0].rsplit(".", 1)[0]])
+    masks = asp.prune_model(net)
+    assert names[0] not in masks
+    asp.reset_excluded_layers()
