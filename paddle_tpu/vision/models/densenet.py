@@ -1,0 +1,145 @@
+"""DenseNet (reference: python/paddle/vision/models/densenet.py): dense blocks
+concatenate every preceding feature map; transitions halve channels/resolution."""
+
+from ... import nn
+from .resnet import _no_pretrained
+from ...ops.manipulation import concat
+
+
+class BNACConvLayer(nn.Layer):
+    """BN -> ReLU -> Conv (pre-activation ordering)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1, pad=0, groups=1):
+        super().__init__()
+        self._batch_norm = nn.BatchNorm2D(num_channels)
+        self._relu = nn.ReLU()
+        self._conv = nn.Conv2D(num_channels, num_filters, filter_size, stride, pad, groups=groups, bias_attr=False)
+
+    def forward(self, x):
+        return self._conv(self._relu(self._batch_norm(x)))
+
+
+class DenseLayer(nn.Layer):
+    def __init__(self, num_channels, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.dropout = dropout
+        self.bn_ac_func1 = BNACConvLayer(num_channels, bn_size * growth_rate, 1)
+        self.bn_ac_func2 = BNACConvLayer(bn_size * growth_rate, growth_rate, 3, pad=1)
+        if dropout:
+            self.dropout_func = nn.Dropout(p=dropout)
+
+    def forward(self, x):
+        out = self.bn_ac_func2(self.bn_ac_func1(x))
+        if self.dropout:
+            out = self.dropout_func(out)
+        return concat([x, out], axis=1)
+
+
+class DenseBlock(nn.Layer):
+    def __init__(self, num_channels, num_layers, bn_size, growth_rate, dropout):
+        super().__init__()
+        self.layers = nn.LayerList([
+            DenseLayer(num_channels + i * growth_rate, growth_rate, bn_size, dropout)
+            for i in range(num_layers)
+        ])
+
+    def forward(self, x):
+        for lyr in self.layers:
+            x = lyr(x)
+        return x
+
+
+class TransitionLayer(nn.Layer):
+    def __init__(self, num_channels, num_output_features):
+        super().__init__()
+        self.conv_ac_func = BNACConvLayer(num_channels, num_output_features, 1)
+        self.pool2d_avg = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool2d_avg(self.conv_ac_func(x))
+
+
+_CFG = {
+    121: (6, 12, 24, 16),
+    161: (6, 12, 36, 24),
+    169: (6, 12, 32, 32),
+    201: (6, 12, 48, 32),
+    264: (6, 12, 64, 48),
+}
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        block_config = _CFG[layers]
+        growth_rate = 48 if layers == 161 else 32
+        num_init_features = 96 if layers == 161 else 64
+
+        self.conv1_func = nn.Sequential(
+            nn.Conv2D(3, num_init_features, 7, 2, 3, bias_attr=False),
+            nn.BatchNorm2D(num_init_features),
+            nn.ReLU(),
+        )
+        self.pool2d_max = nn.MaxPool2D(3, stride=2, padding=1)
+
+        blocks, transitions = [], []
+        ch = num_init_features
+        for i, n_layers in enumerate(block_config):
+            blocks.append(DenseBlock(ch, n_layers, bn_size, growth_rate, dropout))
+            ch += n_layers * growth_rate
+            if i != len(block_config) - 1:
+                transitions.append(TransitionLayer(ch, ch // 2))
+                ch //= 2
+        self.dense_blocks = nn.LayerList(blocks)
+        self.transitions = nn.LayerList(transitions)
+        self.batch_norm = nn.BatchNorm2D(ch)
+        self.relu = nn.ReLU()
+        if with_pool:
+            self.pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.out = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.pool2d_max(self.conv1_func(x))
+        for i, block in enumerate(self.dense_blocks):
+            x = block(x)
+            if i < len(self.transitions):
+                x = self.transitions[i](x)
+        x = self.relu(self.batch_norm(x))
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = self.out(x.flatten(1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("densenet121")
+    return DenseNet(layers=121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("densenet161")
+    return DenseNet(layers=161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("densenet169")
+    return DenseNet(layers=169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("densenet201")
+    return DenseNet(layers=201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("densenet264")
+    return DenseNet(layers=264, **kwargs)

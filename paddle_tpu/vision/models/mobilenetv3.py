@@ -1,0 +1,144 @@
+"""MobileNetV3 small/large (reference: python/paddle/vision/models/
+mobilenetv3.py): inverted residuals + squeeze-excite + hardswish."""
+
+from ... import nn
+from .resnet import _no_pretrained
+from .mobilenetv2 import _make_divisible
+
+
+class SqueezeExcitation(nn.Layer):
+    def __init__(self, input_channels, squeeze_channels):
+        super().__init__()
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(input_channels, squeeze_channels, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(squeeze_channels, input_channels, 1)
+        self.hardsigmoid = nn.Hardsigmoid()
+
+    def forward(self, x):
+        scale = self.hardsigmoid(self.fc2(self.relu(self.fc1(self.avgpool(x)))))
+        return x * scale
+
+
+class ConvNormActivation(nn.Sequential):
+    def __init__(self, in_ch, out_ch, kernel_size=3, stride=1, groups=1, activation="relu"):
+        padding = (kernel_size - 1) // 2
+        layers = [
+            nn.Conv2D(in_ch, out_ch, kernel_size, stride, padding, groups=groups, bias_attr=False),
+            nn.BatchNorm2D(out_ch),
+        ]
+        if activation == "relu":
+            layers.append(nn.ReLU())
+        elif activation == "hardswish":
+            layers.append(nn.Hardswish())
+        super().__init__(*layers)
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_ch, exp_ch, out_ch, kernel_size, stride, use_se, activation):
+        super().__init__()
+        self.use_res_connect = stride == 1 and in_ch == out_ch
+        layers = []
+        if exp_ch != in_ch:
+            layers.append(ConvNormActivation(in_ch, exp_ch, 1, activation=activation))
+        layers.append(ConvNormActivation(exp_ch, exp_ch, kernel_size, stride, groups=exp_ch, activation=activation))
+        if use_se:
+            layers.append(SqueezeExcitation(exp_ch, _make_divisible(exp_ch // 4)))
+        layers.append(ConvNormActivation(exp_ch, out_ch, 1, activation=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res_connect else out
+
+
+_LARGE_CFG = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+_SMALL_CFG = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        scaled = lambda c: _make_divisible(c * scale)
+
+        firstconv_out = scaled(16)
+        layers = [ConvNormActivation(3, firstconv_out, 3, stride=2, activation="hardswish")]
+        in_ch = firstconv_out
+        for k, exp, out, se, act, s in config:
+            layers.append(InvertedResidual(in_ch, scaled(exp), scaled(out), k, s, se, act))
+            in_ch = scaled(out)
+        lastconv_out = 6 * in_ch
+        layers.append(ConvNormActivation(in_ch, lastconv_out, 1, activation="hardswish"))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(lastconv_out, last_channel),
+                nn.Hardswish(),
+                nn.Dropout(0.2),
+                nn.Linear(last_channel, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE_CFG, _make_divisible(1280 * scale), scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL_CFG, _make_divisible(1024 * scale), scale, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        _no_pretrained("mobilenet_v3_large")
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        _no_pretrained("mobilenet_v3_small")
+    return MobileNetV3Small(scale=scale, **kwargs)

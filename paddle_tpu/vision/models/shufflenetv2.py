@@ -1,0 +1,143 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py):
+channel-split + depthwise blocks + channel shuffle (a reshape/transpose pair
+that XLA folds into layout changes)."""
+
+from ... import nn
+from .resnet import _no_pretrained
+from ...ops.linalg import transpose
+from ...ops.manipulation import concat, reshape, split
+
+
+def channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = reshape(x, [n, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [n, c, h, w])
+
+
+def _act_layer(act):
+    return nn.Swish() if act == "swish" else nn.ReLU()
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_channels, out_channels, stride, act="relu"):
+        super().__init__()
+        self._stride = stride
+        branch_ch = out_channels // 2
+        if stride > 1:
+            self._branch1 = nn.Sequential(
+                nn.Conv2D(in_channels, in_channels, 3, stride, 1, groups=in_channels, bias_attr=False),
+                nn.BatchNorm2D(in_channels),
+                nn.Conv2D(in_channels, branch_ch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_ch),
+                _act_layer(act),
+            )
+        branch2_in = in_channels if stride > 1 else in_channels // 2
+        self._branch2 = nn.Sequential(
+            nn.Conv2D(branch2_in, branch_ch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_ch),
+            _act_layer(act),
+            nn.Conv2D(branch_ch, branch_ch, 3, stride, 1, groups=branch_ch, bias_attr=False),
+            nn.BatchNorm2D(branch_ch),
+            nn.Conv2D(branch_ch, branch_ch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_ch),
+            _act_layer(act),
+        )
+
+    def forward(self, x):
+        if self._stride > 1:
+            out = concat([self._branch1(x), self._branch2(x)], axis=1)
+        else:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self._branch2(x2)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        ch = _STAGE_OUT[scale]
+        self._conv1 = nn.Sequential(
+            nn.Conv2D(3, ch[0], 3, 2, 1, bias_attr=False), nn.BatchNorm2D(ch[0]), _act_layer(act)
+        )
+        self._max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        in_c = ch[0]
+        for stage, repeats in enumerate(stage_repeats):
+            out_c = ch[stage + 1]
+            blocks.append(InvertedResidual(in_c, out_c, 2, act))
+            for _ in range(repeats - 1):
+                blocks.append(InvertedResidual(out_c, out_c, 1, act))
+            in_c = out_c
+        self._blocks = nn.Sequential(*blocks)
+        self._last_conv = nn.Sequential(
+            nn.Conv2D(in_c, ch[-1], 1, bias_attr=False), nn.BatchNorm2D(ch[-1]), _act_layer(act)
+        )
+        if with_pool:
+            self._pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self._fc = nn.Linear(ch[-1], num_classes)
+
+    def forward(self, x):
+        x = self._max_pool(self._conv1(x))
+        x = self._last_conv(self._blocks(x))
+        if self.with_pool:
+            x = self._pool2d_avg(x)
+        if self.num_classes > 0:
+            x = self._fc(x.flatten(1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("shufflenet_v2_x0_25")
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("shufflenet_v2_x0_33")
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("shufflenet_v2_x0_5")
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("shufflenet_v2_x1_0")
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("shufflenet_v2_x1_5")
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("shufflenet_v2_x2_0")
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("shufflenet_v2_swish")
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

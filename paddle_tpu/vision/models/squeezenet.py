@@ -1,0 +1,82 @@
+"""SqueezeNet 1.0/1.1 (reference: python/paddle/vision/models/squeezenet.py):
+fire modules (squeeze 1x1 -> expand 1x1 + 3x3 concat)."""
+
+from ... import nn
+from .resnet import _no_pretrained
+from ...ops.manipulation import concat
+
+
+class MakeFire(nn.Layer):
+    def __init__(self, in_channels, squeeze_channels, expand1x1_channels, expand3x3_channels):
+        super().__init__()
+        self._conv = nn.Conv2D(in_channels, squeeze_channels, 1)
+        self._conv_path1 = nn.Conv2D(squeeze_channels, expand1x1_channels, 1)
+        self._conv_path2 = nn.Conv2D(squeeze_channels, expand3x3_channels, 3, padding=1)
+        self._relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self._relu(self._conv(x))
+        return concat([self._relu(self._conv_path1(x)), self._relu(self._conv_path2(x))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.version = version
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self._conv = nn.Conv2D(3, 96, 7, stride=2)
+            self._fires = nn.Sequential(
+                MakeFire(96, 16, 64, 64), MakeFire(128, 16, 64, 64), MakeFire(128, 32, 128, 128),
+            )
+            self._fires2 = nn.Sequential(
+                MakeFire(256, 32, 128, 128), MakeFire(256, 48, 192, 192),
+                MakeFire(384, 48, 192, 192), MakeFire(384, 64, 256, 256),
+            )
+            self._fires3 = nn.Sequential(MakeFire(512, 64, 256, 256))
+        elif version == "1.1":
+            self._conv = nn.Conv2D(3, 64, 3, stride=2, padding=1)
+            self._fires = nn.Sequential(MakeFire(64, 16, 64, 64), MakeFire(128, 16, 64, 64))
+            self._fires2 = nn.Sequential(MakeFire(128, 32, 128, 128), MakeFire(256, 32, 128, 128))
+            self._fires3 = nn.Sequential(
+                MakeFire(256, 48, 192, 192), MakeFire(384, 48, 192, 192),
+                MakeFire(384, 64, 256, 256), MakeFire(512, 64, 256, 256),
+            )
+        else:
+            raise ValueError(f"unsupported SqueezeNet version {version!r}")
+        self._relu = nn.ReLU()
+        self._pool = nn.MaxPool2D(3, stride=2)
+        if num_classes > 0:
+            self._drop = nn.Dropout(0.5)
+            self._conv_last = nn.Conv2D(512, num_classes, 1)
+        if with_pool:
+            self._avg_pool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self._pool(self._relu(self._conv(x)))
+        x = self._fires(x)
+        x = self._pool(x)
+        x = self._fires2(x)
+        if self.version == "1.0":
+            x = self._pool(x)
+        x = self._fires3(x)
+        if self.num_classes > 0:
+            x = self._relu(self._conv_last(self._drop(x)))
+        if self.with_pool:
+            x = self._avg_pool(x)
+            if self.num_classes > 0:
+                x = x.flatten(1)
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("squeezenet1_0")
+    return SqueezeNet(version="1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        _no_pretrained("squeezenet1_1")
+    return SqueezeNet(version="1.1", **kwargs)

@@ -114,3 +114,87 @@ def test_roi_pool_shape():
     boxes = paddle.to_tensor(np.array([[0, 0, 8, 8], [4, 4, 12, 12]], np.float32))
     out = ops.roi_pool(x, boxes, paddle.to_tensor(np.array([2])), output_size=2)
     assert list(out.shape) == [2, 3, 2, 2]
+
+
+# ---- widened model zoo (reference vision/models/__init__.py __all__) ----
+
+@pytest.mark.parametrize(
+    "builder,kwargs",
+    [
+        ("mobilenet_v1", {"scale": 0.25}),
+        ("mobilenet_v3_small", {"scale": 0.5}),
+        ("mobilenet_v3_large", {"scale": 0.35}),
+        ("squeezenet1_0", {}),
+        ("squeezenet1_1", {}),
+        ("shufflenet_v2_x0_25", {}),
+        ("resnext50_32x4d", {}),
+        ("wide_resnet101_2", {}),
+    ],
+)
+def test_model_zoo_forward(builder, kwargs):
+    from paddle_tpu.vision import models as M
+
+    net = getattr(M, builder)(num_classes=7, **kwargs)
+    net.eval()
+    x = paddle.randn([1, 3, 64, 64])
+    out = net(x)
+    assert list(out.shape) == [1, 7], builder
+
+
+def test_densenet_forward():
+    from paddle_tpu.vision.models import DenseNet
+
+    net = DenseNet(layers=121, num_classes=5)
+    net.eval()
+    assert list(net(paddle.randn([1, 3, 64, 64])).shape) == [1, 5]
+
+
+def test_googlenet_aux_heads():
+    from paddle_tpu.vision.models import googlenet
+
+    net = googlenet(num_classes=5)
+    net.train()
+    out, aux1, aux2 = net(paddle.randn([1, 3, 224, 224]))
+    assert list(out.shape) == list(aux1.shape) == list(aux2.shape) == [1, 5]
+
+
+def test_inception_v3_forward():
+    from paddle_tpu.vision.models import inception_v3
+
+    net = inception_v3(num_classes=5)
+    net.eval()
+    assert list(net(paddle.randn([1, 3, 299, 299])).shape) == [1, 5]
+
+
+def test_googlenet_eval_single_output():
+    from paddle_tpu.vision.models import googlenet
+
+    net = googlenet(num_classes=5)
+    net.eval()
+    out = net(paddle.randn([1, 3, 224, 224]))
+    assert list(out.shape) == [1, 5]
+
+
+def test_squeezenet_headless_backbone():
+    from paddle_tpu.vision.models import SqueezeNet
+
+    net = SqueezeNet(version="1.1", num_classes=0, with_pool=False)
+    net.eval()
+    out = net(paddle.randn([1, 3, 64, 64]))
+    assert out.shape[1] == 512 and len(out.shape) == 4
+
+
+def test_shufflenet_swish_uses_swish():
+    from paddle_tpu import nn
+    from paddle_tpu.vision.models import shufflenet_v2_swish
+
+    net = shufflenet_v2_swish(num_classes=3)
+    acts = [type(l).__name__ for l in net.sublayers()]
+    assert "Swish" in acts and "ReLU" not in acts
+
+
+def test_pretrained_raises():
+    from paddle_tpu.vision.models import densenet121
+
+    with pytest.raises(ValueError):
+        densenet121(pretrained=True)
