@@ -73,6 +73,8 @@ class DenseNet(nn.Layer):
         super().__init__()
         self.num_classes = num_classes
         self.with_pool = with_pool
+        if layers not in _CFG:
+            raise ValueError(f"unsupported DenseNet depth {layers!r}; choose from {sorted(_CFG)}")
         block_config = _CFG[layers]
         growth_rate = 48 if layers == 161 else 32
         num_init_features = 96 if layers == 161 else 64

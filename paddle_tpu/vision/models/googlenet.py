@@ -1,6 +1,7 @@
 """GoogLeNet / Inception v1 (reference: python/paddle/vision/models/
 googlenet.py): inception modules with parallel 1x1/3x3/5x5/pool branches and
-two auxiliary classifier heads (returned only in train mode)."""
+two auxiliary classifier heads. Matching the reference, forward always
+returns [out, aux1, aux2] (reference googlenet.py:230)."""
 
 from ... import nn
 from .resnet import _no_pretrained
@@ -89,8 +90,6 @@ class GoogLeNet(nn.Layer):
         if self.num_classes <= 0:
             return x
         out = self._fc_out(self._drop(x).flatten(1))
-        if not self.training:
-            return out
         o1 = self._conv_o1(self._pool_o1(ince4a)).flatten(1)
         o1 = self._out1(self._drop_o1(nn.functional.relu(self._fc_o1(o1))))
         o2 = self._conv_o2(self._pool_o2(ince4d)).flatten(1)

@@ -69,6 +69,8 @@ class ShuffleNetV2(nn.Layer):
         self.num_classes = num_classes
         self.with_pool = with_pool
         stage_repeats = [4, 8, 4]
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"unsupported ShuffleNetV2 scale {scale!r}; choose from {sorted(_STAGE_OUT)}")
         ch = _STAGE_OUT[scale]
         self._conv1 = nn.Sequential(
             nn.Conv2D(3, ch[0], 3, 2, 1, bias_attr=False), nn.BatchNorm2D(ch[0]), _act_layer(act)

@@ -166,12 +166,13 @@ def test_inception_v3_forward():
     assert list(net(paddle.randn([1, 3, 299, 299])).shape) == [1, 5]
 
 
-def test_googlenet_eval_single_output():
+def test_googlenet_eval_returns_triple():
+    # reference contract (googlenet.py:230): always [out, aux1, aux2]
     from paddle_tpu.vision.models import googlenet
 
     net = googlenet(num_classes=5)
     net.eval()
-    out = net(paddle.randn([1, 3, 224, 224]))
+    out, aux1, aux2 = net(paddle.randn([1, 3, 224, 224]))
     assert list(out.shape) == [1, 5]
 
 
@@ -185,7 +186,6 @@ def test_squeezenet_headless_backbone():
 
 
 def test_shufflenet_swish_uses_swish():
-    from paddle_tpu import nn
     from paddle_tpu.vision.models import shufflenet_v2_swish
 
     net = shufflenet_v2_swish(num_classes=3)
@@ -198,3 +198,12 @@ def test_pretrained_raises():
 
     with pytest.raises(ValueError):
         densenet121(pretrained=True)
+
+
+def test_bad_scale_and_depth_raise():
+    from paddle_tpu.vision.models import DenseNet, ShuffleNetV2
+
+    with pytest.raises(ValueError):
+        ShuffleNetV2(scale=0.75)
+    with pytest.raises(ValueError):
+        DenseNet(layers=100)
