@@ -12,6 +12,7 @@ __version__ = "0.1.0"
 
 from .core import (  # noqa: F401
     CPUPlace,
+    CUDAPinnedPlace,
     CUDAPlace,
     Place,
     TPUPlace,
@@ -49,6 +50,34 @@ from .core.tensor import Parameter  # noqa: F401
 from .ops import *  # noqa: F401,F403
 from .ops import sum, max, min, all, any, abs, slice  # noqa: F401,A004
 from .ops.logic import is_tensor  # noqa: F401
+from .ops.compat import (  # noqa: F401
+    LazyGuard,
+    add_n,
+    batch,
+    check_shape,
+    complex,
+    create_parameter,
+    disable_signal_handler,
+    finfo,
+    iinfo,
+    increment,
+    is_complex,
+    is_floating_point,
+    is_integer,
+    nan_to_num,
+    nanquantile,
+    polar,
+    rank,
+    reverse,
+    sgn,
+    shape,
+    shard_index,
+    squeeze_,
+    tanh_,
+    tolist,
+    unsqueeze_,
+)
+from .framework.random import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401
 
 # Subsystem namespaces land here as they are built out (nn, optimizer, io,
 # distributed, jit, ...). Each addition extends this import block.
@@ -96,6 +125,7 @@ from .param_attr import ParamAttr  # noqa: F401,E402
 
 # paddle.grad
 from .core.autograd import grad  # noqa: F401,E402
+from .nn.layer.layers import disable_static, enable_static, in_dynamic_mode  # noqa: F401,E402
 
 
 def get_default_dtype():

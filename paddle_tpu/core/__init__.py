@@ -29,6 +29,7 @@ from .place import (  # noqa: F401
     CPUPlace,
     Place,
     TPUPlace,
+    CUDAPinnedPlace,
     CUDAPlace,
     XPUPlace,
     CustomPlace,

@@ -65,6 +65,15 @@ class XPUPlace(Place):
     kind = "xpu"
 
 
+class CUDAPinnedPlace(Place):
+    """Pinned host memory place; host arrays are always transfer-ready here."""
+
+    kind = "cuda_pinned"
+
+    def __init__(self):
+        super().__init__(0)
+
+
 class CustomPlace(Place):
     def __init__(self, dev_type: str, device_id: int = 0):
         super().__init__(device_id)

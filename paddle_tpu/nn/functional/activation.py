@@ -184,3 +184,26 @@ def glu(x, axis=-1, name=None):
 def softmax_with_temperature(x, temperature=1.0, axis=-1):
     x = as_tensor(x)
     return apply("softmax_t", lambda xv: jax.nn.softmax(xv / temperature, axis=axis), x)
+
+
+@register_op("nn.thresholded_relu")
+def thresholded_relu(x, threshold=1.0, name=None):
+    x = as_tensor(x)
+    return apply("thresholded_relu", lambda xv: jnp.where(xv > threshold, xv, 0.0).astype(xv.dtype), x)
+
+
+# ---- in-place variants (reference exposes *_ for memory reuse; here they
+# rebind the Tensor's value, which under jit is the same program) ----
+def relu_(x, name=None):
+    return x._inplace_from(relu(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._inplace_from(elu(x, alpha))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._inplace_from(softmax(x, axis=axis, dtype=dtype))
+
+
+from ...ops.compat import tanh_  # noqa: E402  (single impl shared with paddle.tanh_)

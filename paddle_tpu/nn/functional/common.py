@@ -354,3 +354,172 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=Tr
         return jnp.transpose(out, (0, 3, 1, 2))
 
     return apply("grid_sample", fn, x, grid)
+
+
+@register_op("nn.pairwise_distance")
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+
+    def f(xv, yv):
+        d = jnp.abs(xv - yv) + epsilon
+        return jnp.power(jnp.sum(jnp.power(d, p), -1, keepdims=keepdim), 1.0 / p)
+
+    return apply("pairwise_distance", f, x, y)
+
+
+@register_op("nn.diag_embed")
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batch of diagonal matrices from the last dim (reference diag_embed)."""
+    x = as_tensor(input)
+
+    def f(xv):
+        n = xv.shape[-1] + abs(offset)
+        out_ndim = xv.ndim + 1
+        d1, d2 = dim1 % out_ndim, dim2 % out_ndim
+        mat = jnp.zeros(xv.shape[:-1] + (n, n), xv.dtype)
+        idx = jnp.arange(xv.shape[-1])
+        rows = idx if offset >= 0 else idx - offset
+        cols = idx + offset if offset >= 0 else idx
+        mat = mat.at[..., rows, cols].set(xv)
+        # move the two new axes to dim1/dim2
+        target = [None] * out_ndim
+        target[d1], target[d2] = out_ndim - 2, out_ndim - 1
+        rest = iter(range(out_ndim - 2))
+        for i in range(out_ndim):
+            if target[i] is None:
+                target[i] = next(rest)
+        return jnp.transpose(mat, target)
+
+    return apply("diag_embed", f, x)
+
+
+@register_op("nn.sequence_mask")
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Length vector -> boolean mask matrix (reference sequence_mask)."""
+    from ...core.dtype import to_jax_dtype
+
+    x = as_tensor(x)
+    jdt = to_jax_dtype(dtype)
+    if maxlen is None:
+        import numpy as np
+
+        maxlen = int(np.asarray(x._value).max(initial=0))
+
+    def f(xv):
+        return (jnp.arange(maxlen)[None, :] < xv[..., None]).astype(jdt)
+
+    return apply("sequence_mask", f, x)
+
+
+@register_op("nn.zeropad2d")
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    l, r, t, b = padding if not isinstance(padding, int) else (padding,) * 4
+
+    def f(xv):
+        if data_format == "NCHW":
+            return jnp.pad(xv, ((0, 0), (0, 0), (t, b), (l, r)))
+        return jnp.pad(xv, ((0, 0), (t, b), (l, r), (0, 0)))
+
+    return apply("zeropad2d", f, x)
+
+
+@register_op("nn.affine_grid")
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2-D affine sampling grid for grid_sample (reference affine_grid)."""
+    theta = as_tensor(theta)
+    if hasattr(out_shape, "_value"):
+        import numpy as np
+
+        out_shape = [int(v) for v in np.asarray(out_shape._value)]
+    n, c, h, w = out_shape
+
+    def f(tv):
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1).reshape(-1, 3)  # [H*W, 3]
+        out = jnp.einsum("hk,nik->nhi", base.astype(tv.dtype), tv)  # [N, H*W, 2]
+        return out.reshape(n, h, w, 2)
+
+    return apply("affine_grid", f, theta)
+
+
+@register_op("nn.gather_tree")
+def gather_tree(ids, parents):
+    """Back-trace beam-search ancestry (reference gather_tree op):
+    ids/parents [T, B, beam] -> full sequences per final beam."""
+    ids, parents = as_tensor(ids), as_tensor(parents)
+
+    def f(iv, pv):
+        T = iv.shape[0]
+        out = [None] * T
+        out[T - 1] = iv[T - 1]
+        parent = pv[T - 1]
+        for t in range(T - 2, -1, -1):
+            out[t] = jnp.take_along_axis(iv[t], parent, axis=-1)
+            parent = jnp.take_along_axis(pv[t], parent, axis=-1)
+        return jnp.stack(out, 0)
+
+    return apply("gather_tree", f, ids, parents)
+
+
+@register_op("nn.temporal_shift")
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """TSM temporal channel shift (reference temporal_shift op): fold the batch
+    into [N//seg, seg, C, H, W], shift the first channels back/forward in time."""
+    x = as_tensor(x)
+
+    def f(xv):
+        if data_format == "NHWC":
+            xv = jnp.transpose(xv, (0, 3, 1, 2))
+        nt, c, h, w = xv.shape
+        n = nt // seg_num
+        v = xv.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        back = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], 1)
+        fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]), v[:, :-1, fold:2 * fold]], 1)
+        keep = v[:, :, 2 * fold:]
+        out = jnp.concatenate([back, fwd, keep], 2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply("temporal_shift", f, x)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns, key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention (reference: CUDA-only sparse_attention op).
+    TPU-native path: densify the CSR mask and run masked SDPA — XLA fuses the
+    mask; a Pallas block-sparse kernel (splash-attention analog) is the
+    upgrade path for real sparsity wins."""
+    import numpy as np
+
+    query, key, value = as_tensor(query), as_tensor(key), as_tensor(value)
+    offs = np.asarray(as_tensor(sparse_csr_offset)._value)
+    cols = np.asarray(as_tensor(sparse_csr_columns)._value)
+    B, H, S, D = query.shape
+    mask = np.zeros((B, H, S, S), np.float32)
+    # vectorized CSR -> dense: repeat each (b, h, row) by its nonzero count,
+    # pair with the flat column list, and scatter in one fancy-index write
+    counts = np.diff(offs, axis=-1).ravel()  # nonzeros per (b, h, row)
+    b_idx, h_idx, r_idx = np.meshgrid(np.arange(B), np.arange(H), np.arange(S), indexing="ij")
+    bs = np.repeat(b_idx.ravel(), counts)
+    hs = np.repeat(h_idx.ravel(), counts)
+    rows = np.repeat(r_idx.ravel(), counts)
+    starts = offs[..., :-1].ravel()
+    within = np.arange(counts.sum()) - np.repeat(np.cumsum(counts) - counts, counts)
+    mask[bs, hs, rows, cols.reshape(B, H, -1)[bs, hs, within + np.repeat(starts, counts)]] = 1.0
+
+    def f(qv, kv, vv):
+        scores = jnp.einsum("bhsd,bhtd->bhst", qv, kv) / jnp.sqrt(jnp.asarray(D, qv.dtype))
+        scores = jnp.where(mask > 0, scores, -1e30)
+        probs = jax.nn.softmax(scores, -1)
+        probs = probs * mask  # rows with no allowed keys -> all zeros
+        return jnp.einsum("bhst,bhtd->bhsd", probs, vv)
+
+    return apply("sparse_attention", f, query, key, value)

@@ -325,3 +325,282 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, red
         return _reduce(loss, reduction)
 
     return apply("sigmoid_focal_loss", fn, *tensors)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice coefficient loss for segmentation (reference: nn/functional/loss.py
+    dice_loss): input [N, ..., C] probabilities, label [N, ..., 1] class ids."""
+    input, label = as_tensor(input), as_tensor(label)
+
+    def f(iv, lv):
+        num_classes = iv.shape[-1]
+        lv = jnp.squeeze(lv, -1)
+        one_hot = jax.nn.one_hot(lv, num_classes, dtype=iv.dtype)
+        reduce_dims = tuple(range(1, iv.ndim))
+        intersect = jnp.sum(iv * one_hot, axis=reduce_dims)
+        denom = jnp.sum(iv, axis=reduce_dims) + jnp.sum(one_hot, axis=reduce_dims)
+        dice = (2 * intersect + epsilon) / (denom + epsilon)
+        return jnp.mean(1 - dice)
+
+    return apply("dice_loss", f, input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """Negative log likelihood of a bernoulli prediction (reference log_loss)."""
+    input, label = as_tensor(input), as_tensor(label)
+
+    def f(iv, lv):
+        return -lv * jnp.log(iv + epsilon) - (1 - lv) * jnp.log(1 - iv + epsilon)
+
+    return apply("log_loss", f, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (reference npair_loss): cross-entropy over anchor @ positive^T
+    similarity + L2 on embeddings."""
+    anchor, positive, labels = as_tensor(anchor), as_tensor(positive), as_tensor(labels)
+
+    def f(av, pv, lv):
+        reg = l2_reg * (jnp.sum(av * av) / av.shape[0] + jnp.sum(pv * pv) / pv.shape[0]) * 0.25
+        sim = av @ pv.T
+        same = (lv[:, None] == lv[None, :]).astype(av.dtype)
+        tgt = same / jnp.maximum(jnp.sum(same, -1, keepdims=True), 1.0)
+        logp = jax.nn.log_softmax(sim, -1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, -1))
+        return ce + reg
+
+    return apply("npair_loss", f, anchor, positive, labels)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    input, label = as_tensor(input), as_tensor(label)
+
+    def f(iv, lv):
+        return _reduce(jnp.log1p(jnp.exp(-lv.astype(iv.dtype) * iv)), reduction)
+
+    return apply("soft_margin_loss", f, input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    tensors = [input, label] + ([as_tensor(weight)] if weight is not None else [])
+
+    def f(iv, lv, *rest):
+        lv = lv.astype(iv.dtype)
+        loss = lv * jax.nn.log_sigmoid(iv) + (1 - lv) * jax.nn.log_sigmoid(-iv)
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(-jnp.mean(loss, -1), reduction)
+
+    return apply("multi_label_soft_margin_loss", f, *tensors)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None, reduction="mean", name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    tensors = [input, label] + ([as_tensor(weight)] if weight is not None else [])
+
+    def f(iv, lv, *rest):
+        n, c = iv.shape
+        correct = jnp.take_along_axis(iv, lv[:, None], 1)
+        m = jnp.maximum(margin - correct + iv, 0.0) ** p
+        if rest:
+            m = m * rest[0][lv][:, None]
+        mask = jax.nn.one_hot(lv, c, dtype=iv.dtype)
+        return _reduce(jnp.sum(m * (1 - mask), -1) / c, reduction)
+
+    return apply("multi_margin_loss", f, *tensors)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8, reduction="mean", name=None):
+    input, label = as_tensor(input), as_tensor(label)
+
+    def f(iv, lv):
+        if log_input:
+            loss = jnp.exp(iv) - lv * iv
+        else:
+            loss = iv - lv * jnp.log(iv + epsilon)
+        if full:
+            stirling = lv * jnp.log(lv + epsilon) - lv + 0.5 * jnp.log(2 * jnp.pi * (lv + epsilon))
+            loss = loss + jnp.where(lv > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply("poisson_nll_loss", f, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6, reduction="mean", name=None):
+    input, label, variance = as_tensor(input), as_tensor(label), as_tensor(variance)
+
+    def f(iv, lv, vv):
+        vv = jnp.maximum(vv, epsilon)
+        loss = 0.5 * (jnp.log(vv) + (iv - lv) ** 2 / vv)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, iv.dtype))
+        return _reduce(loss, reduction)
+
+    return apply("gaussian_nll_loss", f, input, label, variance)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative, distance_function=None,
+                                      margin=1.0, swap=False, reduction="mean", name=None):
+    input, positive, negative = as_tensor(input), as_tensor(positive), as_tensor(negative)
+    if distance_function is None:
+        from ...ops.math import sqrt as _sqrt
+        from ...ops.math import sum as _sum
+
+        def distance_function(a, b):
+            return _sqrt(_sum((a - b) ** 2, -1) + 1e-12)
+
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        from ...ops.math import minimum as _minimum
+
+        d_neg = _minimum(d_neg, d_pn)
+    from ...ops.math import clip as _clip
+
+    loss = _clip(d_pos - d_neg + margin, min=0.0)
+    if reduction == "none":
+        return loss
+    from ...ops.math import mean as _mean
+    from ...ops.math import sum as _sum2
+
+    return _sum2(loss) if reduction == "sum" else _mean(loss)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid over a default complete binary tree (reference:
+    hsigmoid_loss / phi hsigmoid kernels). Each class's path through the tree
+    contributes a sigmoid BCE term; the default tree has num_classes-1 inner
+    nodes indexed by (label + num_classes) // 2 walk."""
+    input, label, weight = as_tensor(input), as_tensor(label), as_tensor(weight)
+    tensors = [input, label, weight] + ([as_tensor(bias)] if bias is not None else [])
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError("custom-tree hsigmoid (path_table/path_code) is not supported yet")
+
+    import math
+
+    depth = max(1, int(math.ceil(math.log2(max(num_classes, 2)))))
+
+    def f(iv, lv, wv, *rest):
+        bv = rest[0] if rest else None
+        # complete-binary-tree walk: node ids in [0, num_classes-1)
+        codes = []
+        nodes = []
+        cur = lv + num_classes  # leaf position in heap layout
+        for _ in range(depth):
+            parent = cur // 2
+            code = (cur % 2).astype(iv.dtype)  # left/right bit
+            valid = parent >= 1
+            nodes.append(jnp.where(valid, parent - 1, 0))
+            codes.append((code, valid))
+            cur = parent
+        loss = jnp.zeros(iv.shape[0], iv.dtype)
+        for (code, valid), node in zip(codes, nodes):
+            w_node = wv[node]  # [N, D]
+            logit = jnp.sum(iv * w_node, -1)
+            if bv is not None:
+                logit = logit + bv[node]
+            bce = -(code * jax.nn.log_sigmoid(logit) + (1 - code) * jax.nn.log_sigmoid(-logit))
+            loss = loss + jnp.where(valid, bce, 0.0)
+        return loss[:, None]
+
+    return apply("hsigmoid_loss", f, *tensors)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0, scale=64.0,
+                         group=None, return_softmax=False, reduction="mean"):
+    """ArcFace-style margin softmax (reference: margin_cross_entropy op):
+    cos(m1*theta + m2) - m3 applied to the target logit, then scaled CE."""
+    logits, label = as_tensor(logits), as_tensor(label)
+
+    def f(lv, yv):
+        theta = jnp.arccos(jnp.clip(lv, -1.0, 1.0))
+        target_theta = jnp.take_along_axis(theta, yv[:, None], 1)
+        modified = jnp.cos(margin1 * target_theta + margin2) - margin3
+        onehot = jax.nn.one_hot(yv, lv.shape[-1], dtype=lv.dtype)
+        out = (lv * (1 - onehot) + modified * onehot) * scale
+        logp = jax.nn.log_softmax(out, -1)
+        loss = -jnp.take_along_axis(logp, yv[:, None], 1)
+        return loss, jnp.exp(logp)
+
+    loss, softmax = apply("margin_cross_entropy", f, logits, label)
+    if reduction != "none":
+        from ...ops.math import mean as _mean
+        from ...ops.math import sum as _sum2
+
+        loss = _sum2(loss) if reduction == "sum" else _mean(loss)
+    return (loss, softmax) if return_softmax else loss
+
+
+_center_sample_rng = __import__("numpy").random.default_rng(0)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers plus all positives (reference:
+    class_center_sample op for PartialFC). Host-side sampling: remaps labels
+    into the sampled index space."""
+    import numpy as np
+
+    label = as_tensor(label)
+    lab = np.asarray(label._value)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        extra = _center_sample_rng.choice(rest, size=num_samples - len(pos), replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    from ...core.tensor import Tensor as _T
+
+    return _T(jnp.asarray(remap[lab])), _T(jnp.asarray(sampled))
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0, fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-Transducer loss via log-space DP over (time, label) lattice
+    (reference: warprnnt-backed rnnt_loss). input: [B, T, U+1, V] log-probs
+    or logits (normalized here), label: [B, U]."""
+    if fastemit_lambda:
+        raise NotImplementedError("FastEmit regularization (fastemit_lambda != 0) is not implemented")
+    input, label = as_tensor(input), as_tensor(label)
+    il, ll = as_tensor(input_lengths), as_tensor(label_lengths)
+
+    def f(xv, yv, ilv, llv):
+        B, T, U1, V = xv.shape
+        logp = jax.nn.log_softmax(xv.astype(jnp.float32), -1)
+        blank_lp = logp[..., blank]  # [B, T, U+1]
+        y_lp = jnp.take_along_axis(
+            logp[:, :, :-1, :], jnp.broadcast_to(yv[:, None, :, None], (B, T, U1 - 1, 1)), 3
+        )[..., 0]  # [B, T, U]
+        NEG = jnp.asarray(-1e30, jnp.float32)
+
+        # explicit DP over the (T, U) lattice; T/U are trace-time constants
+        alpha = jnp.full((B, T, U1), NEG)
+        alpha = alpha.at[:, 0, 0].set(0.0)
+        for t in range(T):
+            for u in range(U1):
+                cands = []
+                if t == 0 and u == 0:
+                    continue
+                if t >= 1:
+                    cands.append(alpha[:, t - 1, u] + blank_lp[:, t - 1, u])
+                if u >= 1:
+                    cands.append(alpha[:, t, u - 1] + y_lp[:, t, u - 1])
+                best = cands[0]
+                for c in cands[1:]:
+                    best = jnp.logaddexp(best, c)
+                alpha = alpha.at[:, t, u].set(best)
+        t_idx = jnp.clip(ilv - 1, 0, T - 1)
+        u_idx = jnp.clip(llv, 0, U1 - 1)
+        final = alpha[jnp.arange(B), t_idx, u_idx] + blank_lp[jnp.arange(B), t_idx, u_idx]
+        return -final
+
+    loss = apply("rnnt_loss", f, input, label, il, ll)
+    if reduction != "none":
+        from ...ops.math import mean as _mean
+        from ...ops.math import sum as _sum2
+
+        loss = _sum2(loss) if reduction == "sum" else _mean(loss)
+    return loss

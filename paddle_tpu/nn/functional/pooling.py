@@ -215,3 +215,44 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 @register_op("nn.adaptive_max_pool3d")
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, output_size, 3, jnp.max, "adaptive_max_pool3d")
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size, ndim, data_format):
+    """Scatter pooled values back to pre-pool positions by flat spatial index
+    (reference: phi unpool kernels; indices as produced by max_pool return_mask)."""
+    x, indices = as_tensor(x), as_tensor(indices)
+    ks = (kernel_size,) * ndim if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = ks if stride is None else ((stride,) * ndim if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * ndim if isinstance(padding, int) else tuple(padding)
+    spatial = list(x.shape[2:])
+    if output_size is None:
+        out_spatial = [(spatial[i] - 1) * st[i] - 2 * pd[i] + ks[i] for i in range(ndim)]
+    else:
+        out_spatial = list(output_size)[-ndim:]
+
+    def f(xv, iv):
+        n, c = xv.shape[0], xv.shape[1]
+        flat_len = 1
+        for s in out_spatial:
+            flat_len *= s
+        xf = xv.reshape(n, c, -1)
+        idxf = iv.reshape(n, c, -1)
+        out = jnp.zeros((n, c, flat_len), xv.dtype)
+        out = out.at[
+            jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None], idxf
+        ].set(xf)
+        return out.reshape((n, c, *out_spatial))
+
+    return apply("max_unpool", f, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 1, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 2, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 3, data_format)

@@ -232,3 +232,13 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, *self.args)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.args = (p, epsilon, keepdim)
+
+    def forward(self, x, y):
+        p, e, k = self.args
+        return F.pairwise_distance(x, y, p, e, k)

@@ -77,3 +77,25 @@ class AdaptiveMaxPool2D(_AdaptivePool):
 class AdaptiveMaxPool3D(_AdaptivePool):
     def forward(self, x):
         return F.adaptive_max_pool3d(x, self.output_size)
+
+
+class _MaxUnPool(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format=None, output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.output_size = output_size
+
+
+class MaxUnPool1D(_MaxUnPool):
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self.kernel_size, self.stride, self.padding, output_size=self.output_size)
+
+
+class MaxUnPool2D(_MaxUnPool):
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride, self.padding, output_size=self.output_size)
+
+
+class MaxUnPool3D(_MaxUnPool):
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.kernel_size, self.stride, self.padding, output_size=self.output_size)

@@ -278,3 +278,99 @@ class BiRNN(Layer):
         out_f, st_f = self.rnn_fw(inputs, sf)
         out_b, st_b = self.rnn_bw(inputs, sb)
         return concat([out_f, out_b], axis=-1), (st_f, st_b)
+
+
+RNNCellBase = _CellBase  # reference name (nn/layer/rnn.py RNNCellBase)
+
+
+class BeamSearchDecoder(Layer):
+    """Beam-search decoding over an RNN cell (reference: nn/decode.py
+    BeamSearchDecoder). Host-driven loop via dynamic_decode; beams are folded
+    into the batch dim so every step is one batched cell call."""
+
+    def __init__(self, cell, start_token, end_token, beam_size, embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token, self.end_token = start_token, end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        import numpy as np
+
+        state0 = initial_cell_states
+        ref = state0[0] if isinstance(state0, (tuple, list)) else state0
+        batch = ref.shape[0]
+        k = self.beam_size
+
+        def tile(t):
+            v = t._value if hasattr(t, "_value") else jnp.asarray(t)
+            return Tensor(jnp.repeat(v, k, axis=0))
+
+        states = tuple(tile(s) for s in state0) if isinstance(state0, (tuple, list)) else tile(state0)
+        ids = Tensor(jnp.full((batch * k,), self.start_token, jnp.int64))
+        # first beam of each batch active; others -inf so step 1 fans out
+        log_probs = jnp.tile(jnp.asarray([0.0] + [-1e9] * (k - 1), jnp.float32), batch)
+        finished = jnp.zeros((batch * k,), bool)
+        return ids, states, {"log_probs": log_probs, "finished": finished, "batch": batch}
+
+    def step(self, time, inputs, states, beam_state):
+        k = self.beam_size
+        batch = beam_state["batch"]
+        x = self.embedding_fn(inputs) if self.embedding_fn is not None else inputs
+        out = self.cell(x, states)
+        cell_out, new_states = out if isinstance(out, tuple) and len(out) == 2 else (out, out)
+        logits = self.output_fn(cell_out) if self.output_fn is not None else cell_out
+        logits_v = logits._value if hasattr(logits, "_value") else jnp.asarray(logits)
+        vocab = logits_v.shape[-1]
+        logp = jax.nn.log_softmax(logits_v.astype(jnp.float32), -1)
+        # finished beams only extend with end_token at zero cost
+        fin = beam_state["finished"][:, None]
+        end_mask = jnp.full((vocab,), -1e9).at[self.end_token].set(0.0)
+        logp = jnp.where(fin, end_mask[None, :], logp)
+        total = beam_state["log_probs"][:, None] + logp  # [batch*k, vocab]
+        total = total.reshape(batch, k * vocab)
+        top_v, top_i = jax.lax.top_k(total, k)  # [batch, k]
+        parent = top_i // vocab  # beam index within batch
+        token = top_i % vocab
+        flat_parent = (jnp.arange(batch)[:, None] * k + parent).reshape(-1)
+
+        def reorder(t):
+            v = t._value if hasattr(t, "_value") else jnp.asarray(t)
+            return Tensor(v[flat_parent])
+
+        new_states = (
+            tuple(reorder(s) for s in new_states) if isinstance(new_states, (tuple, list)) else reorder(new_states)
+        )
+        new_ids = Tensor(token.reshape(-1).astype(jnp.int64))
+        finished = beam_state["finished"][flat_parent] | (token.reshape(-1) == self.end_token)
+        new_beam = {"log_probs": top_v.reshape(-1), "finished": finished, "batch": batch, "parent": flat_parent}
+        return new_ids, new_states, new_beam
+
+    def finalize(self, step_ids, step_parents, beam_state):
+        """Back-trace with gather_tree into [T, batch, beam] sequences."""
+        from .. import functional as F
+
+        ids = Tensor(jnp.stack([t._value for t in step_ids], 0).reshape(len(step_ids), beam_state["batch"], self.beam_size))
+        parents = Tensor(
+            jnp.stack([jnp.asarray(p) % self.beam_size for p in step_parents], 0).reshape(
+                len(step_parents), beam_state["batch"], self.beam_size
+            )
+        )
+        return F.gather_tree(ids, parents)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, **kwargs):
+    """Run a decoder to completion (reference: nn/decode.py dynamic_decode).
+    Returns (sequences [T, batch, beam], final_beam_log_probs)."""
+    ids, states, beam = decoder.initialize(inits)
+    step_ids, step_parents = [], []
+    for t in range(max_step_num):
+        ids, states, beam = decoder.step(t, ids, states, beam)
+        step_ids.append(ids)
+        step_parents.append(beam["parent"])
+        if bool(beam["finished"].all()):
+            break
+    seqs = decoder.finalize(step_ids, step_parents, beam)
+    return seqs, Tensor(beam["log_probs"].reshape(beam["batch"], decoder.beam_size))

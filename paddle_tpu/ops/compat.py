@@ -1,0 +1,260 @@
+"""Top-level API parity fill-ins: small ops and framework compat toggles.
+
+Reference surface: the tail of python/paddle/__init__.py __all__ — dtype
+introspection (iinfo/finfo, is_* predicates), small tensor ops (nan_to_num,
+nanquantile, sgn, polar, complex, add_n, increment, shard_index, reverse),
+in-place aliases, legacy reader `batch`, LazyGuard, and signal-handler /
+CUDA-RNG shims that are no-ops on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype, to_jax_dtype
+from ..core.op_registry import register_op
+from ..core.tensor import Tensor
+from ._dispatch import apply, as_tensor
+from .math import quantile as _quantile
+
+
+# ---- dtype introspection ----
+class _IntInfo:
+    def __init__(self, jdt):
+        info = jnp.iinfo(jdt)
+        self.min, self.max, self.bits = int(info.min), int(info.max), int(info.bits)
+        self.dtype = str(np.dtype(info.dtype))
+
+    def __repr__(self):
+        return f"iinfo(min={self.min}, max={self.max}, bits={self.bits}, dtype={self.dtype})"
+
+
+class _FloatInfo:
+    def __init__(self, jdt):
+        info = jnp.finfo(jdt)
+        self.min, self.max = float(info.min), float(info.max)
+        self.eps, self.tiny = float(info.eps), float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+        self.bits = int(info.bits)
+        self.dtype = str(np.dtype(info.dtype))
+
+    def __repr__(self):
+        return f"finfo(min={self.min}, max={self.max}, eps={self.eps}, bits={self.bits}, dtype={self.dtype})"
+
+
+def iinfo(dtype):
+    return _IntInfo(to_jax_dtype(convert_dtype(dtype)))
+
+
+def finfo(dtype):
+    return _FloatInfo(to_jax_dtype(convert_dtype(dtype)))
+
+
+def _jdt(x):
+    return as_tensor(x)._value.dtype
+
+
+def is_floating_point(x) -> bool:
+    return bool(jnp.issubdtype(_jdt(x), jnp.floating))
+
+
+def is_integer(x) -> bool:
+    return bool(jnp.issubdtype(_jdt(x), jnp.integer))
+
+
+def is_complex(x) -> bool:
+    return bool(jnp.issubdtype(_jdt(x), jnp.complexfloating))
+
+
+# ---- small ops ----
+@register_op("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    x = as_tensor(x)
+
+    def f(xv):
+        return jnp.nan_to_num(xv, nan=nan, posinf=posinf, neginf=neginf)
+
+    return apply("nan_to_num", f, x)
+
+
+@register_op("nanquantile")
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+
+    def f(xv):
+        return jnp.nanquantile(xv.astype(jnp.float32) if jnp.issubdtype(xv.dtype, jnp.integer) else xv,
+                               jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+    return apply("nanquantile", f, x)
+
+
+@register_op("sgn")
+def sgn(x, name=None):
+    """sign for real dtypes; x/|x| (unit phasor, 0 at 0) for complex."""
+    x = as_tensor(x)
+
+    def f(xv):
+        if jnp.issubdtype(xv.dtype, jnp.complexfloating):
+            mag = jnp.abs(xv)
+            return jnp.where(mag == 0, 0.0 + 0.0j, xv / jnp.where(mag == 0, 1.0, mag)).astype(xv.dtype)
+        return jnp.sign(xv)
+
+    return apply("sgn", f, x)
+
+
+@register_op("polar")
+def polar(abs, angle, name=None):
+    a, t = as_tensor(abs), as_tensor(angle)
+
+    def f(av, tv):
+        return (av * jnp.cos(tv) + 1j * av * jnp.sin(tv)).astype(
+            jnp.complex64 if av.dtype == jnp.float32 else jnp.complex128
+        )
+
+    return apply("polar", f, a, t)
+
+
+def complex(real, imag, name=None):  # noqa: A001 - reference API name
+    from .creation import complex_
+
+    return complex_(real, imag, name=name)
+
+
+@register_op("add_n")
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    tensors = [as_tensor(t) for t in inputs]
+
+    def f(*vals):
+        out = vals[0]
+        for v in vals[1:]:
+            out = out + v
+        return out
+
+    return apply("add_n", f, *tensors)
+
+
+def increment(x, value=1.0, name=None):
+    """In-place x += value (reference: paddle.increment on 1-element tensors)."""
+    x = as_tensor(x)
+    out = apply("increment", lambda xv: xv + jnp.asarray(value, xv.dtype), x)
+    return x._inplace_from(out)
+
+
+@register_op("shard_index")
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Map global ids to shard-local ids (reference: tensor/manipulation.py:575);
+    the vocab-split companion of VocabParallelEmbedding."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(f"shard_id {shard_id} out of range [0, {nshards})")
+    x = as_tensor(input)
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def f(xv):
+        in_shard = (xv // shard_size) == shard_id
+        return jnp.where(in_shard, xv % shard_size, ignore_value)
+
+    return apply("shard_index", f, x)
+
+
+def reverse(x, axis, name=None):
+    from .manipulation import flip
+
+    return flip(x, axis)
+
+
+def rank(x, name=None):
+    return as_tensor(x).ndim
+
+
+def shape(x, name=None):
+    """Runtime shape as an int32 tensor (reference: paddle.shape)."""
+    return Tensor(jnp.asarray(as_tensor(x)._value.shape, jnp.int32))
+
+
+def tolist(x):
+    return np.asarray(as_tensor(x)._value).tolist()
+
+
+def squeeze_(x, axis=None, name=None):
+    from .manipulation import squeeze
+
+    return x._inplace_from(squeeze(x, axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    from .manipulation import unsqueeze
+
+    return x._inplace_from(unsqueeze(x, axis))
+
+
+def tanh_(x, name=None):
+    from .math import tanh
+
+    return x._inplace_from(tanh(x))
+
+
+# single source of truth for the in-place tanh; nn.functional re-exports this
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False, default_initializer=None):
+    """Standalone Parameter factory (reference: paddle.create_parameter)."""
+    from ..core.tensor import Parameter
+    from ..nn import initializer as I
+
+    init = default_initializer or (I.Constant(0.0) if is_bias else I.XavierNormal())
+    arr = init(shape, convert_dtype(dtype) or "float32")
+    p = Parameter(arr)
+    if name:
+        p.name = name
+    return p
+
+
+def check_shape(shape):
+    """Validate a shape spec (reference: utils/layers_utils.py:463)."""
+    if isinstance(shape, Tensor):
+        return
+    for dim in shape:
+        if isinstance(dim, (list, tuple)) or (isinstance(dim, (int, np.integer)) and dim < -1):
+            raise ValueError(f"invalid shape entry {dim!r}")
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader-decorator (reference: python/paddle/batch.py): wrap a
+    sample generator into a batch generator."""
+
+    def batch_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    if not isinstance(batch_size, (int, np.integer)) or batch_size <= 0:
+        raise ValueError("batch_size must be a positive integer")
+    return batch_reader
+
+
+# ---- framework compat shims ----
+class LazyGuard:
+    """Parameter-init guard (reference: fluid/lazy_init.py:91). Initialization
+    here is already lazy-friendly (pure-functional init under jit), so the
+    guard only needs to be a context manager."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def disable_signal_handler():
+    """No-op: the reference installs C++ signal handlers; this runtime has none."""
+
+

@@ -1,0 +1,297 @@
+"""New parity surface: losses, unpooling, seq2seq decode, small ops, compat.
+
+Numeric checks follow the reference OpTest pattern (SURVEY §4): compare
+against a numpy (or closed-form) reference on fixed seeds.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+rng = np.random.default_rng(0)
+
+
+def t(a, **kw):
+    return paddle.to_tensor(np.asarray(a), **kw)
+
+
+# ---- losses ----
+
+def test_soft_margin_loss():
+    x = rng.normal(size=(4, 3)).astype(np.float32)
+    y = np.sign(rng.normal(size=(4, 3))).astype(np.float32)
+    out = F.soft_margin_loss(t(x), t(y))
+    np.testing.assert_allclose(out.numpy(), np.log1p(np.exp(-y * x)).mean(), rtol=1e-5)
+
+
+def test_multi_label_soft_margin_loss():
+    x = rng.normal(size=(4, 5)).astype(np.float32)
+    y = (rng.random((4, 5)) > 0.5).astype(np.float32)
+    out = F.multi_label_soft_margin_loss(t(x), t(y))
+    sig = 1 / (1 + np.exp(-x))
+    ref = -(y * np.log(sig) + (1 - y) * np.log(1 - sig)).mean(-1).mean()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+
+
+def test_multi_margin_loss():
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    y = rng.integers(0, 6, 4)
+    out = F.multi_margin_loss(t(x), t(y))
+    correct = x[np.arange(4), y][:, None]
+    m = np.maximum(1.0 - correct + x, 0)
+    m[np.arange(4), y] = 0
+    np.testing.assert_allclose(out.numpy(), (m.sum(-1) / 6).mean(), rtol=1e-5)
+
+
+def test_poisson_and_gaussian_nll():
+    x = rng.normal(size=(8,)).astype(np.float32)
+    y = rng.poisson(2.0, 8).astype(np.float32)
+    out = F.poisson_nll_loss(t(x), t(y))
+    np.testing.assert_allclose(out.numpy(), (np.exp(x) - y * x).mean(), rtol=1e-5)
+
+    mu = rng.normal(size=(8,)).astype(np.float32)
+    var = np.abs(rng.normal(size=(8,))).astype(np.float32) + 0.1
+    lbl = rng.normal(size=(8,)).astype(np.float32)
+    out = F.gaussian_nll_loss(t(mu), t(lbl), t(var))
+    ref = 0.5 * (np.log(var) + (mu - lbl) ** 2 / var).mean()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_dice_log_npair():
+    probs = np.float32([[0.9, 0.1], [0.2, 0.8]])[:, None, :]  # [N=2, 1, C=2]
+    label = np.int64([[0], [1]])[:, None, :]
+    d = F.dice_loss(t(probs), t(label))
+    assert 0 <= float(d.numpy()) < 0.3
+
+    p_ = np.float32([0.9, 0.1])
+    l_ = np.float32([1.0, 0.0])
+    out = F.log_loss(t(p_), t(l_))
+    np.testing.assert_allclose(out.numpy(), -np.log(p_ + 1e-4) * l_ - np.log(1 - p_ + 1e-4) * (1 - l_), rtol=1e-4)
+
+    anchor = rng.normal(size=(4, 8)).astype(np.float32)
+    pos = anchor + 0.01 * rng.normal(size=(4, 8)).astype(np.float32)
+    labels = np.arange(4)
+    loss = F.npair_loss(t(anchor), t(pos), t(labels))
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_triplet_with_distance_and_layer():
+    a = rng.normal(size=(4, 8)).astype(np.float32)
+    p_ = a + 0.1
+    n = rng.normal(size=(4, 8)).astype(np.float32)
+    out = F.triplet_margin_with_distance_loss(t(a), t(p_), t(n))
+    lyr = nn.TripletMarginWithDistanceLoss()
+    out2 = lyr(t(a), t(p_), t(n))
+    np.testing.assert_allclose(out.numpy(), out2.numpy(), rtol=1e-6)
+
+
+def test_hsigmoid_loss_runs_and_trains():
+    feat, classes = 8, 6
+    lyr = nn.HSigmoidLoss(feat, classes)
+    x = t(rng.normal(size=(4, feat)).astype(np.float32), stop_gradient=False)
+    y = t(rng.integers(0, classes, 4))
+    loss = lyr(x, y)
+    assert loss.shape == [4, 1]
+    loss.sum().backward()
+    assert x.grad is not None
+
+
+def test_margin_cross_entropy_reduces_to_ce_when_no_margin():
+    logits = rng.normal(size=(4, 10)).astype(np.float32)
+    # normalize rows to be valid cosines
+    logits = np.clip(logits, -1, 1)
+    y = rng.integers(0, 10, 4)
+    loss = F.margin_cross_entropy(t(logits), t(y), margin1=1.0, margin2=0.0, margin3=0.0, scale=1.0)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p_ = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p_[np.arange(4), y]).mean()
+    np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-4)
+
+
+def test_rnnt_loss_simple():
+    # B=1, T=2, U=1 ( one label ), V=3, blank=0
+    x = np.zeros((1, 2, 2, 3), np.float32)  # uniform logits
+    label = np.int64([[1]])
+    loss = F.rnnt_loss(t(x), t(label), t(np.int64([2])), t(np.int64([1])))
+    # all paths have prob (1/3)^3 per step combo; exact value: -log(sum of 2 paths * (1/3)^3)
+    ref = -np.log(2 * (1 / 3) ** 3)
+    np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-4)
+
+
+def test_class_center_sample():
+    label = t(np.int64([1, 5, 5, 9]))
+    remapped, sampled = F.class_center_sample(label, 20, 6)
+    s = sampled.numpy()
+    assert set([1, 5, 9]).issubset(set(s.tolist()))
+    r = remapped.numpy()
+    assert (s[r] == np.int64([1, 5, 5, 9])).all()
+
+
+# ---- misc functional ----
+
+def test_pairwise_distance():
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    y = rng.normal(size=(4, 8)).astype(np.float32)
+    out = F.pairwise_distance(t(x), t(y))
+    ref = np.sqrt(((np.abs(x - y) + 1e-6) ** 2).sum(-1))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+    lyr = nn.PairwiseDistance()
+    np.testing.assert_allclose(lyr(t(x), t(y)).numpy(), ref, rtol=1e-4)
+
+
+def test_diag_embed_identity_match():
+    v = rng.normal(size=(3, 4)).astype(np.float32)
+    out = F.diag_embed(t(v))
+    assert out.shape == [3, 4, 4]
+    for b in range(3):
+        np.testing.assert_allclose(out.numpy()[b], np.diag(v[b]), rtol=1e-6)
+
+
+def test_temporal_shift():
+    x = rng.normal(size=(4, 8, 2, 2)).astype(np.float32)  # N*T=4 (T=2), C=8
+    out = F.temporal_shift(t(x), seg_num=2, shift_ratio=0.25)
+    assert out.shape == [4, 8, 2, 2]
+    v = x.reshape(2, 2, 8, 2, 2)
+    o = out.numpy().reshape(2, 2, 8, 2, 2)
+    # first quarter channels shifted backward in time
+    np.testing.assert_allclose(o[:, 0, :2], v[:, 1, :2], rtol=1e-6)
+    np.testing.assert_allclose(o[:, 1, :2], 0.0)
+
+
+def test_zeropad2d_and_softmax2d():
+    x = t(rng.normal(size=(1, 2, 3, 3)).astype(np.float32))
+    out = F.zeropad2d(x, [1, 2, 0, 1])
+    assert out.shape == [1, 2, 4, 6]
+    s = nn.Softmax2D()(x)
+    np.testing.assert_allclose(s.numpy().sum(1), 1.0, rtol=1e-5)
+
+
+def test_thresholded_relu_layer():
+    out = nn.ThresholdedReLU(0.5)(t(np.float32([0.3, 0.7])))
+    np.testing.assert_allclose(out.numpy(), [0.0, 0.7])
+
+
+def test_affine_grid_identity():
+    theta = t(np.float32([[[1, 0, 0], [0, 1, 0]]]))
+    grid = F.affine_grid(theta, [1, 1, 2, 2])
+    np.testing.assert_allclose(grid.numpy()[0, :, :, 0], [[-1, 1], [-1, 1]], atol=1e-6)
+    np.testing.assert_allclose(grid.numpy()[0, :, :, 1], [[-1, -1], [1, 1]], atol=1e-6)
+
+
+def test_max_unpool_roundtrip():
+    x = t(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    pooled, idx = F.max_pool2d(x, 2, return_mask=True)
+    un = nn.MaxUnPool2D(2)(pooled, idx)
+    expect = np.zeros((1, 1, 4, 4), np.float32)
+    for v in [5, 7, 13, 15]:
+        expect[0, 0, v // 4, v % 4] = v
+    np.testing.assert_allclose(un.numpy(), expect)
+
+
+def test_sequence_mask_and_gather_tree():
+    m = F.sequence_mask(t(np.int64([2, 4])), maxlen=5)
+    assert m.numpy().tolist() == [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]]
+    ids = t(np.int64([[[2, 3]], [[4, 5]], [[6, 7]]]))  # [T=3, B=1, beam=2]
+    parents = t(np.int64([[[0, 0]], [[1, 0]], [[1, 0]]]))
+    out = F.gather_tree(ids, parents)
+    assert out.shape == [3, 1, 2]
+
+
+def test_sparse_attention_matches_masked_dense():
+    B, H, S, D = 1, 1, 4, 8
+    q = rng.normal(size=(B, H, S, D)).astype(np.float32)
+    k = rng.normal(size=(B, H, S, D)).astype(np.float32)
+    v = rng.normal(size=(B, H, S, D)).astype(np.float32)
+    # full attention CSR
+    offs = np.tile(np.arange(0, (S + 1) * S, S), (B, H, 1)).astype(np.int32).reshape(B, H, S + 1)
+    cols = np.tile(np.arange(S), (B, H, S)).astype(np.int32).reshape(B, H, S * S)
+    out = F.sparse_attention(t(q), t(k), t(v), t(offs), t(cols))
+    scores = q[0, 0] @ k[0, 0].T / np.sqrt(D)
+    probs = np.exp(scores - scores.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy()[0, 0], probs @ v[0, 0], rtol=1e-4)
+
+
+# ---- beam search ----
+
+def test_beam_search_decoder_greedy_path():
+    vocab, hidden, beam = 6, 8, 2
+
+    cell = nn.GRUCell(hidden, hidden)
+    proj = nn.Linear(hidden, vocab)
+    emb = nn.Embedding(vocab, hidden)
+
+    dec = nn.BeamSearchDecoder(
+        cell, start_token=0, end_token=vocab - 1, beam_size=beam,
+        embedding_fn=emb, output_fn=proj,
+    )
+    h0 = paddle.zeros([2, hidden])
+    seqs, logp = nn.dynamic_decode(dec, inits=h0, max_step_num=5)
+    assert seqs.shape[1:] == [2, beam]
+    assert logp.shape == [2, beam]
+    # beams sorted by score
+    lp = logp.numpy()
+    assert (lp[:, 0] >= lp[:, 1] - 1e-6).all()
+
+
+# ---- top-level compat ----
+
+def test_top_level_compat_ops():
+    assert paddle.iinfo("int16").max == 32767
+    assert paddle.finfo("bfloat16").bits == 16
+    assert paddle.rank(paddle.ones([2, 3])) == 2
+    assert paddle.tolist(t(np.int64([1, 2]))) == [1, 2]
+    out = paddle.reverse(t(np.float32([1, 2, 3])), axis=0)
+    np.testing.assert_allclose(out.numpy(), [3, 2, 1])
+    s = paddle.shard_index(t(np.int64([0, 7, 15])), 16, 4, 1)
+    assert s.numpy().tolist() == [-1, 3, -1]
+    x = t(np.float32([1.0]))
+    paddle.increment(x, 2.0)
+    assert float(x.numpy()) == 3.0
+
+
+def test_sparse_attention_banded_pattern():
+    B, H, S, D = 2, 2, 6, 4
+    r2 = np.random.default_rng(1)
+    q, k, v = [r2.normal(size=(B, H, S, D)).astype(np.float32) for _ in range(3)]
+    offs = np.zeros((B, H, S + 1), np.int32)
+    cols_list = []
+    for b in range(B):
+        for h in range(H):
+            cc = []
+            for r in range(S):
+                cc.extend(range(max(0, r - 1), min(S, r + 2)))
+                offs[b, h, r + 1] = len(cc)
+            cols_list.append(cc)
+    cols = np.array(cols_list, np.int32).reshape(B, H, -1)
+    out = F.sparse_attention(t(q), t(k), t(v), t(offs), t(cols))
+    m = np.zeros((S, S))
+    for r in range(S):
+        m[r, max(0, r - 1):min(S, r + 2)] = 1
+    for b in range(B):
+        for h in range(H):
+            sc = np.where(m > 0, q[b, h] @ k[b, h].T / np.sqrt(D), -1e30)
+            pr = np.exp(sc - sc.max(-1, keepdims=True))
+            pr /= pr.sum(-1, keepdims=True)
+            np.testing.assert_allclose(out.numpy()[b, h], (pr * m) @ v[b, h], rtol=1e-4, atol=1e-5)
+
+
+def test_class_center_sample_fresh_negatives():
+    a = F.class_center_sample(t(np.int64([1, 2])), 1000, 10)[1].numpy()
+    b = F.class_center_sample(t(np.int64([1, 2])), 1000, 10)[1].numpy()
+    assert not np.array_equal(a, b)
+
+
+def test_rnnt_fastemit_not_silent():
+    x = np.zeros((1, 2, 2, 3), np.float32)
+    with pytest.raises(NotImplementedError):
+        F.rnnt_loss(t(x), t(np.int64([[1]])), t(np.int64([2])), t(np.int64([1])), fastemit_lambda=0.01)
+
+
+def test_hsigmoid_layer_rejects_custom_tree():
+    lyr = nn.HSigmoidLoss(4, 6)
+    with pytest.raises(NotImplementedError):
+        lyr(t(np.zeros((2, 4), np.float32)), t(np.int64([0, 1])), path_table=t(np.zeros((2, 3))))
