@@ -141,3 +141,28 @@ class PyLayer(metaclass=PyLayerMeta):
     @staticmethod
     def backward(ctx, *grads):
         raise NotImplementedError
+
+
+class saved_tensors_hooks:
+    """Context manager transforming tensors captured for backward (reference:
+    autograd/saved_tensors_hooks.py — used for activation offload/compression).
+    pack_hook(tensor) -> handle at capture; unpack_hook(handle) -> tensor at
+    replay. The eager tape consults the active hook pair via _current_hooks()."""
+
+    _stack = []
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        saved_tensors_hooks._stack.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._stack.pop()
+        return False
+
+    @classmethod
+    def _current_hooks(cls):
+        return cls._stack[-1] if cls._stack else None

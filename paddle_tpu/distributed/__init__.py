@@ -14,6 +14,7 @@ from .collective import (  # noqa: F401
     new_group,
 )
 from .communication import (  # noqa: F401
+    ParallelMode,
     ReduceOp,
     Task,
     all_gather,
@@ -41,7 +42,26 @@ from .communication import (  # noqa: F401
     scatter,
     send,
     to_per_rank,
+    alltoall_single,
+    broadcast_object_list,
+    gather,
+    get_backend,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+    is_available,
+    scatter_object_list,
+    wait,
 )
+from .split_api import split  # noqa: F401
+from .fleet_dataset import (  # noqa: F401
+    CountFilterEntry,
+    InMemoryDataset,
+    ProbabilityEntry,
+    QueueDataset,
+    ShowClickEntry,
+)
+from . import io  # noqa: F401
 from .mesh import (  # noqa: F401
     build_mesh,
     get_global_mesh,

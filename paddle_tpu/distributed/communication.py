@@ -289,3 +289,91 @@ def reduce_scatter_in_trace(x, axis_name, scatter_dimension: int = 0, tiled: boo
 
 def all_to_all_in_trace(x, axis_name, split_axis: int, concat_axis: int, tiled: bool = True):
     return lax.all_to_all(x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
+
+
+def gather(tensor, gather_list=None, dst: int = 0, group=None, sync_op: bool = True) -> Task:
+    """All ranks' slices collected at dst (every rank here — superset, like
+    reduce; reference only guarantees dst)."""
+    g = _resolve_group(group)
+    if gather_list is None:
+        gather_list = []
+    if _is_per_rank(tensor, g):
+        gather_list.extend(Tensor(tensor._value[i]) for i in range(g.nranks))
+    else:
+        gather_list.extend(Tensor(tensor._value) for _ in range(g.nranks))
+    return Task(tensor)
+
+
+def alltoall_single(in_tensor, out_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op: bool = True) -> Task:
+    """Single-tensor all-to-all (reference alltoall_single): the per-rank
+    leading dim is split into nranks chunks that swap ranks."""
+    g = _resolve_group(group)
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError("alltoall_single with uneven in/out_split_sizes is not supported yet")
+    x = in_tensor._value
+    if _is_per_rank(in_tensor, g):
+        # [N(sharded), rows, ...] -> chunk rows into N and swap
+        n = g.nranks
+        rows = x.shape[1]
+        if rows % n:
+            raise ValueError(f"alltoall_single needs rows ({rows}) divisible by nranks ({n})")
+        chunk = rows // n
+        v = x.reshape(n, n, chunk, *x.shape[2:])
+        out = jnp.swapaxes(v, 0, 1).reshape(n, rows, *x.shape[2:])
+        out = jax.device_put(out, NamedSharding(g.mesh, P(g.axis_name)))
+        out_tensor._set_value_raw(out)
+        _mark(out_tensor, g)
+    else:
+        out_tensor._set_value_raw(x)
+    return Task(out_tensor)
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src: int = 0, group=None) -> Task:
+    g = _resolve_group(group)
+    if in_object_list:
+        out_object_list.extend(in_object_list[: g.nranks])
+    return Task()
+
+
+def broadcast_object_list(object_list, src: int = 0, group=None) -> Task:
+    return Task()  # single-process semantics: list already holds src's objects
+
+
+def wait(tensor, group=None, use_calc_stream: bool = True) -> None:
+    """Order comm vs compute (reference c_wait_* ops). XLA orders data flow by
+    construction; block on the value for eager parity."""
+    v = getattr(tensor, "_value", None)
+    if v is not None and hasattr(v, "block_until_ready"):
+        v.block_until_ready()
+
+
+def is_available() -> bool:
+    """Whether the distributed package can be used (reference is_available)."""
+    return True
+
+
+def get_backend(group=None) -> str:
+    """Comm backend name: XLA collectives over ICI/DCN (the NCCL analog)."""
+    return "XCCL"
+
+
+class ParallelMode:
+    """Parallelism mode enum (reference: distributed/parallel.py ParallelMode)."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int, server_endpoint: str):
+    """CPU-barrier bootstrap (reference gloo_* trio). jax.distributed owns
+    rendezvous here; kept as a compatible no-op trio for single-process runs."""
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    pass

@@ -1,0 +1,130 @@
+"""Fleet dataset + sparse-table entry configs.
+
+Reference surface: distributed/fleet/dataset/dataset.py (InMemoryDataset,
+QueueDataset — file-list ingestion for PS training) and
+distributed/entry_attr.py (ProbabilityEntry, CountFilterEntry, ShowClickEntry
+— sparse-embedding admission rules). The brpc parameter-server runtime is the
+one subsystem without a TPU-idiomatic equivalent (SURVEY §7), so these keep
+the configuration/ingestion contract: datasets read whitespace-separated
+slot records from files into host memory batches feeding the device pipeline.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset", "ProbabilityEntry", "CountFilterEntry", "ShowClickEntry"]
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread_num = 1
+        self._filelist = []
+        self._use_var = []
+        self._pipe_command = "cat"
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, pipe_command="cat", input_type=0, fs_name="", fs_ugi="", **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._use_var = use_var or []
+        self._pipe_command = pipe_command
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread_num = thread_num
+
+    def set_use_var(self, var_list):
+        self._use_var = var_list
+
+    def _records(self):
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield np.asarray(line.split(), np.float32)
+
+
+class InMemoryDataset(DatasetBase):
+    """Loads all records into host memory; supports shuffle before batching."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = []
+
+    def load_into_memory(self):
+        self._samples = list(self._records())
+
+    def local_shuffle(self):
+        rng = np.random.default_rng()
+        rng.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()  # single-host scope
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def release_memory(self):
+        self._samples = []
+
+    def __iter__(self):
+        for i in range(0, len(self._samples), self._batch_size):
+            yield self._samples[i:i + self._batch_size]
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: records flow straight from files, no memory residency."""
+
+    def __iter__(self):
+        batch = []
+        for rec in self._records():
+            batch.append(rec)
+            if len(batch) == self._batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+
+class ProbabilityEntry:
+    """Admit a new sparse feature with given probability (reference entry_attr)."""
+
+    def __init__(self, probability: float):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self._probability = probability
+
+    def _to_attr(self):
+        return f"probability_entry:{self._probability}"
+
+
+class CountFilterEntry:
+    """Admit a sparse feature after it has been seen count times."""
+
+    def __init__(self, count: int):
+        if count < 0:
+            raise ValueError("count must be non-negative")
+        self._count = count
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self._count}"
+
+
+class ShowClickEntry:
+    """Track show/click stats by named slots (CTR accessor config)."""
+
+    def __init__(self, show_name: str, click_name: str):
+        if not isinstance(show_name, str) or not isinstance(click_name, str):
+            raise ValueError("show_name/click_name must be strings")
+        self._name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self._name}:{self._click_name}"

@@ -1,0 +1,37 @@
+"""paddle.distributed.io — persistable save/load for distributed programs.
+
+Reference surface: python/paddle/distributed/io.py (save/load_persistables
+over an Executor + Program). Here persistables are a Layer's state_dict (the
+dygraph path); sharded params gather to host before serialization.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var) -> bool:
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save persistable parameters. `main_program` may be a Layer (dygraph) or
+    a static Program wrapper exposing state_dict()."""
+    from ..framework.io import save
+
+    target = main_program if main_program is not None else executor
+    state = target.state_dict() if hasattr(target, "state_dict") else dict(target)
+    os.makedirs(dirname, exist_ok=True)
+    save(state, os.path.join(dirname, filename or "persistables.pdparams"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..framework.io import load
+
+    state = load(os.path.join(dirname, filename or "persistables.pdparams"))
+    target = main_program if main_program is not None else executor
+    if hasattr(target, "set_state_dict"):
+        target.set_state_dict(state)
+    return state

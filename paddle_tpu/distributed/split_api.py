@@ -1,0 +1,37 @@
+"""paddle.distributed.split — inline tensor-parallel linear/embedding.
+
+Reference surface: distributed/fleet/layers/mpu/mp_ops.py:669 split(). Builds
+the matching parallel layer (VocabParallelEmbedding / Column- / Row-Parallel
+Linear) and applies it; the layer carries the mp sharding annotation so a
+pjit'd step shards the weight over the mp mesh axis.
+"""
+
+from __future__ import annotations
+
+_SPLIT_CACHE = {}
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    from .fleet.meta_parallel.mp_layers import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+        VocabParallelEmbedding,
+    )
+
+    key = (name or id(x), operation, tuple(size), axis)
+    layer = _SPLIT_CACHE.get(key) if name else None
+    if layer is None:
+        if operation == "embedding":
+            layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
+        elif operation == "linear" and axis == 0:
+            # split rows of the weight (input dim) -> RowParallelLinear
+            layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False, input_is_parallel=False)
+        elif operation == "linear" and axis == 1:
+            layer = ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False, gather_output=gather_out)
+        else:
+            raise ValueError(f"unsupported split operation={operation!r} axis={axis}")
+        if name:
+            _SPLIT_CACHE[key] = layer
+    return layer(x)

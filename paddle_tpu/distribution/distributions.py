@@ -456,3 +456,135 @@ def _kl_laplace_laplace(p, q):
     scale_ratio = p.scale / q.scale
     loc_abs = jnp.abs(p.loc - q.loc) / q.scale
     return _wrap(-jnp.log(scale_ratio) + scale_ratio * jnp.exp(-loc_abs / scale_ratio) + loc_abs - 1)
+
+
+class Cauchy(Distribution):
+    """Reference: distribution/cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _raw(loc).astype(jnp.float32)
+        self.scale = _raw(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev")
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_random.next_key(), self._extend(shape), jnp.float32, 1e-7, 1 - 1e-7)
+        return _wrap(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return _wrap(-math.log(math.pi) - jnp.log(self.scale) - jnp.log1p(((v - self.loc) / self.scale) ** 2))
+
+    def cdf(self, value):
+        return _wrap(jnp.arctan((_raw(value) - self.loc) / self.scale) / math.pi + 0.5)
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(4 * math.pi * self.scale), self._batch_shape))
+
+
+class ExponentialFamily(Distribution):
+    """Base for natural-parameter families (reference:
+    distribution/exponential_family.py): entropy via the Bregman identity
+    H = F(theta) - <theta, dF(theta)> computed with jax autodiff instead of
+    the reference's double-backward."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nparams = [jnp.asarray(_raw(p), jnp.float32) for p in self._natural_parameters]
+        lognorm = self._log_normalizer(*nparams)
+        # grad of the SUM gives per-element dF/dtheta, keeping entropy batched
+        grads = jax.grad(lambda ps: jnp.sum(self._log_normalizer(*ps)))(tuple(nparams))
+        ent = lognorm - sum(t * g for t, g in zip(nparams, grads)) - self._mean_carrier_measure
+        return _wrap(ent)
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims as event dims (reference:
+    distribution/independent.py): log_prob sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if reinterpreted_batch_rank > len(base.batch_shape):
+            raise ValueError("reinterpreted_batch_rank exceeds base batch rank")
+        self._base = base
+        self._rank = reinterpreted_batch_rank
+        shape = base.batch_shape
+        cut = len(shape) - reinterpreted_batch_rank
+        super().__init__(shape[:cut], shape[cut:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = _raw(self._base.log_prob(value))
+        return _wrap(jnp.sum(lp, axis=tuple(range(lp.ndim - self._rank, lp.ndim))) if self._rank else lp)
+
+    def entropy(self):
+        e = _raw(self._base.entropy())
+        return _wrap(jnp.sum(e, axis=tuple(range(e.ndim - self._rank, e.ndim))) if self._rank else e)
+
+
+class TransformedDistribution(Distribution):
+    """Push a base distribution through invertible transforms (reference:
+    distribution/transformed_distribution.py). Transforms must expose
+    forward(x), inverse(y), forward_log_det_jacobian(x)."""
+
+    def __init__(self, base, transforms):
+        self._base = base
+        self._transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self._base.sample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self._base.rsample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = value
+        log_det = 0.0
+        for t in reversed(self._transforms):
+            x = t.inverse(y)
+            log_det = log_det + _raw(t.forward_log_det_jacobian(x))
+            y = x
+        return _wrap(_raw(self._base.log_prob(y)) - log_det)

@@ -74,3 +74,46 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return apply("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes), as_tensor(x))
+
+
+def _hfftn_impl(v, s, axes, norm):
+    """Hermitian-symmetric last axis -> real output: full FFT on leading axes,
+    hfft on the last (reference: python/paddle/fft.py hfftn). When axes is
+    None, s pairs with the LAST len(s) axes (numpy/reference convention)."""
+    if axes is None:
+        axes = tuple(range(v.ndim)) if s is None else tuple(range(v.ndim - len(s), v.ndim))
+    axes = tuple(a % v.ndim for a in axes)
+    s_map = dict(zip(axes, s)) if s is not None else {}
+    for a in axes[:-1]:
+        v = jnp.fft.fft(v, n=s_map.get(a), axis=a, norm=norm)
+    return jnp.fft.hfft(v, n=s_map.get(axes[-1]), axis=axes[-1], norm=norm)
+
+
+def _ihfftn_impl(v, s, axes, norm):
+    if axes is None:
+        axes = tuple(range(v.ndim)) if s is None else tuple(range(v.ndim - len(s), v.ndim))
+    axes = tuple(a % v.ndim for a in axes)
+    s_map = dict(zip(axes, s)) if s is not None else {}
+    v = jnp.fft.ihfft(v, n=s_map.get(axes[-1]), axis=axes[-1], norm=norm)
+    for a in axes[:-1]:
+        v = jnp.fft.ifft(v, n=s_map.get(a), axis=a, norm=norm)
+    return v
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply("hfftn", lambda v: _hfftn_impl(v, s, axes, norm), as_tensor(x))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply("ihfftn", lambda v: _ihfftn_impl(v, s, axes, norm), as_tensor(x))
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply("hfft2", lambda v: _hfftn_impl(v, s, axes, norm), as_tensor(x))
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply("ihfft2", lambda v: _ihfftn_impl(v, s, axes, norm), as_tensor(x))
+
+
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

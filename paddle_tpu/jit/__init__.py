@@ -1,3 +1,3 @@
-from .api import TranslatedLayer, ignore_module, load, not_to_static, save, to_static
+from .api import (TranslatedLayer, enable_to_static, ignore_module, load, not_to_static, save, set_code_level, set_verbosity, to_static)
 
-__all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer", "ignore_module"]
+__all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer", "ignore_module", "set_code_level", "set_verbosity", "enable_to_static"]

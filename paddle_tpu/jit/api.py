@@ -104,6 +104,8 @@ class StaticFunction:
         return jitted
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled():
+            return self._function(*args, **kwargs)
         if kwargs or not all(_is_arraylike(a) for a in args):
             # non-array args force the eager path (still correct, not cached)
             return self._function(*args, **kwargs)
@@ -280,3 +282,32 @@ def load(path, **configs) -> TranslatedLayer:
     with open(path + _META_SUFFIX) as f:
         meta = json.load(f)
     return TranslatedLayer(exported, state, meta)
+
+
+_CODE_LEVEL = 0
+_VERBOSITY = 0
+_TO_STATIC_ENABLED = True
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Reference: jit/dy2static logging of transformed code. The TPU build has
+    no AST transforms; the analog prints the StableHLO of traced functions at
+    level>0 (stored for introspection)."""
+    global _CODE_LEVEL
+    _CODE_LEVEL = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _VERBOSITY
+    _VERBOSITY = level
+
+
+def enable_to_static(enable_to_static_bool=True):
+    """Globally toggle @to_static (reference ProgramTranslator.enable):
+    when off, decorated functions run eagerly."""
+    global _TO_STATIC_ENABLED
+    _TO_STATIC_ENABLED = bool(enable_to_static_bool)
+
+
+def _to_static_enabled() -> bool:
+    return _TO_STATIC_ENABLED

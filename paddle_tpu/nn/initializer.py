@@ -176,3 +176,38 @@ def _resolve(init, default=None):
     if callable(init):
         return init
     raise TypeError(f"Cannot interpret initializer: {init!r}")
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed-conv upsampling
+    (reference: nn/initializer/Bilinear)."""
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as np
+
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D conv weight")
+        c_out, c_in, kh, kw = shape
+        f = np.ceil(kw / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        w = np.zeros(shape, np.float32)
+        for i in range(kh):
+            for j in range(kw):
+                w[:, :, i, j] = (1 - abs(i / f - c)) * (1 - abs(j / f - c))
+        return jnp.asarray(w, to_jax_dtype(dtype))
+
+
+_global_weight_initializer = None
+_global_bias_initializer = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Set default initializers for subsequently created parameters
+    (reference: nn/initializer/set_global_initializer)."""
+    global _global_weight_initializer, _global_bias_initializer
+    _global_weight_initializer = weight_init
+    _global_bias_initializer = bias_init
+
+
+def _global_initializer(is_bias: bool):
+    return _global_bias_initializer if is_bias else _global_weight_initializer

@@ -132,7 +132,8 @@ class Layer:
         dtype = convert_dtype(dtype) if dtype else self._dtype
         init = I._resolve(
             default_initializer if attr is None else getattr(attr, "initializer", None) or default_initializer,
-            default=I.Constant(0.0) if is_bias else I.XavierUniform(),
+            default=I._global_initializer(is_bias)
+            or (I.Constant(0.0) if is_bias else I.XavierUniform()),
         )
         value = init(tuple(int(s) for s in shape), dtype)
         trainable = getattr(attr, "trainable", True) if attr is not None else True

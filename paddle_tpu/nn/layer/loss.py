@@ -199,7 +199,7 @@ class TripletMarginWithDistanceLoss(Layer):
 
 
 class RNNTLoss(Layer):
-    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean", name=None):
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean", name=None):
         super().__init__()
         self.args = (blank, fastemit_lambda, reduction)
 

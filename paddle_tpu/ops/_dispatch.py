@@ -63,7 +63,27 @@ def apply(op_name: str, pure_fn, *tensors: Tensor):
             for t in tensors
         )
     out, node = run_op(op_name, pure_fn, tensors)
-    return wrap_outputs(out, node)
+    wrapped = wrap_outputs(out, node)
+    # static-graph capture: in static mode every executed op is also appended
+    # to the default Program for Executor replay (paddle.static analog)
+    if not _layers_mod()._dynamic_mode:
+        from ..static.program import record_op
+
+        out_leaves = [t for t in jax.tree_util.tree_leaves(wrapped) if isinstance(t, Tensor)]
+        record_op(op_name, pure_fn, tensors, out_leaves)
+    return wrapped
+
+
+_layers_cache = None
+
+
+def _layers_mod():
+    global _layers_cache
+    if _layers_cache is None:
+        from ..nn.layer import layers as _layers_cache_mod
+
+        _layers_cache = _layers_cache_mod
+    return _layers_cache
 
 
 def unary(op_name: str, jfn):

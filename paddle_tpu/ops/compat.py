@@ -16,7 +16,6 @@ from ..core.dtype import convert_dtype, to_jax_dtype
 from ..core.op_registry import register_op
 from ..core.tensor import Tensor
 from ._dispatch import apply, as_tensor
-from .math import quantile as _quantile
 
 
 # ---- dtype introspection ----

@@ -268,6 +268,10 @@ def index_add(x, index, axis, value, name=None):
     return apply("index_add", fn, x, index, value)
 
 
+def index_add_(x, index, axis, value, name=None):
+    return x._inplace_from(index_add(x, index, axis, value))
+
+
 @register_op("index_put")
 def index_put(x, indices, value, accumulate=False, name=None):
     x = as_tensor(x)

@@ -133,6 +133,16 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..nn.layer.layers import in_dynamic_mode
+
+        if not in_dynamic_mode():
+            # static mode: append grad + update nodes to the default Program
+            # (the analog of appending sgd/adam ops; fluid/backward.py:1865)
+            from ..static.program import append_backward, append_optimizer
+
+            params_grads = append_backward(loss, parameter_list=parameters, no_grad_set=no_grad_set)
+            append_optimizer(self, params_grads)
+            return None, params_grads
         loss.backward()
         self.step()
         self.clear_grad()

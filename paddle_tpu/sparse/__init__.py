@@ -196,3 +196,109 @@ class _SparseNN:
 
 
 nn = _SparseNN()
+
+
+# ---- value-wise unary ops (reference: python/paddle/sparse/unary.py; each
+# maps over the nonzero values only, preserving the sparsity pattern) ----
+def _valuewise(name, fn):
+    def op(x, name=None):
+        if isinstance(x, SparseCooTensor):
+            b = x._bcoo
+            return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
+        return Tensor(fn(x._value if isinstance(x, Tensor) else jnp.asarray(x)))
+
+    op.__name__ = name
+    op.__doc__ = f"Elementwise {name} over the nonzero values of a sparse tensor."
+    return op
+
+
+sin = _valuewise("sin", jnp.sin)
+tan = _valuewise("tan", jnp.tan)
+asin = _valuewise("asin", jnp.arcsin)
+atan = _valuewise("atan", jnp.arctan)
+sinh = _valuewise("sinh", jnp.sinh)
+tanh = _valuewise("tanh", jnp.tanh)
+asinh = _valuewise("asinh", jnp.arcsinh)
+atanh = _valuewise("atanh", jnp.arctanh)
+sqrt = _valuewise("sqrt", jnp.sqrt)
+square = _valuewise("square", jnp.square)
+log1p = _valuewise("log1p", jnp.log1p)
+abs = _valuewise("abs", jnp.abs)  # noqa: A001
+neg = _valuewise("neg", jnp.negative)
+expm1 = _valuewise("expm1", jnp.expm1)
+deg2rad = _valuewise("deg2rad", jnp.deg2rad)
+rad2deg = _valuewise("rad2deg", jnp.rad2deg)
+isnan = _valuewise("isnan", jnp.isnan)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    fn = lambda v: jnp.power(v, factor)
+    return _valuewise("pow", fn)(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..core.dtype import to_jax_dtype
+
+    if isinstance(x, SparseCooTensor):
+        b = x._bcoo
+        data = b.data.astype(to_jax_dtype(value_dtype)) if value_dtype else b.data
+        idx = b.indices.astype(to_jax_dtype(index_dtype)) if index_dtype else b.indices
+        return SparseCooTensor(jsparse.BCOO((data, idx), shape=b.shape))
+    return Tensor(x._value.astype(to_jax_dtype(value_dtype))) if value_dtype else x
+
+
+def divide(x, y, name=None):
+    """Sparse / sparse-or-dense elementwise divide (dense fallback)."""
+    xd = x.to_dense()._value if isinstance(x, SparseCooTensor) else (x._value if isinstance(x, Tensor) else jnp.asarray(x))
+    yd = y.to_dense()._value if isinstance(y, SparseCooTensor) else (y._value if isinstance(y, Tensor) else jnp.asarray(y))
+    return Tensor(xd / yd)
+
+
+def coalesce(x, name=None):
+    """Merge duplicate indices by summation (reference: sparse coalesce)."""
+    if not isinstance(x, SparseCooTensor):
+        return x
+    b = x._bcoo.sum_duplicates(remove_zeros=False)
+    return SparseCooTensor(jsparse.BCOO((b.data, b.indices), shape=b.shape))
+
+
+def reshape(x, shape, name=None):
+    """Reshape preserving sparsity: remap flat nonzero positions."""
+    if not isinstance(x, SparseCooTensor):
+        from ..ops.manipulation import reshape as dense_reshape
+
+        return dense_reshape(x, shape)
+    b = x._bcoo
+    old_shape = b.shape
+    total = int(np.prod(old_shape))
+    shape = list(shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = total // known
+    strides = np.cumprod([1] + list(old_shape[::-1]))[:-1][::-1]
+    flat = (b.indices * jnp.asarray(strides.copy())).sum(-1)
+    new_strides = np.cumprod([1] + list(shape[::-1]))[:-1][::-1]
+    new_idx = jnp.stack([(flat // int(s)) % int(d) for s, d in zip(new_strides, shape)], -1)
+    return SparseCooTensor(jsparse.BCOO((b.data, new_idx), shape=tuple(shape)))
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix @ dense vector."""
+    b = x._bcoo if isinstance(x, SparseCooTensor) else x
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor(b @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * (x @ y) with sparse x (reference sparse.addmm)."""
+    xv = x._bcoo if isinstance(x, SparseCooTensor) else (x._value if isinstance(x, Tensor) else jnp.asarray(x))
+    yv = y.to_dense()._value if isinstance(y, SparseCooTensor) else (y._value if isinstance(y, Tensor) else jnp.asarray(y))
+    iv = input.to_dense()._value if isinstance(input, SparseCooTensor) else (input._value if isinstance(input, Tensor) else jnp.asarray(input))
+    return Tensor(beta * iv + alpha * (xv @ yv))
+
+
+__all__ += [
+    "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh", "sqrt",
+    "square", "log1p", "abs", "pow", "cast", "neg", "deg2rad", "rad2deg",
+    "expm1", "mv", "addmm", "divide", "coalesce", "reshape", "isnan",
+]

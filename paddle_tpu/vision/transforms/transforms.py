@@ -140,3 +140,194 @@ class Transpose(BaseTransform):
 
     def _apply_image(self, img):
         return np.transpose(np.asarray(img), self.order)
+
+
+class RandomResizedCrop(BaseTransform):
+    """Random area/aspect crop then resize (reference RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3), interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio, self.interpolation = scale, ratio, interpolation
+
+    def _apply_image(self, img):
+        import math
+
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = random.uniform(*self.scale) * area
+            log_ratio = (math.log(self.ratio[0]), math.log(self.ratio[1]))
+            aspect = math.exp(random.uniform(*log_ratio))
+            cw = int(round(math.sqrt(target_area * aspect)))
+            ch = int(round(math.sqrt(target_area / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = random.randint(0, h - ch)
+                j = random.randint(0, w - cw)
+                return F.resize(F.crop(img, i, j, ch, cw), self.size, self.interpolation)
+        return F.resize(F.center_crop(img, min(h, w)), self.size, self.interpolation)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return F.adjust_brightness(img, random.uniform(max(0, 1 - self.value), 1 + self.value))
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value should be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return F.adjust_contrast(img, random.uniform(max(0, 1 - self.value), 1 + self.value))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return F.adjust_saturation(img, random.uniform(max(0, 1 - self.value), 1 + self.value))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return F.adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.transforms = [
+            BrightnessTransform(brightness),
+            ContrastTransform(contrast),
+            SaturationTransform(saturation),
+            HueTransform(hue),
+        ]
+
+    def _apply_image(self, img):
+        order = list(range(4))
+        random.shuffle(order)
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False, center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(degrees, numbers.Number) else tuple(degrees)
+        self.args = (interpolation, expand, center, fill)
+
+    def _apply_image(self, img):
+        interp, expand, center, fill = self.args
+        return F.rotate(img, random.uniform(*self.degrees), interp, expand, center, fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None, interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(degrees, numbers.Number) else tuple(degrees)
+        self.translate, self.scale_range, self.shear = translate, scale, shear
+        self.interpolation, self.fill = interpolation, fill
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        scale = random.uniform(*self.scale_range) if self.scale_range else 1.0
+        shear = 0.0
+        if self.shear is not None:
+            sh = (-self.shear, self.shear) if isinstance(self.shear, numbers.Number) else tuple(self.shear)
+            shear = random.uniform(sh[0], sh[1])
+        return F.affine(img, angle, (tx, ty), scale, shear, self.interpolation, self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5, interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob, self.distortion_scale = prob, distortion_scale
+        self.interpolation, self.fill = interpolation, fill
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        d = self.distortion_scale
+        half_h, half_w = int(h * d / 2), int(w * d / 2)
+        tl = (random.randint(0, half_w), random.randint(0, half_h))
+        tr = (w - 1 - random.randint(0, half_w), random.randint(0, half_h))
+        br = (w - 1 - random.randint(0, half_w), h - 1 - random.randint(0, half_h))
+        bl = (random.randint(0, half_w), h - 1 - random.randint(0, half_h))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return F.perspective(img, start, [tl, tr, br, bl], self.interpolation, self.fill)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return F.to_grayscale(img, self.num_output_channels)
+
+
+class RandomErasing(BaseTransform):
+    """Randomly erase a rectangle (reference RandomErasing); operates on HWC
+    numpy or CHW Tensors."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3), value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        import math
+
+        if random.random() >= self.prob:
+            return img
+        from ...core.tensor import Tensor
+
+        if isinstance(img, Tensor):
+            h, w = img.shape[-2], img.shape[-1]
+        else:
+            img = np.asarray(img)
+            h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            aspect = math.exp(random.uniform(math.log(self.ratio[0]), math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target / aspect)))
+            ew = int(round(math.sqrt(target * aspect)))
+            if eh < h and ew < w:
+                i = random.randint(0, h - eh)
+                j = random.randint(0, w - ew)
+                return F.erase(img, i, j, eh, ew, self.value, self.inplace)
+        return img
