@@ -65,6 +65,18 @@ class XPUPlace(Place):
     kind = "xpu"
 
 
+class IPUPlace(Place):
+    kind = "ipu"
+
+
+class NPUPlace(Place):
+    kind = "npu"
+
+
+class MLUPlace(Place):
+    kind = "mlu"
+
+
 class CUDAPinnedPlace(Place):
     """Pinned host memory place; host arrays are always transfer-ready here."""
 

@@ -103,3 +103,54 @@ class Event:
 
     def synchronize(self):
         jax.effects_barrier()
+
+
+def get_cudnn_version():
+    """None: no cuDNN in the TPU build (reference returns version int or None)."""
+    return None
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+from ..core.place import IPUPlace, XPUPlace  # noqa: F401,E402
+
+
+class Stream:
+    """Compat stream handle: XLA owns real streams; this tracks identity only."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+        self.priority = priority
+
+    def synchronize(self):
+        import jax
+
+        jax.effects_barrier()
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    return _current_stream
+
+
+def set_stream(stream: Stream):
+    global _current_stream
+    old, _current_stream = _current_stream, stream
+    return old
+
+
+class stream_guard:
+    def __init__(self, stream: Stream):
+        self.stream = stream
+
+    def __enter__(self):
+        self._old = set_stream(self.stream)
+        return self.stream
+
+    def __exit__(self, *exc):
+        set_stream(self._old)
+        return False

@@ -112,3 +112,158 @@ def barrier_worker():
 
 # fleet.auto namespace (reference: paddle.distributed.fleet import auto)
 from .. import auto_parallel as auto  # noqa: F401,E402
+
+
+# ---- reference fleet facade classes (fleet/__init__.py __all__) ----
+class Role:
+    """Role enum (reference fleet/base/role_maker.py Role)."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class UserDefinedRoleMaker:
+    """Explicit role assignment (reference UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        self._kwargs = kwargs
+        self._role = kwargs.get("role", Role.WORKER)
+        self._current_id = kwargs.get("current_id", 0)
+        self._worker_num = kwargs.get("worker_num", 1)
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def worker_num(self):
+        return self._worker_num
+
+    def worker_index(self):
+        return self._current_id
+
+
+class PaddleCloudRoleMaker:
+    """Env-parsing role maker (reference fleet/base/role_maker.py): reads the
+    PADDLE_* variables the launch controller exports."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        import os
+
+        self._is_collective = is_collective
+        self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        endpoints = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_num = len(endpoints.split(",")) if endpoints else int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def worker_num(self):
+        return self._worker_num
+
+    def worker_index(self):
+        return self._current_id
+
+
+class UtilBase:
+    """Cross-rank util helpers (reference fleet/base/util_factory.py)."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        return np.asarray(input)  # single-process world: identity
+
+    def barrier(self, comm_world="worker"):
+        from ..communication import barrier as _barrier
+
+        _barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        return [input]
+
+    def get_file_shard(self, files):
+        return list(files)
+
+
+class MultiSlotDataGenerator:
+    """Line-protocol data generator for slot-based datasets (reference
+    fleet/data_generator): subclass overrides generate_sample; run() streams
+    '<slot>:<len> <ids...>' lines to stdout for the dataset pipe."""
+
+    def __init__(self):
+        self._line_limit = None
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+    def _format(self, sample):
+        parts = []
+        for name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts)
+
+    def run_from_memory(self, samples):
+        out = []
+        for s in samples:
+            gen = self.generate_sample(s)
+            for sample in (gen() if callable(gen) else gen):
+                out.append(self._format(sample))
+        return out
+
+    def run_from_stdin(self):
+        import sys
+
+        for line in sys.stdin:
+            gen = self.generate_sample(line)
+            for sample in (gen() if callable(gen) else gen):
+                sys.stdout.write(self._format(sample) + "\n")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-slot variant: values are already strings, the line protocol is
+    identical, so the parent's formatter applies unchanged."""
+
+
+class Fleet:
+    """Class facade over the module-level fleet functions (reference
+    fleet/fleet.py Fleet — `paddle.distributed.fleet` module functions are
+    bound methods of a singleton there; here the class wraps the same fns)."""
+
+    def __init__(self):
+        self._role_maker = None
+
+    def init(self, role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+        self._role_maker = role_maker
+        return init(role_maker=role_maker, is_collective=is_collective, strategy=strategy)
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy=strategy)
+
+    @property
+    def util(self):
+        return UtilBase()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def worker_index(self):
+        return get_rank()
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def barrier_worker(self):
+        from ..communication import barrier as _barrier
+
+        _barrier()

@@ -1,5 +1,20 @@
-"""paddle.incubate analog: experimental APIs (MoE, fused ops)."""
+"""paddle.incubate analog: experimental APIs (MoE, fused ops, ASP, graph ops)."""
 
+from . import asp  # noqa: F401
+from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
-from . import asp  # noqa: F401
+from .ops import (  # noqa: F401
+    graph_khop_sampler,
+    graph_reindex,
+    graph_sample_neighbors,
+    graph_send_recv,
+    identity_loss,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
+from .optimizer import LookAhead, ModelAverage  # noqa: F401

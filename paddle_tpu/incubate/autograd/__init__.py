@@ -1,0 +1,44 @@
+"""paddle.incubate.autograd: functional transforms + prim toggles.
+
+Reference surface: python/paddle/incubate/autograd/ (vjp/jvp/Jacobian/Hessian
+over primapi, enable_prim/disable_prim, forward_grad). The transforms
+re-export paddle.autograd's jax-native versions; prim mode is inherently on
+(every op IS a primitive jaxpr program), so the toggles track state for
+API compatibility.
+"""
+
+from ...autograd import grad, hessian, jacobian, jvp, vjp  # noqa: F401
+
+# reference incubate exposes capitalized lazy-evaluating classes; the jax-native
+# implementations compute directly, so the names alias the functions
+Jacobian = jacobian
+Hessian = hessian
+
+_prim_enabled = False
+
+
+def enable_prim():
+    global _prim_enabled
+    _prim_enabled = True
+
+
+def disable_prim():
+    global _prim_enabled
+    _prim_enabled = False
+
+
+def prim_enabled() -> bool:
+    return _prim_enabled
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode AD (reference primapi.forward_grad): JVP of outputs wrt
+    inputs with tangents grad_inputs (defaults to ones)."""
+    from ...autograd import jvp as _jvp
+
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    raise NotImplementedError(
+        "forward_grad over captured static programs is not supported; use "
+        "paddle.incubate.autograd.jvp(func, xs, v) on a python function"
+    )

@@ -1,4 +1,13 @@
 """paddle.incubate.nn analog: fused transformer blocks built on the Pallas
 seams (fused_attention / fused_feedforward op analogs, SURVEY §2.2)."""
 
-from .fused_transformer import FusedFeedForward, FusedMultiHeadAttention, FusedTransformerEncoderLayer  # noqa: F401
+from .fused_transformer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
+    FusedDropoutAdd,
+    FusedEcMoe,
+    FusedFeedForward,
+    FusedLinear,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)

@@ -5,6 +5,9 @@ the reference's pre/post-LN contract."""
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+
 from ... import nn
 from ...nn import functional as F
 from ...nn.layer.layers import Layer
@@ -116,3 +119,114 @@ class FusedTransformerEncoderLayer(Layer):
 
     def forward(self, src, src_mask=None):
         return self.ffn(self.attn(src, attn_mask=src_mask))
+
+
+class FusedLinear(Layer):
+    """Linear whose matmul+bias fuses into one dot (reference FusedLinear /
+    fused_gemm_epilogue). On TPU, XLA fuses the epilogue already — the class
+    exists so checkpoints and code port unchanged."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = [out_features, in_features] if transpose_weight else [in_features, out_features]
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        if bias_attr is False:
+            self.bias = None
+            self.add_parameter("bias", None)
+        else:
+            self.bias = self.create_parameter([out_features], attr=None if bias_attr is True else bias_attr, is_bias=True)
+
+    def forward(self, x):
+        w = self.weight
+        if self.transpose_weight:
+            from ...ops.linalg import t as _t
+
+            w = _t(w)
+        return F.linear(x, w, self.bias)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """out = LayerNorm(residual + dropout(x + bias)) in one fused chain
+    (reference FusedBiasDropoutResidualLayerNorm)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None, bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.ln = nn.LayerNorm(embed_dim, epsilon=epsilon)
+
+    def forward(self, x, residual):
+        return self.ln(residual + self.dropout(x + self.linear_bias))
+
+
+class FusedDropoutAdd(Layer):
+    """out = dropout(x) + y (reference FusedDropoutAdd)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.dropout = nn.Dropout(p, mode=mode)
+
+    def forward(self, x, y):
+        return self.dropout(x) + y
+
+
+class FusedEcMoe(Layer):
+    """Expert-choice MoE layer (reference FusedEcMoe): gate scores route each
+    token to top experts; expert FFNs run as one batched einsum over the
+    expert dim (MXU-batched, the TPU-native layout)."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.act_type = act_type
+        self.gate = nn.Linear(hidden_size, num_experts)
+        self.w1 = self.create_parameter([num_experts, hidden_size, inter_size])
+        self.b1 = self.create_parameter([num_experts, 1, inter_size], is_bias=True)
+        self.w2 = self.create_parameter([num_experts, inter_size, hidden_size])
+        self.b2 = self.create_parameter([num_experts, 1, hidden_size], is_bias=True)
+
+    def forward(self, x, gate_logits=None):
+        from ...ops._dispatch import apply, as_tensor
+
+        if gate_logits is None:
+            gate_logits = self.gate(x)
+        act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[self.act_type]
+
+        def f(xv, gv, w1, b1, w2, b2):
+            B, S, H = xv.shape
+            probs = jax.nn.softmax(gv, -1)  # [B, S, E]
+            flat = xv.reshape(B * S, H)
+            h = jnp.einsum("th,ehi->eti", flat, w1) + b1
+            h = act(h)
+            out = jnp.einsum("eti,eih->eth", h, w2) + b2  # [E, T, H]
+            mixed = jnp.einsum("eth,te->th", out, probs.reshape(B * S, -1))
+            return mixed.reshape(B, S, H)
+
+        return apply(
+            "fused_ec_moe", f, as_tensor(x), as_tensor(gate_logits),
+            self.w1, self.b1, self.w2, self.b2,
+        )
+
+
+class FusedMultiTransformer(Layer):
+    """Stacked fused transformer decoder layers sharing one call (reference
+    FusedMultiTransformer — the inference fast path of fused_multi_transformer
+    CUDA kernels; here each layer is the fused encoder layer whose chain XLA
+    fuses)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
+                 activation="gelu", normalize_before=True, num_layers=1, epsilon=1e-5, name=None):
+        super().__init__()
+        self.layers = nn.LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward, dropout_rate=dropout_rate,
+                activation=activation, normalize_before=normalize_before,
+            )
+            for _ in range(num_layers)
+        ])
+
+    def forward(self, x, attn_mask=None, caches=None):
+        for lyr in self.layers:
+            x = lyr(x, attn_mask)
+        return x

@@ -1,0 +1,94 @@
+"""Incubate op surface: fused softmax-mask, legacy graph-op names, identity_loss.
+
+Reference surface: python/paddle/incubate/__init__.py — graph_send_recv etc.
+pre-date the paddle.geometric package; they alias the geometric ops here.
+softmax_mask_fuse maps to a single fused jnp chain (XLA fuses it into one
+kernel — the point of the reference's fused CUDA op).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..geometric.math import segment_max, segment_mean, segment_min, segment_sum  # noqa: F401
+from ..geometric.reindex import reindex_graph as graph_reindex  # noqa: F401
+from ..geometric.sampling import sample_neighbors as graph_sample_neighbors  # noqa: F401
+from ..ops._dispatch import apply, as_tensor
+
+
+def graph_send_recv(x, src_index, dst_index, reduce_op="sum", out_size=None, name=None):
+    """Legacy name for geometric.send_u_recv (reference incubate alias)."""
+    from ..geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=reduce_op, out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes, sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference graph_khop_sampler): repeated
+    one-hop sampling with reindexing, host-side (data-prep op)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    from ..geometric.sampling import sample_neighbors
+
+    cur = input_nodes
+    edge_src_list, edge_dst_list = [], []
+    all_nodes = [np.asarray(as_tensor(input_nodes)._value)]
+    for size in sample_sizes:
+        out_neighbors, out_count = sample_neighbors(row, colptr, cur, sample_size=size)
+        nv = np.asarray(as_tensor(out_neighbors)._value)
+        cv = np.asarray(as_tensor(out_count)._value)
+        dst = np.repeat(np.asarray(as_tensor(cur)._value), cv)
+        edge_src_list.append(nv)
+        edge_dst_list.append(dst)
+        all_nodes.append(nv)
+        cur = Tensor(jnp.asarray(np.unique(nv)))
+    nodes = np.concatenate(all_nodes)
+    uniq, first = np.unique(nodes, return_index=True)
+    order = np.argsort(first, kind="stable")
+    final_nodes = uniq[order]
+    remap = {int(v): i for i, v in enumerate(final_nodes)}
+    src = np.asarray([remap[int(v)] for v in np.concatenate(edge_src_list)], np.int64)
+    dst = np.asarray([remap[int(v)] for v in np.concatenate(edge_dst_list)], np.int64)
+    return Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)), Tensor(jnp.asarray(final_nodes))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fused chain (reference fused_softmax_mask op:
+    incubate/operators/softmax_mask_fuse.py)."""
+    x, mask = as_tensor(x), as_tensor(mask)
+
+    def f(xv, mv):
+        return jax.nn.softmax(xv.astype(jnp.float32) + mv.astype(jnp.float32), -1).astype(xv.dtype)
+
+    return apply("softmax_mask_fuse", f, x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax with causal (upper-triangle) mask fused (reference
+    fused_softmax_mask_upper_triangle): rows attend to positions <= row."""
+    x = as_tensor(x)
+
+    def f(xv):
+        s = xv.shape[-1]
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(causal, xv.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(scores, -1).astype(xv.dtype)
+
+    return apply("softmax_mask_fuse_upper_triangle", f, x)
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as a loss without changing it (reference identity_loss op,
+    IPU heritage); reduction in {none, sum, mean} applies on the way out."""
+    x = as_tensor(x)
+    if reduction in (0, "sum"):
+        from ..ops.math import sum as _sum
+
+        return _sum(x)
+    if reduction in (1, "mean"):
+        from ..ops.math import mean as _mean
+
+        return _mean(x)
+    return x

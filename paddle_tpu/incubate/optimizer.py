@@ -1,0 +1,94 @@
+"""Incubate optimizers: LookAhead and ModelAverage wrappers.
+
+Reference surface: python/paddle/incubate/optimizer/ (lookahead.py,
+modelaverage.py). Both wrap an inner optimizer and keep host-side slow/EMA
+copies of the parameters.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class LookAhead:
+    """k steps forward, 1 step back (Zhang et al): every k inner steps, pull
+    the fast weights toward the slow weights by alpha."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha, self.k = alpha, k
+        self._step_num = 0
+        self._slow = {}
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def _params(self):
+        return (getattr(self.inner_optimizer, "_parameter_list", None)
+                or getattr(self.inner_optimizer, "_parameters", None) or [])
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for p in self._params():
+                slow = self._slow.get(id(p))
+                if slow is None:
+                    slow = p._value
+                new_slow = slow + self.alpha * (p._value - slow)
+                self._slow[id(p)] = new_slow
+                p._set_value_raw(new_slow)
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.inner_optimizer.clear_grad()
+        return None, None
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+
+class ModelAverage:
+    """Maintains a running average of parameters; apply()/restore() swap the
+    averaged weights in for evaluation (reference incubate ModelAverage)."""
+
+    def __init__(self, average_window_rate, parameters=None, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        self.rate = average_window_rate
+        self._parameters = list(parameters) if parameters is not None else []
+        self._sum = {}
+        self._count = 0
+        self._backup = {}
+
+    def step(self):
+        self._count += 1
+        for p in self._parameters:
+            acc = self._sum.get(id(p))
+            self._sum[id(p)] = p._value if acc is None else acc + p._value
+
+    def update(self):
+        self.step()
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._backup = {id(p): p._value for p in self._parameters}
+            for p in self._parameters:
+                if id(p) in self._sum and self._count:
+                    p._set_value_raw((self._sum[id(p)] / self._count).astype(p._value.dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+
+        return ctx()
+
+    def restore(self, executor=None):
+        for p in self._parameters:
+            if id(p) in self._backup:
+                p._set_value_raw(self._backup[id(p)])
+        self._backup = {}

@@ -353,3 +353,56 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=
         return jnp.power(jnp.sum(jnp.power(diff, p), -1), 1.0 / p)
 
     return apply("cdist", f, x, y)
+
+
+@register_op("linalg.cond")
+def cond(x, p=None, name=None):
+    """Condition number (reference paddle.linalg.cond): ratio of singular
+    values for p in {None, 2, -2, 'fro', 'nuc'}, norm product for 1/inf."""
+    x = as_tensor(x)
+
+    def f(xv):
+        if p is None or p == 2 or p == -2:
+            s = jnp.linalg.svd(xv, compute_uv=False)
+            if p == -2:
+                return s[..., -1] / s[..., 0]
+            return s[..., 0] / s[..., -1]
+        nx = jnp.linalg.norm(xv, ord=p, axis=(-2, -1))
+        ni = jnp.linalg.norm(jnp.linalg.inv(xv), ord=p, axis=(-2, -1))
+        return nx * ni
+
+    return apply("linalg.cond", f, x)
+
+
+@register_op("linalg.lu_unpack")
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack packed LU + pivots into (P, L, U) (reference lu_unpack; pairs
+    with paddle.linalg.lu)."""
+    x, y = as_tensor(x), as_tensor(y)
+
+    def f(lu_v, piv):
+        m, n = lu_v.shape[-2], lu_v.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_v[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_v.dtype)
+        U = jnp.triu(lu_v[..., :k, :])
+
+        def unbatched_perm(piv1):
+            # pivots (1-based sequential row swaps) -> permutation vector
+            perm = jnp.arange(m)
+            piv0 = piv1.astype(jnp.int32) - 1
+            for i in range(piv1.shape[-1]):
+                j = piv0[i]
+                pi, pj = perm[i], perm[j]
+                perm = perm.at[i].set(pj).at[j].set(pi)
+            return perm
+
+        pv = piv
+        batch_shape = pv.shape[:-1]
+        pfn = unbatched_perm
+        for _ in batch_shape:
+            pfn = jax.vmap(pfn)
+        perm = pfn(pv)
+        P = jnp.swapaxes(jnp.eye(m, dtype=lu_v.dtype)[perm], -1, -2)
+        return P, L, U
+
+    return apply("linalg.lu_unpack", f, x, y)

@@ -21,3 +21,19 @@ __all__ = [
     "export_protobuf",
     "load_profiler_result",
 ]
+
+
+class SortedKeys:
+    """Summary-table sort keys (reference profiler/profiler_statistic.py)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+__all__.append("SortedKeys")

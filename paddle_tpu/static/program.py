@@ -456,12 +456,18 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     from ..ops._dispatch import apply, as_tensor
 
     xs = [as_tensor(t) for t in (x if isinstance(x, (list, tuple)) else [x])]
-    outs = out if isinstance(out, (list, tuple)) else [out]
-    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype) for o in outs]
+    multi = isinstance(out, (list, tuple))
+    outs = list(out) if multi else [out]
+    shapes = tuple(jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype) for o in outs)
+
+    def host(*a):
+        res = func(*[Tensor(jnp.asarray(v)) for v in a])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r._value if isinstance(r, Tensor) else r) for r in res)
 
     def f(*vals):
-        res = jax.pure_callback(lambda *a: func(*[Tensor(jnp.asarray(x)) for x in a]).numpy(), shapes[0], *vals)
-        return res
+        res = jax.pure_callback(host, shapes, *vals)
+        return tuple(res) if multi else res[0]
 
     return apply("py_func", f, *xs)
 

@@ -13,3 +13,13 @@ def set_image_backend(backend: str):
 
 def get_image_backend() -> str:
     return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file as an HWC numpy array (reference vision.image_load;
+    PIL backend — cv2 is not in this image)."""
+    import numpy as np
+    from PIL import Image
+
+    return np.asarray(Image.open(path))
+

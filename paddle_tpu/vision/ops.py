@@ -296,18 +296,23 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
         elif var_list is not None:
             out = out / var_list[None, None, :]
         return Tensor(jnp.asarray(out.astype(np.float32)))
-    # decode: target_box [N, M, 4] deltas against priors (axis selects broadcast)
+    # decode: target_box [N, M, 4] deltas; `axis` selects which output dim the
+    # priors broadcast along (reference box_coder axis semantics)
     d = tb
     if d.ndim == 2:
-        d = d[:, None, :]
+        d = d[:, None, :] if axis == 0 else d[None, :, :]
+
+    def brd(v):
+        return v[None, :] if axis == 0 else v[:, None]
+
     if pbv is not None:
-        d = d * pbv[None, :, :]
+        d = d * (pbv[None, :, :] if axis == 0 else pbv[:, None, :])
     elif var_list is not None:
         d = d * var_list[None, None, :]
-    cx = d[..., 0] * pw[None, :] + pcx[None, :]
-    cy = d[..., 1] * ph[None, :] + pcy[None, :]
-    bw = np.exp(d[..., 2]) * pw[None, :]
-    bh = np.exp(d[..., 3]) * ph[None, :]
+    cx = d[..., 0] * brd(pw) + brd(pcx)
+    cy = d[..., 1] * brd(ph) + brd(pcy)
+    bw = np.exp(d[..., 2]) * brd(pw)
+    bh = np.exp(d[..., 3]) * brd(ph)
     out = np.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2 - norm, cy + bh / 2 - norm], -1)
     return Tensor(jnp.asarray(out.astype(np.float32)))
 

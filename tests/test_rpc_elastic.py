@@ -1,6 +1,15 @@
 """RPC + elastic manager tests (reference test/rpc + fleet/elastic tests analog)."""
 
+import socket as _socket
 import time
+
+
+def _free_port():
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 import pytest
 
@@ -27,7 +36,7 @@ class TestRpc:
     def setup_class(cls):
         import os
 
-        os.environ["PADDLE_RPC_BASE_PORT"] = "29870"
+        os.environ["PADDLE_RPC_BASE_PORT"] = str(_free_port())
         rpc.init_rpc("worker0", rank=0, world_size=1)
 
     @classmethod
@@ -157,7 +166,7 @@ class TestWireAuth:
 
         master = KVMaster()
         try:
-            os.environ["PADDLE_RPC_BASE_PORT"] = "29960"
+            os.environ["PADDLE_RPC_BASE_PORT"] = str(_free_port())
             rpc.init_rpc("coordinator", rank=0, world_size=1, master_endpoint=f"127.0.0.1:{master.port}")
             # a fresh resolve by custom name must go through the master table
             assert rpc.get_worker_info("coordinator").rank == 0

@@ -152,3 +152,77 @@ def test_excluded_layers():
     masks = asp.prune_model(net)
     assert names[0] not in masks
     asp.reset_excluded_layers()
+
+
+# ---- incubate / device / fleet facade additions ----
+
+def test_incubate_fused_ec_moe_and_masked_softmax():
+    import paddle_tpu.incubate as inc
+
+    x = paddle.to_tensor(np.random.default_rng(0).normal(size=(2, 3, 8)).astype(np.float32))
+    moe = inc.nn.FusedEcMoe(8, 16, 4)
+    assert moe(x).shape == [2, 3, 8]
+    att = inc.softmax_mask_fuse_upper_triangle(
+        paddle.to_tensor(np.random.default_rng(1).normal(size=(1, 2, 4, 4)).astype(np.float32))
+    )
+    assert abs(att.numpy()[0, 0, 0, 1:].sum()) < 1e-6
+
+
+def test_lookahead_and_model_average():
+    import paddle_tpu.incubate as inc
+
+    lin = nn.Linear(4, 2)
+    la = inc.LookAhead(paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters()), k=2)
+    w0 = np.asarray(lin.weight._value).copy()
+    for _ in range(2):
+        lin(paddle.ones([2, 4])).sum().backward()
+        la.step()
+        la.clear_grad()
+    assert not np.allclose(np.asarray(lin.weight._value), w0)
+    ma = inc.ModelAverage(0.15, parameters=lin.parameters())
+    ma.step()
+    with ma.apply():
+        pass
+
+
+def test_incubate_graph_aliases():
+    import paddle_tpu.incubate as inc
+
+    out = inc.segment_sum(paddle.to_tensor(np.float32([[1, 2], [3, 4], [5, 6]])),
+                          paddle.to_tensor(np.int64([0, 0, 1])))
+    np.testing.assert_allclose(out.numpy(), [[4, 6], [5, 6]])
+
+
+def test_device_stream_shims():
+    st = paddle.device.current_stream()
+    st.synchronize()
+    with paddle.device.stream_guard(paddle.device.Stream()):
+        assert paddle.device.current_stream() is not st
+    assert paddle.device.get_cudnn_version() is None
+
+
+def test_fleet_facade_and_rolemaker():
+    from paddle_tpu.distributed import fleet as F
+
+    rm = F.PaddleCloudRoleMaker(is_collective=True)
+    assert rm.is_worker() and rm.worker_num() >= 1
+    fl = F.Fleet()
+    assert fl.is_first_worker() in (True, False)
+    gen = F.MultiSlotDataGenerator()
+
+    class G(F.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def gen():
+                yield [("a", [1, 2]), ("b", [3])]
+            return gen
+
+    lines = G().run_from_memory([None])
+    assert lines == ["2 1 2 1 3"]
+
+
+def test_linalg_cond_lu_unpack():
+    x = paddle.to_tensor(np.random.default_rng(3).normal(size=(4, 4)).astype(np.float32))
+    assert float(paddle.linalg.cond(x).numpy()) > 1.0
+    lu_, piv = paddle.linalg.lu(x)
+    P, L, U = paddle.linalg.lu_unpack(lu_, piv)
+    np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), x.numpy(), rtol=1e-4, atol=1e-5)
