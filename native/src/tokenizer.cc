@@ -1,0 +1,138 @@
+// Native WordPiece tokenizer (the reference's faster_tokenizer custom host op
+// analog — SURVEY §7 "custom-call host ops ... tokenizer/data feed"). Greedy
+// longest-match WordPiece over a vocab hash map, batch-parallel with worker
+// threads; emits padded int32 id/(mask) matrices ready for device transfer.
+//
+// C ABI for ctypes binding (no pybind11 in this environment).
+
+#include <algorithm>
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Tokenizer {
+  std::unordered_map<std::string, int32_t> vocab;
+  int32_t unk_id = 0;
+  int32_t cls_id = -1;
+  int32_t sep_id = -1;
+  int32_t pad_id = 0;
+  bool lowercase = true;
+  int max_word_chars = 100;
+};
+
+std::vector<std::string> basic_split(const std::string& text, bool lowercase) {
+  // whitespace split + punctuation isolation (BERT BasicTokenizer behavior)
+  std::vector<std::string> out;
+  std::string cur;
+  for (unsigned char c : text) {
+    if (std::isspace(c)) {
+      if (!cur.empty()) { out.push_back(cur); cur.clear(); }
+    } else if (std::ispunct(c)) {
+      if (!cur.empty()) { out.push_back(cur); cur.clear(); }
+      out.emplace_back(1, static_cast<char>(c));
+    } else {
+      cur.push_back(lowercase ? static_cast<char>(std::tolower(c)) : static_cast<char>(c));
+    }
+  }
+  if (!cur.empty()) out.push_back(cur);
+  return out;
+}
+
+void wordpiece(const Tokenizer& tk, const std::string& word, std::vector<int32_t>* ids) {
+  if (static_cast<int>(word.size()) > tk.max_word_chars) {
+    ids->push_back(tk.unk_id);
+    return;
+  }
+  size_t start = 0;
+  std::vector<int32_t> pieces;
+  while (start < word.size()) {
+    size_t end = word.size();
+    int32_t cur_id = -1;
+    while (start < end) {
+      std::string sub = word.substr(start, end - start);
+      if (start > 0) sub = "##" + sub;
+      auto it = tk.vocab.find(sub);
+      if (it != tk.vocab.end()) { cur_id = it->second; break; }
+      end--;
+    }
+    if (cur_id < 0) {  // no piece matched: whole word is UNK
+      ids->push_back(tk.unk_id);
+      return;
+    }
+    pieces.push_back(cur_id);
+    start = end;
+  }
+  ids->insert(ids->end(), pieces.begin(), pieces.end());
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_tokenizer_create(const char** tokens, int32_t n_tokens, const char* unk,
+                          const char* cls, const char* sep, const char* pad,
+                          int32_t lowercase) {
+  auto* tk = new Tokenizer();
+  tk->vocab.reserve(n_tokens * 2);
+  for (int32_t i = 0; i < n_tokens; ++i) tk->vocab.emplace(tokens[i], i);
+  auto find_or = [&](const char* t, int32_t fallback) {
+    auto it = tk->vocab.find(t ? t : "");
+    return it == tk->vocab.end() ? fallback : it->second;
+  };
+  tk->unk_id = find_or(unk, 0);
+  tk->cls_id = cls && *cls ? find_or(cls, -1) : -1;
+  tk->sep_id = sep && *sep ? find_or(sep, -1) : -1;
+  tk->pad_id = pad && *pad ? find_or(pad, 0) : 0;
+  tk->lowercase = lowercase != 0;
+  return tk;
+}
+
+void pt_tokenizer_destroy(void* handle) { delete static_cast<Tokenizer*>(handle); }
+
+// Encode a batch: texts are NUL-separated in one buffer with offsets.
+// Output: ids/mask [batch, max_len] int32, lengths [batch] int32.
+void pt_tokenizer_encode_batch(void* handle, const char* buffer, const int64_t* offsets,
+                               int32_t batch, int32_t max_len, int32_t add_special,
+                               int32_t n_threads, int32_t* out_ids, int32_t* out_mask,
+                               int32_t* out_len) {
+  const auto& tk = *static_cast<Tokenizer*>(handle);
+  auto work = [&](int32_t lo, int32_t hi) {
+    for (int32_t b = lo; b < hi; ++b) {
+      std::string text(buffer + offsets[b], buffer + offsets[b + 1]);
+      std::vector<int32_t> ids;
+      if (add_special && tk.cls_id >= 0) ids.push_back(tk.cls_id);
+      for (const auto& w : basic_split(text, tk.lowercase)) wordpiece(tk, w, &ids);
+      int32_t budget = max_len - ((add_special && tk.sep_id >= 0) ? 1 : 0);
+      if (static_cast<int32_t>(ids.size()) > budget) ids.resize(budget);
+      if (add_special && tk.sep_id >= 0) ids.push_back(tk.sep_id);
+      int32_t L = static_cast<int32_t>(ids.size());
+      out_len[b] = L;
+      int32_t* row = out_ids + static_cast<int64_t>(b) * max_len;
+      int32_t* mrow = out_mask + static_cast<int64_t>(b) * max_len;
+      for (int32_t i = 0; i < max_len; ++i) {
+        row[i] = i < L ? ids[i] : tk.pad_id;
+        mrow[i] = i < L ? 1 : 0;
+      }
+    }
+  };
+  int32_t nt = std::max(1, std::min(n_threads, batch));
+  if (nt == 1) {
+    work(0, batch);
+  } else {
+    std::vector<std::thread> threads;
+    int32_t chunk = (batch + nt - 1) / nt;
+    for (int32_t t = 0; t < nt; ++t) {
+      int32_t lo = t * chunk, hi = std::min(batch, lo + chunk);
+      if (lo < hi) threads.emplace_back(work, lo, hi);
+    }
+    for (auto& th : threads) th.join();
+  }
+}
+
+}  // extern "C"

@@ -114,7 +114,7 @@ def is_compiled_with_cinn() -> bool:
     return False
 
 
-from ..core.place import IPUPlace, XPUPlace  # noqa: F401,E402
+from ..core.place import IPUPlace, MLUPlace, NPUPlace, XPUPlace  # noqa: F401,E402
 
 
 class Stream:

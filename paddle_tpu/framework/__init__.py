@@ -1,4 +1,14 @@
 """paddle.framework analog: io + core re-exports."""
 
-from .io import load, load_sharded, save, save_async, save_sharded, wait_async_saves  # noqa: F401
+from .io import (  # noqa: F401
+    auto_checkpoint_step,
+    disable_auto_checkpoint,
+    enable_auto_checkpoint,
+    load,
+    load_sharded,
+    save,
+    save_async,
+    save_sharded,
+    wait_async_saves,
+)
 from .random import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401

@@ -114,3 +114,62 @@ def load_sharded(directory: str, shardings: dict = None) -> dict:
             k: (jax.device_put(v, shardings[k]) if k in shardings else v) for k, v in restored.items()
         }
     return restored
+
+
+# ---- preemption-aware auto-checkpoint (SURVEY §5.3 TPU path) ----
+_auto_ckpt_state = {}
+
+
+def enable_auto_checkpoint(path: str, state_fn=None, layer=None, optimizer=None, every_n_steps: int = 0):
+    """Install a SIGTERM handler that snapshots training state before the
+    process dies (preemption on TPU VMs delivers SIGTERM), plus an optional
+    step-driven periodic save via `auto_checkpoint_step()`.
+
+    Reference analog: the elastic controller's teardown/save protocol
+    (fleet/elastic) — here checkpointing is owned by the training process so a
+    preempted slice resumes from the last published state.
+    """
+    import signal
+
+    def collect():
+        if state_fn is not None:
+            return state_fn()
+        state = {}
+        if layer is not None:
+            state["model"] = layer.state_dict()
+        if optimizer is not None and hasattr(optimizer, "state_dict"):
+            state["optimizer"] = optimizer.state_dict()
+        return state
+
+    def on_sigterm(signum, frame):
+        save(collect(), path)
+        prev = _auto_ckpt_state.get("prev_handler")
+        if callable(prev):
+            prev(signum, frame)
+        raise SystemExit(143)
+
+    _auto_ckpt_state.update(
+        path=path, collect=collect, every=every_n_steps, step=0,
+        prev_handler=signal.getsignal(signal.SIGTERM),
+    )
+    signal.signal(signal.SIGTERM, on_sigterm)
+
+
+def auto_checkpoint_step():
+    """Call once per training step: saves asynchronously every N steps when
+    enable_auto_checkpoint(..., every_n_steps=N) is active."""
+    st = _auto_ckpt_state
+    if not st or not st.get("every"):
+        return
+    st["step"] += 1
+    if st["step"] % st["every"] == 0:
+        save_async(st["collect"](), st["path"])
+
+
+def disable_auto_checkpoint():
+    import signal
+
+    if _auto_ckpt_state:
+        prev = _auto_ckpt_state.get("prev_handler")
+        signal.signal(signal.SIGTERM, prev if prev is not None else signal.SIG_DFL)
+        _auto_ckpt_state.clear()

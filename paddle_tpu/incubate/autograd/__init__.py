@@ -32,12 +32,9 @@ def prim_enabled() -> bool:
 
 
 def forward_grad(outputs, inputs, grad_inputs=None):
-    """Forward-mode AD (reference primapi.forward_grad): JVP of outputs wrt
-    inputs with tangents grad_inputs (defaults to ones)."""
-    from ...autograd import jvp as _jvp
-
-    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
-    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    """Forward-mode AD over captured static programs (reference
+    primapi.forward_grad) is not supported; use
+    paddle.incubate.autograd.jvp(func, xs, v) on a python function."""
     raise NotImplementedError(
         "forward_grad over captured static programs is not supported; use "
         "paddle.incubate.autograd.jvp(func, xs, v) on a python function"

@@ -192,15 +192,26 @@ class FusedEcMoe(Layer):
         if gate_logits is None:
             gate_logits = self.gate(x)
         act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[self.act_type]
+        num_experts = self.num_experts
 
         def f(xv, gv, w1, b1, w2, b2):
             B, S, H = xv.shape
-            probs = jax.nn.softmax(gv, -1)  # [B, S, E]
-            flat = xv.reshape(B * S, H)
-            h = jnp.einsum("th,ehi->eti", flat, w1) + b1
-            h = act(h)
-            out = jnp.einsum("eti,eih->eth", h, w2) + b2  # [E, T, H]
-            mixed = jnp.einsum("eth,te->th", out, probs.reshape(B * S, -1))
+            T = B * S
+            probs = jax.nn.softmax(gv.reshape(T, num_experts), -1)  # [T, E]
+            flat = xv.reshape(T, H)
+            # expert-choice routing: each expert picks its top-capacity tokens
+            # (Zhou et al.; the reference kernel's contract) — capacity 2T/E
+            capacity = max(1, min(T, (2 * T) // num_experts))
+            expert_scores = probs.T  # [E, T]
+            top_p, top_idx = jax.lax.top_k(expert_scores, capacity)  # [E, C]
+            chosen = flat[top_idx]  # [E, C, H] gathered per expert
+            h = act(jnp.einsum("ech,ehi->eci", chosen, w1) + b1)
+            out = jnp.einsum("eci,eih->ech", h, w2) + b2  # [E, C, H]
+            # combine: scatter-add each expert's outputs back, weighted by prob
+            weighted = out * top_p[..., None]
+            mixed = jnp.zeros((T, H), xv.dtype)
+            for e in range(num_experts):  # E is small and static; unrolled adds fuse
+                mixed = mixed.at[top_idx[e]].add(weighted[e])
             return mixed.reshape(B, S, H)
 
         return apply(

@@ -26,15 +26,21 @@ def graph_send_recv(x, src_index, dst_index, reduce_op="sum", out_size=None, nam
 
 def graph_khop_sampler(row, colptr, input_nodes, sample_sizes, sorted_eids=None, return_eids=False, name=None):
     """Multi-hop neighbor sampling (reference graph_khop_sampler): repeated
-    one-hop sampling with reindexing, host-side (data-prep op)."""
+    one-hop sampling with reindexing, host-side (data-prep op). Returns
+    (edge_src, edge_dst, sample_index, reindex_nodes) like the reference —
+    sample_index maps local ids back to global node ids, reindex_nodes are
+    the local ids of the input center nodes."""
     import numpy as np
 
     from ..core.tensor import Tensor
     from ..geometric.sampling import sample_neighbors
 
+    if return_eids:
+        raise NotImplementedError("return_eids=True is not supported yet")
     cur = input_nodes
     edge_src_list, edge_dst_list = [], []
-    all_nodes = [np.asarray(as_tensor(input_nodes)._value)]
+    input_np = np.asarray(as_tensor(input_nodes)._value)
+    all_nodes = [input_np]
     for size in sample_sizes:
         out_neighbors, out_count = sample_neighbors(row, colptr, cur, sample_size=size)
         nv = np.asarray(as_tensor(out_neighbors)._value)
@@ -47,11 +53,13 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes, sorted_eids=None,
     nodes = np.concatenate(all_nodes)
     uniq, first = np.unique(nodes, return_index=True)
     order = np.argsort(first, kind="stable")
-    final_nodes = uniq[order]
-    remap = {int(v): i for i, v in enumerate(final_nodes)}
+    sample_index = uniq[order]  # local id -> global node id
+    remap = {int(v): i for i, v in enumerate(sample_index)}
     src = np.asarray([remap[int(v)] for v in np.concatenate(edge_src_list)], np.int64)
     dst = np.asarray([remap[int(v)] for v in np.concatenate(edge_dst_list)], np.int64)
-    return Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)), Tensor(jnp.asarray(final_nodes))
+    reindex_nodes = np.asarray([remap[int(v)] for v in input_np], np.int64)
+    return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(sample_index)), Tensor(jnp.asarray(reindex_nodes)))
 
 
 def softmax_mask_fuse(x, mask, name=None):

@@ -18,7 +18,10 @@ class LookAhead:
         self.inner_optimizer = inner_optimizer
         self.alpha, self.k = alpha, k
         self._step_num = 0
-        self._slow = {}
+        # slow weights snapshot the INITIAL params (Zhang et al. / reference)
+        self._slow = {id(p): p._value for p in (
+            getattr(inner_optimizer, "_parameter_list", None)
+            or getattr(inner_optimizer, "_parameters", None) or [])}
 
     def __getattr__(self, item):
         return getattr(self.inner_optimizer, item)
@@ -32,9 +35,7 @@ class LookAhead:
         self._step_num += 1
         if self._step_num % self.k == 0:
             for p in self._params():
-                slow = self._slow.get(id(p))
-                if slow is None:
-                    slow = p._value
+                slow = self._slow.setdefault(id(p), p._value)
                 new_slow = slow + self.alpha * (p._value - slow)
                 self._slow[id(p)] = new_slow
                 p._set_value_raw(new_slow)

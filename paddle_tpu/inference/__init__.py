@@ -1,7 +1,29 @@
-from .predictor import Config, Predictor, PredictorPool, convert_to_mixed_precision, create_predictor, get_version
+from .predictor import (
+    Config,
+    DataType,
+    PlaceType,
+    PrecisionType,
+    Predictor,
+    PredictorPool,
+    _get_phi_kernel_name,
+    convert_to_mixed_precision,
+    create_predictor,
+    get_num_bytes_of_data_type,
+    get_trt_compile_version,
+    get_trt_runtime_version,
+    get_version,
+)
+from ..core.tensor import Tensor  # noqa: F401  (paddle.inference.Tensor handle)
 
 __all__ = [
     "Config",
+    "DataType",
+    "PlaceType",
+    "PrecisionType",
+    "Tensor",
+    "get_num_bytes_of_data_type",
+    "get_trt_compile_version",
+    "get_trt_runtime_version",
     "Predictor",
     "PredictorPool",
     "create_predictor",

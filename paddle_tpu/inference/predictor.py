@@ -175,3 +175,56 @@ def get_version() -> str:
 
 def convert_to_mixed_precision(*args, **kwargs):
     raise NotImplementedError("use bfloat16 layers at save time; XLA handles mixed precision")
+
+
+class DataType:
+    """Tensor element types (reference paddle_infer::DataType)."""
+
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+    BOOL = 7
+
+
+class PlaceType:
+    """Device kinds (reference paddle_infer::PlaceType; XPU here = TPU)."""
+
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+class PrecisionType:
+    """Precision modes (reference AnalysisConfig::Precision). kHalf maps to
+    bf16 on TPU — the MXU-native reduced precision."""
+
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    sizes = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4, DataType.UINT8: 1,
+             DataType.INT8: 1, DataType.FLOAT16: 2, DataType.BFLOAT16: 2, DataType.BOOL: 1}
+    return sizes.get(dtype, 4)
+
+
+def get_trt_compile_version():
+    """No TensorRT on TPU; subgraph offload is XLA itself."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name: str) -> str:
+    """Kernel-name mapping survives as identity: ops lower to XLA, not PHI."""
+    return op_name

@@ -51,7 +51,7 @@ def _np_dtype(name: str):
 
 def build(force: bool = False) -> str:
     """Compile the native library if missing/stale. Returns the .so path."""
-    srcs = [os.path.join(_SRC, f) for f in ("data_pipeline.cc", "checkpoint.cc")]
+    srcs = [os.path.join(_SRC, f) for f in ("data_pipeline.cc", "checkpoint.cc", "tokenizer.cc")]
     hdrs = [os.path.join(_SRC, "blocking_queue.h")]
     if not force and os.path.exists(_LIB_PATH):
         newest_src = max(os.path.getmtime(p) for p in srcs + hdrs)
@@ -287,3 +287,75 @@ def load_tensors(path: str) -> Dict[str, np.ndarray]:
         return out
     finally:
         lib.ckpt_close(h)
+
+
+# ---- native WordPiece tokenizer (tokenizer.cc) ----
+class FastWordPieceTokenizer:
+    """C++ WordPiece tokenizer (the reference's faster_tokenizer host-op
+    analog): greedy longest-match over a vocab, batch-parallel threads,
+    emits padded int32 [batch, max_len] ids + attention mask."""
+
+    def __init__(self, vocab, unk_token="[UNK]", cls_token="[CLS]", sep_token="[SEP]",
+                 pad_token="[PAD]", lowercase=True):
+        lib = _load()
+        lib.pt_tokenizer_create.restype = ctypes.c_void_p
+        lib.pt_tokenizer_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int32,
+        ]
+        lib.pt_tokenizer_destroy.argtypes = [ctypes.c_void_p]
+        lib.pt_tokenizer_encode_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        if isinstance(vocab, dict):
+            items = sorted(vocab.items(), key=lambda kv: kv[1])
+            tokens = [k for k, _ in items]
+        else:
+            tokens = list(vocab)
+        self._tokens = tokens
+        self.vocab = {t: i for i, t in enumerate(tokens)}
+        arr = (ctypes.c_char_p * len(tokens))(*[t.encode() for t in tokens])
+        self._lib = lib
+        self._handle = lib.pt_tokenizer_create(
+            arr, len(tokens), unk_token.encode(), cls_token.encode(),
+            sep_token.encode(), pad_token.encode(), 1 if lowercase else 0,
+        )
+
+    def __call__(self, texts, max_len: int = 128, add_special_tokens: bool = True, n_threads: int = 4):
+        if isinstance(texts, str):
+            texts = [texts]
+        enc = [t.encode() for t in texts]
+        buf = b"".join(enc)
+        offsets = np.zeros(len(enc) + 1, np.int64)
+        np.cumsum([len(e) for e in enc], out=offsets[1:])
+        batch = len(enc)
+        ids = np.zeros((batch, max_len), np.int32)
+        mask = np.zeros((batch, max_len), np.int32)
+        lens = np.zeros(batch, np.int32)
+        self._lib.pt_tokenizer_encode_batch(
+            self._handle, buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            batch, max_len, 1 if add_special_tokens else 0, n_threads,
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            mask.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return {"input_ids": ids, "attention_mask": mask, "lengths": lens}
+
+    def decode(self, ids):
+        toks = [self._tokens[i] for i in np.asarray(ids).reshape(-1) if 0 <= i < len(self._tokens)]
+        out = []
+        for t in toks:
+            if t.startswith("##") and out:
+                out[-1] += t[2:]
+            else:
+                out.append(t)
+        return " ".join(out)
+
+    def __del__(self):
+        try:
+            self._lib.pt_tokenizer_destroy(self._handle)
+        except Exception:
+            pass

@@ -21,7 +21,9 @@ from .search import *  # noqa: F401,F403
 from .math import sum, max, min, all, any, abs  # noqa: F401,A004
 from .manipulation import slice  # noqa: F401,A004
 
-_METHOD_SOURCES = (math, linalg, manipulation, logic, search, creation)
+from . import compat  # noqa: E402
+
+_METHOD_SOURCES = (math, linalg, manipulation, logic, search, creation, compat)
 
 _METHOD_NAMES = """
 add subtract multiply divide floor_divide mod remainder pow maximum minimum fmax fmin
@@ -42,6 +44,12 @@ logical_xor logical_not bitwise_and bitwise_or bitwise_xor bitwise_not equal_all
 allclose isclose isnan isinf isfinite is_empty topk sort argsort searchsorted
 bucketize kthvalue mode zeros_like ones_like full_like clone numel multiplex
 diag tril triu atan2 heaviside trunc stanh
+cov corrcoef cond eigvalsh increment nan_to_num add_n floor_mod broadcast_shape
+is_tensor reverse scatter_nd shard_index vsplit hsplit dsplit tensordot stack
+nanquantile is_complex is_integer is_floating_point rank broadcast_tensors
+multi_dot cholesky_solve triangular_solve lu lu_unpack gcd lcm diff sgn frexp
+trapezoid cumulative_trapezoid polar vander nextafter sigmoid create_tensor
+uniform_ exponential_ squeeze_ unsqueeze_ tanh_ index_add_
 """.split()
 
 
@@ -63,6 +71,9 @@ def _bind_tensor_methods():
     reg["__getitem__"] = manipulation.getitem
     reg["__setitem__"] = manipulation.setitem
     reg["t"] = linalg.t
+    reg["create_parameter"] = lambda self, shape, dtype=None, **kw: compat.create_parameter(
+        shape, dtype if dtype is not None else self.dtype, **kw
+    )
 
     # paddle-style trailing-underscore in-place variants for the common math ops
     def _make_inplace(fname):
@@ -83,6 +94,12 @@ def _bind_tensor_methods():
         "exp",
         "sqrt",
         "rsqrt",
+        "remainder",
+        "flatten",
+        "lerp",
+        "erfinv",
+        "put_along_axis",
+        "sigmoid",
         "reciprocal",
         "round",
         "floor",

@@ -319,3 +319,14 @@ def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
     shape = (shape,) if isinstance(shape, int) else shape
     key = jax.random.PRNGKey(seed) if seed else _key()
     return Tensor(jax.random.normal(key, shape, jdtype(dtype)) * std + mean)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """Empty typed tensor handle (reference paddle.create_tensor)."""
+    from ._dispatch import jdtype
+
+    t = Tensor(jnp.zeros((), jdtype(dtype)))
+    if name:
+        t.name = name
+    t.persistable = persistable
+    return t

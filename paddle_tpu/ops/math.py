@@ -431,3 +431,26 @@ def vander(x, n=None, increasing=False, name=None):
         return v[:, None] ** powers[None, :]
 
     return apply("vander", f, x)
+
+
+@register_op("sigmoid")
+def sigmoid(x, name=None):
+    return apply("sigmoid", jax.nn.sigmoid, as_tensor(x))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    """In-place uniform refill (reference tensor.uniform_)."""
+    from ..core import random as _random
+
+    key = _random.next_key()
+    out = apply("uniform_", lambda xv: jax.random.uniform(key, xv.shape, xv.dtype, min, max), as_tensor(x))
+    return x._inplace_from(out)
+
+
+def exponential_(x, lam=1.0, name=None):
+    """In-place Exponential(lam) refill (reference tensor.exponential_)."""
+    from ..core import random as _random
+
+    key = _random.next_key()
+    out = apply("exponential_", lambda xv: (jax.random.exponential(key, xv.shape, xv.dtype) / lam), as_tensor(x))
+    return x._inplace_from(out)

@@ -16,10 +16,17 @@ def get_image_backend() -> str:
 
 
 def image_load(path, backend=None):
-    """Load an image file as an HWC numpy array (reference vision.image_load;
-    PIL backend — cv2 is not in this image)."""
+    """Load an image file (reference vision.image_load). backend 'pil' (the
+    default here) returns a PIL.Image like the reference; 'numpy' returns an
+    HWC uint8 array. cv2 is not available in this image."""
     import numpy as np
     from PIL import Image
 
-    return np.asarray(Image.open(path))
+    backend = backend or ("pil" if _image_backend == "numpy" else _image_backend)
+    img = Image.open(path)
+    if backend == "pil":
+        return img
+    if backend == "numpy":
+        return np.asarray(img)
+    raise ValueError(f"unsupported image backend {backend!r}; use 'pil' or 'numpy'")
 

@@ -182,3 +182,28 @@ def test_sharded_checkpoint_reshard(tmp_path):
     back = load_sharded(d, {"w": NamedSharding(mesh, P("x", None))})
     np.testing.assert_allclose(np.asarray(back["w"]), state["w"].numpy())
     assert back["w"].sharding.spec == P("x", None)
+
+
+def test_auto_checkpoint_periodic_and_sigterm(tmp_path):
+    import signal
+
+    path = str(tmp_path / "auto.pdparams")
+    net = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.Adam(parameters=net.parameters())
+    paddle.framework.enable_auto_checkpoint(path, layer=net, optimizer=opt, every_n_steps=2)
+    try:
+        for _ in range(2):
+            net(paddle.ones([2, 4])).sum().backward()
+            opt.step()
+            opt.clear_grad()
+            paddle.framework.auto_checkpoint_step()
+        paddle.framework.wait_async_saves()
+        assert os.path.exists(path)
+        os.remove(path)
+        with pytest.raises(SystemExit):
+            signal.raise_signal(signal.SIGTERM)
+        assert os.path.exists(path)
+        state = paddle.load(path)
+        assert "model" in state and "optimizer" in state
+    finally:
+        paddle.framework.disable_auto_checkpoint()

@@ -91,3 +91,30 @@ def test_checkpoint_detects_corruption(tmp_path):
     open(path, "wb").write(bytes(raw))
     with pytest.raises(OSError):
         native.load_tensors(path)
+
+
+def test_fast_wordpiece_tokenizer():
+    import numpy as np
+
+    import paddle_tpu.native as nat
+
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "hello", "world", "un", "##aff", "##able", "!"]
+    tok = nat.FastWordPieceTokenizer(vocab)
+    out = tok(["Hello world!", "unaffable", "zzz"], max_len=8)
+    assert out["input_ids"].shape == (3, 8)
+    assert out["input_ids"][0].tolist()[:5] == [2, 4, 5, 9, 3]
+    assert out["input_ids"][1].tolist()[:5] == [2, 6, 7, 8, 3]
+    assert out["input_ids"][2].tolist()[:3] == [2, 1, 3]  # unknown word -> UNK
+    np.testing.assert_array_equal(out["attention_mask"][0][:5], 1)
+    np.testing.assert_array_equal(out["attention_mask"][0][5:], 0)
+    assert tok.decode(out["input_ids"][1][1:4]) == "unaffable"
+
+
+def test_tokenizer_truncation_and_threads():
+    import paddle_tpu.native as nat
+
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "a"]
+    tok = nat.FastWordPieceTokenizer(vocab)
+    out = tok(["a " * 50] * 16, max_len=8, n_threads=4)
+    assert (out["lengths"] == 8).all()
+    assert (out["input_ids"][:, -1] == 3).all()  # SEP kept after truncation
