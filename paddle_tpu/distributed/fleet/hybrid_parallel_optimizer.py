@@ -35,17 +35,37 @@ class HybridParallelOptimizer:
         self._inner_opt = optimizer
         self._hcg = hcg
         self._strategy = strategy
+        # gradient merge (fleet meta-optimizer analog): accumulate k steps of
+        # grads, apply once — micro-batch accumulation without pipeline
+        self._merge_k = 1
+        if strategy is not None and getattr(strategy, "gradient_merge", False):
+            self._merge_k = int(strategy.gradient_merge_configs.get("k_steps", 1))
+        self._merge_i = 0
         if optimizer._grad_clip is not None and not isinstance(optimizer._grad_clip, HybridParallelClipGrad):
             optimizer._grad_clip = HybridParallelClipGrad(optimizer._grad_clip, hcg)
 
     def step(self):
+        if self._merge_k > 1:
+            self._merge_i += 1
+            if self._merge_i % self._merge_k:
+                return None  # keep accumulating (grads live on the params)
+            # average the accumulated grads so lr semantics match single-step
+            for p in (getattr(self._inner_opt, "_parameter_list", None)
+                      or getattr(self._inner_opt, "_parameters", None) or []):
+                if getattr(p, "grad", None) is not None:
+                    p.grad._set_value_raw(p.grad._value / self._merge_k)
         return self._inner_opt.step()
 
     def clear_grad(self, *args, **kwargs):
+        if self._merge_k > 1 and self._merge_i % self._merge_k:
+            return None  # mid-accumulation: keep grads
         return self._inner_opt.clear_grad(*args, **kwargs)
 
     def minimize(self, loss, *args, **kwargs):
-        return self._inner_opt.minimize(loss, *args, **kwargs)
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
 
     def state_dict(self):
         return self._inner_opt.state_dict()

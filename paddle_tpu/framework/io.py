@@ -67,18 +67,21 @@ _async_threads = []
 
 def save_async(obj, path: str):
     """Non-blocking save: snapshot to host immediately, write in background —
-    the preemption-aware autocheckpoint building block."""
+    the preemption-aware autocheckpoint building block. Concurrent saves to
+    the same path are safe: each writes a unique tmp file and atomically
+    publishes it."""
     payload = {"magic": _SAVE_MAGIC, "obj": _to_payload(obj)}  # host copy NOW
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}.{len(_async_threads)}"
 
     def _write():
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        with open(path + ".tmp", "wb") as f:
+        with open(tmp, "wb") as f:
             pickle.dump(payload, f, protocol=4)
-        os.replace(path + ".tmp", path)  # atomic publish
+        os.replace(tmp, path)  # atomic publish
 
-    t = threading.Thread(target=_write, daemon=False)
+    t = threading.Thread(target=_write, daemon=True)  # unique tmp => safe to drop at exit
     t.start()
     _async_threads.append(t)
     return t
@@ -142,6 +145,7 @@ def enable_auto_checkpoint(path: str, state_fn=None, layer=None, optimizer=None,
         return state
 
     def on_sigterm(signum, frame):
+        wait_async_saves()  # let in-flight periodic saves publish first
         save(collect(), path)
         prev = _auto_ckpt_state.get("prev_handler")
         if callable(prev):
@@ -163,7 +167,12 @@ def auto_checkpoint_step():
         return
     st["step"] += 1
     if st["step"] % st["every"] == 0:
-        save_async(st["collect"](), st["path"])
+        # don't stack saves: if the previous interval's write is still in
+        # flight, skip this one (the next interval will publish fresher state)
+        prev = st.get("inflight")
+        if prev is not None and prev.is_alive():
+            return
+        st["inflight"] = save_async(st["collect"](), st["path"])
 
 
 def disable_auto_checkpoint():

@@ -1,5 +1,15 @@
 """Model zoo: flagship training fixtures (PaddleNLP / test-fixture analogs)."""
 
+from .ernie import (  # noqa: F401
+    ERNIE_BASE,
+    ERNIE_TINY,
+    ErnieConfig,
+    ErnieForPretraining,
+    ErnieForSequenceClassification,
+    ErnieModel,
+    ernie_base,
+    ernie_tiny,
+)
 from .gpt import GPT3_1p3B, GPT_TINY, GPTConfig, GPTForCausalLM, GPTModel, gpt_tiny  # noqa: F401
 from .bert import (  # noqa: F401
     BERT_BASE,

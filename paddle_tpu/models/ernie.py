@@ -1,0 +1,121 @@
+"""ERNIE model family (BASELINE config 3: ERNIE-3.0 pretraining, mp_degree=4).
+
+Reference analog: PaddleNLP's ErnieModel — a BERT-style encoder with an extra
+task-type embedding and ERNIE's masking-centric pretraining heads. Built on
+the same TP-aware encoder stack as models/bert.py (VocabParallelEmbedding +
+Column/RowParallelLinear seams), so `fleet` tensor parallelism and the
+sharded train-step builder apply unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn.layer.layers import Layer
+from .bert import BertConfig, BertLayer, BertPooler
+
+
+@dataclass
+class ErnieConfig(BertConfig):
+    task_type_vocab_size: int = 3
+    use_task_id: bool = True
+
+
+ERNIE_BASE = dict(vocab_size=40000, hidden_size=768, num_layers=12, num_heads=12)
+ERNIE_TINY = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4, max_position_embeddings=64)
+
+
+class ErnieEmbeddings(Layer):
+    """BERT embeddings + ERNIE's task-type embedding (reference ErnieModel)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        from ..distributed.fleet.meta_parallel.mp_layers import VocabParallelEmbedding
+
+        self.word_embeddings = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size, cfg.hidden_size)
+        if cfg.use_task_id:
+            self.task_type_embeddings = nn.Embedding(cfg.task_type_vocab_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.dropout)
+        self._use_task_id = cfg.use_task_id
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, task_type_ids=None):
+        import paddle_tpu as paddle
+
+        if position_ids is None:
+            position_ids = paddle.arange(input_ids.shape[1]).unsqueeze(0)
+        h = self.word_embeddings(input_ids) + self.position_embeddings(position_ids)
+        if token_type_ids is not None:
+            h = h + self.token_type_embeddings(token_type_ids)
+        if self._use_task_id:
+            if task_type_ids is None:
+                task_type_ids = paddle.zeros_like(input_ids)
+            h = h + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(h))
+
+
+class ErnieModel(Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        self.encoder = nn.LayerList([BertLayer(cfg) for _ in range(cfg.num_layers)])
+        self.pooler = BertPooler(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, position_ids=None, task_type_ids=None):
+        h = self.embeddings(input_ids, token_type_ids, position_ids, task_type_ids)
+        for blk in self.encoder:
+            h = blk(h, attention_mask)
+        return h, self.pooler(h)
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, cfg.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, task_type_ids=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, attention_mask, task_type_ids=task_type_ids)
+        return self.classifier(self.dropout(pooled))
+
+    def loss(self, logits, labels):
+        return nn.functional.cross_entropy(logits, labels)
+
+
+class ErnieForPretraining(Layer):
+    """MLM + sentence-order heads (ERNIE pretraining objective)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        from .bert import BertLMHead
+
+        self.ernie = ErnieModel(cfg)
+        self.lm_head = BertLMHead(cfg, self.ernie.embeddings.word_embeddings)
+        self.sop_head = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, task_type_ids=None):
+        seq, pooled = self.ernie(input_ids, token_type_ids, attention_mask, task_type_ids=task_type_ids)
+        return self.lm_head(seq), self.sop_head(pooled)
+
+    def loss(self, outputs, labels):
+        """labels = (mlm_labels with -100 ignore, sop_labels)."""
+        mlm_logits, sop_logits = outputs
+        mlm_labels, sop_labels = labels
+        mlm = nn.functional.cross_entropy(
+            mlm_logits.reshape([-1, mlm_logits.shape[-1]]), mlm_labels.reshape([-1]), ignore_index=-100
+        )
+        sop = nn.functional.cross_entropy(sop_logits, sop_labels)
+        return mlm + sop
+
+
+def ernie_base(**overrides) -> ErnieForSequenceClassification:
+    return ErnieForSequenceClassification(ErnieConfig(**{**ERNIE_BASE, **overrides}))
+
+
+def ernie_tiny(**overrides) -> ErnieForSequenceClassification:
+    return ErnieForSequenceClassification(ErnieConfig(**{**ERNIE_TINY, **overrides}))

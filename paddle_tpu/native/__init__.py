@@ -311,12 +311,16 @@ class FastWordPieceTokenizer:
             ctypes.POINTER(ctypes.c_int32),
         ]
         if isinstance(vocab, dict):
-            items = sorted(vocab.items(), key=lambda kv: kv[1])
-            tokens = [k for k, _ in items]
+            # preserve the caller's ids exactly: position in the C-side table IS
+            # the emitted id, so fill gaps with unmatchable placeholders
+            max_id = max(vocab.values())
+            tokens = [f"\x00unused{i}" for i in range(max_id + 1)]
+            for tok_str, tok_id in vocab.items():
+                tokens[tok_id] = tok_str
         else:
             tokens = list(vocab)
         self._tokens = tokens
-        self.vocab = {t: i for i, t in enumerate(tokens)}
+        self.vocab = {t: i for i, t in enumerate(tokens) if not t.startswith("\x00unused")}
         arr = (ctypes.c_char_p * len(tokens))(*[t.encode() for t in tokens])
         self._lib = lib
         self._handle = lib.pt_tokenizer_create(

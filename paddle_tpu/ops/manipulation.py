@@ -524,6 +524,11 @@ def hsplit(x, num_or_indices, name=None):
 
 
 @register_op("vsplit")
+def dsplit(x, num_or_sections, name=None):
+    """Split along axis 2 (reference paddle.dsplit)."""
+    return split(x, num_or_sections, axis=2)
+
+
 def vsplit(x, num_or_indices, name=None):
     return tensor_split(x, num_or_indices, axis=0)
 

@@ -439,10 +439,11 @@ def sigmoid(x, name=None):
 
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
-    """In-place uniform refill (reference tensor.uniform_)."""
+    """In-place uniform refill (reference tensor.uniform_); seed != 0 gives a
+    deterministic fill independent of the framework RNG stream."""
     from ..core import random as _random
 
-    key = _random.next_key()
+    key = jax.random.key(seed) if seed else _random.next_key()
     out = apply("uniform_", lambda xv: jax.random.uniform(key, xv.shape, xv.dtype, min, max), as_tensor(x))
     return x._inplace_from(out)
 

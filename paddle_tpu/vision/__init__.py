@@ -2,11 +2,14 @@ from . import datasets, models, ops, transforms  # noqa: F401
 
 __all__ = ["datasets", "models", "ops", "transforms", "set_image_backend", "get_image_backend"]
 
-_image_backend = "numpy"
+_image_backend = "pil"
 
 
 def set_image_backend(backend: str):
-    """Reference supports pil/cv2; this build is numpy-native (no PIL dep)."""
+    """Reference supports pil/cv2; this build supports pil (default, like the
+    reference) and numpy (arrays). cv2 is not available in this image."""
+    if backend not in ("pil", "numpy"):
+        raise ValueError(f"unsupported image backend {backend!r}; use 'pil' or 'numpy'")
     global _image_backend
     _image_backend = backend
 
@@ -22,7 +25,7 @@ def image_load(path, backend=None):
     import numpy as np
     from PIL import Image
 
-    backend = backend or ("pil" if _image_backend == "numpy" else _image_backend)
+    backend = backend or _image_backend
     img = Image.open(path)
     if backend == "pil":
         return img

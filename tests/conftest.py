@@ -31,3 +31,18 @@ def _reseed():
 
     paddle_tpu.seed(2024)
     yield
+
+
+# hang watchdog: if any single test runs >8 min, dump every thread's stack and
+# abort the process instead of stalling the whole run (converts intermittent
+# environment hangs into diagnosable failures).
+import faulthandler  # noqa: E402
+
+_WATCHDOG_SECS = 480
+
+
+@pytest.fixture(autouse=True)
+def _hang_watchdog():
+    faulthandler.dump_traceback_later(_WATCHDOG_SECS, exit=True)
+    yield
+    faulthandler.cancel_dump_traceback_later()

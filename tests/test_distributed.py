@@ -429,3 +429,27 @@ def test_rng_tracker():
     with get_rng_state_tracker().rng_state():
         a2 = paddle.randn([4]).numpy()
     np.testing.assert_allclose(a, a2)  # reseeding replays
+
+
+def test_gradient_merge_accumulates_k_steps():
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 3}
+    fleet.init(is_collective=True, strategy=strategy)
+    lin = paddle.nn.Linear(4, 1, bias_attr=False)
+    w0 = np.asarray(lin.weight._value).copy()
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters()), strategy
+    )
+    x = paddle.ones([1, 4])
+    for _ in range(2):
+        lin(x).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        np.testing.assert_allclose(np.asarray(lin.weight._value), w0)
+    lin(x).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    np.testing.assert_allclose(np.asarray(lin.weight._value), w0 - 0.1, rtol=1e-5)
