@@ -111,20 +111,43 @@ class StaticFunction:
             return self._function(*args, **kwargs)
         if any(isinstance(getattr(a, "_value", a), jax.core.Tracer) for a in args):
             return self._function(*args, **kwargs)  # already under a trace: inline
+        if getattr(self, "_eager_fallback", False):
+            return self._function(*args, **kwargs)
         raw = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
         self._seed_counter = getattr(self, "_seed_counter", 0) + 1
         seed = jnp.uint32(self._seed_counter)
-        if self._layer is not None:
-            params, buffers = self._layer.functional_state()
-            jitted = self._traced(self._layer, len(raw))
-            out, new_buffers = jitted(params, buffers, seed, *raw)
-            named = dict(self._layer.named_buffers())
-            for name, val in new_buffers.items():
-                if name in named and named[name] is not None:
-                    named[name]._set_value_raw(val)
-        else:
-            jitted = self._traced(None, len(raw))
-            out = jitted(seed, *raw)
+        try:
+            if self._layer is not None:
+                params, buffers = self._layer.functional_state()
+                jitted = self._traced(self._layer, len(raw))
+                out, new_buffers = jitted(params, buffers, seed, *raw)
+                named = dict(self._layer.named_buffers())
+                for name, val in new_buffers.items():
+                    if name in named and named[name] is not None:
+                        named[name]._set_value_raw(val)
+            else:
+                jitted = self._traced(None, len(raw))
+                out = jitted(seed, *raw)
+        except (
+            jax.errors.TracerBoolConversionError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.TracerIntegerConversionError,
+            jax.errors.ConcretizationTypeError,
+        ):
+            # data-dependent python control flow: the reference's dy2static
+            # rewrites the AST; here the escape hatch is eager execution
+            # (correct, uncompiled) — cached so we don't re-trace every call
+            import warnings
+
+            warnings.warn(
+                f"to_static: '{getattr(self._function, '__name__', '?')}' uses "
+                "data-dependent Python control flow; falling back to eager "
+                "execution (use paddle.where/lax.cond-style ops to compile)",
+                stacklevel=2,
+            )
+            self._eager_fallback = True
+            self._jit_cache.clear()
+            return self._function(*args, **kwargs)
         return jax.tree_util.tree_map(
             lambda v: Tensor(v) if isinstance(v, jnp.ndarray) else v, out
         )

@@ -363,8 +363,31 @@ class AdamW(Adam):
         if param_meta is not None and self._apply_decay_param_fun is not None:
             if not self._apply_decay_param_fun(param_meta.name):
                 decay = 0.0
+        if self._use_fused_kernel(value):
+            from ..kernels.fused_optim import fused_adamw_update
+
+            b1p = state["beta1_pow"] * self._beta1
+            b2p = state["beta2_pow"] * self._beta2
+            new, m, v = fused_adamw_update(
+                value, grad.astype(jnp.float32), state["moment1"], state["moment2"],
+                lr=lr, beta1=self._beta1, beta2=self._beta2, eps=self._epsilon,
+                weight_decay=decay, beta1_pow=b1p, beta2_pow=b2p,
+            )
+            # keep state dtypes stable across paths (scan carries + checkpoints)
+            m = m.astype(state["moment1"].dtype)
+            v = v.astype(state["moment2"].dtype)
+            return new, {**state, "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
         value = value * (1.0 - lr * decay)
         return super()._update(value, grad, state, lr, param_meta)
+
+    def _use_fused_kernel(self, value) -> bool:
+        # one fused HBM pass for big tensors on TPU (fused_adam_kernel.cu analog)
+        from ..core.flags import flag_value
+
+        if self._amsgrad or not flag_value("use_pallas_kernels"):
+            return False
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+        return on_tpu and value.size >= 1 << 16 and value.dtype in (jnp.float32, jnp.bfloat16)
 
 
 class Adamax(Optimizer):

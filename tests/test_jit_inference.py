@@ -91,3 +91,43 @@ def test_static_save_load_inference_model(tmp_path):
     assert in_names == ["x"]
     x = np.zeros((2, 4), np.float32)
     assert layer(paddle.to_tensor(x)).shape == [2, 2]
+
+
+def test_to_static_eager_fallback_on_control_flow():
+    import warnings
+
+    @paddle.jit.to_static
+    def fn(x):
+        if float(x.sum().numpy()) > 0:  # data-dependent python branch
+            return x * 2
+        return x - 1
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = fn(paddle.ones([2]))
+        np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+        out2 = fn(paddle.to_tensor(np.float32([-3.0, -3.0])))
+        np.testing.assert_allclose(out2.numpy(), [-4.0, -4.0])
+    assert any("control flow" in str(x.message) for x in w)
+
+
+def test_enable_to_static_toggle():
+    calls = []
+
+    @paddle.jit.to_static
+    def fn(x):
+        calls.append(1)
+        return x + 1
+
+    paddle.jit.enable_to_static(False)
+    try:
+        for _ in range(2):
+            out = fn(paddle.ones([2]))
+        np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+        # eager: the python body runs every call and nothing was jit-cached
+        assert len(calls) == 2
+        assert not fn._jit_cache
+    finally:
+        paddle.jit.enable_to_static(True)
+    fn(paddle.ones([2]))
+    assert fn._jit_cache  # compiled again once re-enabled

@@ -278,3 +278,28 @@ class TestReviewRegressions:
         w = paddle.ones([3, 6, 2, 2])
         out = F.conv2d_transpose(x, w, stride=2, output_size=[9, 9])
         assert out.shape == [1, 6, 9, 9]
+
+
+def test_fused_adamw_branch_matches_plain(monkeypatch):
+    """Force the Pallas fused branch (interpret mode on CPU) and compare one
+    step against the plain AdamW math."""
+    from paddle_tpu.optimizer.optimizer import AdamW
+
+    rng2 = np.random.default_rng(0)
+    w = rng2.normal(size=(8, 4)).astype(np.float32)
+    g = rng2.normal(size=(8, 4)).astype(np.float32)
+
+    def one_step(force_fused):
+        p = paddle.to_tensor(w.copy(), stop_gradient=False)
+        opt = AdamW(learning_rate=1e-2, weight_decay=0.01, parameters=[p])
+        if force_fused:
+            monkeypatch.setattr(AdamW, "_use_fused_kernel", lambda self, v: True)
+        else:
+            monkeypatch.setattr(AdamW, "_use_fused_kernel", lambda self, v: False)
+        p.grad = paddle.to_tensor(g.copy())
+        opt.step()
+        return np.asarray(p._value)
+
+    fused = one_step(True)
+    plain = one_step(False)
+    np.testing.assert_allclose(fused, plain, rtol=1e-5, atol=1e-6)
