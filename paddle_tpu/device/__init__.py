@@ -154,3 +154,11 @@ class stream_guard:
     def __exit__(self, *exc):
         set_stream(self._old)
         return False
+
+
+from . import plugin  # noqa: E402,F401
+from .plugin import (  # noqa: E402,F401
+    get_all_custom_device_type,
+    is_custom_device_available,
+    register_custom_device,
+)

@@ -1,0 +1,56 @@
+"""Custom-device plugin API: out-of-tree hardware backends.
+
+Reference surface: phi/backends/custom/ + phi/capi — a C ABI
+(C_DeviceInterface, device_ext.h:94) that out-of-tree backends implement and
+Paddle dlopens. The TPU-native equivalent IS the PJRT plugin contract: a
+backend ships a PJRT C-API shared library, jax loads it, and every op lowers
+through StableHLO — no per-op kernel ABI needed (the compiler is the ABI).
+
+This module is the registration surface: point it at a PJRT plugin .so and
+the device becomes a jax backend usable by the whole framework.
+"""
+
+from __future__ import annotations
+
+_registered = {}
+
+
+def register_custom_device(name: str, library_path: str, options: dict = None):
+    """Register an out-of-tree PJRT plugin as a named device backend.
+
+    The analog of dropping a CustomDevice .so into the reference's plugin dir
+    (phi/backends/custom/custom_device.cc load path).
+    """
+    try:  # jax keeps this in xla_bridge; the module path has moved across versions
+        from jax._src.xla_bridge import register_plugin
+    except ImportError:  # pragma: no cover - version-dependent fallback
+        try:
+            from jax.lib.xla_bridge import register_plugin  # older layout
+        except ImportError as e:
+            raise RuntimeError(
+                "this jax version exposes no PJRT plugin registration hook; "
+                "register the plugin via the jax_plugins entry-point mechanism instead"
+            ) from e
+
+    register_plugin(name, library_path=library_path, options=options or {})
+    _registered[name] = library_path
+    return name
+
+
+def list_custom_devices() -> list:
+    """Names of plugin-registered backends (fake/test doubles included)."""
+    return sorted(_registered)
+
+
+def get_all_custom_device_type() -> list:
+    """Reference API name (device/__init__.py get_all_custom_device_type)."""
+    return list_custom_devices()
+
+
+def is_custom_device_available(name: str) -> bool:
+    import jax
+
+    try:
+        return len(jax.devices(name)) > 0
+    except Exception:
+        return False

@@ -62,6 +62,7 @@ from .fleet_dataset import (  # noqa: F401
     ShowClickEntry,
 )
 from . import io  # noqa: F401
+from . import fleet_executor  # noqa: F401
 from .mesh import (  # noqa: F401
     build_mesh,
     get_global_mesh,

@@ -1,0 +1,199 @@
+"""FleetExecutor analog: an in-process actor micro-runtime.
+
+Reference surface: paddle/fluid/distributed/fleet_executor/ — a Carrier
+(carrier.h:50) hosts Interceptors (compute/amplifier/source/sink/cond)
+exchanging InterceptorMessage protos over a brpc MessageBus to run
+static-graph pipelines across ranks.
+
+TPU-native position: the *performance* path for pipeline parallelism is the
+compiled spmd_pipeline (fleet/meta_parallel) — XLA schedules the stages. This
+module keeps the actor-runtime *capability* for the reference's orchestration
+use cases (task DAGs around the compiled steps: data movement, eval loops,
+side effects): same Carrier/Interceptor/message model, queues instead of
+brpc, threads instead of ranks.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class InterceptorMessage:
+    src_id: int = -1
+    dst_id: int = -1
+    message_type: str = "DATA"  # DATA | DATA_IS_READY | DATA_IS_USELESS | STOP
+    payload: object = None
+    scope_idx: int = 0
+
+
+class Interceptor:
+    """Actor: consumes messages from its inbox, runs compute, emits downstream
+    (interceptor.h analog). Subclass or pass compute_fn(payload)->payload.
+    Fan-in nodes join: compute fires once per scope_idx after ALL upstreams
+    delivered (payloads passed as a list in upstream order)."""
+
+    def __init__(self, interceptor_id: int, compute_fn: Optional[Callable] = None, role: str = "compute"):
+        self.id = interceptor_id
+        self.role = role
+        self.compute_fn = compute_fn
+        self.downstream: List[int] = []
+        self.upstream: List[int] = []
+        self._carrier: Optional["Carrier"] = None
+        self._pending: Dict[int, dict] = {}  # scope_idx -> {src_id: payload}
+
+    def handle(self, msg: InterceptorMessage):
+        if msg.message_type == "STOP":
+            for d in self.downstream:
+                self._carrier.send(InterceptorMessage(self.id, d, "STOP"))
+            return False
+        n_up = len(self.upstream)
+        if n_up > 1:  # join: wait for every upstream's contribution
+            slot = self._pending.setdefault(msg.scope_idx, {})
+            slot[msg.src_id] = msg.payload
+            if len(slot) < n_up:
+                return True
+            payload = [slot[u] for u in self.upstream]
+            del self._pending[msg.scope_idx]
+        else:
+            payload = msg.payload
+        try:
+            out = self.compute_fn(payload) if self.compute_fn is not None else payload
+        except Exception as e:  # surface in run(); unblock downstream
+            self._carrier._errors.append((self.id, e))
+            for d in self.downstream:
+                self._carrier.send(InterceptorMessage(self.id, d, "STOP"))
+            return False
+        for d in self.downstream:
+            self._carrier.send(InterceptorMessage(self.id, d, "DATA", out, msg.scope_idx))
+        if self.role == "sink":
+            self._carrier._results.put((msg.scope_idx, out))
+        return True
+
+
+class SourceInterceptor(Interceptor):
+    def __init__(self, interceptor_id: int, generator):
+        super().__init__(interceptor_id, role="source")
+        self._generator = generator
+
+    def run(self):
+        for i, item in enumerate(self._generator):
+            for d in self.downstream:
+                self._carrier.send(InterceptorMessage(self.id, d, "DATA", item, i))
+        for d in self.downstream:
+            self._carrier.send(InterceptorMessage(self.id, d, "STOP"))
+
+
+@dataclass
+class TaskNode:
+    """Static description of one interceptor (task_node.h analog)."""
+
+    task_id: int
+    compute_fn: Optional[Callable] = None
+    role: str = "compute"
+    downstream: List[int] = field(default_factory=list)
+
+
+class Carrier:
+    """Hosts interceptors and the message bus (carrier.h:50). One thread per
+    interceptor; in-process queues replace brpc."""
+
+    def __init__(self):
+        self._interceptors: Dict[int, Interceptor] = {}
+        self._inboxes: Dict[int, "queue.Queue[InterceptorMessage]"] = {}
+        self._threads: List[threading.Thread] = []
+        self._results: "queue.Queue" = queue.Queue()
+        self._errors: List[tuple] = []
+        self._source: Optional[SourceInterceptor] = None
+
+    def add_interceptor(self, interceptor: Interceptor):
+        interceptor._carrier = self
+        self._interceptors[interceptor.id] = interceptor
+        self._inboxes[interceptor.id] = queue.Queue()
+        if isinstance(interceptor, SourceInterceptor):
+            self._source = interceptor
+        return interceptor
+
+    def connect(self, src_id: int, dst_id: int):
+        self._interceptors[src_id].downstream.append(dst_id)
+        self._interceptors[dst_id].upstream.append(src_id)
+
+    def send(self, msg: InterceptorMessage):
+        self._inboxes[msg.dst_id].put(msg)
+
+    def _run_interceptor(self, it: Interceptor):
+        stops = 0
+        n_up = max(1, len(it.upstream))
+        while True:
+            msg = self._inboxes[it.id].get()
+            if msg.message_type == "STOP":
+                stops += 1
+                if stops >= n_up:  # all upstreams drained
+                    it.handle(msg)
+                    return
+                continue
+            it.handle(msg)
+
+    def start(self):
+        for it in self._interceptors.values():
+            if it is self._source:
+                continue
+            t = threading.Thread(target=self._run_interceptor, args=(it,), daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self._source is not None:
+            t = threading.Thread(target=self._source.run, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def wait(self, timeout: float = 60.0):
+        """Join all interceptor threads against ONE shared deadline; raises
+        TimeoutError if any thread is still running when it expires."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        stuck = [t for t in self._threads if t.is_alive()]
+        if stuck:
+            raise TimeoutError(f"fleet_executor: {len(stuck)} interceptor thread(s) still running after {timeout}s")
+
+    def results(self) -> list:
+        out = []
+        while not self._results.empty():
+            out.append(self._results.get())
+        return [p for _, p in sorted(out, key=lambda x: x[0])]
+
+
+class FleetExecutor:
+    """Build a Carrier from TaskNodes and run a feed list through the DAG
+    (fleet_executor.h analog)."""
+
+    def __init__(self, task_nodes: List[TaskNode]):
+        self._ran = False
+        self.carrier = Carrier()
+        for node in task_nodes:
+            self.carrier.add_interceptor(Interceptor(node.task_id, node.compute_fn, node.role))
+        for node in task_nodes:
+            for d in node.downstream:
+                self.carrier.connect(node.task_id, d)
+        self._entry_ids = [n.task_id for n in task_nodes if not self.carrier._interceptors[n.task_id].upstream]
+
+    def run(self, feed: list, timeout: float = 60.0) -> list:
+        if self._ran:
+            raise RuntimeError("FleetExecutor.run is single-use; build a new executor per run "
+                               "(interceptor threads and DAG wiring are consumed)")
+        self._ran = True
+        src = SourceInterceptor(-1, iter(feed))
+        self.carrier.add_interceptor(src)
+        for eid in self._entry_ids:
+            self.carrier.connect(-1, eid)
+        self.carrier.start()
+        self.carrier.wait(timeout)
+        if self.carrier._errors:
+            node_id, err = self.carrier._errors[0]
+            raise RuntimeError(f"interceptor {node_id} failed: {err!r}") from err
+        return self.carrier.results()

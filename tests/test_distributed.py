@@ -453,3 +453,44 @@ def test_gradient_merge_accumulates_k_steps():
     opt.step()
     opt.clear_grad()
     np.testing.assert_allclose(np.asarray(lin.weight._value), w0 - 0.1, rtol=1e-5)
+
+
+def test_fleet_executor_actor_dag():
+    from paddle_tpu.distributed.fleet_executor import FleetExecutor, TaskNode
+
+    nodes = [
+        TaskNode(0, compute_fn=lambda x: x * 2, downstream=[1]),
+        TaskNode(1, compute_fn=lambda x: x + 1, downstream=[2]),
+        TaskNode(2, role="sink"),
+    ]
+    exe = FleetExecutor(nodes)
+    out = exe.run([1, 2, 3], timeout=10)
+    assert out == [3, 5, 7]
+
+
+def test_custom_device_plugin_surface():
+    assert paddle.device.get_all_custom_device_type() == []
+    assert not paddle.device.is_custom_device_available("nonexistent_backend")
+
+
+def test_fleet_executor_error_and_reuse_and_join():
+    from paddle_tpu.distributed.fleet_executor import FleetExecutor, TaskNode
+
+    # errors surface instead of hanging
+    exe = FleetExecutor([TaskNode(0, compute_fn=lambda x: 1 / x, downstream=[1]), TaskNode(1, role="sink")])
+    with pytest.raises(RuntimeError, match="interceptor 0 failed"):
+        exe.run([1, 0, 2], timeout=5)
+
+    # single-use guard
+    exe2 = FleetExecutor([TaskNode(0, role="sink")])
+    exe2.run([1], timeout=5)
+    with pytest.raises(RuntimeError, match="single-use"):
+        exe2.run([2], timeout=5)
+
+    # diamond fan-in joins once per item (payloads in upstream order)
+    nodes = [
+        TaskNode(0, compute_fn=lambda x: x + 1, downstream=[3]),
+        TaskNode(1, compute_fn=lambda x: x * 10, downstream=[3]),
+        TaskNode(3, compute_fn=lambda pair: pair[0] + pair[1], role="sink"),
+    ]
+    assert FleetExecutor(nodes).run([1, 2], timeout=10) == [12, 23]
