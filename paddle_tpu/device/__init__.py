@@ -158,7 +158,6 @@ class stream_guard:
 
 from . import plugin  # noqa: E402,F401
 from .plugin import (  # noqa: E402,F401
-    get_all_custom_device_type,
     is_custom_device_available,
     register_custom_device,
 )

@@ -42,11 +42,6 @@ def list_custom_devices() -> list:
     return sorted(_registered)
 
 
-def get_all_custom_device_type() -> list:
-    """Reference API name (device/__init__.py get_all_custom_device_type)."""
-    return list_custom_devices()
-
-
 def is_custom_device_available(name: str) -> bool:
     import jax
 

@@ -70,7 +70,7 @@ class Interceptor:
         for d in self.downstream:
             self._carrier.send(InterceptorMessage(self.id, d, "DATA", out, msg.scope_idx))
         if self.role == "sink":
-            self._carrier._results.put((msg.scope_idx, out))
+            self._carrier._results.put((msg.scope_idx, self.id, out))
         return True
 
 
@@ -80,11 +80,15 @@ class SourceInterceptor(Interceptor):
         self._generator = generator
 
     def run(self):
-        for i, item in enumerate(self._generator):
+        try:
+            for i, item in enumerate(self._generator):
+                for d in self.downstream:
+                    self._carrier.send(InterceptorMessage(self.id, d, "DATA", item, i))
+        except Exception as e:  # surface in run(); still unblock downstream
+            self._carrier._errors.append((self.id, e))
+        finally:
             for d in self.downstream:
-                self._carrier.send(InterceptorMessage(self.id, d, "DATA", item, i))
-        for d in self.downstream:
-            self._carrier.send(InterceptorMessage(self.id, d, "STOP"))
+                self._carrier.send(InterceptorMessage(self.id, d, "STOP"))
 
 
 @dataclass
@@ -135,7 +139,8 @@ class Carrier:
                     it.handle(msg)
                     return
                 continue
-            it.handle(msg)
+            if not it.handle(msg):  # compute error: this actor is done
+                return
 
     def start(self):
         for it in self._interceptors.values():
@@ -162,10 +167,11 @@ class Carrier:
             raise TimeoutError(f"fleet_executor: {len(stuck)} interceptor thread(s) still running after {timeout}s")
 
     def results(self) -> list:
+        """Sink outputs ordered deterministically by (scope_idx, sink_id)."""
         out = []
         while not self._results.empty():
             out.append(self._results.get())
-        return [p for _, p in sorted(out, key=lambda x: x[0])]
+        return [p for _, _, p in sorted(out, key=lambda x: (x[0], x[1]))]
 
 
 class FleetExecutor:
