@@ -8,7 +8,7 @@ hand-written kernels are Pallas. The public surface mirrors `import paddle`.
 
 from __future__ import annotations
 
-__version__ = "0.1.0"
+from .version import full_version as __version__  # noqa: E402  (single source)
 
 from .core import (  # noqa: F401
     CPUPlace,
@@ -112,6 +112,7 @@ from . import quantization  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from . import hub  # noqa: F401,E402
+from . import version  # noqa: F401,E402
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False):

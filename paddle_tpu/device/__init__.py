@@ -18,7 +18,12 @@ def get_all_device_type():
 
 
 def get_all_custom_device_type():
-    return [p for p in get_all_device_type() if p not in ("cpu", "gpu", "tpu")]
+    """Platform-scanned custom backends plus plugin-registered ones (the two
+    registration paths: jax_plugins entry points and register_custom_device)."""
+    from .plugin import list_custom_devices
+
+    scanned = [p for p in get_all_device_type() if p not in ("cpu", "gpu", "tpu")]
+    return sorted(set(scanned) | set(list_custom_devices()))
 
 
 def get_available_device():

@@ -1,0 +1,34 @@
+"""Version metadata (reference: python/paddle/version.py, generated at build).
+
+full_version mirrors the reference snapshot's generation (2.5-dev era) so
+version-gated user code (`paddle.version.full_version >= ...`) ports cleanly.
+"""
+
+full_version = "2.5.0+tpu"
+major = "2"
+minor = "5"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native-rebuild"
+with_mkl = "OFF"
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False
+
+
+def xpu():
+    return False
