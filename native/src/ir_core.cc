@@ -1,0 +1,557 @@
+// Native IR core: the TPU-native analog of the reference's paddle/ir
+// (ir/core/ir_context.h:34 IrContext, operation.h:23 Operation, value.h Value,
+// type.h/attribute.h with storage uniquing) plus the generic graph passes from
+// fluid/framework/ir (DCE, CSE — pass.h / graph_pattern_detector.h family).
+//
+// TPU-first design: the IR models a FLAT single-block program of primitive
+// ops over ranked tensor types — exactly the shape of a jaxpr — because the
+// program this framework optimizes before XLA compilation IS a jaxpr.
+// Sub-programs (scan/cond bodies) stay opaque Python-side attrs (py_token);
+// CSE treats them conservatively (equal only if the same object).
+//
+// Data model:
+//   IrContext  owns everything: interned strings, uniqued types, values, ops.
+//   Type       = (dtype code, shape) — uniqued, id-addressed.
+//   Value      = block argument | op result; tracks use_count (def-use).
+//   Operation  = interned name + operand value ids + result values + attrs
+//                (tagged union: i64/f64/str/i64-array) + side_effect flag,
+//                kept in creation (program) order with tombstone erasure.
+// C ABI only — bound via ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Type {
+  int32_t dtype;
+  std::vector<int64_t> shape;
+};
+
+struct Value {
+  int64_t id;
+  int64_t def_op;    // -1 for block arguments
+  int32_t def_index; // result index in def op, or block-arg position
+  int64_t type_id;
+  int64_t use_count = 0;
+};
+
+struct Attr {
+  int32_t key;         // interned string id
+  int32_t tag;         // 0=i64 1=f64 2=str 3=i64[]
+  int64_t i = 0;
+  double f = 0.0;
+  int32_t s = -1;      // interned string id
+  std::vector<int64_t> ia;
+};
+
+struct Operation {
+  int64_t id;
+  int32_t name;        // interned string id
+  std::vector<int64_t> operands;  // value ids
+  std::vector<int64_t> results;   // value ids
+  std::vector<Attr> attrs;
+  bool side_effect = false;
+  bool alive = true;
+};
+
+struct IrContext {
+  std::vector<std::string> strings;
+  std::unordered_map<std::string, int32_t> string_ids;
+  std::vector<Type> types;
+  std::map<std::pair<int32_t, std::vector<int64_t>>, int64_t> type_ids;
+  std::vector<Value> values;
+  std::vector<Operation> ops;          // program order (with tombstones)
+  std::vector<int64_t> block_args;     // value ids
+  std::vector<int64_t> outputs;        // value ids
+  std::string print_buf;
+
+  int32_t Intern(const char* s) {
+    auto it = string_ids.find(s);
+    if (it != string_ids.end()) return it->second;
+    int32_t id = static_cast<int32_t>(strings.size());
+    strings.emplace_back(s);
+    string_ids.emplace(strings.back(), id);
+    return id;
+  }
+};
+
+IrContext* Ctx(void* p) { return static_cast<IrContext*>(p); }
+
+bool ValidValue(IrContext* c, int64_t v) {
+  return v >= 0 && v < static_cast<int64_t>(c->values.size());
+}
+bool ValidOp(IrContext* c, int64_t o) {
+  return o >= 0 && o < static_cast<int64_t>(c->ops.size()) && c->ops[o].alive;
+}
+// read accessors accept tombstoned ops (wrappers may outlive erasure) but
+// must never index out of range
+bool OpInRange(IrContext* c, int64_t o) {
+  return o >= 0 && o < static_cast<int64_t>(c->ops.size());
+}
+bool ValidType(IrContext* c, int64_t t) {
+  return t >= 0 && t < static_cast<int64_t>(c->types.size());
+}
+bool ValidAttr(IrContext* c, int64_t o, int32_t i) {
+  return OpInRange(c, o) && i >= 0 &&
+         i < static_cast<int32_t>(c->ops[o].attrs.size());
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ir_ctx_create() { return new IrContext(); }
+void ir_ctx_destroy(void* p) { delete Ctx(p); }
+
+// ---- types (uniqued, like paddle/ir TypeStorage + IrContext::RegisterType) ----
+int64_t ir_type_get(void* p, int32_t dtype, const int64_t* shape, int32_t ndim) {
+  IrContext* c = Ctx(p);
+  std::vector<int64_t> dims(shape, shape + (ndim > 0 ? ndim : 0));
+  auto key = std::make_pair(dtype, dims);
+  auto it = c->type_ids.find(key);
+  if (it != c->type_ids.end()) return it->second;
+  int64_t id = static_cast<int64_t>(c->types.size());
+  c->types.push_back(Type{dtype, dims});
+  c->type_ids.emplace(key, id);
+  return id;
+}
+
+int32_t ir_type_dtype(void* p, int64_t t) {
+  return ValidType(Ctx(p), t) ? Ctx(p)->types[t].dtype : -1;
+}
+int32_t ir_type_ndim(void* p, int64_t t) {
+  return ValidType(Ctx(p), t) ? static_cast<int32_t>(Ctx(p)->types[t].shape.size()) : -1;
+}
+void ir_type_shape(void* p, int64_t t, int64_t* out) {
+  if (!ValidType(Ctx(p), t)) return;
+  const auto& s = Ctx(p)->types[t].shape;
+  std::memcpy(out, s.data(), s.size() * sizeof(int64_t));
+}
+
+// ---- values ----
+int64_t ir_block_arg(void* p, int64_t type_id) {
+  IrContext* c = Ctx(p);
+  int64_t id = static_cast<int64_t>(c->values.size());
+  c->values.push_back(Value{id, -1, static_cast<int32_t>(c->block_args.size()), type_id});
+  c->block_args.push_back(id);
+  return id;
+}
+
+int64_t ir_value_def_op(void* p, int64_t v) {
+  return ValidValue(Ctx(p), v) ? Ctx(p)->values[v].def_op : -1;
+}
+int32_t ir_value_def_index(void* p, int64_t v) {
+  return ValidValue(Ctx(p), v) ? Ctx(p)->values[v].def_index : -1;
+}
+int64_t ir_value_type(void* p, int64_t v) {
+  return ValidValue(Ctx(p), v) ? Ctx(p)->values[v].type_id : -1;
+}
+int64_t ir_value_num_uses(void* p, int64_t v) {
+  return ValidValue(Ctx(p), v) ? Ctx(p)->values[v].use_count : -1;
+}
+int64_t ir_num_block_args(void* p) { return static_cast<int64_t>(Ctx(p)->block_args.size()); }
+int64_t ir_block_arg_at(void* p, int64_t i) {
+  IrContext* c = Ctx(p);
+  if (i < 0 || i >= static_cast<int64_t>(c->block_args.size())) return -1;
+  return c->block_args[i];
+}
+
+// ---- operations ----
+int64_t ir_op_create(void* p, const char* name, const int64_t* operands,
+                     int32_t n_operands, const int64_t* result_types,
+                     int32_t n_results, int32_t side_effect) {
+  IrContext* c = Ctx(p);
+  for (int32_t i = 0; i < n_operands; ++i)
+    if (!ValidValue(c, operands[i])) return -1;
+  Operation op;
+  op.id = static_cast<int64_t>(c->ops.size());
+  op.name = c->Intern(name);
+  op.operands.assign(operands, operands + n_operands);
+  op.side_effect = side_effect != 0;
+  for (int32_t i = 0; i < n_results; ++i) {
+    int64_t vid = static_cast<int64_t>(c->values.size());
+    c->values.push_back(Value{vid, op.id, i, result_types[i]});
+    op.results.push_back(vid);
+  }
+  for (int32_t i = 0; i < n_operands; ++i) c->values[operands[i]].use_count++;
+  c->ops.push_back(std::move(op));
+  return c->ops.back().id;
+}
+
+int64_t ir_op_result(void* p, int64_t op, int32_t i) {
+  IrContext* c = Ctx(p);
+  if (!ValidOp(c, op) || i >= static_cast<int32_t>(c->ops[op].results.size())) return -1;
+  return c->ops[op].results[i];
+}
+const char* ir_op_name(void* p, int64_t op) {
+  IrContext* c = Ctx(p);
+  if (!OpInRange(c, op)) return nullptr;
+  return c->strings[c->ops[op].name].c_str();
+}
+int32_t ir_op_num_operands(void* p, int64_t op) {
+  if (!OpInRange(Ctx(p), op)) return -1;
+  return static_cast<int32_t>(Ctx(p)->ops[op].operands.size());
+}
+int32_t ir_op_num_results(void* p, int64_t op) {
+  if (!OpInRange(Ctx(p), op)) return -1;
+  return static_cast<int32_t>(Ctx(p)->ops[op].results.size());
+}
+int64_t ir_op_operand(void* p, int64_t op, int32_t i) {
+  IrContext* c = Ctx(p);
+  if (!OpInRange(c, op) || i < 0 ||
+      i >= static_cast<int32_t>(c->ops[op].operands.size())) return -1;
+  return c->ops[op].operands[i];
+}
+int32_t ir_op_side_effect(void* p, int64_t op) {
+  if (!OpInRange(Ctx(p), op)) return -1;
+  return Ctx(p)->ops[op].side_effect ? 1 : 0;
+}
+
+void ir_op_set_operand(void* p, int64_t op, int32_t i, int64_t v) {
+  IrContext* c = Ctx(p);
+  if (!ValidOp(c, op) || i < 0 ||
+      i >= static_cast<int32_t>(c->ops[op].operands.size()) ||
+      !ValidValue(c, v)) return;
+  Ctx(p)->values[c->ops[op].operands[i]].use_count--;
+  c->ops[op].operands[i] = v;
+  c->values[v].use_count++;
+}
+
+// ---- attributes ----
+static Attr* FindOrAddAttr(IrContext* c, int64_t op, const char* key) {
+  int32_t k = c->Intern(key);
+  for (auto& a : c->ops[op].attrs)
+    if (a.key == k) return &a;
+  c->ops[op].attrs.push_back(Attr{k, 0});
+  return &c->ops[op].attrs.back();
+}
+
+void ir_op_set_attr_i(void* p, int64_t op, const char* key, int64_t v) {
+  Attr* a = FindOrAddAttr(Ctx(p), op, key);
+  a->tag = 0; a->i = v;
+}
+void ir_op_set_attr_f(void* p, int64_t op, const char* key, double v) {
+  Attr* a = FindOrAddAttr(Ctx(p), op, key);
+  a->tag = 1; a->f = v;
+}
+void ir_op_set_attr_s(void* p, int64_t op, const char* key, const char* v) {
+  IrContext* c = Ctx(p);
+  Attr* a = FindOrAddAttr(c, op, key);
+  a->tag = 2; a->s = c->Intern(v);
+}
+void ir_op_set_attr_ia(void* p, int64_t op, const char* key, const int64_t* v, int32_t n) {
+  Attr* a = FindOrAddAttr(Ctx(p), op, key);
+  a->tag = 3; a->ia.assign(v, v + n);
+}
+
+int32_t ir_op_num_attrs(void* p, int64_t op) {
+  if (!OpInRange(Ctx(p), op)) return -1;
+  return static_cast<int32_t>(Ctx(p)->ops[op].attrs.size());
+}
+const char* ir_op_attr_key(void* p, int64_t op, int32_t i) {
+  IrContext* c = Ctx(p);
+  if (!ValidAttr(c, op, i)) return nullptr;
+  return c->strings[c->ops[op].attrs[i].key].c_str();
+}
+int32_t ir_op_attr_tag(void* p, int64_t op, int32_t i) {
+  return ValidAttr(Ctx(p), op, i) ? Ctx(p)->ops[op].attrs[i].tag : -1;
+}
+int64_t ir_op_attr_i(void* p, int64_t op, int32_t i) {
+  return ValidAttr(Ctx(p), op, i) ? Ctx(p)->ops[op].attrs[i].i : 0;
+}
+double ir_op_attr_f(void* p, int64_t op, int32_t i) {
+  return ValidAttr(Ctx(p), op, i) ? Ctx(p)->ops[op].attrs[i].f : 0.0;
+}
+const char* ir_op_attr_s(void* p, int64_t op, int32_t i) {
+  IrContext* c = Ctx(p);
+  if (!ValidAttr(c, op, i) || c->ops[op].attrs[i].tag != 2 ||
+      c->ops[op].attrs[i].s < 0) return nullptr;
+  return c->strings[c->ops[op].attrs[i].s].c_str();
+}
+int32_t ir_op_attr_ia_len(void* p, int64_t op, int32_t i) {
+  if (!ValidAttr(Ctx(p), op, i)) return -1;
+  return static_cast<int32_t>(Ctx(p)->ops[op].attrs[i].ia.size());
+}
+void ir_op_attr_ia(void* p, int64_t op, int32_t i, int64_t* out) {
+  if (!ValidAttr(Ctx(p), op, i)) return;
+  const auto& v = Ctx(p)->ops[op].attrs[i].ia;
+  std::memcpy(out, v.data(), v.size() * sizeof(int64_t));
+}
+
+// ---- program structure ----
+int64_t ir_num_ops(void* p) {
+  IrContext* c = Ctx(p);
+  int64_t n = 0;
+  for (const auto& op : c->ops) n += op.alive ? 1 : 0;
+  return n;
+}
+// i-th ALIVE op in program order
+int64_t ir_op_at(void* p, int64_t i) {
+  IrContext* c = Ctx(p);
+  int64_t seen = 0;
+  for (const auto& op : c->ops)
+    if (op.alive && seen++ == i) return op.id;
+  return -1;
+}
+
+// bulk listing: fill `out` (caller-sized via ir_num_ops) with alive op ids
+// in program order; returns the count written
+int64_t ir_alive_ops(void* p, int64_t* out, int64_t cap) {
+  IrContext* c = Ctx(p);
+  int64_t n = 0;
+  for (const auto& op : c->ops)
+    if (op.alive) {
+      if (n >= cap) break;
+      out[n++] = op.id;
+    }
+  return n;
+}
+
+void ir_set_outputs(void* p, const int64_t* vids, int32_t n) {
+  IrContext* c = Ctx(p);
+  for (int64_t v : c->outputs) c->values[v].use_count--;
+  c->outputs.assign(vids, vids + n);
+  for (int64_t v : c->outputs) c->values[v].use_count++;
+}
+int32_t ir_num_outputs(void* p) { return static_cast<int32_t>(Ctx(p)->outputs.size()); }
+int64_t ir_output_at(void* p, int32_t i) {
+  IrContext* c = Ctx(p);
+  if (i < 0 || i >= static_cast<int32_t>(c->outputs.size())) return -1;
+  return c->outputs[i];
+}
+
+// Replace every use of `from` (operands AND program outputs) with `to`.
+int64_t ir_replace_all_uses(void* p, int64_t from, int64_t to) {
+  IrContext* c = Ctx(p);
+  if (!ValidValue(c, from) || !ValidValue(c, to)) return -1;
+  int64_t n = 0;
+  for (auto& op : c->ops) {
+    if (!op.alive) continue;
+    for (auto& o : op.operands)
+      if (o == from) { o = to; ++n; }
+  }
+  for (auto& o : c->outputs)
+    if (o == from) { o = to; ++n; }
+  c->values[from].use_count -= n;
+  c->values[to].use_count += n;
+  return n;
+}
+
+// Erase an op whose results are all unused. Returns 0 ok, -1 if still used.
+int32_t ir_erase_op(void* p, int64_t op) {
+  IrContext* c = Ctx(p);
+  if (!ValidOp(c, op)) return -1;
+  for (int64_t r : c->ops[op].results)
+    if (c->values[r].use_count > 0) return -1;
+  c->ops[op].alive = false;
+  for (int64_t o : c->ops[op].operands) c->values[o].use_count--;
+  return 0;
+}
+
+// ---- verifier (paddle/ir op verify analog): def-before-use in program order ----
+int32_t ir_verify(void* p) {
+  IrContext* c = Ctx(p);
+  std::vector<char> defined(c->values.size(), 0);
+  for (int64_t v : c->block_args) defined[v] = 1;
+  // builtin.constant is position-free, like an MLIR module-level constant —
+  // its results are defined everywhere (to_callable hoists exactly these,
+  // so the exemption must not be any broader)
+  auto const_name = c->string_ids.find("builtin.constant");
+  if (const_name != c->string_ids.end())
+    for (const auto& op : c->ops)
+      if (op.alive && op.name == const_name->second && op.operands.empty() &&
+          !op.side_effect)
+        for (int64_t r : op.results) defined[r] = 1;
+  for (const auto& op : c->ops) {
+    if (!op.alive) continue;
+    for (int64_t o : op.operands)
+      if (o < 0 || o >= static_cast<int64_t>(defined.size()) || !defined[o]) return -1;
+    for (int64_t r : op.results) defined[r] = 1;
+  }
+  for (int64_t v : c->outputs)
+    if (v < 0 || v >= static_cast<int64_t>(defined.size()) || !defined[v]) return -2;
+  return 0;
+}
+
+// ---- native passes ----
+
+// Dead code elimination: reverse sweep, erase side-effect-free ops with no
+// remaining uses (framework/ir dead_code_elimination analog).
+int64_t ir_dce(void* p) {
+  IrContext* c = Ctx(p);
+  int64_t removed = 0;
+  bool changed = true;
+  while (changed) {
+    changed = false;
+    for (auto it = c->ops.rbegin(); it != c->ops.rend(); ++it) {
+      if (!it->alive || it->side_effect) continue;
+      bool used = false;
+      for (int64_t r : it->results)
+        if (c->values[r].use_count > 0) { used = true; break; }
+      if (!used) {
+        it->alive = false;
+        for (int64_t o : it->operands) c->values[o].use_count--;
+        ++removed;
+        changed = true;
+      }
+    }
+  }
+  return removed;
+}
+
+namespace {
+// Structural fingerprint for CSE: name + operands + attrs + result types.
+std::string OpKey(IrContext* c, const Operation& op) {
+  std::string k = std::to_string(op.name);
+  k += '(';
+  for (int64_t o : op.operands) { k += std::to_string(o); k += ','; }
+  k += ')';
+  // attrs sorted by key id for order independence
+  std::vector<const Attr*> attrs;
+  for (const auto& a : op.attrs) attrs.push_back(&a);
+  std::sort(attrs.begin(), attrs.end(),
+            [](const Attr* a, const Attr* b) { return a->key < b->key; });
+  for (const Attr* a : attrs) {
+    k += std::to_string(a->key); k += ':'; k += std::to_string(a->tag); k += '=';
+    switch (a->tag) {
+      case 0: k += std::to_string(a->i); break;
+      case 1: {
+        // bit-exact: std::to_string(double) rounds to 6 decimals and would
+        // merge constants that differ below 1e-6
+        uint64_t bits;
+        std::memcpy(&bits, &a->f, sizeof(bits));
+        k += std::to_string(bits);
+        break;
+      }
+      case 2: k += std::to_string(a->s); break;
+      case 3:
+        for (int64_t x : a->ia) { k += std::to_string(x); k += ','; }
+        break;
+    }
+    k += ';';
+  }
+  k += "->";
+  for (int64_t r : op.results) { k += std::to_string(c->values[r].type_id); k += ','; }
+  return k;
+}
+}  // namespace
+
+// Common subexpression elimination: forward sweep, identical side-effect-free
+// ops collapse onto the first occurrence (RAUW + erase).
+int64_t ir_cse(void* p) {
+  IrContext* c = Ctx(p);
+  int64_t merged = 0;
+  bool changed = true;
+  while (changed) {
+    changed = false;
+    std::unordered_map<std::string, int64_t> seen;
+    for (auto& op : c->ops) {
+      if (!op.alive || op.side_effect) continue;
+      std::string key = OpKey(c, op);
+      auto it = seen.find(key);
+      if (it == seen.end()) {
+        seen.emplace(std::move(key), op.id);
+        continue;
+      }
+      const Operation& keep = c->ops[it->second];
+      for (size_t r = 0; r < op.results.size(); ++r)
+        ir_replace_all_uses(p, op.results[r], keep.results[r]);
+      if (ir_erase_op(p, op.id) == 0) {
+        ++merged;
+        changed = true;  // downstream keys referencing old results changed
+      }
+    }
+  }
+  return merged;
+}
+
+// ---- printer (textual form for debugging / golden tests) ----
+int64_t ir_print(void* p, char* buf, int64_t cap) {
+  IrContext* c = Ctx(p);
+  std::string& s = c->print_buf;
+  s.clear();
+  auto type_str = [&](int64_t t) {
+    std::string r = "tensor<";
+    for (size_t i = 0; i < c->types[t].shape.size(); ++i) {
+      r += std::to_string(c->types[t].shape[i]);
+      r += 'x';
+    }
+    r += "dt";
+    r += std::to_string(c->types[t].dtype);
+    r += '>';
+    return r;
+  };
+  s += "module {\n  func(";
+  for (size_t i = 0; i < c->block_args.size(); ++i) {
+    if (i) s += ", ";
+    s += '%'; s += std::to_string(c->block_args[i]);
+    s += ": "; s += type_str(c->values[c->block_args[i]].type_id);
+  }
+  s += ") {\n";
+  for (const auto& op : c->ops) {
+    if (!op.alive) continue;
+    s += "    ";
+    for (size_t i = 0; i < op.results.size(); ++i) {
+      if (i) s += ", ";
+      s += '%'; s += std::to_string(op.results[i]);
+    }
+    if (!op.results.empty()) s += " = ";
+    s += '"'; s += c->strings[op.name]; s += "\"(";
+    for (size_t i = 0; i < op.operands.size(); ++i) {
+      if (i) s += ", ";
+      s += '%'; s += std::to_string(op.operands[i]);
+    }
+    s += ')';
+    if (!op.attrs.empty()) {
+      s += " {";
+      for (size_t i = 0; i < op.attrs.size(); ++i) {
+        if (i) s += ", ";
+        const Attr& a = op.attrs[i];
+        s += c->strings[a.key]; s += ": ";
+        switch (a.tag) {
+          case 0: s += std::to_string(a.i); break;
+          case 1: s += std::to_string(a.f); break;
+          case 2: s += '"'; s += c->strings[a.s]; s += '"'; break;
+          case 3: {
+            s += '[';
+            for (size_t j = 0; j < a.ia.size(); ++j) {
+              if (j) s += ", ";
+              s += std::to_string(a.ia[j]);
+            }
+            s += ']';
+            break;
+          }
+        }
+      }
+      s += '}';
+    }
+    if (!op.results.empty()) {
+      s += " : ";
+      for (size_t i = 0; i < op.results.size(); ++i) {
+        if (i) s += ", ";
+        s += type_str(c->values[op.results[i]].type_id);
+      }
+    }
+    s += '\n';
+  }
+  s += "    return(";
+  for (size_t i = 0; i < c->outputs.size(); ++i) {
+    if (i) s += ", ";
+    s += '%'; s += std::to_string(c->outputs[i]);
+  }
+  s += ")\n  }\n}\n";
+  if (buf && cap > 0) {
+    int64_t n = std::min<int64_t>(cap - 1, static_cast<int64_t>(s.size()));
+    std::memcpy(buf, s.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int64_t>(s.size());
+}
+
+}  // extern "C"

@@ -97,6 +97,7 @@ from . import metric  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
+from . import ir  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import vision  # noqa: F401,E402

@@ -1,0 +1,50 @@
+"""paddle_tpu.ir — next-gen IR core + pass pipeline (TPU-native).
+
+Reference surface: paddle/ir (ir_context.h:34 IrContext, dialect.h:29 Dialect,
+operation.h:23 Operation, Value/Type/Attribute with storage uniquing) and the
+fluid/framework/ir pass library (Pass/PassManager, 268 fusion/optimization
+passes). TPU-first re-design: the IR's program model is a flat jaxpr — ops are
+JAX primitives over ranked tensor types — because the program this framework
+optimizes before compilation IS a jaxpr; XLA then owns scheduling/fusion. The
+uniquing core and the generic passes (DCE, CSE) are native C++ (ir_core.cc)
+bound via ctypes; pattern passes (constant folding, dropout elimination,
+conv+BN folding, cast simplification) are Python over the same graph.
+"""
+
+from .core import (  # noqa: F401
+    Attribute,
+    Dialect,
+    IrContext,
+    Operation,
+    Program,
+    Type,
+    Value,
+    from_jaxpr,
+    trace,
+)
+from .pass_manager import (  # noqa: F401
+    Pass,
+    PassManager,
+    PassRegistry,
+    register_pass,
+)
+from . import passes  # noqa: F401  (registers the builtin passes)
+
+__all__ = [
+    "IrContext", "Dialect", "Operation", "Value", "Type", "Attribute",
+    "Program", "from_jaxpr", "trace",
+    "Pass", "PassManager", "PassRegistry", "register_pass",
+    "optimize",
+]
+
+
+def optimize(fn, *example_args, passes=None, **example_kwargs):
+    """Trace ``fn``, run the pass pipeline, return an optimized callable.
+
+    The one-call analog of the reference's ApplyPass + executor pipeline:
+    jaxpr -> IR -> [constant_folding, cse, dce, ...] -> jittable callable.
+    """
+    prog = trace(fn, *example_args, **example_kwargs)
+    pm = PassManager(passes)
+    pm.run(prog)
+    return prog.to_callable()

@@ -1,0 +1,550 @@
+"""IR core wrappers over the native uniquing store (native/src/ir_core.cc).
+
+Mirrors paddle/ir's object model — IrContext (ir_context.h:34), Dialect
+(dialect.h:29), Operation (operation.h:23), Value, Type, Attribute — with the
+storage held natively and uniqued, addressed by integer ids across the C ABI.
+
+The program model is a flat jaxpr: ``trace(fn, *args)`` builds a Program from
+``jax.make_jaxpr``; ``Program.to_callable()`` re-emits a jittable function by
+re-binding each op's JAX primitive. Complex primitive params (sub-jaxprs for
+scan/cond bodies, dimension_numbers, ...) stay Python-side in a per-program
+side table, mirrored into the native graph as opaque ``py:`` token attributes
+so native CSE stays conservative-but-correct.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .. import native as _native
+
+_SIMPLE_DTYPES = dict(_native._DTYPE_CODES)
+_SIMPLE_DTYPES.update({
+    "complex64": 10, "complex128": 11,
+    "uint16": 12, "uint32": 13, "uint64": 14,
+})
+_CODE_TO_DTYPE = {v: k for k, v in _SIMPLE_DTYPES.items()}
+_TOKEN_CODE = 98  # jax token / effect values (no dtype)
+
+_bound = False
+
+
+def _lib():
+    global _bound
+    lib = _native._load()
+    if _bound:
+        return lib
+    c_i64 = ctypes.c_int64
+    c_i32 = ctypes.c_int32
+    p_i64 = ctypes.POINTER(c_i64)
+    sigs = {
+        "ir_ctx_create": (ctypes.c_void_p, []),
+        "ir_ctx_destroy": (None, [ctypes.c_void_p]),
+        "ir_type_get": (c_i64, [ctypes.c_void_p, c_i32, p_i64, c_i32]),
+        "ir_type_dtype": (c_i32, [ctypes.c_void_p, c_i64]),
+        "ir_type_ndim": (c_i32, [ctypes.c_void_p, c_i64]),
+        "ir_type_shape": (None, [ctypes.c_void_p, c_i64, p_i64]),
+        "ir_block_arg": (c_i64, [ctypes.c_void_p, c_i64]),
+        "ir_value_def_op": (c_i64, [ctypes.c_void_p, c_i64]),
+        "ir_value_def_index": (c_i32, [ctypes.c_void_p, c_i64]),
+        "ir_value_type": (c_i64, [ctypes.c_void_p, c_i64]),
+        "ir_value_num_uses": (c_i64, [ctypes.c_void_p, c_i64]),
+        "ir_num_block_args": (c_i64, [ctypes.c_void_p]),
+        "ir_block_arg_at": (c_i64, [ctypes.c_void_p, c_i64]),
+        "ir_op_create": (c_i64, [ctypes.c_void_p, ctypes.c_char_p, p_i64, c_i32, p_i64, c_i32, c_i32]),
+        "ir_op_result": (c_i64, [ctypes.c_void_p, c_i64, c_i32]),
+        "ir_op_name": (ctypes.c_char_p, [ctypes.c_void_p, c_i64]),
+        "ir_op_num_operands": (c_i32, [ctypes.c_void_p, c_i64]),
+        "ir_op_num_results": (c_i32, [ctypes.c_void_p, c_i64]),
+        "ir_op_operand": (c_i64, [ctypes.c_void_p, c_i64, c_i32]),
+        "ir_op_side_effect": (c_i32, [ctypes.c_void_p, c_i64]),
+        "ir_op_set_operand": (None, [ctypes.c_void_p, c_i64, c_i32, c_i64]),
+        "ir_op_set_attr_i": (None, [ctypes.c_void_p, c_i64, ctypes.c_char_p, c_i64]),
+        "ir_op_set_attr_f": (None, [ctypes.c_void_p, c_i64, ctypes.c_char_p, ctypes.c_double]),
+        "ir_op_set_attr_s": (None, [ctypes.c_void_p, c_i64, ctypes.c_char_p, ctypes.c_char_p]),
+        "ir_op_set_attr_ia": (None, [ctypes.c_void_p, c_i64, ctypes.c_char_p, p_i64, c_i32]),
+        "ir_op_num_attrs": (c_i32, [ctypes.c_void_p, c_i64]),
+        "ir_op_attr_key": (ctypes.c_char_p, [ctypes.c_void_p, c_i64, c_i32]),
+        "ir_op_attr_tag": (c_i32, [ctypes.c_void_p, c_i64, c_i32]),
+        "ir_op_attr_i": (c_i64, [ctypes.c_void_p, c_i64, c_i32]),
+        "ir_op_attr_f": (ctypes.c_double, [ctypes.c_void_p, c_i64, c_i32]),
+        "ir_op_attr_s": (ctypes.c_char_p, [ctypes.c_void_p, c_i64, c_i32]),
+        "ir_op_attr_ia_len": (c_i32, [ctypes.c_void_p, c_i64, c_i32]),
+        "ir_op_attr_ia": (None, [ctypes.c_void_p, c_i64, c_i32, p_i64]),
+        "ir_num_ops": (c_i64, [ctypes.c_void_p]),
+        "ir_op_at": (c_i64, [ctypes.c_void_p, c_i64]),
+        "ir_alive_ops": (c_i64, [ctypes.c_void_p, p_i64, c_i64]),
+        "ir_set_outputs": (None, [ctypes.c_void_p, p_i64, c_i32]),
+        "ir_num_outputs": (c_i32, [ctypes.c_void_p]),
+        "ir_output_at": (c_i64, [ctypes.c_void_p, c_i32]),
+        "ir_replace_all_uses": (c_i64, [ctypes.c_void_p, c_i64, c_i64]),
+        "ir_erase_op": (c_i32, [ctypes.c_void_p, c_i64]),
+        "ir_verify": (c_i32, [ctypes.c_void_p]),
+        "ir_dce": (c_i64, [ctypes.c_void_p]),
+        "ir_cse": (c_i64, [ctypes.c_void_p]),
+        "ir_print": (c_i64, [ctypes.c_void_p, ctypes.c_char_p, c_i64]),
+    }
+    for name, (res, args) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype = res
+        fn.argtypes = args
+    _bound = True
+    return lib
+
+
+class Type:
+    """Uniqued ranked tensor type (dtype + static shape)."""
+
+    __slots__ = ("ctx", "id")
+
+    def __init__(self, ctx: "IrContext", tid: int):
+        self.ctx, self.id = ctx, tid
+
+    @property
+    def dtype(self) -> Optional[str]:
+        code = _lib().ir_type_dtype(self.ctx._h, self.id)
+        return self.ctx._dyn_codes_rev.get(code, _CODE_TO_DTYPE.get(code))
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        lib = _lib()
+        n = lib.ir_type_ndim(self.ctx._h, self.id)
+        buf = (ctypes.c_int64 * max(n, 1))()
+        if n:
+            lib.ir_type_shape(self.ctx._h, self.id, buf)
+        return tuple(buf[i] for i in range(n))
+
+    def __eq__(self, other):
+        return isinstance(other, Type) and other.ctx is self.ctx and other.id == self.id
+
+    def __hash__(self):
+        return hash((id(self.ctx), self.id))
+
+    def __repr__(self):
+        return f"tensor<{'x'.join(map(str, self.shape))}x{self.dtype}>"
+
+
+class Value:
+    """SSA value: block argument or op result, with native use counting."""
+
+    __slots__ = ("ctx", "id")
+
+    def __init__(self, ctx: "IrContext", vid: int):
+        self.ctx, self.id = ctx, vid
+
+    @property
+    def type(self) -> Type:
+        return Type(self.ctx, _lib().ir_value_type(self.ctx._h, self.id))
+
+    @property
+    def num_uses(self) -> int:
+        return _lib().ir_value_num_uses(self.ctx._h, self.id)
+
+    def defining_op(self) -> Optional["Operation"]:
+        op = _lib().ir_value_def_op(self.ctx._h, self.id)
+        return None if op < 0 else Operation(self.ctx, op)
+
+    @property
+    def result_index(self) -> int:
+        return _lib().ir_value_def_index(self.ctx._h, self.id)
+
+    def replace_all_uses_with(self, other: "Value") -> int:
+        return _lib().ir_replace_all_uses(self.ctx._h, self.id, other.id)
+
+    def __eq__(self, other):
+        return isinstance(other, Value) and other.ctx is self.ctx and other.id == self.id
+
+    def __hash__(self):
+        return hash((id(self.ctx), self.id))
+
+    def __repr__(self):
+        return f"%{self.id}"
+
+
+class Attribute:
+    """Plain attribute view (key → int/float/str/int-list)."""
+
+    __slots__ = ("key", "value")
+
+    def __init__(self, key: str, value: Any):
+        self.key, self.value = key, value
+
+    def __repr__(self):
+        return f"{self.key}={self.value!r}"
+
+
+class Operation:
+    """One op in program order: interned name, operands, results, attrs."""
+
+    __slots__ = ("ctx", "id")
+
+    def __init__(self, ctx: "IrContext", op_id: int):
+        self.ctx, self.id = ctx, op_id
+
+    @property
+    def name(self) -> str:
+        return _lib().ir_op_name(self.ctx._h, self.id).decode()
+
+    @property
+    def operands(self) -> List[Value]:
+        lib = _lib()
+        return [Value(self.ctx, lib.ir_op_operand(self.ctx._h, self.id, i))
+                for i in range(lib.ir_op_num_operands(self.ctx._h, self.id))]
+
+    @property
+    def results(self) -> List[Value]:
+        lib = _lib()
+        return [Value(self.ctx, lib.ir_op_result(self.ctx._h, self.id, i))
+                for i in range(lib.ir_op_num_results(self.ctx._h, self.id))]
+
+    def result(self, i: int = 0) -> Value:
+        return Value(self.ctx, _lib().ir_op_result(self.ctx._h, self.id, i))
+
+    @property
+    def has_side_effect(self) -> bool:
+        return bool(_lib().ir_op_side_effect(self.ctx._h, self.id))
+
+    def set_operand(self, i: int, v: Value):
+        _lib().ir_op_set_operand(self.ctx._h, self.id, i, v.id)
+
+    def attrs(self) -> Dict[str, Any]:
+        lib = _lib()
+        out = {}
+        for i in range(lib.ir_op_num_attrs(self.ctx._h, self.id)):
+            key = lib.ir_op_attr_key(self.ctx._h, self.id, i).decode()
+            tag = lib.ir_op_attr_tag(self.ctx._h, self.id, i)
+            if tag == 0:
+                out[key] = lib.ir_op_attr_i(self.ctx._h, self.id, i)
+            elif tag == 1:
+                out[key] = lib.ir_op_attr_f(self.ctx._h, self.id, i)
+            elif tag == 2:
+                out[key] = lib.ir_op_attr_s(self.ctx._h, self.id, i).decode()
+            else:
+                n = lib.ir_op_attr_ia_len(self.ctx._h, self.id, i)
+                buf = (ctypes.c_int64 * max(n, 1))()
+                lib.ir_op_attr_ia(self.ctx._h, self.id, i, buf)
+                out[key] = [buf[j] for j in range(n)]
+        return out
+
+    def erase(self) -> bool:
+        return _lib().ir_erase_op(self.ctx._h, self.id) == 0
+
+    def __eq__(self, other):
+        return isinstance(other, Operation) and other.ctx is self.ctx and other.id == self.id
+
+    def __hash__(self):
+        return hash((id(self.ctx), self.id))
+
+    def __repr__(self):
+        return f'<op {self.id} "{self.name}">'
+
+
+class Dialect:
+    """Namespace of op names (builtin./pd./stablehlo. prefixes)."""
+
+    _registry: Dict[str, "Dialect"] = {}
+
+    def __init__(self, name: str):
+        self.name = name
+        self.ops: List[str] = []
+        Dialect._registry[name] = self
+
+    def register_op(self, op_name: str):
+        self.ops.append(op_name)
+
+    @classmethod
+    def get(cls, name: str) -> "Dialect":
+        return cls._registry.get(name) or Dialect(name)
+
+
+BUILTIN_DIALECT = Dialect("builtin")
+PD_DIALECT = Dialect("pd")
+
+CONSTANT_OP = "builtin.constant"
+
+
+class IrContext:
+    """Owns one native uniquing store; all IR objects hang off it."""
+
+    def __init__(self):
+        self._h = _lib().ir_ctx_create()
+        self._dyn_codes: Dict[str, int] = {}
+        self._dyn_codes_rev: Dict[int, str] = {}
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                _lib().ir_ctx_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def _dtype_code(self, name: str) -> int:
+        if name in _SIMPLE_DTYPES:
+            return _SIMPLE_DTYPES[name]
+        if name not in self._dyn_codes:
+            code = 100 + len(self._dyn_codes)
+            self._dyn_codes[name] = code
+            self._dyn_codes_rev[code] = name
+        return self._dyn_codes[name]
+
+    def tensor_type(self, dtype, shape: Sequence[int]) -> Type:
+        code = self._dtype_code(np.dtype(dtype).name if not isinstance(dtype, str) else dtype)
+        shape = [int(s) for s in shape]
+        arr = (ctypes.c_int64 * max(len(shape), 1))(*shape)
+        return Type(self, _lib().ir_type_get(self._h, code, arr, len(shape)))
+
+    def token_type(self) -> Type:
+        arr = (ctypes.c_int64 * 1)()
+        return Type(self, _lib().ir_type_get(self._h, _TOKEN_CODE, arr, 0))
+
+
+class Program:
+    """A single-block IR function + Python side tables for reconstruction.
+
+    Side tables: ``op_bind[op_id] = (primitive, params)`` for primitive ops,
+    ``const_vals[op_id] = ndarray`` for builtin.constant. Input/output pytree
+    structure is preserved so the re-emitted callable keeps the original
+    signature.
+    """
+
+    def __init__(self, ctx: Optional[IrContext] = None):
+        self.ctx = ctx or IrContext()
+        # block args / outputs live on the native context, so two Programs
+        # over one context would interleave inputs and clobber outputs
+        if getattr(self.ctx, "_owner", None) is not None:
+            raise ValueError("IrContext is already bound to a Program; "
+                             "create a fresh context per program")
+        self.ctx._owner = self
+        self.op_bind: Dict[int, Tuple[Any, Dict[str, Any]]] = {}
+        self.const_vals: Dict[int, Any] = {}
+        self.in_tree = None
+        self.out_tree = None
+        self._token_ids: Dict[int, int] = {}
+        self._token_objs: List[Any] = []
+
+    # ---- construction ----
+    def add_input(self, type_: Type) -> Value:
+        return Value(self.ctx, _lib().ir_block_arg(self.ctx._h, type_.id))
+
+    def create_op(self, name: str, operands: Sequence[Value],
+                  result_types: Sequence[Type], attrs: Optional[Dict[str, Any]] = None,
+                  side_effect: bool = False) -> Operation:
+        h = self.ctx._h
+        ops_arr = (ctypes.c_int64 * max(len(operands), 1))(*[v.id for v in operands])
+        res_arr = (ctypes.c_int64 * max(len(result_types), 1))(*[t.id for t in result_types])
+        op_id = _lib().ir_op_create(h, name.encode(), ops_arr, len(operands),
+                                    res_arr, len(result_types), int(side_effect))
+        if op_id < 0:
+            raise ValueError(f"ir_op_create failed for {name}")
+        op = Operation(self.ctx, op_id)
+        for k, v in (attrs or {}).items():
+            self._set_attr(op_id, k, v)
+        return op
+
+    def _py_token(self, obj: Any) -> int:
+        tok = self._token_ids.get(id(obj))
+        if tok is None:
+            tok = len(self._token_ids)
+            self._token_ids[id(obj)] = tok
+            self._token_objs.append(obj)  # pin: id() stays unique for the
+        return tok                        # program's lifetime
+
+    def _set_attr(self, op_id: int, key: str, v: Any):
+        lib, h = _lib(), self.ctx._h
+        if isinstance(v, (bool, int, np.integer)):
+            lib.ir_op_set_attr_i(h, op_id, key.encode(), int(v))
+        elif isinstance(v, (float, np.floating)):
+            lib.ir_op_set_attr_f(h, op_id, key.encode(), float(v))
+        elif isinstance(v, str):
+            lib.ir_op_set_attr_s(h, op_id, key.encode(), v.encode())
+        elif isinstance(v, (tuple, list)) and all(isinstance(x, (bool, int, np.integer)) for x in v):
+            arr = (ctypes.c_int64 * max(len(v), 1))(*[int(x) for x in v])
+            lib.ir_op_set_attr_ia(h, op_id, key.encode(), arr, len(v))
+        else:
+            # opaque: conservative identity token (same object <=> equal)
+            lib.ir_op_set_attr_i(h, op_id, f"py:{key}".encode(), self._py_token(v))
+
+    def add_constant(self, value) -> Operation:
+        arr = np.asarray(value)
+        t = self.ctx.tensor_type(arr.dtype.name, arr.shape)
+        attrs: Dict[str, Any] = {}
+        if arr.ndim == 0 and arr.dtype.kind in "ifb":
+            attrs["value"] = arr.item()  # scalars unique natively -> CSE merges
+        else:
+            attrs["value_token"] = self._py_token(value)
+        op = self.create_op(CONSTANT_OP, [], [t], attrs)
+        self.const_vals[op.id] = value
+        return op
+
+    def set_outputs(self, values: Sequence[Value]):
+        arr = (ctypes.c_int64 * max(len(values), 1))(*[v.id for v in values])
+        _lib().ir_set_outputs(self.ctx._h, arr, len(values))
+
+    # ---- inspection ----
+    @property
+    def inputs(self) -> List[Value]:
+        lib, h = _lib(), self.ctx._h
+        return [Value(self.ctx, lib.ir_block_arg_at(h, i))
+                for i in range(lib.ir_num_block_args(h))]
+
+    @property
+    def outputs(self) -> List[Value]:
+        lib, h = _lib(), self.ctx._h
+        return [Value(self.ctx, lib.ir_output_at(h, i))
+                for i in range(lib.ir_num_outputs(h))]
+
+    def ops(self) -> List[Operation]:
+        lib, h = _lib(), self.ctx._h
+        cap = lib.ir_num_ops(h)
+        buf = (ctypes.c_int64 * max(cap, 1))()
+        n = lib.ir_alive_ops(h, buf, cap)
+        return [Operation(self.ctx, buf[i]) for i in range(n)]
+
+    def __len__(self):
+        return int(_lib().ir_num_ops(self.ctx._h))
+
+    def verify(self):
+        rc = _lib().ir_verify(self.ctx._h)
+        if rc != 0:
+            raise ValueError(f"IR verification failed (code {rc})")
+
+    def __str__(self):
+        lib, h = _lib(), self.ctx._h
+        n = lib.ir_print(h, None, 0)
+        buf = ctypes.create_string_buffer(n + 1)
+        lib.ir_print(h, buf, n + 1)
+        return buf.value.decode()
+
+    # ---- native passes ----
+    def dce(self) -> int:
+        return int(_lib().ir_dce(self.ctx._h))
+
+    def cse(self) -> int:
+        return int(_lib().ir_cse(self.ctx._h))
+
+    # ---- re-emission ----
+    def to_callable(self) -> Callable:
+        """Re-emit as a Python callable that re-binds each primitive.
+
+        The result traces cleanly under jax.jit — the executor pipeline is
+        XLA itself (SURVEY §3.3 TPU note).
+        """
+        self.verify()
+        # constants are position-free (hoisted first); other ops keep
+        # program order, which the verifier guarantees is def-before-use
+        plan = []  # (kind, op_id, operand_vids, result_vids, payload)
+        for op in self.ops():
+            if op.name == CONSTANT_OP:
+                plan.append(("const", op.id, (), [r.id for r in op.results],
+                             self.const_vals[op.id]))
+        for op in self.ops():
+            if op.name != CONSTANT_OP:
+                if op.id not in self.op_bind:
+                    raise ValueError(
+                        f"op {op.name} (id {op.id}) has no JAX primitive "
+                        "binding; re-emission requires ops created via "
+                        "from_jaxpr/trace (manually built ops must be "
+                        "rewritten away by passes first)")
+                prim, params = self.op_bind[op.id]
+                plan.append(("bind", op.id, tuple(o.id for o in op.operands),
+                             [r.id for r in op.results], (prim, params)))
+        in_vids = [v.id for v in self.inputs]
+        out_vids = [v.id for v in self.outputs]
+        in_tree, out_tree = self.in_tree, self.out_tree
+
+        def run(*args, **kwargs):
+            if in_tree is not None:
+                flat, tree = jax.tree_util.tree_flatten((args, kwargs))
+                if tree != in_tree:
+                    raise TypeError("argument structure does not match traced program")
+            else:
+                flat = list(args)
+            env: Dict[int, Any] = dict(zip(in_vids, flat))
+            for kind, _oid, operand_ids, result_ids, payload in plan:
+                if kind == "const":
+                    env[result_ids[0]] = payload
+                    continue
+                prim, params = payload
+                args_in = [env[i] for i in operand_ids]
+                # get_bind_params reconstructs positional sub-functions for
+                # higher-order primitives (custom_jvp/vjp, scan, pjit) exactly
+                # as jax.core.eval_jaxpr does — custom grad rules survive
+                subfuns, bind_params = prim.get_bind_params(params)
+                vals = prim.bind(*subfuns, *args_in, **bind_params)
+                if prim.multiple_results:
+                    for rid, v in zip(result_ids, vals):
+                        env[rid] = v
+                else:
+                    env[result_ids[0]] = vals
+            outs = [env[i] for i in out_vids]
+            if out_tree is not None:
+                return jax.tree_util.tree_unflatten(out_tree, outs)
+            return tuple(outs)
+
+        return run
+
+
+def _aval_type(ctx: IrContext, aval) -> Type:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return ctx.token_type()
+    return ctx.tensor_type(str(dtype), shape)
+
+
+def from_jaxpr(closed_jaxpr, in_tree=None, out_tree=None) -> Program:
+    """Import a ClosedJaxpr into a fresh Program (jaxpr -> IR translation —
+    the analog of the reference's program_translator into paddle/ir)."""
+    prog = Program()
+    prog.in_tree, prog.out_tree = in_tree, out_tree
+    jaxpr = closed_jaxpr.jaxpr
+    env: Dict[Any, Value] = {}
+    for var in jaxpr.invars:
+        env[var] = prog.add_input(_aval_type(prog.ctx, var.aval))
+    for var, const in zip(jaxpr.constvars, closed_jaxpr.consts):
+        env[var] = prog.add_constant(const).result(0)
+
+    from jax.extend.core import Literal as literal_cls
+    for eqn in jaxpr.eqns:
+        operands = []
+        for iv in eqn.invars:
+            if isinstance(iv, literal_cls):
+                operands.append(prog.add_constant(iv.val).result(0))
+            else:
+                operands.append(env[iv])
+        result_types = [_aval_type(prog.ctx, ov.aval) for ov in eqn.outvars]
+        side_effect = bool(getattr(eqn, "effects", None))
+        name = eqn.primitive.name
+        full_name = name if "." in name else f"pd.{name}"
+        op = prog.create_op(full_name, operands, result_types,
+                            attrs=dict(eqn.params), side_effect=side_effect)
+        prog.op_bind[op.id] = (eqn.primitive, dict(eqn.params))
+        for ov, res in zip(eqn.outvars, op.results):
+            env[ov] = res
+
+    prog.set_outputs([env[ov] if not isinstance(ov, literal_cls)
+                      else prog.add_constant(ov.val).result(0)
+                      for ov in jaxpr.outvars])
+    prog.verify()
+    return prog
+
+
+def trace(fn: Callable, *args, **kwargs) -> Program:
+    """Trace ``fn`` on example args into a Program (preserving pytrees)."""
+    flat_args, in_tree = jax.tree_util.tree_flatten((args, kwargs))
+    store = {}
+
+    def flat_fn(*flat):
+        a, k = jax.tree_util.tree_unflatten(in_tree, flat)
+        out = fn(*a, **k)
+        flat_out, out_tree = jax.tree_util.tree_flatten(out)
+        store["out_tree"] = out_tree
+        return flat_out
+
+    closed = jax.make_jaxpr(flat_fn)(*flat_args)
+    return from_jaxpr(closed, in_tree=in_tree, out_tree=store["out_tree"])

@@ -1,0 +1,82 @@
+"""Pass infrastructure: Pass / PassRegistry / PassManager.
+
+Reference surface: fluid/framework/ir/pass.h (Pass::Apply), pass registry
+macros (REGISTER_PASS), and python/paddle's PassManager over the new IR.
+Passes mutate a Program in place and report a change count; the manager runs
+its pipeline to a fixed point (bounded rounds), matching how the reference's
+analysis pipeline re-runs dependent passes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Type, Union
+
+from .core import Program
+
+
+class Pass:
+    """Base pass: subclass and implement run(program) -> int (num changes)."""
+
+    name = "pass"
+
+    def run(self, program: Program) -> int:
+        raise NotImplementedError
+
+    def __call__(self, program: Program) -> int:
+        n = self.run(program)
+        program.verify()
+        return n
+
+
+class PassRegistry:
+    _passes: Dict[str, Type[Pass]] = {}
+
+    @classmethod
+    def register(cls, pass_cls: Type[Pass]):
+        cls._passes[pass_cls.name] = pass_cls
+        return pass_cls
+
+    @classmethod
+    def get(cls, name: str) -> Pass:
+        if name not in cls._passes:
+            raise KeyError(f"unknown pass '{name}'; registered: {sorted(cls._passes)}")
+        return cls._passes[name]()
+
+    @classmethod
+    def names(cls) -> List[str]:
+        return sorted(cls._passes)
+
+
+def register_pass(pass_cls: Type[Pass]):
+    """Decorator: REGISTER_PASS analog."""
+    return PassRegistry.register(pass_cls)
+
+
+DEFAULT_PIPELINE = ["algebraic_simplify", "constant_folding", "cse", "dce"]
+INFERENCE_PIPELINE = ["dropout_eliminate", "algebraic_simplify",
+                      "constant_folding", "cse", "dce"]
+
+
+class PassManager:
+    """Runs a pipeline of passes to a fixed point (<= max_rounds)."""
+
+    def __init__(self, passes: Optional[Sequence[Union[str, Pass]]] = None,
+                 max_rounds: int = 4):
+        if passes is None:
+            passes = DEFAULT_PIPELINE
+        self.passes: List[Pass] = [PassRegistry.get(p) if isinstance(p, str) else p
+                                   for p in passes]
+        self.max_rounds = max_rounds
+        self.stats: Dict[str, int] = {}
+
+    def run(self, program: Program) -> Dict[str, int]:
+        self.stats = {p.name: 0 for p in self.passes}
+        for _ in range(self.max_rounds):
+            changed = 0
+            for p in self.passes:
+                n = p(program)
+                self.stats[p.name] += n
+                changed += n
+            if not changed:
+                break
+        return self.stats

@@ -1,0 +1,167 @@
+"""Builtin passes — the TPU-relevant core of the reference's 268-file
+fluid/framework/ir pass library.
+
+Kept deliberately small: on TPU, XLA owns fusion/layout/scheduling, so the
+passes that still pay are the PROGRAM-level ones XLA can't see across the
+trace boundary — constant folding (pre-computing frozen subgraphs, which
+subsumes most of conv_bn_fuse's arithmetic once BN runs in eval mode),
+algebraic identity cleanup, CSE and DCE (native, ir_core.cc), and
+inference-only rewrites (dropout elimination). Pattern passes use simple
+def-use matching — the GraphPatternDetector analog over Value.defining_op().
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .core import CONSTANT_OP, Program
+from .pass_manager import Pass, register_pass
+
+_FOLD_ELEMENT_LIMIT = 1 << 22  # don't materialize folded constants > 4M elems
+
+
+@register_pass
+class DeadCodeEliminationPass(Pass):
+    """Native reverse-sweep DCE (framework/ir delete_op_device_pass family)."""
+
+    name = "dce"
+
+    def run(self, program: Program) -> int:
+        return program.dce()
+
+
+@register_pass
+class CommonSubexpressionEliminationPass(Pass):
+    """Native structural CSE over (name, operands, attrs, result types)."""
+
+    name = "cse"
+
+    def run(self, program: Program) -> int:
+        return program.cse()
+
+
+def _const_value(program: Program, v):
+    op = v.defining_op()
+    if op is None or op.name != CONSTANT_OP:
+        return None
+    return program.const_vals.get(op.id)
+
+
+@register_pass
+class ConstantFoldingPass(Pass):
+    """Evaluate side-effect-free ops whose operands are all constants
+    (constant_folding_pass.cc analog). Evaluation re-binds the primitive on
+    the concrete values — i.e. runs it eagerly through XLA once, at
+    optimization time instead of every execution."""
+
+    name = "constant_folding"
+
+    def run(self, program: Program) -> int:
+        changed = 0
+        for op in program.ops():
+            if op.name == CONSTANT_OP or op.has_side_effect:
+                continue
+            if op.id not in program.op_bind:
+                continue
+            vals = []
+            all_const = True
+            for operand in op.operands:
+                cv = _const_value(program, operand)
+                if cv is None:
+                    all_const = False
+                    break
+                vals.append(cv)
+            if not all_const:
+                continue
+            out_elems = sum(int(np.prod(r.type.shape or (1,))) for r in op.results)
+            if out_elems > _FOLD_ELEMENT_LIMIT:
+                continue
+            prim, params = program.op_bind[op.id]
+            try:
+                subfuns, bind_params = prim.get_bind_params(params)
+                folded = prim.bind(*subfuns, *vals, **bind_params)
+            except Exception:
+                continue  # unfoldable (needs trace context) — leave as-is
+            if not prim.multiple_results:
+                folded = [folded]
+            for res, fv in zip(op.results, folded):
+                res.replace_all_uses_with(program.add_constant(np.asarray(fv)).result(0))
+            op.erase()  # now dead; erasing here keeps re-runs convergent
+            changed += 1
+        return changed
+
+
+def _is_const_filled(program: Program, v, scalar) -> bool:
+    cv = _const_value(program, v)
+    if cv is None:
+        return False
+    try:
+        return bool(np.all(np.asarray(cv) == scalar))
+    except Exception:
+        return False
+
+
+@register_pass
+class AlgebraicSimplifyPass(Pass):
+    """Identity cleanup: x+0, x-0, x*1, x/1, double-transpose, no-op convert
+    (the simplify_* / identity_op_clean passes of framework/ir)."""
+
+    name = "algebraic_simplify"
+
+    def run(self, program: Program) -> int:
+        changed = 0
+        for op in program.ops():
+            name = op.name
+            repl = None
+            if name in ("pd.add", "pd.sub") and len(op.operands) == 2:
+                a, b = op.operands
+                if _is_const_filled(program, b, 0) and b.type == a.type == op.result(0).type:
+                    repl = a
+                elif name == "pd.add" and _is_const_filled(program, a, 0) \
+                        and a.type == b.type == op.result(0).type:
+                    repl = b
+            elif name in ("pd.mul", "pd.div") and len(op.operands) == 2:
+                a, b = op.operands
+                if _is_const_filled(program, b, 1) and b.type == a.type == op.result(0).type:
+                    repl = a
+                elif name == "pd.mul" and _is_const_filled(program, a, 1) \
+                        and a.type == b.type == op.result(0).type:
+                    repl = b
+            elif name == "pd.transpose":
+                inner = op.operands[0].defining_op()
+                if inner is not None and inner.name == "pd.transpose":
+                    outer_p = op.attrs().get("permutation")
+                    inner_p = inner.attrs().get("permutation")
+                    if outer_p and inner_p and \
+                            [inner_p[p] for p in outer_p] == list(range(len(outer_p))):
+                        repl = inner.operands[0]
+            elif name == "pd.convert_element_type":
+                if op.result(0).type == op.operands[0].type:
+                    repl = op.operands[0]
+            if repl is not None:
+                n = op.result(0).replace_all_uses_with(repl)
+                erased = op.erase()
+                if n or erased:  # count real rewrites only, or convergence
+                    changed += 1  # detection never settles
+        return changed
+
+
+@register_pass
+class DropoutEliminatePass(Pass):
+    """Inference-only: pd.dropout → identity (delete_dropout_op_pass analog).
+
+    Programs traced from layers in eval() mode never contain dropout (the
+    Python layer gates it), so this matters only for IR built directly or
+    traced in train mode for deployment."""
+
+    name = "dropout_eliminate"
+
+    def run(self, program: Program) -> int:
+        changed = 0
+        for op in program.ops():
+            if op.name in ("pd.dropout", "dropout"):
+                n = op.result(0).replace_all_uses_with(op.operands[0])
+                erased = op.erase()
+                if n or erased:
+                    changed += 1
+        return changed

@@ -23,12 +23,12 @@ def show():
 
 
 def cuda():
-    return False
+    return cuda_version  # reference returns the version STRING ("False" when absent)
 
 
 def cudnn():
-    return False
+    return cudnn_version
 
 
 def xpu():
-    return False
+    return xpu_version

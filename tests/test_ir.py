@@ -1,0 +1,218 @@
+"""IR core + pass pipeline tests (paddle/ir + framework/ir analogs).
+
+Covers: native uniquing store (types, values, ops, attrs), verifier,
+printer, native DCE/CSE, jaxpr round-trip fidelity, constant folding,
+algebraic simplification, and the one-call optimize() pipeline.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import ir
+
+
+def _f32(ctx, *shape):
+    return ctx.tensor_type("float32", shape)
+
+
+class TestIrCore:
+    def test_type_uniquing(self):
+        ctx = ir.IrContext()
+        t1 = _f32(ctx, 4, 8)
+        t2 = _f32(ctx, 4, 8)
+        t3 = _f32(ctx, 8, 4)
+        assert t1.id == t2.id and t1.id != t3.id
+        assert t1.shape == (4, 8) and t1.dtype == "float32"
+
+    def test_build_print_verify(self):
+        prog = ir.Program()
+        x = prog.add_input(_f32(prog.ctx, 4))
+        y = prog.add_input(_f32(prog.ctx, 4))
+        op = prog.create_op("pd.add", [x, y], [_f32(prog.ctx, 4)],
+                            attrs={"axis": -1, "name": "z"})
+        prog.set_outputs([op.result(0)])
+        prog.verify()
+        text = str(prog)
+        assert '"pd.add"' in text and "axis: -1" in text and 'name: "z"' in text
+        assert op.attrs()["axis"] == -1
+        assert [v.id for v in op.operands] == [x.id, y.id]
+        assert x.num_uses == 1
+
+    def test_def_before_use_rejected(self):
+        prog = ir.Program()
+        x = prog.add_input(_f32(prog.ctx, 2))
+        a = prog.create_op("pd.neg", [x], [_f32(prog.ctx, 2)])
+        # manually point the op at a value defined later
+        b = prog.create_op("pd.neg", [a.result(0)], [_f32(prog.ctx, 2)])
+        a.set_operand(0, b.result(0))
+        with pytest.raises(ValueError):
+            prog.verify()
+
+    def test_native_dce(self):
+        prog = ir.Program()
+        x = prog.add_input(_f32(prog.ctx, 4))
+        live = prog.create_op("pd.neg", [x], [_f32(prog.ctx, 4)])
+        prog.create_op("pd.exp", [x], [_f32(prog.ctx, 4)])  # dead
+        dead2 = prog.create_op("pd.sin", [x], [_f32(prog.ctx, 4)])  # dead chain
+        prog.create_op("pd.cos", [dead2.result(0)], [_f32(prog.ctx, 4)])
+        effect = prog.create_op("pd.print", [x], [], side_effect=True)
+        prog.set_outputs([live.result(0)])
+        removed = prog.dce()
+        assert removed == 3
+        names = sorted(op.name for op in prog.ops())
+        assert names == ["pd.neg", "pd.print"]
+        assert effect.id in [op.id for op in prog.ops()]
+
+    def test_native_cse(self):
+        prog = ir.Program()
+        x = prog.add_input(_f32(prog.ctx, 4))
+        a = prog.create_op("pd.exp", [x], [_f32(prog.ctx, 4)], attrs={"k": 1})
+        b = prog.create_op("pd.exp", [x], [_f32(prog.ctx, 4)], attrs={"k": 1})
+        c = prog.create_op("pd.exp", [x], [_f32(prog.ctx, 4)], attrs={"k": 2})
+        add = prog.create_op("pd.add", [a.result(0), b.result(0)], [_f32(prog.ctx, 4)])
+        prog.set_outputs([add.result(0), c.result(0)])
+        merged = prog.cse()
+        assert merged == 1
+        # downstream add now reads the surviving exp twice
+        ops = {op.name: op for op in prog.ops() if op.name == "pd.add"}
+        operands = ops["pd.add"].operands
+        assert operands[0].id == operands[1].id == a.result(0).id
+        # attr-differing op survives
+        assert sum(1 for op in prog.ops() if op.name == "pd.exp") == 2
+
+
+class TestJaxprRoundTrip:
+    def test_round_trip_matches(self):
+        W = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+
+        def fn(x, b):
+            h = jnp.tanh(x @ W + b)
+            return h * 2.0
+
+        x = np.random.RandomState(1).randn(2, 8).astype(np.float32)
+        b = np.zeros(4, np.float32)
+        prog = ir.trace(fn, x, b)
+        assert len(prog) > 0
+        rebuilt = prog.to_callable()
+        np.testing.assert_allclose(rebuilt(x, b), fn(x, b), rtol=1e-6)
+        # and under jit
+        np.testing.assert_allclose(jax.jit(rebuilt)(x, b), fn(x, b), rtol=1e-6)
+
+    def test_pytree_signature_preserved(self):
+        def fn(params, x):
+            return {"out": x @ params["w"] + params["b"]}
+
+        params = {"w": np.ones((3, 2), np.float32), "b": np.zeros(2, np.float32)}
+        x = np.ones((1, 3), np.float32)
+        prog = ir.trace(fn, params, x)
+        out = prog.to_callable()(params, x)
+        assert set(out) == {"out"}
+        np.testing.assert_allclose(out["out"], fn(params, x)["out"])
+
+    def test_multi_result_primitive(self):
+        def fn(x):
+            vals, idx = jax.lax.top_k(x, 2)
+            return vals + idx.astype(jnp.float32)
+
+        x = np.array([3.0, 1.0, 2.0], np.float32)
+        prog = ir.trace(fn, x)
+        np.testing.assert_allclose(prog.to_callable()(x), fn(x))
+
+    def test_control_flow_opaque_params(self):
+        def fn(x):
+            return jax.lax.fori_loop(0, 3, lambda i, c: c * 2.0, x)
+
+        x = np.array([1.0, 2.0], np.float32)
+        prog = ir.trace(fn, x)
+        np.testing.assert_allclose(prog.to_callable()(x), fn(x))
+
+
+class TestPasses:
+    def test_cse_merges_duplicate_subexpr(self):
+        W = np.ones((4, 4), np.float32)
+
+        def fn(x):
+            return jnp.tanh(x @ W) + jnp.tanh(x @ W)
+
+        prog = ir.trace(fn, np.ones((2, 4), np.float32))
+        before = len(prog)
+        pm = ir.PassManager(["cse", "dce"])
+        stats = pm.run(prog)
+        assert stats["cse"] >= 1
+        assert len(prog) < before
+        x = np.random.RandomState(2).randn(2, 4).astype(np.float32)
+        np.testing.assert_allclose(prog.to_callable()(x), fn(x), rtol=1e-6)
+
+    def test_constant_folding(self):
+        c = jnp.arange(4, dtype=jnp.float32)
+
+        def fn(x):
+            return x + (c * 3.0 + 1.0)
+
+        prog = ir.trace(fn, np.ones(4, np.float32))
+        pm = ir.PassManager(["constant_folding", "cse", "dce"])
+        stats = pm.run(prog)
+        assert stats["constant_folding"] >= 1
+        # only the final add (+ constants) should remain
+        non_const = [op for op in prog.ops() if op.name != ir.core.CONSTANT_OP]
+        assert len(non_const) == 1 and non_const[0].name == "pd.add"
+        x = np.random.RandomState(3).randn(4).astype(np.float32)
+        np.testing.assert_allclose(prog.to_callable()(x), fn(x), rtol=1e-6)
+
+    def test_algebraic_simplify_add_zero(self):
+        def fn(x):
+            return x + jnp.zeros_like(x)
+
+        prog = ir.trace(fn, np.ones((3,), np.float32))
+        pm = ir.PassManager()  # default pipeline, fixed point
+        pm.run(prog)
+        assert all(op.name != "pd.add" for op in prog.ops())
+        x = np.random.RandomState(4).randn(3).astype(np.float32)
+        np.testing.assert_allclose(prog.to_callable()(x), fn(x))
+
+    def test_optimize_end_to_end(self):
+        W = np.random.RandomState(5).randn(4, 4).astype(np.float32)
+
+        def fn(x):
+            y = jnp.tanh(x @ W) + jnp.tanh(x @ W)
+            return y * 1.0 + jnp.zeros_like(y)
+
+        x = np.random.RandomState(6).randn(2, 4).astype(np.float32)
+        opt = ir.optimize(fn, x)
+        np.testing.assert_allclose(jax.jit(opt)(x), fn(x), rtol=1e-6)
+
+    def test_dropout_eliminate_on_manual_ir(self):
+        prog = ir.Program()
+        x = prog.add_input(prog.ctx.tensor_type("float32", (4,)))
+        d = prog.create_op("pd.dropout", [x], [prog.ctx.tensor_type("float32", (4,))],
+                           attrs={"p": 0.5})
+        out = prog.create_op("pd.neg", [d.result(0)], [prog.ctx.tensor_type("float32", (4,))])
+        prog.set_outputs([out.result(0)])
+        pm = ir.PassManager(["dropout_eliminate", "dce"])
+        stats = pm.run(prog)
+        assert stats["dropout_eliminate"] == 1
+        assert all(op.name != "pd.dropout" for op in prog.ops())
+
+
+class TestModelScale:
+    def test_mlp_model_trace_and_optimize(self):
+        """A realistic module-built model survives the pipeline."""
+        import paddle_tpu.nn as nn
+
+        paddle_tpu.seed(0)
+        model = nn.Sequential(
+            nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8), nn.Softmax(axis=-1),
+        )
+        model.eval()
+
+        def fwd(x):
+            return model(paddle_tpu.to_tensor(x))._value
+
+        x = np.random.RandomState(7).randn(2, 16).astype(np.float32)
+        prog = ir.trace(fwd, x)
+        pm = ir.PassManager()
+        pm.run(prog)
+        np.testing.assert_allclose(prog.to_callable()(x), fwd(x), rtol=1e-5, atol=1e-6)
