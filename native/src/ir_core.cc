@@ -186,7 +186,8 @@ int64_t ir_op_create(void* p, const char* name, const int64_t* operands,
 
 int64_t ir_op_result(void* p, int64_t op, int32_t i) {
   IrContext* c = Ctx(p);
-  if (!ValidOp(c, op) || i >= static_cast<int32_t>(c->ops[op].results.size())) return -1;
+  if (!ValidOp(c, op) || i < 0 ||
+      i >= static_cast<int32_t>(c->ops[op].results.size())) return -1;
   return c->ops[op].results[i];
 }
 const char* ir_op_name(void* p, int64_t op) {

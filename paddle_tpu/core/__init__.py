@@ -53,3 +53,5 @@ from .random import Generator, default_generator, get_rng_state, seed, set_rng_s
 from .tensor import Parameter, Tensor, to_tensor  # noqa: F401
 from .autograd import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
 from .op_registry import OpDef, get_op, list_ops, register_op  # noqa: F401
+from .selected_rows import SelectedRows  # noqa: F401,E402
+from .string_tensor import StringTensor  # noqa: F401,E402

@@ -1,0 +1,112 @@
+"""SelectedRows — row-sparse tensor (phi/core/selected_rows.h:27).
+
+Reference role: gradient of a vocab-size embedding touches only the looked-up
+rows, so the grad is stored as (rows, value[len(rows), emb]) with a logical
+``height`` = vocab size, and optimizers apply row-sparse updates
+(fluid/operators/optimizers/sgd_op etc. have SelectedRows overloads).
+
+TPU-first: rows/values are fixed-shape device arrays (duplicates allowed, as
+in the reference), so every method below is jit-traceable; merging duplicate
+rows — the reference's scatter_add MergeAdd (selected_rows_functor.cc) — is a
+segment-sum over a sorted row index, and dense application is one scatter-add.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    """Row-sparse value: ``dense[rows[i]] += value[i]`` semantics."""
+
+    def __init__(self, rows, value, height: int):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.value = jnp.asarray(value)
+        self._height = int(height)
+        if self.rows.shape[0] != self.value.shape[0]:
+            raise ValueError(
+                f"rows ({self.rows.shape[0]}) and value rows "
+                f"({self.value.shape[0]}) must match")
+
+    # ---- reference surface (selected_rows.h) ----
+    def height(self) -> int:
+        return self._height
+
+    def set_height(self, h: int):
+        self._height = int(h)
+
+    def numel(self) -> int:
+        return int(self.value.size)
+
+    def has_key(self, key: int):
+        return jnp.any(self.rows == key)
+
+    def sync_index(self):  # index is implicit here; kept for API parity
+        return self
+
+    @property
+    def shape(self):
+        return (self._height,) + tuple(self.value.shape[1:])
+
+    # ---- functional ops (selected_rows_functor.cc analogs) ----
+    def merge_add(self) -> "SelectedRows":
+        """Coalesce duplicate rows by summation (MergeAdd functor).
+
+        Keeps the row count static for XLA: output has the same number of
+        slots, with unique rows leading and freed slots parked at row -1
+        weight 0 (callers treat negative rows as padding).
+        """
+        if self.rows.shape[0] == 0:
+            return self
+        order = jnp.argsort(self.rows)
+        sorted_rows = self.rows[order]
+        sorted_vals = self.value[order]
+        # first occurrence of each run of equal rows
+        is_first = jnp.concatenate(
+            [jnp.ones((1,), bool), sorted_rows[1:] != sorted_rows[:-1]])
+        segment_ids = jnp.cumsum(is_first) - 1
+        n = self.rows.shape[0]
+        summed = jax.ops.segment_sum(sorted_vals, segment_ids, num_segments=n)
+        unique_rows = jnp.where(
+            jnp.arange(n) < segment_ids[-1] + 1,
+            jax.ops.segment_max(sorted_rows, segment_ids, num_segments=n),
+            -1)
+        return SelectedRows(unique_rows, summed, self._height)
+
+    def to_dense(self):
+        """Scatter-add into a dense [height, ...] tensor."""
+        dense = jnp.zeros(self.shape, self.value.dtype)
+        mask = (self.rows >= 0)[(...,) + (None,) * (self.value.ndim - 1)]
+        safe_rows = jnp.clip(self.rows, 0, self._height - 1)
+        return dense.at[safe_rows].add(jnp.where(mask, self.value, 0))
+
+    def apply_to(self, dense, alpha: Union[float, jax.Array] = 1.0):
+        """dense + alpha * self (the optimizer fast path: touched rows only)."""
+        dense = jnp.asarray(dense)
+        mask = (self.rows >= 0)[(...,) + (None,) * (self.value.ndim - 1)]
+        safe_rows = jnp.clip(self.rows, 0, self._height - 1)
+        return dense.at[safe_rows].add(alpha * jnp.where(mask, self.value, 0))
+
+    @classmethod
+    def from_dense_rows(cls, dense, rows: Sequence[int]) -> "SelectedRows":
+        rows = jnp.asarray(rows, jnp.int32)
+        return cls(rows, jnp.asarray(dense)[rows], dense.shape[0])
+
+    # pytree: rows/value traced, height static
+    def tree_flatten(self):
+        return (self.rows, self.value), self._height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, value = children
+        obj = cls.__new__(cls)
+        obj.rows, obj.value, obj._height = rows, value, height
+        return obj
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self._height}, "
+                f"rows={self.rows.shape[0]}, value_shape={tuple(self.value.shape)})")

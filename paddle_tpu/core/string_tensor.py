@@ -1,0 +1,100 @@
+"""StringTensor — variable-length string tensor (phi/core/string_tensor.h,
+kernels: phi/kernels/strings/strings_empty_kernel.h,
+strings_lower_upper_kernel.h with the unicode.h case tables).
+
+TPU-first: strings never touch the device — they are HOST data feeding the
+tokenizer/data pipeline (the accelerator only ever sees ids). So this is a
+numpy-object-backed host tensor with the reference's kernel surface (empty,
+lower, upper with a utf8 flag) plus the bridge that matters on TPU:
+``to_ids`` through the native C++ WordPiece tokenizer (tokenizer.cc).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class StringTensor:
+    """Host tensor of python strings with phi strings-kernel semantics."""
+
+    def __init__(self, data=None, shape: Optional[Sequence[int]] = None):
+        if data is None:
+            self._data = np.empty(tuple(shape) if shape is not None else (0,),
+                                  dtype=object)
+            self._data.fill("")
+        else:
+            arr = np.array(data, dtype=object)
+            self._data = arr
+
+    # ---- reference surface ----
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    def numel(self) -> int:
+        return int(self._data.size)
+
+    def dims(self):
+        return self.shape
+
+    @classmethod
+    def empty(cls, shape: Sequence[int]) -> "StringTensor":
+        """strings_empty_kernel analog."""
+        return cls(shape=shape)
+
+    def lower(self, use_utf8_encoding: bool = True) -> "StringTensor":
+        """strings_lower_upper_kernel: ascii-only unless use_utf8_encoding."""
+        return self._map(lambda s: s.lower() if use_utf8_encoding
+                         else _ascii_case(s, str.lower))
+
+    def upper(self, use_utf8_encoding: bool = True) -> "StringTensor":
+        return self._map(lambda s: s.upper() if use_utf8_encoding
+                         else _ascii_case(s, str.upper))
+
+    def _map(self, fn) -> "StringTensor":
+        out = StringTensor(shape=self.shape)
+        flat_in, flat_out = self._data.reshape(-1), out._data.reshape(-1)
+        for i, s in enumerate(flat_in):
+            flat_out[i] = fn(s)
+        return out
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        got = self._data[idx]
+        if isinstance(got, np.ndarray):
+            t = StringTensor.__new__(StringTensor)
+            t._data = got
+            return t
+        return got
+
+    def __setitem__(self, idx, value):
+        self._data[idx] = value
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        if isinstance(other, StringTensor):
+            other = other._data
+        return np.array_equal(self._data, np.asarray(other, dtype=object))
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data.tolist()!r})"
+
+    # ---- the TPU bridge: strings -> ids via the native tokenizer ----
+    def to_ids(self, tokenizer, max_len: int = 128, **kwargs):
+        """Encode through a FastWordPieceTokenizer (paddle_tpu.native):
+        returns {input_ids, attention_mask, lengths} numpy int32 arrays."""
+        texts = [str(s) for s in self._data.reshape(-1)]
+        return tokenizer(texts, max_len=max_len, **kwargs)
+
+
+def _ascii_case(s: str, fn) -> str:
+    return "".join(fn(ch) if ord(ch) < 128 else ch for ch in s)

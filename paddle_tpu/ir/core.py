@@ -319,7 +319,8 @@ class Program:
         if getattr(self.ctx, "_owner", None) is not None:
             raise ValueError("IrContext is already bound to a Program; "
                              "create a fresh context per program")
-        self.ctx._owner = self
+        self.ctx._owner = True  # sentinel, not self: avoid a ctx<->program
+        #                         cycle that would defer native store release
         self.op_bind: Dict[int, Tuple[Any, Dict[str, Any]]] = {}
         self.const_vals: Dict[int, Any] = {}
         self.in_tree = None

@@ -1,0 +1,135 @@
+"""Tensor-array ops (python/paddle/tensor/array.py: array_length:24,
+array_read:73, array_write:141, create_array:222; phi TensorArray
+phi/core/tensor_array.h).
+
+Reference semantics: dygraph mode = plain Python list; static mode =
+LOD_TENSOR_ARRAY variable. TPU-first split: eager keeps the list contract
+verbatim, and for compiled control flow — where the reference's C++
+TensorArray grows dynamically, which XLA cannot — ``TensorArray`` is a
+fixed-capacity ring of static shape (data [capacity, *elem], length scalar)
+registered as a pytree, so it threads through lax.fori_loop/scan/while_loop
+and jit without shape polymorphism.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..core.dtype import convert_dtype, to_jax_dtype
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _index(i) -> Union[int, jax.Array]:
+    i = _unwrap(i)
+    if hasattr(i, "reshape"):
+        return jnp.reshape(i, ()).astype(jnp.int32)
+    return int(i)
+
+
+def create_array(dtype: str = "float32", initialized_list: Optional[Sequence] = None) -> List[Tensor]:
+    """Eager tensor array = Python list (the reference's dygraph contract)."""
+    if initialized_list is None:
+        return []
+    if not isinstance(initialized_list, (list, tuple)):
+        raise TypeError(
+            f"Require type(initialized_list) should be list/tuple, but received {type(initialized_list)}")
+    return [x if isinstance(x, Tensor) else to_tensor(x, dtype=dtype)
+            for x in initialized_list]
+
+
+def array_write(x, i, array: Optional[list] = None) -> list:
+    """Write ``x`` at index ``i``; appends when i == len(array)."""
+    if array is not None and isinstance(array, TensorArray):
+        return array.write(i, x)
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    idx = int(_index(i))
+    if array is None:
+        array = []
+    if idx > len(array):
+        raise ValueError(f"array_write index {idx} out of range for array of length {len(array)}")
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    """Read element ``i``."""
+    if isinstance(array, TensorArray):
+        return array.read(i)
+    if not isinstance(array, list):
+        raise TypeError("The 'array' in array_read must be a list in dygraph mode")
+    return array[int(_index(i))]
+
+
+def array_length(array):
+    """Length of the array."""
+    if isinstance(array, TensorArray):
+        return array.length()
+    if not isinstance(array, list):
+        raise TypeError("The 'array' in array_length must be a list in dygraph mode")
+    return len(array)
+
+
+@jax.tree_util.register_pytree_node_class
+class TensorArray:
+    """Fixed-capacity tensor array for compiled control flow.
+
+    The static-mode LOD_TENSOR_ARRAY analog: functional (every write returns
+    a new TensorArray), static shapes throughout, so it lives happily as a
+    lax.fori_loop/while_loop carry or scan state on TPU.
+
+        ta = TensorArray.create(capacity=8, elem_shape=(4,), dtype="float32")
+        def body(i, ta):
+            return ta.write(i, jnp.full((4,), i, jnp.float32))
+        ta = jax.lax.fori_loop(0, 8, body, ta)
+        out = ta.stack()   # [8, 4]
+    """
+
+    def __init__(self, data, length):
+        self.data = data        # [capacity, *elem_shape]
+        self._length = length   # scalar int32 (traced or concrete)
+
+    @classmethod
+    def create(cls, capacity: int, elem_shape: Sequence[int], dtype="float32") -> "TensorArray":
+        jdt = to_jax_dtype(convert_dtype(dtype))
+        return cls(jnp.zeros((capacity,) + tuple(elem_shape), jdt),
+                   jnp.zeros((), jnp.int32))
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def write(self, i, x) -> "TensorArray":
+        idx = _index(i)
+        x = jnp.asarray(_unwrap(x), self.data.dtype)
+        data = jax.lax.dynamic_update_index_in_dim(self.data, x, idx, 0)
+        new_len = jnp.maximum(self._length, jnp.asarray(idx, jnp.int32) + 1)
+        return TensorArray(data, new_len)
+
+    def read(self, i):
+        return jax.lax.dynamic_index_in_dim(self.data, _index(i), 0, keepdims=False)
+
+    def length(self):
+        return self._length
+
+    def stack(self):
+        """All written slots in index order ([capacity, *elem]; slots past
+        length() hold zeros — slice host-side if the true length is static)."""
+        return self.data
+
+    # pytree protocol: data + length are leaves (both may be traced)
+    def tree_flatten(self):
+        return (self.data, self._length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)

@@ -1,0 +1,32 @@
+"""paddle_tpu.tensor — the ``paddle.tensor`` namespace
+(python/paddle/tensor/: creation, math, linalg, manipulation, logic, search,
+array — SURVEY §2.7 "tensor ops").
+
+The op implementations live in paddle_tpu.ops (one dispatch seam for eager /
+static capture); this package re-exports them under the reference's module
+layout so ``paddle.tensor.math.add`` style imports port verbatim.
+"""
+
+import sys as _sys
+
+from ..ops import creation, linalg, logic, manipulation, math, search  # noqa: F401
+from ..ops import array  # noqa: F401
+from ..ops.creation import *  # noqa: F401,F403
+from ..ops.linalg import *  # noqa: F401,F403
+from ..ops.logic import *  # noqa: F401,F403
+from ..ops.manipulation import *  # noqa: F401,F403
+from ..ops.math import *  # noqa: F401,F403
+from ..ops.search import *  # noqa: F401,F403
+from ..ops.array import (  # noqa: F401
+    TensorArray,
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+)
+
+# module aliases so `import paddle_tpu.tensor.math` resolves like the reference
+for _name, _mod in (("creation", creation), ("linalg", linalg), ("logic", logic),
+                    ("manipulation", manipulation), ("math", math),
+                    ("search", search), ("array", array)):
+    _sys.modules[__name__ + "." + _name] = _mod

@@ -1,0 +1,168 @@
+"""Tensor-type family tests: TensorArray (paddle/tensor/array.py),
+SelectedRows (phi/core/selected_rows.h), StringTensor
+(phi/core/string_tensor.h + strings kernels)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import SelectedRows, StringTensor
+from paddle_tpu.tensor import (
+    TensorArray, array_length, array_read, array_write, create_array,
+)
+
+
+class TestEagerArray:
+    def test_reference_contract(self):
+        # mirrors the docstring example at python/paddle/tensor/array.py:222
+        arr = create_array(dtype="float32")
+        x = paddle_tpu.full(shape=[3, 3], fill_value=5, dtype="float32")
+        i = paddle_tpu.zeros(shape=[1], dtype="int32")
+        arr = array_write(x, i, array=arr)
+        assert array_length(arr) == 1
+        got = array_read(arr, i)
+        np.testing.assert_allclose(got.numpy(), np.full((3, 3), 5, np.float32))
+
+    def test_initialized_list_and_overwrite(self):
+        arr = create_array("float32", initialized_list=[np.zeros(2, np.float32)])
+        arr = array_write(np.ones(2, np.float32), 0, arr)
+        np.testing.assert_allclose(array_read(arr, 0).numpy(), np.ones(2))
+        with pytest.raises(ValueError):
+            array_write(np.ones(2, np.float32), 5, arr)
+
+    def test_type_errors(self):
+        with pytest.raises(TypeError):
+            array_length("not a list")
+        with pytest.raises(TypeError):
+            array_read({"not": "a list"}, 0)
+
+
+class TestTensorArrayCompiled:
+    def test_fori_loop_write_stack(self):
+        ta = TensorArray.create(capacity=6, elem_shape=(3,), dtype="float32")
+
+        @jax.jit
+        def fill(ta):
+            def body(i, ta):
+                return ta.write(i, jnp.full((3,), i, jnp.float32))
+            return jax.lax.fori_loop(0, 6, body, ta)
+
+        out = fill(ta)
+        assert int(out.length()) == 6
+        np.testing.assert_allclose(
+            out.stack(), np.repeat(np.arange(6, dtype=np.float32)[:, None], 3, 1))
+
+    def test_read_under_jit_and_scan_carry(self):
+        ta = TensorArray.create(4, (2,), "float32")
+        ta = ta.write(2, jnp.array([7.0, 8.0]))
+
+        @jax.jit
+        def read2(ta):
+            return ta.read(jnp.int32(2))
+
+        np.testing.assert_allclose(read2(ta), [7.0, 8.0])
+
+        def step(carry, i):
+            return carry.write(i, jnp.array([1.0, 1.0]) * i), ()
+
+        out, _ = jax.lax.scan(step, ta, jnp.arange(4))
+        assert int(out.length()) == 4
+
+    def test_array_fns_dispatch_to_tensor_array(self):
+        ta = TensorArray.create(3, (2,), "float32")
+        ta = array_write(jnp.ones(2), 0, ta)
+        assert isinstance(ta, TensorArray)
+        np.testing.assert_allclose(array_read(ta, 0), [1.0, 1.0])
+        assert int(array_length(ta)) == 1
+
+
+class TestSelectedRows:
+    def test_basic_and_to_dense(self):
+        sr = SelectedRows(rows=[1, 3], value=np.array([[1., 2.], [3., 4.]], np.float32),
+                          height=5)
+        assert sr.height() == 5 and sr.shape == (5, 2)
+        dense = np.asarray(sr.to_dense())
+        expect = np.zeros((5, 2), np.float32)
+        expect[1] = [1, 2]
+        expect[3] = [3, 4]
+        np.testing.assert_allclose(dense, expect)
+        assert bool(sr.has_key(3)) and not bool(sr.has_key(0))
+
+    def test_merge_add_duplicates(self):
+        sr = SelectedRows(rows=[2, 0, 2, 0], height=4,
+                          value=np.array([[1.], [10.], [2.], [20.]], np.float32))
+        merged = sr.merge_add()
+        np.testing.assert_allclose(np.asarray(merged.to_dense()),
+                                   np.asarray(sr.to_dense()))
+        alive = np.asarray(merged.rows) >= 0
+        assert alive.sum() == 2  # two unique rows
+        np.testing.assert_allclose(sorted(np.asarray(merged.rows)[alive]), [0, 2])
+
+    def test_apply_to_matches_dense_grad_step(self):
+        # the optimizer fast path: W -= lr * sparse_grad
+        rng = np.random.RandomState(0)
+        W = rng.randn(6, 3).astype(np.float32)
+        grad = SelectedRows(rows=[4, 1, 4], height=6,
+                            value=rng.randn(3, 3).astype(np.float32))
+        fast = grad.apply_to(W, alpha=-0.1)
+        ref = W - 0.1 * np.asarray(grad.to_dense())
+        np.testing.assert_allclose(np.asarray(fast), ref, rtol=1e-6)
+
+    def test_jit_traceable(self):
+        sr = SelectedRows(rows=[0, 2], value=np.ones((2, 2), np.float32), height=3)
+
+        @jax.jit
+        def f(sr, W):
+            return sr.merge_add().apply_to(W, alpha=2.0)
+
+        out = f(sr, jnp.zeros((3, 2)))
+        np.testing.assert_allclose(np.asarray(out)[0], [2.0, 2.0])
+
+    def test_from_dense_rows(self):
+        W = np.arange(12, dtype=np.float32).reshape(4, 3)
+        sr = SelectedRows.from_dense_rows(W, [1, 3])
+        np.testing.assert_allclose(np.asarray(sr.value), W[[1, 3]])
+        assert sr.height() == 4
+
+    def test_shape_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            SelectedRows(rows=[0], value=np.ones((2, 2)), height=3)
+
+
+class TestStringTensor:
+    def test_empty_and_fill(self):
+        st = StringTensor.empty([2, 2])
+        assert st.shape == (2, 2) and st.numel() == 4
+        st[0, 0] = "Hello"
+        assert st[0, 0] == "Hello" and st[1, 1] == ""
+
+    def test_lower_upper_utf8(self):
+        st = StringTensor(["Hello WORLD", "Grüße ÄÖÜ"])
+        low = st.lower()
+        assert low.tolist() == ["hello world", "grüße äöü"]
+        up = st.upper()
+        assert up.tolist()[0] == "HELLO WORLD"
+
+    def test_ascii_mode_leaves_nonascii(self):
+        st = StringTensor(["Ärger Zone"])
+        low = st.lower(use_utf8_encoding=False)
+        assert low.tolist() == ["Ärger zone"]  # Ä untouched in ascii mode
+
+    def test_nested_shape_and_slicing(self):
+        st = StringTensor([["a", "b"], ["c", "d"]])
+        assert st.shape == (2, 2)
+        row = st[0]
+        assert isinstance(row, StringTensor) and row.tolist() == ["a", "b"]
+
+    def test_to_ids_via_native_tokenizer(self):
+        native = pytest.importorskip("paddle_tpu.native")
+        if not native.is_available():
+            pytest.skip("native toolchain unavailable")
+        vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "hello", "world"]
+        tok = native.FastWordPieceTokenizer(vocab)
+        st = StringTensor(["hello world"])
+        enc = st.to_ids(tok, max_len=8)
+        ids = enc["input_ids"][0]
+        assert list(ids[:4]) == [2, 4, 5, 3]  # [CLS] hello world [SEP]
