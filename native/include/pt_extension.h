@@ -1,0 +1,123 @@
+// paddle_tpu custom-op extension header — the PT_BUILD_OP ABI.
+//
+// Reference surface: phi/api/ext/op_meta_info.h:898 PD_BUILD_OP (+
+// PD_BUILD_GRAD_OP) and fluid/framework/custom_operator.cc's .so loading.
+// TPU-first split: custom *device* kernels belong in Pallas; this ABI covers
+// custom HOST ops (data augmentation, tokenizers, CPU scoring) which the
+// framework invokes eagerly or under jit via a host callback.
+//
+// Usage (user .cc, self-contained — include this header once per .so):
+//
+//   #include "pt_extension.h"
+//   static int relu_infer(const PT_Tensor* ins, int n_in, PT_Tensor* outs, int n_out) {
+//     outs[0].dtype = ins[0].dtype; outs[0].ndim = ins[0].ndim;
+//     for (int i = 0; i < ins[0].ndim; ++i) outs[0].shape[i] = ins[0].shape[i];
+//     return 0;
+//   }
+//   static int relu_compute(const PT_Tensor* ins, int n_in, PT_Tensor* outs, int n_out) {
+//     const float* x = (const float*)ins[0].data; float* y = (float*)outs[0].data;
+//     int64_t n = pt_numel(&ins[0]);
+//     for (int64_t i = 0; i < n; ++i) y[i] = x[i] > 0 ? x[i] : 0;
+//     return 0;
+//   }
+//   PT_BUILD_OP(my_relu, 1, 1, relu_compute, relu_infer)
+//
+// A grad op named <op>_grad (inputs: forward inputs, then forward outputs,
+// then output grads; outputs: input grads) is auto-wired into autodiff by
+// the Python loader.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#define PT_MAX_NDIM 8
+
+extern "C" {
+
+// dtype codes match paddle_tpu.native._DTYPE_CODES
+typedef struct {
+  int32_t dtype;
+  int32_t ndim;
+  int64_t shape[PT_MAX_NDIM];
+  void* data;  // null during shape inference
+} PT_Tensor;
+
+typedef int (*PT_KernelFn)(const PT_Tensor* ins, int32_t n_in,
+                           PT_Tensor* outs, int32_t n_out);
+}  // extern "C"
+
+inline int64_t pt_numel(const PT_Tensor* t) {
+  int64_t n = 1;
+  for (int32_t i = 0; i < t->ndim; ++i) n *= t->shape[i];
+  return n;
+}
+
+namespace pt_ext {
+
+struct OpDef {
+  std::string name;
+  int32_t n_in;
+  int32_t n_out;
+  PT_KernelFn compute;
+  PT_KernelFn infer;
+};
+
+inline std::vector<OpDef>& Registry() {
+  static std::vector<OpDef> registry;
+  return registry;
+}
+
+struct Registrar {
+  Registrar(const char* name, int32_t n_in, int32_t n_out,
+            PT_KernelFn compute, PT_KernelFn infer) {
+    Registry().push_back(OpDef{name, n_in, n_out, compute, infer});
+  }
+};
+
+}  // namespace pt_ext
+
+#define PT_BUILD_OP(opname, n_in, n_out, compute_fn, infer_fn)            \
+  static ::pt_ext::Registrar __pt_reg_##opname(#opname, n_in, n_out,      \
+                                               compute_fn, infer_fn);
+
+// ---- discovery ABI consumed by paddle_tpu.utils.cpp_extension.load ----
+extern "C" {
+
+__attribute__((visibility("default"), used)) inline int32_t pt_num_ops() {
+  return static_cast<int32_t>(pt_ext::Registry().size());
+}
+
+__attribute__((visibility("default"), used)) inline const char* pt_op_name(int32_t i) {
+  auto& r = pt_ext::Registry();
+  if (i < 0 || i >= static_cast<int32_t>(r.size())) return nullptr;
+  return r[i].name.c_str();
+}
+
+__attribute__((visibility("default"), used)) inline int32_t pt_op_n_in(int32_t i) {
+  auto& r = pt_ext::Registry();
+  return (i < 0 || i >= static_cast<int32_t>(r.size())) ? -1 : r[i].n_in;
+}
+
+__attribute__((visibility("default"), used)) inline int32_t pt_op_n_out(int32_t i) {
+  auto& r = pt_ext::Registry();
+  return (i < 0 || i >= static_cast<int32_t>(r.size())) ? -1 : r[i].n_out;
+}
+
+__attribute__((visibility("default"), used)) inline int32_t pt_op_infer(
+    int32_t i, const PT_Tensor* ins, int32_t n_in, PT_Tensor* outs, int32_t n_out) {
+  auto& r = pt_ext::Registry();
+  if (i < 0 || i >= static_cast<int32_t>(r.size())) return -1;
+  return r[i].infer(ins, n_in, outs, n_out);
+}
+
+__attribute__((visibility("default"), used)) inline int32_t pt_op_compute(
+    int32_t i, const PT_Tensor* ins, int32_t n_in, PT_Tensor* outs, int32_t n_out) {
+  auto& r = pt_ext::Registry();
+  if (i < 0 || i >= static_cast<int32_t>(r.size())) return -1;
+  return r[i].compute(ins, n_in, outs, n_out);
+}
+
+}  // extern "C"

@@ -17,6 +17,7 @@ from typing import Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @jax.tree_util.register_pytree_node_class
@@ -31,6 +32,12 @@ class SelectedRows:
             raise ValueError(
                 f"rows ({self.rows.shape[0]}) and value rows "
                 f"({self.value.shape[0]}) must match")
+        if not isinstance(self.rows, jax.core.Tracer):
+            bad = np.asarray(self.rows) >= self._height
+            if bad.any():
+                raise ValueError(
+                    f"row indices {np.asarray(self.rows)[bad].tolist()} out of "
+                    f"range for height {self._height}")
 
     # ---- reference surface (selected_rows.h) ----
     def height(self) -> int:

@@ -83,7 +83,12 @@ class StringTensor:
     def __eq__(self, other):
         if isinstance(other, StringTensor):
             other = other._data
+        elif not isinstance(other, (list, tuple, np.ndarray, str)):
+            return NotImplemented
         return np.array_equal(self._data, np.asarray(other, dtype=object))
+
+    __hash__ = object.__hash__  # identity hashing (defining __eq__ alone
+    #                             would make instances unhashable)
 
     def __repr__(self):
         return f"StringTensor(shape={self.shape}, data={self._data.tolist()!r})"

@@ -110,13 +110,30 @@ class TensorArray:
 
     def write(self, i, x) -> "TensorArray":
         idx = _index(i)
+        if isinstance(idx, int):  # concrete: range-check eagerly
+            if not 0 <= idx < self.capacity:
+                raise IndexError(
+                    f"TensorArray write index {idx} out of range for capacity "
+                    f"{self.capacity} (fixed-capacity; size it at create())")
         x = jnp.asarray(_unwrap(x), self.data.dtype)
         data = jax.lax.dynamic_update_index_in_dim(self.data, x, idx, 0)
-        new_len = jnp.maximum(self._length, jnp.asarray(idx, jnp.int32) + 1)
+        # traced indices clamp (XLA semantics); length never exceeds capacity
+        # so stack()/length() stay consistent
+        new_len = jnp.minimum(
+            jnp.maximum(self._length, jnp.asarray(idx, jnp.int32) + 1),
+            self.capacity)
         return TensorArray(data, new_len)
 
     def read(self, i):
-        return jax.lax.dynamic_index_in_dim(self.data, _index(i), 0, keepdims=False)
+        idx = _index(i)
+        if isinstance(idx, int):
+            if idx < 0:
+                idx += self.capacity  # python-style negative indexing
+            if not 0 <= idx < self.capacity:
+                raise IndexError(
+                    f"TensorArray read index {i} out of range for capacity "
+                    f"{self.capacity}")
+        return jax.lax.dynamic_index_in_dim(self.data, idx, 0, keepdims=False)
 
     def length(self):
         return self._length

@@ -1,10 +1,16 @@
-"""Build-and-load for user C++ extensions (custom host ops).
+"""Build-and-load for user C++ custom ops (the PD_BUILD_OP flow).
 
-Reference surface: python/paddle/utils/cpp_extension/ (CppExtension/
-CUDAExtension + JIT `load`). The TPU-native analog compiles a C++ source
-with g++ into a shared object and returns a ctypes handle; custom *device*
-ops belong in Pallas, so this path covers host-side ops only (tokenizers,
-data feeds, IO) — the same split as SURVEY.md §7's C++ component list.
+Reference surface: python/paddle/utils/cpp_extension/ (CppExtension +
+JIT `load`), phi/api/ext/op_meta_info.h:898 PD_BUILD_OP, and
+fluid/framework/custom_operator.cc (.so op discovery + registration).
+
+TPU-first split: custom *device* kernels belong in Pallas (paddle_tpu.kernels)
+— this path covers custom HOST ops. A loaded op is exposed as a Python
+callable that (a) runs directly on numpy when called eagerly, and (b) lowers
+to ``jax.pure_callback`` when traced, so it composes with jit pipelines. If
+the .so also registers ``<name>_grad`` (inputs = forward ins + forward outs +
+out grads; outputs = in grads), the op is wrapped in ``jax.custom_vjp`` so it
+differentiates.
 """
 
 from __future__ import annotations
@@ -14,12 +20,32 @@ import hashlib
 import os
 import subprocess
 import tempfile
+import types
+from typing import List, Optional, Sequence
 
-__all__ = ["load", "CppExtension", "get_build_directory"]
+import numpy as np
+
+__all__ = ["load", "CppExtension", "CUDAExtension", "get_build_directory"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_EXT_INCLUDE = os.path.normpath(os.path.join(_HERE, "..", "..", "native", "include"))
+
+from ..native import _CODE_DTYPES, _DTYPE_CODES  # single source of truth for the ABI
+_PT_MAX_NDIM = 8
+
+
+class _PTTensor(ctypes.Structure):
+    _fields_ = [
+        ("dtype", ctypes.c_int32),
+        ("ndim", ctypes.c_int32),
+        ("shape", ctypes.c_int64 * _PT_MAX_NDIM),
+        ("data", ctypes.c_void_p),
+    ]
 
 
 def get_build_directory() -> str:
-    d = os.environ.get("PADDLE_TPU_EXTENSION_DIR", os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions"))
+    d = os.environ.get("PADDLE_TPU_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions"))
     os.makedirs(d, exist_ok=True)
     return d
 
@@ -31,18 +57,178 @@ class CppExtension:
         self.include_dirs = include_dirs or []
 
 
-def load(name: str, sources, extra_cxx_cflags=None, extra_include_paths=None, build_directory: str = None, verbose: bool = False):
-    """JIT-compile C++ sources into <build_dir>/<name>.so and load via ctypes."""
+def CUDAExtension(*args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension is not supported on the TPU build: write device "
+        "kernels in Pallas (paddle_tpu.kernels) and host ops via PT_BUILD_OP "
+        "(native/include/pt_extension.h)")
+
+
+def _meta_tensor(dtype_name: str, shape: Sequence[int]) -> _PTTensor:
+    t = _PTTensor()
+    t.dtype = _DTYPE_CODES[dtype_name]
+    t.ndim = len(shape)
+    for i, s in enumerate(shape):
+        t.shape[i] = int(s)
+    t.data = None
+    return t
+
+
+def _np_tensor(arr: np.ndarray) -> _PTTensor:
+    t = _meta_tensor(arr.dtype.name, arr.shape)
+    t.data = arr.ctypes.data_as(ctypes.c_void_p)
+    return t
+
+
+class _CustomOp:
+    """One registered op: eager numpy execution + jit lowering."""
+
+    def __init__(self, lib, index: int, name: str, n_in: int, n_out: int):
+        self._lib, self._index = lib, index
+        self.name, self.n_in, self.n_out = name, n_in, n_out
+
+    def infer(self, in_metas: List[tuple]) -> List[tuple]:
+        """[(dtype_name, shape), ...] -> output metas via the C infer fn."""
+        if len(in_metas) != self.n_in:
+            raise ValueError(f"{self.name} expects {self.n_in} inputs, got {len(in_metas)}")
+        for dt, shape in in_metas:
+            if len(shape) > _PT_MAX_NDIM:
+                raise ValueError(f"{self.name}: ndim {len(shape)} exceeds PT_MAX_NDIM")
+        ins = (_PTTensor * max(self.n_in, 1))(*[_meta_tensor(d, s) for d, s in in_metas])
+        outs = (_PTTensor * max(self.n_out, 1))()
+        rc = self._lib.pt_op_infer(self._index, ins, self.n_in, outs, self.n_out)
+        if rc != 0:
+            raise RuntimeError(f"shape inference failed for custom op {self.name} (rc={rc})")
+        return [(_CODE_DTYPES[outs[i].dtype],
+                 tuple(outs[i].shape[j] for j in range(outs[i].ndim)))
+                for i in range(self.n_out)]
+
+    def _run_numpy(self, *arrays: np.ndarray):
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        metas = self.infer([(a.dtype.name, a.shape) for a in arrays])
+        out_arrays = [np.empty(shape, dtype=dt) for dt, shape in metas]
+        ins = (_PTTensor * max(self.n_in, 1))(*[_np_tensor(a) for a in arrays])
+        outs = (_PTTensor * max(self.n_out, 1))(*[_np_tensor(a) for a in out_arrays])
+        rc = self._lib.pt_op_compute(self._index, ins, self.n_in, outs, self.n_out)
+        if rc != 0:
+            raise RuntimeError(f"custom op {self.name} failed (rc={rc})")
+        return out_arrays[0] if self.n_out == 1 else tuple(out_arrays)
+
+    def __call__(self, *args):
+        import jax
+
+        from ..core.tensor import Tensor
+
+        wrap = any(isinstance(a, Tensor) for a in args)
+        vals = [a._value if isinstance(a, Tensor) else a for a in args]
+        traced = any(isinstance(v, jax.core.Tracer) for v in vals)
+        if not traced:
+            out = self._run_numpy(*[np.asarray(v) for v in vals])
+            if wrap:
+                from ..core.tensor import to_tensor
+                return to_tensor(out) if self.n_out == 1 else tuple(to_tensor(o) for o in out)
+            return out
+        # traced: lower to a host callback with C-side shape inference
+        metas = self.infer([(str(v.dtype), v.shape) for v in vals])
+        result_shapes = [jax.ShapeDtypeStruct(s, np.dtype(d)) for d, s in metas]
+        if self.n_out == 1:
+            result_shapes = result_shapes[0]
+        fn = lambda *a: self._run_numpy(*[np.asarray(x) for x in a])
+        return jax.pure_callback(fn, result_shapes, *vals)
+
+
+def _wire_autodiff(fwd: _CustomOp, grad: _CustomOp):
+    """custom_vjp over the op pair (PD_BUILD_GRAD_OP convention:
+    grad inputs = fwd ins + fwd outs + out grads; grad outputs = in grads)."""
+    import jax
+
+    @jax.custom_vjp
+    def core_op(*xs):
+        return fwd(*xs)
+
+    def fwd_rule(*xs):
+        ys = fwd(*xs)
+        return ys, (xs, ys if isinstance(ys, tuple) else (ys,))
+
+    def bwd_rule(res, gys):
+        xs, ys = res
+        gys = gys if isinstance(gys, tuple) else (gys,)
+        gxs = grad(*xs, *ys, *gys)
+        return gxs if isinstance(gxs, tuple) else (gxs,)
+
+    core_op.defvjp(fwd_rule, bwd_rule)
+
+    def op(*args):
+        # Tensor unwrap must happen OUTSIDE custom_vjp: jax abstracts the
+        # wrapper's args, and the Tensor facade is not a pytree
+        from ..core.tensor import Tensor, to_tensor
+
+        wrap = any(isinstance(a, Tensor) for a in args)
+        vals = [a._value if isinstance(a, Tensor) else a for a in args]
+        out = core_op(*vals)
+        if wrap:
+            return (tuple(to_tensor(o) for o in out) if isinstance(out, tuple)
+                    else to_tensor(out))
+        return out
+
+    op.__name__ = fwd.name
+    return op
+
+
+def load(name: str, sources, extra_cxx_cflags=None, extra_include_paths=None,
+         build_directory: Optional[str] = None, verbose: bool = False):
+    """JIT-compile sources into <build_dir>/<name>_<hash>.so and return a
+    module exposing every PT_BUILD_OP-registered op as a callable (the
+    reference's `paddle.utils.cpp_extension.load` contract). Raw ctypes
+    access stays available as module._lib; a plain .so without the
+    PT_BUILD_OP registry loads as a bare ctypes.CDLL (legacy behavior)."""
     sources = [sources] if isinstance(sources, str) else list(sources)
     build_dir = build_directory or get_build_directory()
-    tag = hashlib.sha1("".join(open(s, "rb").read().decode(errors="ignore") for s in sources).encode()).hexdigest()[:10]
+    tag = hashlib.sha1(
+        "".join(open(s, "rb").read().decode(errors="ignore") for s in sources).encode()
+    ).hexdigest()[:10]
     so_path = os.path.join(build_dir, f"{name}_{tag}.so")
     if not os.path.exists(so_path):
-        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", so_path, *sources]
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+               "-I", _EXT_INCLUDE, "-o", so_path, *sources]
         for inc in extra_include_paths or []:
             cmd += ["-I", inc]
         cmd += extra_cxx_cflags or []
         if verbose:
             print(" ".join(cmd))
-        subprocess.run(cmd, check=True, capture_output=not verbose)
-    return ctypes.CDLL(so_path)
+        try:
+            subprocess.run(cmd, check=True, capture_output=not verbose)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"building custom op extension '{name}' failed:\n"
+                f"{(e.stderr or b'').decode(errors='ignore')}") from e
+    lib = ctypes.CDLL(so_path)
+    if not hasattr(lib, "pt_num_ops"):
+        return lib  # legacy: plain .so without the PT_BUILD_OP registry
+
+    lib.pt_num_ops.restype = ctypes.c_int32
+    lib.pt_op_name.restype = ctypes.c_char_p
+    lib.pt_op_name.argtypes = [ctypes.c_int32]
+    for f in (lib.pt_op_n_in, lib.pt_op_n_out):
+        f.restype = ctypes.c_int32
+        f.argtypes = [ctypes.c_int32]
+    for f in (lib.pt_op_infer, lib.pt_op_compute):
+        f.restype = ctypes.c_int32
+        f.argtypes = [ctypes.c_int32, ctypes.POINTER(_PTTensor), ctypes.c_int32,
+                      ctypes.POINTER(_PTTensor), ctypes.c_int32]
+
+    mod = types.ModuleType(name)
+    mod._lib = lib
+    mod.__file__ = so_path
+    ops = {}
+    for i in range(lib.pt_num_ops()):
+        op_name = lib.pt_op_name(i).decode()
+        ops[op_name] = _CustomOp(lib, i, op_name,
+                                 lib.pt_op_n_in(i), lib.pt_op_n_out(i))
+    for op_name, op in ops.items():
+        if op_name.endswith("_grad"):
+            continue
+        grad = ops.get(op_name + "_grad")
+        setattr(mod, op_name, _wire_autodiff(op, grad) if grad else op)
+    mod._ops = ops
+    return mod

@@ -1,0 +1,167 @@
+"""Custom C++ op tests (PD_BUILD_OP / paddle.utils.cpp_extension.load
+analog — phi/api/ext/op_meta_info.h:898, custom_operator.cc)."""
+
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.utils import cpp_extension
+
+RELU_SRC = textwrap.dedent("""
+    #include "pt_extension.h"
+
+    static int same_meta(const PT_Tensor* ins, int32_t n_in,
+                         PT_Tensor* outs, int32_t n_out) {
+      outs[0].dtype = ins[0].dtype;
+      outs[0].ndim = ins[0].ndim;
+      for (int i = 0; i < ins[0].ndim; ++i) outs[0].shape[i] = ins[0].shape[i];
+      return 0;
+    }
+
+    static int relu_fwd(const PT_Tensor* ins, int32_t n_in,
+                        PT_Tensor* outs, int32_t n_out) {
+      const float* x = (const float*)ins[0].data;
+      float* y = (float*)outs[0].data;
+      for (int64_t i = 0; i < pt_numel(&ins[0]); ++i) y[i] = x[i] > 0 ? x[i] : 0;
+      return 0;
+    }
+
+    // grad inputs: x, y, dy -> dx
+    static int relu_grad_meta(const PT_Tensor* ins, int32_t n_in,
+                              PT_Tensor* outs, int32_t n_out) {
+      outs[0].dtype = ins[0].dtype;
+      outs[0].ndim = ins[0].ndim;
+      for (int i = 0; i < ins[0].ndim; ++i) outs[0].shape[i] = ins[0].shape[i];
+      return 0;
+    }
+
+    static int relu_bwd(const PT_Tensor* ins, int32_t n_in,
+                        PT_Tensor* outs, int32_t n_out) {
+      const float* x = (const float*)ins[0].data;
+      const float* dy = (const float*)ins[2].data;
+      float* dx = (float*)outs[0].data;
+      for (int64_t i = 0; i < pt_numel(&ins[0]); ++i) dx[i] = x[i] > 0 ? dy[i] : 0;
+      return 0;
+    }
+
+    PT_BUILD_OP(custom_relu, 1, 1, relu_fwd, same_meta)
+    PT_BUILD_OP(custom_relu_grad, 3, 1, relu_bwd, relu_grad_meta)
+
+    // two-output op: (x+y, x*y)
+    static int addmul_meta(const PT_Tensor* ins, int32_t n_in,
+                           PT_Tensor* outs, int32_t n_out) {
+      for (int o = 0; o < 2; ++o) {
+        outs[o].dtype = ins[0].dtype;
+        outs[o].ndim = ins[0].ndim;
+        for (int i = 0; i < ins[0].ndim; ++i) outs[o].shape[i] = ins[0].shape[i];
+      }
+      return 0;
+    }
+
+    static int addmul(const PT_Tensor* ins, int32_t n_in,
+                      PT_Tensor* outs, int32_t n_out) {
+      const float* a = (const float*)ins[0].data;
+      const float* b = (const float*)ins[1].data;
+      float* s = (float*)outs[0].data;
+      float* p = (float*)outs[1].data;
+      for (int64_t i = 0; i < pt_numel(&ins[0]); ++i) { s[i] = a[i] + b[i]; p[i] = a[i] * b[i]; }
+      return 0;
+    }
+
+    PT_BUILD_OP(custom_addmul, 2, 2, addmul, addmul_meta)
+""")
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    d = tmp_path_factory.mktemp("custom_op")
+    src = os.path.join(d, "relu_op.cc")
+    with open(src, "w") as f:
+        f.write(RELU_SRC)
+    return cpp_extension.load("my_ext", src, build_directory=str(d))
+
+
+class TestCustomOp:
+    def test_discovery(self, ext):
+        assert set(ext._ops) == {"custom_relu", "custom_relu_grad", "custom_addmul"}
+        assert ext._ops["custom_relu"].n_in == 1
+        assert ext._ops["custom_addmul"].n_out == 2
+
+    def test_eager_numpy(self, ext):
+        x = np.array([-1.0, 2.0, -3.0, 4.0], np.float32)
+        np.testing.assert_allclose(ext.custom_relu(x), [0, 2, 0, 4])
+
+    def test_eager_tensor_wrapping(self, ext):
+        t = paddle_tpu.to_tensor(np.array([-1.0, 5.0], np.float32))
+        out = ext.custom_relu(t)
+        assert isinstance(out, paddle_tpu.Tensor)
+        np.testing.assert_allclose(out.numpy(), [0, 5])
+
+    def test_under_jit(self, ext):
+        x = np.array([[-1.0, 2.0], [3.0, -4.0]], np.float32)
+
+        @jax.jit
+        def f(x):
+            return ext.custom_relu(x) * 2.0
+
+        np.testing.assert_allclose(f(x), np.maximum(x, 0) * 2)
+
+    def test_grad_wiring(self, ext):
+        x = np.array([-1.0, 2.0, 3.0, -4.0], np.float32)
+        g = jax.grad(lambda x: jnp.sum(ext.custom_relu(x) ** 2))(x)
+        expect = np.where(x > 0, 2 * x, 0)
+        np.testing.assert_allclose(np.asarray(g), expect)
+
+    def test_grad_under_jit(self, ext):
+        x = np.array([1.0, -2.0], np.float32)
+        g = jax.jit(jax.grad(lambda x: jnp.sum(ext.custom_relu(x))))(x)
+        np.testing.assert_allclose(np.asarray(g), [1.0, 0.0])
+
+    def test_multi_output(self, ext):
+        a = np.array([1.0, 2.0], np.float32)
+        b = np.array([3.0, 4.0], np.float32)
+        s, p = ext.custom_addmul(a, b)
+        np.testing.assert_allclose(s, [4, 6])
+        np.testing.assert_allclose(p, [3, 8])
+
+        @jax.jit
+        def f(a, b):
+            s, p = ext.custom_addmul(a, b)
+            return s + p
+
+        np.testing.assert_allclose(f(a, b), [7, 14])
+
+    def test_arity_error(self, ext):
+        with pytest.raises(ValueError):
+            ext.custom_addmul(np.ones(2, np.float32))
+
+    def test_compile_error_surfaces(self, tmp_path):
+        bad = tmp_path / "bad.cc"
+        bad.write_text("this is not C++")
+        with pytest.raises(RuntimeError, match="failed"):
+            cpp_extension.load("bad_ext", str(bad), build_directory=str(tmp_path))
+
+    def test_in_layer_with_to_static(self, ext):
+        """A custom op inside a Layer forward, used through the framework."""
+        import paddle_tpu.nn as nn
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                return ext.custom_relu(h)
+
+        paddle_tpu.seed(0)
+        net = Net()
+        x = paddle_tpu.to_tensor(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+        out = net(x)
+        ref = np.maximum(np.asarray(net.fc(x).numpy()), 0)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
