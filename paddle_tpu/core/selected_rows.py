@@ -25,6 +25,10 @@ class SelectedRows:
     """Row-sparse value: ``dense[rows[i]] += value[i]`` semantics."""
 
     def __init__(self, rows, value, height: int):
+        # device arrays are produced by internal paths (merge_add) that
+        # guarantee range; validating them would force a host sync per
+        # construction. Host inputs (lists/np) are user data — check those.
+        from_host = not isinstance(rows, (jax.Array, jax.core.Tracer))
         self.rows = jnp.asarray(rows, jnp.int32)
         self.value = jnp.asarray(value)
         self._height = int(height)
@@ -32,8 +36,8 @@ class SelectedRows:
             raise ValueError(
                 f"rows ({self.rows.shape[0]}) and value rows "
                 f"({self.value.shape[0]}) must match")
-        if not isinstance(self.rows, jax.core.Tracer):
-            bad = np.asarray(self.rows) >= self._height
+        if from_host:
+            bad = np.asarray(rows, np.int64) >= self._height
             if bad.any():
                 raise ValueError(
                     f"row indices {np.asarray(self.rows)[bad].tolist()} out of "

@@ -1,0 +1,11 @@
+"""paddle.incubate.autotune surface (python/paddle/incubate/autotune.py:
+set_config) over the kernel autotune cache (phi/kernels/autotune)."""
+
+from ..kernels.autotune import (  # noqa: F401
+    autotune_status,
+    disable_autotune,
+    enable_autotune,
+    set_config,
+)
+
+__all__ = ["set_config"]

@@ -226,25 +226,54 @@ def _pick_blocks(S: int):
     return None, None
 
 
+def _select_blocks(BH: int, S: int, D: int, dtype, causal: bool):
+    """Heuristic default, upgraded by the autotune cache when tuning is on
+    (phi/kernels/autotune AutoTuneBase::PickBestAlgorithm analog). Measured
+    configs are keyed by (BH, S, D, dtype, causal) and persist across runs;
+    fwd and bwd share the winning blocks so the saved residual layout
+    matches."""
+    from . import autotune
+
+    default = _pick_blocks(S)
+    if default[0] is None:
+        return default
+    candidates = [(bq, bk)
+                  for bq in (512, 256, 128) if S % bq == 0
+                  for bk in (512, 256, 128) if S % bk == 0]
+    if not candidates:
+        candidates = [default]
+
+    def make_run(cfg):
+        bq, bk = cfg
+        q = jnp.zeros((BH, S, 1, D), dtype)
+        fn = jax.jit(lambda q: _fwd(q, q, q, causal, 1.0, bq, bk)[0])
+        return lambda: fn(q)
+
+    picked = autotune.pick_best(
+        "flash_attention", (BH, S, D, str(jnp.dtype(dtype)), bool(causal)),
+        candidates, make_run, default=default)
+    return tuple(picked)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, causal, scale):
     B, S, H, D = q.shape
-    bq, bk = _pick_blocks(S)
+    bq, bk = _select_blocks(B * H, S, D, q.dtype, causal)
     o, _, _ = _fwd(q, k, v, causal, scale, bq, bk)
     return jnp.swapaxes(o.reshape(B, H, S, D), 1, 2)
 
 
 def _flash_fwd_rule(q, k, v, causal, scale):
     B, S, H, D = q.shape
-    bq, bk = _pick_blocks(S)
+    bq, bk = _select_blocks(B * H, S, D, q.dtype, causal)
     o, lse, (qt, kt, vt) = _fwd(q, k, v, causal, scale, bq, bk)
     out = jnp.swapaxes(o.reshape(B, H, S, D), 1, 2)
     return out, (qt, kt, vt, o, lse)
 
 
 def _flash_bwd_rule(causal, scale, res, g):
-    S = res[0].shape[1]
-    bq, bk = _pick_blocks(S)
+    BH, S, D = res[0].shape
+    bq, bk = _select_blocks(BH, S, D, res[0].dtype, causal)
     return _bwd(causal, scale, bq, bk, res, g)
 
 

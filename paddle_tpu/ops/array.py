@@ -128,7 +128,14 @@ class TensorArray:
         idx = _index(i)
         if isinstance(idx, int):
             if idx < 0:
-                idx += self.capacity  # python-style negative indexing
+                # python-style negatives resolve against the logical length
+                # (matching the eager list contract); a traced length makes
+                # that ambiguous, so reject rather than guess
+                if isinstance(self._length, jax.core.Tracer):
+                    raise IndexError(
+                        "TensorArray negative read index is ambiguous while "
+                        "the length is traced; use a non-negative index")
+                idx += int(self._length)
             if not 0 <= idx < self.capacity:
                 raise IndexError(
                     f"TensorArray read index {i} out of range for capacity "

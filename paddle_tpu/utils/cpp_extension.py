@@ -184,9 +184,15 @@ def load(name: str, sources, extra_cxx_cflags=None, extra_include_paths=None,
     PT_BUILD_OP registry loads as a bare ctypes.CDLL (legacy behavior)."""
     sources = [sources] if isinstance(sources, str) else list(sources)
     build_dir = build_directory or get_build_directory()
-    tag = hashlib.sha1(
-        "".join(open(s, "rb").read().decode(errors="ignore") for s in sources).encode()
-    ).hexdigest()[:10]
+    # tag covers user sources + the ABI header + the effective flags, so a
+    # paddle_tpu upgrade or flag change can never reuse a stale .so
+    hasher = hashlib.sha1()
+    for s in sources + [os.path.join(_EXT_INCLUDE, "pt_extension.h")]:
+        with open(s, "rb") as f:
+            hasher.update(f.read())
+    hasher.update(repr((sorted(extra_cxx_cflags or []),
+                        sorted(extra_include_paths or []))).encode())
+    tag = hasher.hexdigest()[:10]
     so_path = os.path.join(build_dir, f"{name}_{tag}.so")
     if not os.path.exists(so_path):
         cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",

@@ -1,0 +1,148 @@
+"""Autotune subsystem tests (phi/kernels/autotune cache.h / switch_autotune
+analog): cache behavior, measured selection, persistence, flash-attention
+block wiring, and the paddle.incubate.autotune.set_config surface."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.kernels import autotune
+
+
+@pytest.fixture(autouse=True)
+def isolated_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE", str(tmp_path / "cache.json"))
+    autotune.cache.clear()
+    autotune.disable_autotune()
+    yield
+    autotune.cache.clear()
+    autotune.disable_autotune()
+
+
+class TestCache:
+    def test_miss_then_hit(self):
+        assert autotune.cache.get("k", "sig") is None
+        autotune.cache.put("k", "sig", [1, 2])
+        assert autotune.cache.get("k", "sig") == [1, 2]
+        stats = autotune.cache.stats()
+        assert stats["hits"] == 1 and stats["misses"] == 1 and stats["size"] == 1
+
+    def test_persistence_roundtrip(self, tmp_path):
+        autotune.cache.put("kern", "key1", [256, 128])
+        path = os.environ["PADDLE_TPU_AUTOTUNE_CACHE"]
+        assert json.load(open(path)) == {"kern": {"key1": [256, 128]}}
+        # a fresh cache object reloads from disk
+        fresh = autotune.AutoTuneCache()
+        assert fresh.get("kern", "key1") == [256, 128]
+
+    def test_clear_does_not_resurrect(self):
+        autotune.cache.put("kern", "key1", 7)
+        autotune.cache.clear()
+        assert autotune.cache.size() == 0
+
+
+class TestPickBest:
+    def test_disabled_returns_default(self):
+        calls = []
+        got = autotune.pick_best("k", (1,), [10, 20],
+                                 lambda c: calls.append(c) or (lambda: None),
+                                 default=99)
+        assert got == 99 and calls == []  # nothing measured
+
+    def test_enabled_measures_and_caches(self):
+        autotune.enable_autotune()
+        autotune.set_config({"kernel": {"repeats": 1}})
+        import time
+
+        def make_run(cfg):
+            return lambda: time.sleep(0.002 if cfg == "slow" else 0.0001)
+
+        got = autotune.pick_best("k", (5,), ["slow", "fast"], make_run, default="slow")
+        assert got == "fast"
+        # second call: cache hit, no measuring even if disabled now
+        autotune.disable_autotune()
+        got2 = autotune.pick_best("k", (5,), ["slow", "fast"],
+                                  lambda c: (_ for _ in ()).throw(AssertionError),
+                                  default="slow")
+        assert got2 == "fast"
+
+    def test_failing_candidate_disqualified(self):
+        autotune.enable_autotune()
+        autotune.set_config({"kernel": {"repeats": 1}})
+
+        def make_run(cfg):
+            if cfg == "bad":
+                raise RuntimeError("unsupported config")
+            return lambda: None
+
+        assert autotune.pick_best("k", (9,), ["bad", "ok"], make_run) == "ok"
+
+    def test_all_fail_returns_default(self):
+        autotune.enable_autotune()
+
+        def make_run(cfg):
+            def run():
+                raise RuntimeError("boom")
+            return run
+
+        assert autotune.pick_best("k", (2,), ["a"], make_run, default="dflt") == "dflt"
+
+
+class TestFlashAttentionWiring:
+    def test_tuned_blocks_used_and_cached(self):
+        from paddle_tpu.kernels.flash_attention import flash_attention_fwd
+
+        autotune.set_config({"kernel": {"enable": True, "repeats": 1}})
+        rng = np.random.RandomState(0)
+        q = rng.randn(1, 256, 1, 128).astype(np.float32)
+        out = flash_attention_fwd(jnp.asarray(q), jnp.asarray(q), jnp.asarray(q))
+        assert out.shape == (1, 256, 1, 128)
+        entries = autotune.cache._data.get("flash_attention", {})
+        assert len(entries) == 1
+        (key, cfg), = entries.items()
+        assert json.loads(key)[1] == 256  # S in the signature
+        assert tuple(cfg)[0] in (128, 256) and 256 % tuple(cfg)[0] == 0
+
+    def test_heuristic_when_disabled(self):
+        from paddle_tpu.kernels.flash_attention import flash_attention_fwd
+
+        q = np.random.RandomState(1).randn(1, 128, 1, 128).astype(np.float32)
+        out = flash_attention_fwd(jnp.asarray(q), jnp.asarray(q), jnp.asarray(q))
+        assert out.shape == (1, 128, 1, 128)
+        assert autotune.cache.size() == 0  # no tuning happened
+
+
+class TestIncubateSurface:
+    def test_set_config_api(self):
+        import paddle_tpu.incubate.autotune as at
+
+        at.set_config({"kernel": {"enable": True}})
+        assert autotune.autotune_status()["enabled"]
+        at.set_config({"kernel": {"enable": False}})
+        assert not autotune.autotune_status()["enabled"]
+        at.set_config(None)  # reference default: enable
+        assert autotune.autotune_status()["enabled"]
+        status = at.autotune_status()
+        assert {"hits", "misses", "hit_rate", "enabled"} <= set(status)
+
+
+class TestPersistMerge:
+    def test_clear_then_put_preserves_disk(self):
+        autotune.cache.put("kern", "a", [1])
+        autotune.cache.put("other", "b", [2])
+        autotune.cache.clear()
+        autotune.cache.put("kern", "c", [3])
+        fresh = autotune.AutoTuneCache()
+        assert fresh.get("kern", "a") == [1]
+        assert fresh.get("other", "b") == [2]
+        assert fresh.get("kern", "c") == [3]
+
+    def test_set_config_from_json_path(self, tmp_path):
+        p = tmp_path / "tune.json"
+        p.write_text('{"kernel": {"enable": true, "repeats": 2}}')
+        autotune.set_config(str(p))
+        assert autotune.autotune_status()["enabled"]

@@ -166,3 +166,23 @@ class TestStringTensor:
         enc = st.to_ids(tok, max_len=8)
         ids = enc["input_ids"][0]
         assert list(ids[:4]) == [2, 4, 5, 3]  # [CLS] hello world [SEP]
+
+
+class TestTensorArrayNegativeRead:
+    def test_negative_read_uses_length(self):
+        ta = TensorArray.create(8, (2,), "float32")
+        for i in range(3):
+            ta = ta.write(i, np.full(2, i, np.float32))
+        np.testing.assert_allclose(ta.read(-1), [2.0, 2.0])
+        with pytest.raises(IndexError):
+            ta.read(-5)
+
+    def test_negative_read_rejected_when_traced(self):
+        ta = TensorArray.create(4, (2,), "float32")
+
+        @jax.jit
+        def f(ta):
+            return ta.read(-1)
+
+        with pytest.raises(IndexError):
+            f(ta.write(0, np.ones(2, np.float32)))
