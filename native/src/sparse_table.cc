@@ -1,0 +1,236 @@
+// Native sparse parameter table — the memory_sparse_table analog
+// (fluid/distributed/ps/table/memory_sparse_table.cc, accessor update rules
+// from ps/table/sparse_sgd_rule.cc: naive SGD / AdaGrad).
+//
+// TPU-first role: giant embedding tables don't fit accelerator HBM; they live
+// host-side on parameter servers and workers pull/push touched rows only
+// (the reference's PS pull_sparse/push_sparse). This is the hot path of the
+// PS, so it is native: a sharded hash table (per-shard mutex, lock striping
+// like the reference's shard vector) of int64 key -> float[dim] row, with
+// optional AdaGrad accumulator, missing-key initialization, and a binary
+// save/load format.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kShards = 16;
+
+struct Shard {
+  std::mutex mu;
+  std::unordered_map<int64_t, std::vector<float>> rows;      // dim floats
+  std::unordered_map<int64_t, std::vector<float>> g2sums;    // adagrad accum
+};
+
+struct SparseTable {
+  int64_t dim;
+  float init_range;   // uniform(-r, r) init for missing keys; 0 => zeros
+  uint64_t seed;
+  Shard shards[kShards];
+
+  Shard& ShardFor(int64_t key) {
+    return shards[static_cast<uint64_t>(key) % kShards];
+  }
+
+  void InitRow(int64_t key, std::vector<float>* row) {
+    row->resize(dim);
+    if (init_range <= 0.f) {
+      std::fill(row->begin(), row->end(), 0.f);
+      return;
+    }
+    // deterministic per-key init so every server/restart agrees
+    std::mt19937_64 gen(seed ^ static_cast<uint64_t>(key) * 0x9E3779B97F4A7C15ull);
+    std::uniform_real_distribution<float> dist(-init_range, init_range);
+    for (auto& v : *row) v = dist(gen);
+  }
+};
+
+SparseTable* T(void* p) { return static_cast<SparseTable*>(p); }
+
+}  // namespace
+
+extern "C" {
+
+void* st_create(int64_t dim, float init_range, uint64_t seed) {
+  if (dim <= 0) return nullptr;
+  auto* t = new SparseTable();
+  t->dim = dim;
+  t->init_range = init_range;
+  t->seed = seed;
+  return t;
+}
+
+void st_destroy(void* p) { delete T(p); }
+
+int64_t st_dim(void* p) { return T(p)->dim; }
+
+int64_t st_size(void* p) {
+  SparseTable* t = T(p);
+  int64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> g(s.mu);
+    n += static_cast<int64_t>(s.rows.size());
+  }
+  return n;
+}
+
+// Pull rows for keys into out [n, dim]; missing keys are initialized
+// (pull_sparse with create-on-miss, memory_sparse_table.cc semantics).
+int32_t st_pull(void* p, const int64_t* keys, int64_t n, float* out) {
+  SparseTable* t = T(p);
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = t->ShardFor(keys[i]);
+    std::lock_guard<std::mutex> g(s.mu);
+    auto it = s.rows.find(keys[i]);
+    if (it == s.rows.end()) {
+      std::vector<float> row;
+      t->InitRow(keys[i], &row);
+      it = s.rows.emplace(keys[i], std::move(row)).first;
+    }
+    std::memcpy(out + i * t->dim, it->second.data(), t->dim * sizeof(float));
+  }
+  return 0;
+}
+
+// push_sparse with naive SGD rule: row -= lr * grad (duplicate keys fold
+// sequentially, matching the reference's merge-then-apply result for SGD).
+int32_t st_push_sgd(void* p, const int64_t* keys, int64_t n,
+                    const float* grads, float lr) {
+  SparseTable* t = T(p);
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = t->ShardFor(keys[i]);
+    std::lock_guard<std::mutex> g(s.mu);
+    auto it = s.rows.find(keys[i]);
+    if (it == s.rows.end()) {
+      std::vector<float> row;
+      t->InitRow(keys[i], &row);
+      it = s.rows.emplace(keys[i], std::move(row)).first;
+    }
+    float* row = it->second.data();
+    const float* gr = grads + i * t->dim;
+    for (int64_t d = 0; d < t->dim; ++d) row[d] -= lr * gr[d];
+  }
+  return 0;
+}
+
+// push_sparse with AdaGrad rule (sparse_sgd_rule.cc SparseAdaGradSGDRule):
+// g2sum += g^2; row -= lr * g / (sqrt(g2sum) + eps)
+int32_t st_push_adagrad(void* p, const int64_t* keys, int64_t n,
+                        const float* grads, float lr, float eps) {
+  SparseTable* t = T(p);
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = t->ShardFor(keys[i]);
+    std::lock_guard<std::mutex> g(s.mu);
+    auto it = s.rows.find(keys[i]);
+    if (it == s.rows.end()) {
+      std::vector<float> row;
+      t->InitRow(keys[i], &row);
+      it = s.rows.emplace(keys[i], std::move(row)).first;
+    }
+    auto& g2 = s.g2sums[keys[i]];
+    if (g2.empty()) g2.assign(t->dim, 0.f);
+    float* row = it->second.data();
+    const float* gr = grads + i * t->dim;
+    for (int64_t d = 0; d < t->dim; ++d) {
+      g2[d] += gr[d] * gr[d];
+      row[d] -= lr * gr[d] / (std::sqrt(g2[d]) + eps);
+    }
+  }
+  return 0;
+}
+
+// direct assignment (table load / init from checkpoint)
+int32_t st_assign(void* p, const int64_t* keys, int64_t n, const float* vals) {
+  SparseTable* t = T(p);
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = t->ShardFor(keys[i]);
+    std::lock_guard<std::mutex> g(s.mu);
+    auto& row = s.rows[keys[i]];
+    row.assign(vals + i * t->dim, vals + (i + 1) * t->dim);
+  }
+  return 0;
+}
+
+// export all (key, row) pairs; pass null bufs to query count only
+int64_t st_export(void* p, int64_t* keys_out, float* vals_out, int64_t cap) {
+  SparseTable* t = T(p);
+  int64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> g(s.mu);
+    for (auto& kv : s.rows) {
+      if (keys_out && vals_out) {
+        if (n >= cap) return -1;
+        keys_out[n] = kv.first;
+        std::memcpy(vals_out + n * t->dim, kv.second.data(),
+                    t->dim * sizeof(float));
+      }
+      ++n;
+    }
+  }
+  return n;
+}
+
+// binary save/load: magic "PTST" | i64 dim | i64 count | (key, row)*
+int32_t st_save(void* p, const char* path) {
+  SparseTable* t = T(p);
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  // hold every shard lock for the whole save so the header count and the
+  // rows written are one consistent snapshot under concurrent pull/push
+  std::vector<std::unique_lock<std::mutex>> locks;
+  locks.reserve(kShards);
+  for (auto& s : t->shards) locks.emplace_back(s.mu);
+  const char magic[4] = {'P', 'T', 'S', 'T'};
+  std::fwrite(magic, 1, 4, f);
+  std::fwrite(&t->dim, sizeof(int64_t), 1, f);
+  int64_t count = 0;
+  for (auto& s : t->shards) count += static_cast<int64_t>(s.rows.size());
+  std::fwrite(&count, sizeof(int64_t), 1, f);
+  for (auto& s : t->shards) {
+    for (auto& kv : s.rows) {
+      std::fwrite(&kv.first, sizeof(int64_t), 1, f);
+      std::fwrite(kv.second.data(), sizeof(float), t->dim, f);
+    }
+  }
+  std::fclose(f);
+  return 0;
+}
+
+int32_t st_load(void* p, const char* path) {
+  SparseTable* t = T(p);
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  char magic[4];
+  int64_t dim = 0, count = 0;
+  if (std::fread(magic, 1, 4, f) != 4 || std::memcmp(magic, "PTST", 4) != 0 ||
+      std::fread(&dim, sizeof(int64_t), 1, f) != 1 || dim != t->dim ||
+      std::fread(&count, sizeof(int64_t), 1, f) != 1 || count < 0) {
+    std::fclose(f);
+    return -2;
+  }
+  std::vector<float> row(t->dim);
+  for (int64_t i = 0; i < count; ++i) {
+    int64_t key;
+    if (std::fread(&key, sizeof(int64_t), 1, f) != 1 ||
+        std::fread(row.data(), sizeof(float), t->dim, f) !=
+            static_cast<size_t>(t->dim)) {
+      std::fclose(f);
+      return -3;
+    }
+    Shard& s = t->ShardFor(key);
+    std::lock_guard<std::mutex> g(s.mu);
+    s.rows[key] = row;
+  }
+  std::fclose(f);
+  return 0;
+}
+
+}  // extern "C"

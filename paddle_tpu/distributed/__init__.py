@@ -86,6 +86,7 @@ from . import fleet  # noqa: F401,E402
 from . import auto_parallel  # noqa: F401,E402
 from . import launch  # noqa: F401,E402
 from . import rpc  # noqa: F401,E402
+from . import ps  # noqa: F401,E402
 from .auto_parallel import Engine, ProcessMesh, shard_op, shard_tensor  # noqa: F401,E402
 
 

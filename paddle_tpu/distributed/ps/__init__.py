@@ -1,0 +1,434 @@
+"""Parameter-server subsystem (fluid/distributed/ps/: brpc PsClient/PsService
+ps_client.h, memory_sparse_table, and the fleet PS-mode API surface
+fleet.init_server/run_server/init_worker — python/paddle/distributed/ps/).
+
+TPU-first architecture: giant embedding tables live HOST-side on parameter
+servers (they don't fit HBM); workers pull touched rows, feed them to the
+device as dense activations, and push row grads back. The table hot path is
+native C++ (native/src/sparse_table.cc, lock-striped shards + SGD/AdaGrad
+update rules); transport is the framework's shared length-prefixed wire
+protocol (distributed/_wire.py) instead of brpc. Keys partition across
+servers by ``key % num_servers`` — the reference's hash partition.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ... import native as _native
+from .._wire import client_handshake, recv_msg, send_msg, server_handshake
+
+__all__ = [
+    "SparseTable", "PsServer", "PsClient",
+    "init_server", "run_server", "init_worker", "stop_worker",
+    "get_ps_endpoints",
+]
+
+_st_bound = False
+
+
+def _lib():
+    global _st_bound
+    lib = _native._load()
+    if _st_bound:
+        return lib
+    c_i64, c_i32, c_f = ctypes.c_int64, ctypes.c_int32, ctypes.c_float
+    p_i64, p_f = ctypes.POINTER(c_i64), ctypes.POINTER(c_f)
+    sigs = {
+        "st_create": (ctypes.c_void_p, [c_i64, c_f, ctypes.c_uint64]),
+        "st_destroy": (None, [ctypes.c_void_p]),
+        "st_dim": (c_i64, [ctypes.c_void_p]),
+        "st_size": (c_i64, [ctypes.c_void_p]),
+        "st_pull": (c_i32, [ctypes.c_void_p, p_i64, c_i64, p_f]),
+        "st_push_sgd": (c_i32, [ctypes.c_void_p, p_i64, c_i64, p_f, c_f]),
+        "st_push_adagrad": (c_i32, [ctypes.c_void_p, p_i64, c_i64, p_f, c_f, c_f]),
+        "st_assign": (c_i32, [ctypes.c_void_p, p_i64, c_i64, p_f]),
+        "st_export": (c_i64, [ctypes.c_void_p, p_i64, p_f, c_i64]),
+        "st_save": (c_i32, [ctypes.c_void_p, ctypes.c_char_p]),
+        "st_load": (c_i32, [ctypes.c_void_p, ctypes.c_char_p]),
+    }
+    for name, (res, args) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype, fn.argtypes = res, args
+    _st_bound = True
+    return lib
+
+
+def _i64(a) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a, np.int64).reshape(-1))
+
+
+class SparseTable:
+    """Native sharded key->row table (memory_sparse_table analog)."""
+
+    def __init__(self, dim: int, init_range: float = 0.0, seed: int = 0):
+        lib = _lib()
+        self._h = lib.st_create(dim, float(init_range), seed)
+        if not self._h:
+            raise ValueError(f"invalid sparse table dim {dim}")
+        self.dim = dim
+        self._lib = lib
+
+    def pull(self, keys) -> np.ndarray:
+        keys = _i64(keys)
+        out = np.empty((keys.size, self.dim), np.float32)
+        self._lib.st_pull(self._h, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                          keys.size, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return out
+
+    def _check_grads(self, keys, grads) -> np.ndarray:
+        grads = np.ascontiguousarray(np.asarray(grads, np.float32))
+        if grads.shape != (keys.size, self.dim):
+            raise ValueError(f"grads shape {grads.shape} != ({keys.size}, {self.dim})")
+        return grads
+
+    def push_sgd(self, keys, grads, lr: float = 0.01):
+        keys = _i64(keys)
+        grads = self._check_grads(keys, grads)
+        self._lib.st_push_sgd(self._h, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                              keys.size, grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                              float(lr))
+
+    def push_adagrad(self, keys, grads, lr: float = 0.01, eps: float = 1e-8):
+        keys = _i64(keys)
+        grads = self._check_grads(keys, grads)
+        self._lib.st_push_adagrad(self._h, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                                  keys.size, grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                                  float(lr), float(eps))
+
+    def assign(self, keys, values):
+        keys = _i64(keys)
+        values = self._check_grads(keys, values)
+        self._lib.st_assign(self._h, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                            keys.size, values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+
+    def export(self):
+        # the table may grow between the count query and the fill (concurrent
+        # pulls create rows); retry with headroom until the fill fits
+        slack = 0
+        while True:
+            n = self._lib.st_export(self._h, None, None, 0) + slack
+            keys = np.empty(max(n, 1), np.int64)
+            vals = np.empty((max(n, 1), self.dim), np.float32)
+            got = self._lib.st_export(
+                self._h, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
+            if got >= 0:
+                return keys[:got], vals[:got]
+            slack = slack * 2 + 64
+
+    def save(self, path: str):
+        if self._lib.st_save(self._h, path.encode()) != 0:
+            raise OSError(f"cannot save sparse table to {path}")
+
+    def load(self, path: str):
+        rc = self._lib.st_load(self._h, path.encode())
+        if rc != 0:
+            raise OSError(f"cannot load sparse table from {path} (rc={rc})")
+
+    def __len__(self):
+        return int(self._lib.st_size(self._h))
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.st_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PsServer:
+    """One PS rank: serves pull/push/save/load over the shared wire protocol
+    (PsService analog; brpc handlers -> one thread per connection)."""
+
+    def __init__(self, endpoint: str = "127.0.0.1:0"):
+        host, port = endpoint.rsplit(":", 1)
+        self._tables: Dict[int, SparseTable] = {}
+        self._tables_lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, int(port)))
+        self._srv.listen(64)
+        self.endpoint = f"{host}:{self._srv.getsockname()[1]}"
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._conns_lock = threading.Lock()
+        self._active: Dict[threading.Thread, socket.socket] = {}
+
+    def start(self) -> "PsServer":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        self._srv.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            with self._conns_lock:
+                self._active[t] = conn
+            t.start()
+
+    def _table(self, tid: int) -> SparseTable:
+        with self._tables_lock:
+            if tid not in self._tables:
+                raise KeyError(f"table {tid} does not exist on this server")
+            return self._tables[tid]
+
+    def _serve(self, conn: socket.socket):
+        try:
+            if not server_handshake(conn):
+                return
+            while True:
+                try:
+                    req = recv_msg(conn)
+                except (ConnectionError, EOFError, OSError):
+                    return
+                try:
+                    resp = self._handle(req)
+                except Exception as e:  # error surface back to the client
+                    resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                send_msg(conn, resp)
+                if req.get("op") == "shutdown":
+                    return
+        finally:
+            conn.close()
+            with self._conns_lock:
+                self._active.pop(threading.current_thread(), None)
+
+    def _handle(self, req: dict) -> dict:
+        op = req["op"]
+        if op == "create_table":
+            tid = int(req["table_id"])
+            with self._tables_lock:
+                if tid not in self._tables:
+                    self._tables[tid] = SparseTable(
+                        int(req["dim"]), float(req.get("init_range", 0.0)),
+                        int(req.get("seed", 0)))
+            return {"ok": True}
+        if op == "pull":
+            vals = self._table(req["table_id"]).pull(req["keys"])
+            return {"ok": True, "values": vals}
+        if op == "push":
+            t = self._table(req["table_id"])
+            rule = req.get("rule", "sgd")
+            if rule == "sgd":
+                t.push_sgd(req["keys"], req["grads"], req.get("lr", 0.01))
+            elif rule == "adagrad":
+                t.push_adagrad(req["keys"], req["grads"], req.get("lr", 0.01),
+                               req.get("eps", 1e-8))
+            else:
+                raise ValueError(f"unknown push rule {rule}")
+            return {"ok": True}
+        if op == "assign":
+            self._table(req["table_id"]).assign(req["keys"], req["values"])
+            return {"ok": True}
+        if op == "save":
+            self._table(req["table_id"]).save(req["path"])
+            return {"ok": True}
+        if op == "load":
+            self._table(req["table_id"]).load(req["path"])
+            return {"ok": True}
+        if op == "size":
+            return {"ok": True, "size": len(self._table(req["table_id"]))}
+        if op == "shutdown":
+            self._stop.set()
+            return {"ok": True}
+        raise ValueError(f"unknown op {op}")
+
+    def join(self, timeout: Optional[float] = None):
+        if self._thread:
+            self._thread.join(timeout)
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        # unblock + drain in-flight handlers BEFORE destroying native tables
+        # (a handler mid-st_pull must not see a freed table)
+        with self._conns_lock:
+            conns = list(self._active.items())
+        for _, conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        for thread, _ in conns:
+            thread.join(timeout=5)
+        with self._tables_lock:
+            for t in self._tables.values():
+                t.close()
+            self._tables.clear()
+
+
+class PsClient:
+    """Worker-side client: hash-partitions keys across servers and merges
+    results back into request order (brpc_ps_client pull_sparse analog)."""
+
+    def __init__(self, endpoints: Sequence[str]):
+        if not endpoints:
+            raise ValueError("PsClient needs at least one server endpoint")
+        self.endpoints = list(endpoints)
+        self._conns: Dict[int, socket.socket] = {}
+        self._lock = threading.Lock()
+
+    def _conn(self, server: int) -> socket.socket:
+        with self._lock:
+            sock = self._conns.get(server)
+            if sock is None:
+                host, port = self.endpoints[server].rsplit(":", 1)
+                sock = socket.create_connection((host, int(port)), timeout=60)
+                client_handshake(sock)
+                self._conns[server] = sock
+            return sock
+
+    def _call(self, server: int, req: dict) -> dict:
+        # per-connection use is single-threaded (one client per worker
+        # thread); on a broken pipe, evict the cached socket and reconnect
+        # once — the reference brpc client reconnects transparently
+        for attempt in (0, 1):
+            sock = self._conn(server)
+            sent = False
+            try:
+                send_msg(sock, req)
+                sent = True
+                resp = recv_msg(sock)
+                break
+            except (ConnectionError, EOFError, OSError):
+                with self._lock:
+                    if self._conns.get(server) is sock:
+                        del self._conns[server]
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                # push is not idempotent: if the request may already have been
+                # applied (send succeeded, reply lost), don't re-apply it
+                if attempt or (sent and req.get("op") == "push"):
+                    raise
+        if not resp.get("ok"):
+            raise RuntimeError(f"PS server {self.endpoints[server]}: {resp.get('error')}")
+        return resp
+
+    def create_table(self, table_id: int, dim: int, init_range: float = 0.0, seed: int = 0):
+        for s in range(len(self.endpoints)):
+            self._call(s, {"op": "create_table", "table_id": table_id, "dim": dim,
+                           "init_range": init_range, "seed": seed})
+
+    def _partition(self, keys: np.ndarray):
+        servers = (keys % len(self.endpoints)).astype(np.int64)
+        return [(s, np.nonzero(servers == s)[0]) for s in range(len(self.endpoints))
+                if (servers == s).any()]
+
+    def pull_sparse(self, table_id: int, keys) -> np.ndarray:
+        keys = _i64(keys)
+        out: Optional[np.ndarray] = None
+        for s, idx in self._partition(keys):
+            resp = self._call(s, {"op": "pull", "table_id": table_id,
+                                  "keys": keys[idx]})
+            vals = resp["values"]
+            if out is None:
+                out = np.empty((keys.size, vals.shape[1]), np.float32)
+            out[idx] = vals
+        if out is None:
+            raise ValueError("pull_sparse with no keys")
+        return out
+
+    def push_sparse(self, table_id: int, keys, grads, rule: str = "sgd",
+                    lr: float = 0.01, **kwargs):
+        keys = _i64(keys)
+        grads = np.ascontiguousarray(np.asarray(grads, np.float32))
+        if grads.shape[0] != keys.size:
+            raise ValueError(f"push_sparse: {keys.size} keys vs {grads.shape[0]} grads")
+        for s, idx in self._partition(keys):
+            self._call(s, {"op": "push", "table_id": table_id, "keys": keys[idx],
+                           "grads": grads[idx], "rule": rule, "lr": lr, **kwargs})
+
+    def save(self, table_id: int, path_prefix: str):
+        for s in range(len(self.endpoints)):
+            self._call(s, {"op": "save", "table_id": table_id,
+                           "path": f"{path_prefix}.part{s}"})
+
+    def load(self, table_id: int, path_prefix: str):
+        for s in range(len(self.endpoints)):
+            self._call(s, {"op": "load", "table_id": table_id,
+                           "path": f"{path_prefix}.part{s}"})
+
+    def table_size(self, table_id: int) -> int:
+        return sum(self._call(s, {"op": "size", "table_id": table_id})["size"]
+                   for s in range(len(self.endpoints)))
+
+    def shutdown_servers(self):
+        for s in range(len(self.endpoints)):
+            try:
+                self._call(s, {"op": "shutdown"})
+            except (RuntimeError, OSError, ConnectionError):
+                pass
+        self.close()
+
+    def close(self):
+        with self._lock:
+            for sock in self._conns.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+
+# ---- fleet PS-mode module API (fleet.init_server/run_server/init_worker) ----
+_role_state: Dict[str, object] = {}
+
+
+def get_ps_endpoints() -> List[str]:
+    eps = os.environ.get("PADDLE_PSERVER_ENDPOINTS") or os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+    return [e for e in eps.replace(";", ",").split(",") if e]
+
+
+def init_server(endpoint: Optional[str] = None) -> PsServer:
+    """PS-role entry (fleet.init_server): bind + start serving in-thread."""
+    if endpoint is None:
+        eps = get_ps_endpoints()
+        idx = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("POD_IP_RANK", "0")))
+        endpoint = eps[idx] if idx < len(eps) else "127.0.0.1:0"
+    server = PsServer(endpoint).start()
+    _role_state["server"] = server
+    return server
+
+
+def run_server():
+    """Block serving until shutdown (fleet.run_server)."""
+    server = _role_state.get("server")
+    if server is None:
+        raise RuntimeError("call init_server() before run_server()")
+    server.join()
+
+
+def init_worker(endpoints: Optional[Sequence[str]] = None) -> PsClient:
+    """Worker-role entry (fleet.init_worker): connect to all PS ranks."""
+    client = PsClient(list(endpoints) if endpoints else get_ps_endpoints())
+    _role_state["client"] = client
+    return client
+
+
+def stop_worker():
+    client = _role_state.pop("client", None)
+    if client is not None:
+        client.close()

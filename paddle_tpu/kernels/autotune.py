@@ -61,7 +61,8 @@ class AutoTuneCache:
                 disk = json.load(f)
             if isinstance(disk, dict):
                 for k, v in disk.items():
-                    self._data.setdefault(k, {}).update(v)
+                    if isinstance(v, dict):  # tolerate corrupt/old entries
+                        self._data.setdefault(k, {}).update(v)
         except (OSError, ValueError):
             pass
 
