@@ -216,6 +216,14 @@ int32_t st_load(void* p, const char* path) {
     std::fclose(f);
     return -2;
   }
+  // a load is a RESTORE: clear existing rows and optimizer accumulators so
+  // the table state equals the checkpoint exactly (no stale g2sums applying
+  // to restored rows, no pre-load rows surviving)
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> g(s.mu);
+    s.rows.clear();
+    s.g2sums.clear();
+  }
   std::vector<float> row(t->dim);
   for (int64_t i = 0; i < count; ++i) {
     int64_t key;

@@ -55,3 +55,4 @@ from .autograd import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  #
 from .op_registry import OpDef, get_op, list_ops, register_op  # noqa: F401
 from .selected_rows import SelectedRows  # noqa: F401,E402
 from .string_tensor import StringTensor  # noqa: F401,E402
+from .attr_types import DDim, IntArray, Scalar, make_ddim  # noqa: F401,E402

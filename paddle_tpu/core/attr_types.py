@@ -1,0 +1,155 @@
+"""Polymorphic attribute types: DDim, Scalar, IntArray
+(phi/core/ddim.h, phi/common/scalar.h, phi/common/int_array.h).
+
+Reference role: op attributes that accept either literals or tensors — e.g.
+``reshape(x, shape)`` takes a python list OR a shape tensor (IntArray),
+``fill(x, value)`` takes a float OR a 0-d tensor (Scalar). These classes
+normalize both forms at the dispatch seam. TPU note: a *traced* tensor-valued
+Scalar/IntArray stays symbolic (a jax tracer) — ops that can stay shape-static
+should call ``.to_static()`` and only fall back to the symbolic value when the
+attr is genuinely data-dependent (XLA needs static shapes)."""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence, Union
+
+import numpy as np
+
+
+def _unwrap(x):
+    from .tensor import Tensor
+
+    return x._value if isinstance(x, Tensor) else x
+
+
+class DDim:
+    """Immutable dims vector (phi::DDim): size(), at(), product semantics."""
+
+    __slots__ = ("_dims",)
+
+    def __init__(self, dims: Sequence[int]):
+        self._dims = tuple(int(d) for d in dims)
+
+    def size(self) -> int:
+        return len(self._dims)
+
+    def at(self, i: int) -> int:
+        return self._dims[i]
+
+    def to_list(self) -> List[int]:
+        return list(self._dims)
+
+    def numel(self) -> int:
+        n = 1
+        for d in self._dims:
+            n *= d
+        return n
+
+    def __len__(self):
+        return len(self._dims)
+
+    def __getitem__(self, i):
+        got = self._dims[i]
+        return DDim(got) if isinstance(got, tuple) else got
+
+    def __iter__(self):
+        return iter(self._dims)
+
+    def __eq__(self, other):
+        if isinstance(other, DDim):
+            return self._dims == other._dims
+        if isinstance(other, (tuple, list)):
+            return self._dims == tuple(other)
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(self._dims)
+
+    def __repr__(self):
+        return f"DDim({list(self._dims)})"
+
+
+class Scalar:
+    """A scalar attribute that may arrive as a python number, numpy scalar,
+    0-d Tensor, or traced value (phi::Scalar)."""
+
+    __slots__ = ("_value", "_from_tensor")
+
+    def __init__(self, value):
+        v = _unwrap(value)
+        self._from_tensor = hasattr(v, "shape")
+        if self._from_tensor and tuple(np.shape(v)) not in ((), (1,)):
+            raise ValueError(f"Scalar requires a 0-d/1-element value, got shape {np.shape(v)}")
+        self._value = v
+
+    @property
+    def from_tensor(self) -> bool:
+        return self._from_tensor
+
+    def to_float(self) -> float:
+        return float(np.asarray(self._value).reshape(()))
+
+    def to_int(self) -> int:
+        return int(np.asarray(self._value).reshape(()))
+
+    def to_bool(self) -> bool:
+        return bool(np.asarray(self._value).reshape(()))
+
+    def value(self):
+        """The raw (possibly traced) value — use in-graph when data-dependent."""
+        return self._value
+
+    def __float__(self):
+        return self.to_float()
+
+    def __int__(self):
+        return self.to_int()
+
+    def __repr__(self):
+        return f"Scalar({self._value!r})"
+
+
+class IntArray:
+    """An int-vector attribute from a list, tuple, numpy array, int Tensor,
+    or a list mixing ints and 0-d Tensors (phi::IntArray — the reshape/slice
+    shape-attr type)."""
+
+    __slots__ = ("_data", "_from_tensor")
+
+    def __init__(self, data: Union[Sequence, "np.ndarray"]):
+        v = _unwrap(data)
+        if hasattr(v, "shape") and not isinstance(v, (list, tuple)):
+            self._from_tensor = True
+            self._data = [v[i] for i in range(int(np.shape(v)[0]))] if np.ndim(v) else [v]
+        else:
+            self._from_tensor = any(hasattr(_unwrap(e), "shape") for e in v)
+            self._data = [_unwrap(e) for e in v]
+
+    @property
+    def from_tensor(self) -> bool:
+        return self._from_tensor
+
+    def to_static(self) -> List[int]:
+        """Concrete python ints; raises on traced elements (shapes must be
+        static under XLA — callers fall back to symbolic use)."""
+        out = []
+        for e in self._data:
+            arr = np.asarray(e) if not isinstance(e, (int, np.integer)) else e
+            out.append(int(np.reshape(arr, ()).item()) if not isinstance(e, (int, np.integer)) else int(e))
+        return out
+
+    def values(self) -> List:
+        return list(self._data)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __iter__(self) -> Iterable:
+        return iter(self._data)
+
+    def __repr__(self):
+        return f"IntArray({self._data!r})"
+
+
+def make_ddim(dims) -> DDim:
+    return DDim(dims)

@@ -28,9 +28,24 @@ def _find_layer(function):
     return owner if isinstance(owner, Layer) else None
 
 
-def recompute(function, *args, use_reentrant: bool = True, preserve_rng_state: bool = True, **kwargs):
+_POLICIES = {
+    None: None,
+    "full": None,  # save nothing: replay the whole forward (reference default)
+    # save matmul outputs: backward skips re-running the MXU-heavy dots and
+    # only replays cheap elementwise work — the MFU-optimal transformer point
+    # when HBM allows it
+    "dots_saveable": "dots_saveable",
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+}
+
+
+def recompute(function, *args, use_reentrant: bool = True, preserve_rng_state: bool = True, policy=None, **kwargs):
     """Checkpoint `function(*args, **kwargs)`: store inputs + params, replay
-    the forward during backward instead of keeping intermediates."""
+    the forward during backward instead of keeping intermediates.
+
+    policy: None/'full' replays everything; 'dots_saveable' keeps dot_general
+    outputs resident (jax.checkpoint_policies.dots_saveable) so the backward
+    replays only elementwise ops."""
     layer = _find_layer(function)
     params = []
     if layer is not None:
@@ -55,7 +70,11 @@ def recompute(function, *args, use_reentrant: bool = True, preserve_rng_state: b
             lambda o: o._value if isinstance(o, Tensor) else o, out, is_leaf=lambda x: isinstance(x, Tensor)
         )
 
-    ckpt_fn = jax.checkpoint(pure_fn)
+    if policy not in _POLICIES:
+        raise ValueError(f"unknown recompute policy {policy!r}; one of {sorted(k for k in _POLICIES if k)}")
+    pol_name = _POLICIES[policy]
+    pol = getattr(jax.checkpoint_policies, pol_name) if pol_name else None
+    ckpt_fn = jax.checkpoint(pure_fn, policy=pol)
     out, node = run_op("recompute", ckpt_fn, [*params, *tensor_inputs])
     from ...ops._dispatch import wrap_outputs
 

@@ -120,11 +120,21 @@ class ShardedTrainStep:
         loss_fn_ = self.loss_fn
         mdl = model
 
+        # a model-provided fused trunk->loss path (e.g. GPT's chunked CE that
+        # never materializes full logits) wins over forward()+loss(), unless
+        # the caller supplied an explicit loss_fn
+        use_fwl = loss_fn is None and hasattr(model, "forward_with_loss")
+
         def step(params, opt_state, x, y, lr, seed):
             def loss_of(pvals):
                 with no_grad(), _random.rng_scope(seed):
-                    out, _ = mdl.functional_call(pvals, buffers0, Tensor(x))
-                    loss = loss_fn_(out, Tensor(y))
+                    if use_fwl:
+                        loss, _ = mdl.functional_call(
+                            pvals, buffers0, Tensor(x), Tensor(y),
+                            method="forward_with_loss")
+                    else:
+                        out, _ = mdl.functional_call(pvals, buffers0, Tensor(x))
+                        loss = loss_fn_(out, Tensor(y))
                 return loss._value.astype(jnp.float32)
 
             loss, grads = jax.value_and_grad(loss_of)(params)

@@ -54,10 +54,12 @@ _parallel_env: Optional[ParallelEnv] = None
 
 def init_parallel_env() -> ParallelEnv:
     global _parallel_env
+    # always re-ensure the runtime pieces (all idempotent): a cached env must
+    # not short-circuit re-initialization after destroy_process_group()
+    init_distributed_runtime()
+    get_global_mesh()
+    _get_global_group()
     if _parallel_env is None:
-        init_distributed_runtime()
-        get_global_mesh()
-        _get_global_group()
         _parallel_env = ParallelEnv()
     return _parallel_env
 

@@ -17,6 +17,7 @@ import ctypes
 import os
 import socket
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -270,9 +271,20 @@ class PsServer:
                 conn.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
+        stuck = False
         for thread, _ in conns:
             thread.join(timeout=5)
+            stuck = stuck or thread.is_alive()
         with self._tables_lock:
+            if stuck:
+                # a handler is still inside a native table call: leaking the
+                # tables is safe, freeing them under it is a use-after-free
+                import warnings
+
+                warnings.warn("PsServer.stop: handler still running; "
+                              "leaking native tables instead of freeing")
+                self._tables.clear()
+                return
             for t in self._tables.values():
                 t.close()
             self._tables.clear()
@@ -288,6 +300,9 @@ class PsClient:
         self.endpoints = list(endpoints)
         self._conns: Dict[int, socket.socket] = {}
         self._lock = threading.Lock()
+        # per-server fan-out pool: one concurrent RPC per server (each server
+        # has its own connection), so cluster-wide ops cost ~1 RTT, not N
+        self._pool = ThreadPoolExecutor(max_workers=max(len(self.endpoints), 1))
 
     def _conn(self, server: int) -> socket.socket:
         with self._lock:
@@ -327,10 +342,15 @@ class PsClient:
             raise RuntimeError(f"PS server {self.endpoints[server]}: {resp.get('error')}")
         return resp
 
+    def _fanout(self, reqs):
+        """[(server, req)] -> [resp] concurrently, one in-flight per server."""
+        futs = [self._pool.submit(self._call, srv, req) for srv, req in reqs]
+        return [f.result() for f in futs]
+
     def create_table(self, table_id: int, dim: int, init_range: float = 0.0, seed: int = 0):
-        for s in range(len(self.endpoints)):
-            self._call(s, {"op": "create_table", "table_id": table_id, "dim": dim,
+        self._fanout([(s, {"op": "create_table", "table_id": table_id, "dim": dim,
                            "init_range": init_range, "seed": seed})
+                      for s in range(len(self.endpoints))])
 
     def _partition(self, keys: np.ndarray):
         servers = (keys % len(self.endpoints)).astype(np.int64)
@@ -339,10 +359,11 @@ class PsClient:
 
     def pull_sparse(self, table_id: int, keys) -> np.ndarray:
         keys = _i64(keys)
+        parts = self._partition(keys)
+        resps = self._fanout([(s, {"op": "pull", "table_id": table_id,
+                                   "keys": keys[idx]}) for s, idx in parts])
         out: Optional[np.ndarray] = None
-        for s, idx in self._partition(keys):
-            resp = self._call(s, {"op": "pull", "table_id": table_id,
-                                  "keys": keys[idx]})
+        for (s, idx), resp in zip(parts, resps):
             vals = resp["values"]
             if out is None:
                 out = np.empty((keys.size, vals.shape[1]), np.float32)
@@ -357,23 +378,24 @@ class PsClient:
         grads = np.ascontiguousarray(np.asarray(grads, np.float32))
         if grads.shape[0] != keys.size:
             raise ValueError(f"push_sparse: {keys.size} keys vs {grads.shape[0]} grads")
-        for s, idx in self._partition(keys):
-            self._call(s, {"op": "push", "table_id": table_id, "keys": keys[idx],
+        self._fanout([(s, {"op": "push", "table_id": table_id, "keys": keys[idx],
                            "grads": grads[idx], "rule": rule, "lr": lr, **kwargs})
+                      for s, idx in self._partition(keys)])
 
     def save(self, table_id: int, path_prefix: str):
-        for s in range(len(self.endpoints)):
-            self._call(s, {"op": "save", "table_id": table_id,
+        self._fanout([(s, {"op": "save", "table_id": table_id,
                            "path": f"{path_prefix}.part{s}"})
+                      for s in range(len(self.endpoints))])
 
     def load(self, table_id: int, path_prefix: str):
-        for s in range(len(self.endpoints)):
-            self._call(s, {"op": "load", "table_id": table_id,
+        self._fanout([(s, {"op": "load", "table_id": table_id,
                            "path": f"{path_prefix}.part{s}"})
+                      for s in range(len(self.endpoints))])
 
     def table_size(self, table_id: int) -> int:
-        return sum(self._call(s, {"op": "size", "table_id": table_id})["size"]
-                   for s in range(len(self.endpoints)))
+        resps = self._fanout([(s, {"op": "size", "table_id": table_id})
+                              for s in range(len(self.endpoints))])
+        return sum(r["size"] for r in resps)
 
     def shutdown_servers(self):
         for s in range(len(self.endpoints)):
@@ -391,6 +413,7 @@ class PsClient:
                 except OSError:
                     pass
             self._conns.clear()
+        self._pool.shutdown(wait=False)
 
 
 # ---- fleet PS-mode module API (fleet.init_server/run_server/init_worker) ----

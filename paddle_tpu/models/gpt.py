@@ -22,7 +22,10 @@ from dataclasses import dataclass
 
 from jax.sharding import PartitionSpec as P
 
+import jax.numpy as jnp
+
 from .. import nn
+from ..core.tensor import Tensor
 from ..distributed.fleet.meta_parallel import (
     ColumnParallelLinear,
     RowParallelLinear,
@@ -46,6 +49,12 @@ class GPTConfig:
     tie_word_embeddings: bool = True
     sequence_parallel: bool = False
     use_recompute: bool = False
+    recompute_policy: str = None  # None/'full' | 'dots_saveable' (keep MXU
+    #                               outputs resident, replay elementwise only)
+    recompute_interval: int = 1   # remat every k-th block (k=2 halves the
+    #                               replay FLOPs at ~half the memory saving)
+    loss_chunk: int = 0           # CE in seq chunks of this size (0 = off):
+    #                               avoids materializing [B, S, V] fp32 logits
     initializer_range: float = 0.02
 
     def __post_init__(self):
@@ -167,10 +176,11 @@ class GPTModel(Layer):
     def forward(self, input_ids, position_ids=None):
         h = self.embeddings(input_ids, position_ids)
         for i, block in enumerate(self.layers):
-            if self.cfg.use_recompute and self.training:
+            if self.cfg.use_recompute and self.training \
+                    and i % max(self.cfg.recompute_interval, 1) == 0:
                 from ..distributed.fleet.recompute import recompute
 
-                h = recompute(block, h)
+                h = recompute(block, h, policy=self.cfg.recompute_policy)
             else:
                 h = block(h)
         return self.final_ln(h)
@@ -199,6 +209,55 @@ class GPTForCausalLM(Layer):
         """Next-token CE, labels already shifted by the data pipeline."""
         V = logits.shape[-1]
         return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1])).mean()
+
+    def forward_with_loss(self, input_ids, labels):
+        """Fused trunk->loss path. With cfg.loss_chunk set, the LM-head matmul
+        and fp32 cross-entropy run per sequence chunk under jax.checkpoint, so
+        the full [B, S, V] fp32 logits tensor (2.7 GB at B=20, V=32k) never
+        materializes — HBM saved buys batch, and batch buys MFU. Falls back to
+        forward()+loss() when chunking is off or doesn't divide S."""
+        import jax
+
+        cfg = self.cfg
+        chunk = getattr(cfg, "loss_chunk", 0)
+        S = input_ids.shape[1]
+        from ..distributed.topology import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        mp = hcg.get_model_parallel_world_size() if hcg is not None else 1
+        if not chunk or S % chunk or mp > 1:
+            # vocab-parallel logits go through ParallelCrossEntropy instead
+            return self.loss(self.forward(input_ids), labels)
+        h = self.gpt(input_ids)
+        if cfg.tie_word_embeddings:
+            W = self.gpt.embeddings.word_embeddings.weight  # [V, Hd]
+            logits_of = lambda hc, Wv: hc @ Wv.T
+        else:
+            W = self.lm_head.weight  # [Hd, V]
+            logits_of = lambda hc, Wv: hc @ Wv
+        hv = h._value
+        yv = labels._value if isinstance(labels, Tensor) else jnp.asarray(labels)
+        Wv = W._value
+        B, _, Hd = hv.shape
+        n = S // chunk
+        hs = hv.reshape(B, n, chunk, Hd).swapaxes(0, 1)   # [n, B, c, Hd]
+        ys = yv.reshape(B, n, chunk).swapaxes(0, 1)
+
+        def chunk_ce(h_c, y_c, Wv):
+            logits = logits_of(h_c, Wv).astype(jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(
+                logits, y_c[..., None].astype(jnp.int32), axis=-1)[..., 0]
+            return (lse - gold).sum()
+
+        ckpt_ce = jax.checkpoint(chunk_ce)
+
+        def body(acc, xy):
+            h_c, y_c = xy
+            return acc + ckpt_ce(h_c, y_c, Wv), None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ys))
+        return Tensor(total / (B * S))
 
 
 def gpt_tiny(**overrides) -> GPTForCausalLM:

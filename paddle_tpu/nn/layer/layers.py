@@ -357,8 +357,9 @@ class Layer:
         buffers = {name: b._value for name, b in self.named_buffers() if b is not None}
         return params, buffers
 
-    def functional_call(self, params: dict, buffers: dict, *args, **kwargs):
-        """Run forward with external {name: array} state via the core overlay.
+    def functional_call(self, params: dict, buffers: dict, *args, method: str = "forward", **kwargs):
+        """Run `method` (default forward) with external {name: array} state
+        via the core overlay.
 
         Returns (output, new_buffers). Safe to call under jax tracing: all
         reads/writes to parameters and buffers route through the overlay.
@@ -376,7 +377,7 @@ class Layer:
                 uid_map[b._uid] = buffers[name]
                 name_of_uid[b._uid] = ("b", name)
         with F.overlay(uid_map):
-            out = self.forward(*args, **kwargs)
+            out = getattr(self, method)(*args, **kwargs)
             new_buffers = {
                 name_of_uid[uid][1]: val for uid, val in uid_map.items() if name_of_uid[uid][0] == "b"
             }

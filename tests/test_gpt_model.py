@@ -8,6 +8,16 @@ import paddle_tpu as paddle
 from paddle_tpu.models import GPTConfig, GPTForCausalLM, gpt_tiny
 
 
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    """fleet.init installs a global HybridCommunicateGroup; without teardown
+    it leaks into later test files (order-dependent failures)."""
+    yield
+    from paddle_tpu.distributed import topology
+
+    topology.set_hybrid_communicate_group(None)
+
+
 def _batch(cfg_vocab=128, bsz=4, seq=16, seed=0):
     rng = np.random.RandomState(seed)
     x = rng.randint(0, cfg_vocab, size=(bsz, seq))

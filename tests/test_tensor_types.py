@@ -186,3 +186,48 @@ class TestTensorArrayNegativeRead:
 
         with pytest.raises(IndexError):
             f(ta.write(0, np.ones(2, np.float32)))
+
+
+class TestAttrTypes:
+    """DDim/Scalar/IntArray (phi/core/ddim.h, phi/common/scalar.h,
+    phi/common/int_array.h)."""
+
+    def test_ddim(self):
+        from paddle_tpu.core import DDim, make_ddim
+        d = make_ddim([2, 3, 4])
+        assert d.size() == 3 and d.at(1) == 3 and d.numel() == 24
+        assert d == [2, 3, 4] and d == DDim((2, 3, 4))
+        assert list(d) == [2, 3, 4] and d[2] == 4
+        assert hash(d) == hash(DDim([2, 3, 4]))
+
+    def test_scalar_forms(self):
+        from paddle_tpu.core import Scalar
+        assert Scalar(3.5).to_float() == 3.5
+        assert Scalar(7).to_int() == 7 and not Scalar(7).from_tensor
+        t = paddle_tpu.to_tensor(np.array(2.5, np.float32))
+        s = Scalar(t)
+        assert s.from_tensor and s.to_float() == 2.5 and float(s) == 2.5
+        with pytest.raises(ValueError):
+            Scalar(np.ones(3))
+
+    def test_int_array_forms(self):
+        from paddle_tpu.core import IntArray
+        a = IntArray([2, 3])
+        assert a.to_static() == [2, 3] and not a.from_tensor
+        t = IntArray(np.array([4, 5], np.int64))
+        assert t.from_tensor and t.to_static() == [4, 5]
+        mixed = IntArray([2, paddle_tpu.to_tensor(np.array(6, np.int64))])
+        assert mixed.from_tensor and mixed.to_static() == [2, 6]
+        assert len(mixed) == 2
+
+    def test_int_array_traced_to_static_raises(self):
+        from paddle_tpu.core import IntArray
+        import jax
+
+        def f(x):
+            ia = IntArray([x[0]])
+            with pytest.raises(Exception):
+                ia.to_static()  # traced element cannot be concretized
+            return x
+
+        jax.jit(f)(jnp.arange(3))
