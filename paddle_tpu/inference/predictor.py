@@ -124,8 +124,23 @@ class Predictor:
         key = tuple((a.shape, str(a.dtype)) for a in args)
         call = self._compiled_cache.get(key)
         if call is None:
-            # AOT-compile the deserialized StableHLO for these shapes
-            call = jax.jit(self._layer._call).lower(*args).compile()
+            if self._config._options.get("ir_optim", True):
+                # analysis-pass pipeline (AnalysisPredictor's IrAnalysisPass
+                # analog): trace -> inference passes -> re-emit -> compile.
+                # Compilation of the re-emitted fn stays INSIDE the guard:
+                # re-binding failures only surface when the plan re-executes
+                # under jit, and must fall back to the direct path too.
+                try:
+                    from .. import ir as _ir
+                    from ..ir.pass_manager import INFERENCE_PIPELINE
+
+                    prog = _ir.trace(self._layer._call, *args)
+                    _ir.PassManager(INFERENCE_PIPELINE).run(prog)
+                    call = jax.jit(prog.to_callable()).lower(*args).compile()
+                except Exception:
+                    call = None  # opaque/untraceable model: direct path below
+            if call is None:
+                call = jax.jit(self._layer._call).lower(*args).compile()
             self._compiled_cache[key] = call
         outs = call(*args)
         outs = outs if isinstance(outs, (list, tuple)) else [outs]

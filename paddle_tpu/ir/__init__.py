@@ -29,12 +29,13 @@ from .pass_manager import (  # noqa: F401
     register_pass,
 )
 from . import passes  # noqa: F401  (registers the builtin passes)
+from .translator import translate_static  # noqa: F401
 
 __all__ = [
     "IrContext", "Dialect", "Operation", "Value", "Type", "Attribute",
     "Program", "from_jaxpr", "trace",
     "Pass", "PassManager", "PassRegistry", "register_pass",
-    "optimize",
+    "optimize", "translate_static",
 ]
 
 

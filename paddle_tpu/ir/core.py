@@ -322,6 +322,7 @@ class Program:
         self.ctx._owner = True  # sentinel, not self: avoid a ctx<->program
         #                         cycle that would defer native store release
         self.op_bind: Dict[int, Tuple[Any, Dict[str, Any]]] = {}
+        self.op_fns: Dict[int, Callable] = {}  # opaque-fn ops (static translator)
         self.const_vals: Dict[int, Any] = {}
         self.in_tree = None
         self.out_tree = None
@@ -444,16 +445,21 @@ class Program:
                 plan.append(("const", op.id, (), [r.id for r in op.results],
                              self.const_vals[op.id]))
         for op in self.ops():
-            if op.name != CONSTANT_OP:
-                if op.id not in self.op_bind:
-                    raise ValueError(
-                        f"op {op.name} (id {op.id}) has no JAX primitive "
-                        "binding; re-emission requires ops created via "
-                        "from_jaxpr/trace (manually built ops must be "
-                        "rewritten away by passes first)")
+            if op.name == CONSTANT_OP:
+                continue
+            if op.id in self.op_fns:
+                plan.append(("fn", op.id, tuple(o.id for o in op.operands),
+                             [r.id for r in op.results], self.op_fns[op.id]))
+            elif op.id in self.op_bind:
                 prim, params = self.op_bind[op.id]
                 plan.append(("bind", op.id, tuple(o.id for o in op.operands),
                              [r.id for r in op.results], (prim, params)))
+            else:
+                raise ValueError(
+                    f"op {op.name} (id {op.id}) has no JAX primitive "
+                    "binding; re-emission requires ops created via "
+                    "from_jaxpr/trace or translate_static (manually built "
+                    "ops must be rewritten away by passes first)")
         in_vids = [v.id for v in self.inputs]
         out_vids = [v.id for v in self.outputs]
         in_tree, out_tree = self.in_tree, self.out_tree
@@ -469,6 +475,12 @@ class Program:
             for kind, _oid, operand_ids, result_ids, payload in plan:
                 if kind == "const":
                     env[result_ids[0]] = payload
+                    continue
+                if kind == "fn":
+                    outs = payload(*(env[i] for i in operand_ids))
+                    leaves = jax.tree_util.tree_leaves(outs)
+                    for rid, v in zip(result_ids, leaves):
+                        env[rid] = v
                     continue
                 prim, params = payload
                 args_in = [env[i] for i in operand_ids]

@@ -1,0 +1,73 @@
+"""Static Program -> IR translation (the reference's ProgramTranslator into
+paddle/ir — fluid/ir_adaptor/translator/, program_translator.cc).
+
+A captured static.Program is a linear list of op nodes over tensor ids; this
+lifts it into the IR so the pass pipeline applies: DCE strips captured ops
+that don't feed the fetch targets (static capture records EVERYTHING executed
+under the program guard), CSE merges repeated subgraphs, and the result
+re-emits as one jit-compilable callable — the analog of the reference's
+Program -> new-IR -> optimized-program flow.
+
+Scope: forward (inference) programs — _OpNode chains. Grad/optimizer nodes
+(append_backward products) are higher-order replay nodes, not dataflow ops;
+translate the forward slice and differentiate the re-emitted callable with
+jax.grad instead (same division the reference draws between the translator
+and the autodiff pass).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from .core import Program as IrProgram
+from .core import Value
+
+
+def translate_static(static_program, fetch_vars: Sequence,
+                     feed_vars: Optional[Sequence] = None) -> IrProgram:
+    """Translate a paddle_tpu.static.Program into an ir.Program.
+
+    feed_vars: placeholder Tensors that become IR block arguments (defaults
+    to every placeholder of the program, in insertion order).
+    fetch_vars: Tensors whose values become the IR outputs.
+    Captured non-feed tensors (parameters, eagerly computed values) enter as
+    builtin.constant ops.
+    """
+    from ..static.program import _OpNode
+
+    prog = IrProgram()
+    feed_vars = list(feed_vars) if feed_vars is not None \
+        else list(static_program.placeholders.values())
+    env: Dict[int, Value] = {}
+    for t in feed_vars:
+        v = t._value
+        env[id(t)] = prog.add_input(prog.ctx.tensor_type(str(v.dtype), v.shape))
+
+    def value_of(tid: int) -> Value:
+        got = env.get(tid)
+        if got is None:  # captured tensor: parameter or eager intermediate
+            t = static_program.tensors[tid]
+            got = prog.add_constant(t._value).result(0)
+            env[tid] = got
+        return got
+
+    for node in static_program.nodes:
+        if not isinstance(node, _OpNode):
+            raise NotImplementedError(
+                f"translate_static covers forward programs; found a "
+                f"{type(node).__name__} (use jax.grad on the re-emitted "
+                f"callable for gradients)")
+        operands = [value_of(tid) for tid in node.in_ids]
+        result_types = []
+        for tid in node.out_ids:
+            ov = static_program.tensors[tid]._value
+            result_types.append(prog.ctx.tensor_type(str(ov.dtype), ov.shape))
+        op = prog.create_op(f"pd.{node.op_name}", operands, result_types,
+                            attrs={"fn": node.fn})  # identity token for CSE
+        prog.op_fns[op.id] = node.fn
+        for tid, res in zip(node.out_ids, op.results):
+            env[tid] = res
+
+    prog.set_outputs([value_of(id(t)) for t in fetch_vars])
+    prog.verify()
+    return prog

@@ -216,3 +216,86 @@ class TestModelScale:
         pm = ir.PassManager()
         pm.run(prog)
         np.testing.assert_allclose(prog.to_callable()(x), fwd(x), rtol=1e-5, atol=1e-6)
+
+
+class TestStaticTranslation:
+    """static Program -> IR (ProgramTranslator / ir_adaptor analog)."""
+
+    @pytest.fixture(autouse=True)
+    def _static_mode(self):
+        paddle_tpu.enable_static()
+        yield
+        paddle_tpu.disable_static()
+
+    def _build_program(self):
+        import paddle_tpu.static as static
+
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 4], "float32")
+            w = paddle_tpu.to_tensor(np.ones((4, 3), np.float32) * 0.5)
+            h = paddle_tpu.matmul(x, w)
+            y = paddle_tpu.tanh(h)
+            dead = paddle_tpu.exp(h)  # captured but not fetched
+            dead2 = paddle_tpu.sin(dead)  # noqa: F841
+        return main, x, y
+
+    def test_translate_and_match_executor(self):
+        import paddle_tpu.static as static
+
+        main, x, y = self._build_program()
+        prog = ir.translate_static(main, fetch_vars=[y], feed_vars=[x])
+        assert len(prog) >= 4
+        feed = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        exe = static.Executor()
+        ref, = exe.run(main, feed={"x": feed}, fetch_list=[y])
+        got, = prog.to_callable()(jnp.asarray(feed))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6)
+
+    def test_dce_prunes_unfetched_capture(self):
+        main, x, y = self._build_program()
+        prog = ir.translate_static(main, fetch_vars=[y], feed_vars=[x])
+        removed = prog.dce()
+        assert removed >= 2  # exp + sin chain is dead wrt the fetch
+        names = [op.name for op in prog.ops()]
+        assert not any("exp" in n or "sin" in n for n in names)
+        feed = np.ones((2, 4), np.float32)
+        out, = prog.to_callable()(jnp.asarray(feed))
+        np.testing.assert_allclose(np.asarray(out), np.tanh(np.full((2, 3), 2.0)), rtol=1e-6)
+
+    def test_grad_node_rejected(self):
+        import paddle_tpu.static as static
+
+        main = static.Program()
+        with static.program_guard(main, static.Program()):
+            x = static.data("x", [2], "float32")
+            y = paddle_tpu.mean(x * x)
+            static.append_backward(y)
+        with pytest.raises(NotImplementedError):
+            ir.translate_static(main, fetch_vars=[y], feed_vars=[x])
+
+
+class TestPredictorIrOptim:
+    def test_predictor_runs_with_ir_passes(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference, jit
+
+        paddle_tpu.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        net.eval()
+        prefix = str(tmp_path / "model")
+        from paddle_tpu.static import InputSpec
+        jit.save(net, prefix, input_spec=[InputSpec([None, 8], "float32")])
+        cfg = inference.Config(prefix)
+        cfg.switch_ir_optim(True)
+        pred = inference.create_predictor(cfg)
+        x = np.random.RandomState(1).randn(3, 8).astype(np.float32)
+        out, = pred.run([x])
+        ref = net(paddle_tpu.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        # and with passes off, same result
+        cfg2 = inference.Config(prefix)
+        cfg2.switch_ir_optim(False)
+        out2, = inference.create_predictor(cfg2).run([x])
+        np.testing.assert_allclose(out2, ref, rtol=1e-5, atol=1e-6)
