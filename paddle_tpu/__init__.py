@@ -98,6 +98,7 @@ from . import hapi  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import ir  # noqa: F401,E402
+from . import linalg  # noqa: F401,E402
 from . import tensor  # noqa: F401,E402
 from .core.selected_rows import SelectedRows  # noqa: F401,E402
 from .core.string_tensor import StringTensor  # noqa: F401,E402

@@ -47,6 +47,12 @@ def translate_static(static_program, fetch_vars: Sequence,
         got = env.get(tid)
         if got is None:  # captured tensor: parameter or eager intermediate
             t = static_program.tensors[tid]
+            if getattr(t, "_is_placeholder", False):
+                raise ValueError(
+                    f"placeholder {getattr(t, 'name', tid)!r} is reachable "
+                    "from the fetch targets but not listed in feed_vars — "
+                    "baking it in as a constant would silently freeze it at "
+                    "zeros")
             got = prog.add_constant(t._value).result(0)
             env[tid] = got
         return got

@@ -1,0 +1,37 @@
+"""paddle.linalg namespace (python/paddle/linalg.py) — re-exports the
+linear-algebra ops from the tensor op layer under the reference's module
+path, so ``paddle.linalg.svd``-style imports port verbatim."""
+
+from .ops.linalg import (  # noqa: F401
+    cholesky,
+    cholesky_solve,
+    cond,
+    corrcoef,
+    cov,
+    det,
+    eig,
+    eigh,
+    eigvals,
+    eigvalsh,
+    inv,
+    lstsq,
+    lu,
+    lu_unpack,
+    matrix_power,
+    matrix_rank,
+    multi_dot,
+    norm,
+    pinv,
+    qr,
+    slogdet,
+    solve,
+    svd,
+    triangular_solve,
+)
+
+__all__ = [
+    'cholesky', 'norm', 'cond', 'cov', 'corrcoef', 'inv', 'eig', 'eigvals',
+    'multi_dot', 'matrix_rank', 'svd', 'qr', 'lu', 'lu_unpack',
+    'matrix_power', 'det', 'slogdet', 'eigh', 'eigvalsh', 'pinv', 'solve',
+    'cholesky_solve', 'triangular_solve', 'lstsq',
+]

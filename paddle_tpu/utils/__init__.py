@@ -36,3 +36,32 @@ def run_check():
     dev = jax.devices()[0]
     print(f"paddle_tpu is installed successfully on {dev.platform}:{dev.id}.")
     return True
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version is within [min_version,
+    max_version] (fluid/framework.py:348 contract: raises, returns None)."""
+    if not isinstance(min_version, str):
+        raise TypeError(f"min_version must be str, got {type(min_version)}")
+    if max_version is not None and not isinstance(max_version, str):
+        raise TypeError(f"max_version must be str or None, got {type(max_version)}")
+
+    def parse(v: str):
+        # reference contract: \d+(\.\d+){0,3} — no wildcards
+        parts = v.split(".")
+        if not 1 <= len(parts) <= 4 or not all(p.isdigit() for p in parts):
+            raise ValueError(f"invalid version string {v!r}")
+        return [int(p) for p in parts] + [0] * (4 - len(parts))
+
+    from ..version import full_version
+
+    installed = parse(full_version.split("+")[0])
+    if installed < parse(min_version):
+        raise Exception(
+            f"installed version {full_version} is lower than required {min_version}")
+    if max_version is not None and installed > parse(max_version):
+        raise Exception(
+            f"installed version {full_version} is higher than allowed {max_version}")
+
+
+__all__.append("require_version")

@@ -291,7 +291,21 @@ class TestPredictorIrOptim:
         cfg.switch_ir_optim(True)
         pred = inference.create_predictor(cfg)
         x = np.random.RandomState(1).randn(3, 8).astype(np.float32)
-        out, = pred.run([x])
+        # the predictor swallows IR-path failures (fallback by design) — make
+        # the test fail loudly if the pipeline didn't actually run
+        ran = {}
+        orig_run = ir.PassManager.run
+
+        def spy(self, prog):
+            ran["stats"] = orig_run(self, prog)
+            return ran["stats"]
+
+        ir.PassManager.run = spy
+        try:
+            out, = pred.run([x])
+        finally:
+            ir.PassManager.run = orig_run
+        assert "stats" in ran, "predictor never entered the IR pass pipeline"
         ref = net(paddle_tpu.to_tensor(x)).numpy()
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
         # and with passes off, same result
@@ -299,3 +313,19 @@ class TestPredictorIrOptim:
         cfg2.switch_ir_optim(False)
         out2, = inference.create_predictor(cfg2).run([x])
         np.testing.assert_allclose(out2, ref, rtol=1e-5, atol=1e-6)
+
+
+    def test_unfed_placeholder_rejected(self):
+        import paddle_tpu.static as static
+
+        paddle_tpu.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [2], "float32")
+                y2 = static.data("y2", [2], "float32")
+                z = x + y2
+            with pytest.raises(ValueError, match="feed_vars"):
+                ir.translate_static(main, fetch_vars=[z], feed_vars=[x])
+        finally:
+            paddle_tpu.disable_static()
