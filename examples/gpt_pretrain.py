@@ -1,0 +1,83 @@
+"""GPT causal-LM pretraining (the PaddleNLP gpt-3 example workflow:
+fleet hybrid strategy -> distributed model -> train loop -> checkpoints).
+
+Smoke (CPU): python examples/gpt_pretrain.py --smoke
+TPU:         python examples/gpt_pretrain.py --hidden 2048 --layers 12 \
+                 --batch 32 --steps 100
+Multi-chip:  set dp/mp degrees; shardings compile through GSPMD.
+"""
+
+import argparse
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny CPU run")
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--hidden", type=int, default=2048)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--lr", type=float, default=1e-4)
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--mp", type=int, default=1)
+    ap.add_argument("--save", default=None, help="checkpoint path prefix")
+    args = ap.parse_args()
+    if args.smoke:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        args.vocab, args.hidden, args.layers, args.heads = 256, 64, 2, 4
+        args.seq, args.batch, args.steps = 32, 4, 3
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": args.dp, "mp_degree": args.mp}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    cfg = GPTConfig(
+        vocab_size=args.vocab, hidden_size=args.hidden, num_layers=args.layers,
+        num_heads=args.heads, max_seq_len=args.seq, dropout=0.0,
+        use_recompute=not args.smoke, recompute_interval=2, loss_chunk=0 if args.smoke else 128,
+    )
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if on_tpu:
+        model = model.astype("bfloat16")
+    opt = paddle.optimizer.AdamW(
+        learning_rate=args.lr, parameters=model.parameters(),
+        multi_precision=on_tpu, moment_dtype="bfloat16" if on_tpu else None)
+    step = make_sharded_train_step(model, opt)
+
+    rng = np.random.RandomState(0)
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        x = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(args.batch, args.seq), dtype=np.int32))
+        y = jnp.asarray(np.roll(np.asarray(x), -1, axis=1))
+        loss = step(x, y)
+        if i % 10 == 0 or i == args.steps - 1:
+            print(f"step {i}: loss {float(loss):.4f}", flush=True)
+    dt = time.perf_counter() - t0
+    print(f"done: {args.steps * args.batch * args.seq / dt:.0f} tokens/sec")
+
+    if args.save:
+        step.sync_to_model()
+        paddle.save(model.state_dict(), args.save + ".pdparams")
+        paddle.save(opt.state_dict(), args.save + ".pdopt")
+        print(f"saved checkpoint to {args.save}.pdparams/.pdopt")
+
+
+if __name__ == "__main__":
+    main()

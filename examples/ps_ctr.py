@@ -1,0 +1,83 @@
+"""CTR-style training over parameter servers (the fleet PS-mode workflow —
+BASELINE's brpc-PS analog): sparse features live in native PS tables, the
+dense tower trains on-device; workers pull touched rows and push row grads.
+
+Smoke (local cluster in one process): python examples/ps_ctr.py --smoke
+Real deployment: run with TRAINING_ROLE=PSERVER / TRAINER and
+PADDLE_PSERVER_ENDPOINTS set (paddle.distributed.launch ps mode).
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--servers", type=int, default=2)
+    ap.add_argument("--emb-dim", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=1000)
+    ap.add_argument("--steps", type=int, default=60)
+    args = ap.parse_args()
+    if args.smoke:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import ps
+
+    role = os.environ.get("TRAINING_ROLE", "LOCAL")
+    if role == "PSERVER":
+        ps.init_server()
+        ps.run_server()
+        return
+
+    if role == "TRAINER":
+        client = ps.init_worker()
+        servers = []
+    else:  # LOCAL: spin a cluster inside this process
+        servers = [ps.PsServer("127.0.0.1:0").start() for _ in range(args.servers)]
+        client = ps.PsClient([s.endpoint for s in servers])
+
+    client.create_table(0, dim=args.emb_dim, init_range=0.05, seed=0)
+
+    # dense tower: emb-sum -> MLP -> logit
+    paddle.seed(0)
+    tower = paddle.nn.Sequential(
+        paddle.nn.Linear(args.emb_dim, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 1))
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=tower.parameters())
+
+    rng = np.random.RandomState(0)
+    # synthetic CTR: click iff any feature id is even
+    for step in range(args.steps):
+        ids = rng.randint(0, args.vocab, size=(16, 4)).astype(np.int64)
+        y = (ids % 2 == 0).any(axis=1).astype(np.float32)
+        flat = ids.reshape(-1)
+        rows = client.pull_sparse(0, flat)  # [16*4, D] host pull
+        emb = paddle.to_tensor(rows.reshape(16, 4, args.emb_dim).sum(axis=1))
+        emb.stop_gradient = False
+        logit = tower(emb)[:, 0]
+        loss = paddle.nn.functional.binary_cross_entropy_with_logits(
+            logit, paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        # sparse grad: d(loss)/d(emb) broadcast back over the 4 summed slots
+        gemb = emb.grad.numpy()  # [16, D]
+        grows = np.repeat(gemb[:, None, :], 4, axis=1).reshape(-1, args.emb_dim)
+        client.push_sparse(0, flat, grows, rule="adagrad", lr=0.05)
+        if step % 20 == 0 or step == args.steps - 1:
+            print(f"step {step}: loss {float(loss.numpy()):.4f}", flush=True)
+
+    print(f"table rows touched: {client.table_size(0)}")
+    if servers:
+        client.shutdown_servers()
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -163,6 +163,12 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
                 out = hook(Tensor(cot, stop_gradient=True))
                 if out is not None:
                     cot = out._value if isinstance(out, Tensor) else jnp.asarray(out)
+            # mixed-precision graphs (AMP) can accumulate a promoted cotangent
+            # (e.g. fp32 from a deny-list op summed into a bf16 branch); the
+            # vjp's primal output dtype is authoritative
+            if hasattr(cot, "dtype") and cot.dtype != dtype and \
+                    jnp.issubdtype(dtype, jnp.inexact):
+                cot = cot.astype(dtype)
             cots.append(cot)
         cot_pytree = jax.tree_util.tree_unflatten(node.out_tree, cots)
         in_cots = node.vjp_fn(cot_pytree)

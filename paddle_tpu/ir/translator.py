@@ -43,17 +43,17 @@ def translate_static(static_program, fetch_vars: Sequence,
         v = t._value
         env[id(t)] = prog.add_input(prog.ctx.tensor_type(str(v.dtype), v.shape))
 
+    unfed_placeholder_ops: Dict[int, str] = {}  # const op id -> placeholder name
+
     def value_of(tid: int) -> Value:
         got = env.get(tid)
         if got is None:  # captured tensor: parameter or eager intermediate
             t = static_program.tensors[tid]
+            op = prog.add_constant(t._value)
             if getattr(t, "_is_placeholder", False):
-                raise ValueError(
-                    f"placeholder {getattr(t, 'name', tid)!r} is reachable "
-                    "from the fetch targets but not listed in feed_vars — "
-                    "baking it in as a constant would silently freeze it at "
-                    "zeros")
-            got = prog.add_constant(t._value).result(0)
+                # tolerated only if dead wrt the fetches (checked below)
+                unfed_placeholder_ops[op.id] = getattr(t, "name", str(tid))
+            got = op.result(0)
             env[tid] = got
         return got
 
@@ -76,4 +76,24 @@ def translate_static(static_program, fetch_vars: Sequence,
 
     prog.set_outputs([value_of(id(t)) for t in fetch_vars])
     prog.verify()
+    if unfed_placeholder_ops:
+        # an unfed placeholder may only appear in dead captured branches
+        # (DCE strips those); if it REACHES a fetch target, translation would
+        # silently freeze it at its placeholder value — reject instead
+        reachable: set = set()
+        stack = [v for v in prog.outputs]
+        while stack:
+            v = stack.pop()
+            op = v.defining_op()
+            if op is None or op.id in reachable:
+                continue
+            reachable.add(op.id)
+            stack.extend(op.operands)
+        hit = [name for op_id, name in unfed_placeholder_ops.items()
+               if op_id in reachable]
+        if hit:
+            raise ValueError(
+                f"placeholder(s) {hit!r} are reachable from the fetch targets "
+                "but not listed in feed_vars — baking them in as constants "
+                "would silently freeze them at zeros")
     return prog

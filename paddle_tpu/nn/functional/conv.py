@@ -61,6 +61,9 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format, op
             # OI... -> ...IO
             perm = tuple(range(2, 2 + n)) + (1, 0)
             kernel = jnp.transpose(wv, perm)
+        # no preferred_element_type=f32: the MXU already accumulates bf16
+        # convs in fp32 internally, and the flag breaks the eager transpose
+        # rule (f32 cotangent against bf16 operands) under the AMP tape
         out = jax.lax.conv_general_dilated(
             xv,
             kernel,
@@ -69,9 +72,7 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format, op
             rhs_dilation=dilation,
             dimension_numbers=dn_str,
             feature_group_count=groups,
-            preferred_element_type=jnp.float32 if xv.dtype in (jnp.bfloat16, jnp.float16) else None,
         )
-        out = out.astype(xv.dtype)
         if rest:
             bshape = [1] * out.ndim
             bshape[-1 if channels_last else 1] = rest[0].shape[0]

@@ -1,0 +1,33 @@
+"""Smoke-run every examples/ script in a subprocess (--smoke mode, CPU).
+These are the user-journey checks: if an example breaks, a reference user's
+first contact with the framework breaks."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = [
+    "gpt_pretrain.py",
+    "bert_finetune.py",
+    "resnet_train.py",
+    "ps_ctr.py",
+    "deploy_inference.py",
+    "moe_hybrid_parallel.py",
+]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("script", EXAMPLES)
+def test_example_smoke(script):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"{script} failed:\nstdout:\n{proc.stdout[-3000:]}\nstderr:\n{proc.stderr[-3000:]}")
+    assert "done" in proc.stdout

@@ -329,3 +329,21 @@ class TestPredictorIrOptim:
                 ir.translate_static(main, fetch_vars=[z], feed_vars=[x])
         finally:
             paddle_tpu.disable_static()
+
+    def test_unfed_placeholder_in_dead_branch_allowed(self):
+        import paddle_tpu.static as static
+
+        paddle_tpu.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [2], "float32")
+                y2 = static.data("y2", [2], "float32")
+                z = x * 2.0
+                w = y2 + 1.0  # noqa: F841  dead wrt the fetch
+            prog = ir.translate_static(main, fetch_vars=[z], feed_vars=[x])
+            prog.dce()
+            out, = prog.to_callable()(jnp.ones(2, jnp.float32))
+            np.testing.assert_allclose(np.asarray(out), [2.0, 2.0])
+        finally:
+            paddle_tpu.disable_static()
