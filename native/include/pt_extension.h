@@ -28,14 +28,17 @@
 
 #pragma once
 
+#ifdef __cplusplus
 #include <cstdint>
 #include <cstring>
 #include <string>
 #include <vector>
+extern "C" {
+#else
+#include <stdint.h>
+#endif
 
 #define PT_MAX_NDIM 8
-
-extern "C" {
 
 // dtype codes match paddle_tpu.native._DTYPE_CODES
 typedef struct {
@@ -47,6 +50,8 @@ typedef struct {
 
 typedef int (*PT_KernelFn)(const PT_Tensor* ins, int32_t n_in,
                            PT_Tensor* outs, int32_t n_out);
+
+#ifdef __cplusplus
 }  // extern "C"
 
 inline int64_t pt_numel(const PT_Tensor* t) {
@@ -121,3 +126,4 @@ __attribute__((visibility("default"), used)) inline int32_t pt_op_compute(
 }
 
 }  // extern "C"
+#endif  /* __cplusplus */

@@ -1,0 +1,40 @@
+/* C inference API (capi_exp analog) — see native/src/capi.cc.
+ *
+ * Usage from C:
+ *   pt_infer_init();
+ *   void* p = pt_predictor_create("/path/model_prefix");
+ *   PT_Tensor in = {...};                 // dtype codes as pt_extension.h
+ *   pt_predictor_run(p, &in, 1);
+ *   int n = pt_predictor_num_outputs(p);
+ *   pt_predictor_output_meta(p, 0, &dt, &nd, shape, &nbytes);
+ *   pt_predictor_output_data(p, 0, buf, nbytes);
+ *   pt_predictor_destroy(p);
+ *
+ * Link: -lpaddle_tpu_infer -lpython3.12. The embedded runtime needs
+ * PYTHONPATH to reach paddle_tpu and its deps; PT_CAPI_PLATFORM selects the
+ * backend (default "cpu").
+ */
+#pragma once
+
+#include <stdint.h>
+
+#include "pt_extension.h" /* PT_Tensor */
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+int32_t pt_infer_init(void);
+const char* pt_infer_last_error(void);
+void* pt_predictor_create(const char* model_prefix);
+int32_t pt_predictor_run(void* predictor, const PT_Tensor* inputs, int32_t n_inputs);
+int32_t pt_predictor_num_outputs(void* predictor);
+int32_t pt_predictor_output_meta(void* predictor, int32_t i, int32_t* dtype,
+                                 int32_t* ndim, int64_t* shape, int64_t* nbytes);
+int32_t pt_predictor_output_data(void* predictor, int32_t i, void* dst,
+                                 int64_t cap_bytes);
+void pt_predictor_destroy(void* predictor);
+
+#ifdef __cplusplus
+}
+#endif

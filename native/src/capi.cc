@@ -1,0 +1,304 @@
+// C inference API — the capi_exp analog (fluid/inference/capi_exp/pd_*.h:
+// PD_ConfigCreate / PD_PredictorCreate / PD_PredictorRun and the Tensor
+// handle surface).
+//
+// TPU-first architecture note: the reference's C API fronts a C++
+// AnalysisPredictor; ours fronts the XLA/PJRT serving path, whose runtime
+// lives in Python (jit.save'd StableHLO -> inference.Predictor -> AOT
+// compile). So this library EMBEDS the interpreter (libpython) and exposes a
+// pure-C ABI over it — C/Go/Rust callers link this .so and never see Python.
+// Tensor layout is PT_Tensor from pt_extension.h (same dtype codes as
+// paddle_tpu.native).
+//
+// ABI (all functions return 0 on success unless noted; thread-safe via GIL):
+//   pt_infer_init()                         bootstrap interpreter + bridge
+//   pt_predictor_create(model_prefix)       -> opaque handle or NULL
+//   pt_predictor_run(h, ins, n_in)          run; outputs cached on handle
+//   pt_predictor_num_outputs(h)             -> count (after run)
+//   pt_predictor_output_meta(h, i, ...)     dtype/ndim/shape of output i
+//   pt_predictor_output_data(h, i, dst, cap) copy output i into dst
+//   pt_predictor_destroy(h)
+//   pt_infer_last_error()                   -> static error string
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#include "../include/pt_extension.h"
+
+namespace {
+
+std::mutex g_mu;
+std::string g_last_error;
+PyObject* g_bridge = nullptr;  // module dict of the embedded bridge
+
+void SetError(const std::string& msg) { g_last_error = msg; }
+
+void SetPyError(const char* where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = where;
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg += ": ";
+      msg += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  PyErr_Clear();
+  SetError(msg);
+}
+
+// the Python side of the bridge: numpy marshalling + predictor registry
+const char* kBridgeSrc = R"PY(
+import os
+# PT_CAPI_PLATFORM wins over inherited env (a host JAX_PLATFORMS=tpu would
+# otherwise capture the embedded runtime); config.update as well — on some
+# PJRT plugin setups the env var alone is not honored post-registration
+_plat = os.environ.get("PT_CAPI_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _plat
+import jax
+jax.config.update("jax_platforms", _plat)
+import numpy as np
+
+# dtype codes: single source of truth is paddle_tpu.native (pt_extension.h
+# documents the same contract); the C-side kItem itemsizes are ABI-frozen
+# for codes 0..9 and re-checked below against these tables
+from paddle_tpu.native import _CODE_DTYPES as _DTYPES
+from paddle_tpu.native import _DTYPE_CODES as _CODES
+from paddle_tpu.native import _np_dtype
+
+
+class _Session:
+    def __init__(self, prefix):
+        from paddle_tpu import inference
+
+        cfg = inference.Config(prefix)
+        self.predictor = inference.create_predictor(cfg)
+        self.outputs = []
+
+    def run(self, arrays):
+        self.outputs = [np.ascontiguousarray(o) for o in self.predictor.run(arrays)]
+        return len(self.outputs)
+
+
+def create(prefix):
+    return _Session(prefix)
+
+
+def run(sess, metas, views):
+    arrays = []
+    for (dtype_code, shape), mv in zip(metas, views):
+        dt = _np_dtype(_DTYPES[dtype_code])
+        expect = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        if len(mv) != expect:  # catches C-side itemsize desync
+            raise ValueError(f"input buffer is {len(mv)} bytes, expected {expect}")
+        arr = np.frombuffer(mv, dtype=dt)
+        arrays.append(arr.reshape(shape))
+    return sess.run(arrays)
+
+
+def output_meta(sess, i):
+    o = sess.outputs[i]
+    name = "bfloat16" if o.dtype.name == "bfloat16" else o.dtype.name
+    return _CODES[name], list(o.shape), o.nbytes
+
+
+def output_bytes(sess, i):
+    return sess.outputs[i].tobytes()
+)PY";
+
+bool EnsureBridge() {
+  if (g_bridge) return true;
+  bool we_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);  // leaves THIS thread holding the GIL
+    we_initialized = true;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* mod = PyModule_New("pt_capi_bridge");
+  PyObject* dict = PyModule_GetDict(mod);
+  PyDict_SetItemString(dict, "__builtins__", PyEval_GetBuiltins());
+  PyObject* res = PyRun_String(kBridgeSrc, Py_file_input, dict, dict);
+  bool ok = res != nullptr;
+  if (!ok) {
+    SetPyError("bridge bootstrap failed");
+    Py_DECREF(mod);
+  } else {
+    Py_DECREF(res);
+    g_bridge = mod;  // keep module (and its dict) alive forever
+  }
+  PyGILState_Release(gil);
+  if (we_initialized) {
+    // release the init thread's GIL so OTHER threads' PyGILState_Ensure can
+    // acquire it — without this, any multi-threaded caller deadlocks
+    PyEval_SaveThread();
+  }
+  return ok;
+}
+
+PyObject* BridgeFn(const char* name) {
+  PyObject* dict = PyModule_GetDict(g_bridge);
+  return PyDict_GetItemString(dict, name);  // borrowed
+}
+
+}  // namespace
+
+extern "C" {
+
+__attribute__((visibility("default"))) const char* pt_infer_last_error() {
+  return g_last_error.c_str();
+}
+
+__attribute__((visibility("default"))) int32_t pt_infer_init() {
+  std::lock_guard<std::mutex> lock(g_mu);
+  return EnsureBridge() ? 0 : -1;
+}
+
+__attribute__((visibility("default"))) void* pt_predictor_create(const char* model_prefix) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!EnsureBridge()) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* sess = PyObject_CallFunction(BridgeFn("create"), "s", model_prefix);
+  if (!sess) SetPyError("pt_predictor_create");
+  PyGILState_Release(gil);
+  return sess;  // owned reference doubles as the handle
+}
+
+__attribute__((visibility("default"))) int32_t pt_predictor_run(
+    void* h, const PT_Tensor* ins, int32_t n_in) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!h || !EnsureBridge()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* metas = PyList_New(n_in);
+  PyObject* views = PyList_New(n_in);
+  int32_t rc = 0;
+  for (int32_t i = 0; i < n_in; ++i) {
+    const PT_Tensor& t = ins[i];
+    int64_t numel = 1;
+    PyObject* shape = PyList_New(t.ndim);
+    for (int32_t d = 0; d < t.ndim; ++d) {
+      numel *= t.shape[d];
+      PyList_SetItem(shape, d, PyLong_FromLongLong(t.shape[d]));
+    }
+    static const int64_t kItem[] = {4, 8, 2, 2, 1, 1, 2, 4, 8, 1};
+    int64_t nbytes = numel * (t.dtype >= 0 && t.dtype <= 9 ? kItem[t.dtype] : 0);
+    if (nbytes <= 0 || !t.data) {
+      SetError("pt_predictor_run: bad input tensor meta");
+      rc = -2;
+      Py_DECREF(shape);
+      break;
+    }
+    PyObject* meta = Py_BuildValue("(iO)", t.dtype, shape);
+    Py_DECREF(shape);
+    PyObject* mv = meta ? PyMemoryView_FromMemory(
+        static_cast<char*>(t.data), nbytes, PyBUF_READ) : nullptr;
+    if (!meta || !mv) {
+      SetPyError("pt_predictor_run: input marshalling failed");
+      Py_XDECREF(meta);
+      Py_XDECREF(mv);
+      rc = -2;
+      break;
+    }
+    PyList_SetItem(metas, i, meta);
+    PyList_SetItem(views, i, mv);
+  }
+  if (rc == 0) {
+    PyObject* out = PyObject_CallFunction(BridgeFn("run"), "OOO",
+                                          static_cast<PyObject*>(h), metas, views);
+    if (!out) {
+      SetPyError("pt_predictor_run");
+      rc = -3;
+    } else {
+      Py_DECREF(out);
+    }
+  }
+  Py_DECREF(metas);
+  Py_DECREF(views);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+__attribute__((visibility("default"))) int32_t pt_predictor_num_outputs(void* h) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!h) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* outs = PyObject_GetAttrString(static_cast<PyObject*>(h), "outputs");
+  int32_t n = outs ? static_cast<int32_t>(PyList_Size(outs)) : -1;
+  Py_XDECREF(outs);
+  if (n < 0) SetPyError("pt_predictor_num_outputs");
+  PyGILState_Release(gil);
+  return n;
+}
+
+__attribute__((visibility("default"))) int32_t pt_predictor_output_meta(
+    void* h, int32_t i, int32_t* dtype, int32_t* ndim, int64_t* shape,
+    int64_t* nbytes) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!h) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* meta = PyObject_CallFunction(BridgeFn("output_meta"), "Oi",
+                                         static_cast<PyObject*>(h), i);
+  int32_t rc = 0;
+  if (!meta) {
+    SetPyError("pt_predictor_output_meta");
+    rc = -2;
+  } else {
+    PyObject* code = PyTuple_GetItem(meta, 0);
+    PyObject* dims = PyTuple_GetItem(meta, 1);
+    PyObject* nb = PyTuple_GetItem(meta, 2);
+    *dtype = static_cast<int32_t>(PyLong_AsLong(code));
+    *ndim = static_cast<int32_t>(PyList_Size(dims));
+    for (int32_t d = 0; d < *ndim && d < PT_MAX_NDIM; ++d)
+      shape[d] = PyLong_AsLongLong(PyList_GetItem(dims, d));
+    *nbytes = PyLong_AsLongLong(nb);
+    Py_DECREF(meta);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+__attribute__((visibility("default"))) int32_t pt_predictor_output_data(
+    void* h, int32_t i, void* dst, int64_t cap_bytes) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!h || !dst) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* bytes = PyObject_CallFunction(BridgeFn("output_bytes"), "Oi",
+                                          static_cast<PyObject*>(h), i);
+  int32_t rc = 0;
+  if (!bytes) {
+    SetPyError("pt_predictor_output_data");
+    rc = -2;
+  } else {
+    char* buf = nullptr;
+    Py_ssize_t n = 0;
+    PyBytes_AsStringAndSize(bytes, &buf, &n);
+    if (n > cap_bytes) {
+      SetError("pt_predictor_output_data: destination too small");
+      rc = -3;
+    } else {
+      std::memcpy(dst, buf, n);
+    }
+    Py_DECREF(bytes);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+__attribute__((visibility("default"))) void pt_predictor_destroy(void* h) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!h) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_DECREF(static_cast<PyObject*>(h));
+  PyGILState_Release(gil);
+}
+
+}  // extern "C"

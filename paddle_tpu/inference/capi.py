@@ -1,0 +1,42 @@
+"""Build helper for the C inference API (fluid/inference/capi_exp analog;
+native/src/capi.cc embeds the Python/XLA runtime behind a pure-C ABI).
+
+``build()`` compiles libpaddle_tpu_infer.so once; C/Go callers link it with
+-lpython3.12 and include native/include/pt_inference.h. Runtime env for the
+embedded interpreter: PYTHONPATH must reach paddle_tpu + site-packages, and
+PT_CAPI_PLATFORM picks the backend (default cpu)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_NATIVE = os.path.normpath(os.path.join(_HERE, "..", "..", "native"))
+_SRC = os.path.join(_NATIVE, "src", "capi.cc")
+_LIB = os.path.join(_NATIVE, "build", "libpaddle_tpu_infer.so")
+
+
+def include_dir() -> str:
+    return os.path.join(_NATIVE, "include")
+
+
+def build(force: bool = False) -> str:
+    """Compile the C API library if missing/stale; returns the .so path."""
+    hdr = os.path.join(include_dir(), "pt_extension.h")
+    if not force and os.path.exists(_LIB) and \
+            os.path.getmtime(_LIB) >= max(os.path.getmtime(_SRC), os.path.getmtime(hdr)):
+        return _LIB
+    os.makedirs(os.path.dirname(_LIB), exist_ok=True)
+    py_inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    ver = sysconfig.get_config_var("LDVERSION") or "3.12"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+           "-I", py_inc, "-I", include_dir(),
+           "-o", _LIB, _SRC, f"-L{libdir}", f"-lpython{ver}",
+           f"-Wl,-rpath,{libdir}"]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"building C inference API failed:\n{proc.stderr}")
+    return _LIB

@@ -1,0 +1,147 @@
+"""Kernel Primitive API — the KPS analog (phi/kernels/primitive/, kps/:
+block-level device-portable primitives so one kernel source targets multiple
+backends; SURVEY §2.2).
+
+TPU re-design: the portability target is Mosaic's tiling rules rather than
+CUDA/XPU-KP. These helpers encode the layout discipline every Pallas TPU
+kernel here follows — 128-lane trailing dimension, (8,128) float32 tiles,
+flatten-arbitrary-shape-to-padded-2D — plus factory functions that turn a
+plain jnp expression into a tiled elementwise or row-reduction kernel.
+kernels/fused_optim.py and norms.py are hand-rolled instances of the same
+patterns; new kernels should build on these.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANES = 128        # vector lane width (trailing-dim tile)
+SUBLANES = 8       # float32 sublane count -> (8, 128) native tile
+DEFAULT_BLOCK_ROWS = 512
+
+
+def interpret() -> bool:
+    """Pallas interpret mode off-TPU (tests on CPU)."""
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def pad_rows(n: int, lanes: int = LANES) -> int:
+    """Rows of the [rows, lanes] 2D view holding n flat elements."""
+    return -(-n // lanes)
+
+
+def to_tiled_2d(a, lanes: int = LANES):
+    """Flatten to [rows, lanes] with zero padding (ReadData analog: every
+    kernel sees a lane-aligned 2D block regardless of logical shape)."""
+    n = a.size
+    rows = pad_rows(n, lanes)
+    flat = a.reshape(-1)
+    if rows * lanes != n:
+        flat = jnp.pad(flat, (0, rows * lanes - n))
+    return flat.reshape(rows, lanes)
+
+
+def from_tiled_2d(a2d, shape: Sequence[int]):
+    """Inverse of to_tiled_2d (WriteData analog)."""
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return a2d.reshape(-1)[:n].reshape(shape)
+
+
+def row_block_spec(block_rows: int, lanes: int = LANES) -> pl.BlockSpec:
+    """1-D grid over row blocks of a [rows, lanes] view."""
+    return pl.BlockSpec((block_rows, lanes), lambda i: (i, 0))
+
+
+def elementwise_kernel(fn: Callable, block_rows: int = DEFAULT_BLOCK_ROWS):
+    """Lift ``fn(*blocks) -> block`` (pure jnp, fp32 math) into a tiled
+    Pallas kernel over any same-shaped operands (ElementwiseUnary/Binary/
+    Ternary analog in one factory).
+
+        scaled_residual = elementwise_kernel(lambda x, y, a: x + a * y)
+        out = scaled_residual(x, y, alpha)          # any shape, any dtype
+    """
+
+    def kernel(*refs):
+        ins, out_ref = refs[:-1], refs[-1]
+        vals = [r[...].astype(jnp.float32) for r in ins]
+        out_ref[...] = fn(*vals).astype(out_ref.dtype)
+
+    @functools.wraps(fn)
+    def call(*arrays):
+        arrays = [jnp.asarray(a) for a in arrays]
+        shape, dtype = arrays[0].shape, arrays[0].dtype
+        for a in arrays[1:]:
+            if a.shape != shape:
+                raise ValueError(f"elementwise operands must share a shape; "
+                                 f"got {shape} vs {a.shape}")
+        tiled = [to_tiled_2d(a) for a in arrays]
+        rows = tiled[0].shape[0]
+        br = min(block_rows, rows)
+        out = pl.pallas_call(
+            kernel,
+            grid=(pl.cdiv(rows, br),),
+            in_specs=[row_block_spec(br)] * len(tiled),
+            out_specs=row_block_spec(br),
+            out_shape=jax.ShapeDtypeStruct((rows, LANES), dtype),
+            interpret=interpret(),
+        )(*tiled)
+        return from_tiled_2d(out, shape)
+
+    return call
+
+
+def row_reduce_kernel(fn: Callable, init: float,
+                      block_cols: int = 1024):
+    """Lift a pairwise reduction ``fn(acc, block) -> acc`` over the LAST axis
+    into a tiled kernel (Reduce<kps::AddFunctor> analog). The input is viewed
+    as [rows, cols]; cols must be lane-aligned for the fast path, otherwise
+    falls back to jnp.
+
+        row_sum = row_reduce_kernel(lambda acc, x: acc + x.sum(-1), 0.0)
+        out = row_sum(x)   # [..., cols] -> [...]
+    """
+
+    def kernel(x_ref, out_ref, *, cols, bc):
+        acc = jnp.full((x_ref.shape[0],), init, jnp.float32)
+
+        def body(c, acc):
+            blk = x_ref[:, pl.dslice(c * bc, bc)].astype(jnp.float32)
+            return fn(acc, blk)
+
+        acc = jax.lax.fori_loop(0, cols // bc, body, acc)
+        out_ref[:, 0] = acc.astype(out_ref.dtype)
+
+    def call(x):
+        x = jnp.asarray(x)
+        *lead, cols = x.shape
+        rows = 1
+        for s in lead:
+            rows *= int(s)
+        if cols % LANES or rows % SUBLANES:
+            # layout-unfriendly shape: let XLA handle it
+            acc = jnp.full(tuple(lead) or (), init, jnp.float32)
+            return fn(acc.reshape(rows), x.reshape(rows, cols).astype(jnp.float32)) \
+                .reshape(lead).astype(x.dtype)
+        x2 = x.reshape(rows, cols)
+        bc = min(block_cols, cols)
+        while cols % bc:  # the loop covers cols//bc blocks, so bc MUST divide
+            bc //= 2      # cols exactly (cols is lane-aligned, so bc>=LANES
+        #                   always terminates with a divisor)
+        out = pl.pallas_call(
+            functools.partial(kernel, cols=cols, bc=bc),
+            grid=(1,),
+            in_specs=[pl.BlockSpec((rows, cols), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((rows, 1), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, 1), x.dtype),
+            interpret=interpret(),
+        )(x2)
+        return out.reshape(lead)
+
+    return call

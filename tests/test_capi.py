@@ -1,0 +1,107 @@
+"""C inference API end-to-end (capi_exp analog): save a model from Python,
+then compile and run a REAL C program against libpaddle_tpu_infer.so and
+compare its output with the eager forward."""
+
+import os
+import subprocess
+import sys
+import sysconfig
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+C_PROGRAM = textwrap.dedent("""
+    #include <stdio.h>
+    #include <stdlib.h>
+    #include "pt_inference.h"
+
+    int main(int argc, char** argv) {
+      if (pt_infer_init() != 0) {
+        fprintf(stderr, "init failed: %s\\n", pt_infer_last_error());
+        return 1;
+      }
+      void* pred = pt_predictor_create(argv[1]);
+      if (!pred) {
+        fprintf(stderr, "create failed: %s\\n", pt_infer_last_error());
+        return 2;
+      }
+      float data[3 * 8];
+      FILE* f = fopen(argv[2], "rb");
+      if (fread(data, sizeof(float), 3 * 8, f) != 3 * 8) return 3;
+      fclose(f);
+      PT_Tensor in;
+      in.dtype = 0;  /* float32 */
+      in.ndim = 2;
+      in.shape[0] = 3;
+      in.shape[1] = 8;
+      in.data = data;
+      if (pt_predictor_run(pred, &in, 1) != 0) {
+        fprintf(stderr, "run failed: %s\\n", pt_infer_last_error());
+        return 4;
+      }
+      int32_t n = pt_predictor_num_outputs(pred);
+      int32_t dt, nd;
+      int64_t shape[PT_MAX_NDIM], nbytes;
+      pt_predictor_output_meta(pred, 0, &dt, &nd, shape, &nbytes);
+      float* out = (float*)malloc(nbytes);
+      pt_predictor_output_data(pred, 0, out, nbytes);
+      printf("outputs=%d dtype=%d ndim=%d shape=%lld,%lld\\n", n, dt, nd,
+             (long long)shape[0], (long long)shape[1]);
+      FILE* g = fopen(argv[3], "wb");
+      fwrite(out, 1, nbytes, g);
+      fclose(g);
+      free(out);
+      pt_predictor_destroy(pred);
+      printf("done\\n");
+      return 0;
+    }
+""")
+
+
+@pytest.mark.skipif(not os.path.exists("/usr/local/lib/libpython3.12.so"),
+                    reason="libpython not available for embedding")
+def test_c_program_runs_saved_model(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu import jit, nn
+    from paddle_tpu.inference import capi
+    from paddle_tpu.static import InputSpec
+
+    # 1. train-ish + save from Python
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    net.eval()
+    prefix = str(tmp_path / "model")
+    jit.save(net, prefix, input_spec=[InputSpec([None, 8], "float32")])
+    x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    xpath = str(tmp_path / "input.bin")
+    x.tofile(xpath)
+
+    # 2. build the C API lib + the C client
+    lib = capi.build()
+    csrc = tmp_path / "client.c"
+    csrc.write_text(C_PROGRAM)
+    exe = str(tmp_path / "client")
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    ver = sysconfig.get_config_var("LDVERSION") or "3.12"
+    subprocess.run(
+        ["gcc", str(csrc), "-I", capi.include_dir(), "-o", exe,
+         lib, f"-L{libdir}", f"-lpython{ver}",
+         f"-Wl,-rpath,{os.path.dirname(lib)}", f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True, text=True)
+
+    # 3. run the C binary (standalone process embedding the runtime)
+    env = dict(os.environ)
+    site = sysconfig.get_path("purelib")
+    env["PYTHONPATH"] = os.pathsep.join([REPO, site, env.get("PYTHONPATH", "")])
+    env["PT_CAPI_PLATFORM"] = "cpu"
+    outpath = str(tmp_path / "output.bin")
+    proc = subprocess.run([exe, prefix, xpath, outpath],
+                          capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, f"C client failed:\n{proc.stdout}\n{proc.stderr}"
+    assert "outputs=1 dtype=0 ndim=2 shape=3,4" in proc.stdout
+    got = np.fromfile(outpath, np.float32).reshape(3, 4)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
