@@ -226,3 +226,51 @@ def test_linalg_cond_lu_unpack():
     lu_, piv = paddle.linalg.lu(x)
     P, L, U = paddle.linalg.lu_unpack(lu_, piv)
     np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), x.numpy(), rtol=1e-4, atol=1e-5)
+
+
+class TestCiTools:
+    """tools/ CI gates (ci_op_benchmark + parity checker analogs)."""
+
+    def test_op_benchmark_save_and_check(self, tmp_path):
+        import subprocess
+        import sys as _sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        base = str(tmp_path / "base.json")
+        p1 = subprocess.run([_sys.executable, os.path.join(repo, "tools", "op_benchmark.py"),
+                             "--save", base, "--repeats", "2"],
+                            capture_output=True, text=True, timeout=300, env=env)
+        assert p1.returncode == 0, p1.stderr
+        assert os.path.exists(base)
+        # same machine re-check with a generous threshold passes
+        p2 = subprocess.run([_sys.executable, os.path.join(repo, "tools", "op_benchmark.py"),
+                             "--check", base, "--threshold", "25", "--repeats", "2"],
+                            capture_output=True, text=True, timeout=300, env=env)
+        assert p2.returncode == 0, p2.stdout + p2.stderr
+        assert "no regressions" in p2.stdout
+        # an impossible threshold fails the gate
+        import json as _json
+        with open(base) as f:
+            tight = {k: v / 1e6 for k, v in _json.load(f).items()}
+        tbase = str(tmp_path / "tight.json")
+        with open(tbase, "w") as f:
+            _json.dump(tight, f)
+        p3 = subprocess.run([_sys.executable, os.path.join(repo, "tools", "op_benchmark.py"),
+                             "--check", tbase, "--threshold", "1.0", "--repeats", "2"],
+                            capture_output=True, text=True, timeout=300, env=env)
+        assert p3.returncode == 1 and "REGRESSIONS" in p3.stdout
+
+    def test_parity_gate(self):
+        import subprocess
+        import sys as _sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        p = subprocess.run([_sys.executable, os.path.join(repo, "tools", "check_api_parity.py")],
+                           capture_output=True, text=True, timeout=600, env=env)
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "total missing: 0" in p.stdout or "nothing to check" in p.stdout

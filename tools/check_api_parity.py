@@ -1,0 +1,40 @@
+"""CI gate: assert full namespace parity with the reference (the standing
+version of tests/test_api_parity_audit.py — run `python
+tools/check_api_parity.py`; exits 1 listing any missing names)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    import importlib
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from test_api_parity_audit import CHECKS, REF, _ref_all
+
+    if not os.path.isdir(REF):
+        print("reference checkout not available; nothing to check")
+        return 0
+    total = 0
+    for relpath, modname in CHECKS:
+        ref_names = _ref_all(relpath)
+        if not ref_names:
+            continue
+        mod = importlib.import_module(modname)
+        missing = [n for n in dict.fromkeys(ref_names) if not hasattr(mod, n)]
+        if missing:
+            total += len(missing)
+            print(f"{modname}: missing {missing}")
+    print(f"total missing: {total}")
+    return 1 if total else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
