@@ -255,11 +255,19 @@ __attribute__((visibility("default"))) int32_t pt_predictor_output_meta(
     PyObject* code = PyTuple_GetItem(meta, 0);
     PyObject* dims = PyTuple_GetItem(meta, 1);
     PyObject* nb = PyTuple_GetItem(meta, 2);
-    *dtype = static_cast<int32_t>(PyLong_AsLong(code));
-    *ndim = static_cast<int32_t>(PyList_Size(dims));
-    for (int32_t d = 0; d < *ndim && d < PT_MAX_NDIM; ++d)
-      shape[d] = PyLong_AsLongLong(PyList_GetItem(dims, d));
-    *nbytes = PyLong_AsLongLong(nb);
+    int32_t rank = static_cast<int32_t>(PyList_Size(dims));
+    if (rank > PT_MAX_NDIM) {
+      // never report more dims than we wrote — the caller would read
+      // uninitialized shape slots (mirrors the input-side ndim validation)
+      SetError("pt_predictor_output_meta: output rank exceeds PT_MAX_NDIM");
+      rc = -3;
+    } else {
+      *dtype = static_cast<int32_t>(PyLong_AsLong(code));
+      *ndim = rank;
+      for (int32_t d = 0; d < rank; ++d)
+        shape[d] = PyLong_AsLongLong(PyList_GetItem(dims, d));
+      *nbytes = PyLong_AsLongLong(nb);
+    }
     Py_DECREF(meta);
   }
   PyGILState_Release(gil);

@@ -166,13 +166,27 @@ def enabled() -> bool:
 
 
 def _measure(fn: Callable[[], Any]) -> float:
-    """Median wall time of fn() with device sync (PickBestAlgorithm timing)."""
+    """Median wall time of fn() with device sync (PickBestAlgorithm timing).
+
+    Sync is a host transfer of one element of the output, NOT
+    block_until_ready: on remote-tunnel PJRT backends (axon)
+    block_until_ready acks dispatch, not completion, so every candidate
+    would time as ~dispatch latency and the "winner" would be noise.
+    A device->host copy of a single scalar is the only reliable barrier.
+    """
     import jax
+    import jax.numpy as jnp
 
     def sync(out):
-        jax.tree_util.tree_map(
-            lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x,
-            out)
+        import numpy as np
+        # All leaves of one call complete together, so one one-element
+        # device->host copy of the last leaf is a sufficient barrier.
+        # A failed transfer must propagate (pick_best disqualifies the
+        # candidate) — falling back to block_until_ready would time noise.
+        leaves = [x for x in jax.tree_util.tree_leaves(out)
+                  if hasattr(x, "dtype")]
+        if leaves:
+            np.asarray(jnp.ravel(leaves[-1])[:1])
 
     sync(fn())  # warmup (compile)
     times = []

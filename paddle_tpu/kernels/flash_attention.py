@@ -241,10 +241,12 @@ def _select_blocks(BH: int, S: int, D: int, dtype, causal: bool):
     if default[0] is None:
         return default
     candidates = [(bq, bk)
-                  for bq in (512, 256, 128) if S % bq == 0
-                  for bk in (512, 256, 128) if S % bk == 0]
-    if not candidates:
-        candidates = [default]
+                  for bq in (1024, 512, 256, 128) if S % bq == 0
+                  for bk in (1024, 512, 256, 128) if S % bk == 0]
+    if default not in candidates:
+        # measurement must be able to pick (and so can only improve on) the
+        # heuristic default, else enabling autotune could lock in a slower cfg
+        candidates.insert(0, default)
 
     def make_run(cfg):
         bq, bk = cfg

@@ -108,15 +108,19 @@ def row_reduce_kernel(fn: Callable, init: float,
         out = row_sum(x)   # [..., cols] -> [...]
     """
 
-    def kernel(x_ref, out_ref, *, cols, bc):
-        acc = jnp.full((x_ref.shape[0],), init, jnp.float32)
+    def kernel(x_ref, out_ref):
+        # grid dim 1 walks col blocks sequentially (TPU grids iterate the
+        # trailing dim innermost, in order), so the fp32 out block doubles as
+        # the running accumulator across col blocks: VMEM holds only
+        # (block_rows x block_cols) of x at a time, never the full row.
+        ci = pl.program_id(1)
 
-        def body(c, acc):
-            blk = x_ref[:, pl.dslice(c * bc, bc)].astype(jnp.float32)
-            return fn(acc, blk)
+        @pl.when(ci == 0)
+        def _init():
+            out_ref[:, 0] = jnp.full((out_ref.shape[0],), init, jnp.float32)
 
-        acc = jax.lax.fori_loop(0, cols // bc, body, acc)
-        out_ref[:, 0] = acc.astype(out_ref.dtype)
+        acc = out_ref[:, 0]
+        out_ref[:, 0] = fn(acc, x_ref[...].astype(jnp.float32))
 
     def call(x):
         x = jnp.asarray(x)
@@ -130,18 +134,23 @@ def row_reduce_kernel(fn: Callable, init: float,
             return fn(acc.reshape(rows), x.reshape(rows, cols).astype(jnp.float32)) \
                 .reshape(lead).astype(x.dtype)
         x2 = x.reshape(rows, cols)
-        bc = min(block_cols, cols)
-        while cols % bc:  # the loop covers cols//bc blocks, so bc MUST divide
-            bc //= 2      # cols exactly (cols is lane-aligned, so bc>=LANES
-        #                   always terminates with a divisor)
+
+        def divisor_block(limit, n, floor):
+            b = min(limit, n)
+            while n % b:  # n is a multiple of `floor`, so halving terminates
+                b //= 2
+            return max(b, floor)
+
+        bc = divisor_block(block_cols, cols, LANES)
+        br = divisor_block(DEFAULT_BLOCK_ROWS, rows, SUBLANES)
         out = pl.pallas_call(
-            functools.partial(kernel, cols=cols, bc=bc),
-            grid=(1,),
-            in_specs=[pl.BlockSpec((rows, cols), lambda i: (0, 0))],
-            out_specs=pl.BlockSpec((rows, 1), lambda i: (0, 0)),
-            out_shape=jax.ShapeDtypeStruct((rows, 1), x.dtype),
+            kernel,
+            grid=(rows // br, cols // bc),
+            in_specs=[pl.BlockSpec((br, bc), lambda i, j: (i, j))],
+            out_specs=pl.BlockSpec((br, 1), lambda i, j: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, 1), jnp.float32),
             interpret=interpret(),
         )(x2)
-        return out.reshape(lead)
+        return out.astype(x.dtype).reshape(lead)
 
     return call
