@@ -96,10 +96,34 @@ def in_rng_scope() -> bool:
     return getattr(_tls, "rng", None) is not None
 
 
+@contextlib.contextmanager
+def key_salt(salt):
+    """Fold a (possibly traced) salt into every key drawn in this scope.
+
+    The rng_scope counter is Python-side and static per trace position, so a
+    loop body traced once (lax.scan over pipeline ticks, blocks, or
+    microbatches) would reuse the same key at every iteration. Wrapping the
+    body in ``key_salt(iteration_index)`` folds the traced index in, giving
+    each iteration a distinct stream. Scopes nest; all active salts fold.
+    """
+    prev = getattr(_tls, "salts", ())
+    _tls.salts = prev + (salt,)
+    try:
+        yield
+    finally:
+        _tls.salts = prev
+
+
+def _apply_salts(key):
+    for s in getattr(_tls, "salts", ()):
+        key = jax.random.fold_in(key, s)
+    return key
+
+
 def next_key():
     """Fresh PRNG key from the active scope (traced) or the global generator."""
     state = getattr(_tls, "rng", None)
     if state is not None:
         state[1] += 1
-        return jax.random.fold_in(state[0], state[1])
-    return default_generator.next_key()
+        return _apply_salts(jax.random.fold_in(state[0], state[1]))
+    return _apply_salts(default_generator.next_key())

@@ -8,7 +8,11 @@ from . import mp_ops  # noqa: F401
 from .pipeline_parallel import (  # noqa: F401
     PipelineParallel,
     PipelineParallelWithInterleave,
+    PipelineSpec,
+    pipeline_schedule,
     spmd_pipeline,
+    stack_block_params,
+    unstack_block_params,
 )
 from .pp_layers import LayerDesc, PipelineLayer, SegmentLayers, SharedLayerDesc  # noqa: F401
 from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401

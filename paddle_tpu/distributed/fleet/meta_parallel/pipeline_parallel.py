@@ -25,6 +25,8 @@ TPU-native, two runtimes:
 
 from __future__ import annotations
 
+import re
+from dataclasses import dataclass
 from typing import Callable, List, Optional
 
 import jax
@@ -35,6 +37,67 @@ from jax import lax
 from ....core.tensor import Tensor
 from ....nn.layer.layers import Layer
 from .pp_layers import PipelineLayer
+
+
+@dataclass
+class PipelineSpec:
+    """How a model pipelines: the contract `make_sharded_train_step` uses to
+    build a compiled pp step (the PipelineLayer/LayerDesc partition role,
+    reference pp_layers.py:56, re-designed for SPMD homogeneity).
+
+    block_prefix: parameter-name prefix of the homogeneous block stack
+        (e.g. "gpt.layers" — params named f"{prefix}.{i}.{suffix}").
+    n_blocks: how many blocks the stack holds; must divide by pp_degree.
+    pre(params, buffers, x) -> h: everything before the blocks (embeddings).
+    block(block_params, h) -> h: ONE block's functional apply; block_params
+        keys are the per-block suffixes.
+    post_loss(params, buffers, h, y) -> scalar loss: everything after the
+        blocks (final norm, head, loss). `params` excludes block params.
+    """
+
+    block_prefix: str
+    n_blocks: int
+    pre: Callable
+    block: Callable
+    post_loss: Callable
+
+
+def stack_block_params(params: dict, spec: PipelineSpec, pp: int):
+    """Split {name: array} into (stacked, other): per-block params stacked to
+    [pp, L/pp, ...] leaves (contiguous blocks per stage), the rest untouched.
+
+    Returns (stacked: {suffix: array}, other: {name: array}).
+    """
+    L = spec.n_blocks
+    if L % pp:
+        raise ValueError(f"n_blocks {L} not divisible by pp degree {pp}")
+    pat = re.compile(rf"^{re.escape(spec.block_prefix)}\.(\d+)\.(.+)$")
+    by_suffix: dict = {}
+    other = {}
+    for name, v in params.items():
+        m = pat.match(name)
+        if m:
+            by_suffix.setdefault(m.group(2), {})[int(m.group(1))] = v
+        else:
+            other[name] = v
+    stacked = {}
+    for suffix, by_idx in by_suffix.items():
+        if len(by_idx) != L:
+            raise ValueError(f"block param {suffix}: have {len(by_idx)} of {L} layers")
+        leaves = [by_idx[i] for i in range(L)]
+        arr = jnp.stack(leaves)
+        stacked[suffix] = arr.reshape((pp, L // pp) + arr.shape[1:])
+    return stacked, other
+
+
+def unstack_block_params(stacked: dict, spec: PipelineSpec) -> dict:
+    """Inverse of stack_block_params: {suffix: [pp, L/pp, ...]} -> flat names."""
+    out = {}
+    for suffix, arr in stacked.items():
+        flat = arr.reshape((-1,) + arr.shape[2:])
+        for i in range(flat.shape[0]):
+            out[f"{spec.block_prefix}.{i}.{suffix}"] = flat[i]
+    return out
 
 
 class PipelineParallel(Layer):
@@ -120,46 +183,58 @@ class PipelineParallelWithInterleave(PipelineParallel):
     kept for API parity."""
 
 
-def spmd_pipeline(
+def pipeline_schedule(
     stage_fn: Callable,
     stacked_params,
     microbatches,
     axis_name: str = "pp",
     n_stages: Optional[int] = None,
+    remat: bool = True,
 ):
-    """Compiled GPipe loop for use INSIDE shard_map over the pp axis.
+    """Differentiable compiled pipeline schedule, for use INSIDE shard_map
+    over the pp axis (reference forward_backward_pipeline
+    fleet/meta_parallel/pipeline_parallel.py:153 + p2p_communication.py:543).
 
     stage_fn(params, x) -> y : one stage's compute (same arity every stage).
     stacked_params: pytree whose leaves have leading dim = n_stages, sharded
         over `axis_name` — each device sees its own stage's slice (leading
         dim 1, squeezed before stage_fn).
-    microbatches: [M, mb, ...] array, every device gets the full stream
-        (replicated in-spec); stage 0 consumes it, later stages consume the
-        rotated carry.
-    Returns the last stage's outputs for all M microbatches, [M, mb, ...],
-    replicated to every stage (a final psum broadcasts the last stage's
-    slots; other stages contribute zeros).
+    microbatches: [M, mb, ...] array; stage 0 consumes it, later stages
+        consume the ppermute'd carry.
+    Returns [M, mb, ...] outputs — valid ONLY on the LAST stage (zeros
+    elsewhere). Callers mask with `lax.axis_index(axis_name) == n-1` and psum
+    the (scalar) loss rather than broadcasting full microbatch activations.
 
-    The rotation is `lax.ppermute` i -> i+1 — the collective-permute that
-    replaces the reference's partial_send/recv p2p protocol (SURVEY §2.2).
+    Differentiation IS the backward pipeline: `lax.ppermute` transposes to
+    the reverse-direction permute and `lax.scan` transposes to the
+    reverse-time scan, so `jax.grad` of a loss on these outputs runs the
+    cooldown/steady/warmup backward schedule the reference hand-codes with
+    send_backward/recv_backward (p2p_communication.py:600). With
+    `remat=True` each tick's stage compute is rematerialized in the backward
+    pass, so live activation memory is the per-tick carry stream rather than
+    every block intermediate (the memory role 1F1B's eager backward plays in
+    the reference).
     """
     n = n_stages if n_stages is not None else lax.axis_size(axis_name)
-    my_params = jax.tree_util.tree_map(lambda p: p[0] if p.shape and p.shape[0] == 1 else p, stacked_params)
+    my_params = jax.tree_util.tree_map(
+        lambda p: p[0] if hasattr(p, "shape") and p.shape and p.shape[0] == 1 else p,
+        stacked_params)
     stage_idx = lax.axis_index(axis_name)
     M = microbatches.shape[0]
-    T = M + n - 1
     mb_shape = microbatches.shape[1:]
     perm = [(i, (i + 1) % n) for i in range(n)]
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
     def tick(carry, t):
+        from ....core import random as _random
+
         incoming, outputs = carry
         # stage 0 reads microbatch t from the stream; others read the carry
-        x_in = jnp.where(
-            stage_idx == 0,
-            microbatches[jnp.clip(t, 0, M - 1)],
-            incoming,
-        )
-        y = stage_fn(my_params, x_in)
+        x_in = jnp.where(stage_idx == 0, microbatches[jnp.clip(t, 0, M - 1)], incoming)
+        # salt RNG draws with the tick so dropout masks differ per microbatch
+        # (the scan body is traced once; see core.random.key_salt)
+        with _random.key_salt(t):
+            y = fn(my_params, x_in)
         # last stage records its result at slot t - (n - 1)
         slot = t - (n - 1)
         valid = (stage_idx == n - 1) & (slot >= 0)
@@ -175,5 +250,22 @@ def spmd_pipeline(
     init_in = jnp.zeros(mb_shape, microbatches.dtype)
     probe = jax.eval_shape(lambda p, x: stage_fn(p, x), my_params, init_in)
     outputs0 = jnp.zeros((M,) + tuple(probe.shape), probe.dtype)
-    (_, outputs), _ = lax.scan(tick, (init_in, outputs0), jnp.arange(T))
+    (_, outputs), _ = lax.scan(tick, (init_in, outputs0), jnp.arange(M + n - 1))
+    return outputs
+
+
+def spmd_pipeline(
+    stage_fn: Callable,
+    stacked_params,
+    microbatches,
+    axis_name: str = "pp",
+    n_stages: Optional[int] = None,
+):
+    """Legacy wrapper over `pipeline_schedule` that broadcasts the last
+    stage's outputs to every stage via psum. Prefer pipeline_schedule + a
+    masked scalar reduction — broadcasting full microbatch activations
+    wastes ICI bandwidth."""
+    outputs = pipeline_schedule(stage_fn, stacked_params, microbatches,
+                                axis_name=axis_name, n_stages=n_stages,
+                                remat=False)
     return lax.psum(outputs, axis_name)

@@ -82,6 +82,8 @@ class ShardedTrainStep:
         batch_spec: P = P("dp"),
         donate: bool = True,
         seed: int = 0,
+        accumulate_steps: Optional[int] = None,
+        pp_remat: bool = True,
     ):
         from ..topology import get_hybrid_communicate_group
 
@@ -97,11 +99,47 @@ class ShardedTrainStep:
         self._step_i = 0
         self._seed = seed
 
+        pp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("pp", 1)
+        self._pp = pp
+        self._pspec = None
+
         params0, buffers0 = model.functional_state()
         self._buffers = buffers0
-        opt_state0 = optimizer.init_state_pytree(params0)
 
-        p_shard = param_shardings(model, mesh)
+        if pp > 1:
+            # compiled pipeline parallelism: block params restack to
+            # [pp, L/pp, ...] leaves sharded over the pp axis; the step runs
+            # the differentiable ppermute schedule (pipeline_schedule)
+            if not hasattr(model, "pipeline_spec"):
+                raise ValueError(
+                    f"mesh has pp={pp} but {type(model).__name__} provides no "
+                    "pipeline_spec(); implement the PipelineSpec protocol "
+                    "(see meta_parallel.pipeline_parallel)")
+            from .meta_parallel.pipeline_parallel import stack_block_params
+
+            pspec = model.pipeline_spec()
+            self._pspec = pspec
+            self._accum = accumulate_steps if accumulate_steps else pp
+            stacked0, other0 = stack_block_params(params0, pspec, pp)
+            self._stack_prefix = f"{pspec.block_prefix}.__stacked__."
+            skey = lambda sfx: f"{self._stack_prefix}{sfx}"
+            self._suffixes = sorted(stacked0)
+            params0 = {**other0, **{skey(s): v for s, v in stacked0.items()}}
+
+            named = dict(model.named_parameters())
+            p_shard = {}
+            for name in other0:
+                p_shard[name] = NamedSharding(
+                    mesh, resolve_spec(getattr(named[name], "dist_spec", None), mesh))
+            for sfx in self._suffixes:
+                ref = named[f"{pspec.block_prefix}.0.{sfx}"]
+                bspec = resolve_spec(getattr(ref, "dist_spec", None), mesh)
+                entries = list(bspec) + [None] * (ref._value.ndim - len(bspec))
+                p_shard[skey(sfx)] = NamedSharding(mesh, P("pp", None, *entries))
+        else:
+            p_shard = param_shardings(model, mesh)
+
+        opt_state0 = optimizer.init_state_pytree(params0)
         shard_axis = getattr(optimizer, "_shard_state_axis", None)
         s_shard = {
             name: jax.tree_util.tree_map(
@@ -125,8 +163,12 @@ class ShardedTrainStep:
         # the caller supplied an explicit loss_fn
         use_fwl = loss_fn is None and hasattr(model, "forward_with_loss")
 
-        def step(params, opt_state, x, y, lr, seed):
-            def loss_of(pvals):
+        if pp > 1:
+            loss_impl = self._build_pipeline_loss(buffers0, pp_remat)
+        else:
+            self._accum = accumulate_steps if accumulate_steps else 1
+
+            def loss_impl(pvals, x, y, seed):
                 with no_grad(), _random.rng_scope(seed):
                     if use_fwl:
                         loss, _ = mdl.functional_call(
@@ -137,7 +179,46 @@ class ShardedTrainStep:
                         loss = loss_fn_(out, Tensor(y))
                 return loss._value.astype(jnp.float32)
 
-            loss, grads = jax.value_and_grad(loss_of)(params)
+        M_acc = self._accum
+        pp_mode = pp > 1
+
+        def value_and_grad_accum(params, x, y, seed):
+            """Gradient accumulation over M_acc microbatches (pipeline mode
+            microbatches inside the schedule instead): fwd+bwd per microbatch
+            inside a lax.scan, so only one microbatch's activations are live
+            at a time — the memory profile accumulation exists to provide."""
+            if pp_mode or M_acc <= 1:
+                return jax.value_and_grad(lambda p: loss_impl(p, x, y, seed))(params)
+            B = x.shape[0]
+            if B % M_acc:
+                raise ValueError(f"batch {B} not divisible by accumulate_steps {M_acc}")
+            mb = B // M_acc
+            # microbatch m = rows m::M — strided split keeps dp shards local
+            xs = jnp.swapaxes(x.reshape((mb, M_acc) + x.shape[1:]), 0, 1)
+            ys = jnp.swapaxes(y.reshape((mb, M_acc) + y.shape[1:]), 0, 1)
+
+            def body(carry, xsm):
+                acc_l, acc_g = carry
+                xm, ym, m = xsm
+
+                def micro_loss(p):
+                    with _random.key_salt(m):
+                        return loss_impl(p, xm, ym, seed)
+
+                l, g = jax.value_and_grad(micro_loss)(params)
+                return (acc_l + l,
+                        jax.tree_util.tree_map(jnp.add, acc_g, g)), None
+
+            from jax import lax
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (l, g), _ = lax.scan(body, (jnp.zeros((), jnp.float32), zeros),
+                                 (xs, ys, jnp.arange(M_acc)))
+            inv = 1.0 / M_acc
+            return l * inv, jax.tree_util.tree_map(lambda t: t * inv, g)
+
+        def step(params, opt_state, x, y, lr, seed):
+            loss, grads = value_and_grad_accum(params, x, y, seed)
             if clip_norm is not None:
                 gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree_util.tree_leaves(grads))
                 scale = clip_norm / jnp.maximum(jnp.sqrt(gsq), clip_norm)
@@ -152,6 +233,77 @@ class ShardedTrainStep:
             out_shardings=(p_shard, s_shard, NamedSharding(mesh, P())),
             donate_argnums=donate_args,
         )
+
+    def _build_pipeline_loss(self, buffers0, remat: bool):
+        """loss_impl for pp>1: shard_map manual over the pp axis only (dp/mp/
+        sharding stay under GSPMD auto partitioning), GPipe ppermute schedule
+        with grads flowing through its transpose (the backward pipeline)."""
+        from jax import lax, shard_map
+
+        from .meta_parallel.pipeline_parallel import pipeline_schedule
+
+        pspec = self._pspec
+        mesh = self.mesh
+        M = self._accum
+        prefix = self._stack_prefix
+
+        from ..sharding_utils import maybe_shard
+
+        def pipe_loss(pvals, x, y, seed):
+            stacked = {k[len(prefix):]: v for k, v in pvals.items() if k.startswith(prefix)}
+            other = {k: v for k, v in pvals.items() if not k.startswith(prefix)}
+
+            with no_grad(), _random.rng_scope(seed):
+                # pre/post run under plain GSPMD over the full mesh — only the
+                # homogeneous block schedule is manual over pp. The head is
+                # re-sharded over (dp, pp) below, so non-last stages help with
+                # the LM-head FLOPs instead of idling (the reference computes
+                # the head on the last stage only).
+                h0 = pspec.pre(other, buffers0, x)
+                B = h0.shape[0]
+                if B % M:
+                    raise ValueError(f"batch {B} not divisible by accumulate_steps {M}")
+                mb = B // M
+                # microbatch m = rows m::M — the strided split keeps each
+                # dp shard's rows local through the reshape
+                mbs = jnp.swapaxes(h0.reshape((mb, M) + h0.shape[1:]), 0, 1)
+
+                def body(stacked_loc, mbs_loc):
+                    def stage(bp, h):
+                        Lps = jax.tree_util.tree_leaves(bp)[0].shape[0]
+                        base = lax.axis_index("pp") * Lps
+
+                        def one(h, xs):
+                            bpi, li = xs
+                            # salt with the global layer index so dropout
+                            # masks differ per block (scan traces once)
+                            with _random.key_salt(base + li):
+                                return pspec.block(bpi, h), None
+
+                        h, _ = lax.scan(one, h, (bp, jnp.arange(Lps)))
+                        return h
+
+                    outs = pipeline_schedule(stage, stacked_loc, mbs_loc,
+                                             axis_name="pp", remat=remat)
+                    # expose the per-stage outputs on a leading pp axis; the
+                    # caller slices the last stage — no psum broadcast of
+                    # microbatch activations
+                    return outs[None]
+
+                outs_g = shard_map(
+                    body, mesh=mesh,
+                    in_specs=(P("pp"), P()),
+                    out_specs=P("pp"),
+                    axis_names={"pp"},
+                    check_vma=False,
+                )(stacked, mbs)
+                h_last = outs_g[-1]  # [M, mb, ...] — the last stage's stream
+                h_out = jnp.swapaxes(h_last, 0, 1).reshape((B,) + h_last.shape[2:])
+                h_out = maybe_shard(h_out, P(("dp", "pp")))
+                loss = pspec.post_loss(other, buffers0, h_out, y)
+            return loss.astype(jnp.float32)
+
+        return pipe_loss
 
     def __call__(self, x, y, lr: Optional[float] = None):
         lr = self.optimizer.get_lr() if lr is None else lr
@@ -171,6 +323,19 @@ class ShardedTrainStep:
 
     def sync_to_model(self):
         named = dict(self.model.named_parameters())
+        if self._pspec is not None:
+            from .meta_parallel.pipeline_parallel import unstack_block_params
+
+            prefix = self._stack_prefix
+            stacked = {k[len(prefix):]: v for k, v in self.params.items()
+                       if k.startswith(prefix)}
+            flat = unstack_block_params(stacked, self._pspec)
+            for name, v in self.params.items():
+                if not name.startswith(prefix):
+                    named[name]._set_value_raw(v)
+            for name, v in flat.items():
+                named[name]._set_value_raw(v)
+            return
         for name, v in self.params.items():
             named[name]._set_value_raw(v)
 

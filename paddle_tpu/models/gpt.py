@@ -196,14 +196,15 @@ class GPTForCausalLM(Layer):
         if not cfg.tie_word_embeddings:
             self.lm_head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size, has_bias=False, gather_output=False)
 
-    def forward(self, input_ids, position_ids=None):
-        h = self.gpt(input_ids, position_ids)
+    def _logits(self, h):
+        """LM head over final hidden states (tied or separate)."""
         if self.cfg.tie_word_embeddings:
             logits = h.matmul(self.gpt.embeddings.word_embeddings.weight, transpose_y=True)
-            logits = maybe_shard(logits, P("dp", None, "mp"))
-        else:
-            logits = self.lm_head(h)
-        return logits
+            return maybe_shard(logits, P("dp", None, "mp"))
+        return self.lm_head(h)
+
+    def forward(self, input_ids, position_ids=None):
+        return self._logits(self.gpt(input_ids, position_ids))
 
     def loss(self, logits, labels):
         """Next-token CE, labels already shifted by the data pipeline."""
@@ -258,6 +259,49 @@ class GPTForCausalLM(Layer):
 
         total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ys))
         return Tensor(total / (B * S))
+
+
+    # ---- compiled pipeline-parallel protocol (PipelineSpec) ----
+    def embed(self, input_ids):
+        """Pre-stage for pipeline parallelism: embeddings only."""
+        return self.gpt.embeddings(input_ids)
+
+    def head_loss(self, h, labels):
+        """Post-stage for pipeline parallelism: final LN + LM head + CE."""
+        return self.loss(self._logits(self.gpt.final_ln(h)), labels)
+
+    def pipeline_spec(self):
+        """PipelineSpec protocol consumed by make_sharded_train_step when the
+        mesh carries a pp axis (the PipelineLayer/LayerDesc partition role,
+        reference pp_layers.py:56, done functionally: embeddings = pre, the
+        homogeneous GPTBlock stack = stages, final LN + head + loss = post)."""
+        import jax.numpy as jnp
+
+        from ..distributed.fleet.meta_parallel.pipeline_parallel import PipelineSpec
+
+        model = self
+        block0 = self.gpt.layers[0]
+
+        def pre(params, buffers, x):
+            out, _ = model.functional_call(params, buffers, Tensor(x), method="embed")
+            return out._value
+
+        def block(bp, h):
+            out, _ = block0.functional_call(bp, {}, Tensor(h))
+            return out._value
+
+        def post_loss(params, buffers, h, y):
+            out, _ = model.functional_call(
+                params, buffers, Tensor(h), Tensor(y), method="head_loss")
+            return out._value.astype(jnp.float32)
+
+        return PipelineSpec(
+            block_prefix="gpt.layers",
+            n_blocks=self.cfg.num_layers,
+            pre=pre,
+            block=block,
+            post_loss=post_loss,
+        )
 
 
 def gpt_tiny(**overrides) -> GPTForCausalLM:

@@ -1,0 +1,177 @@
+"""Compiled differentiable pipeline parallelism (reference
+fleet/meta_parallel/pipeline_parallel.py:153 forward_backward_pipeline /
+:269 train_batch) on the 8-virtual-device CPU mesh.
+
+The contract under test: a pp>1 mesh + a model exposing the PipelineSpec
+protocol trains through make_sharded_train_step with gradients flowing
+through the ppermute schedule, and produces EXACTLY the same losses and
+parameter updates as the unpipelined run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    from paddle_tpu.distributed import collective, mesh, topology
+
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+    yield
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+
+
+def _train_gpt(pp, dp, mp, L=4, steps=2, M=2, batch=8, seed=0, **model_kw):
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": dp, "pp_degree": pp, "sharding_degree": 1, "mp_degree": mp,
+    }
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(seed)
+    model = gpt_tiny(dropout=0.0, num_layers=L, **model_kw)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = make_sharded_train_step(model, opt, accumulate_steps=M)
+    rng = np.random.RandomState(seed)
+    x = rng.randint(0, 128, size=(batch, 16))
+    y = np.roll(x, -1, axis=1)
+    losses = [float(step(x, y)) for _ in range(steps)]
+    step.sync_to_model()
+    return losses, model
+
+
+def test_pipeline_schedule_matches_sequential():
+    """The raw GPipe schedule applies stage_fns in order: outputs on the last
+    stage equal f3(f2(f1(f0(x)))) per microbatch."""
+    from paddle_tpu.distributed.fleet.meta_parallel import pipeline_schedule
+
+    n, M, mbsz, d = 4, 3, 2, 8
+    mesh = Mesh(np.array(jax.devices()[:n]), ("pp",))
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(n, d, d).astype(np.float32) * 0.3)
+    xs = jnp.asarray(rng.randn(M, mbsz, d).astype(np.float32))
+
+    def stage(p, x):
+        return jnp.tanh(x @ p[0])
+
+    f = jax.jit(
+        shard_map(
+            lambda w, xb: pipeline_schedule(
+                lambda p, t: jnp.tanh(t @ p), w, xb, axis_name="pp")[None],
+            mesh=mesh,
+            in_specs=(P("pp"), P()),
+            out_specs=P("pp"),
+            check_vma=False,
+        )
+    )
+    out = np.asarray(f(w, xs))[-1]  # last stage
+    ref = xs
+    for i in range(n):
+        ref = jnp.tanh(ref @ w[i])
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_schedule_grads_match_sequential():
+    """jax.grad through the ppermute schedule == grad of the sequential net:
+    the transpose of the schedule IS the backward pipeline."""
+    from paddle_tpu.distributed.fleet.meta_parallel import pipeline_schedule
+
+    n, M, mbsz, d = 4, 2, 2, 4
+    mesh = Mesh(np.array(jax.devices()[:n]), ("pp",))
+    rng = np.random.RandomState(1)
+    w = jnp.asarray(rng.randn(n, d, d).astype(np.float32) * 0.3)
+    xs = jnp.asarray(rng.randn(M, mbsz, d).astype(np.float32))
+
+    def pipe_loss(w, xs):
+        def body(w_loc, xb):
+            outs = pipeline_schedule(
+                lambda p, t: jnp.tanh(t @ p), w_loc, xb, axis_name="pp")
+            return outs[None]
+
+        outs_g = shard_map(
+            body, mesh=mesh, in_specs=(P("pp"), P()), out_specs=P("pp"),
+            check_vma=False)(w, xs)
+        return jnp.sum(outs_g[-1] ** 2)
+
+    def seq_loss(w, xs):
+        h = xs
+        for i in range(n):
+            h = jnp.tanh(h @ w[i])
+        return jnp.sum(h ** 2)
+
+    gp = jax.jit(jax.grad(pipe_loss))(w, xs)
+    gs = jax.jit(jax.grad(seq_loss))(w, xs)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gs), rtol=1e-4, atol=1e-5)
+
+
+def test_stack_unstack_roundtrip():
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        PipelineSpec, stack_block_params, unstack_block_params)
+
+    spec = PipelineSpec("m.blocks", 4, None, None, None)
+    params = {f"m.blocks.{i}.w": jnp.full((3,), float(i)) for i in range(4)}
+    params["head.w"] = jnp.ones((2,))
+    stacked, other = stack_block_params(params, spec, 2)
+    assert stacked["w"].shape == (2, 2, 3)
+    assert list(other) == ["head.w"]
+    flat = unstack_block_params(stacked, spec)
+    for i in range(4):
+        np.testing.assert_array_equal(np.asarray(flat[f"m.blocks.{i}.w"]), np.full((3,), float(i)))
+    with pytest.raises(ValueError):
+        stack_block_params(params, spec, 3)  # 4 blocks % 3 != 0
+
+
+def test_gpt_pp4_matches_plain():
+    """4-stage GPT on the virtual mesh: losses and updated params identical
+    to the unpipelined run (VERDICT round-1 'done' criterion)."""
+    l_ref, m_ref = _train_gpt(pp=1, dp=1, mp=1, steps=3)
+    l_pp, m_pp = _train_gpt(pp=4, dp=2, mp=1, steps=3)
+    np.testing.assert_allclose(l_pp, l_ref, rtol=2e-4, atol=2e-5)
+    ref_named = dict(m_ref.named_parameters())
+    for name, p in m_pp.named_parameters():
+        np.testing.assert_allclose(
+            np.asarray(p._value), np.asarray(ref_named[name]._value),
+            rtol=3e-4, atol=3e-5, err_msg=name)
+    assert l_pp[-1] < l_pp[0]  # actually training
+
+
+def test_gpt_3d_hybrid_pp_dp_mp():
+    """pp=2 x dp=2 x mp=2 over all 8 devices, loss equality with plain."""
+    l_ref, _ = _train_gpt(pp=1, dp=1, mp=1, steps=2)
+    l_3d, _ = _train_gpt(pp=2, dp=2, mp=2, steps=2)
+    np.testing.assert_allclose(l_3d, l_ref, rtol=2e-4, atol=2e-5)
+
+
+def test_gpt_pp_with_microbatches_gt_stages():
+    """M=4 microbatches over 2 stages (steady-state schedule longer than the
+    warmup) still matches."""
+    l_ref, _ = _train_gpt(pp=1, dp=1, mp=1, steps=2, M=1)
+    l_pp, _ = _train_gpt(pp=2, dp=1, mp=1, steps=2, M=4)
+    np.testing.assert_allclose(l_pp, l_ref, rtol=2e-4, atol=2e-5)
+
+
+def test_pp_requires_pipeline_spec():
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 2, "sharding_degree": 1, "mp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    lin = paddle.nn.Linear(4, 4)
+    lin.loss = lambda out, y: (out - y).square().mean()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=lin.parameters())
+    with pytest.raises(ValueError, match="pipeline_spec"):
+        make_sharded_train_step(lin, opt)
