@@ -64,14 +64,22 @@ class enable_grad(_GradMode):
 
 
 class Node:
-    """One recorded op: inputs, output avals/treedef, and the vjp closure."""
+    """One recorded op: inputs, output avals/treedef, and the vjp closure.
 
-    __slots__ = ("op_name", "inputs", "vjp_fn", "out_avals", "out_tree", "hooks", "released")
+    ``pure_fn`` (the op's pure lowering) is kept so create_graph backward can
+    re-derive the vjp as a traced function of (primals, cotangents) — the
+    reference's double-grad kernels (backward.yaml *_double_grad) fall out of
+    differentiating that re-derivation instead of being hand-written.
+    """
 
-    def __init__(self, op_name: str, inputs: Sequence, vjp_fn: Callable, out_avals: List, out_tree):
+    __slots__ = ("op_name", "inputs", "vjp_fn", "pure_fn", "out_avals", "out_tree", "hooks", "released")
+
+    def __init__(self, op_name: str, inputs: Sequence, vjp_fn: Callable, out_avals: List, out_tree,
+                 pure_fn: Optional[Callable] = None):
         self.op_name = op_name
         self.inputs = list(inputs)  # Tensors feeding this op (recorded order)
         self.vjp_fn = vjp_fn
+        self.pure_fn = pure_fn
         self.out_avals = out_avals  # [(shape, dtype)] per output leaf
         self.out_tree = out_tree  # treedef of the op's output pytree
         self.hooks = {}  # out_index -> [hook]
@@ -82,6 +90,7 @@ class Node:
 
     def release(self):
         self.vjp_fn = None
+        self.pure_fn = None
         self.inputs = []
         self.released = True
 
@@ -95,43 +104,9 @@ def _zero_cotangent(shape, dtype):
     return np.zeros(shape, jax.dtypes.float0)
 
 
-def backward(tensors, grad_tensors=None, retain_graph: bool = False):
-    """Run reverse-mode from output ``tensors`` (paddle.autograd.backward)."""
-    from .tensor import Tensor  # local import to avoid cycle
-
-    if isinstance(tensors, Tensor):
-        tensors = [tensors]
-    if grad_tensors is None:
-        grad_tensors = [None] * len(tensors)
-    elif isinstance(grad_tensors, Tensor):
-        grad_tensors = [grad_tensors]
-
-    import jax.numpy as jnp
-
-    # Seed cotangents keyed by (node, out_index); leaf roots get grads directly.
-    cotangents = {}
-    roots = []
-    for t, g in zip(tensors, grad_tensors):
-        if g is None:
-            gv = jnp.ones(t.shape, t._jdtype())
-        else:
-            gv = g._value if isinstance(g, Tensor) else jnp.asarray(g)
-        node = t._grad_node
-        if node is None:
-            if not t.stop_gradient:
-                t._accumulate_grad(gv)
-            continue
-        key = (id(node), t._out_index)
-        if key in cotangents:
-            cotangents[key] = (node, t._out_index, cotangents[key][2] + gv)
-        else:
-            cotangents[key] = (node, t._out_index, gv)
-        roots.append(node)
-
-    if not roots:
-        return
-
-    # Topological order over the consumer->producer DAG (DFS postorder reversed)
+def _topo_order(roots):
+    """Consumers-first topological order over the consumer->producer DAG
+    (DFS postorder reversed)."""
     order, visited, stack = [], set(), [(n, False) for n in dict.fromkeys(roots)]
     while stack:
         node, processed = stack.pop()
@@ -146,7 +121,56 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
             pnode = inp._grad_node
             if pnode is not None and not pnode.released and id(pnode) not in visited:
                 stack.append((pnode, False))
-    order.reverse()  # consumers first
+    order.reverse()
+    return order
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False,
+             create_graph: bool = False, _side_only: bool = False):
+    """Run reverse-mode from output ``tensors`` (paddle.autograd.backward).
+
+    ``_side_only`` (internal, set by ``grad()``): deposit only into tensors
+    marked _tape_requires — paddle.grad must not touch the .grad of leaves it
+    wasn't asked about (GeneralGrad contract, fluid/eager/general_grad.h).
+    """
+    from .tensor import Tensor  # local import to avoid cycle
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    if create_graph:
+        return _backward_create_graph(tensors, grad_tensors, retain_graph, _side_only)
+
+    import jax.numpy as jnp
+
+    # Seed cotangents keyed by (node, out_index); leaf roots get grads directly.
+    cotangents = {}
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            gv = jnp.ones(t.shape, t._jdtype())
+        else:
+            gv = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._grad_node
+        if node is None:
+            if not t.stop_gradient and (not _side_only or getattr(t, "_tape_requires", False)):
+                t._accumulate_grad(gv)
+            continue
+        key = (id(node), t._out_index)
+        if key in cotangents:
+            cotangents[key] = (node, t._out_index, cotangents[key][2] + gv)
+        else:
+            cotangents[key] = (node, t._out_index, gv)
+        roots.append(node)
+
+    if not roots:
+        return
+
+    order = _topo_order(roots)
 
     for node in order:
         if node.released:
@@ -184,8 +208,169 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
                     cotangents[key] = (pnode, inp._out_index, g)
                 if getattr(inp, "_tape_requires", False):
                     inp._accumulate_grad(g)
-            elif not inp.stop_gradient:
+            elif not inp.stop_gradient and (not _side_only or getattr(inp, "_tape_requires", False)):
                 inp._accumulate_grad(g)
+        if not retain_graph:
+            node.release()
+
+
+def _deposit_leaf_tensor(t, g):
+    """create_graph leaf deposit: keep the grad graph-connected so a second
+    backward/grad can differentiate through it (the reference's double-grad
+    path leaves grads with grad nodes attached)."""
+    from .tensor import Tensor
+    import jax.numpy as jnp
+
+    if g._value.dtype != t._value.dtype and jnp.issubdtype(t._value.dtype, jnp.inexact):
+        g = g.astype(t._value.dtype)
+    for hook in t._hooks:
+        out = hook(g)
+        if out is not None:
+            g = out if isinstance(out, Tensor) else Tensor(jnp.asarray(out))
+    # fresh Tensor sharing value + graph link: never alias the caller's
+    # tensor (renaming it / mutating it via later in-place accumulation)
+    gcopy = Tensor(g._value, stop_gradient=g.stop_gradient)
+    if g._grad_node is not None:
+        gcopy._attach(g._grad_node, g._out_index)
+    if t.grad is None:
+        t.grad = gcopy
+        t.grad.name = t.name + "@GRAD"
+    else:
+        t.grad = t.grad + gcopy
+
+
+def _node_vjp_as_op(node, cot_tensors):
+    """Re-derive node's vjp as a TRACED op of (primals, cotangents) and run it
+    through the tape (run_op), so the produced input-cotangents carry grad
+    nodes and second derivatives see the dependence through the primals —
+    node.vjp_fn alone has the primals baked in as constants and would give
+    zero d2/dprimal2.
+
+    Non-inexact cotangents (float0 for int/bool outputs) are closed over as
+    constants; inputs with non-inexact dtype get a None cotangent.
+
+    Nodes recorded without a pure_fn (PyLayer custom backward) fall back to
+    the saved vjp closure: first-order-correct, but the produced cotangents
+    carry no graph (torch's once_differentiable semantics).
+    """
+    import jax.numpy as jnp
+
+    from .tensor import Tensor
+
+    if node.pure_fn is None:
+        cot_pytree = jax.tree_util.tree_unflatten(
+            node.out_tree, [c._value for c in cot_tensors])
+        in_cots = node.vjp_fn(cot_pytree)
+        return [None if g is None or (isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0)
+                else Tensor(g, stop_gradient=True)
+                for g in in_cots]
+
+    n_in = len(node.inputs)
+    out_tree = node.out_tree
+    pure_fn = node.pure_fn
+    diff_idx = [i for i, c in enumerate(cot_tensors)
+                if hasattr(c._value, "dtype") and jnp.issubdtype(jnp.asarray(c._value).dtype, jnp.inexact)]
+    const_cots = {i: c._value for i, c in enumerate(cot_tensors) if i not in diff_idx}
+    diff_cots = [cot_tensors[i] for i in diff_idx]
+    in_dtypes = [inp._value.dtype for inp in node.inputs]
+    grad_in_idx = [i for i, dt in enumerate(in_dtypes) if jnp.issubdtype(dt, jnp.inexact)]
+
+    def pure(*args):
+        vals, cots = args[:n_in], args[n_in:]
+        full = [None] * len(cot_tensors)
+        for i, c in zip(diff_idx, cots):
+            full[i] = c
+        for i, c in const_cots.items():
+            full[i] = c
+        cot_pytree = jax.tree_util.tree_unflatten(out_tree, full)
+        _, vjp_fn = jax.vjp(pure_fn, *vals)
+        in_cots = vjp_fn(cot_pytree)
+        return tuple(in_cots[i] for i in grad_in_idx)
+
+    out, new_node = run_op(f"grad::{node.op_name}", pure,
+                           list(node.inputs) + diff_cots)
+    from ..ops._dispatch import wrap_outputs
+
+    wrapped = wrap_outputs(out, new_node)
+    results = [None] * n_in
+    for i, t in zip(grad_in_idx, wrapped):
+        results[i] = t
+    return results
+
+
+def _backward_create_graph(tensors, grad_tensors, retain_graph: bool = True,
+                           _side_only: bool = False):
+    """Tape sweep with Tensor cotangents: every vjp and every cotangent
+    accumulation runs back through the dispatch seam, so the backward builds
+    a differentiable graph (GeneralGrad + *_double_grad analog)."""
+    import jax.numpy as jnp
+
+    from .tensor import Tensor
+
+    cotangents = {}
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            gt = Tensor(jnp.ones(t.shape, t._jdtype()), stop_gradient=True)
+        elif isinstance(g, Tensor):
+            gt = g
+        else:
+            gt = Tensor(jnp.asarray(g), stop_gradient=True)
+        node = t._grad_node
+        if node is None:
+            if not t.stop_gradient and (not _side_only or getattr(t, "_tape_requires", False)):
+                _deposit_leaf_tensor(t, gt)
+            continue
+        key = (id(node), t._out_index)
+        if key in cotangents:
+            cotangents[key] = (node, t._out_index, cotangents[key][2] + gt)
+        else:
+            cotangents[key] = (node, t._out_index, gt)
+        roots.append(node)
+
+    if not roots:
+        return
+
+    for node in _topo_order(roots):
+        if node.released:
+            raise RuntimeError(
+                f"Trying to backward through op '{node.op_name}' a second time; "
+                "set retain_graph=True to keep the graph."
+            )
+        cots = []
+        for idx, (shape, dtype) in enumerate(node.out_avals):
+            entry = cotangents.pop((id(node), idx), None)
+            if entry is not None:
+                cot = entry[2]
+            else:
+                cot = Tensor(_zero_cotangent(shape, dtype), stop_gradient=True)
+            for hook in node.hooks.get(idx, []):
+                out = hook(cot)
+                if out is not None:
+                    cot = out if isinstance(out, Tensor) else Tensor(jnp.asarray(out))
+            if hasattr(cot._value, "dtype") and cot._value.dtype != dtype and \
+                    jnp.issubdtype(dtype, jnp.inexact):
+                cot = cot.astype(dtype)
+            cots.append(cot)
+        in_cots = _node_vjp_as_op(node, cots)
+        for inp, g in zip(node.inputs, in_cots):
+            if g is None:
+                continue
+            pnode = inp._grad_node
+            if pnode is not None and not pnode.released:
+                key = (id(pnode), inp._out_index)
+                if key in cotangents:
+                    cotangents[key] = (pnode, inp._out_index, cotangents[key][2] + g)
+                else:
+                    cotangents[key] = (pnode, inp._out_index, g)
+                if getattr(inp, "_tape_requires", False):
+                    _deposit_leaf_tensor(inp, g)
+            elif not inp.stop_gradient and (not _side_only or getattr(inp, "_tape_requires", False)):
+                _deposit_leaf_tensor(inp, g)
+        # retain_graph defaults to True under create_graph (grad() passes
+        # create_graph when unset); honoring an explicit False releases the
+        # forward nodes — a later second-order backward through them raises
+        # the documented second-time error
         if not retain_graph:
             node.release()
 
@@ -202,8 +387,9 @@ def grad(
 
     Implemented by running the tape backward with grads redirected into a side
     table (the reference's GeneralGrad path, fluid/eager/general_grad.h).
-    create_graph (higher-order) is served by re-running the pure function under
-    jax.grad in the functional API; the eager tape records first-order only.
+    With create_graph=True the sweep re-records every vjp through the dispatch
+    seam, so the returned grads carry tape nodes and support another
+    backward/grad (double-grad; backward.yaml *_double_grad analog).
     """
     from .tensor import Tensor
 
@@ -211,28 +397,25 @@ def grad(
         outputs = [outputs]
     if isinstance(inputs, Tensor):
         inputs = [inputs]
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True on the eager tape is unsupported; use "
-            "paddle_tpu.incubate.autograd (jax.grad composition) for higher order."
-        )
     saved = [(t, t.grad) for t in inputs]
     for t in inputs:
         t.grad = None
         t._tape_requires = True
     try:
-        backward(outputs, grad_tensors=grad_outputs, retain_graph=bool(retain_graph))
+        backward(outputs, grad_tensors=grad_outputs,
+                 retain_graph=create_graph if retain_graph is None else bool(retain_graph),
+                 create_graph=create_graph, _side_only=True)
         results = []
         for t, _ in saved:
             if t.grad is None and not allow_unused:
                 raise RuntimeError("One of the differentiated tensors appears unused; pass allow_unused=True")
             results.append(t.grad)
     finally:
+        # grads captured in results; .grad always restored to pre-call values
+        # (even when backward or the allow_unused check raises)
         for t, old in saved:
             t._tape_requires = False
-        # grads captured in results; restore .grad to pre-call values
-    for (t, old), _ in zip(saved, results):
-        t.grad = old
+            t.grad = old
     return results
 
 
@@ -265,5 +448,5 @@ def run_op(op_name: str, pure_fn: Callable, tensor_inputs: Sequence):
     if needs_grad:
         out_avals = [(tuple(v.shape), v.dtype) for v in leaves]
         out_tree = jax.tree_util.tree_structure(out)
-        node = Node(op_name, tensor_inputs, vjp_fn, out_avals, out_tree)
+        node = Node(op_name, tensor_inputs, vjp_fn, out_avals, out_tree, pure_fn=pure_fn)
     return out, node

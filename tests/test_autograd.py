@@ -206,3 +206,133 @@ class TestPyLayer:
         np.testing.assert_allclose(y.numpy(), [6.0])
         y.backward()
         np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+class TestHigherOrder:
+    """create_graph=True on the eager tape (fluid/eager/general_grad.h:38 +
+    backward.yaml *_double_grad analog): the backward sweep re-records every
+    vjp through the dispatch seam, so grads of grads work."""
+
+    def test_cubic_double_grad(self):
+        x = paddle.to_tensor(np.array([1.5, -2.0, 0.5], np.float32), stop_gradient=False)
+        y = (x * x * x).sum()
+        (g,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(g.numpy(), 3 * x.numpy() ** 2, rtol=1e-6)
+        (g2,) = paddle.grad(g.sum(), x)
+        np.testing.assert_allclose(g2.numpy(), 6 * x.numpy(), rtol=1e-6)
+
+    def test_matmul_double_grad_matches_jax(self):
+        import jax
+        import jax.numpy as jnp
+
+        An = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        Bn = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+        A = paddle.to_tensor(An, stop_gradient=False)
+        f = (A.matmul(paddle.to_tensor(Bn)) ** 2).sum()
+        (gA,) = paddle.grad(f, A, create_graph=True)
+        (ggA,) = paddle.grad(gA.sum(), A)
+        jf = lambda A: jnp.sum((A @ Bn) ** 2)
+        np.testing.assert_allclose(gA.numpy(), np.asarray(jax.grad(jf)(An)), rtol=1e-5)
+        np.testing.assert_allclose(
+            ggA.numpy(),
+            np.asarray(jax.grad(lambda A: jax.grad(jf)(A).sum())(An)),
+            rtol=1e-5, atol=1e-6)
+
+    def test_relu_double_grad(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(np.array([-1.0, 2.0, 3.0], np.float32), stop_gradient=False)
+        y = (F.relu(x) ** 2).sum()
+        (g,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(g.numpy(), [0.0, 4.0, 6.0], rtol=1e-6)
+        (gg,) = paddle.grad(g.sum(), x)
+        np.testing.assert_allclose(gg.numpy(), [0.0, 2.0, 2.0], rtol=1e-6)
+
+    def test_conv_double_grad_finite(self):
+        conv = paddle.nn.Conv2D(1, 2, 3)
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(1, 1, 6, 6).astype(np.float32), stop_gradient=False)
+        y = (conv(x) ** 2).sum()
+        (g,) = paddle.grad(y, x, create_graph=True)
+        (gg,) = paddle.grad((g ** 2).sum(), x)
+        assert np.isfinite(gg.numpy()).all()
+        assert np.abs(gg.numpy()).sum() > 0
+
+    def test_gradient_penalty_training(self):
+        """WGAN-GP-style: grad penalty differentiates back into the weights
+        and matches the pure-jax double composition."""
+        import jax
+        import jax.numpy as jnp
+
+        lin = paddle.nn.Linear(4, 1)
+        xi = paddle.to_tensor(
+            np.random.RandomState(2).randn(5, 4).astype(np.float32), stop_gradient=False)
+        out = lin(xi).sum()
+        (gx,) = paddle.grad(out, xi, create_graph=True)
+        gp = (((gx * gx).sum(axis=1).sqrt() - 1.0) ** 2).mean()
+        gp.backward()
+        W = dict(lin.named_parameters())["weight"]
+        bn = dict(lin.named_parameters())["bias"].numpy()
+        xin = xi.numpy()
+
+        def gp_jax(Wv):
+            g = jax.grad(lambda x: (x @ Wv + bn).sum())(xin)
+            return jnp.mean((jnp.sqrt(jnp.sum(g * g, axis=1)) - 1.0) ** 2)
+
+        np.testing.assert_allclose(
+            W.grad.numpy(), np.asarray(jax.grad(gp_jax)(W.numpy())), rtol=1e-5, atol=1e-6)
+
+    def test_grad_does_not_pollute_other_leaves(self):
+        """paddle.grad must not write .grad of leaves it wasn't asked about
+        (GeneralGrad contract)."""
+        W = paddle.to_tensor(np.ones((3, 2), np.float32), stop_gradient=False)
+        x = paddle.to_tensor(np.ones((4, 3), np.float32), stop_gradient=False)
+        (gx,) = paddle.grad(x.matmul(W).sum(), x)
+        assert W.grad is None
+        assert x.grad is None  # .grad restored after grad()
+        np.testing.assert_allclose(gx.numpy(), np.full((4, 3), 2.0))
+
+    def test_triple_grad(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = (x ** 4).sum()
+        (g1,) = paddle.grad(y, x, create_graph=True)       # 4x^3
+        (g2,) = paddle.grad(g1.sum(), x, create_graph=True)  # 12x^2
+        (g3,) = paddle.grad(g2.sum(), x)                     # 24x
+        np.testing.assert_allclose(g1.numpy(), [32.0], rtol=1e-6)
+        np.testing.assert_allclose(g2.numpy(), [48.0], rtol=1e-6)
+        np.testing.assert_allclose(g3.numpy(), [48.0], rtol=1e-6)
+
+    def test_pylayer_create_graph_first_order_fallback(self):
+        """PyLayer nodes (no pure_fn) fall back to the saved vjp under
+        create_graph: first-order correct, once-differentiable."""
+
+        class Double(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad * 2
+
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = (Double.apply(x) ** 2).sum()
+        (g,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(g.numpy(), [24.0])
+
+    def test_grad_restores_on_exception(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        unused = paddle.to_tensor([1.0], stop_gradient=False)
+        x.grad = paddle.to_tensor([100.0])
+        with np.testing.assert_raises(RuntimeError):
+            paddle.grad((x * 2).sum(), [x, unused])
+        np.testing.assert_allclose(x.grad.numpy(), [100.0])
+
+    def test_create_graph_seed_not_aliased(self):
+        seed = paddle.to_tensor([5.0, 5.0])
+        seed.name = "myseed"
+        leaf = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+        paddle.autograd.backward([leaf], [seed], create_graph=True)
+        assert seed.name == "myseed"
+        (leaf * 1.0).backward()
+        np.testing.assert_allclose(seed.numpy(), [5.0, 5.0])
