@@ -78,16 +78,60 @@ def main():
     peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
     mfu = achieved / peak
 
+    # long-context row (streamed-KV flash kernel, seq 4k): secondary metric
+    # folded into the unit string — the driver contract is ONE JSON line
+    long_note = ""
+    if on_tpu:
+        try:
+            long_note = f", seq4k={_long_context_row():.0f} tok/s"
+        except Exception:
+            long_note = ", seq4k=failed"
+
     print(
         json.dumps(
             {
                 "metric": "gpt_train_tokens_per_sec",
                 "value": round(tokens_per_sec, 1),
-                "unit": f"tokens/sec/chip ({backend}, {n_params/1e6:.0f}M params, MFU={mfu:.3f})",
+                "unit": f"tokens/sec/chip ({backend}, {n_params/1e6:.0f}M params, MFU={mfu:.3f}{long_note})",
                 "vs_baseline": round(mfu / 0.40, 3),
             }
         )
     )
+
+
+def _long_context_row() -> float:
+    """GPT at seq 4096 on one chip (long-context config the round-1 kernel
+    could not fit: full-S K/V BlockSpecs blew VMEM). Smaller model + full
+    remat + chunked CE keep HBM in budget at S=4k."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(
+        vocab_size=32768, hidden_size=1024, num_layers=8, num_heads=8,
+        max_seq_len=4096, dropout=0.0, use_recompute=True,
+        recompute_interval=1, loss_chunk=256,
+    )
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg).astype("bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                                 multi_precision=True, moment_dtype="bfloat16")
+    step = make_sharded_train_step(model, opt)
+    rng = np.random.RandomState(0)
+    bsz, seq, iters = 4, 4096, 8
+    x = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(bsz, seq), dtype=np.int32))
+    y = jnp.asarray(np.roll(np.asarray(x), -1, axis=1))
+    _ = float(step(x, y))  # warmup; host transfer syncs (axon tunnel)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    _ = float(loss)
+    return bsz * seq * iters / (time.perf_counter() - t0)
 
 
 if __name__ == "__main__":

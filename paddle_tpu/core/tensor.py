@@ -144,6 +144,15 @@ class Tensor:
     def __float__(self):
         return float(self._value)
 
+    def __index__(self):
+        # lets scalar int Tensors drive range()/slicing eagerly; under a
+        # trace this raises TracerIntegerConversionError, which to_static
+        # catches to trigger dy2static AST conversion
+        if not jnp.issubdtype(self._value.dtype, jnp.integer):
+            raise TypeError(
+                f"only integer Tensors can be used as an index, got {self._value.dtype}")
+        return int(self._value)
+
     def __hash__(self):
         return id(self)
 

@@ -47,12 +47,23 @@ def init(role_maker=None, is_collective: bool = True, strategy: Optional[Distrib
     init_parallel_env()
     _strategy = strategy or DistributedStrategy()
     cfg = _strategy.hybrid_configs
+    # sep = sequence/context parallel axis (ring/Ulysses attention). The
+    # reference has no SP (SURVEY §5.7); we accept both its later-era key
+    # ("sep_degree") and the common "cp_degree" alias.
+    sep_d = cfg.get("sep_degree", 1) or 1
+    cp_d = cfg.get("cp_degree", 1) or 1
+    if sep_d > 1 and cp_d > 1 and sep_d != cp_d:
+        raise ValueError(
+            f"hybrid_configs sets both sep_degree={sep_d} and cp_degree={cp_d}; "
+            "they alias the same axis — set only one")
+    sep = max(sep_d, cp_d)
     topo = CommunicateTopology(
-        hybrid_group_names=["data", "pipe", "sharding", "model"],
+        hybrid_group_names=["data", "pipe", "sharding", "sep", "model"],
         dims=[
             cfg.get("dp_degree", 1),
             cfg.get("pp_degree", 1),
             cfg.get("sharding_degree", 1),
+            sep,
             cfg.get("mp_degree", 1),
         ],
     )

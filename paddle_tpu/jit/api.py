@@ -65,11 +65,28 @@ class StaticFunction:
     def dygraph_function(self):
         return self._function
 
+    @property
+    def code(self):
+        """Transformed source when AST conversion ran (dy2static .code parity)."""
+        fn = getattr(self, "_converted", None) or self._function
+        fn = getattr(fn, "__func__", fn)
+        src = getattr(fn, "_dy2static_source", None)
+        if src is not None:
+            return src
+        import inspect
+
+        try:
+            return inspect.getsource(fn)
+        except (OSError, TypeError):
+            return None
+
     def _traced(self, layer, n_args):
         key = ("layer", n_args) if layer is not None else ("fn", n_args)
         if key in self._jit_cache:
             return self._jit_cache[key]
-        fn = self._function
+        # trace the AST-converted variant when one exists; the ORIGINAL
+        # function stays in self._function for eager fallback / parity APIs
+        fn = getattr(self, "_converted", None) or self._function
 
         if layer is not None:
             # inline the functional_call overlay but invoke the ORIGINAL
@@ -110,7 +127,9 @@ class StaticFunction:
             # non-array args force the eager path (still correct, not cached)
             return self._function(*args, **kwargs)
         if any(isinstance(getattr(a, "_value", a), jax.core.Tracer) for a in args):
-            return self._function(*args, **kwargs)  # already under a trace: inline
+            # already under a trace: inline (converted variant if one exists,
+            # so control flow compiles instead of raising in the outer trace)
+            return (getattr(self, "_converted", None) or self._function)(*args, **kwargs)
         if getattr(self, "_eager_fallback", False):
             return self._function(*args, **kwargs)
         raw = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
@@ -134,15 +153,27 @@ class StaticFunction:
             jax.errors.TracerIntegerConversionError,
             jax.errors.ConcretizationTypeError,
         ):
-            # data-dependent python control flow: the reference's dy2static
-            # rewrites the AST; here the escape hatch is eager execution
-            # (correct, uncompiled) — cached so we don't re-trace every call
+            # data-dependent python control flow: rewrite the AST into
+            # convert-calls (lax.while_loop / select) like the reference's
+            # dy2static transformers, then retry the trace
+            if not getattr(self, "_ast_tried", False):
+                self._ast_tried = True
+                try:
+                    from .dy2static import convert_to_static
+
+                    self._converted = convert_to_static(self._function)
+                    self._jit_cache.clear()
+                    return self.__call__(*args, **kwargs)
+                except Exception:
+                    self._converted = None
+            # conversion unavailable/failed: eager execution (correct,
+            # uncompiled) — cached so we don't re-trace every call
             import warnings
 
             warnings.warn(
                 f"to_static: '{getattr(self._function, '__name__', '?')}' uses "
-                "data-dependent Python control flow; falling back to eager "
-                "execution (use paddle.where/lax.cond-style ops to compile)",
+                "data-dependent Python control flow that could not be "
+                "AST-converted; falling back to eager execution",
                 stacklevel=2,
             )
             self._eager_fallback = True

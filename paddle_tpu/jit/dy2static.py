@@ -1,0 +1,540 @@
+"""dy2static control-flow conversion (python/paddle/jit/dy2static analog:
+ifelse_transformer.py, loop_transformer.py, logical_transformer.py,
+convert_operators.py — ~3.7k LoC of AST rewriting in the reference).
+
+TPU re-design: the AST transform rewrites data-dependent `if`/`while`/
+`for range()` into *convert calls* that decide at RUNTIME whether the
+governing value is traced:
+
+- python value   -> ordinary python control flow (zero overhead, exact
+                    semantics, unrolling under jit stays available)
+- traced tracer  -> `lax.while_loop` for loops; both-branches + select for
+                    `if` (what XLA lowers small conditionals to anyway, and
+                    it sidesteps pytree/registration issues for Tensor
+                    carries)
+
+This mirrors the reference's convert_ifelse/convert_while_loop runtime
+(jit/dy2static/convert_operators.py) rather than trying to prove tracedness
+statically. Variables assigned inside a branch/loop are carried explicitly;
+possibly-undefined names are guarded with an UNDEFINED sentinel (the
+reference's UndefinedVar)."""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+import types
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "UNDEFINED", "convert_ifelse", "convert_while", "convert_for_range",
+    "convert_and", "convert_or", "convert_not", "convert_to_static",
+    "TransformError",
+]
+
+
+class TransformError(RuntimeError):
+    pass
+
+
+class _Undefined:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<UNDEFINED>"
+
+    def __bool__(self):
+        raise NameError("variable used before assignment in converted control flow")
+
+
+UNDEFINED = _Undefined()
+
+
+def _raw(v):
+    return v._value if isinstance(v, Tensor) else v
+
+
+def _is_traced(v) -> bool:
+    return isinstance(_raw(v), jax.core.Tracer)
+
+
+def _scalar_bool(raw_cond):
+    c = jnp.squeeze(jnp.asarray(raw_cond))
+    if c.ndim != 0:
+        raise TransformError(
+            f"condition must be a scalar (or one-element) tensor, got shape {c.shape}")
+    return c.astype(bool)
+
+
+# ---------------- runtime convert calls ----------------
+def convert_ifelse(cond, true_fn: Callable, false_fn: Callable, init_vars: tuple,
+                   names: Sequence[str] = ()):
+    """if/else convert call. Traced cond: run BOTH branches under the ambient
+    trace and select per variable (reference convert_ifelse runs a real
+    cond; XLA lowers small conditionals to select anyway and this handles
+    Tensor/py-value carries without pytree registration)."""
+    if not _is_traced(cond):
+        taken = true_fn if bool(_raw(cond)) else false_fn
+        return taken(init_vars)
+    c = _scalar_bool(_raw(cond))
+    t_out = true_fn(init_vars)
+    f_out = false_fn(init_vars)
+    out = []
+    for i, (tv, fv) in enumerate(zip(t_out, f_out)):
+        name = names[i] if i < len(names) else f"#{i}"
+        if tv is UNDEFINED and fv is UNDEFINED:
+            out.append(UNDEFINED)
+            continue
+        if tv is UNDEFINED or fv is UNDEFINED:
+            raise TransformError(
+                f"variable '{name}' is assigned in only one branch of a "
+                "traced if/else and has no prior value; initialize it before "
+                "the if")
+        tr, fr = _raw(tv), _raw(fv)
+        if isinstance(tr, (jax.Array, jax.core.Tracer)) or isinstance(fr, (jax.Array, jax.core.Tracer)) \
+                or isinstance(tr, (int, float, bool)) or isinstance(fr, (int, float, bool)):
+            try:
+                sel = jnp.where(c, tr, fr)
+            except Exception as e:
+                raise TransformError(
+                    f"variable '{name}' has incompatible values across traced "
+                    f"if/else branches: {e}") from e
+            out.append(Tensor(sel) if isinstance(tv, Tensor) or isinstance(fv, Tensor) else sel)
+        else:
+            if tr is not fr and tr != fr:
+                raise TransformError(
+                    f"non-tensor variable '{name}' differs across traced "
+                    f"if/else branches ({tr!r} vs {fr!r}); this cannot compile")
+            out.append(tv)
+    return tuple(out)
+
+
+def _resolve_undefined(init_vars, names, probe_fn):
+    """Body-local loop vars (assigned before read inside the body, e.g.
+    `m = scores.max()`) reach the carry as UNDEFINED. Probe one body
+    iteration in the ambient trace to learn each slot's aval and seed the
+    carry with zeros of that aval: the probe's outputs are dead code XLA
+    DCEs, and genuinely read-before-assign vars fail inside the probe with
+    a clear error (the reference's UndefinedVar checks)."""
+    if not any(v is UNDEFINED for v in init_vars):
+        return init_vars
+    undef_names = [names[i] if i < len(names) else f"#{i}"
+                   for i, v in enumerate(init_vars) if v is UNDEFINED]
+    try:
+        probed = probe_fn(init_vars)
+    except TransformError:
+        raise
+    except Exception as e:
+        raise TransformError(
+            f"loop variable(s) {undef_names} have no value before a traced "
+            f"loop and appear to be read before assignment inside it: {e}") from e
+    out = list(init_vars)
+    for i, v in enumerate(init_vars):
+        if v is not UNDEFINED:
+            continue
+        pv = probed[i]
+        if pv is UNDEFINED:
+            name = names[i] if i < len(names) else f"#{i}"
+            raise TransformError(
+                f"loop variable '{name}' is never assigned a traceable value "
+                "in the loop body; initialize it before the loop")
+        r = jnp.zeros_like(jnp.asarray(_raw(pv)))
+        out[i] = Tensor(r) if isinstance(pv, Tensor) else r
+    return tuple(out)
+
+
+def convert_while(test_fn: Callable, body_fn: Callable, init_vars: tuple,
+                  names: Sequence[str] = ()):
+    """while convert call: python loop when the condition is concrete,
+    lax.while_loop when traced (reference convert_while_loop)."""
+    first = test_fn(init_vars)
+    if not _is_traced(first) and not any(_is_traced(v) for v in init_vars):
+        vars_ = init_vars
+        while bool(_raw(test_fn(vars_))):
+            vars_ = body_fn(vars_)
+        return vars_
+
+    init_vars = _resolve_undefined(init_vars, names, body_fn)
+    wrap = [isinstance(v, Tensor) for v in init_vars]
+
+    def rewrap(raws):
+        return tuple(Tensor(r) if w and not isinstance(r, Tensor) else r
+                     for r, w in zip(raws, wrap))
+
+    def cond(raws):
+        return _scalar_bool(_raw(test_fn(rewrap(raws))))
+
+    def body(raws):
+        out = body_fn(rewrap(raws))
+        return tuple(jnp.asarray(_raw(v)) for v in out)
+
+    init = tuple(jnp.asarray(_raw(v)) for v in init_vars)
+    try:
+        final = lax.while_loop(cond, body, init)
+    except TypeError as e:
+        raise TransformError(
+            f"traced while loop carry changed structure across iterations "
+            f"(vars {tuple(names)}): {e}") from e
+    return rewrap(final)
+
+
+def convert_for_range(start, stop, step, body_fn: Callable, init_vars: tuple,
+                      names: Sequence[str] = ()):
+    """`for i in range(...)` convert call: python unrolled loop for concrete
+    bounds, counter-carrying lax.while_loop for traced bounds. body_fn(i,
+    vars) -> vars."""
+    rs, re_, rp = _raw(start), _raw(stop), _raw(step)
+    if not any(isinstance(b, jax.core.Tracer) for b in (rs, re_, rp)):
+        vars_ = init_vars
+        for i in range(int(rs), int(re_), int(rp)):
+            vars_ = body_fn(i, vars_)
+        return vars_
+
+    init_vars = _resolve_undefined(init_vars, names,
+                                   lambda vars_: body_fn(jnp.asarray(rs), vars_))
+    wrap = [isinstance(v, Tensor) for v in init_vars]
+
+    def rewrap(raws):
+        return tuple(Tensor(r) if w and not isinstance(r, Tensor) else r
+                     for r, w in zip(raws, wrap))
+
+    step_arr = jnp.asarray(rp)
+
+    def cond(carry):
+        i = carry[0]
+        return jnp.where(step_arr > 0, i < re_, i > re_)
+
+    def body(carry):
+        i, raws = carry[0], carry[1:]
+        out = body_fn(i, rewrap(raws))
+        return (i + step_arr,) + tuple(jnp.asarray(_raw(v)) for v in out)
+
+    init = (jnp.asarray(rs),) + tuple(jnp.asarray(_raw(v)) for v in init_vars)
+    try:
+        final = lax.while_loop(cond, body, init)
+    except TypeError as e:
+        raise TransformError(
+            f"traced for-range loop carry changed structure across iterations "
+            f"(vars {tuple(names)}): {e}") from e
+    return rewrap(final[1:])
+
+
+def convert_and(lhs_fn: Callable, rhs_fn: Callable):
+    """`a and b` preserving short-circuit for python values, jnp.logical_and
+    for traced (reference logical_transformer)."""
+    a = lhs_fn()
+    if not _is_traced(a):
+        return a if not bool(_raw(a)) else rhs_fn()
+    b = rhs_fn()
+    res = jnp.logical_and(_scalar_bool(_raw(a)), _scalar_bool(_raw(b)))
+    return Tensor(res) if isinstance(a, Tensor) or isinstance(b, Tensor) else res
+
+
+def convert_or(lhs_fn: Callable, rhs_fn: Callable):
+    a = lhs_fn()
+    if not _is_traced(a):
+        return a if bool(_raw(a)) else rhs_fn()
+    b = rhs_fn()
+    res = jnp.logical_or(_scalar_bool(_raw(a)), _scalar_bool(_raw(b)))
+    return Tensor(res) if isinstance(a, Tensor) or isinstance(b, Tensor) else res
+
+
+def convert_not(v):
+    if not _is_traced(v):
+        return not bool(_raw(v))
+    res = jnp.logical_not(_scalar_bool(_raw(v)))
+    return Tensor(res) if isinstance(v, Tensor) else res
+
+
+# ---------------- the AST transformer ----------------
+_JST = "_paddle_jst"
+
+
+def _names_assigned(stmts) -> List[str]:
+    """Names assigned anywhere in stmts (not descending into nested defs)."""
+    out = []
+
+    def collect_target(t):
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect_target(e)
+        elif isinstance(t, ast.Starred):
+            collect_target(t.value)
+
+    def walk(nodes):
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(node, (ast.Assign,)):
+                for t in node.targets:
+                    collect_target(t)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                collect_target(node.target)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                collect_target(node.target)
+            elif isinstance(node, ast.withitem) and node.optional_vars is not None:
+                collect_target(node.optional_vars)
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+                    continue
+                walk([child])
+
+    walk(stmts)
+    seen, uniq = set(), []
+    for n in out:
+        if n not in seen and not n.startswith("_pt_"):
+            seen.add(n)
+            uniq.append(n)
+    return uniq
+
+
+def _has_escape(stmts, *, top_loop=False) -> bool:
+    """True if stmts contain return (any depth except nested defs), or
+    break/continue not bound to an inner loop."""
+
+    def walk(nodes, loop_depth):
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Return):
+                return True
+            if isinstance(node, (ast.Break, ast.Continue)) and loop_depth == 0:
+                return True
+            inner_depth = loop_depth + 1 if isinstance(node, (ast.For, ast.While, ast.AsyncFor)) else loop_depth
+            for child in ast.iter_child_nodes(node):
+                if walk([child], inner_depth):
+                    return True
+        return False
+
+    return walk(stmts, 1 if top_loop else 0)
+
+
+def _name(n, ctx):
+    return ast.Name(id=n, ctx=ctx())
+
+
+def _tuple_of(names, ctx):
+    return ast.Tuple(elts=[_name(n, ctx) for n in names], ctx=ctx())
+
+
+def _jst_attr(fn_name):
+    return ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()), attr=fn_name, ctx=ast.Load())
+
+
+def _undef_guard(name):
+    """try: name\nexcept NameError: name = _paddle_jst.UNDEFINED"""
+    return ast.Try(
+        body=[ast.Expr(value=_name(name, ast.Load))],
+        handlers=[ast.ExceptHandler(
+            type=ast.Name(id="NameError", ctx=ast.Load()),
+            name=None,
+            body=[ast.Assign(targets=[_name(name, ast.Store)], value=_jst_attr("UNDEFINED"))],
+        )],
+        orelse=[], finalbody=[],
+    )
+
+
+def _make_branch_fn(fname, carried, body_stmts):
+    args = ast.arguments(posonlyargs=[], args=[ast.arg(arg="_pt_vars")], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+    stmts = []
+    if carried:
+        stmts.append(ast.Assign(targets=[_tuple_of(carried, ast.Store)],
+                                value=ast.Name(id="_pt_vars", ctx=ast.Load())))
+    stmts.extend(body_stmts)
+    stmts.append(ast.Return(value=_tuple_of(carried, ast.Load)))
+    return ast.FunctionDef(name=fname, args=args, body=stmts, decorator_list=[], returns=None)
+
+
+def _names_tuple_const(carried):
+    return ast.Tuple(elts=[ast.Constant(value=n) for n in carried], ctx=ast.Load())
+
+
+class _CtrlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._n = 0
+
+    def _next(self):
+        self._n += 1
+        return self._n
+
+    # -- boolean operators inside the function body --
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        # a and b and c -> convert_and(lambda: a, lambda: convert_and(...))
+        fn = "convert_and" if isinstance(node.op, ast.And) else "convert_or"
+
+        def lam(expr):
+            return ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                                   kw_defaults=[], kwarg=None, defaults=[]),
+                body=expr)
+
+        result = node.values[-1]
+        for val in reversed(node.values[:-1]):
+            result = ast.Call(func=_jst_attr(fn), args=[lam(val), lam(result)], keywords=[])
+        return result
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=_jst_attr("convert_not"), args=[node.operand], keywords=[])
+        return node
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            return node
+        carried = _names_assigned(node.body + node.orelse)
+        i = self._next()
+        tname, fname = f"_pt_true_{i}", f"_pt_false_{i}"
+        stmts = [_undef_guard(n) for n in carried]
+        stmts.append(_make_branch_fn(tname, carried, node.body))
+        stmts.append(_make_branch_fn(fname, carried, node.orelse or [ast.Pass()]))
+        call = ast.Call(
+            func=_jst_attr("convert_ifelse"),
+            args=[node.test, _name(tname, ast.Load), _name(fname, ast.Load),
+                  _tuple_of(carried, ast.Load), _names_tuple_const(carried)],
+            keywords=[])
+        if carried:
+            stmts.append(ast.Assign(targets=[_tuple_of(carried, ast.Store)], value=call))
+        else:
+            stmts.append(ast.Expr(value=call))
+        return stmts
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_escape(node.body, top_loop=False):
+            return node
+        carried = _names_assigned(node.body)
+        i = self._next()
+        test_name, body_name = f"_pt_test_{i}", f"_pt_body_{i}"
+        stmts = [_undef_guard(n) for n in carried]
+        # test fn: unpack carry, return the condition
+        args = ast.arguments(posonlyargs=[], args=[ast.arg(arg="_pt_vars")], vararg=None,
+                             kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+        test_body = []
+        if carried:
+            test_body.append(ast.Assign(targets=[_tuple_of(carried, ast.Store)],
+                                        value=ast.Name(id="_pt_vars", ctx=ast.Load())))
+        test_body.append(ast.Return(value=node.test))
+        stmts.append(ast.FunctionDef(name=test_name, args=args, body=test_body,
+                                     decorator_list=[], returns=None))
+        stmts.append(_make_branch_fn(body_name, carried, node.body))
+        call = ast.Call(
+            func=_jst_attr("convert_while"),
+            args=[_name(test_name, ast.Load), _name(body_name, ast.Load),
+                  _tuple_of(carried, ast.Load), _names_tuple_const(carried)],
+            keywords=[])
+        if carried:
+            stmts.append(ast.Assign(targets=[_tuple_of(carried, ast.Store)], value=call))
+        else:
+            stmts.append(ast.Expr(value=call))
+        return stmts
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if (node.orelse or _has_escape(node.body, top_loop=False)
+                or not isinstance(node.target, ast.Name)
+                or not (isinstance(node.iter, ast.Call)
+                        and isinstance(node.iter.func, ast.Name)
+                        and node.iter.func.id == "range"
+                        and not node.iter.keywords
+                        and 1 <= len(node.iter.args) <= 3)):
+            return node
+        target = node.target.id
+        carried = [n for n in _names_assigned(node.body) if n != target]
+        rargs = node.iter.args
+        if len(rargs) == 1:
+            start, stop, step = ast.Constant(value=0), rargs[0], ast.Constant(value=1)
+        elif len(rargs) == 2:
+            start, stop, step = rargs[0], rargs[1], ast.Constant(value=1)
+        else:
+            start, stop, step = rargs
+        i = self._next()
+        body_name = f"_pt_forbody_{i}"
+        stmts = [_undef_guard(n) for n in carried]
+        args = ast.arguments(posonlyargs=[], args=[ast.arg(arg=target), ast.arg(arg="_pt_vars")],
+                             vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+        fbody = []
+        if carried:
+            fbody.append(ast.Assign(targets=[_tuple_of(carried, ast.Store)],
+                                    value=ast.Name(id="_pt_vars", ctx=ast.Load())))
+        fbody.extend(node.body)
+        fbody.append(ast.Return(value=_tuple_of(carried, ast.Load)))
+        stmts.append(ast.FunctionDef(name=body_name, args=args, body=fbody,
+                                     decorator_list=[], returns=None))
+        call = ast.Call(
+            func=_jst_attr("convert_for_range"),
+            args=[start, stop, step, _name(body_name, ast.Load),
+                  _tuple_of(carried, ast.Load), _names_tuple_const(carried)],
+            keywords=[])
+        if carried:
+            stmts.append(ast.Assign(targets=[_tuple_of(carried, ast.Store)], value=call))
+        else:
+            stmts.append(ast.Expr(value=call))
+        return stmts
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """AST-convert fn's data-dependent control flow into convert calls.
+
+    Returns a new function with the same closure/globals. Raises
+    TransformError when the source is unavailable or conversion fails."""
+    if isinstance(fn, types.MethodType):
+        converted = convert_to_static(fn.__func__)
+        return types.MethodType(converted, fn.__self__)
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError) as e:
+        raise TransformError(f"cannot get source of {fn!r}: {e}") from e
+    tree = ast.parse(src)
+    fndef = tree.body[0]
+    if not isinstance(fndef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise TransformError(f"expected a function definition, got {type(fndef).__name__}")
+    fndef.decorator_list = []  # strip @to_static etc. — we re-wrap ourselves
+    _CtrlFlowTransformer().visit(fndef)
+
+    freevars = fn.__code__.co_freevars
+    if freevars:
+        outer = ast.FunctionDef(
+            name="_pt_outer",
+            args=ast.arguments(posonlyargs=[], args=[ast.arg(arg=v) for v in freevars],
+                               vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=[fndef, ast.Return(value=ast.Name(id=fndef.name, ctx=ast.Load()))],
+            decorator_list=[], returns=None)
+        module = ast.Module(body=[outer], type_ignores=[])
+    else:
+        module = ast.Module(body=[fndef], type_ignores=[])
+    ast.fix_missing_locations(module)
+    code = compile(module, filename=f"<dy2static {getattr(fn, '__qualname__', fn.__name__)}>",
+                   mode="exec")
+    from . import dy2static as _self
+
+    ns = dict(fn.__globals__)
+    ns[_JST] = _self
+    exec(code, ns)
+    if freevars:
+        cells = [c.cell_contents for c in (fn.__closure__ or ())]
+        new_fn = ns["_pt_outer"](*cells)
+    else:
+        new_fn = ns[fndef.name]
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    new_fn._dy2static_source = ast.unparse(module)
+    return new_fn

@@ -32,46 +32,55 @@ def _interpret() -> bool:
 
 
 # ---------------- forward ----------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, scale: float):
-    q = q_ref[0].astype(jnp.float32)  # [bq, d]
-    bq, d = q.shape
-    S = k_ref.shape[1]
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, num_kb: int, block_q: int, block_k: int, causal: bool, scale: float):
+    """Grid (BH, num_q, num_k): K/V blocks STREAM through the trailing
+    (sequential) grid dim, so VMEM holds only [block] tiles — never full-S
+    K/V. Running (max, sum, acc) live in VMEM scratch across k iterations;
+    the epilogue writes o/lse on the last relevant k block."""
     qi = pl.program_id(1)
-    num_kb = S // block_k
-    if causal:
-        # process key blocks up to (and including) the diagonal block
-        last = (qi + 1) * bq  # first key index past this q block
-        kb_hi = (last + jnp.int32(block_k - 1)) // jnp.int32(block_k)
-    else:
-        kb_hi = num_kb
+    ki = pl.program_id(2)
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    # causal: key blocks strictly after the diagonal contribute nothing
+    kb_hi = ((qi + 1) * bq + jnp.int32(block_k - 1)) // jnp.int32(block_k) if causal else num_kb
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(ki < kb_hi)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [bq, bk]
+        ) * jnp.float32(scale)  # [bq, bk]
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            kpos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m = m_scr[:, 0]
+        l = l_scr[:, 0]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return m_new, l, acc
+        m_scr[...] = jax.lax.broadcast_in_dim(m_new, m_scr.shape, (0,))
+        l_scr[...] = jax.lax.broadcast_in_dim(l_new, l_scr.shape, (0,))
 
-    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, kb_hi, body, (m0, l0, acc0))
-    l_safe = jnp.where(l == 0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = jax.lax.broadcast_in_dim(m + jnp.log(l_safe), (q.shape[0], LANES), (0,))
+    @pl.when(ki == num_kb - 1)
+    def _epilogue():
+        l = l_scr[:, 0]
+        l_safe = jnp.where(l == 0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = jax.lax.broadcast_in_dim(
+            m_scr[:, 0] + jnp.log(l_safe), (bq, LANES), (0,))
 
 
 def _fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int):
@@ -79,92 +88,119 @@ def _fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int):
     qt = jnp.swapaxes(q, 1, 2).reshape(B * H, S, D)
     kt = jnp.swapaxes(k, 1, 2).reshape(B * H, S, D)
     vt = jnp.swapaxes(v, 1, 2).reshape(B * H, S, D)
-    grid = (B * H, S // block_q)
+    num_kb = S // block_k
+    grid = (B * H, S // block_q, num_kb)
+    from jax.experimental.pallas import tpu as pltpu
+
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, block_k=block_k, causal=causal, scale=scale),
+        functools.partial(_fwd_kernel, num_kb=num_kb, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
             jax.ShapeDtypeStruct((B * H, S, LANES), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(qt, kt, vt)
     return o, lse[..., 0], (qt, kt, vt)
 
 
 # ---------------- backward ----------------
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k, causal, scale):
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, :1]  # [bq, 1] (lanes-broadcast layout)
-    delta = delta_ref[0][:, :1]
-    bq, d = q.shape
-    S = k_ref.shape[1]
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+               *, num_kb, block_k, causal, scale):
+    """Grid (BH, num_q, num_k): K/V stream through the trailing dim, dq
+    accumulates in VMEM scratch."""
     qi = pl.program_id(1)
-    kb_hi = ((qi + 1) * bq + jnp.int32(block_k - 1)) // jnp.int32(block_k) if causal else S // block_k
+    ki = pl.program_id(2)
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    kb_hi = ((qi + 1) * bq + jnp.int32(block_k - 1)) // jnp.int32(block_k) if causal else num_kb
 
-    def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(ki < kb_hi)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]  # [bq, 1] (lanes-broadcast layout)
+        delta = delta_ref[0][:, :1]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * jnp.float32(scale)
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            kpos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * jnp.float32(scale)
+        dq_scr[...] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, kb_hi, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(ki == num_kb - 1)
+    def _epilogue():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q, causal, scale):
-    k = k_ref[0].astype(jnp.float32)  # [bk, d]
-    v = v_ref[0].astype(jnp.float32)
-    bk, d = k.shape
-    S = q_ref.shape[1]
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                dk_scr, dv_scr, *, num_qb, block_q, causal, scale):
+    """Grid (BH, num_k, num_q): Q/dO stream through the trailing dim, dk/dv
+    accumulate in VMEM scratch."""
     ki = pl.program_id(1)
-    # causal: query blocks at or after this key block contribute
+    qi = pl.program_id(2)
+    bk, d = k_ref.shape[1], k_ref.shape[2]
+    # causal: query blocks before this key block contribute nothing
     qb_lo = (ki * bk) // block_q if causal else 0
-    num_qb = S // block_q
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :1]  # [bq, 1]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q), :1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(qi >= qb_lo)
+    def _compute():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]  # [bq, 1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * jnp.float32(scale)
         if causal:
-            qpos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
             kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
         p = jnp.exp(s - lse)  # [bq, bk]
-        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dv_scr[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
-        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+        ds = p * (dp - delta) * jnp.float32(scale)
+        dk_scr[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(qb_lo, num_qb, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == num_qb - 1)
+    def _epilogue():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _bwd(causal, scale, block_q, block_k, res, g):
+    from jax.experimental.pallas import tpu as pltpu
+
     qt, kt, vt, o, lse = res
     BH, S, D = qt.shape
     do = jnp.swapaxes(g, 1, 2).reshape(BH, S, D)
@@ -172,42 +208,52 @@ def _bwd(causal, scale, block_q, block_k, res, g):
     # lanes-broadcast layout for the per-row scalars (see LANES above)
     lse = jnp.broadcast_to(lse[..., None], (BH, S, LANES))
     delta = jnp.broadcast_to(delta[..., None], (BH, S, LANES))
+    num_kb = S // block_k
+    num_qb = S // block_q
+    seq_par = ("parallel", "parallel", "arbitrary")
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_k=block_k, causal=causal, scale=scale),
-        grid=(BH, S // block_q),
+        functools.partial(_dq_kernel, num_kb=num_kb, block_k=block_k, causal=causal, scale=scale),
+        grid=(BH, num_qb, num_kb),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi, ki: (bh, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), qt.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=seq_par),
         interpret=_interpret(),
     )(qt, kt, vt, do, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=block_q, causal=causal, scale=scale),
-        grid=(BH, S // block_k),
+        functools.partial(_dkv_kernel, num_qb=num_qb, block_q=block_q, causal=causal, scale=scale),
+        grid=(BH, num_kb, num_qb),
         in_specs=[
-            pl.BlockSpec((1, S, D), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, S, LANES), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, S, LANES), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, ki, qi: (bh, qi, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), kt.dtype),
             jax.ShapeDtypeStruct((BH, S, D), vt.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=seq_par),
         interpret=_interpret(),
     )(qt, kt, vt, do, lse, delta)
 

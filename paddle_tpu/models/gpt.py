@@ -48,6 +48,8 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     tie_word_embeddings: bool = True
     sequence_parallel: bool = False
+    context_parallel: str = "ring"  # attention scheme under a sep axis:
+    #                                 'ring' (ppermute K/V) | 'ulysses' (a2a)
     use_recompute: bool = False
     recompute_policy: str = None  # None/'full' | 'dots_saveable' (keep MXU
     #                               outputs resident, replay elementwise only)
@@ -74,9 +76,17 @@ GPT_TINY = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4, max_s
 
 
 def _seq_spec(cfg: GPTConfig) -> P:
-    # residual stream sharding between blocks: batch over dp, and seq over mp
-    # when sequence-parallel (Megatron-SP)
-    return P("dp", "mp", None) if cfg.sequence_parallel else P("dp", None, None)
+    """Residual-stream sharding between blocks: batch over dp; seq over the
+    sep (context-parallel) axis when the ambient mesh has one, and over mp
+    when Megatron-SP is on."""
+    from ..distributed.sharding_utils import ambient_axis_names
+
+    seq_axes = []
+    if "sep" in ambient_axis_names():
+        seq_axes.append("sep")
+    if cfg.sequence_parallel:
+        seq_axes.append("mp")
+    return P("dp", tuple(seq_axes) if seq_axes else None, None)
 
 
 class GPTAttention(Layer):
@@ -90,13 +100,32 @@ class GPTAttention(Layer):
     def forward(self, x):
         B, S = x.shape[0], x.shape[1]
         cfg = self.cfg
+        from ..distributed.sharding_utils import ambient_axis_names
+        from ..distributed.topology import get_hybrid_communicate_group
+
         qkv = self.qkv(x)  # [B, S, 3H/mp] sharded on last dim
         qkv = qkv.reshape([B, S, 3, cfg.num_heads, cfg.head_dim])
-        qkv = maybe_shard(qkv, P("dp", None, None, "mp", None))  # heads over mp
+        # heads over mp; seq stays sharded over sep when the axis is active
+        # (gathering full-S here would defeat context parallelism's memory)
+        seq_axis = "sep" if "sep" in ambient_axis_names() else None
+        qkv = maybe_shard(qkv, P("dp", seq_axis, None, "mp", None))
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, S, H, D]
-        out = F.scaled_dot_product_attention(
-            q, k, v, dropout_p=cfg.dropout, is_causal=True, training=self.training
-        )
+        hcg = get_hybrid_communicate_group()
+        sep = hcg.get_sep_parallel_world_size() if hcg is not None else 1
+        if sep > 1:
+            # context parallelism: seq stays sharded over the sep axis and
+            # attention runs as a ring (or Ulysses a2a) over it — the
+            # long-context path (SURVEY §5.7)
+            if cfg.dropout > 0 and self.training:
+                raise NotImplementedError(
+                    "attention dropout is unsupported under context "
+                    "parallelism (sep_degree > 1); set dropout=0 or sep=1")
+            out = F.context_parallel_attention(
+                q, k, v, mode=cfg.context_parallel, is_causal=True)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, dropout_p=cfg.dropout, is_causal=True, training=self.training
+            )
         out = out.reshape([B, S, cfg.hidden_size])
         return self.dropout(self.proj(out))
 

@@ -81,6 +81,51 @@ def scaled_dot_product_attention(
     return apply("sdpa", fn, *tensors)
 
 
+@register_op("nn.context_parallel_attention")
+def context_parallel_attention(query, key, value, mode: str = "ring",
+                               is_causal: bool = False, scale=None,
+                               axis_name: str = "sep", name=None):
+    """Attention over a sequence-sharded residual stream (SURVEY §5.7 —
+    absent in the reference; this is where the TPU build exceeds it).
+
+    query/key/value: [B, S, H, D] GLOBAL arrays whose seq dim is sharded
+    over the `axis_name` mesh axis. Runs ring attention (ppermute K/V ring,
+    blockwise-softmax accumulation) or Ulysses (all_to_all head<->seq
+    reshard) inside a shard_map manual over that axis only; dp/mp stay under
+    GSPMD auto. Differentiable (the tape records the whole shard_map vjp).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ...distributed.fleet.meta_parallel.sequence_parallel import (
+        ring_attention, ulysses_attention)
+    from ...distributed.topology import get_hybrid_communicate_group
+
+    query, key, value = as_tensor(query), as_tensor(key), as_tensor(value)
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError("context_parallel_attention needs fleet.init with sep_degree set")
+    mesh = hcg.get_mesh()
+    if mode not in ("ring", "ulysses"):
+        raise ValueError(f"mode must be 'ring' or 'ulysses', got {mode!r}")
+
+    def fn(q, k, v):
+        spec = P(None, axis_name)
+
+        def body(ql, kl, vl):
+            if mode == "ulysses":
+                return ulysses_attention(ql, kl, vl, axis_name, causal=is_causal, scale=scale)
+            return ring_attention(ql, kl, vl, axis_name, causal=is_causal, scale=scale)
+
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names={axis_name}, check_vma=False,
+        )(q, k, v)
+
+    return apply("cp_attention", fn, query, key, value)
+
+
 @register_op("nn.flash_attention")
 def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False, fixed_seed_offset=None, training=True, name=None):
     """paddle.nn.functional.flash_attention API (flash_attention.py in reference)."""

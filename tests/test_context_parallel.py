@@ -1,0 +1,137 @@
+"""Context/sequence parallelism wired into the product (SURVEY §5.7 — the
+axis the reference lacks): sep axis in hybrid_configs, GPT attention under
+ring/Ulysses, and the streamed-KV flash kernel at long context."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    from paddle_tpu.distributed import collective, mesh, topology
+
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+    yield
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+
+
+def _train_gpt(sep=1, dp=1, mp=1, mode="ring", steps=2, seed=0):
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {
+        "dp_degree": dp, "pp_degree": 1, "sharding_degree": 1,
+        "mp_degree": mp, "sep_degree": sep,
+    }
+    fleet.init(is_collective=True, strategy=s)
+    paddle.seed(seed)
+    m = gpt_tiny(dropout=0.0, num_layers=2, context_parallel=mode)
+    o = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    st = make_sharded_train_step(m, o)
+    rng = np.random.RandomState(seed)
+    x = rng.randint(0, 128, size=(4, 16))
+    y = np.roll(x, -1, axis=1)
+    return [float(st(x, y)) for _ in range(steps)]
+
+
+def test_sep_axis_in_topology():
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.topology import get_hybrid_communicate_group
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "sep_degree": 4}
+    fleet.init(is_collective=True, strategy=s)
+    hcg = get_hybrid_communicate_group()
+    assert hcg.get_sep_parallel_world_size() == 4
+    assert "sep" in hcg.get_mesh().axis_names
+    assert hcg.get_sep_parallel_group() is not None
+
+
+def test_cp_degree_alias():
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.topology import get_hybrid_communicate_group
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"cp_degree": 2}
+    fleet.init(is_collective=True, strategy=s)
+    assert get_hybrid_communicate_group().get_sep_parallel_world_size() == 2
+
+
+def test_gpt_ring_matches_plain():
+    ref = _train_gpt()
+    ring = _train_gpt(sep=4, dp=2, mode="ring")
+    np.testing.assert_allclose(ring, ref, rtol=2e-4, atol=2e-5)
+    assert ring[-1] < ring[0]
+
+
+def test_gpt_ulysses_matches_plain():
+    ref = _train_gpt()
+    uly = _train_gpt(sep=4, dp=2, mode="ulysses")
+    np.testing.assert_allclose(uly, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_gpt_sep_with_mp():
+    """3-axis hybrid: sep x mp x dp."""
+    ref = _train_gpt()
+    mix = _train_gpt(sep=2, dp=2, mp=2)
+    np.testing.assert_allclose(mix, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_long_context_ring_8k():
+    """S=8192 on the 8-device virtual mesh: each device holds a 1k shard;
+    ring attention output == full attention (VERDICT round-1 done bar)."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.distributed.fleet.meta_parallel.sequence_parallel import ring_attention
+
+    n = 8
+    S, B, H, D = 8192, 1, 2, 64
+    mesh = Mesh(np.array(jax.devices()[:n]), ("sep",))
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, S, H, D).astype(np.float32) * 0.2)
+    k = jnp.asarray(rs.randn(B, S, H, D).astype(np.float32) * 0.2)
+    v = jnp.asarray(rs.randn(B, S, H, D).astype(np.float32) * 0.2)
+
+    out = jax.jit(
+        shard_map(
+            lambda q, k, v: ring_attention(q, k, v, "sep", causal=True),
+            mesh=mesh,
+            in_specs=(P(None, "sep"), P(None, "sep"), P(None, "sep")),
+            out_specs=P(None, "sep"),
+            check_vma=False,
+        )
+    )(q, k, v)
+
+    # reference: plain full attention
+    qt = jnp.swapaxes(q, 1, 2)
+    s = (qt @ jnp.swapaxes(jnp.swapaxes(k, 1, 2), -1, -2)) / np.sqrt(D)
+    s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, -1e30)
+    ref = jnp.swapaxes(jax.nn.softmax(s, -1) @ jnp.swapaxes(v, 1, 2), 1, 2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_flash_kernel_long_context_vmem_bounded():
+    """The streamed-KV kernel compiles and matches reference at S=4096 with
+    small blocks — the config whose full-S K/V BlockSpec used to blow VMEM."""
+    from paddle_tpu.kernels import flash_attention as fa
+
+    B, S, H, D = 1, 4096, 1, 64
+    rs = np.random.RandomState(1)
+    q = jnp.asarray(rs.randn(B, S, H, D).astype(np.float32) * 0.2)
+    out = fa._fwd(q, q, q, True, 1.0 / np.sqrt(D), 512, 512)[0]
+    qt = jnp.swapaxes(q, 1, 2).reshape(B * H, S, D)
+    s = (qt @ jnp.swapaxes(qt, -1, -2)) / np.sqrt(D)
+    s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, -1e30)
+    ref = jax.nn.softmax(s, -1) @ qt
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
