@@ -82,6 +82,12 @@ def main():
     # folded into the unit string — the driver contract is ONE JSON line
     long_note = ""
     if on_tpu:
+        # free the headline model/optimizer/step first: it was sized to fill
+        # HBM, and the seq-4k model must fit alongside nothing
+        import gc
+
+        del step, model, opt, x, y, loss
+        gc.collect()
         try:
             long_note = f", seq4k={_long_context_row():.0f} tok/s"
         except Exception:

@@ -66,6 +66,32 @@ class MoELayer(Layer):
         fn = gshard_gating if self.gate_type == "gshard" else switch_gating
         return fn(logits, capacity)
 
+    def _fused_expert_stack(self):
+        """When every expert is a same-shaped ExpertMLP, return stacked
+        (w1, b1, w2, b2, act) Tensors [E, ...] sharded over ep; else None."""
+        if not all(type(e) is ExpertMLP for e in self.experts):
+            return None
+        e0 = self.experts[0]
+        shapes = (e0.fc1.weight.shape, e0.fc2.weight.shape)
+        if not all((e.fc1.weight.shape, e.fc2.weight.shape) == shapes
+                   and e._act_name == e0._act_name for e in self.experts):
+            return None
+        from ..... import ops as _ops
+
+        w1 = maybe_shard(_ops.stack([e.fc1.weight for e in self.experts], axis=0), P(EP_AXIS, None, None))
+        b1 = maybe_shard(_ops.stack([e.fc1.bias for e in self.experts], axis=0), P(EP_AXIS, None))
+        w2 = maybe_shard(_ops.stack([e.fc2.weight for e in self.experts], axis=0), P(EP_AXIS, None, None))
+        b2 = maybe_shard(_ops.stack([e.fc2.bias for e in self.experts], axis=0), P(EP_AXIS, None))
+        import jax.nn as jnn
+
+        # match nn.functional defaults (paddle gelu is exact, not tanh-approx)
+        acts = {"gelu": lambda x: jnn.gelu(x, approximate=False), "relu": jnn.relu,
+                "silu": jnn.silu, "sigmoid": jnn.sigmoid, "tanh": jnp.tanh}
+        act = acts.get(e0._act_name)
+        if act is None:
+            return None
+        return w1, b1, w2, b2, act
+
     def forward(self, x):
         orig_shape = x.shape
         d = orig_shape[-1]
@@ -91,12 +117,29 @@ class MoELayer(Layer):
         expert_in = apply("moe_dispatch", dispatch_fn, dispatch, xt)  # [E, C, d]
         expert_in = maybe_shard(expert_in, P(EP_AXIS, None, None))
 
-        outs = []
-        for i, e in enumerate(self.experts):
-            outs.append(e(expert_in[i]))
-        from ..... import ops as _ops
+        fused = self._fused_expert_stack()
+        if fused is not None:
+            # homogeneous ExpertMLPs: run all experts as ONE batched einsum
+            # over stacked weights sharded on the ep axis — expert compute
+            # stays on the owning devices and XLA emits the all-to-all pair
+            # around the dispatch/combine einsums (global_scatter/gather
+            # analog, verified by tests/test_hlo_collectives.py)
+            w1, b1, w2, b2, act = fused
 
-        expert_out = _ops.stack(outs, axis=0)  # [E, C, d_out]
+            def experts_fn(ei, w1v, b1v, w2v, b2v):
+                h = jnp.einsum("ecd,edh->ech", ei.astype(jnp.float32), w1v.astype(jnp.float32))
+                h = act(h + b1v[:, None, :])
+                o = jnp.einsum("ech,ehd->ecd", h, w2v.astype(jnp.float32))
+                return (o + b2v[:, None, :]).astype(ei.dtype)
+
+            expert_out = apply("moe_experts_fused", experts_fn, expert_in, w1, b1, w2, b2)
+        else:
+            outs = []
+            for i, e in enumerate(self.experts):
+                outs.append(e(expert_in[i]))
+            from ..... import ops as _ops
+
+            expert_out = _ops.stack(outs, axis=0)  # [E, C, d_out]
         expert_out = maybe_shard(expert_out, P(EP_AXIS, None, None))
 
         def combine_fn(cv, ev):
@@ -115,6 +158,7 @@ class ExpertMLP(Layer):
 
         self.fc1 = nn.Linear(d_model, d_hidden)
         self.fc2 = nn.Linear(d_hidden, d_model)
+        self._act_name = activation
         self.act = getattr(nn.functional, activation)
 
     def forward(self, x):

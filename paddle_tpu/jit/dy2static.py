@@ -161,9 +161,13 @@ def convert_while(test_fn: Callable, body_fn: Callable, init_vars: tuple,
     lax.while_loop when traced (reference convert_while_loop)."""
     first = test_fn(init_vars)
     if not _is_traced(first) and not any(_is_traced(v) for v in init_vars):
+        # reuse `first` for iteration 0 — re-evaluating a stateful test would
+        # diverge from eager semantics
         vars_ = init_vars
-        while bool(_raw(test_fn(vars_))):
+        cond = bool(_raw(first))
+        while cond:
             vars_ = body_fn(vars_)
+            cond = bool(_raw(test_fn(vars_)))
         return vars_
 
     init_vars = _resolve_undefined(init_vars, names, body_fn)
