@@ -1,0 +1,53 @@
+"""Value constraints (python/paddle/distribution/constraint.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["Constraint", "Real", "Range", "Positive", "Simplex",
+           "real", "positive", "simplex"]
+
+
+def _raw(x):
+    from .distributions import _raw as raw
+
+    return raw(x)
+
+
+class Constraint:
+    """Membership test for a distribution's support (reference :17)."""
+
+    def __call__(self, value):
+        raise NotImplementedError
+
+
+class Real(Constraint):
+    def __call__(self, value):
+        v = _raw(value)
+        return v == v  # finite-by-identity test (NaN fails) per reference
+
+
+class Range(Constraint):
+    def __init__(self, lower, upper):
+        self._lower = lower
+        self._upper = upper
+
+    def __call__(self, value):
+        v = _raw(value)
+        return (self._lower <= v) & (v <= self._upper)
+
+
+class Positive(Constraint):
+    def __call__(self, value):
+        return _raw(value) >= 0.0
+
+
+class Simplex(Constraint):
+    def __call__(self, value):
+        v = _raw(value)
+        return jnp.all(v >= 0, axis=-1) & (jnp.abs(v.sum(-1) - 1.0) < 1e-6)
+
+
+real = Real()
+positive = Positive()
+simplex = Simplex()

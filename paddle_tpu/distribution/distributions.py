@@ -566,6 +566,15 @@ class TransformedDistribution(Distribution):
     def __init__(self, base, transforms):
         self._base = base
         self._transforms = list(transforms)
+        # output event rank: base event rank raised by any vector transform
+        # (reference transformed_distribution.py: chain codomain event rank)
+        rank = len(base.event_shape)
+        for t in self._transforms:
+            dom = getattr(t, "_domain", None)
+            cod = getattr(t, "_codomain", None)
+            if dom is not None and cod is not None:
+                rank = max(rank + cod.event_rank - dom.event_rank, cod.event_rank)
+        self._event_rank = rank
         super().__init__(base.batch_shape, base.event_shape)
 
     def sample(self, shape=()):
@@ -580,11 +589,28 @@ class TransformedDistribution(Distribution):
             x = t.forward(x)
         return x
 
+    @staticmethod
+    def _sum_rightmost(v, n):
+        return v.sum(axis=tuple(range(-n, 0))) if n > 0 else v
+
     def log_prob(self, value):
-        y = value
-        log_det = 0.0
+        """Event-rank-aware change of variables (reference
+        transformed_distribution.py TransformedDistribution.log_prob): each
+        stage's ldj and the base log_prob reduce over the dims the chain
+        reinterprets as event dims."""
+        y = _raw(value)
+        log_prob = 0.0
+        event_rank = self._event_rank
         for t in reversed(self._transforms):
-            x = t.inverse(y)
-            log_det = log_det + _raw(t.forward_log_det_jacobian(x))
+            x = _raw(t.inverse(_wrap(y)))
+            dom = getattr(t, "_domain", None)
+            cod = getattr(t, "_codomain", None)
+            d_rank = dom.event_rank if dom is not None else 0
+            c_rank = cod.event_rank if cod is not None else 0
+            event_rank += d_rank - c_rank
+            ldj = _raw(t.forward_log_det_jacobian(_wrap(x)))
+            log_prob = log_prob - self._sum_rightmost(ldj, event_rank - d_rank)
             y = x
-        return _wrap(_raw(self._base.log_prob(y)) - log_det)
+        base_lp = _raw(self._base.log_prob(_wrap(y)))
+        base_event = len(self._base.event_shape)
+        return _wrap(log_prob + self._sum_rightmost(base_lp, event_rank - base_event))

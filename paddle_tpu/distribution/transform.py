@@ -1,0 +1,574 @@
+"""Random-variable transforms (python/paddle/distribution/transform.py:59
+Transform and the 13 concrete classes :342-:1284).
+
+TPU-native: pure jnp math on Tensor values; log-det-Jacobians are closed
+form (never materialized Jacobians), so everything traces/compiles. A
+Transform applied to a Distribution builds TransformedDistribution; applied
+to another Transform it chains.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+import math
+import operator
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import constraint as _constraint
+from . import variable as _variable
+from .distributions import _raw, _wrap  # single Tensor-unboxing pair
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Type(enum.Enum):
+    """Mapping type of a transform (reference transform.py:45)."""
+
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t) -> bool:
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    """Base class (reference transform.py:59). Subclasses implement
+    _forward/_inverse and one of the log-det-Jacobian methods."""
+
+    _type = Type.INJECTION
+
+    def _is_injective(self):
+        return Type.is_injective(self._type)
+
+    def __call__(self, input):
+        from .distributions import Distribution, TransformedDistribution
+
+        if isinstance(input, Distribution):
+            return TransformedDistribution(input, [self])
+        if isinstance(input, Transform):
+            return ChainTransform([self, input])
+        return self.forward(input)
+
+    # ---- public API ----
+    def forward(self, x):
+        return _wrap(self._forward(_raw(x)))
+
+    def inverse(self, y):
+        return _wrap(self._inverse(_raw(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _wrap(self._call_forward_log_det_jacobian(_raw(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return _wrap(self._call_inverse_log_det_jacobian(_raw(y)))
+
+    def forward_shape(self, shape):
+        return tuple(self._forward_shape(tuple(shape)))
+
+    def inverse_shape(self, shape):
+        return tuple(self._inverse_shape(tuple(shape)))
+
+    @property
+    def _domain(self):
+        return _variable.real
+
+    @property
+    def _codomain(self):
+        return _variable.real
+
+    # ---- subclass hooks ----
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _call_forward_log_det_jacobian(self, x):
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return self._forward_log_det_jacobian(x)
+        if not self._is_injective():
+            raise NotImplementedError(
+                f"{type(self).__name__} is not injective; its forward "
+                "log_det_jacobian is undefined")
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return -self._inverse_log_det_jacobian(self._forward(x))
+        raise NotImplementedError(
+            f"{type(self).__name__} implements no log_det_jacobian")
+
+    def _call_inverse_log_det_jacobian(self, y):
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return self._inverse_log_det_jacobian(y)
+        if not self._is_injective():
+            raise NotImplementedError(
+                f"{type(self).__name__} is not injective; its inverse "
+                "log_det_jacobian is undefined")
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return -self._forward_log_det_jacobian(self._inverse(y))
+        raise NotImplementedError(
+            f"{type(self).__name__} implements no log_det_jacobian")
+
+    def _forward_shape(self, shape):
+        return shape
+
+    def _inverse_shape(self, shape):
+        return shape
+
+
+class AbsTransform(Transform):
+    """y = |x| (reference :342). Not injective: inverse returns the
+    (-y, y) preimage pair."""
+
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return (-y, y)
+
+    def inverse(self, y):
+        neg, pos = self._inverse(_raw(y))
+        return (_wrap(neg), _wrap(pos))
+
+    def _inverse_log_det_jacobian(self, y):
+        zero = jnp.zeros_like(y)
+        return (zero, zero)
+
+    def inverse_log_det_jacobian(self, y):
+        a, b = self._inverse_log_det_jacobian(_raw(y))
+        return (_wrap(a), _wrap(b))
+
+    @property
+    def _domain(self):
+        return _variable.real
+
+    @property
+    def _codomain(self):
+        return _variable.positive
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (reference :414)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self._loc = _raw(loc)
+        self._scale = _raw(scale)
+
+    @property
+    def loc(self):
+        return _wrap(self._loc)
+
+    @property
+    def scale(self):
+        return _wrap(self._scale)
+
+    def _forward(self, x):
+        return self._loc + self._scale * x
+
+    def _inverse(self, y):
+        return (y - self._loc) / self._scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(
+            jnp.log(jnp.abs(self._scale)),
+            jnp.broadcast_shapes(jnp.shape(x), jnp.shape(self._loc), jnp.shape(self._scale)))
+
+    def _forward_shape(self, shape):
+        return jnp.broadcast_shapes(shape, jnp.shape(self._loc), jnp.shape(self._scale))
+
+    _inverse_shape = _forward_shape
+
+
+class ChainTransform(Transform):
+    """Composition t_n(...t_1(x)) (reference :496)."""
+
+    def __init__(self, transforms: Sequence[Transform]):
+        if not all(isinstance(t, Transform) for t in transforms):
+            raise TypeError("ChainTransform expects a sequence of Transforms")
+        self.transforms = list(transforms)
+
+    def _is_injective(self):
+        return all(t._is_injective() for t in self.transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        value = 0.0
+        event_rank = self._domain.event_rank
+        for t in self.transforms:
+            value = value + self._sum_rightmost(
+                t._call_forward_log_det_jacobian(x),
+                event_rank - t._domain.event_rank)
+            x = t._forward(x)
+            event_rank += t._codomain.event_rank - t._domain.event_rank
+        return value
+
+    def _forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t._forward_shape(shape)
+        return shape
+
+    def _inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t._inverse_shape(shape)
+        return shape
+
+    @staticmethod
+    def _sum_rightmost(value, n):
+        return value.sum(axis=tuple(range(-n, 0))) if n > 0 else value
+
+    @property
+    def _domain(self):
+        # lower bound of input event rank over the chain (reference :582 —
+        # solved backwards: N(i) = max(N(i+1) - delta(ti), ti_in))
+        domain = self.transforms[0]._domain
+        event_rank = self.transforms[-1]._codomain.event_rank
+        for t in reversed(self.transforms):
+            event_rank -= t._codomain.event_rank - t._domain.event_rank
+            event_rank = max(event_rank, t._domain.event_rank)
+        extra = event_rank - domain.event_rank
+        return _variable.Independent(domain, extra) if extra > 0 else domain
+
+    @property
+    def _codomain(self):
+        codomain = self.transforms[-1]._codomain
+        event_rank = self.transforms[0]._domain.event_rank
+        for t in self.transforms:
+            event_rank += t._codomain.event_rank - t._domain.event_rank
+            event_rank = max(event_rank, t._codomain.event_rank)
+        extra = event_rank - codomain.event_rank
+        return _variable.Independent(codomain, extra) if extra > 0 else codomain
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (reference :621)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+    @property
+    def _codomain(self):
+        return _variable.positive
+
+
+class IndependentTransform(Transform):
+    """Reinterpret rightmost batch dims as event dims (reference :670):
+    log-det sums over the reinterpreted dims."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        if reinterpreted_batch_rank <= 0:
+            raise ValueError("reinterpreted_batch_rank must be positive")
+        self._base = base
+        self._reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+
+    def _is_injective(self):
+        return self._base._is_injective()
+
+    def _forward(self, x):
+        return self._base._forward(x)
+
+    def _inverse(self, y):
+        return self._base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = self._base._call_forward_log_det_jacobian(x)
+        return ldj.sum(axis=tuple(range(-self._reinterpreted_batch_rank, 0)))
+
+    def _forward_shape(self, shape):
+        return self._base._forward_shape(shape)
+
+    def _inverse_shape(self, shape):
+        return self._base._inverse_shape(shape)
+
+    @property
+    def _domain(self):
+        return _variable.Independent(self._base._domain, self._reinterpreted_batch_rank)
+
+    @property
+    def _codomain(self):
+        return _variable.Independent(self._base._codomain, self._reinterpreted_batch_rank)
+
+
+class PowerTransform(Transform):
+    """y = x ** power on the positive reals (reference :765)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self._power = _raw(power)
+
+    @property
+    def power(self):
+        return _wrap(self._power)
+
+    def _forward(self, x):
+        return jnp.power(x, self._power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self._power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self._power * jnp.power(x, self._power - 1)))
+
+    def _forward_shape(self, shape):
+        return jnp.broadcast_shapes(shape, jnp.shape(self._power))
+
+    _inverse_shape = _forward_shape
+
+    @property
+    def _domain(self):
+        return _variable.positive
+
+    @property
+    def _codomain(self):
+        return _variable.positive
+
+
+class ReshapeTransform(Transform):
+    """Reshape the event part of the sample (reference :829)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self._in = tuple(int(d) for d in in_event_shape)
+        self._out = tuple(int(d) for d in out_event_shape)
+        if functools.reduce(operator.mul, self._in, 1) != functools.reduce(operator.mul, self._out, 1):
+            raise ValueError(
+                f"in_event_shape {self._in} and out_event_shape {self._out} "
+                "must have the same number of elements")
+
+    @property
+    def in_event_shape(self):
+        return self._in
+
+    @property
+    def out_event_shape(self):
+        return self._out
+
+    def _batch(self, shape, event):
+        n = len(event)
+        if n and tuple(shape[-n:]) != event:
+            raise ValueError(f"shape {shape} does not end with event shape {event}")
+        return tuple(shape[: len(shape) - n])
+
+    def _forward(self, x):
+        batch = self._batch(jnp.shape(x), self._in)
+        return jnp.reshape(x, batch + self._out)
+
+    def _inverse(self, y):
+        batch = self._batch(jnp.shape(y), self._out)
+        return jnp.reshape(y, batch + self._in)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = self._batch(jnp.shape(x), self._in)
+        return jnp.zeros(batch, x.dtype)
+
+    def _forward_shape(self, shape):
+        return self._batch(shape, self._in) + self._out
+
+    def _inverse_shape(self, shape):
+        return self._batch(shape, self._out) + self._in
+
+    @property
+    def _domain(self):
+        return _variable.Independent(_variable.real, len(self._in))
+
+    @property
+    def _codomain(self):
+        return _variable.Independent(_variable.real, len(self._out))
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (reference :953)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+    @property
+    def _codomain(self):
+        return _variable.Variable(False, 0, _constraint.Range(0.0, 1.0))
+
+
+class SoftmaxTransform(Transform):
+    """Normalize to the simplex (reference :996). Surjective, not
+    injective — no log-det-Jacobian."""
+
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        x = x - x.max(axis=-1, keepdims=True)
+        x = jnp.exp(x)
+        return x / x.sum(axis=-1, keepdims=True)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_shape(self, shape):
+        if len(shape) < 1:
+            raise ValueError("SoftmaxTransform needs at least one dim")
+        return shape
+
+    _inverse_shape = _forward_shape
+
+    @property
+    def _codomain(self):
+        return _variable.Variable(False, 1, _constraint.simplex)
+
+
+class StackTransform(Transform):
+    """Apply a different transform to each slice along `axis`
+    (reference :1052)."""
+
+    def __init__(self, transforms: Sequence[Transform], axis: int = 0):
+        if not transforms or not all(isinstance(t, Transform) for t in transforms):
+            raise TypeError("StackTransform expects a non-empty sequence of Transforms")
+        self._transforms = list(transforms)
+        self._axis = int(axis)
+
+    @property
+    def transforms(self):
+        return self._transforms
+
+    @property
+    def axis(self):
+        return self._axis
+
+    def _is_injective(self):
+        return all(t._is_injective() for t in self._transforms)
+
+    def _check(self, v):
+        if v.shape[self._axis] != len(self._transforms):
+            raise ValueError(
+                f"input size {v.shape[self._axis]} along axis {self._axis} != "
+                f"number of transforms {len(self._transforms)}")
+
+    def _map(self, v, method):
+        self._check(v)
+        slices = jnp.moveaxis(v, self._axis, 0)
+        outs = [getattr(t, method)(slices[i]) for i, t in enumerate(self._transforms)]
+        return jnp.moveaxis(jnp.stack(outs), 0, self._axis)
+
+    def _forward(self, x):
+        return self._map(x, "_forward")
+
+    def _inverse(self, y):
+        return self._map(y, "_inverse")
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map(x, "_call_forward_log_det_jacobian")
+
+    @property
+    def _domain(self):
+        return _variable.Stack([t._domain for t in self._transforms], self._axis)
+
+    @property
+    def _codomain(self):
+        return _variable.Stack([t._codomain for t in self._transforms], self._axis)
+
+
+class StickBreakingTransform(Transform):
+    """R^(K-1) -> K-simplex via stick breaking (reference :1172)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.arange(k, 0, -1, dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zc = jnp.concatenate([z, jnp.ones(x.shape[:-1] + (1,), x.dtype)], axis=-1)
+        one_minus = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), jnp.cumprod(1 - z, axis=-1)], axis=-1)
+        return zc * one_minus
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        k = y_crop.shape[-1]
+        offset = jnp.arange(k, 0, -1, dtype=y.dtype)
+        sf = 1.0 - jnp.cumsum(y_crop, axis=-1)
+        sf = jnp.concatenate([jnp.ones(y.shape[:-1] + (1,), y.dtype), sf[..., :-1]], axis=-1)
+        z = y_crop / sf
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        k = x.shape[-1]
+        offset = jnp.arange(k, 0, -1, dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        # d simplex / d x: sum log(z_i (1-z_i) * remaining-stick_i)
+        sf = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), jnp.cumprod(1 - z, axis=-1)[..., :-1]],
+            axis=-1)
+        return (jnp.log(z) + jnp.log1p(-z) + jnp.log(sf)).sum(-1)
+
+    def _forward_shape(self, shape):
+        return shape[:-1] + (shape[-1] + 1,)
+
+    def _inverse_shape(self, shape):
+        return shape[:-1] + (shape[-1] - 1,)
+
+    @property
+    def _domain(self):
+        # vector transform: the ldj reduces the last axis
+        return _variable.Independent(_variable.real, 1)
+
+    @property
+    def _codomain(self):
+        return _variable.Variable(False, 1, _constraint.simplex)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference :1238)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2 (log2 - x - softplus(-2x)), numerically stable
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+    @property
+    def _codomain(self):
+        return _variable.Variable(False, 0, _constraint.Range(-1.0, 1.0))

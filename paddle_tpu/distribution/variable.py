@@ -1,0 +1,74 @@
+"""Random-variable metadata (python/paddle/distribution/variable.py): the
+(is_discrete, event_rank, constraint) triple transforms use for domain/
+codomain bookkeeping."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import constraint as _constraint
+
+__all__ = ["Variable", "Real", "Positive", "Independent", "Stack",
+           "real", "positive"]
+
+
+class Variable:
+    def __init__(self, is_discrete=False, event_rank=0, constraint=None):
+        self._is_discrete = is_discrete
+        self._event_rank = event_rank
+        self._constraint = constraint or _constraint.real
+
+    @property
+    def is_discrete(self):
+        return self._is_discrete
+
+    @property
+    def event_rank(self):
+        return self._event_rank
+
+    def constraint(self, value):
+        return self._constraint(value)
+
+
+class Real(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, _constraint.real)
+
+
+class Positive(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, _constraint.positive)
+
+
+class Independent(Variable):
+    """Reinterpret rightmost batch dims as event dims (reference :56)."""
+
+    def __init__(self, base: Variable, reinterpreted_batch_rank: int):
+        self._base = base
+        self._reinterpreted_batch_rank = reinterpreted_batch_rank
+        super().__init__(base.is_discrete,
+                         base.event_rank + reinterpreted_batch_rank,
+                         None)
+
+    def constraint(self, value):
+        ret = self._base.constraint(value)
+        if hasattr(ret, "ndim") and ret.ndim:
+            ret = ret.all(axis=tuple(range(-self._reinterpreted_batch_rank, 0)))
+        return ret
+
+
+class Stack(Variable):
+    def __init__(self, vars, axis=0):
+        self._vars = list(vars)
+        self._axis = axis
+        rank = max(v.event_rank for v in self._vars)
+        super().__init__(any(v.is_discrete for v in self._vars), rank, None)
+
+    def constraint(self, value):
+        slices = jnp.moveaxis(value, self._axis, 0)
+        outs = [v.constraint(slices[i]) for i, v in enumerate(self._vars)]
+        return jnp.moveaxis(jnp.stack(outs), 0, self._axis)
+
+
+real = Real()
+positive = Positive()

@@ -1,0 +1,183 @@
+"""Transform family (reference distribution/transform.py:59ff class list):
+forward/inverse roundtrips and log_det_jacobian checked against autodiff
+Jacobians (slogdet of jax.jacfwd), plus TransformedDistribution parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+def _autodiff_ldj_scalar(fn, x):
+    """Elementwise transform: log|f'(x)| per element via vmap grad."""
+    g = jax.vmap(jax.grad(lambda v: fn(v.reshape(1))[0]))(x.reshape(-1, 1))
+    return np.log(np.abs(np.asarray(g))).reshape(x.shape)
+
+
+ELEMENTWISE = [
+    (D.ExpTransform(), np.array([-1.0, 0.3, 2.0], np.float32)),
+    (D.SigmoidTransform(), np.array([-2.0, 0.0, 3.0], np.float32)),
+    (D.TanhTransform(), np.array([-1.5, 0.1, 0.9], np.float32)),
+    (D.AffineTransform(_t(1.0), _t(-2.5)), np.array([-1.0, 0.0, 4.0], np.float32)),
+    (D.PowerTransform(_t(3.0)), np.array([0.5, 1.0, 2.0], np.float32)),
+]
+
+
+@pytest.mark.parametrize("t,x", ELEMENTWISE, ids=lambda p: type(p).__name__ if isinstance(p, D.Transform) else "x")
+def test_elementwise_roundtrip_and_ldj(t, x):
+    y = t.forward(_t(x))
+    back = t.inverse(y)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-5, atol=1e-6)
+    ldj = t.forward_log_det_jacobian(_t(x)).numpy()
+    ref = _autodiff_ldj_scalar(lambda v: t._forward(v), jnp.asarray(x))
+    np.testing.assert_allclose(ldj, ref, rtol=1e-5, atol=1e-5)
+    # inverse ldj is the negation at the image point
+    ildj = t.inverse_log_det_jacobian(y).numpy()
+    np.testing.assert_allclose(ildj, -ldj, rtol=1e-4, atol=1e-5)
+
+
+def test_chain_transform():
+    chain = D.ChainTransform([D.AffineTransform(_t(0.0), _t(2.0)), D.ExpTransform()])
+    x = np.array([0.1, 1.0], np.float32)
+    y = chain.forward(_t(x))
+    np.testing.assert_allclose(y.numpy(), np.exp(2 * x), rtol=1e-6)
+    np.testing.assert_allclose(chain.inverse(y).numpy(), x, rtol=1e-5)
+    ldj = chain.forward_log_det_jacobian(_t(x)).numpy()
+    ref = _autodiff_ldj_scalar(lambda v: chain._forward(v), jnp.asarray(x))
+    np.testing.assert_allclose(ldj, ref, rtol=1e-5)
+    # calling a transform on a transform chains
+    assert isinstance(D.ExpTransform()(D.TanhTransform()), D.ChainTransform)
+
+
+def test_abs_transform():
+    t = D.AbsTransform()
+    x = np.array([-3.0, 2.0], np.float32)
+    np.testing.assert_allclose(t.forward(_t(x)).numpy(), [3.0, 2.0])
+    neg, pos = t.inverse(_t(np.array([3.0, 2.0], np.float32)))
+    np.testing.assert_allclose(neg.numpy(), [-3.0, -2.0])
+    np.testing.assert_allclose(pos.numpy(), [3.0, 2.0])
+    assert not t._is_injective()
+
+
+def test_reshape_transform():
+    t = D.ReshapeTransform((2, 3), (6,))
+    x = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+    y = t.forward(_t(x))
+    assert y.shape == [2, 6]
+    np.testing.assert_allclose(t.inverse(y).numpy(), x)
+    assert t.forward_shape((5, 2, 3)) == (5, 6)
+    assert t.inverse_shape((5, 6)) == (5, 2, 3)
+    np.testing.assert_allclose(t.forward_log_det_jacobian(_t(x)).numpy(), np.zeros(2))
+    with pytest.raises(ValueError):
+        D.ReshapeTransform((2, 3), (5,))
+
+
+def test_softmax_transform():
+    t = D.SoftmaxTransform()
+    x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    y = t.forward(_t(x)).numpy()
+    np.testing.assert_allclose(y.sum(-1), np.ones(4), rtol=1e-6)
+    # surjection onto the simplex: forward(inverse(y)) == y
+    y2 = t.forward(t.inverse(_t(y))).numpy()
+    np.testing.assert_allclose(y2, y, rtol=1e-5)
+
+
+def test_stack_transform():
+    t = D.StackTransform([D.ExpTransform(), D.TanhTransform()], axis=1)
+    x = np.random.RandomState(1).randn(3, 2).astype(np.float32) * 0.5
+    y = t.forward(_t(x)).numpy()
+    np.testing.assert_allclose(y[:, 0], np.exp(x[:, 0]), rtol=1e-6)
+    np.testing.assert_allclose(y[:, 1], np.tanh(x[:, 1]), rtol=1e-6)
+    np.testing.assert_allclose(t.inverse(_t(y)).numpy(), x, rtol=1e-5)
+    ldj = t.forward_log_det_jacobian(_t(x)).numpy()
+    assert ldj.shape == (3, 2)
+
+
+def test_stick_breaking_transform():
+    t = D.StickBreakingTransform()
+    x = np.random.RandomState(2).randn(6).astype(np.float32)
+    y = t.forward(_t(x)).numpy()
+    assert y.shape == (7,)
+    assert (y > 0).all()
+    np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(t.inverse(_t(y)).numpy(), x, rtol=1e-4, atol=1e-5)
+    # ldj vs autodiff: jacobian of R^K -> first K coords of the simplex
+    ldj = float(t.forward_log_det_jacobian(_t(x)).numpy())
+    J = jax.jacfwd(lambda v: t._forward(v)[:-1])(jnp.asarray(x))
+    _, ref = np.linalg.slogdet(np.asarray(J))
+    np.testing.assert_allclose(ldj, ref, rtol=1e-4)
+    assert t.forward_shape((6,)) == (7,)
+    assert t.inverse_shape((7,)) == (6,)
+
+
+def test_independent_transform():
+    t = D.IndependentTransform(D.ExpTransform(), 1)
+    x = np.random.RandomState(3).randn(4, 3).astype(np.float32)
+    ldj = t.forward_log_det_jacobian(_t(x)).numpy()
+    assert ldj.shape == (4,)
+    np.testing.assert_allclose(ldj, x.sum(-1), rtol=1e-6)
+
+
+def test_transformed_distribution_exp_is_lognormal():
+    """Normal pushed through ExpTransform must match LogNormal.log_prob —
+    the canonical TransformedDistribution identity."""
+    base = D.Normal(_t(0.3), _t(0.8))
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    ln = D.LogNormal(_t(0.3), _t(0.8))
+    v = _t(np.array([0.5, 1.0, 2.5], np.float32))
+    np.testing.assert_allclose(td.log_prob(v).numpy(), ln.log_prob(v).numpy(), rtol=1e-5)
+
+
+def test_transform_call_on_distribution():
+    td = D.ExpTransform()(D.Normal(_t(0.0), _t(1.0)))
+    assert isinstance(td, D.TransformedDistribution)
+    s = td.sample((100,))
+    assert (s.numpy() > 0).all()
+
+
+def test_constraints_and_variables():
+    from paddle_tpu.distribution import constraint, variable
+
+    assert bool(np.all(np.asarray(constraint.simplex(np.array([[0.2, 0.8]])))))
+    assert not bool(np.all(np.asarray(constraint.simplex(np.array([[0.5, 0.9]])))))
+    assert bool(np.asarray(constraint.positive(3.0)))
+    r = variable.Independent(variable.real, 1)
+    assert r.event_rank == 1
+    assert variable.positive.constraint(1.0)
+
+
+def test_chain_with_mixed_event_ranks():
+    """Elementwise ldj must reduce over dims a later vector-transform stage
+    reinterprets as event dims (reference ChainTransform._domain DP)."""
+    chain = D.ChainTransform([D.ExpTransform(), D.ReshapeTransform((2, 3), (6,))])
+    x = np.random.RandomState(4).randn(4, 2, 3).astype(np.float32)
+    ldj = chain.forward_log_det_jacobian(_t(x)).numpy()
+    assert ldj.shape == (4,)
+    np.testing.assert_allclose(ldj, x.sum((-2, -1)), rtol=1e-5)
+
+
+def test_stickbreaking_transformed_log_prob_is_scalar():
+    base = D.Independent(D.Normal(_t(np.zeros(5, np.float32)), _t(np.ones(5, np.float32))), 1)
+    td = D.TransformedDistribution(base, [D.StickBreakingTransform()])
+    y = td.sample()
+    lp = td.log_prob(y)
+    assert lp.numpy().shape == ()
+
+
+def test_affine_higher_rank_scale_ldj():
+    t = D.AffineTransform(_t(0.0), _t(np.ones((3, 1), np.float32) * 2.0))
+    ldj = t.forward_log_det_jacobian(_t(np.ones(5, np.float32))).numpy()
+    assert ldj.shape == (3, 5)
+    np.testing.assert_allclose(ldj, np.log(2.0))
+
+
+def test_abs_forward_ldj_raises():
+    with pytest.raises(NotImplementedError, match="not injective"):
+        D.AbsTransform().forward_log_det_jacobian(_t([1.0]))
