@@ -26,6 +26,11 @@ def _wrap(v):
     return Tensor(v)
 
 
+def _sum_rightmost(value, n):
+    """Sum over the rightmost n dims (shared by chain/transformed ldj)."""
+    return value.sum(axis=tuple(range(-n, 0))) if n > 0 else value
+
+
 class Distribution:
     def __init__(self, batch_shape=(), event_shape=()):
         self._batch_shape = tuple(batch_shape)
@@ -567,15 +572,29 @@ class TransformedDistribution(Distribution):
         self._base = base
         self._transforms = list(transforms)
         # output event rank: base event rank raised by any vector transform
-        # (reference transformed_distribution.py: chain codomain event rank)
+        # (reference transformed_distribution.py: chain codomain event rank);
+        # guard at construction that the base supplies enough event dims for
+        # each stage's domain (reference raises here, not at sample time)
         rank = len(base.event_shape)
         for t in self._transforms:
             dom = getattr(t, "_domain", None)
             cod = getattr(t, "_codomain", None)
-            if dom is not None and cod is not None:
-                rank = max(rank + cod.event_rank - dom.event_rank, cod.event_rank)
+            if dom is None or cod is None:
+                continue
+            if rank < dom.event_rank:
+                raise ValueError(
+                    f"base distribution event rank {rank} is smaller than "
+                    f"{type(t).__name__}'s domain event rank {dom.event_rank}")
+            rank = max(rank + cod.event_rank - dom.event_rank, cod.event_rank)
         self._event_rank = rank
-        super().__init__(base.batch_shape, base.event_shape)
+        # batch/event shapes of the TRANSFORMED variable: push the base's
+        # full shape through the chain, then split by the output event rank
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        for t in self._transforms:
+            if hasattr(t, "forward_shape"):
+                shape = tuple(t.forward_shape(shape))
+        split = len(shape) - rank
+        super().__init__(shape[:split], shape[split:])
 
     def sample(self, shape=()):
         x = self._base.sample(shape)
@@ -589,9 +608,7 @@ class TransformedDistribution(Distribution):
             x = t.forward(x)
         return x
 
-    @staticmethod
-    def _sum_rightmost(v, n):
-        return v.sum(axis=tuple(range(-n, 0))) if n > 0 else v
+    _sum_rightmost = staticmethod(_sum_rightmost)
 
     def log_prob(self, value):
         """Event-rank-aware change of variables (reference

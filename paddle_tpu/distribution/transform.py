@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from . import constraint as _constraint
 from . import variable as _variable
-from .distributions import _raw, _wrap  # single Tensor-unboxing pair
+from .distributions import _raw, _sum_rightmost, _wrap
 
 __all__ = [
     "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
@@ -219,7 +219,7 @@ class ChainTransform(Transform):
         value = 0.0
         event_rank = self._domain.event_rank
         for t in self.transforms:
-            value = value + self._sum_rightmost(
+            value = value + _sum_rightmost(
                 t._call_forward_log_det_jacobian(x),
                 event_rank - t._domain.event_rank)
             x = t._forward(x)
@@ -235,10 +235,6 @@ class ChainTransform(Transform):
         for t in reversed(self.transforms):
             shape = t._inverse_shape(shape)
         return shape
-
-    @staticmethod
-    def _sum_rightmost(value, n):
-        return value.sum(axis=tuple(range(-n, 0))) if n > 0 else value
 
     @property
     def _domain(self):

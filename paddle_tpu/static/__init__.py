@@ -80,8 +80,8 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     return layer, in_names, None
 
 
-# nn sub-namespace for static (reference: paddle.static.nn)
-from .. import nn  # noqa: F401,E402
+# static-graph layer builders (reference: paddle.static.nn)
+from . import nn  # noqa: F401,E402
 
 __all__ = [
     "InputSpec", "save_inference_model", "load_inference_model", "Program",

@@ -1,0 +1,509 @@
+"""paddle.static.nn — static-graph layer builders
+(python/paddle/static/nn/__init__.py, 39 names).
+
+TPU re-design: the reference's builders append ops + create scoped
+parameters in the default Program via LayerHelper. Here parameters have
+eager identity (core Tensors) and static capture happens at the dispatch
+seam, so each builder simply instantiates the matching nn.Layer (fresh
+params per call, like LayerHelper's unique names) and applies it; control
+flow delegates to the dy2static convert calls (lax.cond/while_loop under a
+trace, plain python eagerly); the sequence_* family lives in
+sequence_lod.py on dense padded tensors + lengths instead of LoD."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...ops._dispatch import apply, as_tensor
+from .sequence_lod import (  # noqa: F401
+    sequence_concat,
+    sequence_conv,
+    sequence_enumerate,
+    sequence_expand,
+    sequence_expand_as,
+    sequence_first_step,
+    sequence_last_step,
+    sequence_pad,
+    sequence_pool,
+    sequence_reshape,
+    sequence_reverse,
+    sequence_scatter,
+    sequence_slice,
+    sequence_softmax,
+    sequence_unpad,
+)
+
+__all__ = [
+    'fc', 'batch_norm', 'bilinear_tensor_product', 'embedding', 'case',
+    'cond', 'conv2d', 'conv2d_transpose', 'conv3d', 'conv3d_transpose',
+    'data_norm', 'deform_conv2d', 'group_norm', 'instance_norm',
+    'layer_norm', 'nce', 'prelu', 'py_func', 'row_conv', 'spectral_norm',
+    'switch_case', 'while_loop', 'sparse_embedding', 'sequence_conv',
+    'sequence_softmax', 'sequence_pool', 'sequence_concat',
+    'sequence_first_step', 'sequence_last_step', 'sequence_slice',
+    'sequence_expand', 'sequence_expand_as', 'sequence_pad',
+    'sequence_unpad', 'sequence_reshape', 'sequence_scatter',
+    'sequence_enumerate', 'sequence_reverse', 'StaticRNN',
+]
+
+
+def _act(out, act):
+    if act:
+        from ... import nn
+
+        return getattr(nn.functional, act)(out)
+    return out
+
+
+# ---------------- parameterized builders ----------------
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None, activation=None, name=None):
+    """Fully connected (reference static/nn/common.py fc): flattens trailing
+    dims past num_flatten_dims, fresh weights per call."""
+    from ... import nn
+
+    xs = [as_tensor(v) for v in (x if isinstance(x, (list, tuple)) else [x])]
+    outs = None
+    for v in xs:
+        in_f = int(np.prod(v.shape[num_flatten_dims:]))
+        lin = nn.Linear(in_f, size, weight_attr=weight_attr, bias_attr=bias_attr)
+        flat = v.reshape(list(v.shape[:num_flatten_dims]) + [in_f])
+        o = lin(flat)
+        outs = o if outs is None else outs + o
+    return _act(outs, activation)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    from ... import nn
+
+    emb = nn.Embedding(size[0], size[1], padding_idx=padding_idx, weight_attr=param_attr)
+    return emb(as_tensor(input))
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False, entry=None,
+                     table_class="MemorySparseTable", param_attr=None, dtype="float32", name=None):
+    """PS-backed embedding in the reference (the_one_ps); dense table here —
+    the distributed/ps package provides the server-side analog."""
+    return embedding(input, size, padding_idx=padding_idx, param_attr=param_attr, dtype=dtype)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True, act=None,
+           name=None, data_format="NCHW"):
+    from ... import nn
+
+    conv = nn.Conv2D(input.shape[1] if data_format == "NCHW" else input.shape[-1],
+                     num_filters, filter_size, stride=stride, padding=padding,
+                     dilation=dilation, groups=groups, weight_attr=param_attr,
+                     bias_attr=bias_attr, data_format=data_format)
+    return _act(conv(as_tensor(input)), act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True, act=None,
+           name=None, data_format="NCDHW"):
+    from ... import nn
+
+    conv = nn.Conv3D(input.shape[1], num_filters, filter_size, stride=stride,
+                     padding=padding, dilation=dilation, groups=groups,
+                     weight_attr=param_attr, bias_attr=bias_attr)
+    return _act(conv(as_tensor(input)), act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1, param_attr=None,
+                     bias_attr=None, use_cudnn=True, act=None, name=None,
+                     data_format="NCHW"):
+    from ... import nn
+
+    conv = nn.Conv2DTranspose(input.shape[1], num_filters, filter_size,
+                              stride=stride, padding=padding, dilation=dilation,
+                              groups=groups, weight_attr=param_attr, bias_attr=bias_attr)
+    out = conv(as_tensor(input), output_size=output_size) if output_size is not None else conv(as_tensor(input))
+    return _act(out, act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1, param_attr=None,
+                     bias_attr=None, use_cudnn=True, act=None, name=None,
+                     data_format="NCDHW"):
+    from ... import nn
+
+    conv = nn.Conv3DTranspose(input.shape[1], num_filters, filter_size,
+                              stride=stride, padding=padding, dilation=dilation,
+                              groups=groups, weight_attr=param_attr, bias_attr=bias_attr)
+    return _act(conv(as_tensor(input)), act)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW", name=None,
+               moving_mean_name=None, moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    from ... import nn
+
+    C = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    bn = nn.BatchNorm(C, momentum=momentum, epsilon=epsilon, weight_attr=param_attr,
+                      bias_attr=bias_attr, data_format=data_layout,
+                      use_global_stats=use_global_stats)
+    if is_test:
+        bn.eval()
+    return _act(bn(as_tensor(input)), act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
+               param_attr=None, bias_attr=None, act=None, name=None):
+    from ... import nn
+
+    shape = list(input.shape[begin_norm_axis:])
+    ln = nn.LayerNorm(shape, epsilon=epsilon,
+                      weight_attr=param_attr if scale else False,
+                      bias_attr=bias_attr if shift else False)
+    return _act(ln(as_tensor(input)), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None, name=None):
+    from ... import nn
+
+    inorm = nn.InstanceNorm2D(input.shape[1], epsilon=epsilon,
+                              weight_attr=param_attr, bias_attr=bias_attr)
+    return inorm(as_tensor(input))
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    from ... import nn
+
+    gn = nn.GroupNorm(groups, input.shape[1], epsilon=epsilon,
+                      weight_attr=param_attr, bias_attr=bias_attr)
+    return _act(gn(as_tensor(input)), act)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, data_layout="NCHW",
+              in_place=False, name=None, moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1, sync_stats=False,
+              summary_decay_rate=0.9999999, enable_scale_and_shift=False):
+    """Summary-statistics normalization (data_norm_op.cc): learned batch
+    (size, sum, square_sum) accumulators normalize without batch coupling."""
+    from ... import nn
+
+    input = as_tensor(input)
+    C = input.shape[-1]
+    layer = nn.Layer()
+    bsize = layer.create_parameter([C], default_initializer=nn.initializer.Constant(1e4))
+    bsum = layer.create_parameter([C], default_initializer=nn.initializer.Constant(0.0))
+    bsq = layer.create_parameter([C], default_initializer=nn.initializer.Constant(1e4))
+
+    def f(x, n, s, sq):
+        mean = s / n
+        scale = jnp.sqrt(n / jnp.maximum(sq - s * s / n, epsilon))
+        return (x - mean) * scale
+
+    return _act(apply("data_norm", f, input, bsize, bsum, bsq), act)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None, bias_attr=None):
+    from ... import nn
+
+    bl = nn.Bilinear(x.shape[-1], y.shape[-1], size, weight_attr=param_attr, bias_attr=bias_attr)
+    return _act(bl(as_tensor(x), as_tensor(y)), act)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    """Channel/element/all-shared learned negative slope (prelu op)."""
+    from ... import nn
+
+    x = as_tensor(x)
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [x.shape[1] if data_format == "NCHW" else x.shape[-1]]
+    elif mode == "element":
+        shape = list(x.shape[1:])
+    else:
+        raise ValueError(f"mode must be all|channel|element, got {mode!r}")
+    layer = nn.Layer()
+    alpha = layer.create_parameter(shape, default_initializer=nn.initializer.Constant(0.25))
+
+    def f(v, a):
+        if mode == "channel" and data_format == "NCHW":
+            a = a.reshape((1, -1) + (1,) * (v.ndim - 2))
+        return jnp.where(v >= 0, v, a * v)
+
+    return apply("prelu", f, x, alpha)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (row_conv_op.cc): out[t] = sum_{i<=k}
+    w[i] * x[t+i] over a [k+1, D] filter."""
+    from ... import nn
+
+    input = as_tensor(input)
+    D = input.shape[-1]
+    k = future_context_size
+    layer = nn.Layer()
+    w = layer.create_parameter([k + 1, D])
+
+    def f(x, wv):
+        T = x.shape[1]
+        t = jnp.arange(T)[:, None] + jnp.arange(k + 1)[None, :]
+        valid = t < T
+        idx = jnp.clip(t, 0, T - 1)
+        win = x[:, idx]  # [B, T, k+1, D]
+        win = win * valid[None, :, :, None].astype(x.dtype)
+        return jnp.einsum("btkd,kd->btd", win.astype(jnp.float32), wv.astype(jnp.float32)).astype(x.dtype)
+
+    return _act(apply("row_conv", f, input, w), act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ... import nn
+
+    sn = nn.SpectralNorm(weight.shape, dim=dim, power_iters=power_iters, epsilon=eps)
+    return sn(as_tensor(weight))
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (nce_op.cc): logistic loss on the
+    true class + uniformly sampled noise classes."""
+    from ... import nn
+    from ...core import random as _random
+
+    input, label = as_tensor(input), as_tensor(label)
+    D = input.shape[-1]
+    k = num_neg_samples or 5
+    layer = nn.Layer()
+    w = layer.create_parameter([num_total_classes, D])
+    b = layer.create_parameter([num_total_classes], is_bias=True)
+
+    def f(x, y, wv, bv):
+        B = x.shape[0]
+        key = _random.next_key()
+        noise = jax.random.randint(key, (B, k), 0, num_total_classes)
+        yv = y.reshape(-1).astype(jnp.int32)
+        pos = jnp.einsum("bd,bd->b", x, wv[yv]) + bv[yv]
+        neg = jnp.einsum("bd,bkd->bk", x, wv[noise]) + bv[noise]
+        loss = jax.nn.softplus(-pos) + jax.nn.softplus(neg).sum(-1)
+        return loss.reshape(-1, 1)
+
+    return apply("nce", f, input, label, w, b)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ... import nn
+    from ...vision.ops import deform_conv2d as _dc
+
+    C = input.shape[1]
+    ks = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size, filter_size)
+    layer = nn.Layer()
+    weight = layer.create_parameter([num_filters, C // groups, ks[0], ks[1]])
+    bias = layer.create_parameter([num_filters], is_bias=True) if bias_attr is not False else None
+    return _dc(as_tensor(input), as_tensor(offset), weight, bias=bias, stride=stride,
+               padding=padding, dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=as_tensor(mask) if mask is not None else None)
+
+
+# ---------------- control flow ----------------
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Two-branch conditional (reference control_flow.py cond): traced pred
+    runs both branches and selects leaf-wise; concrete pred runs one."""
+    from ...jit.dy2static import convert_ifelse
+
+    t_fn = true_fn if true_fn is not None else (lambda: None)
+    f_fn = false_fn if false_fn is not None else (lambda: None)
+
+    def norm(fn):
+        def run(_vars):
+            out = fn()
+            leaves = out if isinstance(out, (list, tuple)) else (out,)
+            return tuple(leaves)
+        return run
+
+    res = convert_ifelse(pred, norm(t_fn), norm(f_fn), (), names=())
+    if len(res) == 1:
+        return res[0]
+    return list(res)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Chained conditionals (reference case): first true pred wins."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if not rest:
+        return cond(pred, fn, default if default is not None else fn)
+    return cond(pred, fn, lambda: case(rest, default))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Indexed dispatch (reference switch_case): lax.switch when traced."""
+    from ...jit.dy2static import _is_traced, _raw
+
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    idx = as_tensor(branch_index) if not isinstance(branch_index, (int, np.integer)) else branch_index
+    raw = _raw(idx) if isinstance(idx, Tensor) else idx
+    if isinstance(raw, jax.core.Tracer):
+        if default is not None:
+            fns = fns + [default]
+        # map branch_index -> dense position (keys may be sparse)
+        positions = jnp.full((max(keys) + 2,), len(fns) - 1, jnp.int32)
+        for pos, kk in enumerate(keys):
+            positions = positions.at[kk].set(pos)
+        sel = positions[jnp.clip(jnp.asarray(raw).astype(jnp.int32), 0, max(keys) + 1)]
+        outs = [f() for f in fns]
+        leaves = [o._value if isinstance(o, Tensor) else o for o in outs]
+        stacked = jnp.stack([jnp.asarray(l) for l in leaves])
+        return Tensor(stacked[sel])
+    key = int(raw)
+    for kk, f in items:
+        if kk == key:
+            return f()
+    if default is not None:
+        return default()
+    return fns[-1]()
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """reference while_loop: compiled lax.while_loop under a trace, python
+    loop eagerly (jit/dy2static convert_while)."""
+    from ...jit.dy2static import convert_while
+
+    n = len(loop_vars)
+
+    def body_wrap(vars_):
+        out = body(*vars_)
+        return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+    out = convert_while(lambda vars_: cond(*vars_), body_wrap, tuple(loop_vars),
+                        names=tuple(f"var{i}" for i in range(n)))
+    return list(out)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None, name=None):
+    """Host-python op (py_func_op.cc): eager calls func directly; under a
+    trace it becomes jax.pure_callback with `out` as the shape template."""
+    xs = [as_tensor(v) for v in (x if isinstance(x, (list, tuple)) else [x])]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._jdtype()) for o in outs]
+
+    def f(*vals):
+        def host(*np_vals):
+            res = func(*np_vals)
+            res = res if isinstance(res, (list, tuple)) else [res]
+            return tuple(np.asarray(r, dtype=s.dtype) for r, s in zip(res, shapes))
+
+        res = jax.pure_callback(host, tuple(shapes), *vals)
+        return res if len(res) > 1 else res[0]
+
+    return apply("py_func", f, *xs)
+
+
+class StaticRNN:
+    """Step-wise RNN builder (reference control_flow.py StaticRNN).
+
+    TPU re-design over the Program capture: the `with rnn.step():` block runs
+    once in static mode, recording its ops into the default Program;
+    step_input/memory hand out concrete per-step tensors (t=0 slice / init)
+    so the block executes normally; `rnn()` then replays exactly the
+    recorded node range per timestep with that step's slices and memories
+    fed in, stacking outputs on the time axis. Sequences are dense
+    [B, T, ...] (the LoD-free contract used across static.nn)."""
+
+    def __init__(self, name=None):
+        self._seq_inputs = []   # (placeholder Tensor, source Tensor)
+        self._memories = []     # (placeholder Tensor, init value)
+        self._mem_updates = {}  # id(placeholder) -> updated Tensor
+        self._step_outputs = []
+        self._range = None
+
+    def step(self):
+        from ...nn.layer import layers as _layers
+        from ..program import default_main_program
+
+        if _layers.in_dynamic_mode():
+            raise RuntimeError("StaticRNN requires paddle.enable_static()")
+        rnn = self
+        prog = default_main_program()
+
+        class _Guard:
+            def __enter__(self):
+                self._start = len(prog.nodes)
+                return rnn
+
+            def __exit__(self, *exc):
+                rnn._range = (self._start, len(prog.nodes))
+                return False
+
+        return _Guard()
+
+    def step_input(self, x):
+        x = as_tensor(x)
+        ph = Tensor(x._value[:, 0])
+        self._seq_inputs.append((ph, x))
+        return ph
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory needs init or (shape, batch_ref)")
+            B = batch_ref.shape[init_batch_dim_idx]
+            dims = tuple(d for d in shape if d not in (-1, None))
+            init = Tensor(jnp.full((B,) + dims, init_value, jnp.float32))
+        init = as_tensor(init)
+        ph = Tensor(init._value)
+        self._memories.append((ph, init._value))
+        return ph
+
+    def update_memory(self, mem, new_val):
+        self._mem_updates[id(mem)] = as_tensor(new_val)
+
+    def step_output(self, o):
+        self._step_outputs.append(as_tensor(o))
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        from ..program import _OpNode, default_main_program
+
+        if self._range is None:
+            raise RuntimeError("run the step block (`with rnn.step():`) first")
+        if not self._seq_inputs:
+            raise RuntimeError("StaticRNN needs at least one step_input")
+        prog = default_main_program()
+        nodes = [n for n in prog.nodes[self._range[0]: self._range[1]]
+                 if isinstance(n, _OpNode)]
+        T = self._seq_inputs[0][1].shape[1]
+        mems = {id(ph): val for ph, val in self._memories}
+        outs = []
+        for t in range(T):
+            env = {id(ph): src._value[:, t] for ph, src in self._seq_inputs}
+            env.update(mems)
+            for node in nodes:
+                vals = node.fn(*[env.get(tid, None) if env.get(tid) is not None
+                                 else prog.tensors[tid]._value for tid in node.in_ids])
+                for tid, leaf in zip(node.out_ids, jax.tree_util.tree_leaves(vals)):
+                    env[tid] = leaf
+            mems = {pid: env.get(id(new), new._value)
+                    for pid, new in ((id(ph), self._mem_updates.get(id(ph)))
+                                     for ph, _ in self._memories) if new is not None}
+            for ph, init in self._memories:
+                mems.setdefault(id(ph), env[id(ph)])
+            outs.append([env.get(id(o), o._value) for o in self._step_outputs])
+        stacked = [Tensor(jnp.stack([step[i] for step in outs], axis=1))
+                   for i in range(len(self._step_outputs))]
+        return stacked[0] if len(stacked) == 1 else stacked

@@ -1,0 +1,263 @@
+"""Sequence ops (reference fluid/operators/sequence_ops/*, exposed via
+python/paddle/static/nn/sequence_lod.py).
+
+TPU re-design: the reference's LoD (level-of-detail) ragged tensors become
+dense padded [B, T, ...] arrays + explicit per-row `length` vectors — the
+same migration newer paddle made. Everything here is static-shape and
+traces/compiles except the pack/unpack pair (sequence_pad/sequence_unpad
+with packed inputs), whose output shapes depend on data and therefore run
+eagerly on concrete lengths.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...ops._dispatch import apply, as_tensor
+
+__all__ = [
+    "sequence_conv", "sequence_softmax", "sequence_pool", "sequence_concat",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "sequence_expand", "sequence_expand_as", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_scatter",
+    "sequence_enumerate", "sequence_reverse",
+]
+
+NEG_INF = -1e30
+
+
+def _mask(T, length, B):
+    """[B, T] validity mask from per-row lengths (None -> all valid)."""
+    if length is None:
+        return jnp.ones((B, T), bool)
+    t = jnp.arange(T)[None, :]
+    return t < jnp.asarray(length).reshape(-1, 1)
+
+
+def sequence_softmax(input, length=None, name=None):
+    """Masked softmax over the time axis (sequence_softmax_op.cc)."""
+    input = as_tensor(input)
+
+    def f(x, *rest):
+        m = _mask(x.shape[1], rest[0] if rest else None, x.shape[0])
+        while m.ndim < x.ndim:
+            m = m[..., None]
+        z = jnp.where(m, x.astype(jnp.float32), NEG_INF)
+        return jax.nn.softmax(z, axis=1).astype(x.dtype) * m.astype(x.dtype)
+
+    args = (input,) if length is None else (input, as_tensor(length))
+    return apply("sequence_softmax", f, *args)
+
+
+def sequence_pool(input, pool_type: str, length=None, pad_value: float = 0.0, name=None):
+    """sum/average/sqrt/max/min/first/last over valid timesteps
+    (sequence_pool_op.cc)."""
+    input = as_tensor(input)
+    pool_type = pool_type.lower()
+
+    def f(x, *rest):
+        B, T = x.shape[0], x.shape[1]
+        ln = rest[0] if rest else None
+        m = _mask(T, ln, B)
+        while m.ndim < x.ndim:
+            m = m[..., None]
+        xf = x.astype(jnp.float32)
+        n = jnp.maximum(m.sum(axis=1), 1)
+        if pool_type == "sum":
+            out = jnp.where(m, xf, 0).sum(axis=1)
+        elif pool_type == "average":
+            out = jnp.where(m, xf, 0).sum(axis=1) / n
+        elif pool_type == "sqrt":
+            out = jnp.where(m, xf, 0).sum(axis=1) / jnp.sqrt(n.astype(jnp.float32))
+        elif pool_type == "max":
+            out = jnp.where(m, xf, NEG_INF).max(axis=1)
+        elif pool_type == "min":
+            out = jnp.where(m, xf, -NEG_INF).min(axis=1)
+        elif pool_type == "first":
+            out = xf[:, 0]
+        elif pool_type == "last":
+            idx = (jnp.asarray(ln).reshape(-1) - 1 if ln is not None
+                   else jnp.full((B,), T - 1))
+            out = jnp.take_along_axis(
+                xf, idx.reshape(-1, *([1] * (x.ndim - 1))).astype(jnp.int32), axis=1
+            )[:, 0]
+        else:
+            raise ValueError(f"unknown pool_type {pool_type!r}")
+        if ln is not None and pool_type in ("max", "min", "first", "last"):
+            empty = (jnp.asarray(ln).reshape(-1, *([1] * (out.ndim - 1))) == 0)
+            out = jnp.where(empty, pad_value, out)
+        return out.astype(x.dtype)
+
+    args = (input,) if length is None else (input, as_tensor(length))
+    return apply(f"sequence_pool_{pool_type}", f, *args)
+
+
+def sequence_first_step(input, length=None, name=None):
+    return sequence_pool(input, "first", length=length)
+
+
+def sequence_last_step(input, length=None, name=None):
+    return sequence_pool(input, "last", length=length)
+
+
+def sequence_concat(input, name=None):
+    """Concatenate along time (sequence_concat_op.cc)."""
+    tensors = [as_tensor(t) for t in input]
+    return apply("sequence_concat", lambda *xs: jnp.concatenate(xs, axis=1), *tensors)
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-row [offset, offset+length) time slice, zero-padded to max(length)
+    (sequence_slice_op.cc). Static output length = max over the batch."""
+    input, offset, length = as_tensor(input), as_tensor(offset), as_tensor(length)
+    out_T = int(np.max(np.asarray(length._value)))
+
+    def f(x, off, ln):
+        off = off.reshape(-1, 1)
+        ln = ln.reshape(-1, 1)
+        t = jnp.arange(out_T)[None, :]
+        idx = jnp.clip(off + t, 0, x.shape[1] - 1).astype(jnp.int32)
+        shaped = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+        out = jnp.take_along_axis(x, jnp.broadcast_to(shaped, (x.shape[0], out_T) + x.shape[2:]), axis=1)
+        m = (t < ln)
+        while m.ndim < out.ndim:
+            m = m[..., None]
+        return out * m.astype(out.dtype)
+
+    return apply("sequence_slice", f, input, offset, length)
+
+
+def sequence_expand(x, y_lengths, ref_level=0, name=None):
+    """Repeat row i of x y_lengths[i] times (sequence_expand_op.cc done on
+    dense rows). Output row count depends on data -> eager with concrete
+    lengths."""
+    x = as_tensor(x)
+    reps = np.asarray(as_tensor(y_lengths)._value).astype(np.int64)
+    return apply("sequence_expand", lambda v: jnp.repeat(v, jnp.asarray(reps), axis=0,
+                                                         total_repeat_length=int(reps.sum())), x)
+
+
+def sequence_expand_as(x, y, name=None):
+    """Expand x's rows to match y's row count (sequence_expand_as_op.cc):
+    each of x's N rows repeats rows(y)/N times."""
+    x, y = as_tensor(x), as_tensor(y)
+    n, m = x.shape[0], y.shape[0]
+    if m % n:
+        raise ValueError(f"cannot expand {n} rows as {m} rows")
+    return apply("sequence_expand_as", lambda v: jnp.repeat(v, m // n, axis=0), x)
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """Packed [sum_T, D] + lengths -> (padded [B, maxT, D], lengths)
+    (sequence_pad_op.cc). Eager: output shape depends on lengths."""
+    x = as_tensor(x)
+    if length is None:
+        raise ValueError("sequence_pad needs per-sequence `length`")
+    lens = np.asarray(as_tensor(length)._value).astype(np.int64)
+    T = int(maxlen) if maxlen else int(lens.max())
+    pv = float(np.asarray(as_tensor(pad_value)._value).reshape(-1)[0])
+    starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
+
+    def f(v):
+        rows = []
+        for s, ln in zip(starts, lens):
+            seg = v[int(s): int(s + min(ln, T))]
+            pad = [(0, T - seg.shape[0])] + [(0, 0)] * (v.ndim - 1)
+            rows.append(jnp.pad(seg, pad, constant_values=pv))
+        return jnp.stack(rows)
+
+    return apply("sequence_pad", f, x), Tensor(jnp.asarray(lens))
+
+
+def sequence_unpad(x, length, name=None):
+    """Inverse of sequence_pad: [B, T, D] + lengths -> packed [sum_T, D]
+    (sequence_unpad_op.cc). Eager: output rows depend on lengths."""
+    x = as_tensor(x)
+    lens = np.asarray(as_tensor(length)._value).astype(np.int64)
+
+    def f(v):
+        return jnp.concatenate([v[i, : int(ln)] for i, ln in enumerate(lens)], axis=0)
+
+    return apply("sequence_unpad", f, x)
+
+
+def sequence_reshape(input, new_dim: int, name=None):
+    """Re-chunk the trailing dim (sequence_reshape_op.cc): [N, D] ->
+    [N*D/new_dim, new_dim]."""
+    input = as_tensor(input)
+    return apply("sequence_reshape", lambda v: v.reshape(-1, new_dim), input)
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """x[index[i]] += updates[i] (sequence_scatter_op.cc)."""
+    input, index, updates = as_tensor(input), as_tensor(index), as_tensor(updates)
+    return apply("sequence_scatter",
+                 lambda x, i, u: x.at[i.astype(jnp.int32)].add(u.astype(x.dtype)),
+                 input, index, updates)
+
+
+def sequence_enumerate(input, win_size: int, pad_value: int = 0, name=None):
+    """Sliding windows of ids (sequence_enumerate_op.cc): [B, T] ->
+    [B, T, win_size], windows past the end fill pad_value."""
+    input = as_tensor(input)
+
+    def f(x):
+        T = x.shape[-1]
+        t = jnp.arange(T)[:, None] + jnp.arange(win_size)[None, :]
+        valid = t < T
+        idx = jnp.clip(t, 0, T - 1)
+        win = jnp.take(x, idx, axis=-1)
+        return jnp.where(valid, win, pad_value)
+
+    return apply("sequence_enumerate", f, input)
+
+
+def sequence_reverse(x, length=None, name=None):
+    """Reverse each row's valid prefix, padding stays in place
+    (sequence_reverse_op.cc)."""
+    x = as_tensor(x)
+
+    def f(v, *rest):
+        B, T = v.shape[0], v.shape[1]
+        ln = (rest[0].reshape(-1, 1).astype(jnp.int32) if rest
+              else jnp.full((B, 1), T, jnp.int32))
+        t = jnp.arange(T)[None, :]
+        idx = jnp.where(t < ln, ln - 1 - t, t).astype(jnp.int32)
+        shaped = idx.reshape(idx.shape + (1,) * (v.ndim - 2))
+        return jnp.take_along_axis(v, jnp.broadcast_to(shaped, (B, T) + v.shape[2:]), axis=1)
+
+    args = (x,) if length is None else (x, as_tensor(length))
+    return apply("sequence_reverse", f, *args)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, length=None, name=None):
+    """Context-window projection (sequence_conv_op.cc): each timestep's
+    output = flatten(window of filter_size steps) @ W. Dense re-design via
+    gather + one matmul (im2col rides the MXU)."""
+    from ... import nn
+
+    input = as_tensor(input)
+    D = input.shape[-1]
+    lin = nn.Linear(filter_size * D, num_filters,
+                    bias_attr=bias_attr if bias_attr is not None else True)
+    start = padding_start if padding_start is not None else -((filter_size - 1) // 2)
+
+    def f(x):
+        B, T = x.shape[0], x.shape[1]
+        t = jnp.arange(T)[:, None] + jnp.arange(filter_size)[None, :] + start
+        valid = (t >= 0) & (t < T)
+        idx = jnp.clip(t, 0, T - 1)
+        win = x[:, idx]  # [B, T, filter_size, D]
+        win = win * valid[None, :, :, None].astype(x.dtype)
+        return win.reshape(B, T, filter_size * D)
+
+    windows = apply("sequence_conv_im2col", f, input)
+    out = lin(windows)
+    if act:
+        out = getattr(nn.functional, act)(out)
+    return out

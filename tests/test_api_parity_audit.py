@@ -44,6 +44,10 @@ CHECKS = [
     ("profiler/__init__.py", "paddle_tpu.profiler"),
     ("utils/__init__.py", "paddle_tpu.utils"),
     ("incubate/__init__.py", "paddle_tpu.incubate"),
+    ("static/nn/__init__.py", "paddle_tpu.static.nn"),
+    ("distribution/transform.py", "paddle_tpu.distribution.transform"),
+    ("nn/initializer/__init__.py", "paddle_tpu.nn.initializer"),
+    ("incubate/nn/__init__.py", "paddle_tpu.incubate.nn"),
 ]
 
 

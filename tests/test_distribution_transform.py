@@ -181,3 +181,18 @@ def test_affine_higher_rank_scale_ldj():
 def test_abs_forward_ldj_raises():
     with pytest.raises(NotImplementedError, match="not injective"):
         D.AbsTransform().forward_log_det_jacobian(_t([1.0]))
+
+
+def test_transformed_distribution_shapes():
+    """event/batch shapes reflect the TRANSFORMED variable (chain
+    forward_shape split by the output event rank)."""
+    base = D.Independent(D.Normal(_t(np.zeros(5, np.float32)), _t(np.ones(5, np.float32))), 1)
+    td = D.TransformedDistribution(base, [D.StickBreakingTransform()])
+    assert tuple(td.event_shape) == (6,)
+    assert td.sample().numpy().shape == (6,)
+
+
+def test_transformed_distribution_rank_guard():
+    with pytest.raises(ValueError, match="event rank"):
+        D.TransformedDistribution(D.Normal(_t(0.0), _t(1.0)),
+                                  [D.ReshapeTransform((2, 3), (6,))])

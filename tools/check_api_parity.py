@@ -11,6 +11,26 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def run_call_smoke() -> int:
+    """Call-level smoke: invoke each table entry; an exception = a name that
+    exists but is broken glue (hasattr parity can't see it)."""
+    from api_smoke_table import build_table
+
+    table = build_table()
+    failed = []
+    for key, thunk in table.items():
+        try:
+            out = thunk()
+            if out is None:
+                raise ValueError("returned None")
+        except Exception as e:  # noqa: BLE001 — report every breakage
+            failed.append((key, f"{type(e).__name__}: {e}"))
+    for key, err in failed:
+        print(f"CALL-FAIL {key}: {err}")
+    print(f"call smoke: {len(table) - len(failed)}/{len(table)} ok")
+    return len(failed)
+
+
 def main():
     import importlib
 
@@ -33,6 +53,9 @@ def main():
             total += len(missing)
             print(f"{modname}: missing {missing}")
     print(f"total missing: {total}")
+    if "--call" in sys.argv or "--all" in sys.argv:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        total += run_call_smoke()
     return 1 if total else 0
 
 
