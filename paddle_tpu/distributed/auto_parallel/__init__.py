@@ -11,6 +11,8 @@ from .process_mesh import ProcessMesh, get_current_process_mesh
 from .interface import shard_tensor, shard_op, recompute, fetch
 from .strategy import Strategy
 from .engine import Engine
+from .cost import ClusterSpec, CostBreakdown, CostModel, ModelSpec, TrainConfig
+from .planner import Plan, Planner, plan_mesh
 from .dist_attribute import DistAttr, TensorDistAttr
 
 __all__ = [
@@ -24,4 +26,12 @@ __all__ = [
     "Engine",
     "DistAttr",
     "TensorDistAttr",
+    "ClusterSpec",
+    "CostBreakdown",
+    "CostModel",
+    "ModelSpec",
+    "TrainConfig",
+    "Plan",
+    "Planner",
+    "plan_mesh",
 ]

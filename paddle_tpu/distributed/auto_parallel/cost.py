@@ -1,0 +1,199 @@
+"""Comm + compute cost model (reference auto_parallel/cost/: comm_op_cost.py,
+comp_op_cost.py, estimate_cost — SURVEY §2.6 planner/tuner/cost row).
+
+TPU re-design: instead of per-op cost classes fed by profiled tables, the
+model is an analytic transformer-step estimator over a ClusterSpec of chip
+peak FLOPs + ICI/DCN bandwidths. It prices the four hybrid axes:
+
+- mp  (tensor parallel): 2 activation all-reduces per block over mp links
+- dp  (data parallel):   one grad all-reduce (bucketed, overlappable)
+- sharding (ZeRO):       reduce-scatter grads + all-gather params
+- pp  (pipeline):        bubble fraction (pp-1)/(M+pp-1) on compute
+- sep (context):         ring/all-to-all activation exchange per block
+
+plus an HBM footprint estimate (params, optimizer moments, activations
+under remat) used as a hard feasibility filter.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+__all__ = ["ClusterSpec", "ModelSpec", "TrainConfig", "CostModel", "CostBreakdown"]
+
+
+@dataclass
+class ClusterSpec:
+    """Hardware description (reference cluster.py Cluster analog)."""
+
+    n_devices: int = 8
+    peak_flops: float = 197e12          # bf16 MXU peak per chip (v5e)
+    hbm_bytes: float = 16e9             # per chip (v5e: 16 GB)
+    ici_bandwidth: float = 180e9        # bytes/s per chip all-links (v5e ring)
+    dcn_bandwidth: float = 25e9         # bytes/s per host across slices
+    ici_devices: Optional[int] = None   # devices within one ICI domain (None = all)
+    mfu: float = 0.55                   # achievable fraction of peak (measured)
+
+    def bandwidth(self, group_size: int) -> float:
+        """Bandwidth for a collective spanning group_size devices: ICI inside
+        a slice, DCN across."""
+        if self.ici_devices is not None and group_size > self.ici_devices:
+            return self.dcn_bandwidth
+        return self.ici_bandwidth
+
+
+@dataclass
+class ModelSpec:
+    """Decoder-only transformer description (the GPT family the planner
+    serves; reference parallel_tuner works off the serial program instead)."""
+
+    hidden: int
+    layers: int
+    heads: int
+    vocab: int
+    seq: int
+    intermediate: Optional[int] = None
+    param_bytes: int = 4                # f32 master params
+    act_bytes: int = 2                  # bf16 activations
+
+    def __post_init__(self):
+        if self.intermediate is None:
+            self.intermediate = 4 * self.hidden
+
+    @property
+    def n_params(self) -> float:
+        h, l = self.hidden, self.layers
+        block = 4 * h * h + 2 * h * self.intermediate + 4 * h
+        return l * block + self.vocab * h + self.seq * h + 2 * h
+
+    def flops_per_token(self) -> float:
+        # 6N + attention term (2 * 2 * S * h per layer fwd, x3 with bwd)
+        return 6 * self.n_params + 12 * self.layers * self.hidden * self.seq
+
+
+@dataclass
+class TrainConfig:
+    batch: int                  # global batch (sequences)
+    accumulate_steps: int = 1   # microbatches (pp) / grad accumulation
+    remat: bool = True
+    zero_stage: int = 0         # 0/1/2 shard opt state, 3 shard params
+    moment_bytes: int = 4       # optimizer moment precision
+
+
+@dataclass
+class CostBreakdown:
+    compute: float = 0.0
+    mp_comm: float = 0.0
+    dp_comm: float = 0.0
+    sharding_comm: float = 0.0
+    sep_comm: float = 0.0
+    pp_bubble: float = 0.0
+    memory_bytes: float = 0.0
+    feasible: bool = True
+    reason: str = ""
+    extras: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def total_time(self) -> float:
+        if not self.feasible:
+            return float("inf")
+        # dp grad sync overlaps backward compute on TPU (async collectives):
+        # charge only the non-overlappable half
+        return (self.compute + self.pp_bubble + self.mp_comm
+                + self.sharding_comm + self.sep_comm + 0.5 * self.dp_comm)
+
+
+class CostModel:
+    """Estimate one training step's time/memory for a hybrid factorization
+    (the estimate_cost role of reference auto_parallel/cost)."""
+
+    def __init__(self, cluster: ClusterSpec, model: ModelSpec, train: TrainConfig):
+        self.cluster = cluster
+        self.model = model
+        self.train = train
+
+    def _divisible(self, dp, pp, sharding, mp, sep) -> Optional[str]:
+        m, t = self.model, self.train
+        world = dp * pp * sharding * mp * sep
+        if world != self.cluster.n_devices:
+            return f"axes product {world} != devices {self.cluster.n_devices}"
+        if sharding > 1 and t.zero_stage == 0:
+            return "sharding axis needs zero_stage >= 1"
+        if m.layers % pp:
+            return f"layers {m.layers} % pp {pp}"
+        if m.heads % (mp * sep) if sep > 1 else m.heads % mp:
+            return f"heads {m.heads} not divisible by mp{'*sep' if sep > 1 else ''}"
+        if m.vocab % mp:
+            return f"vocab {m.vocab} % mp {mp}"
+        if t.batch % (dp * sharding * max(t.accumulate_steps, 1)):
+            return f"batch {t.batch} % (dp*sharding*accum)"
+        if m.seq % sep:
+            return f"seq {m.seq} % sep {sep}"
+        return None
+
+    def memory(self, dp, pp, sharding, mp, sep) -> float:
+        """Per-chip HBM: params + grads + moments (sharded per config) +
+        activations for one microbatch's live set."""
+        m, t = self.model, self.train
+        p_total = m.n_params
+        param_shard = mp * pp * (sharding if t.zero_stage >= 3 else 1)
+        grad_shard = mp * pp * (sharding if t.zero_stage >= 2 else 1)
+        state_shard = mp * pp * (sharding if t.zero_stage >= 1 else 1)
+        mem = p_total * m.param_bytes / param_shard
+        mem += p_total * m.param_bytes / grad_shard
+        mem += 2 * p_total * t.moment_bytes / state_shard
+        # activations: microbatch per data rank (dp x sharding both carry
+        # data); with remat only the residual stream per block survives
+        # (~2 tensors of [mb, S/sep, H]), else ~16
+        mb = t.batch // (dp * sharding * max(t.accumulate_steps, 1))
+        per_block = mb * (m.seq // sep) * m.hidden * m.act_bytes / mp
+        live_blocks = (m.layers // pp)
+        factor = 2 if t.remat else 16
+        mem += factor * per_block * live_blocks
+        # logits chunk / embedding working set
+        mem += mb * (m.seq // sep) * max(m.vocab // mp // 8, m.hidden) * 4
+        return mem
+
+    def cost(self, dp=1, pp=1, sharding=1, mp=1, sep=1) -> CostBreakdown:
+        cl, m, t = self.cluster, self.model, self.train
+        why = self._divisible(dp, pp, sharding, mp, sep)
+        if why:
+            return CostBreakdown(feasible=False, reason=why)
+        bd = CostBreakdown()
+        tokens = t.batch * m.seq
+        bd.compute = (m.flops_per_token() * tokens
+                      / (cl.n_devices * cl.peak_flops * cl.mfu))
+
+        # pp bubble: GPipe fraction over M microbatches, fwd+bwd both bubble
+        M = max(t.accumulate_steps, 1)
+        if pp > 1:
+            bd.pp_bubble = bd.compute * (pp - 1) / (M + pp - 1)
+
+        data_deg = dp * sharding  # both axes shard the batch (ZeRO = dp
+        #                           with sharded states, GroupSharded semantics)
+        mb_tokens = tokens / data_deg / M
+        act_bytes_block = mb_tokens / sep * m.hidden * m.act_bytes
+        if mp > 1:
+            # 2 all-reduces per block fwd + 2 bwd over the mp group
+            per_ar = 2 * act_bytes_block * (mp - 1) / mp / cl.bandwidth(mp)
+            bd.mp_comm = 4 * m.layers / pp * per_ar * M
+        if sep > 1:
+            # ring attention: K+V circulate the full ring per block
+            per_ring = 2 * act_bytes_block * (sep - 1) / sep / cl.bandwidth(sep)
+            bd.sep_comm = 2 * m.layers / pp * per_ring * M
+        p_shard_bytes = m.n_params * m.param_bytes / (mp * pp)
+        if data_deg > 1:
+            # grad sync across the combined data axes (reduce-scatter +
+            # all-gather under ZeRO collapses to the same byte volume)
+            bd.dp_comm = 2 * p_shard_bytes * (data_deg - 1) / data_deg / cl.bandwidth(data_deg)
+        if sharding > 1 and t.zero_stage >= 3:
+            # stage-3 re-gathers params on use each microbatch
+            bd.sharding_comm = (p_shard_bytes * (sharding - 1) / sharding
+                                / cl.bandwidth(sharding) * M)
+
+        bd.memory_bytes = self.memory(dp, pp, sharding, mp, sep)
+        if bd.memory_bytes > cl.hbm_bytes:
+            bd.feasible = False
+            bd.reason = f"HBM {bd.memory_bytes/1e9:.1f} GB > {cl.hbm_bytes/1e9:.1f} GB"
+        return bd

@@ -1,0 +1,93 @@
+"""Mesh planner (reference auto_parallel/tuner/parallel_tuner.py +
+rule_based_tuner.py): search hybrid factorizations with the cost model and
+return the best feasible plan.
+
+Replaces hand-picked / divisibility-heuristic dp-mp-pp splits: enumerate
+every factorization of the device count over (dp, pp, sharding, mp[, sep]),
+price each with CostModel, and rank by estimated step time. The search space
+is tiny (divisor tuples of N), so exhaustive beats the reference's pruned
+MCMC search at TPU pod sizes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from .cost import ClusterSpec, CostBreakdown, CostModel, ModelSpec, TrainConfig
+
+__all__ = ["Plan", "Planner", "plan_mesh"]
+
+
+@dataclass
+class Plan:
+    dp: int
+    pp: int
+    sharding: int
+    mp: int
+    sep: int
+    cost: CostBreakdown
+
+    @property
+    def hybrid_configs(self) -> dict:
+        return {
+            "dp_degree": self.dp,
+            "pp_degree": self.pp,
+            "sharding_degree": self.sharding,
+            "mp_degree": self.mp,
+            "sep_degree": self.sep,
+        }
+
+    def __repr__(self):
+        c = self.cost
+        return (f"Plan(dp={self.dp} pp={self.pp} sharding={self.sharding} "
+                f"mp={self.mp} sep={self.sep} t={c.total_time*1e3:.2f}ms "
+                f"mem={c.memory_bytes/1e9:.1f}GB)")
+
+
+def _factorizations(n: int, axes: int) -> List[Tuple[int, ...]]:
+    if axes == 1:
+        return [(n,)]
+    out = []
+    for d in range(1, n + 1):
+        if n % d == 0:
+            out.extend((d,) + rest for rest in _factorizations(n // d, axes - 1))
+    return out
+
+
+class Planner:
+    """Exhaustive factorization search (tuner/parallel_tuner.py analog)."""
+
+    def __init__(self, cluster: ClusterSpec, model: ModelSpec, train: TrainConfig,
+                 enable_sep: bool = False, enable_sharding: bool = True):
+        self.cluster = cluster
+        self.model = model
+        self.train = train
+        self.enable_sep = enable_sep
+        self.enable_sharding = enable_sharding
+
+    def candidates(self) -> List[Plan]:
+        cm = CostModel(self.cluster, self.model, self.train)
+        plans = []
+        for dp, pp, sharding, mp, sep in _factorizations(self.cluster.n_devices, 5):
+            if not self.enable_sep and sep > 1:
+                continue
+            if not self.enable_sharding and sharding > 1:
+                continue
+            bd = cm.cost(dp=dp, pp=pp, sharding=sharding, mp=mp, sep=sep)
+            if bd.feasible:
+                plans.append(Plan(dp, pp, sharding, mp, sep, bd))
+        plans.sort(key=lambda p: p.cost.total_time)
+        return plans
+
+    def best(self) -> Optional[Plan]:
+        cands = self.candidates()
+        return cands[0] if cands else None
+
+
+def plan_mesh(model: ModelSpec, cluster: Optional[ClusterSpec] = None,
+              train: Optional[TrainConfig] = None, **kw) -> Optional[Plan]:
+    """One-call facade: best feasible hybrid plan for model on cluster."""
+    cluster = cluster or ClusterSpec()
+    train = train or TrainConfig(batch=max(cluster.n_devices, 8))
+    return Planner(cluster, model, train, **kw).best()

@@ -70,7 +70,9 @@ class ShardedTrainStep:
     """Holds device state (params, opt state) and the compiled step.
 
     step(batch) -> loss. Batch = (x, y) numpy/jax arrays; x sharded over the
-    dp axis on dim 0. `sync_to_model()` writes params back into the Layer.
+    data axes (dp AND sharding — the ZeRO axis is data parallelism with
+    sharded optimizer states, reference GroupSharded semantics) on dim 0.
+    `sync_to_model()` writes params back into the Layer.
     """
 
     def __init__(
@@ -79,7 +81,7 @@ class ShardedTrainStep:
         optimizer: Optimizer,
         loss_fn: Optional[Callable] = None,
         mesh: Optional[Mesh] = None,
-        batch_spec: P = P("dp"),
+        batch_spec: P = P(("dp", "sharding")),
         donate: bool = True,
         seed: int = 0,
         accumulate_steps: Optional[int] = None,

@@ -363,9 +363,22 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
             positions = positions.at[kk].set(pos)
         sel = positions[jnp.clip(jnp.asarray(raw).astype(jnp.int32), 0, max(keys) + 1)]
         outs = [f() for f in fns]
-        leaves = [o._value if isinstance(o, Tensor) else o for o in outs]
-        stacked = jnp.stack([jnp.asarray(l) for l in leaves])
-        return Tensor(stacked[sel])
+        flats = []
+        treedef0 = None
+        for o in outs:
+            leaves, treedef = jax.tree_util.tree_flatten(
+                o, is_leaf=lambda v: isinstance(v, Tensor))
+            if treedef0 is None:
+                treedef0 = treedef
+            elif treedef != treedef0:
+                raise ValueError(
+                    "switch_case branches must return the same structure "
+                    f"under a trace; got {treedef0} vs {treedef}")
+            flats.append([v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                          for v in leaves])
+        picked = [Tensor(jnp.stack(per_leaf)[sel])
+                  for per_leaf in zip(*flats)]
+        return jax.tree_util.tree_unflatten(treedef0, picked)
     key = int(raw)
     for kk, f in items:
         if kk == key:

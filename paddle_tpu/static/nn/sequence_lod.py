@@ -4,9 +4,10 @@ python/paddle/static/nn/sequence_lod.py).
 TPU re-design: the reference's LoD (level-of-detail) ragged tensors become
 dense padded [B, T, ...] arrays + explicit per-row `length` vectors — the
 same migration newer paddle made. Everything here is static-shape and
-traces/compiles except the pack/unpack pair (sequence_pad/sequence_unpad
-with packed inputs), whose output shapes depend on data and therefore run
-eagerly on concrete lengths.
+traces/compiles EXCEPT the ops whose OUTPUT SHAPE depends on the data and
+which therefore need concrete lengths (eager-only): sequence_pad,
+sequence_unpad, sequence_slice (out length = max requested), and
+sequence_expand (row count = sum of repeats).
 """
 
 from __future__ import annotations
@@ -111,7 +112,8 @@ def sequence_concat(input, name=None):
 
 def sequence_slice(input, offset, length, name=None):
     """Per-row [offset, offset+length) time slice, zero-padded to max(length)
-    (sequence_slice_op.cc). Static output length = max over the batch."""
+    (sequence_slice_op.cc). Static output length = max over the batch, so
+    `length` must be concrete (eager-only; see module docstring)."""
     input, offset, length = as_tensor(input), as_tensor(offset), as_tensor(length)
     out_T = int(np.max(np.asarray(length._value)))
 
@@ -132,8 +134,8 @@ def sequence_slice(input, offset, length, name=None):
 
 def sequence_expand(x, y_lengths, ref_level=0, name=None):
     """Repeat row i of x y_lengths[i] times (sequence_expand_op.cc done on
-    dense rows). Output row count depends on data -> eager with concrete
-    lengths."""
+    dense rows). Output row count depends on data -> eager-only with
+    concrete lengths (see module docstring)."""
     x = as_tensor(x)
     reps = np.asarray(as_tensor(y_lengths)._value).astype(np.int64)
     return apply("sequence_expand", lambda v: jnp.repeat(v, jnp.asarray(reps), axis=0,
