@@ -1,0 +1,145 @@
+// Graph table — the PS GNN slice (fluid/distributed/ps/table/
+// common_graph_table.h GraphTable analog): adjacency storage + uniform
+// neighbor sampling serving paddle_tpu.geometric's message-passing ops.
+//
+// TPU-first role: graph structure lives host-side (like the embedding
+// tables); workers ask for fixed-fanout neighbor samples, which arrive as
+// dense [n, k] index tensors ready for device gathers — the data-dependent
+// part (ragged adjacency walks) stays on the host, the math stays on chip.
+
+#include <algorithm>
+#include <cstdint>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kShards = 16;
+
+struct GShard {
+  std::mutex mu;
+  std::unordered_map<int64_t, std::vector<int64_t>> adj;
+};
+
+struct GraphTable {
+  GShard shards[kShards];
+
+  GShard& ShardFor(int64_t key) {
+    return shards[static_cast<uint64_t>(key) % kShards];
+  }
+};
+
+GraphTable* G(void* p) { return static_cast<GraphTable*>(p); }
+
+}  // namespace
+
+extern "C" {
+
+void* gt_create() { return new GraphTable(); }
+
+void gt_destroy(void* p) { delete G(p); }
+
+int32_t gt_add_edges(void* p, const int64_t* src, const int64_t* dst, int64_t n) {
+  GraphTable* g = G(p);
+  for (int64_t i = 0; i < n; ++i) {
+    GShard& s = g->ShardFor(src[i]);
+    std::lock_guard<std::mutex> lk(s.mu);
+    s.adj[src[i]].push_back(dst[i]);
+  }
+  return 0;
+}
+
+int64_t gt_num_nodes(void* p) {
+  GraphTable* g = G(p);
+  int64_t n = 0;
+  for (auto& s : g->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    n += static_cast<int64_t>(s.adj.size());
+  }
+  return n;
+}
+
+int64_t gt_degree(void* p, int64_t key) {
+  GraphTable* g = G(p);
+  GShard& s = g->ShardFor(key);
+  std::lock_guard<std::mutex> lk(s.mu);
+  auto it = s.adj.find(key);
+  return it == s.adj.end() ? 0 : static_cast<int64_t>(it->second.size());
+}
+
+// full neighbor list for one key into out (cap bounds); returns count
+int64_t gt_neighbors(void* p, int64_t key, int64_t* out, int64_t cap) {
+  GraphTable* g = G(p);
+  GShard& s = g->ShardFor(key);
+  std::lock_guard<std::mutex> lk(s.mu);
+  auto it = s.adj.find(key);
+  if (it == s.adj.end()) return 0;
+  int64_t n = std::min<int64_t>(cap, it->second.size());
+  std::copy_n(it->second.begin(), n, out);
+  return static_cast<int64_t>(it->second.size());
+}
+
+// uniform neighbor sampling (graph_table sample_neighbors): out [n, k];
+// nodes with degree < k pad with -1 when replace=0, sample with
+// replacement when replace=1; isolated nodes are all -1.
+int32_t gt_sample_neighbors(void* p, const int64_t* keys, int64_t n,
+                            int64_t k, uint64_t seed, int32_t replace,
+                            int64_t* out) {
+  GraphTable* g = G(p);
+  for (int64_t i = 0; i < n; ++i) {
+    GShard& s = g->ShardFor(keys[i]);
+    std::lock_guard<std::mutex> lk(s.mu);
+    auto it = s.adj.find(keys[i]);
+    int64_t* row = out + i * k;
+    if (it == s.adj.end() || it->second.empty()) {
+      std::fill(row, row + k, int64_t{-1});
+      continue;
+    }
+    const auto& nbrs = it->second;
+    std::mt19937_64 gen(seed ^ (static_cast<uint64_t>(keys[i]) * 0x9E3779B97F4A7C15ull + i));
+    if (replace || static_cast<int64_t>(nbrs.size()) <= k) {
+      if (!replace && static_cast<int64_t>(nbrs.size()) <= k) {
+        // take all, pad the tail
+        std::copy(nbrs.begin(), nbrs.end(), row);
+        std::fill(row + nbrs.size(), row + k, int64_t{-1});
+      } else {
+        std::uniform_int_distribution<size_t> dist(0, nbrs.size() - 1);
+        for (int64_t j = 0; j < k; ++j) row[j] = nbrs[dist(gen)];
+      }
+    } else {
+      // partial Fisher-Yates without replacement
+      std::vector<int64_t> pool(nbrs);
+      for (int64_t j = 0; j < k; ++j) {
+        std::uniform_int_distribution<size_t> dist(j, pool.size() - 1);
+        std::swap(pool[j], pool[dist(gen)]);
+        row[j] = pool[j];
+      }
+    }
+  }
+  return 0;
+}
+
+// random node batch (graph_table random_sample_nodes): reservoir over shards
+int64_t gt_sample_nodes(void* p, int64_t count, uint64_t seed, int64_t* out) {
+  GraphTable* g = G(p);
+  std::mt19937_64 gen(seed);
+  int64_t seen = 0, taken = 0;
+  for (auto& s : g->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    for (auto& kv : s.adj) {
+      ++seen;
+      if (taken < count) {
+        out[taken++] = kv.first;
+      } else {
+        std::uniform_int_distribution<int64_t> dist(0, seen - 1);
+        int64_t j = dist(gen);
+        if (j < count) out[j] = kv.first;
+      }
+    }
+  }
+  return taken;
+}
+
+}  // extern "C"

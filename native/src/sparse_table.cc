@@ -15,8 +15,10 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <list>
 #include <mutex>
 #include <random>
+#include <string>
 #include <unordered_map>
 #include <vector>
 
@@ -24,16 +26,124 @@ namespace {
 
 constexpr int kShards = 16;
 
+// CTR accessor metadata (ps/table/ctr_accessor.h CtrCommonAccessor analog):
+// per-key show/click counters with day-decay; the score gates shrink().
+struct CtrMeta {
+  float show = 0.f;
+  float click = 0.f;
+  int32_t unseen_days = 0;
+};
+
 struct Shard {
   std::mutex mu;
   std::unordered_map<int64_t, std::vector<float>> rows;      // dim floats
   std::unordered_map<int64_t, std::vector<float>> g2sums;    // adagrad accum
+  std::unordered_map<int64_t, CtrMeta> metas;                // ctr accessor
+  // LRU for the spill policy: most-recent at front
+  std::list<int64_t> lru;
+  std::unordered_map<int64_t, std::list<int64_t>::iterator> lru_pos;
+};
+
+// Disk-spill backing store (ssd_sparse_table.cc role, RocksDB replaced by an
+// append-log + in-memory offset index; latest record wins, save() compacts).
+struct SpillStore {
+  std::mutex mu;
+  std::string path;
+  FILE* f = nullptr;
+  std::unordered_map<int64_t, int64_t> index;  // key -> file offset
+
+  bool Open(const std::string& p) {
+    path = p;
+    f = std::fopen(p.c_str(), "w+b");
+    return f != nullptr;
+  }
+
+  // record layout: key | row[dim] | g2[dim]
+  bool Append(int64_t key, const float* row, const float* g2, int64_t dim) {
+    std::lock_guard<std::mutex> g(mu);
+    if (!f) return false;
+    std::fseek(f, 0, SEEK_END);
+    int64_t off = std::ftell(f);
+    if (std::fwrite(&key, sizeof(int64_t), 1, f) != 1) return false;
+    if (std::fwrite(row, sizeof(float), dim, f) != static_cast<size_t>(dim)) return false;
+    static thread_local std::vector<float> zeros;
+    if (!g2) {
+      zeros.assign(dim, 0.f);
+      g2 = zeros.data();
+    }
+    if (std::fwrite(g2, sizeof(float), dim, f) != static_cast<size_t>(dim)) return false;
+    index[key] = off;
+    return true;
+  }
+
+  bool Read(int64_t key, float* row, float* g2, int64_t dim) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = index.find(key);
+    if (it == index.end() || !f) return false;
+    std::fseek(f, it->second, SEEK_SET);
+    int64_t k = 0;
+    if (std::fread(&k, sizeof(int64_t), 1, f) != 1 || k != key) return false;
+    if (std::fread(row, sizeof(float), dim, f) != static_cast<size_t>(dim)) return false;
+    if (std::fread(g2, sizeof(float), dim, f) != static_cast<size_t>(dim)) return false;
+    return true;
+  }
+
+  bool Erase(int64_t key) {
+    std::lock_guard<std::mutex> g(mu);
+    return index.erase(key) > 0;
+  }
+
+  // rewrite live records into a fresh log and swap (reclaims the dead
+  // records every Append superseded) — called from st_save
+  bool Compact(int64_t dim) {
+    std::lock_guard<std::mutex> g(mu);
+    if (!f) return false;
+    std::string tmp = path + ".compact";
+    FILE* nf = std::fopen(tmp.c_str(), "w+b");
+    if (!nf) return false;
+    std::unordered_map<int64_t, int64_t> nidx;
+    std::vector<float> buf(2 * dim);
+    for (auto& kv : index) {
+      std::fseek(f, kv.second, SEEK_SET);
+      int64_t k = 0;
+      if (std::fread(&k, sizeof(int64_t), 1, f) != 1 || k != kv.first) continue;
+      if (std::fread(buf.data(), sizeof(float), 2 * dim, f) !=
+          static_cast<size_t>(2 * dim)) continue;
+      std::fseek(nf, 0, SEEK_END);
+      int64_t off = std::ftell(nf);
+      if (std::fwrite(&k, sizeof(int64_t), 1, nf) != 1 ||
+          std::fwrite(buf.data(), sizeof(float), 2 * dim, nf) !=
+              static_cast<size_t>(2 * dim)) {
+        std::fclose(nf);
+        std::remove(tmp.c_str());
+        return false;
+      }
+      nidx[k] = off;
+    }
+    std::fclose(nf);
+    std::fclose(f);
+    f = nullptr;
+    if (std::rename(tmp.c_str(), path.c_str()) != 0) {
+      f = std::fopen(path.c_str(), "r+b");  // keep the old log usable
+      std::remove(tmp.c_str());
+      return false;
+    }
+    f = std::fopen(path.c_str(), "r+b");
+    index = std::move(nidx);
+    return f != nullptr;
+  }
+
+  ~SpillStore() {
+    if (f) std::fclose(f);
+  }
 };
 
 struct SparseTable {
   int64_t dim;
   float init_range;   // uniform(-r, r) init for missing keys; 0 => zeros
   uint64_t seed;
+  int64_t max_mem_rows = 0;  // 0 = never spill
+  SpillStore spill;
   Shard shards[kShards];
 
   Shard& ShardFor(int64_t key) {
@@ -50,6 +160,66 @@ struct SparseTable {
     std::mt19937_64 gen(seed ^ static_cast<uint64_t>(key) * 0x9E3779B97F4A7C15ull);
     std::uniform_real_distribution<float> dist(-init_range, init_range);
     for (auto& v : *row) v = dist(gen);
+  }
+
+  void Touch(Shard& s, int64_t key) {
+    if (max_mem_rows <= 0) return;
+    auto it = s.lru_pos.find(key);
+    if (it != s.lru_pos.end()) s.lru.erase(it->second);
+    s.lru.push_front(key);
+    s.lru_pos[key] = s.lru.begin();
+  }
+
+  // evict cold rows to disk until the shard is within budget (caller holds
+  // the shard lock). Budget is per-shard ceil(max_mem_rows/kShards) with a
+  // floor of 1 (each active shard keeps its working row), so the effective
+  // minimum residency is one row per touched shard.
+  void MaybeEvict(Shard& s) {
+    if (max_mem_rows <= 0) return;
+    int64_t cap = std::max<int64_t>(1, (max_mem_rows + kShards - 1) / kShards);
+    while (static_cast<int64_t>(s.rows.size()) > cap && !s.lru.empty()) {
+      int64_t victim = s.lru.back();
+      auto it = s.rows.find(victim);
+      if (it == s.rows.end()) {
+        s.lru.pop_back();
+        s.lru_pos.erase(victim);
+        continue;
+      }
+      auto g2 = s.g2sums.find(victim);
+      if (!spill.Append(victim, it->second.data(),
+                        g2 != s.g2sums.end() ? g2->second.data() : nullptr, dim)) {
+        // spill write failed (disk full?): keep the row in memory rather
+        // than silently losing state; stop evicting this round
+        return;
+      }
+      s.lru.pop_back();
+      s.lru_pos.erase(victim);
+      s.rows.erase(it);
+      if (g2 != s.g2sums.end()) s.g2sums.erase(g2);
+    }
+  }
+
+  // load a row into memory: from mem, else disk, else init. Caller holds
+  // the shard lock. Returns the live row map iterator.
+  std::unordered_map<int64_t, std::vector<float>>::iterator Fetch(Shard& s, int64_t key) {
+    auto it = s.rows.find(key);
+    if (it != s.rows.end()) {
+      Touch(s, key);
+      return it;
+    }
+    std::vector<float> row(dim), g2(dim);
+    if (max_mem_rows > 0 && spill.Read(key, row.data(), g2.data(), dim)) {
+      spill.Erase(key);
+      bool any_g2 = false;
+      for (auto v : g2) any_g2 |= (v != 0.f);
+      if (any_g2) s.g2sums[key] = g2;
+    } else {
+      InitRow(key, &row);
+    }
+    it = s.rows.emplace(key, std::move(row)).first;
+    Touch(s, key);
+    MaybeEvict(s);
+    return it;
   }
 };
 
@@ -68,6 +238,21 @@ void* st_create(int64_t dim, float init_range, uint64_t seed) {
   return t;
 }
 
+// Spill-enabled table (ssd_sparse_table.cc role): at most max_mem_rows live
+// in memory; LRU-cold rows (and their AdaGrad state) move to an append-log
+// at spill_path and fault back in on access.
+void* st_create_spill(int64_t dim, float init_range, uint64_t seed,
+                      int64_t max_mem_rows, const char* spill_path) {
+  auto* t = static_cast<SparseTable*>(st_create(dim, init_range, seed));
+  if (!t) return nullptr;
+  t->max_mem_rows = max_mem_rows;
+  if (!t->spill.Open(spill_path)) {
+    delete t;
+    return nullptr;
+  }
+  return t;
+}
+
 void st_destroy(void* p) { delete T(p); }
 
 int64_t st_dim(void* p) { return T(p)->dim; }
@@ -79,22 +264,35 @@ int64_t st_size(void* p) {
     std::lock_guard<std::mutex> g(s.mu);
     n += static_cast<int64_t>(s.rows.size());
   }
+  std::lock_guard<std::mutex> g(t->spill.mu);
+  return n + static_cast<int64_t>(t->spill.index.size());
+}
+
+int64_t st_mem_rows(void* p) {
+  SparseTable* t = T(p);
+  int64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> g(s.mu);
+    n += static_cast<int64_t>(s.rows.size());
+  }
   return n;
 }
 
+int64_t st_spilled_rows(void* p) {
+  SparseTable* t = T(p);
+  std::lock_guard<std::mutex> g(t->spill.mu);
+  return static_cast<int64_t>(t->spill.index.size());
+}
+
 // Pull rows for keys into out [n, dim]; missing keys are initialized
-// (pull_sparse with create-on-miss, memory_sparse_table.cc semantics).
+// (pull_sparse with create-on-miss, memory_sparse_table.cc semantics);
+// spilled keys fault in from disk.
 int32_t st_pull(void* p, const int64_t* keys, int64_t n, float* out) {
   SparseTable* t = T(p);
   for (int64_t i = 0; i < n; ++i) {
     Shard& s = t->ShardFor(keys[i]);
     std::lock_guard<std::mutex> g(s.mu);
-    auto it = s.rows.find(keys[i]);
-    if (it == s.rows.end()) {
-      std::vector<float> row;
-      t->InitRow(keys[i], &row);
-      it = s.rows.emplace(keys[i], std::move(row)).first;
-    }
+    auto it = t->Fetch(s, keys[i]);
     std::memcpy(out + i * t->dim, it->second.data(), t->dim * sizeof(float));
   }
   return 0;
@@ -108,12 +306,7 @@ int32_t st_push_sgd(void* p, const int64_t* keys, int64_t n,
   for (int64_t i = 0; i < n; ++i) {
     Shard& s = t->ShardFor(keys[i]);
     std::lock_guard<std::mutex> g(s.mu);
-    auto it = s.rows.find(keys[i]);
-    if (it == s.rows.end()) {
-      std::vector<float> row;
-      t->InitRow(keys[i], &row);
-      it = s.rows.emplace(keys[i], std::move(row)).first;
-    }
+    auto it = t->Fetch(s, keys[i]);
     float* row = it->second.data();
     const float* gr = grads + i * t->dim;
     for (int64_t d = 0; d < t->dim; ++d) row[d] -= lr * gr[d];
@@ -129,12 +322,7 @@ int32_t st_push_adagrad(void* p, const int64_t* keys, int64_t n,
   for (int64_t i = 0; i < n; ++i) {
     Shard& s = t->ShardFor(keys[i]);
     std::lock_guard<std::mutex> g(s.mu);
-    auto it = s.rows.find(keys[i]);
-    if (it == s.rows.end()) {
-      std::vector<float> row;
-      t->InitRow(keys[i], &row);
-      it = s.rows.emplace(keys[i], std::move(row)).first;
-    }
+    auto it = t->Fetch(s, keys[i]);
     auto& g2 = s.g2sums[keys[i]];
     if (g2.empty()) g2.assign(t->dim, 0.f);
     float* row = it->second.data();
@@ -147,7 +335,86 @@ int32_t st_push_adagrad(void* p, const int64_t* keys, int64_t n,
   return 0;
 }
 
-// direct assignment (table load / init from checkpoint)
+// ---- CTR accessor (ps/table/ctr_accessor.cc CtrCommonAccessor) ----
+// record impressions/clicks for keys (push_show/push_click fused)
+int32_t st_push_show_click(void* p, const int64_t* keys, int64_t n,
+                           const float* shows, const float* clicks) {
+  SparseTable* t = T(p);
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = t->ShardFor(keys[i]);
+    std::lock_guard<std::mutex> g(s.mu);
+    CtrMeta& m = s.metas[keys[i]];
+    m.show += shows ? shows[i] : 1.f;
+    m.click += clicks ? clicks[i] : 0.f;
+    m.unseen_days = 0;
+  }
+  return 0;
+}
+
+// end-of-day decay (CtrCommonAccessor::UpdateStatAfterSave show_decay_rate):
+// show/click *= decay, unseen_days += 1 for every key
+int32_t st_decay_days(void* p, float decay, int32_t days) {
+  SparseTable* t = T(p);
+  float f = std::pow(decay, static_cast<float>(days));
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> g(s.mu);
+    for (auto& kv : s.metas) {
+      kv.second.show *= f;
+      kv.second.click *= f;
+      kv.second.unseen_days += days;
+    }
+  }
+  return 0;
+}
+
+// shrink (CtrCommonAccessor::Shrink): delete keys whose score
+// show_coeff*show + click_coeff*click < threshold OR unseen too long.
+// Returns rows deleted.
+int64_t st_shrink(void* p, float show_coeff, float click_coeff,
+                  float threshold, int32_t max_unseen_days) {
+  SparseTable* t = T(p);
+  int64_t deleted = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> g(s.mu);
+    std::vector<int64_t> victims;
+    for (auto& kv : s.metas) {
+      float score = show_coeff * kv.second.show + click_coeff * kv.second.click;
+      if (score < threshold ||
+          (max_unseen_days > 0 && kv.second.unseen_days > max_unseen_days)) {
+        victims.push_back(kv.first);
+      }
+    }
+    for (int64_t key : victims) {
+      bool gone = s.rows.erase(key) > 0;
+      s.g2sums.erase(key);
+      s.metas.erase(key);
+      auto lit = s.lru_pos.find(key);
+      if (lit != s.lru_pos.end()) {
+        s.lru.erase(lit->second);
+        s.lru_pos.erase(lit);
+      }
+      gone |= t->spill.Erase(key);
+      deleted += gone ? 1 : 0;
+    }
+  }
+  return deleted;
+}
+
+// read back meta for a key: out = {show, click, unseen_days}; 0 found
+int32_t st_get_meta(void* p, int64_t key, float* out) {
+  SparseTable* t = T(p);
+  Shard& s = t->ShardFor(key);
+  std::lock_guard<std::mutex> g(s.mu);
+  auto it = s.metas.find(key);
+  if (it == s.metas.end()) return -1;
+  out[0] = it->second.show;
+  out[1] = it->second.click;
+  out[2] = static_cast<float>(it->second.unseen_days);
+  return 0;
+}
+
+// direct assignment (table load / init from checkpoint); participates in
+// the spill policy like any other write
 int32_t st_assign(void* p, const int64_t* keys, int64_t n, const float* vals) {
   SparseTable* t = T(p);
   for (int64_t i = 0; i < n; ++i) {
@@ -155,11 +422,15 @@ int32_t st_assign(void* p, const int64_t* keys, int64_t n, const float* vals) {
     std::lock_guard<std::mutex> g(s.mu);
     auto& row = s.rows[keys[i]];
     row.assign(vals + i * t->dim, vals + (i + 1) * t->dim);
+    t->spill.Erase(keys[i]);  // the fresh value supersedes any spilled one
+    t->Touch(s, keys[i]);
+    t->MaybeEvict(s);
   }
   return 0;
 }
 
-// export all (key, row) pairs; pass null bufs to query count only
+// export all (key, row) pairs incl. spilled rows; pass null bufs to query
+// count only. (Invariant: a key lives in memory XOR in the spill index.)
 int64_t st_export(void* p, int64_t* keys_out, float* vals_out, int64_t cap) {
   SparseTable* t = T(p);
   int64_t n = 0;
@@ -174,6 +445,21 @@ int64_t st_export(void* p, int64_t* keys_out, float* vals_out, int64_t cap) {
       }
       ++n;
     }
+  }
+  std::vector<int64_t> spilled;
+  {
+    std::lock_guard<std::mutex> g(t->spill.mu);
+    for (auto& kv : t->spill.index) spilled.push_back(kv.first);
+  }
+  std::vector<float> row(t->dim), g2(t->dim);
+  for (int64_t key : spilled) {
+    if (keys_out && vals_out) {
+      if (n >= cap) return -1;
+      if (!t->spill.Read(key, row.data(), g2.data(), t->dim)) continue;
+      keys_out[n] = key;
+      std::memcpy(vals_out + n * t->dim, row.data(), t->dim * sizeof(float));
+    }
+    ++n;
   }
   return n;
 }
@@ -193,6 +479,10 @@ int32_t st_save(void* p, const char* path) {
   std::fwrite(&t->dim, sizeof(int64_t), 1, f);
   int64_t count = 0;
   for (auto& s : t->shards) count += static_cast<int64_t>(s.rows.size());
+  {
+    std::lock_guard<std::mutex> g(t->spill.mu);
+    count += static_cast<int64_t>(t->spill.index.size());
+  }
   std::fwrite(&count, sizeof(int64_t), 1, f);
   for (auto& s : t->shards) {
     for (auto& kv : s.rows) {
@@ -200,7 +490,21 @@ int32_t st_save(void* p, const char* path) {
       std::fwrite(kv.second.data(), sizeof(float), t->dim, f);
     }
   }
+  // spilled rows: read back from the append-log (save doubles as compaction
+  // of the log's dead records)
+  std::vector<int64_t> spilled;
+  {
+    std::lock_guard<std::mutex> g(t->spill.mu);
+    for (auto& kv : t->spill.index) spilled.push_back(kv.first);
+  }
+  std::vector<float> row(t->dim), g2(t->dim);
+  for (int64_t key : spilled) {
+    if (!t->spill.Read(key, row.data(), g2.data(), t->dim)) continue;
+    std::fwrite(&key, sizeof(int64_t), 1, f);
+    std::fwrite(row.data(), sizeof(float), t->dim, f);
+  }
   std::fclose(f);
+  if (t->max_mem_rows > 0) t->spill.Compact(t->dim);
   return 0;
 }
 
@@ -216,13 +520,20 @@ int32_t st_load(void* p, const char* path) {
     std::fclose(f);
     return -2;
   }
-  // a load is a RESTORE: clear existing rows and optimizer accumulators so
-  // the table state equals the checkpoint exactly (no stale g2sums applying
-  // to restored rows, no pre-load rows surviving)
+  // a load is a RESTORE: clear existing rows, optimizer accumulators, ctr
+  // meta and the spill index so the table state equals the checkpoint
+  // exactly (no stale g2sums applying to restored rows, no pre-load rows)
   for (auto& s : t->shards) {
     std::lock_guard<std::mutex> g(s.mu);
     s.rows.clear();
     s.g2sums.clear();
+    s.metas.clear();
+    s.lru.clear();
+    s.lru_pos.clear();
+  }
+  {
+    std::lock_guard<std::mutex> g(t->spill.mu);
+    t->spill.index.clear();
   }
   std::vector<float> row(t->dim);
   for (int64_t i = 0; i < count; ++i) {
@@ -236,6 +547,8 @@ int32_t st_load(void* p, const char* path) {
     Shard& s = t->ShardFor(key);
     std::lock_guard<std::mutex> g(s.mu);
     s.rows[key] = row;
+    t->Touch(s, key);
+    t->MaybeEvict(s);
   }
   std::fclose(f);
   return 0;

@@ -122,8 +122,10 @@ class CostModel:
             return "sharding axis needs zero_stage >= 1"
         if m.layers % pp:
             return f"layers {m.layers} % pp {pp}"
-        if m.heads % (mp * sep) if sep > 1 else m.heads % mp:
-            return f"heads {m.heads} not divisible by mp{'*sep' if sep > 1 else ''}"
+        if m.heads % mp:
+            # ring attention (the priced sep scheme) shards SEQ, not heads,
+            # so sep imposes no head-divisibility constraint
+            return f"heads {m.heads} % mp {mp}"
         if m.vocab % mp:
             return f"vocab {m.vocab} % mp {mp}"
         if t.batch % (dp * sharding * max(t.accumulate_steps, 1)):

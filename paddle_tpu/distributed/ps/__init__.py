@@ -26,7 +26,7 @@ from ... import native as _native
 from .._wire import client_handshake, recv_msg, send_msg, server_handshake
 
 __all__ = [
-    "SparseTable", "PsServer", "PsClient",
+    "SparseTable", "GraphTable", "PsServer", "PsClient",
     "init_server", "run_server", "init_worker", "stop_worker",
     "get_ps_endpoints",
 ]
@@ -53,6 +53,23 @@ def _lib():
         "st_export": (c_i64, [ctypes.c_void_p, p_i64, p_f, c_i64]),
         "st_save": (c_i32, [ctypes.c_void_p, ctypes.c_char_p]),
         "st_load": (c_i32, [ctypes.c_void_p, ctypes.c_char_p]),
+        # spill + ctr accessor (ssd_sparse_table / ctr_accessor analogs)
+        "st_create_spill": (ctypes.c_void_p, [c_i64, c_f, ctypes.c_uint64, c_i64, ctypes.c_char_p]),
+        "st_mem_rows": (c_i64, [ctypes.c_void_p]),
+        "st_spilled_rows": (c_i64, [ctypes.c_void_p]),
+        "st_push_show_click": (c_i32, [ctypes.c_void_p, p_i64, c_i64, p_f, p_f]),
+        "st_decay_days": (c_i32, [ctypes.c_void_p, c_f, c_i32]),
+        "st_shrink": (c_i64, [ctypes.c_void_p, c_f, c_f, c_f, c_i32]),
+        "st_get_meta": (c_i32, [ctypes.c_void_p, c_i64, p_f]),
+        # graph table (common_graph_table analog)
+        "gt_create": (ctypes.c_void_p, []),
+        "gt_destroy": (None, [ctypes.c_void_p]),
+        "gt_add_edges": (c_i32, [ctypes.c_void_p, p_i64, p_i64, c_i64]),
+        "gt_num_nodes": (c_i64, [ctypes.c_void_p]),
+        "gt_degree": (c_i64, [ctypes.c_void_p, c_i64]),
+        "gt_neighbors": (c_i64, [ctypes.c_void_p, c_i64, p_i64, c_i64]),
+        "gt_sample_neighbors": (c_i32, [ctypes.c_void_p, p_i64, c_i64, c_i64, ctypes.c_uint64, c_i32, p_i64]),
+        "gt_sample_nodes": (c_i64, [ctypes.c_void_p, c_i64, ctypes.c_uint64, p_i64]),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)
@@ -66,15 +83,75 @@ def _i64(a) -> np.ndarray:
 
 
 class SparseTable:
-    """Native sharded key->row table (memory_sparse_table analog)."""
+    """Native sharded key->row table (memory_sparse_table analog).
 
-    def __init__(self, dim: int, init_range: float = 0.0, seed: int = 0):
+    With ``max_mem_rows`` set, LRU-cold rows (and their AdaGrad state) spill
+    to an append-log at ``spill_path`` and fault back in on access — the
+    ssd_sparse_table role with the RocksDB dependency replaced by a
+    compacting log. The CTR accessor surface (push_show_click / decay_days /
+    shrink / get_meta) mirrors ctr_accessor.cc's show/click scoring.
+    """
+
+    def __init__(self, dim: int, init_range: float = 0.0, seed: int = 0,
+                 max_mem_rows: int = 0, spill_path: Optional[str] = None):
         lib = _lib()
-        self._h = lib.st_create(dim, float(init_range), seed)
+        self._own_spill_dir = None
+        if max_mem_rows > 0:
+            if not spill_path:
+                import tempfile
+
+                self._own_spill_dir = tempfile.mkdtemp(prefix="pt_spill_")
+                spill_path = os.path.join(self._own_spill_dir, "table.log")
+            self._h = lib.st_create_spill(dim, float(init_range), seed,
+                                          int(max_mem_rows), spill_path.encode())
+        else:
+            self._h = lib.st_create(dim, float(init_range), seed)
         if not self._h:
-            raise ValueError(f"invalid sparse table dim {dim}")
+            raise ValueError(f"cannot create sparse table (dim={dim})")
         self.dim = dim
+        self.spill_path = spill_path if max_mem_rows > 0 else None
         self._lib = lib
+
+    # ---- spill stats ----
+    def mem_rows(self) -> int:
+        return int(self._lib.st_mem_rows(self._h))
+
+    def spilled_rows(self) -> int:
+        return int(self._lib.st_spilled_rows(self._h))
+
+    # ---- CTR accessor ----
+    def push_show_click(self, keys, shows=None, clicks=None):
+        keys = _i64(keys)
+        p_f = ctypes.POINTER(ctypes.c_float)
+        sh = (np.ascontiguousarray(np.asarray(shows, np.float32).reshape(-1))
+              if shows is not None else None)
+        ck = (np.ascontiguousarray(np.asarray(clicks, np.float32).reshape(-1))
+              if clicks is not None else None)
+        for arr, name in ((sh, "shows"), (ck, "clicks")):
+            if arr is not None and arr.size != keys.size:
+                raise ValueError(f"{name} size {arr.size} != keys {keys.size}")
+        self._lib.st_push_show_click(
+            self._h, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
+            sh.ctypes.data_as(p_f) if sh is not None else None,
+            ck.ctypes.data_as(p_f) if ck is not None else None)
+
+    def decay_days(self, decay: float = 0.98, days: int = 1):
+        self._lib.st_decay_days(self._h, float(decay), int(days))
+
+    def shrink(self, show_coeff: float = 1.0, click_coeff: float = 10.0,
+               threshold: float = 0.0, max_unseen_days: int = 0) -> int:
+        """Delete rows scoring below threshold (ctr_accessor Shrink)."""
+        return int(self._lib.st_shrink(self._h, float(show_coeff),
+                                       float(click_coeff), float(threshold),
+                                       int(max_unseen_days)))
+
+    def get_meta(self, key: int):
+        out = np.zeros(3, np.float32)
+        rc = self._lib.st_get_meta(self._h, int(key),
+                                   out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            return None
+        return {"show": float(out[0]), "click": float(out[1]), "unseen_days": int(out[2])}
 
     def pull(self, keys) -> np.ndarray:
         keys = _i64(keys)
@@ -139,6 +216,70 @@ class SparseTable:
     def close(self):
         if getattr(self, "_h", None):
             self._lib.st_destroy(self._h)
+            self._h = None
+        if getattr(self, "_own_spill_dir", None):
+            import shutil
+
+            shutil.rmtree(self._own_spill_dir, ignore_errors=True)
+            self._own_spill_dir = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class GraphTable:
+    """Native adjacency store + neighbor sampling (ps/table/
+    common_graph_table.h GraphTable analog). Samples come back as dense
+    [n, k] int64 arrays (-1 padded) ready for paddle_tpu.geometric gathers —
+    the ragged host work stays here, the math stays on chip."""
+
+    def __init__(self):
+        self._lib = _lib()
+        self._h = self._lib.gt_create()
+
+    def add_edges(self, src, dst):
+        src, dst = _i64(src), _i64(dst)
+        if src.size != dst.size:
+            raise ValueError(f"src size {src.size} != dst size {dst.size}")
+        self._lib.gt_add_edges(
+            self._h, src.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), src.size)
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self._lib.gt_num_nodes(self._h))
+
+    def degree(self, key: int) -> int:
+        return int(self._lib.gt_degree(self._h, int(key)))
+
+    def neighbors(self, key: int) -> np.ndarray:
+        n = self.degree(key)
+        out = np.empty(max(n, 1), np.int64)
+        self._lib.gt_neighbors(self._h, int(key),
+                               out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n)
+        return out[:n]
+
+    def sample_neighbors(self, keys, k: int, seed: int = 0, replace: bool = False) -> np.ndarray:
+        keys = _i64(keys)
+        out = np.empty((keys.size, k), np.int64)
+        self._lib.gt_sample_neighbors(
+            self._h, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
+            int(k), int(seed), 1 if replace else 0,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return out
+
+    def sample_nodes(self, count: int, seed: int = 0) -> np.ndarray:
+        out = np.empty(max(count, 1), np.int64)
+        got = self._lib.gt_sample_nodes(self._h, int(count), int(seed),
+                                        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return out[:got]
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.gt_destroy(self._h)
             self._h = None
 
     def __del__(self):

@@ -51,7 +51,7 @@ def _np_dtype(name: str):
 
 def build(force: bool = False) -> str:
     """Compile the native library if missing/stale. Returns the .so path."""
-    srcs = [os.path.join(_SRC, f) for f in ("data_pipeline.cc", "checkpoint.cc", "tokenizer.cc", "ir_core.cc", "sparse_table.cc")]
+    srcs = [os.path.join(_SRC, f) for f in ("data_pipeline.cc", "checkpoint.cc", "tokenizer.cc", "ir_core.cc", "sparse_table.cc", "graph_table.cc")]
     hdrs = [os.path.join(_SRC, "blocking_queue.h")]
     if not force and os.path.exists(_LIB_PATH):
         newest_src = max(os.path.getmtime(p) for p in srcs + hdrs)
