@@ -92,6 +92,10 @@ def main():
             long_note = f", seq4k={_long_context_row():.0f} tok/s"
         except Exception:
             long_note = ", seq4k=failed"
+        try:
+            long_note += f", infer={_predictor_row():.0f} tok/s"
+        except Exception:
+            long_note += ", infer=failed"
 
     print(
         json.dumps(
@@ -138,6 +142,64 @@ def _long_context_row() -> float:
         loss = step(x, y)
     _ = float(loss)
     return bsz * seq * iters / (time.perf_counter() - t0)
+
+
+def _predictor_row() -> float:
+    """Serving throughput: a FusedMultiTransformer decoder (stacked-scan
+    blocks, the fused_multi_transformer analog) exported with jit.save and
+    run through the AOT inference Predictor — the deployment path."""
+    import gc
+    import tempfile
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import jit
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.static import InputSpec
+
+    # sized so the serialized StableHLO (weights baked in) stays under the
+    # axon tunnel's request-body limit (~50 MB of constants)
+    B, S, H, NH, L = 16, 1024, 512, 8, 8
+    paddle.seed(0)
+
+    class Decoder(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.blocks = FusedMultiTransformer(H, NH, 4 * H, num_layers=L)
+
+        def forward(self, x):
+            return self.blocks(x)
+
+    net = Decoder().astype("bfloat16")
+    net.eval()
+    prefix = f"{tempfile.mkdtemp()}/decoder"
+    jit.save(net, prefix, input_spec=[InputSpec([B, S, H], "bfloat16")])
+    pred = create_predictor(Config(prefix))
+    del net
+    gc.collect()
+    import ml_dtypes
+
+    rs = np.random.RandomState(0)
+    x = (rs.randn(B, S, H) * 0.1).astype(ml_dtypes.bfloat16)
+    ih = pred.get_input_handle(pred.get_input_names()[0])
+
+    def once():
+        ih.copy_from_cpu(x)
+        pred.run()
+        oh = pred.get_output_handle(pred.get_output_names()[0])
+        return oh.copy_to_cpu()  # host copy = completion barrier
+
+    once()  # warm (compile)
+    iters = 8
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = once()
+    dt = time.perf_counter() - t0
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+    return B * S * iters / dt
 
 
 if __name__ == "__main__":

@@ -1,0 +1,35 @@
+// Config — the reference goapi config.go analog (PD_Config* surface reduced
+// to what the TPU serving path needs: a model prefix; GPU/TRT/MKLDNN toggles
+// are accepted but inert, matching paddle_tpu.inference.Config).
+package goapi
+
+// Config holds predictor construction options.
+type Config struct {
+	modelPrefix string
+	paramsFile  string
+}
+
+// NewConfig returns an empty Config.
+func NewConfig() *Config {
+	return &Config{}
+}
+
+// SetModel sets the model prefix (the path passed to paddle.jit.save) —
+// reference Config.SetModel(model, params).
+func (c *Config) SetModel(model string, params ...string) {
+	c.modelPrefix = model
+	if len(params) > 0 {
+		c.paramsFile = params[0]
+	}
+}
+
+// ModelDir returns the configured model prefix (reference Config.ProgFile).
+func (c *Config) ModelDir() string {
+	return c.modelPrefix
+}
+
+// EnableUseGpu is accepted for API parity and inert: placement is XLA's.
+func (c *Config) EnableUseGpu(memoryMB uint64, deviceID int32) {}
+
+// SwitchIrOptim is accepted for parity; the IR pipeline always runs.
+func (c *Config) SwitchIrOptim(enable bool) {}

@@ -1,0 +1,146 @@
+// Predictor — the reference goapi predictor.go analog: cgo over the
+// pt_inference.h C ABI (which embeds the XLA/PJRT serving runtime).
+package goapi
+
+/*
+#include <stdint.h>
+#include <stdlib.h>
+#include "pt_inference.h"
+*/
+import "C"
+
+import (
+	"fmt"
+	"runtime"
+	"sync"
+	"unsafe"
+)
+
+var initOnce sync.Once
+var initErr error
+
+func ensureInit() error {
+	initOnce.Do(func() {
+		if C.pt_infer_init() != 0 {
+			initErr = fmt.Errorf("pt_infer_init: %s", C.GoString(C.pt_infer_last_error()))
+		}
+	})
+	return initErr
+}
+
+// Predictor wraps one loaded model (reference Predictor).
+type Predictor struct {
+	h unsafe.Pointer
+}
+
+// NewPredictor loads the model named by config (reference NewPredictor).
+func NewPredictor(config *Config) (*Predictor, error) {
+	if err := ensureInit(); err != nil {
+		return nil, err
+	}
+	cPrefix := C.CString(config.ModelDir())
+	defer C.free(unsafe.Pointer(cPrefix))
+	h := C.pt_predictor_create(cPrefix)
+	if h == nil {
+		return nil, fmt.Errorf("pt_predictor_create: %s", C.GoString(C.pt_infer_last_error()))
+	}
+	p := &Predictor{h: h}
+	runtime.SetFinalizer(p, func(p *Predictor) { p.Destroy() })
+	return p, nil
+}
+
+// Destroy releases the native handle (idempotent).
+func (p *Predictor) Destroy() {
+	if p.h != nil {
+		C.pt_predictor_destroy(p.h)
+		p.h = nil
+	}
+}
+
+func fillCTensor(dst *C.PT_Tensor, t *Tensor, pinner *runtime.Pinner) error {
+	if err := t.check(); err != nil {
+		return err
+	}
+	if len(t.Shape) > int(C.PT_MAX_NDIM) {
+		return fmt.Errorf("tensor rank %d exceeds PT_MAX_NDIM", len(t.Shape))
+	}
+	dst.dtype = C.int32_t(t.Dtype)
+	dst.ndim = C.int32_t(len(t.Shape))
+	for i, d := range t.Shape {
+		dst.shape[i] = C.int64_t(d)
+	}
+	var ptr unsafe.Pointer
+	switch {
+	case len(t.F32) > 0:
+		ptr = unsafe.Pointer(&t.F32[0])
+	case len(t.I32) > 0:
+		ptr = unsafe.Pointer(&t.I32[0])
+	case len(t.I64) > 0:
+		ptr = unsafe.Pointer(&t.I64[0])
+	case len(t.Raw) > 0:
+		ptr = unsafe.Pointer(&t.Raw[0])
+	default:
+		return fmt.Errorf("empty tensor")
+	}
+	// pin the Go-owned buffer so storing its pointer in C-allocated memory
+	// and passing it to C is legal under the cgo pointer rules
+	pinner.Pin(ptr)
+	dst.data = ptr
+	return nil
+}
+
+// Run executes the model on inputs and returns all outputs
+// (reference Predictor.Run + output-handle copies collapsed into one call).
+func (p *Predictor) Run(inputs []*Tensor) ([]*Tensor, error) {
+	if p.h == nil {
+		return nil, fmt.Errorf("predictor destroyed")
+	}
+	// the PT_Tensor array lives in C memory (a Go slice of structs holding
+	// Go data pointers would trip the cgo pointer-passing checker)
+	var insPtr *C.PT_Tensor
+	var pinner runtime.Pinner
+	defer pinner.Unpin()
+	if len(inputs) > 0 {
+		raw := C.malloc(C.size_t(len(inputs)) * C.size_t(unsafe.Sizeof(C.PT_Tensor{})))
+		if raw == nil {
+			return nil, fmt.Errorf("malloc failed")
+		}
+		defer C.free(raw)
+		cIns := unsafe.Slice((*C.PT_Tensor)(raw), len(inputs))
+		for i, t := range inputs {
+			if err := fillCTensor(&cIns[i], t, &pinner); err != nil {
+				return nil, err
+			}
+		}
+		insPtr = &cIns[0]
+	}
+	if C.pt_predictor_run(p.h, insPtr, C.int32_t(len(inputs))) != 0 {
+		return nil, fmt.Errorf("pt_predictor_run: %s", C.GoString(C.pt_infer_last_error()))
+	}
+	runtime.KeepAlive(inputs)
+	n := int(C.pt_predictor_num_outputs(p.h))
+	outs := make([]*Tensor, 0, n)
+	for i := 0; i < n; i++ {
+		var dt, nd C.int32_t
+		var nbytes C.int64_t
+		shape := make([]C.int64_t, int(C.PT_MAX_NDIM))
+		if C.pt_predictor_output_meta(p.h, C.int32_t(i), &dt, &nd, &shape[0], &nbytes) != 0 {
+			return nil, fmt.Errorf("output_meta(%d): %s", i, C.GoString(C.pt_infer_last_error()))
+		}
+		buf := make([]byte, int(nbytes))
+		if nbytes > 0 {
+			if C.pt_predictor_output_data(p.h, C.int32_t(i), unsafe.Pointer(&buf[0]), nbytes) != 0 {
+				return nil, fmt.Errorf("output_data(%d): %s", i, C.GoString(C.pt_infer_last_error()))
+			}
+		}
+		t := &Tensor{Dtype: DataType(dt), Raw: buf}
+		for j := 0; j < int(nd); j++ {
+			t.Shape = append(t.Shape, int64(shape[j]))
+		}
+		if t.Dtype == Float32 && len(buf) >= 4 {
+			t.F32 = unsafe.Slice((*float32)(unsafe.Pointer(&buf[0])), len(buf)/4)
+		}
+		outs = append(outs, t)
+	}
+	return outs, nil
+}

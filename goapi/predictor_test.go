@@ -1,0 +1,39 @@
+// Smoke test (reference predictor_test.go): needs a model saved by
+// tests/test_goapi.py's harness; PT_MODEL points at the prefix.
+package goapi
+
+import (
+	"math"
+	"os"
+	"testing"
+)
+
+func TestPredictorSmoke(t *testing.T) {
+	prefix := os.Getenv("PT_MODEL")
+	if prefix == "" {
+		t.Skip("PT_MODEL not set (run via tests/test_goapi.py)")
+	}
+	config := NewConfig()
+	config.SetModel(prefix)
+	pred, err := NewPredictor(config)
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer pred.Destroy()
+	data := make([]float32, 3*8)
+	for i := range data {
+		data[i] = float32(i%7) * 0.25
+	}
+	outs, err := pred.Run([]*Tensor{NewTensorFloat32([]int64{3, 8}, data)})
+	if err != nil {
+		t.Fatal(err)
+	}
+	if len(outs) != 1 || len(outs[0].Shape) != 2 || outs[0].Shape[0] != 3 {
+		t.Fatalf("unexpected outputs: %+v", outs)
+	}
+	for _, v := range outs[0].F32 {
+		if math.IsNaN(float64(v)) {
+			t.Fatal("NaN output")
+		}
+	}
+}

@@ -1,0 +1,73 @@
+// Tensor — the reference goapi tensor.go analog over PT_Tensor
+// (native/include/pt_extension.h): dense host tensors with the shared dtype
+// codes.
+package goapi
+
+import "fmt"
+
+// DataType mirrors the PT dtype codes (pt_extension.h / paddle_tpu.native).
+type DataType int32
+
+const (
+	Float32 DataType = 0
+	Float64 DataType = 1
+	Float16 DataType = 2
+	Bfloat16 DataType = 3
+	Int8    DataType = 4
+	Uint8   DataType = 5
+	Int16   DataType = 6
+	Int32   DataType = 7
+	Int64   DataType = 8
+	Bool    DataType = 9
+)
+
+// Tensor is a dense host tensor handed to / received from the predictor.
+type Tensor struct {
+	Dtype DataType
+	Shape []int64
+	// exactly one of these backs the data, by dtype
+	F32 []float32
+	I32 []int32
+	I64 []int64
+	Raw []byte
+}
+
+func numel(shape []int64) int64 {
+	n := int64(1)
+	for _, d := range shape {
+		n *= d
+	}
+	return n
+}
+
+// NewTensorFloat32 builds a float32 tensor; len(data) must match the shape.
+func NewTensorFloat32(shape []int64, data []float32) *Tensor {
+	return &Tensor{Dtype: Float32, Shape: shape, F32: data}
+}
+
+// NewTensorInt64 builds an int64 tensor (token ids etc.).
+func NewTensorInt64(shape []int64, data []int64) *Tensor {
+	return &Tensor{Dtype: Int64, Shape: shape, I64: data}
+}
+
+func (t *Tensor) check() error {
+	n := numel(t.Shape)
+	var have int64
+	switch t.Dtype {
+	case Float32:
+		have = int64(len(t.F32))
+	case Int32:
+		have = int64(len(t.I32))
+	case Int64:
+		have = int64(len(t.I64))
+	default:
+		have = int64(len(t.Raw))
+		if have > 0 {
+			return nil // raw bytes: caller owns the layout
+		}
+	}
+	if have != n {
+		return fmt.Errorf("tensor data length %d != shape product %d", have, n)
+	}
+	return nil
+}

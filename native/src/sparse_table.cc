@@ -431,11 +431,16 @@ int32_t st_assign(void* p, const int64_t* keys, int64_t n, const float* vals) {
 
 // export all (key, row) pairs incl. spilled rows; pass null bufs to query
 // count only. (Invariant: a key lives in memory XOR in the spill index.)
+// Holds every shard lock for the duration so concurrent pulls/evictions
+// can't move a key between the memory pass and the spill pass (same
+// snapshot discipline as st_save).
 int64_t st_export(void* p, int64_t* keys_out, float* vals_out, int64_t cap) {
   SparseTable* t = T(p);
+  std::vector<std::unique_lock<std::mutex>> locks;
+  locks.reserve(kShards);
+  for (auto& s : t->shards) locks.emplace_back(s.mu);
   int64_t n = 0;
   for (auto& s : t->shards) {
-    std::lock_guard<std::mutex> g(s.mu);
     for (auto& kv : s.rows) {
       if (keys_out && vals_out) {
         if (n >= cap) return -1;

@@ -221,23 +221,168 @@ class FusedEcMoe(Layer):
 
 
 class FusedMultiTransformer(Layer):
-    """Stacked fused transformer decoder layers sharing one call (reference
-    FusedMultiTransformer — the inference fast path of fused_multi_transformer
-    CUDA kernels; here each layer is the fused encoder layer whose chain XLA
-    fuses)."""
+    """Stacked pre-LN decoder layers in ONE op (reference
+    incubate/nn/layer/fused_transformer.py:1021 FusedMultiTransformer, the
+    inference fast path of fused_multi_transformer_op.cu).
+
+    TPU re-design: all layers' weights live STACKED on a leading [L, ...]
+    dim and the block chain runs as a lax.scan — one traced block regardless
+    of depth (compile time O(1) in L), with XLA fusing the intra-block
+    chain. KV caches are [L, B, H, S_max, D] pairs; ``time_step`` selects
+    the single-token decode path (write K/V at the position, attend over the
+    valid prefix) — the generation loop the CUDA kernel serves.
+    """
 
     def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
-                 activation="gelu", normalize_before=True, num_layers=1, epsilon=1e-5, name=None):
+                 activation="gelu", normalize_before=True, num_layers=1,
+                 epsilon=1e-5, name=None):
         super().__init__()
-        self.layers = nn.LayerList([
-            FusedTransformerEncoderLayer(
-                embed_dim, num_heads, dim_feedforward, dropout_rate=dropout_rate,
-                activation=activation, normalize_before=normalize_before,
-            )
-            for _ in range(num_layers)
-        ])
+        if embed_dim % num_heads:
+            raise ValueError(f"embed_dim {embed_dim} % num_heads {num_heads}")
+        if not normalize_before:
+            raise NotImplementedError("FusedMultiTransformer is pre-LN only "
+                                      "(reference normalize_before=True path)")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dim_feedforward = dim_feedforward
+        self.num_layers = num_layers
+        self.epsilon = epsilon
+        self._act = activation
+        L, H, F_ = num_layers, embed_dim, dim_feedforward
+        mk = self.create_parameter
+        from ...nn import initializer as I
 
-    def forward(self, x, attn_mask=None, caches=None):
-        for lyr in self.layers:
-            x = lyr(x, attn_mask)
-        return x
+        ones, zeros = I.Constant(1.0), I.Constant(0.0)
+        self.ln1_w = mk([L, H], default_initializer=ones)
+        self.ln1_b = mk([L, H], default_initializer=zeros, is_bias=True)
+        self.qkv_w = mk([L, H, 3 * H])
+        self.qkv_b = mk([L, 3 * H], default_initializer=zeros, is_bias=True)
+        self.proj_w = mk([L, H, H])
+        self.proj_b = mk([L, H], default_initializer=zeros, is_bias=True)
+        self.ln2_w = mk([L, H], default_initializer=ones)
+        self.ln2_b = mk([L, H], default_initializer=zeros, is_bias=True)
+        self.ffn1_w = mk([L, H, F_])
+        self.ffn1_b = mk([L, F_], default_initializer=zeros, is_bias=True)
+        self.ffn2_w = mk([L, F_, H])
+        self.ffn2_b = mk([L, H], default_initializer=zeros, is_bias=True)
+
+    def gen_cache(self, batch_size: int, max_seq_len: int, dtype="float32"):
+        """Empty [L, B, heads, S_max, D] K and V caches (reference
+        gen_cache contract for the generation loop)."""
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+
+        shape = (self.num_layers, batch_size, self.num_heads, max_seq_len, self.head_dim)
+        return Tensor(jnp.zeros(shape, dtype)), Tensor(jnp.zeros(shape, dtype))
+
+    def forward(self, x, attn_mask=None, caches=None, time_step=None):
+        """Prefill: x [B, S, H] -> [B, S, H] (causal); filling caches when
+        given. Decode: x [B, 1, H] + time_step -> one-token output with the
+        caches advanced. Returns (out, (k_cache, v_cache)) when caches are
+        passed, else out — the reference's cache_kvs contract."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ...ops._dispatch import apply, as_tensor
+
+        if attn_mask is not None:
+            raise NotImplementedError(
+                "FusedMultiTransformer is causal-only (the generation fast "
+                "path); custom attn_mask is unsupported")
+        x = as_tensor(x)
+        nh, hd, eps, act_name = self.num_heads, self.head_dim, self.epsilon, self._act
+
+        def ln(v, w, b):
+            mu = v.mean(-1, keepdims=True)
+            var = ((v - mu) ** 2).mean(-1, keepdims=True)
+            return (v - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+        def act(v):
+            return jax.nn.gelu(v, approximate=False) if act_name == "gelu" else jax.nn.relu(v)
+
+        def block(h, p, k_layer, v_layer, step):
+            """One decoder block on [B, T, H]; k_layer/v_layer are this
+            layer's cache slices or None."""
+            (l1w, l1b, qkvw, qkvb, pw, pb, l2w, l2b, f1w, f1b, f2w, f2b) = p
+            B, T = h.shape[0], h.shape[1]
+            z = ln(h, l1w, l1b)
+            qkv = z @ qkvw + qkvb  # [B, T, 3H]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)  # [B, nh, T, hd]
+            k = k.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+            v = v.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+            if k_layer is not None:
+                if step is not None:
+                    # decode: write this token's K/V at `step`, attend prefix
+                    zero = jnp.zeros((), step.dtype)
+                    k_layer = lax.dynamic_update_slice(
+                        k_layer, k, (zero, zero, step, zero))
+                    v_layer = lax.dynamic_update_slice(
+                        v_layer, v, (zero, zero, step, zero))
+                    S_max = k_layer.shape[2]
+                    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_layer,
+                                   preferred_element_type=jnp.float32) / jnp.sqrt(float(hd)).astype(jnp.float32)
+                    pos = jnp.arange(S_max)
+                    s = jnp.where(pos[None, None, None, :] <= step, s, -1e30)
+                    o = jnp.einsum("bhqk,bhkd->bhqd",
+                                   jax.nn.softmax(s, -1).astype(v.dtype), v_layer)
+                else:
+                    # prefill: causal attention; caches filled with the prefix
+                    k_layer = lax.dynamic_update_slice(k_layer, k, (0, 0, 0, 0))
+                    v_layer = lax.dynamic_update_slice(v_layer, v, (0, 0, 0, 0))
+                    o = _causal_attn(q, k, v)
+            else:
+                o = _causal_attn(q, k, v)
+            o = o.transpose(0, 2, 1, 3).reshape(B, T, nh * hd)
+            h = h + (o @ pw + pb)
+            z = ln(h, l2w, l2b)
+            h = h + (act(z @ f1w + f1b) @ f2w + f2b)
+            return h, k_layer, v_layer
+
+        def _causal_attn(q, k, v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                           preferred_element_type=jnp.float32) / jnp.sqrt(float(hd)).astype(jnp.float32)
+            T = q.shape[2]
+            s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], s, -1e30)
+            return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1).astype(v.dtype), v)
+
+        params = (self.ln1_w, self.ln1_b, self.qkv_w, self.qkv_b,
+                  self.proj_w, self.proj_b, self.ln2_w, self.ln2_b,
+                  self.ffn1_w, self.ffn1_b, self.ffn2_w, self.ffn2_b)
+
+        if caches is None:
+            def fn(xv, *pv):
+                def body(h, layer_p):
+                    h2, _, _ = block(h, layer_p, None, None, None)
+                    return h2, None
+                out, _ = lax.scan(body, xv, tuple(pv))
+                return out
+
+            return apply("fused_multi_transformer", fn, x, *params)
+
+        k_cache, v_cache = caches
+        k_cache, v_cache = as_tensor(k_cache), as_tensor(v_cache)
+        step_t = as_tensor(time_step) if time_step is not None else None
+        has_step = step_t is not None
+
+        def fn(xv, kc, vc, *rest):
+            if has_step:
+                step = rest[0].astype(jnp.int32).reshape(())
+                pv = rest[1:]
+            else:
+                step, pv = None, rest
+
+            def body(h, layer_in):
+                layer_p, kl, vl = layer_in[:-2], layer_in[-2], layer_in[-1]
+                h2, kl2, vl2 = block(h, layer_p, kl, vl, step)
+                return h2, (kl2, vl2)
+
+            out, (nk, nv) = lax.scan(body, xv, tuple(pv) + (kc, vc))
+            return out, nk, nv
+
+        args = (x, k_cache, v_cache) + ((step_t,) if has_step else ()) + params
+        out, nk, nv = apply("fused_multi_transformer_cached", fn, *args)
+        return out, (nk, nv)

@@ -4,7 +4,6 @@ and an end-to-end PS-backed embedding training flow."""
 
 import os
 
-import os
 
 import numpy as np
 import pytest
