@@ -63,6 +63,18 @@ class enable_grad(_GradMode):
         super().__init__(True)
 
 
+_live_nodes = 0
+
+
+def live_node_count() -> int:
+    """Tape nodes currently alive (diagnostic for the forward-only-leak
+    hazard: running inference on grad-requiring params WITHOUT no_grad keeps
+    every op's node + inputs reachable through the output's grad chain —
+    wrap inference in paddle.no_grad(), as the reference does with
+    paddle.no_grad over eval loops)."""
+    return _live_nodes
+
+
 class Node:
     """One recorded op: inputs, output avals/treedef, and the vjp closure.
 
@@ -76,6 +88,8 @@ class Node:
 
     def __init__(self, op_name: str, inputs: Sequence, vjp_fn: Callable, out_avals: List, out_tree,
                  pure_fn: Optional[Callable] = None):
+        global _live_nodes
+        _live_nodes += 1
         self.op_name = op_name
         self.inputs = list(inputs)  # Tensors feeding this op (recorded order)
         self.vjp_fn = vjp_fn
@@ -84,6 +98,10 @@ class Node:
         self.out_tree = out_tree  # treedef of the op's output pytree
         self.hooks = {}  # out_index -> [hook]
         self.released = False
+
+    def __del__(self):
+        global _live_nodes
+        _live_nodes -= 1
 
     def add_hook(self, out_index: int, hook: Callable):
         self.hooks.setdefault(out_index, []).append(hook)

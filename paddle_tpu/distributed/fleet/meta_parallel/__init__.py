@@ -10,6 +10,7 @@ from .pipeline_parallel import (  # noqa: F401
     PipelineParallelWithInterleave,
     PipelineSpec,
     pipeline_schedule,
+    pipeline_schedule_interleaved,
     spmd_pipeline,
     stack_block_params,
     unstack_block_params,

@@ -62,41 +62,70 @@ class PipelineSpec:
     post_loss: Callable
 
 
-def stack_block_params(params: dict, spec: PipelineSpec, pp: int):
+def _chunk_order(L: int, pp: int, v: int):
+    """Layer order for chunk-major stacking: chunk j (j = r*pp + d) covers
+    layers [j*Lpc, (j+1)*Lpc); device d holds its chunks r = 0..v-1 in local
+    order, so global index (d, r, i) -> layer (r*pp + d)*Lpc + i."""
+    Lpc = L // (pp * v)
+    order = []
+    for d in range(pp):
+        for r in range(v):
+            j = r * pp + d
+            order.extend(range(j * Lpc, (j + 1) * Lpc))
+    return order
+
+
+def stack_block_params(params: dict, spec: PipelineSpec, pp: int,
+                       virtual_stages: int = 1):
     """Split {name: array} into (stacked, other): per-block params stacked to
     [pp, L/pp, ...] leaves (contiguous blocks per stage), the rest untouched.
+    With virtual_stages=v > 1 the layout is [pp, v, L/(pp*v), ...] chunk-major
+    (device d's chunk r is model chunk r*pp + d — the Megatron interleaved
+    assignment, reference pp_layers.py get_stage_from_index).
 
     Returns (stacked: {suffix: array}, other: {name: array}).
     """
     L = spec.n_blocks
-    if L % pp:
-        raise ValueError(f"n_blocks {L} not divisible by pp degree {pp}")
+    v = virtual_stages
+    if L % (pp * v):
+        raise ValueError(f"n_blocks {L} not divisible by pp*virtual {pp}*{v}")
     pat = re.compile(rf"^{re.escape(spec.block_prefix)}\.(\d+)\.(.+)$")
     by_suffix: dict = {}
     other = {}
-    for name, v in params.items():
+    for name, val in params.items():
         m = pat.match(name)
         if m:
-            by_suffix.setdefault(m.group(2), {})[int(m.group(1))] = v
+            by_suffix.setdefault(m.group(2), {})[int(m.group(1))] = val
         else:
-            other[name] = v
+            other[name] = val
+    order = _chunk_order(L, pp, v) if v > 1 else list(range(L))
     stacked = {}
     for suffix, by_idx in by_suffix.items():
         if len(by_idx) != L:
             raise ValueError(f"block param {suffix}: have {len(by_idx)} of {L} layers")
-        leaves = [by_idx[i] for i in range(L)]
-        arr = jnp.stack(leaves)
-        stacked[suffix] = arr.reshape((pp, L // pp) + arr.shape[1:])
+        arr = jnp.stack([by_idx[i] for i in order])
+        if v > 1:
+            stacked[suffix] = arr.reshape((pp, v, L // (pp * v)) + arr.shape[1:])
+        else:
+            stacked[suffix] = arr.reshape((pp, L // pp) + arr.shape[1:])
     return stacked, other
 
 
-def unstack_block_params(stacked: dict, spec: PipelineSpec) -> dict:
-    """Inverse of stack_block_params: {suffix: [pp, L/pp, ...]} -> flat names."""
+def unstack_block_params(stacked: dict, spec: PipelineSpec,
+                         pp: Optional[int] = None, virtual_stages: int = 1) -> dict:
+    """Inverse of stack_block_params: stacked leaves -> flat layer names."""
     out = {}
     for suffix, arr in stacked.items():
-        flat = arr.reshape((-1,) + arr.shape[2:])
-        for i in range(flat.shape[0]):
-            out[f"{spec.block_prefix}.{i}.{suffix}"] = flat[i]
+        if virtual_stages > 1:
+            flat = arr.reshape((-1,) + arr.shape[3:])
+            L = flat.shape[0]
+            order = _chunk_order(L, pp if pp is not None else arr.shape[0], virtual_stages)
+            for pos, layer in enumerate(order):
+                out[f"{spec.block_prefix}.{layer}.{suffix}"] = flat[pos]
+        else:
+            flat = arr.reshape((-1,) + arr.shape[2:])
+            for i in range(flat.shape[0]):
+                out[f"{spec.block_prefix}.{i}.{suffix}"] = flat[i]
     return out
 
 
@@ -178,9 +207,12 @@ class PipelineParallel(Layer):
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """Interleaved virtual stages (reference :514). Host-driven dispatch makes
-    the schedule distinction moot (XLA queues per-device work in issue order);
-    kept for API parity."""
+    """Interleaved virtual stages (reference :514). This host-driven wrapper
+    keeps the reference's eager train_batch contract; the COMPILED
+    interleaved schedule is `pipeline_schedule_interleaved` below (reached
+    via make_sharded_train_step(virtual_pp_degree=v)), which gives the
+    v-fold-smaller warmup/cooldown bubble the reference's interleaved 1F1B
+    exists for."""
 
 
 def pipeline_schedule(
@@ -251,6 +283,126 @@ def pipeline_schedule(
     probe = jax.eval_shape(lambda p, x: stage_fn(p, x), my_params, init_in)
     outputs0 = jnp.zeros((M,) + tuple(probe.shape), probe.dtype)
     (_, outputs), _ = lax.scan(tick, (init_in, outputs0), jnp.arange(M + n - 1))
+    return outputs
+
+
+def _simulate_interleaved_ticks(n: int, v: int, M: int) -> int:
+    """Host-side simulation of the greedy interleaved ring below (returning
+    laps preempt fresh injections): exact tick count to finish all M
+    microbatches through n*v chunks. Deterministic, so the traced scan can
+    use the exact length."""
+    slots = [None] * n  # per-device incoming (mb, chunk) or None
+    fresh = 0
+    done = 0
+    t = 0
+    while done < M:
+        nxt = [None] * n
+        for d in range(n):
+            work = slots[d]
+            if d == 0 and work is None and fresh < M:
+                work = (fresh, 0)
+                fresh += 1
+            if work is None:
+                continue
+            mb, chunk = work
+            if chunk + 1 == n * v:
+                done += 1
+            else:
+                nxt[(d + 1) % n] = (mb, chunk + 1)
+        slots = nxt
+        t += 1
+        if t > (M + n) * n * v + n:  # safety: schedule must have converged
+            raise RuntimeError("interleaved schedule failed to converge")
+    return t
+
+
+def pipeline_schedule_interleaved(
+    stage_fn: Callable,
+    stacked_params,
+    microbatches,
+    axis_name: str = "pp",
+    n_stages: Optional[int] = None,
+    virtual_stages: int = 2,
+    remat: bool = True,
+):
+    """Interleaved virtual-stage pipeline (reference
+    PipelineParallelWithInterleave, pipeline_parallel.py:514): device d owns
+    model chunks {r*n + d}, every microbatch circles the ring v times, and
+    the warmup/cooldown bubble shrinks from (n-1) stage-ticks to (n-1)
+    CHUNK-ticks — a v-fold smaller bubble fraction.
+
+    stacked_params: local leaves [1, v, Lpc, ...] (sharded over axis_name) —
+    the chunk-major layout stack_block_params(virtual_stages=v) produces.
+    stage_fn(chunk_params, x) applies ONE chunk (Lpc blocks).
+
+    Schedule: a validity-tagged slot rotates the ring each tick; a device
+    executes its incoming chunk work if valid, and stage 0 injects a fresh
+    microbatch whenever its slot is free (returning laps take priority).
+    Differentiation transposes the whole scan+ppermute program = the
+    interleaved backward schedule. Returns [M, mb, ...] outputs valid ONLY
+    on the LAST stage (zeros elsewhere), like pipeline_schedule.
+    """
+    n = n_stages if n_stages is not None else lax.axis_size(axis_name)
+    v = virtual_stages
+    my = jax.tree_util.tree_map(
+        lambda p: p[0] if hasattr(p, "shape") and p.shape and p.shape[0] == 1 else p,
+        stacked_params)
+    stage_idx = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    T = _simulate_interleaved_ticks(n, v, M)
+
+    probe_params = jax.tree_util.tree_map(lambda p: p[0], my)
+    probe = jax.eval_shape(lambda p, x: stage_fn(p, x),
+                           probe_params, jnp.zeros(mb_shape, microbatches.dtype))
+    out_dtype = probe.dtype
+
+    def tick(carry, _):
+        act, mb_idx, chunk_idx, valid, fresh, outputs = carry
+        # stage 0 injects a fresh microbatch into a free slot
+        inject = (stage_idx == 0) & (~valid) & (fresh < M)
+        act = jnp.where(inject, microbatches[jnp.clip(fresh, 0, M - 1)], act)
+        mb_idx = jnp.where(inject, fresh, mb_idx)
+        chunk_idx = jnp.where(inject, 0, chunk_idx)
+        valid = valid | inject
+        fresh = fresh + jnp.where(inject, 1, 0)
+        # execute this device's chunk r = chunk_idx // n for the slot
+        from ....core import random as _random
+
+        r = jnp.clip(chunk_idx // n, 0, v - 1)
+        chunk_params = jax.tree_util.tree_map(lambda p: p[r], my)
+        # salt RNG with (microbatch, chunk) so dropout masks are distinct
+        # per microbatch AND per virtual chunk (the scan body traces once)
+        with _random.key_salt(mb_idx * (n * v) + chunk_idx):
+            y = fn(chunk_params, act)
+        y = jnp.where(valid, y, act)  # bubbles pass through untouched
+        # finished microbatches (chunk nv-1, which lives on stage n-1) record
+        finishing = valid & (chunk_idx == n * v - 1)
+        outputs = lax.cond(
+            finishing,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y.astype(out_dtype), jnp.clip(mb_idx, 0, M - 1), 0),
+            lambda o: o,
+            outputs,
+        )
+        out_valid = valid & ~finishing
+        nxt = (lax.ppermute(y, axis_name, perm),
+               lax.ppermute(mb_idx, axis_name, perm),
+               lax.ppermute(chunk_idx + 1, axis_name, perm),
+               lax.ppermute(out_valid, axis_name, perm))
+        return (nxt[0], nxt[1], nxt[2], nxt[3], fresh, outputs), None
+
+    init = (
+        jnp.zeros(mb_shape, microbatches.dtype),
+        jnp.zeros((), jnp.int32),
+        jnp.zeros((), jnp.int32),
+        jnp.zeros((), bool),
+        jnp.zeros((), jnp.int32),
+        jnp.zeros((M,) + tuple(probe.shape), out_dtype),
+    )
+    (_, _, _, _, _, outputs), _ = lax.scan(tick, init, None, length=T)
     return outputs
 
 

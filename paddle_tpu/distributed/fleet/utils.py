@@ -86,6 +86,7 @@ class ShardedTrainStep:
         seed: int = 0,
         accumulate_steps: Optional[int] = None,
         pp_remat: bool = True,
+        virtual_pp_degree: int = 1,
     ):
         from ..topology import get_hybrid_communicate_group
 
@@ -122,7 +123,9 @@ class ShardedTrainStep:
             pspec = model.pipeline_spec()
             self._pspec = pspec
             self._accum = accumulate_steps if accumulate_steps else pp
-            stacked0, other0 = stack_block_params(params0, pspec, pp)
+            self._vpp = max(int(virtual_pp_degree), 1)
+            stacked0, other0 = stack_block_params(params0, pspec, pp,
+                                                  virtual_stages=self._vpp)
             self._stack_prefix = f"{pspec.block_prefix}.__stacked__."
             skey = lambda sfx: f"{self._stack_prefix}{sfx}"
             self._suffixes = sorted(stacked0)
@@ -133,11 +136,12 @@ class ShardedTrainStep:
             for name in other0:
                 p_shard[name] = NamedSharding(
                     mesh, resolve_spec(getattr(named[name], "dist_spec", None), mesh))
+            lead = ("pp", None, None) if self._vpp > 1 else ("pp", None)
             for sfx in self._suffixes:
                 ref = named[f"{pspec.block_prefix}.0.{sfx}"]
                 bspec = resolve_spec(getattr(ref, "dist_spec", None), mesh)
                 entries = list(bspec) + [None] * (ref._value.ndim - len(bspec))
-                p_shard[skey(sfx)] = NamedSharding(mesh, P("pp", None, *entries))
+                p_shard[skey(sfx)] = NamedSharding(mesh, P(*lead, *entries))
         else:
             p_shard = param_shardings(model, mesh)
 
@@ -242,11 +246,13 @@ class ShardedTrainStep:
         with grads flowing through its transpose (the backward pipeline)."""
         from jax import lax, shard_map
 
-        from .meta_parallel.pipeline_parallel import pipeline_schedule
+        from .meta_parallel.pipeline_parallel import (
+            pipeline_schedule, pipeline_schedule_interleaved)
 
         pspec = self._pspec
         mesh = self.mesh
         M = self._accum
+        vpp = self._vpp
         prefix = self._stack_prefix
 
         from ..sharding_utils import maybe_shard
@@ -285,8 +291,13 @@ class ShardedTrainStep:
                         h, _ = lax.scan(one, h, (bp, jnp.arange(Lps)))
                         return h
 
-                    outs = pipeline_schedule(stage, stacked_loc, mbs_loc,
-                                             axis_name="pp", remat=remat)
+                    if vpp > 1:
+                        outs = pipeline_schedule_interleaved(
+                            stage, stacked_loc, mbs_loc, axis_name="pp",
+                            virtual_stages=vpp, remat=remat)
+                    else:
+                        outs = pipeline_schedule(stage, stacked_loc, mbs_loc,
+                                                 axis_name="pp", remat=remat)
                     # expose the per-stage outputs on a leading pp axis; the
                     # caller slices the last stage — no psum broadcast of
                     # microbatch activations
@@ -331,7 +342,8 @@ class ShardedTrainStep:
             prefix = self._stack_prefix
             stacked = {k[len(prefix):]: v for k, v in self.params.items()
                        if k.startswith(prefix)}
-            flat = unstack_block_params(stacked, self._pspec)
+            flat = unstack_block_params(stacked, self._pspec, pp=self._pp,
+                                        virtual_stages=self._vpp)
             for name, v in self.params.items():
                 if not name.startswith(prefix):
                     named[name]._set_value_raw(v)

@@ -310,10 +310,89 @@ def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
 
 
 # ---------------- control flow ----------------
+def _capture_subprogram(run_fn):
+    """Record run_fn's ops into a fresh sub-Program (the reference's
+    sub-block, while_op/conditional_block design). Returns
+    (subprog, result)."""
+    from ..program import Program, _program_stack
+
+    sub = Program()
+    _program_stack.append(sub)
+    try:
+        result = run_fn()
+    finally:
+        _program_stack.pop()
+    return sub, result
+
+
+def _sub_externals(subs, internal_tids):
+    """Tensors a sub-program reads that it neither produces nor receives as
+    carries: they become inputs of the parent control-flow node, so feeds
+    propagate into the block at replay."""
+    from ..program import _OpNode
+
+    produced = set(internal_tids)
+    ext_ids, ext_tensors = [], []
+    for sub in subs:
+        for node in sub.nodes:
+            if not isinstance(node, _OpNode):
+                continue
+            for tid in node.in_ids:
+                if tid not in produced and tid not in ext_ids:
+                    ext_ids.append(tid)
+                    ext_tensors.append(sub.tensors[tid])
+            produced.update(node.out_ids)
+    return ext_ids, ext_tensors
+
+
+def _sub_produced(sub):
+    from ..program import _OpNode
+
+    out = set()
+    for node in sub.nodes:
+        if isinstance(node, _OpNode):
+            out.update(node.out_ids)
+    return out
+
+
+def _add_passthrough_externals(tensors, produced, skip, ext_ids, ext_tensors):
+    """Block RESULTS that no recorded op produced (identity branches like
+    `lambda: x` over a placeholder) must still be node inputs, else replay
+    falls back to their capture-time values and feeds never reach them."""
+    for t in tensors:
+        if not isinstance(t, Tensor):
+            continue
+        tid = id(t)
+        if tid not in produced and tid not in skip and tid not in ext_ids:
+            ext_ids.append(tid)
+            ext_tensors.append(t)
+
+
+def _sub_replay(sub, env):
+    from ..program import _OpNode
+
+    for node in sub.nodes:
+        if not isinstance(node, _OpNode):
+            continue
+        vals = node.fn(*[env.get(tid, None) if env.get(tid) is not None
+                         else sub.tensors[tid]._value for tid in node.in_ids])
+        import jax
+
+        for tid, leaf in zip(node.out_ids, jax.tree_util.tree_leaves(vals)):
+            env[tid] = leaf
+
+
 def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
     """Two-branch conditional (reference control_flow.py cond): traced pred
-    runs both branches and selects leaf-wise; concrete pred runs one."""
+    runs both branches and selects leaf-wise; concrete pred runs one.
+
+    In static-capture mode each branch records into a sub-Program
+    (conditional_block_op design) and ONE node replays them with the feeds
+    flowing in — the conditional survives into the captured program."""
+    import jax.numpy as jnp
+
     from ...jit.dy2static import convert_ifelse
+    from ...nn.layer.layers import in_dynamic_mode
 
     t_fn = true_fn if true_fn is not None else (lambda: None)
     f_fn = false_fn if false_fn is not None else (lambda: None)
@@ -325,10 +404,43 @@ def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
             return tuple(leaves)
         return run
 
-    res = convert_ifelse(pred, norm(t_fn), norm(f_fn), (), names=())
-    if len(res) == 1:
-        return res[0]
-    return list(res)
+    def unwrap(res):
+        if len(res) == 1:
+            return res[0]
+        return list(res)
+
+    if in_dynamic_mode():
+        return unwrap(convert_ifelse(pred, norm(t_fn), norm(f_fn), (), names=()))
+
+    pred_t = as_tensor(pred)
+    sub_t, outs_t = _capture_subprogram(lambda: norm(t_fn)(()))
+    sub_f, outs_f = _capture_subprogram(lambda: norm(f_fn)(()))
+    if len(outs_t) != len(outs_f):
+        raise ValueError("cond branches must return the same number of outputs")
+    t_out_ids = [id(o) for o in outs_t]
+    f_out_ids = [id(o) for o in outs_f]
+    ext_ids, ext_tensors = _sub_externals([sub_t, sub_f], [])
+    _add_passthrough_externals(outs_t, _sub_produced(sub_t), set(), ext_ids, ext_tensors)
+    _add_passthrough_externals(outs_f, _sub_produced(sub_f), set(), ext_ids, ext_tensors)
+
+    def fn(p_raw, *ext_raws):
+        ext_env = dict(zip(ext_ids, ext_raws))
+        env_t = dict(ext_env)
+        env_f = dict(ext_env)
+        _sub_replay(sub_t, env_t)
+        _sub_replay(sub_f, env_f)
+        c = jnp.squeeze(jnp.asarray(p_raw)).astype(bool)
+        outs = []
+        for ti, fi, ot, of in zip(t_out_ids, f_out_ids, outs_t, outs_f):
+            tv = env_t.get(ti, ot._value if isinstance(ot, Tensor) else ot)
+            fv = env_f.get(fi, of._value if isinstance(of, Tensor) else of)
+            outs.append(jnp.where(c, tv, fv))
+        return tuple(outs)
+
+    res = apply("cond", fn, pred_t, *ext_tensors)
+    if not isinstance(res, (tuple, list)):
+        return res
+    return unwrap(tuple(res))
 
 
 def case(pred_fn_pairs, default=None, name=None):
@@ -390,18 +502,74 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
 def while_loop(cond, body, loop_vars, is_test=False, name=None):
     """reference while_loop: compiled lax.while_loop under a trace, python
-    loop eagerly (jit/dy2static convert_while)."""
+    loop eagerly (jit/dy2static convert_while).
+
+    In static-capture mode the loop records as ONE Program node (the
+    reference's while_op role): replay re-runs the convert call on the
+    replay values, so the trip count follows the FEEDS — the loop is not
+    unrolled at capture time."""
     from ...jit.dy2static import convert_while
+    from ...nn.layer.layers import in_dynamic_mode
 
     n = len(loop_vars)
+    names = tuple(f"var{i}" for i in range(n))
 
     def body_wrap(vars_):
         out = body(*vars_)
         return tuple(out) if isinstance(out, (list, tuple)) else (out,)
 
-    out = convert_while(lambda vars_: cond(*vars_), body_wrap, tuple(loop_vars),
-                        names=tuple(f"var{i}" for i in range(n)))
-    return list(out)
+    if in_dynamic_mode():
+        out = convert_while(lambda vars_: cond(*vars_), body_wrap,
+                            tuple(loop_vars), names=names)
+        return list(out)
+
+    # static capture (while_op sub-block design): record cond and body ONCE
+    # into sub-Programs against carry placeholders; the parent node replays
+    # them inside lax.while_loop, with external reads (feeds, upstream
+    # results) wired in as node inputs
+    import jax.numpy as jnp
+    from jax import lax
+
+    vars_t = [as_tensor(v) for v in loop_vars]
+    carries = [Tensor(v._value) for v in vars_t]  # placeholders for the block
+    carry_ids = [id(c) for c in carries]
+    sub_c, cond_out = _capture_subprogram(lambda: as_tensor(cond(*carries)))
+    sub_b, body_out = _capture_subprogram(lambda: body_wrap(tuple(carries)))
+    body_out = [as_tensor(o) for o in body_out]
+    if len(body_out) != n:
+        raise ValueError(f"while_loop body returned {len(body_out)} values for {n} loop_vars")
+    cond_id = id(cond_out)
+    out_ids = [id(o) for o in body_out]
+    ext_ids, ext_tensors = _sub_externals([sub_c, sub_b], carry_ids)
+    carry_set = set(carry_ids)
+    _add_passthrough_externals(body_out + [cond_out], _sub_produced(sub_b) | _sub_produced(sub_c),
+                               carry_set, ext_ids, ext_tensors)
+
+    def fn(*raws):
+        carry0 = tuple(jnp.asarray(r) for r in raws[:n])
+        ext_env = dict(zip(ext_ids, raws[n:]))
+
+        def cond_fn(carry):
+            env = dict(ext_env)
+            env.update(zip(carry_ids, carry))
+            _sub_replay(sub_c, env)
+            c = env.get(cond_id, cond_out._value)
+            return jnp.squeeze(jnp.asarray(c)).astype(bool)
+
+        def body_fn(carry):
+            env = dict(ext_env)
+            env.update(zip(carry_ids, carry))
+            _sub_replay(sub_b, env)
+            return tuple(
+                jnp.asarray(env.get(oid, o._value)).astype(c0.dtype).reshape(c0.shape)
+                for oid, o, c0 in zip(out_ids, body_out, carry0))
+
+        return lax.while_loop(cond_fn, body_fn, carry0)
+
+    outs = apply("while_loop", fn, *(vars_t + ext_tensors))
+    if not isinstance(outs, (tuple, list)):
+        return [outs]
+    return list(outs)[:n]
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None, name=None):

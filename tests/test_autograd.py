@@ -336,3 +336,57 @@ class TestHigherOrder:
         assert seed.name == "myseed"
         (leaf * 1.0).backward()
         np.testing.assert_allclose(seed.numpy(), [5.0, 5.0])
+
+
+class TestTapeMemory:
+    """The forward-only tape-growth hazard (round-1 weak item): iterating
+    inference on grad-requiring params without no_grad chains every step's
+    nodes through the carried output. no_grad must record nothing, and
+    dropping the output must free the whole chain."""
+
+    def test_no_grad_records_no_nodes(self):
+        import gc
+
+        from paddle_tpu.core.autograd import live_node_count
+
+        lin = paddle.nn.Linear(8, 8)
+        h = paddle.to_tensor(np.ones((2, 8), np.float32))
+        gc.collect()
+        base = live_node_count()
+        with paddle.no_grad():
+            for _ in range(20):
+                h = lin(h) * 0.5
+        gc.collect()
+        assert live_node_count() == base
+
+    def test_dropping_output_frees_chain(self):
+        import gc
+
+        from paddle_tpu.core.autograd import live_node_count
+
+        lin = paddle.nn.Linear(8, 8)
+        gc.collect()
+        base = live_node_count()
+        h = paddle.to_tensor(np.ones((2, 8), np.float32))
+        for _ in range(10):
+            h = lin(h) * 0.5
+        grown = live_node_count()
+        assert grown > base  # the hazard is real without no_grad
+        del h
+        gc.collect()
+        assert live_node_count() <= base + 1
+
+    def test_backward_release_frees_nodes(self):
+        import gc
+
+        from paddle_tpu.core.autograd import live_node_count
+
+        lin = paddle.nn.Linear(8, 8)
+        gc.collect()
+        base = live_node_count()
+        h = paddle.to_tensor(np.ones((2, 8), np.float32))
+        loss = (lin(h) ** 2).mean()
+        loss.backward()  # retain_graph=False releases node payloads
+        del loss
+        gc.collect()
+        assert live_node_count() <= base + 1

@@ -129,3 +129,64 @@ def test_eager_mode_unaffected():
     before = len(static.default_main_program().ops)
     x = paddle.ones([2, 2]) * 3
     assert len(static.default_main_program().ops) == before
+
+
+def test_static_while_and_cond_follow_feeds():
+    """Data-dependent control flow survives capture (while_op /
+    conditional_block sub-block design): one recorded node per construct,
+    trip count and branch follow the FEEDS at replay — not burned in."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.static import nn as snn
+
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            n = static.data("n", [], "int32")
+            x = static.data("x", [2], "float32")
+            flag = static.data("flag", [], "float32")
+            i, acc = snn.while_loop(
+                lambda i, acc: i < n,
+                lambda i, acc: [i + 1, acc + x],
+                [paddle.to_tensor(np.int32(0)),
+                 paddle.to_tensor(np.zeros(2, np.float32))])
+            out = snn.cond(flag.sum() > 0, lambda: acc * 2.0, lambda: acc * -1.0)
+        exe = static.Executor()
+        xv = np.array([1.0, 2.0], np.float32)
+        r = exe.run(prog, feed={"n": np.int32(3), "x": xv, "flag": np.float32(1.0)},
+                    fetch_list=[out])
+        np.testing.assert_allclose(r[0], [6.0, 12.0])
+        r = exe.run(prog, feed={"n": np.int32(5), "x": xv, "flag": np.float32(-1.0)},
+                    fetch_list=[out])
+        np.testing.assert_allclose(r[0], [-5.0, -10.0])
+        r = exe.run(prog, feed={"n": np.int32(0), "x": xv, "flag": np.float32(1.0)},
+                    fetch_list=[out])
+        np.testing.assert_allclose(r[0], [0.0, 0.0])
+    finally:
+        paddle.disable_static()
+
+
+def test_static_cond_identity_branches_follow_feeds():
+    """Branch results that ARE placeholders (no recorded op) must still wire
+    as node inputs — feeds reach pass-through branches."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.static import nn as snn
+
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            flag = static.data("flag", [], "float32")
+            x = static.data("cx", [2], "float32")
+            y = static.data("cy", [2], "float32")
+            out = snn.cond(flag.sum() > 0, lambda: x, lambda: y)
+        exe = static.Executor()
+        feed = {"flag": np.float32(1.0), "cx": np.array([3.0, 4.0], np.float32),
+                "cy": np.array([7.0, 8.0], np.float32)}
+        np.testing.assert_allclose(exe.run(prog, feed=feed, fetch_list=[out])[0], [3.0, 4.0])
+        feed["flag"] = np.float32(-1.0)
+        np.testing.assert_allclose(exe.run(prog, feed=feed, fetch_list=[out])[0], [7.0, 8.0])
+    finally:
+        paddle.disable_static()
