@@ -1,0 +1,13 @@
+"""Role makers (reference fleet/base/role_maker.py): env-parsing worker/
+server identity for collective and PS modes. The concrete classes live in
+fleet/__init__ (facade parity); this module gives them the reference's
+module path so `from paddle.distributed.fleet.base import role_maker` code
+ports unchanged."""
+
+from ... import fleet as _fleet
+
+Role = _fleet.Role
+UserDefinedRoleMaker = _fleet.UserDefinedRoleMaker
+PaddleCloudRoleMaker = _fleet.PaddleCloudRoleMaker
+
+__all__ = ["Role", "UserDefinedRoleMaker", "PaddleCloudRoleMaker"]

@@ -62,6 +62,33 @@ class PipelineSpec:
     post_loss: Callable
 
 
+def make_layer_stack_pipeline_spec(model, block_layer, block_prefix: str,
+                                   n_blocks: int, embed_method: str = "embed",
+                                   head_method: str = "head_loss") -> PipelineSpec:
+    """Build the PipelineSpec for the common homogeneous-stack shape: a model
+    exposing ``embed(x)`` (pre) and ``head_loss(h, y)`` (post) methods plus a
+    LayerList of identical blocks. GPT/BERT/ERNIE all use this."""
+    import jax.numpy as jnp
+
+    from ....core.tensor import Tensor
+
+    def pre(params, buffers, x):
+        out, _ = model.functional_call(params, buffers, Tensor(x), method=embed_method)
+        return out._value
+
+    def block(bp, h):
+        out, _ = block_layer.functional_call(bp, {}, Tensor(h))
+        return out._value
+
+    def post_loss(params, buffers, h, y):
+        out, _ = model.functional_call(
+            params, buffers, Tensor(h), Tensor(y), method=head_method)
+        return out._value.astype(jnp.float32)
+
+    return PipelineSpec(block_prefix=block_prefix, n_blocks=n_blocks,
+                        pre=pre, block=block, post_loss=post_loss)
+
+
 def _chunk_order(L: int, pp: int, v: int):
     """Layer order for chunk-major stacking: chunk j (j = r*pp + d) covers
     layers [j*Lpc, (j+1)*Lpc); device d holds its chunks r = 0..v-1 in local

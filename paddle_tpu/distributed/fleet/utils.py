@@ -311,9 +311,17 @@ class ShardedTrainStep:
                     check_vma=False,
                 )(stacked, mbs)
                 h_last = outs_g[-1]  # [M, mb, ...] — the last stage's stream
-                h_out = jnp.swapaxes(h_last, 0, 1).reshape((B,) + h_last.shape[2:])
-                h_out = maybe_shard(h_out, P(("dp", "pp")))
-                loss = pspec.post_loss(other, buffers0, h_out, y)
+                # loss PER MICROBATCH, averaged — the reference's train_batch
+                # semantics (matters for ratio losses like masked-LM, where a
+                # full-batch loss is NOT the mean of microbatch losses; it is
+                # also what plain gradient accumulation computes). vmap keeps
+                # the M head matmuls batched (one MXU call, not M serial)
+                ys = jnp.swapaxes(y.reshape((B // M, M) + y.shape[1:]), 0, 1)
+                h_last = maybe_shard(h_last, P(None, ("dp", "pp")))
+                per_mb = jax.vmap(
+                    lambda hm, ym: pspec.post_loss(other, buffers0, hm, ym))(
+                    h_last, ys)
+                loss = jnp.mean(per_mb.astype(jnp.float32))
             return loss.astype(jnp.float32)
 
         return pipe_loss

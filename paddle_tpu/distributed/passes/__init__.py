@@ -1,0 +1,241 @@
+"""Distributed pass infrastructure (python/paddle/distributed/passes/:
+pass_base.py PassBase:50 / register_pass:124 / new_pass:133 /
+PassManager:353, plus the auto_parallel_* pass files).
+
+TPU re-design: the reference's passes REWRITE serial programs (insert casts,
+recompute ops, allreduce fusion...). Here a pass rewrites the TRAINING
+RECIPE — a dict of knobs the sharded-step builder and strategy already
+consume (amp dtype, remat policy, gradient accumulation, ZeRO stage, mesh
+degrees) — because the program rewriting itself is XLA's job (GSPMD
+partitioning, fusion, DCE). The pass API (names, attrs, manager ordering,
+applicability checks) matches the reference so orchestration code ports;
+what a pass DOES is set the equivalent TPU knob.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional
+
+__all__ = ["PassBase", "PassContext", "PassManager", "new_pass", "register_pass"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+class PassContext:
+    """Carries cross-pass state (reference PassContext): here, the
+    accumulated recipe dict the train-step builder consumes."""
+
+    def __init__(self):
+        self.recipe: Dict[str, object] = {}
+        self.attrs: Dict[str, object] = {}
+
+    def set_attr(self, key, value):
+        self.attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self.attrs.get(key, default)
+
+
+class PassBase(ABC):
+    """reference pass_base.py:50. Subclasses set _attrs defaults, implement
+    _check_self/_check_conflict and _apply_single_impl."""
+
+    name: str = ""
+
+    def __init__(self):
+        self._attrs: Dict[str, object] = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    def _check_self(self) -> bool:
+        return True
+
+    def _check_conflict(self, other) -> bool:
+        return True
+
+    def apply(self, main_programs=None, startup_programs=None, context: Optional[PassContext] = None):
+        """Apply to the recipe in `context` (programs accepted for signature
+        parity; the XLA pipeline has no serial program to mutate)."""
+        context = context if context is not None else PassContext()
+        if not self._check_self():
+            raise ValueError(f"pass {self.name!r} attrs invalid: {self._attrs}")
+        self._apply_single_impl(main_programs, startup_programs, context)
+        return context
+
+    @abstractmethod
+    def _apply_single_impl(self, main_program, startup_program, context: PassContext):
+        ...
+
+
+def register_pass(name):
+    def wrap(cls):
+        cls.name = name
+        _REGISTRY[name] = cls
+        return cls
+
+    return wrap
+
+
+def new_pass(name, pass_attrs: Optional[dict] = None) -> PassBase:
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown pass {name!r}; registered: {sorted(_REGISTRY)}")
+    p = _REGISTRY[name]()
+    for k, v in (pass_attrs or {}).items():
+        p.set_attr(k, v)
+    return p
+
+
+class PassManager:
+    """reference pass_base.py:353: ordered application with conflict checks."""
+
+    def __init__(self, passes: List[PassBase]):
+        self._passes = list(passes)
+        for i, p in enumerate(self._passes):
+            for q in self._passes[:i]:
+                if not p._check_conflict(q):
+                    raise ValueError(f"pass {p.name!r} conflicts with {q.name!r}")
+        self.context = PassContext()
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+    def apply(self, main_programs=None, startup_programs=None):
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, self.context)
+        return self.context
+
+
+# ---------------- the auto_parallel_* passes as recipe rewrites ----------------
+@register_pass("auto_parallel_amp")
+class AMPPass(PassBase):
+    """auto_parallel_amp.py: O1 mixed precision -> dispatch-seam auto_cast."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        context.recipe["amp"] = {
+            "enable": True, "level": self.get_attr("level", "O1"),
+            "dtype": self.get_attr("dtype", "bfloat16"),
+        }
+
+
+@register_pass("auto_parallel_fp16")
+class FP16Pass(AMPPass):
+    """auto_parallel_fp16.py: O2 pure half precision."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        context.recipe["amp"] = {
+            "enable": True, "level": "O2",
+            "dtype": self.get_attr("dtype", "bfloat16"),
+        }
+
+
+@register_pass("auto_parallel_recompute")
+class RecomputePass(PassBase):
+    """auto_parallel_recompute.py -> jax.checkpoint policy knobs."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        context.recipe["recompute"] = {
+            "enable": True,
+            "policy": self.get_attr("policy"),
+            "interval": self.get_attr("interval", 1),
+        }
+
+
+@register_pass("auto_parallel_gradient_merge")
+class GradientMergePass(PassBase):
+    """auto_parallel_gradient_merge.py -> accumulate_steps (the microbatch
+    scan in make_sharded_train_step)."""
+
+    def _check_self(self):
+        return int(self.get_attr("k_steps", 1)) >= 1
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        context.recipe["accumulate_steps"] = int(self.get_attr("k_steps", 1))
+
+
+@register_pass("auto_parallel_sharding")
+class ShardingPass(PassBase):
+    """auto_parallel_sharding.py -> ZeRO stage + sharding axis degree."""
+
+    def _check_self(self):
+        return int(self.get_attr("stage", 1)) in (1, 2, 3)
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        context.recipe["sharding"] = {
+            "stage": int(self.get_attr("stage", 1)),
+            "degree": int(self.get_attr("degree", 1)),
+        }
+
+
+@register_pass("auto_parallel_pipeline")
+class PipelinePass(PassBase):
+    """auto_parallel_pipeline.py -> pp/virtual degrees consumed by the
+    compiled ppermute schedule."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        context.recipe["pipeline"] = {
+            "pp_degree": int(self.get_attr("pp_degree", 1)),
+            "virtual_pp_degree": int(self.get_attr("virtual_pp_degree", 1)),
+            "accumulate_steps": int(self.get_attr("accumulate_steps", 1)),
+        }
+
+
+@register_pass("auto_parallel_grad_clip")
+class GradClipPass(PassBase):
+    """auto_parallel_grad_clip.py -> the global-norm clip the step builder
+    folds across every mesh axis."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        context.recipe["grad_clip"] = {"clip_norm": float(self.get_attr("clip_norm", 1.0))}
+
+
+@register_pass("fuse_all_reduce")
+class FuseAllReducePass(PassBase):
+    """fuse_all_reduce.py: grad-bucket fusion — subsumed by GSPMD/XLA
+    collective combining; recorded for inspection so orchestration code sees
+    the pass as applied."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        context.recipe["fuse_all_reduce"] = {"subsumed_by": "xla-collective-combining"}
+
+
+def apply_recipe_to_strategy(context: PassContext, strategy):
+    """Fold a pass recipe into a fleet DistributedStrategy (the seam where
+    the reference applies pass results to the program: here the strategy
+    feeds fleet.init / make_sharded_train_step)."""
+    r = context.recipe
+    if "amp" in r:
+        strategy.amp = True
+        dtype = r["amp"].get("dtype", "bfloat16")
+        strategy.amp_configs = {
+            **getattr(strategy, "amp_configs", {}),
+            "dtype": dtype,
+            "use_pure_bf16": r["amp"]["level"] == "O2" and dtype == "bfloat16",
+            "use_pure_fp16": r["amp"]["level"] == "O2" and dtype == "float16",
+        }
+    if "recompute" in r:
+        strategy.recompute = True
+        strategy.recompute_configs = {**getattr(strategy, "recompute_configs", {}),
+                                      **r["recompute"]}
+    if "accumulate_steps" in r:
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": r["accumulate_steps"]}
+    if "sharding" in r:
+        strategy.sharding = True
+        strategy.sharding_configs = {**getattr(strategy, "sharding_configs", {}),
+                                     "stage": r["sharding"]["stage"]}
+        strategy.hybrid_configs = {"sharding_degree": r["sharding"]["degree"]}
+    if "pipeline" in r:
+        strategy.hybrid_configs = {"pp_degree": r["pipeline"]["pp_degree"]}
+        strategy.pipeline_configs = {
+            **getattr(strategy, "pipeline_configs", {}),
+            "accumulate_steps": r["pipeline"]["accumulate_steps"],
+            "virtual_pp_degree": r["pipeline"]["virtual_pp_degree"],
+        }
+    return strategy

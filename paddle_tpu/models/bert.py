@@ -181,26 +181,47 @@ class BertForMaskedLM(Layer):
         h, _ = self.bert(input_ids, token_type_ids, attention_mask)
         return self.lm_head(h)
 
+    # ---- compiled pipeline-parallel protocol (PipelineSpec) ----
+    def embed(self, input_ids):
+        return self.bert.embeddings(input_ids)
+
+    def head_loss(self, h, labels):
+        return self.loss(self.lm_head(h), labels)
+
+    def pipeline_spec(self):
+        """PipelineSpec protocol (see models/gpt.py): embeddings = pre, the
+        homogeneous BertLayer stack = stages, LM head + masked loss = post.
+        Covers the no-padding-mask pretraining path (mask-free blocks)."""
+        from ..distributed.fleet.meta_parallel.pipeline_parallel import (
+            make_layer_stack_pipeline_spec)
+
+        return make_layer_stack_pipeline_spec(
+            self, self.bert.layers[0], "bert.layers", self.cfg.num_layers)
+
     def loss(self, logits, labels, ignore_index: int = -100):
-        """Masked-LM loss: positions with label == ignore_index contribute 0."""
-        import jax.numpy as jnp
+        return masked_lm_loss(logits, labels, ignore_index=ignore_index)
 
-        from ..ops._dispatch import apply
 
-        def f(lg, lb):
-            V = lg.shape[-1]
-            lg2 = lg.reshape(-1, V).astype(jnp.float32)
-            lb2 = lb.reshape(-1)
-            valid = lb2 != ignore_index
-            lb_safe = jnp.where(valid, lb2, 0)
-            logp = jax.nn.log_softmax(lg2, axis=-1)
-            nll = -jnp.take_along_axis(logp, lb_safe[:, None], axis=-1)[:, 0]
-            nll = jnp.where(valid, nll, 0.0)
-            return nll.sum() / jnp.maximum(valid.sum(), 1)
+def masked_lm_loss(logits, labels, ignore_index: int = -100):
+    """Masked-LM loss: positions with label == ignore_index contribute 0.
+    Module-level so BERT and ERNIE share one definition."""
+    import jax
+    import jax.numpy as jnp
 
-        import jax
+    from ..ops._dispatch import apply
 
-        return apply("masked_lm_loss", f, logits, labels)
+    def f(lg, lb):
+        V = lg.shape[-1]
+        lg2 = lg.reshape(-1, V).astype(jnp.float32)
+        lb2 = lb.reshape(-1)
+        valid = lb2 != ignore_index
+        lb_safe = jnp.where(valid, lb2, 0)
+        logp = jax.nn.log_softmax(lg2, axis=-1)
+        nll = -jnp.take_along_axis(logp, lb_safe[:, None], axis=-1)[:, 0]
+        nll = jnp.where(valid, nll, 0.0)
+        return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+    return apply("masked_lm_loss", f, logits, labels)
 
 
 def bert_base(**overrides) -> BertForSequenceClassification:

@@ -102,6 +102,26 @@ class ErnieForPretraining(Layer):
         seq, pooled = self.ernie(input_ids, token_type_ids, attention_mask, task_type_ids=task_type_ids)
         return self.lm_head(seq), self.sop_head(pooled)
 
+    # ---- compiled pipeline-parallel protocol (PipelineSpec) ----
+    def embed(self, input_ids):
+        return self.ernie.embeddings(input_ids)
+
+    def head_loss(self, h, mlm_labels):
+        """Pipeline post stage: MLM head + masked loss. (The SOP head needs
+        the pooled [CLS]; under pp the MLM term is the pretrain objective —
+        reference ERNIE mp/pp recipes do the same split.)"""
+        from .bert import masked_lm_loss
+
+        return masked_lm_loss(self.lm_head(h), mlm_labels)
+
+    def pipeline_spec(self):
+        from ..distributed.fleet.meta_parallel.pipeline_parallel import (
+            make_layer_stack_pipeline_spec)
+
+        return make_layer_stack_pipeline_spec(
+            self, self.ernie.encoder[0], "ernie.encoder",
+            self.ernie.cfg.num_layers)
+
     def loss(self, outputs, labels):
         """labels = (mlm_labels with -100 ignore, sop_labels)."""
         mlm_logits, sop_logits = outputs

@@ -302,35 +302,13 @@ class GPTForCausalLM(Layer):
     def pipeline_spec(self):
         """PipelineSpec protocol consumed by make_sharded_train_step when the
         mesh carries a pp axis (the PipelineLayer/LayerDesc partition role,
-        reference pp_layers.py:56, done functionally: embeddings = pre, the
-        homogeneous GPTBlock stack = stages, final LN + head + loss = post)."""
-        import jax.numpy as jnp
+        reference pp_layers.py:56: embeddings = pre, the homogeneous GPTBlock
+        stack = stages, final LN + head + loss = post)."""
+        from ..distributed.fleet.meta_parallel.pipeline_parallel import (
+            make_layer_stack_pipeline_spec)
 
-        from ..distributed.fleet.meta_parallel.pipeline_parallel import PipelineSpec
-
-        model = self
-        block0 = self.gpt.layers[0]
-
-        def pre(params, buffers, x):
-            out, _ = model.functional_call(params, buffers, Tensor(x), method="embed")
-            return out._value
-
-        def block(bp, h):
-            out, _ = block0.functional_call(bp, {}, Tensor(h))
-            return out._value
-
-        def post_loss(params, buffers, h, y):
-            out, _ = model.functional_call(
-                params, buffers, Tensor(h), Tensor(y), method="head_loss")
-            return out._value.astype(jnp.float32)
-
-        return PipelineSpec(
-            block_prefix="gpt.layers",
-            n_blocks=self.cfg.num_layers,
-            pre=pre,
-            block=block,
-            post_loss=post_loss,
-        )
+        return make_layer_stack_pipeline_spec(
+            self, self.gpt.layers[0], "gpt.layers", self.cfg.num_layers)
 
 
 def gpt_tiny(**overrides) -> GPTForCausalLM:

@@ -38,7 +38,9 @@ def _reseed():
 # environment hangs into diagnosable failures).
 import faulthandler  # noqa: E402
 
-_WATCHDOG_SECS = 480
+# under xdist the workers contend for cores, so compile-heavy tests run
+# several times slower — scale the hang threshold accordingly
+_WATCHDOG_SECS = 900 if os.environ.get("PYTEST_XDIST_WORKER") else 480
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,88 @@
+"""Distributed pass infrastructure (reference distributed/passes/pass_base.py
+PassBase/PassManager/new_pass + the auto_parallel_* passes): on TPU a pass
+rewrites the training RECIPE (the knobs make_sharded_train_step consumes)
+rather than a serial program — XLA does the program rewriting."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.passes import (
+    PassContext, PassManager, apply_recipe_to_strategy, new_pass, register_pass)
+
+
+def test_new_pass_and_attrs():
+    p = new_pass("auto_parallel_gradient_merge", {"k_steps": 4})
+    ctx = p.apply()
+    assert ctx.recipe["accumulate_steps"] == 4
+    with pytest.raises(ValueError, match="unknown pass"):
+        new_pass("nope")
+
+
+def test_pass_attr_validation():
+    p = new_pass("auto_parallel_sharding", {"stage": 7})
+    with pytest.raises(ValueError, match="attrs invalid"):
+        p.apply()
+
+
+def test_manager_orders_and_merges_recipe():
+    mgr = PassManager([
+        new_pass("auto_parallel_amp", {"level": "O1"}),
+        new_pass("auto_parallel_recompute", {"interval": 2}),
+        new_pass("auto_parallel_gradient_merge", {"k_steps": 8}),
+        new_pass("auto_parallel_sharding", {"stage": 2, "degree": 4}),
+        new_pass("auto_parallel_pipeline", {"pp_degree": 2, "virtual_pp_degree": 2,
+                                            "accumulate_steps": 8}),
+        new_pass("fuse_all_reduce"),
+    ])
+    assert "auto_parallel_amp" in mgr.names
+    ctx = mgr.apply()
+    r = ctx.recipe
+    assert r["amp"]["enable"] and r["recompute"]["interval"] == 2
+    assert r["accumulate_steps"] == 8
+    assert r["sharding"] == {"stage": 2, "degree": 4}
+    assert r["pipeline"]["virtual_pp_degree"] == 2
+
+
+def test_recipe_feeds_strategy_and_train_step():
+    """The recipe folds into DistributedStrategy and those knobs drive a
+    real train step (pp + accumulation from passes)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import collective, mesh, topology
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    ctx = PassManager([
+        new_pass("auto_parallel_pipeline", {"pp_degree": 2, "accumulate_steps": 2}),
+    ]).apply()
+    strategy = fleet.DistributedStrategy()
+    apply_recipe_to_strategy(ctx, strategy)
+    assert strategy.hybrid_configs["pp_degree"] == 2
+    assert strategy.pipeline_configs["accumulate_steps"] == 2
+
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+    try:
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        model = gpt_tiny(dropout=0.0, num_layers=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        step = make_sharded_train_step(
+            model, opt,
+            accumulate_steps=strategy.pipeline_configs["accumulate_steps"])
+        x = np.random.RandomState(0).randint(0, 128, size=(4, 16))
+        loss = float(step(x, np.roll(x, -1, 1)))
+        assert np.isfinite(loss)
+    finally:
+        collective.destroy_process_group()
+        mesh.reset_global_mesh()
+        topology.set_hybrid_communicate_group(None)
+
+
+def test_role_maker_module_path():
+    from paddle_tpu.distributed.fleet.base import role_maker
+
+    rm = role_maker.PaddleCloudRoleMaker(is_collective=True)
+    assert rm.is_worker() and rm.worker_num() >= 1
+    assert role_maker.Role.WORKER == 1
