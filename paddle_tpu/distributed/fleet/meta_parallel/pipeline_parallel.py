@@ -60,11 +60,16 @@ class PipelineSpec:
     pre: Callable
     block: Callable
     post_loss: Callable
+    # blocks handle manual-sep local seq shards (ring/Ulysses attention);
+    # only then may the pipeline region go manual over sep — models with
+    # plain attention would silently lose cross-chunk attention otherwise
+    context_parallel: bool = False
 
 
 def make_layer_stack_pipeline_spec(model, block_layer, block_prefix: str,
                                    n_blocks: int, embed_method: str = "embed",
-                                   head_method: str = "head_loss") -> PipelineSpec:
+                                   head_method: str = "head_loss",
+                                   context_parallel: bool = False) -> PipelineSpec:
     """Build the PipelineSpec for the common homogeneous-stack shape: a model
     exposing ``embed(x)`` (pre) and ``head_loss(h, y)`` (post) methods plus a
     LayerList of identical blocks. GPT/BERT/ERNIE all use this."""
@@ -86,7 +91,8 @@ def make_layer_stack_pipeline_spec(model, block_layer, block_prefix: str,
         return out._value.astype(jnp.float32)
 
     return PipelineSpec(block_prefix=block_prefix, n_blocks=n_blocks,
-                        pre=pre, block=block, post_loss=post_loss)
+                        pre=pre, block=block, post_loss=post_loss,
+                        context_parallel=context_parallel)
 
 
 def _chunk_order(L: int, pp: int, v: int):

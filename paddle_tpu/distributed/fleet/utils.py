@@ -303,11 +303,22 @@ class ShardedTrainStep:
                     # microbatch activations
                     return outs[None]
 
+                # when the mesh carries a sep (context-parallel) axis, the
+                # pipeline region goes manual over it too: the microbatch
+                # stream enters as local seq shards and the blocks' ring
+                # attention runs directly (nested shard_map trips Shardy)
+                sep_deg = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sep", 1)
+                # only models whose blocks run context-parallel attention may
+                # receive local seq shards
+                use_sep = sep_deg > 1 and getattr(pspec, "context_parallel", False)
+                sep_deg = sep_deg if use_sep else 1
+                manual = {"pp"} | ({"sep"} if sep_deg > 1 else set())
+                mbs_spec = P(None, None, "sep") if sep_deg > 1 else P()
                 outs_g = shard_map(
                     body, mesh=mesh,
-                    in_specs=(P("pp"), P()),
-                    out_specs=P("pp"),
-                    axis_names={"pp"},
+                    in_specs=(P("pp"), mbs_spec),
+                    out_specs=P("pp", None, None, "sep") if sep_deg > 1 else P("pp"),
+                    axis_names=manual,
                     check_vma=False,
                 )(stacked, mbs)
                 h_last = outs_g[-1]  # [M, mb, ...] — the last stage's stream

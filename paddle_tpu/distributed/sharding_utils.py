@@ -34,6 +34,32 @@ def _spec_axes(spec: P):
     return axes
 
 
+def _strip_manual_axes(spec: P) -> P:
+    """Drop spec axes that are Manual in the ambient mesh (inside a
+    shard_map region those dims are already local shards; constraints may
+    only reference Auto axes)."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        types = dict(zip(mesh.axis_names, mesh.axis_types))
+    except Exception:
+        return spec
+    manual = {n for n, t in types.items() if t == jax.sharding.AxisType.Manual}
+    if not manual:
+        return spec
+    entries = []
+    for entry in spec:
+        if entry is None:
+            entries.append(None)
+        elif isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a not in manual)
+            entries.append(kept if kept else None)
+        else:
+            entries.append(entry if entry not in manual else None)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
 def maybe_shard(x, spec: P):
     """with_sharding_constraint(x, spec) iff the ambient mesh has the axes.
 
@@ -42,6 +68,9 @@ def maybe_shard(x, spec: P):
     """
     names = ambient_axis_names()
     if not names or not _spec_axes(spec).issubset(set(names)):
+        return x
+    spec = _strip_manual_axes(spec)
+    if not _spec_axes(spec):
         return x
     if isinstance(x, Tensor):
         from ..ops._dispatch import apply

@@ -112,10 +112,24 @@ class GPTAttention(Layer):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, S, H, D]
         hcg = get_hybrid_communicate_group()
         sep = hcg.get_sep_parallel_world_size() if hcg is not None else 1
-        if sep > 1:
+        # inside a region already manual over sep (the pipeline), x is a
+        # LOCAL seq shard and the ring MUST run (falling through to plain
+        # attention would silently drop cross-chunk attention)
+        import jax as _jax
+
+        ctx_types = {}
+        try:
+            _m = _jax.sharding.get_abstract_mesh()
+            ctx_types = dict(zip(_m.axis_names, _m.axis_types))
+        except Exception:
+            pass
+        in_manual_sep = ctx_types.get("sep") == _jax.sharding.AxisType.Manual
+        if sep > 1 and (in_manual_sep or S % sep == 0):
             # context parallelism: seq stays sharded over the sep axis and
             # attention runs as a ring (or Ulysses a2a) over it — the
-            # long-context path (SURVEY §5.7)
+            # long-context path (SURVEY §5.7). Indivisible GLOBAL S outside
+            # a manual region (e.g. generation growing the prefix) falls
+            # through to plain attention below, which is then exact.
             if cfg.dropout > 0 and self.training:
                 raise NotImplementedError(
                     "attention dropout is unsupported under context "
@@ -308,7 +322,45 @@ class GPTForCausalLM(Layer):
             make_layer_stack_pipeline_spec)
 
         return make_layer_stack_pipeline_spec(
-            self, self.gpt.layers[0], "gpt.layers", self.cfg.num_layers)
+            self, self.gpt.layers[0], "gpt.layers", self.cfg.num_layers,
+            context_parallel=True)  # GPTAttention handles manual-sep shards
+
+    def generate(self, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
+                 temperature: float = 1.0, top_k: int = 0, eos_token_id=None):
+        """Autoregressive decoding (PaddleNLP GenerationMixin.generate's
+        greedy/sampling core). Each step runs the causal forward on the grown
+        prefix — positions before the new token are unaffected by the causal
+        mask, so this is exact; the KV-cached fast path for serving is
+        incubate.nn.FusedMultiTransformer's time_step decode."""
+        import jax
+
+        from ..core import random as _random
+        from ..core.autograd import no_grad
+        from ..ops._dispatch import as_tensor
+
+        ids = as_tensor(input_ids)
+        B = ids.shape[0]
+        finished = jnp.zeros((B,), bool)
+        with no_grad():
+            for _ in range(max_new_tokens):
+                logits = self.forward(ids)._value[:, -1]  # [B, V]
+                if do_sample:
+                    logits = logits / jnp.maximum(jnp.float32(temperature), 1e-6)
+                    if top_k and top_k > 0:
+                        k_eff = min(int(top_k), logits.shape[-1])  # >= vocab = no filter
+                        kth = jnp.sort(logits, axis=-1)[:, -k_eff][:, None]
+                        logits = jnp.where(logits < kth, -1e30, logits)
+                    nxt = jax.random.categorical(_random.next_key(), logits, axis=-1)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1)
+                nxt = nxt.astype(ids._value.dtype)
+                if eos_token_id is not None:
+                    nxt = jnp.where(finished, eos_token_id, nxt)
+                    finished = finished | (nxt == eos_token_id)
+                ids = Tensor(jnp.concatenate([ids._value, nxt[:, None]], axis=1))
+                if eos_token_id is not None and bool(finished.all()):
+                    break
+        return ids
 
 
 def gpt_tiny(**overrides) -> GPTForCausalLM:

@@ -81,6 +81,36 @@ def scaled_dot_product_attention(
     return apply("sdpa", fn, *tensors)
 
 
+import functools as _functools
+
+
+def _cp_body(mode, is_causal, scale, axis_name):
+    from ...distributed.fleet.meta_parallel.sequence_parallel import (
+        ring_attention, ulysses_attention)
+
+    def body(ql, kl, vl):
+        if mode == "ulysses":
+            return ulysses_attention(ql, kl, vl, axis_name, causal=is_causal, scale=scale)
+        return ring_attention(ql, kl, vl, axis_name, causal=is_causal, scale=scale)
+
+    return body
+
+
+@_functools.lru_cache(maxsize=64)
+def _cp_sharded(mesh, mode, is_causal, scale, axis_name):
+    """Cached jitted shard_map for context-parallel attention: one compile
+    per (mesh, mode, causal, scale, axis, shape) instead of per call."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name)
+    return jax.jit(shard_map(
+        _cp_body(mode, is_causal, scale, axis_name), mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=frozenset({axis_name}), check_vma=False,
+    ))
+
+
 @register_op("nn.context_parallel_attention")
 def context_parallel_attention(query, key, value, mode: str = "ring",
                                is_causal: bool = False, scale=None,
@@ -94,11 +124,6 @@ def context_parallel_attention(query, key, value, mode: str = "ring",
     reshard) inside a shard_map manual over that axis only; dp/mp stay under
     GSPMD auto. Differentiable (the tape records the whole shard_map vjp).
     """
-    from jax import shard_map
-    from jax.sharding import PartitionSpec as P
-
-    from ...distributed.fleet.meta_parallel.sequence_parallel import (
-        ring_attention, ulysses_attention)
     from ...distributed.topology import get_hybrid_communicate_group
 
     query, key, value = as_tensor(query), as_tensor(key), as_tensor(value)
@@ -110,18 +135,18 @@ def context_parallel_attention(query, key, value, mode: str = "ring",
         raise ValueError(f"mode must be 'ring' or 'ulysses', got {mode!r}")
 
     def fn(q, k, v):
-        spec = P(None, axis_name)
-
-        def body(ql, kl, vl):
-            if mode == "ulysses":
-                return ulysses_attention(ql, kl, vl, axis_name, causal=is_causal, scale=scale)
-            return ring_attention(ql, kl, vl, axis_name, causal=is_causal, scale=scale)
-
-        return shard_map(
-            body, mesh=mesh,
-            in_specs=(spec, spec, spec), out_specs=spec,
-            axis_names={axis_name}, check_vma=False,
-        )(q, k, v)
+        # already inside a region manual over this axis (the pp pipeline's
+        # shard_map includes 'sep' in its manual set): values are local seq
+        # shards, so run the ring directly — nesting another shard_map here
+        # trips Shardy's manual-axis bounding
+        ctx = jax.sharding.get_abstract_mesh()
+        types = dict(zip(getattr(ctx, "axis_names", ()), getattr(ctx, "axis_types", ())))
+        if types.get(axis_name) == jax.sharding.AxisType.Manual:
+            return _cp_body(mode, is_causal, scale, axis_name)(q, k, v)
+        use_mesh = ctx if axis_name in types else mesh
+        # _cp_sharded returns a CACHED jitted callable (one compile per
+        # distinct shape); under an outer trace the jit inlines
+        return _cp_sharded(use_mesh, mode, is_causal, scale, axis_name)(q, k, v)
 
     return apply("cp_attention", fn, query, key, value)
 

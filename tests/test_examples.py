@@ -15,6 +15,7 @@ EXAMPLES = [
     "ps_ctr.py",
     "deploy_inference.py",
     "moe_hybrid_parallel.py",
+    "long_context_hybrid.py",
 ]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
