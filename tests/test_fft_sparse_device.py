@@ -104,3 +104,29 @@ def test_householder_product():
     (h, tau), _ = scipy.linalg.qr(A, mode="raw")
     Q = paddle.householder_product(paddle.to_tensor(np.asarray(h)), paddle.to_tensor(np.asarray(tau))).numpy()
     np.testing.assert_allclose(Q[:, :4], np.linalg.qr(A)[0], rtol=1e-5, atol=1e-6)
+
+
+class TestCustomDevicePlugin:
+    """Custom-device plugin surface (phi/backends/custom + fake_cpu_device.h
+    role): the TPU-native plugin ABI is PJRT, so the test double mocks the
+    jax registration hook and drives the registration surface through it."""
+
+    def test_register_fake_plugin(self, monkeypatch):
+        from paddle_tpu.device import plugin
+
+        calls = {}
+
+        def fake_register(name, library_path=None, options=None):
+            calls[name] = (library_path, options)
+
+        import jax._src.xla_bridge as xb
+
+        monkeypatch.setattr(xb, "register_plugin", fake_register)
+        monkeypatch.setattr(plugin, "_registered", {})
+        plugin.register_custom_device("fake_npu", "/opt/fake/libpjrt_fake.so",
+                                      {"visible_devices": "0"})
+        assert calls["fake_npu"][0] == "/opt/fake/libpjrt_fake.so"
+        assert calls["fake_npu"][1] == {"visible_devices": "0"}
+        assert plugin.list_custom_devices() == ["fake_npu"]
+        # availability goes through jax.devices and reports honestly
+        assert not plugin.is_custom_device_available("fake_npu")
