@@ -482,21 +482,24 @@ int32_t st_save(void* p, const char* path) {
   const char magic[4] = {'P', 'T', 'S', 'T'};
   std::fwrite(magic, 1, 4, f);
   std::fwrite(&t->dim, sizeof(int64_t), 1, f);
+  // write a placeholder count, stream the rows, then seek back and patch
+  // the real count: the header must promise exactly the records written
+  // (a failed spill Read would otherwise leave st_load hitting a short
+  // fread and rejecting the checkpoint), and streaming keeps save memory
+  // flat — materializing the spill (which exists because rows exceed
+  // memory) would defeat max_mem_rows
+  const long count_off = std::ftell(f);
   int64_t count = 0;
-  for (auto& s : t->shards) count += static_cast<int64_t>(s.rows.size());
-  {
-    std::lock_guard<std::mutex> g(t->spill.mu);
-    count += static_cast<int64_t>(t->spill.index.size());
-  }
   std::fwrite(&count, sizeof(int64_t), 1, f);
   for (auto& s : t->shards) {
     for (auto& kv : s.rows) {
       std::fwrite(&kv.first, sizeof(int64_t), 1, f);
       std::fwrite(kv.second.data(), sizeof(float), t->dim, f);
+      ++count;
     }
   }
-  // spilled rows: read back from the append-log (save doubles as compaction
-  // of the log's dead records)
+  // spilled rows: read back from the append-log (save doubles as
+  // compaction of the log's dead records)
   std::vector<int64_t> spilled;
   {
     std::lock_guard<std::mutex> g(t->spill.mu);
@@ -507,7 +510,10 @@ int32_t st_save(void* p, const char* path) {
     if (!t->spill.Read(key, row.data(), g2.data(), t->dim)) continue;
     std::fwrite(&key, sizeof(int64_t), 1, f);
     std::fwrite(row.data(), sizeof(float), t->dim, f);
+    ++count;
   }
+  std::fseek(f, count_off, SEEK_SET);
+  std::fwrite(&count, sizeof(int64_t), 1, f);
   std::fclose(f);
   if (t->max_mem_rows > 0) t->spill.Compact(t->dim);
   return 0;

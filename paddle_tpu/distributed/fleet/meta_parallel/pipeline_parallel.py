@@ -366,7 +366,11 @@ def pipeline_schedule_interleaved(
 
     stacked_params: local leaves [1, v, Lpc, ...] (sharded over axis_name) —
     the chunk-major layout stack_block_params(virtual_stages=v) produces.
-    stage_fn(chunk_params, x) applies ONE chunk (Lpc blocks).
+    stage_fn(chunk_params, x) applies ONE chunk (Lpc blocks). A 3-arg
+    stage_fn(chunk_params, x, chunk_idx) additionally receives the GLOBAL
+    chunk index (slot hop count == r*n + d, i.e. the chunk whose first
+    layer is chunk_idx * Lpc) — needed for layer-indexed RNG salts to match
+    the non-pipelined layer order under interleaving.
 
     Schedule: a validity-tagged slot rotates the ring each tick; a device
     executes its incoming chunk work if valid, and stage 0 injects a fresh
@@ -384,11 +388,23 @@ def pipeline_schedule_interleaved(
     M = microbatches.shape[0]
     mb_shape = microbatches.shape[1:]
     perm = [(i, (i + 1) % n) for i in range(n)]
-    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    import inspect
+
+    try:
+        pos_kinds = (inspect.Parameter.POSITIONAL_ONLY,
+                     inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                     inspect.Parameter.VAR_POSITIONAL)
+        takes_chunk = sum(
+            1 for p in inspect.signature(stage_fn).parameters.values()
+            if p.kind in pos_kinds) >= 3
+    except (TypeError, ValueError):
+        takes_chunk = False
+    call = stage_fn if takes_chunk else (lambda p, x, ci: stage_fn(p, x))
+    fn = jax.checkpoint(call) if remat else call
     T = _simulate_interleaved_ticks(n, v, M)
 
     probe_params = jax.tree_util.tree_map(lambda p: p[0], my)
-    probe = jax.eval_shape(lambda p, x: stage_fn(p, x),
+    probe = jax.eval_shape(lambda p, x: call(p, x, jnp.zeros((), jnp.int32)),
                            probe_params, jnp.zeros(mb_shape, microbatches.dtype))
     out_dtype = probe.dtype
 
@@ -409,7 +425,7 @@ def pipeline_schedule_interleaved(
         # salt RNG with (microbatch, chunk) so dropout masks are distinct
         # per microbatch AND per virtual chunk (the scan body traces once)
         with _random.key_salt(mb_idx * (n * v) + chunk_idx):
-            y = fn(chunk_params, act)
+            y = fn(chunk_params, act, jnp.clip(chunk_idx, 0, n * v - 1))
         y = jnp.where(valid, y, act)  # bubbles pass through untouched
         # finished microbatches (chunk nv-1, which lives on stage n-1) record
         finishing = valid & (chunk_idx == n * v - 1)

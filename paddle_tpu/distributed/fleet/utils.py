@@ -277,9 +277,16 @@ class ShardedTrainStep:
                 mbs = jnp.swapaxes(h0.reshape((mb, M) + h0.shape[1:]), 0, 1)
 
                 def body(stacked_loc, mbs_loc):
-                    def stage(bp, h):
+                    def stage(bp, h, chunk_idx=None):
                         Lps = jax.tree_util.tree_leaves(bp)[0].shape[0]
-                        base = lax.axis_index("pp") * Lps
+                        # global first-layer index of this stage's slice:
+                        # contiguous stages own [s*Lps, ...); under
+                        # interleaving device d's chunk r covers layers
+                        # (r*pp+d)*Lpc, and the schedule hands us that
+                        # global chunk index — so layer-salted dropout
+                        # matches the non-pipelined layer order exactly
+                        base = (lax.axis_index("pp") if chunk_idx is None
+                                else chunk_idx) * Lps
 
                         def one(h, xs):
                             bpi, li = xs

@@ -237,8 +237,11 @@ class GraphTable:
     the ragged host work stays here, the math stays on chip."""
 
     def __init__(self):
+        import itertools
+
         self._lib = _lib()
         self._h = self._lib.gt_create()
+        self._sample_nonce = itertools.count(1)  # next() is atomic in CPython
 
     def add_edges(self, src, dst):
         src, dst = _i64(src), _i64(dst)
@@ -262,18 +265,27 @@ class GraphTable:
                                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n)
         return out[:n]
 
-    def sample_neighbors(self, keys, k: int, seed: int = 0, replace: bool = False) -> np.ndarray:
+    def _next_nonce(self) -> int:
+        # per-call nonce: the native sampler is deterministic in (seed, key,
+        # position), so a fixed seed would repeat the same neighbor sample
+        # every epoch and bias GNN training; callers wanting reproducible
+        # draws pass an explicit seed
+        return next(self._sample_nonce)
+
+    def sample_neighbors(self, keys, k: int, seed: int = None, replace: bool = False) -> np.ndarray:
         keys = _i64(keys)
         out = np.empty((keys.size, k), np.int64)
+        seed = self._next_nonce() if seed is None else int(seed)
         self._lib.gt_sample_neighbors(
             self._h, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
-            int(k), int(seed), 1 if replace else 0,
+            int(k), seed, 1 if replace else 0,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
         return out
 
-    def sample_nodes(self, count: int, seed: int = 0) -> np.ndarray:
+    def sample_nodes(self, count: int, seed: int = None) -> np.ndarray:
         out = np.empty(max(count, 1), np.int64)
-        got = self._lib.gt_sample_nodes(self._h, int(count), int(seed),
+        seed = self._next_nonce() if seed is None else int(seed)
+        got = self._lib.gt_sample_nodes(self._h, int(count), seed,
                                         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
         return out[:got]
 

@@ -79,16 +79,98 @@ def _scalar_bool(raw_cond):
 
 
 # ---------------- runtime convert calls ----------------
+def _try_lax_cond(c, true_fn, false_fn, init_vars):
+    """Real conditional via lax.cond: only the taken branch EXECUTES at
+    runtime, so guarded expressions (`if x > 0: y = 1 / x`) cannot poison
+    outputs or gradients with the untaken branch's inf/NaN (the where-NaN
+    hazard of the select fallback). Both branches are still TRACED, so
+    Python side effects in either run at trace time — same as the select
+    path. Requires matching array carries across branches; any structural
+    mismatch raises and the caller falls back to the select form."""
+    is_arr = [isinstance(_raw(v), (jax.Array, jax.core.Tracer)) or isinstance(v, Tensor)
+              for v in init_vars]
+    operand = tuple(jnp.asarray(_raw(v)) for v, a in zip(init_vars, is_arr) if a)
+
+    def rebuild(op):
+        it = iter(op)
+        out = []
+        for v, a in zip(init_vars, is_arr):
+            if not a:
+                out.append(v)
+            else:
+                leaf = next(it)
+                out.append(Tensor(leaf) if isinstance(v, Tensor) else leaf)
+        return tuple(out)
+
+    metas = {}
+
+    def wrap(fn, tag):
+        def wrapped(op):
+            outs = fn(rebuild(op))
+            arrs, meta = [], []
+            for o in outs:
+                r = _raw(o)
+                if isinstance(r, (jax.Array, jax.core.Tracer)):
+                    arrs.append(r)
+                    meta.append(("arr", isinstance(o, Tensor)))
+                else:
+                    meta.append(("static", o))
+            metas[tag] = meta
+            return tuple(arrs)
+
+        return wrapped
+
+    # abstract compatibility probe FIRST (jax.eval_shape stages nothing):
+    # a mismatch must not leave an abandoned lax.cond — with both branches'
+    # staged effects like jax.debug.print — in the ambient trace when the
+    # caller falls back to the select form
+    t_avals = jax.eval_shape(wrap(true_fn, "t"), operand)
+    f_avals = jax.eval_shape(wrap(false_fn, "f"), operand)
+    tm, fm = metas["t"], metas["f"]
+    if len(tm) != len(fm):
+        raise TransformError("branch output arity mismatch")
+    for (tk, tv), (fk, fv) in zip(tm, fm):
+        if tk != fk:
+            raise TransformError("mixed array/static carry across branches")
+        if tk == "static":
+            same = tv is fv
+            if not same:
+                try:
+                    same = bool(tv == fv)
+                except Exception:
+                    same = False
+            if not same:
+                raise TransformError("static carry differs across branches")
+    if [(a.shape, a.dtype) for a in t_avals] != [(a.shape, a.dtype) for a in f_avals]:
+        raise TransformError("array carry shape/dtype differs across branches")
+
+    res = jax.lax.cond(c, wrap(true_fn, "t"), wrap(false_fn, "f"), operand)
+    out, it = [], iter(res)
+    for (tk, tv), (fk, fv) in zip(metas["t"], metas["f"]):
+        if tk == "arr":
+            leaf = next(it)
+            out.append(Tensor(leaf) if (tv or fv) else leaf)
+        else:
+            out.append(tv)
+    return tuple(out)
+
+
 def convert_ifelse(cond, true_fn: Callable, false_fn: Callable, init_vars: tuple,
                    names: Sequence[str] = ()):
-    """if/else convert call. Traced cond: run BOTH branches under the ambient
-    trace and select per variable (reference convert_ifelse runs a real
-    cond; XLA lowers small conditionals to select anyway and this handles
-    Tensor/py-value carries without pytree registration)."""
+    """if/else convert call (reference convert_ifelse). Traced cond: first
+    try a REAL conditional (lax.cond — runtime-exclusive branches, see
+    _try_lax_cond); carries lax.cond can't express (mixed array/static,
+    UNDEFINED-in-one-branch, differing statics) fall back to running both
+    branches under the ambient trace and selecting per variable, where the
+    precise user-facing errors are raised."""
     if not _is_traced(cond):
         taken = true_fn if bool(_raw(cond)) else false_fn
         return taken(init_vars)
     c = _scalar_bool(_raw(cond))
+    try:
+        return _try_lax_cond(c, true_fn, false_fn, init_vars)
+    except (TransformError, TypeError, ValueError):
+        pass
     t_out = true_fn(init_vars)
     f_out = false_fn(init_vars)
     out = []

@@ -49,6 +49,28 @@ class TestConvertCalls:
         out = jax.jit(f)(jnp.float32(-1.0), jnp.asarray([3.0]))
         np.testing.assert_allclose(np.asarray(out), [-3.0])
 
+    def test_ifelse_guard_grad_no_nan(self):
+        """Guard patterns (`if x > 0: y = 1/x`) must not poison gradients
+        with the untaken branch's inf (the where-NaN hazard): traced ifs
+        lower to a real lax.cond, so only the taken branch executes."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.jit.dy2static import convert_ifelse
+
+        def f(x):
+            (y,) = convert_ifelse(
+                x > 0, lambda v: (1.0 / v[0],), lambda v: (v[0] * 0.0,),
+                (x,), ("y",))
+            return y
+
+        g0 = jax.grad(f)(jnp.float32(0.0))  # else branch; 1/x never runs
+        assert np.isfinite(np.asarray(g0)), g0
+        np.testing.assert_allclose(np.asarray(jax.grad(f)(jnp.float32(2.0))), -0.25)
+        # the lowering really is a conditional, not a select of both branches
+        hlo = jax.jit(f).lower(jnp.float32(0.0)).as_text()
+        assert "cond" in hlo or "select_n" not in hlo
+
     def test_ifelse_one_sided_undefined_raises(self):
         import jax
         import jax.numpy as jnp

@@ -208,6 +208,41 @@ def test_stack_unstack_chunk_major_roundtrip():
         np.testing.assert_array_equal(np.asarray(flat[f"m.blocks.{i}.w"]), [float(i)] * 2)
 
 
+def test_interleaved_chunk_index_is_global_layer_base():
+    """A 3-arg stage_fn receives the GLOBAL chunk index (slot hop count ==
+    r*pp + d), so chunk_idx * Lpc is the chunk's true first layer id.
+    Regression for the interleaved RNG-salt advisory: layer-indexed dropout
+    salts must follow the non-pipelined layer order, not axis_index*Lps."""
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        PipelineSpec, stack_block_params)
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        pipeline_schedule_interleaved)
+
+    n, v, Lpc = 2, 2, 2
+    L = n * v * Lpc
+    spec = PipelineSpec("m.blocks", L, None, None, None)
+    # block i's param IS its layer id: device d chunk r holds layers
+    # (r*n+d)*Lpc + i, so the chunk's first entry must equal chunk_idx*Lpc
+    params = {f"m.blocks.{i}.w": jnp.full((1,), float(i)) for i in range(L)}
+    stacked, _ = stack_block_params(params, spec, n, virtual_stages=v)
+    mesh = Mesh(np.array(jax.devices()[:n]), ("pp",))
+    M, mbsz = 4, 2
+    xs = jnp.zeros((M, mbsz), jnp.float32)
+
+    def stage(bp, x, chunk_idx):
+        first = bp["w"][0, 0]
+        # any mismatch between the passed chunk index and the params'
+        # actual first layer id poisons the stream and fails the assert
+        return x + jnp.abs(first - chunk_idx.astype(jnp.float32) * Lpc)
+
+    out = jax.jit(shard_map(
+        lambda w, xb: pipeline_schedule_interleaved(
+            stage, w, xb, axis_name="pp", virtual_stages=v, remat=False)[None],
+        mesh=mesh, in_specs=(P("pp"), P()), out_specs=P("pp"),
+        check_vma=False))(stacked, xs)
+    np.testing.assert_allclose(np.asarray(out)[-1], 0.0, atol=1e-6)
+
+
 def test_gpt_interleaved_vpp2_matches_plain():
     """pp=2 x dp=2 with 2 virtual chunks per stage (reference
     PipelineParallelWithInterleave :514): losses and updated params equal
