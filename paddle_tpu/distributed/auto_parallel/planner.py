@@ -59,12 +59,14 @@ class Planner:
     """Exhaustive factorization search (tuner/parallel_tuner.py analog)."""
 
     def __init__(self, cluster: ClusterSpec, model: ModelSpec, train: TrainConfig,
-                 enable_sep: bool = False, enable_sharding: bool = True):
+                 enable_sep: bool = False, enable_sharding: bool = True,
+                 enable_pp: bool = True):
         self.cluster = cluster
         self.model = model
         self.train = train
         self.enable_sep = enable_sep
         self.enable_sharding = enable_sharding
+        self.enable_pp = enable_pp
 
     def candidates(self) -> List[Plan]:
         cm = CostModel(self.cluster, self.model, self.train)
@@ -73,6 +75,8 @@ class Planner:
             if not self.enable_sep and sep > 1:
                 continue
             if not self.enable_sharding and sharding > 1:
+                continue
+            if not self.enable_pp and pp > 1:
                 continue
             bd = cm.cost(dp=dp, pp=pp, sharding=sharding, mp=mp, sep=sep)
             if bd.feasible:

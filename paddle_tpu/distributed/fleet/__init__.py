@@ -41,11 +41,76 @@ _fleet_initialized = False
 _strategy: Optional[DistributedStrategy] = None
 
 
+def plan_hybrid_configs(model=None, batch: Optional[int] = None, cluster=None,
+                        zero_stage: int = 0, accumulate_steps: int = 1,
+                        enable_sep: bool = False, ep_degree: int = 1,
+                        enable_pp: Optional[bool] = None,
+                        require=None) -> dict:
+    """Cost-model-planned hybrid_configs (the product seam for the planner;
+    reference parallel_tuner). `model`: ModelSpec or its kwargs dict.
+    `ep_degree`: expert-parallel degree (not a planner-priced axis; the
+    planner factors the remaining n_devices/ep over the other axes).
+    `require`: optional predicate over Plan to constrain the pick (used by
+    the multichip dryrun to exercise specific compositions while still
+    letting the cost model rank the rest)."""
+    import jax
+
+    from ..auto_parallel.cost import ClusterSpec, ModelSpec, TrainConfig
+    from ..auto_parallel.planner import Planner
+
+    if model is None:
+        raise ValueError("plan_hybrid_configs needs `model` (a ModelSpec or "
+                         "its kwargs dict); via fleet.init, set "
+                         "strategy.auto_plan_configs['model']")
+    if isinstance(model, dict):
+        model = ModelSpec(**model)
+    if cluster is None:
+        cluster = ClusterSpec(n_devices=len(jax.devices()))
+    elif isinstance(cluster, dict):
+        cluster = ClusterSpec(**cluster)
+    ep = max(int(ep_degree or 1), 1)
+    if ep > 1:
+        if cluster.n_devices % ep:
+            raise ValueError(f"ep_degree {ep} does not divide "
+                             f"{cluster.n_devices} devices")
+        import dataclasses
+
+        cluster = dataclasses.replace(cluster, n_devices=cluster.n_devices // ep)
+    train = TrainConfig(batch=batch if batch else max(cluster.n_devices, 8),
+                        zero_stage=zero_stage,
+                        accumulate_steps=accumulate_steps)
+    if enable_pp is None:
+        # MoE models don't pipeline (the stacked-stage schedule can't carry
+        # the gate aux loss), so an expert axis turns pp off by default
+        enable_pp = ep == 1
+    cands = Planner(cluster, model, train, enable_sep=enable_sep,
+                    enable_sharding=zero_stage >= 1,
+                    enable_pp=enable_pp).candidates()
+    if require is not None:
+        cands = [p for p in cands if require(p)]
+    if not cands:
+        raise ValueError(
+            f"planner found no feasible hybrid factorization for "
+            f"{cluster.n_devices} devices (model ~{model.n_params/1e6:.0f}M "
+            f"params, batch {train.batch}, zero_stage {zero_stage})")
+    return {**cands[0].hybrid_configs, "ep_degree": ep}
+
+
 def init(role_maker=None, is_collective: bool = True, strategy: Optional[DistributedStrategy] = None):
-    """fleet.init (fleet.py:168): build the hybrid mesh from the strategy."""
+    """fleet.init (fleet.py:168): build the hybrid mesh from the strategy.
+
+    With strategy.auto_plan the cost-model planner chooses hybrid_configs
+    from the model/cluster specs instead of hand-picked degrees (reference
+    auto_parallel/tuner/parallel_tuner.py role)."""
     global _fleet_initialized, _strategy
     init_parallel_env()
     _strategy = strategy or DistributedStrategy()
+    if getattr(_strategy, "auto_plan", False):
+        apc = dict(_strategy.auto_plan_configs or {})
+        # a user-set ep_degree survives auto_plan: the planner factors the
+        # non-expert sub-cluster (ep is not a priced axis)
+        apc.setdefault("ep_degree", _strategy.hybrid_configs.get("ep_degree", 1))
+        _strategy.hybrid_configs = plan_hybrid_configs(**apc)
     cfg = _strategy.hybrid_configs
     # sep = sequence/context parallel axis (ring/Ulysses attention). The
     # reference has no SP (SURVEY §5.7); we accept both its later-era key
@@ -57,13 +122,17 @@ def init(role_maker=None, is_collective: bool = True, strategy: Optional[Distrib
             f"hybrid_configs sets both sep_degree={sep_d} and cp_degree={cp_d}; "
             "they alias the same axis — set only one")
     sep = max(sep_d, cp_d)
+    # expert (ep) axis: expert-parallel MoE dispatch rides an all-to-all
+    # over it (reference moe_layer.py:117 global_scatter/global_gather).
+    # It sits between sep and model so expert groups are ICI-contiguous.
     topo = CommunicateTopology(
-        hybrid_group_names=["data", "pipe", "sharding", "sep", "model"],
+        hybrid_group_names=["data", "pipe", "sharding", "sep", "expert", "model"],
         dims=[
             cfg.get("dp_degree", 1),
             cfg.get("pp_degree", 1),
             cfg.get("sharding_degree", 1),
             sep,
+            cfg.get("ep_degree", 1) or 1,
             cfg.get("mp_degree", 1),
         ],
     )

@@ -17,6 +17,7 @@ class DistributedStrategy:
             "pp_degree": 1,
             "sharding_degree": 1,
             "sep_degree": 1,
+            "ep_degree": 1,
         }
         self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1, "schedule": "1F1B"}
         self.amp = False
@@ -30,6 +31,13 @@ class DistributedStrategy:
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True  # parity no-op: XLA fuses collectives
         self.tensor_parallel_configs = {"tensor_init_seed": -1}
+        # auto_plan: let the cost-model planner choose hybrid_configs at
+        # fleet.init (reference auto_parallel/tuner/parallel_tuner.py role).
+        # auto_plan_configs: {"model": ModelSpec|dict, "batch": int,
+        #   "cluster": ClusterSpec (default: real device count),
+        #   "zero_stage": int, "accumulate_steps": int, "enable_sep": bool}
+        self.auto_plan = False
+        self.auto_plan_configs = {}
 
     def __setattr__(self, key, value):
         if key == "hybrid_configs" and hasattr(self, "hybrid_configs"):

@@ -56,23 +56,39 @@ def param_shardings(model: Layer, mesh: Mesh):
 
 
 def _state_sharding_like(param_sharding: NamedSharding, leaf, mesh: Mesh, shard_axis: Optional[str]):
+    """Optimizer-state placement for one leaf: inherit the param's spec
+    (mp/pp/ep placement), then — under ZeRO — ALSO shard over the sharding
+    axis on the first free divisible dim. This is what makes the sharded
+    optimizer compose with pipeline parallelism (reference
+    DygraphShardingOptimizer inside HybridParallelOptimizer): a stacked
+    block state [pp, L/pp, d, ...] comes out P('pp', None, 'sharding', ...)
+    rather than losing the ZeRO axis."""
     if leaf.ndim == 0:
         return NamedSharding(mesh, P())
-    spec = param_sharding.spec
-    if shard_axis and shard_axis in mesh.axis_names and not any(spec):
-        from .meta_parallel.sharding import shard_spec_for
-
-        return NamedSharding(mesh, shard_spec_for(leaf.shape, mesh.shape[shard_axis], shard_axis))
-    return NamedSharding(mesh, spec if len(spec) <= leaf.ndim else P())
+    spec = param_sharding.spec if len(param_sharding.spec) <= leaf.ndim else P()
+    entries = list(spec) + [None] * (leaf.ndim - len(spec))
+    if shard_axis and shard_axis in mesh.axis_names:
+        deg = mesh.shape[shard_axis]
+        used = {a for e in entries if e is not None
+                for a in (e if isinstance(e, tuple) else (e,))}
+        if deg > 1 and shard_axis not in used:
+            for i, e in enumerate(entries):
+                if e is None and leaf.shape[i] % deg == 0 and leaf.shape[i] >= deg:
+                    entries[i] = shard_axis
+                    break
+    while entries and entries[-1] is None:
+        entries.pop()
+    return NamedSharding(mesh, P(*entries))
 
 
 class ShardedTrainStep:
     """Holds device state (params, opt state) and the compiled step.
 
     step(batch) -> loss. Batch = (x, y) numpy/jax arrays; x sharded over the
-    data axes (dp AND sharding — the ZeRO axis is data parallelism with
-    sharded optimizer states, reference GroupSharded semantics) on dim 0.
-    `sync_to_model()` writes params back into the Layer.
+    data axes (dp AND sharding AND ep — the ZeRO axis is data parallelism
+    with sharded optimizer states, reference GroupSharded semantics; the
+    expert axis carries data for non-expert compute, DeepSpeed-MoE style)
+    on dim 0. `sync_to_model()` writes params back into the Layer.
     """
 
     def __init__(
@@ -81,7 +97,7 @@ class ShardedTrainStep:
         optimizer: Optimizer,
         loss_fn: Optional[Callable] = None,
         mesh: Optional[Mesh] = None,
-        batch_spec: P = P(("dp", "sharding")),
+        batch_spec: P = P(("dp", "sharding", "ep")),
         donate: bool = True,
         seed: int = 0,
         accumulate_steps: Optional[int] = None,

@@ -20,7 +20,8 @@ from .collective import Group
 from .mesh import build_mesh, set_global_mesh
 
 # paddle axis naming -> our mesh axis names
-_AXIS_ALIAS = {"data": "dp", "pipe": "pp", "sharding": "sharding", "model": "mp", "sep": "sep"}
+_AXIS_ALIAS = {"data": "dp", "pipe": "pp", "sharding": "sharding", "model": "mp",
+               "sep": "sep", "expert": "ep"}
 
 
 class CommunicateTopology:
@@ -98,6 +99,7 @@ class HybridCommunicateGroup:
         self._sharding_degree = self._axes.get("sharding", 1)
         self._mp_degree = self._axes.get("mp", 1)
         self._sep_degree = self._axes.get("sep", 1)
+        self._ep_degree = self._axes.get("ep", 1)
 
         coord = topology.get_coord(global_rank)
         self._coord = dict(zip(names, coord))
@@ -186,6 +188,17 @@ class HybridCommunicateGroup:
 
     def get_sharding_parallel_group_src_rank(self) -> int:
         return self._groups["sharding"].ranks[0]
+
+    # expert parallel (reference topology.py expert-parallel accessors; the
+    # moe_layer's global_scatter/gather group maps to this mesh axis)
+    def get_expert_parallel_rank(self) -> int:
+        return self._coord.get("expert", 0)
+
+    def get_expert_parallel_world_size(self) -> int:
+        return self._ep_degree
+
+    def get_expert_parallel_group(self) -> Optional[Group]:
+        return self._groups.get("ep")
 
     # sep (sequence parallel axis, ours — absent in the reference §5.7)
     def get_sep_parallel_rank(self) -> int:

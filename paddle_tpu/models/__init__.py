@@ -10,7 +10,9 @@ from .ernie import (  # noqa: F401
     ernie_base,
     ernie_tiny,
 )
-from .gpt import GPT3_1p3B, GPT_TINY, GPTConfig, GPTForCausalLM, GPTModel, gpt_tiny  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPT3_1p3B, GPT_TINY, GPTConfig, GPTForCausalLM, GPTModel, GPTMoEMLP,
+    gpt_moe_tiny, gpt_tiny)
 from .bert import (  # noqa: F401
     BERT_BASE,
     BERT_TINY,

@@ -58,6 +58,13 @@ class GPTConfig:
     loss_chunk: int = 0           # CE in seq chunks of this size (0 = off):
     #                               avoids materializing [B, S, V] fp32 logits
     initializer_range: float = 0.02
+    # ---- GPT-MoE (reference incubate/distributed/models/moe) ----
+    moe_num_experts: int = 0      # 0 = dense FFN everywhere
+    moe_every_k: int = 2          # MoE FFN replaces the dense FFN in every
+    #                               k-th block (blocks k-1, 2k-1, ...)
+    moe_top_k: int = 2            # 2 = GShard gate, 1 = Switch gate
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01  # load-balance aux-loss weight
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -75,10 +82,20 @@ GPT3_1p3B = dict(vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16
 GPT_TINY = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4, max_seq_len=64)
 
 
+def _batch_axes():
+    """Mesh axes carrying the batch dim: dp always, plus ep when the mesh
+    has one — expert parallelism rides the data axes for non-expert compute
+    (DeepSpeed-MoE style); the MoE dispatch all-to-all regroups tokens by
+    expert across ep."""
+    from ..distributed.sharding_utils import ambient_axis_names
+
+    return ("dp", "ep") if "ep" in ambient_axis_names() else ("dp",)
+
+
 def _seq_spec(cfg: GPTConfig) -> P:
-    """Residual-stream sharding between blocks: batch over dp; seq over the
-    sep (context-parallel) axis when the ambient mesh has one, and over mp
-    when Megatron-SP is on."""
+    """Residual-stream sharding between blocks: batch over dp (+ep); seq
+    over the sep (context-parallel) axis when the ambient mesh has one, and
+    over mp when Megatron-SP is on."""
     from ..distributed.sharding_utils import ambient_axis_names
 
     seq_axes = []
@@ -86,7 +103,7 @@ def _seq_spec(cfg: GPTConfig) -> P:
         seq_axes.append("sep")
     if cfg.sequence_parallel:
         seq_axes.append("mp")
-    return P("dp", tuple(seq_axes) if seq_axes else None, None)
+    return P(_batch_axes(), tuple(seq_axes) if seq_axes else None, None)
 
 
 class GPTAttention(Layer):
@@ -108,7 +125,7 @@ class GPTAttention(Layer):
         # heads over mp; seq stays sharded over sep when the axis is active
         # (gathering full-S here would defeat context parallelism's memory)
         seq_axis = "sep" if "sep" in ambient_axis_names() else None
-        qkv = maybe_shard(qkv, P("dp", seq_axis, None, "mp", None))
+        qkv = maybe_shard(qkv, P(_batch_axes(), seq_axis, None, "mp", None))
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, S, H, D]
         hcg = get_hybrid_communicate_group()
         sep = hcg.get_sep_parallel_world_size() if hcg is not None else 1
@@ -155,14 +172,91 @@ class GPTMLP(Layer):
         return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
 
 
-class GPTBlock(Layer):
+class GPTMoEMLP(Layer):
+    """Expert-parallel MoE FFN — the GPT-MoE block's dense-FFN replacement
+    (reference incubate/distributed/models/moe/moe_layer.py:261 MoELayer with
+    global_scatter/global_gather index routing :117/:188).
+
+    TPU-native: experts are first-class STACKED parameters [E, ...] whose
+    dist_spec shards the expert dim over the `ep` mesh axis, and routing is
+    the dense GShard/Switch capacity dispatch — two einsums against one-hot
+    dispatch/combine tensors. Under an ep mesh GSPMD emits exactly the
+    all-to-all pair the reference wrote by hand (asserted by
+    tests/test_hlo_collectives.py), and the batched expert einsum stays on
+    the owning devices. `aux_loss` carries the load-balancing gate term,
+    folded into the LM loss with cfg.moe_aux_weight."""
+
     def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        E, d, f = cfg.moe_num_experts, cfg.hidden_size, cfg.intermediate_size
+        self.cfg = cfg
+        self.gate_weight = self.create_parameter([d, E])
+        self.w1 = self.create_parameter([E, d, f])
+        self.b1 = self.create_parameter([E, f], is_bias=True)
+        self.w2 = self.create_parameter([E, f, d])
+        self.b2 = self.create_parameter([E, d], is_bias=True)
+        annotate_parameter(self.w1, P("ep", None, None))
+        annotate_parameter(self.b1, P("ep", None))
+        annotate_parameter(self.w2, P("ep", None, None))
+        annotate_parameter(self.b2, P("ep", None))
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.aux_loss = None
+
+    def forward(self, x):
+        from ..incubate.distributed.models.moe.gate import (
+            gshard_gating, switch_gating)
+        from ..ops._dispatch import apply
+
+        cfg = self.cfg
+        B, S, d = x.shape[0], x.shape[1], x.shape[2]
+        E = cfg.moe_num_experts
+        xt = x.reshape([-1, d])  # [T, d]
+        T = xt.shape[0]
+        capacity = max(1, int(cfg.moe_capacity_factor * T / E))
+        logits = xt.matmul(self.gate_weight)  # [T, E]
+        gating = gshard_gating if cfg.moe_top_k == 2 else switch_gating
+
+        dispatch, combine, aux = apply(
+            "moe_gating", lambda lg: gating(lg, capacity), logits)
+        self.aux_loss = aux
+
+        def dispatch_fn(dv, xv):
+            return jnp.einsum("tec,td->ecd", dv,
+                              xv.astype(jnp.float32)).astype(xv.dtype)
+
+        ein = apply("moe_dispatch", dispatch_fn, dispatch, xt)  # [E, C, d]
+        ein = maybe_shard(ein, P("ep", None, None))
+
+        import jax as _jax
+
+        def experts_fn(ei, w1, b1, w2, b2):
+            # batched per-expert FFN in the activation dtype (bf16 on the
+            # MXU); the expert dim stays sharded over ep end to end
+            h = jnp.einsum("ecd,edf->ecf", ei, w1.astype(ei.dtype))
+            h = _jax.nn.gelu(h + b1[:, None, :].astype(ei.dtype), approximate=True)
+            o = jnp.einsum("ecf,efd->ecd", h, w2.astype(ei.dtype))
+            return o + b2[:, None, :].astype(ei.dtype)
+
+        eout = apply("moe_experts_fused", experts_fn, ein,
+                     self.w1, self.b1, self.w2, self.b2)
+        eout = maybe_shard(eout, P("ep", None, None))
+
+        def combine_fn(cv, ev):
+            return jnp.einsum("tec,ecd->td", cv,
+                              ev.astype(jnp.float32)).astype(ev.dtype)
+
+        out = apply("moe_combine", combine_fn, combine, eout)
+        return self.dropout(out.reshape([B, S, d]))
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig, use_moe: bool = False):
         super().__init__()
         self.cfg = cfg
         self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
         self.attn = GPTAttention(cfg)
         self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
-        self.mlp = GPTMLP(cfg)
+        self.mlp = GPTMoEMLP(cfg) if use_moe else GPTMLP(cfg)
 
     def forward(self, x):
         x = maybe_shard(x, _seq_spec(self.cfg))
@@ -195,8 +289,12 @@ class GPTModel(Layer):
         super().__init__()
         self.cfg = cfg
         self.embeddings = GPTEmbeddings(cfg)
-        self.layers = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        k = max(cfg.moe_every_k, 1)
+        self.layers = nn.LayerList([
+            GPTBlock(cfg, use_moe=cfg.moe_num_experts > 0 and i % k == k - 1)
+            for i in range(cfg.num_layers)])
         self.final_ln = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.moe_aux_loss = None
         self._init_weights()
 
     def _init_weights(self):
@@ -218,14 +316,22 @@ class GPTModel(Layer):
 
     def forward(self, input_ids, position_ids=None):
         h = self.embeddings(input_ids, position_ids)
+        aux = None
         for i, block in enumerate(self.layers):
+            # MoE blocks run outside recompute: their aux_loss is read by
+            # the loss path this trace, and smuggling it out of a
+            # jax.checkpoint region would leak tracers
             if self.cfg.use_recompute and self.training \
-                    and i % max(self.cfg.recompute_interval, 1) == 0:
+                    and i % max(self.cfg.recompute_interval, 1) == 0 \
+                    and not isinstance(block.mlp, GPTMoEMLP):
                 from ..distributed.fleet.recompute import recompute
 
                 h = recompute(block, h, policy=self.cfg.recompute_policy)
             else:
                 h = block(h)
+            if isinstance(block.mlp, GPTMoEMLP) and block.mlp.aux_loss is not None:
+                aux = block.mlp.aux_loss if aux is None else aux + block.mlp.aux_loss
+        self.moe_aux_loss = aux
         return self.final_ln(h)
 
 
@@ -249,8 +355,18 @@ class GPTForCausalLM(Layer):
     def forward(self, input_ids, position_ids=None):
         return self._logits(self.gpt(input_ids, position_ids))
 
+    def _moe_aux(self):
+        """Weighted MoE load-balance aux term from the LAST trunk forward
+        (None for dense models). Callers inside the same trace only."""
+        aux = getattr(self.gpt, "moe_aux_loss", None)
+        if aux is None:
+            return None
+        return aux * self.cfg.moe_aux_weight
+
     def loss(self, logits, labels):
-        """Next-token CE, labels already shifted by the data pipeline."""
+        """Next-token CE, labels already shifted by the data pipeline.
+        For MoE configs the gate aux loss is added by forward_with_loss
+        (this method sees only logits)."""
         V = logits.shape[-1]
         return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1])).mean()
 
@@ -271,7 +387,9 @@ class GPTForCausalLM(Layer):
         mp = hcg.get_model_parallel_world_size() if hcg is not None else 1
         if not chunk or S % chunk or mp > 1:
             # vocab-parallel logits go through ParallelCrossEntropy instead
-            return self.loss(self.forward(input_ids), labels)
+            loss = self.loss(self.forward(input_ids), labels)
+            aux = self._moe_aux()
+            return loss if aux is None else loss + aux
         h = self.gpt(input_ids)
         if cfg.tie_word_embeddings:
             W = self.gpt.embeddings.word_embeddings.weight  # [V, Hd]
@@ -301,7 +419,9 @@ class GPTForCausalLM(Layer):
             return acc + ckpt_ce(h_c, y_c, Wv), None
 
         total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ys))
-        return Tensor(total / (B * S))
+        loss = Tensor(total / (B * S))
+        aux = self._moe_aux()
+        return loss if aux is None else loss + aux
 
 
     # ---- compiled pipeline-parallel protocol (PipelineSpec) ----
@@ -321,6 +441,12 @@ class GPTForCausalLM(Layer):
         from ..distributed.fleet.meta_parallel.pipeline_parallel import (
             make_layer_stack_pipeline_spec)
 
+        if self.cfg.moe_num_experts > 0:
+            raise NotImplementedError(
+                "GPT-MoE does not pipeline yet: the homogeneous-stack "
+                "schedule can't carry the gate aux loss out of the scanned "
+                "stage. Compose MoE with dp x ep x sharding x mp instead "
+                "(BASELINE config 5 shape).")
         return make_layer_stack_pipeline_spec(
             self, self.gpt.layers[0], "gpt.layers", self.cfg.num_layers,
             context_parallel=True)  # GPTAttention handles manual-sep shards
@@ -365,4 +491,11 @@ class GPTForCausalLM(Layer):
 
 def gpt_tiny(**overrides) -> GPTForCausalLM:
     cfg = {**GPT_TINY, **overrides}
+    return GPTForCausalLM(GPTConfig(**cfg))
+
+
+def gpt_moe_tiny(**overrides) -> GPTForCausalLM:
+    """Tiny GPT-MoE fixture: 4 experts, MoE FFN every 2nd block."""
+    cfg = {**GPT_TINY, "num_layers": 2, "moe_num_experts": 4,
+           "moe_every_k": 2, **overrides}
     return GPTForCausalLM(GPTConfig(**cfg))
