@@ -81,3 +81,8 @@ register_flag("default_dtype", "float32", "Default floating dtype for creation o
 register_flag("amp_dtype", "bfloat16", "Preferred autocast dtype on TPU")
 register_flag("enable_async_checkpoint", True, "Write checkpoints from a background thread")
 register_flag("max_inflight_microbatches", 2, "Pipeline schedule in-flight cap")
+register_flag("eval_no_record", False,
+              "Layers in eval() mode skip tape recording entirely: closes "
+              "the chained-forward tape growth hazard (h = m(h) inference "
+              "loops without no_grad) at the cost of input-gradients "
+              "through eval-mode layers")

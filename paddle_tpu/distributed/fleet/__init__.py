@@ -157,6 +157,12 @@ def distributed_model(model):
     from .meta_parallel.pp_layers import PipelineLayer
 
     if hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
+        from .meta_parallel import PipelineParallelWithInterleave
+
+        vpp = (_strategy.pipeline_configs.get("virtual_pp_degree", 1)
+               if _strategy is not None else 1)
+        if vpp and vpp > 1:
+            return PipelineParallelWithInterleave(model, hcg=hcg, strategy=_strategy)
         return PipelineParallel(model, hcg=hcg, strategy=_strategy)
     if hcg.get_model_parallel_world_size() > 1:
         return TensorParallel(model, hcg=hcg, strategy=_strategy)

@@ -122,7 +122,8 @@ def stack_block_params(params: dict, spec: PipelineSpec, pp: int,
     v = virtual_stages
     if L % (pp * v):
         raise ValueError(f"n_blocks {L} not divisible by pp*virtual {pp}*{v}")
-    pat = re.compile(rf"^{re.escape(spec.block_prefix)}\.(\d+)\.(.+)$")
+    pat = (re.compile(rf"^{re.escape(spec.block_prefix)}\.(\d+)\.(.+)$")
+           if spec.block_prefix else re.compile(r"^(\d+)\.(.+)$"))
     by_suffix: dict = {}
     other = {}
     for name, val in params.items():
@@ -144,6 +145,12 @@ def stack_block_params(params: dict, spec: PipelineSpec, pp: int,
     return stacked, other
 
 
+def block_param_name(prefix: str, idx, suffix: str) -> str:
+    """Flat parameter name of block `idx`'s `suffix` ('' prefix supported —
+    PipelineLayer's sublayers are named bare '0', '1', ...)."""
+    return f"{prefix}.{idx}.{suffix}" if prefix else f"{idx}.{suffix}"
+
+
 def unstack_block_params(stacked: dict, spec: PipelineSpec,
                          pp: Optional[int] = None, virtual_stages: int = 1) -> dict:
     """Inverse of stack_block_params: stacked leaves -> flat layer names."""
@@ -154,11 +161,11 @@ def unstack_block_params(stacked: dict, spec: PipelineSpec,
             L = flat.shape[0]
             order = _chunk_order(L, pp if pp is not None else arr.shape[0], virtual_stages)
             for pos, layer in enumerate(order):
-                out[f"{spec.block_prefix}.{layer}.{suffix}"] = flat[pos]
+                out[block_param_name(spec.block_prefix, layer, suffix)] = flat[pos]
         else:
             flat = arr.reshape((-1,) + arr.shape[2:])
             for i in range(flat.shape[0]):
-                out[f"{spec.block_prefix}.{i}.{suffix}"] = flat[i]
+                out[block_param_name(spec.block_prefix, i, suffix)] = flat[i]
     return out
 
 
@@ -240,12 +247,56 @@ class PipelineParallel(Layer):
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """Interleaved virtual stages (reference :514). This host-driven wrapper
-    keeps the reference's eager train_batch contract; the COMPILED
-    interleaved schedule is `pipeline_schedule_interleaved` below (reached
-    via make_sharded_train_step(virtual_pp_degree=v)), which gives the
-    v-fold-smaller warmup/cooldown bubble the reference's interleaved 1F1B
-    exists for."""
+    """Interleaved virtual stages (reference :514): train_batch routes
+    through the COMPILED interleaved schedule (`pipeline_schedule_interleaved`
+    via make_sharded_train_step(virtual_pp_degree=v)) — device d owns model
+    chunks {r*pp + d} and the warmup/cooldown bubble shrinks v-fold, the
+    schedule the reference's interleaved 1F1B exists for. Requires the
+    PipelineLayer to be a homogeneous stack (PipelineLayer.pipeline_spec);
+    heterogeneous stacks raise rather than silently not interleaving."""
+
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None,
+                 virtual_pp_degree: Optional[int] = None):
+        super().__init__(layers, hcg=hcg, strategy=strategy)
+        cfg = getattr(strategy, "pipeline_configs", {}) if strategy is not None else {}
+        self._vpp = int(virtual_pp_degree or cfg.get("virtual_pp_degree", 2))
+        self._step = None
+        self._opt_id = None
+
+    def _compiled_step(self, optimizer):
+        inner = getattr(optimizer, "_inner", optimizer)
+        inner = getattr(inner, "_inner", inner)  # HybridParallelOptimizer chain
+        if self._step is None or self._opt_id != id(inner):
+            from ..utils import make_sharded_train_step
+
+            self._step = make_sharded_train_step(
+                self._layers, inner,
+                accumulate_steps=max(self.accumulate_steps, 1),
+                virtual_pp_degree=self._vpp)
+            self._opt_id = id(inner)
+        return self._step
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        raise NotImplementedError(
+            "PipelineParallelWithInterleave compiles fwd+bwd+update as one "
+            "step; use train_batch")
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        if scaler is not None:
+            raise NotImplementedError(
+                "PipelineParallelWithInterleave compiles the step in f32/bf16 "
+                "master-weight form; GradScaler loss scaling is not wired "
+                "into the compiled schedule — drop the scaler (bf16 needs "
+                "none) or use PipelineParallel (vpp=1)")
+        self._layers.train()
+        x, y = data
+        step = self._compiled_step(optimizer)
+        loss = step(x, y, lr=lr_scheduler.get_lr() if lr_scheduler is not None else None)
+        step.sync_to_model()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        self.total_loss = loss
+        return Tensor(loss) if not isinstance(loss, Tensor) else loss
 
 
 def pipeline_schedule(

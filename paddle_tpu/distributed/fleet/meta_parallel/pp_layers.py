@@ -160,3 +160,46 @@ class PipelineLayer(Layer):
             else:
                 x = sub(x)
         return x
+
+    def pipeline_spec(self):
+        """PipelineSpec for the compiled SPMD schedules (consumed by
+        make_sharded_train_step and PipelineParallelWithInterleave): valid
+        when the layer list is a homogeneous stack — same Layer class, same
+        parameter shapes, no SharedLayerDesc forward_funcs — which is what
+        the scan-over-stacked-params schedule requires."""
+        import jax.numpy as jnp
+
+        from ....core.tensor import Tensor
+        from .pipeline_parallel import PipelineSpec
+
+        layers = [sub for sub, _ in self.run_function]
+        if any(fwd is not None for _, fwd in self.run_function):
+            raise NotImplementedError(
+                "compiled pipeline needs plain layers (SharedLayerDesc "
+                "forward_funcs are host-driven only)")
+        first = layers[0]
+        shapes0 = {k: tuple(v.shape) for k, v in first.state_dict().items()}
+        for l in layers[1:]:
+            if type(l) is not type(first) or {
+                    k: tuple(v.shape) for k, v in l.state_dict().items()} != shapes0:
+                raise NotImplementedError(
+                    "compiled pipeline needs a homogeneous layer stack "
+                    f"({type(first).__name__} vs {type(l).__name__})")
+        if self.loss_fn is None:
+            raise ValueError("PipelineLayer needs loss_fn for the compiled "
+                             "pipeline's last stage")
+        loss_fn = self.loss_fn
+
+        def pre(params, buffers, x):
+            return x if not isinstance(x, Tensor) else x._value
+
+        def block(bp, h):
+            out, _ = first.functional_call(bp, {}, Tensor(h))
+            return out._value
+
+        def post_loss(params, buffers, h, y):
+            l = loss_fn(Tensor(h), Tensor(y))
+            return (l._value if isinstance(l, Tensor) else jnp.asarray(l)).astype(jnp.float32)
+
+        return PipelineSpec(block_prefix="", n_blocks=len(layers),
+                            pre=pre, block=block, post_loss=post_loss)

@@ -114,7 +114,9 @@ class ShardedTrainStep:
         self.mesh = mesh
         self.model = model
         self.optimizer = optimizer
-        self.loss_fn = loss_fn if loss_fn is not None else getattr(model, "loss")
+        # pp mode takes its loss from pipeline_spec().post_loss, so a model
+        # without .loss (e.g. PipelineLayer with its own loss_fn) is fine
+        self.loss_fn = loss_fn if loss_fn is not None else getattr(model, "loss", None)
         self._step_i = 0
         self._seed = seed
 
@@ -134,7 +136,8 @@ class ShardedTrainStep:
                     f"mesh has pp={pp} but {type(model).__name__} provides no "
                     "pipeline_spec(); implement the PipelineSpec protocol "
                     "(see meta_parallel.pipeline_parallel)")
-            from .meta_parallel.pipeline_parallel import stack_block_params
+            from .meta_parallel.pipeline_parallel import (
+                block_param_name, stack_block_params)
 
             pspec = model.pipeline_spec()
             self._pspec = pspec
@@ -142,7 +145,8 @@ class ShardedTrainStep:
             self._vpp = max(int(virtual_pp_degree), 1)
             stacked0, other0 = stack_block_params(params0, pspec, pp,
                                                   virtual_stages=self._vpp)
-            self._stack_prefix = f"{pspec.block_prefix}.__stacked__."
+            self._stack_prefix = (f"{pspec.block_prefix}." if pspec.block_prefix
+                                  else "") + "__stacked__."
             skey = lambda sfx: f"{self._stack_prefix}{sfx}"
             self._suffixes = sorted(stacked0)
             params0 = {**other0, **{skey(s): v for s, v in stacked0.items()}}
@@ -154,7 +158,7 @@ class ShardedTrainStep:
                     mesh, resolve_spec(getattr(named[name], "dist_spec", None), mesh))
             lead = ("pp", None, None) if self._vpp > 1 else ("pp", None)
             for sfx in self._suffixes:
-                ref = named[f"{pspec.block_prefix}.0.{sfx}"]
+                ref = named[block_param_name(pspec.block_prefix, 0, sfx)]
                 bspec = resolve_spec(getattr(ref, "dist_spec", None), mesh)
                 entries = list(bspec) + [None] * (ref._value.ndim - len(bspec))
                 p_shard[skey(sfx)] = NamedSharding(mesh, P(*lead, *entries))
@@ -188,6 +192,10 @@ class ShardedTrainStep:
         if pp > 1:
             loss_impl = self._build_pipeline_loss(buffers0, pp_remat)
         else:
+            if not use_fwl and loss_fn_ is None:
+                raise ValueError(
+                    f"{type(model).__name__} has no .loss/.forward_with_loss; "
+                    "pass loss_fn= to make_sharded_train_step")
             self._accum = accumulate_steps if accumulate_steps else 1
 
             def loss_impl(pvals, x, y, seed):

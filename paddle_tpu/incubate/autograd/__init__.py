@@ -1,10 +1,20 @@
-"""paddle.incubate.autograd: functional transforms + prim toggles.
+"""paddle.incubate.autograd: functional transforms + the prim/composite layer.
 
 Reference surface: python/paddle/incubate/autograd/ (vjp/jvp/Jacobian/Hessian
-over primapi, enable_prim/disable_prim, forward_grad). The transforms
-re-export paddle.autograd's jax-native versions; prim mode is inherently on
-(every op IS a primitive jaxpr program), so the toggles track state for
-API compatibility.
+over primapi, enable_prim/disable_prim, forward_grad — primapi.py:25) and the
+composite-grad decomposition rules in paddle/fluid/prim/.
+
+TPU re-design: every op already lowers to a jax-primitive composition, so
+"prim mode" doesn't need a program rewriter. What it DOES change:
+
+- fused custom_vjp kernels (Pallas flash attention, fused LN/RMSNorm) are
+  only once-differentiable; with prim enabled the dispatch routes them to
+  their primitive jnp compositions so arbitrary-order autodiff composes
+  (the composite-grad role of fluid/prim — see nn/functional/_pallas_gate).
+- `register_composite` lets users attach a decomposition for their own
+  custom-vjp ops, consulted at the dispatch seam while prim is on.
+- `forward_grad` records a forward-mode (jvp-of-replay) node into the
+  captured static Program (static/program.forward_gradients).
 """
 
 from ...autograd import grad, hessian, jacobian, jvp, vjp  # noqa: F401
@@ -15,6 +25,9 @@ Jacobian = jacobian
 Hessian = hessian
 
 _prim_enabled = False
+
+# op_name -> pure composite fn (same signature as the op's pure lowering)
+_composites = {}
 
 
 def enable_prim():
@@ -31,11 +44,43 @@ def prim_enabled() -> bool:
     return _prim_enabled
 
 
+def register_composite(op_name: str, fn=None):
+    """Register a primitive decomposition for `op_name`, used by the op
+    dispatch while prim is enabled (the composite-grad registration of
+    fluid/prim). Usable as a decorator::
+
+        @register_composite("my_fused_op")
+        def my_composite(x, w): ...   # same signature as the pure lowering
+    """
+    if fn is None:
+        def deco(f):
+            _composites[op_name] = f
+            return f
+
+        return deco
+    _composites[op_name] = fn
+    return fn
+
+
+def composite_for(op_name: str):
+    """The registered decomposition for op_name iff prim mode is on."""
+    if not _prim_enabled:
+        return None
+    return _composites.get(op_name)
+
+
 def forward_grad(outputs, inputs, grad_inputs=None):
-    """Forward-mode AD over captured static programs (reference
-    primapi.forward_grad) is not supported; use
-    paddle.incubate.autograd.jvp(func, xs, v) on a python function."""
-    raise NotImplementedError(
-        "forward_grad over captured static programs is not supported; use "
-        "paddle.incubate.autograd.jvp(func, xs, v) on a python function"
-    )
+    """Forward-mode AD over the captured static program (reference
+    primapi.py:25 forward_grad): returns one grad var per output holding
+    d(output)/d(inputs) . tangents, with tangents = grad_inputs (default
+    ones). Must run under paddle.enable_static() with prim enabled, inside
+    the program being built — like the reference."""
+    if not _prim_enabled:
+        raise RuntimeError(
+            "forward_grad requires prim mode: call "
+            "paddle.incubate.autograd.enable_prim() first (reference "
+            "primapi.forward_grad has the same precondition)")
+    from ...static.program import forward_gradients
+
+    outs = forward_gradients(outputs, inputs, input_gradients=grad_inputs)
+    return outs if isinstance(outputs, (list, tuple)) else outs[0]

@@ -24,6 +24,31 @@ from .gate import GShardGate, SwitchGate, gshard_gating, switch_gating
 EP_AXIS = "ep"
 
 
+def moe_route(xt, gate_weight, gate_type: str, capacity: int, run_experts):
+    """Shared dense-routing core (GShard/Switch): gate -> dispatch einsum ->
+    run_experts([E, C, d] -> [E, C, d'], ep-sharded) -> combine einsum.
+    Both MoELayer and models.gpt.GPTMoEMLP route through here, so capacity/
+    overflow/gating semantics cannot diverge. Returns (out [T, d'], aux)."""
+    logits = xt.matmul(gate_weight)  # [T, E]
+    gating = gshard_gating if gate_type == "gshard" else switch_gating
+    dispatch, combine, aux = apply(
+        "moe_gating", lambda lg: gating(lg, capacity), logits)
+
+    def dispatch_fn(dv, xv):
+        return jnp.einsum("tec,td->ecd", dv,
+                          xv.astype(jnp.float32)).astype(xv.dtype)
+
+    ein = apply("moe_dispatch", dispatch_fn, dispatch, xt)  # [E, C, d]
+    ein = maybe_shard(ein, P(EP_AXIS, None, None))
+    eout = maybe_shard(run_experts(ein), P(EP_AXIS, None, None))
+
+    def combine_fn(cv, ev):
+        return jnp.einsum("tec,ecd->td", cv,
+                          ev.astype(jnp.float32)).astype(ev.dtype)
+
+    return apply("moe_combine", combine_fn, combine, eout), aux
+
+
 class MoELayer(Layer):
     """Mixture of experts over `experts` (a list of same-architecture Layers).
 
@@ -97,25 +122,7 @@ class MoELayer(Layer):
         d = orig_shape[-1]
         xt = x.reshape([-1, d])  # [T, d]
         T = xt.shape[0]
-        E = self.num_experts
-        capacity = max(1, int(self.capacity_factor * T / E))
-
-        logits = xt.matmul(self.gate_weight)  # [T, E]
-
-        gate_type = self.gate_type
-
-        def gating_fn(lg):
-            return (gshard_gating if gate_type == "gshard" else switch_gating)(lg, capacity)
-
-        dispatch, combine, aux = apply("moe_gating", gating_fn, logits)
-        self.aux_loss = aux
-
-        # expert_in[e] = sum_t dispatch[t,e,c] * x[t]  -> [E, C, d]
-        def dispatch_fn(dv, xv):
-            return jnp.einsum("tec,td->ecd", dv, xv.astype(jnp.float32)).astype(xv.dtype)
-
-        expert_in = apply("moe_dispatch", dispatch_fn, dispatch, xt)  # [E, C, d]
-        expert_in = maybe_shard(expert_in, P(EP_AXIS, None, None))
+        capacity = max(1, int(self.capacity_factor * T / self.num_experts))
 
         fused = self._fused_expert_stack()
         if fused is not None:
@@ -126,27 +133,23 @@ class MoELayer(Layer):
             # analog, verified by tests/test_hlo_collectives.py)
             w1, b1, w2, b2, act = fused
 
-            def experts_fn(ei, w1v, b1v, w2v, b2v):
-                h = jnp.einsum("ecd,edh->ech", ei.astype(jnp.float32), w1v.astype(jnp.float32))
-                h = act(h + b1v[:, None, :])
-                o = jnp.einsum("ech,ehd->ecd", h, w2v.astype(jnp.float32))
-                return (o + b2v[:, None, :]).astype(ei.dtype)
+            def run_experts(expert_in):
+                def experts_fn(ei, w1v, b1v, w2v, b2v):
+                    h = jnp.einsum("ecd,edh->ech", ei.astype(jnp.float32), w1v.astype(jnp.float32))
+                    h = act(h + b1v[:, None, :])
+                    o = jnp.einsum("ech,ehd->ecd", h, w2v.astype(jnp.float32))
+                    return (o + b2v[:, None, :]).astype(ei.dtype)
 
-            expert_out = apply("moe_experts_fused", experts_fn, expert_in, w1, b1, w2, b2)
+                return apply("moe_experts_fused", experts_fn, expert_in, w1, b1, w2, b2)
         else:
-            outs = []
-            for i, e in enumerate(self.experts):
-                outs.append(e(expert_in[i]))
-            from ..... import ops as _ops
+            def run_experts(expert_in):
+                from ..... import ops as _ops
 
-            expert_out = _ops.stack(outs, axis=0)  # [E, C, d_out]
-        expert_out = maybe_shard(expert_out, P(EP_AXIS, None, None))
+                return _ops.stack([e(expert_in[i]) for i, e in enumerate(self.experts)], axis=0)
 
-        def combine_fn(cv, ev):
-            return jnp.einsum("tec,ecd->td", cv, ev.astype(jnp.float32)).astype(ev.dtype)
-
-        out = apply("moe_combine", combine_fn, combine, expert_out)
-        return out.reshape(orig_shape[:-1] + [expert_out.shape[-1]])
+        out, aux = moe_route(xt, self.gate_weight, self.gate_type, capacity, run_experts)
+        self.aux_loss = aux
+        return out.reshape(orig_shape[:-1] + [out.shape[-1]])
 
 
 class ExpertMLP(Layer):

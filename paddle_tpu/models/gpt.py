@@ -203,49 +203,33 @@ class GPTMoEMLP(Layer):
         self.aux_loss = None
 
     def forward(self, x):
-        from ..incubate.distributed.models.moe.gate import (
-            gshard_gating, switch_gating)
+        from ..incubate.distributed.models.moe.moe_layer import moe_route
         from ..ops._dispatch import apply
 
         cfg = self.cfg
         B, S, d = x.shape[0], x.shape[1], x.shape[2]
-        E = cfg.moe_num_experts
         xt = x.reshape([-1, d])  # [T, d]
         T = xt.shape[0]
-        capacity = max(1, int(cfg.moe_capacity_factor * T / E))
-        logits = xt.matmul(self.gate_weight)  # [T, E]
-        gating = gshard_gating if cfg.moe_top_k == 2 else switch_gating
-
-        dispatch, combine, aux = apply(
-            "moe_gating", lambda lg: gating(lg, capacity), logits)
-        self.aux_loss = aux
-
-        def dispatch_fn(dv, xv):
-            return jnp.einsum("tec,td->ecd", dv,
-                              xv.astype(jnp.float32)).astype(xv.dtype)
-
-        ein = apply("moe_dispatch", dispatch_fn, dispatch, xt)  # [E, C, d]
-        ein = maybe_shard(ein, P("ep", None, None))
+        capacity = max(1, int(cfg.moe_capacity_factor * T / cfg.moe_num_experts))
 
         import jax as _jax
 
-        def experts_fn(ei, w1, b1, w2, b2):
-            # batched per-expert FFN in the activation dtype (bf16 on the
-            # MXU); the expert dim stays sharded over ep end to end
-            h = jnp.einsum("ecd,edf->ecf", ei, w1.astype(ei.dtype))
-            h = _jax.nn.gelu(h + b1[:, None, :].astype(ei.dtype), approximate=True)
-            o = jnp.einsum("ecf,efd->ecd", h, w2.astype(ei.dtype))
-            return o + b2[:, None, :].astype(ei.dtype)
+        def run_experts(ein):
+            def experts_fn(ei, w1, b1, w2, b2):
+                # batched per-expert FFN in the activation dtype (bf16 on
+                # the MXU); the expert dim stays sharded over ep end to end
+                h = jnp.einsum("ecd,edf->ecf", ei, w1.astype(ei.dtype))
+                h = _jax.nn.gelu(h + b1[:, None, :].astype(ei.dtype), approximate=True)
+                o = jnp.einsum("ecf,efd->ecd", h, w2.astype(ei.dtype))
+                return o + b2[:, None, :].astype(ei.dtype)
 
-        eout = apply("moe_experts_fused", experts_fn, ein,
-                     self.w1, self.b1, self.w2, self.b2)
-        eout = maybe_shard(eout, P("ep", None, None))
+            return apply("moe_experts_fused", experts_fn, ein,
+                         self.w1, self.b1, self.w2, self.b2)
 
-        def combine_fn(cv, ev):
-            return jnp.einsum("tec,ecd->td", cv,
-                              ev.astype(jnp.float32)).astype(ev.dtype)
-
-        out = apply("moe_combine", combine_fn, combine, eout)
+        out, aux = moe_route(
+            xt, self.gate_weight, "gshard" if cfg.moe_top_k == 2 else "switch",
+            capacity, run_experts)
+        self.aux_loss = aux
         return self.dropout(out.reshape([B, S, d]))
 
 

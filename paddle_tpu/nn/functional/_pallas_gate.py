@@ -9,6 +9,14 @@ from ...core.flags import flag_value
 def use_pallas() -> bool:
     if not flag_value("use_pallas_kernels"):
         return False
+    # prim/composite mode (reference fluid/prim composite grads): fused
+    # custom_vjp kernels are only once-differentiable; with prim enabled
+    # every op lowers through its primitive jnp composition so arbitrary-
+    # order autodiff rules compose
+    from ...incubate.autograd import prim_enabled
+
+    if prim_enabled():
+        return False
     try:
         return jax.default_backend() in ("tpu", "axon")
     except Exception:

@@ -53,6 +53,14 @@ def apply(op_name: str, pure_fn, *tensors: Tensor):
     from ..amp.auto_cast import amp_dtype_for
     from ..core.dtype import to_jax_dtype
 
+    from ..incubate.autograd import composite_for
+
+    comp = composite_for(op_name)
+    if comp is not None:
+        # prim/composite mode: swap the (possibly custom-vjp, once-
+        # differentiable) lowering for its registered primitive
+        # decomposition so higher-order autodiff composes
+        pure_fn = comp
     target = amp_dtype_for(op_name)
     if target is not None:
         from .manipulation import cast as _cast  # tape-recorded so grads flow back

@@ -45,6 +45,18 @@ class _GradNode:
         self.fwd_len = fwd_len  # only nodes before this index feed the loss
 
 
+class _JvpNode:
+    """Forward-mode grads: jvp of the forward replay (reference
+    primapi.forward_grad's linearize-program rewrite, fluid/prim/)."""
+
+    __slots__ = ("out_ids", "in_ids", "tangent_ids", "jvp_ids", "fwd_len")
+
+    def __init__(self, out_ids, in_ids, tangent_ids, jvp_ids, fwd_len):
+        self.out_ids, self.in_ids = out_ids, in_ids
+        self.tangent_ids, self.jvp_ids = tangent_ids, jvp_ids
+        self.fwd_len = fwd_len
+
+
 class _UpdateNode:
     """Optimizer update: consumes grads, writes new param values (side effect)."""
 
@@ -187,6 +199,10 @@ def _replay(prog: Program, env: Dict[int, jnp.ndarray], upto: Optional[int] = No
             grads = _compute_grads(prog, env, node)
             for tid, g in zip(node.grad_ids, grads):
                 env[tid] = g
+        elif isinstance(node, _JvpNode):
+            jvps = _compute_jvps(prog, env, node)
+            for tid, g in zip(node.jvp_ids, jvps):
+                env[tid] = g
         elif isinstance(node, _UpdateNode):
             _apply_update(prog, env, node)
     return env
@@ -203,11 +219,22 @@ def _forward_fn(prog: Program, node: _GradNode, feeds: Dict[int, jnp.ndarray]):
 
 
 def _replay_pure(prog, env, upto):
+    """Differentiable replay: like _replay but without _UpdateNode side
+    effects. _GradNode/_JvpNode values ARE replayed (jax.grad/jvp of the
+    inner replay is itself differentiable) so forward-over-reverse —
+    forward_grad of static.gradients outputs, the canonical HVP — sees the
+    real gradient path instead of a zero constant."""
     for n in prog.nodes[:upto]:
         if isinstance(n, _OpNode):
             outs = n.fn(*[env.get(t, None) if env.get(t) is not None else prog.tensors[t]._value for t in n.in_ids])
             for tid, leaf in zip(n.out_ids, jax.tree_util.tree_leaves(outs)):
                 env[tid] = leaf
+        elif isinstance(n, _GradNode):
+            for tid, g in zip(n.grad_ids, _compute_grads(prog, env, n)):
+                env[tid] = g
+        elif isinstance(n, _JvpNode):
+            for tid, g in zip(n.jvp_ids, _compute_jvps(prog, env, n)):
+                env[tid] = g
 
 
 def _compute_grads(prog, env, node: _GradNode):
@@ -216,6 +243,26 @@ def _compute_grads(prog, env, node: _GradNode):
     for t in node.wrt_ids:
         feeds.pop(t, None)
     return jax.grad(_forward_fn(prog, node, feeds))(wrt_vals)
+
+
+def _compute_jvps(prog, env, node: _JvpNode):
+    feeds = {tid: v for tid, v in env.items()}
+    in_vals = [env.get(t, prog.tensors[t]._value) for t in node.in_ids]
+    tangents = [env.get(t, prog.tensors[t]._value) if t is not None
+                else jnp.ones_like(v)
+                for t, v in zip(node.tangent_ids, in_vals)]
+    for t in node.in_ids:
+        feeds.pop(t, None)
+
+    def f(*vals):
+        env2 = dict(feeds)
+        env2.update(dict(zip(node.in_ids, vals)))
+        _replay_pure(prog, env2, node.fwd_len)
+        return [env2[o] for o in node.out_ids]
+
+    _, jvps = jax.jvp(f, tuple(in_vals),
+                      tuple(t.astype(v.dtype) for t, v in zip(tangents, in_vals)))
+    return jvps
 
 
 def _apply_update(prog, env, node: _UpdateNode):
@@ -262,6 +309,36 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     prog.nodes.append(_GradNode(id(tgt), [id(p) for p in inputs], [id(g) for g in grad_vars], len(prog.nodes)))
     prog._fetch_cache.clear()
     return grad_vars
+
+
+def forward_gradients(targets, inputs, input_gradients=None):
+    """Forward-mode grad vars of targets w.r.t. inputs over the captured
+    program (the machinery behind paddle.incubate.autograd.forward_grad;
+    reference primapi.py:25). input_gradients are the input tangents
+    (default: ones). Returns one grad var per target."""
+    prog = default_main_program()
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if input_gradients is not None:
+        tg = input_gradients if isinstance(input_gradients, (list, tuple)) else [input_gradients]
+        if len(tg) != len(inputs):
+            raise ValueError(f"{len(tg)} input_gradients for {len(inputs)} inputs")
+        tangent_ids = [id(t) if t is not None else None for t in tg]
+        for t in tg:
+            if t is not None:
+                prog._register(t)
+    else:
+        tangent_ids = [None] * len(inputs)
+    jvp_vars = []
+    for t in targets:
+        g = Tensor(jnp.zeros_like(t._value))
+        g.name = f"{getattr(t, 'name', 'out')}@FWDGRAD"
+        prog._register(g)
+        jvp_vars.append(g)
+    prog.nodes.append(_JvpNode([id(t) for t in targets], [id(p) for p in inputs],
+                               tangent_ids, [id(g) for g in jvp_vars], len(prog.nodes)))
+    prog._fetch_cache.clear()
+    return jvp_vars
 
 
 def append_optimizer(optimizer, params_and_grads):

@@ -1,0 +1,172 @@
+"""prim/composite gradient layer (reference incubate/autograd/primapi.py:25
+forward_grad + fluid/prim composite-grad decompositions — round-2 verdict
+missing #4).
+
+Contract: forward_grad records a jvp-of-replay node into the captured static
+program and matches jax.jvp of the same function; enable_prim swaps opaque
+custom-vjp lowerings for registered primitive decompositions so double-grad
+works and matches numerics.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.incubate import autograd as iag
+
+
+@pytest.fixture
+def static_prim():
+    paddle.enable_static()
+    iag.enable_prim()
+    yield
+    iag.disable_prim()
+    paddle.disable_static()
+
+
+def test_forward_grad_requires_prim():
+    with pytest.raises(RuntimeError, match="prim"):
+        iag.forward_grad(None, None)
+
+
+def test_forward_grad_static_mlp_matches_jvp(static_prim):
+    """forward_grad on a captured 2-layer MLP == jax.jvp of the same math
+    with the same tangents (the reference's primapi parity check)."""
+    main = static.Program()
+    rng = np.random.RandomState(0)
+    W1 = rng.randn(4, 8).astype(np.float32)
+    W2 = rng.randn(8, 2).astype(np.float32)
+    X = rng.randn(3, 4).astype(np.float32)
+    V = rng.randn(3, 4).astype(np.float32)  # input tangents
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        w1 = static.create_parameter([4, 8], "float32")
+        w2 = static.create_parameter([8, 2], "float32")
+        w1._set_value_raw(jnp.asarray(W1))
+        w2._set_value_raw(jnp.asarray(W2))
+        out = paddle.tanh(paddle.matmul(x, w1)).matmul(w2)
+        vt = paddle.to_tensor(V)
+        (jv,) = iag.forward_grad([out], [x], grad_inputs=[vt])
+    exe = static.Executor()
+    (got,) = exe.run(main, feed={"x": X}, fetch_list=[jv])
+
+    f = lambda xv: jnp.tanh(xv @ W1) @ W2
+    _, want = jax.jvp(f, (jnp.asarray(X),), (jnp.asarray(V),))
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5, atol=1e-6)
+
+
+def test_forward_grad_default_tangents_are_ones(static_prim):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        out = (x * x).sum(axis=-1)
+        (jv,) = iag.forward_grad([out], [x])
+    exe = static.Executor()
+    X = np.arange(6, dtype=np.float32).reshape(2, 3)
+    (got,) = exe.run(main, feed={"x": X}, fetch_list=[jv])
+    # d(sum x^2)/dx . ones = sum(2x)
+    np.testing.assert_allclose(got, (2 * X).sum(axis=-1), rtol=1e-5)
+
+
+def test_forward_grad_over_gradients_hvp(static_prim):
+    """Forward-over-reverse — forward_grad of static.gradients outputs —
+    the canonical Hessian-vector product (review regression: the grad
+    target used to replay as a zero constant)."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [3], "float32")
+        loss = (x * x * x).sum()
+        (g,) = static.gradients([loss], [x])       # 3x^2
+        v = paddle.to_tensor(np.ones(3, np.float32))
+        (hv,) = iag.forward_grad([g], [x], grad_inputs=[v])  # H @ v = 6x
+    exe = static.Executor()
+    X = np.array([1.0, 2.0, 3.0], np.float32)
+    (got,) = exe.run(main, feed={"x": X}, fetch_list=[hv])
+    np.testing.assert_allclose(got, 6 * X, rtol=1e-5)
+
+
+def _fused_once_differentiable():
+    """A custom_vjp op (like the Pallas fused kernels): first-order grads
+    fine, second-order impossible without decomposition — the bwd rule is
+    an opaque callback the way a hand-written bwd kernel is."""
+    @jax.custom_vjp
+    def f(x):
+        return jnp.sin(x) * x
+
+    def fwd(x):
+        return f(x), x
+
+    def bwd(x, g):
+        grad = jax.pure_callback(
+            lambda xv, gv: np.asarray(
+                gv * (np.cos(xv) * xv + np.sin(xv)), np.float32),
+            jax.ShapeDtypeStruct(np.shape(x), jnp.float32), x, g)
+        return (grad,)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def test_composite_enables_double_grad():
+    """Double-grad through a custom-vjp op fails; with enable_prim + a
+    registered composite it works and matches the numeric second
+    derivative (reference *_double_grad via composite decomposition)."""
+    from paddle_tpu.ops._dispatch import apply
+
+    fused = _fused_once_differentiable()
+    iag.register_composite("test_fused_sinx", lambda xv: jnp.sin(xv) * xv)
+
+    def op(t):
+        return apply("test_fused_sinx", fused, t)
+
+    x = paddle.to_tensor(np.float32(0.7))
+    x.stop_gradient = False
+
+    # first order works on the opaque kernel
+    y = op(x)
+    (g1,) = paddle.grad([y], [x])
+    want1 = np.cos(0.7) * 0.7 + np.sin(0.7)
+    np.testing.assert_allclose(float(g1), want1, rtol=1e-5)
+
+    # ...but the higher-order path (create_graph re-records the vjp as a
+    # differentiable program) cannot trace through the opaque bwd
+    with pytest.raises(Exception):
+        y = op(x)
+        (g1_cg,) = paddle.grad([y], [x], create_graph=True)
+        paddle.grad([g1_cg], [x])
+
+    # ...and succeed via the composite under prim mode
+    iag.enable_prim()
+    try:
+        x2 = paddle.to_tensor(np.float32(0.7))
+        x2.stop_gradient = False
+        y2 = op(x2)
+        (g1b,) = paddle.grad([y2], [x2], create_graph=True)
+        (g2,) = paddle.grad([g1b], [x2])
+    finally:
+        iag.disable_prim()
+    # d2/dx2 (x sin x) = 2 cos x - x sin x
+    want2 = 2 * np.cos(0.7) - 0.7 * np.sin(0.7)
+    np.testing.assert_allclose(float(g2), want2, rtol=1e-4)
+    # numeric cross-check (float64 central second difference)
+    eps = 1e-4
+    fn = lambda v: v * np.sin(v)
+    num = (fn(0.7 + eps) - 2 * fn(0.7) + fn(0.7 - eps)) / eps**2
+    np.testing.assert_allclose(float(g2), num, rtol=1e-2)
+
+
+def test_prim_gates_pallas_path():
+    """enable_prim turns the fused-Pallas routing off (composite lowering
+    for arbitrary-order autodiff), disable_prim restores it."""
+    from paddle_tpu.nn.functional._pallas_gate import use_pallas
+
+    before = use_pallas()
+    iag.enable_prim()
+    try:
+        assert use_pallas() is False
+    finally:
+        iag.disable_prim()
+    assert use_pallas() == before
