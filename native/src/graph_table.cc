@@ -21,6 +21,9 @@ constexpr int kShards = 16;
 struct GShard {
   std::mutex mu;
   std::unordered_map<int64_t, std::vector<int64_t>> adj;
+  // node feature rows (common_graph_table.h:657 get_node_feat role):
+  // fixed feat_dim floats per node, set/served independently of edges
+  std::unordered_map<int64_t, std::vector<float>> feats;
 };
 
 struct GraphTable {
@@ -119,6 +122,40 @@ int32_t gt_sample_neighbors(void* p, const int64_t* keys, int64_t n,
     }
   }
   return 0;
+}
+
+// node features (common_graph_table.h:657 get_node_feat / set_node_feat):
+// dense [n, dim] rows; get fills missing nodes with zeros and returns how
+// many keys were found. Serving GNN trainers is the point: sampled
+// subgraph indices + these rows = one device gather away from training.
+int32_t gt_set_node_feat(void* p, const int64_t* keys, int64_t n,
+                         const float* feats, int64_t dim) {
+  GraphTable* g = G(p);
+  for (int64_t i = 0; i < n; ++i) {
+    GShard& s = g->ShardFor(keys[i]);
+    std::lock_guard<std::mutex> lk(s.mu);
+    s.feats[keys[i]].assign(feats + i * dim, feats + (i + 1) * dim);
+  }
+  return 0;
+}
+
+int64_t gt_get_node_feat(void* p, const int64_t* keys, int64_t n,
+                         float* out, int64_t dim) {
+  GraphTable* g = G(p);
+  int64_t found = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    GShard& s = g->ShardFor(keys[i]);
+    std::lock_guard<std::mutex> lk(s.mu);
+    auto it = s.feats.find(keys[i]);
+    float* row = out + i * dim;
+    if (it == s.feats.end() || static_cast<int64_t>(it->second.size()) != dim) {
+      std::fill(row, row + dim, 0.f);
+    } else {
+      std::copy(it->second.begin(), it->second.end(), row);
+      ++found;
+    }
+  }
+  return found;
 }
 
 // random node batch (graph_table random_sample_nodes): reservoir over shards

@@ -174,9 +174,40 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
-    """fleet.py:1058."""
+    """fleet.py:1058. Applies the meta-optimizer substitutions the
+    reference's graph-rewriting meta-optimizers performed: strategy.lars
+    (lars_optimizer.py) and strategy.dgc (dgc_optimizer.py) swap a Momentum
+    inner optimizer for the Lars / DGCMomentum update rule."""
+    from ...optimizer import DGCMomentum, Lars, Momentum
+
+    st = strategy or _strategy
+    # exact-type check: an already-substituted Lars/DGCMomentum (or any
+    # other optimizer) passes through untouched
+    if st is not None and type(optimizer) is Momentum:
+        if getattr(st, "lars", False):
+            cfg = st.lars_configs
+            optimizer = Lars(
+                learning_rate=optimizer._lr, momentum=optimizer._momentum,
+                lars_coeff=cfg.get("lars_coeff", 0.001),
+                lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+                epsilon=cfg.get("epsilon", 1e-9),
+                exclude_from_weight_decay=cfg.get("exclude_from_weight_decay", []),
+                parameters=optimizer._parameters,
+                grad_clip=optimizer._grad_clip,
+                multi_precision=optimizer._multi_precision)
+        elif getattr(st, "dgc", False):
+            cfg = st.dgc_configs
+            sparsity = cfg.get("sparsity", [0.999])
+            optimizer = DGCMomentum(
+                learning_rate=optimizer._lr, momentum=optimizer._momentum,
+                sparsity=sparsity[-1] if isinstance(sparsity, (list, tuple)) else sparsity,
+                rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                parameters=optimizer._parameters,
+                weight_decay=optimizer._weight_decay,
+                grad_clip=optimizer._grad_clip,
+                multi_precision=optimizer._multi_precision)
     hcg = get_hybrid_communicate_group()
-    return HybridParallelOptimizer(optimizer, hcg=hcg, strategy=strategy or _strategy)
+    return HybridParallelOptimizer(optimizer, hcg=hcg, strategy=st)
 
 
 def worker_num() -> int:

@@ -28,6 +28,19 @@ class DistributedStrategy:
         self.sharding_configs = {"stage": 1, "offload": False}
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1}
+        # meta-optimizer knobs (reference fleet/meta_optimizers/
+        # lars_optimizer.py, dgc_optimizer.py, localsgd_optimizer.py,
+        # fp16_allreduce_optimizer.py): consumed by
+        # fleet.distributed_optimizer (optimizer substitution) and the
+        # recipe passes in distributed/passes
+        self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                             "epsilon": 1e-9, "exclude_from_weight_decay": []}
+        self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.999]}
+        self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
+        self.fp16_allreduce = False
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True  # parity no-op: XLA fuses collectives
         self.tensor_parallel_configs = {"tensor_init_seed": -1}

@@ -264,8 +264,12 @@ class PipelineParallelWithInterleave(PipelineParallel):
         self._opt_id = None
 
     def _compiled_step(self, optimizer):
-        inner = getattr(optimizer, "_inner", optimizer)
-        inner = getattr(inner, "_inner", inner)  # HybridParallelOptimizer chain
+        # unwrap HybridParallelOptimizer (_inner_opt) and the sharding
+        # stage-2 wrapper (_inner); cache on the INNER id so re-wrapping
+        # the same optimizer doesn't silently rebuild (and reset) state
+        inner = optimizer
+        for attr in ("_inner_opt", "_inner"):
+            inner = getattr(inner, attr, inner)
         if self._step is None or self._opt_id != id(inner):
             from ..utils import make_sharded_train_step
 

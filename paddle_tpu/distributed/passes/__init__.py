@@ -195,6 +195,69 @@ class GradClipPass(PassBase):
         context.recipe["grad_clip"] = {"clip_norm": float(self.get_attr("clip_norm", 1.0))}
 
 
+@register_pass("lars")
+class LarsPass(PassBase):
+    """fleet/meta_optimizers/lars_optimizer.py -> substitute the LARS
+    update rule (paddle.optimizer.Lars) for Momentum at
+    fleet.distributed_optimizer."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        context.recipe["lars"] = {
+            "lars_coeff": float(self.get_attr("lars_coeff", 0.001)),
+            "lars_weight_decay": float(self.get_attr("lars_weight_decay", 0.0005)),
+            "epsilon": float(self.get_attr("epsilon", 1e-9)),
+            "exclude_from_weight_decay": self.get_attr("exclude_from_weight_decay", []),
+        }
+
+
+@register_pass("dgc")
+class DGCPass(PassBase):
+    """fleet/meta_optimizers/dgc_optimizer.py -> DGCMomentum (top-k
+    sparsified grads with error feedback) substitution."""
+
+    def _check_self(self):
+        s = self.get_attr("sparsity", [0.999])
+        vals = s if isinstance(s, (list, tuple)) else [s]
+        return all(0.0 <= float(v) < 1.0 for v in vals)
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        context.recipe["dgc"] = {
+            "sparsity": self.get_attr("sparsity", [0.999]),
+            "rampup_begin_step": int(self.get_attr("rampup_begin_step", 0)),
+        }
+
+
+@register_pass("localsgd")
+class LocalSGDPass(PassBase):
+    """fleet/meta_optimizers/localsgd_optimizer.py: sync params every
+    k_steps instead of grads every step. Under GSPMD the per-step grad
+    sync is compiled into the step, so local-SGD maps to gradient
+    accumulation with k-step cadence (same comm volume reduction: one sync
+    per k local updates)."""
+
+    def _check_self(self):
+        return int(self.get_attr("k_steps", 1)) >= 1
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        context.recipe["localsgd"] = {
+            "k_steps": int(self.get_attr("k_steps", 1)),
+            "begin_step": int(self.get_attr("begin_step", 1)),
+        }
+
+
+@register_pass("fp16_allreduce")
+class FP16AllreducePass(PassBase):
+    """fleet/meta_optimizers/fp16_allreduce_optimizer.py: cast grads to
+    half precision for the sync. The TPU recipe: bf16 grads end-to-end
+    (the step builder keeps grads in the param compute dtype, so enabling
+    bf16 params already halves grad-sync bytes); recorded for strategy
+    orchestration parity."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        context.recipe["fp16_allreduce"] = {
+            "dtype": self.get_attr("dtype", "bfloat16")}
+
+
 @register_pass("fuse_all_reduce")
 class FuseAllReducePass(PassBase):
     """fuse_all_reduce.py: grad-bucket fusion — subsumed by GSPMD/XLA
@@ -238,4 +301,16 @@ def apply_recipe_to_strategy(context: PassContext, strategy):
             "accumulate_steps": r["pipeline"]["accumulate_steps"],
             "virtual_pp_degree": r["pipeline"]["virtual_pp_degree"],
         }
+    if "lars" in r:
+        strategy.lars = True
+        strategy.lars_configs = {**getattr(strategy, "lars_configs", {}), **r["lars"]}
+    if "dgc" in r:
+        strategy.dgc = True
+        strategy.dgc_configs = {**getattr(strategy, "dgc_configs", {}), **r["dgc"]}
+    if "localsgd" in r:
+        strategy.localsgd = True
+        strategy.localsgd_configs = {**getattr(strategy, "localsgd_configs", {}),
+                                     **r["localsgd"]}
+    if "fp16_allreduce" in r:
+        strategy.fp16_allreduce = True
     return strategy

@@ -70,6 +70,8 @@ def _lib():
         "gt_neighbors": (c_i64, [ctypes.c_void_p, c_i64, p_i64, c_i64]),
         "gt_sample_neighbors": (c_i32, [ctypes.c_void_p, p_i64, c_i64, c_i64, ctypes.c_uint64, c_i32, p_i64]),
         "gt_sample_nodes": (c_i64, [ctypes.c_void_p, c_i64, ctypes.c_uint64, p_i64]),
+        "gt_set_node_feat": (c_i32, [ctypes.c_void_p, p_i64, c_i64, p_f, c_i64]),
+        "gt_get_node_feat": (c_i64, [ctypes.c_void_p, p_i64, c_i64, p_f, c_i64]),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)
@@ -280,6 +282,39 @@ class GraphTable:
             self._h, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
             int(k), seed, 1 if replace else 0,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return out
+
+    def set_node_feat(self, keys, feats) -> None:
+        """Store dense feature rows for nodes (common_graph_table.h
+        set_node_feat): feats [n, dim] float32."""
+        keys = _i64(keys)
+        feats = np.ascontiguousarray(np.asarray(feats, np.float32))
+        if feats.ndim != 2 or feats.shape[0] != keys.size:
+            raise ValueError(f"feats must be [{keys.size}, dim], got {feats.shape}")
+        self._feat_dim = feats.shape[1]
+        self._lib.gt_set_node_feat(
+            self._h, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            keys.size, feats.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            feats.shape[1])
+
+    def get_node_feat(self, keys, dim: int = None) -> np.ndarray:
+        """Fetch [n, dim] feature rows (common_graph_table.h:657
+        get_node_feat); unknown nodes (and the -1 sample padding) come back
+        as zero rows, ready for masked message passing."""
+        keys = _i64(keys)
+        stored = getattr(self, "_feat_dim", None)
+        dim = dim if dim is not None else stored
+        if dim is None:
+            raise ValueError("feature dim unknown: call set_node_feat first "
+                             "or pass dim=")
+        if stored is not None and dim != stored:
+            # the native side zero-fills on row-size mismatch, which would
+            # read as "all features are zero" — fail loudly instead
+            raise ValueError(f"requested dim {dim} != stored feature dim {stored}")
+        out = np.zeros((keys.size, dim), np.float32)
+        self._lib.gt_get_node_feat(
+            self._h, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            keys.size, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), dim)
         return out
 
     def sample_nodes(self, count: int, seed: int = None) -> np.ndarray:

@@ -340,23 +340,19 @@ class Layer:
             result = hook(self, inputs)
             if result is not None:
                 inputs = result if isinstance(result, tuple) else (result,)
-        if not self.training:
-            from ...core.autograd import is_grad_enabled, no_grad
-            from ...core.flags import flag_value
+        # FLAGS_eval_no_record: eval-mode layers never record tape nodes,
+        # so chained inference (h = m(h)) can't grow the graph unboundedly
+        # when the caller forgot no_grad (reference eager AutogradMeta
+        # keeps recording here — opt-in divergence)
+        import contextlib
 
-            # FLAGS_eval_no_record: eval-mode layers never record tape
-            # nodes, so chained inference (h = m(h)) can't grow the graph
-            # unboundedly when the caller forgot no_grad (reference eager
-            # AutogradMeta keeps recording here — opt-in divergence)
-            if is_grad_enabled() and flag_value("eval_no_record"):
-                with no_grad():
-                    outputs = self.forward(*inputs, **kwargs)
-                for hook in self._forward_post_hooks.values():
-                    result = hook(self, inputs, outputs)
-                    if result is not None:
-                        outputs = result
-                return outputs
-        outputs = self.forward(*inputs, **kwargs)
+        from ...core.autograd import is_grad_enabled, no_grad
+        from ...core.flags import flag_value
+
+        ctx = (no_grad() if not self.training and is_grad_enabled()
+               and flag_value("eval_no_record") else contextlib.nullcontext())
+        with ctx:
+            outputs = self.forward(*inputs, **kwargs)
         for hook in self._forward_post_hooks.values():
             result = hook(self, inputs, outputs)
             if result is not None:

@@ -8,7 +8,10 @@ from .optimizer import (  # noqa: F401
     Adam,
     Adamax,
     AdamW,
+    DGCMomentum,
     Lamb,
+    Lars,
+    LarsMomentum,
     Momentum,
     NAdam,
     Optimizer,
