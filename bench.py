@@ -1,8 +1,18 @@
 """Benchmark: GPT causal-LM training throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-The reference publishes no in-repo numbers (SURVEY §6); the driver-set north
-star is GPT pretrain MFU >= 0.40, so vs_baseline = model_flops_utilization / 0.40.
+Default invocation (the driver contract) prints ONE JSON line:
+{"metric", "value", "unit", "vs_baseline"}. The reference publishes no
+in-repo numbers (SURVEY §6); the driver-set north star is GPT pretrain
+MFU >= 0.40, so vs_baseline = model_flops_utilization / 0.40.
+
+`--config {bert_sst2,gpt_dp,ernie_mp4,resnet50,gpt_moe,all}` runs the
+BASELINE.json config rows instead (tools/ci_model_benchmark.sh role): each
+prints one JSON line with throughput + a measured step-time breakdown —
+compute fraction (model FLOPs / chip peak over the device-resident step),
+host_input fraction (host-fed step minus device-resident step), collective
+fraction (0 measured on one chip; the cost-model estimate at the config's
+target degrees is reported separately as collective_est). Results fill
+BASELINE.md's table.
 """
 
 from __future__ import annotations
@@ -202,5 +212,318 @@ def _predictor_row() -> float:
     return B * S * iters / dt
 
 
+# ---------------- BASELINE.json config rows ----------------
+def _on_tpu():
+    import jax
+
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def _peak_flops():
+    return 197e12 if _on_tpu() else 1e12
+
+
+def _measure(step, x, y, iters, tokens_per_step):
+    """(throughput, step_s_device, host_input_frac): time the compiled step
+    with device-resident inputs, then with per-step host feeds — the delta
+    is the host-input cost (axon: the tunnel transfer; real pods: infeed).
+    Completion barrier = host transfer of the loss (block_until_ready lies
+    through the axon tunnel)."""
+    import jax.numpy as jnp
+
+    xd, yd = jnp.asarray(x), jnp.asarray(y)
+    _ = float(step(xd, yd))  # compile + warm
+    best_dev = float("inf")
+    for _w in range(2):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(xd, yd)
+        _ = float(loss)
+        best_dev = min(best_dev, (time.perf_counter() - t0) / iters)
+    best_host = float("inf")
+    for _w in range(2):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(x, y)  # numpy -> device transfer inside the step
+        _ = float(loss)
+        best_host = min(best_host, (time.perf_counter() - t0) / iters)
+    host_frac = max(0.0, (best_host - best_dev) / best_host)
+    return tokens_per_step * iters / (iters * best_dev), best_dev, host_frac
+
+
+def _row(config, metric, value, unit, step_s, flops_per_step, host_frac,
+         collective_est=0.0, note=""):
+    compute_frac = min(1.0, flops_per_step / (_peak_flops() * step_s))
+    out = {
+        "config": config,
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": unit,
+        "step_ms": round(step_s * 1e3, 2),
+        "breakdown": {
+            "compute": round(compute_frac, 3),
+            "collective_measured": 0.0,  # one chip: no cross-chip comm
+            "collective_est": round(collective_est, 3),
+            "host_input": round(host_frac, 3),
+            "other": round(max(0.0, 1 - compute_frac), 3),
+        },
+        "mfu": round(flops_per_step / (_peak_flops() * step_s), 3),
+        "note": note,
+    }
+    print(json.dumps(out))
+    return out
+
+
+def _collective_est(model_kw, train_kw, **degrees):
+    """Cost-model comm fraction at the config's TARGET degrees (measured
+    multi-chip runs are impossible on one chip; tests assert the collective
+    HLO on the virtual mesh instead)."""
+    try:
+        from paddle_tpu.distributed.auto_parallel.cost import (
+            ClusterSpec, CostModel, ModelSpec, TrainConfig)
+
+        import math as _m
+
+        n = _m.prod(degrees.values()) if degrees else 1
+        cm = CostModel(ClusterSpec(n_devices=max(n, 1)), ModelSpec(**model_kw),
+                       TrainConfig(**train_kw))
+        bd = cm.cost(**degrees)
+        if not bd.feasible:
+            return 0.0
+        comm = bd.mp_comm + bd.sharding_comm + bd.sep_comm + 0.5 * bd.dp_comm
+        return comm / bd.total_time if bd.total_time > 0 else 0.0
+    except Exception:
+        return 0.0
+
+
+def _n_params(model):
+    return sum(int(np.prod(p.shape)) for p in model.parameters())
+
+
+def bench_bert_sst2():
+    """BASELINE config 1: BERT-base SST-2 fine-tune, single device."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models.bert import bert_base, bert_tiny
+
+    on_tpu = _on_tpu()
+    paddle.seed(0)
+    model = bert_base(dropout=0.0) if on_tpu else bert_tiny(dropout=0.0)
+    if on_tpu:
+        model = model.astype("bfloat16")
+    bsz, seq, iters = (32, 128, 20) if on_tpu else (4, 16, 2)
+    opt = paddle.optimizer.AdamW(learning_rate=2e-5, parameters=model.parameters(),
+                                 multi_precision=on_tpu)
+    step = make_sharded_train_step(model, opt)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 1000, size=(bsz, seq), dtype=np.int32)
+    y = rng.randint(0, 2, size=(bsz,), dtype=np.int32)
+    tput, step_s, host_frac = _measure(step, x, y, iters, bsz * seq)
+    n = _n_params(model)
+    flops = 6 * n * bsz * seq
+    return _row("bert_sst2", "tokens_per_sec", tput, "tokens/sec/chip",
+                step_s, flops, host_frac,
+                note=f"{n/1e6:.0f}M params, B={bsz} S={seq}")
+
+
+def bench_gpt_dp():
+    """BASELINE config 2: GPT-3 1.3B pretraining, data-parallel only (one
+    chip = the dp worker's per-chip slice; dp adds only the overlappable
+    grad all-reduce)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import GPT3_1p3B, GPTConfig, GPTForCausalLM
+
+    on_tpu = _on_tpu()
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig(**{**GPT3_1p3B, "dropout": 0.0, "use_recompute": True,
+                           "recompute_interval": 1, "loss_chunk": 128})
+        bsz, seq, iters = 4, 2048, 8
+    else:
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64, dropout=0.0)
+        bsz, seq, iters = 2, 32, 2
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model = model.astype("bfloat16")
+    # pure-bf16 Adam (params 2.6 GB + moments 5.2 GB) so 1.3B + activations
+    # fit one 16 GB chip
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                                 moment_dtype="bfloat16" if on_tpu else None)
+    step = make_sharded_train_step(model, opt)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, size=(bsz, seq), dtype=np.int32)
+    y = np.roll(x, -1, axis=1)
+    tput, step_s, host_frac = _measure(step, x, y, iters, bsz * seq)
+    n = _n_params(model)
+    flops = (6 * n + 12 * cfg.num_layers * cfg.hidden_size * seq) * bsz * seq
+    est = _collective_est(
+        dict(hidden=cfg.hidden_size, layers=cfg.num_layers, heads=cfg.num_heads,
+             vocab=cfg.vocab_size, seq=seq, param_bytes=2),
+        dict(batch=bsz * 8, zero_stage=1, moment_bytes=2), dp=4, sharding=2)
+    return _row("gpt_dp", "tokens_per_sec", tput, "tokens/sec/chip",
+                step_s, flops, host_frac, collective_est=est,
+                note=f"{n/1e6:.0f}M params, B={bsz} S={seq}, "
+                     "dp x zero1 est at 8 chips")
+
+
+def bench_ernie_mp4():
+    """BASELINE config 3: ERNIE-3.0 pretraining, mp_degree=4 target (one
+    chip measures the compute; the mp=4 collective fraction is the cost
+    model's, and tests/test_hlo_collectives.py proves the all-reduce HLO)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models.ernie import (ERNIE_BASE, ERNIE_TINY, ErnieConfig,
+                                         ErnieForPretraining)
+
+    on_tpu = _on_tpu()
+    paddle.seed(0)
+    cfg = ErnieConfig(**{**(ERNIE_BASE if on_tpu else ERNIE_TINY), "dropout": 0.0})
+    model = ErnieForPretraining(cfg)
+    if on_tpu:
+        model = model.astype("bfloat16")
+    bsz, seq, iters = (32, 512, 10) if on_tpu else (2, 16, 2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                                 multi_precision=on_tpu)
+    # benches the MLM term of the pretrain objective (the FLOPs; the SOP
+    # head is a 2-class linear on pooled [CLS], negligible)
+    from paddle_tpu.models.bert import masked_lm_loss
+
+    def loss_fn(logits_pair, y):
+        mlm_logits, _sop_logits = logits_pair
+        return masked_lm_loss(mlm_logits, y)
+
+    step = make_sharded_train_step(model, opt, loss_fn=loss_fn)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, size=(bsz, seq), dtype=np.int32)
+    y = np.where(rng.rand(bsz, seq) < 0.15, x, -100).astype(np.int32)
+    tput, step_s, host_frac = _measure(step, x, y, iters, bsz * seq)
+    n = _n_params(model)
+    flops = (6 * n + 12 * cfg.num_layers * cfg.hidden_size * seq) * bsz * seq
+    est = _collective_est(
+        dict(hidden=cfg.hidden_size, layers=cfg.num_layers, heads=cfg.num_heads,
+             vocab=cfg.vocab_size, seq=seq),
+        dict(batch=bsz * 4), mp=4)
+    return _row("ernie_mp4", "tokens_per_sec", tput, "tokens/sec/chip",
+                step_s, flops, host_frac, collective_est=est,
+                note=f"{n/1e6:.0f}M params, B={bsz} S={seq}, mp=4 est")
+
+
+def bench_resnet50():
+    """BASELINE config 4: ResNet50 (conv/bn kernel paths), LARS optimizer."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.vision.models import resnet18, resnet50
+
+    on_tpu = _on_tpu()
+    paddle.seed(0)
+    if on_tpu:
+        model = resnet50(num_classes=1000).astype("bfloat16")
+        bsz, hw, iters, fwd_flops = 64, 224, 10, 4.089e9
+    else:
+        model = resnet18(num_classes=10)
+        bsz, hw, iters, fwd_flops = 2, 32, 2, 0.037e9
+    opt = paddle.optimizer.Lars(learning_rate=0.1, momentum=0.9,
+                                parameters=model.parameters(),
+                                exclude_from_weight_decay=["bn", "bias"])
+
+    def loss_fn(logits, labels):
+        return nn.functional.cross_entropy(logits, labels).mean()
+
+    step = make_sharded_train_step(model, opt, loss_fn=loss_fn)
+    rng = np.random.RandomState(0)
+    x = (rng.randn(bsz, 3, hw, hw) * 0.1).astype(np.float32)
+    if on_tpu:
+        import ml_dtypes
+
+        x = x.astype(ml_dtypes.bfloat16)  # match the bf16 conv weights
+    y = rng.randint(0, 10, size=(bsz,), dtype=np.int32)
+    tput, step_s, host_frac = _measure(step, x, y, iters, bsz)
+    flops = 3 * fwd_flops * bsz  # fwd + bwd ~= 3x fwd
+    return _row("resnet50", "images_per_sec", tput, "images/sec/chip",
+                step_s, flops, host_frac,
+                note=f"B={bsz} {hw}x{hw}, LARS")
+
+
+def bench_gpt_moe():
+    """BASELINE config 5: GPT-MoE (expert parallel + ZeRO-3 target). One
+    chip holds all experts (ep=1 slice); the ep all-to-all fraction is the
+    cost model's dp-equivalent estimate and the fleet-mesh HLO test proves
+    the all-to-all emission."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    on_tpu = _on_tpu()
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=8,
+                        num_heads=16, max_seq_len=1024, dropout=0.0,
+                        moe_num_experts=8, moe_every_k=2, use_recompute=True,
+                        recompute_interval=1)
+        bsz, seq, iters = 8, 1024, 8
+    else:
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=32, dropout=0.0,
+                        moe_num_experts=4, moe_every_k=2)
+        bsz, seq, iters = 2, 16, 2
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model = model.astype("bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                                 moment_dtype="bfloat16" if on_tpu else None)
+    step = make_sharded_train_step(model, opt)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, size=(bsz, seq), dtype=np.int32)
+    y = np.roll(x, -1, axis=1)
+    tput, step_s, host_frac = _measure(step, x, y, iters, bsz * seq)
+    # ACTIVATED params per token: expert stacks ([E, ...] leading dim)
+    # contribute top_k/E of their size, everything else fully
+    E, k = cfg.moe_num_experts, cfg.moe_top_k
+    n_active = 0
+    for name, p in model.named_parameters():
+        sz = int(np.prod(p.shape))
+        if ".mlp.w" in name or ".mlp.b" in name:
+            n_active += sz * k // E
+        else:
+            n_active += sz
+    flops = (6 * n_active + 12 * cfg.num_layers * cfg.hidden_size * seq) * bsz * seq
+    est = _collective_est(
+        dict(hidden=cfg.hidden_size, layers=cfg.num_layers, heads=cfg.num_heads,
+             vocab=cfg.vocab_size, seq=seq),
+        dict(batch=bsz * 4, zero_stage=3), dp=2, sharding=2)
+    n_total = _n_params(model)
+    return _row("gpt_moe", "tokens_per_sec", tput, "tokens/sec/chip",
+                step_s, flops, host_frac, collective_est=est,
+                note=f"{n_total/1e6:.0f}M total/{n_active/1e6:.0f}M active, "
+                     f"E={E} top{k}, B={bsz} S={seq}, ep+zero3 est")
+
+
+CONFIGS = {
+    "bert_sst2": bench_bert_sst2,
+    "gpt_dp": bench_gpt_dp,
+    "ernie_mp4": bench_ernie_mp4,
+    "resnet50": bench_resnet50,
+    "gpt_moe": bench_gpt_moe,
+}
+
+
 if __name__ == "__main__":
-    main()
+    import argparse
+    import gc
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", choices=[*CONFIGS, "all"], default=None,
+                    help="run a BASELINE.json config row instead of the "
+                         "driver headline")
+    args = ap.parse_args()
+    if args.config is None:
+        main()
+    elif args.config == "all":
+        for name, fn in CONFIGS.items():
+            fn()
+            gc.collect()
+    else:
+        CONFIGS[args.config]()

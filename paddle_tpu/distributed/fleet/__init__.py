@@ -186,7 +186,7 @@ def distributed_optimizer(optimizer, strategy=None):
     if st is not None and type(optimizer) is Momentum:
         if getattr(st, "lars", False):
             cfg = st.lars_configs
-            optimizer = Lars(
+            lars = Lars(
                 learning_rate=optimizer._lr, momentum=optimizer._momentum,
                 lars_coeff=cfg.get("lars_coeff", 0.001),
                 lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
@@ -195,6 +195,11 @@ def distributed_optimizer(optimizer, strategy=None):
                 parameters=optimizer._parameters,
                 grad_clip=optimizer._grad_clip,
                 multi_precision=optimizer._multi_precision)
+            # the inner Momentum's L2 term survives the substitution (the
+            # reference lars meta-optimizer forwards regularization)
+            lars._weight_decay = optimizer._weight_decay
+            lars.regularization = optimizer._weight_decay
+            optimizer = lars
         elif getattr(st, "dgc", False):
             cfg = st.dgc_configs
             sparsity = cfg.get("sparsity", [0.999])

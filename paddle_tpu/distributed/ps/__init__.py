@@ -291,6 +291,12 @@ class GraphTable:
         feats = np.ascontiguousarray(np.asarray(feats, np.float32))
         if feats.ndim != 2 or feats.shape[0] != keys.size:
             raise ValueError(f"feats must be [{keys.size}, dim], got {feats.shape}")
+        stored = getattr(self, "_feat_dim", None)
+        if stored is not None and feats.shape[1] != stored:
+            # rows stored at the old dim would silently serve zeros
+            raise ValueError(
+                f"feature dim {feats.shape[1]} != existing {stored}; one "
+                "table holds one feature width")
         self._feat_dim = feats.shape[1]
         self._lib.gt_set_node_feat(
             self._h, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),

@@ -53,8 +53,8 @@ def register_pass(pass_cls: Type[Pass]):
 
 
 DEFAULT_PIPELINE = ["algebraic_simplify", "constant_folding", "cse", "dce"]
-INFERENCE_PIPELINE = ["dropout_eliminate", "algebraic_simplify",
-                      "constant_folding", "cse", "dce"]
+INFERENCE_PIPELINE = ["delete_quant_dequant", "dropout_eliminate",
+                      "algebraic_simplify", "constant_folding", "cse", "dce"]
 
 
 class PassManager:

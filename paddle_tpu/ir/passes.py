@@ -147,6 +147,62 @@ class AlgebraicSimplifyPass(Pass):
 
 
 @register_pass
+class DeleteQuantDequantPass(Pass):
+    """Strip fake quant-dequant chains at predictor load (the
+    delete_quant_dequant_filter_op_pass.cc / delete_quant_dequant_op_pass
+    family of framework/ir): a QAT model saved WITHOUT convert() carries
+    the straight-through fake-quant program
+        add(v, sub(mul(jit:clip(jit:round(mul(v, 1/s)), qmin, qmax), s), v))
+    per quantized tensor; at inference the simulation noise serves nothing
+    (the int8 payload + scales travel as metadata — qat._freeze), so every
+    matched chain is replaced by its input value `v`."""
+
+    name = "delete_quant_dequant"
+
+    @staticmethod
+    def _qdq_input(add_op):
+        if add_op.name != "pd.add" or len(add_op.operands) != 2:
+            return None
+        v, s = add_op.operands
+        sub = s.defining_op()
+        if sub is None or sub.name != "pd.sub" or len(sub.operands) != 2:
+            return None
+        m, v2 = sub.operands
+        if v2.id != v.id:
+            return None
+        mul = m.defining_op()
+        if mul is None or mul.name != "pd.mul":
+            return None
+        clip = mul.operands[0].defining_op()
+        if clip is None or clip.name != "pd.jit" or \
+                clip.attrs().get("name") != "clip":
+            return None
+        rnd = clip.operands[0].defining_op()
+        if rnd is None or rnd.name != "pd.jit" or \
+                rnd.attrs().get("name") != "round":
+            return None
+        scale_mul = rnd.operands[0].defining_op()
+        if scale_mul is None or scale_mul.name != "pd.mul":
+            return None
+        if scale_mul.operands[0].id != v.id:
+            return None
+        return v
+
+    def run(self, program: Program) -> int:
+        changed = 0
+        for op in program.ops():
+            v = self._qdq_input(op)
+            if v is not None:
+                n = op.result(0).replace_all_uses_with(v)
+                erased = op.erase()
+                if n or erased:
+                    changed += 1
+        if changed:
+            program.dce()  # sweep the orphaned round/clip/scale chain
+        return changed
+
+
+@register_pass
 class DropoutEliminatePass(Pass):
     """Inference-only: pd.dropout → identity (delete_dropout_op_pass analog).
 

@@ -61,8 +61,12 @@ def _pool(x, kernel, stride, padding, n, data_format, kind, ceil_mode, op_name, 
             strides = (1, 1) + stride
             pads = pads_sp if isinstance(pads_sp, str) else [(0, 0), (0, 0)] + pads_sp
         if kind == "max":
-            init = -jnp.inf if jnp.issubdtype(xv.dtype, jnp.floating) else jnp.iinfo(xv.dtype).min
-            return jax.lax.reduce_window(xv, jnp.asarray(init, xv.dtype), jax.lax.max, window, strides, pads)
+            # PYTHON-scalar init: lax dispatches to the differentiable
+            # reduce_window_max monoid only for concrete identity scalars;
+            # a device array forces the generic (non-transposable) form,
+            # which breaks grads under jit
+            init = -np.inf if jnp.issubdtype(xv.dtype, jnp.floating) else np.iinfo(np.dtype(xv.dtype)).min
+            return jax.lax.reduce_window(xv, init, jax.lax.max, window, strides, pads)
         out = jax.lax.reduce_window(xv, jnp.zeros((), xv.dtype), jax.lax.add, window, strides, pads)
         has_pad = not isinstance(pads, str) and any(p != (0, 0) for p in pads)
         if (exclusive and has_pad) or extra_any:

@@ -343,15 +343,18 @@ class Layer:
         # FLAGS_eval_no_record: eval-mode layers never record tape nodes,
         # so chained inference (h = m(h)) can't grow the graph unboundedly
         # when the caller forgot no_grad (reference eager AutogradMeta
-        # keeps recording here — opt-in divergence)
-        import contextlib
+        # keeps recording here — opt-in divergence). Train mode pays no
+        # overhead beyond the attribute check.
+        if not self.training:
+            from ...core.autograd import is_grad_enabled, no_grad
+            from ...core.flags import flag_value
 
-        from ...core.autograd import is_grad_enabled, no_grad
-        from ...core.flags import flag_value
-
-        ctx = (no_grad() if not self.training and is_grad_enabled()
-               and flag_value("eval_no_record") else contextlib.nullcontext())
-        with ctx:
+            if is_grad_enabled() and flag_value("eval_no_record"):
+                with no_grad():
+                    outputs = self.forward(*inputs, **kwargs)
+            else:
+                outputs = self.forward(*inputs, **kwargs)
+        else:
             outputs = self.forward(*inputs, **kwargs)
         for hook in self._forward_post_hooks.values():
             result = hook(self, inputs, outputs)

@@ -22,6 +22,7 @@ from .math import sum, max, min, all, any, abs  # noqa: F401,A004
 from .manipulation import slice  # noqa: F401,A004
 
 from . import compat  # noqa: E402
+from . import yaml_compat  # noqa: E402,F401  (phi ops.yaml name registry)
 
 _METHOD_SOURCES = (math, linalg, manipulation, logic, search, creation, compat)
 
@@ -50,6 +51,7 @@ nanquantile is_complex is_integer is_floating_point rank broadcast_tensors
 multi_dot cholesky_solve triangular_solve lu lu_unpack gcd lcm diff sgn frexp
 trapezoid cumulative_trapezoid polar vander nextafter sigmoid create_tensor
 uniform_ exponential_ squeeze_ unsqueeze_ tanh_ index_add_
+fill_diagonal_ fill_diagonal_tensor
 """.split()
 
 

@@ -257,3 +257,84 @@ def disable_signal_handler():
     """No-op: the reference installs C++ signal handlers; this runtime has none."""
 
 
+
+
+@register_op("fill_diagonal", tensor_method="fill_diagonal_")
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """In-place main-diagonal fill (reference phi fill_diagonal op /
+    Tensor.fill_diagonal_). wrap repeats the diagonal every ncols rows for
+    tall 2-D matrices, matching the reference kernel."""
+    import jax.numpy as jnp
+
+    from ._dispatch import apply, as_tensor
+
+    x = as_tensor(x)
+
+    def f(xv):
+        if xv.ndim == 2:
+            R, C = xv.shape
+            if wrap and R > C:
+                # wrapped fill: every (C+1)-th element of the flat view,
+                # i.e. the diagonal restarts after a blank separator row
+                flat = xv.reshape(-1)
+                pos = jnp.arange(offset, R * C, C + 1)
+                return flat.at[pos].set(jnp.asarray(value, xv.dtype)).reshape(R, C)
+            n = min(R, C - offset) if offset >= 0 else min(R + offset, C)
+            rows = jnp.arange(max(n, 0)) + max(-offset, 0)
+            cols = jnp.arange(max(n, 0)) + max(offset, 0)
+            return xv.at[rows, cols].set(jnp.asarray(value, xv.dtype))
+        idx = jnp.arange(min(xv.shape))
+        return xv.at[tuple(idx for _ in range(xv.ndim))].set(
+            jnp.asarray(value, xv.dtype))
+
+    out = apply("fill_diagonal", f, x)
+    x._set_value_raw(out._value)
+    return x
+
+
+@register_op("fill_diagonal_tensor", tensor_method="fill_diagonal_tensor")
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Write tensor `y` along the (dim1, dim2) diagonal (reference phi
+    fill_diagonal_tensor op)."""
+    import jax.numpy as jnp
+
+    from ._dispatch import apply, as_tensor
+
+    x, y = as_tensor(x), as_tensor(y)
+
+    def f(xv, yv):
+        moved = jnp.moveaxis(xv, (dim1, dim2), (-2, -1))
+        R, C = moved.shape[-2], moved.shape[-1]
+        if offset >= 0:
+            n = min(R, C - offset)
+            rows, cols = jnp.arange(n), jnp.arange(n) + offset
+        else:
+            n = min(R + offset, C)
+            rows, cols = jnp.arange(n) - offset, jnp.arange(n)
+        moved = moved.at[..., rows, cols].set(yv)
+        return jnp.moveaxis(moved, (-2, -1), (dim1, dim2))
+
+    return apply("fill_diagonal_tensor", f, x, y)
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(x, name=None):
+    """sum(x^2) as a 0-d tensor (phi squared_l2_norm — the grad-clip
+    building block)."""
+    import jax.numpy as jnp
+
+    from ._dispatch import apply, as_tensor
+
+    return apply("squared_l2_norm",
+                 lambda v: jnp.sum(jnp.square(v.astype(jnp.float32))),
+                 as_tensor(x))
+
+
+@register_op("mean_all")
+def mean_all(x, name=None):
+    """Global mean (phi mean_all op)."""
+    import jax.numpy as jnp
+
+    from ._dispatch import apply, as_tensor
+
+    return apply("mean_all", lambda v: jnp.mean(v), as_tensor(x))

@@ -8,7 +8,50 @@ import jax.numpy as jnp
 
 from .ops._dispatch import apply, as_tensor
 
-__all__ = ["stft", "istft"]
+__all__ = ["frame", "istft", "overlap_add", "stft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames (reference signal.frame / phi frame op):
+    x [..., T] (axis=-1) -> [..., frame_length, n_frames]; axis=0 frames
+    the leading dim to [n_frames, frame_length, ...]. A static gather —
+    XLA turns it into strided loads."""
+
+    def f(v):
+        T = v.shape[axis]
+        if frame_length > T:
+            raise ValueError(
+                f"frame_length {frame_length} > signal length {T}")
+        n = 1 + (T - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[:, None]
+               + hop_length * jnp.arange(n)[None, :])  # [frame_length, n]
+        if axis == 0:
+            return v[idx.T]  # [n_frames, frame_length, ...]
+        if axis in (-1, v.ndim - 1):
+            return v[..., idx]
+        raise ValueError("frame: axis must be 0 or -1")
+
+    return apply("frame", f, as_tensor(x))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference signal.overlap_add / phi overlap_add op):
+    x [..., frame_length, n_frames] (axis=-1) -> [..., T] with overlapping
+    frames summed; axis=0 takes [n_frames, frame_length, ...]."""
+
+    def f(v):
+        if axis == 0:
+            # [n_frames, frame_length, ...] -> [..., frame_length, n_frames]
+            v = jnp.moveaxis(jnp.moveaxis(v, 0, -1), 0, -2)
+        L, n = v.shape[-2], v.shape[-1]
+        T = L + hop_length * (n - 1)
+        lead = v.shape[:-2]
+        out = jnp.zeros(lead + (T,), v.dtype)
+        idx = (jnp.arange(L)[:, None] + hop_length * jnp.arange(n)[None, :]).reshape(-1)
+        out = out.at[..., idx].add(v.reshape(lead + (-1,)))
+        return jnp.moveaxis(out, -1, 0) if axis == 0 else out
+
+    return apply("overlap_add", f, as_tensor(x))
 
 
 def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True, pad_mode="reflect", normalized=False, onesided=True, name=None):

@@ -191,8 +191,176 @@ class _SparseNNFunctional:
         return SparseCooTensor(jsparse.BCOO((vals, bx.indices), shape=bx.shape))
 
 
+def _attention(query, key, value, sparse_mask, key_padding_mask=None,
+               attn_mask=None, name=None):
+    """Sparse-masked attention (reference sparse/nn/functional/attention
+    and the sparse_attention CUDA op): only positions present in
+    sparse_mask's pattern attend. TPU-first: the pattern densifies to a
+    bool mask and the math runs as one fused MXU softmax-matmul — TPUs
+    have no sparse units, so the win IS the masking, not skipped FLOPs."""
+    q = query._value if isinstance(query, Tensor) else jnp.asarray(query)
+    k = key._value if isinstance(key, Tensor) else jnp.asarray(key)
+    v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+    bm = _bcoo(sparse_mask)
+    pattern = jsparse.BCOO((jnp.ones_like(bm.data, jnp.float32), bm.indices),
+                           shape=bm.shape).todense() > 0
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k) / jnp.sqrt(
+        jnp.asarray(q.shape[-1], jnp.float32))
+    pattern = jnp.broadcast_to(pattern.reshape(scores.shape), scores.shape)
+    if key_padding_mask is not None:
+        kpm = key_padding_mask._value if isinstance(key_padding_mask, Tensor) else jnp.asarray(key_padding_mask)
+        pattern = pattern & (kpm[:, None, None, :] > 0)
+    if attn_mask is not None:
+        am = attn_mask._value if isinstance(attn_mask, Tensor) else jnp.asarray(attn_mask)
+        pattern = pattern & (am[None, None] > 0)
+    scores = jnp.where(pattern, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(pattern, probs, 0.0)
+    return Tensor(jnp.einsum("bhst,bhtd->bhsd", probs, v))
+
+
+def _conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+            subm=False, key=None, data_format="NDHWC", name=None):
+    """Sparse 3-D convolution (reference sparse/nn/functional/conv.py
+    conv3d / subm_conv3d over voxel grids). TPU-first: the sparse voxels
+    densify to the grid and XLA's conv runs on the MXU — dense windows are
+    how a TPU computes this regardless; sparse is the STORAGE format. With
+    subm=True the output keeps exactly the input's active sites (the
+    submanifold convention that stops dilation of the active set)."""
+    b = _bcoo(x)
+    dense = b.todense()  # [N, D, H, W, C]
+    w = weight._value if isinstance(weight, Tensor) else jnp.asarray(weight)
+    # weight layout [kd, kh, kw, C_in/groups, C_out] (reference layout)
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    d = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+    if isinstance(padding, int):
+        pads = [(padding, padding)] * 3
+    else:
+        pads = [(p, p) if isinstance(p, int) else tuple(p) for p in padding]
+    out = jax.lax.conv_general_dilated(
+        dense, w, window_strides=s, padding=pads, rhs_dilation=d,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        feature_group_count=groups)
+    if bias is not None:
+        bv = bias._value if isinstance(bias, Tensor) else jnp.asarray(bias)
+        out = out + bv
+    if subm:
+        # keep the input's active sites only (same spatial shape required)
+        active = jnp.abs(dense).sum(-1, keepdims=True) > 0
+        out = jnp.where(jnp.broadcast_to(active, out.shape), out, 0.0)
+    # keep the [nnz, C] channel-dense layout the input convention uses
+    return SparseCooTensor(jsparse.BCOO.fromdense(out, n_dense=1))
+
+
+def _max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+                data_format="NDHWC", name=None):
+    """Sparse max pooling over the voxel grid (reference
+    sparse/nn/functional/pool.py)."""
+    b = _bcoo(x)
+    dense = b.todense()
+    ks = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = ks if stride is None else ((stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    out = jax.lax.reduce_window(
+        dense, -jnp.inf, jax.lax.max,
+        window_dimensions=(1,) + ks + (1,),
+        window_strides=(1,) + st + (1,),
+        padding=((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),))
+    out = jnp.where(jnp.isfinite(out), out, 0.0)
+    # keep the [nnz, C] channel-dense layout the input convention uses
+    return SparseCooTensor(jsparse.BCOO.fromdense(out, n_dense=1))
+
+
+class _SparseNNFunctionalFull(_SparseNNFunctional):
+    attention = staticmethod(_attention)
+    conv3d = staticmethod(lambda *a, **k: _conv3d(*a, **k))
+    subm_conv3d = staticmethod(lambda *a, **k: _conv3d(*a, subm=True, **k))
+    max_pool3d = staticmethod(_max_pool3d)
+
+
+class _SparseLayerBase:
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+
+class _ReLULayer(_SparseLayerBase):
+    def forward(self, x):
+        return _SparseNNFunctional.relu(x)
+
+
+class _SoftmaxLayer(_SparseLayerBase):
+    def __init__(self, axis=-1):
+        self.axis = axis
+
+    def forward(self, x):
+        return _SparseNNFunctional.softmax(x, axis=self.axis)
+
+
+class _Conv3DLayer(_SparseLayerBase):
+    """sparse.nn.Conv3D / SubmConv3D (reference sparse/nn/layer/conv.py)."""
+
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=None,
+                 data_format="NDHWC"):
+        ks = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
+        from ..core import random as _random
+
+        fan_in = in_channels * int(np.prod(ks))
+        bound = 1.0 / np.sqrt(fan_in)
+        key = _random.default_generator.next_key()
+        self.weight = Tensor(jax.random.uniform(
+            key, ks + (in_channels // groups, out_channels), jnp.float32,
+            minval=-bound, maxval=bound), stop_gradient=False)
+        self.bias = (None if bias_attr is False else Tensor(
+            jnp.zeros((out_channels,), jnp.float32), stop_gradient=False))
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         groups=groups, data_format=data_format)
+
+    def forward(self, x):
+        return _conv3d(x, self.weight, self.bias, subm=self._subm, **self._cfg)
+
+
+class _SubmConv3DLayer(_Conv3DLayer):
+    _subm = True
+
+
+class _MaxPool3DLayer(_SparseLayerBase):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NDHWC"):
+        self._cfg = dict(kernel_size=kernel_size, stride=stride, padding=padding)
+
+    def forward(self, x):
+        return _max_pool3d(x, **self._cfg)
+
+
+class _BatchNormLayer(_SparseLayerBase):
+    """sparse.nn.BatchNorm (reference sparse/nn/layer/norm.py): normalizes
+    over the NONZERO values per channel — zeros are absent sites, not data."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, data_format="NDHWC"):
+        self.eps = epsilon
+        self.weight = Tensor(jnp.ones((num_features,), jnp.float32), stop_gradient=False)
+        self.bias = Tensor(jnp.zeros((num_features,), jnp.float32), stop_gradient=False)
+
+    def forward(self, x):
+        b = _bcoo(x)
+        vals = b.data  # [nnz, C]
+        mean = vals.mean(axis=0)
+        var = vals.var(axis=0)
+        out = (vals - mean) / jnp.sqrt(var + self.eps)
+        out = out * self.weight._value + self.bias._value
+        return SparseCooTensor(jsparse.BCOO((out, b.indices), shape=b.shape))
+
+
 class _SparseNN:
-    functional = _SparseNNFunctional()
+    functional = _SparseNNFunctionalFull()
+    ReLU = _ReLULayer
+    Softmax = _SoftmaxLayer
+    Conv3D = _Conv3DLayer
+    SubmConv3D = _SubmConv3DLayer
+    MaxPool3D = _MaxPool3DLayer
+    BatchNorm = _BatchNormLayer
 
 
 nn = _SparseNN()

@@ -9,6 +9,7 @@ from .datasets import (  # noqa: F401
     WMT14,
     WMT16,
 )
+from .edit_distance import edit_distance  # noqa: F401
 from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
 
 __all__ = [
@@ -20,5 +21,6 @@ __all__ = [
     "WMT14",
     "WMT16",
     "ViterbiDecoder",
+    "edit_distance",
     "viterbi_decode",
 ]

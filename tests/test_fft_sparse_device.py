@@ -130,3 +130,78 @@ class TestCustomDevicePlugin:
         assert plugin.list_custom_devices() == ["fake_npu"]
         # availability goes through jax.devices and reports honestly
         assert not plugin.is_custom_device_available("fake_npu")
+
+
+class TestSparseNNExtended:
+    """sparse.nn depth (reference sparse/nn layer+functional families):
+    attention, (subm_)conv3d, max_pool3d, BatchNorm — sparse storage,
+    dense MXU compute."""
+
+    def _voxels(self, rng, N=1, D=4, H=4, W=4, C=3, nnz=10):
+        import paddle_tpu.sparse as sp
+
+        idx = np.stack([rng.randint(0, s, nnz) for s in (N, D, H, W)], 1)
+        idx = np.unique(idx, axis=0)
+        vals = rng.randn(idx.shape[0], C).astype(np.float32)
+        return sp.sparse_coo_tensor(idx.T, vals, shape=[N, D, H, W, C])
+
+    def test_sparse_attention_matches_masked_dense(self):
+        import paddle_tpu.sparse as sp
+
+        rng = np.random.RandomState(0)
+        B, H, S, Dh = 1, 2, 6, 4
+        q, k, v = (rng.randn(B, H, S, Dh).astype(np.float32) for _ in range(3))
+        # random sparse pattern with every row nonempty (diag included)
+        pat = (rng.rand(B, H, S, S) < 0.4)
+        pat |= np.eye(S, dtype=bool)[None, None]
+        idx = np.argwhere(pat)
+        mask = sp.sparse_coo_tensor(idx.T, np.ones(len(idx), np.float32),
+                                    shape=[B, H, S, S])
+        out = sp.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), mask)
+        scores = np.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(Dh)
+        scores = np.where(pat, scores, -1e30)
+        e = np.exp(scores - scores.max(-1, keepdims=True))
+        p = np.where(pat, e, 0); p = p / p.sum(-1, keepdims=True)
+        ref = np.einsum("bhst,bhtd->bhsd", p, v)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-4, atol=1e-5)
+
+    def test_subm_conv3d_preserves_active_sites(self):
+        import paddle_tpu.sparse as sp
+
+        rng = np.random.RandomState(1)
+        x = self._voxels(rng)
+        conv = sp.nn.SubmConv3D(3, 5, kernel_size=3, padding=1)
+        y = conv(x)
+        assert list(y.shape) == [1, 4, 4, 4, 5]
+        yd = np.asarray(y.to_dense().numpy())
+        xd = np.asarray(x.to_dense().numpy())
+        inactive = np.abs(xd).sum(-1) == 0
+        assert np.all(yd[inactive] == 0)  # submanifold: no dilation
+        # plain conv3d does dilate
+        conv2 = sp.nn.Conv3D(3, 5, kernel_size=3, padding=1)
+        y2 = conv2(x)
+        assert list(y2.shape) == [1, 4, 4, 4, 5]
+
+    def test_sparse_max_pool3d(self):
+        import paddle_tpu.sparse as sp
+
+        rng = np.random.RandomState(2)
+        x = self._voxels(rng, D=4, H=4, W=4)
+        y = sp.nn.MaxPool3D(2)(x)
+        assert list(y.shape) == [1, 2, 2, 2, 3]
+        ref = np.asarray(x.to_dense().numpy()).reshape(1, 2, 2, 2, 2, 2, 2, 3)
+        ref = ref.transpose(0, 1, 3, 5, 2, 4, 6, 7).reshape(1, 2, 2, 2, 8, 3).max(4)
+        ref = np.where(np.isfinite(ref), ref, 0.0)
+        np.testing.assert_allclose(np.asarray(y.to_dense().numpy()), ref, rtol=1e-6)
+
+    def test_sparse_batchnorm_normalizes_nonzeros(self):
+        import paddle_tpu.sparse as sp
+
+        rng = np.random.RandomState(3)
+        x = self._voxels(rng, nnz=20)
+        y = sp.nn.BatchNorm(3)(x)
+        vals = np.asarray(y._bcoo.data)
+        np.testing.assert_allclose(vals.mean(0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(vals.std(0), 1.0, atol=1e-2)

@@ -347,3 +347,64 @@ class TestPredictorIrOptim:
             np.testing.assert_allclose(np.asarray(out), [2.0, 2.0])
         finally:
             paddle_tpu.disable_static()
+
+
+class TestDeleteQuantDequant:
+    """delete_quant_dequant IR pass (reference framework/ir
+    delete_quant_dequant_filter_op_pass.cc family): fake-QDQ chains from an
+    unconverted QAT model vanish at predictor load, output == the
+    unquantized float model."""
+
+    def _qat_model(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import (
+            QAT, FakeQuanterWithAbsMaxObserver, QuantConfig)
+
+        paddle_tpu.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                          weight=FakeQuanterWithAbsMaxObserver())
+        qnet = QAT(cfg).quantize(net)
+        x = paddle_tpu.to_tensor(
+            np.random.RandomState(0).randn(2, 4).astype(np.float32))
+        qnet(x)  # populate observer scales
+        qnet.eval()
+        return qnet, x
+
+    def test_pass_strips_qdq_and_matches_float(self):
+        from paddle_tpu.core.tensor import Tensor
+
+        qnet, x = self._qat_model()
+        prog = ir.trace(lambda xv: qnet(Tensor(xv))._value, x._value)
+        names_before = [op.name for op in prog.ops()]
+        n_round = sum(1 for op in prog.ops()
+                      if op.name == "pd.jit" and op.attrs().get("name") == "round")
+        assert n_round >= 3, names_before  # 2 weight + >=1 activation QDQ
+
+        stats = ir.PassManager(["delete_quant_dequant", "dce"]).run(prog)
+        assert stats["delete_quant_dequant"] >= 3, stats
+        assert not any(op.name == "pd.jit" and op.attrs().get("name") == "round"
+                       for op in prog.ops())
+
+        # stripped program == the float path (QDQ noise removed entirely):
+        # run the wrapped layers WITHOUT their quanters
+        import paddle_tpu.nn.functional as F
+
+        from paddle_tpu.quantization.wrapper import QuantedLinear
+
+        with paddle_tpu.no_grad():
+            h = x
+            for sub in qnet.sublayers(include_self=False):
+                if isinstance(sub, QuantedLinear):
+                    h = F.linear(h, sub.weight, sub.bias)
+                elif type(sub).__name__ == "ReLU":
+                    h = F.relu(h)
+        got = prog.to_callable()(x._value)
+        got = got[0] if isinstance(got, (list, tuple)) else got
+        np.testing.assert_allclose(np.asarray(got), np.asarray(h._value),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_in_inference_pipeline(self):
+        from paddle_tpu.ir.pass_manager import INFERENCE_PIPELINE
+
+        assert "delete_quant_dequant" in INFERENCE_PIPELINE

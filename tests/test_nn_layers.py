@@ -334,3 +334,30 @@ class TestGradClip:
         g = paddle.to_tensor(np.array([-5.0, 0.5, 5.0], np.float32))
         (out,) = nn.ClipGradByValue(1.0)([(p, g)])
         np.testing.assert_array_equal(out[1].numpy(), [-1, 0.5, 1])
+
+
+def test_max_pool_grad_under_jit():
+    """Regression: lax dispatches reduce_window to its differentiable max
+    monoid only for concrete scalar inits; a device-array init broke
+    jit(grad(maxpool)) (ResNet's exact training path)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.core.tensor import Tensor
+
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 16, 16), jnp.float32)
+
+    def loss(v):
+        out = F.max_pool2d(Tensor(v), 3, 2, 1)
+        return out._value.sum()
+
+    g = jax.jit(jax.grad(loss))(x)
+    assert np.isfinite(np.asarray(g)).all()
+    # bf16 too (the dtype the bench trains in)
+    import ml_dtypes
+
+    xb = x.astype(ml_dtypes.bfloat16)
+    gb = jax.jit(jax.grad(lambda v: F.max_pool2d(Tensor(v), 2, 2)._value
+                          .astype(jnp.float32).sum()))(xb)
+    assert np.isfinite(np.asarray(gb, np.float32)).all()

@@ -1,0 +1,148 @@
+"""phi ops.yaml name coverage: every yaml-name registry entry resolves AND
+the new long-tail implementations compute correctly (edit_distance,
+signal.frame/overlap_add, fill_diagonal*, decode_jpeg, squared_l2_norm)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.op_registry import get_op, has_op
+
+
+def test_yaml_names_registered():
+    from paddle_tpu.ops.yaml_compat import _DELEGATES
+
+    for name in _DELEGATES:
+        assert has_op(name), name
+    for mode in ("bilinear", "bicubic", "nearest", "linear", "trilinear"):
+        assert has_op(f"{mode}_interp")
+    for name in ("merge_selected_rows", "coalesce_tensor", "npu_identity",
+                 "copy_to", "uniform_inplace", "fill_diagonal",
+                 "fill_diagonal_tensor", "squared_l2_norm", "mean_all"):
+        assert has_op(name), name
+
+
+def test_yaml_delegates_callable_sample():
+    """Spot-call a representative slice of the delegate adapters with real
+    inputs — the call-level gate, not an import-only check."""
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
+
+    out = get_op("logsigmoid").fn(x)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.log(1 / (1 + np.exp(-np.asarray(x.numpy())))),
+                               rtol=1e-5)
+    out = get_op("tanh_shrink").fn(x)
+    assert out.shape == [2, 8]
+    out = get_op("p_norm").fn(x)
+    assert np.isfinite(float(out))
+    out = get_op("squared_l2_norm").fn(x)
+    np.testing.assert_allclose(float(out), (np.asarray(x.numpy()) ** 2).sum(),
+                               rtol=1e-5)
+    out = get_op("mean_all").fn(x)
+    np.testing.assert_allclose(float(out), np.asarray(x.numpy()).mean(), rtol=1e-5)
+    img = paddle.to_tensor(rng.rand(1, 1, 8, 8).astype(np.float32))
+    out = get_op("bilinear_interp").fn(img, out_size=[16, 16])
+    assert out.shape == [1, 1, 16, 16]
+    logits = paddle.to_tensor(rng.randn(4, 3).astype(np.float32))
+    labels = paddle.to_tensor(np.array([0, 1, 2, 1]))
+    out = get_op("cross_entropy_with_softmax").fn(logits, labels)
+    assert np.isfinite(np.asarray(out.numpy())).all()
+    boxes = paddle.to_tensor(np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                                       [50, 50, 60, 60]], np.float32))
+    kept = get_op("nms").fn(boxes, 0.5)
+    assert len(np.asarray(kept.numpy())) >= 2
+
+
+def test_edit_distance_matches_python_dp():
+    def ref(a, b):
+        la, lb = len(a), len(b)
+        d = [[0] * (lb + 1) for _ in range(la + 1)]
+        for i in range(la + 1):
+            d[i][0] = i
+        for j in range(lb + 1):
+            d[0][j] = j
+        for i in range(1, la + 1):
+            for j in range(1, lb + 1):
+                d[i][j] = min(d[i - 1][j] + 1, d[i][j - 1] + 1,
+                              d[i - 1][j - 1] + (a[i - 1] != b[j - 1]))
+        return d[la][lb]
+
+    rng = np.random.RandomState(0)
+    A = np.zeros((6, 10), np.int64)
+    B = np.zeros((6, 12), np.int64)
+    las, lbs, want = [], [], []
+    for k in range(6):
+        la, lb = rng.randint(1, 9), rng.randint(1, 11)
+        a, b = rng.randint(0, 5, la), rng.randint(0, 5, lb)
+        A[k, :la], B[k, :lb] = a, b
+        las.append(la), lbs.append(lb)
+        want.append(ref(list(a), list(b)))
+    d, n = paddle.text.edit_distance(
+        paddle.to_tensor(A), paddle.to_tensor(B),
+        input_length=paddle.to_tensor(np.array(las)),
+        label_length=paddle.to_tensor(np.array(lbs)), normalized=False)
+    np.testing.assert_array_equal(np.asarray(d.numpy()).reshape(-1), want)
+    assert int(n) == 6
+    # normalized divides by label length
+    dn, _ = paddle.text.edit_distance(
+        paddle.to_tensor(A), paddle.to_tensor(B),
+        input_length=paddle.to_tensor(np.array(las)),
+        label_length=paddle.to_tensor(np.array(lbs)), normalized=True)
+    np.testing.assert_allclose(np.asarray(dn.numpy()).reshape(-1),
+                               np.array(want) / np.array(lbs), rtol=1e-6)
+
+
+def test_frame_overlap_add_roundtrip():
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(2, 20).astype(np.float32))
+    fr = paddle.signal.frame(x, 6, 2)
+    assert fr.shape == [2, 6, 8]
+    # frame content: frame j = x[j*2 : j*2+6]
+    np.testing.assert_allclose(np.asarray(fr.numpy())[0, :, 3],
+                               np.asarray(x.numpy())[0, 6:12])
+    # non-overlapping frames reconstruct exactly
+    fr2 = paddle.signal.frame(x, 5, 5)
+    rec = paddle.signal.overlap_add(fr2, 5)
+    np.testing.assert_allclose(np.asarray(rec.numpy()), np.asarray(x.numpy()),
+                               rtol=1e-6)
+    # axis=0 layout
+    x0 = paddle.to_tensor(rng.randn(20).astype(np.float32))
+    f0 = paddle.signal.frame(x0, 6, 2, axis=0)
+    assert f0.shape == [8, 6]
+    o0 = paddle.signal.overlap_add(f0, 2, axis=0)
+    assert o0.shape == [20]
+
+
+def test_fill_diagonal_variants():
+    m = paddle.zeros([3, 3])
+    m.fill_diagonal_(5.0)
+    np.testing.assert_allclose(np.diag(np.asarray(m.numpy())), 5.0)
+    # wrap on a tall matrix: every (C+1)-th flat element
+    t = paddle.zeros([7, 3])
+    t.fill_diagonal_(1.0, wrap=True)
+    tv = np.asarray(t.numpy()).reshape(-1)
+    assert tv[::4].sum() == len(tv[::4])
+    from paddle_tpu.ops.compat import fill_diagonal_tensor
+
+    m2 = fill_diagonal_tensor(paddle.zeros([3, 4]),
+                              paddle.to_tensor(np.array([1., 2., 3.], np.float32)),
+                              offset=1)
+    np.testing.assert_allclose(np.asarray(m2.numpy())[[0, 1, 2], [1, 2, 3]],
+                               [1, 2, 3])
+
+
+def test_decode_jpeg():
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    img = Image.fromarray(rng.randint(0, 255, (16, 16, 3), dtype=np.uint8))
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG")
+    data = np.frombuffer(buf.getvalue(), np.uint8)
+    out = paddle.vision.ops.decode_jpeg(paddle.to_tensor(data))
+    assert out.shape == [3, 16, 16]
+    assert str(out.dtype).endswith("uint8")
