@@ -1,6 +1,7 @@
 """paddle.incubate.nn analog: fused transformer blocks built on the Pallas
 seams (fused_attention / fused_feedforward op analogs, SURVEY §2.2)."""
 
+from . import functional  # noqa: F401
 from .fused_transformer import (  # noqa: F401
     FusedBiasDropoutResidualLayerNorm,
     FusedDropoutAdd,

@@ -1,0 +1,126 @@
+"""paddle.incubate.nn.functional (reference incubate/nn/functional/):
+the fused-op functional surface. On TPU "fused" means one traced
+expression XLA fuses — these exist so serving/training code written
+against the reference's fused API ports unchanged.
+
+fused_rotary_position_embedding re-designs the RoPE CUDA kernel
+(fused_rotary_position_embedding.py) as pure jnp: build cos/sin once,
+rotate q/k in one fused elementwise block on the VPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops._dispatch import apply, as_tensor
+
+__all__ = [
+    "fused_dropout_add",
+    "fused_linear",
+    "fused_rms_norm",
+    "fused_rotary_position_embedding",
+]
+
+
+def _rope_pair(x, cos, sin, use_neox: bool):
+    """Rotate the feature pairs of x [B, S, H, D] by (cos, sin) [S, D]."""
+    if use_neox:
+        # neox style: rotate halves (x1 = x[..., :D/2], x2 = x[..., D/2:])
+        D = x.shape[-1]
+        x1, x2 = x[..., : D // 2], x[..., D // 2:]
+        rot = jnp.concatenate([-x2, x1], axis=-1)
+    else:
+        # GPT-J style: rotate even/odd interleaved pairs
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        rot = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+    return x * cos[None, :, None, :] + rot * sin[None, :, None, :]
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major: bool = False, rotary_emb_base=10000.0):
+    """RoPE over q/k[/v] [B, S, H, D] (reference
+    incubate/nn/functional/fused_rotary_position_embedding.py). With
+    sin/cos None they are built from rotary_emb_base; position_ids
+    optionally gathers per-batch positions. Returns the same tuple arity
+    it was given ((q,), (q, k) or (q, k, v))."""
+    q = as_tensor(q)
+    B, S, H, D = q.shape
+
+    if cos is None or sin is None:
+        pos = jnp.arange(S, dtype=jnp.float32)
+        inv = rotary_emb_base ** (-jnp.arange(0, D, 2, dtype=jnp.float32) / D)
+        freqs = pos[:, None] * inv[None, :]  # [S, D/2]
+        if use_neox_rotary_style:
+            emb = jnp.concatenate([freqs, freqs], axis=-1)  # [S, D]
+        else:
+            emb = jnp.repeat(freqs, 2, axis=-1)
+        cos_v, sin_v = jnp.cos(emb), jnp.sin(emb)
+    else:
+        cos_v = as_tensor(cos)._value.reshape(-1, D)[:S]
+        sin_v = as_tensor(sin)._value.reshape(-1, D)[:S]
+
+    if position_ids is not None:
+        pid = as_tensor(position_ids)._value  # [B, S]
+        cos_v = cos_v[pid]  # [B, S, D]
+        sin_v = sin_v[pid]
+
+    def rope_one(t):
+        tv = t._value
+        c, s = cos_v.astype(tv.dtype), sin_v.astype(tv.dtype)
+        if position_ids is not None:
+            if use_neox_rotary_style:
+                Dh = tv.shape[-1]
+                x1, x2 = tv[..., : Dh // 2], tv[..., Dh // 2:]
+                rot = jnp.concatenate([-x2, x1], axis=-1)
+            else:
+                rot = jnp.stack([-tv[..., 1::2], tv[..., 0::2]], axis=-1).reshape(tv.shape)
+            return Tensor(tv * c[:, :, None, :] + rot * s[:, :, None, :])
+        return Tensor(_rope_pair(tv, c, s, use_neox_rotary_style))
+
+    outs = [rope_one(q)]
+    if k is not None:
+        outs.append(rope_one(as_tensor(k)))
+    if v is not None:
+        outs.append(as_tensor(v))  # reference: v passes through un-rotated
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y in one fused expression (reference
+    incubate/nn/functional/fused_dropout_add.py)."""
+    from ...nn.functional import dropout
+
+    x = as_tensor(x)
+    y = as_tensor(y)
+    return dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """One-matmul linear (reference incubate fused_linear / fused_gemm)."""
+    x = as_tensor(x)
+    w = as_tensor(weight)
+
+    def f(xv, wv, *rest):
+        wv2 = wv.T if transpose_weight else wv
+        out = xv @ wv2
+        return out + rest[0] if rest else out
+
+    args = [x, w] + ([as_tensor(bias)] if bias is not None else [])
+    return apply("fused_linear", f, *args)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, name=None):
+    """RMSNorm through the fused kernel seam (reference fused_rms_norm)."""
+    from ...nn.functional import rms_norm
+
+    out = rms_norm(x, weight=norm_weight, epsilon=epsilon)
+    if norm_bias is not None:
+        out = out + as_tensor(norm_bias)
+    return out

@@ -275,9 +275,12 @@ def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
             R, C = xv.shape
             if wrap and R > C:
                 # wrapped fill: every (C+1)-th element of the flat view,
-                # i.e. the diagonal restarts after a blank separator row
+                # i.e. the diagonal restarts after a blank separator row.
+                # Negative offset starts |offset| rows down (a negative
+                # flat start would wrap to the array END under jax).
+                start = offset if offset >= 0 else (-offset) * C
                 flat = xv.reshape(-1)
-                pos = jnp.arange(offset, R * C, C + 1)
+                pos = jnp.arange(start, R * C, C + 1)
                 return flat.at[pos].set(jnp.asarray(value, xv.dtype)).reshape(R, C)
             n = min(R, C - offset) if offset >= 0 else min(R + offset, C)
             rows = jnp.arange(max(n, 0)) + max(-offset, 0)

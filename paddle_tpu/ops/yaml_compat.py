@@ -67,7 +67,6 @@ _DELEGATES = {
     "frobenius_norm": "linalg.norm",
     "p_norm": "linalg.norm",
     "matrix_rank_tol": "linalg.matrix_rank",
-    "clip_by_norm": "nn.clip_by_norm",
     "spectral_norm": "static.nn.spectral_norm",
     # detection / vision
     "box_coder": "vision.ops.box_coder",
@@ -105,8 +104,8 @@ _DELEGATES = {
     "elementwise_pow": "ops.math.pow",
     "reverse": "ops.manipulation.flip",
     "split_with_num": "ops.manipulation.split",
-    "shape": "ops.creation.shape" ,
-    "increment": "ops.math.increment",
+    "shape": "ops.compat.shape",
+    "increment": "ops.compat.increment",
     "fill": "ops.creation.full_like",
     "full_batch_size_like": "ops.creation.full_like",
     "repeat_interleave_with_tensor_index": "ops.manipulation.repeat_interleave",
@@ -114,9 +113,6 @@ _DELEGATES = {
     # XLA conv covers it — phi keeps separate kernels for cuDNN reasons)
     "depthwise_conv2d": "nn.functional.conv2d",
     "depthwise_conv2d_transpose": "nn.functional.conv2d_transpose",
-    # random
-    "truncated_gaussian_random": "nn.initializer.TruncatedNormal",
-    "dirichlet": "distribution.Dirichlet",
 }
 
 for _name, _path in _DELEGATES.items():
@@ -124,6 +120,54 @@ for _name, _path in _DELEGATES.items():
 
 for _mode in ("bilinear", "bicubic", "nearest", "linear", "trilinear"):
     register_op(f"{_mode}_interp")(_interp(_mode))
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(x, max_norm, name=None):
+    """Scale x so ||x||_2 <= max_norm (phi clip_by_norm — the per-tensor
+    grad-clip kernel)."""
+    import jax.numpy as jnp
+
+    from ._dispatch import apply, as_tensor
+
+    def f(xv):
+        norm = jnp.sqrt(jnp.sum(jnp.square(xv.astype(jnp.float32))))
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+        return (xv.astype(jnp.float32) * scale).astype(xv.dtype)
+
+    return apply("clip_by_norm", f, as_tensor(x))
+
+
+@register_op("truncated_gaussian_random")
+def truncated_gaussian_random(shape, mean=0.0, std=1.0, dtype="float32",
+                              a=-2.0, b=2.0, name=None):
+    """Sample N(mean, std) truncated to [mean + a*std, mean + b*std]
+    (phi truncated_gaussian_random op)."""
+    import jax
+
+    from ..core import random as _random
+    from ..core.dtype import to_jax_dtype
+    from ..core.tensor import Tensor
+
+    key = _random.next_key()
+    s = jax.random.truncated_normal(key, a, b, tuple(shape),
+                                    to_jax_dtype("float32"))
+    return Tensor((s * std + mean).astype(to_jax_dtype(dtype)))
+
+
+@register_op("dirichlet")
+def dirichlet(alpha, name=None):
+    """Sample Dirichlet(alpha) (phi dirichlet op): gamma draws normalized
+    over the last axis."""
+    import jax
+
+    from ..core import random as _random
+    from ._dispatch import as_tensor
+    from ..core.tensor import Tensor
+
+    av = as_tensor(alpha)._value
+    g = jax.random.gamma(_random.next_key(), av)
+    return Tensor(g / g.sum(axis=-1, keepdims=True))
 
 
 @register_op("merge_selected_rows")

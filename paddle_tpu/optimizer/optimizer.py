@@ -133,7 +133,10 @@ class Optimizer:
                 new_state["master_weight"] = new_value
                 p._set_value_raw(new_value.astype(p._value.dtype))
             else:
-                p._set_value_raw(new_value)
+                # eager dtype pin (see apply_gradients): trust-ratio math in
+                # f32 must not promote bf16 params step over step
+                p._set_value_raw(new_value.astype(p._value.dtype)
+                                 if new_value.dtype != p._value.dtype else new_value)
             self._accumulators[p._uid] = new_state
 
     def clear_grad(self, set_to_zero: bool = False):
@@ -195,11 +198,20 @@ class Optimizer:
             nv, ns = self._update(value, gv, s, lr,
                                   param_meta=_NamedParamMeta(name))
             ns.pop("_step_override", None)
+            # pin output dtypes to the input dtypes: a traced f32 lr (or a
+            # trust-ratio norm) silently promotes bf16 params/states to
+            # f32, which retraces the jitted step with f32 weights against
+            # bf16 activations and breaks dtype-strict ops like conv
+            ns = {k: (sv.astype(state[name][k].dtype)
+                      if k in state[name] and hasattr(sv, "dtype")
+                      and hasattr(state[name][k], "dtype")
+                      and sv.dtype != state[name][k].dtype else sv)
+                  for k, sv in ns.items()}
             if "master_weight" in s:
                 ns["master_weight"] = nv
                 new_params[name] = nv.astype(v.dtype)
             else:
-                new_params[name] = nv
+                new_params[name] = nv.astype(v.dtype) if nv.dtype != v.dtype else nv
             new_state[name] = ns
         return new_params, new_state
 

@@ -255,9 +255,13 @@ def _conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 def _max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                 data_format="NDHWC", name=None):
     """Sparse max pooling over the voxel grid (reference
-    sparse/nn/functional/pool.py)."""
+    sparse/nn/functional/pool.py): the max is over ACTIVE sites only —
+    empty sites densify to -inf, not 0, so negative activations survive."""
     b = _bcoo(x)
     dense = b.todense()
+    ones = jnp.ones((b.indices.shape[0],), dense.dtype)
+    site = jsparse.BCOO((ones, b.indices), shape=b.shape[:-1]).todense() > 0
+    dense = jnp.where(site[..., None], dense, -jnp.inf)
     ks = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
     st = ks if stride is None else ((stride,) * 3 if isinstance(stride, int) else tuple(stride))
     pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)

@@ -61,11 +61,30 @@ def edit_distance(input, label, input_length=None, label_length=None,
     yl = (as_tensor(label_length) if label_length is not None
           else as_tensor(jnp.full((B,), T2, jnp.int32)))
 
+    ignored = tuple(ignored_tokens) if ignored_tokens else ()
+
+    def _drop_ignored(seq, length):
+        """Stable-compact non-ignored tokens to the front; returns
+        (compacted seq, new length). Positions >= length never count."""
+        T = seq.shape[0]
+        pos = jnp.arange(T)
+        bad = jnp.zeros((T,), bool)
+        for tok in ignored:
+            bad = bad | (seq == tok)
+        bad = bad & (pos < length)
+        keep_rank = jnp.argsort(jnp.where(bad | (pos >= length), T + pos, pos))
+        return seq[keep_rank], length - bad.sum().astype(length.dtype)
+
     def f(xv, yv, xlv, ylv):
         xlv = xlv.reshape(-1).astype(jnp.int32)
         ylv = ylv.reshape(-1).astype(jnp.int32)
-        d = jax.vmap(_pair_distance)(xv.astype(jnp.int32),
-                                     yv.astype(jnp.int32), xlv, ylv)
+        xv, yv = xv.astype(jnp.int32), yv.astype(jnp.int32)
+        if ignored:
+            # reference semantics: ignored tokens (blanks/padding ids) are
+            # stripped before the distance
+            xv, xlv = jax.vmap(_drop_ignored)(xv, xlv)
+            yv, ylv = jax.vmap(_drop_ignored)(yv, ylv)
+        d = jax.vmap(_pair_distance)(xv, yv, xlv, ylv)
         d = d.astype(jnp.float32)
         if normalized:
             d = d / jnp.maximum(ylv.astype(jnp.float32), 1.0)

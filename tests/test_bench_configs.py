@@ -1,0 +1,30 @@
+"""BASELINE config bench harness (bench.py --config ...): the rows run on
+CPU with tiny shapes so the harness itself is CI-guarded — shapes, JSON
+contract, breakdown fields."""
+
+import json
+
+import numpy as np
+
+
+def test_bench_row_contract(capsys):
+    import bench
+
+    row = bench.bench_gpt_moe()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(out)
+    assert parsed == row
+    assert parsed["config"] == "gpt_moe"
+    assert parsed["value"] > 0 and np.isfinite(parsed["value"])
+    bd = parsed["breakdown"]
+    for key in ("compute", "collective_measured", "collective_est",
+                "host_input", "other"):
+        assert 0.0 <= bd[key] <= 1.0, (key, bd)
+    assert parsed["step_ms"] > 0
+
+
+def test_all_configs_registered():
+    import bench
+
+    assert set(bench.CONFIGS) == {"bert_sst2", "gpt_dp", "ernie_mp4",
+                                  "resnet50", "gpt_moe"}

@@ -191,7 +191,11 @@ class TestSparseNNExtended:
         x = self._voxels(rng, D=4, H=4, W=4)
         y = sp.nn.MaxPool3D(2)(x)
         assert list(y.shape) == [1, 2, 2, 2, 3]
-        ref = np.asarray(x.to_dense().numpy()).reshape(1, 2, 2, 2, 2, 2, 2, 3)
+        # reference: max over ACTIVE sites only (absent voxels are not zero)
+        dense = np.asarray(x.to_dense().numpy())
+        active = (np.abs(dense).sum(-1, keepdims=True) > 0)
+        masked = np.where(active, dense, -np.inf)
+        ref = masked.reshape(1, 2, 2, 2, 2, 2, 2, 3)
         ref = ref.transpose(0, 1, 3, 5, 2, 4, 6, 7).reshape(1, 2, 2, 2, 8, 3).max(4)
         ref = np.where(np.isfinite(ref), ref, 0.0)
         np.testing.assert_allclose(np.asarray(y.to_dense().numpy()), ref, rtol=1e-6)
@@ -205,3 +209,16 @@ class TestSparseNNExtended:
         vals = np.asarray(y._bcoo.data)
         np.testing.assert_allclose(vals.mean(0), 0.0, atol=1e-5)
         np.testing.assert_allclose(vals.std(0), 1.0, atol=1e-2)
+
+
+def test_sparse_max_pool_keeps_negative_actives():
+    """Empty sites are ABSENT, not zero: a window holding only a negative
+    active voxel pools to that value (review regression)."""
+    import paddle_tpu.sparse as sp
+
+    idx = np.array([[0], [0], [0], [0]])  # one voxel at (0,0,0,0)
+    vx = sp.sparse_coo_tensor(idx, np.array([[-2.0]], np.float32),
+                              shape=[1, 2, 2, 2, 1])
+    y = sp.nn.MaxPool3D(2)(vx)
+    np.testing.assert_allclose(np.asarray(y.to_dense().numpy()).reshape(-1),
+                               [-2.0])

@@ -117,3 +117,62 @@ def test_decode_is_differentiable():
     loss.backward()
     g = m.qkv_w.grad
     assert g is not None and np.isfinite(g.numpy()).all()
+
+
+class TestIncubateFunctional:
+    """incubate.nn.functional fused surface (reference incubate/nn/
+    functional): RoPE correctness vs a hand rollout, dropout_add, linear."""
+
+    def test_fused_rotary_position_embedding_neox(self):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        rng = np.random.RandomState(0)
+        B, S, H, D = 2, 6, 2, 8
+        q = rng.randn(B, S, H, D).astype(np.float32)
+        k = rng.randn(B, S, H, D).astype(np.float32)
+        qo, ko = IF.fused_rotary_position_embedding(
+            paddle.to_tensor(q), paddle.to_tensor(k))
+        # reference: rotate halves with cos/sin of pos * base^(-2i/D)
+        pos = np.arange(S, dtype=np.float32)
+        inv = 10000.0 ** (-np.arange(0, D, 2, dtype=np.float32) / D)
+        emb = np.concatenate([pos[:, None] * inv, pos[:, None] * inv], -1)
+        c, s = np.cos(emb), np.sin(emb)
+        def rot(x):
+            x1, x2 = x[..., :D // 2], x[..., D // 2:]
+            r = np.concatenate([-x2, x1], -1)
+            return x * c[None, :, None, :] + r * s[None, :, None, :]
+        np.testing.assert_allclose(np.asarray(qo.numpy()), rot(q), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(ko.numpy()), rot(k), rtol=1e-5, atol=1e-5)
+        # position 0 is identity
+        np.testing.assert_allclose(np.asarray(qo.numpy())[:, 0], q[:, 0], rtol=1e-6)
+
+    def test_rope_position_ids_gather(self):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        rng = np.random.RandomState(1)
+        q = rng.randn(1, 4, 1, 8).astype(np.float32)
+        # positions [3,2,1,0] == reversing the default rotation order
+        pid = np.array([[3, 2, 1, 0]])
+        (qo,) = (IF.fused_rotary_position_embedding(
+            paddle.to_tensor(q), position_ids=paddle.to_tensor(pid)),)
+        qr = IF.fused_rotary_position_embedding(paddle.to_tensor(q[:, ::-1].copy()))
+        np.testing.assert_allclose(np.asarray(qo.numpy())[:, ::-1],
+                                   np.asarray(qr.numpy()), rtol=1e-5, atol=1e-5)
+
+    def test_fused_dropout_add_and_linear(self):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        out = IF.fused_dropout_add(x, y, p=0.0, training=True)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(x.numpy()) + np.asarray(y.numpy()),
+                                   rtol=1e-6)
+        w = paddle.to_tensor(rng.randn(8, 3).astype(np.float32))
+        b = paddle.to_tensor(rng.randn(3).astype(np.float32))
+        out = IF.fused_linear(x, w, b)
+        np.testing.assert_allclose(
+            np.asarray(out.numpy()),
+            np.asarray(x.numpy()) @ np.asarray(w.numpy()) + np.asarray(b.numpy()),
+            rtol=1e-5)

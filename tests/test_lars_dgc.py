@@ -200,3 +200,32 @@ def test_meta_optimizer_passes_map_to_strategy():
 
     with pytest.raises(ValueError):
         new_pass("dgc", {"sparsity": [1.5]}).apply()
+
+
+def test_optimizer_preserves_param_dtype_across_steps():
+    """Regression: a traced f32 lr (or LARS trust-ratio f32 math) must not
+    promote bf16 params/optimizer state to f32 between steps — that
+    retraces the jitted train step with f32 weights against bf16
+    activations and breaks dtype-strict ops (conv) on the second call."""
+    import jax
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    from paddle_tpu.optimizer import SGD, Lars
+
+    for opt in (Lars(learning_rate=0.1, momentum=0.9),
+                SGD(learning_rate=0.1),
+                DGCMomentum(learning_rate=0.1, momentum=0.9, sparsity=0.5)):
+        params = {"w": jnp.asarray(np.ones((8, 8)), ml_dtypes.bfloat16)}
+        grads = {"w": jnp.asarray(np.full((8, 8), 0.1), ml_dtypes.bfloat16)}
+        state = opt.init_state_pytree(params)
+        for _ in range(2):
+            params, state = opt.apply_gradients(params, grads, state,
+                                                lr=jnp.float32(0.1))
+        assert params["w"].dtype == jnp.bfloat16, type(opt).__name__
+        # state dtypes stable too: no per-step retrace from dtype drift
+        s0 = opt.init_state_pytree(params)
+        _, s1 = opt.apply_gradients(params, grads, s0, lr=jnp.float32(0.1))
+        d0 = [str(l.dtype) for l in jax.tree_util.tree_leaves(s0)]
+        d1 = [str(l.dtype) for l in jax.tree_util.tree_leaves(s1)]
+        assert d0 == d1, (type(opt).__name__, d0, d1)

@@ -146,3 +146,53 @@ def test_decode_jpeg():
     out = paddle.vision.ops.decode_jpeg(paddle.to_tensor(data))
     assert out.shape == [3, 16, 16]
     assert str(out.dtype).endswith("uint8")
+
+
+def test_clip_by_norm_and_random_ops_callable():
+    rng = np.random.RandomState(5)
+    x = paddle.to_tensor((rng.randn(4, 4) * 10).astype(np.float32))
+    out = get_op("clip_by_norm").fn(x, 1.0)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out.numpy())), 1.0, rtol=1e-5)
+    small = paddle.to_tensor(np.full((2,), 0.1, np.float32))
+    out2 = get_op("clip_by_norm").fn(small, 5.0)
+    np.testing.assert_allclose(np.asarray(out2.numpy()), 0.1, rtol=1e-6)
+
+    s = get_op("truncated_gaussian_random").fn([1000], mean=1.0, std=0.5)
+    sv = np.asarray(s.numpy())
+    assert s.shape == [1000]
+    assert sv.min() >= 1.0 - 2 * 0.5 - 1e-5 and sv.max() <= 1.0 + 2 * 0.5 + 1e-5
+
+    d = get_op("dirichlet").fn(paddle.to_tensor(np.ones((3, 4), np.float32)))
+    dv = np.asarray(d.numpy())
+    np.testing.assert_allclose(dv.sum(-1), 1.0, rtol=1e-5)
+    assert (dv >= 0).all()
+
+    # shape / increment resolve to real functions now
+    assert list(np.asarray(get_op("shape").fn(
+        paddle.to_tensor(np.zeros((2, 3), np.float32))).numpy())) == [2, 3]
+
+
+def test_edit_distance_ignored_tokens():
+    # blanks (0) stripped before the distance: [5,0,0,6] vs [5,6] -> 0
+    pred = paddle.to_tensor(np.array([[5, 0, 0, 6]], np.int64))
+    lab = paddle.to_tensor(np.array([[5, 6, 0, 0]], np.int64))
+    d, _ = paddle.text.edit_distance(
+        pred, lab,
+        input_length=paddle.to_tensor(np.array([4])),
+        label_length=paddle.to_tensor(np.array([2])),
+        normalized=False, ignored_tokens=[0])
+    assert float(np.asarray(d.numpy())[0, 0]) == 0.0
+    # without the ignore list they count
+    d2, _ = paddle.text.edit_distance(
+        pred, lab,
+        input_length=paddle.to_tensor(np.array([4])),
+        label_length=paddle.to_tensor(np.array([2])), normalized=False)
+    assert float(np.asarray(d2.numpy())[0, 0]) == 2.0
+
+
+def test_fill_diagonal_wrap_negative_offset():
+    t = paddle.zeros([7, 3])
+    t.fill_diagonal_(1.0, offset=-1, wrap=True)
+    tv = np.asarray(t.numpy())
+    assert tv[1, 0] == 1.0 and tv[0].sum() == 0.0
