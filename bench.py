@@ -264,10 +264,11 @@ def _row(config, metric, value, unit, step_s, flops_per_step, host_frac,
             "compute": round(compute_frac, 3),
             "collective_measured": 0.0,  # one chip: no cross-chip comm
             "collective_est": round(collective_est, 3),
+            # compute/other partition the DEVICE-RESIDENT step; host_input
+            # is the extra fraction of the host-fed step (not additive
+            # with the device-step fields)
             "host_input": round(host_frac, 3),
-            # partition of the HOST-FED step: compute + host + other = 1
-            "other": round(max(0.0, 1 - compute_frac * (1 - host_frac)
-                               - host_frac), 3),
+            "other": round(max(0.0, 1 - compute_frac), 3),
         },
         "mfu": round(flops_per_step / (_peak_flops() * step_s), 3),
         "note": note,

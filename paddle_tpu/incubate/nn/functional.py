@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor
@@ -49,20 +50,48 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     optionally gathers per-batch positions. Returns the same tuple arity
     it was given ((q,), (q, k) or (q, k, v))."""
     q = as_tensor(q)
+    if time_major:
+        # [S, B, H, D] layout: rotate in batch-major form and restore below
+        def tm(t):
+            return Tensor(jnp.swapaxes(as_tensor(t)._value, 0, 1))
+
+        outs = fused_rotary_position_embedding(
+            tm(q), tm(k) if k is not None else None,
+            tm(v) if v is not None else None, sin=sin, cos=cos,
+            position_ids=position_ids,
+            use_neox_rotary_style=use_neox_rotary_style,
+            time_major=False, rotary_emb_base=rotary_emb_base)
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        back = tuple(Tensor(jnp.swapaxes(o._value, 0, 1)) for o in outs)
+        return back if len(back) > 1 else back[0]
     B, S, H, D = q.shape
 
     if cos is None or sin is None:
-        pos = jnp.arange(S, dtype=jnp.float32)
+        # with explicit position_ids the table must cover max(position)+1
+        # rows, not just S (KV-cache decode gathers positions >= S)
+        n_rows = S
+        if position_ids is not None:
+            pid_v = as_tensor(position_ids)._value
+            if isinstance(pid_v, jax.core.Tracer):
+                raise ValueError(
+                    "fused_rotary_position_embedding: pass explicit sin/cos "
+                    "tables when position_ids is traced (the generated "
+                    "table's length can't depend on traced values)")
+            n_rows = max(S, int(pid_v.max()) + 1)
+        pos = jnp.arange(n_rows, dtype=jnp.float32)
         inv = rotary_emb_base ** (-jnp.arange(0, D, 2, dtype=jnp.float32) / D)
-        freqs = pos[:, None] * inv[None, :]  # [S, D/2]
+        freqs = pos[:, None] * inv[None, :]  # [n_rows, D/2]
         if use_neox_rotary_style:
-            emb = jnp.concatenate([freqs, freqs], axis=-1)  # [S, D]
+            emb = jnp.concatenate([freqs, freqs], axis=-1)  # [n_rows, D]
         else:
             emb = jnp.repeat(freqs, 2, axis=-1)
         cos_v, sin_v = jnp.cos(emb), jnp.sin(emb)
     else:
-        cos_v = as_tensor(cos)._value.reshape(-1, D)[:S]
-        sin_v = as_tensor(sin)._value.reshape(-1, D)[:S]
+        # full table; truncate to S only when gathering positionally 0..S-1
+        cos_v = as_tensor(cos)._value.reshape(-1, D)
+        sin_v = as_tensor(sin)._value.reshape(-1, D)
+        if position_ids is None:
+            cos_v, sin_v = cos_v[:S], sin_v[:S]
 
     if position_ids is not None:
         pid = as_tensor(position_ids)._value  # [B, S]
@@ -117,10 +146,28 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
 
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
                    begin_norm_axis=-1, name=None):
-    """RMSNorm through the fused kernel seam (reference fused_rms_norm)."""
-    from ...nn.functional import rms_norm
+    """RMSNorm through the fused kernel seam (reference fused_rms_norm).
+    begin_norm_axis normalizes over ALL trailing axes from that index
+    (the reference layer_norm-style contract)."""
+    x = as_tensor(x)
+    nd = len(x.shape)
+    axis = begin_norm_axis % nd
+    if axis == nd - 1:
+        from ...nn.functional import rms_norm
 
-    out = rms_norm(x, weight=norm_weight, epsilon=epsilon)
+        out = rms_norm(x, weight=norm_weight, epsilon=epsilon)
+    else:
+        w = as_tensor(norm_weight)
+
+        def f(xv, wv):
+            axes = tuple(range(axis, xv.ndim))
+            ms = jnp.mean(jnp.square(xv.astype(jnp.float32)), axis=axes,
+                          keepdims=True)
+            out = xv.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)
+            return (out * wv.reshape(xv.shape[axis:]).astype(jnp.float32)
+                    ).astype(xv.dtype)
+
+        out = apply("fused_rms_norm", f, x, w)
     if norm_bias is not None:
         out = out + as_tensor(norm_bias)
     return out

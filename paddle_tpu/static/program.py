@@ -364,20 +364,57 @@ class _VarView:
 
 
 class Scope:
-    def __init__(self):
+    """Hierarchical variable scope (reference phi/core Scope,
+    framework/scope.h): `var` creates in THIS scope, `find_var` searches
+    this scope then walks the PARENT chain — plus the program-variable
+    lookup the TPU executor keeps (programs own the live tensors here).
+    `new_scope` makes a kid; `drop_kids` releases the subtree."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
         self._extra = {}
+        self._parent = parent
+        self._kids: List["Scope"] = []
+
+    def parent(self) -> Optional["Scope"]:
+        return self._parent
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(parent=self)
+        self._kids.append(kid)
+        return kid
+
+    def kids(self) -> List["Scope"]:
+        return list(self._kids)
+
+    def drop_kids(self):
+        self._kids.clear()
+
+    def local_var_names(self) -> List[str]:
+        return list(self._extra)
+
+    def find_var_locally(self, name):
+        if name in self._extra:
+            return _VarView(self._extra[name])
+        return None
 
     def find_var(self, name):
+        local = self.find_var_locally(name)
+        if local is not None:
+            return local
+        if self._parent is not None:
+            found = self._parent.find_var(name)
+            if found is not None:
+                return found
         for prog in [default_main_program(), _default_startup]:
             try:
                 return _VarView(prog.var(name))
             except KeyError:
                 continue
-        if name in self._extra:
-            return _VarView(self._extra[name])
         return None
 
     def var(self, name):
+        if name in self._extra:
+            return _VarView(self._extra[name])
         t = Tensor(jnp.zeros(()))
         t.name = name
         self._extra[name] = t

@@ -176,3 +176,45 @@ class TestIncubateFunctional:
             np.asarray(out.numpy()),
             np.asarray(x.numpy()) @ np.asarray(w.numpy()) + np.asarray(b.numpy()),
             rtol=1e-5)
+
+    def test_rope_decode_positions_beyond_s(self):
+        """KV-cache decode: S=1 with position_ids >= S must rotate by the
+        TRUE position, via a generated table or a user table that is never
+        truncated (review regression)."""
+        from paddle_tpu.incubate.nn import functional as IF
+
+        rng = np.random.RandomState(3)
+        D = 8
+        q_full = rng.randn(1, 12, 1, D).astype(np.float32)
+        qr_full = IF.fused_rotary_position_embedding(paddle.to_tensor(q_full))
+        # decode token at position 9, passed alone with position_ids=[[9]]
+        q_step = q_full[:, 9:10]
+        (qr_step,) = (IF.fused_rotary_position_embedding(
+            paddle.to_tensor(q_step),
+            position_ids=paddle.to_tensor(np.array([[9]]))),)
+        np.testing.assert_allclose(np.asarray(qr_step.numpy())[0, 0],
+                                   np.asarray(qr_full.numpy())[0, 9],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_rope_time_major(self):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        rng = np.random.RandomState(4)
+        q = rng.randn(2, 6, 2, 8).astype(np.float32)  # [B, S, H, D]
+        ref = IF.fused_rotary_position_embedding(paddle.to_tensor(q))
+        tm = IF.fused_rotary_position_embedding(
+            paddle.to_tensor(q.swapaxes(0, 1).copy()), time_major=True)
+        np.testing.assert_allclose(np.asarray(tm.numpy()).swapaxes(0, 1),
+                                   np.asarray(ref.numpy()), rtol=1e-5, atol=1e-6)
+
+    def test_fused_rms_norm_begin_axis(self):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        rng = np.random.RandomState(5)
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        w = np.ones((3, 4), np.float32)
+        out = IF.fused_rms_norm(paddle.to_tensor(x), paddle.to_tensor(w),
+                                begin_norm_axis=1)
+        ms = (x.reshape(2, -1) ** 2).mean(-1, keepdims=True)
+        ref = (x.reshape(2, -1) / np.sqrt(ms + 1e-6)).reshape(2, 3, 4)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-5)

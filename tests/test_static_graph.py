@@ -190,3 +190,31 @@ def test_static_cond_identity_branches_follow_feeds():
         np.testing.assert_allclose(exe.run(prog, feed=feed, fetch_list=[out])[0], [7.0, 8.0])
     finally:
         paddle.disable_static()
+
+
+def test_scope_parent_chain(static_mode):
+    """Scope tree (reference framework/scope.h): kids see parent vars,
+    parents don't see kid vars, shadowing is scope-local, drop_kids
+    releases the subtree."""
+    from paddle_tpu.static.program import Scope
+
+    root = Scope()
+    root.var("a").set(np.array(1.0, np.float32))
+    kid = root.new_scope()
+    # kid finds the parent's var through the chain
+    assert kid.find_var("a") is not None
+    np.testing.assert_allclose(kid.find_var("a").get_tensor(), 1.0)
+    # kid-local var invisible to the parent
+    kid.var("b").set(np.array(2.0, np.float32))
+    assert root.find_var_locally("b") is None
+    assert kid.find_var_locally("b") is not None
+    # shadowing: kid's own 'a' wins locally, parent's untouched
+    kid.var("a").set(np.array(9.0, np.float32))
+    np.testing.assert_allclose(kid.find_var("a").get_tensor(), 9.0)
+    np.testing.assert_allclose(root.find_var("a").get_tensor(), 1.0)
+    # tree bookkeeping
+    assert kid.parent() is root and root.kids() == [kid]
+    grandkid = kid.new_scope()
+    assert grandkid.find_var("a") is not None  # two levels up
+    root.drop_kids()
+    assert root.kids() == []
