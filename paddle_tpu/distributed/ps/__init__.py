@@ -27,6 +27,7 @@ from .._wire import client_handshake, recv_msg, send_msg, server_handshake
 
 __all__ = [
     "SparseTable", "GraphTable", "PsServer", "PsClient",
+    "HeterClient", "register_heter_entry", "heter_entries",
     "init_server", "run_server", "init_worker", "stop_worker",
     "get_ps_endpoints",
 ]
@@ -649,3 +650,6 @@ def stop_worker():
     client = _role_state.pop("client", None)
     if client is not None:
         client.close()
+
+
+from .heter import HeterClient, heter_entries, register_heter_entry  # noqa: F401,E402

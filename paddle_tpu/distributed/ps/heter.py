@@ -1,0 +1,121 @@
+"""Heterogeneous trainer bridge — the HeterClient/HeterServer analog
+(reference fluid/distributed/ps/service/heter_client.h,
+heter_server.h: CPU trainers offload program segments to accelerator
+"heter workers" via SendAndRecv of variables).
+
+TPU re-design: the hot compute path never leaves the chip, so the slice of
+heter-PS that still matters is the REVERSE offload — host-bound stages
+(giant embedding gathers, feature preprocessing) running next to the
+parameter servers while the device trainer keeps the MXU busy. The bridge
+is a named-entry RPC: a heter worker registers python callables ("program
+segments"); trainers call send_and_recv(name, tensors) and get tensors
+back, batched over the worker pool round-robin.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["HeterClient", "register_heter_entry", "heter_entries"]
+
+_entries: Dict[str, Callable] = {}
+_entries_lock = threading.Lock()
+
+
+def register_heter_entry(name: str, fn: Callable = None):
+    """Register a program segment served to trainers (the heter worker's
+    RunComponent registration). Decorator-friendly."""
+    if fn is None:
+        def deco(f):
+            register_heter_entry(name, f)
+            return f
+
+        return deco
+    with _entries_lock:
+        _entries[name] = fn
+    return fn
+
+
+def heter_entries() -> List[str]:
+    with _entries_lock:
+        return sorted(_entries)
+
+
+def _run_entry(name: str, arrays):
+    with _entries_lock:
+        fn = _entries.get(name)
+    if fn is None:
+        raise KeyError(f"no heter entry {name!r}; registered: {heter_entries()}")
+    outs = fn(*[np.asarray(a) for a in arrays])
+    outs = outs if isinstance(outs, (list, tuple)) else (outs,)
+    return [np.asarray(o) for o in outs]
+
+
+class HeterClient:
+    """Trainer-side handle over a group of heter workers (heter_client.h
+    SendAndRecv): requests round-robin across the worker names, each call
+    ships input arrays, runs the named entry remotely, returns outputs."""
+
+    def __init__(self, workers: Sequence[str]):
+        if not workers:
+            raise ValueError("HeterClient needs at least one heter worker name")
+        self._workers = list(workers)
+        self._rr = itertools.cycle(range(len(self._workers)))
+        self._rr_lock = threading.Lock()
+
+    def _next_worker(self) -> str:
+        with self._rr_lock:
+            return self._workers[next(self._rr)]
+
+    def _prepare(self, tensors, to):
+        arrays = [np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+                  for t in tensors]
+        return arrays, (to if to is not None else self._next_worker())
+
+    @staticmethod
+    def _wrap(outs):
+        from ...core.tensor import Tensor
+
+        return [Tensor(np.asarray(o)) for o in outs]
+
+    def send_and_recv(self, entry: str, *tensors, to: Optional[str] = None,
+                      timeout: float = 180.0):
+        """Run `entry` on a heter worker with `tensors` (Tensor/ndarray);
+        returns a list of Tensors (SendAndRecv's vars-out)."""
+        from ..rpc import rpc_sync
+
+        arrays, target = self._prepare(tensors, to)
+        return self._wrap(rpc_sync(target, _run_entry, args=(entry, arrays),
+                                   timeout=timeout))
+
+    def send_and_recv_async(self, entry: str, *tensors,
+                            to: Optional[str] = None, timeout: float = 180.0):
+        """Async form; the returned future resolves to the SAME list-of-
+        Tensors contract as send_and_recv."""
+        from ..rpc import rpc_async
+
+        arrays, target = self._prepare(tensors, to)
+        fut = rpc_async(target, _run_entry, args=(entry, arrays),
+                        timeout=timeout)
+
+        class _TensorFuture:
+            def __init__(self, inner, wrap):
+                self._inner, self._wrap = inner, wrap
+
+            def result(self, timeout=None):
+                return self._wrap(self._inner.result(timeout))
+
+            wait = result
+
+            def done(self):
+                return self._inner.done()
+
+        return _TensorFuture(fut, self._wrap)
+
+    def stop(self):
+        """Parity with heter_client's FinalizeWorker: nothing to tear down —
+        connections belong to the rpc layer."""

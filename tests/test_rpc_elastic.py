@@ -1,6 +1,8 @@
 """RPC + elastic manager tests (reference test/rpc + fleet/elastic tests analog)."""
 
 import socket as _socket
+
+import numpy as np
 import time
 
 
@@ -181,3 +183,43 @@ class TestWireAuth:
         finally:
             rpc.shutdown()
             master.stop()
+
+
+class TestHeterBridge:
+    """Heter trainer bridge (reference ps/service/heter_client.h
+    SendAndRecv): a worker registers a program segment; trainers offload
+    host-bound stages and get tensors back over rpc."""
+
+    def test_send_and_recv_roundtrip(self):
+        from paddle_tpu.distributed import rpc
+        from paddle_tpu.distributed.ps import (
+            HeterClient, heter_entries, register_heter_entry)
+
+        @register_heter_entry("embed_sum")
+        def embed_sum(table, ids):
+            return table[ids].sum(axis=1)
+
+        register_heter_entry("scale2", lambda x: (x * 2.0, x + 1.0))
+        assert "embed_sum" in heter_entries()
+
+        import os
+
+        os.environ["PADDLE_RPC_BASE_PORT"] = str(_free_port())
+        rpc.init_rpc("trainer0", rank=0, world_size=1)
+        try:
+            client = HeterClient(["trainer0"])  # self-loop: same wire path
+            table = np.arange(20, dtype=np.float32).reshape(5, 4)
+            ids = np.array([[0, 2], [1, 4]])
+            (out,) = client.send_and_recv("embed_sum", table, ids)
+            np.testing.assert_allclose(np.asarray(out.numpy()),
+                                       table[ids].sum(axis=1))
+            a, b = client.send_and_recv("scale2", np.ones((2, 2), np.float32))
+            np.testing.assert_allclose(np.asarray(a.numpy()), 2.0)
+            np.testing.assert_allclose(np.asarray(b.numpy()), 2.0)
+            fut = client.send_and_recv_async("scale2", np.ones(3, np.float32))
+            a2, _ = fut.result(timeout=30)
+            assert a2.numpy().shape == (3,)  # async honors the Tensor contract
+            with pytest.raises(RuntimeError, match="no heter entry"):
+                client.send_and_recv("missing_entry", table)
+        finally:
+            rpc.shutdown()
