@@ -64,15 +64,28 @@ class PipelineSpec:
     # only then may the pipeline region go manual over sep — models with
     # plain attention would silently lose cross-chunk attention otherwise
     context_parallel: bool = False
+    # MoE: block_with_aux(bp, h) -> (h, aux_scalar) carries the gate
+    # load-balance term OUT of the scanned schedule (an attribute write
+    # would leak tracers); the step adds aux_weight * mean-over-microbatch
+    # aux to the loss
+    block_with_aux: Optional[Callable] = None
+    aux_weight: float = 0.0
 
 
 def make_layer_stack_pipeline_spec(model, block_layer, block_prefix: str,
                                    n_blocks: int, embed_method: str = "embed",
                                    head_method: str = "head_loss",
-                                   context_parallel: bool = False) -> PipelineSpec:
+                                   context_parallel: bool = False,
+                                   aux_attr: Optional[str] = None,
+                                   aux_weight: float = 0.0) -> PipelineSpec:
     """Build the PipelineSpec for the common homogeneous-stack shape: a model
     exposing ``embed(x)`` (pre) and ``head_loss(h, y)`` (post) methods plus a
-    LayerList of identical blocks. GPT/BERT/ERNIE all use this."""
+    LayerList of identical blocks. GPT/BERT/ERNIE all use this.
+
+    aux_attr: dotted attribute path on the block (e.g. "mlp.aux_loss") whose
+    value AFTER one functional apply is that block's gate aux loss — read
+    inside the block fn so the traced value rides the scan out legally
+    (MoE blocks under pp)."""
     import jax.numpy as jnp
 
     from ....core.tensor import Tensor
@@ -85,6 +98,16 @@ def make_layer_stack_pipeline_spec(model, block_layer, block_prefix: str,
         out, _ = block_layer.functional_call(bp, {}, Tensor(h))
         return out._value
 
+    block_with_aux = None
+    if aux_attr is not None:
+        def block_with_aux(bp, h):
+            out, _ = block_layer.functional_call(bp, {}, Tensor(h))
+            obj = block_layer
+            for part in aux_attr.split("."):
+                obj = getattr(obj, part)
+            aux = obj._value if isinstance(obj, Tensor) else jnp.asarray(obj)
+            return out._value, aux.astype(jnp.float32)
+
     def post_loss(params, buffers, h, y):
         out, _ = model.functional_call(
             params, buffers, Tensor(h), Tensor(y), method=head_method)
@@ -92,7 +115,8 @@ def make_layer_stack_pipeline_spec(model, block_layer, block_prefix: str,
 
     return PipelineSpec(block_prefix=block_prefix, n_blocks=n_blocks,
                         pre=pre, block=block, post_loss=post_loss,
-                        context_parallel=context_parallel)
+                        context_parallel=context_parallel,
+                        block_with_aux=block_with_aux, aux_weight=aux_weight)
 
 
 def _chunk_order(L: int, pp: int, v: int):
@@ -310,6 +334,7 @@ def pipeline_schedule(
     axis_name: str = "pp",
     n_stages: Optional[int] = None,
     remat: bool = True,
+    with_aux: bool = False,
 ):
     """Differentiable compiled pipeline schedule, for use INSIDE shard_map
     over the pp axis (reference forward_backward_pipeline
@@ -348,13 +373,20 @@ def pipeline_schedule(
     def tick(carry, t):
         from ....core import random as _random
 
-        incoming, outputs = carry
+        incoming, outputs, aux_acc = carry
         # stage 0 reads microbatch t from the stream; others read the carry
         x_in = jnp.where(stage_idx == 0, microbatches[jnp.clip(t, 0, M - 1)], incoming)
         # salt RNG draws with the tick so dropout masks differ per microbatch
         # (the scan body is traced once; see core.random.key_salt)
         with _random.key_salt(t):
-            y = fn(my_params, x_in)
+            if with_aux:
+                y, aux = fn(my_params, x_in)
+                # only ticks carrying a REAL microbatch contribute: stage s
+                # holds microbatch t-s, live for t-s in [0, M)
+                live = (t - stage_idx >= 0) & (t - stage_idx < M)
+                aux_acc = aux_acc + jnp.where(live, aux, 0.0)
+            else:
+                y = fn(my_params, x_in)
         # last stage records its result at slot t - (n - 1)
         slot = t - (n - 1)
         valid = (stage_idx == n - 1) & (slot >= 0)
@@ -365,13 +397,18 @@ def pipeline_schedule(
             outputs,
         )
         nxt = lax.ppermute(y, axis_name, perm)
-        return (nxt, outputs), None
+        return (nxt, outputs, aux_acc), None
 
     init_in = jnp.zeros(mb_shape, microbatches.dtype)
-    probe = jax.eval_shape(lambda p, x: stage_fn(p, x), my_params, init_in)
+    probe_fn = (lambda p, x: stage_fn(p, x)[0]) if with_aux else stage_fn
+    probe = jax.eval_shape(probe_fn, my_params, init_in)
     outputs0 = jnp.zeros((M,) + tuple(probe.shape), probe.dtype)
-    (_, outputs), _ = lax.scan(tick, (init_in, outputs0), jnp.arange(M + n - 1))
-    return outputs
+    (_, outputs, aux_acc), _ = lax.scan(
+        tick, (init_in, outputs0, jnp.zeros((), jnp.float32)),
+        jnp.arange(M + n - 1))
+    # aux_acc is each stage's partial sum over its microbatches; the total
+    # over all stages/blocks is the psum (still inside the manual region)
+    return (outputs, lax.psum(aux_acc, axis_name)) if with_aux else outputs
 
 
 def _simulate_interleaved_ticks(n: int, v: int, M: int) -> int:
@@ -412,6 +449,7 @@ def pipeline_schedule_interleaved(
     n_stages: Optional[int] = None,
     virtual_stages: int = 2,
     remat: bool = True,
+    with_aux: bool = False,
 ):
     """Interleaved virtual-stage pipeline (reference
     PipelineParallelWithInterleave, pipeline_parallel.py:514): device d owns
@@ -459,12 +497,14 @@ def pipeline_schedule_interleaved(
     T = _simulate_interleaved_ticks(n, v, M)
 
     probe_params = jax.tree_util.tree_map(lambda p: p[0], my)
-    probe = jax.eval_shape(lambda p, x: call(p, x, jnp.zeros((), jnp.int32)),
+    probe_fn = (lambda p, x: call(p, x, jnp.zeros((), jnp.int32))[0]) \
+        if with_aux else (lambda p, x: call(p, x, jnp.zeros((), jnp.int32)))
+    probe = jax.eval_shape(probe_fn,
                            probe_params, jnp.zeros(mb_shape, microbatches.dtype))
     out_dtype = probe.dtype
 
     def tick(carry, _):
-        act, mb_idx, chunk_idx, valid, fresh, outputs = carry
+        act, mb_idx, chunk_idx, valid, fresh, outputs, aux_acc = carry
         # stage 0 injects a fresh microbatch into a free slot
         inject = (stage_idx == 0) & (~valid) & (fresh < M)
         act = jnp.where(inject, microbatches[jnp.clip(fresh, 0, M - 1)], act)
@@ -480,7 +520,11 @@ def pipeline_schedule_interleaved(
         # salt RNG with (microbatch, chunk) so dropout masks are distinct
         # per microbatch AND per virtual chunk (the scan body traces once)
         with _random.key_salt(mb_idx * (n * v) + chunk_idx):
-            y = fn(chunk_params, act, jnp.clip(chunk_idx, 0, n * v - 1))
+            if with_aux:
+                y, aux = fn(chunk_params, act, jnp.clip(chunk_idx, 0, n * v - 1))
+                aux_acc = aux_acc + jnp.where(valid, aux, 0.0)  # bubbles: no aux
+            else:
+                y = fn(chunk_params, act, jnp.clip(chunk_idx, 0, n * v - 1))
         y = jnp.where(valid, y, act)  # bubbles pass through untouched
         # finished microbatches (chunk nv-1, which lives on stage n-1) record
         finishing = valid & (chunk_idx == n * v - 1)
@@ -496,7 +540,7 @@ def pipeline_schedule_interleaved(
                lax.ppermute(mb_idx, axis_name, perm),
                lax.ppermute(chunk_idx + 1, axis_name, perm),
                lax.ppermute(out_valid, axis_name, perm))
-        return (nxt[0], nxt[1], nxt[2], nxt[3], fresh, outputs), None
+        return (nxt[0], nxt[1], nxt[2], nxt[3], fresh, outputs, aux_acc), None
 
     init = (
         jnp.zeros(mb_shape, microbatches.dtype),
@@ -505,9 +549,10 @@ def pipeline_schedule_interleaved(
         jnp.zeros((), bool),
         jnp.zeros((), jnp.int32),
         jnp.zeros((M,) + tuple(probe.shape), out_dtype),
+        jnp.zeros((), jnp.float32),
     )
-    (_, _, _, _, _, outputs), _ = lax.scan(tick, init, None, length=T)
-    return outputs
+    (_, _, _, _, _, outputs, aux_acc), _ = lax.scan(tick, init, None, length=T)
+    return (outputs, lax.psum(aux_acc, axis_name)) if with_aux else outputs
 
 
 def spmd_pipeline(

@@ -300,6 +300,8 @@ class ShardedTrainStep:
                 # dp shard's rows local through the reshape
                 mbs = jnp.swapaxes(h0.reshape((mb, M) + h0.shape[1:]), 0, 1)
 
+                with_aux = pspec.block_with_aux is not None
+
                 def body(stacked_loc, mbs_loc):
                     def stage(bp, h, chunk_idx=None):
                         Lps = jax.tree_util.tree_leaves(bp)[0].shape[0]
@@ -312,26 +314,38 @@ class ShardedTrainStep:
                         base = (lax.axis_index("pp") if chunk_idx is None
                                 else chunk_idx) * Lps
 
-                        def one(h, xs):
+                        def one(carry, xs):
                             bpi, li = xs
                             # salt with the global layer index so dropout
                             # masks differ per block (scan traces once)
+                            h, aux = carry
                             with _random.key_salt(base + li):
-                                return pspec.block(bpi, h), None
+                                if with_aux:
+                                    h, a = pspec.block_with_aux(bpi, h)
+                                    aux = aux + a
+                                else:
+                                    h = pspec.block(bpi, h)
+                            return (h, aux), None
 
-                        h, _ = lax.scan(one, h, (bp, jnp.arange(Lps)))
-                        return h
+                        (h, aux), _ = lax.scan(
+                            one, (h, jnp.zeros((), jnp.float32)),
+                            (bp, jnp.arange(Lps)))
+                        return (h, aux) if with_aux else h
 
                     if vpp > 1:
                         outs = pipeline_schedule_interleaved(
                             stage, stacked_loc, mbs_loc, axis_name="pp",
-                            virtual_stages=vpp, remat=remat)
+                            virtual_stages=vpp, remat=remat, with_aux=with_aux)
                     else:
                         outs = pipeline_schedule(stage, stacked_loc, mbs_loc,
-                                                 axis_name="pp", remat=remat)
+                                                 axis_name="pp", remat=remat,
+                                                 with_aux=with_aux)
                     # expose the per-stage outputs on a leading pp axis; the
                     # caller slices the last stage — no psum broadcast of
-                    # microbatch activations
+                    # microbatch activations. The aux total is already
+                    # psummed over pp (identical across stages).
+                    if with_aux:
+                        return outs[0][None], outs[1]
                     return outs[None]
 
                 # when the mesh carries a sep (context-parallel) axis, the
@@ -343,15 +357,24 @@ class ShardedTrainStep:
                 # receive local seq shards
                 use_sep = sep_deg > 1 and getattr(pspec, "context_parallel", False)
                 sep_deg = sep_deg if use_sep else 1
+                if with_aux and sep_deg > 1:
+                    raise NotImplementedError(
+                        "MoE gate aux under context parallelism needs "
+                        "per-shard capacity semantics; use sep_degree=1 "
+                        "with MoE pipelines")
                 manual = {"pp"} | ({"sep"} if sep_deg > 1 else set())
                 mbs_spec = P(None, None, "sep") if sep_deg > 1 else P()
+                h_spec = P("pp", None, None, "sep") if sep_deg > 1 else P("pp")
+                out_specs = (h_spec, P()) if with_aux else h_spec
                 outs_g = shard_map(
                     body, mesh=mesh,
                     in_specs=(P("pp"), mbs_spec),
-                    out_specs=P("pp", None, None, "sep") if sep_deg > 1 else P("pp"),
+                    out_specs=out_specs,
                     axis_names=manual,
                     check_vma=False,
                 )(stacked, mbs)
+                if with_aux:
+                    outs_g, aux_total = outs_g
                 h_last = outs_g[-1]  # [M, mb, ...] — the last stage's stream
                 # loss PER MICROBATCH, averaged — the reference's train_batch
                 # semantics (matters for ratio losses like masked-LM, where a
@@ -364,6 +387,10 @@ class ShardedTrainStep:
                     lambda hm, ym: pspec.post_loss(other, buffers0, hm, ym))(
                     h_last, ys)
                 loss = jnp.mean(per_mb.astype(jnp.float32))
+                if with_aux:
+                    # mean-over-microbatch gate aux, weighted — matches the
+                    # per-microbatch sequential objective
+                    loss = loss + pspec.aux_weight * aux_total / M
             return loss.astype(jnp.float32)
 
         return pipe_loss

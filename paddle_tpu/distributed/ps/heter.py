@@ -55,6 +55,21 @@ def _run_entry(name: str, arrays):
     return [np.asarray(o) for o in outs]
 
 
+class _TensorFuture:
+    """Future resolving to HeterClient's list-of-Tensors contract."""
+
+    def __init__(self, inner, wrap):
+        self._inner, self._wrap = inner, wrap
+
+    def result(self, timeout=None):
+        return self._wrap(self._inner.result(timeout))
+
+    wait = result
+
+    def done(self):
+        return self._inner.done()
+
+
 class HeterClient:
     """Trainer-side handle over a group of heter workers (heter_client.h
     SendAndRecv): requests round-robin across the worker names, each call
@@ -101,19 +116,6 @@ class HeterClient:
         arrays, target = self._prepare(tensors, to)
         fut = rpc_async(target, _run_entry, args=(entry, arrays),
                         timeout=timeout)
-
-        class _TensorFuture:
-            def __init__(self, inner, wrap):
-                self._inner, self._wrap = inner, wrap
-
-            def result(self, timeout=None):
-                return self._wrap(self._inner.result(timeout))
-
-            wait = result
-
-            def done(self):
-                return self._inner.done()
-
         return _TensorFuture(fut, self._wrap)
 
     def stop(self):

@@ -426,11 +426,19 @@ class GPTForCausalLM(Layer):
             make_layer_stack_pipeline_spec)
 
         if self.cfg.moe_num_experts > 0:
-            raise NotImplementedError(
-                "GPT-MoE does not pipeline yet: the homogeneous-stack "
-                "schedule can't carry the gate aux loss out of the scanned "
-                "stage. Compose MoE with dp x ep x sharding x mp instead "
-                "(BASELINE config 5 shape).")
+            if self.cfg.moe_every_k != 1:
+                raise NotImplementedError(
+                    "pipelined GPT-MoE needs a homogeneous stack: set "
+                    "moe_every_k=1 (every block MoE) so the scanned stage "
+                    "params stack; mixed dense/MoE stacks compose with "
+                    "dp x ep x sharding x mp instead")
+            # every block is MoE: the gate aux rides the schedule via the
+            # block_with_aux protocol (an attribute write can't leave the
+            # scan), weighted into the loss like the unpipelined objective
+            return make_layer_stack_pipeline_spec(
+                self, self.gpt.layers[0], "gpt.layers", self.cfg.num_layers,
+                context_parallel=True, aux_attr="mlp.aux_loss",
+                aux_weight=self.cfg.moe_aux_weight)
         return make_layer_stack_pipeline_spec(
             self, self.gpt.layers[0], "gpt.layers", self.cfg.num_layers,
             context_parallel=True)  # GPTAttention handles manual-sep shards
