@@ -349,6 +349,9 @@ def pipeline_schedule(
     Returns [M, mb, ...] outputs — valid ONLY on the LAST stage (zeros
     elsewhere). Callers mask with `lax.axis_index(axis_name) == n-1` and psum
     the (scalar) loss rather than broadcasting full microbatch activations.
+    With with_aux=True, stage_fn returns (y, aux_scalar) instead and the
+    schedule returns the TUPLE (outputs, aux_total): aux summed over live
+    slots only and psummed over the ring (identical on every stage).
 
     Differentiation IS the backward pipeline: `lax.ppermute` transposes to
     the reverse-direction permute and `lax.scan` transposes to the
@@ -470,7 +473,9 @@ def pipeline_schedule_interleaved(
     microbatch whenever its slot is free (returning laps take priority).
     Differentiation transposes the whole scan+ppermute program = the
     interleaved backward schedule. Returns [M, mb, ...] outputs valid ONLY
-    on the LAST stage (zeros elsewhere), like pipeline_schedule.
+    on the LAST stage (zeros elsewhere), like pipeline_schedule — and like
+    it, with_aux=True switches to 3-arg-aware stage fns returning
+    (y, aux_scalar) and an (outputs, aux_total) TUPLE return.
     """
     n = n_stages if n_stages is not None else lax.axis_size(axis_name)
     v = virtual_stages

@@ -110,17 +110,17 @@ class TestElastic:
         master = KVMaster()
         try:
             ep = f"127.0.0.1:{master.port}"
-            m = ElasticManager(np="1:2", host="hostR", master=ep, job_id="j2", heartbeat_s=0.2)
+            # 1s beats -> ~3s lease: starvation windows on a loaded xdist
+            # box (GIL + 4 workers) can't lapse it between renewals
+            m = ElasticManager(np="1:2", host="hostR", master=ep, job_id="j2", heartbeat_s=1.0)
             m.register()
             m.exit()
             m.register()  # must resurrect the heartbeat thread
-            time.sleep(0.8)  # > 3 heartbeats: lease survives only if renewed
-            # poll: on a loaded box a starved beat can lapse the lease for a
-            # moment; a live heartbeat thread restores it within one period
-            deadline = time.time() + 5.0
+            time.sleep(3.5)  # > 3 heartbeats: lease survives only if renewed
+            deadline = time.time() + 10.0
             seen = m.hosts()
             while seen != ["hostR"] and time.time() < deadline:
-                time.sleep(0.1)
+                time.sleep(0.2)
                 seen = m.hosts()
             assert seen == ["hostR"]
             m.exit()
