@@ -16,6 +16,7 @@ EXAMPLES = [
     "deploy_inference.py",
     "moe_hybrid_parallel.py",
     "long_context_hybrid.py",
+    "gpt_moe_fleet.py",
 ]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
