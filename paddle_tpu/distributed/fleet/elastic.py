@@ -154,20 +154,24 @@ class ElasticManager:
         if not self._client:
             return
         self._client.put(self._prefix + self.host, {"host": self.host, "ts": time.time()}, ttl=self.heartbeat_s * 3)
-        if self._thread is None or not self._thread.is_alive():
-            # re-registering after exit(): reset the stop latch so the fresh
-            # heartbeat thread actually renews the lease
+        if self._thread is None or not self._thread.is_alive() or self._stop.is_set():
+            # Fresh latch + fresh thread. Each loop captures ITS OWN stop
+            # event at spawn, so a previous loop still winding down after
+            # exit() (possibly blocked in a socket call) can neither be
+            # resurrected by the new event nor block this registration —
+            # spawning while the old thread drains is harmless.
             self._stop = threading.Event()
-            self._thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
+            self._thread = threading.Thread(
+                target=self._heartbeat_loop, args=(self._stop,), daemon=True)
             self._thread.start()
 
-    def _heartbeat_loop(self):
-        while not self._stop.is_set():
+    def _heartbeat_loop(self, stop: threading.Event):
+        while not stop.is_set():
             try:
                 self._client.put(self._prefix + self.host, {"host": self.host, "ts": time.time()}, ttl=self.heartbeat_s * 3)
             except (OSError, RuntimeError, ConnectionError):
                 pass
-            self._stop.wait(self.heartbeat_s)
+            stop.wait(self.heartbeat_s)
 
     def hosts(self) -> List[str]:
         if not self._client:

@@ -73,17 +73,23 @@ class Config:
 
 
 class _IOHandle:
-    """ZeroCopy tensor handle (paddle_infer.Tensor analog)."""
+    """ZeroCopy tensor handle (paddle_infer.Tensor analog).
+
+    The copies are the host<->device boundary, exactly as in the reference's
+    ZeroCopy API: copy_from_cpu uploads to device memory once, Run() consumes
+    and produces device-resident arrays, and copy_to_cpu materializes to host
+    (doubling as the completion barrier for async dispatch)."""
 
     def __init__(self, name: str):
         self.name = name
-        self._array: Optional[np.ndarray] = None
+        self._array = None  # device (jax) array once filled
 
     def reshape(self, shape):
-        self._array = np.zeros(shape, self._array.dtype if self._array is not None else np.float32)
+        dtype = self._array.dtype if self._array is not None else np.float32
+        self._array = jnp.zeros(shape, dtype)
 
     def copy_from_cpu(self, arr: np.ndarray):
-        self._array = np.asarray(arr)
+        self._array = jnp.asarray(arr)
 
     def copy_to_cpu(self) -> np.ndarray:
         return np.asarray(self._array)
@@ -120,7 +126,7 @@ class Predictor:
         if inputs is not None:
             for n, a in zip(self._input_names, inputs):
                 self._inputs[n].copy_from_cpu(a)
-        args = [jnp.asarray(self._inputs[n]._array) for n in self._input_names]
+        args = [self._inputs[n]._array for n in self._input_names]
         key = tuple((a.shape, str(a.dtype)) for a in args)
         call = self._compiled_cache.get(key)
         if call is None:
@@ -148,10 +154,12 @@ class Predictor:
         self._outputs = {}
         results = []
         for n, o in zip(self._output_names, outs):
+            # outputs stay device-resident: Run() is async dispatch, and
+            # copy_to_cpu is the host materialization + completion barrier
             h = _IOHandle(n)
-            h.copy_from_cpu(np.asarray(o))
+            h._array = o
             self._outputs[n] = h
-            results.append(np.asarray(o))
+            results.append(o)
         return results
 
     def get_output_names(self):

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
+LOG2E = 1.4426950408889634  # softmax runs in the exp2 domain (see _fwd_kernel)
 # TPU vector lanes: scalar-per-row outputs (lse, delta) are broadcast across a
 # 128-wide trailing dim so their blocks satisfy Mosaic's (8, 128) tiling rule —
 # same layout as jax.experimental.pallas.ops.tpu.flash_attention (MIN_BLOCK_SIZE).
@@ -52,12 +53,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(ki < kb_hi)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # MXU dots take the native (bf16) operands — fp32 inputs run the MXU
+        # at a fraction of peak; fp32 lives only in accumulators/stats
+        # (preferred_element_type pins the accumulation dtype). Softmax runs
+        # in the exp2 domain: log2(e) folds into the dot's scale, saving a
+        # full [bq, bk] multiply pass per block (stats/lse stay log2-domain;
+        # the bwd kernels use the same domain).
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * jnp.float32(scale)  # [bq, bk]
+        ) * jnp.float32(scale * LOG2E)  # [bq, bk], log2-domain
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
@@ -65,11 +72,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m = m_scr[:, 0]
         l = l_scr[:, 0]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
+        p = jnp.exp2(s - m_new[:, None])
+        alpha = jnp.exp2(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_scr[...] = jax.lax.broadcast_in_dim(m_new, m_scr.shape, (0,))
         l_scr[...] = jax.lax.broadcast_in_dim(l_new, l_scr.shape, (0,))
@@ -80,7 +88,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_safe = jnp.where(l == 0, 1.0, l)
         o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
         lse_ref[0] = jax.lax.broadcast_in_dim(
-            m_scr[:, 0] + jnp.log(l_safe), (bq, LANES), (0,))
+            m_scr[:, 0] + jnp.log2(l_safe), (bq, LANES), (0,))
 
 
 def _fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int):
@@ -137,20 +145,21 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
 
     @pl.when(ki < kb_hi)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, :1]  # [bq, 1] (lanes-broadcast layout)
+        # native-dtype MXU operands + log2-domain p — see _fwd_kernel
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]  # [bq, 1] (lanes-broadcast layout), log2-domain
         delta = delta_ref[0][:, :1]
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * jnp.float32(scale)
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * jnp.float32(scale * LOG2E)
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        p = jnp.exp2(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * jnp.float32(scale)
+        ds = (p * (dp - delta) * jnp.float32(scale)).astype(k.dtype)
         dq_scr[...] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     @pl.when(ki == num_kb - 1)
@@ -175,21 +184,21 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
     @pl.when(qi >= qb_lo)
     def _compute():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, :1]  # [bq, 1]
+        k = k_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]  # [bq, 1], log2-domain
         delta = delta_ref[0][:, :1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * jnp.float32(scale)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * jnp.float32(scale * LOG2E)
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
             kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
-        p = jnp.exp(s - lse)  # [bq, bk]
-        dv_scr[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        p = jnp.exp2(s - lse)  # [bq, bk]
+        dv_scr[...] += jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * jnp.float32(scale)
+        ds = (p * (dp - delta) * jnp.float32(scale)).astype(q.dtype)
         dk_scr[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     @pl.when(qi == num_qb - 1)
