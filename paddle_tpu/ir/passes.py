@@ -202,6 +202,181 @@ class DeleteQuantDequantPass(Pass):
         return changed
 
 
+_AFFINE_BYTES_LIMIT = 1 << 22  # don't materialize collapsed consts > 4 MiB
+
+
+def _affine_step(name, operands, program):
+    """If op is elementwise {add,sub,mul,div} with exactly one constant
+    operand, return (data_value, m, b) describing y = m*x + b where x is
+    the non-const operand; else None. `div` only folds a constant divisor;
+    `sub` handles the constant on either side."""
+    if name not in ("pd.add", "pd.sub", "pd.mul", "pd.div") or len(operands) != 2:
+        return None
+    a, b_ = operands
+    ca, cb = _const_value(program, a), _const_value(program, b_)
+    if (ca is None) == (cb is None):
+        return None  # exactly one constant operand
+    const = np.asarray(ca if ca is not None else cb)
+    if not np.issubdtype(const.dtype, np.floating):
+        return None
+    data = b_ if ca is not None else a
+    if name == "pd.add":
+        return data, 1.0, const
+    if name == "pd.mul":
+        return data, const, 0.0
+    if name == "pd.sub":
+        if cb is not None:
+            return data, 1.0, -const        # x - C
+        return data, -1.0, const            # C - x
+    if cb is not None:                       # x / C
+        return data, 1.0 / const, 0.0
+    return None                              # C / x is not affine
+
+
+@register_pass
+class AffineChainCollapsePass(Pass):
+    """Collapse chains of elementwise ops with constant operands into one
+    mul + one add (simplify_with_basic_ops / the arithmetic half of
+    conv_bn_fuse_pass.cc): eval-mode BatchNorm traces to
+    sub(mean)->mul(rsqrt)->mul(gamma)->add(beta) over the conv output; this
+    rewrites the whole chain to y = M*x + B with M, B precomputed on host.
+
+    Rewrite is by operand surgery on ops already in the chain (the IR has no
+    op-reordering): one existing pd.mul becomes the M stage, the chain's
+    last op becomes the B stage, everything between goes dead for DCE."""
+
+    name = "affine_chain_collapse"
+
+    def run(self, program: Program) -> int:
+        changed = 0
+        for last in program.ops():
+            step = _affine_step(last.name, last.operands, program)
+            if step is None:
+                continue
+            rtype = last.result(0).type
+            # walk upward while ops stay affine, single-use, same-typed
+            chain = [last]
+            data, m, b = step
+            while True:
+                up = data.defining_op()
+                if up is None or up.result(0).num_uses != 1:
+                    break
+                s = _affine_step(up.name, up.operands, program)
+                if s is None or up.result(0).type != rtype:
+                    break
+                d2, m2, b2 = s
+                # compose: y = m*(m2*x + b2) + b
+                data, m, b = d2, np.asarray(m) * m2, np.asarray(m) * b2 + b
+                chain.append(up)
+            if len(chain) < 3:
+                continue  # 1-2 ops are already minimal
+            m, b = np.asarray(m), np.asarray(b)
+            if m.nbytes > _AFFINE_BYTES_LIMIT or b.nbytes > _AFFINE_BYTES_LIMIT:
+                continue
+            mul_stage = next((op for op in chain if op.name == "pd.mul"), None)
+            if mul_stage is None or last.name not in ("pd.add", "pd.sub"):
+                continue  # need a mul to repurpose and an additive tail
+            dtype = rtype.dtype if hasattr(rtype, "dtype") else m.dtype
+            m_c = program.add_constant(m.astype(np.dtype(str(dtype)), copy=False))
+            # B stage keeps `last`'s own opcode: add gets +B, sub gets -B
+            b_v = b if last.name == "pd.add" else -b
+            b_c = program.add_constant(b_v.astype(np.dtype(str(dtype)), copy=False))
+            mul_stage.set_operand(0, data)
+            mul_stage.set_operand(1, m_c.result(0))
+            last.set_operand(0, mul_stage.result(0))
+            last.set_operand(1, b_c.result(0))
+            changed += 1
+        if changed:
+            program.dce()  # the bypassed chain interior is now dead
+        return changed
+
+
+@register_pass
+class ConvBnFusePass(Pass):
+    """Fold a per-output-channel constant scale into conv / matmul weights
+    (conv_bn_fuse_pass.cc, conv_affine_channel_fuse_pass.cc, fc_fuse): after
+    AffineChainCollapse the eval-BN residue is mul(conv(x, W), M) + add(B);
+    when W is a baked constant (inference trace) the mul disappears into W,
+    leaving conv + bias-add — the classic fused form."""
+
+    name = "conv_bn_fuse"
+
+    @staticmethod
+    def _channel_vector(scale: np.ndarray, ch_dim: int, full_shape):
+        """scale must be constant along every dim except `ch_dim` of the
+        producing op's output; returns the length-C vector or None."""
+        # right-align scale's shape against the output shape
+        pad = len(full_shape) - len(scale.shape)
+        if pad < 0:
+            return None
+        aligned = [1] * pad + list(scale.shape)
+        for d, n in enumerate(aligned):
+            if d != ch_dim and n != 1:
+                return None
+        if aligned[ch_dim] not in (1, full_shape[ch_dim]):
+            return None
+        if aligned[ch_dim] == 1:
+            return np.asarray(
+                np.broadcast_to(scale.reshape(-1)[:1], (full_shape[ch_dim],)))
+        return np.asarray(np.broadcast_to(scale, aligned).reshape(-1))
+
+    def run(self, program: Program) -> int:
+        changed = 0
+        for op in program.ops():
+            if op.name != "pd.mul" or len(op.operands) != 2:
+                continue
+            prod_v, scale_v = op.operands
+            scale = _const_value(program, scale_v)
+            if scale is None:
+                scale, prod_v = _const_value(program, prod_v), scale_v
+            if scale is None:
+                continue
+            scale = np.asarray(scale)
+            if not np.issubdtype(scale.dtype, np.floating):
+                continue
+            prod = prod_v.defining_op()
+            if prod is None or prod.result(0).num_uses != 1 \
+                    or prod.id not in program.op_bind:
+                continue
+            prim, params = program.op_bind[prod.id]
+            out_shape = prod.result(0).type.shape
+            if prod.name == "pd.conv_general_dilated":
+                dn = params.get("dimension_numbers")
+                if dn is None:
+                    continue
+                ch_dim, w_out_dim = dn.out_spec[1], dn.rhs_spec[0]
+                w_idx = 1
+            elif prod.name == "pd.dot_general":
+                ((lc, rc), (lb, rb)) = params.get("dimension_numbers")
+                if list(lb) or list(rb) or len(rc) != 1:
+                    continue
+                ch_dim = len(out_shape) - 1  # plain x @ W: out channel last
+                w_rank = len(prod.operands[1].type.shape)
+                # out dims are lhs-free then rhs-free IN ORDER, so the last
+                # output dim maps to the LAST non-contracted rhs dim
+                w_out_dim = max(d for d in range(w_rank) if d != rc[0])
+                w_idx = 1
+            else:
+                continue
+            W = _const_value(program, prod.operands[w_idx])
+            if W is None:
+                continue
+            vec = self._channel_vector(scale, ch_dim, out_shape)
+            if vec is None:
+                continue
+            W = np.asarray(W)
+            bshape = [1] * W.ndim
+            bshape[w_out_dim] = W.shape[w_out_dim]
+            if W.shape[w_out_dim] != vec.shape[0]:
+                continue
+            newW = (W * vec.reshape(bshape)).astype(W.dtype, copy=False)
+            prod.set_operand(w_idx, program.add_constant(newW).result(0))
+            op.result(0).replace_all_uses_with(prod.result(0))
+            op.erase()
+            changed += 1
+        return changed
+
+
 @register_pass
 class DropoutEliminatePass(Pass):
     """Inference-only: pd.dropout → identity (delete_dropout_op_pass analog).

@@ -172,11 +172,24 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
     return Tensor(bx.todense().sum(axis=axis, keepdims=keepdim))
 
 
+def _map_values(x, fn):
+    """Apply fn over the nonzero values only, preserving the pattern."""
+    bx = _bcoo(x)
+    return SparseCooTensor(jsparse.BCOO((fn(bx.data), bx.indices), shape=bx.shape))
+
+
 class _SparseNNFunctional:
     @staticmethod
     def relu(x):
-        bx = _bcoo(x)
-        return SparseCooTensor(jsparse.BCOO((jnp.maximum(bx.data, 0), bx.indices), shape=bx.shape))
+        return _map_values(x, jax.nn.relu)
+
+    @staticmethod
+    def leaky_relu(x, negative_slope=0.01):
+        return _map_values(x, lambda v: jax.nn.leaky_relu(v, negative_slope))
+
+    @staticmethod
+    def relu6(x):
+        return _map_values(x, jax.nn.relu6)
 
     @staticmethod
     def softmax(x, axis=-1):
@@ -292,6 +305,19 @@ class _ReLULayer(_SparseLayerBase):
         return _SparseNNFunctional.relu(x)
 
 
+class _LeakyReLULayer(_SparseLayerBase):
+    def __init__(self, negative_slope=0.01):
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return _SparseNNFunctional.leaky_relu(x, self.negative_slope)
+
+
+class _ReLU6Layer(_SparseLayerBase):
+    def forward(self, x):
+        return _SparseNNFunctional.relu6(x)
+
+
 class _SoftmaxLayer(_SparseLayerBase):
     def __init__(self, axis=-1):
         self.axis = axis
@@ -360,11 +386,17 @@ class _BatchNormLayer(_SparseLayerBase):
 class _SparseNN:
     functional = _SparseNNFunctionalFull()
     ReLU = _ReLULayer
+    LeakyReLU = _LeakyReLULayer
+    ReLU6 = _ReLU6Layer
     Softmax = _SoftmaxLayer
     Conv3D = _Conv3DLayer
     SubmConv3D = _SubmConv3DLayer
     MaxPool3D = _MaxPool3DLayer
     BatchNorm = _BatchNormLayer
+    # single-process analog: per-device stats ARE the global stats under
+    # SPMD (XLA all-reduces batch moments inside the jitted step), matching
+    # reference sparse/nn/layer/norm.py SyncBatchNorm semantics on TPU
+    SyncBatchNorm = _BatchNormLayer
 
 
 nn = _SparseNN()

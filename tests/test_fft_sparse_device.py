@@ -222,3 +222,20 @@ def test_sparse_max_pool_keeps_negative_actives():
     y = sp.nn.MaxPool3D(2)(vx)
     np.testing.assert_allclose(np.asarray(y.to_dense().numpy()).reshape(-1),
                                [-2.0])
+
+
+def test_sparse_leaky_relu_relu6_pattern_preserving():
+    """leaky_relu/relu6 map over nonzero values only (reference
+    sparse/nn/functional/activation.py), as functionals and layers."""
+    import paddle_tpu.sparse as sp
+
+    idx = np.array([[0, 1, 2], [0, 1, 0]])
+    vals = np.array([-4.0, 2.0, 9.0], np.float32)
+    x = sp.sparse_coo_tensor(idx, vals, shape=[3, 2])
+    lr = sp.nn.functional.leaky_relu(x, 0.1)
+    np.testing.assert_allclose(np.asarray(lr._bcoo.data), [-0.4, 2.0, 9.0], rtol=1e-6)
+    r6 = sp.nn.ReLU6()(x)
+    np.testing.assert_allclose(np.asarray(r6._bcoo.data), [0.0, 2.0, 6.0])
+    np.testing.assert_allclose(np.asarray(sp.nn.LeakyReLU(0.1)(x)._bcoo.data),
+                               np.asarray(lr._bcoo.data))
+    assert sp.nn.SyncBatchNorm is not None

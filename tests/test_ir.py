@@ -408,3 +408,108 @@ class TestDeleteQuantDequant:
         from paddle_tpu.ir.pass_manager import INFERENCE_PIPELINE
 
         assert "delete_quant_dequant" in INFERENCE_PIPELINE
+
+
+class TestConvBnFuse:
+    """conv_bn_fuse_pass.cc / conv_affine_channel_fuse_pass.cc analogs: the
+    eval-BN constant chain collapses to mul+add and the per-channel scale
+    disappears into the conv (or matmul) weights."""
+
+    def _fused(self, net, x):
+        from paddle_tpu import ir
+        from paddle_tpu.ir.pass_manager import INFERENCE_PIPELINE, PassManager
+
+        want = np.asarray(net(paddle_tpu.to_tensor(x))._value)
+        prog = ir.trace(lambda xv: net(paddle_tpu.to_tensor(xv))._value, x)
+        n0 = len(prog.ops())
+        stats = PassManager(INFERENCE_PIPELINE).run(prog)
+        got = np.asarray(prog.to_callable()(x))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        return prog, stats, n0
+
+    def _bn_with_stats(self, bn, c, seed):
+        rs = np.random.RandomState(seed)
+        bn.weight.set_value(rs.rand(c).astype("float32") + 0.5)
+        bn.bias.set_value(rs.randn(c).astype("float32"))
+        bn._mean.set_value(rs.randn(c).astype("float32"))
+        bn._variance.set_value(rs.rand(c).astype("float32") + 0.3)
+
+    def test_conv_bn_chain_fully_fused(self):
+        paddle_tpu.seed(0)
+        net = paddle_tpu.nn.Sequential(
+            paddle_tpu.nn.Conv2D(3, 8, 3, padding=1),
+            paddle_tpu.nn.BatchNorm2D(8),
+            paddle_tpu.nn.ReLU(),
+        )
+        net.eval()
+        self._bn_with_stats(net[1], 8, 1)
+        x = np.random.RandomState(2).randn(2, 3, 8, 8).astype("float32")
+        prog, stats, n0 = self._fused(net, x)
+        assert stats["affine_chain_collapse"] >= 1, stats
+        assert stats["conv_bn_fuse"] >= 1, stats
+        # the BN arithmetic is gone: no mul survives on the conv output
+        assert not any(op.name == "pd.mul" for op in prog.ops())
+        assert len(prog.ops()) < n0
+
+    def test_linear_scale_folds_into_matmul(self):
+        paddle_tpu.seed(0)
+        net = paddle_tpu.nn.Sequential(
+            paddle_tpu.nn.Linear(6, 5),
+            paddle_tpu.nn.BatchNorm1D(5),
+        )
+        net.eval()
+        self._bn_with_stats(net[1], 5, 3)
+        x = np.random.RandomState(4).randn(4, 6).astype("float32")
+        prog, stats, _ = self._fused(net, x)
+        assert stats["conv_bn_fuse"] >= 1, stats
+        assert not any(op.name == "pd.mul" for op in prog.ops())
+
+    def test_affine_collapse_skips_multi_use(self):
+        """A chain whose intermediate feeds two consumers must NOT collapse
+        through the shared node."""
+        from paddle_tpu import ir
+        from paddle_tpu.ir.pass_manager import PassManager
+
+        import jax.numpy as jnp
+
+        c1 = np.float32(2.0)
+
+        def f(xv):
+            t = xv * c1          # shared
+            return (t + 1.0) * 3.0 + t.sum()
+
+        x = np.random.RandomState(0).randn(4, 4).astype("float32")
+        prog = ir.trace(f, x)
+        want = np.asarray(f(jnp.asarray(x)))
+        PassManager(["constant_folding", "affine_chain_collapse", "cse", "dce"]).run(prog)
+        got = np.asarray(prog.to_callable()(x))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_in_inference_pipeline(self):
+        from paddle_tpu.ir.pass_manager import INFERENCE_PIPELINE
+
+        assert "affine_chain_collapse" in INFERENCE_PIPELINE
+        assert "conv_bn_fuse" in INFERENCE_PIPELINE
+
+    def test_rank3_dot_general_scales_last_free_dim(self):
+        """Review regression: einsum('bi,ijk->bjk') with equal free dims —
+        the per-channel scale must fold into W's LAST free dim, not the
+        first (which only coincidentally passes the shape guard)."""
+        import jax.numpy as jnp
+
+        from paddle_tpu import ir
+        from paddle_tpu.ir.pass_manager import INFERENCE_PIPELINE, PassManager
+
+        rs = np.random.RandomState(0)
+        W = jnp.asarray(rs.randn(6, 5, 5).astype(np.float32))
+        c = jnp.asarray(rs.rand(1, 1, 5).astype(np.float32) + 0.5)
+
+        def f(xv):
+            return jnp.einsum("bi,ijk->bjk", xv, W) * c
+
+        x = rs.randn(4, 6).astype(np.float32)
+        want = np.asarray(f(jnp.asarray(x)))
+        prog = ir.trace(f, x)
+        PassManager(INFERENCE_PIPELINE).run(prog)
+        got = np.asarray(prog.to_callable()(x))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
