@@ -348,9 +348,12 @@ def bench_gpt_dp():
     on_tpu = _on_tpu()
     paddle.seed(0)
     if on_tpu:
+        # sweep-found point: full per-block remat keeps activations at one
+        # block-input per layer, so batch (not remat interval) is the free
+        # variable — B=16 saturates; B=24 OOMs, B=20 plateaus
         cfg = GPTConfig(**{**GPT3_1p3B, "dropout": 0.0, "use_recompute": True,
                            "recompute_interval": 1, "loss_chunk": 128})
-        bsz, seq, iters = 4, 2048, 8
+        bsz, seq, iters = 16, 2048, 6
     else:
         cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
                         num_heads=4, max_seq_len=64, dropout=0.0)

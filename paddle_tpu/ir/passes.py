@@ -352,6 +352,8 @@ class ConvBnFusePass(Pass):
                     continue
                 ch_dim = len(out_shape) - 1  # plain x @ W: out channel last
                 w_rank = len(prod.operands[1].type.shape)
+                if w_rank < 2:
+                    continue  # matvec rhs has no free dim to scale
                 # out dims are lhs-free then rhs-free IN ORDER, so the last
                 # output dim maps to the LAST non-contracted rhs dim
                 w_out_dim = max(d for d in range(w_rank) if d != rc[0])

@@ -25,6 +25,7 @@ from .quanters import (  # noqa: F401
     FakeQuanterWithAbsMaxObserver,
     FakeQuanterWithAbsMaxObserverLayer,
 )
+from .int8_inference import Int8Linear, to_int8_inference
 from .wrapper import ObserveWrapper, QuantedConv2D, QuantedLinear
 
 

@@ -17,6 +17,8 @@ EXAMPLES = [
     "moe_hybrid_parallel.py",
     "long_context_hybrid.py",
     "gpt_moe_fleet.py",
+    "recognize_digits.py",
+    "word2vec.py",
 ]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -513,3 +513,23 @@ class TestConvBnFuse:
         PassManager(INFERENCE_PIPELINE).run(prog)
         got = np.asarray(prog.to_callable()(x))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_matvec_scale_does_not_crash_pipeline(self):
+        """Review regression: (x @ v) * c with a rank-1 rhs must pass
+        through the pipeline untouched, not crash conv_bn_fuse."""
+        import jax.numpy as jnp
+
+        from paddle_tpu import ir
+        from paddle_tpu.ir.pass_manager import INFERENCE_PIPELINE, PassManager
+
+        rs = np.random.RandomState(0)
+        v = jnp.asarray(rs.randn(6).astype(np.float32))
+
+        def f(xv):
+            return (xv @ v) * np.float32(2.0)
+
+        x = rs.randn(4, 6).astype(np.float32)
+        want = np.asarray(f(jnp.asarray(x)))
+        prog = ir.trace(f, x)
+        PassManager(INFERENCE_PIPELINE).run(prog)
+        np.testing.assert_allclose(np.asarray(prog.to_callable()(x)), want, rtol=1e-5)

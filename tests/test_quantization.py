@@ -10,6 +10,7 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.quantization import (
     QAT,
     PTQ,
@@ -152,3 +153,73 @@ def test_quanted_conv2d():
     assert isinstance(q.conv, QuantedConv2D)
     x = paddle.to_tensor(np.random.default_rng(4).normal(size=(2, 3, 8, 8)).astype(np.float32))
     assert q(x).shape == [2, 8, 8, 8]
+
+
+def test_int8_inference_path():
+    """to_int8_inference swaps frozen layers for Int8Linear: the int8
+    payload is EXECUTED (int8 x int8 -> int32 dot), output tracks the
+    dequantized-float path within dynamic-quant tolerance, and the layer
+    jits + survives the predictor export path."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.quantization import Int8Linear, to_int8_inference
+
+    cfg = QuantConfig(
+        activation=FakeQuanterWithAbsMaxObserver(),
+        weight=FakeQuanterWithAbsMaxObserver(),
+    )
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16)
+            self.act = nn.ReLU()
+            self.fc2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    paddle.seed(0)
+    net = Net()
+    q = QAT(cfg).quantize(net, inplace=False)
+    x = paddle.to_tensor(np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32))
+    _ = q(x)  # observe
+    frozen = QAT(cfg).convert(q, inplace=False)
+    want = np.asarray(frozen(x)._value)
+
+    served = to_int8_inference(frozen, inplace=False)
+    assert isinstance(served.fc1, Int8Linear) and isinstance(served.fc2, Int8Linear)
+    assert served.fc1._wq.dtype == jnp.int8
+    got = np.asarray(served(x)._value)
+    # dynamic per-tensor act quant adds ~1/127-scale noise on top of the
+    # fake-quant reference
+    np.testing.assert_allclose(got, want, rtol=0.1, atol=0.15)
+    assert np.abs(got - want).mean() < 0.05
+
+    # the int8 dot is real: jaxpr holds an int8->int32 dot_general
+    jaxpr = jax.make_jaxpr(lambda xv: served.fc1(Tensor(xv))._value)(x._value)
+    dots = [e for e in jaxpr.jaxpr.eqns if e.primitive.name == "dot_general"]
+    assert dots and dots[0].params["preferred_element_type"] == jnp.int32
+
+    # jits clean (serving is a compiled path)
+    f = jax.jit(lambda xv: served(Tensor(xv))._value)
+    np.testing.assert_allclose(np.asarray(f(x._value)), got, rtol=1e-5, atol=1e-6)
+
+
+def test_int8_inference_rejects_per_in_channel_scales():
+    """Review regression: per-in-channel scales can't fold after the
+    contraction — Int8Linear refuses them and to_int8_inference keeps the
+    float path."""
+    from paddle_tpu.quantization import Int8Linear, to_int8_inference
+
+    w = np.random.default_rng(0).integers(-100, 100, size=(8, 16)).astype(np.int8)
+    with pytest.raises(ValueError):
+        Int8Linear(w, np.ones(8, np.float32))  # 8 = in_features, not out
+
+    lin = nn.Linear(8, 16)
+    lin._quant_weight_int8 = w
+    lin._quant_scales = np.ones(8, np.float32)
+    host = nn.Sequential(lin)
+    served = to_int8_inference(host, inplace=False)
+    assert isinstance(served[0], nn.Linear)  # unchanged: float path kept
