@@ -123,7 +123,10 @@ def _fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int):
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            # the 2048x1024 fp32 score tile + bf16 p + double-buffered K/V
+            # brush past the 16 MiB default scoped-vmem cap; v5e has 128 MiB
+            vmem_limit_bytes=64 * 1024 * 1024),
         interpret=_interpret(),
     )(qt, kt, vt)
     return o, lse[..., 0], (qt, kt, vt)
@@ -274,29 +277,38 @@ def _bwd(causal, scale, block_q, block_k, res, g):
     return unfold(dq, B, H), unfold(dk, B, H), unfold(dv, B, H)
 
 
-def _pick_blocks(S: int):
-    # measured on v5e (S=1024, D=128): (1024,1024) beats (512,512) by ~29% —
-    # fewer grid steps amortize the per-block epilogue; fp32 score tile
-    # (1024x1024x4B = 4 MiB) still fits VMEM. Autotune refines per-shape.
-    for b in (1024, 512, 256, 128, 64, 32, 16, 8):
-        if S % b == 0:
-            return min(b, S), min(b, S)
-    return None, None
+def _pick_blocks(S: int, role: str = "fwd"):
+    # measured on v5e (D=128): bigger blocks win — fewer grid steps amortize
+    # the per-block epilogue. S=1024: (1024,1024) beats (512,512) by ~29%;
+    # S=4096: fwd (2048,1024) beats (1024,1024) by ~18% (the fp32 score
+    # tile 2048x1024x4B = 8 MiB still fits VMEM). The BACKWARD kernels hold
+    # two score-sized tiles (p and the ds/dp chain), so bq caps at 1024
+    # there — fwd/bwd block choices are independent (residuals are full
+    # [BH, S, D] arrays; only the block-free lse layout is shared).
+    bq_cap = 2048 if role == "fwd" else 1024
+    bq = next((b for b in (bq_cap, 1024, 512, 256, 128, 64, 32, 16, 8)
+               if b <= bq_cap and S % b == 0), None)
+    bk = next((b for b in (1024, 512, 256, 128, 64, 32, 16, 8)
+               if S % b == 0), None)
+    if bq is None or bk is None:
+        return None, None
+    return min(bq, S), min(bk, S)
 
 
-def _select_blocks(BH: int, S: int, D: int, dtype, causal: bool):
+def _select_blocks(BH: int, S: int, D: int, dtype, causal: bool, role: str = "fwd"):
     """Heuristic default, upgraded by the autotune cache when tuning is on
     (phi/kernels/autotune AutoTuneBase::PickBestAlgorithm analog). Measured
-    configs are keyed by (BH, S, D, dtype, causal) and persist across runs;
-    fwd and bwd share the winning blocks so the saved residual layout
-    matches."""
+    configs are keyed by (BH, S, D, dtype, causal, role); fwd and bwd pick
+    independently."""
     from . import autotune
 
-    default = _pick_blocks(S)
+    default = _pick_blocks(S, role)
     if default[0] is None:
         return default
+    bq_cap = 2048 if role == "fwd" else 1024
     candidates = [(bq, bk)
-                  for bq in (1024, 512, 256, 128) if S % bq == 0
+                  for bq in (2048, 1024, 512, 256, 128)
+                  if bq <= bq_cap and S % bq == 0
                   for bk in (1024, 512, 256, 128) if S % bk == 0]
     if default not in candidates:
         # measurement must be able to pick (and so can only improve on) the
@@ -306,11 +318,22 @@ def _select_blocks(BH: int, S: int, D: int, dtype, causal: bool):
     def make_run(cfg):
         bq, bk = cfg
         q = jnp.zeros((BH, S, 1, D), dtype)
+        if role == "bwd":
+            # measure the kernels the pick actually configures: dq + dkv
+            qt = jnp.zeros((BH, S, D), dtype)
+            lse = jnp.zeros((BH, S), jnp.float32)
+
+            def bwd_fn(qt):
+                return _bwd(causal, 1.0, bq, bk,
+                            (qt, qt, qt, qt, lse), q)[0]
+
+            fn = jax.jit(bwd_fn)
+            return lambda: fn(qt)
         fn = jax.jit(lambda q: _fwd(q, q, q, causal, 1.0, bq, bk)[0])
         return lambda: fn(q)
 
     picked = autotune.pick_best(
-        "flash_attention", (BH, S, D, str(jnp.dtype(dtype)), bool(causal)),
+        "flash_attention", (BH, S, D, str(jnp.dtype(dtype)), bool(causal), role),
         candidates, make_run, default=default)
     return tuple(picked)
 
@@ -333,7 +356,7 @@ def _flash_fwd_rule(q, k, v, causal, scale):
 
 def _flash_bwd_rule(causal, scale, res, g):
     BH, S, D = res[0].shape
-    bq, bk = _select_blocks(BH, S, D, res[0].dtype, causal)
+    bq, bk = _select_blocks(BH, S, D, res[0].dtype, causal, role="bwd")
     return _bwd(causal, scale, bq, bk, res, g)
 
 

@@ -72,7 +72,7 @@ class Int8Linear(Layer):
         return Tensor(y.astype(dtype))
 
 
-def to_int8_inference(model: Layer, inplace: bool = True) -> Layer:
+def to_int8_inference(model: Layer, inplace: bool = False) -> Layer:
     """Swap frozen layers carrying `_quant_weight_int8` metadata for
     Int8Linear so serving executes the int8 payload. Conv payloads stay on
     the dequantized-float path (conv int8 needs im2col-side quant; the
@@ -90,8 +90,15 @@ def to_int8_inference(model: Layer, inplace: bool = True) -> Layer:
         if q is None or q.ndim != 2:
             return None
         s = np.asarray(layer._quant_scales).reshape(-1)
+        # per-channel scales must run along the OUT axis (weight [in, out] →
+        # axis 1): per-in-channel scales cannot fold after the contraction.
+        # The recorded axis makes this exact even for square layers, where
+        # the size check alone cannot tell the two apart.
+        axis = getattr(layer, "_quant_channel_axis", None)
+        if s.size > 1 and axis is not None and axis != 1:
+            return None  # keep the dequantized-float path
         if s.size not in (1, q.shape[1]):
-            return None  # per-in-channel scales: keep the dequantized-float path
+            return None
         bias = getattr(layer, "bias", None)
         return Int8Linear(q, layer._quant_scales,
                           None if bias is None else np.asarray(bias._value))

@@ -103,6 +103,11 @@ def _freeze(quanted):
     if q is not None:
         out._quant_weight_int8 = q.astype(np.int8)
         out._quant_scales = scales
+        # which weight axis the per-channel scales run along (None = scalar);
+        # int8 serving needs this to tell per-out from per-in channel scales
+        out._quant_channel_axis = (
+            getattr(wq, "channel_axis", -1) % w.ndim
+            if np.ndim(scales) > 0 and np.size(scales) > 1 else None)
     if quanted.activation_quanter is not None:
         out._quant_act_scale = quanted.activation_quanter.scales()
     return out

@@ -55,11 +55,13 @@ class TestPickBest:
 
     def test_enabled_measures_and_caches(self):
         autotune.enable_autotune()
-        autotune.set_config({"kernel": {"repeats": 1}})
+        # median-of-3 with a 50x gap: a single scheduler stall on a loaded
+        # xdist box cannot flip the winner (repeats=1 + 20x flaked)
+        autotune.set_config({"kernel": {"repeats": 3}})
         import time
 
         def make_run(cfg):
-            return lambda: time.sleep(0.002 if cfg == "slow" else 0.0001)
+            return lambda: time.sleep(0.05 if cfg == "slow" else 0.001)
 
         got = autotune.pick_best("k", (5,), ["slow", "fast"], make_run, default="slow")
         assert got == "fast"

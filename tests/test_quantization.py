@@ -223,3 +223,25 @@ def test_int8_inference_rejects_per_in_channel_scales():
     host = nn.Sequential(lin)
     served = to_int8_inference(host, inplace=False)
     assert isinstance(served[0], nn.Linear)  # unchanged: float path kept
+
+
+def test_int8_inference_rejects_square_per_in_channel():
+    """Review regression: on a SQUARE layer the scale-size check alone
+    can't tell per-in from per-out channel scales — the recorded
+    _quant_channel_axis must gate the swap."""
+    from paddle_tpu.quantization import to_int8_inference
+
+    w = np.random.default_rng(1).integers(-100, 100, size=(8, 8)).astype(np.int8)
+    lin = nn.Linear(8, 8)
+    lin._quant_weight_int8 = w
+    lin._quant_scales = np.ones(8, np.float32)
+    lin._quant_channel_axis = 0  # per-IN-channel
+    served = to_int8_inference(nn.Sequential(lin))
+    assert isinstance(served[0], nn.Linear)  # float path kept
+
+    lin2 = nn.Linear(8, 8)
+    lin2._quant_weight_int8 = w
+    lin2._quant_scales = np.ones(8, np.float32)
+    lin2._quant_channel_axis = 1  # per-OUT-channel: swap happens
+    served2 = to_int8_inference(nn.Sequential(lin2))
+    assert type(served2[0]).__name__ == "Int8Linear"
