@@ -235,7 +235,7 @@ class FusedMultiTransformer(Layer):
 
     def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
                  activation="gelu", normalize_before=True, num_layers=1,
-                 epsilon=1e-5, name=None):
+                 epsilon=1e-5, kv_num_heads=None, name=None):
         super().__init__()
         if embed_dim % num_heads:
             raise ValueError(f"embed_dim {embed_dim} % num_heads {num_heads}")
@@ -245,19 +245,26 @@ class FusedMultiTransformer(Layer):
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
+        # GQA serving: K/V carry kv_num_heads (< num_heads) — the KV cache
+        # shrinks by num_heads/kv_num_heads, the binding memory at long S
+        self.kv_num_heads = kv_num_heads if kv_num_heads is not None else num_heads
+        if num_heads % self.kv_num_heads:
+            raise ValueError(
+                f"num_heads {num_heads} % kv_num_heads {self.kv_num_heads}")
         self.dim_feedforward = dim_feedforward
         self.num_layers = num_layers
         self.epsilon = epsilon
         self._act = activation
         L, H, F_ = num_layers, embed_dim, dim_feedforward
+        qkv_out = (num_heads + 2 * self.kv_num_heads) * self.head_dim
         mk = self.create_parameter
         from ...nn import initializer as I
 
         ones, zeros = I.Constant(1.0), I.Constant(0.0)
         self.ln1_w = mk([L, H], default_initializer=ones)
         self.ln1_b = mk([L, H], default_initializer=zeros, is_bias=True)
-        self.qkv_w = mk([L, H, 3 * H])
-        self.qkv_b = mk([L, 3 * H], default_initializer=zeros, is_bias=True)
+        self.qkv_w = mk([L, H, qkv_out])
+        self.qkv_b = mk([L, qkv_out], default_initializer=zeros, is_bias=True)
         self.proj_w = mk([L, H, H])
         self.proj_b = mk([L, H], default_initializer=zeros, is_bias=True)
         self.ln2_w = mk([L, H], default_initializer=ones)
@@ -274,7 +281,7 @@ class FusedMultiTransformer(Layer):
 
         from ...core.tensor import Tensor
 
-        shape = (self.num_layers, batch_size, self.num_heads, max_seq_len, self.head_dim)
+        shape = (self.num_layers, batch_size, self.kv_num_heads, max_seq_len, self.head_dim)
         return Tensor(jnp.zeros(shape, dtype)), Tensor(jnp.zeros(shape, dtype))
 
     def forward(self, x, attn_mask=None, caches=None, time_step=None):
@@ -294,6 +301,8 @@ class FusedMultiTransformer(Layer):
                 "path); custom attn_mask is unsupported")
         x = as_tensor(x)
         nh, hd, eps, act_name = self.num_heads, self.head_dim, self.epsilon, self._act
+        nkv = self.kv_num_heads
+        rep = nh // nkv  # query heads per shared K/V head (1 = MHA)
 
         def ln(v, w, b):
             mu = v.mean(-1, keepdims=True)
@@ -309,11 +318,14 @@ class FusedMultiTransformer(Layer):
             (l1w, l1b, qkvw, qkvb, pw, pb, l2w, l2b, f1w, f1b, f2w, f2b) = p
             B, T = h.shape[0], h.shape[1]
             z = ln(h, l1w, l1b)
-            qkv = z @ qkvw + qkvb  # [B, T, 3H]
-            q, k, v = jnp.split(qkv, 3, axis=-1)
+            qkv = z @ qkvw + qkvb  # [B, T, (nh + 2*nkv)*hd]
+            q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
             q = q.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)  # [B, nh, T, hd]
-            k = k.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
-            v = v.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+            k = k.reshape(B, T, nkv, hd).transpose(0, 2, 1, 3)  # [B, nkv, T, hd]
+            v = v.reshape(B, T, nkv, hd).transpose(0, 2, 1, 3)
+            # caches store nkv heads (the GQA memory win); queries see the
+            # shared heads via a broadcast XLA keeps fused into the einsum
+            expand = (lambda t: jnp.repeat(t, rep, axis=1)) if rep > 1 else (lambda t: t)
             if k_layer is not None:
                 if step is not None:
                     # decode: write this token's K/V at `step`, attend prefix
@@ -323,19 +335,19 @@ class FusedMultiTransformer(Layer):
                     v_layer = lax.dynamic_update_slice(
                         v_layer, v, (zero, zero, step, zero))
                     S_max = k_layer.shape[2]
-                    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_layer,
+                    s = jnp.einsum("bhqd,bhkd->bhqk", q, expand(k_layer),
                                    preferred_element_type=jnp.float32) / jnp.sqrt(float(hd)).astype(jnp.float32)
                     pos = jnp.arange(S_max)
                     s = jnp.where(pos[None, None, None, :] <= step, s, -1e30)
                     o = jnp.einsum("bhqk,bhkd->bhqd",
-                                   jax.nn.softmax(s, -1).astype(v.dtype), v_layer)
+                                   jax.nn.softmax(s, -1).astype(v.dtype), expand(v_layer))
                 else:
                     # prefill: causal attention; caches filled with the prefix
                     k_layer = lax.dynamic_update_slice(k_layer, k, (0, 0, 0, 0))
                     v_layer = lax.dynamic_update_slice(v_layer, v, (0, 0, 0, 0))
-                    o = _causal_attn(q, k, v)
+                    o = _causal_attn(q, expand(k), expand(v))
             else:
-                o = _causal_attn(q, k, v)
+                o = _causal_attn(q, expand(k), expand(v))
             o = o.transpose(0, 2, 1, 3).reshape(B, T, nh * hd)
             h = h + (o @ pw + pb)
             z = ln(h, l2w, l2b)

@@ -324,8 +324,13 @@ def _select_blocks(BH: int, S: int, D: int, dtype, causal: bool, role: str = "fw
             lse = jnp.zeros((BH, S), jnp.float32)
 
             def bwd_fn(qt):
-                return _bwd(causal, 1.0, bq, bk,
-                            (qt, qt, qt, qt, lse), q)[0]
+                dq, dk, dv = _bwd(causal, 1.0, bq, bk,
+                                  (qt, qt, qt, qt, lse), q)
+                # consume all three grads so neither pallas_call is DCE'd —
+                # the pick must price dq AND dkv together
+                return (dq[0, 0, 0].astype(jnp.float32)
+                        + dk[0, 0, 0].astype(jnp.float32)
+                        + dv[0, 0, 0].astype(jnp.float32))
 
             fn = jax.jit(bwd_fn)
             return lambda: fn(qt)

@@ -42,6 +42,11 @@ class GPTConfig:
     hidden_size: int = 768
     num_layers: int = 12
     num_heads: int = 12
+    num_kv_heads: int = None  # grouped-query attention: K/V heads shared by
+    #                           num_heads/num_kv_heads query heads each
+    #                           (1 = MQA, None = full MHA). Shrinks the
+    #                           serving KV cache by the same ratio — a
+    #                           capability the reference snapshot lacks.
     max_seq_len: int = 1024
     intermediate_size: int = None
     dropout: float = 0.0
@@ -71,6 +76,10 @@ class GPTConfig:
             self.intermediate_size = 4 * self.hidden_size
         if self.hidden_size % self.num_heads:
             raise ValueError("hidden_size must divide num_heads")
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        if self.num_heads % self.num_kv_heads:
+            raise ValueError("num_heads must be a multiple of num_kv_heads")
 
     @property
     def head_dim(self):
@@ -110,7 +119,10 @@ class GPTAttention(Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
         self.cfg = cfg
-        self.qkv = ColumnParallelLinear(cfg.hidden_size, 3 * cfg.hidden_size, gather_output=False)
+        # GQA: the fused projection emits H query heads + 2*H_kv K/V heads
+        # (H_kv == H is plain MHA, the 3H layout)
+        qkv_out = (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
+        self.qkv = ColumnParallelLinear(cfg.hidden_size, qkv_out, gather_output=False)
         self.proj = RowParallelLinear(cfg.hidden_size, cfg.hidden_size, input_is_parallel=True)
         self.dropout = nn.Dropout(cfg.dropout)
 
@@ -120,13 +132,23 @@ class GPTAttention(Layer):
         from ..distributed.sharding_utils import ambient_axis_names
         from ..distributed.topology import get_hybrid_communicate_group
 
-        qkv = self.qkv(x)  # [B, S, 3H/mp] sharded on last dim
-        qkv = qkv.reshape([B, S, 3, cfg.num_heads, cfg.head_dim])
+        qkv = self.qkv(x)  # [B, S, (H + 2*Hkv)*D/mp] sharded on last dim
+        Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
         # heads over mp; seq stays sharded over sep when the axis is active
         # (gathering full-S here would defeat context parallelism's memory)
         seq_axis = "sep" if "sep" in ambient_axis_names() else None
-        qkv = maybe_shard(qkv, P(_batch_axes(), seq_axis, None, "mp", None))
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, S, H, D]
+        head_spec = P(_batch_axes(), seq_axis, "mp", None)
+        q = maybe_shard(qkv[:, :, :Hq * D].reshape([B, S, Hq, D]), head_spec)
+        k = qkv[:, :, Hq * D:(Hq + Hkv) * D].reshape([B, S, Hkv, D])
+        v = qkv[:, :, (Hq + Hkv) * D:].reshape([B, S, Hkv, D])
+        if Hkv != Hq:
+            # expand shared K/V heads to the query-head count — exact GQA
+            # semantics; XLA keeps the broadcast fused into the attention
+            rep = Hq // Hkv
+            k = k.repeat_interleave(rep, axis=2)
+            v = v.repeat_interleave(rep, axis=2)
+        k = maybe_shard(k, head_spec)
+        v = maybe_shard(v, head_spec)
         hcg = get_hybrid_communicate_group()
         sep = hcg.get_sep_parallel_world_size() if hcg is not None else 1
         # inside a region already manual over sep (the pipeline), x is a

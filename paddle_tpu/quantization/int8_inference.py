@@ -74,8 +74,10 @@ class Int8Linear(Layer):
 
 def to_int8_inference(model: Layer, inplace: bool = False) -> Layer:
     """Swap frozen layers carrying `_quant_weight_int8` metadata for
-    Int8Linear so serving executes the int8 payload. Conv payloads stay on
-    the dequantized-float path (conv int8 needs im2col-side quant; the
+    Int8Linear so serving executes the int8 payload. Copies by default
+    (package convention — QAT/PTQ convert do too); pass inplace=True to
+    mutate `model` and serve it directly. Conv payloads stay on the
+    dequantized-float path (conv int8 needs im2col-side quant; the
     bandwidth win there is the weight constant, which XLA already keeps
     int8 when small enough not to constant-fold)."""
     import copy

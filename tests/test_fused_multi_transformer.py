@@ -218,3 +218,33 @@ class TestIncubateFunctional:
         ms = (x.reshape(2, -1) ** 2).mean(-1, keepdims=True)
         ref = (x.reshape(2, -1) / np.sqrt(ms + 1e-6)).reshape(2, 3, 4)
         np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-5)
+
+
+def test_gqa_prefill_decode_small_cache():
+    """GQA serving: kv_num_heads=2 under 8 query heads — the cache carries
+    2 heads (4x smaller), prefill+decode matches the model's own full
+    forward on the grown prefix."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+    paddle.seed(0)
+    B, S, H, NH, NKV, L = 2, 8, 64, 8, 2, 2
+    m = FusedMultiTransformer(H, NH, 4 * H, num_layers=L, kv_num_heads=NKV)
+    m.eval()
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(B, S, H).astype("float32") * 0.1)
+    kc, vc = m.gen_cache(B, S + 2)
+    assert list(kc.shape) == [L, B, NKV, S + 2, H // NH]
+
+    out, (kc, vc) = m(x, caches=(kc, vc))
+    nxt = paddle.to_tensor(rs.randn(B, 1, H).astype("float32") * 0.1)
+    import jax.numpy as jnp
+
+    step = jnp.asarray(S, jnp.int32)
+    dec, _ = m(nxt, caches=(kc, vc), time_step=step)
+
+    full = m(paddle.to_tensor(jnp.concatenate(
+        [x._value, nxt._value], axis=1)))
+    np.testing.assert_allclose(np.asarray(dec._value[:, 0]),
+                               np.asarray(full._value[:, -1]),
+                               rtol=2e-4, atol=2e-5)
