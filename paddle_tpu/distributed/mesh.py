@@ -69,7 +69,10 @@ def init_distributed_runtime():
     same env contract as `paddle.distributed.launch` sets for the reference
     (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER).
     """
-    if int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1 and jax.process_count() == 1:
+    if int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1 \
+            and not jax.distributed.is_initialized():
+        # NOTE: the guard must not touch the XLA backend (jax.process_count()
+        # would initialize it, after which jax.distributed.initialize raises)
         coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
         if coord:
             jax.distributed.initialize(

@@ -1,0 +1,51 @@
+"""Worker for test_multiprocess.py — NOT a test module.
+
+Runs under a 2-process world wired by the parent (the reference's
+TestDistRunnerBase pattern, test_dist_base.py:90): env carries
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER, and
+init_parallel_env must bring up jax.distributed BEFORE the backend is
+touched, build the global mesh, and let a cross-process psum run over it.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu.distributed as dist
+
+
+def main():
+    env = dist.init_parallel_env()
+    n = int(os.environ["PADDLE_TRAINERS_NUM"])
+    assert jax.process_count() == n, jax.process_count()
+    assert jax.device_count() == n, jax.device_count()
+    assert env.world_size == n
+
+    from jax import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    rank = jax.process_index()
+    local = np.full((1, 4), float(rank + 1), np.float32)
+    ga = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), local)
+    f = jax.jit(shard_map(lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+                          in_specs=P("dp"), out_specs=P()))
+    out = f(ga)
+    val = float(np.asarray(out.addressable_shards[0].data).ravel()[0])
+    want = n * (n + 1) / 2
+    assert val == want, (val, want)
+    print(f"MULTIPROC_OK rank={rank} psum={val}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)
