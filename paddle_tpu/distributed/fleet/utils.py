@@ -15,6 +15,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...core import random as _random
@@ -179,6 +180,7 @@ class ShardedTrainStep:
         self.opt_state = jax.tree_util.tree_map(jax.device_put, opt_state0, s_shard)
 
         batch_sharding = NamedSharding(mesh, resolve_spec(batch_spec, mesh))
+        self._batch_sharding = batch_sharding
         clip = optimizer._grad_clip if isinstance(optimizer._grad_clip, ClipGradByGlobalNorm) else None
         clip_norm = clip.clip_norm if clip is not None else None
         loss_fn_ = self.loss_fn
@@ -395,6 +397,23 @@ class ShardedTrainStep:
 
         return pipe_loss
 
+    def _to_global_batch(self, a):
+        """Host array -> device batch. Single-controller: plain transfer.
+        Multi-process (real multi-host): the caller's array is its LOCAL
+        shard — each process loads its own slice of the global batch, the
+        multi-host data-loading contract — and the global array is
+        assembled across processes (hybrid_parallel_util broadcast analog,
+        inverted: data stays where it was loaded)."""
+        v = a._value if isinstance(a, Tensor) else a
+        if jax.process_count() > 1:
+            if isinstance(v, jax.Array) and len(v.sharding.device_set) > 1:
+                return v  # already assembled over the global mesh
+            # local numpy OR a single-device jax.Array (every eager Tensor
+            # holds one) — both are this process's local shard
+            return jax.make_array_from_process_local_data(
+                self._batch_sharding, np.asarray(v))
+        return jnp.asarray(v)
+
     def __call__(self, x, y, lr: Optional[float] = None):
         lr = self.optimizer.get_lr() if lr is None else lr
         self._step_i += 1
@@ -402,8 +421,8 @@ class ShardedTrainStep:
             self.params, self.opt_state, loss = self._compiled(
                 self.params,
                 self.opt_state,
-                jnp.asarray(x if not isinstance(x, Tensor) else x._value),
-                jnp.asarray(y if not isinstance(y, Tensor) else y._value),
+                self._to_global_batch(x),
+                self._to_global_batch(y),
                 jnp.float32(lr),
                 jnp.uint32(self._seed + self._step_i),
             )

@@ -1,0 +1,58 @@
+"""Worker for test_multiprocess.py::test_two_process_data_parallel_training.
+
+Each process owns one cpu device and loads ITS OWN half of the global batch
+(the multi-host data-loading contract); the sharded train step assembles the
+global batch across processes and runs dp=2 training. Losses printed by both
+ranks must equal the single-process full-batch run the parent computes.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    dist.init_parallel_env()
+    assert jax.process_count() == 2
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2}
+    fleet.init(is_collective=True, strategy=s)
+
+    paddle.seed(0)
+    m = gpt_tiny(dropout=0.0, num_layers=2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    st = make_sharded_train_step(m, opt)
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 128, size=(4, 16))  # the GLOBAL batch, same on each host
+    y = np.roll(x, -1, axis=1)
+    rank = jax.process_index()
+    x_local, y_local = x[rank * 2:(rank + 1) * 2], y[rank * 2:(rank + 1) * 2]
+
+    # step 1 feeds numpy, step 2 feeds eager Tensors — both are LOCAL shards
+    # and must take the cross-process assembly path (review regression: a
+    # Tensor's single-device jax.Array used to skip assembly)
+    losses = [float(st(x_local, y_local)),
+              float(st(paddle.to_tensor(x_local), paddle.to_tensor(y_local)))]
+    print(f"MP_TRAIN_OK rank={rank} losses={losses[0]:.6f},{losses[1]:.6f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

@@ -25,7 +25,7 @@ def _free_port() -> int:
     return port
 
 
-def _run_cluster(n: int, timeout: float = 240.0):
+def _run_cluster(n: int, timeout: float = 240.0, worker: str = WORKER):
     port = _free_port()
     procs = []
     try:
@@ -39,9 +39,16 @@ def _run_cluster(n: int, timeout: float = 240.0):
                 PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
             )
             procs.append(subprocess.Popen(
-                [sys.executable, WORKER], env=env, cwd=REPO,
+                [sys.executable, worker], env=env, cwd=REPO,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+        outs = []
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=timeout)[0])
+            except subprocess.TimeoutExpired:
+                # keep the hung rank's log for the assertion message
+                p.kill()
+                outs.append((p.communicate()[0] or "") + "\n<RANK TIMED OUT>")
         return procs, outs
     finally:
         # a rank that hung on rendezvous must not outlive the test
@@ -56,3 +63,46 @@ def test_two_process_psum_over_coordination_service():
     for r, (p, o) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{o[-3000:]}"
         assert f"MULTIPROC_OK rank={r} psum=3.0" in o, o[-1500:]
+
+
+def test_two_process_data_parallel_training():
+    """dp=2 across two real processes: each rank feeds its LOCAL half of the
+    global batch, the step assembles the global array, and per-step losses
+    equal the single-process full-batch run — multi-host training fidelity
+    (the reference's _run_cluster loss-comparison contract)."""
+    import re
+
+    import numpy as np
+
+    # single-process reference on the full batch
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import collective, fleet, mesh as pmesh, topology
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    collective.destroy_process_group()
+    pmesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 1}
+    fleet.init(is_collective=True, strategy=s)
+    paddle.seed(0)
+    m = gpt_tiny(dropout=0.0, num_layers=2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    st = make_sharded_train_step(m, opt)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 128, size=(4, 16))
+    y = np.roll(x, -1, axis=1)
+    want = [float(st(x, y)) for _ in range(2)]
+    collective.destroy_process_group()
+    pmesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+
+    procs, outs = _run_cluster(
+        2, worker=os.path.join(REPO, "tests", "mp_train_worker.py"))
+    for r, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{o[-3000:]}"
+        got = re.search(r"losses=([\d.]+),([\d.]+)", o)
+        assert got, o[-1500:]
+        np.testing.assert_allclose([float(got.group(1)), float(got.group(2))],
+                                   want, rtol=2e-4, atol=2e-5)
