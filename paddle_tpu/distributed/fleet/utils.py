@@ -15,7 +15,6 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...core import random as _random
@@ -406,12 +405,14 @@ class ShardedTrainStep:
         inverted: data stays where it was loaded)."""
         v = a._value if isinstance(a, Tensor) else a
         if jax.process_count() > 1:
-            if isinstance(v, jax.Array) and len(v.sharding.device_set) > 1:
+            if isinstance(v, jax.Array) and not v.is_fully_addressable:
                 return v  # already assembled over the global mesh
-            # local numpy OR a single-device jax.Array (every eager Tensor
-            # holds one) — both are this process's local shard
+            # local numpy OR a process-local jax.Array (every eager Tensor
+            # holds one) — both are this process's batch shard; passing the
+            # array through directly lets on-device data assemble without a
+            # host round-trip
             return jax.make_array_from_process_local_data(
-                self._batch_sharding, np.asarray(v))
+                self._batch_sharding, v)
         return jnp.asarray(v)
 
     def __call__(self, x, y, lr: Optional[float] = None):

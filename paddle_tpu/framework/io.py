@@ -106,17 +106,28 @@ def save_sharded(state: dict, directory: str):
 def load_sharded(directory: str, shardings: dict = None) -> dict:
     """Restore with optional resharding: pass {name: NamedSharding} to lay
     arrays out for a (possibly different) mesh — converter.py's reshard done
-    by device_put."""
+    at deserialization. Checkpoints written cooperatively by a multi-process
+    world restore fine on ANY topology (e.g. a single analysis process):
+    entries without a requested sharding materialize as host numpy."""
     import jax
+    import numpy as np
     import orbax.checkpoint as ocp
 
+    path = os.path.abspath(directory)
     ckptr = ocp.PyTreeCheckpointer()
-    restored = ckptr.restore(os.path.abspath(directory))
-    if shardings:
-        restored = {
-            k: (jax.device_put(v, shardings[k]) if k in shardings else v) for k, v in restored.items()
-        }
-    return restored
+    shardings = shardings or {}
+    meta = ckptr.metadata(path)
+    if hasattr(meta, "item_metadata"):  # orbax >= 0.5 StepMetadata
+        meta = meta.item_metadata
+    names = meta.keys() if hasattr(meta, "keys") else meta.tree.keys()
+    restore_args = {
+        k: (ocp.ArrayRestoreArgs(sharding=shardings[k]) if k in shardings
+            else ocp.RestoreArgs(restore_type=np.ndarray))
+        for k in names
+    }
+    # entries restored through ArrayRestoreArgs already carry the requested
+    # sharding; everything else is host numpy
+    return ckptr.restore(path, restore_args=restore_args)
 
 
 # ---- preemption-aware auto-checkpoint (SURVEY §5.3 TPU path) ----

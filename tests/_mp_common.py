@@ -1,0 +1,45 @@
+"""Shared setup for the multi-process worker scripts (NOT a test module).
+
+`build_step()` is the single source of the seed/model/optimizer/batch recipe
+— the parent's single-process reference and both workers must stay in
+lockstep for the loss/parameter equality assertions to mean anything.
+Workers import it AFTER pinning their 1-device CPU world."""
+
+import numpy as np
+
+
+def build_step():
+    """Tiny-GPT sharded train step + the GLOBAL batch (same on every host).
+    No distributed init — composes with whatever world is already up."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    paddle.seed(0)
+    m = gpt_tiny(dropout=0.0, num_layers=2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    st = make_sharded_train_step(m, opt)
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 128, size=(4, 16))
+    y = np.roll(x, -1, axis=1)
+    return st, x, y
+
+
+def setup_dp2_step():
+    """init the 2-process world; returns (step, x_local, y_local, rank)."""
+    import jax
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+
+    dist.init_parallel_env()
+    assert jax.process_count() == 2
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2}
+    fleet.init(is_collective=True, strategy=s)
+
+    st, x, y = build_step()
+    rank = jax.process_index()
+    return st, x[rank * 2:(rank + 1) * 2], y[rank * 2:(rank + 1) * 2], rank

@@ -1,0 +1,32 @@
+"""Worker for test_multiprocess.py::test_two_process_checkpoint_reshard.
+
+Both processes train one identical dp=2 step, then cooperatively write ONE
+sharded checkpoint (orbax/tensorstore multi-host write — the dist_save
+analog). The parent restores it single-process and compares parameters.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    from _mp_common import setup_dp2_step
+    from paddle_tpu.framework.io import save_sharded
+
+    out_dir = sys.argv[1]
+    st, x_local, y_local, rank = setup_dp2_step()
+    loss = float(st(x_local, y_local))
+    save_sharded(st.params, out_dir)  # collective across both processes
+    print(f"MP_CKPT_OK rank={rank} loss={loss:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

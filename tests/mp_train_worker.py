@@ -16,34 +16,12 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-import numpy as np
-
 
 def main():
     import paddle_tpu as paddle
-    import paddle_tpu.distributed as dist
-    from paddle_tpu.distributed import fleet
-    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
-    from paddle_tpu.models import gpt_tiny
+    from _mp_common import setup_dp2_step
 
-    dist.init_parallel_env()
-    assert jax.process_count() == 2
-
-    s = fleet.DistributedStrategy()
-    s.hybrid_configs = {"dp_degree": 2}
-    fleet.init(is_collective=True, strategy=s)
-
-    paddle.seed(0)
-    m = gpt_tiny(dropout=0.0, num_layers=2)
-    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
-    st = make_sharded_train_step(m, opt)
-
-    rng = np.random.RandomState(0)
-    x = rng.randint(0, 128, size=(4, 16))  # the GLOBAL batch, same on each host
-    y = np.roll(x, -1, axis=1)
-    rank = jax.process_index()
-    x_local, y_local = x[rank * 2:(rank + 1) * 2], y[rank * 2:(rank + 1) * 2]
-
+    st, x_local, y_local, rank = setup_dp2_step()
     # step 1 feeds numpy, step 2 feeds eager Tensors — both are LOCAL shards
     # and must take the cross-process assembly path (review regression: a
     # Tensor's single-device jax.Array used to skip assembly)

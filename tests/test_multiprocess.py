@@ -5,10 +5,13 @@ service (the TCPStore analog), and assert a cross-process collective.
 
 This is the piece the 8-virtual-device in-process mesh cannot cover: the
 coordinator bootstrap path (`init_distributed_runtime`), per-process global
-array assembly, and Gloo cross-host collectives.
+array assembly, Gloo cross-host collectives, and cooperative multi-host
+checkpoint writes.
 """
 
+import contextlib
 import os
+import time
 import socket
 import subprocess
 import sys
@@ -25,7 +28,8 @@ def _free_port() -> int:
     return port
 
 
-def _run_cluster(n: int, timeout: float = 240.0, worker: str = WORKER):
+def _run_cluster(n: int, timeout: float = 240.0, worker: str = WORKER,
+                 extra_args=None):
     port = _free_port()
     procs = []
     try:
@@ -39,12 +43,14 @@ def _run_cluster(n: int, timeout: float = 240.0, worker: str = WORKER):
                 PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
             )
             procs.append(subprocess.Popen(
-                [sys.executable, worker], env=env, cwd=REPO,
+                [sys.executable, worker, *(extra_args or [])], env=env, cwd=REPO,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
         outs = []
+        deadline = time.monotonic() + timeout  # one shared budget, not per rank
         for p in procs:
             try:
-                outs.append(p.communicate(timeout=timeout)[0])
+                outs.append(p.communicate(
+                    timeout=max(1.0, deadline - time.monotonic()))[0])
             except subprocess.TimeoutExpired:
                 # keep the hung rank's log for the assertion message
                 p.kill()
@@ -56,6 +62,36 @@ def _run_cluster(n: int, timeout: float = 240.0, worker: str = WORKER):
             if p.poll() is None:
                 p.kill()
                 p.wait()
+
+
+@contextlib.contextmanager
+def _single_process_world():
+    """Fresh in-process dp=1 fleet world, torn down even on assertion
+    failure (the new tests run in the shared pytest process)."""
+    from paddle_tpu.distributed import collective, fleet, mesh, topology
+
+    def reset():
+        collective.destroy_process_group()
+        mesh.reset_global_mesh()
+        topology.set_hybrid_communicate_group(None)
+
+    reset()
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 1}
+    fleet.init(is_collective=True, strategy=s)
+    try:
+        yield
+    finally:
+        reset()
+
+
+def _single_process_reference(steps: int):
+    """Single-process full-batch run — the SAME recipe the workers use
+    (_mp_common.build_step is the single source). Returns (losses, step)."""
+    from _mp_common import build_step
+
+    st, x, y = build_step()
+    return [float(st(x, y)) for _ in range(steps)], st
 
 
 def test_two_process_psum_over_coordination_service():
@@ -74,29 +110,8 @@ def test_two_process_data_parallel_training():
 
     import numpy as np
 
-    # single-process reference on the full batch
-    import paddle_tpu as paddle
-    from paddle_tpu.distributed import collective, fleet, mesh as pmesh, topology
-    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
-    from paddle_tpu.models import gpt_tiny
-
-    collective.destroy_process_group()
-    pmesh.reset_global_mesh()
-    topology.set_hybrid_communicate_group(None)
-    s = fleet.DistributedStrategy()
-    s.hybrid_configs = {"dp_degree": 1}
-    fleet.init(is_collective=True, strategy=s)
-    paddle.seed(0)
-    m = gpt_tiny(dropout=0.0, num_layers=2)
-    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
-    st = make_sharded_train_step(m, opt)
-    rng = np.random.RandomState(0)
-    x = rng.randint(0, 128, size=(4, 16))
-    y = np.roll(x, -1, axis=1)
-    want = [float(st(x, y)) for _ in range(2)]
-    collective.destroy_process_group()
-    pmesh.reset_global_mesh()
-    topology.set_hybrid_communicate_group(None)
+    with _single_process_world():
+        want, _ = _single_process_reference(steps=2)
 
     procs, outs = _run_cluster(
         2, worker=os.path.join(REPO, "tests", "mp_train_worker.py"))
@@ -106,3 +121,31 @@ def test_two_process_data_parallel_training():
         assert got, o[-1500:]
         np.testing.assert_allclose([float(got.group(1)), float(got.group(2))],
                                    want, rtol=2e-4, atol=2e-5)
+
+
+def test_two_process_checkpoint_reshard(tmp_path):
+    """Multi-host checkpointing (SURVEY §5.4): two processes cooperatively
+    write ONE sharded checkpoint through orbax/tensorstore after an
+    identical dp=2 step; a single process restores it onto its own mesh and
+    the parameters match a single-process run — the cross-topology
+    reshard-on-load contract (converter.py's job)."""
+    import numpy as np
+
+    from paddle_tpu.framework.io import load_sharded
+
+    ckpt = str(tmp_path / "mp_ckpt")
+    procs, outs = _run_cluster(
+        2, worker=os.path.join(REPO, "tests", "mp_ckpt_worker.py"),
+        extra_args=[ckpt])
+    for r, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{o[-3000:]}"
+        assert "MP_CKPT_OK" in o, o[-1500:]
+
+    with _single_process_world():
+        _, st = _single_process_reference(steps=1)
+        restored = load_sharded(ckpt)
+        for name in ("gpt.layers.0.attn.qkv.weight",
+                     "gpt.embeddings.word_embeddings.weight"):
+            np.testing.assert_allclose(np.asarray(restored[name]),
+                                       np.asarray(st.params[name]),
+                                       rtol=1e-5, atol=1e-6)
