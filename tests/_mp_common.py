@@ -26,8 +26,10 @@ def build_step():
     return st, x, y
 
 
-def setup_dp2_step():
-    """init the 2-process world; returns (step, x_local, y_local, rank)."""
+def setup_2proc_step(mode: str = "dp"):
+    """init the 2-process world with dp=2 or mp=2; returns
+    (step, x_local, y_local, rank). Under mp the batch is replicated (every
+    process feeds the full batch); under dp each rank feeds its half."""
     import jax
 
     import paddle_tpu.distributed as dist
@@ -36,10 +38,18 @@ def setup_dp2_step():
     dist.init_parallel_env()
     assert jax.process_count() == 2
 
+    assert mode in ("dp", "mp"), mode
     s = fleet.DistributedStrategy()
-    s.hybrid_configs = {"dp_degree": 2}
+    s.hybrid_configs = ({"dp_degree": 2} if mode == "dp"
+                        else {"dp_degree": 1, "mp_degree": 2})
     fleet.init(is_collective=True, strategy=s)
 
     st, x, y = build_step()
     rank = jax.process_index()
-    return st, x[rank * 2:(rank + 1) * 2], y[rank * 2:(rank + 1) * 2], rank
+    if mode == "dp":
+        return st, x[rank * 2:(rank + 1) * 2], y[rank * 2:(rank + 1) * 2], rank
+    return st, x, y, rank
+
+
+def setup_dp2_step():
+    return setup_2proc_step("dp")

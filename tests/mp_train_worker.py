@@ -19,9 +19,10 @@ jax.config.update("jax_platforms", "cpu")
 
 def main():
     import paddle_tpu as paddle
-    from _mp_common import setup_dp2_step
+    from _mp_common import setup_2proc_step
 
-    st, x_local, y_local, rank = setup_dp2_step()
+    mode = sys.argv[1] if len(sys.argv) > 1 else "dp"
+    st, x_local, y_local, rank = setup_2proc_step(mode)
     # step 1 feeds numpy, step 2 feeds eager Tensors — both are LOCAL shards
     # and must take the cross-process assembly path (review regression: a
     # Tensor's single-device jax.Array used to skip assembly)

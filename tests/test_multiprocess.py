@@ -149,3 +149,25 @@ def test_two_process_checkpoint_reshard(tmp_path):
             np.testing.assert_allclose(np.asarray(restored[name]),
                                        np.asarray(st.params[name]),
                                        rtol=1e-5, atol=1e-6)
+
+
+def test_two_process_tensor_parallel_training():
+    """mp=2 across two real processes: ColumnParallel/RowParallel weights
+    shard ACROSS processes, so the compiled step's TP collectives ride the
+    cross-process transport; losses equal the single-process run."""
+    import re
+
+    import numpy as np
+
+    with _single_process_world():
+        want, _ = _single_process_reference(steps=2)
+
+    procs, outs = _run_cluster(
+        2, worker=os.path.join(REPO, "tests", "mp_train_worker.py"),
+        extra_args=["mp"])
+    for r, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{o[-3000:]}"
+        got = re.search(r"losses=([\d.]+),([\d.]+)", o)
+        assert got, o[-1500:]
+        np.testing.assert_allclose([float(got.group(1)), float(got.group(2))],
+                                   want, rtol=2e-4, atol=2e-5)
