@@ -106,6 +106,11 @@ def main():
             long_note += f", infer={_predictor_row():.0f} tok/s"
         except Exception:
             long_note += ", infer=failed"
+        try:
+            # the north-star config itself (BASELINE config 2), one chip
+            long_note += f", gpt1.3B_mfu={_gpt13b_mfu():.3f}"
+        except Exception:
+            long_note += ", gpt1.3B_mfu=failed"
 
     print(
         json.dumps(
@@ -152,6 +157,23 @@ def _long_context_row() -> float:
         loss = step(x, y)
     _ = float(loss)
     return bsz * seq * iters / (time.perf_counter() - t0)
+
+
+def _gpt13b_mfu() -> float:
+    """GPT-3 1.3B MFU on one chip — the north-star config (BASELINE config
+    2), folded into the headline artifact. Reuses bench_gpt_dp's recipe so
+    the two numbers cannot diverge."""
+    import gc
+    import io
+    import json
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench_gpt_dp()
+    row = json.loads(buf.getvalue().strip().splitlines()[-1])
+    gc.collect()
+    return float(row["mfu"])
 
 
 def _predictor_row() -> float:

@@ -1,9 +1,11 @@
-"""Worker for test_multiprocess.py::test_two_process_data_parallel_training.
+"""Worker for the multi-process training tests (dp and mp modes).
 
-Each process owns one cpu device and loads ITS OWN half of the global batch
-(the multi-host data-loading contract); the sharded train step assembles the
-global batch across processes and runs dp=2 training. Losses printed by both
-ranks must equal the single-process full-batch run the parent computes.
+argv[1] picks the topology: "dp" (default) — each process owns one cpu
+device and loads ITS OWN half of the global batch (the multi-host
+data-loading contract; the step assembles the global array across
+processes); "mp" — weights shard across the two processes and every rank
+feeds the replicated full batch. Losses printed by both ranks must equal
+the single-process full-batch run the parent computes.
 """
 
 import os
@@ -23,9 +25,10 @@ def main():
 
     mode = sys.argv[1] if len(sys.argv) > 1 else "dp"
     st, x_local, y_local, rank = setup_2proc_step(mode)
-    # step 1 feeds numpy, step 2 feeds eager Tensors — both are LOCAL shards
-    # and must take the cross-process assembly path (review regression: a
-    # Tensor's single-device jax.Array used to skip assembly)
+    # step 1 feeds numpy, step 2 feeds eager Tensors — under dp both are
+    # LOCAL shards and must take the cross-process assembly path (review
+    # regression: a Tensor's single-device jax.Array used to skip assembly);
+    # under mp the replicated batch goes through the same seam
     losses = [float(st(x_local, y_local)),
               float(st(paddle.to_tensor(x_local), paddle.to_tensor(y_local)))]
     print(f"MP_TRAIN_OK rank={rank} losses={losses[0]:.6f},{losses[1]:.6f}",
