@@ -165,13 +165,12 @@ def _gpt13b_mfu() -> float:
     the two numbers cannot diverge."""
     import gc
     import io
-    import json
     from contextlib import redirect_stdout
 
-    buf = io.StringIO()
-    with redirect_stdout(buf):
-        bench_gpt_dp()
-    row = json.loads(buf.getvalue().strip().splitlines()[-1])
+    # the redirect only upholds the one-JSON-line driver contract;
+    # bench_gpt_dp returns its row directly
+    with redirect_stdout(io.StringIO()):
+        row = bench_gpt_dp()
     gc.collect()
     return float(row["mfu"])
 
@@ -230,9 +229,9 @@ def _predictor_row() -> float:
     ih.copy_from_cpu(x)
     pred.run()
     fetch()  # warm (compile)
-    iters = 8
-    dt = float("inf")  # best-of-5 windows rides out tunnel RPC latency spikes
-    for _w in range(5):
+    iters = 24  # enough runs that single RPC bursts amortize inside a window
+    dt = float("inf")  # best-of-3 windows rides out tunnel latency spikes
+    for _w in range(3):
         t0 = time.perf_counter()
         for _ in range(iters):
             pred.run()

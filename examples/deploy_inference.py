@@ -18,6 +18,11 @@ def main():
     args = ap.parse_args()
     if args.smoke:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # a sitecustomize may pin an accelerator plugin at interpreter
+        # start; the config update is the authoritative override
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     import paddle_tpu as paddle
     from paddle_tpu import inference, jit, nn

@@ -31,6 +31,11 @@ def main():
     args = ap.parse_args()
     if args.smoke:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # a sitecustomize may pin an accelerator plugin at interpreter
+        # start; the config update is the authoritative override
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
         args.vocab, args.hidden, args.layers, args.heads = 256, 64, 2, 4
         args.seq, args.batch, args.steps = 32, 4, 3
 

@@ -21,6 +21,11 @@ def main():
     args = ap.parse_args()
     if args.smoke:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # a sitecustomize may pin an accelerator plugin at interpreter
+        # start; the config update is the authoritative override
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
         os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
     import jax
