@@ -26,30 +26,36 @@ def build_step():
     return st, x, y
 
 
-def setup_2proc_step(mode: str = "dp"):
-    """init the 2-process world with dp=2 or mp=2; returns
-    (step, x_local, y_local, rank). Under mp the batch is replicated (every
-    process feeds the full batch); under dp each rank feeds its half."""
+def setup_mp_world(mode: str = "dp"):
+    """init the multi-process world; returns (step, x_local, y_local, rank).
+
+    Modes: "dp" (2 procs, each feeds its half of the batch), "mp" (2 procs,
+    weights shard across processes, replicated batch), "dpmp" (4 procs,
+    dp=2 x mp=2 — each process feeds the half its dp coordinate owns)."""
     import jax
 
     import paddle_tpu.distributed as dist
     from paddle_tpu.distributed import fleet
 
+    assert mode in ("dp", "mp", "dpmp"), mode
     dist.init_parallel_env()
-    assert jax.process_count() == 2
+    assert jax.process_count() == (4 if mode == "dpmp" else 2)
 
-    assert mode in ("dp", "mp"), mode
     s = fleet.DistributedStrategy()
     s.hybrid_configs = ({"dp_degree": 2} if mode == "dp"
-                        else {"dp_degree": 1, "mp_degree": 2})
+                        else {"dp_degree": 1, "mp_degree": 2} if mode == "mp"
+                        else {"dp_degree": 2, "mp_degree": 2})
     fleet.init(is_collective=True, strategy=s)
 
     st, x, y = build_step()
     rank = jax.process_index()
-    if mode == "dp":
-        return st, x[rank * 2:(rank + 1) * 2], y[rank * 2:(rank + 1) * 2], rank
-    return st, x, y, rank
+    if mode == "mp":
+        return st, x, y, rank
+    # batch rows live on the dp coordinate: mesh (dp, mp) is row-major over
+    # the process-ordered device list, so dp_coord = rank // mp_degree
+    dpc = rank if mode == "dp" else rank // 2
+    return st, x[dpc * 2:(dpc + 1) * 2], y[dpc * 2:(dpc + 1) * 2], rank
 
 
 def setup_dp2_step():
-    return setup_2proc_step("dp")
+    return setup_mp_world("dp")

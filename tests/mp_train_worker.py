@@ -1,11 +1,12 @@
-"""Worker for the multi-process training tests (dp and mp modes).
+"""Worker for the multi-process training tests.
 
-argv[1] picks the topology: "dp" (default) — each process owns one cpu
-device and loads ITS OWN half of the global batch (the multi-host
-data-loading contract; the step assembles the global array across
-processes); "mp" — weights shard across the two processes and every rank
-feeds the replicated full batch. Losses printed by both ranks must equal
-the single-process full-batch run the parent computes.
+argv[1] picks the topology: "dp" (default), "mp", or "dpmp"
+(dp=2 x mp=2 over four processes). Under dp-bearing modes each process
+owns one cpu device and loads the batch half its dp coordinate owns (the
+multi-host data-loading contract; the step assembles the global array
+across processes); under "mp" weights shard across the two processes and
+every rank feeds the replicated full batch. Losses printed by every rank
+must equal the single-process full-batch run the parent computes.
 """
 
 import os
@@ -21,10 +22,10 @@ jax.config.update("jax_platforms", "cpu")
 
 def main():
     import paddle_tpu as paddle
-    from _mp_common import setup_2proc_step
+    from _mp_common import setup_mp_world
 
     mode = sys.argv[1] if len(sys.argv) > 1 else "dp"
-    st, x_local, y_local, rank = setup_2proc_step(mode)
+    st, x_local, y_local, rank = setup_mp_world(mode)
     # step 1 feeds numpy, step 2 feeds eager Tensors — under dp both are
     # LOCAL shards and must take the cross-process assembly path (review
     # regression: a Tensor's single-device jax.Array used to skip assembly);

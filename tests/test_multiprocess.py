@@ -94,6 +94,21 @@ def _single_process_reference(steps: int):
     return [float(st(x, y)) for _ in range(steps)], st
 
 
+def _assert_losses(procs, outs, want):
+    """Every rank exited clean and printed per-step losses matching the
+    single-process reference."""
+    import re
+
+    import numpy as np
+
+    for r, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{o[-3000:]}"
+        got = re.search(r"losses=([\d.]+),([\d.]+)", o)
+        assert got, o[-1500:]
+        np.testing.assert_allclose([float(got.group(1)), float(got.group(2))],
+                                   want, rtol=2e-4, atol=2e-5)
+
+
 def test_two_process_psum_over_coordination_service():
     procs, outs = _run_cluster(2)
     for r, (p, o) in enumerate(zip(procs, outs)):
@@ -106,21 +121,12 @@ def test_two_process_data_parallel_training():
     global batch, the step assembles the global array, and per-step losses
     equal the single-process full-batch run — multi-host training fidelity
     (the reference's _run_cluster loss-comparison contract)."""
-    import re
-
-    import numpy as np
-
     with _single_process_world():
         want, _ = _single_process_reference(steps=2)
 
     procs, outs = _run_cluster(
         2, worker=os.path.join(REPO, "tests", "mp_train_worker.py"))
-    for r, (p, o) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {r} failed:\n{o[-3000:]}"
-        got = re.search(r"losses=([\d.]+),([\d.]+)", o)
-        assert got, o[-1500:]
-        np.testing.assert_allclose([float(got.group(1)), float(got.group(2))],
-                                   want, rtol=2e-4, atol=2e-5)
+    _assert_losses(procs, outs, want)
 
 
 def test_two_process_checkpoint_reshard(tmp_path):
@@ -155,19 +161,24 @@ def test_two_process_tensor_parallel_training():
     """mp=2 across two real processes: ColumnParallel/RowParallel weights
     shard ACROSS processes, so the compiled step's TP collectives ride the
     cross-process transport; losses equal the single-process run."""
-    import re
-
-    import numpy as np
-
     with _single_process_world():
         want, _ = _single_process_reference(steps=2)
 
     procs, outs = _run_cluster(
         2, worker=os.path.join(REPO, "tests", "mp_train_worker.py"),
         extra_args=["mp"])
-    for r, (p, o) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {r} failed:\n{o[-3000:]}"
-        got = re.search(r"losses=([\d.]+),([\d.]+)", o)
-        assert got, o[-1500:]
-        np.testing.assert_allclose([float(got.group(1)), float(got.group(2))],
-                                   want, rtol=2e-4, atol=2e-5)
+    _assert_losses(procs, outs, want)
+
+
+def test_four_process_hybrid_dp_mp_training():
+    """dp=2 x mp=2 over FOUR real processes (one device each): batch rows
+    live on the dp coordinate, weights shard over mp across process
+    boundaries, and losses equal the single-process run — hybrid-parallel
+    multi-host fidelity."""
+    with _single_process_world():
+        want, _ = _single_process_reference(steps=2)
+
+    procs, outs = _run_cluster(
+        4, worker=os.path.join(REPO, "tests", "mp_train_worker.py"),
+        extra_args=["dpmp"], timeout=360.0)
+    _assert_losses(procs, outs, want)
