@@ -24,10 +24,18 @@ from jax.sharding import PartitionSpec as P
 from ....nn import functional as F
 from ....nn import initializer as I
 from ....nn.layer.layers import Layer
-from ...sharding_utils import annotate_parameter, maybe_shard
+from ...sharding_utils import UNCONSTRAINED, annotate_parameter, maybe_shard
 from ...topology import get_hybrid_communicate_group
 
 MP_AXIS = "mp"
+
+
+def _last_dim_mp(ndim: int) -> P:
+    """Constrain only the last dim to mp; every other dim is UNCONSTRAINED so
+    batch/seq sharding (dp, the ZeRO axis, sep) propagates through instead of
+    being forced replicated — a P(None, ..., 'mp') here would demand an
+    all-gather of the batch around every parallel linear."""
+    return P(*([UNCONSTRAINED] * (ndim - 1)), MP_AXIS)
 
 
 def _mp_world_size() -> int:
@@ -55,7 +63,9 @@ class VocabParallelEmbedding(Layer):
 
     def forward(self, x):
         out = F.embedding(x, self.weight)
-        return maybe_shard(out, P())  # output replicated across mp (post-psum)
+        # no constraint (P() is a maybe_shard no-op): the masked-psum over
+        # the vocab-sharded table resolves at first use via propagation
+        return maybe_shard(out, P())
 
 
 class ColumnParallelLinear(Layer):
@@ -94,8 +104,11 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
-            return maybe_shard(out, P())  # allgather over mp
-        return maybe_shard(out, P(*([None] * (len(out.shape) - 1) + [MP_AXIS])))
+            # no constraint: with W sharded P(None, 'mp') the output's mp
+            # sharding is resolved by its consumers — GSPMD all-gathers over
+            # mp at first replicated use (maybe_shard treats P() as a no-op)
+            return maybe_shard(out, P())
+        return maybe_shard(out, _last_dim_mp(len(out.shape)))
 
 
 class RowParallelLinear(Layer):
@@ -134,9 +147,11 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         if self.input_is_parallel:
-            x = maybe_shard(x, P(*([None] * (len(x.shape) - 1) + [MP_AXIS])))
+            x = maybe_shard(x, _last_dim_mp(len(x.shape)))
         out = F.linear(x, self.weight, self.bias)
-        return maybe_shard(out, P())  # psum over mp
+        # no constraint (P() is a maybe_shard no-op): the partial products
+        # over the mp-sharded contraction psum at first use via propagation
+        return maybe_shard(out, P())
 
 
 class ParallelCrossEntropy(Layer):
@@ -151,5 +166,5 @@ class ParallelCrossEntropy(Layer):
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        input = maybe_shard(input, P(*([None] * (len(input.shape) - 1) + [MP_AXIS])))
+        input = maybe_shard(input, _last_dim_mp(len(input.shape)))
         return F.cross_entropy(input, label, reduction="none", ignore_index=self.ignore_index)

@@ -37,7 +37,16 @@ def _first_divisible_dim(shape, degree: int) -> Optional[int]:
 
 
 def shard_spec_for(shape, degree: int, axis: str = SHARDING_AXIS) -> P:
-    """ZeRO-3 placement for one param: shard the first divisible dim."""
+    """ZeRO-3 placement for one param: shard the first divisible dim.
+
+    Vector params (biases, norm scales — O(d) memory next to the O(d^2)
+    matrices) stay replicated, the reference's segment_size / DeepSpeed
+    persistence-threshold behavior: sharding a [d] norm scale saves nothing
+    and its sharding would propagate into the elementwise ops against
+    batch-sharded activations, forcing a replicate-then-partition reshard
+    (the involuntary-full-rematerialization cliff)."""
+    if len(shape) < 2:
+        return P()
     dim = _first_divisible_dim(shape, degree)
     if dim is None:
         return P()

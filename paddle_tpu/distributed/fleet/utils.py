@@ -27,19 +27,15 @@ from ..sharding_utils import ambient_axis_names
 
 
 def resolve_spec(spec: Optional[P], mesh: Mesh) -> P:
-    """Drop spec axes the mesh doesn't have (mp spec on a dp-only mesh -> P())."""
+    """Drop spec axes the mesh doesn't have (mp spec on a dp-only mesh ->
+    P()). UNCONSTRAINED entries become None: this resolver feeds
+    NamedShardings (param/state placement), which must be fully specified."""
     if spec is None:
         return P()
-    names = set(mesh.axis_names)
-    out = []
-    for entry in spec:
-        if entry is None:
-            out.append(None)
-        elif isinstance(entry, tuple):
-            kept = tuple(a for a in entry if a in names)
-            out.append(kept if kept else None)
-        else:
-            out.append(entry if entry in names else None)
+    from ..sharding_utils import _resolve_ambient
+
+    resolved = _resolve_ambient(spec, mesh.axis_names)
+    out = [None if e is P.UNCONSTRAINED else e for e in resolved]
     while out and out[-1] is None:
         out.pop()
     return P(*out)
@@ -248,8 +244,42 @@ class ShardedTrainStep:
             inv = 1.0 / M_acc
             return l * inv, jax.tree_util.tree_map(lambda t: t * inv, g)
 
+        # Grad compute sharding = param storage sharding minus the ZeRO axis:
+        # under ZeRO-3 the stored param (hence, by propagation, its grad) is
+        # sharded over `sharding`, and letting that reach the weight-grad dot
+        # makes the partitioner reshard the ACTIVATION operand to match
+        # (involuntary full rematerialization). Constraining the grad to the
+        # compute spec keeps the dot local-partials + allreduce; the slice
+        # down to the storage shard happens at the optimizer update, exactly
+        # like ZeRO-1/2 grads (reference GroupShardedStage3's
+        # reduce-then-keep-own-slice, group_sharded_stage3.py:486).
+        zero_axis = getattr(optimizer, "_shard_state_axis", None) or "sharding"
+
+        def _strip_axis(spec: P, axis: str) -> P:
+            out = []
+            for e in spec:
+                if e == axis:
+                    out.append(None)
+                elif isinstance(e, tuple):
+                    kept = tuple(a for a in e if a != axis)
+                    out.append(kept if kept else None)
+                else:
+                    out.append(e)
+            while out and out[-1] is None:
+                out.pop()
+            return P(*out)
+
+        g_shard = {
+            name: NamedSharding(mesh, _strip_axis(s.spec, zero_axis))
+            for name, s in p_shard.items()
+        }
+
         def step(params, opt_state, x, y, lr, seed):
             loss, grads = value_and_grad_accum(params, x, y, seed)
+            grads = {
+                k: jax.lax.with_sharding_constraint(g, g_shard[k])
+                for k, g in grads.items()
+            }
             if clip_norm is not None:
                 gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree_util.tree_leaves(grads))
                 scale = clip_norm / jnp.maximum(jnp.sqrt(gsq), clip_norm)

@@ -24,14 +24,53 @@ def ambient_axis_names():
         return ()
 
 
+#: Per-dim "leave this dim's sharding to GSPMD propagation" marker. Layer
+#: code uses it for dims it has no opinion on (e.g. batch dims in mp-layer
+#: constraints) so a constraint on the last dim doesn't silently force the
+#: batch replicated — the transition the reference avoids with explicit
+#: reshard collectives (auto_parallel/reshard.py:1008).
+UNCONSTRAINED = P.UNCONSTRAINED
+
+DATA_AXES = ("dp", "sharding", "ep")
+
+
+def data_axes():
+    """Ambient mesh axes that carry the global batch on dim 0 — dp always,
+    plus the ZeRO axis (sharded optimizer ≡ data parallelism for activations)
+    and ep (expert parallelism rides the data axes for non-expert compute).
+    Order matches ShardedTrainStep's batch_spec so activation constraints
+    agree with the input sharding instead of forcing a reshard."""
+    names = set(ambient_axis_names())
+    return tuple(a for a in DATA_AXES if a in names)
+
+
 def _spec_axes(spec: P):
     axes = set()
     for entry in spec:
-        if entry is None:
+        if entry is None or entry is P.UNCONSTRAINED:
             continue
         for a in (entry if isinstance(entry, tuple) else (entry,)):
             axes.add(a)
     return axes
+
+
+def _resolve_ambient(spec: P, names) -> P:
+    """Drop spec axes the ambient mesh doesn't carry (a ('dp','sharding')
+    batch entry on a dp-only mesh resolves to ('dp',)) so one spec serves
+    every mesh shape; UNCONSTRAINED entries pass through."""
+    names = set(names)
+    out = []
+    for entry in spec:
+        if entry is None or entry is P.UNCONSTRAINED:
+            out.append(entry)
+        elif isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in names)
+            out.append(kept if kept else None)
+        else:
+            out.append(entry if entry in names else None)
+    while out and (out[-1] is None):
+        out.pop()
+    return P(*out)
 
 
 def _strip_manual_axes(spec: P) -> P:
@@ -48,8 +87,8 @@ def _strip_manual_axes(spec: P) -> P:
         return spec
     entries = []
     for entry in spec:
-        if entry is None:
-            entries.append(None)
+        if entry is None or entry is P.UNCONSTRAINED:
+            entries.append(entry)
         elif isinstance(entry, tuple):
             kept = tuple(a for a in entry if a not in manual)
             entries.append(kept if kept else None)
@@ -67,8 +106,9 @@ def maybe_shard(x, spec: P):
     (gradient-transparent) constraint and eager backward still flows.
     """
     names = ambient_axis_names()
-    if not names or not _spec_axes(spec).issubset(set(names)):
+    if not names:
         return x
+    spec = _resolve_ambient(spec, names)
     spec = _strip_manual_axes(spec)
     if not _spec_axes(spec):
         return x

@@ -19,7 +19,7 @@ from ..distributed.fleet.meta_parallel.mp_layers import (
     RowParallelLinear,
     VocabParallelEmbedding,
 )
-from ..distributed.sharding_utils import maybe_shard
+from ..distributed.sharding_utils import data_axes as _data_axes, maybe_shard
 from ..nn import functional as F
 from ..nn.layer.layers import Layer
 
@@ -86,7 +86,7 @@ class BertSelfAttention(Layer):
         B, S = x.shape[0], x.shape[1]
         cfg = self.cfg
         qkv = self.qkv(x).reshape([B, S, 3, cfg.num_heads, cfg.head_dim])
-        qkv = maybe_shard(qkv, P("dp", None, None, "mp", None))
+        qkv = maybe_shard(qkv, P(_data_axes(), None, None, "mp", None))
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=cfg.attention_dropout, is_causal=False, training=self.training
@@ -108,7 +108,7 @@ class BertLayer(Layer):
         self.dropout = nn.Dropout(cfg.dropout)
 
     def forward(self, x, attn_mask=None):
-        x = maybe_shard(x, P("dp", None, None))
+        x = maybe_shard(x, P(_data_axes(), None, None))
         x = self.ln1(x + self.attn(x, attn_mask))
         h = self.fc2(F.gelu(self.fc1(x), approximate=True))
         return self.ln2(x + self.dropout(h))

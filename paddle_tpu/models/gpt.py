@@ -92,13 +92,15 @@ GPT_TINY = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4, max_s
 
 
 def _batch_axes():
-    """Mesh axes carrying the batch dim: dp always, plus ep when the mesh
-    has one — expert parallelism rides the data axes for non-expert compute
-    (DeepSpeed-MoE style); the MoE dispatch all-to-all regroups tokens by
-    expert across ep."""
-    from ..distributed.sharding_utils import ambient_axis_names
+    """Mesh axes carrying the batch dim: dp, the ZeRO `sharding` axis (a
+    sharded optimizer is still data parallelism for activations — dropping it
+    here forced a replicate-over-sharding reshard every block), and ep
+    (expert parallelism rides the data axes for non-expert compute,
+    DeepSpeed-MoE style). Resolved against the ambient mesh at constraint
+    time; order matches ShardedTrainStep's batch_spec."""
+    from ..distributed.sharding_utils import data_axes
 
-    return ("dp", "ep") if "ep" in ambient_axis_names() else ("dp",)
+    return data_axes()
 
 
 def _seq_spec(cfg: GPTConfig) -> P:
@@ -355,7 +357,7 @@ class GPTForCausalLM(Layer):
         """LM head over final hidden states (tied or separate)."""
         if self.cfg.tie_word_embeddings:
             logits = h.matmul(self.gpt.embeddings.word_embeddings.weight, transpose_y=True)
-            return maybe_shard(logits, P("dp", None, "mp"))
+            return maybe_shard(logits, P(_batch_axes(), None, "mp"))
         return self.lm_head(h)
 
     def forward(self, input_ids, position_ids=None):

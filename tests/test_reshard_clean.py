@@ -1,0 +1,128 @@
+"""No involuntary-full-rematerialization resharding in the hybrid configs.
+
+Round-3 verdict: the multichip dryrun's ZeRO-3 MoE leg hit XLA's
+`[SPMD] Involuntary full rematerialization` path — a replicate-then-partition
+reshard of the residual stream — because (a) activation constraints dropped
+the ZeRO `sharding` axis from the batch dim and (b) ZeRO-3 storage sharding
+propagated into the weight-grad dots. The reference avoids this class of
+cliff by inserting exact resharding collectives
+(auto_parallel/reshard.py:1008); our fix is constraint hygiene
+(sharding_utils.data_axes, _last_dim_mp UNCONSTRAINED specs, grad
+compute-spec constraints in fleet.utils).
+
+Two gates: the partitioner warning must not appear on stderr (capfd sees the
+C++ glog fd), and the compiled HLO must not contain an all-gather that
+materializes a full global activation on every device.
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    from paddle_tpu.distributed import collective, mesh, topology
+
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+    yield
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+
+
+def _build_step(dp, sharding, mp=1, ep=1, level=None, moe=False, seq_par=False,
+                bsz=32, seq=16):
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel import group_sharded_parallel
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": dp, "pp_degree": 1, "sharding_degree": sharding,
+        "mp_degree": mp, "ep_degree": ep, "sep_degree": 1,
+    }
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    if moe:
+        from paddle_tpu.models import gpt_moe_tiny
+
+        model = gpt_moe_tiny(dropout=0.0, moe_every_k=2)
+    else:
+        from paddle_tpu.models import gpt_tiny
+
+        model = gpt_tiny(dropout=0.0, sequence_parallel=seq_par)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    if level:
+        model, opt, _ = group_sharded_parallel(model, opt, level=level)
+    step = make_sharded_train_step(getattr(model, "_layers", model),
+                                   getattr(opt, "_inner", opt))
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 128, size=(bsz, seq))
+    y = np.roll(x, -1, axis=1)
+    return step, x, y
+
+
+def _assert_no_full_activation_allgather(compiled_text, global_batch,
+                                         global_act_bytes):
+    """SPMD-partitioned HLO shapes are per-device. Legitimate collectives
+    keep activations partial: a Megatron-SP seq gather emits a LOCAL-batch
+    result, a ZeRO-3 param gather has no batch dim. The
+    replicate-then-partition fallback's fingerprint is an all-gather whose
+    result is a full GLOBAL-batch-leading activation on every device."""
+    sizes = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4}
+    # result shape follows '=': "%ag.7 = f32[32,16,64]{2,1,0} all-gather("
+    pat = r"=\s*(\w+)\[([\d,]*)\](?:\{[^}]*\})?\s*all-gather\("
+    matches = list(re.finditer(pat, compiled_text))
+    if "all-gather" in compiled_text:
+        assert matches, "all-gather present but result-shape regex matched none"
+    for m in matches:
+        dt, dims = m.group(1), m.group(2)
+        if dt not in sizes or not dims:
+            continue
+        shape = [int(d) for d in dims.split(",")]
+        if len(shape) < 2 or shape[0] != global_batch:
+            continue
+        n = sizes[dt]
+        for d in shape:
+            n *= d
+        assert n < global_act_bytes, (
+            f"all-gather materializes a global-batch activation of {n} bytes "
+            f">= {global_act_bytes}B: {m.group(0)}")
+
+
+@pytest.mark.parametrize(
+    "name,kw",
+    [
+        ("zero2_megatron_sp", dict(dp=2, sharding=2, mp=2, level="os_g",
+                                   seq_par=True)),
+        ("zero3_moe_ep", dict(dp=2, sharding=2, ep=2, level="p_g_os",
+                              moe=True)),
+    ],
+)
+def test_no_involuntary_remat(name, kw, capfd):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    step, x, y = _build_step(**kw)
+    loss = float(step(x, y))
+    assert np.isfinite(loss)
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err, err[-2000:]
+
+    compiled = step.lower_compiled(x, y).compile()
+    txt = compiled.as_text()
+    # residual stream [B, S, H] in the step's compute dtype = the tensor the
+    # r3 artifact showed being fully rematerialized
+    from paddle_tpu.models.gpt import GPT_TINY
+
+    hidden = GPT_TINY["hidden_size"]
+    itemsize = np.dtype(np.float32).itemsize
+    global_act_bytes = x.shape[0] * x.shape[1] * hidden * itemsize
+    _assert_no_full_activation_allgather(txt, x.shape[0], global_act_bytes)
