@@ -97,6 +97,25 @@ def in_rng_scope() -> bool:
 
 
 @contextlib.contextmanager
+def rng_scope_key(key):
+    """Like rng_scope but seeded with a raw (possibly traced) PRNG key, and
+    with a FRESH counter and no inherited salts — so a computation replayed
+    under the same key draws identical streams regardless of the ambient
+    trace position. The compiled 1F1B pipeline uses this to make its
+    backward-pass recompute reproduce the forward's dropout masks exactly
+    (the custom_vjp bwd is traced outside the forward's context managers)."""
+    prev_rng = getattr(_tls, "rng", None)
+    prev_salts = getattr(_tls, "salts", ())
+    _tls.rng = [key, 0]
+    _tls.salts = ()
+    try:
+        yield
+    finally:
+        _tls.rng = prev_rng
+        _tls.salts = prev_salts
+
+
+@contextlib.contextmanager
 def key_salt(salt):
     """Fold a (possibly traced) salt into every key drawn in this scope.
 

@@ -414,6 +414,197 @@ def pipeline_schedule(
     return (outputs, lax.psum(aux_acc, axis_name)) if with_aux else outputs
 
 
+def pipeline_schedule_1f1b(
+    stage_fn: Callable,
+    stacked_params,
+    microbatches,
+    axis_name: str = "pp",
+    n_stages: Optional[int] = None,
+    remat: bool = True,
+    with_aux: bool = False,
+):
+    """1F1B-memory compiled pipeline schedule (reference
+    forward_backward_pipeline's steady-state 1F1B,
+    fleet/meta_parallel/pipeline_parallel.py:153), for use INSIDE shard_map
+    over the pp axis. Same contract as `pipeline_schedule` (outputs valid on
+    the last stage only; with_aux returns (outputs, aux_total)).
+
+    Why not AD-transpose the GPipe scan: transposing scan-over-(M+n-1)-ticks
+    stores one microbatch carry PER TICK, so live activation memory scales
+    with accumulate_steps M. The reference's 1F1B instead caps in-flight
+    microbatches at the pp degree. Here that bound comes from a custom_vjp:
+
+    * primal: forward-only scan (no residual stashing beyond the carry).
+    * backward: ONE combined scan of M + 2(n-1) ticks in which a RECOMPUTE
+      stream re-runs the forward ring (regenerating each stage's inputs,
+      pushed into a ring stash of 2n-1 microbatch slots — the 1F1B
+      in-flight bound) while the BACKWARD stream, offset by the pipeline
+      depth exactly as 1F1B's steady state, pops stashed inputs and runs
+      each stage's VJP, accumulating param grads and ppermuting input
+      cotangents in the reverse ring direction.
+
+    Cost: one extra forward per microbatch-stage vs. the remat'd GPipe
+    transpose (~+25% of a fwd+bwd), bought for activation memory O(pp)
+    instead of O(accumulate_steps). RNG: every (stage, microbatch) cell
+    derives its key from one base key captured at trace time (core.random.
+    rng_scope_key), so the backward recompute reproduces the forward's
+    dropout masks exactly.
+    """
+    n = n_stages if n_stages is not None else lax.axis_size(axis_name)
+    my_params = jax.tree_util.tree_map(
+        lambda p: p[0] if hasattr(p, "shape") and p.shape and p.shape[0] == 1 else p,
+        stacked_params)
+    M = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    rev_perm = [(i, (i - 1) % n) for i in range(n)]
+    C = max(2 * n - 1, 1)  # stash capacity: 1F1B in-flight bound
+    T_fwd = M + n - 1
+    T_bwd = M + 2 * (n - 1)
+
+    from ....core import random as _random
+    from ....core.autograd import no_grad
+
+    base_key = (_random.next_key() if _random.in_rng_scope()
+                else jax.random.PRNGKey(0))
+
+    def _call(params, x, key):
+        # fresh key-scoped RNG: reproducible at backward-recompute time
+        with no_grad(), _random.rng_scope_key(key):
+            return stage_fn(params, x)
+
+    probe_fn = (lambda p, x: _call(p, x, base_key)[0]) if with_aux \
+        else (lambda p, x: _call(p, x, base_key))
+    probe = jax.eval_shape(probe_fn, my_params,
+                           jnp.zeros(mb_shape, microbatches.dtype))
+
+    def _fwd_scan(params, mbs, key0):
+        # derived INSIDE each traced function: custom_vjp traces fwd/bwd
+        # outside this scope, so closing over an axis_index tracer leaks
+        stage_idx = lax.axis_index(axis_name)
+
+        def tick(carry, t):
+            incoming, outputs, aux_acc = carry
+            x_in = jnp.where(stage_idx == 0,
+                             mbs[jnp.clip(t, 0, M - 1)], incoming)
+            # stage s works microbatch k = t - s; key folds t = s + k so the
+            # backward can re-derive it from (s, k). Layer salts inside
+            # stage_fn distinguish stages sharing a tick.
+            k = jax.random.fold_in(key0, t)
+            if with_aux:
+                y, aux = _call(params, x_in, k)
+                live = (t - stage_idx >= 0) & (t - stage_idx < M)
+                aux_acc = aux_acc + jnp.where(live, aux, 0.0)
+            else:
+                y = _call(params, x_in, k)
+            slot = t - (n - 1)
+            valid = (stage_idx == n - 1) & (slot >= 0)
+            outputs = lax.cond(
+                valid,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y.astype(o.dtype), jnp.maximum(slot, 0), 0),
+                lambda o: o,
+                outputs)
+            return (lax.ppermute(y, axis_name, fwd_perm), outputs, aux_acc), None
+
+        outputs0 = jnp.zeros((M,) + tuple(probe.shape), probe.dtype)
+        (_, outputs, aux_acc), _ = lax.scan(
+            tick, (jnp.zeros(mb_shape, microbatches.dtype), outputs0,
+                   jnp.zeros((), jnp.float32)),
+            jnp.arange(T_fwd))
+        if with_aux:
+            return outputs, lax.psum(aux_acc, axis_name)
+        return outputs
+
+    @jax.custom_vjp
+    def pipe(params, mbs, key0):
+        return _fwd_scan(params, mbs, key0)
+
+    def pipe_fwd(params, mbs, key0):
+        return _fwd_scan(params, mbs, key0), (params, mbs, key0)
+
+    def pipe_bwd(res, ct):
+        params, mbs, key0 = res
+        if with_aux:
+            d_out, d_aux = ct
+            # the primal's last aux op is lax.psum: its transpose sums the
+            # per-device cotangent shares (shard_map hands each device
+            # ct/n for a replicated output) back into the full cotangent
+            d_aux = lax.psum(d_aux, axis_name)
+        else:
+            d_out, d_aux = ct, None
+
+        # plain _call, not jax.checkpoint: the vjp's residuals are consumed
+        # within the same tick (jax.vjp then vjp_fn back to back), so
+        # checkpointing can't reduce cross-tick memory — it only risks a
+        # wasted extra forward if the unused-primal DCE doesn't fire. The
+        # `remat` flag matters for the GPipe transpose path, not here.
+        stage_idx = lax.axis_index(axis_name)
+
+        def tick(carry, t):
+            y_ring, dx_ring, stash, g, d_mbs = carry
+
+            # ---- recompute stream: same timing as the forward scan ----
+            kR = t - stage_idx  # microbatch this stage recomputes this tick
+            liveR = (kR >= 0) & (kR < M)
+            xR = jnp.where(stage_idx == 0,
+                           mbs[jnp.clip(t, 0, M - 1)], y_ring)
+            keyR = jax.random.fold_in(key0, t)
+            if with_aux:
+                yR, _ = _call(params, xR, keyR)
+            else:
+                yR = _call(params, xR, keyR)
+            stash = lax.cond(
+                liveR,
+                lambda s: lax.dynamic_update_index_in_dim(
+                    s, xR, jnp.mod(jnp.maximum(kR, 0), C), 0),
+                lambda s: s,
+                stash)
+
+            # ---- backward stream: 1F1B offset 2(n-1) - 2*stage behind ----
+            kB = t - 2 * (n - 1) + stage_idx
+            liveB = (kB >= 0) & (kB < M)
+            x_b = lax.dynamic_index_in_dim(
+                stash, jnp.mod(jnp.maximum(kB, 0), C), 0, keepdims=False)
+            dy = jnp.where(stage_idx == n - 1,
+                           d_out[jnp.clip(kB, 0, M - 1)].astype(probe.dtype),
+                           dx_ring)
+            keyB = jax.random.fold_in(key0, jnp.maximum(kB, 0) + stage_idx)
+            _, vjp_fn = jax.vjp(
+                lambda p, x: _call(p, x, keyB), params, x_b)
+            ct_in = (dy, jnp.where(liveB, d_aux, 0.0).astype(jnp.float32)) \
+                if with_aux else dy
+            dp, dx = vjp_fn(ct_in)
+            g = jax.tree_util.tree_map(
+                lambda a, b: a + jnp.where(liveB, b, 0).astype(a.dtype), g, dp)
+            # stage 0's input cotangent lands in the microbatch stream grad
+            d_mbs = lax.cond(
+                liveB & (stage_idx == 0),
+                lambda d: lax.dynamic_update_index_in_dim(
+                    d, dx.astype(d.dtype), jnp.maximum(kB, 0), 0),
+                lambda d: d,
+                d_mbs)
+            dx = jnp.where(liveB, dx, 0).astype(dx_ring.dtype)
+            return (lax.ppermute(yR, axis_name, fwd_perm),
+                    lax.ppermute(dx, axis_name, rev_perm),
+                    stash, g, d_mbs), None
+
+        g0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, p.dtype), params)
+        init = (
+            jnp.zeros(mb_shape, microbatches.dtype),
+            jnp.zeros(tuple(probe.shape), probe.dtype),
+            jnp.zeros((C,) + mb_shape, microbatches.dtype),
+            g0,
+            jnp.zeros(mbs.shape, mbs.dtype),
+        )
+        (_, _, _, g, d_mbs), _ = lax.scan(tick, init, jnp.arange(T_bwd))
+        return g, d_mbs, None
+
+    pipe.defvjp(pipe_fwd, pipe_bwd)
+    return pipe(my_params, microbatches, base_key)
+
+
 def _simulate_interleaved_ticks(n: int, v: int, M: int) -> int:
     """Host-side simulation of the greedy interleaved ring below (returning
     laps preempt fresh injections): exact tick count to finish all M

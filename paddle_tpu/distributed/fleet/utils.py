@@ -99,6 +99,7 @@ class ShardedTrainStep:
         accumulate_steps: Optional[int] = None,
         pp_remat: bool = True,
         virtual_pp_degree: int = 1,
+        pp_schedule: str = "1f1b",
     ):
         from ..topology import get_hybrid_communicate_group
 
@@ -139,6 +140,10 @@ class ShardedTrainStep:
             self._pspec = pspec
             self._accum = accumulate_steps if accumulate_steps else pp
             self._vpp = max(int(virtual_pp_degree), 1)
+            if pp_schedule not in ("1f1b", "gpipe"):
+                raise ValueError(
+                    f"pp_schedule must be '1f1b' or 'gpipe', got {pp_schedule!r}")
+            self._pp_schedule = pp_schedule
             stacked0, other0 = stack_block_params(params0, pspec, pp,
                                                   virtual_stages=self._vpp)
             self._stack_prefix = (f"{pspec.block_prefix}." if pspec.block_prefix
@@ -302,7 +307,8 @@ class ShardedTrainStep:
         from jax import lax, shard_map
 
         from .meta_parallel.pipeline_parallel import (
-            pipeline_schedule, pipeline_schedule_interleaved)
+            pipeline_schedule, pipeline_schedule_1f1b,
+            pipeline_schedule_interleaved)
 
         pspec = self._pspec
         mesh = self.mesh
@@ -367,6 +373,12 @@ class ShardedTrainStep:
                         outs = pipeline_schedule_interleaved(
                             stage, stacked_loc, mbs_loc, axis_name="pp",
                             virtual_stages=vpp, remat=remat, with_aux=with_aux)
+                    elif self._pp_schedule == "1f1b":
+                        # activation memory bounded by the pp degree (1F1B
+                        # in-flight cap) instead of accumulate_steps
+                        outs = pipeline_schedule_1f1b(
+                            stage, stacked_loc, mbs_loc, axis_name="pp",
+                            remat=remat, with_aux=with_aux)
                     else:
                         outs = pipeline_schedule(stage, stacked_loc, mbs_loc,
                                                  axis_name="pp", remat=remat,
@@ -413,10 +425,28 @@ class ShardedTrainStep:
                 # also what plain gradient accumulation computes). vmap keeps
                 # the M head matmuls batched (one MXU call, not M serial)
                 ys = jnp.swapaxes(y.reshape((B // M, M) + y.shape[1:]), 0, 1)
-                h_last = maybe_shard(h_last, P(None, ("dp", "pp")))
-                per_mb = jax.vmap(
-                    lambda hm, ym: pspec.post_loss(other, buffers0, hm, ym))(
-                    h_last, ys)
+                # spread the M per-microbatch head matmuls over pp (so
+                # non-last stages help with LM-head FLOPs) and keep mb on dp,
+                # each guarded by divisibility — an infeasible split forces
+                # the partitioner into replicate-then-partition
+                sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+                head_spec = [None, None]
+                if sizes.get("pp", 1) > 1 and M % sizes["pp"] == 0:
+                    head_spec[0] = "pp"
+                if (B // M) % max(sizes.get("dp", 1), 1) == 0:
+                    head_spec[1] = "dp"
+                h_last = maybe_shard(h_last, P(*head_spec))
+                post_one = lambda hm, ym: pspec.post_loss(other, buffers0, hm, ym)
+                if M <= max(2 * sizes.get("pp", 1), 4):
+                    # small stream: one batched MXU call for all M heads
+                    per_mb = jax.vmap(post_one)(h_last, ys)
+                else:
+                    # large accumulation: sequential remat'd heads so the
+                    # logits buffer is one microbatch's, not M stacked —
+                    # the per-microbatch loss shape 1F1B's memory assumes
+                    per_mb = lax.map(
+                        jax.checkpoint(lambda hy: post_one(*hy)),
+                        (h_last, ys))
                 loss = jnp.mean(per_mb.astype(jnp.float32))
                 if with_aux:
                     # mean-over-microbatch gate aux, weighted — matches the

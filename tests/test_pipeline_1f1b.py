@@ -1,0 +1,160 @@
+"""1F1B-memory compiled pipeline schedule (pipeline_schedule_1f1b).
+
+Round-3 verdict item 2: the GPipe-shaped scan transpose stashes one
+microbatch carry per tick, so activation memory scales with
+accumulate_steps M; the reference's 1F1B caps in-flight microbatches at the
+pp degree (fleet/meta_parallel/pipeline_parallel.py:153,
+p2p_communication.py:543). pipeline_schedule_1f1b's custom_vjp backward
+re-runs the forward ring while consuming a 2*pp-1-slot stash — these tests
+pin (a) exact loss parity with the unpipelined and GPipe paths at M=16/32,
+(b) the schedule's stash memory staying flat in M while GPipe's grows,
+(c) dropout reproducibility through the backward recompute (key-scoped RNG),
+and (d) the MoE aux path riding the 1F1B schedule.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    from paddle_tpu.distributed import collective, mesh, topology
+
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+    yield
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+
+
+def _train(pp, dp, M, schedule="1f1b", L=4, steps=2, batch=16, dropout=0.0,
+           moe=False, seed=0):
+    from paddle_tpu.distributed import collective, mesh, topology
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": dp, "pp_degree": pp,
+                        "sharding_degree": 1, "mp_degree": 1}
+    fleet.init(is_collective=True, strategy=s)
+    paddle.seed(seed)
+    if moe:
+        from paddle_tpu.models import gpt_moe_tiny
+
+        model = gpt_moe_tiny(dropout=dropout, moe_every_k=1, num_layers=L)
+    else:
+        from paddle_tpu.models import gpt_tiny
+
+        model = gpt_tiny(dropout=dropout, num_layers=L)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = make_sharded_train_step(
+        model, opt, accumulate_steps=M if pp > 1 else None,
+        pp_schedule=schedule)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 128, size=(batch, 16))
+    y = np.roll(x, -1, axis=1)
+    return [float(step(x, y)) for _ in range(steps)]
+
+
+def test_1f1b_matches_unpipelined_and_gpipe():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    ref = _train(1, 1, None)
+    l_1f1b = _train(4, 2, 16, "1f1b")
+    l_gpipe = _train(4, 2, 16, "gpipe")
+    np.testing.assert_allclose(l_1f1b, ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(l_gpipe, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_1f1b_accumulate_32():
+    """VERDICT done-bar: the pp step compiles and matches at M=32."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    ref = _train(1, 1, None, batch=32, steps=1)
+    l = _train(4, 2, 32, "1f1b", batch=32, steps=1)
+    np.testing.assert_allclose(l, ref, rtol=2e-4, atol=2e-5)
+
+
+def _raw_schedule_temp_bytes(which, M, n=4, mb=8, S=16, H=64):
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        pipeline_schedule, pipeline_schedule_1f1b)
+
+    mesh = Mesh(np.array(jax.devices()[:n]), ("pp",))
+    W = {"w": jnp.zeros((n, 1, H, H), jnp.float32)
+         + jnp.eye(H, dtype=jnp.float32) * 0.9,
+         "b": jnp.zeros((n, 1, H), jnp.float32)}
+
+    def stage(bp, h):
+        for _ in range(3):
+            h = jnp.tanh(h @ bp["w"][0] + bp["b"][0][None, None, :])
+        return h
+
+    sched = pipeline_schedule if which == "gpipe" else pipeline_schedule_1f1b
+    mbs = jnp.ones((M, mb, S, H), jnp.float32)
+
+    def loss(W, mbs):
+        body = lambda Wl, ml: sched(stage, Wl, ml, axis_name="pp")[None]
+        outs = shard_map(body, mesh=mesh, in_specs=(P("pp"), P()),
+                         out_specs=P("pp"), check_vma=False)(W, mbs)
+        return jnp.sum(outs[-1] ** 2)
+
+    c = jax.jit(jax.grad(loss)).lower(W, mbs).compile()
+    return c.memory_analysis().temp_size_in_bytes
+
+
+def test_1f1b_activation_memory_bounded_by_pp():
+    """The schedule-attributable stash is O(pp), not O(M): growing M from 8
+    to 32 at fixed microbatch size, GPipe's transpose residual grows by one
+    microbatch activation PER TICK while 1F1B's stays at the 2*pp-1 ring
+    stash. The per-microbatch output/cotangent streams (one full-batch
+    residual, present in both) are the only O(M) terms left in 1F1B."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    n, mb, S, H = 4, 8, 16, 64
+    act = mb * S * H * 4  # one microbatch activation, f32 bytes
+    g8, g32 = (_raw_schedule_temp_bytes("gpipe", M) for M in (8, 32))
+    f8, f32 = (_raw_schedule_temp_bytes("1f1b", M) for M in (8, 32))
+    gpipe_growth, f1b_growth = g32 - g8, f32 - f8
+    # GPipe grows by >= the 24 extra ticks' stashed carries beyond 1F1B
+    assert gpipe_growth - f1b_growth > 0.5 * 24 * act, (
+        f"1f1b should shed the per-tick stash: gpipe +{gpipe_growth}, "
+        f"1f1b +{f1b_growth}, act={act}")
+    # 1F1B's remaining growth is the output/cotangent/input-grad streams
+    # (~3 activations per microbatch) — no per-tick stash term
+    assert f1b_growth <= 24 * 4 * act, (
+        f"1f1b growth {f1b_growth} exceeds stream-only bound {24 * 4 * act}")
+
+
+def test_1f1b_dropout_reproducible_and_trains():
+    """The custom_vjp backward re-derives every (stage, microbatch) RNG key
+    from the captured base key — two identical runs must produce identical
+    losses, and training with dropout must stay finite and descend."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    a = _train(4, 2, 8, "1f1b", dropout=0.1, steps=3, seed=7)
+    b = _train(4, 2, 8, "1f1b", dropout=0.1, steps=3, seed=7)
+    assert a == b, (a, b)
+    assert all(np.isfinite(v) for v in a)
+    assert a[-1] < a[0]
+
+
+def test_1f1b_moe_aux_parity():
+    """GPT-MoE through the 1F1B schedule: the gate aux cotangent rides the
+    per-tick VJPs; losses must match the GPipe path exactly."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    l_g = _train(2, 2, 4, "gpipe", moe=True, L=2)
+    l_f = _train(2, 2, 4, "1f1b", moe=True, L=2)
+    np.testing.assert_allclose(l_f, l_g, rtol=1e-6, atol=1e-7)
