@@ -154,12 +154,15 @@ class Predictor:
         self._outputs = {}
         results = []
         for n, o in zip(self._output_names, outs):
-            # outputs stay device-resident: Run() is async dispatch, and
-            # copy_to_cpu is the host materialization + completion barrier
+            # the output HANDLES stay device-resident (Run() is async
+            # dispatch; copy_to_cpu is the host materialization +
+            # completion barrier — the ZeroCopy serving path), but run()'s
+            # RETURN matches the reference's public contract: numpy arrays
+            # callers may mutate or type-check
             h = _IOHandle(n)
             h._array = o
             self._outputs[n] = h
-            results.append(o)
+            results.append(np.asarray(o))
         return results
 
     def get_output_names(self):

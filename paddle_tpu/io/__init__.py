@@ -30,3 +30,4 @@ from .sampler import (  # noqa: F401
     WeightedRandomSampler,
 )
 from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+from .prefetch import DevicePrefetcher, prefetch_to_device  # noqa: F401

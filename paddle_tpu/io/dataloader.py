@@ -206,5 +206,15 @@ class DataLoader:
             return self._iter_single()
         return self._iter_workers()
 
+    def device_iter(self, device=None, depth: Optional[int] = None):
+        """Iterate with async host→device staging (the reference's
+        buffer-reader / reader-op infeed, fluid/reader.py): batch k+1's
+        transfer overlaps step k. `device` may be a Device or Sharding;
+        depth defaults to prefetch_factor."""
+        from .prefetch import DevicePrefetcher
+
+        return iter(DevicePrefetcher(
+            self, depth=depth or self.prefetch_factor, device=device))
+
     def __call__(self):
         return self.__iter__()

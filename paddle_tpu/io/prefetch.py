@@ -1,0 +1,102 @@
+"""Async host→device input prefetch (reference reader-op pipeline analog).
+
+The reference feeds training with reader ops pulling from a C++
+LoDTensorBlockingQueue filled by a background pipeline
+(fluid/operators/reader/, python/paddle/fluid/reader.py) so the host→device
+copy of batch k+1 overlaps step k. TPU-native, the same overlap comes from
+`jax.device_put` being asynchronous: a background thread stages upcoming
+batches onto the device through a bounded queue, and the consumer receives
+arrays whose transfer is already in flight — compute on step k and the
+infeed of step k+1 proceed concurrently.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, Optional
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+def _to_device(batch, device):
+    """device_put a batch pytree (Tensor leaves unwrapped to jax arrays)."""
+    def put(leaf):
+        v = leaf._value if isinstance(leaf, Tensor) else leaf
+        return jax.device_put(v, device)
+
+    return jax.tree_util.tree_map(
+        put, batch, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+class DevicePrefetcher:
+    """Double-buffered device staging over any batch iterable.
+
+    depth=2 is classic double buffering: while the consumer runs step k on
+    batch k, the worker thread is already pushing batch k+1 (and k+2)
+    through `jax.device_put`. `device` may be a Device, a Sharding (to
+    stage each batch directly into its training layout), or None for the
+    default device.
+    """
+
+    _END = object()
+
+    def __init__(self, iterable: Iterable, depth: int = 2, device=None):
+        self._iterable = iterable
+        self._depth = max(1, int(depth))
+        self._device = device
+
+    def __iter__(self) -> Iterator:
+        q: "queue.Queue" = queue.Queue(maxsize=self._depth)
+        err: list = []
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            # bounded put that notices consumer abandonment: without the
+            # stop check an early `break` would leave this thread blocked
+            # in q.put forever, pinning staged device batches
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for batch in self._iterable:
+                    if stop.is_set() or not _put(_to_device(batch, self._device)):
+                        return
+            except Exception as e:  # propagate to the consumer
+                err.append(e)
+            finally:
+                _put(self._END)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._END:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            # consumer done or bailed early (break/exception/GeneratorExit):
+            # release the producer and drop staged batches
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+
+
+def prefetch_to_device(iterable: Iterable, depth: int = 2, device=None):
+    """Functional form: wrap a DataLoader (or any batch iterator) so its
+    batches arrive device-resident ahead of use."""
+    return DevicePrefetcher(iterable, depth=depth, device=device)

@@ -347,7 +347,10 @@ class ConvBnFusePass(Pass):
                 ch_dim, w_out_dim = dn.out_spec[1], dn.rhs_spec[0]
                 w_idx = 1
             elif prod.name == "pd.dot_general":
-                ((lc, rc), (lb, rb)) = params.get("dimension_numbers")
+                dn = params.get("dimension_numbers")
+                if dn is None:
+                    continue  # manually built op without dnums: skip, don't crash
+                ((lc, rc), (lb, rb)) = dn
                 if list(lb) or list(rb) or len(rc) != 1:
                     continue
                 ch_dim = len(out_shape) - 1  # plain x @ W: out channel last

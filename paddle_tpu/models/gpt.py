@@ -145,10 +145,21 @@ class GPTAttention(Layer):
         v = qkv[:, :, (Hq + Hkv) * D:].reshape([B, S, Hkv, D])
         if Hkv != Hq:
             # expand shared K/V heads to the query-head count — exact GQA
-            # semantics; XLA keeps the broadcast fused into the attention
+            # semantics. A true broadcast (insert group dim, broadcast,
+            # merge), NOT repeat_interleave: jnp.repeat lowers to
+            # gather/concat which materializes K/V at full query-head
+            # width; broadcast_in_dim XLA fuses into the attention matmuls
             rep = Hq // Hkv
-            k = k.repeat_interleave(rep, axis=2)
-            v = v.repeat_interleave(rep, axis=2)
+
+            def _expand(tv):
+                tv = jnp.broadcast_to(tv[:, :, :, None, :],
+                                      (B, S, Hkv, rep, D))
+                return tv.reshape(B, S, Hq, D)
+
+            from ..ops._dispatch import apply
+
+            k = apply("gqa_expand", _expand, k)
+            v = apply("gqa_expand", _expand, v)
         k = maybe_shard(k, head_spec)
         v = maybe_shard(v, head_spec)
         hcg = get_hybrid_communicate_group()

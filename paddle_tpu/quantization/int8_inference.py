@@ -97,8 +97,13 @@ def to_int8_inference(model: Layer, inplace: bool = False) -> Layer:
         # The recorded axis makes this exact even for square layers, where
         # the size check alone cannot tell the two apart.
         axis = getattr(layer, "_quant_channel_axis", None)
-        if s.size > 1 and axis is not None and axis != 1:
-            return None  # keep the dequantized-float path
+        if s.size > 1 and axis != 1:
+            # requires a RECORDED out-axis: for a square [N, N] weight the
+            # size check below cannot distinguish per-in- from
+            # per-out-channel scales, and an absent axis (artifacts frozen
+            # before it was recorded, or external payloads) would silently
+            # produce wrong serving numerics — fall back to float.
+            return None
         if s.size not in (1, q.shape[1]):
             return None
         bias = getattr(layer, "bias", None)

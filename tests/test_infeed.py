@@ -1,0 +1,138 @@
+"""Async device infeed (io.prefetch) + multi-step scanned execution.
+
+Round-3 verdict item 3: the resnet row was 96% host-bound because every
+step's batch crossed host→device synchronously. The fixes under test:
+DevicePrefetcher (background-thread jax.device_put, double-buffered — the
+reference's reader-op/blocking-queue infeed, fluid/operators/reader/),
+DataLoader.device_iter, and ShardedTrainStep.run_steps (K optimizer steps
+per dispatch, amortizing per-dispatch host overhead).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io.prefetch import DevicePrefetcher, prefetch_to_device
+
+
+def test_prefetcher_order_and_device_residency():
+    batches = [(np.full((2, 3), i, np.float32), np.array([i])) for i in range(7)]
+    out = list(DevicePrefetcher(iter(batches), depth=2))
+    assert len(out) == 7
+    for i, (x, y) in enumerate(out):
+        assert isinstance(x, jax.Array) and isinstance(y, jax.Array)
+        assert float(x[0, 0]) == i and int(y[0]) == i
+
+
+def test_prefetcher_propagates_exceptions():
+    def gen():
+        yield np.zeros((2,))
+        raise RuntimeError("boom")
+
+    it = iter(DevicePrefetcher(gen(), depth=2))
+    next(it)
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+
+
+def test_prefetcher_unwraps_tensor_leaves():
+    t = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    (batch,) = list(DevicePrefetcher([[t]], depth=1))
+    assert isinstance(batch[0], jax.Array)
+    np.testing.assert_array_equal(np.asarray(batch[0]),
+                                  np.arange(4, dtype=np.float32))
+
+
+def test_dataloader_device_iter():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.full((3,), i, np.float32), np.int64(i)
+
+    loader = DataLoader(DS(), batch_size=4)
+    seen = list(loader.device_iter())
+    assert len(seen) == 2
+    x0, y0 = seen[0]
+    assert isinstance(x0, jax.Array)
+    np.testing.assert_array_equal(np.asarray(y0), [0, 1, 2, 3])
+
+
+def test_run_steps_matches_sequential_steps():
+    """K scanned steps in one dispatch == K individual step() dispatches:
+    same per-step losses, same final parameters."""
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    K = 4
+    rng = np.random.RandomState(0)
+    xs = rng.randint(0, 128, size=(K, 4, 16))
+    ys = np.roll(xs, -1, axis=2)
+
+    def build():
+        paddle.seed(0)
+        model = gpt_tiny(dropout=0.0)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        return model, make_sharded_train_step(model, opt)
+
+    _, s1 = build()
+    seq_losses = [float(s1(xs[k], ys[k])) for k in range(K)]
+
+    m2, s2 = build()
+    scan_losses = np.asarray(s2.run_steps(xs, ys))
+    np.testing.assert_allclose(scan_losses, seq_losses, rtol=1e-6, atol=1e-7)
+
+    p1 = jax.tree_util.tree_map(np.asarray, s1.params)
+    p2 = jax.tree_util.tree_map(np.asarray, s2.params)
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p2[k], rtol=1e-6, atol=1e-7,
+                                   err_msg=k)
+
+
+def test_run_steps_seed_parity_with_dropout():
+    """Seeds must line up: scanned step j draws the same RNG stream as the
+    j-th sequential __call__ — verified where it matters, with dropout on."""
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    K = 3
+    rng = np.random.RandomState(1)
+    xs = rng.randint(0, 128, size=(K, 4, 16))
+    ys = np.roll(xs, -1, axis=2)
+
+    def build():
+        paddle.seed(0)
+        model = gpt_tiny(dropout=0.2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        return make_sharded_train_step(model, opt)
+
+    s1 = build()
+    seq = [float(s1(xs[k], ys[k])) for k in range(K)]
+    s2 = build()
+    scan = np.asarray(s2.run_steps(xs, ys))
+    np.testing.assert_allclose(scan, seq, rtol=1e-6, atol=1e-7)
+
+
+def test_run_steps_then_step_continues():
+    """run_steps advances the held state; a following plain step() trains on."""
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    paddle.seed(0)
+    model = gpt_tiny(dropout=0.0)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = make_sharded_train_step(model, opt)
+    rng = np.random.RandomState(0)
+    xs = rng.randint(0, 128, size=(3, 4, 16))
+    ys = np.roll(xs, -1, axis=2)
+    losses = np.asarray(step.run_steps(xs, ys))
+    after = float(step(xs[0], ys[0]))
+    assert after < losses[0]
+    assert np.all(np.isfinite(losses))
