@@ -287,21 +287,25 @@ class PipelineParallelWithInterleave(PipelineParallel):
         self._step = None
         self._opt_id = None
 
-    def _compiled_step(self, optimizer):
+    def _compiled_step(self, optimizer, scaler=None):
         # unwrap HybridParallelOptimizer (_inner_opt) and the sharding
         # stage-2 wrapper (_inner); cache on the INNER id so re-wrapping
         # the same optimizer doesn't silently rebuild (and reset) state
         inner = optimizer
         for attr in ("_inner_opt", "_inner"):
             inner = getattr(inner, attr, inner)
-        if self._step is None or self._opt_id != id(inner):
+        # key on (optimizer, scaler) identity: a scaler attached (or swapped)
+        # after a scalerless warmup call must rebuild — silently reusing a
+        # scaler=None step would skip loss scaling without any error
+        key = (id(inner), id(scaler) if scaler is not None else None)
+        if self._step is None or self._opt_id != key:
             from ..utils import make_sharded_train_step
 
             self._step = make_sharded_train_step(
                 self._layers, inner,
                 accumulate_steps=max(self.accumulate_steps, 1),
-                virtual_pp_degree=self._vpp)
-            self._opt_id = id(inner)
+                virtual_pp_degree=self._vpp, scaler=scaler)
+            self._opt_id = key
         return self._step
 
     def forward_backward_pipeline(self, data, scaler=None):
@@ -310,17 +314,14 @@ class PipelineParallelWithInterleave(PipelineParallel):
             "step; use train_batch")
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        if scaler is not None:
-            raise NotImplementedError(
-                "PipelineParallelWithInterleave compiles the step in f32/bf16 "
-                "master-weight form; GradScaler loss scaling is not wired "
-                "into the compiled schedule — drop the scaler (bf16 needs "
-                "none) or use PipelineParallel (vpp=1)")
         self._layers.train()
         x, y = data
-        step = self._compiled_step(optimizer)
+        # GradScaler rides the compiled schedule: dynamic loss scaling +
+        # found_inf update-skip run inside the jit (utils.ShardedTrainStep)
+        step = self._compiled_step(optimizer, scaler=scaler)
         loss = step(x, y, lr=lr_scheduler.get_lr() if lr_scheduler is not None else None)
         step.sync_to_model()
+        step.sync_scaler()
         if lr_scheduler is not None:
             lr_scheduler.step()
         self.total_loss = loss

@@ -100,6 +100,7 @@ class ShardedTrainStep:
         pp_remat: bool = True,
         virtual_pp_degree: int = 1,
         pp_schedule: str = "1f1b",
+        scaler=None,
     ):
         from ..topology import get_hybrid_communicate_group
 
@@ -116,6 +117,7 @@ class ShardedTrainStep:
         self.loss_fn = loss_fn if loss_fn is not None else getattr(model, "loss", None)
         self._step_i = 0
         self._seed = seed
+        self._donate = donate
 
         pp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("pp", 1)
         self._pp = pp
@@ -214,13 +216,19 @@ class ShardedTrainStep:
         M_acc = self._accum
         pp_mode = pp > 1
 
-        def value_and_grad_accum(params, x, y, seed):
+        def value_and_grad_accum(params, x, y, seed, loss_scale=None):
             """Gradient accumulation over M_acc microbatches (pipeline mode
             microbatches inside the schedule instead): fwd+bwd per microbatch
             inside a lax.scan, so only one microbatch's activations are live
-            at a time — the memory profile accumulation exists to provide."""
+            at a time — the memory profile accumulation exists to provide.
+            loss_scale (traced scalar) multiplies the loss BEFORE autodiff —
+            fp16 dynamic loss scaling; grads and the returned loss come back
+            scaled. Applied outside the pipeline's custom_vjp, so it scales
+            the 1F1B/GPipe/vpp backward streams identically."""
+            sc = jnp.float32(1.0) if loss_scale is None else loss_scale
             if pp_mode or M_acc <= 1:
-                return jax.value_and_grad(lambda p: loss_impl(p, x, y, seed))(params)
+                return jax.value_and_grad(
+                    lambda p: loss_impl(p, x, y, seed) * sc)(params)
             B = x.shape[0]
             if B % M_acc:
                 raise ValueError(f"batch {B} not divisible by accumulate_steps {M_acc}")
@@ -235,7 +243,7 @@ class ShardedTrainStep:
 
                 def micro_loss(p):
                     with _random.key_salt(m):
-                        return loss_impl(p, xm, ym, seed)
+                        return loss_impl(p, xm, ym, seed) * sc
 
                 l, g = jax.value_and_grad(micro_loss)(params)
                 return (acc_l + l,
@@ -279,8 +287,7 @@ class ShardedTrainStep:
             for name, s in p_shard.items()
         }
 
-        def step(params, opt_state, x, y, lr, seed):
-            loss, grads = value_and_grad_accum(params, x, y, seed)
+        def _clip_and_update(params, opt_state, grads, lr):
             grads = {
                 k: jax.lax.with_sharding_constraint(g, g_shard[k])
                 for k, g in grads.items()
@@ -289,16 +296,85 @@ class ShardedTrainStep:
                 gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree_util.tree_leaves(grads))
                 scale = clip_norm / jnp.maximum(jnp.sqrt(gsq), clip_norm)
                 grads = jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), grads)
-            new_params, new_state = optimizer.apply_gradients(params, grads, opt_state, lr=lr)
-            return new_params, new_state, loss
+            return optimizer.apply_gradients(params, grads, opt_state, lr=lr)
 
-        donate_args = (0, 1) if donate else ()
-        self._compiled = jax.jit(
-            step,
-            in_shardings=(p_shard, s_shard, batch_sharding, batch_sharding, None, None),
-            out_shardings=(p_shard, s_shard, NamedSharding(mesh, P())),
-            donate_argnums=donate_args,
-        )
+        self._scaler = scaler if (scaler is not None
+                                  and scaler.is_enable()) else None
+        if self._scaler is not None:
+            # fp16 dynamic loss scaling inside the compiled step (reference
+            # amp/grad_scaler.py:576 update_loss_scaling): loss scaled before
+            # AD, grads unscaled in f32, non-finite grads skip the update
+            # (jnp.where select — branchless, SPMD-uniform), and the
+            # (scale, good, bad) automaton is device state carried by the
+            # step exactly like optimizer state.
+            sc = self._scaler
+            dynamic = sc.is_use_dynamic_loss_scaling()
+            incr_every, decr_every = sc._incr_every, sc._decr_every
+            incr_ratio, decr_ratio = sc._incr_ratio, sc._decr_ratio
+
+            def step(params, opt_state, sstate, x, y, lr, seed):
+                scale, good, bad = sstate
+                scaled_loss, grads = value_and_grad_accum(
+                    params, x, y, seed, loss_scale=scale)
+                inv = 1.0 / scale
+                dts = {k: g.dtype for k, g in grads.items()}
+                grads = {k: g.astype(jnp.float32) * inv
+                         for k, g in grads.items()}
+                found = jnp.zeros((), bool)
+                for g in grads.values():
+                    found = found | ~jnp.all(jnp.isfinite(g))
+                grads = {k: g.astype(dts[k]) for k, g in grads.items()}
+                new_params, new_state = _clip_and_update(
+                    params, opt_state, grads, lr)
+                keep = lambda old, new: jax.tree_util.tree_map(
+                    lambda o, n: jnp.where(found, o, n.astype(o.dtype)),
+                    old, new)
+                new_params = keep(params, new_params)
+                new_state = keep(opt_state, new_state)
+                if dynamic:
+                    good2 = jnp.where(found, 0, good + 1)
+                    bad2 = jnp.where(found, bad + 1, 0)
+                    dec = found & (bad2 >= decr_every)
+                    inc = (~found) & (good2 >= incr_every)
+                    new_scale = jnp.where(
+                        dec, jnp.maximum(scale * decr_ratio, 1.0),
+                        jnp.where(inc, scale * incr_ratio, scale))
+                    good2 = jnp.where(inc, 0, good2)
+                    bad2 = jnp.where(dec, 0, bad2)
+                else:
+                    new_scale, good2, bad2 = scale, good, bad
+                # loss reported unscaled (inf stays inf on overflow steps)
+                return (new_params, new_state, (new_scale, good2, bad2),
+                        scaled_loss * inv)
+
+            self.scaler_state = (jnp.float32(sc._scale),
+                                 jnp.int32(sc._good_steps),
+                                 jnp.int32(sc._bad_steps))
+            donate_args = (0, 1, 2) if donate else ()
+            self._compiled = jax.jit(
+                step,
+                in_shardings=(p_shard, s_shard, None, batch_sharding,
+                              batch_sharding, None, None),
+                out_shardings=(p_shard, s_shard, None,
+                               NamedSharding(mesh, P())),
+                donate_argnums=donate_args,
+            )
+        else:
+            self.scaler_state = None
+
+            def step(params, opt_state, x, y, lr, seed):
+                loss, grads = value_and_grad_accum(params, x, y, seed)
+                new_params, new_state = _clip_and_update(
+                    params, opt_state, grads, lr)
+                return new_params, new_state, loss
+
+            donate_args = (0, 1) if donate else ()
+            self._compiled = jax.jit(
+                step,
+                in_shardings=(p_shard, s_shard, batch_sharding, batch_sharding, None, None),
+                out_shardings=(p_shard, s_shard, NamedSharding(mesh, P())),
+                donate_argnums=donate_args,
+            )
         # for run_steps (multi-step scan): the raw python step + shardings
         self._compiled_step_fn = step
         self._p_shard, self._s_shard = p_shard, s_shard
@@ -487,57 +563,91 @@ class ShardedTrainStep:
         C++ executor running the whole program per call. Returns the [K]
         per-step losses."""
         lr = self.optimizer.get_lr() if lr is None else lr
+        scaled = self.scaler_state is not None
         if self._multi is None:
             base = self._compiled_step_fn
 
-            def multi(params, opt_state, xs, ys, lr, seed):
+            def multi(params, opt_state, sstate, xs, ys, lr, seed):
                 def body(carry, xy):
-                    p, s = carry
+                    p, s, ss = carry
                     xk, yk, k = xy
-                    p, s, loss = base(p, s, xk, yk, lr, seed + k)
-                    return (p, s), loss
+                    if scaled:
+                        p, s, ss, loss = base(p, s, ss, xk, yk, lr, seed + k)
+                    else:
+                        p, s, loss = base(p, s, xk, yk, lr, seed + k)
+                    return (p, s, ss), loss
 
-                (params, opt_state), losses = jax.lax.scan(
-                    body, (params, opt_state),
+                (params, opt_state, sstate), losses = jax.lax.scan(
+                    body, (params, opt_state, sstate),
                     (xs, ys, jnp.arange(xs.shape[0], dtype=jnp.uint32)))
-                return params, opt_state, losses
+                return params, opt_state, sstate, losses
 
             bspec = self._batch_sharding.spec
             stacked = NamedSharding(self.mesh, P(None, *bspec))
             self._multi = jax.jit(
                 multi,
-                in_shardings=(self._p_shard, self._s_shard, stacked, stacked,
-                              None, None),
-                out_shardings=(self._p_shard, self._s_shard,
+                in_shardings=(self._p_shard, self._s_shard, None, stacked,
+                              stacked, None, None),
+                out_shardings=(self._p_shard, self._s_shard, None,
                                NamedSharding(self.mesh, P())),
-                donate_argnums=(0, 1),
+                donate_argnums=(0, 1, 2) if self._donate else (),
             )
         K = xs.shape[0] if hasattr(xs, "shape") else len(xs)
         self._step_i += K
+        ss_in = self.scaler_state if scaled else jnp.zeros((), jnp.float32)
         with jax.set_mesh(self.mesh):
-            self.params, self.opt_state, losses = self._multi(
-                self.params, self.opt_state,
+            self.params, self.opt_state, ss_out, losses = self._multi(
+                self.params, self.opt_state, ss_in,
                 jnp.asarray(xs), jnp.asarray(ys),
                 # +1 so scanned step j draws seed (seed + prev_steps + 1 + j)
                 # — identical to the seeds K sequential __call__s would use
                 jnp.float32(lr), jnp.uint32(self._seed + self._step_i - K + 1))
+        if scaled:
+            self.scaler_state = ss_out
         return losses
 
     def __call__(self, x, y, lr: Optional[float] = None):
         lr = self.optimizer.get_lr() if lr is None else lr
         self._step_i += 1
         with jax.set_mesh(self.mesh):
-            self.params, self.opt_state, loss = self._compiled(
-                self.params,
-                self.opt_state,
-                self._to_global_batch(x),
-                self._to_global_batch(y),
-                jnp.float32(lr),
-                jnp.uint32(self._seed + self._step_i),
-            )
+            if self.scaler_state is not None:
+                (self.params, self.opt_state, self.scaler_state,
+                 loss) = self._compiled(
+                    self.params,
+                    self.opt_state,
+                    self.scaler_state,
+                    self._to_global_batch(x),
+                    self._to_global_batch(y),
+                    jnp.float32(lr),
+                    jnp.uint32(self._seed + self._step_i),
+                )
+            else:
+                self.params, self.opt_state, loss = self._compiled(
+                    self.params,
+                    self.opt_state,
+                    self._to_global_batch(x),
+                    self._to_global_batch(y),
+                    jnp.float32(lr),
+                    jnp.uint32(self._seed + self._step_i),
+                )
         return loss
 
     step = __call__
+
+    def loss_scaling(self) -> float:
+        """Current dynamic loss scale (1.0 when no scaler is attached)."""
+        if self.scaler_state is None:
+            return 1.0
+        return float(self.scaler_state[0])
+
+    def sync_scaler(self):
+        """Write the device scale automaton back into the attached
+        GradScaler (for state_dict/checkpoint round trips)."""
+        if self.scaler_state is None or self._scaler is None:
+            return
+        self._scaler._scale = float(self.scaler_state[0])
+        self._scaler._good_steps = int(self.scaler_state[1])
+        self._scaler._bad_steps = int(self.scaler_state[2])
 
     def sync_to_model(self):
         named = dict(self.model.named_parameters())
@@ -560,6 +670,11 @@ class ShardedTrainStep:
 
     def lower_compiled(self, x, y):
         """AOT-lower (for compile checks without executing)."""
+        if self.scaler_state is not None:
+            return self._compiled.lower(
+                self.params, self.opt_state, self.scaler_state,
+                jnp.asarray(x), jnp.asarray(y), jnp.float32(1e-3),
+                jnp.uint32(0))
         return self._compiled.lower(
             self.params, self.opt_state, jnp.asarray(x), jnp.asarray(y), jnp.float32(1e-3), jnp.uint32(0)
         )

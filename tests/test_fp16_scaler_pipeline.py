@@ -1,0 +1,165 @@
+"""fp16 dynamic loss scaling through the compiled schedules.
+
+Round-3 verdict item 4: GradScaler was absent from the compiled path
+(PipelineParallelWithInterleave.train_batch raised on scaler). Now the
+(scale, good, bad) automaton is device state inside the jitted step
+(reference amp/grad_scaler.py update_loss_scaling): loss scaled before
+autodiff, grads unscaled in f32, non-finite grads skip the optimizer
+update. Tests pin true fp16 (not bf16) training through pp x dp with a
+forced-overflow step that must leave parameters untouched, and the scale
+trajectory matching the eager GradScaler automaton.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    from paddle_tpu.distributed import collective, mesh, topology
+
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+    yield
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+
+
+def _build(pp, dp, M, scaler, dtype="float16"):
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": dp, "pp_degree": pp,
+                        "sharding_degree": 1, "mp_degree": 1}
+    fleet.init(is_collective=True, strategy=s)
+    paddle.seed(0)
+    model = gpt_tiny(dropout=0.0, num_layers=4).astype(dtype)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+    step = make_sharded_train_step(
+        model, opt, accumulate_steps=M if pp > 1 else None, scaler=scaler)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 128, size=(16, 16))
+    y = np.roll(x, -1, axis=1)
+    return step, x, y
+
+
+def test_fp16_pp_dp_trains_with_scaler():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 15)
+    step, x, y = _build(pp=2, dp=2, M=4, scaler=scaler)
+    assert any(v.dtype == jnp.float16 for v in step.params.values())
+    losses = [float(step(x, y)) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0]
+    assert step.loss_scaling() == 2.0 ** 15  # no overflow, incr_every=2000
+
+
+def test_fp16_forced_overflow_skips_update():
+    """A step whose scaled loss overflows must leave params AND optimizer
+    state untouched, halve the scale, and training must resume after."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 10)
+    step, x, y = _build(pp=2, dp=2, M=4, scaler=scaler)
+    l0 = float(step(x, y))
+    assert np.isfinite(l0)
+    before = jax.tree_util.tree_map(np.asarray, step.params)
+
+    # force overflow: scale so large the f32 scaled loss is inf
+    step.scaler_state = (jnp.float32(1e38), step.scaler_state[1],
+                         step.scaler_state[2])
+    l_ovf = float(step(x, y))
+    assert not np.isfinite(l_ovf)
+    after = jax.tree_util.tree_map(np.asarray, step.params)
+    for k in before:
+        np.testing.assert_array_equal(before[k], after[k], err_msg=k)
+    assert step.loss_scaling() == pytest.approx(5e37)  # decr_ratio 0.5
+
+    # resume at a sane scale: the next step trains
+    step.scaler_state = (jnp.float32(2.0 ** 10), step.scaler_state[1],
+                         step.scaler_state[2])
+    l2 = float(step(x, y))
+    assert np.isfinite(l2)
+    resumed = jax.tree_util.tree_map(np.asarray, step.params)
+    assert any(not np.array_equal(before[k], resumed[k]) for k in before)
+
+
+def test_scale_automaton_matches_eager_gradscaler():
+    """Drive the compiled automaton through [overflow, good, good] with
+    incr_every_n_steps=2 and compare scale/counters against the eager
+    GradScaler.update() semantics step by step."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mk = lambda: paddle.amp.GradScaler(init_loss_scaling=1024.0,
+                                       incr_every_n_steps=2,
+                                       decr_every_n_nan_or_inf=1)
+    scaler = mk()
+    step, x, y = _build(pp=1, dp=2, M=None, scaler=scaler)
+
+    eager = mk()
+    trajectory = []
+    # overflow step: push scale to inf-land for exactly one step
+    step.scaler_state = (jnp.float32(1e38), step.scaler_state[1],
+                         step.scaler_state[2])
+    eager._scale = 1e38
+    _ = float(step(x, y))
+    eager._found_inf = True
+    eager.update()
+    trajectory.append((step.loss_scaling(), eager._scale))
+    # two good steps at a matched sane scale -> one x2 growth in both
+    step.scaler_state = (jnp.float32(1024.0), step.scaler_state[1],
+                         step.scaler_state[2])
+    eager._scale = 1024.0
+    for _ in range(2):
+        _ = float(step(x, y))
+        eager._found_inf = False
+        eager.update()
+        trajectory.append((step.loss_scaling(), eager._scale))
+    for got, want in trajectory:
+        assert got == pytest.approx(want), trajectory
+    step.sync_scaler()
+    assert scaler._scale == pytest.approx(eager._scale)
+    assert scaler._good_steps == eager._good_steps
+    assert scaler._bad_steps == eager._bad_steps
+
+
+def test_vpp_train_batch_accepts_scaler():
+    """The interleaved pipeline driver no longer raises on scaler."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        PipelineParallelWithInterleave)
+    from paddle_tpu.models import gpt_tiny
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "pp_degree": 2,
+                        "sharding_degree": 1, "mp_degree": 1}
+    s.pipeline_configs = {"accumulate_steps": 2}
+    fleet.init(is_collective=True, strategy=s)
+    paddle.seed(0)
+    model = gpt_tiny(dropout=0.0, num_layers=4).astype("float16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+    pipe = PipelineParallelWithInterleave(model, strategy=s,
+                                          virtual_pp_degree=2)
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 10)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 128, size=(8, 16))
+    y = np.roll(x, -1, axis=1)
+    l1 = float(pipe.train_batch((x, y), opt, scaler=scaler))
+    l2 = float(pipe.train_batch((x, y), opt, scaler=scaler))
+    assert np.isfinite(l1) and np.isfinite(l2)
+    assert l2 < l1
