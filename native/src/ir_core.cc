@@ -66,7 +66,11 @@ struct IrContext {
   std::vector<Type> types;
   std::map<std::pair<int32_t, std::vector<int64_t>>, int64_t> type_ids;
   std::vector<Value> values;
-  std::vector<Operation> ops;          // program order (with tombstones)
+  std::vector<Operation> ops;          // storage, indexed by op id
+  std::vector<int64_t> order;          // PROGRAM order of op ids (with
+                                       // tombstones) — fusion passes insert
+                                       // replacement ops mid-program via
+                                       // ir_op_move_before
   std::vector<int64_t> block_args;     // value ids
   std::vector<int64_t> outputs;        // value ids
   std::string print_buf;
@@ -181,7 +185,25 @@ int64_t ir_op_create(void* p, const char* name, const int64_t* operands,
   }
   for (int32_t i = 0; i < n_operands; ++i) c->values[operands[i]].use_count++;
   c->ops.push_back(std::move(op));
+  c->order.push_back(c->ops.back().id);
   return c->ops.back().id;
+}
+
+// Reposition `op` immediately before `anchor` in program order (both must be
+// alive). The enabling primitive for pattern-fusion passes: a freshly
+// created replacement op is appended at the end, then moved to the matched
+// subgraph's position so def-before-use holds for downstream consumers.
+int32_t ir_op_move_before(void* p, int64_t op, int64_t anchor) {
+  IrContext* c = Ctx(p);
+  if (!ValidOp(c, op) || !ValidOp(c, anchor) || op == anchor) return -1;
+  auto& ord = c->order;
+  auto it = std::find(ord.begin(), ord.end(), op);
+  if (it == ord.end()) return -1;
+  ord.erase(it);
+  auto at = std::find(ord.begin(), ord.end(), anchor);
+  if (at == ord.end()) { ord.push_back(op); return -1; }
+  ord.insert(at, op);
+  return 0;
 }
 
 int64_t ir_op_result(void* p, int64_t op, int32_t i) {
@@ -292,12 +314,12 @@ int64_t ir_num_ops(void* p) {
   for (const auto& op : c->ops) n += op.alive ? 1 : 0;
   return n;
 }
-// i-th ALIVE op in program order
+// i-th ALIVE op in program order (c->order, which move_before may permute)
 int64_t ir_op_at(void* p, int64_t i) {
   IrContext* c = Ctx(p);
   int64_t seen = 0;
-  for (const auto& op : c->ops)
-    if (op.alive && seen++ == i) return op.id;
+  for (int64_t oid : c->order)
+    if (c->ops[oid].alive && seen++ == i) return oid;
   return -1;
 }
 
@@ -306,10 +328,10 @@ int64_t ir_op_at(void* p, int64_t i) {
 int64_t ir_alive_ops(void* p, int64_t* out, int64_t cap) {
   IrContext* c = Ctx(p);
   int64_t n = 0;
-  for (const auto& op : c->ops)
-    if (op.alive) {
+  for (int64_t oid : c->order)
+    if (c->ops[oid].alive) {
       if (n >= cap) break;
-      out[n++] = op.id;
+      out[n++] = oid;
     }
   return n;
 }
@@ -369,7 +391,8 @@ int32_t ir_verify(void* p) {
       if (op.alive && op.name == const_name->second && op.operands.empty() &&
           !op.side_effect)
         for (int64_t r : op.results) defined[r] = 1;
-  for (const auto& op : c->ops) {
+  for (int64_t oid : c->order) {
+    const auto& op = c->ops[oid];
     if (!op.alive) continue;
     for (int64_t o : op.operands)
       if (o < 0 || o >= static_cast<int64_t>(defined.size()) || !defined[o]) return -1;
@@ -390,14 +413,15 @@ int64_t ir_dce(void* p) {
   bool changed = true;
   while (changed) {
     changed = false;
-    for (auto it = c->ops.rbegin(); it != c->ops.rend(); ++it) {
-      if (!it->alive || it->side_effect) continue;
+    for (auto it = c->order.rbegin(); it != c->order.rend(); ++it) {
+      Operation& op = c->ops[*it];
+      if (!op.alive || op.side_effect) continue;
       bool used = false;
-      for (int64_t r : it->results)
+      for (int64_t r : op.results)
         if (c->values[r].use_count > 0) { used = true; break; }
       if (!used) {
-        it->alive = false;
-        for (int64_t o : it->operands) c->values[o].use_count--;
+        op.alive = false;
+        for (int64_t o : op.operands) c->values[o].use_count--;
         ++removed;
         changed = true;
       }
@@ -452,7 +476,8 @@ int64_t ir_cse(void* p) {
   while (changed) {
     changed = false;
     std::unordered_map<std::string, int64_t> seen;
-    for (auto& op : c->ops) {
+    for (int64_t oid : c->order) {
+      Operation& op = c->ops[oid];
       if (!op.alive || op.side_effect) continue;
       std::string key = OpKey(c, op);
       auto it = seen.find(key);
@@ -495,7 +520,8 @@ int64_t ir_print(void* p, char* buf, int64_t cap) {
     s += ": "; s += type_str(c->values[c->block_args[i]].type_id);
   }
   s += ") {\n";
-  for (const auto& op : c->ops) {
+  for (int64_t oid : c->order) {
+    const auto& op = c->ops[oid];
     if (!op.alive) continue;
     s += "    ";
     for (size_t i = 0; i < op.results.size(); ++i) {

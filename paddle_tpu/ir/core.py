@@ -63,6 +63,7 @@ def _lib():
         "ir_op_operand": (c_i64, [ctypes.c_void_p, c_i64, c_i32]),
         "ir_op_side_effect": (c_i32, [ctypes.c_void_p, c_i64]),
         "ir_op_set_operand": (None, [ctypes.c_void_p, c_i64, c_i32, c_i64]),
+        "ir_op_move_before": (c_i32, [ctypes.c_void_p, c_i64, c_i64]),
         "ir_op_set_attr_i": (None, [ctypes.c_void_p, c_i64, ctypes.c_char_p, c_i64]),
         "ir_op_set_attr_f": (None, [ctypes.c_void_p, c_i64, ctypes.c_char_p, ctypes.c_double]),
         "ir_op_set_attr_s": (None, [ctypes.c_void_p, c_i64, ctypes.c_char_p, ctypes.c_char_p]),
@@ -335,7 +336,11 @@ class Program:
 
     def create_op(self, name: str, operands: Sequence[Value],
                   result_types: Sequence[Type], attrs: Optional[Dict[str, Any]] = None,
-                  side_effect: bool = False) -> Operation:
+                  side_effect: bool = False,
+                  before: Optional["Operation"] = None) -> Operation:
+        """Create an op; with `before=` it is inserted at that op's program
+        position (the pattern-fusion primitive: a replacement op takes the
+        matched subgraph's place so def-before-use holds for its users)."""
         h = self.ctx._h
         ops_arr = (ctypes.c_int64 * max(len(operands), 1))(*[v.id for v in operands])
         res_arr = (ctypes.c_int64 * max(len(result_types), 1))(*[t.id for t in result_types])
@@ -346,6 +351,9 @@ class Program:
         op = Operation(self.ctx, op_id)
         for k, v in (attrs or {}).items():
             self._set_attr(op_id, k, v)
+        if before is not None:
+            if _lib().ir_op_move_before(h, op_id, before.id) != 0:
+                raise ValueError("ir_op_move_before failed")
         return op
 
     def _py_token(self, obj: Any) -> int:

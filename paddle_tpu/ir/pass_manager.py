@@ -54,6 +54,7 @@ def register_pass(pass_cls: Type[Pass]):
 
 DEFAULT_PIPELINE = ["algebraic_simplify", "constant_folding", "cse", "dce"]
 INFERENCE_PIPELINE = ["delete_quant_dequant", "dropout_eliminate",
+                      "multihead_matmul_fuse", "gelu_fuse",
                       "algebraic_simplify", "constant_folding",
                       "affine_chain_collapse", "conv_bn_fuse",
                       "cse", "dce"]

@@ -382,6 +382,400 @@ class ConvBnFusePass(Pass):
         return changed
 
 
+def _skip_through(v, names=("pd.broadcast_in_dim", "pd.stop_gradient",
+                            "pd.convert_element_type", "pd.reshape")):
+    """Walk a value up through shape/metadata-only ops."""
+    while True:
+        op = v.defining_op()
+        if op is None or op.name not in names:
+            return v
+        v = op.operands[0]
+
+
+def _jit_name(program: Program, op) -> str:
+    """The wrapped function's name for a pd.jit (pjit) op, '' otherwise."""
+    if op is None or op.name != "pd.jit" or op.id not in program.op_bind:
+        return ""
+    _, params = program.op_bind[op.id]
+    return str(params.get("name", ""))
+
+
+def _eval_const_chain(program: Program, v, memo=None, limit=1 << 22):
+    """Evaluate a value whose whole defining chain is constant (constants +
+    side-effect-free ops), or None. The mask-recognition analog of
+    ConstantFoldingPass — run once over a small subgraph at match time."""
+    memo = {} if memo is None else memo
+    if v.id in memo:
+        return memo[v.id]
+    cv = _const_value(program, v)
+    if cv is not None:
+        memo[v.id] = np.asarray(cv)
+        return memo[v.id]
+    op = v.defining_op()
+    if op is None or op.has_side_effect:
+        return None
+    if sum(int(np.prod(r.type.shape or (1,))) for r in op.results) > limit:
+        return None
+    vals = []
+    for o in op.operands:
+        val = _eval_const_chain(program, o, memo, limit)
+        if val is None:
+            return None
+        vals.append(val)
+    try:
+        if op.id in program.op_fns:
+            out = program.op_fns[op.id](*vals)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        elif op.id in program.op_bind:
+            prim, params = program.op_bind[op.id]
+            subfuns, bind_params = prim.get_bind_params(params)
+            out = prim.bind(*subfuns, *vals, **bind_params)
+            outs = list(out) if prim.multiple_results else [out]
+        else:
+            return None
+    except Exception:
+        return None
+    for res, ov in zip(op.results, outs):
+        memo[res.id] = np.asarray(ov)
+    return memo.get(v.id)
+
+
+def _is_causal_mask(program: Program, v) -> bool:
+    """True when `v` provably EVALUATES to the standard lower-triangular
+    (diagonal-inclusive) boolean causal mask. Name-sniffing a tril jit is
+    not enough — tril(k=-1) or tril of a non-ones matrix would fuse as
+    standard causal and silently corrupt outputs — so the mask subgraph is
+    evaluated and compared exactly."""
+    m = _eval_const_chain(program, v)
+    if m is None or m.dtype != bool or m.ndim < 2:
+        return False
+    lead = m.shape[:-2]
+    if any(d != 1 for d in lead):
+        return False
+    m2 = m.reshape(m.shape[-2], m.shape[-1])
+    if m2.shape[0] != m2.shape[1]:
+        return False
+    return bool(np.array_equal(m2, np.tril(np.ones_like(m2))))
+
+
+@register_pass
+class MultiheadMatmulFusePass(Pass):
+    """Fuse the decomposed attention subgraph into one op — the reference's
+    multihead_matmul_fuse_pass.cc / fused softmax-mask kernel, TPU-native:
+    the fused op re-binds to the Pallas flash-attention kernel on TPU (or
+    the fused jnp SDPA elsewhere), so a traced-and-optimized serving program
+    runs flash attention even though the trace recorded the decomposed form.
+
+    Anchored on the probs@V dot_general; two tiers:
+    * full fusion — softmax chain, a provably-causal (or absent) mask, the
+      scaled Q@K^T dot all matched → pd.fused_multihead_attention(q, k, v).
+    * softmax+PV collapse — unrecognized masking: the softmax chain and the
+      PV matmul fuse into pd.fused_softmax_matmul(scores, v), leaving the
+      mask arithmetic intact.
+    """
+
+    name = "multihead_matmul_fuse"
+
+    @staticmethod
+    def _reduce_axes(program: Program, op):
+        if op is None or op.id not in program.op_bind:
+            return None
+        axes = program.op_bind[op.id][1].get("axes")
+        return tuple(axes) if axes is not None else None
+
+    def _match_softmax(self, program: Program, probs_v):
+        """probs = div(exp(sub(s, rowmax)), bcast(reduce_sum(exp))) with the
+        reductions over the KEY axis (3 of [b,h,q,k]) — a softmax over any
+        other axis must not fuse as key-axis softmax. Returns the
+        masked-scores value or None. The caller walks probs_v through
+        converts first (bf16 traces cast f32 probs before the PV dot)."""
+        div_op = probs_v.defining_op()
+        if div_op is None or div_op.name != "pd.div":
+            return None
+        exp_v, denom_v = div_op.operands
+        exp_op = exp_v.defining_op()
+        if exp_op is None or exp_op.name != "pd.exp":
+            return None
+        den = _skip_through(denom_v).defining_op()
+        if den is None or den.name != "pd.reduce_sum" \
+                or den.operands[0].id != exp_v.id \
+                or self._reduce_axes(program, den) != (3,):
+            return None
+        sub_op = exp_op.operands[0].defining_op()
+        if sub_op is None or sub_op.name != "pd.sub":
+            return None
+        s_v, rowmax_v = sub_op.operands
+        # the subtracted row-stat must reduce from the same scores over the
+        # same key axis (walk through the max-clamp sdpa inserts for
+        # fully-masked rows)
+        rm = _skip_through(rowmax_v).defining_op()
+        if rm is not None and rm.name == "pd.max":
+            cands = [o for o in rm.operands
+                     if _const_value(program, o) is None]
+            rm = _skip_through(cands[0]).defining_op() if cands else None
+        if rm is None or rm.name != "pd.reduce_max" \
+                or rm.operands[0].id != s_v.id \
+                or self._reduce_axes(program, rm) != (3,):
+            return None
+        return s_v
+
+    def _match_qk(self, program: Program, s_v):
+        """s = [where-jit](mask, scores, fill) | scores;
+        scores = dot(mul(q, c), k). Returns (q, k, scale, causal) or None."""
+        causal = False
+        sop = s_v.defining_op()
+        if sop is not None and sop.name == "pd.jit" \
+                and "where" in _jit_name(program, sop) \
+                and len(sop.operands) == 3:
+            mask_v, scores_v, fill_v = sop.operands
+            fill = _const_value(program, fill_v)
+            if fill is None or not np.all(np.asarray(fill) <= -1e20):
+                return None
+            if not _is_causal_mask(program, mask_v):
+                return None  # additive/padding masks: tier-2 handles
+            causal = True
+            sop = scores_v.defining_op()
+        if sop is None or sop.name != "pd.dot_general":
+            return None
+        # q/k must enter [b,s,h,d]: batch dims (0,2)=(b,h), contract d=3 on
+        # BOTH sides (the einsum "bqhd,bkhd->bhqk" lowering) — anything else
+        # would reorder the scores layout the softmax match assumed
+        if sop.id not in program.op_bind:
+            return None
+        dn_s = program.op_bind[sop.id][1].get("dimension_numbers")
+        if dn_s is None:
+            return None
+        (slc, src), (slb, srb) = dn_s
+        if tuple(slb) != (0, 2) or tuple(srb) != (0, 2) \
+                or tuple(slc) != (3,) or tuple(src) != (3,):
+            return None
+        qs_v, k_v = sop.operands
+        scale = None
+        qs_op = qs_v.defining_op()
+        if qs_op is not None and qs_op.name == "pd.mul":
+            for i in (1, 0):
+                c = _const_value(program, qs_op.operands[i])
+                if c is not None and np.asarray(c).size == 1:
+                    scale = float(np.asarray(c).reshape(()))
+                    qs_v = qs_op.operands[1 - i]
+                    break
+        if scale is None:
+            scale = 1.0
+        # q/k enter as [B, S, H, D] (the pre-einsum reshape outputs)
+        if len(qs_v.type.shape) != 4 or len(k_v.type.shape) != 4:
+            return None
+        return qs_v, k_v, scale, causal
+
+    @staticmethod
+    def _pv_layout(program: Program, pv, probs_idx):
+        """Validate the probs@V dot's dimension_numbers and derive the
+        permutation from SDPA's natural [b, q, h, d] output to the dot's
+        actual output layout. probs is [b, h, q, k] (guaranteed by the
+        matched softmax/scores structure); V must be [b, s, h, d]. XLA's
+        output dim order is batch dims then lhs-free then rhs-free — the
+        orientation is NOT fixed (it emits [b,h,d,q] when V is the lhs), so
+        it must be derived, not assumed. Returns the permutation or None."""
+        if pv.id not in program.op_bind:
+            return None
+        _, params = program.op_bind[pv.id]
+        dn = params.get("dimension_numbers")
+        if dn is None:
+            return None
+        (lc, rc), (lb, rb) = dn
+        if len(lc) != 1 or len(lb) != 2:
+            return None
+        # contraction/batch specs per operand role
+        if probs_idx == 1:
+            p_c, p_b, v_c, v_b = rc[0], tuple(rb), lc[0], tuple(lb)
+        else:
+            p_c, p_b, v_c, v_b = lc[0], tuple(lb), rc[0], tuple(rb)
+        # probs [b,h,q,k]: batch (0,1) in order, contract k=3, free q=2
+        if p_b != (0, 1) or p_c != 3:
+            return None
+        # v [b,s,h,d]: batch (0,2) pairing (b,h), contract s=1, free d=3
+        if v_b != (0, 2) or v_c != 1:
+            return None
+        # output = batch(b,h) + lhs-free + rhs-free
+        labels = ["b", "h"] + (["d", "q"] if probs_idx == 1 else ["q", "d"])
+        sdpa_axis = {"b": 0, "q": 1, "h": 2, "d": 3}
+        return tuple(sdpa_axis[l] for l in labels)
+
+    def run(self, program: Program) -> int:
+        changed = 0
+        for pv in program.ops():
+            if pv.name != "pd.dot_general" or len(pv.operands) != 2:
+                continue
+            a, b = pv.operands
+            if len(a.type.shape) != 4 or len(b.type.shape) != 4:
+                continue
+            # the probs operand is the one rooted in the softmax chain —
+            # walked through converts (bf16 traces cast the f32 probs
+            # before the PV dot; without this the pass is a silent no-op
+            # for mixed-precision serving)
+            s_v, probs_idx = None, None
+            for idx, cand in ((1, b), (0, a)):
+                s_v = self._match_softmax(
+                    program,
+                    _skip_through(cand, ("pd.convert_element_type",)))
+                if s_v is not None:
+                    probs_idx = idx
+                    break
+            if s_v is None:
+                continue
+            v_v = pv.operands[1 - probs_idx]
+            perm = self._pv_layout(program, pv, probs_idx)
+            if perm is None:
+                continue
+            # dtype name string: jnp.astype accepts it, incl. 'bfloat16'
+            out_dtype = str(pv.result(0).type.dtype)
+            qk = self._match_qk(program, s_v)
+            if qk is not None:
+                q_v, k_v, scale, causal = qk
+
+                def fused(q, k, v, _scale=scale, _causal=causal, _perm=perm,
+                          _dt=out_dtype):
+                    import jax.numpy as jnp
+
+                    from ..nn.functional.attention import _sdpa_ref, _use_pallas
+
+                    o = None
+                    # flash kernel requires self-attention shapes (its
+                    # blocks tile one shared seq length)
+                    if _use_pallas(q.dtype) and q.shape[1] == k.shape[1]:
+                        from ..kernels.flash_attention import (
+                            _pick_blocks, flash_attention_fwd)
+
+                        if _pick_blocks(q.shape[1])[0] is not None:
+                            o = flash_attention_fwd(q, k, v, causal=_causal,
+                                                    scale=_scale)
+                    if o is None:
+                        o = _sdpa_ref(q, k, v, causal=_causal, scale=_scale)
+                    return jnp.transpose(o, _perm).astype(_dt)
+
+                op = program.create_op(
+                    "pd.fused_multihead_attention", [q_v, k_v, v_v],
+                    [pv.result(0).type],
+                    attrs={"scale": scale, "causal": causal}, before=pv)
+                program.op_fns[op.id] = fused
+            else:
+                def fused_sm(s, v, _perm=perm, _dt=out_dtype):
+                    import jax
+                    import jax.numpy as jnp
+
+                    probs = jax.nn.softmax(s.astype(np.float32), axis=-1)
+                    o = jnp.einsum("bhqk,bkhd->bqhd",
+                                   probs.astype(v.dtype), v)
+                    return jnp.transpose(o, _perm).astype(_dt)
+
+                op = program.create_op(
+                    "pd.fused_softmax_matmul", [s_v, v_v],
+                    [pv.result(0).type], before=pv)
+                program.op_fns[op.id] = fused_sm
+            pv.result(0).replace_all_uses_with(op.result(0))
+            pv.erase()
+            changed += 1
+        if changed:
+            program.dce()  # the matched interior is now dead
+        return changed
+
+
+@register_pass
+class GeluFusePass(Pass):
+    """Collapse the traced 8-op tanh-approx GELU polynomial into one op
+    (fc_elementwise_act / gelu fuse family of framework/ir): the pattern is
+    mul(x, mul(0.5, add(1, tanh(mul(c, add(x, mul(0.044715, x^3))))))),
+    byte-matched on the constants so lookalike arithmetic is left alone."""
+
+    name = "gelu_fuse"
+
+    @staticmethod
+    def _const_scalar(program, v):
+        c = _const_value(program, v)
+        if c is None:
+            return None
+        c = np.asarray(c)
+        return float(c.reshape(())) if c.size == 1 else None
+
+    def _split_mul(self, program, op, want):
+        """mul op with one const ≈ want: returns the non-const operand.
+        Tolerance is loose (1%) because bf16 traces round the polynomial
+        constants (0.044715 -> 0.044678); the surrounding structural match
+        (x^3, tanh, the exact chain shape) carries the specificity."""
+        if op is None or op.name != "pd.mul":
+            return None
+        for i in (0, 1):
+            c = self._const_scalar(program, op.operands[i])
+            if c is not None and abs(c - want) < 1e-2 * abs(want):
+                return op.operands[1 - i]
+        return None
+
+    def run(self, program: Program) -> int:
+        changed = 0
+        for outer in program.ops():
+            if outer.name != "pd.mul" or len(outer.operands) != 2:
+                continue
+            for xi in (0, 1):
+                x_v, inner_v = outer.operands[xi], outer.operands[1 - xi]
+                half_arg = self._split_mul(program, inner_v.defining_op(), 0.5)
+                if half_arg is None:
+                    continue
+                add1 = half_arg.defining_op()
+                if add1 is None or add1.name != "pd.add":
+                    continue
+                tanh_v = None
+                for j in (0, 1):
+                    c = self._const_scalar(program, add1.operands[j])
+                    if c is not None and abs(c - 1.0) < 1e-6:
+                        tanh_v = add1.operands[1 - j]
+                if tanh_v is None:
+                    continue
+                tanh_op = tanh_v.defining_op()
+                if tanh_op is None or tanh_op.name != "pd.tanh":
+                    continue
+                s_arg = self._split_mul(program, tanh_op.operands[0].defining_op(),
+                                        float(np.sqrt(2.0 / np.pi)))
+                if s_arg is None:
+                    continue
+                add2 = s_arg.defining_op()
+                if add2 is None or add2.name != "pd.add":
+                    continue
+                cube_v = None
+                for j in (0, 1):
+                    if add2.operands[j].id == x_v.id:
+                        cube_v = add2.operands[1 - j]
+                if cube_v is None:
+                    continue
+                g_arg = self._split_mul(program, cube_v.defining_op(), 0.044715)
+                if g_arg is None:
+                    continue
+                pow_op = g_arg.defining_op()
+                if pow_op is None or pow_op.name != "pd.integer_pow" \
+                        or pow_op.operands[0].id != x_v.id:
+                    continue
+
+                def gelu(x):
+                    import jax.numpy as jnp
+
+                    # dtype-preserving tanh polynomial (python scalars stay
+                    # weak-typed): jax.nn.gelu upcasts bf16 internally,
+                    # which measured 20% SLOWER than the traced bf16 chain
+                    inner = x + 0.044715 * x * x * x
+                    return 0.5 * x * (1.0 + jnp.tanh(0.7978845608028654 * inner))
+
+                op = program.create_op("pd.gelu", [x_v],
+                                       [outer.result(0).type],
+                                       attrs={"approximate": True},
+                                       before=outer)
+                program.op_fns[op.id] = gelu
+                outer.result(0).replace_all_uses_with(op.result(0))
+                outer.erase()
+                changed += 1
+                break
+        if changed:
+            program.dce()
+        return changed
+
+
 @register_pass
 class DropoutEliminatePass(Pass):
     """Inference-only: pd.dropout → identity (delete_dropout_op_pass analog).
