@@ -445,8 +445,9 @@ def _is_causal_mask(program: Program, v) -> bool:
     (diagonal-inclusive) boolean causal mask. Name-sniffing a tril jit is
     not enough — tril(k=-1) or tril of a non-ones matrix would fuse as
     standard causal and silently corrupt outputs — so the mask subgraph is
-    evaluated and compared exactly."""
-    m = _eval_const_chain(program, v)
+    evaluated and compared exactly. The element limit covers bool masks up
+    to seq 8192 (the long-context serving case this fusion exists for)."""
+    m = _eval_const_chain(program, v, limit=8192 * 8192)
     if m is None or m.dtype != bool or m.ndim < 2:
         return False
     lead = m.shape[:-2]
@@ -751,6 +752,11 @@ class GeluFusePass(Pass):
                 pow_op = g_arg.defining_op()
                 if pow_op is None or pow_op.name != "pd.integer_pow" \
                         or pow_op.operands[0].id != x_v.id:
+                    continue
+                # the polynomial term must be exactly x^3 — an x^2/x^4
+                # lookalike with the same chain shape is NOT gelu
+                if pow_op.id not in program.op_bind \
+                        or program.op_bind[pow_op.id][1].get("y") != 3:
                     continue
 
                 def gelu(x):

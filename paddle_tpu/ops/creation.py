@@ -296,6 +296,18 @@ def poisson(x, name=None):
     return Tensor(jax.random.poisson(_key(), x._value).astype(x._jdtype()))
 
 
+@register_op("exponential")
+def exponential(x, lam=1.0, name=None):
+    """Out-of-place Exponential(lam) samples shaped like x (phi
+    exponential_kernel.h). Thin wrapper over the in-place
+    Tensor.exponential_ sampler (ops/math.exponential_) so the two surfaces
+    share one implementation."""
+    from .math import exponential_
+
+    x = as_tensor(x)
+    return exponential_(Tensor(x._value), lam=lam)
+
+
 @register_op("multinomial")
 def multinomial(x, num_samples=1, replacement=False, name=None):
     x = as_tensor(x)

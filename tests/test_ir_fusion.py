@@ -223,6 +223,43 @@ def test_cross_attention_fused_without_flash_crash():
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-6)
 
 
+def test_gelu_lookalike_with_square_not_fused():
+    """The exact gelu chain shape but with x^2 instead of x^3 must be left
+    alone (the exponent is part of the pattern)."""
+    x = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+
+    def call(x):
+        inner = x + 0.044715 * x ** 2  # NOT gelu
+        return x * (0.5 * (1.0 + jnp.tanh(0.7978845608 * inner)))
+
+    ref = np.asarray(call(x))
+    prog = _ir.trace(call, x)
+    stats = PassManager(["gelu_fuse"]).run(prog)
+    assert stats["gelu_fuse"] == 0
+    out = jax.jit(prog.to_callable())(x)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+
+def test_causal_fusion_at_long_context():
+    """The mask evaluation limit must not silently drop the flash rebind at
+    long-context sizes (S=4096)."""
+    S = 4096
+    q = np.zeros((1, S, 1, 8), np.float32)
+
+    def call(q):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, q)
+        m = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(m, s, jnp.float32(-1e30))
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, q)
+
+    prog = _ir.trace(call, q)
+    stats = PassManager(["multihead_matmul_fuse"]).run(prog)
+    assert stats["multihead_matmul_fuse"] == 1
+    c = _op_counts(prog)
+    assert c.get("pd.fused_multihead_attention", 0) == 1
+
+
 def test_create_op_before_preserves_program_order():
     """The native insert-before primitive: a replacement op created at the
     matched position keeps def-before-use for downstream consumers."""

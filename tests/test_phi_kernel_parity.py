@@ -1,0 +1,68 @@
+"""PHI kernel-header parity gate (VERDICT r3 item 6).
+
+tools/phi_kernel_parity.py enumerates the reference's phi/kernels/*.h
+signature headers (the op-kernel surface the fluid tail bottoms out in) and
+classifies all ~268 op families as registered / composed / n-a. This test
+keeps that classification honest: the unclassified fraction stays under the
+5% bar (currently 0), every `composed` mapping target actually imports, the
+`registered` claims re-resolve against the live surface, and the checked-in
+OPS_PARITY.md is the current generator output (not a stale artifact).
+"""
+
+import os
+
+import pytest
+
+REF = "/root/reference/paddle/phi/kernels"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference checkout not available")
+
+
+def _rows():
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import phi_kernel_parity as pkp
+
+    return pkp, pkp.classify()
+
+
+def test_under_five_percent_unclassified():
+    _, rows = _rows()
+    assert len(rows) > 250, "header enumeration collapsed"
+    unclassified = [n for n, s, _ in rows if s == "unclassified"]
+    assert len(unclassified) / len(rows) < 0.05, unclassified
+
+
+def test_composed_targets_import():
+    pkp, rows = _rows()
+    missing = []
+    for name, status, detail in rows:
+        if status != "composed":
+            continue
+        target = detail.split(" ")[0]
+        try:
+            obj = pkp.resolve_target(target)
+        except ImportError:
+            missing.append((name, target))
+            continue
+        assert obj is not None
+    assert not missing, missing
+
+
+def test_registered_claims_resolve():
+    pkp, rows = _rows()
+    broken = [n for n, s, _ in rows
+              if s == "registered" and not pkp._auto_resolve(n)]
+    assert not broken, broken
+
+
+def test_parity_table_is_current():
+    pkp, rows = _rows()
+    path = os.path.join(os.path.dirname(__file__), "..", "OPS_PARITY.md")
+    with open(path) as f:
+        on_disk = f.read()
+    assert on_disk == pkp.render(rows), (
+        "OPS_PARITY.md is stale — regenerate with "
+        "`python tools/phi_kernel_parity.py`")
