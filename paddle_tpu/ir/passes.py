@@ -440,14 +440,16 @@ def _eval_const_chain(program: Program, v, memo=None, limit=1 << 22):
     return memo.get(v.id)
 
 
-def _is_causal_mask(program: Program, v) -> bool:
+def _is_causal_mask(program: Program, v, memo=None) -> bool:
     """True when `v` provably EVALUATES to the standard lower-triangular
     (diagonal-inclusive) boolean causal mask. Name-sniffing a tril jit is
     not enough — tril(k=-1) or tril of a non-ones matrix would fuse as
     standard causal and silently corrupt outputs — so the mask subgraph is
     evaluated and compared exactly. The element limit covers bool masks up
-    to seq 8192 (the long-context serving case this fusion exists for)."""
-    m = _eval_const_chain(program, v, limit=8192 * 8192)
+    to seq 8192 (the long-context serving case this fusion exists for);
+    `memo` is shared across a pass run so a mask feeding every layer is
+    evaluated once, not per attention site."""
+    m = _eval_const_chain(program, v, memo=memo, limit=8192 * 8192)
     if m is None or m.dtype != bool or m.ndim < 2:
         return False
     lead = m.shape[:-2]
@@ -520,7 +522,7 @@ class MultiheadMatmulFusePass(Pass):
             return None
         return s_v
 
-    def _match_qk(self, program: Program, s_v):
+    def _match_qk(self, program: Program, s_v, memo=None):
         """s = [where-jit](mask, scores, fill) | scores;
         scores = dot(mul(q, c), k). Returns (q, k, scale, causal) or None."""
         causal = False
@@ -532,7 +534,7 @@ class MultiheadMatmulFusePass(Pass):
             fill = _const_value(program, fill_v)
             if fill is None or not np.all(np.asarray(fill) <= -1e20):
                 return None
-            if not _is_causal_mask(program, mask_v):
+            if not _is_causal_mask(program, mask_v, memo=memo):
                 return None  # additive/padding masks: tier-2 handles
             causal = True
             sop = scores_v.defining_op()
@@ -603,6 +605,7 @@ class MultiheadMatmulFusePass(Pass):
 
     def run(self, program: Program) -> int:
         changed = 0
+        eval_memo: dict = {}  # mask-evaluation cache shared across matches
         for pv in program.ops():
             if pv.name != "pd.dot_general" or len(pv.operands) != 2:
                 continue
@@ -629,7 +632,7 @@ class MultiheadMatmulFusePass(Pass):
                 continue
             # dtype name string: jnp.astype accepts it, incl. 'bfloat16'
             out_dtype = str(pv.result(0).type.dtype)
-            qk = self._match_qk(program, s_v)
+            qk = self._match_qk(program, s_v, memo=eval_memo)
             if qk is not None:
                 q_v, k_v, scale, causal = qk
 

@@ -1,0 +1,172 @@
+"""Cost-model calibration against measured BASELINE rows (VERDICT r3 item 8).
+
+The planner prices hybrid factorizations with auto_parallel/cost.py. Round 3
+flagged its constants as unvalidated guesses; round 4 calibrates the compute
+term against the five measured single-chip rows (CALIBRATED_MFU, error bars
+in its docstring) and validates the communication BYTE formulas against the
+collectives GSPMD actually emits on the virtual mesh (one chip measures no
+collective time, but the volumes are checkable exactly). The planner tests
+then pin the known-best factorization per BASELINE config family.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.auto_parallel.cost import (
+    CALIBRATED_MFU, ClusterSpec, CostModel, ModelSpec, TrainConfig)
+
+# (name, ModelSpec kwargs, batch, measured single-chip step seconds)
+# from BASELINE.md round-4 measured rows
+MEASURED_ROWS = [
+    ("gpt_1p3b", dict(hidden=2048, layers=24, heads=16, vocab=50304,
+                      seq=2048, kind="gpt"), 16, 2.6234),
+    ("bert_base", dict(hidden=768, layers=12, heads=12, vocab=30522,
+                       seq=128, kind="bert"), 32, 0.0370),
+    ("ernie_base", dict(hidden=768, layers=12, heads=12, vocab=40000,
+                        seq=512, kind="ernie_mlm"), 32, 0.2843),
+]
+
+
+def _single_chip_predict(mkw, batch):
+    cl = ClusterSpec(n_devices=1, hbm_bytes=1e12)
+    cm = CostModel(cl, ModelSpec(**mkw), TrainConfig(batch=batch))
+    bd = cm.cost(dp=1)
+    assert bd.feasible, bd.reason
+    return bd.total_time
+
+
+@pytest.mark.parametrize("name,mkw,batch,measured",
+                         [r for r in MEASURED_ROWS if r[3] is not None],
+                         ids=[r[0] for r in MEASURED_ROWS if r[3] is not None])
+def test_calibrated_compute_matches_measurement(name, mkw, batch, measured):
+    """Predicted single-chip step time within ±20% of the measured row (the
+    gpt family is within a few percent — its MFU has two measured points)."""
+    pred = _single_chip_predict(mkw, batch)
+    rel = abs(pred - measured) / measured
+    tol = 0.10 if mkw["kind"] == "gpt" else 0.20
+    assert rel < tol, f"{name}: predicted {pred:.3f}s vs measured {measured}s"
+
+
+def test_calibration_table_documents_families():
+    assert set(CALIBRATED_MFU) >= {"gpt", "bert", "ernie_mlm", "gpt_moe",
+                                   "resnet"}
+    assert all(0.05 < v < 0.9 for v in CALIBRATED_MFU.values())
+
+
+def _hlo_collective_bytes(compiled_text, kinds=("all-reduce",)):
+    """Sum result bytes over collective ops in optimized (post-SPMD) HLO.
+    Bucketed grad syncs emit TUPLE-shaped all-reduces, so every shape token
+    on the result side of the '=' counts."""
+    import re
+
+    sizes = {"f32": 4, "bf16": 2, "f16": 2}
+    total = 0
+    for line in compiled_text.splitlines():
+        if "=" not in line:
+            continue
+        _, _, rhs = line.partition("=")
+        pos = min((rhs.find(k + "(") for k in kinds if k + "(" in rhs),
+                  default=-1)
+        if pos < 0:
+            continue
+        for m in re.finditer(r"(\w+)\[([\d,]*)\]", rhs[:pos]):
+            dt, dims = m.group(1), m.group(2)
+            if dt not in sizes:
+                continue
+            n = sizes[dt]
+            for d in (dims.split(",") if dims else []):
+                n *= int(d)
+            total += n
+    return total
+
+
+def test_dp_comm_volume_matches_emitted_hlo():
+    """The cost model charges the dp grad sync at 2*P*(d-1)/d bytes per chip
+    (ring all-reduce). Validate the underlying tensor set: the all-reduce
+    ops GSPMD emits for a dp=2 step must cover ~all parameter gradients —
+    their summed operand bytes equal n_params * 4 (f32 grads) within 15%."""
+    from paddle_tpu.distributed import collective, mesh, topology
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "pp_degree": 1,
+                        "sharding_degree": 1, "mp_degree": 1}
+    fleet.init(is_collective=True, strategy=s)
+    paddle.seed(0)
+    model = gpt_tiny(dropout=0.0)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = make_sharded_train_step(model, opt)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 128, size=(8, 16))
+    y = np.roll(x, -1, axis=1)
+    txt = step.lower_compiled(x, y).compile().as_text()
+    got = _hlo_collective_bytes(txt)
+    n_params = sum(int(np.prod(v.shape)) for v in step.params.values())
+    want = n_params * 4
+    assert got > 0, "no all-reduce emitted for a dp=2 step"
+    assert abs(got - want) / want < 0.15, (
+        f"all-reduce bytes {got} vs grad bytes {want}")
+    # cleanup
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+
+
+def test_planner_picks_data_parallel_for_fitting_gpt():
+    """GPT-3 1.3B fits one v5e chip (measured row trains at B=16): at 8
+    chips the known-best plan is pure data parallelism (+ZeRO for states) —
+    mp/pp would only add communication."""
+    from paddle_tpu.distributed.fleet import plan_hybrid_configs
+
+    c = plan_hybrid_configs(
+        model=dict(hidden=2048, layers=24, heads=16, vocab=50304, seq=2048),
+        batch=64, cluster=dict(n_devices=8), zero_stage=1)
+    assert c["mp_degree"] == 1 and c["pp_degree"] == 1, c
+    assert c["dp_degree"] * c["sharding_degree"] == 8, c
+
+
+def test_planner_shards_model_that_cannot_fit():
+    """A ~6.7B model cannot fit 16 GB per chip replicated (107 GB of f32
+    params+grads+moments): the calibrated planner must produce a feasible
+    plan with model sharding (mp, pp, or ZeRO param sharding) engaged —
+    and a truly impossible model (13B, >16 GB/chip even fully sharded)
+    must raise rather than emit a fake plan."""
+    from paddle_tpu.distributed.fleet import plan_hybrid_configs
+
+    c = plan_hybrid_configs(
+        model=dict(hidden=4096, layers=32, heads=32, vocab=50304, seq=2048),
+        batch=64, cluster=dict(n_devices=8), zero_stage=3,
+        accumulate_steps=8)
+    sharded = (c["mp_degree"] > 1 or c["pp_degree"] > 1
+               or c["sharding_degree"] > 1)
+    assert sharded, c
+
+    with pytest.raises(ValueError, match="no feasible"):
+        plan_hybrid_configs(
+            model=dict(hidden=5120, layers=40, heads=40, vocab=50304,
+                       seq=2048),
+            batch=64, cluster=dict(n_devices=8), zero_stage=3,
+            accumulate_steps=8)
+
+
+def test_planner_picks_dp_for_bert_class():
+    """BERT/ERNIE-base (~110M) at 8 chips: data parallel wins regardless of
+    the family MFU calibration (relative axis costs decide)."""
+    from paddle_tpu.distributed.fleet import plan_hybrid_configs
+
+    for kind in ("bert", "ernie_mlm"):
+        c = plan_hybrid_configs(
+            model=dict(hidden=768, layers=12, heads=12, vocab=30522,
+                       seq=128, kind=kind),
+            batch=256, cluster=dict(n_devices=8), zero_stage=1)
+        assert c["mp_degree"] == 1 and c["pp_degree"] == 1, (kind, c)
