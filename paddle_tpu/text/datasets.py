@@ -1,11 +1,17 @@
 """Text datasets (reference python/paddle/text/datasets/: conll05.py, imdb.py,
 imikolov.py, movielens.py, uci_housing.py, wmt14.py, wmt16.py).
 
-The reference streams tarballs from paddle's dataset CDN. This environment has
-zero egress, so each dataset reads a local `data_file` when given one and
-otherwise synthesizes a deterministic corpus with the same record schema
-(field count, dtypes, vocab behavior) — the same hermetic-fallback contract as
-paddle_tpu.vision.datasets.
+Three data paths per dataset, in priority order:
+
+1. ``data_file=`` — parse a local file in the reference's on-disk format
+   (tarballs, CoNLL props, tab-parallel text...), the real parse code.
+2. ``download=True`` — the reference's download/cache protocol
+   (utils.download.dataset_path): resolve the CDN URL against
+   ``$PADDLE_TPU_DATA_HOME``, fetching only when
+   ``PADDLE_TPU_ALLOW_DOWNLOAD=1`` (this build targets hermetic
+   environments; a cache miss without the env raises with remediation).
+3. neither — synthesize a deterministic corpus with the same record schema
+   (field count, dtypes, vocab behavior), the offline test fallback.
 """
 
 from __future__ import annotations
@@ -18,20 +24,36 @@ from typing import Optional
 import numpy as np
 
 from ..io import Dataset
+from ..utils.download import dataset_path
+
+
+def _resolve(data_file, download, url, module, md5):
+    """The 3-way path selection shared by every dataset here. An explicitly
+    named data_file that does not exist is an ERROR — silently falling back
+    to the CDN artifact or a synthetic corpus would train on different data
+    than the user asked for."""
+    if data_file:
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(f"data_file {data_file!r} does not exist")
+        return data_file
+    if download and url:
+        return dataset_path(url, module, md5)
+    return None
 
 
 class UCIHousing(Dataset):
     """13 float features -> 1 float target (uci_housing.py analog)."""
 
     FEATURE_DIM = 13
+    URL = "http://paddlemodels.bj.bcebos.com/uci_housing/housing.data"
+    MD5 = "d4accdce7a25600298819f8e28e8d593"
 
     def __init__(self, data_file: Optional[str] = None, mode: str = "train", download: bool = False, n_synthetic: int = 404):
         mode = mode.lower()
-        if data_file and os.path.exists(data_file):
+        data_file = _resolve(data_file, download, self.URL, "uci_housing", self.MD5)
+        if data_file:
             raw = np.loadtxt(data_file).astype(np.float32)
         else:
-            if download:
-                raise RuntimeError("downloads unavailable; pass data_file")
             rng = np.random.RandomState(0)
             w = rng.rand(self.FEATURE_DIM).astype(np.float32)
             X = rng.rand(n_synthetic + 102, self.FEATURE_DIM).astype(np.float32)
@@ -59,13 +81,15 @@ def _synthetic_docs(rng, n_docs, vocab_size, lo=10, hi=120):
 class Imdb(Dataset):
     """Binary sentiment docs as word-id arrays (imdb.py analog)."""
 
+    URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+    MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
+
     def __init__(self, data_file: Optional[str] = None, mode: str = "train", cutoff: int = 150, download: bool = False, n_synthetic: int = 256):
         mode = mode.lower()
-        if data_file and os.path.exists(data_file):
+        data_file = _resolve(data_file, download, self.URL, "imdb", self.MD5)
+        if data_file:
             self.docs, self.labels, self.word_idx = self._load(data_file, mode, cutoff)
         else:
-            if download:
-                raise RuntimeError("downloads unavailable; pass data_file")
             vocab = 2000
             rng = np.random.RandomState(0 if mode == "train" else 1)
             self.docs = _synthetic_docs(rng, n_synthetic, vocab)
@@ -104,15 +128,17 @@ class Imdb(Dataset):
 class Imikolov(Dataset):
     """PTB-style n-gram tuples (imikolov.py analog)."""
 
+    URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tgz"
+    MD5 = "30177ea32e27c525793142b6bf2c8e2d"
+
     def __init__(self, data_file: Optional[str] = None, data_type: str = "NGRAM", window_size: int = 5, mode: str = "train", min_word_freq: int = 50, download: bool = False, n_synthetic: int = 512):
         mode = mode.lower()
         self.data_type = data_type.upper()
         self.window_size = window_size
-        if data_file and os.path.exists(data_file):
+        data_file = _resolve(data_file, download, self.URL, "imikolov", self.MD5)
+        if data_file:
             sents, self.word_idx = self._load(data_file, mode, min_word_freq)
         else:
-            if download:
-                raise RuntimeError("downloads unavailable; pass data_file")
             vocab = 500
             rng = np.random.RandomState(0 if mode == "train" else 1)
             sents = _synthetic_docs(rng, n_synthetic // 4, vocab, lo=window_size + 1, hi=40)
@@ -150,14 +176,16 @@ class Imikolov(Dataset):
 class Movielens(Dataset):
     """(user_feats, movie_feats, rating) records (movielens.py analog)."""
 
+    URL = "https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip"
+    MD5 = "c4d9eecfca2ab87c1945afe126590906"
+
     def __init__(self, data_file: Optional[str] = None, mode: str = "train", test_ratio: float = 0.1, rand_seed: int = 0, download: bool = False, n_synthetic: int = 1024):
         mode = mode.lower()
         rng = np.random.RandomState(rand_seed)
-        if data_file and os.path.exists(data_file):
+        data_file = _resolve(data_file, download, self.URL, "movielens", self.MD5)
+        if data_file:
             records = self._load(data_file)
         else:
-            if download:
-                raise RuntimeError("downloads unavailable; pass data_file")
             records = []
             for _ in range(n_synthetic):
                 user = [rng.randint(1, 6041), rng.randint(0, 2), rng.randint(0, 7), rng.randint(0, 21)]
@@ -167,14 +195,24 @@ class Movielens(Dataset):
         self.data = [r for r, t in zip(records, is_test) if t == (mode == "test")]
 
     def _load(self, data_file):
+        import zipfile
+
         records = []
-        with tarfile.open(data_file) as tf:
-            ratings = [m for m in tf.getnames() if m.endswith("ratings.dat")][0]
-            for ln in tf.extractfile(ratings).read().decode("latin1").splitlines():
-                u, m, r, _ = ln.split("::")
-                records.append(
-                    (np.asarray([int(u), 0, 0, 0], np.int64), np.asarray([int(m), 0, 0], np.int64), np.float32(r))
-                )
+        if zipfile.is_zipfile(data_file):  # the CDN artifact is ml-1m.zip
+            with zipfile.ZipFile(data_file) as zf:
+                name = [m for m in zf.namelist() if m.endswith("ratings.dat")][0]
+                text = zf.read(name).decode("latin1")
+        else:
+            with tarfile.open(data_file) as tf:
+                name = [m for m in tf.getnames() if m.endswith("ratings.dat")][0]
+                text = tf.extractfile(name).read().decode("latin1")
+        for ln in text.splitlines():
+            if not ln.strip():
+                continue
+            u, m, r, _ = ln.split("::")
+            records.append(
+                (np.asarray([int(u), 0, 0, 0], np.int64), np.asarray([int(m), 0, 0], np.int64), np.float32(r))
+            )
         return records
 
     def __getitem__(self, idx):
@@ -187,16 +225,24 @@ class Movielens(Dataset):
 class Conll05st(Dataset):
     """SRL records: (words, predicate, marks, labels) (conll05.py analog).
 
-    Real-data path: ``data_file`` is a CoNLL-style text file — one token per
-    line as "word<TAB>label", a "1" in a third column marking the predicate,
-    blank line between sentences.
+    Real-data paths: ``data_file`` may be the reference's CDN tarball
+    (conll05st-tests.tar.gz: paired words.gz/props.gz streams with
+    per-predicate span columns, parsed to B-I-O labels, one record per
+    predicate — conll05.py _load_anno), or a flat CoNLL-style text file —
+    one token per line as "word<TAB>label", a "1" in a third column marking
+    the predicate, blank line between sentences.
     """
 
+    URL = "http://paddlemodels.bj.bcebos.com/conll05st/conll05st-tests.tar.gz"
+    MD5 = "387719152ae52d60422c016e92a742fc"
+
     def __init__(self, data_file: Optional[str] = None, mode: str = "train", download: bool = False, n_synthetic: int = 128):
-        if download and not (data_file and os.path.exists(data_file)):
-            raise RuntimeError("downloads unavailable; pass data_file")
-        if data_file and os.path.exists(data_file):
-            self.data, self.word_dict, self.label_dict = self._load(data_file)
+        data_file = _resolve(data_file, download, self.URL, "conll05st", self.MD5)
+        if data_file:
+            if tarfile.is_tarfile(data_file):
+                self.data, self.word_dict, self.label_dict = self._load_tar(data_file)
+            else:
+                self.data, self.word_dict, self.label_dict = self._load(data_file)
             self.predicate_dict = dict(self.word_dict)
             return
         vocab, n_labels = 800, 20
@@ -213,6 +259,77 @@ class Conll05st(Dataset):
         self.word_dict = {f"w{i}": i for i in range(vocab)}
         self.label_dict = {f"L{i}": i for i in range(n_labels)}
         self.predicate_dict = dict(self.word_dict)
+
+    @staticmethod
+    def _span_to_bio(col):
+        """One predicate's span column ("(A0*", "*", "*)", "(V*)") to B-I-O
+        tags — the conversion conll05.py _load_anno does inline."""
+        tags, cur, inside = [], "O", False
+        for tok in col:
+            if "(" in tok:
+                cur = tok[1 : tok.find("*")]
+                tags.append("B-" + cur)
+                inside = ")" not in tok
+            elif tok.startswith("*"):
+                tags.append("I-" + cur if inside else "O")
+                if ")" in tok:
+                    inside = False
+            else:
+                tags.append("O")
+        return tags
+
+    @classmethod
+    def _load_tar(cls, data_file):
+        """The CDN tarball layout: conll05st-release/test.wsj/{words,props}/
+        *.gz, words one-per-line, props one row per token with a column per
+        predicate; blank/empty rows end a sentence. One record per
+        predicate, like the reference's reader."""
+        with tarfile.open(data_file) as tf:
+            names = tf.getnames()
+            wname = [n for n in names if n.endswith(".words.gz")][0]
+            pname = [n for n in names if n.endswith(".props.gz")][0]
+            with gzip.GzipFile(fileobj=tf.extractfile(wname)) as wf:
+                wlines = [ln.strip().decode() for ln in wf]
+            with gzip.GzipFile(fileobj=tf.extractfile(pname)) as pf:
+                plines = [ln.strip().decode().split() for ln in pf]
+        word_dict: dict = {}
+        label_dict: dict = {"O": 0}
+        data = []
+        sent_words: list = []
+        sent_props: list = []
+
+        def flush():
+            if not sent_words:
+                return
+            for w in sent_words:
+                word_dict.setdefault(w, len(word_dict))
+            n_preds = max((len(r) for r in sent_props), default=1) - 1
+            for p in range(n_preds):
+                col = [r[1 + p] if len(r) > 1 + p else "*" for r in sent_props]
+                tags = cls._span_to_bio(col)
+                for t in tags:
+                    label_dict.setdefault(t, len(label_dict))
+                # predicate token: its row's col 0 is the verb lemma
+                verb_rows = [i for i, r in enumerate(sent_props)
+                             if r and r[0] != "-" and tags[i].endswith("-V")]
+                vi = verb_rows[0] if verb_rows else max(
+                    (i for i, r in enumerate(sent_props) if r and r[0] != "-"),
+                    default=0)
+                words = np.asarray([word_dict[w] for w in sent_words], np.int64)
+                marks = np.zeros(len(sent_words), np.int64)
+                marks[vi] = 1
+                labels = np.asarray([label_dict[t] for t in tags], np.int64)
+                data.append((words, np.int64(words[vi]), marks, labels))
+
+        for w, p in zip(wlines, plines):
+            if not w:
+                flush()
+                sent_words, sent_props = [], []
+                continue
+            sent_words.append(w)
+            sent_props.append(p)
+        flush()
+        return data, word_dict, label_dict
 
     @staticmethod
     def _load(data_file):
@@ -269,15 +386,19 @@ class _WMTBase(Dataset):
     BOS, EOS, UNK = 0, 1, 2
     _SPECIALS = ["<s>", "<e>", "<unk>"]
 
+    URL: Optional[str] = None
+    MD5: Optional[str] = None
+    MODULE = "wmt"
+
     def __init__(self, data_file: Optional[str] = None, mode: str = "train", src_dict_size: int = 1000, trg_dict_size: int = 1000, download: bool = False, n_synthetic: int = 256, lang: str = "en"):
         mode = mode.lower()
         self.lang = lang
-        if download and not (data_file and os.path.exists(data_file)):
-            raise RuntimeError("downloads unavailable; pass data_file")
+        data_file = _resolve(data_file, download, self.URL, self.MODULE, self.MD5)
         src_dict_size = max(src_dict_size, 10)
         trg_dict_size = max(trg_dict_size, 10)
-        if data_file and os.path.exists(data_file):
-            self.data, self.src_dict, self.trg_dict = self._load(data_file, src_dict_size, trg_dict_size)
+        if data_file:
+            self.data, self.src_dict, self.trg_dict = self._load(
+                data_file, src_dict_size, trg_dict_size, mode)
             return
         self.src_dict = {(self._SPECIALS[i] if i < 3 else f"s{i}"): i for i in range(src_dict_size)}
         self.trg_dict = {(self._SPECIALS[i] if i < 3 else f"t{i}"): i for i in range(trg_dict_size)}
@@ -300,22 +421,47 @@ class _WMTBase(Dataset):
         return vocab
 
     @classmethod
-    def _load(cls, data_file, src_dict_size, trg_dict_size):
+    def _lines(cls, data_file, mode):
+        """Tab-separated parallel lines from a flat/gz file or the CDN
+        tarball (members are split-named train/test/dev files — wmt14.py
+        _load_data reads the mode's members line by line)."""
+        if tarfile.is_tarfile(data_file):
+            want = {"train": ("train",), "test": ("test",),
+                    "dev": ("dev", "val"), "val": ("dev", "val")}.get(
+                        mode, (mode,))
+            with tarfile.open(data_file) as tf:
+                for m in tf.getmembers():
+                    base = os.path.basename(m.name)
+                    # the split lives in the member PATH (wmt14/train/...)
+                    if not m.isfile() or not any(k in m.name for k in want):
+                        continue
+                    f = tf.extractfile(m)
+                    raw = f.read()
+                    if base.endswith(".gz"):
+                        raw = gzip.decompress(raw)
+                    for ln in raw.decode("utf-8", "ignore").splitlines():
+                        yield ln
+            return
         opener = gzip.open if data_file.endswith(".gz") else open
+        with opener(data_file, "rt") as f:
+            for ln in f:
+                yield ln
+
+    @classmethod
+    def _load(cls, data_file, src_dict_size, trg_dict_size, mode="train"):
         pairs = []
         src_freq: dict = {}
         trg_freq: dict = {}
-        with opener(data_file, "rt") as f:
-            for ln in f:
-                if "\t" not in ln:
-                    continue
-                s, t = ln.rstrip("\n").split("\t", 1)
-                sw, tw = s.split(), t.split()
-                pairs.append((sw, tw))
-                for w in sw:
-                    src_freq[w] = src_freq.get(w, 0) + 1
-                for w in tw:
-                    trg_freq[w] = trg_freq.get(w, 0) + 1
+        for ln in cls._lines(data_file, mode):
+            if "\t" not in ln:
+                continue
+            s, t = ln.rstrip("\n").split("\t", 1)
+            sw, tw = s.split(), t.split()
+            pairs.append((sw, tw))
+            for w in sw:
+                src_freq[w] = src_freq.get(w, 0) + 1
+            for w in tw:
+                trg_freq[w] = trg_freq.get(w, 0) + 1
         src_dict = cls._build_vocab(src_freq, src_dict_size)
         trg_dict = cls._build_vocab(trg_freq, trg_dict_size)
         data = []
@@ -341,12 +487,20 @@ class _WMTBase(Dataset):
 class WMT14(_WMTBase):
     """EN->FR pairs (wmt14.py analog)."""
 
+    URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz"
+    MD5 = "0791583d57d5beb693b9414c5b36798c"
+    MODULE = "wmt14"
+
     def __init__(self, data_file=None, mode="train", dict_size: int = 1000, download: bool = False, n_synthetic: int = 256, lang: str = "en"):
         super().__init__(data_file, mode, dict_size, dict_size, download, n_synthetic, lang)
 
 
 class WMT16(_WMTBase):
     """EN->DE pairs (wmt16.py analog)."""
+
+    URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz"
+    MD5 = "0c38be43600334966403524a40dcd81e"
+    MODULE = "wmt16"
 
     def __init__(self, data_file=None, mode="train", src_dict_size=1000, trg_dict_size=1000, lang="en", download: bool = False, n_synthetic: int = 256):
         super().__init__(data_file, mode, src_dict_size, trg_dict_size, download, n_synthetic, lang)

@@ -1,11 +1,15 @@
 """Dataset/weights fetch-and-cache with md5 validation.
 
 Reference surface: python/paddle/utils/download.py (get_weights_path_from_url,
-get_path_from_url with md5 check, decompress, DOWNLOAD_RETRY_LIMIT).
+get_path_from_url with md5 check, decompress, DOWNLOAD_RETRY_LIMIT) plus the
+dataset cache protocol of python/paddle/dataset/common.py
+(_check_exists_and_download over DATA_HOME/<module>/<file>).
 
-This build runs with zero network egress: local paths and file:// URLs are
-served from cache; remote URLs raise unless the file is already cached
-(populated out-of-band), keeping the API contract without network access.
+Network fetches are ENV-GATED: this build targets hermetic (often
+zero-egress) environments, so a real fetch only happens when
+`PADDLE_TPU_ALLOW_DOWNLOAD=1`. Otherwise local paths, file:// URLs, and
+out-of-band-populated cache entries are served, and a cache miss raises a
+clear error naming both the env var and the `data_file=` escape hatch.
 """
 
 from __future__ import annotations
@@ -17,10 +21,91 @@ import shutil
 import tarfile
 import zipfile
 
-__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+__all__ = ["get_weights_path_from_url", "get_path_from_url", "dataset_path",
+           "data_home", "downloads_allowed"]
 
 WEIGHTS_HOME = osp.expanduser("~/.cache/paddle_tpu/weights")
 DOWNLOAD_RETRY_LIMIT = 3
+
+
+def data_home() -> str:
+    """Dataset cache root (reference dataset/common.py DATA_HOME), overridable
+    via PADDLE_TPU_DATA_HOME (re-read per call so tests can redirect it)."""
+    return os.environ.get(
+        "PADDLE_TPU_DATA_HOME",
+        osp.join(osp.expanduser("~"), ".cache", "paddle_tpu", "dataset"))
+
+
+def downloads_allowed() -> bool:
+    return os.environ.get("PADDLE_TPU_ALLOW_DOWNLOAD", "") == "1"
+
+
+class _Md5Mismatch(RuntimeError):
+    pass
+
+
+def _fetch(url: str, fullname: str, md5sum: str = None, timeout: float = 60.0):
+    """Gated network fetch with atomic cache publish. Transient network
+    errors retry; an md5 mismatch fails FAST (re-downloading a stale-at-
+    source multi-GB artifact twice more cannot fix its hash)."""
+    import urllib.request
+
+    os.makedirs(osp.dirname(fullname), exist_ok=True)
+    tmp = fullname + ".part"
+    last = None
+    for _ in range(DOWNLOAD_RETRY_LIMIT):
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp, \
+                    open(tmp, "wb") as out:
+                shutil.copyfileobj(resp, out)
+            if not _md5check(tmp, md5sum):
+                raise _Md5Mismatch(
+                    f"md5 mismatch downloading {url}: got {_md5_of(tmp)}, "
+                    f"expected {md5sum}")
+            shutil.move(tmp, fullname)  # atomic: no partial file in cache
+            return
+        except _Md5Mismatch:
+            if osp.exists(tmp):
+                os.remove(tmp)
+            raise
+        except Exception as e:  # noqa: BLE001 — transient: retried, then re-raised
+            last = e
+            if osp.exists(tmp):
+                os.remove(tmp)
+    raise RuntimeError(f"failed to download {url}: {last}")
+
+
+def _md5_of(path: str) -> str:
+    md5 = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            md5.update(chunk)
+    return md5.hexdigest()
+
+
+def dataset_path(url: str, module_name: str, md5sum: str = None) -> str:
+    """Resolve a dataset URL to a local file: data_home()/<module>/<file> on
+    cache hit, an env-gated fetch on miss (the reference's
+    _check_exists_and_download)."""
+    filename = osp.basename(url.replace("%2F", "/").split("?")[0])
+    fullname = osp.join(data_home(), module_name, filename)
+    present = osp.exists(fullname)
+    if present and _md5check(fullname, md5sum):
+        return fullname
+    if not downloads_allowed():
+        if present:
+            raise RuntimeError(
+                f"{fullname} is cached but CORRUPT (md5 {_md5_of(fullname)}"
+                f" != expected {md5sum}) and network fetches are disabled. "
+                "Replace the file, or set PADDLE_TPU_ALLOW_DOWNLOAD=1 to "
+                "re-fetch it.")
+        raise RuntimeError(
+            f"{filename} is not cached at {fullname} and network fetches "
+            "are disabled. Set PADDLE_TPU_ALLOW_DOWNLOAD=1 to fetch from "
+            "the dataset CDN, place the file at that path, or pass "
+            "data_file=<local path>.")
+    _fetch(url, fullname, md5sum)
+    return fullname
 
 
 def _md5check(fullname, md5sum=None):
@@ -68,10 +153,13 @@ def get_path_from_url(url: str, root_dir: str = WEIGHTS_HOME, md5sum: str = None
     else:
         fullname = osp.join(root_dir, osp.basename(url.split("?")[0]))
         if not (osp.exists(fullname) and _md5check(fullname, md5sum)):
-            raise RuntimeError(
-                f"cannot fetch {url}: this build has no network egress. "
-                f"Place the file at {fullname} to populate the cache out-of-band."
-            )
+            if downloads_allowed():
+                _fetch(url, fullname, md5sum)
+            else:
+                raise RuntimeError(
+                    f"cannot fetch {url}: network fetches are disabled. Set "
+                    "PADDLE_TPU_ALLOW_DOWNLOAD=1 or place the file at "
+                    f"{fullname} to populate the cache out-of-band.")
     if decompress and (tarfile.is_tarfile(fullname) or zipfile.is_zipfile(fullname)):
         return _decompress(fullname)
     return fullname

@@ -101,25 +101,27 @@ def test_dp_comm_volume_matches_emitted_hlo():
     s.hybrid_configs = {"dp_degree": 2, "pp_degree": 1,
                         "sharding_degree": 1, "mp_degree": 1}
     fleet.init(is_collective=True, strategy=s)
-    paddle.seed(0)
-    model = gpt_tiny(dropout=0.0)
-    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
-                                 parameters=model.parameters())
-    step = make_sharded_train_step(model, opt)
-    rng = np.random.RandomState(0)
-    x = rng.randint(0, 128, size=(8, 16))
-    y = np.roll(x, -1, axis=1)
-    txt = step.lower_compiled(x, y).compile().as_text()
-    got = _hlo_collective_bytes(txt)
-    n_params = sum(int(np.prod(v.shape)) for v in step.params.values())
-    want = n_params * 4
-    assert got > 0, "no all-reduce emitted for a dp=2 step"
-    assert abs(got - want) / want < 0.15, (
-        f"all-reduce bytes {got} vs grad bytes {want}")
-    # cleanup
-    collective.destroy_process_group()
-    mesh.reset_global_mesh()
-    topology.set_hybrid_communicate_group(None)
+    try:
+        paddle.seed(0)
+        model = gpt_tiny(dropout=0.0)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = make_sharded_train_step(model, opt)
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, 128, size=(8, 16))
+        y = np.roll(x, -1, axis=1)
+        txt = step.lower_compiled(x, y).compile().as_text()
+        got = _hlo_collective_bytes(txt)
+        n_params = sum(int(np.prod(v.shape)) for v in step.params.values())
+        want = n_params * 4
+        assert got > 0, "no all-reduce emitted for a dp=2 step"
+        assert abs(got - want) / want < 0.15, (
+            f"all-reduce bytes {got} vs grad bytes {want}")
+    finally:
+        # a failed assert must not leak dp=2 fleet state into later tests
+        collective.destroy_process_group()
+        mesh.reset_global_mesh()
+        topology.set_hybrid_communicate_group(None)
 
 
 def test_planner_picks_data_parallel_for_fitting_gpt():

@@ -1,0 +1,185 @@
+"""Real dataset parse paths + the download/cache protocol (VERDICT r3 item 9).
+
+Round 3 flagged that text datasets only ever ran their synthetic fallback in
+tests. These tests build mini-fixtures in the REAL on-disk formats (aclImdb
+tarball, PTB simple-examples tgz, ml-1m zip, CoNLL words/props gz tarball,
+WMT parallel tgz, housing.data) and drive the actual parse code, then pin
+the env-gated download/cache protocol: cache hit without egress, a clear
+error on cache miss when PADDLE_TPU_ALLOW_DOWNLOAD is unset.
+"""
+
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text.datasets import (
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16)
+
+
+def _add_bytes(tf, name, data: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture
+def imdb_tgz(tmp_path):
+    path = tmp_path / "aclImdb_v1.tar.gz"
+    docs = {
+        "train/pos/0_9.txt": b"a truly great great movie with heart",
+        "train/pos/1_8.txt": b"great fun and a great cast",
+        "train/neg/0_2.txt": b"a bad bad film with no heart",
+        "train/neg/1_1.txt": b"bad plot bad acting",
+        "test/pos/0_9.txt": b"great",
+        "test/neg/0_1.txt": b"bad",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, data in docs.items():
+            _add_bytes(tf, f"aclImdb/{name}", data)
+    return str(path)
+
+
+def test_imdb_parses_real_tarball(imdb_tgz):
+    ds = Imdb(data_file=imdb_tgz, mode="train", cutoff=2)
+    assert len(ds) == 4
+    assert sorted(np.asarray(ds.labels).tolist()) == [0, 0, 1, 1]
+    # cutoff=2 keeps words appearing >= 2 times: great(4), bad(4), a(2),
+    # heart(2), with(2); ids ordered by frequency then alpha, from 2
+    assert set(ds.word_idx) == {"great", "bad", "a", "heart", "with"}
+    doc, label = ds[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    # out-of-vocab words map to 1
+    assert (doc >= 1).all()
+
+
+def test_imikolov_parses_ptb_tgz(tmp_path):
+    path = tmp_path / "simple-examples.tgz"
+    train = b"the cat sat on the mat\nthe dog sat on the cat\n"
+    valid = b"the cat ran\n"
+    with tarfile.open(path, "w:gz") as tf:
+        _add_bytes(tf, "./simple-examples/data/ptb.train.txt", train)
+        _add_bytes(tf, "./simple-examples/data/ptb.valid.txt", valid)
+    ds = Imikolov(data_file=str(path), mode="train", window_size=2,
+                  min_word_freq=2)
+    assert len(ds) > 0
+    gram = ds[0]
+    assert gram.shape == (3,)  # window + target
+    # 'the' is the most frequent word -> id 1 (0 reserved for <unk>)
+    assert ds.word_idx["the"] == 1
+
+
+def test_movielens_parses_ml1m_zip(tmp_path):
+    path = tmp_path / "ml-1m.zip"
+    ratings = "1::10::5::123\n2::20::3::456\n3::30::4::789\n"
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("ml-1m/ratings.dat", ratings)
+    train = Movielens(data_file=str(path), mode="train", test_ratio=0.0)
+    assert len(train) == 3
+    user, movie, rating = train[0]
+    assert user[0] == 1 and movie[0] == 10 and rating == 5.0
+
+
+def test_conll05_parses_words_props_tarball(tmp_path):
+    path = tmp_path / "conll05st-tests.tar.gz"
+    words = b"The\ncat\nsat\n\nDogs\nbark\n\n"
+    # props: col0 = verb lemma or '-', then one span column per predicate
+    props = (b"-\t(A0*\n-\t*)\nsit\t(V*)\n\n"
+             b"-\t(A0*)\nbark\t(V*)\n\n")
+    wbuf, pbuf = io.BytesIO(), io.BytesIO()
+    with gzip.GzipFile(fileobj=wbuf, mode="wb") as g:
+        g.write(words)
+    with gzip.GzipFile(fileobj=pbuf, mode="wb") as g:
+        g.write(props)
+    with tarfile.open(path, "w:gz") as tf:
+        _add_bytes(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                   wbuf.getvalue())
+        _add_bytes(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                   pbuf.getvalue())
+    ds = Conll05st(data_file=str(path))
+    assert len(ds) == 2  # one record per predicate
+    words1, pred1, marks1, labels1 = ds[0]
+    assert words1.shape == (3,) and marks1.sum() == 1
+    inv_labels = {v: k for k, v in ds.label_dict.items()}
+    tags = [inv_labels[int(i)] for i in labels1]
+    assert tags == ["B-A0", "I-A0", "B-V"]
+    assert marks1[2] == 1  # the verb token carries the mark
+    assert pred1 == words1[2]
+    words2, _, _, labels2 = ds[1]
+    tags2 = [inv_labels[int(i)] for i in labels2]
+    assert tags2 == ["B-A0", "B-V"]
+
+
+def test_wmt_parses_parallel_tarball(tmp_path):
+    path = tmp_path / "wmt14.tgz"
+    train = (b"the cat\tle chat\n"
+             b"the dog\tle chien\n")
+    test = b"a cat\tun chat\n"
+    with tarfile.open(path, "w:gz") as tf:
+        _add_bytes(tf, "wmt14/train/part-00", train)
+        _add_bytes(tf, "wmt14/test/part-00", test)
+    tr = WMT14(data_file=str(path), mode="train", dict_size=50)
+    assert len(tr) == 2
+    src, trg_in, trg_out = tr[0]
+    assert trg_in[0] == WMT14.BOS and trg_out[-1] == WMT14.EOS
+    assert tr.src_dict["<unk>"] == 2
+    te = WMT16(data_file=str(path), mode="test", src_dict_size=50,
+               trg_dict_size=50)
+    assert len(te) == 1
+
+
+def test_uci_housing_parses_datafile(tmp_path):
+    path = tmp_path / "housing.data"
+    rng = np.random.RandomState(0)
+    rows = np.hstack([rng.rand(10, 13), rng.rand(10, 1) * 50])
+    np.savetxt(path, rows)
+    tr = UCIHousing(data_file=str(path), mode="train")
+    te = UCIHousing(data_file=str(path), mode="test")
+    assert len(tr) == 8 and len(te) == 2  # 8:2 split
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # features are normalized over the full file
+    allx = np.vstack([tr[i][0] for i in range(8)]
+                     + [te[i][0] for i in range(2)])
+    np.testing.assert_allclose(allx.mean(0), 0.0, atol=1e-5)
+
+
+def test_download_protocol_cache_and_gate(tmp_path, monkeypatch):
+    """download=True serves a cache hit without egress; a cache miss with
+    PADDLE_TPU_ALLOW_DOWNLOAD unset raises with remediation."""
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    monkeypatch.delenv("PADDLE_TPU_ALLOW_DOWNLOAD", raising=False)
+
+    # miss: clear error naming the env var (no network attempted)
+    with pytest.raises(RuntimeError, match="PADDLE_TPU_ALLOW_DOWNLOAD"):
+        UCIHousing(download=True)
+
+    # hit: pre-place the file where the protocol expects it (md5 pinned to
+    # the fixture, simulating a correctly cached CDN artifact)
+    import hashlib
+
+    cache = tmp_path / "uci_housing"
+    cache.mkdir()
+    rng = np.random.RandomState(0)
+    rows = np.hstack([rng.rand(10, 13), rng.rand(10, 1)])
+    np.savetxt(cache / "housing.data", rows)
+    monkeypatch.setattr(
+        UCIHousing, "MD5",
+        hashlib.md5((cache / "housing.data").read_bytes()).hexdigest())
+    ds = UCIHousing(download=True)  # served from cache, zero egress
+    assert len(ds) == 8
+
+
+def test_download_protocol_md5_rejects_corrupt_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    monkeypatch.delenv("PADDLE_TPU_ALLOW_DOWNLOAD", raising=False)
+    cache = tmp_path / "imdb"
+    cache.mkdir()
+    (cache / "aclImdb_v1.tar.gz").write_bytes(b"not a tarball")
+    # md5 mismatch -> treated as a miss -> gated error, not a bad parse
+    with pytest.raises(RuntimeError, match="PADDLE_TPU_ALLOW_DOWNLOAD"):
+        Imdb(download=True)
