@@ -20,13 +20,159 @@ def _parse_args(argv=None):
     p.add_argument("--job_id", type=str, default="default")
     p.add_argument("--devices", "--gpus", type=str, default=None, help="visible device ids")
     p.add_argument("--max_restart", type=int, default=3, help="elastic: restarts before giving up")
+    # PS mode (reference launch/controllers/ps.py): any of these flags
+    # selects it, like PSController.enable
+    p.add_argument("--run_mode", type=str, default=None,
+                   help="collective (default) or ps")
+    p.add_argument("--server_num", type=int, default=None,
+                   help="ps mode: number of parameter servers on this host")
+    p.add_argument("--trainer_num", type=int, default=None,
+                   help="ps mode: number of trainer processes on this host")
+    p.add_argument("--servers", type=str, default="",
+                   help="ps mode: comma-separated server endpoints")
+    p.add_argument("--trainers", type=str, default="",
+                   help="ps mode: comma-separated trainer endpoints")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_ports(endpoints, procs=(), timeout=30.0):
+    """Block until every endpoint accepts TCP (servers up before trainers).
+    Fails FAST when a watched process dies first — otherwise a server that
+    crashed at startup burns the whole timeout with the real cause buried
+    in its log."""
+    import socket
+
+    deadline = time.time() + timeout
+    for ep in endpoints:
+        host, port = ep.rsplit(":", 1)
+        while True:
+            dead = [p for p in procs if p.poll() not in (None, 0)]
+            if dead:
+                raise RuntimeError(
+                    f"server exited with {dead[0].returncode} before "
+                    f"opening its port (see serverlog.*)")
+            try:
+                with socket.create_connection((host, int(port)), timeout=1.0):
+                    break
+            except OSError:
+                if time.time() > deadline:
+                    raise TimeoutError(f"server {ep} did not come up")
+                time.sleep(0.1)
+
+
+def _ps_mode(args) -> bool:
+    return (args.run_mode == "ps" or args.server_num or args.servers
+            or args.trainer_num or args.trainers)
+
+
+def launch_ps(args) -> int:
+    """PS-mode controller (reference launch/controllers/ps.py): spawn the
+    server processes with the PSERVER env contract, wait for their ports,
+    spawn trainers with the TRAINER contract, then reap — trainers
+    finishing cleanly wins; servers (which block in run_server) are
+    terminated once training is done."""
+    os.makedirs(args.log_dir, exist_ok=True)
+    if args.servers:
+        server_eps = args.servers.split(",")
+    else:
+        server_eps = [f"127.0.0.1:{_free_port()}"
+                      for _ in range(args.server_num or 2)]
+    if args.trainers:
+        trainer_eps = args.trainers.split(",")
+    else:
+        trainer_eps = [f"127.0.0.1:{_free_port()}"
+                       for _ in range(args.trainer_num or 2)]
+
+    def common_env():
+        env = _pkg_pythonpath(dict(os.environ))
+        env.update(
+            PADDLE_PSERVERS_IP_PORT_LIST=",".join(server_eps),
+            PADDLE_PSERVER_ENDPOINTS=",".join(server_eps),
+            PADDLE_TRAINER_ENDPOINTS=",".join(trainer_eps),
+            PADDLE_TRAINERS_NUM=str(len(trainer_eps)),
+            PADDLE_JOB_ID=args.job_id,
+            POD_IP="127.0.0.1",
+        )
+        return env
+
+    cmd = [sys.executable, args.training_script, *args.training_script_args]
+    procs = []
+    try:
+        servers = []
+        for i, ep in enumerate(server_eps):
+            env = common_env()
+            env.update(TRAINING_ROLE="PSERVER", PADDLE_ROLE="PSERVER",
+                       PADDLE_PORT=ep.rsplit(":", 1)[1],
+                       PADDLE_TRAINER_ID=str(i))
+            log = open(os.path.join(args.log_dir, f"serverlog.{i}"), "a")
+            p = subprocess.Popen(cmd, env=env, stdout=log,
+                                 stderr=subprocess.STDOUT)
+            procs.append(("server", p, log))
+            servers.append(p)
+        _wait_ports(server_eps, procs=servers)
+        trainers = []
+        for i, ep in enumerate(trainer_eps):
+            env = common_env()
+            env.update(TRAINING_ROLE="TRAINER", PADDLE_ROLE="TRAINER",
+                       PADDLE_PORT=ep.rsplit(":", 1)[1],
+                       PADDLE_TRAINER_ID=str(i))
+            log = open(os.path.join(args.log_dir, f"workerlog.{i}"), "a")
+            p = subprocess.Popen(cmd, env=env, stdout=log,
+                                 stderr=subprocess.STDOUT)
+            procs.append(("trainer", p, log))
+            trainers.append(p)
+        # reap trainers while watching servers: a dead server would leave
+        # trainers blocked on it forever, so that is a job failure too
+        while True:
+            if all(p.poll() is not None for p in trainers):
+                break
+            dead_server = next((p for p in servers
+                                if p.poll() not in (None, 0)), None)
+            if dead_server is not None:
+                print(f"parameter server exited with "
+                      f"{dead_server.returncode}; aborting job",
+                      file=sys.stderr)
+                return 1
+            time.sleep(0.2)
+        codes = [p.returncode for p in trainers]
+        failures = [c for c in codes if c != 0]
+        if not failures:
+            return 0
+        # signal deaths report negative codes; the controller's exit must
+        # still be a FAILURE (a positive status), never 0
+        return failures[0] if failures[0] > 0 else 1
+    finally:
+        for role, p, log in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            log.close()
+
+
+def _pkg_pythonpath(env: dict):
+    """Children must import paddle_tpu even when it is not pip-installed:
+    prepend the package's parent directory to PYTHONPATH."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
 def _worker_env(args, local_rank: int, world: int) -> dict:
-    env = dict(os.environ)
+    env = _pkg_pythonpath(dict(os.environ))
     rank = args.rank * args.nproc_per_node + local_rank
     env.update(
         PADDLE_TRAINER_ID=str(rank),
@@ -59,6 +205,8 @@ def _current_nnodes(args) -> int:
 
 def launch(args=None):
     args = args if args is not None else _parse_args()
+    if _ps_mode(args):
+        return launch_ps(args)
     os.makedirs(args.log_dir, exist_ok=True)
 
     procs = []

@@ -50,19 +50,25 @@ def _fetch(url: str, fullname: str, md5sum: str = None, timeout: float = 60.0):
     source multi-GB artifact twice more cannot fix its hash)."""
     import urllib.request
 
+    import tempfile
+
     os.makedirs(osp.dirname(fullname), exist_ok=True)
-    tmp = fullname + ".part"
     last = None
     for _ in range(DOWNLOAD_RETRY_LIMIT):
+        # per-process tempfile in the destination dir: N launcher workers
+        # cold-fetching the same artifact must not clobber each other's
+        # partial file; os.replace publishes whoever finishes first
+        fd, tmp = tempfile.mkstemp(dir=osp.dirname(fullname),
+                                   prefix=osp.basename(fullname) + ".part.")
         try:
             with urllib.request.urlopen(url, timeout=timeout) as resp, \
-                    open(tmp, "wb") as out:
+                    os.fdopen(fd, "wb") as out:
                 shutil.copyfileobj(resp, out)
             if not _md5check(tmp, md5sum):
                 raise _Md5Mismatch(
                     f"md5 mismatch downloading {url}: got {_md5_of(tmp)}, "
                     f"expected {md5sum}")
-            shutil.move(tmp, fullname)  # atomic: no partial file in cache
+            os.replace(tmp, fullname)  # atomic: no partial file in cache
             return
         except _Md5Mismatch:
             if osp.exists(tmp):
@@ -109,13 +115,7 @@ def dataset_path(url: str, module_name: str, md5sum: str = None) -> str:
 
 
 def _md5check(fullname, md5sum=None):
-    if md5sum is None:
-        return True
-    md5 = hashlib.md5()
-    with open(fullname, "rb") as f:
-        for chunk in iter(lambda: f.read(4096), b""):
-            md5.update(chunk)
-    return md5.hexdigest() == md5sum
+    return md5sum is None or _md5_of(fullname) == md5sum
 
 
 def is_url(path: str) -> bool:
