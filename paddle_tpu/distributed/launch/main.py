@@ -56,7 +56,9 @@ def _wait_ports(endpoints, procs=(), timeout=30.0):
     for ep in endpoints:
         host, port = ep.rsplit(":", 1)
         while True:
-            dead = [p for p in procs if p.poll() not in (None, 0)]
+            # ANY exit (even 0) before the port opens is fatal — a server
+            # that returned cleanly without binding will never serve
+            dead = [p for p in procs if p.poll() is not None]
             if dead:
                 raise RuntimeError(
                     f"server exited with {dead[0].returncode} before "
@@ -136,8 +138,10 @@ def launch_ps(args) -> int:
         while True:
             if all(p.poll() is not None for p in trainers):
                 break
+            # any server exit while trainers still run strands them mid-RPC
+            # — clean exit code included
             dead_server = next((p for p in servers
-                                if p.poll() not in (None, 0)), None)
+                                if p.poll() is not None), None)
             if dead_server is not None:
                 print(f"parameter server exited with "
                       f"{dead_server.returncode}; aborting job",

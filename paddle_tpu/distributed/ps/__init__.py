@@ -653,3 +653,5 @@ def stop_worker():
 
 
 from .heter import HeterClient, heter_entries, register_heter_entry  # noqa: F401,E402
+from .device_embedding import (  # noqa: F401,E402
+    DeviceSparseEmbedding, embedding_lookup)

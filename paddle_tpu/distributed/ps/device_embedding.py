@@ -1,0 +1,92 @@
+"""Device-resident sparse embedding over the parameter server
+(memory_sparse_table.cc / SparseCore-style lookup, VERDICT r3 item 7).
+
+The host-side PS path pulls rows and does the embedding arithmetic in
+numpy; here only the PS sync stays on the host, at step boundaries:
+
+* step begin — the batch's ids are uniqued host-side, the touched rows are
+  pulled once from the PS shards and device_put as one [U, D] block, and
+  the ids are remapped to LOCAL row indices.
+* in-step — the embedding lookup is a device GATHER (jnp.take) from the
+  row block inside the jitted train step; its backward is the on-device
+  scatter-add XLA derives, producing a dense [U, D] row-gradient block.
+* step end — the row-grad block is pushed back to the PS shards
+  (adagrad/sgd rules applied server-side), exactly one pull and one push
+  per step regardless of how many times a row was touched.
+
+Under a mesh the [U, D] block is replicated (every data shard may touch
+any row — DeepSpeed/SparseCore embedding semantics) while the id tensor
+and the dense compute shard over dp; GSPMD partitions the gather like any
+other op.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["DeviceSparseEmbedding", "embedding_lookup"]
+
+
+def embedding_lookup(rows, local_ids):
+    """Device gather: rows [U, D] x local_ids [...] -> [..., D]. Use inside
+    the jitted step; XLA emits gather fwd / scatter-add bwd."""
+    import jax.numpy as jnp
+
+    return jnp.take(rows, local_ids, axis=0)
+
+
+class DeviceSparseEmbedding:
+    """Step-boundary PS sync around a device-resident row block."""
+
+    def __init__(self, client, table_id: int, dim: int,
+                 rule: str = "adagrad", lr: float = 0.05,
+                 min_bucket: int = 64):
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+        self.rule = rule
+        self.lr = lr
+        self.min_bucket = min_bucket
+        self._uniq: Optional[np.ndarray] = None
+
+    def _bucket(self, n: int) -> int:
+        b = self.min_bucket
+        while b < n:
+            b <<= 1
+        return b
+
+    def pull(self, ids):
+        """Host step-begin: returns (rows [B, D] on device, local_ids with
+        ids' shape, int32) — feed both into the jitted step.
+
+        The row block is zero-PADDED to a power-of-two bucket >= the unique
+        count: the per-batch unique count varies, and an exact-U shape would
+        make jax.jit retrace the train step nearly every step. Padding rows
+        receive no gather references, so their grads are zero and push()
+        slices them away."""
+        import jax
+
+        ids = np.asarray(ids)
+        uniq, inv = np.unique(ids.reshape(-1), return_inverse=True)
+        rows = np.asarray(self.client.pull_sparse(self.table_id, uniq),
+                          np.float32)
+        bucket = self._bucket(len(uniq))
+        if bucket > len(uniq):
+            rows = np.concatenate(
+                [rows, np.zeros((bucket - len(uniq), self.dim), np.float32)])
+        self._uniq = uniq
+        return (jax.device_put(rows),
+                inv.reshape(ids.shape).astype(np.int32))
+
+    def push(self, row_grads, lr: Optional[float] = None):
+        """Host step-end: push the row-gradient block from the step back to
+        the PS shards (padding rows sliced off; keys = last pull's)."""
+        if self._uniq is None:
+            raise RuntimeError("push() before pull(): no step in flight")
+        grads = np.asarray(row_grads, np.float32)[: len(self._uniq)]
+        self.client.push_sparse(self.table_id, self._uniq, grads,
+                                rule=self.rule,
+                                lr=self.lr if lr is None else lr)
+        self._uniq = None

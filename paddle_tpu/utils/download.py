@@ -50,9 +50,17 @@ def _fetch(url: str, fullname: str, md5sum: str = None, timeout: float = 60.0):
     source multi-GB artifact twice more cannot fix its hash)."""
     import urllib.request
 
+    import glob
     import tempfile
 
     os.makedirs(osp.dirname(fullname), exist_ok=True)
+    # sweep partials orphaned by a killed prior run (SIGKILL between
+    # mkstemp and publish/remove) so they cannot accumulate
+    for stale in glob.glob(fullname + ".part.*"):
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
     last = None
     for _ in range(DOWNLOAD_RETRY_LIMIT):
         # per-process tempfile in the destination dir: N launcher workers
@@ -61,8 +69,10 @@ def _fetch(url: str, fullname: str, md5sum: str = None, timeout: float = 60.0):
         fd, tmp = tempfile.mkstemp(dir=osp.dirname(fullname),
                                    prefix=osp.basename(fullname) + ".part.")
         try:
-            with urllib.request.urlopen(url, timeout=timeout) as resp, \
-                    os.fdopen(fd, "wb") as out:
+            # fdopen FIRST: if urlopen raises, the with still closes the
+            # mkstemp descriptor (urlopen-first leaked one fd per retry)
+            with os.fdopen(fd, "wb") as out, \
+                    urllib.request.urlopen(url, timeout=timeout) as resp:
                 shutil.copyfileobj(resp, out)
             if not _md5check(tmp, md5sum):
                 raise _Md5Mismatch(
