@@ -124,7 +124,6 @@ class ShardedTrainStep:
         self._pspec = None
 
         params0, buffers0 = model.functional_state()
-        self._buffers = buffers0
 
         if pp > 1:
             # compiled pipeline parallelism: block params restack to
@@ -194,7 +193,12 @@ class ShardedTrainStep:
         use_fwl = loss_fn is None and hasattr(model, "forward_with_loss")
 
         if pp > 1:
-            loss_impl = self._build_pipeline_loss(buffers0, pp_remat)
+            pipe_loss = self._build_pipeline_loss(buffers0, pp_remat)
+
+            def loss_impl(pvals, bufs, x, y, seed):
+                # pipeline models are homogeneous transformer stacks (LN,
+                # not BN) — buffers pass through unchanged
+                return pipe_loss(pvals, x, y, seed), bufs
         else:
             if not use_fwl and loss_fn_ is None:
                 raise ValueError(
@@ -202,21 +206,24 @@ class ShardedTrainStep:
                     "pass loss_fn= to make_sharded_train_step")
             self._accum = accumulate_steps if accumulate_steps else 1
 
-            def loss_impl(pvals, x, y, seed):
+            def loss_impl(pvals, bufs, x, y, seed):
+                """Returns (loss, new_buffers): buffer updates (BatchNorm
+                running stats etc.) are step STATE, not discarded — frozen
+                buffers would silently leave eval statistics at init."""
                 with no_grad(), _random.rng_scope(seed):
                     if use_fwl:
-                        loss, _ = mdl.functional_call(
-                            pvals, buffers0, Tensor(x), Tensor(y),
+                        loss, new_bufs = mdl.functional_call(
+                            pvals, bufs, Tensor(x), Tensor(y),
                             method="forward_with_loss")
                     else:
-                        out, _ = mdl.functional_call(pvals, buffers0, Tensor(x))
+                        out, new_bufs = mdl.functional_call(pvals, bufs, Tensor(x))
                         loss = loss_fn_(out, Tensor(y))
-                return loss._value.astype(jnp.float32)
+                return loss._value.astype(jnp.float32), new_bufs
 
         M_acc = self._accum
         pp_mode = pp > 1
 
-        def value_and_grad_accum(params, x, y, seed, loss_scale=None):
+        def value_and_grad_accum(params, bufs, x, y, seed, loss_scale=None):
             """Gradient accumulation over M_acc microbatches (pipeline mode
             microbatches inside the schedule instead): fwd+bwd per microbatch
             inside a lax.scan, so only one microbatch's activations are live
@@ -224,11 +231,16 @@ class ShardedTrainStep:
             loss_scale (traced scalar) multiplies the loss BEFORE autodiff —
             fp16 dynamic loss scaling; grads and the returned loss come back
             scaled. Applied outside the pipeline's custom_vjp, so it scales
-            the 1F1B/GPipe/vpp backward streams identically."""
+            the 1F1B/GPipe/vpp backward streams identically.
+            Returns ((loss, new_buffers), grads)."""
             sc = jnp.float32(1.0) if loss_scale is None else loss_scale
+
             if pp_mode or M_acc <= 1:
-                return jax.value_and_grad(
-                    lambda p: loss_impl(p, x, y, seed) * sc)(params)
+                def fn(p):
+                    loss, new_bufs = loss_impl(p, bufs, x, y, seed)
+                    return loss * sc, new_bufs
+
+                return jax.value_and_grad(fn, has_aux=True)(params)
             B = x.shape[0]
             if B % M_acc:
                 raise ValueError(f"batch {B} not divisible by accumulate_steps {M_acc}")
@@ -238,24 +250,29 @@ class ShardedTrainStep:
             ys = jnp.swapaxes(y.reshape((mb, M_acc) + y.shape[1:]), 0, 1)
 
             def body(carry, xsm):
-                acc_l, acc_g = carry
+                acc_l, acc_g, bufs_c = carry
                 xm, ym, m = xsm
 
                 def micro_loss(p):
                     with _random.key_salt(m):
-                        return loss_impl(p, xm, ym, seed) * sc
+                        loss, new_bufs = loss_impl(p, bufs_c, xm, ym, seed)
+                    return loss * sc, new_bufs
 
-                l, g = jax.value_and_grad(micro_loss)(params)
+                (l, new_bufs), g = jax.value_and_grad(
+                    micro_loss, has_aux=True)(params)
                 return (acc_l + l,
-                        jax.tree_util.tree_map(jnp.add, acc_g, g)), None
+                        jax.tree_util.tree_map(jnp.add, acc_g, g),
+                        new_bufs), None
 
             from jax import lax
 
             zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-            (l, g), _ = lax.scan(body, (jnp.zeros((), jnp.float32), zeros),
-                                 (xs, ys, jnp.arange(M_acc)))
+            (l, g, new_bufs), _ = lax.scan(
+                body, (jnp.zeros((), jnp.float32), zeros, bufs),
+                (xs, ys, jnp.arange(M_acc)))
             inv = 1.0 / M_acc
-            return l * inv, jax.tree_util.tree_map(lambda t: t * inv, g)
+            return ((l * inv, new_bufs),
+                    jax.tree_util.tree_map(lambda t: t * inv, g))
 
         # Grad compute sharding = param storage sharding minus the ZeRO axis:
         # under ZeRO-3 the stored param (hence, by propagation, its grad) is
@@ -312,10 +329,10 @@ class ShardedTrainStep:
             incr_every, decr_every = sc._incr_every, sc._decr_every
             incr_ratio, decr_ratio = sc._incr_ratio, sc._decr_ratio
 
-            def step(params, opt_state, sstate, x, y, lr, seed):
+            def step(params, opt_state, bufs, sstate, x, y, lr, seed):
                 scale, good, bad = sstate
-                scaled_loss, grads = value_and_grad_accum(
-                    params, x, y, seed, loss_scale=scale)
+                (scaled_loss, new_bufs), grads = value_and_grad_accum(
+                    params, bufs, x, y, seed, loss_scale=scale)
                 inv = 1.0 / scale
                 dts = {k: g.dtype for k, g in grads.items()}
                 grads = {k: g.astype(jnp.float32) * inv
@@ -343,13 +360,34 @@ class ShardedTrainStep:
                     bad2 = jnp.where(dec, 0, bad2)
                 else:
                     new_scale, good2, bad2 = scale, good, bad
-                # loss reported unscaled (inf stays inf on overflow steps)
-                return (new_params, new_state, (new_scale, good2, bad2),
-                        scaled_loss * inv)
+                # loss reported unscaled (inf stays inf on overflow steps);
+                # buffer updates (BN stats) keep even on skipped updates —
+                # eager forward updates them before overflow is known
+                return (new_params, new_state, new_bufs,
+                        (new_scale, good2, bad2), scaled_loss * inv)
 
             self.scaler_state = (jnp.float32(sc._scale),
                                  jnp.int32(sc._good_steps),
                                  jnp.int32(sc._bad_steps))
+            donate_args = (0, 1, 2, 3) if donate else ()
+            self._compiled = jax.jit(
+                step,
+                in_shardings=(p_shard, s_shard, None, None, batch_sharding,
+                              batch_sharding, None, None),
+                out_shardings=(p_shard, s_shard, None, None,
+                               NamedSharding(mesh, P())),
+                donate_argnums=donate_args,
+            )
+        else:
+            self.scaler_state = None
+
+            def step(params, opt_state, bufs, x, y, lr, seed):
+                (loss, new_bufs), grads = value_and_grad_accum(
+                    params, bufs, x, y, seed)
+                new_params, new_state = _clip_and_update(
+                    params, opt_state, grads, lr)
+                return new_params, new_state, new_bufs, loss
+
             donate_args = (0, 1, 2) if donate else ()
             self._compiled = jax.jit(
                 step,
@@ -359,22 +397,12 @@ class ShardedTrainStep:
                                NamedSharding(mesh, P())),
                 donate_argnums=donate_args,
             )
-        else:
-            self.scaler_state = None
-
-            def step(params, opt_state, x, y, lr, seed):
-                loss, grads = value_and_grad_accum(params, x, y, seed)
-                new_params, new_state = _clip_and_update(
-                    params, opt_state, grads, lr)
-                return new_params, new_state, loss
-
-            donate_args = (0, 1) if donate else ()
-            self._compiled = jax.jit(
-                step,
-                in_shardings=(p_shard, s_shard, batch_sharding, batch_sharding, None, None),
-                out_shardings=(p_shard, s_shard, NamedSharding(mesh, P())),
-                donate_argnums=donate_args,
-            )
+        # buffers are step STATE (device-resident like params/opt state).
+        # COPIED, not aliased: functional_state returns the model's live
+        # arrays, and donation would delete them out from under any eager
+        # use of the model between compiled steps.
+        self.buffers = jax.tree_util.tree_map(
+            lambda v: jnp.array(v, copy=True), buffers0)
         # for run_steps (multi-step scan): the raw python step + shardings
         self._compiled_step_fn = step
         self._p_shard, self._s_shard = p_shard, s_shard
@@ -567,37 +595,39 @@ class ShardedTrainStep:
         if self._multi is None:
             base = self._compiled_step_fn
 
-            def multi(params, opt_state, sstate, xs, ys, lr, seed):
+            def multi(params, opt_state, bufs, sstate, xs, ys, lr, seed):
                 def body(carry, xy):
-                    p, s, ss = carry
+                    p, s, b, ss = carry
                     xk, yk, k = xy
                     if scaled:
-                        p, s, ss, loss = base(p, s, ss, xk, yk, lr, seed + k)
+                        p, s, b, ss, loss = base(p, s, b, ss, xk, yk, lr,
+                                                 seed + k)
                     else:
-                        p, s, loss = base(p, s, xk, yk, lr, seed + k)
-                    return (p, s, ss), loss
+                        p, s, b, loss = base(p, s, b, xk, yk, lr, seed + k)
+                    return (p, s, b, ss), loss
 
-                (params, opt_state, sstate), losses = jax.lax.scan(
-                    body, (params, opt_state, sstate),
+                (params, opt_state, bufs, sstate), losses = jax.lax.scan(
+                    body, (params, opt_state, bufs, sstate),
                     (xs, ys, jnp.arange(xs.shape[0], dtype=jnp.uint32)))
-                return params, opt_state, sstate, losses
+                return params, opt_state, bufs, sstate, losses
 
             bspec = self._batch_sharding.spec
             stacked = NamedSharding(self.mesh, P(None, *bspec))
             self._multi = jax.jit(
                 multi,
-                in_shardings=(self._p_shard, self._s_shard, None, stacked,
-                              stacked, None, None),
-                out_shardings=(self._p_shard, self._s_shard, None,
+                in_shardings=(self._p_shard, self._s_shard, None, None,
+                              stacked, stacked, None, None),
+                out_shardings=(self._p_shard, self._s_shard, None, None,
                                NamedSharding(self.mesh, P())),
-                donate_argnums=(0, 1, 2) if self._donate else (),
+                donate_argnums=(0, 1, 2, 3) if self._donate else (),
             )
         K = xs.shape[0] if hasattr(xs, "shape") else len(xs)
         self._step_i += K
         ss_in = self.scaler_state if scaled else jnp.zeros((), jnp.float32)
         with jax.set_mesh(self.mesh):
-            self.params, self.opt_state, ss_out, losses = self._multi(
-                self.params, self.opt_state, ss_in,
+            (self.params, self.opt_state, self.buffers, ss_out,
+             losses) = self._multi(
+                self.params, self.opt_state, self.buffers, ss_in,
                 jnp.asarray(xs), jnp.asarray(ys),
                 # +1 so scanned step j draws seed (seed + prev_steps + 1 + j)
                 # — identical to the seeds K sequential __call__s would use
@@ -611,10 +641,11 @@ class ShardedTrainStep:
         self._step_i += 1
         with jax.set_mesh(self.mesh):
             if self.scaler_state is not None:
-                (self.params, self.opt_state, self.scaler_state,
-                 loss) = self._compiled(
+                (self.params, self.opt_state, self.buffers,
+                 self.scaler_state, loss) = self._compiled(
                     self.params,
                     self.opt_state,
+                    self.buffers,
                     self.scaler_state,
                     self._to_global_batch(x),
                     self._to_global_batch(y),
@@ -622,9 +653,11 @@ class ShardedTrainStep:
                     jnp.uint32(self._seed + self._step_i),
                 )
             else:
-                self.params, self.opt_state, loss = self._compiled(
+                (self.params, self.opt_state, self.buffers,
+                 loss) = self._compiled(
                     self.params,
                     self.opt_state,
+                    self.buffers,
                     self._to_global_batch(x),
                     self._to_global_batch(y),
                     jnp.float32(lr),
@@ -650,6 +683,15 @@ class ShardedTrainStep:
         self._scaler._bad_steps = int(self.scaler_state[2])
 
     def sync_to_model(self):
+        """Write the step's device state (params + buffers) back into the
+        Layer. REQUIRED before any eager use of the model mid-training:
+        with donate=True (default) each step consumes its input arrays —
+        including, after the first sync, the model's own — so the Layer's
+        tensors are stale/deleted until re-synced."""
+        named_bufs = dict(self.model.named_buffers())
+        for name, v in (self.buffers or {}).items():
+            if name in named_bufs and named_bufs[name] is not None:
+                named_bufs[name]._set_value_raw(v)
         named = dict(self.model.named_parameters())
         if self._pspec is not None:
             from .meta_parallel.pipeline_parallel import unstack_block_params
@@ -672,12 +714,12 @@ class ShardedTrainStep:
         """AOT-lower (for compile checks without executing)."""
         if self.scaler_state is not None:
             return self._compiled.lower(
-                self.params, self.opt_state, self.scaler_state,
-                jnp.asarray(x), jnp.asarray(y), jnp.float32(1e-3),
-                jnp.uint32(0))
+                self.params, self.opt_state, self.buffers,
+                self.scaler_state, jnp.asarray(x), jnp.asarray(y),
+                jnp.float32(1e-3), jnp.uint32(0))
         return self._compiled.lower(
-            self.params, self.opt_state, jnp.asarray(x), jnp.asarray(y), jnp.float32(1e-3), jnp.uint32(0)
-        )
+            self.params, self.opt_state, self.buffers, jnp.asarray(x),
+            jnp.asarray(y), jnp.float32(1e-3), jnp.uint32(0))
 
 
 def make_sharded_train_step(model, optimizer, loss_fn=None, mesh=None, **kwargs) -> ShardedTrainStep:

@@ -55,10 +55,15 @@ def _fetch(url: str, fullname: str, md5sum: str = None, timeout: float = 60.0):
 
     os.makedirs(osp.dirname(fullname), exist_ok=True)
     # sweep partials orphaned by a killed prior run (SIGKILL between
-    # mkstemp and publish/remove) so they cannot accumulate
+    # mkstemp and publish/remove) so they cannot accumulate. Age-gated:
+    # a young .part belongs to a CONCURRENT worker mid-download — deleting
+    # it would break the N-worker cold-fetch contract below.
+    import time as _time
+
     for stale in glob.glob(fullname + ".part.*"):
         try:
-            os.remove(stale)
+            if _time.time() - os.path.getmtime(stale) > 3600:
+                os.remove(stale)
         except OSError:
             pass
     last = None

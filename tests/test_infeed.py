@@ -119,6 +119,75 @@ def test_run_steps_seed_parity_with_dropout():
     np.testing.assert_allclose(scan, seq, rtol=1e-6, atol=1e-7)
 
 
+def test_compiled_step_updates_bn_running_stats():
+    """Buffer updates (BatchNorm running stats) are step STATE in the
+    compiled path: after N steps they match the eager path exactly and
+    sync_to_model writes them back (previously they froze at init)."""
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+
+    def build():
+        paddle.seed(0)
+        m = paddle.nn.Sequential(
+            paddle.nn.Conv2D(3, 8, 3, padding=1), paddle.nn.BatchNorm2D(8),
+            paddle.nn.ReLU(), paddle.nn.Flatten(),
+            paddle.nn.Linear(8 * 8 * 8, 4))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        return m, opt
+
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(4, 3, 8, 8).astype(np.float32) for _ in range(4)]
+    ys = [rng.randint(0, 4, size=(4,)).astype(np.int64) for _ in range(4)]
+    loss_fn = lambda l, y: paddle.nn.functional.cross_entropy(l, y).mean()
+
+    m1, o1 = build()
+    m1.train()
+    for x, y in zip(xs, ys):
+        loss = loss_fn(m1(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+    key = [n for n in dict(m1.named_buffers()) if "mean" in n][0]
+    eager_mean = dict(m1.named_buffers())[key].numpy()
+
+    m2, o2 = build()
+    m2.train()
+    step = make_sharded_train_step(m2, o2, loss_fn=loss_fn)
+    for x, y in zip(xs, ys):
+        _ = float(step(x, y))
+    step.sync_to_model()
+    compiled_mean = dict(m2.named_buffers())[key].numpy()
+    assert not np.allclose(compiled_mean, 0.0), "running mean frozen at init"
+    np.testing.assert_allclose(compiled_mean, eager_mean, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_eager_validation_between_compiled_steps_via_sync():
+    """The documented interleave contract: params are donated (moved) into
+    the step, so eager use of the model requires sync_to_model first —
+    after which eval works, training continues, and a second sync
+    re-materializes the model (incl. the BN buffers the step now carries)."""
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+
+    paddle.seed(0)
+    m = paddle.nn.Sequential(
+        paddle.nn.Conv2D(3, 4, 3, padding=1), paddle.nn.BatchNorm2D(4),
+        paddle.nn.Flatten(), paddle.nn.Linear(4 * 4 * 4, 2))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    loss_fn = lambda l, y: paddle.nn.functional.cross_entropy(l, y).mean()
+    step = make_sharded_train_step(m, opt, loss_fn=loss_fn)
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 4, 4).astype(np.float32)
+    y = rng.randint(0, 2, size=(2,)).astype(np.int64)
+    for _round in range(2):
+        _ = float(step(x, y))
+        step.sync_to_model()
+        m.eval()
+        out = m(paddle.to_tensor(x))
+        assert np.isfinite(out.numpy()).all()
+        m.train()
+
+
 def test_run_steps_then_step_continues():
     """run_steps advances the held state; a following plain step() trains on."""
     from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
