@@ -7,20 +7,21 @@ variant (:514).
 
 TPU-native, two runtimes:
 
-1. **Host-driven (eager)**: the single controller owns all stages, so the
-   "p2p" is just handing the activation to the next stage's computation;
-   XLA's async dispatch queues every stage's work without host blocking, so
-   issuing microbatch k's stage-s compute while k+1's stage-(s-1) is in
-   flight gives the 1F1B overlap without explicit scheduling. Used by
-   `train_batch` below: correct semantics, grad accumulation over
-   microbatches, loss averaging — the reference's contract.
+1. **Host-driven (eager)**: the single controller owns all stages and runs
+   microbatches SEQUENTIALLY — there is no explicit pipeline schedule here,
+   only XLA's ordinary async dispatch queueing work ahead of the host. Its
+   value is the reference's train_batch CONTRACT (microbatch loop, grad
+   accumulation, loss averaging) as an eager compatibility path, not
+   pipeline efficiency; use the compiled runtime for that.
 
-2. **Compiled SPMD (`spmd_pipeline`)**: the whole schedule inside one jit —
-   stage params stacked over the `pp` mesh axis, shard_map + ppermute rotate
-   microbatch activations around the ring, lax.scan over M + S - 1 ticks
-   (GPipe-shaped; each tick every stage computes, so the steady state is the
-   same as 1F1B's). This is the path the multichip dry-run and the perf
-   harness compile.
+2. **Compiled SPMD**: the whole schedule inside one jit — stage params
+   stacked over the `pp` mesh axis, shard_map + ppermute rotate microbatch
+   activations around the ring. Two schedules: `pipeline_schedule_1f1b`
+   (default) holds activation memory at O(pp) via a custom_vjp backward
+   with a bounded recompute stash — the reference 1F1B's memory profile —
+   and `pipeline_schedule` is the simpler GPipe-shaped scan whose AD
+   transpose stashes O(M) carries. This is the path the multichip dry-run
+   and the perf harness compile.
 """
 
 from __future__ import annotations

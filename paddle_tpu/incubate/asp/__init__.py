@@ -8,6 +8,7 @@ and checkers match the reference.
 """
 
 from .asp import (  # noqa: F401
+    add_supported_layer,
     ASPHelper,
     decorate,
     prune_model,
@@ -27,6 +28,7 @@ from .utils import (  # noqa: F401
 )
 
 __all__ = [
+    "add_supported_layer",
     "calculate_density",
     "decorate",
     "prune_model",

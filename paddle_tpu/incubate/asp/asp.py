@@ -20,15 +20,31 @@ class ASPHelper:
     MASK_APPENDDED_NAME = "asp_mask"
     _masks: dict = {}  # param name -> numpy mask
 
+    @staticmethod
+    def _owner_types(model) -> dict:
+        """{owner-prefix: class name} over the model, incl. the root as ''
+        — built ONCE per prune, not re-scanned per parameter."""
+        owners = {"": type(model).__name__}
+        for sub_name, sub in model.named_sublayers():
+            owners[sub_name] = type(sub).__name__
+        return owners
+
     @classmethod
-    def _supported(cls, model, param, param_name: str) -> bool:
+    def _supported(cls, model, param, param_name: str, owners=None) -> bool:
         if param_name in _EXCLUDED:
             return False
         for ex in _EXCLUDED:
             if param_name.startswith(ex + ".") or param_name.split(".")[0] == ex:
                 return False
-        # weights of Linear (2-D) and Conv (4-D); skip biases / norms / embeddings
         shape = param.shape
+        # custom-registered layer types (add_supported_layer) win over the
+        # built-in heuristic — match by the owning layer's class name
+        if _CUSTOM_SUPPORTED and model is not None:
+            owners = owners if owners is not None else cls._owner_types(model)
+            owner = param_name.rsplit(".", 1)[0] if "." in param_name else ""
+            if owners.get(owner) in _CUSTOM_SUPPORTED:
+                return len(shape) >= 2
+        # weights of Linear (2-D) and Conv (4-D); skip biases / norms / embeddings
         if len(shape) not in (2, 4):
             return False
         flat_cols = int(np.prod(shape[1:]))
@@ -39,11 +55,19 @@ class ASPHelper:
         from ...ops.creation import to_tensor
 
         masks = {}
+        owners = cls._owner_types(model)
         for name, param in model.named_parameters():
-            if not cls._supported(model, param, name):
+            if not cls._supported(model, param, name, owners=owners):
                 continue
             w = np.asarray(param._value, dtype=np.float32)
-            mask = create_mask(w, func_name=mask_algo, n=n, m=m)
+            # a custom pruning_func registered for the owning layer type
+            # overrides the built-in n:m mask (add_supported_layer contract)
+            owner = name.rsplit(".", 1)[0] if "." in name else ""
+            custom = _CUSTOM_SUPPORTED.get(owners.get(owner))
+            if custom is not None:
+                mask = np.asarray(custom(w, n, m, mask_algo), w.dtype)
+            else:
+                mask = create_mask(w, func_name=mask_algo, n=n, m=m)
             param._set_value_raw(to_tensor((w * mask).astype(w.dtype))._value)
             if with_mask:
                 masks[name] = mask
@@ -106,3 +130,18 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d", with_
 
 def decorate(optimizer):
     return ASPHelper.decorate(optimizer)
+
+
+#: layer types registered as prunable beyond the built-in Linear/Conv
+#: heuristic (reference asp add_supported_layer)
+_CUSTOM_SUPPORTED: dict = {}
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Register a custom layer type (class or its name string) whose
+    weights ASP should prune; `pruning_func(weight, n, m, mask_algo)` may
+    override mask computation (reference
+    incubate/asp/supported_layer_list.py)."""
+    name = layer if isinstance(layer, str) else getattr(
+        layer, "__name__", str(layer))
+    _CUSTOM_SUPPORTED[name] = pruning_func

@@ -19,8 +19,14 @@ from ...core.tensor import Tensor
 from ...ops._dispatch import apply, as_tensor
 
 __all__ = [
+    "fused_bias_dropout_residual_layer_norm",
     "fused_dropout_add",
+    "fused_ec_moe",
+    "fused_feedforward",
     "fused_linear",
+    "fused_matmul_bias",
+    "fused_multi_head_attention",
+    "fused_multi_transformer",
     "fused_rms_norm",
     "fused_rotary_position_embedding",
 ]
@@ -171,3 +177,272 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
     if norm_bias is not None:
         out = out + as_tensor(norm_bias)
     return out
+
+
+# ---- fused transformer functional surface (reference incubate/nn/
+# functional/fused_transformer.py + fused_matmul_bias.py + fused_ec_moe.py).
+# "Fused" on TPU = one traced expression XLA fuses; these exist so code
+# written against the reference's functional fused API ports unchanged. ----
+
+
+def _dropout(v, rate, training, key=None, mode="upscale_in_train"):
+    """Reference dropout semantics: upscale_in_train scales kept values by
+    1/(1-rate) during training and is identity at inference;
+    downscale_in_infer keeps raw values during training and scales the
+    WHOLE tensor by (1-rate) at inference."""
+    if rate <= 0.0:
+        return v
+    if not training:
+        return v * (1.0 - rate) if mode == "downscale_in_infer" else v
+    from ...core import random as _random
+
+    key = key if key is not None else _random.next_key()
+    keep = jax.random.bernoulli(key, 1.0 - rate, v.shape)
+    kept = v / (1.0 - rate) if mode == "upscale_in_train" else v
+    return jnp.where(keep, kept, jnp.zeros_like(v))
+
+
+def _layer_norm(v, scale, bias, eps):
+    vf = v.astype(jnp.float32)
+    mean = vf.mean(axis=-1, keepdims=True)
+    var = vf.var(axis=-1, keepdims=True)
+    out = (vf - mean) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        out = out * scale.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(v.dtype)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """matmul + bias epilogue (reference fused_matmul_bias, cuBLASLt path);
+    XLA fuses the bias add into the dot."""
+    x, y = as_tensor(x), as_tensor(y)
+    args = [x, y] + ([as_tensor(bias)] if bias is not None else [])
+
+    def f(xv, yv, *rest):
+        xv = jnp.swapaxes(xv, -1, -2) if transpose_x else xv
+        yv = jnp.swapaxes(yv, -1, -2) if transpose_y else yv
+        out = xv @ yv
+        return out + rest[0] if rest else out
+
+    return apply("fused_matmul_bias", f, *args)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """y = layer_norm(residual + dropout(bias + x)) — reference
+    fused_transformer.py:274."""
+    x, residual = as_tensor(x), as_tensor(residual)
+    args = [x, residual]
+    for t in (bias, ln_scale, ln_bias):
+        if t is not None:
+            args.append(as_tensor(t))
+    has = [bias is not None, ln_scale is not None, ln_bias is not None]
+
+    from ...core import random as _random
+
+    key = (_random.next_key() if training and dropout_rate > 0.0 else None)
+
+    def f(xv, rv, *rest):
+        i = 0
+        b = rest[i] if has[0] else None
+        i += has[0]
+        s = rest[i] if has[1] else None
+        i += has[1]
+        lb = rest[i] if has[2] else None
+        h = xv + b if b is not None else xv
+        h = rv + _dropout(h, dropout_rate, training, key, mode)
+        return _layer_norm(h, s, lb, ln_epsilon)
+
+    return apply("fused_bias_dropout_residual_ln", f, *args)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, add_residual=True,
+                      name=None):
+    """Transformer FFN block (reference fused_transformer.py:31):
+    residual + dropout2(linear2(dropout1(act(linear1(maybe_ln(x))))))
+    with pre- or post-LN placement."""
+    from ...core import random as _random
+
+    tensors = {"x": as_tensor(x), "w1": as_tensor(linear1_weight),
+               "w2": as_tensor(linear2_weight)}
+    opt = {"b1": linear1_bias, "b2": linear2_bias, "s1": ln1_scale,
+           "lb1": ln1_bias, "s2": ln2_scale, "lb2": ln2_bias}
+    names = [k for k, v in opt.items() if v is not None]
+    args = list(tensors.values()) + [as_tensor(opt[k]) for k in names]
+    acts = {"relu": jax.nn.relu,
+            "gelu": lambda v: jax.nn.gelu(v, approximate=False)}
+    act = acts[activation]
+    k1 = _random.next_key() if training and dropout1_rate > 0 else None
+    k2 = _random.next_key() if training and dropout2_rate > 0 else None
+
+    def f(xv, w1, w2, *rest):
+        o = dict(zip(names, rest))
+        res = xv
+        h = _layer_norm(xv, o.get("s1"), o.get("lb1"), ln1_epsilon) \
+            if pre_layer_norm else xv
+        h = h @ w1
+        if "b1" in o:
+            h = h + o["b1"]
+        h = _dropout(act(h), dropout1_rate, training, k1, mode)
+        h = h @ w2
+        if "b2" in o:
+            h = h + o["b2"]
+        h = _dropout(h, dropout2_rate, training, k2, mode)
+        h = res + h if add_residual else h
+        if not pre_layer_norm:
+            h = _layer_norm(h, o.get("s2"), o.get("lb2"), ln2_epsilon)
+        return h
+
+    return apply("fused_feedforward", f, *args)
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False, pre_ln_scale=None,
+        pre_ln_bias=None, ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+        qkv_bias=None, linear_bias=None, cache_kv=None, attn_mask=None,
+        dropout_rate=0.5, attn_dropout_rate=0.5, ln_epsilon=1e-5,
+        training=True, mode="upscale_in_train", ring_id=-1, add_residual=True,
+        num_heads=-1, transpose_qkv_wb=False, name=None):
+    """Self-attention block (reference fused_transformer.py:464): fused
+    QKV projection -> scaled dot-product attention (+additive mask) ->
+    output linear -> dropout -> residual -> LN (pre- or post-placement).
+    qkv_weight: [3, num_heads, head_dim, embed_dim] (or [embed, 3*embed]
+    with transpose_qkv_wb=True and num_heads given)."""
+    from ...core import random as _random
+
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "cached decode: use incubate.nn.FusedMultiHeadAttention / "
+            "FusedMultiTransformer (gen_cache + time_step)")
+    xt, qkvw, lw = as_tensor(x), as_tensor(qkv_weight), as_tensor(linear_weight)
+    opt = {"pre_s": pre_ln_scale, "pre_b": pre_ln_bias, "s": ln_scale,
+           "lb": ln_bias, "qb": qkv_bias, "ob": linear_bias,
+           "mask": attn_mask}
+    names = [k for k, v in opt.items() if v is not None]
+    args = [xt, qkvw, lw] + [as_tensor(opt[k]) for k in names]
+    ka = _random.next_key() if training and attn_dropout_rate > 0 else None
+    kd = _random.next_key() if training and dropout_rate > 0 else None
+
+    def f(xv, qw, lwv, *rest):
+        o = dict(zip(names, rest))
+        B, S, E = xv.shape
+        res = xv
+        h = _layer_norm(xv, o.get("pre_s"), o.get("pre_b"), pre_ln_epsilon) \
+            if pre_layer_norm else xv
+        if transpose_qkv_wb:
+            if num_heads <= 0:
+                raise ValueError(
+                    "transpose_qkv_wb=True needs num_heads > 0 (the 2-D "
+                    "qkv_weight carries no head structure)")
+            H = num_heads
+            qkv = h @ qw  # [B, S, 3E]
+            if "qb" in o:
+                qkv = qkv + o["qb"]
+            qkv = qkv.reshape(B, S, 3, H, E // H)
+        else:
+            _, H, D, _ = qw.shape
+            qkv = jnp.einsum("bse,thde->bsthd", h, qw)
+            if "qb" in o:
+                qkv = qkv + o["qb"][None, None]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, S, H, D]
+        D = q.shape[-1]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) / jnp.sqrt(D)
+        if "mask" in o:
+            logits = logits + o["mask"].astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = _dropout(probs, attn_dropout_rate, training, ka, mode)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+        out = out.reshape(B, S, -1) @ lwv
+        if "ob" in o:
+            out = out + o["ob"]
+        out = _dropout(out, dropout_rate, training, kd, mode)
+        out = res + out if add_residual else out
+        if not pre_layer_norm:
+            out = _layer_norm(out, o.get("s"), o.get("lb"), ln_epsilon)
+        return out
+
+    return apply("fused_multi_head_attention", f, *args)
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type):
+    """Dense-gated expert mixture (reference fused_ec_moe): per token,
+    out = sum_e softmax(gate)[..., e] * ffn_e(x)."""
+    if act_type not in ("gelu", "relu"):
+        raise ValueError(f"act_type must be gelu|relu, got {act_type!r}")
+    args = [as_tensor(t) for t in
+            (x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias)]
+    act = jax.nn.relu if act_type == "relu" else \
+        (lambda v: jax.nn.gelu(v, approximate=False))
+
+    def f(xv, gv, w0, b0, w1, b1):
+        probs = jax.nn.softmax(gv.astype(jnp.float32), axis=-1)
+        h = jnp.einsum("bsd,edf->ebsf", xv, w0) + b0[:, None]
+        h = act(h)
+        y = jnp.einsum("ebsf,efd->ebsd", h, w1) + b1[:, None]
+        return jnp.einsum("ebsd,bse->bsd", y,
+                          probs.astype(y.dtype))
+
+    return apply("fused_ec_moe", f, *args)
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, cache_kvs=None, pre_caches=None, seq_lens=None,
+        rotary_embs=None, time_step=None, attn_mask=None, dropout_rate=0.0,
+        rotary_emb_dims=0, activation="gelu", training=False,
+        mode="upscale_in_train", trans_qkvw=True, ring_id=-1, name=None):
+    """Stacked transformer layers from per-layer weight lists (reference
+    fused_transformer.py:872), the functional twin of
+    incubate.nn.FusedMultiTransformer. The no-cache forward is implemented
+    here; cached decode (cache_kvs/time_step) lives on the layer class,
+    which carries the KV-cache state."""
+    if cache_kvs is not None or time_step is not None or pre_caches is not None:
+        raise NotImplementedError(
+            "cached decode: use incubate.nn.FusedMultiTransformer "
+            "(gen_cache + time_step)")
+    L = len(qkv_weights)
+    out = x
+    for i in range(L):
+        qw = as_tensor(qkv_weights[i])
+        # trans_qkvw=True stores [3, H, D, E]; False stores [E, 3, H, D]
+        if not trans_qkvw:
+            qw = Tensor(jnp.transpose(qw._value, (1, 2, 3, 0)))
+        out = fused_multi_head_attention(
+            out, qw, linear_weights[i], pre_layer_norm=pre_layer_norm,
+            pre_ln_scale=ln_scales[i] if ln_scales else None,
+            pre_ln_bias=ln_biases[i] if ln_biases else None,
+            ln_scale=ln_scales[i] if ln_scales else None,
+            ln_bias=ln_biases[i] if ln_biases else None,
+            pre_ln_epsilon=epsilon,
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, ln_epsilon=epsilon,
+            training=training, add_residual=True)
+        out = fused_feedforward(
+            out, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i] if ffn1_biases else None,
+            linear2_bias=ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=ffn_ln_scales[i] if ffn_ln_scales else None,
+            ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            ln2_scale=ffn_ln_scales[i] if ffn_ln_scales else None,
+            ln2_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, ln1_epsilon=epsilon, ln2_epsilon=epsilon,
+            pre_layer_norm=pre_layer_norm, training=training,
+            add_residual=True)
+    return as_tensor(out)

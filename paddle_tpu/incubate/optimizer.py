@@ -93,3 +93,160 @@ class ModelAverage:
             if id(p) in self._backup:
                 p._set_value_raw(self._backup[id(p)])
         self._backup = {}
+
+
+class LBFGS:
+    """Limited-memory BFGS with optional strong-Wolfe line search
+    (reference incubate/optimizer/lbfgs.py). Closure-driven like the
+    reference: ``step(closure)`` re-evaluates the loss (the closure must
+    zero grads, run forward, call backward) as many times as the line
+    search needs.
+
+    TPU note: L-BFGS is a host-driven sequential algorithm (curvature
+    pairs, dot products, line search); the heavy work — the closure's
+    forward/backward — still runs on device. History and direction math
+    run on flattened f32 host vectors.
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        import numpy as np
+
+        if parameters is None:
+            raise ValueError("LBFGS requires parameters=")
+        self._np = np
+        self._parameters = list(parameters)
+        self.learning_rate = learning_rate
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or 'strong_wolfe'")
+        if weight_decay is not None or grad_clip is not None:
+            raise NotImplementedError(
+                "LBFGS does not apply weight_decay/grad_clip (matching its "
+                "closure-driven contract); fold them into the closure's loss")
+        self.line_search_fn = line_search_fn
+        self._s: list = []  # param displacements
+        self._y: list = []  # grad displacements
+
+    # ---- flat <-> params ----
+    def _gather(self, grads=False):
+        np = self._np
+        parts = []
+        for p in self._parameters:
+            if grads and p.grad is None:
+                v = 0 * p._value  # parameter unused by the closure's loss
+            else:
+                v = p.grad._value if grads else p._value
+            parts.append(np.asarray(v, np.float32).reshape(-1))
+        return np.concatenate(parts) if parts else np.zeros(0, np.float32)
+
+    def _scatter(self, flat):
+        np = self._np
+        i = 0
+        for p in self._parameters:
+            n = int(np.prod(p.shape)) if p.shape else 1
+            block = flat[i:i + n].reshape(p.shape)
+            p._set_value_raw(block.astype(str(p._value.dtype)))
+            i += n
+
+    def _direction(self, g):
+        """Two-loop recursion over the (s, y) history."""
+        np = self._np
+        q = g.copy()
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / max(float(y @ s), 1e-20)
+            a = rho * float(s @ q)
+            alphas.append((a, rho, s, y))
+            q -= a * y
+        if self._y:
+            s, y = self._s[-1], self._y[-1]
+            q *= float(s @ y) / max(float(y @ y), 1e-20)
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(y @ q)
+            q += (a - b) * s
+        return -q
+
+    def step(self, closure):
+        np = self._np
+        loss = closure()
+        evals = 1
+        for _ in range(self.max_iter):
+            g = self._gather(grads=True)
+            if np.max(np.abs(g), initial=0.0) <= self.tolerance_grad:
+                break
+            d = self._direction(g)
+            x0 = self._gather()
+            f0 = float(loss.numpy()) if hasattr(loss, "numpy") else float(loss)
+            gtd = float(g @ d)
+            if gtd > -1e-20:  # not a descent direction: reset history
+                self._s, self._y = [], []
+                d = -g
+                gtd = float(g @ d)
+            t = self.learning_rate
+
+            def evaluate(step_size):
+                self._scatter(x0 + step_size * d)
+                l = closure()
+                return (float(l.numpy()) if hasattr(l, "numpy") else float(l),
+                        self._gather(grads=True), l)
+
+            if self.line_search_fn == "strong_wolfe":
+                c1, c2 = 1e-4, 0.9
+                lo, hi = 0.0, None
+                best = None
+                for _ls in range(10):
+                    f_t, g_t, loss_t = evaluate(t)
+                    evals += 1
+                    if f_t > f0 + c1 * t * gtd:
+                        hi = t
+                        t = (lo + hi) / 2
+                    elif abs(float(g_t @ d)) > c2 * abs(gtd):
+                        lo = t
+                        t = 2 * t if hi is None else (lo + hi) / 2
+                    else:
+                        best = (f_t, g_t, loss_t)
+                        break
+                    if evals >= self.max_eval:
+                        break
+                if best is None:
+                    f_t, g_t, loss_t = evaluate(t)
+                    evals += 1
+                f_t, g_new, loss = best if best else (f_t, g_t, loss_t)
+            else:
+                self._scatter(x0 + t * d)
+                loss = closure()
+                evals += 1
+                g_new = self._gather(grads=True)
+            x_new = self._gather()
+            s = x_new - x0
+            y = g_new - g
+            if float(y @ s) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if np.max(np.abs(s), initial=0.0) <= self.tolerance_change:
+                break
+            if evals >= self.max_eval:
+                break
+        return loss
+
+    def clear_grad(self):
+        for p in self._parameters:
+            p.clear_gradient()
+
+    def state_dict(self):
+        return {"s": [v.copy() for v in self._s],
+                "y": [v.copy() for v in self._y]}
+
+    def set_state_dict(self, state):
+        self._s = [self._np.asarray(v) for v in state.get("s", [])]
+        self._y = [self._np.asarray(v) for v in state.get("y", [])]
