@@ -82,19 +82,64 @@ def launch_ps(args) -> int:
     server processes with the PSERVER env contract, wait for their ports,
     spawn trainers with the TRAINER contract, then reap — trainers
     finishing cleanly wins; servers (which block in run_server) are
-    terminated once training is done."""
-    os.makedirs(args.log_dir, exist_ok=True)
-    if args.servers:
-        server_eps = args.servers.split(",")
-    else:
-        server_eps = [f"127.0.0.1:{_free_port()}"
-                      for _ in range(args.server_num or 2)]
-    if args.trainers:
-        trainer_eps = args.trainers.split(",")
-    else:
-        trainer_eps = [f"127.0.0.1:{_free_port()}"
-                       for _ in range(args.trainer_num or 2)]
+    terminated once training is done.
 
+    Auto-assigned ports come from _free_port(), which binds then releases —
+    another process can claim the port in that window (TOCTOU). A server
+    dying before its port opens is therefore retried with fresh ports (only
+    when the ports were auto-assigned; user-specified endpoints fail fast).
+    """
+    os.makedirs(args.log_dir, exist_ok=True)
+    # retries are decided PER ROLE: a bind failure only reruns the job when
+    # that role's ports were auto-assigned (a steal can land on a fresh
+    # port); user-specified endpoints and non-bind deaths fail fast
+    auto_servers, auto_trainers = not args.servers, not args.trainers
+    attempts = 3 if (auto_servers or auto_trainers) else 1
+    for attempt in range(attempts):
+        server_eps = (args.servers.split(",") if args.servers else
+                      [f"127.0.0.1:{_free_port()}"
+                       for _ in range(args.server_num or 2)])
+        trainer_eps = (args.trainers.split(",") if args.trainers else
+                       [f"127.0.0.1:{_free_port()}"
+                        for _ in range(args.trainer_num or 2)])
+        try:
+            return _launch_ps_once(
+                args, server_eps, trainer_eps,
+                retry_servers=auto_servers and attempt + 1 < attempts,
+                retry_trainers=auto_trainers and attempt + 1 < attempts)
+        except _RetryableLaunchError as e:
+            print(f"ps launch attempt {attempt + 1} failed ({e}); "
+                  f"retrying with fresh ports", file=sys.stderr)
+    raise AssertionError("unreachable")
+
+
+class _RetryableLaunchError(RuntimeError):
+    """A launch failure attributable to an auto-assigned port being stolen
+    in the _free_port TOCTOU window — worth rerunning with fresh ports."""
+
+
+# a trainer dying this quickly after spawn AND with a bind error in its log
+# is a port-steal casualty (the _free_port TOCTOU window) — retried when
+# ports were auto-assigned. Deterministic script errors (ImportError, bad
+# argv) also exit fast but show no bind marker, and must NOT be retried.
+_TRAINER_STARTUP_WINDOW = 10.0
+_BIND_ERROR_MARKERS = ("address already in use", "eaddrinuse", "errno 98",
+                       "failed to bind", "bind(")
+
+
+def _log_tail_has_bind_error(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            f.seek(max(0, f.tell() - 8192))
+            tail = f.read().decode("utf-8", "ignore").lower()
+    except OSError:
+        return False
+    return any(m in tail for m in _BIND_ERROR_MARKERS)
+
+
+def _launch_ps_once(args, server_eps, trainer_eps, retry_servers=False,
+                    retry_trainers=False) -> int:
     def common_env():
         env = _pkg_pythonpath(dict(os.environ))
         env.update(
@@ -121,7 +166,17 @@ def launch_ps(args) -> int:
                                  stderr=subprocess.STDOUT)
             procs.append(("server", p, log))
             servers.append(p)
-        _wait_ports(server_eps, procs=servers)
+        try:
+            _wait_ports(server_eps, procs=servers)
+        except RuntimeError as e:
+            # retry only a server death whose log shows a bind error on
+            # auto-assigned ports; script bugs / hangs fail fast
+            if retry_servers and any(
+                    _log_tail_has_bind_error(
+                        os.path.join(args.log_dir, f"serverlog.{i}"))
+                    for i in range(len(server_eps))):
+                raise _RetryableLaunchError(str(e)) from e
+            raise
         trainers = []
         for i, ep in enumerate(trainer_eps):
             env = common_env()
@@ -133,9 +188,21 @@ def launch_ps(args) -> int:
                                  stderr=subprocess.STDOUT)
             procs.append(("trainer", p, log))
             trainers.append(p)
+        trainers_spawned = time.time()
         # reap trainers while watching servers: a dead server would leave
         # trainers blocked on it forever, so that is a job failure too
         while True:
+            if retry_trainers and time.time() - trainers_spawned \
+                    < _TRAINER_STARTUP_WINDOW:
+                for i, p in enumerate(trainers):
+                    if p.poll() is not None and p.returncode != 0 \
+                            and _log_tail_has_bind_error(
+                                os.path.join(args.log_dir, f"workerlog.{i}")):
+                        raise _RetryableLaunchError(
+                            f"trainer {i} exited with {p.returncode} on a "
+                            f"bind error within "
+                            f"{_TRAINER_STARTUP_WINDOW:.0f}s of spawn "
+                            "(see workerlog.*)")
             if all(p.poll() is not None for p in trainers):
                 break
             # any server exit while trainers still run strands them mid-RPC

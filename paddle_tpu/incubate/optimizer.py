@@ -215,7 +215,7 @@ class LBFGS:
                         break
                     if evals >= self.max_eval:
                         break
-                if best is None:
+                if best is None and evals < self.max_eval:
                     f_t, g_t, loss_t = evaluate(t)
                     evals += 1
                 f_t, g_new, loss = best if best else (f_t, g_t, loss_t)

@@ -162,20 +162,22 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
     return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", ceil_mode, "avg_pool3d", exclusive=exclusive)
 
 
-def _adaptive_pool(x, output_size, n, reduce_fn, op_name):
+def _adaptive_pool(x, output_size, n, reduce_fn, op_name, data_format=None):
     x = as_tensor(x)
     out_sizes = _norm_tuple(output_size, n)
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+    off = 1 if channels_last else 2  # spatial dims start
 
     def fn(xv):
-        spatial = xv.shape[2:]
+        spatial = xv.shape[off:off + n]
         out = xv
         # pool each spatial dim independently with computed windows
         for d in range(n):
             in_s, out_s = spatial[d], out_sizes[d]
             if in_s % out_s == 0:
                 k = in_s // out_s
-                shape = out.shape[: 2 + d] + (out_s, k) + out.shape[2 + d + 1 :]
-                out = reduce_fn(out.reshape(shape), axis=2 + d + 1)
+                shape = out.shape[: off + d] + (out_s, k) + out.shape[off + d + 1 :]
+                out = reduce_fn(out.reshape(shape), axis=off + d + 1)
             else:
                 # general case: gather per-output-bin slices (static loop)
                 starts = [int(np.floor(i * in_s / out_s)) for i in range(out_s)]
@@ -183,9 +185,9 @@ def _adaptive_pool(x, output_size, n, reduce_fn, op_name):
                 pieces = []
                 for s, e in zip(starts, ends):
                     sl = [slice(None)] * out.ndim
-                    sl[2 + d] = slice(s, e)
-                    pieces.append(reduce_fn(out[tuple(sl)], axis=2 + d, keepdims=True))
-                out = jnp.concatenate(pieces, axis=2 + d)
+                    sl[off + d] = slice(s, e)
+                    pieces.append(reduce_fn(out[tuple(sl)], axis=off + d, keepdims=True))
+                out = jnp.concatenate(pieces, axis=off + d)
         return out
 
     return apply(op_name, fn, x)
@@ -198,12 +200,14 @@ def adaptive_avg_pool1d(x, output_size, name=None):
 
 @register_op("nn.adaptive_avg_pool2d")
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
-    return _adaptive_pool(x, output_size, 2, jnp.mean, "adaptive_avg_pool2d")
+    return _adaptive_pool(x, output_size, 2, jnp.mean, "adaptive_avg_pool2d",
+                          data_format=data_format)
 
 
 @register_op("nn.adaptive_avg_pool3d")
 def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
-    return _adaptive_pool(x, output_size, 3, jnp.mean, "adaptive_avg_pool3d")
+    return _adaptive_pool(x, output_size, 3, jnp.mean, "adaptive_avg_pool3d",
+                          data_format=data_format)
 
 
 @register_op("nn.adaptive_max_pool1d")

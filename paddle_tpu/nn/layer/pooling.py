@@ -6,10 +6,29 @@ from .. import functional as F
 from .layers import Layer
 
 
-class _Pool(Layer):
+class _PoolBase(Layer):
+    """data_format plumbing shared by all pool layers: subclasses that can
+    honor it declare _DF_DEFAULT; a non-default data_format passed to a
+    layer whose functional cannot honor it is an ERROR, never silently
+    dropped (it would pool over the wrong axes of a channels-last tensor)."""
+
+    _DF_DEFAULT = None
+
+    def _take_df(self, kw):
+        df = kw.pop("data_format", None)
+        if df is None:
+            return self._DF_DEFAULT
+        if self._DF_DEFAULT is None:
+            raise ValueError(
+                f"{type(self).__name__} does not support data_format={df!r}")
+        return df
+
+
+class _Pool(_PoolBase):
     def __init__(self, kernel_size=None, stride=None, padding=0, **kw):
         super().__init__()
         self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format = self._take_df(kw)
         self.kw = kw
 
 
@@ -19,13 +38,19 @@ class MaxPool1D(_Pool):
 
 
 class MaxPool2D(_Pool):
+    _DF_DEFAULT = "NCHW"
+
     def forward(self, x):
-        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            data_format=self.data_format)
 
 
 class MaxPool3D(_Pool):
+    _DF_DEFAULT = "NCDHW"
+
     def forward(self, x):
-        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            data_format=self.data_format)
 
 
 class AvgPool1D(_Pool):
@@ -34,19 +59,27 @@ class AvgPool1D(_Pool):
 
 
 class AvgPool2D(_Pool):
+    _DF_DEFAULT = "NCHW"
+
     def forward(self, x):
-        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding)
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            data_format=self.data_format)
 
 
 class AvgPool3D(_Pool):
+    _DF_DEFAULT = "NCDHW"
+
     def forward(self, x):
-        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding)
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            data_format=self.data_format)
 
 
-class _AdaptivePool(Layer):
+class _AdaptivePool(_PoolBase):
     def __init__(self, output_size, **kw):
         super().__init__()
         self.output_size = output_size
+        self.data_format = self._take_df(kw)
+        self.kw = kw
 
 
 class AdaptiveAvgPool1D(_AdaptivePool):
@@ -55,13 +88,19 @@ class AdaptiveAvgPool1D(_AdaptivePool):
 
 
 class AdaptiveAvgPool2D(_AdaptivePool):
+    _DF_DEFAULT = "NCHW"
+
     def forward(self, x):
-        return F.adaptive_avg_pool2d(x, self.output_size)
+        return F.adaptive_avg_pool2d(x, self.output_size,
+                                     data_format=self.data_format)
 
 
 class AdaptiveAvgPool3D(_AdaptivePool):
+    _DF_DEFAULT = "NCDHW"
+
     def forward(self, x):
-        return F.adaptive_avg_pool3d(x, self.output_size)
+        return F.adaptive_avg_pool3d(x, self.output_size,
+                                     data_format=self.data_format)
 
 
 class AdaptiveMaxPool1D(_AdaptivePool):

@@ -1,4 +1,9 @@
 from .mnist import MNIST, FashionMNIST
 from .cifar import Cifar10, Cifar100
+from .folder import (DatasetFolder, ImageFolder, make_dataset,
+                     has_valid_extension, default_loader, IMG_EXTENSIONS)
+from .flowers import Flowers
+from .voc2012 import VOC2012
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder", "Flowers", "VOC2012"]

@@ -1,0 +1,120 @@
+"""Oxford 102 Flowers (reference python/paddle/vision/datasets/flowers.py:41
+Flowers). Three artifacts: the image tarball (jpg/image_%05d.jpg), the
+imagelabels.mat label vector, and the setid.mat train/valid/test index split
+— all loaded with scipy.io like the reference (:170-172).
+
+Data paths per the repo-wide protocol (see vision/datasets/cifar.py and
+text/datasets.py): explicit ``*_file`` args -> parse the real on-disk
+formats; ``download=True`` -> env-gated cache fetch; neither -> a
+deterministic synthetic set with the same record schema so offline tests
+exercise the full indexing path.
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+from typing import Optional
+
+import numpy as np
+
+from ...io import Dataset
+from ...utils.download import dataset_path
+
+__all__ = ["Flowers"]
+
+DATA_URL = "http://paddlemodels.bj.bcebos.com/flowers/102flowers.tgz"
+LABEL_URL = "http://paddlemodels.bj.bcebos.com/flowers/imagelabels.mat"
+SETID_URL = "http://paddlemodels.bj.bcebos.com/flowers/setid.mat"
+DATA_MD5 = "52808999861908f626f3c1f4e79d11fa"
+LABEL_MD5 = "e0620be6f572b9609742df49c70aed4d"
+SETID_MD5 = "a5357ecc9cb78c4bef273ce3793fc85c"
+
+# mode -> setid.mat field (reference flowers.py MODE_FLAG_MAP)
+MODE_FLAG_MAP = {"train": "trnid", "test": "tstid", "valid": "valid"}
+
+
+class Flowers(Dataset):
+    """102-class flower images; labels are 1-based in the .mat files and
+    returned as int64 arrays of shape (1,) exactly like the reference
+    (flowers.py:174-190)."""
+
+    NUM_CLASSES = 102
+
+    def __init__(self, data_file: Optional[str] = None,
+                 label_file: Optional[str] = None,
+                 setid_file: Optional[str] = None,
+                 mode: str = "train", transform=None, download: bool = False,
+                 backend=None, n_synthetic: int = 64):
+        mode = mode.lower()
+        if mode not in MODE_FLAG_MAP:
+            raise ValueError(
+                f"mode should be 'train', 'valid' or 'test', but got {mode}")
+        from .. import get_image_backend
+        backend = backend or get_image_backend()
+        if backend not in ("pil", "numpy"):
+            raise ValueError(
+                f"Expected backend 'pil' or 'numpy', got {backend!r}")
+        self.backend = backend
+        self.mode = mode
+        self.transform = transform
+        flag = MODE_FLAG_MAP[mode]
+
+        if download:
+            data_file = data_file or dataset_path(DATA_URL, "flowers", DATA_MD5)
+            label_file = label_file or dataset_path(LABEL_URL, "flowers", LABEL_MD5)
+            setid_file = setid_file or dataset_path(SETID_URL, "flowers", SETID_MD5)
+
+        if data_file and label_file and setid_file:
+            import scipy.io as scio
+
+            self._synthetic = None
+            # index the tarball once; images decode lazily per __getitem__
+            self._tar = tarfile.open(data_file)
+            self._members = {os.path.normpath(m.name).lstrip("./"): m
+                             for m in self._tar.getmembers()}
+            self.labels = np.asarray(
+                scio.loadmat(label_file)["labels"][0], np.int64)
+            self.indexes = np.asarray(
+                scio.loadmat(setid_file)[flag][0], np.int64)
+        elif data_file or label_file or setid_file:
+            raise ValueError(
+                "Flowers needs all three of data_file/label_file/setid_file "
+                "(or none, for the synthetic fallback)")
+        else:
+            rng = np.random.RandomState(
+                {"train": 0, "valid": 1, "test": 2}[mode])
+            self._tar = None
+            self._synthetic = (rng.rand(n_synthetic, 32, 32, 3)
+                               * 255).astype(np.uint8)
+            self.labels = rng.randint(
+                1, self.NUM_CLASSES + 1, size=n_synthetic).astype(np.int64)
+            self.indexes = np.arange(1, n_synthetic + 1, dtype=np.int64)
+
+    def _image(self, index: int):
+        from PIL import Image
+
+        if self._synthetic is not None:
+            return Image.fromarray(self._synthetic[index - 1])
+        name = "jpg/image_%05d.jpg" % index
+        member = self._members[name]
+        import io as _io
+
+        return Image.open(_io.BytesIO(self._tar.extractfile(member).read()))
+
+    def __getitem__(self, idx):
+        index = int(self.indexes[idx])
+        label = np.array([self.labels[index - 1]]).astype(np.int64)
+        image = self._image(index)
+        if self.backend == "numpy":
+            image = np.array(image)
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+    def __del__(self):
+        if getattr(self, "_tar", None) is not None:
+            self._tar.close()

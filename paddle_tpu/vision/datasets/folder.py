@@ -1,0 +1,147 @@
+"""Generic on-disk image datasets (reference python/paddle/vision/datasets/
+folder.py:26 has_valid_extension, :43 make_dataset, :66 DatasetFolder,
+:306 ImageFolder).
+
+`DatasetFolder` walks ``root/class_x/*.ext`` assigning one integer label per
+class directory; `ImageFolder` walks a flat (possibly nested) directory and
+yields unlabeled samples. Both defer decoding to a pluggable ``loader`` so
+the image backend ('pil' default, 'numpy' here instead of the reference's
+cv2 — cv2 is not in this image) is a per-dataset choice.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ...io import Dataset
+
+__all__ = ["DatasetFolder", "ImageFolder", "make_dataset",
+           "has_valid_extension", "default_loader", "IMG_EXTENSIONS"]
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+def has_valid_extension(filename: str, extensions) -> bool:
+    """Case-insensitive suffix check (reference folder.py:26)."""
+    if not isinstance(extensions, (list, tuple)):
+        raise TypeError("`extensions` must be list or tuple.")
+    return filename.lower().endswith(tuple(x.lower() for x in extensions))
+
+
+def default_loader(path: str):
+    """Decode one image via the module-level backend (reference
+    folder.py:297 default_loader; pil/numpy instead of pil/cv2)."""
+    from .. import image_load
+
+    return image_load(path)
+
+
+def make_dataset(directory, class_to_idx, extensions, is_valid_file=None):
+    """Collect (path, class_index) samples under per-class subdirectories,
+    sorted for determinism (reference folder.py:43)."""
+    samples = []
+    directory = os.path.expanduser(directory)
+    if extensions is not None:
+        def is_valid_file(x):  # noqa: F811 — reference shadows it the same way
+            return has_valid_extension(x, extensions)
+    for target in sorted(class_to_idx):
+        d = os.path.join(directory, target)
+        if not os.path.isdir(d):
+            continue
+        for sub, _, fnames in sorted(os.walk(d, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(sub, fname)
+                if is_valid_file(path):
+                    samples.append((path, class_to_idx[target]))
+    return samples
+
+
+class DatasetFolder(Dataset):
+    """root/class_a/*.ext, root/class_b/*.ext -> (image, class_index)
+    (reference folder.py:66). Attributes match the reference: ``classes``
+    (sorted class names), ``class_to_idx``, ``samples``, ``targets``."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        if extensions is not None and is_valid_file is not None:
+            raise ValueError(
+                "Only one of extensions / is_valid_file may be given")
+        classes, class_to_idx = self._find_classes(root)
+        samples = make_dataset(root, class_to_idx, extensions, is_valid_file)
+        if not samples:
+            raise RuntimeError(
+                f"Found 0 files in subfolders of: {root}\n"
+                f"Supported extensions are: {extensions}")
+        self.loader = loader if loader is not None else default_loader
+        self.extensions = extensions
+        self.classes = classes
+        self.class_to_idx = class_to_idx
+        self.samples = samples
+        self.targets = [s[1] for s in samples]
+
+    def _find_classes(self, directory):
+        """Sorted subdirectory names -> contiguous indices (reference
+        folder.py:237)."""
+        classes = sorted(e.name for e in os.scandir(directory) if e.is_dir())
+        if not classes:
+            raise RuntimeError(f"Found 0 class directories in: {directory}")
+        return classes, {c: i for i, c in enumerate(classes)}
+
+    def __getitem__(self, index):
+        path, target = self.samples[index]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat (recursively walked) directory of images, no labels — each
+    sample is a one-element list like the reference's (reference
+    folder.py:306, __getitem__ :465 returns [sample])."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        if extensions is not None and is_valid_file is not None:
+            raise ValueError(
+                "Only one of extensions / is_valid_file may be given")
+        if is_valid_file is None:
+            def is_valid_file(x):
+                return has_valid_extension(x, extensions)
+        samples = []
+        for sub, _, fnames in sorted(os.walk(os.path.expanduser(root),
+                                             followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(sub, fname)
+                if is_valid_file(path):
+                    samples.append(path)
+        if not samples:
+            raise RuntimeError(
+                f"Found 0 files in subfolders of: {root}\n"
+                f"Supported extensions are: {extensions}")
+        self.loader = loader if loader is not None else default_loader
+        self.extensions = extensions
+        self.samples = samples
+
+    def __getitem__(self, index):
+        path = self.samples[index]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,113 @@
+"""Pascal VOC2012 segmentation (reference python/paddle/vision/datasets/
+voc2012.py:39 VOC2012). Samples come straight out of the trainval tarball:
+the split list under ImageSets/Segmentation/{train,val,trainval}.txt names
+the JPEG image and the PNG class-index mask per record (:147 _load_anno,
+:166 __getitem__ decodes both from the open tar).
+
+Data paths per the repo-wide protocol: ``data_file=`` parses a real VOC
+tarball; ``download=True`` is the env-gated cache fetch; neither
+synthesizes deterministic (image, mask) pairs with the same schema.
+"""
+
+from __future__ import annotations
+
+import io
+import tarfile
+from typing import Optional
+
+import numpy as np
+
+from ...io import Dataset
+from ...utils.download import dataset_path
+
+__all__ = ["VOC2012"]
+
+VOC_URL = "https://dataset.bj.bcebos.com/voc/VOCtrainval_11-May-2012.tar"
+VOC_MD5 = "6cd6e144f989b92b3379bac3b3de84fd"
+
+SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+# mode -> split-list name (reference voc2012.py MODE_FLAG_MAP; 'valid'->'val')
+MODE_FLAG_MAP = {"train": "train", "test": "test", "valid": "val"}
+
+
+class VOC2012(Dataset):
+    """(image, segmentation mask) pairs; 21 classes + void(255)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform=None, download: bool = False, backend=None,
+                 n_synthetic: int = 16):
+        mode = mode.lower()
+        if mode not in MODE_FLAG_MAP:
+            raise ValueError(
+                f"mode should be 'train', 'valid' or 'test', but got {mode}")
+        from .. import get_image_backend
+        backend = backend or get_image_backend()
+        if backend not in ("pil", "numpy"):
+            raise ValueError(
+                f"Expected backend 'pil' or 'numpy', got {backend!r}")
+        self.backend = backend
+        self.mode = mode
+        self.transform = transform
+        self.flag = MODE_FLAG_MAP[mode]
+
+        if download and not data_file:
+            data_file = dataset_path(VOC_URL, "voc2012", VOC_MD5)
+        self.data_file = data_file
+        self.data_tar = None
+        if data_file:
+            self._synthetic = None
+            self._load_anno()
+        else:
+            rng = np.random.RandomState(
+                {"train": 0, "valid": 1, "test": 2}[mode])
+            imgs = (rng.rand(n_synthetic, 32, 32, 3) * 255).astype(np.uint8)
+            masks = rng.randint(0, 21, size=(n_synthetic, 32, 32)).astype(
+                np.uint8)
+            self._synthetic = (imgs, masks)
+            self.data = list(range(n_synthetic))
+            self.labels = list(range(n_synthetic))
+
+    def _load_anno(self):
+        """Index the tarball and resolve the split list into per-record
+        member names (reference voc2012.py:147)."""
+        self.data_tar = tarfile.open(self.data_file)
+        self.name2mem = {m.name.lstrip("./"): m
+                         for m in self.data_tar.getmembers()}
+        sets = self.data_tar.extractfile(
+            self.name2mem[SET_FILE.format(self.flag)])
+        self.data, self.labels = [], []
+        for line in sets:
+            name = line.strip().decode("utf-8")
+            if not name:
+                continue
+            self.data.append(DATA_FILE.format(name))
+            self.labels.append(LABEL_FILE.format(name))
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        if self._synthetic is not None:
+            imgs, masks = self._synthetic
+            image = Image.fromarray(imgs[idx])
+            label = Image.fromarray(masks[idx], mode="L")
+        else:
+            image = Image.open(io.BytesIO(self.data_tar.extractfile(
+                self.name2mem[self.data[idx]]).read()))
+            label = Image.open(io.BytesIO(self.data_tar.extractfile(
+                self.name2mem[self.labels[idx]]).read()))
+        if self.backend == "numpy":
+            image = np.array(image)
+            label = np.array(label)
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label
+
+    def __len__(self):
+        return len(self.data)
+
+    def __del__(self):
+        if getattr(self, "data_tar", None) is not None:
+            self.data_tar.close()

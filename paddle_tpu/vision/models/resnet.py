@@ -1,5 +1,8 @@
 """ResNet family (vision/models/resnet.py analog; BASELINE config 4's
-conv/bn path). NCHW layout like the reference; convs lower to XLA
+conv/bn path). Default layout is NCHW like the reference; pass
+``data_format="NHWC"`` for the TPU-native channels-last layout (XLA's conv
+layouts are NHWC-native — the reference reaches the same point via its
+layout autotuner, phi/kernels/autotune/). Convs lower to XLA
 conv_general_dilated which maps onto the MXU — bf16-friendly when the model
 is cast. No pretrained download (zero-egress environment): `pretrained=True`
 raises with a pointer to state_dict loading."""
@@ -19,14 +22,15 @@ def _no_pretrained(arch):
 class BasicBlock(nn.Layer):
     expansion = 1
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1, base_width=64, dilation=1, norm_layer=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1, base_width=64, dilation=1, norm_layer=None, data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
-        self.conv1 = nn.Conv2D(inplanes, planes, 3, padding=1, stride=stride, bias_attr=False)
-        self.bn1 = norm_layer(planes)
+        df = dict(data_format=data_format)
+        self.conv1 = nn.Conv2D(inplanes, planes, 3, padding=1, stride=stride, bias_attr=False, **df)
+        self.bn1 = norm_layer(planes, **df)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False, **df)
+        self.bn2 = norm_layer(planes, **df)
         self.downsample = downsample
         self.stride = stride
 
@@ -42,16 +46,17 @@ class BasicBlock(nn.Layer):
 class BottleneckBlock(nn.Layer):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1, base_width=64, dilation=1, norm_layer=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1, base_width=64, dilation=1, norm_layer=None, data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = norm_layer(width)
-        self.conv2 = nn.Conv2D(width, width, 3, padding=dilation, stride=stride, groups=groups, dilation=dilation, bias_attr=False)
-        self.bn2 = norm_layer(width)
-        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False)
-        self.bn3 = norm_layer(planes * self.expansion)
+        df = dict(data_format=data_format)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False, **df)
+        self.bn1 = norm_layer(width, **df)
+        self.conv2 = nn.Conv2D(width, width, 3, padding=dilation, stride=stride, groups=groups, dilation=dilation, bias_attr=False, **df)
+        self.bn2 = norm_layer(width, **df)
+        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False, **df)
+        self.bn3 = norm_layer(planes * self.expansion, **df)
         self.relu = nn.ReLU()
         self.downsample = downsample
 
@@ -66,7 +71,7 @@ class BottleneckBlock(nn.Layer):
 
 
 class ResNet(nn.Layer):
-    def __init__(self, block, depth=None, layers=None, width=64, num_classes=1000, with_pool=True, groups=1):
+    def __init__(self, block, depth=None, layers=None, width=64, num_classes=1000, with_pool=True, groups=1, data_format="NCHW"):
         super().__init__()
         layer_cfg = {
             18: [2, 2, 2, 2],
@@ -81,34 +86,37 @@ class ResNet(nn.Layer):
         self.num_classes = num_classes
         self.with_pool = with_pool
         self._norm_layer = nn.BatchNorm2D
+        self._data_format = data_format
         self.inplanes = 64
         self.dilation = 1
 
-        self.conv1 = nn.Conv2D(3, self.inplanes, kernel_size=7, stride=2, padding=3, bias_attr=False)
-        self.bn1 = self._norm_layer(self.inplanes)
+        df = dict(data_format=data_format)
+        self.conv1 = nn.Conv2D(3, self.inplanes, kernel_size=7, stride=2, padding=3, bias_attr=False, **df)
+        self.bn1 = self._norm_layer(self.inplanes, **df)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1, **df)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1), **df)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1):
         norm_layer = self._norm_layer
         downsample = None
+        df = dict(data_format=self._data_format)
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
-                nn.Conv2D(self.inplanes, planes * block.expansion, 1, stride=stride, bias_attr=False),
-                norm_layer(planes * block.expansion),
+                nn.Conv2D(self.inplanes, planes * block.expansion, 1, stride=stride, bias_attr=False, **df),
+                norm_layer(planes * block.expansion, **df),
             )
-        layers = [block(self.inplanes, planes, stride, downsample, self.groups, self.base_width, norm_layer=norm_layer)]
+        layers = [block(self.inplanes, planes, stride, downsample, self.groups, self.base_width, norm_layer=norm_layer, **df)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes, groups=self.groups, base_width=self.base_width, norm_layer=norm_layer))
+            layers.append(block(self.inplanes, planes, groups=self.groups, base_width=self.base_width, norm_layer=norm_layer, **df))
         return nn.Sequential(*layers)
 
     def forward(self, x):
