@@ -53,11 +53,18 @@ def register_pass(pass_cls: Type[Pass]):
 
 
 DEFAULT_PIPELINE = ["algebraic_simplify", "constant_folding", "cse", "dce"]
+# Order constraints: multihead before fc (the QKV projections must still be
+# raw dot+add when the attention pattern anchors); gelu before fc (fc
+# absorbs pd.gelu as its activation); layer_norm before embedding_eltwise
+# (which anchors on pd.layer_norm); affine/conv_bn folds before fc (folding
+# a BN scale INTO the matmul weights beats wrapping the matmul in a fused
+# op, so fc must not consume those matmuls first).
 INFERENCE_PIPELINE = ["delete_quant_dequant", "dropout_eliminate",
                       "multihead_matmul_fuse", "gelu_fuse",
+                      "layer_norm_fuse", "embedding_eltwise_layernorm_fuse",
                       "algebraic_simplify", "constant_folding",
                       "affine_chain_collapse", "conv_bn_fuse",
-                      "cse", "dce"]
+                      "fc_fuse", "cse", "dce"]
 
 
 class PassManager:

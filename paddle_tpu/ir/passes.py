@@ -440,24 +440,32 @@ def _eval_const_chain(program: Program, v, memo=None, limit=1 << 22):
     return memo.get(v.id)
 
 
+# Per-intermediate element ceiling for mask-subgraph evaluation: 4096^2
+# keeps a worst-case f32 intermediate at 64MB. Masks for longer sequences
+# are simply not proven (the flash kernel still handles them via the
+# explicit `causal` flag on the fused op); raise if a serving program
+# genuinely traces a longer constant mask.
+_MASK_EVAL_LIMIT = 4096 * 4096
+
+
 def _is_causal_mask(program: Program, v, memo=None) -> bool:
     """True when `v` provably EVALUATES to the standard lower-triangular
     (diagonal-inclusive) boolean causal mask. Name-sniffing a tril jit is
     not enough — tril(k=-1) or tril of a non-ones matrix would fuse as
     standard causal and silently corrupt outputs — so the mask subgraph is
-    evaluated and compared exactly. The element limit covers bool masks up
-    to seq 8192 (the long-context serving case this fusion exists for);
-    `memo` is shared across a pass run so a mask feeding every layer is
+    evaluated and compared exactly. The static shape is screened BEFORE any
+    evaluation (non-square or oversized masks never run the constant chain),
+    and `memo` is shared across a pass run so a mask feeding every layer is
     evaluated once, not per attention site."""
-    m = _eval_const_chain(program, v, memo=memo, limit=8192 * 8192)
+    shp = tuple(getattr(v.type, "shape", None) or ())
+    if len(shp) < 2 or shp[-1] != shp[-2] or any(d != 1 for d in shp[:-2]):
+        return False
+    if shp[-1] * shp[-1] > _MASK_EVAL_LIMIT:
+        return False
+    m = _eval_const_chain(program, v, memo=memo, limit=_MASK_EVAL_LIMIT)
     if m is None or m.dtype != bool or m.ndim < 2:
         return False
-    lead = m.shape[:-2]
-    if any(d != 1 for d in lead):
-        return False
     m2 = m.reshape(m.shape[-2], m.shape[-1])
-    if m2.shape[0] != m2.shape[1]:
-        return False
     return bool(np.array_equal(m2, np.tril(np.ones_like(m2))))
 
 
@@ -763,13 +771,9 @@ class GeluFusePass(Pass):
                     continue
 
                 def gelu(x):
-                    import jax.numpy as jnp
+                    from ..kernels.elementwise import tanh_gelu_raw
 
-                    # dtype-preserving tanh polynomial (python scalars stay
-                    # weak-typed): jax.nn.gelu upcasts bf16 internally,
-                    # which measured 20% SLOWER than the traced bf16 chain
-                    inner = x + 0.044715 * x * x * x
-                    return 0.5 * x * (1.0 + jnp.tanh(0.7978845608028654 * inner))
+                    return tanh_gelu_raw(x)
 
                 op = program.create_op("pd.gelu", [x_v],
                                        [outer.result(0).type],
@@ -780,6 +784,378 @@ class GeluFusePass(Pass):
                 outer.erase()
                 changed += 1
                 break
+        if changed:
+            program.dce()
+        return changed
+
+
+def _bcast_of_1d(program: Program, v, size: int):
+    """The affine-param idiom every normalization/bias site traces as:
+    v = broadcast_in_dim(u) of a 1-D u of `size`, or (after constant
+    folding collapses that broadcast) a CONSTANT shaped (1, ..., 1, size).
+    Returns the parameter value or None. Consumers must reshape(-1) —
+    the folded form keeps its leading 1s."""
+    op = v.defining_op()
+    if op is not None and op.name == "pd.broadcast_in_dim":
+        u = op.operands[0]
+        if tuple(u.type.shape) == (size,):
+            return u
+    shp = tuple(v.type.shape)
+    if shp == (size,):
+        return v
+    if shp and shp[-1] == size and all(d == 1 for d in shp[:-1]) \
+            and _const_value(program, v) is not None:
+        return v
+    return None
+
+
+def _split_binary(program: Program, op, name, pred):
+    """op must be `name`(a, b) with exactly one operand satisfying pred;
+    returns (matched, other) or None. The either-operand-order helper all
+    commutative patterns need."""
+    if op is None or op.name != name or len(op.operands) != 2:
+        return None
+    for i in (0, 1):
+        m = pred(op.operands[i])
+        if m is not None:
+            return m, op.operands[1 - i]
+    return None
+
+
+def _is_mean_of(program: Program, v, x_v, axis: int, n: int):
+    """v == reduce_sum(x, axes=(axis,)) broadcast back keepdims then
+    divided by n (or multiplied by 1/n). Returns True when v is the mean of
+    x_v over `axis` — the exact chain nn.LayerNorm traces."""
+    op = v.defining_op()
+    if op is None:
+        return False
+    if op.name == "pd.div":
+        c = _const_value(program, op.operands[1])
+        if c is None or np.asarray(c).size != 1 \
+                or abs(float(np.asarray(c).reshape(())) - n) > 1e-6 * n:
+            return False
+        v = op.operands[0]
+    elif op.name == "pd.mul":
+        got = _split_binary(
+            program, op, "pd.mul",
+            lambda o: o if (_const_value(program, o) is not None
+                           and np.asarray(_const_value(program, o)).size == 1)
+            else None)
+        if got is None:
+            return False
+        cv, v = got
+        if abs(float(np.asarray(_const_value(program, cv)).reshape(()))
+               - 1.0 / n) > 1e-6 / n:
+            return False
+    else:
+        return False
+    op = v.defining_op()
+    if op is not None and op.name == "pd.broadcast_in_dim":
+        v = op.operands[0]
+        op = v.defining_op()
+    if op is None or op.name != "pd.reduce_sum" \
+            or op.operands[0].id != x_v.id:
+        return False
+    axes = program.op_bind[op.id][1].get("axes") \
+        if op.id in program.op_bind else None
+    return axes is not None and tuple(axes) == (axis,)
+
+
+@register_pass
+class LayerNormFusePass(Pass):
+    """Recompose the traced mean/var/rsqrt/affine chain into one
+    pd.layer_norm op (the reference's layer_norm_fuse_pass.cc:1, which
+    rebuilds LayerNorm from its decomposed form for the serving engines).
+    TPU-native payoff: the single op re-binds to the Pallas fused_layer_norm
+    kernel (kernels/norms.py) instead of the 15-op jnp chain, and it is the
+    anchor EmbeddingEltwiseLayerNormFusePass matches on.
+
+    Matched chain (exactly what nn.LayerNorm traces — see test):
+        mu    = mean(x, -1, keepdims)            # sum/N or sum*(1/N)
+        c     = sub(x, mu)                       # traced twice pre-CSE
+        var   = mean(square(c), -1, keepdims)
+        rstd  = rsqrt(add(var, eps))
+        y     = add(mul(mul(c, rstd), bcast(gamma)), bcast(beta))
+    Every reduction is verified to run over the LAST axis with N equal to
+    its extent — a lookalike over another axis must not fuse."""
+
+    name = "layer_norm_fuse"
+
+    def run(self, program: Program) -> int:
+        changed = 0
+        for final in program.ops():
+            if final.name != "pd.add" or len(final.operands) != 2:
+                continue
+            out_shape = tuple(final.result(0).type.shape)
+            if not out_shape:
+                continue
+            H = out_shape[-1]
+            axis = len(out_shape) - 1
+            got = _split_binary(
+                program, final, "pd.add",
+                lambda v: _bcast_of_1d(program, v, H))
+            if got is None:
+                continue
+            beta_v, scaled_v = got
+            got = _split_binary(
+                program, scaled_v.defining_op(), "pd.mul",
+                lambda v: _bcast_of_1d(program, v, H))
+            if got is None:
+                continue
+            gamma_v, normed_v = got
+
+            def _rstd_like(v):
+                op = v.defining_op()
+                return v if (op is not None and op.name == "pd.rsqrt") \
+                    else None
+
+            got = _split_binary(program, normed_v.defining_op(), "pd.mul",
+                                _rstd_like)
+            if got is None:
+                continue
+            rstd_v, c2_v = got
+            c2_op = c2_v.defining_op()
+            if c2_op is None or c2_op.name != "pd.sub":
+                continue
+            x_v, mu_v = c2_op.operands
+            if not _is_mean_of(program, mu_v, x_v, axis, H):
+                continue
+            # rstd = rsqrt(var + eps), var = mean(square(x - mu), -1)
+            add_op = rstd_v.defining_op().operands[0].defining_op()
+            if add_op is None or add_op.name != "pd.add":
+                continue
+            got = _split_binary(
+                program, add_op, "pd.add",
+                lambda v: v if (_const_value(program, v) is not None
+                                and np.asarray(_const_value(program, v)).size
+                                == 1) else None)
+            if got is None:
+                continue
+            eps_v, var_v = got
+            eps = float(np.asarray(_const_value(program, eps_v)).reshape(()))
+            if not (0.0 < eps < 1e-2):
+                continue
+            var_op = var_v.defining_op()
+            # unwrap the mean chain down to square(sub(x, mu)) and verify
+            # the centered value matches the SAME x and mu
+            vv = var_v
+            # walk: mean(square(c1)) — reuse _is_mean_of on the square value
+            sq_v = None
+            op = vv.defining_op()
+            if op is not None and op.name in ("pd.div", "pd.mul"):
+                # locate the square feeding the reduction
+                def find_sq(v, depth=0):
+                    o = v.defining_op()
+                    if o is None or depth > 4:
+                        return None
+                    if o.name == "pd.square":
+                        return v
+                    if o.name in ("pd.div", "pd.mul", "pd.broadcast_in_dim",
+                                  "pd.reduce_sum"):
+                        for operand in o.operands:
+                            r = find_sq(operand, depth + 1)
+                            if r is not None:
+                                return r
+                    return None
+                sq_v = find_sq(vv)
+            if sq_v is None or not _is_mean_of(program, var_v, sq_v, axis, H):
+                continue
+            c1_op = sq_v.defining_op().operands[0].defining_op()
+            if c1_op is None or c1_op.name != "pd.sub" \
+                    or c1_op.operands[0].id != x_v.id:
+                continue
+            mu1 = c1_op.operands[1]
+            if mu1.id != mu_v.id \
+                    and not _is_mean_of(program, mu1, x_v, axis, H):
+                continue
+
+            def ln(x, g, b, _eps=eps, _dt=str(final.result(0).type.dtype)):
+                from ..kernels.norms import fused_layer_norm
+
+                return fused_layer_norm(
+                    x, g.reshape(-1), b.reshape(-1), _eps).astype(_dt)
+
+            op = program.create_op(
+                "pd.layer_norm", [x_v, gamma_v, beta_v],
+                [final.result(0).type], attrs={"epsilon": eps},
+                before=final)
+            program.op_fns[op.id] = ln
+            final.result(0).replace_all_uses_with(op.result(0))
+            final.erase()
+            changed += 1
+        if changed:
+            program.dce()
+        return changed
+
+
+@register_pass
+class FcFusePass(Pass):
+    """matmul + bias-add (+ activation) -> pd.fused_fc (the reference's
+    fc_fuse_pass.cc:1 + fc_elementwise_layernorm family). The activation is
+    absorbed only when the bias-add's SOLE consumer is a recognized
+    activation op — relu (the custom_jvp wrapper nn.functional.relu traces)
+    or a pd.gelu produced by GeluFusePass (which therefore must run before
+    this pass)."""
+
+    name = "fc_fuse"
+
+    @staticmethod
+    def _act_of(program: Program, op):
+        """Return 'relu'/'gelu' when op is a recognized activation."""
+        if op is None:
+            return None
+        if op.name == "pd.gelu":
+            return "gelu"
+        if op.name == "pd.custom_jvp_call" and op.id in program.op_bind:
+            cj = program.op_bind[op.id][1].get("call_jaxpr")
+            try:
+                eqns = cj.jaxpr.eqns
+            except AttributeError:
+                eqns = getattr(cj, "eqns", [])
+            for e in eqns:
+                if str(e.params.get("name", "")) == "relu":
+                    return "relu"
+        return None
+
+    def run(self, program: Program) -> int:
+        changed = 0
+        for add in program.ops():
+            if add.name != "pd.add" or len(add.operands) != 2:
+                continue
+            out_shape = tuple(add.result(0).type.shape)
+            if not out_shape:
+                continue
+            H = out_shape[-1]
+            got = _split_binary(program, add, "pd.add",
+                                lambda v: _bcast_of_1d(program, v, H))
+            if got is None:
+                continue
+            bias_v, dot_v = got
+            dot = dot_v.defining_op()
+            if dot is None or dot.name != "pd.dot_general" \
+                    or dot.id not in program.op_bind:
+                continue
+            dn = program.op_bind[dot.id][1].get("dimension_numbers")
+            if dn is None:
+                continue
+            (lc, rc), (lb, rb) = dn
+            x_v, w_v = dot.operands
+            # the Linear lowering: contract x's last dim against W dim 0,
+            # no batch dims, W rank-2 — anything else is not an FC
+            if lb or rb or len(w_v.type.shape) != 2 \
+                    or tuple(lc) != (len(x_v.type.shape) - 1,) \
+                    or tuple(rc) != (0,):
+                continue
+            # absorb a sole-consumer activation (users scanned at match
+            # time — a cached map would go stale across fusions)
+            target = add
+            act = "none"
+            if add.result(0).num_uses == 1:
+                rid = add.result(0).id
+                user = next((o for o in program.ops()
+                             if any(v.id == rid for v in o.operands)), None)
+                a = self._act_of(program, user)
+                if a is not None:
+                    target, act = user, a
+
+            def fc(x, w, b, _act=act, _dt=str(target.result(0).type.dtype)):
+                import jax.numpy as jnp
+
+                from ..kernels.elementwise import tanh_gelu_raw
+
+                y = jnp.matmul(x, w) + b
+                if _act == "relu":
+                    y = jnp.maximum(y, 0)
+                elif _act == "gelu":
+                    y = tanh_gelu_raw(y)
+                return y.astype(_dt)
+
+            op = program.create_op(
+                "pd.fused_fc", [x_v, w_v, bias_v],
+                [target.result(0).type], attrs={"activation": act},
+                before=target)
+            program.op_fns[op.id] = fc
+            target.result(0).replace_all_uses_with(op.result(0))
+            target.erase()
+            changed += 1
+        if changed:
+            program.dce()
+        return changed
+
+
+@register_pass
+class EmbeddingEltwiseLayerNormFusePass(Pass):
+    """N embedding lookups summed then layer-normalized -> one op (the
+    reference's trt_embedding_eltwise_layernorm_fuse_pass — the BERT serving
+    input block: word + position [+ type] embeddings). Anchors on the
+    pd.layer_norm op LayerNormFusePass produced (so it must run after it)
+    whose input is an add-tree of pd.jit[_take] gathers."""
+
+    name = "embedding_eltwise_layernorm_fuse"
+
+    @staticmethod
+    def _take_operands(program: Program, v):
+        """v = jnp.take(table, ids) trace: pd.jit named _take over
+        (table 2-D, ids int). Returns (table_v, ids_v) or None."""
+        op = v.defining_op()
+        if op is None or op.name != "pd.jit" \
+                or _jit_name(program, op) != "_take" \
+                or len(op.operands) != 2:
+            return None
+        table_v, ids_v = op.operands
+        if len(table_v.type.shape) != 2 \
+                or not str(ids_v.type.dtype).startswith(("int", "uint")):
+            return None
+        return table_v, ids_v
+
+    def _collect_lookups(self, program: Program, v, out, depth=0):
+        """Flatten an add-tree whose every leaf is a _take gather."""
+        tk = self._take_operands(program, v)
+        if tk is not None:
+            out.append(tk)
+            return True
+        op = v.defining_op()
+        if op is None or op.name != "pd.add" or depth > 4:
+            return False
+        return all(self._collect_lookups(program, o, out, depth + 1)
+                   for o in op.operands)
+
+    def run(self, program: Program) -> int:
+        changed = 0
+        for ln in program.ops():
+            if ln.name != "pd.layer_norm":
+                continue
+            x_v, gamma_v, beta_v = ln.operands
+            lookups: list = []
+            if not self._collect_lookups(program, x_v, lookups) \
+                    or len(lookups) < 2:
+                continue
+            eps = float(ln.attrs().get("epsilon", 1e-5))
+            n_emb = len(lookups)
+
+            def fused(*args, _n=n_emb, _eps=eps,
+                      _dt=str(ln.result(0).type.dtype)):
+                import jax.numpy as jnp
+
+                from ..kernels.norms import fused_layer_norm
+
+                tables, ids = args[:_n], args[_n:2 * _n]
+                g, b = args[2 * _n], args[2 * _n + 1]
+                x = sum(jnp.take(t, i, axis=0)
+                        for t, i in zip(tables, ids))
+                return fused_layer_norm(
+                    x, g.reshape(-1), b.reshape(-1), _eps).astype(_dt)
+
+            operands = ([t for t, _ in lookups] + [i for _, i in lookups]
+                        + [gamma_v, beta_v])
+            op = program.create_op(
+                "pd.fused_embedding_eltwise_layernorm", operands,
+                [ln.result(0).type],
+                attrs={"epsilon": eps, "num_embeddings": n_emb}, before=ln)
+            program.op_fns[op.id] = fused
+            ln.result(0).replace_all_uses_with(op.result(0))
+            ln.erase()
+            changed += 1
         if changed:
             program.dce()
         return changed

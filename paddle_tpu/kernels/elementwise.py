@@ -1,0 +1,16 @@
+"""Shared raw-jnp elementwise helpers for fused paths."""
+
+from __future__ import annotations
+
+
+def tanh_gelu_raw(x):
+    """Dtype-preserving tanh-approximation GELU on a raw jnp array:
+    0.5*x*(1+tanh(sqrt(2/pi)*(x+0.044715*x^3))) with python-scalar
+    (weak-typed) constants so bf16 stays bf16 end to end — jax.nn.gelu
+    upcasts bf16 internally, which measured 20% SLOWER than this chain.
+    Single definition shared by GeluFusePass, FcFusePass, and the chunked
+    masked-LM head so the fused paths cannot drift numerically."""
+    import jax.numpy as jnp
+
+    inner = x + 0.044715 * x * x * x
+    return 0.5 * x * (1.0 + jnp.tanh(0.7978845608028654 * inner))
