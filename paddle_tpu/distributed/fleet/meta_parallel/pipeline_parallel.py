@@ -489,10 +489,12 @@ def pipeline_schedule_1f1b(
             incoming, outputs, aux_acc = carry
             x_in = jnp.where(stage_idx == 0,
                              mbs[jnp.clip(t, 0, M - 1)], incoming)
-            # stage s works microbatch k = t - s; key folds t = s + k so the
-            # backward can re-derive it from (s, k). Layer salts inside
-            # stage_fn distinguish stages sharing a tick.
-            k = jax.random.fold_in(key0, t)
+            # stage s works microbatch k = t - s; fold k*n + s so distinct
+            # (stage, microbatch) cells draw distinct keys even when an
+            # external stage_fn does no internal layer salting. The backward
+            # re-derives the same key from (s, k).
+            k = jax.random.fold_in(
+                key0, jnp.clip(t - stage_idx, 0, M - 1) * n + stage_idx)
             if with_aux:
                 y, aux = _call(params, x_in, k)
                 live = (t - stage_idx >= 0) & (t - stage_idx < M)
@@ -551,7 +553,8 @@ def pipeline_schedule_1f1b(
             liveR = (kR >= 0) & (kR < M)
             xR = jnp.where(stage_idx == 0,
                            mbs[jnp.clip(t, 0, M - 1)], y_ring)
-            keyR = jax.random.fold_in(key0, t)
+            keyR = jax.random.fold_in(
+                key0, jnp.clip(t - stage_idx, 0, M - 1) * n + stage_idx)
             if with_aux:
                 yR, _ = _call(params, xR, keyR)
             else:
@@ -571,7 +574,8 @@ def pipeline_schedule_1f1b(
             dy = jnp.where(stage_idx == n - 1,
                            d_out[jnp.clip(kB, 0, M - 1)].astype(probe.dtype),
                            dx_ring)
-            keyB = jax.random.fold_in(key0, jnp.maximum(kB, 0) + stage_idx)
+            keyB = jax.random.fold_in(
+                key0, jnp.clip(kB, 0, M - 1) * n + stage_idx)
             _, vjp_fn = jax.vjp(
                 lambda p, x: _call(p, x, keyB), params, x_b)
             ct_in = (dy, jnp.where(liveB, d_aux, 0.0).astype(jnp.float32)) \
@@ -751,6 +755,353 @@ def pipeline_schedule_interleaved(
     )
     (_, _, _, _, _, outputs, aux_acc), _ = lax.scan(tick, init, None, length=T)
     return (outputs, lax.psum(aux_acc, axis_name)) if with_aux else outputs
+
+
+def _interleaved_1f1b_tables(n: int, v: int, M: int):
+    """Host-side schedule construction for the interleaved 1F1B-memory
+    backward. The greedy interleaved ring is DATA-INDEPENDENT (validity
+    tags depend only on (n, v, M)), so the whole schedule — which (mb,
+    chunk) cell each device works at each tick, for both the forward and a
+    mirrored backward stream — can be precomputed and baked into the traced
+    scan as static tables.
+
+    Returns (fwd_rows, bwd_rows, slot_of, T_f, T_b, C):
+    * fwd_rows[t][d] = (m, c) or None — the greedy forward ring (returning
+      laps preempt fresh injections), identical to the schedule
+      pipeline_schedule_interleaved executes.
+    * bwd_rows[t][d] — the mirrored backward ring: reverse rotation, device
+      n-1 injects microbatch m's output cotangent (in order) once the
+      recompute stream has re-stashed its last chunk (tick > t_f[m,nv-1]);
+      each hop then steps chunk c -> c-1 on device d -> d-1, which is
+      exactly where the forward placed chunk c-1 (chunk c lives on device
+      c mod n). Microbatches drain in arrival order — the 1F1B property
+      that caps in-flight activations at O(n*v), unlike a time-reversed
+      schedule whose liveness grows with M.
+    * slot_of[(m, c)] — stash slot per cell from greedy interval coloring
+      of [t_f, t_b] per device; C = max slots any device needs (the
+      measured in-flight bound). A slot is reused only STRICTLY after its
+      consumption tick, so a same-tick store can never clobber a pending
+      load (the combined scan stores before it loads).
+    """
+    import heapq
+
+    nv = n * v
+    fwd_rows, t_f = [], {}
+    slots = [None] * n
+    fresh = done = t = 0
+    while done < M:
+        row = [None] * n
+        nxt = [None] * n
+        for d in range(n):
+            work = slots[d]
+            if d == 0 and work is None and fresh < M:
+                work = (fresh, 0)
+                fresh += 1
+            if work is None:
+                continue
+            m, c = work
+            row[d] = (m, c)
+            t_f[(m, c)] = t
+            if c + 1 == nv:
+                done += 1
+            else:
+                nxt[(d + 1) % n] = (m, c + 1)
+        fwd_rows.append(row)
+        slots = nxt
+        t += 1
+        if t > (M + n) * nv + n:
+            raise RuntimeError("interleaved forward schedule failed to converge")
+    T_f = t
+
+    bwd_rows, t_b = [], {}
+    slots = [None] * n
+    inject = done = 0
+    t = 0
+    while done < M:
+        row = [None] * n
+        nxt = [None] * n
+        for d in range(n):
+            work = slots[d]
+            if d == n - 1 and work is None and inject < M \
+                    and t > t_f[(inject, nv - 1)]:
+                work = (inject, nv - 1)
+                inject += 1
+            if work is None:
+                continue
+            m, c = work
+            row[d] = (m, c)
+            t_b[(m, c)] = t
+            if c == 0:
+                done += 1
+            else:
+                nxt[(d - 1) % n] = (m, c - 1)
+        bwd_rows.append(row)
+        slots = nxt
+        t += 1
+        if t > 2 * ((M + n) * nv + n) + nv:
+            raise RuntimeError("interleaved backward schedule failed to converge")
+    T_b = t
+
+    slot_of = {}
+    C = 1
+    for d in range(n):
+        cells = sorted((cl for cl in t_f if cl[1] % n == d),
+                       key=lambda cl: t_f[cl])
+        free: list = []
+        live: list = []  # heap of (t_b, slot)
+        next_slot = 0
+        for cell in cells:
+            while live and live[0][0] < t_f[cell]:
+                free.append(heapq.heappop(live)[1])
+            if free:
+                s = free.pop()
+            else:
+                s = next_slot
+                next_slot += 1
+            slot_of[cell] = s
+            heapq.heappush(live, (t_b[cell], s))
+        C = max(C, next_slot)
+    return fwd_rows, bwd_rows, slot_of, T_f, T_b, C
+
+
+def pipeline_schedule_interleaved_1f1b(
+    stage_fn: Callable,
+    stacked_params,
+    microbatches,
+    axis_name: str = "pp",
+    n_stages: Optional[int] = None,
+    virtual_stages: int = 2,
+    remat: bool = True,
+    with_aux: bool = False,
+):
+    """Interleaved virtual-stage pipeline with the 1F1B activation-memory
+    bound (reference PipelineParallelWithInterleave, fleet/meta_parallel/
+    pipeline_parallel.py:514 — which delivers the v-fold bubble shrink AND
+    the in-flight memory cap together; the plain AD transpose of
+    `pipeline_schedule_interleaved` only delivers the bubble shrink, at
+    O(M) activation memory).
+
+    Same contract as pipeline_schedule_interleaved (stacked_params leaves
+    [1, v, Lpc, ...]; 2- or 3-arg stage_fn; outputs [M, ...] valid on the
+    last stage; with_aux returns (outputs, aux_total)). Technique: the
+    custom_vjp recompute-stream design of pipeline_schedule_1f1b, driven by
+    HOST-PRECOMPUTED work tables (_interleaved_1f1b_tables) instead of the
+    arithmetic tick maps the non-interleaved schedule affords — the greedy
+    interleaved schedule is data-independent, so each device's (microbatch,
+    chunk, stash-slot) assignment per tick is a static array the traced
+    scan just indexes. Activation stash = C slots (the interval-colored
+    in-flight bound, O(n*v)), not O(M).
+
+    RNG: every (microbatch m, global chunk c) cell derives
+    fold_in(key0, m*n*v + c), so backward recompute reproduces the
+    forward's dropout masks exactly, and distinct cells decorrelate even
+    under an unsalted stage_fn.
+
+    `remat` is accepted for signature parity but intentionally inert: this
+    schedule IS a bounded recompute stream (like pipeline_schedule_1f1b —
+    see its docstring), so there is nothing extra to checkpoint. Callers
+    wanting remat=False semantics (no recompute at all) should use
+    pipeline_schedule_interleaved; make_sharded_train_step routes there.
+    """
+    import inspect
+
+    n = n_stages if n_stages is not None else lax.axis_size(axis_name)
+    v = virtual_stages
+    nv = n * v
+    my = jax.tree_util.tree_map(
+        lambda p: p[0] if hasattr(p, "shape") and p.shape and p.shape[0] == 1 else p,
+        stacked_params)
+    M = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    rev_perm = [(i, (i - 1) % n) for i in range(n)]
+
+    try:
+        pos_kinds = (inspect.Parameter.POSITIONAL_ONLY,
+                     inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                     inspect.Parameter.VAR_POSITIONAL)
+        takes_chunk = sum(
+            1 for p in inspect.signature(stage_fn).parameters.values()
+            if p.kind in pos_kinds) >= 3
+    except (TypeError, ValueError):
+        takes_chunk = False
+    raw_call = stage_fn if takes_chunk else (lambda p, x, ci: stage_fn(p, x))
+
+    from ....core import random as _random
+    from ....core.autograd import no_grad
+
+    base_key = (_random.next_key() if _random.in_rng_scope()
+                else jax.random.PRNGKey(0))
+
+    def _call(chunk_params, x, ci, key):
+        with no_grad(), _random.rng_scope_key(key):
+            return raw_call(chunk_params, x, ci)
+
+    fwd_rows, bwd_rows, slot_of, T_f, T_b, C = \
+        _interleaved_1f1b_tables(n, v, M)
+
+    def _tables(rows, T, use_slots):
+        m_t = np.zeros((T, n), np.int32)
+        c_t = np.zeros((T, n), np.int32)
+        v_t = np.zeros((T, n), bool)
+        s_t = np.zeros((T, n), np.int32)
+        for t, row in enumerate(rows):
+            for d, cell in enumerate(row):
+                if cell is None:
+                    continue
+                m_t[t, d], c_t[t, d], v_t[t, d] = cell[0], cell[1], True
+                if use_slots:
+                    s_t[t, d] = slot_of[cell]
+        # NUMPY constants, not jnp: custom_vjp traces pipe_fwd/pipe_bwd in
+        # their own scopes, and a jnp array materialized under the caller's
+        # shard_map trace would leak that trace into them
+        return m_t, c_t, v_t, s_t
+
+    # pad the (shorter) forward tables to the combined backward length so
+    # one scan drives both streams
+    fwd_padded = fwd_rows + [[None] * n] * (T_b - T_f)
+    fm, fc, fv, fs = _tables(fwd_padded, T_b, use_slots=True)
+
+    bm, bc, bv, bs = _tables(bwd_rows, T_b, use_slots=True)
+
+    probe_params = jax.tree_util.tree_map(lambda p: p[0], my)
+    probe_fn = (lambda p, x: _call(p, x, jnp.zeros((), jnp.int32),
+                                   base_key)[0]) if with_aux \
+        else (lambda p, x: _call(p, x, jnp.zeros((), jnp.int32), base_key))
+    probe = jax.eval_shape(probe_fn, probe_params,
+                           jnp.zeros(mb_shape, microbatches.dtype))
+    out_dtype = probe.dtype
+
+    def _cell(table_m, table_c, table_v, table_s, t, d):
+        row = lambda a: lax.dynamic_index_in_dim(
+            lax.dynamic_index_in_dim(a, t, 0, keepdims=False),
+            d, 0, keepdims=False)
+        return row(table_m), row(table_c), row(table_v), row(table_s)
+
+    def _run_fwd(params, mbs, key0, ticks):
+        stage_idx = lax.axis_index(axis_name)
+
+        def tick(carry, t):
+            ring, outputs, aux_acc = carry
+            m_, c_, val, _ = _cell(fm, fc, fv, fs, t, stage_idx)
+            x_in = jnp.where(c_ == 0, mbs[jnp.clip(m_, 0, M - 1)], ring)
+            r = jnp.clip(c_ // n, 0, v - 1)
+            chunk_params = jax.tree_util.tree_map(lambda p: p[r], params)
+            key = jax.random.fold_in(key0, m_ * nv + c_)
+            if with_aux:
+                y, aux = _call(chunk_params, x_in, c_, key)
+                aux_acc = aux_acc + jnp.where(val, aux, 0.0)
+            else:
+                y = _call(chunk_params, x_in, c_, key)
+            finishing = val & (c_ == nv - 1)
+            outputs = lax.cond(
+                finishing,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y.astype(out_dtype), jnp.clip(m_, 0, M - 1), 0),
+                lambda o: o,
+                outputs)
+            y = jnp.where(val, y, ring)  # idle devices pass the ring through
+            return (lax.ppermute(y, axis_name, fwd_perm), outputs,
+                    aux_acc), None
+
+        outputs0 = jnp.zeros((M,) + tuple(probe.shape), out_dtype)
+        (_, outputs, aux_acc), _ = lax.scan(
+            tick,
+            (jnp.zeros(mb_shape, microbatches.dtype), outputs0,
+             jnp.zeros((), jnp.float32)),
+            ticks)
+        if with_aux:
+            return outputs, lax.psum(aux_acc, axis_name)
+        return outputs
+
+    @jax.custom_vjp
+    def pipe(params, mbs, key0):
+        return _run_fwd(params, mbs, key0, jnp.arange(T_f))
+
+    def pipe_fwd(params, mbs, key0):
+        return _run_fwd(params, mbs, key0, jnp.arange(T_f)), \
+            (params, mbs, key0)
+
+    def pipe_bwd(res, ct):
+        params, mbs, key0 = res
+        if with_aux:
+            d_out, d_aux = ct
+            # transpose of the primal's trailing psum (see
+            # pipeline_schedule_1f1b.pipe_bwd)
+            d_aux = lax.psum(d_aux, axis_name)
+        else:
+            d_out, d_aux = ct, None
+        stage_idx = lax.axis_index(axis_name)
+
+        def tick(carry, t):
+            yR_ring, dx_ring, stash, g, d_mbs = carry
+
+            # ---- recompute stream: replays the forward tables, stashing
+            # each cell's INPUT at its colored slot ----
+            mR, cR, vR, sR = _cell(fm, fc, fv, fs, t, stage_idx)
+            xR = jnp.where(cR == 0, mbs[jnp.clip(mR, 0, M - 1)], yR_ring)
+            rR = jnp.clip(cR // n, 0, v - 1)
+            paramsR = jax.tree_util.tree_map(lambda p: p[rR], params)
+            keyR = jax.random.fold_in(key0, mR * nv + cR)
+            if with_aux:
+                yR, _ = _call(paramsR, xR, cR, keyR)
+            else:
+                yR = _call(paramsR, xR, cR, keyR)
+            stash = lax.cond(
+                vR,
+                lambda s: lax.dynamic_update_index_in_dim(s, xR, sR, 0),
+                lambda s: s,
+                stash)
+            yR = jnp.where(vR, yR, yR_ring)
+
+            # ---- backward stream: mirrored tables, strictly after the
+            # recompute stash of each cell (guaranteed by construction) ----
+            mB, cB, vB, sB = _cell(bm, bc, bv, bs, t, stage_idx)
+            x_b = lax.dynamic_index_in_dim(stash, sB, 0, keepdims=False)
+            dy = jnp.where(cB == nv - 1,
+                           d_out[jnp.clip(mB, 0, M - 1)].astype(probe.dtype),
+                           dx_ring)
+            rB = jnp.clip(cB // n, 0, v - 1)
+            paramsB = jax.tree_util.tree_map(lambda p: p[rB], params)
+            keyB = jax.random.fold_in(key0, mB * nv + cB)
+            _, vjp_fn = jax.vjp(
+                lambda pr, x: _call(pr, x, cB, keyB), paramsB, x_b)
+            ct_in = (dy, jnp.where(vB, d_aux, 0.0).astype(jnp.float32)) \
+                if with_aux else dy
+            dp, dx = vjp_fn(ct_in)
+            # accumulate into lap rB of the [v, ...] grad stack
+            g = jax.tree_util.tree_map(
+                lambda a, b: lax.dynamic_update_index_in_dim(
+                    a,
+                    lax.dynamic_index_in_dim(a, rB, 0, keepdims=False)
+                    + jnp.where(vB, b, 0).astype(a.dtype),
+                    rB, 0),
+                g, dp)
+            d_mbs = lax.cond(
+                vB & (cB == 0),
+                lambda d: lax.dynamic_update_index_in_dim(
+                    d, dx.astype(d.dtype), jnp.clip(mB, 0, M - 1), 0),
+                lambda d: d,
+                d_mbs)
+            dx = jnp.where(vB, dx, 0).astype(dx_ring.dtype)
+            return (lax.ppermute(yR, axis_name, fwd_perm),
+                    lax.ppermute(dx, axis_name, rev_perm),
+                    stash, g, d_mbs), None
+
+        g0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, p.dtype), params)
+        init = (
+            jnp.zeros(mb_shape, microbatches.dtype),
+            jnp.zeros(tuple(probe.shape), probe.dtype),
+            jnp.zeros((C,) + mb_shape, microbatches.dtype),
+            g0,
+            jnp.zeros(mbs.shape, mbs.dtype),
+        )
+        (_, _, _, g, d_mbs), _ = lax.scan(tick, init, jnp.arange(T_b))
+        return g, d_mbs, None
+
+    pipe.defvjp(pipe_fwd, pipe_bwd)
+    return pipe(my, microbatches, base_key)
 
 
 def spmd_pipeline(

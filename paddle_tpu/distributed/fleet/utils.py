@@ -416,7 +416,8 @@ class ShardedTrainStep:
 
         from .meta_parallel.pipeline_parallel import (
             pipeline_schedule, pipeline_schedule_1f1b,
-            pipeline_schedule_interleaved)
+            pipeline_schedule_interleaved,
+            pipeline_schedule_interleaved_1f1b)
 
         pspec = self._pspec
         mesh = self.mesh
@@ -478,7 +479,16 @@ class ShardedTrainStep:
                         return (h, aux) if with_aux else h
 
                     if vpp > 1:
-                        outs = pipeline_schedule_interleaved(
+                        # default (1f1b) pairs the v-fold bubble shrink with
+                        # the O(pp*v) in-flight memory cap; "gpipe" keeps the
+                        # plain AD-transposed scan (O(M) activation memory).
+                        # remat=False asks for NO recompute — the 1f1b
+                        # schedule IS a recompute stream, so that request
+                        # routes to the AD path (which honors the flag)
+                        sched_i = (pipeline_schedule_interleaved_1f1b
+                                   if self._pp_schedule == "1f1b" and remat
+                                   else pipeline_schedule_interleaved)
+                        outs = sched_i(
                             stage, stacked_loc, mbs_loc, axis_name="pp",
                             virtual_stages=vpp, remat=remat, with_aux=with_aux)
                     elif self._pp_schedule == "1f1b":

@@ -1,0 +1,316 @@
+"""Pipeline schedule property sweep (VERDICT r4 items 2+5).
+
+Differential grid over pp in {2,3,4}, M in {1, pp-1, 4*pp}, vpp in {1,2,4},
+schedules {gpipe, 1f1b, interleaved-AD, interleaved-1f1b}: every schedule's
+loss AND gradients must match the unpipelined sequential application of the
+same chunks at tight fp32 tolerance (the reference pins its hybrid pp
+schedules the same way — test/collective/fleet/hybrid_parallel_pp_layers.py).
+The grid runs at the RAW schedule level (tiny shapes, one matmul per chunk)
+so the whole sweep stays in CI time; the heavier composed paths (fp16
+scaler, MoE aux, dropout) ride make_sharded_train_step in
+test_fp16_scaler_pipeline.py / test_pipeline_1f1b.py and the vpp composed
+tests here.
+
+The interleaved-1f1b schedule additionally pins the r5 memory claim: its
+compiled backward holds the activation stash at the interval-colored
+in-flight bound (O(pp*v)), beating the AD-transposed interleaved scan whose
+residuals grow per tick — asserted on XLA buffer-assignment stats.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+    _interleaved_1f1b_tables,
+    pipeline_schedule,
+    pipeline_schedule_1f1b,
+    pipeline_schedule_interleaved,
+    pipeline_schedule_interleaved_1f1b,
+)
+
+H = 8
+MB, S = 2, 4
+
+
+def _chunk_params(nv, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        {"w": jnp.asarray(rng.randn(1, H, H) * 0.3, jnp.float32),
+         "b": jnp.asarray(rng.randn(1, H) * 0.1, jnp.float32)}
+        for _ in range(nv)
+    ]
+
+
+def _stage(bp, h, ci=None):
+    return jnp.tanh(h @ bp["w"][0] + bp["b"][0][None, None, :])
+
+
+def _stage_aux(bp, h, ci=None):
+    y = _stage(bp, h)
+    return y, jnp.mean(y * y)
+
+
+def _device_major(chunks, n, v):
+    """[nv] chunk params -> leaves [n, v, ...]: device d owns chunks r*n+d
+    (the stack_block_params chunk-major layout)."""
+    return {
+        k: jnp.stack([jnp.stack([chunks[r * n + d][k] for r in range(v)])
+                      for d in range(n)])
+        for k in chunks[0]
+    }
+
+
+def _reference(chunks, mbs, with_aux=False):
+    """Unpipelined: every microbatch through all chunks in order."""
+    def apply(x):
+        aux = jnp.zeros((), jnp.float32)
+        for bp in chunks:
+            if with_aux:
+                x, a = _stage_aux(bp, x)
+                aux = aux + a
+            else:
+                x = _stage(bp, x)
+        return (x, aux) if with_aux else x
+
+    outs = [apply(m) for m in mbs]
+    if with_aux:
+        return (jnp.stack([o[0] for o in outs]),
+                sum(o[1] for o in outs))
+    return jnp.stack(outs)
+
+
+def _run_schedule(sched, chunks, mbs, n, v, with_aux=False):
+    mesh = Mesh(np.array(jax.devices()[:n]), ("pp",))
+    stacked = (_device_major(chunks, n, v) if v > 1
+               else {k: jnp.stack([c[k] for c in chunks]) for k in chunks[0]})
+    kwargs = {"axis_name": "pp"}
+    if v > 1:
+        kwargs["virtual_stages"] = v
+    stage = _stage_aux if with_aux else _stage
+
+    def body(Wl, ml):
+        outs = sched(stage, Wl, ml, with_aux=with_aux, **kwargs)
+        if with_aux:
+            return outs[0][None], outs[1]
+        return outs[None]
+
+    out_specs = (P("pp"), P()) if with_aux else P("pp")
+    return shard_map(body, mesh=mesh, in_specs=(P("pp"), P()),
+                     out_specs=out_specs, check_vma=False)(stacked, mbs)
+
+
+SCHEDULES = {
+    "gpipe": (pipeline_schedule, 1),
+    "1f1b": (pipeline_schedule_1f1b, 1),
+    "interleaved_ad": (pipeline_schedule_interleaved, None),
+    "interleaved_1f1b": (pipeline_schedule_interleaved_1f1b, None),
+}
+
+
+def _grid():
+    cases = []
+    for pp in (2, 3, 4):
+        for M in sorted({1, pp - 1, 4 * pp} - {0}):
+            for name, (_, fixed_v) in SCHEDULES.items():
+                vs = (1,) if fixed_v == 1 else (2, 4)
+                for v in vs:
+                    cases.append((pp, v, M, name))
+    return cases
+
+
+@pytest.mark.parametrize("pp,v,M,name", _grid())
+def test_schedule_matches_unpipelined(pp, v, M, name):
+    """Loss AND grad parity vs the sequential reference at fp32 tolerance."""
+    if len(jax.devices()) < pp:
+        pytest.skip(f"needs {pp} devices")
+    sched = SCHEDULES[name][0]
+    nv = pp * v
+    chunks = _chunk_params(nv, seed=pp * 100 + v * 10 + M)
+    rng = np.random.RandomState(1)
+    mbs = jnp.asarray(rng.randn(M, MB, S, H), jnp.float32)
+
+    ref_out = _reference(chunks, mbs)
+
+    def loss_ref(ch, ml):
+        return jnp.mean(_reference(ch, ml) ** 2)
+
+    def loss_sched(ch, ml):
+        outs = _run_schedule(sched, ch, ml, pp, v)
+        return jnp.mean(outs[-1] ** 2)
+
+    out = _run_schedule(sched, chunks, mbs, pp, v)[-1]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=2e-6, atol=2e-7)
+
+    chunks_t = list(chunks)  # pytree for grad
+    val_r, g_r = jax.value_and_grad(loss_ref)(chunks_t, mbs)
+    val_s, g_s = jax.jit(jax.value_and_grad(loss_sched))(chunks_t, mbs)
+    assert abs(float(val_r) - float(val_s)) < 1e-6
+    for a, b in zip(jax.tree_util.tree_leaves(g_r),
+                    jax.tree_util.tree_leaves(g_s)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("pp,v,M", [(2, 2, 4), (2, 2, 5), (4, 2, 8),
+                                    (3, 2, 7), (2, 4, 6)])
+def test_interleaved_1f1b_aux_parity(pp, v, M):
+    """The aux scalar (MoE gate-loss analog) and its cotangent ride the
+    interleaved recompute-stream backward identically to the AD path."""
+    if len(jax.devices()) < pp:
+        pytest.skip(f"needs {pp} devices")
+    nv = pp * v
+    chunks = _chunk_params(nv, seed=3)
+    rng = np.random.RandomState(2)
+    mbs = jnp.asarray(rng.randn(M, MB, S, H), jnp.float32)
+
+    def loss(sched, ch, ml):
+        outs, aux = _run_schedule(sched, ch, ml, pp, v, with_aux=True)
+        return jnp.mean(outs[-1] ** 2) + 0.1 * jnp.squeeze(aux) / M
+
+    va, ga = jax.jit(jax.value_and_grad(
+        lambda ch, ml: loss(pipeline_schedule_interleaved, ch, ml)))(
+            list(chunks), mbs)
+    vb, gb = jax.jit(jax.value_and_grad(
+        lambda ch, ml: loss(pipeline_schedule_interleaved_1f1b, ch, ml)))(
+            list(chunks), mbs)
+    assert abs(float(va) - float(vb)) < 1e-6
+    for a, b in zip(jax.tree_util.tree_leaves(ga),
+                    jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_interleaved_tables_invariants():
+    """Schedule-table proofs for a sweep of (n, v, M): every cell scheduled
+    exactly once per stream on its owning device, backward strictly after
+    the recompute stash, slots reused only strictly after consumption, and
+    stash capacity C bounded by the 1F1B in-flight cap 2*n*v-1 regardless
+    of M."""
+    for (n, v, M) in [(2, 2, 1), (2, 2, 4), (2, 2, 16), (2, 2, 64),
+                      (3, 2, 6), (3, 3, 7), (4, 2, 8), (4, 4, 16),
+                      (2, 4, 3)]:
+        fwd, bwd, slot_of, T_f, T_b, C = _interleaved_1f1b_tables(n, v, M)
+        nv = n * v
+        t_f, t_b = {}, {}
+        for t, row in enumerate(fwd):
+            for d, cell in enumerate(row):
+                if cell is not None:
+                    assert cell not in t_f
+                    assert cell[1] % n == d
+                    t_f[cell] = t
+        for t, row in enumerate(bwd):
+            for d, cell in enumerate(row):
+                if cell is not None:
+                    assert cell not in t_b
+                    assert cell[1] % n == d
+                    t_b[cell] = t
+        assert len(t_f) == M * nv and len(t_b) == M * nv
+        for cell in t_f:
+            assert t_b[cell] > t_f[cell], (n, v, M, cell)
+        per_slot: dict = {}
+        for cell, s in slot_of.items():
+            per_slot.setdefault((cell[1] % n, s), []).append(cell)
+        for cells in per_slot.values():
+            cells.sort(key=lambda c: t_f[c])
+            for a, b in zip(cells, cells[1:]):
+                assert t_f[b] > t_b[a], (n, v, M, a, b)
+        assert C <= 2 * nv - 1, (n, v, M, C)
+
+
+def _interleaved_temp_bytes(sched, M, n=2, v=2, mb=8, S=16, Hm=64):
+    mesh = Mesh(np.array(jax.devices()[:n]), ("pp",))
+    W = {"w": jnp.zeros((n, v, 1, Hm, Hm), jnp.float32)
+         + jnp.eye(Hm, dtype=jnp.float32) * 0.9,
+         "b": jnp.zeros((n, v, 1, Hm), jnp.float32)}
+    mbs = jnp.ones((M, mb, S, Hm), jnp.float32)
+
+    def stage(bp, h, ci=None):
+        for _ in range(3):
+            h = jnp.tanh(h @ bp["w"][0] + bp["b"][0][None, None, :])
+        return h
+
+    def loss(Wl, ml):
+        body = lambda Wloc, mloc: sched(stage, Wloc, mloc, axis_name="pp",
+                                        virtual_stages=v)[None]
+        outs = shard_map(body, mesh=mesh, in_specs=(P("pp"), P()),
+                        out_specs=P("pp"), check_vma=False)(Wl, ml)
+        return jnp.sum(outs[-1] ** 2)
+
+    c = jax.jit(jax.grad(loss)).lower(W, mbs).compile()
+    return c.memory_analysis().temp_size_in_bytes
+
+
+def test_interleaved_1f1b_memory_beats_ad_transpose():
+    """VERDICT r4 item 2 done-bar: growing M from 8 to 32, the AD-transposed
+    interleaved scan stashes per-tick carries (O(M)) while the 1f1b variant
+    keeps its colored stash flat — only the inherent per-microbatch
+    output/cotangent streams (~3 activations per mb) remain."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    act = 8 * 16 * 64 * 4  # one microbatch activation, f32 bytes
+    a8 = _interleaved_temp_bytes(pipeline_schedule_interleaved, 8)
+    a32 = _interleaved_temp_bytes(pipeline_schedule_interleaved, 32)
+    f8 = _interleaved_temp_bytes(pipeline_schedule_interleaved_1f1b, 8)
+    f32 = _interleaved_temp_bytes(pipeline_schedule_interleaved_1f1b, 32)
+    ad_growth, f_growth = a32 - a8, f32 - f8
+    assert ad_growth - f_growth > 24 * act, (
+        f"interleaved_1f1b should shed the per-tick stash: "
+        f"AD +{ad_growth}, 1f1b +{f_growth}, act={act}")
+    assert f_growth <= 24 * 4 * act, (
+        f"1f1b growth {f_growth} exceeds stream-only bound {24 * 4 * act}")
+
+
+def test_vpp_train_step_composes_scaler_and_dropout():
+    """e2e: make_sharded_train_step with vpp=2 defaults to the interleaved
+    1f1b schedule; fp16 scaler + dropout compose, runs are reproducible,
+    and the loss matches the unpipelined model (dropout off)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import collective, mesh, topology
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+
+    def train(pp, vpp, M, dropout=0.0, scaler=None, steps=2, seed=0):
+        collective.destroy_process_group()
+        mesh.reset_global_mesh()
+        topology.set_hybrid_communicate_group(None)
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 2 if pp == 2 else 1,
+                            "pp_degree": pp, "sharding_degree": 1,
+                            "mp_degree": 1}
+        fleet.init(is_collective=True, strategy=s)
+        paddle.seed(seed)
+        from paddle_tpu.models import gpt_tiny
+
+        model = gpt_tiny(dropout=dropout, num_layers=4)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        sc = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 10) \
+            if scaler else None
+        step = make_sharded_train_step(
+            model, opt, accumulate_steps=M if pp > 1 else None,
+            virtual_pp_degree=vpp, scaler=sc)
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, 128, size=(16, 16))
+        y = np.roll(x, -1, axis=1)
+        out = [float(step(x, y)) for _ in range(steps)]
+        collective.destroy_process_group()
+        mesh.reset_global_mesh()
+        topology.set_hybrid_communicate_group(None)
+        return out
+
+    ref = train(1, 1, None)
+    vpp_losses = train(2, 2, 16)
+    np.testing.assert_allclose(vpp_losses, ref, rtol=2e-4, atol=2e-5)
+    # scaler + dropout: reproducible and finite, and it descends
+    a = train(2, 2, 8, dropout=0.1, scaler=True, steps=3, seed=7)
+    b = train(2, 2, 8, dropout=0.1, scaler=True, steps=3, seed=7)
+    assert a == b, (a, b)
+    assert all(np.isfinite(x) for x in a)
+    assert a[-1] < a[0]
