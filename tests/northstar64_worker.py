@@ -1,0 +1,144 @@
+"""Subprocess worker for test_northstar64: the planner's v5e-64 plan for the
+GPT-3 1.3B north star, executed at the REAL factorization on a 64-device
+virtual CPU mesh with toy model dims (reference keeps multi-node schedule
+tests for this class of bug: test/collective/multinode/).
+
+Run with XLA_FLAGS=--xla_force_host_platform_device_count=64. Prints one
+JSON line per leg: {"leg", "plan", "losses", "n_param_bytes", "volumes"}.
+The parent test asserts exit code, SPMD-clean stderr, and the per-collective
+HLO byte volumes against the calibrated cost model's contracts.
+"""
+
+import json
+import os
+import re
+import sys
+from collections import Counter
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.distributed import fleet  # noqa: E402
+from paddle_tpu.distributed.fleet import plan_hybrid_configs  # noqa: E402
+from paddle_tpu.distributed.fleet.meta_parallel import (  # noqa: E402
+    group_sharded_parallel)
+from paddle_tpu.distributed.fleet.utils import (  # noqa: E402
+    make_sharded_train_step)
+
+# the north-star model (BASELINE.json): GPT-3 1.3B on v5e-64
+MODEL_13B = dict(hidden=2048, layers=24, heads=16, vocab=50304, seq=2048,
+                 kind="gpt")
+N_DEV = 64
+
+_SIZES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4}
+
+
+def _collective_volumes(txt):
+    """Result bytes per collective kind in post-SPMD HLO (tuple-shaped
+    bucketed ops count every element shape)."""
+    vol = Counter()
+    for kind in ("all-reduce", "reduce-scatter", "all-gather",
+                 "collective-permute", "all-to-all"):
+        pat = (r"=\s*((?:\([^)]*\))|(?:\w+\[[\d,]*\](?:\{[^}]*\})?))\s*"
+               + kind + r"\(")
+        for m in re.finditer(pat, txt):
+            for s in re.finditer(r"(\w+)\[([\d,]*)\]", m.group(1)):
+                dt, dims = s.group(1), s.group(2)
+                if dt not in _SIZES:
+                    continue
+                n = _SIZES[dt]
+                for d in (dims.split(",") if dims else []):
+                    n *= int(d)
+                vol[kind] += n
+    return dict(vol)
+
+
+def _reset_world():
+    from paddle_tpu.distributed import collective, mesh, topology
+
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+
+
+def run_leg(leg, plan, layers, accum=None, vpp=1, level=None, seq_par=False,
+            bsz=128, seq=16):
+    _reset_world()
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {
+        "dp_degree": plan["dp_degree"], "pp_degree": plan["pp_degree"],
+        "sharding_degree": plan["sharding_degree"],
+        "mp_degree": plan["mp_degree"],
+    }
+    fleet.init(is_collective=True, strategy=s)
+    paddle.seed(0)
+    from paddle_tpu.models import gpt_tiny
+
+    model = gpt_tiny(dropout=0.0, num_layers=layers,
+                     sequence_parallel=seq_par)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    if level:
+        model, opt, _ = group_sharded_parallel(model, opt, level=level)
+    step = make_sharded_train_step(
+        getattr(model, "_layers", model), getattr(opt, "_inner", opt),
+        accumulate_steps=accum, virtual_pp_degree=vpp)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 128, size=(bsz, seq))
+    y = np.roll(x, -1, axis=1)
+    losses = [float(step(x, y)) for _ in range(2)]
+    txt = step.lower_compiled(x, y).compile().as_text()
+    n_param_bytes = 4 * sum(int(np.prod(v.shape))
+                            for v in step.params.values())
+    print(json.dumps({
+        "leg": leg, "plan": plan, "losses": losses,
+        "n_param_bytes": n_param_bytes,
+        "volumes": _collective_volumes(txt),
+    }), flush=True)
+    _reset_world()
+
+
+def main():
+    assert len(jax.devices()) >= N_DEV, len(jax.devices())
+
+    # Leg A — the north star's own config class (dp + ZeRO-1): the planner's
+    # zero-1 pick for the REAL 1.3B spec at 64 chips.
+    plan_a = plan_hybrid_configs(model=MODEL_13B, batch=512,
+                                 cluster=dict(n_devices=N_DEV), zero_stage=1,
+                                 accumulate_steps=1)
+    assert (plan_a["dp_degree"] * plan_a["pp_degree"]
+            * plan_a["sharding_degree"] * plan_a["mp_degree"]) == N_DEV
+    run_leg("A_zero1", plan_a, layers=24, level="os_g")
+
+    # Leg B — the planner's zero-0 pick (dp x mp at 64; Megatron-SP rides
+    # the mp axis like the production config would).
+    plan_b = plan_hybrid_configs(model=MODEL_13B, batch=512,
+                                 cluster=dict(n_devices=N_DEV), zero_stage=0,
+                                 accumulate_steps=1)
+    assert (plan_b["dp_degree"] * plan_b["pp_degree"]
+            * plan_b["sharding_degree"] * plan_b["mp_degree"]) == N_DEV
+    run_leg("B_zero0", plan_b, layers=8, seq_par=plan_b["mp_degree"] > 1)
+
+    # Leg C — a full 3-D dp x mp x pp x sharding factorization of 64 (the
+    # composition every large-model recipe uses; constrain the planner to
+    # pp>1, mp>1, sharding>1 and take its best such plan).
+    plan_c = plan_hybrid_configs(
+        model=MODEL_13B, batch=512, cluster=dict(n_devices=N_DEV),
+        zero_stage=2, accumulate_steps=8,
+        require=lambda p: p.pp > 1 and p.mp > 1 and p.sharding > 1)
+    assert (plan_c["dp_degree"] * plan_c["pp_degree"]
+            * plan_c["sharding_degree"] * plan_c["mp_degree"]) == N_DEV
+    run_leg("C_3d", plan_c, layers=2 * plan_c["pp_degree"], accum=8,
+            level="os_g")
+
+    print("WORKER_DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""64-device virtual-mesh dryrun of the v5e-64 north-star plan (VERDICT r4
+item 3). The conftest pins this process to 8 virtual devices, so the run
+happens in a subprocess with --xla_force_host_platform_device_count=64
+(the reference's subprocess+env trick, test/collective/multinode/).
+
+northstar64_worker.py executes the planner's ACTUAL plans for the real
+GPT-3 1.3B spec at 64 chips (zero-1 -> 64-way sharding; zero-0 ->
+dp32 x mp2; a constrained full 3-D dp x mp x pp x sharding factorization)
+on toy model dims, and reports per-collective HLO byte volumes. Here we
+assert: clean SPMD stderr (no involuntary remat), and the volumes against
+the calibrated cost model's byte contracts (auto_parallel/cost.py):
+
+* ZeRO grad sync: all-reduce result bytes ~= total f32 grad bytes.
+* ZeRO-1 param re-gather: all-gather result bytes ~= param bytes.
+* dp x mp: all-reduce ~= the per-chip grad shard; collective-permute
+  present for the mp seams (Megatron-SP gather/scatter lowers to cp).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_TIMEOUT = 2400
+
+
+@pytest.fixture(scope="module")
+def worker_result():
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=64"
+    env["JAX_PLATFORMS"] = "cpu"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.run(
+        [sys.executable, os.path.join(root, "tests", "northstar64_worker.py")],
+        capture_output=True, text=True, timeout=_TIMEOUT, env=env, cwd=root)
+    assert p.returncode == 0, p.stderr[-4000:]
+    assert "WORKER_DONE" in p.stdout, p.stdout[-2000:]
+    legs = {}
+    for line in p.stdout.splitlines():
+        if line.startswith("{"):
+            rec = json.loads(line)
+            legs[rec["leg"]] = rec
+    return legs, p.stderr
+
+
+def test_spmd_tail_clean(worker_result):
+    _, err = worker_result
+    assert "Involuntary full rematerialization" not in err, err[-2000:]
+
+
+def test_plans_factorize_64(worker_result):
+    legs, _ = worker_result
+    assert set(legs) == {"A_zero1", "B_zero0", "C_3d"}
+    for rec in legs.values():
+        p = rec["plan"]
+        assert (p["dp_degree"] * p["pp_degree"] * p["sharding_degree"]
+                * p["mp_degree"]) == 64, p
+        assert all(abs(v) < 20 and v == v for v in rec["losses"]), rec
+        # second step improves on the first (training actually happened)
+        assert rec["losses"][1] < rec["losses"][0], rec
+
+
+def test_zero1_sharded_plan_volumes(worker_result):
+    """The planner's zero-1 pick is the 64-way sharded plan; its emitted
+    volumes must match the cost model's sharding_comm contract: one grad
+    reduce (all-reduce over the 64-way group, result = full f32 grads) and
+    one param re-gather (all-gather, result = full param bytes)."""
+    legs, _ = worker_result
+    rec = legs["A_zero1"]
+    assert rec["plan"]["sharding_degree"] == 64, rec["plan"]
+    pb = rec["n_param_bytes"]
+    ar = rec["volumes"].get("all-reduce", 0)
+    ag = rec["volumes"].get("all-gather", 0)
+    assert 0.9 < ar / pb < 1.25, (ar, pb)
+    assert 0.9 < ag / pb < 1.25, (ag, pb)
+
+
+def test_dp_mp_plan_volumes(worker_result):
+    """The zero-0 pick (dp32 x mp2): the dp grad sync covers the per-chip
+    grad shard; the Megatron-SP mp seams emit collective-permutes."""
+    legs, _ = worker_result
+    rec = legs["B_zero0"]
+    assert rec["plan"]["dp_degree"] > 1 and rec["plan"]["mp_degree"] > 1
+    pb = rec["n_param_bytes"]
+    ar = rec["volumes"].get("all-reduce", 0)
+    assert 0.6 < ar / pb < 1.5, (ar, pb)
+    assert rec["volumes"].get("collective-permute", 0) > 0, rec["volumes"]
+
+
+def test_3d_composed_plan_runs(worker_result):
+    """Full dp x mp x pp x sharding factorization of 64: all three
+    collective families present (grad reduce, ZeRO gather, pipeline/SP
+    permutes), training steps finite and improving."""
+    legs, _ = worker_result
+    rec = legs["C_3d"]
+    p = rec["plan"]
+    assert p["pp_degree"] > 1 and p["mp_degree"] > 1 \
+        and p["sharding_degree"] > 1
+    v = rec["volumes"]
+    assert v.get("all-reduce", 0) > 0
+    assert v.get("all-gather", 0) > 0
+    assert v.get("collective-permute", 0) > 0
