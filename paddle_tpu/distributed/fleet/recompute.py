@@ -36,6 +36,11 @@ _POLICIES = {
     # when HBM allows it
     "dots_saveable": "dots_saveable",
     "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+    # save ONLY the flash-attention outputs (tagged flash_out in
+    # kernels/flash_attention.py): one [B, S, H, D] residual per block buys
+    # skipping the whole flash forward in the replay — the best
+    # memory/FLOPs trade when full dots_saveable doesn't fit
+    "save_flash": "save_flash",
 }
 
 
@@ -73,7 +78,10 @@ def recompute(function, *args, use_reentrant: bool = True, preserve_rng_state: b
     if policy not in _POLICIES:
         raise ValueError(f"unknown recompute policy {policy!r}; one of {sorted(k for k in _POLICIES if k)}")
     pol_name = _POLICIES[policy]
-    pol = getattr(jax.checkpoint_policies, pol_name) if pol_name else None
+    if pol_name == "save_flash":
+        pol = jax.checkpoint_policies.save_only_these_names("flash_out")
+    else:
+        pol = getattr(jax.checkpoint_policies, pol_name) if pol_name else None
     ckpt_fn = jax.checkpoint(pure_fn, policy=pol)
     out, node = run_op("recompute", ckpt_fn, [*params, *tensor_inputs])
     from ...ops._dispatch import wrap_outputs

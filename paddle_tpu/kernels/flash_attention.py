@@ -371,8 +371,13 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 def flash_attention_fwd(q, k, v, causal: bool = False, scale: float = None):
     """[B, S, H, D] flash attention; falls back to None-signal if unsupported
     (caller uses the jnp reference path)."""
+    from jax.ad_checkpoint import checkpoint_name
+
     B, S, H, D = q.shape
     if _pick_blocks(S)[0] is None:
         raise ValueError(f"flash_attention: seq len {S} not divisible by a supported block")
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
-    return _flash(q, k, v, causal, scale)
+    # named for the 'save_flash' remat policy (fleet/recompute.py): a
+    # checkpointed block can keep THIS output resident so its backward
+    # replays only the cheap projections/elementwise, not the flash kernel
+    return checkpoint_name(_flash(q, k, v, causal, scale), "flash_out")

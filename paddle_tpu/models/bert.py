@@ -38,6 +38,7 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     initializer_range: float = 0.02
     num_labels: int = 2
+    loss_chunk: int = 0  # masked-LM CE in seq chunks (0 = off; see gpt.py)
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -200,6 +201,77 @@ class BertForMaskedLM(Layer):
 
     def loss(self, logits, labels, ignore_index: int = -100):
         return masked_lm_loss(logits, labels, ignore_index=ignore_index)
+
+    def forward_with_loss(self, input_ids, labels):
+        """Fused trunk->loss with chunked masked-LM CE when cfg.loss_chunk
+        divides S (see masked_lm_head_loss_chunked); falls back to
+        forward()+loss() otherwise."""
+        from ..core.tensor import Tensor
+
+        chunk = getattr(self.cfg, "loss_chunk", 0)
+        S = input_ids.shape[1]
+        if not chunk or S % chunk:
+            return self.loss(self.forward(input_ids), labels)
+        h, _ = self.bert(input_ids)
+        return Tensor(masked_lm_head_loss_chunked(
+            self.lm_head, h, labels, chunk, self.cfg.layer_norm_eps))
+
+
+def masked_lm_head_loss_chunked(lm_head: "BertLMHead", h, labels, chunk: int,
+                                eps: float, ignore_index: int = -100):
+    """Fused LM-head -> masked-CE path in sequence chunks (the gpt.py
+    forward_with_loss technique applied to the BERT/ERNIE head): the head
+    transform, the [*, V] logits matmul, and the fp32 softmax-CE run per
+    chunk under jax.checkpoint, so the full [B, S, V] fp32 logits tensor
+    (2.6 GB at B=32, S=512, V=40k) never materializes. Numerics match
+    lm_head(h) + masked_lm_loss exactly: bf16 logits cast to f32 before
+    log-softmax, losses summed over valid positions / count.
+
+    Returns a raw jnp scalar; callers wrap in Tensor."""
+    import jax
+    import jax.numpy as jnp
+
+    hv = h._value if hasattr(h, "_value") else jnp.asarray(h)
+    yv = labels._value if hasattr(labels, "_value") else jnp.asarray(labels)
+    wT = lm_head.transform.weight._value
+    bT = lm_head.transform.bias._value
+    g = lm_head.layer_norm.weight._value
+    b = lm_head.layer_norm.bias._value
+    W = lm_head._tied.weight._value  # [V, Hd]
+    B, S, Hd = hv.shape
+    n = S // chunk
+    hs = hv.reshape(B, n, chunk, Hd).swapaxes(0, 1)  # [n, B, c, Hd]
+    ys = yv.reshape(B, n, chunk).swapaxes(0, 1)
+
+    from ..kernels.elementwise import tanh_gelu_raw
+
+    def chunk_ce(h_c, y_c, wT, bT, g, b, W):
+        t = tanh_gelu_raw(h_c @ wT + bT)
+        tf = t.astype(jnp.float32)
+        mu = tf.mean(-1, keepdims=True)
+        var = jnp.square(tf - mu).mean(-1, keepdims=True)
+        t = (((tf - mu) * jax.lax.rsqrt(var + eps))
+             .astype(h_c.dtype) * g + b)
+        logits = (t @ W.T).astype(jnp.float32)
+        valid = y_c != ignore_index
+        y_safe = jnp.where(valid, y_c, 0).astype(jnp.int32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, y_safe[..., None], axis=-1)[..., 0]
+        nll = jnp.where(valid, lse - gold, 0.0)
+        # int32 regardless of the x64 flag: the scan carry is typed int32
+        return nll.sum().astype(jnp.float32), valid.sum().astype(jnp.int32)
+
+    ckpt_ce = jax.checkpoint(chunk_ce)
+
+    def body(acc, xy):
+        h_c, y_c = xy
+        s, c = ckpt_ce(h_c, y_c, wT, bT, g, b, W)
+        return (acc[0] + s, acc[1] + c), None
+
+    (total, count), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (hs, ys))
+    return total / jnp.maximum(count, 1)
 
 
 def masked_lm_loss(logits, labels, ignore_index: int = -100):

@@ -102,6 +102,24 @@ class ErnieForPretraining(Layer):
         seq, pooled = self.ernie(input_ids, token_type_ids, attention_mask, task_type_ids=task_type_ids)
         return self.lm_head(seq), self.sop_head(pooled)
 
+    def forward_with_loss(self, input_ids, mlm_labels):
+        """Fused trunk->MLM-loss with chunked CE (the gpt.py technique via
+        bert.masked_lm_head_loss_chunked) when cfg.loss_chunk divides S.
+        The SOP head (a 2-class linear on pooled [CLS], negligible FLOPs)
+        has no labels on this path — the MLM term is the pretrain
+        objective, matching head_loss under pp."""
+        from ..core.tensor import Tensor
+        from .bert import masked_lm_head_loss_chunked, masked_lm_loss
+
+        cfg = self.ernie.cfg
+        chunk = getattr(cfg, "loss_chunk", 0)
+        S = input_ids.shape[1]
+        if not chunk or S % chunk:
+            return masked_lm_loss(self.forward(input_ids)[0], mlm_labels)
+        h, _ = self.ernie(input_ids)
+        return Tensor(masked_lm_head_loss_chunked(
+            self.lm_head, h, mlm_labels, chunk, cfg.layer_norm_eps))
+
     # ---- compiled pipeline-parallel protocol (PipelineSpec) ----
     def embed(self, input_ids):
         return self.ernie.embeddings(input_ids)

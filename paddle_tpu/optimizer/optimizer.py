@@ -90,6 +90,12 @@ class Optimizer:
         """AdamW-style decoupled weight decay coefficient (0 = off)."""
         return 0.0
 
+    def _takes_native_grad(self, value) -> bool:
+        """True when _update accepts grads at their native dtype (a fused
+        kernel casting in VMEM); apply_gradients then skips the f32
+        pre-convert that would materialize a full grad copy in HBM."""
+        return False
+
     def _coupled_wd(self) -> float:
         """L2-regularization folded into the gradient (SGD/Momentum/Adam style)."""
         wd = self._weight_decay
@@ -187,10 +193,13 @@ class Optimizer:
                 continue
             s = dict(state[name])
             value = s.get("master_weight", v)
-            gv = g.astype(value.dtype)
+            # optimizers whose update kernel casts internally (fused AdamW)
+            # take the grad at its native dtype — a pre-convert here would
+            # materialize a full f32 grad copy in HBM per parameter
+            gv = g if self._takes_native_grad(value) else g.astype(value.dtype)
             cwd = self._coupled_wd()
             if cwd:
-                gv = gv + cwd * value
+                gv = gv.astype(value.dtype) + cwd * value
             if step_count is not None:
                 s = {**s, "_step_override": step_count}
             # name-only meta so name-keyed rules (LARS exclude lists) apply
@@ -403,14 +412,14 @@ class AdamW(Adam):
 
             b1p = state["beta1_pow"] * self._beta1
             b2p = state["beta2_pow"] * self._beta2
+            # operands pass at their NATIVE dtypes: the kernel casts in VMEM
+            # and writes moments back in the state dtype, so no full-tensor
+            # f32 copies ever hit HBM (see _adamw_kernel)
             new, m, v = fused_adamw_update(
-                value, grad.astype(jnp.float32), state["moment1"], state["moment2"],
+                value, grad, state["moment1"], state["moment2"],
                 lr=lr, beta1=self._beta1, beta2=self._beta2, eps=self._epsilon,
                 weight_decay=decay, beta1_pow=b1p, beta2_pow=b2p,
             )
-            # keep state dtypes stable across paths (scan carries + checkpoints)
-            m = m.astype(state["moment1"].dtype)
-            v = v.astype(state["moment2"].dtype)
             return new, {**state, "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
         value = value * (1.0 - lr * decay)
         return super()._update(value, grad, state, lr, param_meta)
@@ -423,6 +432,9 @@ class AdamW(Adam):
             return False
         on_tpu = jax.default_backend() in ("tpu", "axon")
         return on_tpu and value.size >= 1 << 16 and value.dtype in (jnp.float32, jnp.bfloat16)
+
+    def _takes_native_grad(self, value) -> bool:
+        return self._use_fused_kernel(value)
 
 
 class Adamax(Optimizer):

@@ -120,3 +120,54 @@ def test_ernie_tp_sharding_annotations():
     model = ernie_tiny()
     specs = [p.dist_spec for _, p in model.named_parameters() if p.dist_spec is not None]
     assert specs, "ERNIE should carry mp sharding annotations via parallel layers"
+
+
+def test_chunked_masked_lm_loss_matches_unchunked():
+    """forward_with_loss with loss_chunk set must match lm_head+masked_lm_loss
+    exactly (the chunked path never materializes full [B*S, V] fp32 logits —
+    the r5 ernie/bert serving-the-loss fix; see bert.masked_lm_head_loss_chunked)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.bert import (BERT_TINY, BertConfig,
+                                        BertForMaskedLM, masked_lm_loss)
+
+    paddle.seed(0)
+    cfg = BertConfig(**{**BERT_TINY, "dropout": 0.0, "attention_dropout": 0.0,
+                        "loss_chunk": 8})
+    m = BertForMaskedLM(cfg)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, size=(2, 16)).astype(np.int32)
+    y = np.where(rng.rand(2, 16) < 0.3, x, -100).astype(np.int32)
+    with paddle.no_grad():
+        ref = float(masked_lm_loss(m(Tensor(x)), Tensor(y)).numpy())
+        got = float(m.forward_with_loss(Tensor(x), Tensor(y)).numpy())
+    assert abs(ref - got) < 2e-5, (ref, got)
+    # all-ignored edge: zero loss, not NaN
+    y2 = np.full_like(y, -100)
+    with paddle.no_grad():
+        z = float(m.forward_with_loss(Tensor(x), Tensor(y2)).numpy())
+    assert z == 0.0
+
+
+def test_ernie_chunked_pretrain_loss_matches():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.bert import masked_lm_loss
+    from paddle_tpu.models.ernie import (ERNIE_TINY, ErnieConfig,
+                                         ErnieForPretraining)
+
+    paddle.seed(0)
+    cfg = ErnieConfig(**{**ERNIE_TINY, "dropout": 0.0,
+                         "attention_dropout": 0.0, "loss_chunk": 8})
+    m = ErnieForPretraining(cfg)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, size=(2, 16)).astype(np.int32)
+    y = np.where(rng.rand(2, 16) < 0.3, x, -100).astype(np.int32)
+    with paddle.no_grad():
+        ref = float(masked_lm_loss(m(Tensor(x))[0], Tensor(y)).numpy())
+        got = float(m.forward_with_loss(Tensor(x), Tensor(y)).numpy())
+    assert abs(ref - got) < 2e-5, (ref, got)
