@@ -652,8 +652,11 @@ class MultiheadMatmulFusePass(Pass):
 
                     o = None
                     # flash kernel requires self-attention shapes (its
-                    # blocks tile one shared seq length)
-                    if _use_pallas(q.dtype) and q.shape[1] == k.shape[1]:
+                    # blocks tile one shared seq length); below S=512 the
+                    # decomposed XLA attention is at kernel parity and the
+                    # pallas boundary only blocks fusion (measured r5)
+                    if _use_pallas(q.dtype) and q.shape[1] == k.shape[1] \
+                            and q.shape[1] >= 512:
                         from ..kernels.flash_attention import (
                             _pick_blocks, flash_attention_fwd)
 
@@ -970,10 +973,9 @@ class LayerNormFusePass(Pass):
                 continue
 
             def ln(x, g, b, _eps=eps, _dt=str(final.result(0).type.dtype)):
-                from ..kernels.norms import fused_layer_norm
+                from ..kernels.elementwise import layer_norm_raw
 
-                return fused_layer_norm(
-                    x, g.reshape(-1), b.reshape(-1), _eps).astype(_dt)
+                return layer_norm_raw(x, g, b, _eps).astype(_dt)
 
             op = program.create_op(
                 "pd.layer_norm", [x_v, gamma_v, beta_v],
@@ -1031,10 +1033,15 @@ class FcFusePass(Pass):
             if got is None:
                 continue
             bias_v, dot_v = got
-            dot = dot_v.defining_op()
+            # bf16 Linears trace dot(preferred f32) -> convert -> add: walk
+            # through the convert and reproduce it in the fused op
+            mid_v = _skip_through(dot_v, ("pd.convert_element_type",))
+            dot = mid_v.defining_op()
             if dot is None or dot.name != "pd.dot_general" \
                     or dot.id not in program.op_bind:
                 continue
+            acc_dtype = str(mid_v.type.dtype)  # the dot's own result dtype
+            mid_dtype = str(dot_v.type.dtype)  # post-convert (= add input)
             dn = program.op_bind[dot.id][1].get("dimension_numbers")
             if dn is None:
                 continue
@@ -1058,12 +1065,14 @@ class FcFusePass(Pass):
                 if a is not None:
                     target, act = user, a
 
-            def fc(x, w, b, _act=act, _dt=str(target.result(0).type.dtype)):
+            def fc(x, w, b, _act=act, _acc=acc_dtype, _mid=mid_dtype,
+                   _dt=str(target.result(0).type.dtype)):
                 import jax.numpy as jnp
 
                 from ..kernels.elementwise import tanh_gelu_raw
 
-                y = jnp.matmul(x, w) + b
+                y = jnp.matmul(x, w, preferred_element_type=_acc)
+                y = y.astype(_mid) + b
                 if _act == "relu":
                     y = jnp.maximum(y, 0)
                 elif _act == "gelu":
@@ -1137,14 +1146,13 @@ class EmbeddingEltwiseLayerNormFusePass(Pass):
                       _dt=str(ln.result(0).type.dtype)):
                 import jax.numpy as jnp
 
-                from ..kernels.norms import fused_layer_norm
+                from ..kernels.elementwise import layer_norm_raw
 
                 tables, ids = args[:_n], args[_n:2 * _n]
                 g, b = args[2 * _n], args[2 * _n + 1]
                 x = sum(jnp.take(t, i, axis=0)
                         for t, i in zip(tables, ids))
-                return fused_layer_norm(
-                    x, g.reshape(-1), b.reshape(-1), _eps).astype(_dt)
+                return layer_norm_raw(x, g, b, _eps).astype(_dt)
 
             operands = ([t for t, _ in lookups] + [i for _, i in lookups]
                         + [gamma_v, beta_v])

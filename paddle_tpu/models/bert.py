@@ -243,15 +243,10 @@ def masked_lm_head_loss_chunked(lm_head: "BertLMHead", h, labels, chunk: int,
     hs = hv.reshape(B, n, chunk, Hd).swapaxes(0, 1)  # [n, B, c, Hd]
     ys = yv.reshape(B, n, chunk).swapaxes(0, 1)
 
-    from ..kernels.elementwise import tanh_gelu_raw
+    from ..kernels.elementwise import layer_norm_raw, tanh_gelu_raw
 
     def chunk_ce(h_c, y_c, wT, bT, g, b, W):
-        t = tanh_gelu_raw(h_c @ wT + bT)
-        tf = t.astype(jnp.float32)
-        mu = tf.mean(-1, keepdims=True)
-        var = jnp.square(tf - mu).mean(-1, keepdims=True)
-        t = (((tf - mu) * jax.lax.rsqrt(var + eps))
-             .astype(h_c.dtype) * g + b)
+        t = layer_norm_raw(tanh_gelu_raw(h_c @ wT + bT), g, b, eps)
         logits = (t @ W.T).astype(jnp.float32)
         valid = y_c != ignore_index
         y_safe = jnp.where(valid, y_c, 0).astype(jnp.int32)

@@ -38,19 +38,32 @@ def main():
     sys.path.insert(0, ".")
     import bench
 
-    fn = {"bert_sst2": bench.bench_bert_sst2, "gpt_dp": bench.bench_gpt_dp,
-          "ernie_mp4": bench.bench_ernie_mp4}.get(config)
+    configs = {"bert_sst2": bench.bench_bert_sst2,
+               "gpt_dp": bench.bench_gpt_dp,
+               "ernie_mp4": bench.bench_ernie_mp4,
+               "resnet50": bench.bench_resnet50,
+               "gpt_moe": bench.bench_gpt_moe}
+    fn = configs.get(config)
+    if fn is None:
+        raise SystemExit(
+            f"unknown config {config!r}; one of {sorted(configs)}")
     # for profiling we rebuild the step like the bench does but trace it —
-    # easiest: monkeypatch _measure to capture (step, x, y) then trace
+    # easiest: monkeypatch BOTH measurement paths to capture (step, x, y)
     captured = {}
 
     real_measure = bench._measure
+    real_scanned = bench._measure_scanned
 
     def fake_measure(step, x, y, iters, tokens):
         captured.update(step=step, x=x, y=y)
         return real_measure(step, x, y, 2, tokens)
 
+    def fake_scanned(step, x, y, iters, tokens, repeats=3):
+        captured.update(step=step, x=x, y=y)
+        return real_scanned(step, x, y, iters, tokens, repeats=1)
+
     bench._measure = fake_measure
+    bench._measure_scanned = fake_scanned
     fn()
     step, x, y = captured["step"], captured["x"], captured["y"]
     paths = collect(lambda: step(x, y))
