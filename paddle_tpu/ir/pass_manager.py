@@ -62,6 +62,7 @@ DEFAULT_PIPELINE = ["algebraic_simplify", "constant_folding", "cse", "dce"]
 INFERENCE_PIPELINE = ["delete_quant_dequant", "dropout_eliminate",
                       "multihead_matmul_fuse", "gelu_fuse",
                       "layer_norm_fuse", "embedding_eltwise_layernorm_fuse",
+                      "skip_layernorm_fuse",
                       "algebraic_simplify", "constant_folding",
                       "affine_chain_collapse", "conv_bn_fuse",
                       "fc_fuse", "cse", "dce"]

@@ -1170,6 +1170,53 @@ class EmbeddingEltwiseLayerNormFusePass(Pass):
 
 
 @register_pass
+class SkipLayerNormFusePass(Pass):
+    """residual add + layer norm -> one pd.fused_skip_layernorm op (the
+    reference's skip_layernorm_fuse_pass, the transformer residual seam
+    BERT/ERNIE serving hits twice per block). Anchors on the pd.layer_norm
+    ops LayerNormFusePass produced, so it runs after it — and after
+    EmbeddingEltwiseLayerNormFusePass, which claims the input-block
+    add-trees first. Constants are excluded (an add with a constant is a
+    bias, not a residual seam — AffineChainCollapse territory)."""
+
+    name = "skip_layernorm_fuse"
+
+    def run(self, program: Program) -> int:
+        changed = 0
+        for ln in program.ops():
+            if ln.name != "pd.layer_norm":
+                continue
+            x_v, gamma_v, beta_v = ln.operands
+            add = x_v.defining_op()
+            if add is None or add.name != "pd.add" or len(add.operands) != 2:
+                continue
+            u_v, w_v = add.operands
+            if _const_value(program, u_v) is not None \
+                    or _const_value(program, w_v) is not None:
+                continue
+            if tuple(u_v.type.shape) != tuple(w_v.type.shape):
+                continue  # broadcasted add: not the residual seam
+            eps = float(ln.attrs().get("epsilon", 1e-5))
+
+            def fused(u, w, g, b, _eps=eps,
+                      _dt=str(ln.result(0).type.dtype)):
+                from ..kernels.elementwise import layer_norm_raw
+
+                return layer_norm_raw(u + w, g, b, _eps).astype(_dt)
+
+            op = program.create_op(
+                "pd.fused_skip_layernorm", [u_v, w_v, gamma_v, beta_v],
+                [ln.result(0).type], attrs={"epsilon": eps}, before=ln)
+            program.op_fns[op.id] = fused
+            ln.result(0).replace_all_uses_with(op.result(0))
+            ln.erase()
+            changed += 1
+        if changed:
+            program.dce()
+        return changed
+
+
+@register_pass
 class DropoutEliminatePass(Pass):
     """Inference-only: pd.dropout → identity (delete_dropout_op_pass analog).
 

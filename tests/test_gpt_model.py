@@ -194,3 +194,29 @@ def test_gpt_gqa_generate():
     x = np.random.RandomState(0).randint(0, 128, size=(2, 8))
     out = m.generate(paddle.to_tensor(x), max_new_tokens=4)
     assert out.shape == [2, 12]
+
+
+def test_gpt_recompute_policies_match():
+    """Every recompute policy (full, dots_saveable, save_flash) computes the
+    same loss and grads as the unrecomputed model — policies trade memory
+    for replay FLOPs, never numerics. save_flash keeps the tagged
+    flash/sdpa output resident (kernels/flash_attention.py checkpoint_name)."""
+    paddle.seed(5)
+    base = gpt_tiny(dropout=0.0)
+    x, y = _batch()
+    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+    l_ref = base.loss(base(xt), yt)
+    l_ref.backward()
+    g_ref = dict(base.named_parameters())[
+        "gpt.layers.0.mlp.fc1.weight"].grad.numpy()
+    for policy in (None, "dots_saveable", "save_flash"):
+        paddle.seed(5)
+        m = gpt_tiny(dropout=0.0, use_recompute=True,
+                     recompute_policy=policy)
+        m.set_state_dict(base.state_dict())
+        l = m.loss(m(xt), yt)
+        np.testing.assert_allclose(l.numpy(), l_ref.numpy(), rtol=1e-5)
+        l.backward()
+        g = dict(m.named_parameters())[
+            "gpt.layers.0.mlp.fc1.weight"].grad.numpy()
+        np.testing.assert_allclose(g, g_ref, rtol=1e-4, atol=1e-6)

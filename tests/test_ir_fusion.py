@@ -483,3 +483,97 @@ def test_bert_serving_trace_full_fusion_set():
     assert len(list(prog.ops())) < n0
     out = np.asarray(jax.jit(prog.to_callable())(ids))
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_skip_layernorm_fuses_residual_seam():
+    """Residual add + LN -> pd.fused_skip_layernorm (the reference's
+    skip_layernorm_fuse_pass); a BERT block hits the seam twice."""
+    paddle.seed(0)
+
+    class Block(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(16, 16)
+            self.ln = paddle.nn.LayerNorm(16)
+
+        def forward(self, x):
+            return self.ln(x + self.fc(x))
+
+    m = Block()
+    m.eval()
+
+    def call(x):
+        with paddle.no_grad():
+            return m(Tensor(x))._value
+
+    x = np.random.RandomState(0).randn(2, 8, 16).astype(np.float32)
+    ref = np.asarray(call(x))
+    prog = _ir.trace(call, x)
+    stats = PassManager(INFERENCE_PIPELINE).run(prog)
+    assert stats["layer_norm_fuse"] == 1
+    assert stats["skip_layernorm_fuse"] == 1
+    c = _op_counts(prog)
+    assert c["pd.fused_skip_layernorm"] == 1
+    assert c.get("pd.layer_norm", 0) == 0
+    out = np.asarray(jax.jit(prog.to_callable())(x))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_skip_layernorm_leaves_bias_add_alone():
+    """LN over (activation + CONSTANT) is a bias pattern, not a residual
+    seam — must not fuse as skip-layernorm."""
+    import jax.numpy as jnp
+
+    c_bias = np.random.RandomState(1).randn(16).astype(np.float32)
+
+    def call(x):
+        g = jnp.ones((16,), np.float32)
+        b = jnp.zeros((16,), np.float32)
+        h = x + jnp.asarray(c_bias)
+        mu = jnp.mean(h, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(h - mu), axis=-1, keepdims=True)
+        return (h - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+    x = np.random.RandomState(0).randn(2, 8, 16).astype(np.float32)
+    ref = np.asarray(call(x))
+    prog = _ir.trace(call, x)
+    stats = PassManager(INFERENCE_PIPELINE).run(prog)
+    assert stats["skip_layernorm_fuse"] == 0
+    out = np.asarray(jax.jit(prog.to_callable())(x))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_fc_fuse_bf16_convert_chain():
+    """bf16 Linears trace dot(preferred f32) -> convert -> bias add; the
+    pass must walk the convert and reproduce the exact dtype chain (f32
+    accumulate, bf16 truncate, bf16 add) — bit-exact vs the unfused trace."""
+    import ml_dtypes
+
+    paddle.seed(0)
+
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = paddle.nn.Linear(16, 32)
+            self.b = paddle.nn.Linear(32, 8)
+
+        def forward(self, x):
+            return self.b(paddle.nn.functional.relu(self.a(x)))
+
+    m = M().astype("bfloat16")
+    m.eval()
+
+    def call(x):
+        with paddle.no_grad():
+            return m(Tensor(x))._value
+
+    x = (np.random.RandomState(0).randn(4, 16) * 0.1).astype(
+        ml_dtypes.bfloat16)
+    ref = np.asarray(call(x), np.float32)
+    prog = _ir.trace(call, x)
+    stats = PassManager(INFERENCE_PIPELINE).run(prog)
+    assert stats["fc_fuse"] == 2, stats
+    c = _op_counts(prog)
+    assert c["pd.fused_fc"] == 2 and c["pd.dot_general"] == 0
+    out = np.asarray(jax.jit(prog.to_callable())(x), np.float32)
+    np.testing.assert_array_equal(out, ref)  # same dtype chain => bit-exact
