@@ -23,21 +23,21 @@ from typing import Dict, Optional
 __all__ = ["ClusterSpec", "ModelSpec", "TrainConfig", "CostModel", "CostBreakdown"]
 
 
-#: Measured single-chip MFU per model family (BASELINE.md round-4 rows, one
+#: Measured single-chip MFU per model family (BASELINE.md round-5 rows, one
 #: real v5e chip). These calibrate the cost model's compute term; the v5e
 #: bandwidth/peak constants stay datasheet values (one chip measures no
 #: collectives — the HLO-volume test validates the comm BYTE formulas on the
 #: virtual mesh instead).
 #:
-#: Error bars: the gpt family has two measured points (674M: 0.604,
-#: 1.3B: 0.577) — spread ±2.5% around 0.59; single-point families carry the
+#: Error bars: the gpt family has two measured points (674M: 0.621,
+#: 1.3B: 0.586) — spread ±3% around 0.60; single-point families carry the
 #: bench's observed run-to-run variance, ±10-15%. Families not listed fall
 #: back to the gpt anchor.
 CALIBRATED_MFU = {
-    "gpt": 0.59,        # 674M 0.604 / 1.3B 0.577 (±2.5%)
-    "bert": 0.37,       # BERT-base MLM-style cls, B=32 S=128
-    "ernie_mlm": 0.22,  # masked-LM head dominates at S=512
-    "gpt_moe": 0.33,    # dense-dispatch MoE, E=8 top-2
+    "gpt": 0.60,        # 674M 0.621 / 1.3B 0.586 (±3%)
+    "bert": 0.35,       # BERT-base MLM-style cls, B=32 S=128 (scanned)
+    "ernie_mlm": 0.44,  # r5: flash routing + chunked masked-LM CE
+    "gpt_moe": 0.35,    # dense-dispatch MoE, E=8 top-2
     "resnet": 0.12,     # conv-bound (see BASELINE.md profile note)
 }
 

@@ -18,14 +18,14 @@ from paddle_tpu.distributed.auto_parallel.cost import (
     CALIBRATED_MFU, ClusterSpec, CostModel, ModelSpec, TrainConfig)
 
 # (name, ModelSpec kwargs, batch, measured single-chip step seconds)
-# from BASELINE.md round-4 measured rows
+# from BASELINE.md round-5 measured rows
 MEASURED_ROWS = [
     ("gpt_1p3b", dict(hidden=2048, layers=24, heads=16, vocab=50304,
-                      seq=2048, kind="gpt"), 16, 2.6234),
+                      seq=2048, kind="gpt"), 16, 2.5850),
     ("bert_base", dict(hidden=768, layers=12, heads=12, vocab=30522,
-                       seq=128, kind="bert"), 32, 0.0370),
+                       seq=128, kind="bert"), 32, 0.0389),
     ("ernie_base", dict(hidden=768, layers=12, heads=12, vocab=40000,
-                        seq=512, kind="ernie_mlm"), 32, 0.2843),
+                        seq=512, kind="ernie_mlm"), 32, 0.1438),
 ]
 
 
