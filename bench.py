@@ -112,16 +112,23 @@ def main():
         except Exception:
             long_note += ", gpt1.3B_mfu=failed"
 
-    print(
-        json.dumps(
-            {
-                "metric": "gpt_train_tokens_per_sec",
-                "value": round(tokens_per_sec, 1),
-                "unit": f"tokens/sec/chip ({backend}, {n_params/1e6:.0f}M params, MFU={mfu:.3f}{long_note})",
-                "vs_baseline": round(mfu / 0.40, 3),
-            }
-        )
-    )
+    out = {
+        "metric": "gpt_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": f"tokens/sec/chip ({backend}, {n_params/1e6:.0f}M params, MFU={mfu:.3f}{long_note})",
+        "vs_baseline": round(mfu / 0.40, 3),
+    }
+    # FLAGS_observability=1: fold the registry into the artifact. When the
+    # flag is off the dict above is exactly the seed shape (no telemetry key).
+    from paddle_tpu import observability
+
+    if observability.enabled():
+        observability.record_window(
+            tokens=bsz * seq * iters, seconds=best_dt,
+            flops=flops_per_token * bsz * seq * iters, peak=peak,
+            config="headline")
+        out["telemetry"] = observability.snapshot()
+    print(json.dumps(out))
 
 
 def _long_context_row() -> float:
@@ -351,6 +358,14 @@ def _row(config, metric, value, unit, step_s, flops_per_step, host_frac,
         "mfu": round(flops_per_step / (_peak_flops() * step_s), 3),
         "note": note,
     }
+    from paddle_tpu import observability
+
+    if observability.enabled():
+        observability.record_window(
+            tokens_per_sec=value if metric.endswith("tokens_per_sec") else None,
+            flops=flops_per_step, seconds=step_s, peak=_peak_flops(),
+            config=config)
+        out["telemetry"] = observability.snapshot()
     print(json.dumps(out))
     return out
 

@@ -8,6 +8,7 @@ hand-written kernels are Pallas. The public surface mirrors `import paddle`.
 
 from __future__ import annotations
 
+from . import _jaxcompat  # noqa: F401  (backfills jax.shard_map & co. on 0.4.x)
 from .version import full_version as __version__  # noqa: E402  (single source)
 
 from .core import (  # noqa: F401
@@ -103,6 +104,7 @@ from . import tensor  # noqa: F401,E402
 from .core.selected_rows import SelectedRows  # noqa: F401,E402
 from .core.string_tensor import StringTensor  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import observability  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402

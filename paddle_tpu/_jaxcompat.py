@@ -1,0 +1,114 @@
+"""Compatibility shims for older jax releases (0.4.x).
+
+The codebase targets the modern public API — ``jax.shard_map``,
+``jax.set_mesh``, ``jax.sharding.AxisType``, ``check_vma=`` — which older
+jaxlib wheels (still common in TPU-pinned containers) do not export. This
+module backfills just those names onto the ``jax`` namespace from their
+0.4.x equivalents so the rest of the tree can use one spelling:
+
+- ``jax.shard_map``            <- ``jax.experimental.shard_map.shard_map``
+  (``check_vma`` maps to ``check_rep``; ``axis_names`` — the manual set —
+  maps to its complement ``auto``)
+- ``jax.set_mesh``             <- entering the ``Mesh`` context manager
+- ``jax.sharding.AxisType``    <- a stand-in enum (old meshes carry no axis
+  types, so membership tests simply never match ``Manual``/``Explicit``)
+- ``jax.sharding.get_abstract_mesh`` <- an empty-mesh stub
+
+Imported for its side effects at the very top of ``paddle_tpu/__init__``;
+a no-op on jax versions that already ship the modern names.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import functools
+
+import jax
+
+
+def _install_shard_map():
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    @functools.wraps(_legacy)
+    def shard_map(f=None, *, mesh=None, in_specs=None, out_specs=None,
+                  axis_names=None, check_vma=None, check_rep=None, **kwargs):
+        if f is None:  # decorator form: jax.shard_map(mesh=..., ...)
+            return lambda fn: shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                axis_names=axis_names, check_vma=check_vma,
+                check_rep=check_rep, **kwargs)
+        if check_rep is None:
+            check_rep = True if check_vma is None else check_vma
+        auto = kwargs.pop("auto", frozenset())
+        if axis_names:  # modern: manual axes; legacy: the auto complement
+            all_names = frozenset(getattr(mesh, "axis_names", ()) or ())
+            auto = all_names - frozenset(axis_names)
+        return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_rep=check_rep, auto=auto, **kwargs)
+
+    jax.shard_map = shard_map
+
+
+def _install_set_mesh():
+    if hasattr(jax, "set_mesh"):
+        return
+
+    @contextlib.contextmanager
+    def set_mesh(mesh):
+        # the 0.4.x global-mesh idiom: Mesh is itself a context manager
+        if mesh is None:
+            yield None
+        else:
+            with mesh:
+                yield mesh
+
+    jax.set_mesh = set_mesh
+
+
+def _install_axis_size():
+    if hasattr(jax.lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        # the pre-axis_size idiom: psum of a unit literal is evaluated
+        # statically to the axis size
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = axis_size
+
+
+def _install_sharding_extras():
+    sharding = jax.sharding
+    if not hasattr(sharding, "AxisType"):
+
+        class AxisType(enum.Enum):
+            Auto = "auto"
+            Explicit = "explicit"
+            Manual = "manual"
+
+        sharding.AxisType = AxisType
+    if not hasattr(sharding, "get_abstract_mesh"):
+
+        class _EmptyAbstractMesh:
+            axis_names = ()
+            axis_types = ()
+            shape_tuple = ()
+
+            def __bool__(self):
+                return False
+
+        _empty = _EmptyAbstractMesh()
+        sharding.get_abstract_mesh = lambda: _empty
+
+
+def install():
+    _install_shard_map()
+    _install_set_mesh()
+    _install_axis_size()
+    _install_sharding_extras()
+
+
+install()

@@ -81,6 +81,11 @@ register_flag("default_dtype", "float32", "Default floating dtype for creation o
 register_flag("amp_dtype", "bfloat16", "Preferred autocast dtype on TPU")
 register_flag("enable_async_checkpoint", True, "Write checkpoints from a background thread")
 register_flag("max_inflight_microbatches", 2, "Pipeline schedule in-flight cap")
+register_flag("observability", False,
+              "Enable the runtime telemetry substrate (metrics registry + "
+              "span tracer, paddle_tpu.observability). Off by default: "
+              "instrumented sites reduce to one flag check and the registry "
+              "stays empty, so tier-1 timing is unaffected")
 register_flag("eval_no_record", False,
               "Layers in eval() mode skip tape recording entirely: closes "
               "the chained-forward tape growth hazard (h = m(h) inference "

@@ -18,6 +18,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from ..observability import metrics as _metrics
 from .mesh import get_global_mesh
 
 _group_counter = itertools.count()
@@ -71,6 +72,7 @@ def _make_default_group() -> "Group":
     axis = mesh.axis_names[0] if mesh.axis_names else "world"
     n = int(np.prod(mesh.devices.shape)) if mesh.devices.size else 1
     flat_mesh = Mesh(mesh.devices.reshape(n), (axis,)) if len(mesh.axis_names) != 1 else mesh
+    _metrics.counter("dist.group.created", 1, kind="default")
     return Group(list(range(n)), flat_mesh, axis, gid=0, name="_default_pg")
 
 
@@ -106,6 +108,7 @@ def new_group(ranks: Optional[List[int]] = None, backend: str = None, timeout=No
     sub = np.array([devices[r % len(devices)] for r in ranks])
     g = Group(ranks, Mesh(sub, (axis,)), axis, name=axis)
     _groups[g.id] = g
+    _metrics.counter("dist.group.created", 1, kind="sub")
     return g
 
 

@@ -24,6 +24,7 @@ collective effectively `sync_op=False` until the value is read back.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +32,38 @@ from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
+from ..observability import instrument as _obs
+from ..observability import metrics as _metrics
 from .collective import Group, _resolve_group
+
+
+def _observed(fn):
+    """Per-collective telemetry (op count, payload bytes, host latency) on
+    the eager API — one flag check when observability is off."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not _metrics.enabled():
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        payload = None
+        for a in args:
+            if isinstance(a, Tensor):
+                payload = a
+                break
+            if isinstance(a, (list, tuple)):
+                for e in a:
+                    if isinstance(e, Tensor):
+                        payload = e
+                        break
+                if payload is not None:
+                    break
+        _obs.record_collective(fn.__name__, value=payload,
+                               seconds=time.perf_counter() - t0)
+        return out
+
+    return wrapper
 
 
 class ReduceOp:
@@ -110,6 +142,7 @@ def _allreduce_fn(mesh: Mesh, axis: str, op: str):
     return jax.jit(shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis)))
 
 
+@_observed
 def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group=None, sync_op: bool = True) -> Task:
     g = _resolve_group(group)
     if _is_per_rank(tensor, g):
@@ -133,6 +166,7 @@ def reduce(tensor: Tensor, dst: int = 0, op: str = ReduceOp.SUM, group=None, syn
     return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
 
 
+@_observed
 def all_gather(tensor_list: list, tensor: Tensor, group=None, sync_op: bool = True) -> Task:
     g = _resolve_group(group)
     if _is_per_rank(tensor, g):
@@ -142,12 +176,14 @@ def all_gather(tensor_list: list, tensor: Tensor, group=None, sync_op: bool = Tr
     return Task(tensor)
 
 
+@_observed
 def all_gather_object(object_list: list, obj, group=None) -> Task:
     g = _resolve_group(group)
     object_list.extend(obj for _ in range(g.nranks))
     return Task()
 
 
+@_observed
 def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op: bool = True) -> Task:
     g = _resolve_group(group)
     if _is_per_rank(tensor, g):
@@ -158,6 +194,7 @@ def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op: bool = True) ->
     return Task(tensor)
 
 
+@_observed
 def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group=None, sync_op: bool = True) -> Task:
     """tensor becomes the per-rank stack of tensor_list (rank i gets slice i)."""
     g = _resolve_group(group)
@@ -168,6 +205,7 @@ def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group=None, sync_op:
     return Task(tensor)
 
 
+@_observed
 def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op: bool = True) -> Task:
     """global_scatter/global_gather's building block (SURVEY §2.2): rank i's
     j-th chunk goes to rank j's i-th slot. Per-rank stacks [N, N, *S] swap
@@ -200,6 +238,7 @@ def _reduce_scatter_fn(mesh: Mesh, axis: str):
     return jax.jit(shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis)))
 
 
+@_observed
 def reduce_scatter(tensor: Tensor, tensor_list, op: str = ReduceOp.SUM, group=None, sync_op: bool = True) -> Task:
     """Per-rank input: each rank holds N chunks ([N, N, *S] stacked); rank i
     receives sum_j chunk[j][i] -> per-rank stack [N, *S] written into tensor."""
@@ -226,12 +265,14 @@ def reduce_scatter(tensor: Tensor, tensor_list, op: str = ReduceOp.SUM, group=No
 _mailbox: dict = {}
 
 
+@_observed
 def send(tensor: Tensor, dst: int = 0, group=None, sync_op: bool = True) -> Task:
     g = _resolve_group(group)
     _mailbox.setdefault((g.id, dst), []).append(tensor._value)
     return Task(tensor)
 
 
+@_observed
 def recv(tensor: Tensor, src: int = 0, group=None, sync_op: bool = True) -> Task:
     g = _resolve_group(group)
     queue = None
@@ -248,30 +289,38 @@ isend = send
 irecv = recv
 
 
+@_observed
 def barrier(group=None) -> Task:
     g = _resolve_group(group)
     jax.effects_barrier()
     return Task()
 
 
-# ---- traced-face wrappers: use inside shard_map/pjit-traced functions ----
+# ---- traced-face wrappers: use inside shard_map/pjit-traced functions.
+# Telemetry records at TRACE time (once per compile, payload bytes from the
+# abstract shape) — zero cost in the compiled program. ----
 def psum(x, axis_name):
+    _obs.record_collective("psum", value=x, face="traced")
     return lax.psum(x, axis_name)
 
 
 def pmean(x, axis_name):
+    _obs.record_collective("pmean", value=x, face="traced")
     return lax.pmean(x, axis_name)
 
 
 def pmax(x, axis_name):
+    _obs.record_collective("pmax", value=x, face="traced")
     return lax.pmax(x, axis_name)
 
 
 def pmin(x, axis_name):
+    _obs.record_collective("pmin", value=x, face="traced")
     return lax.pmin(x, axis_name)
 
 
 def ppermute(x, axis_name, perm):
+    _obs.record_collective("ppermute", value=x, face="traced")
     return lax.ppermute(x, axis_name, perm)
 
 
@@ -280,17 +329,21 @@ def axis_index(axis_name):
 
 
 def all_gather_in_trace(x, axis_name, axis: int = 0, tiled: bool = False):
+    _obs.record_collective("all_gather", value=x, face="traced")
     return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter_in_trace(x, axis_name, scatter_dimension: int = 0, tiled: bool = True):
+    _obs.record_collective("reduce_scatter", value=x, face="traced")
     return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled)
 
 
 def all_to_all_in_trace(x, axis_name, split_axis: int, concat_axis: int, tiled: bool = True):
+    _obs.record_collective("all_to_all", value=x, face="traced")
     return lax.all_to_all(x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
 
 
+@_observed
 def gather(tensor, gather_list=None, dst: int = 0, group=None, sync_op: bool = True) -> Task:
     """All ranks' slices collected at dst (every rank here — superset, like
     reduce; reference only guarantees dst)."""
@@ -304,6 +357,7 @@ def gather(tensor, gather_list=None, dst: int = 0, group=None, sync_op: bool = T
     return Task(tensor)
 
 
+@_observed
 def alltoall_single(in_tensor, out_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op: bool = True) -> Task:
     """Single-tensor all-to-all (reference alltoall_single): the per-rank
     leading dim is split into nranks chunks that swap ranks."""
@@ -328,6 +382,7 @@ def alltoall_single(in_tensor, out_tensor, in_split_sizes=None, out_split_sizes=
     return Task(out_tensor)
 
 
+@_observed
 def scatter_object_list(out_object_list, in_object_list=None, src: int = 0, group=None) -> Task:
     g = _resolve_group(group)
     if in_object_list:
@@ -335,6 +390,7 @@ def scatter_object_list(out_object_list, in_object_list=None, src: int = 0, grou
     return Task()
 
 
+@_observed
 def broadcast_object_list(object_list, src: int = 0, group=None) -> Task:
     return Task()  # single-process semantics: list already holds src's objects
 

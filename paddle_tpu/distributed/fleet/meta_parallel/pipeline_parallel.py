@@ -37,7 +37,20 @@ from jax import lax
 
 from ....core.tensor import Tensor
 from ....nn.layer.layers import Layer
+from ....observability import instrument as _obs
 from .pp_layers import PipelineLayer
+
+
+def _ppermute(x, axis_name, perm):
+    """lax.ppermute + trace-time telemetry (op count / payload bytes per
+    compile — the per-collective accounting the schedules report through)."""
+    _obs.record_collective("ppermute", value=x, face="traced")
+    return lax.ppermute(x, axis_name, perm)
+
+
+def _psum(x, axis_name):
+    _obs.record_collective("psum", value=x, face="traced")
+    return lax.psum(x, axis_name)
 
 
 @dataclass
@@ -401,7 +414,7 @@ def pipeline_schedule(
             lambda o: o,
             outputs,
         )
-        nxt = lax.ppermute(y, axis_name, perm)
+        nxt = _ppermute(y, axis_name, perm)
         return (nxt, outputs, aux_acc), None
 
     init_in = jnp.zeros(mb_shape, microbatches.dtype)
@@ -413,7 +426,7 @@ def pipeline_schedule(
         jnp.arange(M + n - 1))
     # aux_acc is each stage's partial sum over its microbatches; the total
     # over all stages/blocks is the psum (still inside the manual region)
-    return (outputs, lax.psum(aux_acc, axis_name)) if with_aux else outputs
+    return (outputs, _psum(aux_acc, axis_name)) if with_aux else outputs
 
 
 def pipeline_schedule_1f1b(
@@ -509,7 +522,7 @@ def pipeline_schedule_1f1b(
                     o, y.astype(o.dtype), jnp.maximum(slot, 0), 0),
                 lambda o: o,
                 outputs)
-            return (lax.ppermute(y, axis_name, fwd_perm), outputs, aux_acc), None
+            return (_ppermute(y, axis_name, fwd_perm), outputs, aux_acc), None
 
         outputs0 = jnp.zeros((M,) + tuple(probe.shape), probe.dtype)
         (_, outputs, aux_acc), _ = lax.scan(
@@ -517,7 +530,7 @@ def pipeline_schedule_1f1b(
                    jnp.zeros((), jnp.float32)),
             jnp.arange(T_fwd))
         if with_aux:
-            return outputs, lax.psum(aux_acc, axis_name)
+            return outputs, _psum(aux_acc, axis_name)
         return outputs
 
     @jax.custom_vjp
@@ -534,7 +547,7 @@ def pipeline_schedule_1f1b(
             # the primal's last aux op is lax.psum: its transpose sums the
             # per-device cotangent shares (shard_map hands each device
             # ct/n for a replicated output) back into the full cotangent
-            d_aux = lax.psum(d_aux, axis_name)
+            d_aux = _psum(d_aux, axis_name)
         else:
             d_out, d_aux = ct, None
 
@@ -591,8 +604,8 @@ def pipeline_schedule_1f1b(
                 lambda d: d,
                 d_mbs)
             dx = jnp.where(liveB, dx, 0).astype(dx_ring.dtype)
-            return (lax.ppermute(yR, axis_name, fwd_perm),
-                    lax.ppermute(dx, axis_name, rev_perm),
+            return (_ppermute(yR, axis_name, fwd_perm),
+                    _ppermute(dx, axis_name, rev_perm),
                     stash, g, d_mbs), None
 
         g0 = jax.tree_util.tree_map(
@@ -738,10 +751,10 @@ def pipeline_schedule_interleaved(
             outputs,
         )
         out_valid = valid & ~finishing
-        nxt = (lax.ppermute(y, axis_name, perm),
-               lax.ppermute(mb_idx, axis_name, perm),
-               lax.ppermute(chunk_idx + 1, axis_name, perm),
-               lax.ppermute(out_valid, axis_name, perm))
+        nxt = (_ppermute(y, axis_name, perm),
+               _ppermute(mb_idx, axis_name, perm),
+               _ppermute(chunk_idx + 1, axis_name, perm),
+               _ppermute(out_valid, axis_name, perm))
         return (nxt[0], nxt[1], nxt[2], nxt[3], fresh, outputs, aux_acc), None
 
     init = (
@@ -754,7 +767,7 @@ def pipeline_schedule_interleaved(
         jnp.zeros((), jnp.float32),
     )
     (_, _, _, _, _, outputs, aux_acc), _ = lax.scan(tick, init, None, length=T)
-    return (outputs, lax.psum(aux_acc, axis_name)) if with_aux else outputs
+    return (outputs, _psum(aux_acc, axis_name)) if with_aux else outputs
 
 
 def _interleaved_1f1b_tables(n: int, v: int, M: int):
@@ -1001,7 +1014,7 @@ def pipeline_schedule_interleaved_1f1b(
                 lambda o: o,
                 outputs)
             y = jnp.where(val, y, ring)  # idle devices pass the ring through
-            return (lax.ppermute(y, axis_name, fwd_perm), outputs,
+            return (_ppermute(y, axis_name, fwd_perm), outputs,
                     aux_acc), None
 
         outputs0 = jnp.zeros((M,) + tuple(probe.shape), out_dtype)
@@ -1011,7 +1024,7 @@ def pipeline_schedule_interleaved_1f1b(
              jnp.zeros((), jnp.float32)),
             ticks)
         if with_aux:
-            return outputs, lax.psum(aux_acc, axis_name)
+            return outputs, _psum(aux_acc, axis_name)
         return outputs
 
     @jax.custom_vjp
@@ -1028,7 +1041,7 @@ def pipeline_schedule_interleaved_1f1b(
             d_out, d_aux = ct
             # transpose of the primal's trailing psum (see
             # pipeline_schedule_1f1b.pipe_bwd)
-            d_aux = lax.psum(d_aux, axis_name)
+            d_aux = _psum(d_aux, axis_name)
         else:
             d_out, d_aux = ct, None
         stage_idx = lax.axis_index(axis_name)
@@ -1084,8 +1097,8 @@ def pipeline_schedule_interleaved_1f1b(
                 lambda d: d,
                 d_mbs)
             dx = jnp.where(vB, dx, 0).astype(dx_ring.dtype)
-            return (lax.ppermute(yR, axis_name, fwd_perm),
-                    lax.ppermute(dx, axis_name, rev_perm),
+            return (_ppermute(yR, axis_name, fwd_perm),
+                    _ppermute(dx, axis_name, rev_perm),
                     stash, g, d_mbs), None
 
         g0 = jax.tree_util.tree_map(
@@ -1118,4 +1131,4 @@ def spmd_pipeline(
     outputs = pipeline_schedule(stage_fn, stacked_params, microbatches,
                                 axis_name=axis_name, n_stages=n_stages,
                                 remat=False)
-    return lax.psum(outputs, axis_name)
+    return _psum(outputs, axis_name)

@@ -11,6 +11,7 @@ and the hapi/auto-parallel engines all compile through.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional
 
 import jax
@@ -18,6 +19,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...core import random as _random
+from ...observability import instrument as _obs_instr
+from ...observability import metrics as _obs_metrics
 from ...core.autograd import no_grad
 from ...core.tensor import Tensor
 from ...nn.clip import ClipGradByGlobalNorm
@@ -407,6 +410,25 @@ class ShardedTrainStep:
         self._compiled_step_fn = step
         self._p_shard, self._s_shard = p_shard, s_shard
         self._multi = None
+        # observability: first dispatch per compiled path = compile-cache miss
+        self._obs_warm = {"step": False, "multi": False}
+
+    def _obs_record(self, site: str, path: str, seconds: float,
+                    samples: Optional[int], steps: int = 1):
+        """Per-step training telemetry + compile-cache accounting (gated on
+        the observability flag by the helpers; the first dispatch of a
+        compiled path blocks through trace+compile, so its wall time is the
+        compile cost)."""
+        first = not self._obs_warm[path]
+        self._obs_warm[path] = True
+        _obs_instr.record_compile(site, seconds=seconds if first else None,
+                                  cache_hit=not first)
+        _obs_metrics.counter("train.steps", steps)
+        if samples:
+            _obs_metrics.counter("train.samples", samples)
+        if not first:
+            _obs_metrics.histogram("train.step.dispatch_seconds",
+                                   seconds / max(steps, 1))
 
     def _build_pipeline_loss(self, buffers0, remat: bool):
         """loss_impl for pp>1: shard_map manual over the pp axis only (dp/mp/
@@ -634,6 +656,8 @@ class ShardedTrainStep:
         K = xs.shape[0] if hasattr(xs, "shape") else len(xs)
         self._step_i += K
         ss_in = self.scaler_state if scaled else jnp.zeros((), jnp.float32)
+        obs = _obs_metrics.enabled()
+        t0 = time.perf_counter() if obs else 0.0
         with jax.set_mesh(self.mesh):
             (self.params, self.opt_state, self.buffers, ss_out,
              losses) = self._multi(
@@ -642,6 +666,12 @@ class ShardedTrainStep:
                 # +1 so scanned step j draws seed (seed + prev_steps + 1 + j)
                 # — identical to the seeds K sequential __call__s would use
                 jnp.float32(lr), jnp.uint32(self._seed + self._step_i - K + 1))
+        if obs:
+            samples = None
+            if hasattr(xs, "shape") and len(getattr(xs, "shape", ())) >= 2:
+                samples = int(xs.shape[0]) * int(xs.shape[1])
+            self._obs_record("sharded_train_step.run_steps", "multi",
+                             time.perf_counter() - t0, samples, steps=K)
         if scaled:
             self.scaler_state = ss_out
         return losses
@@ -649,6 +679,8 @@ class ShardedTrainStep:
     def __call__(self, x, y, lr: Optional[float] = None):
         lr = self.optimizer.get_lr() if lr is None else lr
         self._step_i += 1
+        obs = _obs_metrics.enabled()
+        t0 = time.perf_counter() if obs else 0.0
         with jax.set_mesh(self.mesh):
             if self.scaler_state is not None:
                 (self.params, self.opt_state, self.buffers,
@@ -673,6 +705,12 @@ class ShardedTrainStep:
                     jnp.float32(lr),
                     jnp.uint32(self._seed + self._step_i),
                 )
+        if obs:
+            samples = None
+            if hasattr(x, "shape") and len(getattr(x, "shape", ())) >= 1:
+                samples = int(x.shape[0])
+            self._obs_record("sharded_train_step", "step",
+                             time.perf_counter() - t0, samples)
         return loss
 
     step = __call__

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Type, Union
 
+from ..observability import metrics as _metrics
+from ..observability.tracing import span as _span
 from .core import Program
 
 
@@ -85,9 +87,18 @@ class PassManager:
         for _ in range(self.max_rounds):
             changed = 0
             for p in self.passes:
-                n = p(program)
+                # ir.pass.seconds{pass=...} histogram via the span tracer
+                with _span("ir.pass", **{"pass": p.name}):
+                    n = p(program)
+                if n:
+                    _metrics.counter("ir.pass.rewrites", n,
+                                     **{"pass": p.name})
+                else:
+                    _metrics.counter("ir.pass.no_change", 1,
+                                     **{"pass": p.name})
                 self.stats[p.name] += n
                 changed += n
+            _metrics.counter("ir.pass_manager.rounds")
             if not changed:
                 break
         return self.stats

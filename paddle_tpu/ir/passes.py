@@ -12,10 +12,15 @@ def-use matching — the GraphPatternDetector analog over Value.defining_op().
 
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
+from ..observability import metrics as _metrics
 from .core import CONSTANT_OP, Program
 from .pass_manager import Pass, register_pass
+
+_log = logging.getLogger(__name__)
 
 _FOLD_ELEMENT_LIMIT = 1 << 22  # don't materialize folded constants > 4M elems
 
@@ -461,12 +466,22 @@ def _is_causal_mask(program: Program, v, memo=None) -> bool:
     if len(shp) < 2 or shp[-1] != shp[-2] or any(d != 1 for d in shp[:-2]):
         return False
     if shp[-1] * shp[-1] > _MASK_EVAL_LIMIT:
+        # the lost fusion must be visible (ADVICE r5): count + log the skip
+        _metrics.counter("ir.causal_mask.skipped_oversized")
+        _log.info(
+            "causal-mask proof skipped: %dx%d mask exceeds _MASK_EVAL_LIMIT "
+            "(%d elements); this attention site keeps the softmax+PV "
+            "collapse instead of full flash fusion",
+            shp[-1], shp[-1], _MASK_EVAL_LIMIT)
         return False
     m = _eval_const_chain(program, v, memo=memo, limit=_MASK_EVAL_LIMIT)
     if m is None or m.dtype != bool or m.ndim < 2:
         return False
     m2 = m.reshape(m.shape[-2], m.shape[-1])
-    return bool(np.array_equal(m2, np.tril(np.ones_like(m2))))
+    proven = bool(np.array_equal(m2, np.tril(np.ones_like(m2))))
+    if proven:
+        _metrics.counter("ir.causal_mask.proven")
+    return proven
 
 
 @register_pass

@@ -23,6 +23,8 @@ from jax import export as jax_export
 from ..core import random as _random
 from ..core.autograd import no_grad
 from ..core.tensor import Tensor
+from ..observability import instrument as _obs
+from ..observability import metrics as _obs_metrics
 from ..static.input_spec import InputSpec
 
 _MODEL_SUFFIX = ".pdmodel"
@@ -83,7 +85,11 @@ class StaticFunction:
     def _traced(self, layer, n_args):
         key = ("layer", n_args) if layer is not None else ("fn", n_args)
         if key in self._jit_cache:
+            if _obs_metrics.enabled():
+                _obs.record_compile("to_static", cache_hit=True)
             return self._jit_cache[key]
+        if _obs_metrics.enabled():
+            _obs.record_compile("to_static", cache_hit=False)
         # trace the AST-converted variant when one exists; the ORIGINAL
         # function stays in self._function for eager fallback / parity APIs
         fn = getattr(self, "_converted", None) or self._function
@@ -117,6 +123,8 @@ class StaticFunction:
                 return jax.tree_util.tree_map(_leaf_to_raw, out)
 
             jitted = jax.jit(traced)
+        if _obs_metrics.enabled():
+            jitted = _obs.TimedFirstCall(jitted, "to_static")
         self._jit_cache[key] = jitted
         return jitted
 

@@ -1,0 +1,41 @@
+"""paddle_tpu.observability — framework-wide runtime telemetry.
+
+A process-global metrics registry (counters / gauges / histograms with
+labels, thread-safe snapshot/reset) plus a span tracer unified with
+``paddle_tpu.profiler``'s host event recorder. Off by default behind
+``FLAGS_observability``; see observability/README.md for the metric naming
+scheme and the bench.py field mapping.
+
+    import paddle_tpu
+    paddle_tpu.observability.enable()
+    ...train / run passes / collectives...
+    print(paddle_tpu.observability.summary())
+    paddle_tpu.observability.dump_jsonl("/tmp/metrics.jsonl")
+"""
+
+from . import instrument, metrics, tracing, training  # noqa: F401
+from .instrument import record_collective, record_compile  # noqa: F401
+from .metrics import (  # noqa: F401
+    MetricsRegistry,
+    counter,
+    disable,
+    dump_jsonl,
+    enable,
+    enabled,
+    gauge,
+    get_registry,
+    histogram,
+    reset,
+    snapshot,
+    summary,
+)
+from .tracing import clear_spans, export_chrome_trace, span, spans  # noqa: F401
+from .training import record_step, record_window  # noqa: F401
+
+__all__ = [
+    "MetricsRegistry", "enabled", "enable", "disable",
+    "counter", "gauge", "histogram", "snapshot", "reset", "get_registry",
+    "summary", "dump_jsonl",
+    "span", "spans", "clear_spans", "export_chrome_trace",
+    "record_collective", "record_compile", "record_step", "record_window",
+]

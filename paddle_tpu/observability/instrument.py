@@ -1,0 +1,93 @@
+"""Shared instrumentation helpers for the hot layers.
+
+Collective accounting (the GSPMD/EQuARX-style per-collective byte/latency
+attribution) and jit compile-cache accounting. Every helper gates on
+``metrics.enabled()`` itself, so call sites stay one line and pay only the
+flag check when observability is off.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from . import metrics
+
+
+def payload_bytes(x: Any) -> Optional[int]:
+    """Estimated payload size of a Tensor / jax array / tracer / ndarray.
+
+    Works at trace time too: abstract values carry shape+dtype, which is all
+    the estimate needs (bytes moved scale with the payload; the per-algorithm
+    constant — e.g. ring all-reduce's 2(n-1)/n — is left to the reader)."""
+    try:
+        v = getattr(x, "_value", x)
+        shape = getattr(v, "shape", None)
+        dtype = getattr(v, "dtype", None)
+        if shape is None or dtype is None:
+            return None
+        n = 1
+        for d in shape:
+            n *= int(d)
+        return n * np.dtype(dtype).itemsize
+    except Exception:
+        return None
+
+
+def record_collective(op: str, value: Any = None, nbytes: Optional[int] = None,
+                      seconds: Optional[float] = None, face: str = "eager"):
+    """One collective issued: count it, account payload bytes, and (eager
+    face only — the traced face records at trace time, once per compile)
+    its host-observed latency."""
+    if not metrics.enabled():
+        return
+    if nbytes is None and value is not None:
+        nbytes = payload_bytes(value)
+    metrics.counter("dist.collective.calls", 1, op=op, face=face)
+    if nbytes:
+        metrics.counter("dist.collective.bytes", nbytes, op=op, face=face)
+    if seconds is not None:
+        metrics.histogram("dist.collective.seconds", seconds, op=op, face=face)
+
+
+def record_compile(site: str, seconds: Optional[float] = None,
+                   cache_hit: bool = False):
+    """Compile-cache accounting: a hit bumps ``jit.compile.cache_hit``; a
+    miss bumps ``jit.compile.cache_miss`` and, when the caller timed the
+    compiling call, observes ``jit.compile.seconds``."""
+    if not metrics.enabled():
+        return
+    if cache_hit:
+        metrics.counter("jit.compile.cache_hit", 1, site=site)
+    else:
+        metrics.counter("jit.compile.cache_miss", 1, site=site)
+        if seconds is not None:
+            metrics.histogram("jit.compile.seconds", seconds, site=site)
+
+
+class TimedFirstCall:
+    """Wrap a jitted callable so its FIRST invocation (trace + XLA compile;
+    jax blocks until the executable exists) is observed as compile seconds.
+    Attribute access (``.lower`` etc.) passes through."""
+
+    __slots__ = ("_fn", "_site", "_warm")
+
+    def __init__(self, fn, site: str):
+        self._fn = fn
+        self._site = site
+        self._warm = False
+
+    def __call__(self, *args, **kwargs):
+        if self._warm or not metrics.enabled():
+            return self._fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        self._warm = True
+        metrics.histogram("jit.compile.seconds", time.perf_counter() - t0,
+                          site=self._site)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)

@@ -1,0 +1,92 @@
+"""Lightweight span tracer, unified with the profiler's host recorder.
+
+``span(name, **labels)`` times a region and, when ``FLAGS_observability`` is
+on:
+
+* records a ``<name>.seconds`` histogram into the metrics registry,
+* forwards the span into ``profiler._HostEventRecorder`` — the SAME buffer
+  ``profiler.RecordEvent`` writes — so an active ``profiler.Profiler`` merges
+  observability spans into its ``export_chrome_tracing`` output for free
+  (no second recorder, no duplicate span type), and
+* appends to a bounded local buffer so ``export_chrome_trace`` can write a
+  chrome://tracing JSON even when no Profiler is attached.
+
+With the flag off, ``span`` yields immediately: no timing, no events, no
+registry entries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List
+
+from ..profiler.profiler import _recorder
+from . import metrics
+
+_MAX_SPANS = 65536
+_spans: deque = deque(maxlen=_MAX_SPANS)
+_lock = threading.Lock()
+
+
+def _span_name(name: str, labels: Dict[str, Any]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+@contextmanager
+def span(name: str, **labels):
+    """Time a region; no-op (single flag check) when observability is off."""
+    if not metrics.enabled():
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        t1 = time.perf_counter()
+        metrics.histogram(f"{name}.seconds", t1 - t0, **labels)
+        full = _span_name(name, labels)
+        # no-ops unless a Profiler is in a RECORD state — the merge seam
+        _recorder.record(full, t0, t1)
+        with _lock:
+            _spans.append({
+                "name": full,
+                "ts": t0 * 1e6,
+                "dur": (t1 - t0) * 1e6,
+                "tid": threading.get_ident() % 100000,
+            })
+
+
+def spans() -> List[Dict[str, Any]]:
+    """Copy of the local span buffer (most recent _MAX_SPANS)."""
+    with _lock:
+        return list(_spans)
+
+
+def clear_spans():
+    with _lock:
+        _spans.clear()
+
+
+def export_chrome_trace(path: str) -> str:
+    """Write the local span buffer as chrome://tracing JSON — the same event
+    schema profiler.export_chrome_tracing emits, so the files are
+    interchangeable in the trace viewer."""
+    events = [
+        {"name": e["name"], "ph": "X", "ts": e["ts"], "dur": e["dur"],
+         "pid": os.getpid(), "tid": e["tid"]}
+        for e in spans()
+    ]
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return path

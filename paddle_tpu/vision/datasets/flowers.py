@@ -30,7 +30,12 @@ DATA_MD5 = "52808999861908f626f3c1f4e79d11fa"
 LABEL_MD5 = "e0620be6f572b9609742df49c70aed4d"
 SETID_MD5 = "a5357ecc9cb78c4bef273ce3793fc85c"
 
-# mode -> setid.mat field (reference flowers.py MODE_FLAG_MAP)
+# mode -> setid.mat field. DELIBERATE divergence from the reference
+# (flowers.py:38), whose MODE_FLAG_MAP swaps the two: {'train': 'tstid',
+# 'test': 'trnid'} — it trains on the larger 6149-image "tstid" partition.
+# Here each mode reads the setid.mat field literally named for it, so
+# len(train)=1020 matches the published split; pass mode='test' to get the
+# reference's training partition.
 MODE_FLAG_MAP = {"train": "trnid", "test": "tstid", "valid": "valid"}
 
 

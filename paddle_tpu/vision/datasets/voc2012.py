@@ -29,8 +29,11 @@ SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
 DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
 LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
 
-# mode -> split-list name (reference voc2012.py MODE_FLAG_MAP; 'valid'->'val')
-MODE_FLAG_MAP = {"train": "train", "test": "test", "valid": "val"}
+# mode -> split-list name (reference voc2012.py:36 MODE_FLAG_MAP). The
+# trainval tarball has no test annotations, so the reference maps
+# 'train'->trainval (the full annotated set) and 'test'->train — a plain
+# {'test': 'test'} would KeyError on the tar member, since no test.txt ships.
+MODE_FLAG_MAP = {"train": "trainval", "test": "train", "valid": "val"}
 
 
 class VOC2012(Dataset):

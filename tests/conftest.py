@@ -22,6 +22,10 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# Backfill modern jax names (jax.shard_map, jax.set_mesh, ...) before any
+# test module runs its own `from jax import shard_map` at collection time.
+import paddle_tpu._jaxcompat  # noqa: E402,F401
+
 import pytest  # noqa: E402
 
 

@@ -9,6 +9,11 @@ import pytest
 
 import paddle_tpu as paddle
 
+# jaxlib 0.4.x's XLA:CPU aborts the whole process while compiling the
+# Ulysses all-to-all attention reshard (SIGABRT inside backend_compile, which
+# no pytest-level timeout can intercept). Gate only the affected tests.
+_LEGACY_JAX = tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5)
+
 
 @pytest.fixture(autouse=True)
 def _fresh_world():
@@ -74,6 +79,9 @@ def test_gpt_ring_matches_plain():
     assert ring[-1] < ring[0]
 
 
+@pytest.mark.skipif(
+    _LEGACY_JAX, reason="ulysses all-to-all compile SIGABRTs XLA:CPU on jax<0.5"
+)
 def test_gpt_ulysses_matches_plain():
     ref = _train_gpt()
     uly = _train_gpt(sep=4, dp=2, mode="ulysses")

