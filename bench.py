@@ -5,7 +5,7 @@ Default invocation (the driver contract) prints ONE JSON line:
 in-repo numbers (SURVEY §6); the driver-set north star is GPT pretrain
 MFU >= 0.40, so vs_baseline = model_flops_utilization / 0.40.
 
-`--config {bert_sst2,gpt_dp,ernie_mp4,resnet50,gpt_moe,all}` runs the
+`--config {bert_sst2,gpt_dp,ernie_mp4,resnet50,gpt_moe,serving,all}` runs the
 BASELINE.json config rows instead (tools/ci_model_benchmark.sh role): each
 prints one JSON line with throughput + a measured step-time breakdown —
 compute fraction (model FLOPs / chip peak over the device-resident step),
@@ -627,12 +627,75 @@ def bench_gpt_moe():
                      f"E={E} top{k}, B={bsz} S={seq}, ep+zero3 est")
 
 
+def bench_serving():
+    """Serving config: offline Engine.generate over the static-shape decode
+    core — TTFT / TPOT / throughput, the latency-side analog of the training
+    rows (vLLM-style offline benchmark, one chip)."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import Engine, SamplingParams
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1024, num_layers=12,
+                        num_heads=16, num_kv_heads=4, max_seq_len=1024,
+                        dropout=0.0)
+        B, n_req, prompt_len, max_new = 8, 16, 128, 128
+    else:  # tiny on CPU so the harness still runs
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64, dropout=0.0)
+        B, n_req, prompt_len, max_new = 2, 4, 8, 8
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    engine = Engine(model, max_batch_size=B, max_seq_len=cfg.max_seq_len)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
+               for _ in range(n_req)]
+    # warm-up drains the compile cost (one prefill bucket + the decode step)
+    # out of the timed run — steady-state serving numbers, not cold start
+    engine.generate([prompts[0]], SamplingParams(max_new_tokens=2))
+    sp = SamplingParams(max_new_tokens=max_new)
+    t0 = time.perf_counter()
+    reqs = [engine.add_request(p, sp) for p in prompts]
+    while engine.has_unfinished:
+        engine.step()
+    elapsed = time.perf_counter() - t0
+    total = sum(r.num_generated for r in reqs)
+    ttfts = sorted(r.first_token_time - r.arrival_time for r in reqs)
+    tpots = sorted((r.finish_time - r.first_token_time)
+                   / (r.num_generated - 1)
+                   for r in reqs if r.num_generated > 1)
+
+    def _ms(xs, q):
+        return round(1e3 * xs[min(len(xs) - 1, int(q * len(xs)))], 2)
+
+    out = {
+        "config": "serving",
+        "metric": "tokens_per_sec",
+        "value": round(total / elapsed, 1),
+        "unit": "tokens/sec/chip",
+        "ttft_ms": {"p50": _ms(ttfts, 0.5), "p99": _ms(ttfts, 0.99)},
+        "tpot_ms": {"p50": _ms(tpots, 0.5), "p99": _ms(tpots, 0.99)},
+        "note": f"{n_req} reqs, prompt={prompt_len}, max_new={max_new}, "
+                f"slots={B}",
+    }
+    if observability.enabled():
+        out["telemetry"] = observability.snapshot()
+    print(json.dumps(out))
+    return out
+
+
 CONFIGS = {
     "bert_sst2": bench_bert_sst2,
     "gpt_dp": bench_gpt_dp,
     "ernie_mp4": bench_ernie_mp4,
     "resnet50": bench_resnet50,
     "gpt_moe": bench_gpt_moe,
+    "serving": bench_serving,
 }
 
 

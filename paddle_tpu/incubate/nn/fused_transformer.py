@@ -294,6 +294,7 @@ class FusedMultiTransformer(Layer):
         from jax import lax
 
         from ...ops._dispatch import apply, as_tensor
+        from ...serving import kv_cache as _kvc
 
         if attn_mask is not None:
             raise NotImplementedError(
@@ -328,19 +329,13 @@ class FusedMultiTransformer(Layer):
             expand = (lambda t: jnp.repeat(t, rep, axis=1)) if rep > 1 else (lambda t: t)
             if k_layer is not None:
                 if step is not None:
-                    # decode: write this token's K/V at `step`, attend prefix
-                    zero = jnp.zeros((), step.dtype)
-                    k_layer = lax.dynamic_update_slice(
-                        k_layer, k, (zero, zero, step, zero))
-                    v_layer = lax.dynamic_update_slice(
-                        v_layer, v, (zero, zero, step, zero))
-                    S_max = k_layer.shape[2]
-                    s = jnp.einsum("bhqd,bhkd->bhqk", q, expand(k_layer),
-                                   preferred_element_type=jnp.float32) / jnp.sqrt(float(hd)).astype(jnp.float32)
-                    pos = jnp.arange(S_max)
-                    s = jnp.where(pos[None, None, None, :] <= step, s, -1e30)
-                    o = jnp.einsum("bhqk,bhkd->bhqd",
-                                   jax.nn.softmax(s, -1).astype(v.dtype), expand(v_layer))
+                    # decode: shared static-cache write/attend
+                    # (serving.kv_cache) — the same path the GPT serving
+                    # engine runs, so the two cached decode implementations
+                    # cannot drift
+                    k_layer = _kvc.write_kv(k_layer, k, step)
+                    v_layer = _kvc.write_kv(v_layer, v, step)
+                    o = _kvc.decode_attend(q, k_layer, v_layer, step)
                 else:
                     # prefill: causal attention; caches filled with the prefix
                     k_layer = lax.dynamic_update_slice(k_layer, k, (0, 0, 0, 0))

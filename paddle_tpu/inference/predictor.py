@@ -65,6 +65,11 @@ class Config:
     def switch_ir_optim(self, flag=True):
         self._options["ir_optim"] = flag
 
+    def switch_batch_bucketing(self, flag=True):
+        """Pad symbolic batch dims to power-of-two buckets in Predictor.run
+        (on by default); off = compile one executable per exact batch size."""
+        self._options["batch_bucketing"] = flag
+
     def set_cpu_math_library_num_threads(self, n):
         self._options["cpu_threads"] = n
 
@@ -122,6 +127,34 @@ class Predictor:
     def get_input_tensor(self, name: str) -> _IOHandle:
         return self.get_input_handle(name)
 
+    def _bucket_batch(self, args):
+        """Pad a shared symbolic leading (batch) dim up to the next power of
+        two, so the compile cache holds O(log B) executables instead of one
+        per distinct batch size (each a full XLA compile). Only applies when
+        every saved InputSpec's leading dim is symbolic (None) — a
+        fixed-batch artifact must see its exact shape. Returns
+        (args, real_B or None); outputs carrying the padded dim are sliced
+        back in run()."""
+        if not self._config._options.get("batch_bucketing", True):
+            return args, None
+        specs = self._layer._input_specs
+        if len(specs) != len(args) or not args:
+            return args, None
+        for s, a in zip(specs, args):
+            shape = s.get("shape") or []
+            if not shape or shape[0] is not None or a.ndim < 1:
+                return args, None
+        sizes = {int(a.shape[0]) for a in args}
+        if len(sizes) != 1:
+            return args, None
+        B = sizes.pop()
+        padded = 1 << max(0, B - 1).bit_length()  # next power of two >= B
+        if padded == B:
+            return args, None
+        pad = [jnp.pad(a, [(0, padded - B)] + [(0, 0)] * (a.ndim - 1))
+               for a in args]
+        return pad, B
+
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         """Execute. Either pass arrays positionally or pre-fill input handles.
 
@@ -131,14 +164,26 @@ class Predictor:
         reads; for a real, writable numpy copy use
         get_output_handle(name).copy_to_cpu(), which is also the
         completion barrier — run() itself is async dispatch, so device
-        errors surface at the first materialization, not here."""
+        errors surface at the first materialization, not here.
+
+        Symbolic-batch artifacts get their batch dim padded to a power-of-two
+        bucket before compilation (outputs sliced back), so serving a stream
+        of ragged batch sizes costs O(log B) compiles, not one per size."""
+        import time as _time
+
+        from ..observability.instrument import record_compile
+
         if inputs is not None:
             for n, a in zip(self._input_names, inputs):
                 self._inputs[n].copy_from_cpu(a)
         args = [self._inputs[n]._array for n in self._input_names]
+        args, real_B = self._bucket_batch(args)
         key = tuple((a.shape, str(a.dtype)) for a in args)
         call = self._compiled_cache.get(key)
-        if call is None:
+        if call is not None:
+            record_compile("predictor", cache_hit=True)
+        else:
+            _t0 = _time.perf_counter()
             if self._config._options.get("ir_optim", True):
                 # analysis-pass pipeline (AnalysisPredictor's IrAnalysisPass
                 # analog): trace -> inference passes -> re-emit -> compile.
@@ -156,9 +201,15 @@ class Predictor:
                     call = None  # opaque/untraceable model: direct path below
             if call is None:
                 call = jax.jit(self._layer._call).lower(*args).compile()
+            record_compile("predictor", seconds=_time.perf_counter() - _t0,
+                           cache_hit=False)
             self._compiled_cache[key] = call
         outs = call(*args)
         outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        if real_B is not None:
+            padded = args[0].shape[0]
+            outs = [o[:real_B] if getattr(o, "ndim", 0) >= 1
+                    and o.shape[0] == padded else o for o in outs]
         self._output_names = [f"output_{i}" for i in range(len(outs))]
         self._outputs = {}
         results = []

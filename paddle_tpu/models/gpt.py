@@ -128,7 +128,7 @@ class GPTAttention(Layer):
         self.proj = RowParallelLinear(cfg.hidden_size, cfg.hidden_size, input_is_parallel=True)
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def forward(self, x):
+    def forward(self, x, kv_cache=None, cache_positions=None, return_kv=False):
         B, S = x.shape[0], x.shape[1]
         cfg = self.cfg
         from ..distributed.sharding_utils import ambient_axis_names
@@ -136,6 +136,9 @@ class GPTAttention(Layer):
 
         qkv = self.qkv(x)  # [B, S, (H + 2*Hkv)*D/mp] sharded on last dim
         Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        if return_kv or kv_cache is not None:
+            return self._serving_forward(qkv, B, S, kv_cache, cache_positions,
+                                         return_kv)
         # heads over mp; seq stays sharded over sep when the axis is active
         # (gathering full-S here would defeat context parallelism's memory)
         seq_axis = "sep" if "sep" in ambient_axis_names() else None
@@ -194,6 +197,58 @@ class GPTAttention(Layer):
             )
         out = out.reshape([B, S, cfg.hidden_size])
         return self.dropout(self.proj(out))
+
+    def _serving_forward(self, qkv, B, S, kv_cache, cache_positions,
+                         return_kv):
+        """KV-cache serving paths over the same mp-sharded projections.
+
+        Prefill (``return_kv=True``): ordinary causal attention over the
+        (padded) prompt, plus this layer's K/V in cache layout
+        ``[B, H_kv, S, D]`` for the engine to install in its static cache.
+        Decode (``kv_cache=(k, v)`` each ``[B, H_kv, S_max, D]``): write the
+        incoming token's K/V at ``cache_positions`` and attend the valid
+        prefix through serving.kv_cache's shared decode helpers (the same
+        math FusedMultiTransformer's time_step path uses)."""
+        from ..ops._dispatch import apply, as_tensor
+        from ..serving import kv_cache as _kvc
+
+        cfg = self.cfg
+        Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        q = qkv[:, :, :Hq * D].reshape([B, S, Hq, D])
+        k = qkv[:, :, Hq * D:(Hq + Hkv) * D].reshape([B, S, Hkv, D])
+        v = qkv[:, :, (Hq + Hkv) * D:].reshape([B, S, Hkv, D])
+        if return_kv:
+            rep = Hq // Hkv
+
+            def _expand(tv):
+                tv = jnp.broadcast_to(tv[:, :, :, None, :],
+                                      (B, S, Hkv, rep, D))
+                return tv.reshape(B, S, Hq, D)
+
+            k_att = apply("gqa_expand", _expand, k) if rep > 1 else k
+            v_att = apply("gqa_expand", _expand, v) if rep > 1 else v
+            out = F.scaled_dot_product_attention(
+                q, k_att, v_att, is_causal=True, training=False)
+            kv = apply("serving_kv_layout",
+                       lambda kv_, vv: (kv_.transpose(0, 2, 1, 3),
+                                        vv.transpose(0, 2, 1, 3)), k, v)
+            out = out.reshape([B, S, cfg.hidden_size])
+            return self.dropout(self.proj(out)), tuple(kv)
+
+        kc, vc = kv_cache
+
+        def _decode(qv, kv_, vv, kcv, vcv, posv):
+            qT = qv.transpose(0, 2, 1, 3)   # [B, Hq, 1, D]
+            kc2 = _kvc.write_kv(kcv, kv_.transpose(0, 2, 1, 3), posv)
+            vc2 = _kvc.write_kv(vcv, vv.transpose(0, 2, 1, 3), posv)
+            o = _kvc.decode_attend(qT, kc2, vc2, posv)
+            return o.transpose(0, 2, 1, 3), kc2, vc2
+
+        o, kc2, vc2 = apply("serving_decode_attn", _decode, q, k, v,
+                            as_tensor(kc), as_tensor(vc),
+                            as_tensor(cache_positions))
+        out = o.reshape([B, S, cfg.hidden_size])
+        return self.dropout(self.proj(out)), (kc2, vc2)
 
 
 class GPTMLP(Layer):
@@ -277,8 +332,15 @@ class GPTBlock(Layer):
         self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
         self.mlp = GPTMoEMLP(cfg) if use_moe else GPTMLP(cfg)
 
-    def forward(self, x):
+    def forward(self, x, kv_cache=None, cache_positions=None, return_kv=False):
         x = maybe_shard(x, _seq_spec(self.cfg))
+        if return_kv or kv_cache is not None:
+            a, kv = self.attn(self.ln1(x), kv_cache=kv_cache,
+                              cache_positions=cache_positions,
+                              return_kv=return_kv)
+            x = x + a
+            x = x + self.mlp(self.ln2(x))
+            return maybe_shard(x, _seq_spec(self.cfg)), kv
         x = x + self.attn(self.ln1(x))
         x = x + self.mlp(self.ln2(x))
         return maybe_shard(x, _seq_spec(self.cfg))
@@ -333,7 +395,22 @@ class GPTModel(Layer):
             elif "bias" in name:
                 p._set_value_raw(jnp.zeros_like(p._value))
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, kv_caches=None,
+                cache_positions=None, return_kv=False):
+        if return_kv or kv_caches is not None:
+            # serving paths: thread per-layer KV through the block stack
+            # (prefill returns the prompt's K/V; decode updates the static
+            # cache). Inference-only — recompute/MoE-aux machinery is the
+            # training loop's concern.
+            h = self.embeddings(input_ids, position_ids)
+            kvs = []
+            for i, block in enumerate(self.layers):
+                cache_i = kv_caches[i] if kv_caches is not None else None
+                h, kv = block(h, kv_cache=cache_i,
+                              cache_positions=cache_positions,
+                              return_kv=return_kv)
+                kvs.append(kv)
+            return self.final_ln(h), kvs
         h = self.embeddings(input_ids, position_ids)
         aux = None
         for i, block in enumerate(self.layers):
@@ -478,42 +555,69 @@ class GPTForCausalLM(Layer):
             self, self.gpt.layers[0], "gpt.layers", self.cfg.num_layers,
             context_parallel=True)  # GPTAttention handles manual-sep shards
 
-    def generate(self, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
-                 temperature: float = 1.0, top_k: int = 0, eos_token_id=None):
-        """Autoregressive decoding (PaddleNLP GenerationMixin.generate's
-        greedy/sampling core). Each step runs the causal forward on the grown
-        prefix — positions before the new token are unaffected by the causal
-        mask, so this is exact; the KV-cached fast path for serving is
-        incubate.nn.FusedMultiTransformer's time_step decode."""
-        import jax
-
-        from ..core import random as _random
-        from ..core.autograd import no_grad
+    # ---- serving decode protocol (paddle_tpu/serving engine) ----
+    def prefill_with_cache(self, input_ids, lengths=None, position_ids=None):
+        """Serving prefill: one causal forward over the (right-padded)
+        prompt that also returns each layer's K/V in cache layout
+        ``[B, H_kv, T, D]``. ``lengths`` (``[B]`` ints, or None for the full
+        width) selects each row's LAST REAL token; returns
+        ``(last_logits [B, V], kvs)``. Padding rows beyond a row's length
+        produce garbage K/V, but the decode mask (``key_pos <= position``)
+        never reads a padded position before a real token overwrites it."""
         from ..ops._dispatch import as_tensor
 
         ids = as_tensor(input_ids)
-        B = ids.shape[0]
-        finished = jnp.zeros((B,), bool)
-        with no_grad():
-            for _ in range(max_new_tokens):
-                logits = self.forward(ids)._value[:, -1]  # [B, V]
-                if do_sample:
-                    logits = logits / jnp.maximum(jnp.float32(temperature), 1e-6)
-                    if top_k and top_k > 0:
-                        k_eff = min(int(top_k), logits.shape[-1])  # >= vocab = no filter
-                        kth = jnp.sort(logits, axis=-1)[:, -k_eff][:, None]
-                        logits = jnp.where(logits < kth, -1e30, logits)
-                    nxt = jax.random.categorical(_random.next_key(), logits, axis=-1)
-                else:
-                    nxt = jnp.argmax(logits, axis=-1)
-                nxt = nxt.astype(ids._value.dtype)
-                if eos_token_id is not None:
-                    nxt = jnp.where(finished, eos_token_id, nxt)
-                    finished = finished | (nxt == eos_token_id)
-                ids = Tensor(jnp.concatenate([ids._value, nxt[:, None]], axis=1))
-                if eos_token_id is not None and bool(finished.all()):
-                    break
-        return ids
+        B, T = ids.shape[0], ids.shape[1]
+        h, kvs = self.gpt(ids, position_ids=position_ids, return_kv=True)
+        hv = h._value
+        if lengths is None:
+            h_last = hv[:, T - 1:T]
+        else:
+            idx = jnp.clip(
+                as_tensor(lengths)._value.astype(jnp.int32) - 1, 0, T - 1)
+            h_last = jnp.take_along_axis(hv, idx[:, None, None], axis=1)
+        logits = self._logits(Tensor(h_last))  # [B, 1, V]
+        return Tensor(logits._value[:, 0]), kvs
+
+    def decode_step(self, tokens, kv_caches, positions):
+        """One static-shape cached decode step: ``tokens`` ``[B]`` (or
+        ``[B, 1]``) int ids, ``kv_caches`` a per-layer list of ``(k, v)``
+        each ``[B, H_kv, S_max, D]``, ``positions`` ``[B]`` — the sequence
+        index each row's token is written at. Returns
+        ``(logits [B, V], new_caches)``; functionally pure, so the serving
+        engine jit-compiles it once and reuses the executable every token."""
+        from ..ops._dispatch import as_tensor
+
+        idv = as_tensor(tokens)._value
+        if idv.ndim == 1:
+            idv = idv[:, None]
+        pos = as_tensor(positions)._value.astype(jnp.int32)
+        if pos.ndim == 0:
+            pos = jnp.broadcast_to(pos, (idv.shape[0],))
+        # position embedding indices clamp at the table edge, matching
+        # jnp's clamping gather the grown-prefix path relied on implicitly
+        position_ids = Tensor(jnp.clip(pos, 0, self.cfg.max_seq_len - 1)[:, None])
+        caches = [(as_tensor(k), as_tensor(v)) for k, v in kv_caches]
+        h, new = self.gpt(Tensor(idv), position_ids=position_ids,
+                          kv_caches=caches, cache_positions=Tensor(pos))
+        logits = self._logits(h)  # [B, 1, V]
+        return Tensor(logits._value[:, -1]), new
+
+    def generate(self, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
+                 temperature: float = 1.0, top_k: int = 0, eos_token_id=None):
+        """Autoregressive decoding (PaddleNLP GenerationMixin.generate's
+        greedy/sampling core). Runs on the serving decode core
+        (paddle_tpu/serving): one bucketed prefill + a single-token decode
+        step over a static KV cache — one prefill compile + one decode
+        compile total, instead of the old grown-prefix forward that
+        re-compiled every emitted token. API and greedy/temperature/top-k/
+        forced-eos semantics are unchanged."""
+        from ..serving.engine import cached_generate
+
+        return cached_generate(
+            self, input_ids, max_new_tokens=max_new_tokens,
+            do_sample=do_sample, temperature=temperature, top_k=top_k,
+            eos_token_id=eos_token_id)
 
 
 def gpt_tiny(**overrides) -> GPTForCausalLM:

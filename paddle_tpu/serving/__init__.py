@@ -1,0 +1,35 @@
+"""TPU-native LLM serving: static-shape KV-cache decode + continuous batching.
+
+Public surface:
+
+- :class:`Engine` / :class:`EngineConfig` — offline/online serving engine
+  with slot-based continuous batching over a preallocated KV cache.
+- :class:`SamplingParams` — per-request decoding controls.
+- :class:`Request` / :class:`Scheduler` — FIFO queue + slot table.
+- :class:`KVCache`, :func:`write_kv`, :func:`decode_attend` — the shared
+  static-cache write/attend primitives (also used by
+  ``incubate.nn.FusedMultiTransformer``'s ``time_step`` decode).
+- :func:`cached_generate` — the static-shape decode loop
+  ``models.gpt.GPTForCausalLM.generate`` delegates to.
+
+See ``paddle_tpu/serving/README.md`` for the design and metric names.
+"""
+
+from __future__ import annotations
+
+from .engine import Engine, EngineConfig, cached_generate  # noqa: F401
+from .kv_cache import KVCache, decode_attend, write_kv  # noqa: F401
+from .sampling import SamplingParams  # noqa: F401
+from .scheduler import Request, Scheduler  # noqa: F401
+
+__all__ = [
+    "Engine",
+    "EngineConfig",
+    "KVCache",
+    "Request",
+    "SamplingParams",
+    "Scheduler",
+    "cached_generate",
+    "decode_attend",
+    "write_kv",
+]

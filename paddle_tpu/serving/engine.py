@@ -1,0 +1,432 @@
+"""TPU-native LLM serving engine: static-shape decode + continuous batching.
+
+The engine composes three static-shape compiled executables over a
+preallocated KV cache (kv_cache.KVCache):
+
+- **bucketed prefill** — one AOT-compiled executable per prompt-length
+  bucket (powers of two up to ``max_seq_len``): the padded prompt runs the
+  causal forward once, its K/V land in the request's cache slot, and the
+  last real token's logits come back for the first sampled token (TTFT).
+- **decode step** — ONE executable for the whole engine lifetime: a
+  ``[B_max]`` batch of single tokens with per-row positions scatters into
+  the cache and attends over each row's valid prefix. Per-request
+  SamplingParams ride as device arrays (sampling.sample_batched), so an
+  arbitrary mix of greedy/sampled requests never triggers a recompile.
+- **cached_generate** — the batch decode loop ``GPTForCausalLM.generate``
+  now delegates to: same API/semantics as the old grown-prefix loop, but
+  one prefill compile + one decode compile total (asserted via the
+  ``jit.compile.cache_miss{site=serving.*}`` observability counters).
+
+Everything is AOT-compiled (``jax.jit(fn).lower(...).compile()``): a shape
+drift raises instead of silently recompiling per token — the property the
+regression test in tests/test_serving.py pins down.
+"""
+
+from __future__ import annotations
+
+import time
+import weakref
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core import random as _random
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from ..observability import instrument as _obs
+from ..observability import metrics as _metrics
+from . import sampling as _sampling
+from .kv_cache import KVCache
+from .sampling import SamplingParams
+from .scheduler import Request, Scheduler
+
+_DUMMY_KEY = None
+
+
+def _dummy_key():
+    """Placeholder PRNG key for greedy-only compiled signatures (the arg is
+    dead code under argmax; keeping the signature uniform avoids a second
+    decode executable)."""
+    global _DUMMY_KEY
+    if _DUMMY_KEY is None:
+        _DUMMY_KEY = jax.random.PRNGKey(0)
+    return _DUMMY_KEY
+
+
+def _aot(cache: Dict, key, site: str, fn, args) -> "jax.stages.Compiled":
+    """AOT compile-or-fetch with observability accounting: a dict hit bumps
+    ``jit.compile.cache_hit{site=}``, a miss compiles (timed into
+    ``jit.compile.seconds{site=}``) and bumps the miss counter. The
+    compiled executable is shape-locked — drifting shapes raise rather
+    than recompile, which is what makes the one-compile guarantee
+    testable."""
+    exe = cache.get(key)
+    if exe is not None:
+        _obs.record_compile(site, cache_hit=True)
+        return exe
+    t0 = time.perf_counter()
+    exe = jax.jit(fn).lower(*args).compile()
+    _obs.record_compile(site, seconds=time.perf_counter() - t0,
+                        cache_hit=False)
+    cache[key] = exe
+    return exe
+
+
+def _param_dtype(params: Dict[str, jax.Array]):
+    for v in params.values():
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            return v.dtype
+    return jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# Batch decode loop: the static-shape core GPTForCausalLM.generate rides on.
+# ---------------------------------------------------------------------------
+
+_GEN_EXE_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def cached_generate(model, input_ids, *, max_new_tokens: int = 32,
+                    do_sample: bool = False, temperature: float = 1.0,
+                    top_k: int = 0, eos_token_id=None):
+    """Autoregressive decoding over a static KV cache — the drop-in body of
+    ``GPTForCausalLM.generate`` (same API, same greedy/temperature/top-k
+    and forced-eos-fill semantics as the old grown-prefix loop), at one
+    prefill + one decode compilation instead of one compile per emitted
+    token."""
+    from ..ops._dispatch import as_tensor
+
+    ids = as_tensor(input_ids)
+    if max_new_tokens <= 0:
+        return ids
+    idsv = ids._value
+    B, S = int(idsv.shape[0]), int(idsv.shape[1])
+    cfg = model.cfg
+    S_max = S + max_new_tokens
+    params, _ = model.functional_state()
+    dt = _param_dtype(params)
+    L, Hkv, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+    kc = jnp.zeros((L, B, Hkv, S_max, D), dt)
+    vc = jnp.zeros((L, B, Hkv, S_max, D), dt)
+
+    exe_cache = _GEN_EXE_CACHE.setdefault(model, {})
+    tok_dtype = idsv.dtype
+
+    def prefill_fn(p, kc, vc, ids):
+        with no_grad():
+            (logits, kvs), _ = model.functional_call(
+                p, {}, Tensor(ids), method="prefill_with_cache")
+        knew = jnp.stack([k._value for k, _ in kvs])   # [L, B, Hkv, S, D]
+        vnew = jnp.stack([v._value for _, v in kvs])
+        zero = jnp.zeros((), jnp.int32)
+        kc = lax.dynamic_update_slice(kc, knew.astype(kc.dtype),
+                                      (zero,) * 5)
+        vc = lax.dynamic_update_slice(vc, vnew.astype(vc.dtype),
+                                      (zero,) * 5)
+        return logits._value, kc, vc
+
+    pkey = ("prefill", B, S, S_max, str(tok_dtype), str(dt))
+    prefill = _aot(exe_cache, pkey, "serving.prefill", prefill_fn,
+                   (params, kc, vc, idsv))
+
+    def decode_fn(p, kc, vc, tokens, positions, key):
+        caches = [(kc[l], vc[l]) for l in range(L)]
+        with no_grad():
+            (logits, new), _ = model.functional_call(
+                p, {}, Tensor(tokens), caches, Tensor(positions),
+                method="decode_step")
+        kc2 = jnp.stack([k._value for k, _ in new])
+        vc2 = jnp.stack([v._value for _, v in new])
+        nxt = _sampling.sample_static(
+            logits._value, key, do_sample=do_sample,
+            temperature=temperature, top_k=top_k)
+        return nxt.astype(tokens.dtype), kc2, vc2
+
+    dkey = ("decode", B, S_max, str(tok_dtype), str(dt),
+            do_sample, float(temperature), int(top_k))
+    tok0 = jnp.zeros((B,), tok_dtype)
+    pos0 = jnp.full((B,), S - 1, jnp.int32)
+    decode = _aot(exe_cache, dkey, "serving.decode", decode_fn,
+                  (params, kc, vc, tok0, pos0, _dummy_key()))
+
+    logits0, kc, vc = prefill(params, kc, vc, idsv)
+    finished = np.zeros((B,), bool)
+    toks: List[np.ndarray] = []
+    key = _random.next_key() if do_sample else _dummy_key()
+    nxt = np.asarray(_sampling.sample_static(
+        logits0, key, do_sample=do_sample, temperature=temperature,
+        top_k=top_k)).astype(np.asarray(idsv).dtype)
+    for i in range(max_new_tokens):
+        if i > 0:
+            pos = jnp.full((B,), S - 1 + i, jnp.int32)
+            key = _random.next_key() if do_sample else _dummy_key()
+            nxt_dev, kc, vc = decode(params, kc, vc, jnp.asarray(toks[-1]),
+                                     pos, key)
+            nxt = np.asarray(nxt_dev)
+        if eos_token_id is not None:
+            nxt = np.where(finished, eos_token_id, nxt).astype(nxt.dtype)
+            finished = finished | (nxt == eos_token_id)
+        toks.append(nxt)
+        if eos_token_id is not None and bool(finished.all()):
+            break
+    out = np.concatenate([np.asarray(idsv)]
+                         + [t[:, None] for t in toks], axis=1)
+    return Tensor(jnp.asarray(out))
+
+
+# ---------------------------------------------------------------------------
+# Continuous-batching engine
+# ---------------------------------------------------------------------------
+
+@dataclass
+class EngineConfig:
+    """Static serving envelope, fixed at engine construction (the shapes
+    every compiled executable is locked to)."""
+
+    max_batch_size: int = 4      # decode slots (B_max)
+    max_seq_len: int = 128       # per-slot prompt + generation budget (S_max)
+    prefill_buckets: Optional[Tuple[int, ...]] = None  # default: pow2 <= S_max
+    cache_dtype: Optional[str] = None  # default: the model's param dtype
+
+    def __post_init__(self):
+        if self.prefill_buckets is None:
+            buckets = []
+            b = 8
+            while b < self.max_seq_len:
+                buckets.append(b)
+                b *= 2
+            buckets.append(self.max_seq_len)
+            self.prefill_buckets = tuple(buckets)
+        else:
+            self.prefill_buckets = tuple(sorted(set(self.prefill_buckets)))
+
+
+class _SlotState:
+    __slots__ = ("request",)
+
+    def __init__(self, request=None):
+        self.request = request
+
+
+class Engine:
+    """Offline/online LLM serving engine over a cache-aware causal LM.
+
+    The model must speak the decode protocol GPTForCausalLM implements:
+    ``cfg`` (num_layers / num_kv_heads / head_dim / max_seq_len),
+    ``functional_state()``, and the ``prefill_with_cache`` /
+    ``decode_step`` methods (callable through ``functional_call``).
+
+        engine = Engine(model, max_batch_size=4, max_seq_len=128)
+        outputs = engine.generate([[5, 17, 3], [9, 2]],
+                                  SamplingParams(max_new_tokens=16))
+
+    Request flow: ``add_request`` queues; each ``step()`` first admits
+    waiting requests into any free KV-cache slots (prefill + first token —
+    continuous batching: admission happens the moment a slot frees, between
+    decode steps), then runs ONE batched decode step for every running
+    request. All serving metrics are flag-gated through
+    ``paddle_tpu.observability`` (see serving/README.md for the names).
+    """
+
+    def __init__(self, model, config: Optional[EngineConfig] = None, **kw):
+        self.model = model
+        model.eval()
+        self.config = config or EngineConfig(**kw)
+        cfg = model.cfg
+        if self.config.max_seq_len > cfg.max_seq_len:
+            raise ValueError(
+                f"engine max_seq_len {self.config.max_seq_len} exceeds the "
+                f"model's position table ({cfg.max_seq_len})")
+        self.params, _ = model.functional_state()
+        dt = (self.config.cache_dtype if self.config.cache_dtype is not None
+              else _param_dtype(self.params))
+        B, S_max = self.config.max_batch_size, self.config.max_seq_len
+        self.cache = KVCache(cfg.num_layers, B, cfg.num_kv_heads, S_max,
+                             cfg.head_dim, dt)
+        _metrics.gauge("serving.kv_cache.bytes", self.cache.nbytes)
+        self.scheduler = Scheduler(B)
+        self._slots: List[_SlotState] = [_SlotState() for _ in range(B)]
+        # vectorized per-slot decode state (device args rebuilt per step)
+        self._tokens = np.zeros((B,), np.int32)
+        self._positions = np.zeros((B,), np.int32)
+        self._temps = np.ones((B,), np.float32)
+        self._top_ks = np.zeros((B,), np.int32)
+        self._greedy = np.ones((B,), bool)
+        self._exe: Dict = {}
+
+    # -- request API --
+    def add_request(self, prompt_ids: Sequence[int],
+                    sampling: Optional[SamplingParams] = None) -> Request:
+        req = Request(prompt_ids, sampling)
+        if len(req.prompt_ids) >= self.config.max_seq_len:
+            raise ValueError(
+                f"prompt of {len(req.prompt_ids)} tokens leaves no room to "
+                f"generate within max_seq_len={self.config.max_seq_len}")
+        self.scheduler.add(req)
+        return req
+
+    @property
+    def has_unfinished(self) -> bool:
+        return self.scheduler.has_unfinished
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 sampling: Union[SamplingParams, Sequence[SamplingParams],
+                                 None] = None) -> List[List[int]]:
+        """Offline convenience: queue every prompt, run steps to drain, and
+        return each prompt's generated token ids (prompt excluded), in
+        order."""
+        if isinstance(sampling, SamplingParams) or sampling is None:
+            sampling = [sampling] * len(prompts)
+        if len(sampling) != len(prompts):
+            raise ValueError("len(sampling) != len(prompts)")
+        reqs = [self.add_request(p, sp) for p, sp in zip(prompts, sampling)]
+        t0 = time.perf_counter()
+        while self.scheduler.has_unfinished:
+            self.step()
+        elapsed = time.perf_counter() - t0
+        total = sum(r.num_generated for r in reqs)
+        if elapsed > 0:
+            _metrics.gauge("serving.tokens_per_sec", total / elapsed)
+        return [r.output_ids for r in reqs]
+
+    # -- engine loop --
+    def step(self):
+        """One scheduler iteration: admit waiting requests into free slots
+        (bucketed prefill + first token each), then one batched decode step
+        over every running request."""
+        self._admit()
+        self._decode()
+
+    # -- internals --
+    def _bucket(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if b >= n:
+                return b
+        return self.config.max_seq_len
+
+    def _prefill_exe(self, T: int):
+        model, L = self.model, self.cache.num_layers
+
+        def prefill_fn(p, kc, vc, ids, slot, length):
+            with no_grad():
+                (logits, kvs), _ = model.functional_call(
+                    p, {}, Tensor(ids), method="prefill_with_cache",
+                    lengths=Tensor(length[None]))
+            knew = jnp.stack([k._value for k, _ in kvs])  # [L, 1, Hkv, T, D]
+            vnew = jnp.stack([v._value for _, v in kvs])
+            zero = jnp.zeros((), jnp.int32)
+            start = (zero, slot, zero, zero, zero)
+            kc = lax.dynamic_update_slice(kc, knew.astype(kc.dtype), start)
+            vc = lax.dynamic_update_slice(vc, vnew.astype(vc.dtype), start)
+            return logits._value, kc, vc
+
+        args = (self.params, self.cache.k, self.cache.v,
+                jnp.zeros((1, T), jnp.int32), jnp.int32(0), jnp.int32(1))
+        return _aot(self._exe, ("prefill", T), "serving.prefill",
+                    prefill_fn, args)
+
+    def _decode_exe(self):
+        model, L = self.model, self.cache.num_layers
+
+        def decode_fn(p, kc, vc, tokens, positions, temps, top_ks, greedy,
+                      key):
+            caches = [(kc[l], vc[l]) for l in range(L)]
+            with no_grad():
+                (logits, new), _ = model.functional_call(
+                    p, {}, Tensor(tokens), caches, Tensor(positions),
+                    method="decode_step")
+            kc2 = jnp.stack([k._value for k, _ in new])
+            vc2 = jnp.stack([v._value for _, v in new])
+            nxt = _sampling.sample_batched(logits._value, key, temps,
+                                           top_ks, greedy)
+            return nxt.astype(jnp.int32), kc2, vc2
+
+        B = self.config.max_batch_size
+        args = (self.params, self.cache.k, self.cache.v,
+                jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
+                jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.int32),
+                jnp.ones((B,), bool), _dummy_key())
+        return _aot(self._exe, ("decode",), "serving.decode", decode_fn, args)
+
+    def _admit(self):
+        while self.cache.free_slots and self.scheduler.waiting:
+            req = self.scheduler.next_waiting()
+            slot = self.cache.alloc_slot()
+            req.slot = slot
+            sp = req.sampling
+            t0 = time.perf_counter()
+            n = len(req.prompt_ids)
+            T = self._bucket(n)
+            ids = np.zeros((1, T), np.int32)
+            ids[0, :n] = req.prompt_ids
+            exe = self._prefill_exe(T)
+            logits, self.cache.k, self.cache.v = exe(
+                self.params, self.cache.k, self.cache.v, jnp.asarray(ids),
+                jnp.int32(slot), jnp.int32(n))
+            key = _random.next_key() if sp.do_sample else _dummy_key()
+            tok = int(np.asarray(_sampling.sample_static(
+                logits, key, do_sample=sp.do_sample,
+                temperature=sp.temperature, top_k=sp.top_k))[0])
+            now = time.perf_counter()
+            req.first_token_time = now
+            _metrics.histogram("serving.prefill.seconds", now - t0)
+            _metrics.histogram("serving.ttft.seconds", now - req.arrival_time)
+            _metrics.counter("serving.tokens.generated", 1)
+            self._slots[slot].request = req
+            self._tokens[slot] = tok
+            self._positions[slot] = n  # first generated token's index
+            self._temps[slot] = sp.temperature
+            self._top_ks[slot] = sp.top_k
+            self._greedy[slot] = not sp.do_sample
+            req.output_ids.append(tok)
+            self._maybe_finish(req, tok)
+
+    def _decode(self):
+        running = [s.request for s in self._slots if s.request is not None]
+        if not running:
+            return
+        t0 = time.perf_counter()
+        any_sampled = not bool(self._greedy.all())
+        key = _random.next_key() if any_sampled else _dummy_key()
+        exe = self._decode_exe()
+        nxt, self.cache.k, self.cache.v = exe(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(self._tokens), jnp.asarray(self._positions),
+            jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+            jnp.asarray(self._greedy), key)
+        nxt = np.asarray(nxt)
+        _metrics.histogram("serving.decode.step.seconds",
+                           time.perf_counter() - t0)
+        _metrics.counter("serving.tokens.generated", len(running))
+        for req in running:
+            slot = req.slot
+            tok = int(nxt[slot])
+            req.output_ids.append(tok)
+            self._tokens[slot] = tok
+            self._positions[slot] += 1
+            self._maybe_finish(req, tok)
+
+    def _maybe_finish(self, req: Request, tok: int):
+        sp = req.sampling
+        reason = None
+        if sp.eos_token_id is not None and tok == sp.eos_token_id:
+            reason = "eos"
+        elif req.num_generated >= sp.max_new_tokens:
+            reason = "length"
+        elif len(req.prompt_ids) + req.num_generated >= self.config.max_seq_len:
+            reason = "cache_full"  # next token would fall off the cache
+        if reason is None:
+            return
+        slot = req.slot
+        self.scheduler.finish(req, reason)
+        self._slots[slot].request = None
+        self._tokens[slot] = 0
+        self._positions[slot] = 0
+        self._temps[slot] = 1.0
+        self._top_ks[slot] = 0
+        self._greedy[slot] = True
+        self.cache.free_slot(slot)

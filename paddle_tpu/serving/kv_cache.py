@@ -1,0 +1,138 @@
+"""Static-shape KV cache: the serving engine's HBM-resident decode state.
+
+The cache is preallocated at engine construction — per layer a
+``[B_max, H_kv, S_max, D]`` K and V buffer (GQA: ``H_kv < H_q`` shrinks it by
+the query/KV head ratio) — so every prefill and every decode step runs at a
+FIXED shape: XLA compiles the prefill once per prompt bucket and the decode
+step exactly once, no matter how many tokens or requests flow through.
+
+The write/attend helpers here are the SHARED decode path: both the GPT
+serving engine (paddle_tpu/serving/engine.py) and
+``incubate.nn.FusedMultiTransformer``'s ``time_step`` decode route through
+them, so the two cached-attention implementations cannot drift.
+
+Numerics deliberately mirror ``nn.functional._sdpa_ref`` (pre-scaled q,
+f32 logits, -1e30 masking, f32 softmax) so cached decode logits match the
+full-prefix causal forward within float tolerance — asserted by
+tests/test_serving.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_NEG_INF = jnp.float32(-1e30)
+
+
+def write_kv(cache, new, positions):
+    """Write new K (or V) entries into a ``[B, H_kv, S_max, D]`` cache.
+
+    ``positions`` scalar: contiguous write of ``new [B, H_kv, T, D]``
+    starting at that sequence index (the prefill / shared-step case —
+    ``lax.dynamic_update_slice``, batch must match the cache's).
+    ``positions`` ``[B]``: per-row single-token scatter of
+    ``new [B, H_kv, 1, D]`` at each row's own index (the continuous-batching
+    decode case, where slots sit at different sequence positions).
+    """
+    new = new.astype(cache.dtype)
+    positions = jnp.asarray(positions)
+    if positions.ndim == 0:
+        zero = jnp.zeros((), positions.dtype)
+        return lax.dynamic_update_slice(cache, new, (zero, zero, positions, zero))
+    B = cache.shape[0]
+    return cache.at[jnp.arange(B), :, positions, :].set(new[:, :, 0, :])
+
+
+def _expand_kv_heads(t, rep: int):
+    """GQA: broadcast [B, H_kv, S, D] -> [B, H_kv*rep, S, D]. A broadcast
+    (insert group dim + reshape), not repeat: XLA keeps it fused into the
+    attention einsums instead of materializing full-width K/V."""
+    if rep == 1:
+        return t
+    B, Hkv, S, D = t.shape
+    return jnp.broadcast_to(t[:, :, None], (B, Hkv, rep, S, D)).reshape(
+        B, Hkv * rep, S, D)
+
+
+def decode_attend(q, k_cache, v_cache, positions):
+    """Single-position cached attention: q ``[B, H_q, T, D]`` (T=1 in
+    decode) against the full static cache ``[B, H_kv, S_max, D]``, masked to
+    the valid prefix ``key_pos <= positions`` (scalar or per-row ``[B]``).
+
+    Matches _sdpa_ref numerics: q pre-scaled in its own dtype, f32 scores,
+    f32 softmax, output cast back to v's dtype.
+    """
+    D = q.shape[-1]
+    rep = q.shape[1] // k_cache.shape[1]
+    k = _expand_kv_heads(k_cache, rep)
+    v = _expand_kv_heads(v_cache, rep)
+    qf = (q * (1.0 / np.sqrt(D))).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, k,
+                   preferred_element_type=jnp.float32)
+    pos = jnp.asarray(positions)
+    key_pos = jnp.arange(k_cache.shape[2])
+    if pos.ndim == 0:
+        valid = key_pos[None, None, None, :] <= pos
+    else:
+        valid = key_pos[None, None, None, :] <= pos[:, None, None, None]
+    s = jnp.where(valid, s, _NEG_INF)
+    probs = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+class KVCache:
+    """Preallocated stacked K/V buffers ``[L, B_max, H_kv, S_max, D]`` plus
+    slot bookkeeping for the continuous-batching scheduler.
+
+    The arrays are plain device buffers handed in and out of the engine's
+    compiled prefill/decode executables (functional updates — the engine
+    reassigns ``.k``/``.v`` after every step). Slot allocation is host-side:
+    a freed slot is immediately reusable because its next prefill overwrites
+    positions ``[0, T)`` before any decode reads them.
+    """
+
+    def __init__(self, num_layers: int, max_batch_size: int,
+                 num_kv_heads: int, max_seq_len: int, head_dim: int,
+                 dtype="float32"):
+        self.num_layers = num_layers
+        self.max_batch_size = max_batch_size
+        self.num_kv_heads = num_kv_heads
+        self.max_seq_len = max_seq_len
+        self.head_dim = head_dim
+        shape = (num_layers, max_batch_size, num_kv_heads, max_seq_len, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self._free: List[int] = list(range(max_batch_size))[::-1]
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.k.size * self.k.dtype.itemsize * 2)
+
+    def alloc_slot(self) -> Optional[int]:
+        """Lowest free slot index, or None when the batch is full."""
+        return self._free.pop() if self._free else None
+
+    def free_slot(self, slot: int):
+        self._free.append(slot)
+        self._free.sort(reverse=True)
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_slots(self) -> int:
+        return self.max_batch_size - len(self._free)
+
+    def layer_caches(self, k=None, v=None) -> List[Tuple[jax.Array, jax.Array]]:
+        """Per-layer (k, v) view of the stacked buffers — the pytree shape
+        GPTForCausalLM.decode_step consumes. Static python indexing, so it
+        is free under a trace."""
+        k = self.k if k is None else k
+        v = self.v if v is None else v
+        return [(k[l], v[l]) for l in range(self.num_layers)]

@@ -1,0 +1,106 @@
+"""Continuous-batching request scheduler (vLLM/Orca-style iteration-level
+scheduling, reduced to the static-slot model the TPU decode core wants).
+
+Requests queue FIFO; the engine admits one into a KV-cache slot the moment
+the slot frees — mid-run, between decode steps — instead of waiting for the
+whole batch to drain (the static-batching failure mode where one long
+generation holds B-1 idle slots hostage). Queue depth / slot occupancy are
+exported through paddle_tpu.observability when FLAGS_observability is on.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from typing import Deque, List, Optional
+
+from ..observability import metrics as _metrics
+from .sampling import SamplingParams
+
+QUEUED = "queued"
+RUNNING = "running"
+FINISHED = "finished"
+
+_req_counter = itertools.count()
+
+
+class Request:
+    """One generation request: prompt ids + SamplingParams + accumulated
+    output. ``finish_reason`` is ``eos`` | ``length`` | ``cache_full``."""
+
+    def __init__(self, prompt_ids, sampling: Optional[SamplingParams] = None,
+                 request_id: Optional[int] = None):
+        self.request_id = next(_req_counter) if request_id is None else request_id
+        self.prompt_ids = [int(t) for t in prompt_ids]
+        if not self.prompt_ids:
+            raise ValueError("empty prompt")
+        self.sampling = sampling or SamplingParams()
+        self.output_ids: List[int] = []
+        self.state = QUEUED
+        self.finish_reason: Optional[str] = None
+        self.slot: Optional[int] = None
+        # timing (host clocks; feed the ttft/tpot histograms)
+        self.arrival_time = time.perf_counter()
+        self.first_token_time: Optional[float] = None
+        self.finish_time: Optional[float] = None
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.output_ids)
+
+    def __repr__(self):
+        return (f"Request(id={self.request_id}, state={self.state}, "
+                f"prompt={len(self.prompt_ids)} toks, "
+                f"generated={self.num_generated})")
+
+
+class Scheduler:
+    """FIFO waiting queue + fixed slot table of size ``num_slots``."""
+
+    def __init__(self, num_slots: int):
+        self.num_slots = num_slots
+        self.waiting: Deque[Request] = deque()
+        self.running: List[Request] = []
+
+    def add(self, request: Request):
+        request.state = QUEUED
+        self.waiting.append(request)
+        _metrics.counter("serving.requests", 1, event="added")
+        self._export_gauges()
+
+    def next_waiting(self) -> Optional[Request]:
+        """Pop the request the engine should admit next (None when the queue
+        is empty). The engine pairs it with a freshly allocated slot."""
+        if not self.waiting:
+            return None
+        req = self.waiting.popleft()
+        req.state = RUNNING
+        self.running.append(req)
+        self._export_gauges()
+        return req
+
+    def finish(self, request: Request, reason: str):
+        request.state = FINISHED
+        request.finish_reason = reason
+        request.finish_time = time.perf_counter()
+        self.running.remove(request)
+        _metrics.counter("serving.requests", 1, event="finished")
+        _metrics.counter("serving.finish_reason", 1, reason=reason)
+        if request.first_token_time is not None and request.num_generated > 1:
+            tpot = ((request.finish_time - request.first_token_time)
+                    / (request.num_generated - 1))
+            _metrics.histogram("serving.tpot.seconds", tpot)
+        self._export_gauges()
+
+    @property
+    def has_unfinished(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def _export_gauges(self):
+        if not _metrics.enabled():
+            return
+        _metrics.gauge("serving.queue.depth", len(self.waiting))
+        _metrics.gauge("serving.slots.active", len(self.running))
+        _metrics.gauge("serving.slots.occupancy",
+                       len(self.running) / max(1, self.num_slots))

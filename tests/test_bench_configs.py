@@ -27,4 +27,4 @@ def test_all_configs_registered():
     import bench
 
     assert set(bench.CONFIGS) == {"bert_sst2", "gpt_dp", "ernie_mp4",
-                                  "resnet50", "gpt_moe"}
+                                  "resnet50", "gpt_moe", "serving"}

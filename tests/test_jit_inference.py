@@ -83,6 +83,51 @@ def test_inference_predictor(tmp_path):
     assert out_h.copy_to_cpu().shape == (5, 3)
 
 
+def test_predictor_batch_bucketing(tmp_path):
+    """Symbolic-batch artifacts compile per power-of-two bucket, not per
+    exact batch size: 5/6/7 all land in the 8-bucket (one compile, sliced
+    outputs), and switching bucketing off keys the cache on exact shapes."""
+    paddle.seed(2)
+    net = paddle.nn.Linear(4, 3)
+    path = str(tmp_path / "bucketed")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 4], "float32", name="x")])
+
+    from paddle_tpu import inference as paddle_infer
+    from paddle_tpu import observability as obs
+
+    config = paddle_infer.Config(path + ".pdmodel")
+    predictor = paddle_infer.create_predictor(config)
+    obs.enable()
+    obs.reset()
+    try:
+        rng = np.random.RandomState(0)
+        for B in (5, 6, 7):
+            x = rng.randn(B, 4).astype(np.float32)
+            outs = predictor.run([x])
+            assert outs[0].shape == (B, 3)
+            np.testing.assert_allclose(
+                np.asarray(outs[0]), net(paddle.to_tensor(x)).numpy(),
+                rtol=1e-5, atol=1e-6)
+        c = obs.snapshot()["counters"]
+        assert c["jit.compile.cache_miss{site=predictor}"] == 1
+        assert c["jit.compile.cache_hit{site=predictor}"] == 2
+        # exact batch-8 input shares the bucket executable too
+        predictor.run([rng.randn(8, 4).astype(np.float32)])
+        assert obs.snapshot()["counters"][
+            "jit.compile.cache_hit{site=predictor}"] == 3
+    finally:
+        obs.disable()
+        obs.reset()
+
+    config2 = paddle_infer.Config(path + ".pdmodel")
+    config2.switch_batch_bucketing(False)
+    p2 = paddle_infer.create_predictor(config2)
+    for B in (3, 5):
+        out = p2.run([np.zeros((B, 4), np.float32)])[0]
+        assert out.shape == (B, 3)
+    assert len(p2._compiled_cache) == 2  # one executable per exact shape
+
+
 def test_static_save_load_inference_model(tmp_path):
     net = paddle.nn.Linear(4, 2)
     path = str(tmp_path / "static_model")
