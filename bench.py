@@ -689,6 +689,80 @@ def bench_serving():
     return out
 
 
+def bench_ckpt():
+    """Checkpoint config: save/restore latency through CheckpointManager.
+    The row's point is the async-save invariant — the step-blocking cost is
+    ONLY the device->host snapshot — demonstrated by the
+    ckpt.save.blocking_seconds vs ckpt.save.total_seconds histograms in the
+    telemetry sub-object (observability is enabled for this row; it IS the
+    row's contract)."""
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    on_tpu = _on_tpu()
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=8,
+                        num_heads=16, max_seq_len=512, dropout=0.0)
+        bsz, seq, saves = 8, 512, 4
+    else:
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64, dropout=0.0)
+        bsz, seq, saves = 2, 32, 3
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = make_sharded_train_step(model, opt)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, size=(bsz, seq), dtype=np.int32)
+    y = np.roll(x, -1, axis=1)
+    _ = float(step(x, y))  # compile + warm
+
+    was_enabled = observability.enabled()
+    observability.enable()
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            mgr = CheckpointManager(d, keep_last_n=2, async_=True)
+            for _i in range(saves):
+                _ = float(step(x, y))
+                mgr.save(step._step_i, step.state_for_checkpoint().to_tree())
+            mgr.wait_until_finished()
+            t0 = time.perf_counter()
+            tree = mgr.restore(shardings=step.checkpoint_shardings())
+            step.restore_from_checkpoint(tree)
+            restore_s = time.perf_counter() - t0
+            mgr.close()
+        snap = observability.snapshot()
+        blocking = snap["histograms"]["ckpt.save.blocking_seconds"]
+        total = snap["histograms"]["ckpt.save.total_seconds"]
+        saved_bytes = snap["counters"].get("ckpt.save.bytes", 0)
+        out = {
+            "config": "ckpt",
+            "metric": "ckpt_save_blocking_ms",
+            "value": round(blocking["avg"] * 1e3, 3),
+            "unit": "ms (device->host snapshot, the only step-blocking cost)",
+            "save_total_ms": round(total["avg"] * 1e3, 3),
+            "restore_ms": round(restore_s * 1e3, 3),
+            "ckpt_mb": round(saved_bytes / max(saves, 1) / 1e6, 2),
+            "async_overlap": round(
+                max(0.0, 1 - blocking["avg"] / total["avg"])
+                if total["avg"] else 0.0, 3),
+            "note": f"{saves} saves, keep_last_n=2, GPT "
+                    f"{_n_params(model)/1e6:.0f}M params, B={bsz} S={seq}",
+            "telemetry": observability.snapshot(),
+        }
+    finally:
+        if not was_enabled:
+            observability.disable()
+    print(json.dumps(out))
+    return out
+
+
 CONFIGS = {
     "bert_sst2": bench_bert_sst2,
     "gpt_dp": bench_gpt_dp,
@@ -696,6 +770,7 @@ CONFIGS = {
     "resnet50": bench_resnet50,
     "gpt_moe": bench_gpt_moe,
     "serving": bench_serving,
+    "ckpt": bench_ckpt,
 }
 
 

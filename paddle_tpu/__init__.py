@@ -105,6 +105,7 @@ from .core.selected_rows import SelectedRows  # noqa: F401,E402
 from .core.string_tensor import StringTensor  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import observability  # noqa: F401,E402
+from . import checkpoint  # noqa: F401,E402
 from . import serving  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import vision  # noqa: F401,E402

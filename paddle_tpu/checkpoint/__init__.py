@@ -1,0 +1,26 @@
+"""paddle_tpu.checkpoint — fault-tolerant distributed checkpointing.
+
+Async sharded save with atomic commit, integrity-checked restore, and
+restore-time resharding onto a changed mesh. See checkpoint/README.md for
+the commit protocol and manifest format.
+
+    from paddle_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager("/ckpts/run1", keep_last_n=3)   # async by default
+    mgr.save(step, train_step.state_for_checkpoint().to_tree())
+    ...
+    tree = mgr.restore()                      # latest committed step
+    train_step.restore_from_checkpoint(tree)  # bitwise-faithful resume
+"""
+
+from . import arrays, async_writer, manager, train_state  # noqa: F401
+from .arrays import load_tree, restore_array, save_tree  # noqa: F401
+from .async_writer import AsyncCheckpointError, AsyncWriter  # noqa: F401
+from .manager import CheckpointManager  # noqa: F401
+from .train_state import TrainState, is_train_state_tree  # noqa: F401
+
+__all__ = [
+    "CheckpointManager", "TrainState", "is_train_state_tree",
+    "AsyncWriter", "AsyncCheckpointError",
+    "save_tree", "load_tree", "restore_array",
+]

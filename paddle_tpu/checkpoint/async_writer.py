@@ -1,0 +1,96 @@
+"""Background checkpoint writer with loud failures.
+
+The save path splits in two: the device->host snapshot happens synchronously
+on the caller's thread (the only step-blocking cost — see
+``CheckpointManager.save``), and the disk I/O runs here, on one ordered
+worker thread per writer. Ordering matters: step N's COMMIT must not race
+step N+1's shard writes, and a single FIFO worker gives that for free.
+
+Failure contract (the fix for framework/io.py's silently-dying save thread):
+an exception in a background write is recorded and re-raised on the NEXT
+``submit``/``wait_until_finished`` call — a failed checkpoint save must
+surface in the training loop, not vanish with a daemon thread. Once raised
+the error is cleared; pending work submitted after the failing item still
+runs (each item is independent — a later save to a healthy path should not
+be poisoned by an earlier full disk).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+from ..observability import metrics as _metrics
+
+
+class AsyncCheckpointError(RuntimeError):
+    """A background checkpoint write failed (original exception chained)."""
+
+
+class AsyncWriter:
+    def __init__(self, name: str = "ckpt-writer"):
+        self._name = name
+        self._queue: "queue.Queue[Optional[Callable[[], None]]]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name=self._name, daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._queue.get()
+            try:
+                if item is None:
+                    return
+                try:
+                    item()
+                except BaseException as e:  # noqa: BLE001 — recorded, re-raised on next call
+                    _metrics.counter("ckpt.async.failures")
+                    with self._lock:
+                        if self._error is None:
+                            self._error = e
+            finally:
+                self._queue.task_done()
+
+    def _raise_pending(self):
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise AsyncCheckpointError(
+                f"a background checkpoint write failed: {err!r}") from err
+
+    def submit(self, fn: Callable[[], None]):
+        """Queue `fn`; raises first if a previous background write failed."""
+        if self._closed:
+            raise RuntimeError(f"AsyncWriter {self._name!r} is closed")
+        self._raise_pending()
+        self._ensure_thread()
+        self._queue.put(fn)
+
+    def run_sync(self, fn: Callable[[], None]):
+        """Synchronous mode (async_=False): same failure surfacing, caller's
+        thread, still ordered AFTER any queued async work."""
+        if self._closed:
+            raise RuntimeError(f"AsyncWriter {self._name!r} is closed")
+        self.wait_until_finished()
+        fn()
+
+    def wait_until_finished(self):
+        """Block until every queued write has run; re-raise any failure."""
+        self._queue.join()
+        self._raise_pending()
+
+    def close(self):
+        self._closed = True
+        self.wait_until_finished()
+        if self._thread is not None and self._thread.is_alive():
+            self._queue.put(None)
+            self._thread.join(timeout=10)
+        self._thread = None

@@ -1,0 +1,280 @@
+"""CheckpointManager: step directories, atomic COMMIT, keep-last-N GC.
+
+Directory layout (one manager directory, many steps):
+
+    <directory>/
+      step_00000100/
+        manifest.json            # arrays + structure + checksums
+        COMMIT                   # atomic publish marker, written LAST
+        params__w.o0_0.bin       # per-host shard files
+        ...
+      step_00000200/ ...
+
+Commit protocol: a step is visible to ``latest_step``/``all_steps``/
+``restore`` ONLY once its COMMIT marker exists, and COMMIT is written (via
+tmp + rename) strictly after every shard file and the manifest have landed.
+A save killed mid-write leaves a torn, invisible directory that the next
+manager construction garbage-collects. Multi-process saves barrier before
+process 0 merges the per-process manifest parts and publishes COMMIT, so a
+partially-written cooperative save is equally invisible.
+
+Async saves: ``save`` blocks only for the device->host snapshot; shard
+files, manifest, COMMIT, and GC run on the ordered background writer, whose
+failures surface on the next ``save``/``wait_until_finished`` (see
+async_writer.py). ``keep_last_n`` GC never deletes the newest committed
+step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+from ..observability import metrics as _metrics
+from . import arrays as _arrays
+from .async_writer import AsyncWriter
+
+STEP_PREFIX = "step_"
+COMMIT_NAME = "COMMIT"
+
+
+def step_dir_name(step: int) -> str:
+    if step < 0:
+        raise ValueError(f"checkpoint step must be >= 0, got {step}")
+    return f"{STEP_PREFIX}{step:08d}"
+
+
+def parse_step(name: str) -> Optional[int]:
+    if not name.startswith(STEP_PREFIX):
+        return None
+    try:
+        return int(name[len(STEP_PREFIX):])
+    except ValueError:
+        return None
+
+
+def is_committed(step_path: str) -> bool:
+    return os.path.exists(os.path.join(step_path, COMMIT_NAME))
+
+
+def _sync_processes(tag: str):
+    """Cross-host barrier for cooperative saves (no-op single-process)."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+class CheckpointManager:
+    """save/restore/latest_step/all_steps/wait_until_finished over one
+    checkpoint directory. See module docstring for the commit protocol."""
+
+    def __init__(self, directory: str, keep_last_n: Optional[int] = None,
+                 async_: bool = True, validate_on_restore: bool = True):
+        import jax
+
+        self.directory = os.path.abspath(str(directory))
+        self.keep_last_n = keep_last_n
+        self.async_ = async_
+        self.validate_on_restore = validate_on_restore
+        self._proc = jax.process_index()
+        self._writer = AsyncWriter(name=f"ckpt-writer:{self.directory}")
+        os.makedirs(self.directory, exist_ok=True)
+        self._gc_uncommitted()
+
+    # ---------------- step discovery ----------------
+    def all_steps(self) -> List[int]:
+        """Committed steps, ascending. Torn/in-flight saves are invisible."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return out
+        for name in names:
+            step = parse_step(name)
+            if step is None:
+                continue
+            if is_committed(os.path.join(self.directory, name)):
+                out.append(step)
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def step_path(self, step: int) -> str:
+        return os.path.join(self.directory, step_dir_name(step))
+
+    def manifest(self, step: int) -> dict:
+        return _arrays.read_manifest(self.step_path(step))
+
+    # ---------------- save ----------------
+    def save(self, step: int, state, force: bool = False) -> None:
+        """Checkpoint `state` (nested dict/list tree of arrays + scalars) as
+        `step`. Blocks only for the device->host snapshot; everything else
+        is async when async_=True. Raises AsyncCheckpointError here if a
+        PREVIOUS background save failed."""
+        self._writer._raise_pending()
+        sdir = self.step_path(step)
+        if is_committed(sdir):
+            if not force:
+                raise ValueError(
+                    f"step {step} already committed in {self.directory} "
+                    "(pass force=True to overwrite)")
+            self.wait_until_finished()
+            if self._proc == 0:
+                shutil.rmtree(sdir, ignore_errors=True)
+            _sync_processes(f"ckpt_overwrite_{step}")
+
+        t0 = time.perf_counter()
+        flat = _arrays.flatten_tree(state)
+        snaps: Dict[str, Any] = {
+            path: _arrays.snapshot_array(leaf)
+            for path, leaf in flat.items() if _arrays._is_array_leaf(leaf)
+        }
+        structure = _arrays._structure(state, snaps)
+        blocking = time.perf_counter() - t0
+        _metrics.histogram("ckpt.save.blocking_seconds", blocking)
+
+        def write():
+            os.makedirs(sdir, exist_ok=True)
+            entries = {}
+            total = 0
+            for path, snap in snaps.items():
+                entry = _arrays.write_snapshot(sdir, path, snap)
+                total += entry.pop("_bytes_written")
+                entries[path] = entry
+            manifest = {
+                "format": _arrays.FORMAT,
+                "step": step,
+                "structure": structure,
+                "arrays": entries,
+                "bytes_written": total,
+            }
+            self._publish(sdir, step, manifest)
+            _metrics.counter("ckpt.save.bytes", total)
+            _metrics.histogram("ckpt.save.total_seconds",
+                               time.perf_counter() - t0)
+            self._gc_old()
+
+        if self.async_:
+            self._writer.submit(write)
+        else:
+            self._writer.run_sync(write)
+
+    def _publish(self, sdir: str, step: int, manifest: dict) -> None:
+        """All shard files are on disk -> make the step visible atomically.
+        Multi-process: everyone contributes a manifest part, process 0
+        merges and writes COMMIT after the barrier proves every process
+        finished writing."""
+        import jax
+
+        nproc = jax.process_count()
+        if nproc > 1:
+            _arrays.write_manifest(
+                sdir, manifest, manifest_name=f"manifest.part{self._proc}.json")
+            _sync_processes(f"ckpt_commit_{step}")
+            if self._proc != 0:
+                _sync_processes(f"ckpt_committed_{step}")
+                return
+            parts = []
+            for p in range(nproc):
+                part_name = f"manifest.part{p}.json"
+                parts.append(_arrays.read_manifest(sdir, part_name))
+            manifest = _arrays.merge_manifests(parts)
+            _arrays.write_manifest(sdir, manifest)
+            for p in range(nproc):
+                os.remove(os.path.join(sdir, f"manifest.part{p}.json"))
+        else:
+            _arrays.write_manifest(sdir, manifest)
+        self._write_commit(sdir, step)
+        if nproc > 1:
+            _sync_processes(f"ckpt_committed_{step}")
+
+    def _write_commit(self, sdir: str, step: int) -> None:
+        """The atomic publish: rename so a crash can never leave a partial
+        COMMIT (a step is either fully visible or fully invisible)."""
+        tmp = os.path.join(sdir, COMMIT_NAME + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump({"step": step, "time": time.time()}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(sdir, COMMIT_NAME))
+
+    # ---------------- restore ----------------
+    def restore(self, step: Optional[int] = None, shardings=None):
+        """Restore a committed step (default: latest). `shardings` is a
+        nested tree (or flat {path: NamedSharding} dict) selecting device
+        layout per array — on ANY mesh, not just the save-time one; arrays
+        without a requested sharding come back as host numpy."""
+        self.wait_until_finished()
+        steps = self.all_steps()
+        if step is None:
+            if not steps:
+                raise FileNotFoundError(
+                    f"no committed checkpoint steps in {self.directory}")
+            step = steps[-1]
+        elif step not in steps:
+            raise FileNotFoundError(
+                f"step {step} is not a committed checkpoint in "
+                f"{self.directory} (committed: {steps})")
+        t0 = time.perf_counter()
+        tree = _arrays.load_tree(self.step_path(step), shardings=shardings,
+                                 validate=self.validate_on_restore)
+        _metrics.histogram("ckpt.restore.seconds", time.perf_counter() - t0)
+        return tree
+
+    # ---------------- lifecycle ----------------
+    def wait_until_finished(self) -> None:
+        """Drain in-flight saves; re-raise any background failure."""
+        self._writer.wait_until_finished()
+
+    def close(self) -> None:
+        self._writer.close()
+
+    # ---------------- GC ----------------
+    def _gc_uncommitted(self) -> None:
+        """Construction-time sweep: torn saves (no COMMIT) are deleted."""
+        if self._proc != 0:
+            return
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return
+        removed = 0
+        for name in names:
+            if parse_step(name) is None:
+                continue
+            path = os.path.join(self.directory, name)
+            if os.path.isdir(path) and not is_committed(path):
+                shutil.rmtree(path, ignore_errors=True)
+                removed += 1
+        if removed:
+            _metrics.counter("ckpt.gc.uncommitted_removed", removed)
+
+    def _gc_old(self) -> None:
+        """keep_last_n sweep over COMMITTED steps; the newest committed step
+        is never deleted (keep_last_n <= 0 still keeps one)."""
+        if self.keep_last_n is None or self._proc != 0:
+            return
+        keep = max(1, int(self.keep_last_n))
+        steps = self.all_steps()
+        removed = 0
+        for step in steps[:-keep] if keep < len(steps) else []:
+            # remove COMMIT first so a sweep killed mid-rmtree leaves an
+            # uncommitted (= invisible, construction-GC'd) directory, not a
+            # corrupt committed one
+            sdir = self.step_path(step)
+            try:
+                os.remove(os.path.join(sdir, COMMIT_NAME))
+            except FileNotFoundError:
+                pass
+            shutil.rmtree(sdir, ignore_errors=True)
+            removed += 1
+        if removed:
+            _metrics.counter("ckpt.gc.steps_removed", removed)

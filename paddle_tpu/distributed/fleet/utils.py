@@ -758,6 +758,59 @@ class ShardedTrainStep:
         for name, v in self.params.items():
             named[name]._set_value_raw(v)
 
+    # ---------- fault-tolerant checkpointing (paddle_tpu.checkpoint) ----------
+    def state_for_checkpoint(self):
+        """The step's full resume state as a composite TrainState: params,
+        optimizer state, buffers, loss-scaler automaton, and the
+        (seed, step) RNG position — one tree, so a CheckpointManager.save
+        publishes it atomically and resume is bitwise-faithful (same
+        parameter bits, same dropout streams, same scaler state).
+
+        Snapshot before the next step(): donation consumes these arrays."""
+        from ...checkpoint import TrainState
+
+        extra = None
+        if self.scaler_state is not None:
+            extra = {"scaler_state": list(self.scaler_state)}
+        return TrainState(
+            params=self.params,
+            opt_state=self.opt_state,
+            buffers=self.buffers or None,
+            rng={"seed": int(self._seed)},
+            step=self._step_i,
+            extra=extra,
+        )
+
+    def checkpoint_shardings(self):
+        """Shardings tree aligned with state_for_checkpoint().to_tree() —
+        hand to CheckpointManager.restore so params/opt state come back
+        device-resident in THIS step's layout (which may differ from the
+        save-time mesh: restore-time resharding)."""
+        return {"params": dict(self._p_shard), "opt_state": self._s_shard}
+
+    def restore_from_checkpoint(self, tree):
+        """Adopt a restored TrainState tree (from CheckpointManager.restore,
+        ideally with checkpoint_shardings()). Host-numpy leaves are placed
+        onto this step's mesh here, so a checkpoint saved under a different
+        topology restores cleanly."""
+        from ...checkpoint import TrainState
+
+        ts = tree if isinstance(tree, TrainState) else TrainState.from_tree(tree)
+        self.params = {k: jax.device_put(v, self._p_shard[k])
+                       for k, v in ts.params.items()}
+        self.opt_state = jax.tree_util.tree_map(
+            lambda v, s: jax.device_put(v, s), ts.opt_state, self._s_shard)
+        if ts.buffers is not None:
+            self.buffers = jax.tree_util.tree_map(jnp.asarray, ts.buffers)
+        if ts.extra and ts.extra.get("scaler_state") is not None:
+            sc = ts.extra["scaler_state"]
+            self.scaler_state = (jnp.float32(sc[0]), jnp.int32(sc[1]),
+                                 jnp.int32(sc[2]))
+        self._step_i = int(ts.step)
+        if ts.rng and "seed" in ts.rng:
+            self._seed = int(ts.rng["seed"])
+        return self
+
     def lower_compiled(self, x, y):
         """AOT-lower (for compile checks without executing)."""
         if self.scaler_state is not None:

@@ -61,46 +61,102 @@ def load(path: str, return_numpy: bool = False, **configs):
     return _from_payload(payload, return_numpy)  # foreign pickle: best effort
 
 
-# ---- async + sharded checkpoints (orbax/tensorstore; SURVEY §5.4 TPU path) ----
+# ---- async + sharded checkpoints (thin wrappers over paddle_tpu.checkpoint,
+#      the fault-tolerant subsystem; SURVEY §5.4 TPU path) ----
 _async_threads = []
+_async_errors = []
+_async_lock = threading.Lock()
+_async_seq = 0  # monotonic: tmp names stay unique even after thread reaping
+
+
+def _reap_async_threads():
+    """Drop finished threads so _async_threads stays O(in-flight), not
+    O(saves issued over the process lifetime)."""
+    with _async_lock:
+        _async_threads[:] = [t for t in _async_threads if t.is_alive()]
 
 
 def save_async(obj, path: str):
     """Non-blocking save: snapshot to host immediately, write in background —
     the preemption-aware autocheckpoint building block. Concurrent saves to
     the same path are safe: each writes a unique tmp file and atomically
-    publishes it."""
+    publishes it. A failed background write is recorded and re-raised from
+    the next wait_async_saves() — it does NOT die silently with its thread."""
+    global _async_seq
+    _reap_async_threads()
     payload = {"magic": _SAVE_MAGIC, "obj": _to_payload(obj)}  # host copy NOW
-    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}.{len(_async_threads)}"
+    with _async_lock:
+        _async_seq += 1
+        seq = _async_seq
+    tmp = f"{path}.tmp.{os.getpid()}.{seq}"
 
     def _write():
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        with open(tmp, "wb") as f:
-            pickle.dump(payload, f, protocol=4)
-        os.replace(tmp, path)  # atomic publish
+        try:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f, protocol=4)
+            os.replace(tmp, path)  # atomic publish
+        except BaseException as e:  # noqa: BLE001 — surfaced by wait_async_saves
+            from ..observability import metrics as _metrics
+
+            _metrics.counter("ckpt.async.failures")
+            with _async_lock:
+                _async_errors.append(e)
 
     t = threading.Thread(target=_write, daemon=True)  # unique tmp => safe to drop at exit
     t.start()
-    _async_threads.append(t)
+    with _async_lock:
+        _async_threads.append(t)
     return t
 
 
 def wait_async_saves():
-    while _async_threads:
-        _async_threads.pop().join()
+    """Join every in-flight save_async; if any background write failed since
+    the last call, raise (first failure, others chained count only)."""
+    while True:
+        with _async_lock:
+            if not _async_threads:
+                break
+            t = _async_threads.pop()
+        t.join()
+    with _async_lock:
+        errs, _async_errors[:] = list(_async_errors), []
+    if errs:
+        from ..checkpoint.async_writer import AsyncCheckpointError
+
+        raise AsyncCheckpointError(
+            f"{len(errs)} background save(s) failed; first: {errs[0]!r}"
+        ) from errs[0]
 
 
 def save_sharded(state: dict, directory: str):
-    """Sharded (per-device-layout) checkpoint via orbax: arrays keep their
-    NamedSharding; multi-host writes cooperate through tensorstore."""
-    import jax
-    import orbax.checkpoint as ocp
+    """Sharded (per-device-layout) checkpoint: arrays keep their
+    NamedSharding, each process writes only its addressable shards, and
+    multi-host writes cooperate through the shared filesystem. Compat
+    wrapper over paddle_tpu.checkpoint.save_tree (manifest + checksums;
+    no step management — use CheckpointManager for that)."""
+    from ..checkpoint import arrays as _ckpt_arrays
 
-    ckptr = ocp.PyTreeCheckpointer()
-    arrays = {k: (v._value if isinstance(v, Tensor) else v) for k, v in state.items()}
-    ckptr.save(os.path.abspath(directory), arrays, force=True)
+    path = os.path.abspath(directory)
+    os.makedirs(path, exist_ok=True)
+    state = {k: (v._value if isinstance(v, Tensor) else v) for k, v in state.items()}
+    import jax
+
+    if jax.process_count() > 1:
+        from ..checkpoint.manager import _sync_processes
+
+        manifest = _ckpt_arrays.save_tree(
+            path, state, manifest_name=f"manifest.part{jax.process_index()}.json")
+        _sync_processes(f"save_sharded:{path}")
+        if jax.process_index() == 0:
+            parts = [_ckpt_arrays.read_manifest(path, f"manifest.part{p}.json")
+                     for p in range(jax.process_count())]
+            _ckpt_arrays.write_manifest(path, _ckpt_arrays.merge_manifests(parts))
+        _sync_processes(f"save_sharded_done:{path}")
+    else:
+        _ckpt_arrays.save_tree(path, state)
 
 
 def load_sharded(directory: str, shardings: dict = None) -> dict:
@@ -108,36 +164,30 @@ def load_sharded(directory: str, shardings: dict = None) -> dict:
     arrays out for a (possibly different) mesh — converter.py's reshard done
     at deserialization. Checkpoints written cooperatively by a multi-process
     world restore fine on ANY topology (e.g. a single analysis process):
-    entries without a requested sharding materialize as host numpy."""
-    import jax
-    import numpy as np
-    import orbax.checkpoint as ocp
+    entries without a requested sharding materialize as host numpy. Compat
+    wrapper over paddle_tpu.checkpoint.load_tree (checksum-validated)."""
+    from ..checkpoint import arrays as _ckpt_arrays
 
-    path = os.path.abspath(directory)
-    ckptr = ocp.PyTreeCheckpointer()
-    shardings = shardings or {}
-    meta = ckptr.metadata(path)
-    if hasattr(meta, "item_metadata"):  # orbax >= 0.5 StepMetadata
-        meta = meta.item_metadata
-    names = meta.keys() if hasattr(meta, "keys") else meta.tree.keys()
-    restore_args = {
-        k: (ocp.ArrayRestoreArgs(sharding=shardings[k]) if k in shardings
-            else ocp.RestoreArgs(restore_type=np.ndarray))
-        for k in names
-    }
-    # entries restored through ArrayRestoreArgs already carry the requested
-    # sharding; everything else is host numpy
-    return ckptr.restore(path, restore_args=restore_args)
+    return _ckpt_arrays.load_tree(os.path.abspath(directory),
+                                  shardings=shardings or None)
 
 
 # ---- preemption-aware auto-checkpoint (SURVEY §5.3 TPU path) ----
 _auto_ckpt_state = {}
 
 
-def enable_auto_checkpoint(path: str, state_fn=None, layer=None, optimizer=None, every_n_steps: int = 0):
+def enable_auto_checkpoint(path: str, state_fn=None, layer=None, optimizer=None,
+                           every_n_steps: int = 0, keep_last_n: int = None):
     """Install a SIGTERM handler that snapshots training state before the
     process dies (preemption on TPU VMs delivers SIGTERM), plus an optional
     step-driven periodic save via `auto_checkpoint_step()`.
+
+    Target selection: a `path` WITH a file extension (``run/auto.pdparams``)
+    keeps the legacy single-file pickle contract; a `path` without one is
+    treated as a checkpoint DIRECTORY managed by
+    ``paddle_tpu.checkpoint.CheckpointManager`` — sharded step directories,
+    atomic COMMIT, keep_last_n GC, and crash-safe resume via
+    ``CheckpointManager(path).restore()``.
 
     Reference analog: the elastic controller's teardown/save protocol
     (fleet/elastic) — here checkpointing is owned by the training process so a
@@ -155,19 +205,31 @@ def enable_auto_checkpoint(path: str, state_fn=None, layer=None, optimizer=None,
             state["optimizer"] = optimizer.state_dict()
         return state
 
+    mgr = None
+    if os.path.splitext(path)[1] == "":  # directory target -> managed steps
+        from ..checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(path, keep_last_n=keep_last_n, async_=True)
+
     def on_sigterm(signum, frame):
-        wait_async_saves()  # let in-flight periodic saves publish first
-        save(collect(), path)
+        if mgr is not None:
+            # publish the final state under the step counter, atomically
+            mgr.save(_auto_ckpt_state.get("step", 0), collect(), force=True)
+            mgr.wait_until_finished()
+        else:
+            wait_async_saves()  # let in-flight periodic saves publish first
+            save(collect(), path)
         prev = _auto_ckpt_state.get("prev_handler")
         if callable(prev):
             prev(signum, frame)
         raise SystemExit(143)
 
     _auto_ckpt_state.update(
-        path=path, collect=collect, every=every_n_steps, step=0,
+        path=path, collect=collect, every=every_n_steps, step=0, manager=mgr,
         prev_handler=signal.getsignal(signal.SIGTERM),
     )
     signal.signal(signal.SIGTERM, on_sigterm)
+    return mgr
 
 
 def auto_checkpoint_step():
@@ -178,6 +240,12 @@ def auto_checkpoint_step():
         return
     st["step"] += 1
     if st["step"] % st["every"] == 0:
+        mgr = st.get("manager")
+        if mgr is not None:
+            # CheckpointManager's ordered writer queues the write; blocking
+            # cost here is only the host snapshot
+            mgr.save(st["step"], st["collect"](), force=True)
+            return
         # don't stack saves: if the previous interval's write is still in
         # flight, skip this one (the next interval will publish fresher state)
         prev = st.get("inflight")
@@ -192,4 +260,7 @@ def disable_auto_checkpoint():
     if _auto_ckpt_state:
         prev = _auto_ckpt_state.get("prev_handler")
         signal.signal(signal.SIGTERM, prev if prev is not None else signal.SIG_DFL)
+        mgr = _auto_ckpt_state.get("manager")
+        if mgr is not None:
+            mgr.close()
         _auto_ckpt_state.clear()

@@ -130,16 +130,53 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None):
+    """Epoch-granular model.save (the reference behavior, default) plus
+    step-granular fault-tolerant checkpointing: with ``save_steps=N`` the
+    full train state (model + optimizer state_dicts) is saved every N train
+    batches through ``paddle_tpu.checkpoint.CheckpointManager`` — async
+    sharded write, atomic COMMIT, keep_last_n GC — under
+    ``<save_dir>/steps/``. Resume with
+    ``CheckpointManager(f"{save_dir}/steps").restore()``."""
+
+    def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None,
+                 save_steps: Optional[int] = None, keep_last_n: Optional[int] = 3):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.save_steps = save_steps
+        self.keep_last_n = keep_last_n
+        self._manager = None
+        self._global_step = 0
+
+    def _collect_state(self):
+        state = {"model": self.model.network.state_dict()}
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is not None and hasattr(opt, "state_dict"):
+            state["optimizer"] = opt.state_dict()
+        return state
+
+    def on_train_begin(self, logs=None):
+        if self.save_steps and self.save_dir and self._manager is None:
+            from ..checkpoint import CheckpointManager
+
+            self._manager = CheckpointManager(
+                os.path.join(self.save_dir, "steps"),
+                keep_last_n=self.keep_last_n, async_=True)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        if (self._manager is not None and self.model is not None
+                and self._global_step % self.save_steps == 0):
+            self._manager.save(self._global_step, self._collect_state(),
+                               force=True)
 
     def on_epoch_end(self, epoch, logs=None):
         if self.model is not None and self.save_dir and epoch % self.save_freq == 0:
             self.model.save(os.path.join(self.save_dir, str(epoch)))
 
     def on_train_end(self, logs=None):
+        if self._manager is not None:
+            self._manager.wait_until_finished()  # surface async failures
         if self.model is not None and self.save_dir:
             self.model.save(os.path.join(self.save_dir, "final"))
 

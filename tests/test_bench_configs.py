@@ -27,4 +27,30 @@ def test_all_configs_registered():
     import bench
 
     assert set(bench.CONFIGS) == {"bert_sst2", "gpt_dp", "ernie_mp4",
-                                  "resnet50", "gpt_moe", "serving"}
+                                  "resnet50", "gpt_moe", "serving", "ckpt"}
+
+
+def test_bench_ckpt_row_contract(capsys):
+    """The ckpt row's acceptance invariant: blocking save time (device->host
+    snapshot) is strictly less than total save time (snapshot + background
+    disk write), both present in the telemetry sub-object."""
+    import bench
+    from paddle_tpu import observability
+
+    row = bench.bench_ckpt()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(out)
+    assert parsed == row
+    assert parsed["config"] == "ckpt"
+    assert parsed["value"] > 0 and np.isfinite(parsed["value"])
+    assert parsed["save_total_ms"] >= parsed["value"]  # blocking <= total
+    assert parsed["restore_ms"] > 0
+    hists = parsed["telemetry"]["histograms"]
+    blocking = hists["ckpt.save.blocking_seconds"]
+    total = hists["ckpt.save.total_seconds"]
+    assert blocking["count"] == total["count"] > 0
+    assert blocking["avg"] <= total["avg"]
+    assert "ckpt.restore.seconds" in hists
+    assert parsed["telemetry"]["counters"]["ckpt.save.bytes"] > 0
+    # the row must not leave the global observability flag flipped on
+    assert not observability.enabled()

@@ -1,0 +1,492 @@
+"""Fault-tolerant checkpointing tests (paddle_tpu/checkpoint/): atomic
+COMMIT crash-safety, keep-last-N GC, reshard-on-restore, bitwise-faithful
+TrainState resume, async failure propagation, and the inspect CLI."""
+
+import importlib.util
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.checkpoint import (
+    AsyncCheckpointError,
+    AsyncWriter,
+    CheckpointManager,
+    TrainState,
+    is_train_state_tree,
+    load_tree,
+    save_tree,
+)
+
+
+def _tree_equal(a, b):
+    if isinstance(a, dict):
+        assert set(a) == set(b)
+        for k in a:
+            _tree_equal(a[k], b[k])
+    elif isinstance(a, (list, tuple)):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            _tree_equal(x, y)
+    elif isinstance(a, np.ndarray) or hasattr(a, "dtype"):
+        assert np.asarray(a).dtype == np.asarray(b).dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    else:
+        assert a == b
+
+
+# ---------------- arrays.py: tree serialization ----------------
+
+def test_tree_roundtrip_mixed_dtypes(tmp_path):
+    """Nested dicts/lists, varied dtypes, scalars and strings survive a
+    save_tree/load_tree roundtrip; tuples come back as lists."""
+    state = {
+        "w": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "ids": np.array([1, 2, 3], dtype=np.int64),
+        "flag": np.array(True),
+        "half": np.arange(4, dtype=np.float16),
+        "nested": {"scale": np.float64(2.5), "name": "layer0",
+                   "shapes": [1, 2, 3]},
+        "pair": (np.zeros(2, np.float32), 7),
+        "step": 42,
+        "t": paddle.to_tensor([1.0, 2.0]),
+    }
+    d = str(tmp_path / "ck")
+    save_tree(d, state)
+    back = load_tree(d)
+    assert isinstance(back["pair"], list)  # tuple -> list (JSON structure)
+    np.testing.assert_array_equal(back["w"], state["w"])
+    assert back["w"].dtype == np.float32
+    np.testing.assert_array_equal(back["ids"], state["ids"])
+    assert back["ids"].dtype == np.int64
+    assert back["half"].dtype == np.float16
+    assert bool(back["flag"]) is True
+    assert back["nested"] == {"scale": 2.5, "name": "layer0",
+                              "shapes": [1, 2, 3]}
+    assert back["step"] == 42
+    np.testing.assert_array_equal(back["t"], [1.0, 2.0])
+
+
+def test_checksum_validation_detects_corruption(tmp_path):
+    d = str(tmp_path / "ck")
+    save_tree(d, {"w": np.arange(8, dtype=np.float32)})
+    [shard] = [f for f in os.listdir(d) if f.endswith(".bin")]
+    with open(os.path.join(d, shard), "r+b") as f:
+        f.seek(0)
+        raw = f.read(1)
+        f.seek(0)
+        f.write(bytes([raw[0] ^ 0xFF]))
+    with pytest.raises(IOError, match="(?i)crc|checksum|corrupt"):
+        load_tree(d)
+    back = load_tree(d, validate=False)  # explicit opt-out still reads
+    assert back["w"].shape == (8,)
+
+
+def test_reshard_on_restore_across_meshes(tmp_path):
+    """Save under a (2,2) mesh, restore (a) as host numpy with no mesh at
+    all and (b) resharded onto a different 1-D mesh over 8 devices —
+    topology-change restore."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh22 = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("x", "y"))
+    w = np.arange(64, dtype=np.float32).reshape(8, 8)
+    b = np.arange(8, dtype=np.float32)
+    state = {
+        "w": jax.device_put(w, NamedSharding(mesh22, P("x", "y"))),
+        "b": jax.device_put(b, NamedSharding(mesh22, P("x"))),
+        "step": 3,
+    }
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_=False)
+    mgr.save(3, state)
+    assert mgr.all_steps() == [3]
+
+    # (a) single-process analysis restore: plain host numpy
+    host = mgr.restore()
+    assert isinstance(host["w"], np.ndarray)
+    np.testing.assert_array_equal(host["w"], w)
+    np.testing.assert_array_equal(host["b"], b)
+    assert host["step"] == 3
+
+    # (b) reshard onto a different mesh (1-D over all 8 devices)
+    mesh8 = Mesh(np.array(jax.devices()), ("z",))
+    back = mgr.restore(shardings={
+        "w": NamedSharding(mesh8, P("z", None)),
+        "b": NamedSharding(mesh8, P("z")),
+    })
+    np.testing.assert_array_equal(np.asarray(back["w"]), w)
+    np.testing.assert_array_equal(np.asarray(back["b"]), b)
+    assert back["w"].sharding.spec == P("z", None)
+    assert len(back["w"].sharding.device_set) == 8
+    mgr.close()
+
+
+# ---------------- manager.py: commit protocol + GC ----------------
+
+def test_manager_latest_and_already_committed(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_=False)
+    assert mgr.latest_step() is None
+    with pytest.raises(FileNotFoundError):
+        mgr.restore()
+    mgr.save(1, {"v": np.float32(1.0)})
+    mgr.save(5, {"v": np.float32(5.0)})
+    assert mgr.all_steps() == [1, 5]
+    assert mgr.latest_step() == 5
+    with pytest.raises(ValueError, match="already committed"):
+        mgr.save(5, {"v": np.float32(9.0)})
+    mgr.save(5, {"v": np.float32(9.0)}, force=True)  # explicit overwrite
+    assert float(mgr.restore(5)["v"]) == 9.0
+    with pytest.raises(FileNotFoundError, match="not a committed"):
+        mgr.restore(3)
+    mgr.close()
+
+
+def test_torn_save_invisible_then_gcd(tmp_path):
+    """Kill between shard write and COMMIT: the torn step is invisible to
+    latest_step/all_steps, restore() returns the previous committed state
+    bitwise-intact, the failure surfaces on wait, and the next manager
+    construction garbage-collects the torn directory."""
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(d, async_=True)
+    state1 = {"w": np.arange(6, dtype=np.float32), "step": 1}
+    mgr.save(1, state1)
+    mgr.wait_until_finished()
+
+    # simulated preemption: every shard file + manifest lands, COMMIT never
+    # does (the exact window the commit protocol exists for)
+    def killed(sdir, step):
+        raise RuntimeError("simulated kill before COMMIT")
+
+    mgr._write_commit = killed
+    mgr.save(2, {"w": np.zeros(6, np.float32), "step": 2})
+    with pytest.raises(AsyncCheckpointError, match="simulated kill"):
+        mgr.wait_until_finished()
+
+    torn = mgr.step_path(2)
+    assert os.path.isdir(torn)  # shards landed...
+    assert not os.path.exists(os.path.join(torn, "COMMIT"))  # ...no COMMIT
+    assert mgr.all_steps() == [1]  # torn step invisible
+    assert mgr.latest_step() == 1
+    back = mgr.restore()  # default latest skips the torn step
+    np.testing.assert_array_equal(back["w"], state1["w"])
+    assert back["step"] == 1
+    mgr.close()
+
+    mgr2 = CheckpointManager(d)  # construction-time GC sweeps torn dirs
+    assert not os.path.exists(torn)
+    assert mgr2.all_steps() == [1]
+    mgr2.close()
+
+
+def test_keep_last_n_gc_never_deletes_newest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep_last_n=2,
+                            async_=False)
+    for s in range(1, 5):
+        mgr.save(s, {"v": np.float32(s)})
+    assert mgr.all_steps() == [3, 4]
+    assert not os.path.exists(mgr.step_path(1))
+    mgr.close()
+
+    # keep_last_n <= 0 still keeps the newest committed step
+    mgr0 = CheckpointManager(str(tmp_path / "ck0"), keep_last_n=0,
+                             async_=False)
+    mgr0.save(1, {"v": np.float32(1)})
+    mgr0.save(2, {"v": np.float32(2)})
+    assert mgr0.all_steps() == [2]
+    assert float(mgr0.restore()["v"]) == 2.0
+    mgr0.close()
+
+
+def test_async_failure_surfaces_on_next_save(tmp_path, monkeypatch):
+    """A background write failure is raised from the NEXT save (not lost
+    with the writer thread), and the writer recovers afterwards."""
+    from paddle_tpu.checkpoint import arrays as ckpt_arrays
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_=True)
+    real = ckpt_arrays.write_snapshot
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ckpt_arrays, "write_snapshot", boom)
+    mgr.save(1, {"v": np.float32(1)})
+    mgr._writer._queue.join()  # failing write has run; error is recorded
+    monkeypatch.setattr(ckpt_arrays, "write_snapshot", real)
+    with pytest.raises(AsyncCheckpointError, match="disk full"):
+        mgr.save(2, {"v": np.float32(2)})
+    mgr.save(2, {"v": np.float32(2)})  # error consumed; writer usable again
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [2]
+    mgr.close()
+
+
+def test_async_writer_ordering_and_close():
+    done = []
+    w = AsyncWriter(name="t")
+    for i in range(8):
+        w.submit(lambda i=i: done.append(i))
+    w.wait_until_finished()
+    assert done == list(range(8))  # strict FIFO: COMMIT N before shards N+1
+    w.close()
+    with pytest.raises(RuntimeError):
+        w.submit(lambda: None)
+
+
+def test_save_blocks_only_for_snapshot(tmp_path):
+    """The acceptance invariant at unit scale: a slow disk write does not
+    extend save()'s blocking time."""
+    import time
+
+    from paddle_tpu.checkpoint import arrays as ckpt_arrays
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_=True)
+    real = ckpt_arrays.write_snapshot
+
+    def slow(*a, **k):
+        time.sleep(0.25)
+        return real(*a, **k)
+
+    ckpt_arrays_write, ckpt_arrays.write_snapshot = ckpt_arrays.write_snapshot, slow
+    try:
+        t0 = time.perf_counter()
+        mgr.save(1, {"v": np.arange(4, dtype=np.float32)})
+        blocking = time.perf_counter() - t0
+        mgr.wait_until_finished()
+        total = time.perf_counter() - t0
+    finally:
+        ckpt_arrays.write_snapshot = ckpt_arrays_write
+    assert blocking < 0.2 < total
+    assert mgr.latest_step() == 1
+    mgr.close()
+
+
+# ---------------- TrainState: bitwise-faithful resume ----------------
+
+def test_train_state_tree_roundtrip(tmp_path):
+    ts = TrainState(params={"w": np.ones(3, np.float32)},
+                    opt_state={"w": {"moment1": np.zeros(3, np.float32)}},
+                    rng={"seed": 7}, step=11, data_position=128)
+    tree = ts.to_tree()
+    assert is_train_state_tree(tree)
+    d = str(tmp_path / "ck")
+    save_tree(d, tree)
+    ts2 = TrainState.from_tree(load_tree(d))
+    assert ts2.step == 11 and ts2.rng == {"seed": 7}
+    assert ts2.data_position == 128 and ts2.buffers is None
+    np.testing.assert_array_equal(ts2.params["w"], ts.params["w"])
+    with pytest.raises(ValueError, match="__train_state__"):
+        TrainState.from_tree({"params": {}})
+
+
+def test_sharded_train_step_bitwise_resume(tmp_path):
+    """Save mid-run, keep training; restore into a FRESH step and replay —
+    the continued and resumed runs must match bitwise: same losses, same
+    final parameter bits, same optimizer moments (same RNG position)."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    try:
+        from _mp_common import build_step
+    finally:
+        sys.path.pop(0)
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_=True)
+    st, x, y = build_step()
+    for _ in range(3):
+        st(x, y)
+    # snapshot BEFORE the next step: donation consumes these buffers
+    mgr.save(st._step_i, st.state_for_checkpoint().to_tree())
+    cont_losses = [float(st(x, y)) for _ in range(2)]
+
+    st2, x2, y2 = build_step()  # fresh step, freshly-initialized state
+    tree = mgr.restore(shardings=st2.checkpoint_shardings())
+    assert is_train_state_tree(tree)
+    st2.restore_from_checkpoint(tree)
+    assert st2._step_i == 3
+    resume_losses = [float(st2(x2, y2)) for _ in range(2)]
+
+    assert resume_losses == cont_losses  # bitwise, not approx
+    for name in st.params:
+        np.testing.assert_array_equal(np.asarray(st.params[name]),
+                                      np.asarray(st2.params[name]), err_msg=name)
+    for name, slots in st.opt_state.items():
+        for slot, v in slots.items():
+            np.testing.assert_array_equal(
+                np.asarray(v), np.asarray(st2.opt_state[name][slot]),
+                err_msg=f"{name}/{slot}")
+    mgr.close()
+
+
+# ---------------- observability ----------------
+
+def test_ckpt_metrics_recorded(tmp_path):
+    from paddle_tpu import observability
+
+    observability.enable()
+    try:
+        observability.reset()
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep_last_n=1,
+                                async_=False)
+        mgr.save(1, {"v": np.arange(8, dtype=np.float32)})
+        mgr.save(2, {"v": np.arange(8, dtype=np.float32)})
+        mgr.restore()
+        snap = observability.snapshot()
+        hists = snap["histograms"]
+        assert hists["ckpt.save.blocking_seconds"]["count"] == 2
+        assert hists["ckpt.save.total_seconds"]["count"] == 2
+        assert hists["ckpt.restore.seconds"]["count"] == 1
+        assert snap["counters"]["ckpt.save.bytes"] >= 64
+        assert snap["counters"]["ckpt.gc.steps_removed"] == 1
+        mgr.close()
+    finally:
+        observability.disable()
+        observability.reset()
+
+
+# ---------------- framework/io.py regressions ----------------
+
+def test_save_async_failure_raises_and_threads_reaped(tmp_path):
+    """Regression: a failed background save_async must NOT die silently —
+    wait_async_saves re-raises it — and _async_threads must not grow
+    without bound across many saves."""
+    from paddle_tpu.framework import io as fio
+
+    blocker = tmp_path / "blocker"
+    blocker.write_text("a regular file, not a directory")
+    # parent of the target path is a FILE -> background makedirs/open fails
+    bad = str(blocker / "sub" / "x.pdparams")
+    fio.save_async({"v": paddle.to_tensor([1.0])}, bad)
+    with pytest.raises(AsyncCheckpointError, match="background save"):
+        fio.wait_async_saves()
+    fio.wait_async_saves()  # errors were consumed, not sticky
+
+    good = str(tmp_path / "ok.pdparams")
+    for _ in range(20):
+        fio.save_async({"v": paddle.to_tensor([2.0])}, good)
+    fio.wait_async_saves()
+    fio.save_async({"v": paddle.to_tensor([3.0])}, good)
+    assert len(fio._async_threads) <= 2  # reaped, not 20+ zombies
+    fio.wait_async_saves()
+    np.testing.assert_allclose(paddle.load(good)["v"].numpy(), [3.0])
+
+
+def test_enable_auto_checkpoint_directory_mode(tmp_path):
+    """A path without an extension selects CheckpointManager-managed step
+    directories; SIGTERM publishes the final state atomically."""
+    import signal
+
+    ckdir = str(tmp_path / "autockpt")
+    net = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.Adam(parameters=net.parameters())
+    mgr = paddle.framework.enable_auto_checkpoint(
+        ckdir, layer=net, optimizer=opt, every_n_steps=2, keep_last_n=2)
+    try:
+        assert isinstance(mgr, CheckpointManager)
+        for _ in range(4):
+            net(paddle.ones([2, 4])).sum().backward()
+            opt.step()
+            opt.clear_grad()
+            paddle.framework.auto_checkpoint_step()
+        mgr.wait_until_finished()
+        assert mgr.all_steps() == [2, 4]
+        with pytest.raises(SystemExit):
+            signal.raise_signal(signal.SIGTERM)
+        state = mgr.restore()  # SIGTERM force-published under step 4
+        assert "model" in state and "optimizer" in state
+        assert mgr.latest_step() == 4
+    finally:
+        paddle.framework.disable_auto_checkpoint()
+
+
+# ---------------- hapi ModelCheckpoint(save_steps=N) ----------------
+
+def test_hapi_model_checkpoint_save_steps(tmp_path):
+    from paddle_tpu.hapi.callbacks import ModelCheckpoint
+    from paddle_tpu.metric import Accuracy
+
+    class _Ds(paddle.io.Dataset):
+        def __init__(self, n=64):
+            rng = np.random.RandomState(0)
+            self.x = rng.randn(n, 4).astype(np.float32)
+            self.y = (self.x.sum(axis=1) > 0).astype(np.int64)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+        def __len__(self):
+            return len(self.x)
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 2))
+    model = paddle.Model(net)
+    model.prepare(optimizer=paddle.optimizer.Adam(
+        learning_rate=0.05, parameters=net.parameters()),
+        loss=paddle.nn.CrossEntropyLoss(), metrics=Accuracy())
+    cb = ModelCheckpoint(save_dir=str(tmp_path), save_steps=3, keep_last_n=2)
+    model.fit(_Ds(), batch_size=16, epochs=2, verbose=0, callbacks=[cb])
+
+    mgr = CheckpointManager(str(tmp_path / "steps"))
+    steps = mgr.all_steps()  # 8 batches total, save every 3 -> {3, 6}
+    assert steps == [3, 6]
+    state = mgr.restore()
+    assert set(state) >= {"model", "optimizer"}
+    for k, v in net.state_dict().items():
+        assert k in state["model"]
+        assert np.asarray(state["model"][k]).shape == tuple(v.shape)
+    mgr.close()
+    # epoch-granular saves (the reference default) still happen
+    assert os.path.exists(str(tmp_path / "final.pdparams"))
+
+
+# ---------------- tools/ckpt_inspect.py ----------------
+
+def _load_inspect():
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "ckpt_inspect.py")
+    spec = importlib.util.spec_from_file_location("ckpt_inspect", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_ckpt_inspect_cli(tmp_path, capsys):
+    insp = _load_inspect()
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(d, async_=False)
+    mgr.save(1, {"w": np.arange(8, dtype=np.float32), "tag": "a"})
+    mgr.save(2, {"w": np.arange(8, dtype=np.float32) * 2, "tag": "b"})
+    mgr.close()
+    os.makedirs(os.path.join(d, "step_00000003"))  # torn: no manifest/COMMIT
+
+    assert insp.main([d]) == 0  # listing alone never fails
+    out = capsys.readouterr().out
+    assert "step" in out and "True" in out and "False" in out
+
+    assert insp.main([d, "--step", "2", "--json"]) == 0
+    detail = json.loads(capsys.readouterr().out)
+    assert detail["detail"]["committed"] is True
+    names = [e["name"] for e in detail["detail"]["entries"]]
+    assert "w" in names
+    steps = {r["step"]: r for r in detail["steps"]}
+    assert steps[3]["committed"] is False
+
+    assert insp.main([d, "--verify"]) == 0
+    capsys.readouterr()
+
+    # corrupt one shard byte -> --verify reports it and exits nonzero
+    sdir = os.path.join(d, "step_00000002")
+    [shard] = [f for f in os.listdir(sdir) if f.endswith(".bin")]
+    with open(os.path.join(sdir, shard), "r+b") as f:
+        raw = f.read()
+        f.seek(0)
+        f.write(bytes([raw[0] ^ 0xFF]) + raw[1:])
+    assert zlib.crc32(open(os.path.join(sdir, shard), "rb").read()) != 0
+    assert insp.main([d, "--verify"]) == 1
+    assert "CORRUPT" in capsys.readouterr().out
+    assert insp.main([d, "--step", "1", "--verify"]) == 0  # step 1 untouched
+    capsys.readouterr()
+    assert insp.main([str(tmp_path / "nope")]) == 1

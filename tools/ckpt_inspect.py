@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Inspect a paddle_tpu.checkpoint directory: steps, commit status, manifest
+entries, and (optionally) shard checksum verification.
+
+Usage:
+    python tools/ckpt_inspect.py CKPT_DIR                 # list steps
+    python tools/ckpt_inspect.py CKPT_DIR --step 100      # one step's arrays
+    python tools/ckpt_inspect.py CKPT_DIR --verify        # recompute CRC32s
+    python tools/ckpt_inspect.py CKPT_DIR --json          # machine-readable
+
+Runs standalone — no paddle_tpu (or jax) import, so it works on checkpoint
+directories copied off a TPU host. Exit code 1 if --verify finds corruption
+or a torn step directory is passed with --step.
+
+Layout/format: see paddle_tpu/checkpoint/README.md (manifest.json +
+per-shard .bin files + COMMIT marker per step_XXXXXXXX directory).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import zlib
+
+STEP_PREFIX = "step_"
+COMMIT_NAME = "COMMIT"
+MANIFEST_NAME = "manifest.json"
+FORMAT = "paddle_tpu.ckpt.v1"
+
+
+def parse_step(name: str):
+    if not name.startswith(STEP_PREFIX):
+        return None
+    try:
+        return int(name[len(STEP_PREFIX):])
+    except ValueError:
+        return None
+
+
+def read_manifest(step_dir: str):
+    path = os.path.join(step_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        m = json.load(f)
+    if m.get("format") != FORMAT:
+        return None
+    return m
+
+
+def scan(directory: str):
+    """[{step, dir, committed, arrays, bytes}] for every step directory."""
+    rows = []
+    for name in sorted(os.listdir(directory)):
+        step = parse_step(name)
+        if step is None:
+            continue
+        sdir = os.path.join(directory, name)
+        if not os.path.isdir(sdir):
+            continue
+        manifest = read_manifest(sdir)
+        rows.append({
+            "step": step,
+            "dir": name,
+            "committed": os.path.exists(os.path.join(sdir, COMMIT_NAME)),
+            "arrays": len(manifest["arrays"]) if manifest else None,
+            "bytes": manifest.get("bytes_written") if manifest else None,
+        })
+    return rows
+
+
+def _fmt_sharding(sh) -> str:
+    if not sh:
+        return "-"
+    spec = ",".join("None" if e is None else
+                    "+".join(e) if isinstance(e, list) else str(e)
+                    for e in sh["spec"])
+    mesh = "x".join(f"{a}={n}" for a, n in zip(sh["mesh_axes"],
+                                               sh["mesh_shape"]))
+    return f"P({spec}) @ ({mesh})"
+
+
+def describe(step_dir: str):
+    """Manifest entries: name, global shape, dtype, sharding, shard count."""
+    manifest = read_manifest(step_dir)
+    if manifest is None:
+        raise SystemExit(f"{step_dir}: no readable {MANIFEST_NAME} "
+                         "(torn/in-flight save?)")
+    rows = []
+    for name in sorted(manifest["arrays"]):
+        e = manifest["arrays"][name]
+        rows.append({
+            "name": name,
+            "global_shape": e["global_shape"],
+            "dtype": e["dtype"],
+            "sharding": _fmt_sharding(e.get("sharding")),
+            "shards": len(e["shards"]),
+            "bytes": sum(s["bytes"] for s in e["shards"]),
+        })
+    return manifest, rows
+
+
+def verify(step_dir: str):
+    """Recompute every shard file's CRC32 against the manifest.
+    Returns (n_ok, [error strings])."""
+    manifest = read_manifest(step_dir)
+    if manifest is None:
+        return 0, [f"{step_dir}: no readable manifest"]
+    ok, errors = 0, []
+    for name, e in sorted(manifest["arrays"].items()):
+        for s in e["shards"]:
+            fpath = os.path.join(step_dir, s["file"])
+            try:
+                with open(fpath, "rb") as f:
+                    raw = f.read()
+            except OSError as exc:
+                errors.append(f"{name}: {s['file']}: unreadable ({exc})")
+                continue
+            if len(raw) != s["bytes"]:
+                errors.append(f"{name}: {s['file']}: size {len(raw)} != "
+                              f"manifest {s['bytes']}")
+            elif (zlib.crc32(raw) & 0xFFFFFFFF) != s["crc32"]:
+                errors.append(f"{name}: {s['file']}: CRC32 mismatch "
+                              "(corrupt shard)")
+            else:
+                ok += 1
+    return ok, errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("directory", help="CheckpointManager directory")
+    ap.add_argument("--step", type=int, default=None,
+                    help="describe one step's manifest entries")
+    ap.add_argument("--verify", action="store_true",
+                    help="recompute shard checksums (all committed steps, "
+                         "or --step's)")
+    ap.add_argument("--json", action="store_true", help="emit JSON")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.directory):
+        print(f"{args.directory}: not a directory", file=sys.stderr)
+        return 1
+    rows = scan(args.directory)
+
+    rc = 0
+    out = {"directory": os.path.abspath(args.directory), "steps": rows}
+
+    if args.step is not None:
+        sdir = os.path.join(args.directory, f"{STEP_PREFIX}{args.step:08d}")
+        row = next((r for r in rows if r["step"] == args.step), None)
+        if row is None:
+            print(f"step {args.step}: no such step directory", file=sys.stderr)
+            return 1
+        if not row["committed"]:
+            rc = 1
+        manifest, entries = describe(sdir)
+        out["detail"] = {"step": args.step, "committed": row["committed"],
+                         "entries": entries,
+                         "scalars_step": manifest.get("step")}
+
+    if args.verify:
+        targets = ([args.step] if args.step is not None
+                   else [r["step"] for r in rows if r["committed"]])
+        vr = {}
+        for step in targets:
+            sdir = os.path.join(args.directory, f"{STEP_PREFIX}{step:08d}")
+            n_ok, errors = verify(sdir)
+            vr[step] = {"shards_ok": n_ok, "errors": errors}
+            if errors:
+                rc = 1
+        out["verify"] = vr
+
+    if args.json:
+        print(json.dumps(out, indent=1, sort_keys=True))
+        return rc
+
+    print(f"{out['directory']}")
+    print(f"{'step':>10}  {'committed':<9}  {'arrays':>7}  {'bytes':>12}")
+    for r in rows:
+        print(f"{r['step']:>10}  {str(r['committed']):<9}  "
+              f"{r['arrays'] if r['arrays'] is not None else '-':>7}  "
+              f"{r['bytes'] if r['bytes'] is not None else '-':>12}")
+    if not rows:
+        print("  (no step directories)")
+    if "detail" in out:
+        d = out["detail"]
+        print(f"\nstep {d['step']} (committed={d['committed']}):")
+        print(f"  {'name':<48} {'shape':<18} {'dtype':<10} "
+              f"{'shards':>6}  sharding")
+        for e in d["entries"]:
+            shape = "x".join(map(str, e["global_shape"])) or "scalar"
+            print(f"  {e['name'][:47]:<48} {shape:<18} {e['dtype']:<10} "
+                  f"{e['shards']:>6}  {e['sharding']}")
+    if "verify" in out:
+        print()
+        for step, v in sorted(out["verify"].items()):
+            status = "OK" if not v["errors"] else "CORRUPT"
+            print(f"verify step {step}: {v['shards_ok']} shard(s) OK — {status}")
+            for err in v["errors"]:
+                print(f"  !! {err}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
