@@ -4,6 +4,10 @@ fleet hybrid strategy -> distributed model -> train loop -> checkpoints).
 Smoke (CPU): python examples/gpt_pretrain.py --smoke
 TPU:         python examples/gpt_pretrain.py --hidden 2048 --layers 12 \
                  --batch 32 --steps 100
+Real data:   --data 'shards/*.bin' feeds packed [B, S] batches from the
+             deterministic paddle_tpu.data pipeline; with --ckpt-dir and
+             --save-steps N the data position rides in the checkpoint, so
+             a restarted run resumes mid-epoch on the exact next batch.
 Multi-chip:  set dp/mp degrees; shardings compile through GSPMD.
 """
 
@@ -28,6 +32,16 @@ def main():
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--mp", type=int, default=1)
     ap.add_argument("--save", default=None, help="checkpoint path prefix")
+    ap.add_argument("--data", default=None,
+                    help="token .bin shard glob (paddle_tpu.data pipeline); "
+                         "synthetic random batches when unset")
+    ap.add_argument("--eos-id", type=int, default=0,
+                    help="document delimiter token in the .bin shards")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="managed checkpoint dir: auto-resumes (model, "
+                         "optimizer, AND data position)")
+    ap.add_argument("--save-steps", type=int, default=0,
+                    help="save to --ckpt-dir every N steps")
     args = ap.parse_args()
     if args.smoke:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -66,16 +80,58 @@ def main():
         multi_precision=on_tpu, moment_dtype="bfloat16" if on_tpu else None)
     step = make_sharded_train_step(model, opt)
 
+    pipe = data_it = None
+    if args.data:
+        from paddle_tpu.data import build_pretrain_pipeline
+
+        # per-host shard assignment + greedy packing + device feed; the
+        # GSPMD step shards the fed batch over the mesh
+        pipe = build_pretrain_pipeline(
+            args.data, args.batch, args.seq, eos_id=args.eos_id, seed=0)
+        data_it = iter(pipe)
+
+    mgr = None
+    start = 0
+    if args.ckpt_dir:
+        from paddle_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(args.ckpt_dir, keep_last_n=3, async_=True)
+        if mgr.latest_step() is not None:
+            start = int(mgr.latest_step())
+            tree = mgr.restore(shardings=step.checkpoint_shardings())
+            step.restore_from_checkpoint(tree)
+            if pipe is not None and tree.get("data_position"):
+                pipe.set_state(tree["data_position"])
+            print(f"resumed from step {start}"
+                  + (" (data position restored)" if pipe is not None else ""))
+
     rng = np.random.RandomState(0)
     t0 = time.perf_counter()
-    for i in range(args.steps):
-        x = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(args.batch, args.seq), dtype=np.int32))
-        y = jnp.asarray(np.roll(np.asarray(x), -1, axis=1))
+    for i in range(start, args.steps):
+        if data_it is not None:
+            x = next(data_it)["tokens"]
+            y = jnp.roll(x, -1, axis=1)
+        else:
+            x = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(args.batch, args.seq), dtype=np.int32))
+            y = jnp.asarray(np.roll(np.asarray(x), -1, axis=1))
         loss = step(x, y)
         if i % 10 == 0 or i == args.steps - 1:
             print(f"step {i}: loss {float(loss):.4f}", flush=True)
+        if mgr is not None and args.save_steps and (i + 1) % args.save_steps == 0:
+            st = step.state_for_checkpoint()
+            if pipe is not None:
+                st.data_position = pipe.get_state()
+            mgr.save(i + 1, st.to_tree(), force=True)
     dt = time.perf_counter() - t0
-    print(f"done: {args.steps * args.batch * args.seq / dt:.0f} tokens/sec")
+    done = max(args.steps - start, 1)
+    print(f"done: {done * args.batch * args.seq / dt:.0f} tokens/sec"
+          + (f", packing efficiency {pipe.packing_efficiency:.3f}"
+             if pipe is not None else ""))
+    if mgr is not None:
+        mgr.wait_until_finished()
+        mgr.close()
+    if data_it is not None:
+        data_it.close()
 
     if args.save:
         step.sync_to_model()

@@ -88,6 +88,7 @@ from . import nn  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402
 from . import io  # noqa: F401,E402
+from . import data  # noqa: F401,E402
 from . import framework  # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
 from . import models  # noqa: F401,E402

@@ -1,0 +1,48 @@
+"""paddle_tpu.data — deterministic sharded input pipeline (TPU-native
+rebuild of the fleet InMemoryDataset/QueueDataset capability + the LLM
+pretraining input path the reference kept in external tooling).
+
+Stages, each a checkpointable iterator (``get_state``/``set_state``):
+
+  sources   TokenBinSource / JsonlSource / TextLineSource — per-host
+            file-shard readers with epoch-seeded deterministic shuffling
+  packing   SequencePacker — greedy pack of ragged documents into static
+            [B, S] token/segment-id/position buffers (XLA needs one shape)
+  feed      GlobalBatchFeeder — per-host batches assembled into ONE
+            mesh-global jax.Array over the data axis, double-buffered
+            through io.prefetch.DevicePrefetcher
+  pipeline  DataPipeline / build_pretrain_pipeline — composition whose
+            single state dict plugs into TrainState.data_position for
+            exact mid-epoch resume
+
+See data/README.md for the contracts and tools/data_inspect.py for
+offline shard/assignment/packing inspection (no jax required).
+"""
+
+from .protocol import (  # noqa: F401
+    CheckpointableIterator,
+    iterator_state,
+    mix_seed,
+    restore_iterator,
+)
+from .sources import (  # noqa: F401
+    JsonlSource,
+    ShardedFileSource,
+    TextLineSource,
+    TokenBinSource,
+    expand_files,
+    shard_assignment,
+)
+from .packing import SequencePacker  # noqa: F401
+from .feed import GlobalBatchFeeder, batch_sharding  # noqa: F401
+from .pipeline import DataPipeline, build_pretrain_pipeline  # noqa: F401
+
+__all__ = [
+    "CheckpointableIterator", "iterator_state", "restore_iterator",
+    "mix_seed",
+    "ShardedFileSource", "TokenBinSource", "JsonlSource", "TextLineSource",
+    "expand_files", "shard_assignment",
+    "SequencePacker",
+    "GlobalBatchFeeder", "batch_sharding",
+    "DataPipeline", "build_pretrain_pipeline",
+]

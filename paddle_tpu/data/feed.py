@@ -1,0 +1,144 @@
+"""Global-batch assembly + async device feeding.
+
+GSPMD's input contract: the training step consumes ONE global jax.Array
+per field, sharded over the mesh's data axis; each host contributes only
+the rows it loaded. This stage turns the packer's per-host numpy batches
+into exactly that:
+
+  * multi-process — ``jax.make_array_from_process_local_data`` assembles
+    the global [B_global, S] array against a ``NamedSharding`` without any
+    cross-host copy (data stays where it was read; the same primitive
+    ``ShardedTrainStep._to_global_batch`` uses);
+  * single process — ``jax.device_put`` against the batch sharding (or the
+    default device), which is asynchronous: the transfer is in flight when
+    the batch is handed over.
+
+Layered under ``io.prefetch.DevicePrefetcher``: a producer thread runs
+assembly (and therefore the host->device transfer) for batch k+1 while
+the consumer runs step k, so the steady-state step never waits on infeed.
+The consumer-side stall that remains is measured: ``host_wait_ms_mean``
+(and the flag-gated ``data.host_wait_seconds`` histogram) is the time
+``__next__`` blocked on the queue — the bench row's "host wait" number.
+
+Checkpoint positioning: prefetch means the upstream stages run AHEAD of
+the consumer. ``get_state()`` therefore does NOT read the live stage
+state — the producer snapshots the pipeline state right after producing
+each batch, and the feeder re-associates each snapshot with the batch as
+it is yielded. The state you read after consuming batch k resumes at
+batch k+1, regardless of how deep the prefetch queue is.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from .protocol import CheckpointableIterator, iterator_state, restore_iterator
+
+
+def batch_sharding(mesh, batch_axes="dp"):
+    """NamedSharding placing dim 0 of each batch field over the mesh's data
+    axes (axis name or tuple of names, e.g. ("dp", "sharding"))."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if isinstance(batch_axes, str):
+        batch_axes = (batch_axes,)
+    missing = [a for a in batch_axes if a not in mesh.axis_names]
+    if missing:
+        raise ValueError(f"mesh {mesh.axis_names} has no axes {missing}")
+    return NamedSharding(mesh, P(tuple(batch_axes)))
+
+
+class GlobalBatchFeeder(CheckpointableIterator):
+    """Iterate device-resident (optionally mesh-global) batches with
+    transfer/compute overlap and exact checkpoint positioning.
+
+    ``upstream`` is the host-batch iterator (usually a SequencePacker; any
+    iterator of numpy pytrees works). ``sharding`` is a NamedSharding for
+    the batch (see ``batch_sharding``); None feeds the default device.
+    ``state_of``/``restore_to`` default to the upstream's own protocol
+    methods and may be overridden to snapshot a larger pipeline.
+    """
+
+    def __init__(self, upstream: Iterator, sharding=None,
+                 prefetch_depth: int = 2,
+                 state_of: Optional[Callable] = None,
+                 restore_to: Optional[Callable] = None):
+        self.upstream = upstream
+        self.sharding = sharding
+        self.prefetch_depth = max(1, int(prefetch_depth))
+        self._state_of = state_of or (lambda: iterator_state(self.upstream))
+        self._restore_to = restore_to or (
+            lambda s: restore_iterator(self.upstream, s))
+        self._last_state = None
+        # host-wait stats (consumer-side stalls)
+        self.batches_fed = 0
+        self.host_wait_s_total = 0.0
+
+    # ---------------- assembly ----------------
+    def _assemble(self, batch):
+        import jax
+
+        def put(leaf):
+            v = np.asarray(leaf) if not isinstance(leaf, jax.Array) else leaf
+            if self.sharding is not None and jax.process_count() > 1:
+                return jax.make_array_from_process_local_data(
+                    self.sharding, v)
+            return jax.device_put(v, self.sharding)
+
+        return jax.tree_util.tree_map(put, batch)
+
+    # ---------------- iteration ----------------
+    @property
+    def host_wait_ms_mean(self) -> float:
+        if not self.batches_fed:
+            return 0.0
+        return 1e3 * self.host_wait_s_total / self.batches_fed
+
+    def __iter__(self):
+        from ..io.prefetch import DevicePrefetcher
+        from ..observability import metrics as _metrics
+
+        pending = collections.deque()
+
+        def produce():
+            for host_batch in self.upstream:
+                dev = self._assemble(host_batch)
+                # snapshot AFTER producing: resuming from it starts at the
+                # NEXT batch. append-then-yield keeps the deque in lockstep
+                # with the prefetch queue (both FIFO, producer-ordered).
+                pending.append(self._state_of())
+                yield dev
+
+        # depth batches ride the queue device-resident; device_put in
+        # _assemble already ran in the producer thread, so _to_device's
+        # second put is a no-op re-commit
+        pre = iter(DevicePrefetcher(produce(), depth=self.prefetch_depth))
+        while True:
+            t0 = time.perf_counter()
+            try:
+                dev = next(pre)
+            except StopIteration:
+                return
+            wait = time.perf_counter() - t0  # consumer stalled this long
+            self._last_state = pending.popleft()
+            self.batches_fed += 1
+            self.host_wait_s_total += wait
+            if _metrics.enabled():
+                _metrics.histogram("data.host_wait_seconds", wait)
+            yield dev
+
+    # ---------------- protocol ----------------
+    def get_state(self):
+        """Pipeline state as of the last batch YIELDED to the consumer
+        (not the producer's read-ahead position)."""
+        if self._last_state is not None:
+            return self._last_state
+        return self._state_of()
+
+    def set_state(self, state) -> None:
+        self._restore_to(state)
+        self._last_state = state

@@ -7,6 +7,12 @@ distributed/entry_attr.py (ProbabilityEntry, CountFilterEntry, ShowClickEntry
 one subsystem without a TPU-idiomatic equivalent (SURVEY §7), so these keep
 the configuration/ingestion contract: datasets read whitespace-separated
 slot records from files into host memory batches feeding the device pipeline.
+
+File reading is backed by ``paddle_tpu.data.TextLineSource`` (the
+checkpointable sharded reader), with ``sort_files=False`` — set_filelist's
+explicit order IS the agreed order — so QueueDataset gains the
+``get_state``/``set_state`` resume protocol and InMemoryDataset's shuffle
+becomes epoch-deterministic for free.
 """
 
 from __future__ import annotations
@@ -42,13 +48,22 @@ class DatasetBase:
     def set_use_var(self, var_list):
         self._use_var = var_list
 
-    def _records(self):
-        for path in self._filelist:
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if line:
-                        yield np.asarray(line.split(), np.float32)
+    def _make_source(self):
+        from ..data.sources import TextLineSource
+
+        # the trainer already split the filelist per worker, so this reads
+        # the whole list in the caller's order: no re-shard, no re-sort
+        return TextLineSource(
+            self._filelist, sort_files=False, shuffle_shards=False,
+            repeat=False, process_index=0, process_count=1)
+
+    def _records(self, source=None):
+        if source is None:
+            if not self._filelist:  # pre-source behavior: empty yields nothing
+                return
+            source = self._make_source()
+        for line in source:
+            yield np.asarray(line.split(), np.float32)
 
 
 class InMemoryDataset(DatasetBase):
@@ -57,13 +72,22 @@ class InMemoryDataset(DatasetBase):
     def __init__(self):
         super().__init__()
         self._samples = []
+        self._epoch = 0
+        self._shuffle_seed = 0
+
+    def set_epoch(self, epoch: int):
+        self._epoch = int(epoch)
 
     def load_into_memory(self):
         self._samples = list(self._records())
 
     def local_shuffle(self):
-        rng = np.random.default_rng()
+        from ..data.protocol import mix_seed
+
+        # epoch-deterministic: a resumed run replays the same order
+        rng = np.random.default_rng(mix_seed(self._shuffle_seed, self._epoch))
         rng.shuffle(self._samples)
+        self._epoch += 1
 
     def global_shuffle(self, fleet=None, thread_num=12):
         self.local_shuffle()  # single-host scope
@@ -80,11 +104,33 @@ class InMemoryDataset(DatasetBase):
 
 
 class QueueDataset(DatasetBase):
-    """Streaming dataset: records flow straight from files, no memory residency."""
+    """Streaming dataset: records flow straight from files, no memory
+    residency. Checkpointable: ``get_state`` between batches captures the
+    underlying TextLineSource position (file cursor + line offset)."""
+
+    def __init__(self):
+        super().__init__()
+        self._source = None
+        self._pending_state = None
+
+    def get_state(self):
+        if self._source is not None:
+            return self._source.get_state()
+        return self._pending_state
+
+    def set_state(self, state):
+        self._pending_state = state
+        self._source = None
 
     def __iter__(self):
+        if not self._filelist:
+            return
+        self._source = self._make_source()
+        if self._pending_state is not None:
+            self._source.set_state(self._pending_state)
+            self._pending_state = None
         batch = []
-        for rec in self._records():
+        for rec in self._records(self._source):
             batch.append(rec)
             if len(batch) == self._batch_size:
                 yield batch

@@ -177,7 +177,8 @@ _auto_ckpt_state = {}
 
 
 def enable_auto_checkpoint(path: str, state_fn=None, layer=None, optimizer=None,
-                           every_n_steps: int = 0, keep_last_n: int = None):
+                           every_n_steps: int = 0, keep_last_n: int = None,
+                           data_loader=None):
     """Install a SIGTERM handler that snapshots training state before the
     process dies (preemption on TPU VMs delivers SIGTERM), plus an optional
     step-driven periodic save via `auto_checkpoint_step()`.
@@ -203,6 +204,14 @@ def enable_auto_checkpoint(path: str, state_fn=None, layer=None, optimizer=None,
             state["model"] = layer.state_dict()
         if optimizer is not None and hasattr(optimizer, "state_dict"):
             state["optimizer"] = optimizer.state_dict()
+        if data_loader is not None:
+            from ..data.protocol import iterator_state
+
+            # DataLoader.state_dict / DataPipeline.get_state — either
+            # protocol; restores give exact mid-epoch resume
+            pos = iterator_state(data_loader)
+            if pos is not None:
+                state["data_position"] = pos
         return state
 
     mgr = None

@@ -149,10 +149,17 @@ class ModelCheckpoint(Callback):
         self._global_step = 0
 
     def _collect_state(self):
+        from ..data.protocol import iterator_state
+
         state = {"model": self.model.network.state_dict()}
         opt = getattr(self.model, "_optimizer", None)
         if opt is not None and hasattr(opt, "state_dict"):
             state["optimizer"] = opt.state_dict()
+        # input-pipeline position (DataLoader / DataPipeline state): restore
+        # it to resume mid-epoch without replaying consumed batches
+        pos = iterator_state(getattr(self.model, "_train_loader", None))
+        if pos is not None:
+            state["data_position"] = pos
         return state
 
     def on_train_begin(self, logs=None):

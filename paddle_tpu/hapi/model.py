@@ -124,6 +124,9 @@ class Model:
         assert train_data is not None
         self._save_dir = save_dir
         loader = self._loader(train_data, batch_size, shuffle, num_workers)
+        # exposed so ModelCheckpoint(save_steps=N) can fold the loader's
+        # position into the step checkpoint (TrainState.data_position)
+        self._train_loader = loader
         cbks = CallbackList(_to_list(callbacks))
         if verbose:
             cbks.append(ProgBarLogger(log_freq, verbose=verbose))
@@ -140,6 +143,8 @@ class Model:
             if self.stop_training:
                 break
             cbks.on_epoch_begin(epoch)
+            if hasattr(loader, "set_epoch"):
+                loader.set_epoch(epoch)  # epoch-deterministic reshuffle
             for m in self._metrics:
                 m.reset()
             for step, batch in enumerate(loader):

@@ -79,6 +79,8 @@ class DataLoader:
         worker_init_fn: Optional[Callable] = None,
         persistent_workers: bool = False,
     ):
+        from ..core import random as _random
+
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
@@ -87,6 +89,13 @@ class DataLoader:
         self.timeout = timeout or None
         self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
+        # checkpointable-iterator bookkeeping (paddle_tpu.data protocol):
+        # epoch drives sampler reshuffling and worker RNG seeds; the batch
+        # cursor makes mid-epoch resume exact for deterministic samplers
+        self._epoch = 0
+        self._batches_done = 0
+        self._skip_batches = 0
+        self._base_seed = _random.default_generator.initial_seed()
         if self._iterable_mode:
             self.batch_sampler = None
             self.batch_size = batch_size
@@ -101,11 +110,53 @@ class DataLoader:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
 
+    # ---- checkpointable-iterator protocol (paddle_tpu.data) ----
+    def set_epoch(self, epoch: int):
+        """Pin the epoch used for sampler reseeding and worker RNG seeds.
+        Iteration advances it automatically; call this only to override."""
+        self._epoch = int(epoch)
+
+    def state_dict(self) -> dict:
+        """Loader position: (epoch, batches consumed this epoch) plus the
+        dataset's own state when it implements get_state. Plugs into
+        TrainState.data_position alongside a DataPipeline state."""
+        st = {"version": 1, "epoch": self._epoch,
+              "batches_done": self._batches_done,
+              "base_seed": self._base_seed}
+        if hasattr(self.dataset, "get_state"):
+            st["dataset"] = self.dataset.get_state()
+        return st
+
+    def load_state_dict(self, state: dict):
+        """Reposition: a checkpointable dataset restores through its own
+        set_state (no replay); otherwise the next epoch iteration replays
+        the (epoch-seeded, deterministic) sampler order and skips the
+        already-consumed batches."""
+        self._epoch = int(state.get("epoch", 0))
+        self._batches_done = int(state.get("batches_done", 0))
+        self._base_seed = int(state.get("base_seed", self._base_seed))
+        restored = False
+        if state.get("dataset") is not None and hasattr(self.dataset, "set_state"):
+            self.dataset.set_state(state["dataset"])
+            restored = True
+        self._skip_batches = 0 if restored else self._batches_done
+
+    # protocol aliases
+    get_state = state_dict
+    set_state = load_state_dict
+
+    def _worker_seed(self, wid: int) -> int:
+        from ..data.protocol import mix_seed
+
+        # varies per epoch (deterministic-but-distinct augmentation RNG),
+        # replays exactly after load_state_dict restores the epoch
+        return mix_seed(self._base_seed, self._epoch, wid)
+
     # ---- iteration ----
     def _fetch(self, indices):
         return self.collate_fn([self.dataset[i] for i in indices])
 
-    def _iter_single(self):
+    def _iter_single(self, skip: int = 0):
         if self._iterable_mode:
             it = iter(self.dataset)
             while True:
@@ -114,12 +165,17 @@ class DataLoader:
                     return
                 if len(chunk) < self.batch_size and self.drop_last:
                     return
+                if skip > 0:
+                    skip -= 1
+                    continue
                 yield self.collate_fn(chunk)
         else:
-            for indices in self.batch_sampler:
+            for i, indices in enumerate(self.batch_sampler):
+                if i < skip:
+                    continue  # replayed position: indices only, no fetch
                 yield self._fetch(indices)
 
-    def _iter_workers(self):
+    def _iter_workers(self, skip: int = 0):
         """Thread pool + ordered bounded prefetch queue."""
         n = self.num_workers
         depth = n * self.prefetch_factor
@@ -131,7 +187,7 @@ class DataLoader:
 
         if self._iterable_mode:
             # one worker streams; others idle (iterable split is dataset's job)
-            batches = self._iter_single()
+            batches = self._iter_single(skip)
 
             def produce():
                 for i, b in enumerate(batches):
@@ -162,12 +218,12 @@ class DataLoader:
                 i += 1
             return
 
-        indices_list = list(self.batch_sampler)
+        indices_list = list(self.batch_sampler)[skip:]
         for i, idx in enumerate(indices_list):
             task_q.put((i, idx))
 
         def worker(wid):
-            _worker_info_tls.info = WorkerInfo(wid, n, wid, self.dataset)
+            _worker_info_tls.info = WorkerInfo(wid, n, self._worker_seed(wid), self.dataset)
             if self.worker_init_fn is not None:
                 self.worker_init_fn(wid)
             while not stop.is_set():
@@ -201,10 +257,25 @@ class DataLoader:
         finally:
             stop.set()
 
+    def _run_epoch(self):
+        if self.batch_sampler is not None and hasattr(self.batch_sampler, "set_epoch"):
+            self.batch_sampler.set_epoch(self._epoch)
+        skip = self._skip_batches
+        self._skip_batches = 0
+        self._batches_done = skip
+        inner = (self._iter_single(skip) if self.num_workers == 0
+                 else self._iter_workers(skip))
+        for b in inner:
+            # count BEFORE yielding: state_dict() taken while the consumer
+            # holds batch k must say k+1 consumed (resume replays from k+1)
+            self._batches_done += 1
+            yield b
+        # clean epoch boundary: next __iter__ reshuffles under epoch+1
+        self._epoch += 1
+        self._batches_done = 0
+
     def __iter__(self):
-        if self.num_workers == 0:
-            return self._iter_single()
-        return self._iter_workers()
+        return self._run_epoch()
 
     def device_iter(self, device=None, depth: Optional[int] = None):
         """Iterate with async host→device staging (the reference's

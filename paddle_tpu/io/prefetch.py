@@ -94,6 +94,9 @@ class DevicePrefetcher:
                     q.get_nowait()
                 except queue.Empty:
                     break
+            # wait for the producer to leave device_put — a daemon thread
+            # killed inside the runtime at interpreter exit aborts the process
+            t.join(timeout=2.0)
 
 
 def prefetch_to_device(iterable: Iterable, depth: int = 2, device=None):

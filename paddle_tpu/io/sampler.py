@@ -27,23 +27,35 @@ class SequenceSampler(Sampler):
 
 
 class RandomSampler(Sampler):
+    """Shuffled indices, deterministic per (global seed, epoch): epoch k's
+    permutation is a pure function of ``paddle.seed``'s value and k, never
+    of ambient generator state — so a resumed run can replay any epoch's
+    order exactly (the reference's set_epoch contract). An explicit
+    `generator` opts back into stateful draws."""
+
     def __init__(self, data_source, replacement: bool = False, num_samples: int = None, generator=None):
         super().__init__(data_source)
         self.replacement = replacement
         self._num_samples = num_samples
         self.generator = generator
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = int(epoch)
 
     @property
     def num_samples(self):
         return self._num_samples if self._num_samples is not None else len(self.data_source)
 
     def _rng(self):
-        seed = (
-            self.generator.random()
-            if self.generator is not None
-            else _random.default_generator.random()
-        )
-        return np.random.RandomState(seed % (2**32))
+        from ..data.protocol import mix_seed
+
+        if self.generator is not None:
+            seed = self.generator.random() % (2**32)
+        else:
+            seed = mix_seed(_random.default_generator.initial_seed(),
+                            self.epoch)
+        return np.random.RandomState(seed)
 
     def __iter__(self):
         n = len(self.data_source)
@@ -103,6 +115,12 @@ class BatchSampler(Sampler):
         self.batch_size = int(batch_size)
         self.drop_last = drop_last
 
+    def set_epoch(self, epoch: int):
+        """Reseed shuffling for epoch `epoch` (delegates to the sampler).
+        DataLoader calls this automatically at each epoch boundary."""
+        if hasattr(self.sampler, "set_epoch"):
+            self.sampler.set_epoch(epoch)
+
     def __iter__(self):
         batch = []
         for idx in self.sampler:
@@ -136,9 +154,14 @@ class DistributedBatchSampler(BatchSampler):
         self.total_size = self.num_samples * self.num_replicas
 
     def __iter__(self):
+        from ..data.protocol import mix_seed
+
         n = len(self.data_source)
         if self.shuffle:
-            seed = (_random.default_generator.initial_seed() + self.epoch) % (2**32)
+            # every rank derives the same epoch permutation (seed and epoch
+            # agree fleet-wide), then takes its stride — disjoint shards,
+            # reshuffled per epoch, replayable on resume
+            seed = mix_seed(_random.default_generator.initial_seed(), self.epoch)
             indices = np.random.RandomState(seed).permutation(n).tolist()
         else:
             indices = list(range(n))

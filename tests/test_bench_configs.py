@@ -27,7 +27,8 @@ def test_all_configs_registered():
     import bench
 
     assert set(bench.CONFIGS) == {"bert_sst2", "gpt_dp", "ernie_mp4",
-                                  "resnet50", "gpt_moe", "serving", "ckpt"}
+                                  "resnet50", "gpt_moe", "serving", "ckpt",
+                                  "data"}
 
 
 def test_bench_ckpt_row_contract(capsys):
@@ -52,5 +53,30 @@ def test_bench_ckpt_row_contract(capsys):
     assert blocking["avg"] <= total["avg"]
     assert "ckpt.restore.seconds" in hists
     assert parsed["telemetry"]["counters"]["ckpt.save.bytes"] > 0
+    # the row must not leave the global observability flag flipped on
+    assert not observability.enabled()
+
+
+def test_bench_data_row_contract(capsys):
+    """The data row's acceptance invariant: packing efficiency >= 0.85 on
+    the synthetic mixed-length doc mix, with the data.* metric series in
+    the telemetry sub-object."""
+    import bench
+    from paddle_tpu import observability
+
+    row = bench.bench_data()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(out)
+    assert parsed == row
+    assert parsed["config"] == "data"
+    assert parsed["value"] > 0 and np.isfinite(parsed["value"])
+    assert parsed["packing_efficiency"] >= 0.85
+    assert parsed["host_wait_ms_mean"] >= 0.0
+    assert parsed["batch_shape"][1] == 1024
+    tele = parsed["telemetry"]
+    assert tele["counters"]["data.batches"] > 0
+    assert tele["counters"]["data.tokens"] > 0
+    assert tele["histograms"]["data.host_wait_seconds"]["count"] > 0
+    assert 0.0 < tele["gauges"]["data.packing.efficiency"] <= 1.0
     # the row must not leave the global observability flag flipped on
     assert not observability.enabled()

@@ -1,0 +1,465 @@
+"""paddle_tpu.data: deterministic sharded sources, sequence packing,
+global-batch feeding, and the exact mid-epoch-resume contract
+(state -> TrainState.data_position -> CheckpointManager -> restore)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.data import (
+    DataPipeline,
+    GlobalBatchFeeder,
+    SequencePacker,
+    TokenBinSource,
+    build_pretrain_pipeline,
+    expand_files,
+    mix_seed,
+    shard_assignment,
+)
+
+EOS = 1
+
+
+def write_shards(tmp_path, n_shards=4, docs_per_shard=25, lo=6, hi=40,
+                 seed=0):
+    """Tiny .bin token shards with eos-delimited variable-length docs.
+    Tokens are >= 2 so eos/pad never collide with payload."""
+    rng = np.random.RandomState(seed)
+    paths = []
+    for s in range(n_shards):
+        docs = []
+        for _ in range(docs_per_shard):
+            n = rng.randint(lo, hi)
+            d = rng.randint(2, 1000, size=n).astype(np.uint16)
+            d[-1] = EOS
+            docs.append(d)
+        p = tmp_path / f"shard_{s:02d}.bin"
+        np.concatenate(docs).tofile(p)
+        paths.append(str(p))
+    return paths
+
+
+def take(it, n):
+    return [next(it) for _ in range(n)]
+
+
+def batches_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+
+
+# ---------------------------------------------------------------- protocol
+
+def test_mix_seed_pure_and_decorrelated():
+    assert mix_seed(7, 3) == mix_seed(7, 3)
+    assert 0 <= mix_seed(7, 3) < 2**32
+    seen = {mix_seed(7, e) for e in range(100)}
+    assert len(seen) == 100  # epochs decorrelate
+    assert mix_seed(7, 3) != mix_seed(3, 7)  # order matters
+
+
+def test_expand_files_sorted_vs_order_preserving(tmp_path):
+    paths = write_shards(tmp_path, n_shards=3, docs_per_shard=2)
+    rev = list(reversed(paths))
+    assert expand_files(rev) == sorted(paths)
+    assert expand_files(rev, sort=False) == rev
+    assert expand_files(str(tmp_path / "*.bin")) == sorted(paths)
+
+
+# ------------------------------------------------------------- assignment
+
+def test_shard_assignment_disjoint_and_covering(tmp_path):
+    files = [f"f{i}" for i in range(13)]
+    for epoch in range(3):
+        per_host = [shard_assignment(files, p, 4, seed=5, epoch=epoch)
+                    for p in range(4)]
+        flat = [f for hs in per_host for f in hs]
+        assert sorted(flat) == sorted(files)  # covering, disjoint
+        # pure function: recomputing gives the identical assignment
+        assert per_host[2] == shard_assignment(files, 2, 4, seed=5,
+                                               epoch=epoch)
+    # epochs reshuffle
+    assert (shard_assignment(files, 0, 4, seed=5, epoch=0)
+            != shard_assignment(files, 0, 4, seed=5, epoch=1))
+    with pytest.raises(ValueError):
+        shard_assignment(files, 4, 4)
+
+
+def test_source_requires_one_shard_per_host(tmp_path):
+    paths = write_shards(tmp_path, n_shards=2)
+    with pytest.raises(ValueError):
+        TokenBinSource(paths, eos_id=EOS, process_index=0, process_count=3)
+
+
+# ---------------------------------------------------------------- sources
+
+def test_token_bin_doc_boundaries(tmp_path):
+    docs = [np.array([5, 6, EOS], np.uint16),
+            np.array([7, EOS], np.uint16),
+            np.array([8, 9, 10], np.uint16)]  # trailing, no eos
+    p = tmp_path / "one.bin"
+    np.concatenate(docs).tofile(p)
+    src = TokenBinSource([str(p)], eos_id=EOS, process_index=0,
+                         process_count=1, shuffle_shards=False, repeat=False)
+    got = list(src)
+    assert len(got) == 3
+    np.testing.assert_array_equal(got[0], [5, 6, EOS])  # eos stays with doc
+    np.testing.assert_array_equal(got[2], [8, 9, 10])   # trailing tail doc
+    # chunk mode: fixed-length splits, last partial kept
+    src = TokenBinSource([str(p)], chunk_len=3, process_index=0,
+                         process_count=1, shuffle_shards=False, repeat=False)
+    chunks = list(src)
+    assert [len(c) for c in chunks] == [3, 3, 2]
+
+
+def test_source_midepoch_resume_exact(tmp_path):
+    paths = write_shards(tmp_path)
+
+    def build():
+        return TokenBinSource(paths, eos_id=EOS, seed=3, process_index=0,
+                              process_count=1, shuffle_shards=True,
+                              repeat=True)
+
+    src = build()
+    take(src, 37)
+    state = json.loads(json.dumps(src.get_state()))  # JSON-plain
+    expect = take(src, 80)  # crosses shard (and possibly epoch) boundaries
+
+    resumed = build()
+    resumed.set_state(state)
+    got = take(resumed, 80)
+    for e, g in zip(expect, got):
+        np.testing.assert_array_equal(e, g)
+
+
+def test_source_epochs_reshuffle_deterministically(tmp_path):
+    paths = write_shards(tmp_path, n_shards=5, docs_per_shard=4)
+
+    def epoch_stream(skip, n):
+        src = TokenBinSource(paths, eos_id=EOS, seed=9, process_index=0,
+                             process_count=1, shuffle_shards=True,
+                             repeat=True)
+        take(src, skip)
+        return [tuple(d.tolist()) for d in take(src, n)]
+
+    n = 20  # one full epoch
+    e0, e1 = epoch_stream(0, n), epoch_stream(n, n)
+    assert sorted(e0) == sorted(e1)  # same docs
+    assert e0 != e1                  # different order
+    assert e0 == epoch_stream(0, n)  # replayable
+
+
+def test_empty_shards_raise_instead_of_spinning(tmp_path):
+    p = tmp_path / "empty.bin"
+    p.write_bytes(b"")
+    src = TokenBinSource([str(p)], eos_id=EOS, process_index=0,
+                         process_count=1, repeat=True)
+    with pytest.raises(RuntimeError, match="no records"):
+        next(src)
+
+
+# ---------------------------------------------------------------- packing
+
+def test_packer_static_shapes_and_masks(tmp_path):
+    paths = write_shards(tmp_path)
+    src = TokenBinSource(paths, eos_id=EOS, process_index=0, process_count=1,
+                         repeat=True)
+    packer = SequencePacker(src, batch_size=3, seq_len=32)
+    for batch in take(packer, 6):
+        for k in ("tokens", "segment_ids", "positions"):
+            assert batch[k].shape == (3, 32)
+            assert batch[k].dtype == np.int32
+        toks, segs, pos = (batch["tokens"], batch["segment_ids"],
+                          batch["positions"])
+        # pad cells: segment 0, token pad_id, position 0
+        np.testing.assert_array_equal(toks[segs == 0], 0)
+        np.testing.assert_array_equal(pos[segs == 0], 0)
+        for r in range(3):
+            row_segs = segs[r][segs[r] > 0]
+            if row_segs.size:
+                # 1-based contiguous per-row ids
+                assert row_segs.min() == 1
+                assert set(np.unique(row_segs)) == set(
+                    range(1, row_segs.max() + 1))
+            for s in np.unique(row_segs):
+                span = pos[r][segs[r] == s]
+                np.testing.assert_array_equal(
+                    span, np.arange(len(span)))  # positions reset per doc
+
+
+def test_packer_truncate_vs_split(tmp_path):
+    long_doc = np.arange(2, 52, dtype=np.uint16)
+    long_doc[-1] = EOS
+    p = tmp_path / "long.bin"
+    np.concatenate([long_doc, long_doc]).tofile(p)
+
+    def build(**kw):
+        src = TokenBinSource([str(p)], eos_id=EOS, process_index=0,
+                             process_count=1, repeat=False)
+        return SequencePacker(src, batch_size=1, seq_len=16,
+                              drop_remainder=False, **kw)
+
+    packer = build()
+    got = list(packer)
+    assert packer.docs_truncated == 2
+    assert packer.tokens_truncated == 2 * (50 - 16)
+    assert all(b["tokens"].shape == (1, 16) for b in got)
+
+    packer = build(split_long_docs=True)
+    got = list(packer)
+    # lossless: every input token reappears exactly once
+    out = np.concatenate([b["tokens"][b["segment_ids"] > 0] for b in got])
+    assert out.size == 100
+    assert packer.tokens_truncated == 0
+
+
+def test_packer_efficiency_on_synthetic_mix(tmp_path):
+    # the bench --config data mix at the bench's S: acceptance >= 0.85
+    rng = np.random.RandomState(0)
+    docs = []
+    for _ in range(150):
+        n = (rng.randint(32, 256) if rng.random_sample() < 0.75
+             else rng.randint(256, 768))
+        d = rng.randint(2, 1000, size=n).astype(np.uint16)
+        d[-1] = EOS
+        docs.append(d)
+    p = tmp_path / "mix.bin"
+    np.concatenate(docs).tofile(p)
+    src = TokenBinSource([str(p)], eos_id=EOS, process_index=0,
+                         process_count=1, repeat=True)
+    packer = SequencePacker(src, batch_size=4, seq_len=1024)
+    take(packer, 8)
+    assert packer.efficiency >= 0.85
+
+
+def test_packer_state_carry_roundtrip(tmp_path):
+    paths = write_shards(tmp_path)
+
+    def build():
+        src = TokenBinSource(paths, eos_id=EOS, process_index=0,
+                             process_count=1, repeat=True)
+        return src, SequencePacker(src, batch_size=2, seq_len=24)
+
+    src, packer = build()
+    take(packer, 5)
+    src_state, pk_state = src.get_state(), packer.get_state()
+    expect = take(packer, 7)
+
+    src2, packer2 = build()
+    src2.set_state(json.loads(json.dumps(src_state)))
+    packer2.set_state(json.loads(json.dumps(pk_state)))
+    for e, g in zip(expect, take(packer2, 7)):
+        batches_equal(e, g)
+
+
+# --------------------------------------------------------------- pipeline
+
+def test_pipeline_midepoch_resume_host_only(tmp_path):
+    paths = write_shards(tmp_path)
+
+    def build():
+        return build_pretrain_pipeline(paths, 2, 24, eos_id=EOS, seed=4,
+                                       device_feed=False)
+
+    pipe = build()
+    it = iter(pipe)
+    take(it, 5)
+    state = json.loads(json.dumps(pipe.get_state()))
+    expect = take(it, 8)
+
+    pipe2 = build()
+    pipe2.set_state(state)
+    for e, g in zip(expect, take(iter(pipe2), 8)):
+        batches_equal(e, g)
+
+
+def test_pipeline_resume_under_device_prefetch(tmp_path):
+    """get_state after consuming batch k resumes at k+1 even though the
+    prefetch producer has run several batches ahead."""
+    paths = write_shards(tmp_path)
+
+    def build():
+        return build_pretrain_pipeline(paths, 2, 24, eos_id=EOS, seed=4,
+                                       prefetch_depth=3, device_feed=True)
+
+    pipe = build()
+    it = iter(pipe)
+    take(it, 5)
+    state = json.loads(json.dumps(pipe.get_state()))
+    expect = take(it, 8)
+    it.close()
+
+    pipe2 = build()
+    pipe2.set_state(state)
+    it2 = iter(pipe2)
+    for e, g in zip(expect, take(it2, 8)):
+        batches_equal(e, g)
+    it2.close()
+
+
+def test_pipeline_state_version_checked(tmp_path):
+    paths = write_shards(tmp_path)
+    pipe = build_pretrain_pipeline(paths, 2, 24, eos_id=EOS,
+                                   device_feed=False)
+    with pytest.raises(ValueError, match="version"):
+        pipe.set_state({"version": 99})
+
+
+def test_pipeline_rejects_bare_next(tmp_path):
+    paths = write_shards(tmp_path)
+    pipe = build_pretrain_pipeline(paths, 2, 24, eos_id=EOS,
+                                   device_feed=False)
+    with pytest.raises(TypeError):
+        next(pipe)
+
+
+# ------------------------------------------------------- simulated multi-host
+
+def test_multihost_disjoint_coverage(tmp_path):
+    paths = write_shards(tmp_path, n_shards=6)
+
+    def host_docs(p, count):
+        src = TokenBinSource(paths, eos_id=EOS, seed=2, process_index=p,
+                             process_count=count, repeat=False)
+        return [tuple(d.tolist()) for d in src]
+
+    per_host = [host_docs(p, 3) for p in range(3)]
+    all_docs = host_docs(0, 1)
+    flat = [d for h in per_host for d in h]
+    assert sorted(flat) == sorted(all_docs)  # disjoint + covering
+
+
+def test_multihost_kill_and_reconstruct(tmp_path):
+    """Both simulated hosts checkpoint mid-epoch; reconstructed pipelines
+    continue with exactly the batches the uninterrupted run produces."""
+    paths = write_shards(tmp_path, n_shards=6)
+
+    def build(p):
+        return build_pretrain_pipeline(
+            paths, 2, 24, eos_id=EOS, seed=7, process_index=p,
+            process_count=2, device_feed=False)
+
+    states, expect = {}, {}
+    for p in range(2):
+        pipe = build(p)
+        it = iter(pipe)
+        take(it, 4)
+        states[p] = json.loads(json.dumps(pipe.get_state()))
+        expect[p] = take(it, 6)
+
+    for p in range(2):  # "restarted" processes
+        pipe = build(p)
+        pipe.set_state(states[p])
+        for e, g in zip(expect[p], take(iter(pipe), 6)):
+            batches_equal(e, g)
+
+
+# ------------------------------------------------ checkpoint integration
+
+def test_data_position_roundtrips_through_checkpoint_manager(tmp_path):
+    from paddle_tpu.checkpoint import CheckpointManager, TrainState
+
+    paths = write_shards(tmp_path)
+
+    def build():
+        return build_pretrain_pipeline(paths, 2, 24, eos_id=EOS, seed=11,
+                                       device_feed=False)
+
+    pipe = build()
+    it = iter(pipe)
+    take(it, 3)
+    st = TrainState(params={"w": np.arange(4, dtype=np.float32)},
+                    opt_state={}, step=3,
+                    data_position=pipe.get_state())
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_=False)
+    mgr.save(3, st.to_tree())
+    expect = take(it, 5)
+
+    restored = TrainState.from_tree(mgr.restore())
+    mgr.close()
+    assert restored.step == 3
+    pipe2 = build()
+    pipe2.set_state(restored.data_position)
+    for e, g in zip(expect, take(iter(pipe2), 5)):
+        batches_equal(e, g)
+
+
+# ------------------------------------------------------------------ feed
+
+def test_global_batch_feeder_yields_device_arrays(tmp_path):
+    import jax
+
+    paths = write_shards(tmp_path)
+    src = TokenBinSource(paths, eos_id=EOS, process_index=0, process_count=1,
+                         repeat=True)
+    packer = SequencePacker(src, batch_size=2, seq_len=16)
+    feeder = GlobalBatchFeeder(packer, prefetch_depth=2)
+    it = iter(feeder)
+    batch = next(it)
+    assert isinstance(batch["tokens"], jax.Array)
+    assert batch["tokens"].shape == (2, 16)
+    assert feeder.batches_fed == 1
+    assert feeder.host_wait_ms_mean >= 0.0
+    it.close()
+
+
+def test_batch_sharding_validates_axes():
+    import jax
+    from paddle_tpu.data import batch_sharding
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("dp",))
+    sh = batch_sharding(mesh, "dp")
+    assert sh.spec == jax.sharding.PartitionSpec(("dp",))
+    with pytest.raises(ValueError, match="no axes"):
+        batch_sharding(mesh, "mp")
+
+
+# ------------------------------------------------------------ observability
+
+def test_packing_metrics_flag_gated(tmp_path):
+    from paddle_tpu import observability
+
+    paths = write_shards(tmp_path)
+    src = TokenBinSource(paths, eos_id=EOS, process_index=0, process_count=1,
+                         repeat=True)
+    packer = SequencePacker(src, batch_size=2, seq_len=24)
+    was = observability.enabled()
+    observability.enable()
+    try:
+        take(packer, 3)
+        snap = observability.snapshot()
+    finally:
+        if not was:
+            observability.disable()
+    assert snap["counters"]["data.batches"] >= 3
+    assert snap["counters"]["data.tokens"] > 0
+    assert 0.0 < snap["gauges"]["data.packing.efficiency"] <= 1.0
+
+
+# -------------------------------------------------------------- tooling
+
+def test_data_inspect_tool_runs_without_jax(tmp_path):
+    write_shards(tmp_path, n_shards=2)
+    script = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                          "data_inspect.py")
+    code = (
+        "import sys, runpy\n"
+        f"sys.argv = ['data_inspect.py', {str(tmp_path / '*.bin')!r}, "
+        "'--eos-id', '1', '--processes', '2', '--pack', '2', '32', '--json']\n"
+        f"try: runpy.run_path({script!r}, run_name='__main__')\n"
+        "except SystemExit as e:\n"
+        "    assert (e.code or 0) == 0, e.code\n"
+        "assert 'jax' not in sys.modules, 'tool must not import jax'\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["files"] == 2
+    assert len(out["assignment"]) == 2
+    assert 0.0 < out["pack"]["efficiency"] <= 1.0

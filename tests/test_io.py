@@ -207,3 +207,182 @@ def test_auto_checkpoint_periodic_and_sigterm(tmp_path):
         assert "model" in state and "optimizer" in state
     finally:
         paddle.framework.disable_auto_checkpoint()
+
+
+# ---------------- epoch determinism + checkpointable loader state ----------------
+
+def _order(loader):
+    return [int(b[0]._value[0]) for b in loader]
+
+
+def test_random_sampler_epoch_determinism():
+    paddle.seed(77)
+    s = RandomSampler(list(range(16)))
+    s.set_epoch(0)
+    e0 = list(s)
+    s.set_epoch(1)
+    e1 = list(s)
+    assert e0 != e1                 # epochs reshuffle
+    s.set_epoch(0)
+    assert list(s) == e0            # pure function of (seed, epoch)
+    paddle.seed(77)
+    s2 = RandomSampler(list(range(16)))
+    assert list(s2) == e0           # and of the global seed, not RNG state
+
+
+def test_distributed_batch_sampler_set_epoch_replayable():
+    ds = _Square(24)
+    s = DistributedBatchSampler(ds, batch_size=4, num_replicas=2, rank=0,
+                                shuffle=True)
+    s.set_epoch(0)
+    e0 = list(s)
+    s.set_epoch(3)
+    e3 = list(s)
+    assert e0 != e3
+    s.set_epoch(0)
+    assert list(s) == e0
+    # ranks stay disjoint under any epoch
+    s1 = DistributedBatchSampler(ds, batch_size=4, num_replicas=2, rank=1,
+                                 shuffle=True)
+    s1.set_epoch(3)
+    flat0 = {i for b in e3 for i in b}
+    flat1 = {i for b in s1 for i in b}
+    assert not flat0 & flat1
+
+
+def test_dataloader_auto_epoch_reshuffles():
+    paddle.seed(5)
+    loader = DataLoader(_Square(12), batch_size=1, shuffle=True)
+    e0, e1 = _order(loader), _order(loader)  # epoch auto-bumps per pass
+    assert sorted(e0) == sorted(e1)
+    assert e0 != e1
+    loader.set_epoch(0)
+    assert _order(loader) == e0
+
+
+def test_dataloader_state_dict_midepoch_resume():
+    paddle.seed(9)
+
+    def build():
+        return DataLoader(_Square(20), batch_size=2, shuffle=True)
+
+    loader = build()
+    it = iter(loader)
+    for _ in range(3):
+        next(it)
+    state = loader.state_dict()
+    assert state["epoch"] == 0 and state["batches_done"] == 3
+    expect = [b[0]._value.tolist() for b in it]  # rest of the epoch
+
+    paddle.seed(9)
+    resumed = build()
+    resumed.load_state_dict(state)
+    got = [b[0]._value.tolist() for b in iter(resumed)]
+    assert got == expect
+
+
+def test_dataloader_worker_seed_varies_per_epoch():
+    from paddle_tpu.io.dataloader import get_worker_info
+
+    seeds = []
+
+    class _Probe(Dataset):
+        def __getitem__(self, i):
+            info = get_worker_info()
+            if info is not None:
+                seeds.append(info.seed)
+            return np.float32(i)
+
+        def __len__(self):
+            return 4
+
+    loader = DataLoader(_Probe(), batch_size=2, num_workers=1)
+    list(loader)
+    first = set(seeds)
+    seeds.clear()
+    list(loader)  # epoch auto-bumped
+    second = set(seeds)
+    assert len(first) == len(second) == 1
+    assert first != second          # new epoch -> new worker seed
+
+
+def test_queue_dataset_checkpointable(tmp_path):
+    from paddle_tpu.distributed.fleet_dataset import QueueDataset
+
+    for s in range(2):
+        (tmp_path / f"{s}.txt").write_text(
+            "\n".join(f"{s} {i}" for i in range(6)) + "\n")
+    files = [str(tmp_path / "0.txt"), str(tmp_path / "1.txt")]
+
+    def build():
+        ds = QueueDataset()
+        ds.init(batch_size=2)
+        ds.set_filelist(files)
+        return ds
+
+    ds = build()
+    it = iter(ds)
+    next(it), next(it)
+    state = ds.get_state()
+    expect = [[r.tolist() for r in b] for b in it]
+
+    ds2 = build()
+    ds2.set_state(state)
+    got = [[r.tolist() for r in b] for b in iter(ds2)]
+    assert got == expect
+
+
+def test_inmemory_dataset_shuffle_deterministic(tmp_path):
+    from paddle_tpu.distributed.fleet_dataset import InMemoryDataset
+
+    (tmp_path / "a.txt").write_text("\n".join(str(i) for i in range(12)))
+
+    def build():
+        ds = InMemoryDataset()
+        ds.init(batch_size=3)
+        ds.set_filelist([str(tmp_path / "a.txt")])
+        ds.load_into_memory()
+        return ds
+
+    a, b = build(), build()
+    a.local_shuffle()
+    b.local_shuffle()
+    assert [r.tolist() for bt in a for r in bt] == \
+           [r.tolist() for bt in b for r in bt]
+    c = build()
+    c.local_shuffle()
+    c.local_shuffle()  # epoch advanced -> different order
+    assert [r.tolist() for bt in a for r in bt] != \
+           [r.tolist() for bt in c for r in bt]
+
+
+def test_auto_checkpoint_includes_data_position(tmp_path):
+    import signal
+
+    from paddle_tpu.data import build_pretrain_pipeline
+
+    rng = np.random.RandomState(0)
+    toks = rng.randint(2, 99, size=400).astype(np.uint16)
+    toks[::20] = 1
+    (tmp_path / "t.bin").write_bytes(toks.tobytes())
+    pipe = build_pretrain_pipeline(str(tmp_path / "t.bin"), 2, 16, eos_id=1,
+                                   device_feed=False)
+    it = iter(pipe)
+    next(it), next(it)
+
+    path = str(tmp_path / "auto.pdparams")
+    net = paddle.nn.Linear(2, 2)
+    paddle.framework.enable_auto_checkpoint(path, layer=net, data_loader=pipe)
+    try:
+        with pytest.raises(SystemExit):
+            signal.raise_signal(signal.SIGTERM)
+        state = paddle.load(path)
+        assert state["data_position"]["batches"] == 2
+        pipe2 = build_pretrain_pipeline(str(tmp_path / "t.bin"), 2, 16,
+                                        eos_id=1, device_feed=False)
+        pipe2.set_state(state["data_position"])
+        a = next(iter(pipe))
+        b = next(iter(pipe2))
+        np.testing.assert_array_equal(a["tokens"], b["tokens"])
+    finally:
+        paddle.framework.disable_auto_checkpoint()

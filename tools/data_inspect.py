@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Inspect paddle_tpu.data shard files: per-shard document stats, per-host
+shard assignment, and offline packing simulation.
+
+Usage:
+    python tools/data_inspect.py 'shards/*.bin' --eos-id 0        # doc stats
+    python tools/data_inspect.py 'shards/*.bin' --eos-id 0 \
+        --processes 4                      # shard -> host assignment table
+    python tools/data_inspect.py 'shards/*.bin' --eos-id 0 \
+        --pack 8 1024                      # packing-efficiency simulation
+    python tools/data_inspect.py 'shards/*.jsonl' --format jsonl --json
+
+Runs standalone — no paddle_tpu (or jax) import: the data-source and
+packing modules are numpy/stdlib-only and are loaded directly from
+paddle_tpu/data/, so the tool works on shard sets copied off a TPU host.
+Exit code 1 on unreadable/empty shard sets.
+
+Formats/contracts: see paddle_tpu/data/README.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+import types
+
+import numpy as np
+
+# Load paddle_tpu/data/{protocol,sources,packing}.py as a synthetic package:
+# executing paddle_tpu/__init__.py would initialize jax, which this tool
+# must not require (and the data modules do not).
+_DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir, "paddle_tpu", "data")
+_pkg = types.ModuleType("_ptdata")
+_pkg.__path__ = [_DATA_DIR]
+sys.modules.setdefault("_ptdata", _pkg)
+protocol = importlib.import_module("_ptdata.protocol")
+sources = importlib.import_module("_ptdata.sources")
+packing = importlib.import_module("_ptdata.packing")
+
+
+def _make_source(files, args, **extra):
+    kw = dict(seed=args.seed, process_index=0, process_count=1,
+              shuffle_shards=False, repeat=False, **extra)
+    if args.format == "bin":
+        return sources.TokenBinSource(files, dtype=args.dtype,
+                                      eos_id=args.eos_id,
+                                      chunk_len=args.chunk_len, **kw)
+    if args.format == "jsonl":
+        return sources.JsonlSource(files, **kw)
+    return sources.TextLineSource(files, **kw)
+
+
+def shard_stats(files, args):
+    """[{file, bytes, docs, tokens, doc_len: {min, mean, p50, p95, max}}]"""
+    src = _make_source(files, args)
+    rows = []
+    for f in files:
+        docs = src._read_shard(f)
+        lens = np.array([len(d) if hasattr(d, "__len__") else 1
+                         for d in docs], dtype=np.int64)
+        row = {"file": f, "bytes": os.path.getsize(f), "docs": len(docs)}
+        if len(lens):
+            row["tokens"] = int(lens.sum())
+            row["doc_len"] = {
+                "min": int(lens.min()), "mean": round(float(lens.mean()), 1),
+                "p50": int(np.percentile(lens, 50)),
+                "p95": int(np.percentile(lens, 95)), "max": int(lens.max()),
+            }
+        else:
+            row["tokens"] = 0
+            row["doc_len"] = None
+        rows.append(row)
+    return rows
+
+
+def assignment_table(files, args):
+    """Per-host shard lists at (seed, epoch) — the exact sets each
+    process_index reads, disjoint and covering by construction."""
+    return [{"process_index": p,
+             "shards": sources.shard_assignment(
+                 files, p, args.processes, seed=args.seed, epoch=args.epoch,
+                 shuffle=not args.no_shuffle)}
+            for p in range(args.processes)]
+
+
+def pack_simulation(files, args, batch_size, seq_len):
+    """Run the real SequencePacker over the shard set (process 0's view of
+    a 1-host fleet) and report the efficiency the training job would see."""
+    src = _make_source(files, args)
+    packer = packing.SequencePacker(src, batch_size, seq_len,
+                                    split_long_docs=args.split_long_docs)
+    batches = 0
+    for _ in packer:
+        batches += 1
+        if args.batches and batches >= args.batches:
+            break
+    return {
+        "batch_size": batch_size, "seq_len": seq_len, "batches": batches,
+        "efficiency": round(packer.efficiency, 4),
+        "docs_packed": packer.docs_packed,
+        "docs_truncated": packer.docs_truncated,
+        "tokens_packed": packer.tokens_packed,
+        "tokens_truncated": packer.tokens_truncated,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", help="shard path or glob (quote the glob)")
+    ap.add_argument("--format", choices=["bin", "jsonl", "text"],
+                    default="bin")
+    ap.add_argument("--dtype", default="uint16", help=".bin token dtype")
+    ap.add_argument("--eos-id", type=int, default=None,
+                    help=".bin document delimiter token")
+    ap.add_argument("--chunk-len", type=int, default=None,
+                    help=".bin fixed-length chunking (alternative to eos)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--no-shuffle", action="store_true",
+                    help="assignment without the epoch permutation")
+    ap.add_argument("--processes", type=int, default=0,
+                    help="show the per-host shard assignment for N hosts")
+    ap.add_argument("--pack", nargs=2, type=int, metavar=("B", "S"),
+                    default=None, help="simulate packing into [B, S] batches")
+    ap.add_argument("--batches", type=int, default=0,
+                    help="cap --pack at N batches (default: whole epoch)")
+    ap.add_argument("--split-long-docs", action="store_true")
+    ap.add_argument("--json", action="store_true", help="emit JSON")
+    args = ap.parse_args(argv)
+
+    if args.format == "bin" and args.eos_id is None and args.chunk_len is None:
+        print("--format bin needs --eos-id or --chunk-len", file=sys.stderr)
+        return 1
+    files = sources.expand_files(args.files)
+    if not files:
+        print(f"{args.files}: no files match", file=sys.stderr)
+        return 1
+
+    try:
+        rows = shard_stats(files, args)
+    except (OSError, ValueError, FileNotFoundError) as exc:
+        print(f"unreadable shard set: {exc}", file=sys.stderr)
+        return 1
+    out = {"files": len(files), "format": args.format, "shards": rows,
+           "total_docs": sum(r["docs"] for r in rows),
+           "total_tokens": sum(r["tokens"] for r in rows)}
+    if args.processes:
+        out["assignment"] = assignment_table(files, args)
+    if args.pack:
+        out["pack"] = pack_simulation(files, args, *args.pack)
+
+    if args.json:
+        print(json.dumps(out, indent=1, sort_keys=True))
+        return 0
+
+    print(f"{out['files']} shard file(s), {out['total_docs']} docs, "
+          f"{out['total_tokens']} tokens")
+    print(f"{'file':<48} {'bytes':>10} {'docs':>7} {'tokens':>10}  doc_len")
+    for r in rows:
+        dl = r["doc_len"]
+        dls = (f"min={dl['min']} mean={dl['mean']} p50={dl['p50']} "
+               f"p95={dl['p95']} max={dl['max']}") if dl else "-"
+        print(f"{r['file'][-47:]:<48} {r['bytes']:>10} {r['docs']:>7} "
+              f"{r['tokens']:>10}  {dls}")
+    if "assignment" in out:
+        print(f"\nassignment (seed={args.seed}, epoch={args.epoch}, "
+              f"shuffle={not args.no_shuffle}):")
+        for a in out["assignment"]:
+            names = ", ".join(os.path.basename(f) for f in a["shards"])
+            print(f"  host {a['process_index']}: {names}")
+    if "pack" in out:
+        p = out["pack"]
+        print(f"\npack [B={p['batch_size']}, S={p['seq_len']}]: "
+              f"{p['batches']} batches, efficiency {p['efficiency']}, "
+              f"{p['docs_packed']} docs packed, "
+              f"{p['docs_truncated']} truncated")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
