@@ -31,6 +31,18 @@ def main():
     ap.add_argument("--lr", type=float, default=1e-4)
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--mp", type=int, default=1)
+    ap.add_argument("--grad-reduce", default=None,
+                    choices=["off", "fp32", "int8", "bf16"],
+                    help="explicit gradient-reduction strategy "
+                         "(distributed/comm_opt): fp32 = hierarchical "
+                         "reduce-scatter/all-gather, int8/bf16 = quantized "
+                         "wire format with error feedback; default = XLA's "
+                         "implicit all-reduce. Plan preview: "
+                         "tools/comm_plan.py")
+    ap.add_argument("--accum", type=int, default=0,
+                    help="gradient accumulation microbatches (with "
+                         "--grad-reduce, reductions overlap microbatch "
+                         "boundaries)")
     ap.add_argument("--save", default=None, help="checkpoint path prefix")
     ap.add_argument("--data", default=None,
                     help="token .bin shard glob (paddle_tpu.data pipeline); "
@@ -78,7 +90,9 @@ def main():
     opt = paddle.optimizer.AdamW(
         learning_rate=args.lr, parameters=model.parameters(),
         multi_precision=on_tpu, moment_dtype="bfloat16" if on_tpu else None)
-    step = make_sharded_train_step(model, opt)
+    step = make_sharded_train_step(
+        model, opt, grad_reduce=args.grad_reduce,
+        accumulate_steps=args.accum or None)
 
     pipe = data_it = None
     if args.data:

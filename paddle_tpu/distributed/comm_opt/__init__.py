@@ -1,0 +1,13 @@
+"""Gradient-reduction communication optimizer (see README.md here).
+
+Quantized (block-scaled int8 / bf16) + hierarchical (per-mesh-axis)
+gradient collectives with error feedback, selected by ShardedTrainStep's
+`grad_reduce=` config. config/plan are pure python (tools/comm_plan.py
+loads them without jax); reduce is the jax execution layer.
+"""
+
+from .config import (DATA_AXES, GradReduceConfig,  # noqa: F401
+                     from_fleet_strategy, normalize_grad_reduce)
+from .plan import ReducePlan, build_plan, describe, plan_as_dict  # noqa: F401
+from .reduce import (GradReducer, make_tree_reducer,  # noqa: F401
+                     record_reduce_metrics, reducer_for_step)

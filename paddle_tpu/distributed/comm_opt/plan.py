@@ -1,0 +1,227 @@
+"""Reduction planning: bucketing + per-stage byte accounting.
+
+Pure python/stdlib — no jax import. Shared by three consumers:
+- reduce.GradReducer lays out its flattened buckets from this plan,
+- bench.py reports bytes-on-wire / compression ratio from it,
+- tools/comm_plan.py prints it standalone (no accelerator stack).
+
+All byte counts are PER DEVICE PER REDUCTION, using the receive-side
+convention (what lands on each chip's ICI links). The fp32 baseline uses
+the same stage structure at 4 B/value, so `compression_ratio` is exactly
+the wire-format ratio (~3.88x for int8 block 128, 2x for bf16).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple, Union
+
+from .config import GradReduceConfig
+
+__all__ = ["LeafSlot", "Bucket", "Stage", "ReducePlan", "build_plan"]
+
+
+@dataclass(frozen=True)
+class LeafSlot:
+    """One gradient leaf's position inside its bucket's flat vector."""
+    name: str
+    shape: Tuple[int, ...]
+    size: int
+    offset: int
+
+
+@dataclass(frozen=True)
+class Bucket:
+    index: int
+    leaves: Tuple[LeafSlot, ...]
+    length: int         # sum of leaf sizes
+    padded_length: int  # rounded up to world * granule
+
+
+@dataclass(frozen=True)
+class Stage:
+    """One collective stage, aggregated over all buckets."""
+    phase: str                           # "reduce_scatter" | "all_gather"
+    axis: Union[str, Tuple[str, ...]]    # mesh axis (tuple when flat)
+    size: int                            # devices in the stage's group
+    elems: int                           # values received per device
+    bytes_raw: int                       # at 4 B/value (fp32 baseline)
+    bytes_wire: int                      # at the configured wire format
+
+
+@dataclass(frozen=True)
+class ReducePlan:
+    config: GradReduceConfig
+    axes: Tuple[Tuple[str, int], ...]    # reduction axes (name, size)
+    world: int                           # prod of axis sizes
+    granule: int                         # per-shard alignment unit
+    buckets: Tuple[Bucket, ...]
+    stages: Tuple[Stage, ...]
+    bytes_raw_per_step: int
+    bytes_wire_per_step: int
+    compression_ratio: float
+
+    @property
+    def total_elements(self) -> int:
+        return sum(b.length for b in self.buckets)
+
+    @property
+    def padded_elements(self) -> int:
+        return sum(b.padded_length for b in self.buckets)
+
+
+def _build_buckets(leaves, world: int, granule: int,
+                   bucket_bytes: int) -> Tuple[Bucket, ...]:
+    """Name-sorted greedy packing: deterministic across processes (every
+    rank must flatten identically) and insensitive to dict order."""
+    align = max(world, 1) * max(granule, 1)
+    items = sorted((str(n), tuple(int(d) for d in shape))
+                   for n, shape in leaves)
+    buckets: List[Bucket] = []
+    cur: List[LeafSlot] = []
+    cur_len = 0
+
+    def flush():
+        nonlocal cur, cur_len
+        if not cur:
+            return
+        padded = -(-cur_len // align) * align
+        buckets.append(Bucket(len(buckets), tuple(cur), cur_len, padded))
+        cur, cur_len = [], 0
+
+    for name, shape in items:
+        size = int(math.prod(shape)) if shape else 1
+        if cur and (cur_len + size) * 4 > bucket_bytes:
+            flush()
+        cur.append(LeafSlot(name, shape, size, cur_len))
+        cur_len += size
+    flush()
+    return tuple(buckets)
+
+
+def _stage_volumes(padded_lengths: Sequence[int],
+                   axes: Sequence[Tuple[str, int]], hierarchical: bool):
+    """[(phase, axis, size, elems-received-per-device)] over all buckets.
+
+    Reduce-scatter over axis of size n on a length-L vector moves
+    (n-1)/n * L values per device; the reverse all-gather the same. The
+    hierarchical schedule reduce-scatters axis by axis (each stage on the
+    previous stage's shard) then gathers back in reverse; the flat
+    schedule is one stage over the combined axis tuple.
+    """
+    sizes = [n for _, n in axes]
+    if not hierarchical and len(axes) > 1:
+        axes = [(tuple(a for a, _ in axes), math.prod(sizes))]
+        sizes = [axes[0][1]]
+    out = []
+    # phase 1: reduce-scatter, axis by axis
+    shard = list(padded_lengths)
+    rs = []
+    for (axis, n) in axes:
+        elems = sum((n - 1) * (L // n) for L in shard)
+        rs.append((axis, n, elems))
+        shard = [L // n for L in shard]
+    out.extend(("reduce_scatter", axis, n, e) for axis, n, e in rs)
+    # phase 2: all-gather, reverse order (shard grows back)
+    for (axis, n) in reversed(list(axes)):
+        elems = sum((n - 1) * L for L in shard)
+        out.append(("all_gather", axis, n, elems))
+        shard = [L * n for L in shard]
+    return out
+
+
+def build_plan(leaves, mesh_axes: Dict[str, int],
+               config: GradReduceConfig) -> ReducePlan:
+    """leaves: {name: shape} or [(name, shape)]; mesh_axes: {axis: size}
+    restricted by the caller to the data axes the reduction runs over."""
+    if isinstance(leaves, dict):
+        leaves = list(leaves.items())
+    order = config.resolved_axis_order(tuple(mesh_axes))
+    axes = tuple((a, int(mesh_axes[a])) for a in order
+                 if int(mesh_axes.get(a, 1)) > 1)
+    world = math.prod(n for _, n in axes) if axes else 1
+    granule = config.block_size if config.quantized and config.dtype == "int8" else 1
+    buckets = _build_buckets(leaves, world, granule, config.bucket_bytes)
+
+    wire_cost = config.wire_bytes_per_value
+    stages = tuple(
+        Stage(phase, axis, n, elems, bytes_raw=elems * 4,
+              bytes_wire=int(math.ceil(elems * wire_cost)))
+        for phase, axis, n, elems in _stage_volumes(
+            [b.padded_length for b in buckets], axes, config.hierarchical)
+    )
+    raw = sum(s.bytes_raw for s in stages)
+    wire = sum(s.bytes_wire for s in stages)
+    return ReducePlan(
+        config=config, axes=axes, world=world, granule=granule,
+        buckets=buckets, stages=stages,
+        bytes_raw_per_step=raw, bytes_wire_per_step=wire,
+        compression_ratio=4.0 / wire_cost,
+    )
+
+
+def describe(plan: ReducePlan) -> str:
+    """Human-readable plan (the tools/comm_plan.py output)."""
+    cfg = plan.config
+    lines = []
+    lines.append(f"grad_reduce: mode={cfg.mode} dtype={cfg.dtype} "
+                 f"block={cfg.block_size} ef={cfg.error_feedback} "
+                 f"hierarchical={cfg.hierarchical} overlap={cfg.overlap}")
+    ax = " x ".join(f"{a}={n}" for a, n in plan.axes) or "(single device)"
+    lines.append(f"reduction axes: {ax}  (world={plan.world})")
+    lines.append(f"buckets: {len(plan.buckets)} "
+                 f"(<= {cfg.bucket_bytes / 2**20:.1f} MiB raw each, "
+                 f"align {plan.world}*{plan.granule})")
+    for b in plan.buckets:
+        pad = b.padded_length - b.length
+        lines.append(f"  bucket {b.index}: {len(b.leaves)} leaves, "
+                     f"{b.length} elems (+{pad} pad) = "
+                     f"{b.padded_length * 4 / 2**20:.2f} MiB raw")
+    if plan.stages:
+        lines.append("stages (per device, per reduction):")
+        for s in plan.stages:
+            axis = "+".join(s.axis) if isinstance(s.axis, tuple) else s.axis
+            lines.append(
+                f"  {s.phase:<14} over {axis:<12} n={s.size}  "
+                f"{s.bytes_raw / 2**20:8.2f} MiB raw -> "
+                f"{s.bytes_wire / 2**20:8.2f} MiB wire")
+        lines.append(
+            f"total: {plan.bytes_raw_per_step / 2**20:.2f} MiB raw -> "
+            f"{plan.bytes_wire_per_step / 2**20:.2f} MiB wire  "
+            f"(compression {plan.compression_ratio:.2f}x)")
+    else:
+        lines.append("no collective stages (world=1); format compression "
+                     f"{plan.compression_ratio:.2f}x")
+    return "\n".join(lines)
+
+
+def plan_as_dict(plan: ReducePlan) -> dict:
+    """JSON-friendly form (tools/comm_plan.py --json, bench row)."""
+    return {
+        "config": {
+            "mode": plan.config.mode, "dtype": plan.config.dtype,
+            "block_size": plan.config.block_size,
+            "error_feedback": plan.config.error_feedback,
+            "hierarchical": plan.config.hierarchical,
+            "overlap": plan.config.overlap,
+            "bucket_bytes": plan.config.bucket_bytes,
+        },
+        "axes": [[a, n] for a, n in plan.axes],
+        "world": plan.world,
+        "buckets": [
+            {"index": b.index, "leaves": len(b.leaves), "length": b.length,
+             "padded_length": b.padded_length}
+            for b in plan.buckets
+        ],
+        "stages": [
+            {"phase": s.phase,
+             "axis": list(s.axis) if isinstance(s.axis, tuple) else s.axis,
+             "size": s.size, "elems": s.elems, "bytes_raw": s.bytes_raw,
+             "bytes_wire": s.bytes_wire}
+            for s in plan.stages
+        ],
+        "bytes_raw_per_step": plan.bytes_raw_per_step,
+        "bytes_wire_per_step": plan.bytes_wire_per_step,
+        "compression_ratio": round(plan.compression_ratio, 4),
+    }

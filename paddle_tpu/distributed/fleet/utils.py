@@ -27,6 +27,7 @@ from ...nn.clip import ClipGradByGlobalNorm
 from ...nn.layer.layers import Layer
 from ...optimizer.optimizer import Optimizer
 from ..sharding_utils import ambient_axis_names
+from .. import comm_opt as _comm_opt
 
 
 def resolve_spec(spec: Optional[P], mesh: Mesh) -> P:
@@ -104,6 +105,7 @@ class ShardedTrainStep:
         virtual_pp_degree: int = 1,
         pp_schedule: str = "1f1b",
         scaler=None,
+        grad_reduce=None,
     ):
         from ..topology import get_hybrid_communicate_group
 
@@ -226,6 +228,120 @@ class ShardedTrainStep:
         M_acc = self._accum
         pp_mode = pp > 1
 
+        # ---- gradient-reduction strategy (distributed.comm_opt) ----
+        # The explicit reducer replaces GSPMD's implicit grad all-reduce
+        # with bucketed quantized/hierarchical collectives inside a
+        # fully-manual shard_map over the data axes. reducer_for_step
+        # returns None (implicit reduction stays) for mode="off", a
+        # single-device data world, or meshes with active non-data axes
+        # (incl. pp — partial-auto shard_map cannot host these
+        # collectives; see comm_opt.reduce).
+        self._grad_reduce = _comm_opt.normalize_grad_reduce(grad_reduce)
+        bspec0 = (batch_sharding.spec[0] if len(batch_sharding.spec)
+                  else None)
+        data_axes = (bspec0 if isinstance(bspec0, tuple)
+                     else (bspec0,)) if bspec0 else ()
+        reducer = _comm_opt.reducer_for_step(
+            self._grad_reduce, mesh, data_axes,
+            {k: (tuple(v.shape), v.dtype) for k, v in params0.items()})
+        self._reducer = reducer
+        self._ef_shard = reducer.ef_shardings() if reducer else {}
+        self.ef_state = {} if reducer is None else {
+            k: jax.device_put(v, self._ef_shard[k])
+            for k, v in reducer.init_ef().items()}
+        # with overlap, every accumulation microbatch issues its own
+        # bucket reductions (they hide under the next microbatch's
+        # backward) — the per-step wire volume scales by M_acc
+        self._reductions_per_step = (
+            M_acc if (reducer is not None and self._grad_reduce.overlap
+                      and M_acc > 1) else 1)
+        overlap_reduce = reducer is not None and self._reductions_per_step > 1
+
+        def grads_with_reduce(params, bufs, ef, x, y, seed, loss_scale=None):
+            """value_and_grad_accum + the explicit reduction when active:
+            returns ((loss, new_buffers), grads, new_ef). The whole
+            fwd+bwd runs inside the manual region so per-microbatch
+            reductions interleave with the remaining backward; the local
+            loss is the LOCAL batch mean, pmean'd back to the global mean
+            (ditto float buffer stats), which is exactly what the
+            implicit path computes from the globally-sharded batch."""
+            if reducer is None:
+                (loss, new_bufs), grads = value_and_grad_accum(
+                    params, bufs, x, y, seed, loss_scale=loss_scale)
+                return (loss, new_bufs), grads, ef
+
+            from jax import lax
+
+            dax = reducer.data_axes
+            scaled_in = loss_scale is not None
+
+            def local(params_l, bufs_l, ef_blk, x_l, y_l, seed_l, sc_l):
+                ef_loc = {k: v[0] for k, v in ef_blk.items()}
+                inv = (1.0 / sc_l) if scaled_in else None
+                ls = sc_l if scaled_in else None
+                if overlap_reduce:
+                    B = x_l.shape[0]
+                    if B % M_acc:
+                        raise ValueError(
+                            f"local batch {B} not divisible by "
+                            f"accumulate_steps {M_acc}")
+                    mb = B // M_acc
+                    xs = jnp.swapaxes(
+                        x_l.reshape((mb, M_acc) + x_l.shape[1:]), 0, 1)
+                    ys = jnp.swapaxes(
+                        y_l.reshape((mb, M_acc) + y_l.shape[1:]), 0, 1)
+                    sc = sc_l if scaled_in else jnp.float32(1.0)
+
+                    def body(carry, xsm):
+                        acc_l, acc_g, bufs_c, ef_c = carry
+                        xm, ym, m = xsm
+
+                        def micro_loss(p):
+                            with _random.key_salt(m):
+                                l_, nb_ = loss_impl(p, bufs_c, xm, ym,
+                                                    seed_l)
+                            return l_ * sc, nb_
+
+                        (l_, nb_), g_ = jax.value_and_grad(
+                            micro_loss, has_aux=True)(params_l)
+                        g_, ef_c = reducer.reduce_local(g_, ef_c,
+                                                        inv_scale=inv)
+                        return (acc_l + l_,
+                                jax.tree_util.tree_map(jnp.add, acc_g, g_),
+                                nb_, ef_c), None
+
+                    zeros = jax.tree_util.tree_map(jnp.zeros_like, params_l)
+                    (l, g, new_bufs, ef_loc), _ = lax.scan(
+                        body, (jnp.zeros((), jnp.float32), zeros, bufs_l,
+                               ef_loc),
+                        (xs, ys, jnp.arange(M_acc)))
+                    invM = 1.0 / M_acc
+                    l = l * invM
+                    g = jax.tree_util.tree_map(lambda t: t * invM, g)
+                else:
+                    (l, new_bufs), g = value_and_grad_accum(
+                        params_l, bufs_l, x_l, y_l, seed_l, loss_scale=ls)
+                    g, ef_loc = reducer.reduce_local(g, ef_loc,
+                                                     inv_scale=inv)
+                l = jax.lax.pmean(l, dax)
+                new_bufs = jax.tree_util.tree_map(
+                    lambda t: (jax.lax.pmean(t, dax)
+                               if jnp.issubdtype(t.dtype, jnp.floating)
+                               else t), new_bufs)
+                return l, new_bufs, g, {k: v[None] for k, v in
+                                        ef_loc.items()}
+
+            sc_in = (loss_scale if scaled_in else jnp.float32(1.0))
+            ef_specs = {k: P(dax) for k in ef}
+            loss, new_bufs, grads, new_ef = jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(P(), P(), ef_specs, batch_sharding.spec,
+                          batch_sharding.spec, P(), P()),
+                out_specs=(P(), P(), P(), ef_specs),
+                axis_names=set(mesh.axis_names), check_vma=False,
+            )(params, bufs, ef, x, y, seed, sc_in)
+            return (loss, new_bufs), grads, new_ef
+
         def value_and_grad_accum(params, bufs, x, y, seed, loss_scale=None):
             """Gradient accumulation over M_acc microbatches (pipeline mode
             microbatches inside the schedule instead): fwd+bwd per microbatch
@@ -332,10 +448,10 @@ class ShardedTrainStep:
             incr_every, decr_every = sc._incr_every, sc._decr_every
             incr_ratio, decr_ratio = sc._incr_ratio, sc._decr_ratio
 
-            def step(params, opt_state, bufs, sstate, x, y, lr, seed):
+            def step(params, opt_state, bufs, sstate, ef, x, y, lr, seed):
                 scale, good, bad = sstate
-                (scaled_loss, new_bufs), grads = value_and_grad_accum(
-                    params, bufs, x, y, seed, loss_scale=scale)
+                (scaled_loss, new_bufs), grads, new_ef = grads_with_reduce(
+                    params, bufs, ef, x, y, seed, loss_scale=scale)
                 inv = 1.0 / scale
                 dts = {k: g.dtype for k, g in grads.items()}
                 grads = {k: g.astype(jnp.float32) * inv
@@ -351,6 +467,10 @@ class ShardedTrainStep:
                     old, new)
                 new_params = keep(params, new_params)
                 new_state = keep(opt_state, new_state)
+                # overflow steps keep the PRE-STEP residuals too: the
+                # non-finite grads poisoned this step's compression errors
+                # (quant scales propagate NaN by design so `found` trips)
+                new_ef = keep(ef, new_ef)
                 if dynamic:
                     good2 = jnp.where(found, 0, good + 1)
                     bad2 = jnp.where(found, bad + 1, 0)
@@ -366,37 +486,37 @@ class ShardedTrainStep:
                 # loss reported unscaled (inf stays inf on overflow steps);
                 # buffer updates (BN stats) keep even on skipped updates —
                 # eager forward updates them before overflow is known
-                return (new_params, new_state, new_bufs,
+                return (new_params, new_state, new_bufs, new_ef,
                         (new_scale, good2, bad2), scaled_loss * inv)
 
             self.scaler_state = (jnp.float32(sc._scale),
                                  jnp.int32(sc._good_steps),
                                  jnp.int32(sc._bad_steps))
-            donate_args = (0, 1, 2, 3) if donate else ()
+            donate_args = (0, 1, 2, 3, 4) if donate else ()
             self._compiled = jax.jit(
                 step,
-                in_shardings=(p_shard, s_shard, None, None, batch_sharding,
-                              batch_sharding, None, None),
-                out_shardings=(p_shard, s_shard, None, None,
+                in_shardings=(p_shard, s_shard, None, None, self._ef_shard,
+                              batch_sharding, batch_sharding, None, None),
+                out_shardings=(p_shard, s_shard, None, self._ef_shard, None,
                                NamedSharding(mesh, P())),
                 donate_argnums=donate_args,
             )
         else:
             self.scaler_state = None
 
-            def step(params, opt_state, bufs, x, y, lr, seed):
-                (loss, new_bufs), grads = value_and_grad_accum(
-                    params, bufs, x, y, seed)
+            def step(params, opt_state, bufs, ef, x, y, lr, seed):
+                (loss, new_bufs), grads, new_ef = grads_with_reduce(
+                    params, bufs, ef, x, y, seed)
                 new_params, new_state = _clip_and_update(
                     params, opt_state, grads, lr)
-                return new_params, new_state, new_bufs, loss
+                return new_params, new_state, new_bufs, new_ef, loss
 
-            donate_args = (0, 1, 2) if donate else ()
+            donate_args = (0, 1, 2, 3) if donate else ()
             self._compiled = jax.jit(
                 step,
-                in_shardings=(p_shard, s_shard, None, batch_sharding,
-                              batch_sharding, None, None),
-                out_shardings=(p_shard, s_shard, None,
+                in_shardings=(p_shard, s_shard, None, self._ef_shard,
+                              batch_sharding, batch_sharding, None, None),
+                out_shardings=(p_shard, s_shard, None, self._ef_shard,
                                NamedSharding(mesh, P())),
                 donate_argnums=donate_args,
             )
@@ -429,6 +549,11 @@ class ShardedTrainStep:
         if not first:
             _obs_metrics.histogram("train.step.dispatch_seconds",
                                    seconds / max(steps, 1))
+        if self._reducer is not None:
+            # static schedule -> exact byte accounting per dispatched step
+            _comm_opt.record_reduce_metrics(
+                self._reducer, steps=steps,
+                reductions_per_step=self._reductions_per_step)
 
     def _build_pipeline_loss(self, buffers0, remat: bool):
         """loss_impl for pp>1: shard_map manual over the pp axis only (dp/mp/
@@ -627,31 +752,33 @@ class ShardedTrainStep:
         if self._multi is None:
             base = self._compiled_step_fn
 
-            def multi(params, opt_state, bufs, sstate, xs, ys, lr, seed):
+            def multi(params, opt_state, bufs, sstate, ef, xs, ys, lr, seed):
                 def body(carry, xy):
-                    p, s, b, ss = carry
+                    p, s, b, ss, e = carry
                     xk, yk, k = xy
                     if scaled:
-                        p, s, b, ss, loss = base(p, s, b, ss, xk, yk, lr,
-                                                 seed + k)
+                        p, s, b, e, ss, loss = base(p, s, b, ss, e, xk, yk,
+                                                    lr, seed + k)
                     else:
-                        p, s, b, loss = base(p, s, b, xk, yk, lr, seed + k)
-                    return (p, s, b, ss), loss
+                        p, s, b, e, loss = base(p, s, b, e, xk, yk, lr,
+                                                seed + k)
+                    return (p, s, b, ss, e), loss
 
-                (params, opt_state, bufs, sstate), losses = jax.lax.scan(
-                    body, (params, opt_state, bufs, sstate),
+                (params, opt_state, bufs, sstate, ef), losses = jax.lax.scan(
+                    body, (params, opt_state, bufs, sstate, ef),
                     (xs, ys, jnp.arange(xs.shape[0], dtype=jnp.uint32)))
-                return params, opt_state, bufs, sstate, losses
+                return params, opt_state, bufs, sstate, ef, losses
 
             bspec = self._batch_sharding.spec
             stacked = NamedSharding(self.mesh, P(None, *bspec))
             self._multi = jax.jit(
                 multi,
                 in_shardings=(self._p_shard, self._s_shard, None, None,
-                              stacked, stacked, None, None),
+                              self._ef_shard, stacked, stacked, None, None),
                 out_shardings=(self._p_shard, self._s_shard, None, None,
+                               self._ef_shard,
                                NamedSharding(self.mesh, P())),
-                donate_argnums=(0, 1, 2, 3) if self._donate else (),
+                donate_argnums=(0, 1, 2, 3, 4) if self._donate else (),
             )
         K = xs.shape[0] if hasattr(xs, "shape") else len(xs)
         self._step_i += K
@@ -660,9 +787,9 @@ class ShardedTrainStep:
         t0 = time.perf_counter() if obs else 0.0
         with jax.set_mesh(self.mesh):
             (self.params, self.opt_state, self.buffers, ss_out,
-             losses) = self._multi(
+             self.ef_state, losses) = self._multi(
                 self.params, self.opt_state, self.buffers, ss_in,
-                jnp.asarray(xs), jnp.asarray(ys),
+                self.ef_state, jnp.asarray(xs), jnp.asarray(ys),
                 # +1 so scanned step j draws seed (seed + prev_steps + 1 + j)
                 # — identical to the seeds K sequential __call__s would use
                 jnp.float32(lr), jnp.uint32(self._seed + self._step_i - K + 1))
@@ -683,23 +810,25 @@ class ShardedTrainStep:
         t0 = time.perf_counter() if obs else 0.0
         with jax.set_mesh(self.mesh):
             if self.scaler_state is not None:
-                (self.params, self.opt_state, self.buffers,
+                (self.params, self.opt_state, self.buffers, self.ef_state,
                  self.scaler_state, loss) = self._compiled(
                     self.params,
                     self.opt_state,
                     self.buffers,
                     self.scaler_state,
+                    self.ef_state,
                     self._to_global_batch(x),
                     self._to_global_batch(y),
                     jnp.float32(lr),
                     jnp.uint32(self._seed + self._step_i),
                 )
             else:
-                (self.params, self.opt_state, self.buffers,
+                (self.params, self.opt_state, self.buffers, self.ef_state,
                  loss) = self._compiled(
                     self.params,
                     self.opt_state,
                     self.buffers,
+                    self.ef_state,
                     self._to_global_batch(x),
                     self._to_global_batch(y),
                     jnp.float32(lr),
@@ -769,9 +898,14 @@ class ShardedTrainStep:
         Snapshot before the next step(): donation consumes these arrays."""
         from ...checkpoint import TrainState
 
-        extra = None
+        extra = {}
         if self.scaler_state is not None:
-            extra = {"scaler_state": list(self.scaler_state)}
+            extra["scaler_state"] = list(self.scaler_state)
+        if self.ef_state:
+            # error-feedback residuals are convergence state: losing them
+            # on resume would replay one step's compression error twice
+            extra["grad_reduce_ef"] = dict(self.ef_state)
+        extra = extra or None
         return TrainState(
             params=self.params,
             opt_state=self.opt_state,
@@ -806,6 +940,19 @@ class ShardedTrainStep:
             sc = ts.extra["scaler_state"]
             self.scaler_state = (jnp.float32(sc[0]), jnp.int32(sc[1]),
                                  jnp.int32(sc[2]))
+        if self._reducer is not None and self._reducer.has_ef:
+            ef_in = (ts.extra or {}).get("grad_reduce_ef")
+            if ef_in is not None and self._reducer.ef_matches(ef_in):
+                self.ef_state = {
+                    k: jax.device_put(jnp.asarray(v, jnp.float32),
+                                      self._ef_shard[k])
+                    for k, v in dict(ef_in).items()}
+            else:
+                # topology or bucket-plan change (or a checkpoint saved
+                # without the reducer): residuals don't transfer — reset
+                self.ef_state = {
+                    k: jax.device_put(v, self._ef_shard[k])
+                    for k, v in self._reducer.init_ef().items()}
         self._step_i = int(ts.step)
         if ts.rng and "seed" in ts.rng:
             self._seed = int(ts.rng["seed"])
@@ -816,11 +963,12 @@ class ShardedTrainStep:
         if self.scaler_state is not None:
             return self._compiled.lower(
                 self.params, self.opt_state, self.buffers,
-                self.scaler_state, jnp.asarray(x), jnp.asarray(y),
-                jnp.float32(1e-3), jnp.uint32(0))
+                self.scaler_state, self.ef_state, jnp.asarray(x),
+                jnp.asarray(y), jnp.float32(1e-3), jnp.uint32(0))
         return self._compiled.lower(
-            self.params, self.opt_state, self.buffers, jnp.asarray(x),
-            jnp.asarray(y), jnp.float32(1e-3), jnp.uint32(0))
+            self.params, self.opt_state, self.buffers, self.ef_state,
+            jnp.asarray(x), jnp.asarray(y), jnp.float32(1e-3),
+            jnp.uint32(0))
 
 
 def make_sharded_train_step(model, optimizer, loss_fn=None, mesh=None, **kwargs) -> ShardedTrainStep:

@@ -1,0 +1,65 @@
+"""Block-scaled gradient quantization (the EQuARX wire format).
+
+Communication compression for gradient collectives: values are quantized
+per contiguous block of `block_size` elements along the LAST dim to int8
+with one f32 scale per block (amax/127), so the wire carries
+1 + 4/block_size bytes per f32 value (~3.9x at block 128). The bf16 mode
+is the conservative fallback — a plain downcast, 2x, no scales.
+
+These are plain jnp ops (VPU element-wise work, fused by XLA into the
+surrounding collective schedule), not Pallas kernels: the cost of the
+quantized-reduce path is the collectives themselves, and keeping
+quant/dequant as stock HLO lets the SPMD partitioner schedule them inside
+the per-axis reduction stages that comm_opt emits.
+
+Non-finite propagation contract (load-bearing for the fp16 GradScaler):
+a NaN/Inf anywhere in a block must survive the quantize->dequant round
+trip so the train step's overflow detector still trips. The scale is
+computed as amax (no finite clamping), so a non-finite amax poisons the
+whole block's dequantized values.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+__all__ = ["quantize_block_scaled", "dequantize_block_scaled"]
+
+
+def quantize_block_scaled(
+    v: jnp.ndarray, block_size: int = 128, dtype: str = "int8"
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """v [..., C] float -> (payload, scales).
+
+    int8: payload int8 [..., C], scales f32 [..., C // block_size]; C must
+    be a multiple of block_size. bf16: payload bf16 [..., C], scales None.
+    """
+    if dtype in ("bf16", "bfloat16"):
+        return v.astype(jnp.bfloat16), None
+    if dtype != "int8":
+        raise ValueError(f"quantize dtype must be int8/bf16, got {dtype!r}")
+    C = v.shape[-1]
+    if C % block_size:
+        raise ValueError(f"last dim {C} not a multiple of block {block_size}")
+    v = v.astype(jnp.float32)
+    b = v.reshape(v.shape[:-1] + (C // block_size, block_size))
+    amax = jnp.max(jnp.abs(b), axis=-1)
+    # maximum (not where) so a non-finite amax PROPAGATES into the scale;
+    # the tiny floor only rescues all-zero blocks from 0/0
+    scale = jnp.maximum(amax, jnp.float32(1e-30)) * jnp.float32(1.0 / 127.0)
+    q = jnp.round(b / scale[..., None])
+    q = jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
+    return q.reshape(v.shape), scale
+
+
+def dequantize_block_scaled(
+    q: jnp.ndarray, scales: Optional[jnp.ndarray], block_size: int = 128
+) -> jnp.ndarray:
+    """Inverse of quantize_block_scaled; always returns f32."""
+    if scales is None:
+        return q.astype(jnp.float32)
+    C = q.shape[-1]
+    b = q.astype(jnp.float32).reshape(q.shape[:-1] + (C // block_size, block_size))
+    return (b * scales[..., None].astype(jnp.float32)).reshape(q.shape)

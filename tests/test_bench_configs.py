@@ -3,8 +3,14 @@ CPU with tiny shapes so the harness itself is CI-guarded — shapes, JSON
 contract, breakdown fields."""
 
 import json
+import os
+import subprocess
+import sys
 
 import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_bench_row_contract(capsys):
@@ -28,7 +34,7 @@ def test_all_configs_registered():
 
     assert set(bench.CONFIGS) == {"bert_sst2", "gpt_dp", "ernie_mp4",
                                   "resnet50", "gpt_moe", "serving", "ckpt",
-                                  "data"}
+                                  "data", "comm"}
 
 
 def test_bench_ckpt_row_contract(capsys):
@@ -80,3 +86,48 @@ def test_bench_data_row_contract(capsys):
     assert 0.0 < tele["gauges"]["data.packing.efficiency"] <= 1.0
     # the row must not leave the global observability flag flipped on
     assert not observability.enabled()
+
+
+def test_bench_comm_row_contract(capsys):
+    """The comm row's acceptance invariant: int8 block-128 wire format
+    gives >= 3.5x compression over fp32, with the comm.* metric series in
+    the telemetry sub-object and exact static byte accounting."""
+    import bench
+    from paddle_tpu import observability
+
+    row = bench.bench_comm()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(out)
+    assert parsed == row
+    assert parsed["config"] == "comm"
+    assert parsed["value"] > 0 and np.isfinite(parsed["value"])  # reduce ms
+    assert parsed["step_ms"] > 0
+    assert parsed["compression_ratio"] >= 3.5
+    assert 0 < parsed["bytes_wire_per_step"] < parsed["bytes_raw_per_step"]
+    assert parsed["buckets"] >= 1
+    tele = parsed["telemetry"]
+    assert tele["counters"]["train.steps"] > 0
+    if "comm.grad_reduce.steps" in tele["counters"]:  # multi-device run
+        assert tele["counters"]["comm.grad_reduce.steps"] > 0
+        assert tele["counters"]["comm.grad_reduce.bytes{kind=wire}"] > 0
+        assert tele["gauges"]["comm.grad_reduce.compression_ratio"] >= 3.5
+    # the row must not leave the global observability flag flipped on
+    assert not observability.enabled()
+
+
+@pytest.mark.slow
+def test_bench_cpu_fallback_row(tmp_path):
+    """BENCH_r05 regression: an unavailable accelerator backend must not
+    kill the bench with rc=1 — the run re-execs onto CPU and the row
+    carries "backend": "cpu_fallback". JAX_PLATFORMS=cuda reproduces the
+    unavailable-backend init failure on a CPU-only host."""
+    env = dict(os.environ, JAX_PLATFORMS="cuda")
+    env.pop("PADDLE_TPU_BENCH_CPU_FALLBACK", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--config", "comm"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    row = json.loads(r.stdout.strip().splitlines()[-1])
+    assert row["config"] == "comm"
+    assert row["backend"] == "cpu_fallback"
+    assert "re-executing on CPU fallback" in r.stderr

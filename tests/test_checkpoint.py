@@ -320,6 +320,63 @@ def test_sharded_train_step_bitwise_resume(tmp_path):
     mgr.close()
 
 
+def test_grad_reduce_ef_bitwise_resume(tmp_path):
+    """Bitwise resume with the quantized grad-reduce path active: the
+    error-feedback residuals ride in TrainState.extra, so the resumed run
+    replays the EXACT loss sequence — dropping them would re-apply one
+    step's compression error and fork the trajectory."""
+    import jax
+    from jax.sharding import Mesh
+
+    def build():
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+        from paddle_tpu.models import gpt_tiny
+
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        paddle.seed(0)
+        m = gpt_tiny(dropout=0.0, num_layers=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        st = make_sharded_train_step(m, opt, mesh=mesh, grad_reduce="int8")
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, 128, size=(8, 16))
+        return st, x, np.roll(x, -1, axis=1)
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_=True)
+    st, x, y = build()
+    assert st._reducer is not None and st._reducer.has_ef
+    for _ in range(3):
+        st(x, y)
+    tree = st.state_for_checkpoint().to_tree()
+    assert "grad_reduce_ef" in tree["extra"]
+    # after 3 quantized steps the residuals are live, not zeros
+    assert any(np.abs(np.asarray(v)).max() > 0
+               for v in tree["extra"]["grad_reduce_ef"].values())
+    mgr.save(st._step_i, tree)
+    cont_losses = [float(st(x, y)) for _ in range(3)]
+
+    st2, x2, y2 = build()
+    st2.restore_from_checkpoint(mgr.restore(
+        shardings=st2.checkpoint_shardings()))
+    assert st2._step_i == 3
+    resume_losses = [float(st2(x2, y2)) for _ in range(3)]
+    assert resume_losses == cont_losses  # bitwise, not approx
+    for name in st.params:
+        np.testing.assert_array_equal(np.asarray(st.params[name]),
+                                      np.asarray(st2.params[name]),
+                                      err_msg=name)
+    mgr.close()
+
+    # a fresh step restoring a checkpoint with NO residuals (or a changed
+    # bucket plan) resets EF to zeros instead of crashing
+    st3, _, _ = build()
+    tree = {**mgr.restore(), "extra": None}
+    st3.restore_from_checkpoint(tree)
+    assert all(np.abs(np.asarray(v)).max() == 0
+               for v in st3.ef_state.values())
+
+
 # ---------------- observability ----------------
 
 def test_ckpt_metrics_recorded(tmp_path):

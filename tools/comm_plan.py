@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Describe a gradient-reduction plan (distributed.comm_opt) offline.
+
+Prints the bucketed reduction schedule ShardedTrainStep would run for a
+given mesh + parameter set + grad_reduce config: buckets, axis order,
+and per-stage bytes on the wire before/after compression.
+
+Usage:
+    python tools/comm_plan.py --mesh dp=4,sharding=2 --params 1.3e9
+    python tools/comm_plan.py --mesh dp=8 --mode quant --dtype bf16 \
+        --leaf embed=32000x1024 --leaf w1=1024x4096 --leaf b1=4096
+    python tools/comm_plan.py --mesh dp=2,sharding=4 --flat --json
+    python tools/comm_plan.py --mesh dp=8 --params 350e6 --accum 4
+
+Runs standalone — no paddle_tpu (or jax) import: comm_opt's config/plan
+modules are pure python and are loaded directly from
+paddle_tpu/distributed/comm_opt/, so the plan can be inspected on
+machines without an accelerator stack. Exit code 1 on a bad mesh/leaf
+spec or config. Semantics: paddle_tpu/distributed/comm_opt/README.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+import types
+
+# Load comm_opt/{config,plan}.py as a synthetic package: executing
+# paddle_tpu/__init__.py would initialize jax, which this tool must not
+# require (and these modules do not).
+_COMM_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir, "paddle_tpu", "distributed", "comm_opt")
+_pkg = types.ModuleType("_ptcomm")
+_pkg.__path__ = [_COMM_DIR]
+sys.modules.setdefault("_ptcomm", _pkg)
+config = importlib.import_module("_ptcomm.config")
+plan = importlib.import_module("_ptcomm.plan")
+
+
+def parse_mesh(spec: str) -> dict:
+    """"dp=4,sharding=2" -> {"dp": 4, "sharding": 2} (order kept)."""
+    axes = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, num = part.partition("=")
+        if not _ or not num.isdigit() or int(num) < 1:
+            raise ValueError(f"bad mesh entry {part!r}; want axis=N")
+        axes[name.strip()] = int(num)
+    if not axes:
+        raise ValueError(f"empty mesh spec {spec!r}")
+    return axes
+
+
+def parse_leaf(spec: str):
+    """"embed=32000x1024" -> ("embed", (32000, 1024))."""
+    name, _, dims = spec.partition("=")
+    if not _:
+        raise ValueError(f"bad leaf {spec!r}; want name=DxDx...")
+    try:
+        shape = tuple(int(d) for d in dims.lower().split("x"))
+    except ValueError:
+        raise ValueError(f"bad leaf shape in {spec!r}") from None
+    if not shape or any(d < 1 for d in shape):
+        raise ValueError(f"bad leaf shape in {spec!r}")
+    return name.strip(), shape
+
+
+def synthetic_leaves(n_params: int):
+    """A GPT-ish leaf mix totalling ~n_params: one embedding-sized leaf,
+    a run of square-matmul blocks, and small 1-D bias/norm leaves. The
+    plan only depends on sizes, so this stands in for a real state dict
+    when the caller just knows the parameter count."""
+    leaves = []
+    embed = max(n_params // 8, 1)
+    leaves.append(("embed.weight", (embed,)))
+    remaining = n_params - embed
+    block = max(min(remaining // 12, 64 << 20), 1)
+    i = 0
+    while remaining > 0:
+        take = min(block, remaining)
+        leaves.append((f"layer{i:02d}.weight", (take,)))
+        remaining -= take
+        bias = min(max(int(take ** 0.5), 1), remaining)
+        if bias > 0:
+            leaves.append((f"layer{i:02d}.bias", (bias,)))
+            remaining -= bias
+        i += 1
+    return leaves
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mesh", required=True,
+                    help="data-axis sizes, e.g. dp=4,sharding=2")
+    ap.add_argument("--params", type=float, default=None,
+                    help="total parameter count (synthetic GPT-ish leaf "
+                         "mix); alternative to --leaf")
+    ap.add_argument("--leaf", action="append", default=[],
+                    metavar="NAME=DxD", help="explicit leaf, repeatable "
+                    "(e.g. --leaf w1=1024x4096)")
+    ap.add_argument("--mode", default="quant",
+                    choices=["off", "fp32", "quant"])
+    ap.add_argument("--dtype", default="int8", choices=["int8", "bf16"])
+    ap.add_argument("--block-size", type=int, default=128)
+    ap.add_argument("--bucket-mb", type=float, default=4.0,
+                    help="bucket size in MiB of raw fp32 (default 4)")
+    ap.add_argument("--no-error-feedback", action="store_true")
+    ap.add_argument("--flat", action="store_true",
+                    help="one flat replica group instead of hierarchical "
+                         "per-axis stages")
+    ap.add_argument("--axis-order", default=None,
+                    help="comma-separated reduction order (default "
+                         "sharding,ep,dp)")
+    ap.add_argument("--accum", type=int, default=1,
+                    help="accumulate_steps: with overlap, one reduction "
+                         "per microbatch (scales the per-step totals)")
+    ap.add_argument("--json", action="store_true", help="emit JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        mesh_axes = parse_mesh(args.mesh)
+        if args.leaf:
+            leaves = [parse_leaf(s) for s in args.leaf]
+        elif args.params:
+            leaves = synthetic_leaves(int(args.params))
+        else:
+            print("need --params or at least one --leaf", file=sys.stderr)
+            return 1
+        cfg = config.GradReduceConfig(
+            mode=args.mode, dtype=args.dtype, block_size=args.block_size,
+            error_feedback=not args.no_error_feedback,
+            hierarchical=not args.flat,
+            axis_order=(tuple(a.strip() for a in args.axis_order.split(","))
+                        if args.axis_order else None),
+            bucket_bytes=int(args.bucket_mb * 2 ** 20))
+        data_axes = {a: n for a, n in mesh_axes.items()
+                     if a in config.DATA_AXES}
+        ignored = sorted(set(mesh_axes) - set(data_axes))
+        p = plan.build_plan(leaves, data_axes, cfg)
+    except (ValueError, TypeError) as exc:
+        print(f"comm_plan: {exc}", file=sys.stderr)
+        return 1
+
+    reductions = max(args.accum, 1) if cfg.overlap else 1
+    if args.json:
+        out = plan.plan_as_dict(p)
+        out["reductions_per_step"] = reductions
+        out["bytes_wire_per_step"] = p.bytes_wire_per_step * reductions
+        out["bytes_raw_per_step"] = p.bytes_raw_per_step * reductions
+        if ignored:
+            out["ignored_axes"] = ignored
+        print(json.dumps(out, indent=1, sort_keys=True))
+        return 0
+
+    print(plan.describe(p))
+    if ignored:
+        print(f"note: non-data mesh axes ignored: {', '.join(ignored)} "
+              f"(reduction runs over data axes only)")
+    if reductions > 1:
+        print(f"with accum={args.accum} overlap: {reductions} reductions/"
+              f"step = {p.bytes_wire_per_step * reductions / 2**20:.2f} "
+              f"MiB wire/step")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
