@@ -995,6 +995,93 @@ def bench_comm():
     return out
 
 
+def bench_reshard():
+    """Reshard config: the resharding compiler (distributed.resharding)
+    moving one mp-sharded parameter from a (2,2) dp x mp training mesh to
+    a (4,) fully-sharded serving mesh — the checkpoint-restore / weight-
+    load move. Reports plan compile time, executor time, and the plan's
+    exact byte accounting; the headline acceptance is reduction_ratio
+    >= 2.0 over the naive replicate-then-slice baseline (this move
+    reindexes in place: 4.0x)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu import observability
+    from paddle_tpu.distributed import resharding
+
+    on_tpu = _on_tpu()
+    shape = (4096, 8192) if on_tpu else (1024, 512)
+    rng = np.random.RandomState(0)
+    host = rng.randn(*shape).astype(np.float32)
+    devs = np.asarray(jax.devices())
+
+    was_enabled = observability.enabled()
+    observability.enable()
+    try:
+        if devs.size >= 4:
+            src_mesh = Mesh(devs.flat[:4].reshape(2, 2), ("dp", "mp"))
+            dst_mesh = Mesh(devs.flat[:4], ("x",))
+            note = "(2,2) dp x mp -> (4,) x, planner-executed"
+        else:
+            # single device: no portable move to run — plan and execute
+            # the degenerate identity so the executor path still runs,
+            # but report the byte accounting of the 4-device move from
+            # the pure-python planner (the plan is device-count exact)
+            src_mesh = Mesh(devs.flat[:1].reshape(1, 1), ("dp", "mp"))
+            dst_mesh = Mesh(devs.flat[:1], ("x",))
+            note = "1 device (plan estimated at (2,2) -> (4,))"
+        src = NamedSharding(src_mesh, P("mp", None))
+        dst = NamedSharding(dst_mesh, P("x", None))
+        arr = jax.device_put(host, src)
+
+        resharding.clear_caches()
+        t0 = time.perf_counter()
+        plan = resharding.plan_for(arr, dst)
+        plan_ms = (time.perf_counter() - t0) * 1e3
+        if devs.size < 4:
+            sm = resharding.MeshSpec.make({"dp": 2, "mp": 2})
+            dm = resharding.MeshSpec.make({"x": 4})
+            plan = resharding.plan_reshard(
+                shape, 4,
+                resharding.ShardingSpec.make(sm, [("mp",), None], 2),
+                resharding.ShardingSpec.make(dm, [("x",), None], 2),
+                dtype="float32")
+
+        out_arr = resharding.reshard(arr, dst)  # compile + warm
+        jax.block_until_ready(out_arr)
+        reps = 5
+        t0 = time.perf_counter()
+        for _i in range(reps):
+            out_arr = resharding.reshard(arr, dst)
+        jax.block_until_ready(out_arr)
+        exec_ms = (time.perf_counter() - t0) / reps * 1e3
+
+        out = {
+            "config": "reshard",
+            "metric": "reshard_execute_ms",
+            "value": round(exec_ms, 3),
+            "unit": "ms/move (mp-sharded param -> fully sharded)",
+            "plan_ms": round(plan_ms, 3),
+            "execute_ms": round(exec_ms, 3),
+            "bytes_wire": plan.bytes_wire,
+            "bytes_naive": plan.bytes_naive,
+            "reduction_ratio": round(plan.reduction_ratio, 4),
+            "steps": [s.op for s in plan.steps],
+            "shape": list(shape),
+            "note": f"{shape[0]}x{shape[1]} fp32 "
+                    f"({host.nbytes / 2**20:.0f} MiB), {note}",
+            "telemetry": observability.snapshot(),
+        }
+        if _cpu_fallback():
+            out["backend"] = "cpu_fallback"
+    finally:
+        if not was_enabled:
+            observability.disable()
+    print(json.dumps(out))
+    return out
+
+
 CONFIGS = {
     "bert_sst2": bench_bert_sst2,
     "gpt_dp": bench_gpt_dp,
@@ -1005,6 +1092,7 @@ CONFIGS = {
     "ckpt": bench_ckpt,
     "data": bench_data,
     "comm": bench_comm,
+    "reshard": bench_reshard,
 }
 
 

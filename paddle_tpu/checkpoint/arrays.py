@@ -376,23 +376,62 @@ def restore_array(directory: str, path: str, entry: dict, sharding=None,
         reader.global_shape, sharding, lambda idx: reader.read_index(idx))
 
 
+def _live_reshard(leaf, entry: dict, sharding):
+    """Planner-driven device-to-device restore of one leaf, or None when
+    the live source doesn't match the checkpoint (shape/dtype drift) or
+    isn't a mesh-resident jax array — the caller then reads files."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ..distributed import resharding as _resharding
+
+    leaf = _as_host_or_jax(leaf)
+    if not (isinstance(leaf, jax.Array)
+            and isinstance(getattr(leaf, "sharding", None), NamedSharding)
+            and isinstance(sharding, NamedSharding)):
+        return None
+    if (list(leaf.shape) != list(entry["global_shape"])
+            or str(leaf.dtype) != entry["dtype"]):
+        return None
+    try:
+        plan = _resharding.plan_for(leaf, sharding)
+    except _resharding.Unplannable:
+        return None
+    return _resharding.reshard(leaf, sharding, plan=plan)
+
+
 def load_tree(directory: str, shardings=None, validate: bool = True,
-              manifest: Optional[dict] = None):
+              manifest: Optional[dict] = None, live_state=None):
     """Restore the full state tree. `shardings` may be a flat
     {path: NamedSharding} dict or a nested tree mirroring the state (None
-    leaves = host numpy)."""
+    leaves = host numpy).
+
+    `live_state` (optional, same structure) supplies arrays that are still
+    resident on a mesh — e.g. the pre-reconfiguration TrainState during an
+    elastic topology change. Leaves found there move device-to-device
+    through the resharding planner (bitwise-identical to the file path,
+    no host round trip); anything missing, mismatched, or unplannable
+    falls back to the shard-file reads below."""
     m = manifest if manifest is not None else read_manifest(directory)
     flat_sh: Dict[str, Any] = {}
     if shardings:
         for p, s in flatten_tree(shardings).items():
             if s is not None:
                 flat_sh[p] = s
+    flat_live: Dict[str, Any] = {}
+    if live_state is not None:
+        flat_live = flatten_tree(live_state)
 
     def resolve(path):
         entry = m["arrays"].get(path)
         if entry is None:
             raise KeyError(f"array {path!r} not present in checkpoint")
+        sharding = flat_sh.get(path)
+        if path in flat_live and sharding is not None:
+            out = _live_reshard(flat_live[path], entry, sharding)
+            if out is not None:
+                return out
         return restore_array(directory, path, entry,
-                             sharding=flat_sh.get(path), validate=validate)
+                             sharding=sharding, validate=validate)
 
     return _unstructure(m["structure"], resolve)

@@ -207,11 +207,17 @@ class CheckpointManager:
         os.replace(tmp, os.path.join(sdir, COMMIT_NAME))
 
     # ---------------- restore ----------------
-    def restore(self, step: Optional[int] = None, shardings=None):
+    def restore(self, step: Optional[int] = None, shardings=None,
+                live_state=None):
         """Restore a committed step (default: latest). `shardings` is a
         nested tree (or flat {path: NamedSharding} dict) selecting device
         layout per array — on ANY mesh, not just the save-time one; arrays
-        without a requested sharding come back as host numpy."""
+        without a requested sharding come back as host numpy.
+
+        `live_state` (same structure) lets arrays that are still resident
+        on a mesh skip the filesystem: they reshard device-to-device
+        through distributed.resharding (bitwise-identical to the file
+        path), with shard-file reads as the per-leaf fallback."""
         self.wait_until_finished()
         steps = self.all_steps()
         if step is None:
@@ -225,7 +231,8 @@ class CheckpointManager:
                 f"{self.directory} (committed: {steps})")
         t0 = time.perf_counter()
         tree = _arrays.load_tree(self.step_path(step), shardings=shardings,
-                                 validate=self.validate_on_restore)
+                                 validate=self.validate_on_restore,
+                                 live_state=live_state)
         _metrics.histogram("ckpt.restore.seconds", time.perf_counter() - t0)
         return tree
 

@@ -9,14 +9,25 @@ explicit per-bucket schedule the step controls (EQuARX/HiCCL shape):
   -> quantize the owned shard once -> all_gather payload+scales
      back up the axes in reverse -> dequant -> unflatten.
 
-`reduce_local` runs INSIDE a fully-manual shard_map region (every mesh
-axis named manual). That is a hard constraint on this jax/XLA build:
-partial-auto shard_map (manual over the data axes while mp/pp stay auto)
-compiles psum but ABORTS the process in the SPMD partitioner for
-psum_scatter/all_to_all. `reducer_for_step` therefore only activates the
-explicit path when every non-data mesh axis has degree 1 — exactly the
-dp/sharding(/ep) topologies where the grad reduce dominates — and falls
-back to the implicit GSPMD reduction otherwise.
+`reduce_local` normally runs INSIDE a fully-manual shard_map region
+(every mesh axis named manual). That is a hard constraint on this
+jax/XLA build: partial-auto shard_map (manual over the data axes while
+mp/pp stay auto) compiles psum but ABORTS the process in the SPMD
+partitioner for psum_scatter/all_to_all. `reducer_for_step` therefore
+activates the full quantized/hierarchical path only when every non-data
+mesh axis has degree 1 — the dp/sharding(/ep) topologies where the grad
+reduce dominates.
+
+Hybrid meshes (active model-parallel axes, e.g. dp x mp) get the HYBRID
+reducer instead of the old warn-and-fall-back: the step hosts the region
+as a partial-auto shard_map manual over only the data axes
+(`manual_axes`), mp stays auto/GSPMD, and the reduction is restricted to
+the one collective that survives partial-auto — a single flat fp32 psum
+per bucket over the data-axis tuple, i.e. an explicit mean over the data
+replicas within each model shard. Quant/hierarchical requests downgrade
+(with a warning) and error feedback is off. Pipeline/expert-style axes
+still fall back to implicit GSPMD: their stages nest shard_maps of their
+own, which the hybrid region cannot wrap.
 
 Error-feedback semantics (EF14/DGC): each device keeps an f32 residual per
 bucket, in LOCAL-GRADIENT units, added to its local gradient before
@@ -29,6 +40,7 @@ they ride in TrainState.extra and are donated through the compiled step.
 from __future__ import annotations
 
 import warnings
+from dataclasses import replace as _replace
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -41,7 +53,14 @@ from ...kernels.quant import dequantize_block_scaled, quantize_block_scaled
 from .config import GradReduceConfig
 from .plan import ReducePlan, build_plan
 
-__all__ = ["GradReducer", "reducer_for_step", "make_tree_reducer"]
+__all__ = ["GradReducer", "reducer_for_step", "make_tree_reducer",
+           "HYBRID_AXES"]
+
+#: Non-data mesh axes the hybrid (partial-auto) reducer can leave to
+#: GSPMD. Tensor/model parallelism is plain within-layer GSPMD sharding;
+#: pp/sep stages nest their own shard_maps, which the hybrid region
+#: cannot wrap on this build.
+HYBRID_AXES = ("mp",)
 
 
 def _axis_index(ax):
@@ -66,7 +85,14 @@ class GradReducer:
 
     def __init__(self, config: GradReduceConfig, mesh: Mesh,
                  templates: Dict[str, Tuple[Tuple[int, ...], np.dtype]],
-                 data_axes: Tuple[str, ...]):
+                 data_axes: Tuple[str, ...], hybrid: bool = False):
+        if hybrid and (config.quantized or config.hierarchical):
+            # hybrid regions are partial-auto shard_map: psum compiles
+            # there but psum_scatter/all_to_all abort the process (module
+            # docstring), so the hybrid reducer is always one flat fp32
+            # psum per bucket
+            config = _replace(config, mode="fp32", hierarchical=False)
+        self.hybrid = bool(hybrid)
         self.config = config
         self.mesh = mesh
         self.data_axes = tuple(data_axes)
@@ -83,6 +109,13 @@ class GradReducer:
             self._stages = [(a, n) for a, n in axes]
         else:
             self._stages = [(tuple(a for a, _ in axes), self.world)]
+
+    @property
+    def manual_axes(self) -> Tuple[str, ...]:
+        """Mesh axes the hosting shard_map must name manual: every axis
+        for the fully-manual path, only the data axes for hybrid (model
+        axes stay auto so GSPMD keeps partitioning the fwd/bwd)."""
+        return self.data_axes if self.hybrid else tuple(self.mesh.axis_names)
 
     # ---------------- error-feedback state ----------------
     @property
@@ -240,9 +273,18 @@ def reducer_for_step(config: GradReduceConfig, mesh: Mesh,
                      templates: Dict[str, Tuple[Tuple[int, ...], np.dtype]],
                      warn: bool = True) -> Optional[GradReducer]:
     """The activation rules: a GradReducer, or None meaning "leave the
-    reduction to GSPMD" (mode off, single-device data world, or a mesh
-    with active non-data axes — see the module docstring for why the
-    explicit path cannot run under partial-auto shard_map)."""
+    reduction to GSPMD".
+
+    - mode off or single-device data world: None.
+    - all non-data axes degree 1: full reducer (quant/hierarchical as
+      configured, fully-manual region).
+    - non-data axes all in HYBRID_AXES (e.g. dp x mp): HYBRID reducer —
+      flat fp32 psum over the data axes inside a partial-auto region;
+      quant requests downgrade with a warning.
+    - any other active non-data axis (pp, sep, ...): None with a warning
+      naming the blocking axes (their stages nest their own shard_maps,
+      which the hybrid region cannot wrap — see the module docstring).
+    """
     if not config.active:
         return None
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -253,16 +295,27 @@ def reducer_for_step(config: GradReduceConfig, mesh: Mesh,
         return None
     nondata = {a: n for a, n in sizes.items()
                if a not in data_axes and n > 1}
-    if nondata:
+    if not nondata:
+        return GradReducer(config, mesh, templates, data_axes)
+    blocked = {a: n for a, n in nondata.items() if a not in HYBRID_AXES}
+    if blocked:
         if warn:
             warnings.warn(
-                f"grad_reduce mode={config.mode!r} requested but mesh has "
-                f"active non-data axes {nondata}; explicit grad collectives "
-                "need a fully-manual shard_map over the data axes, which "
-                "those axes preclude — falling back to XLA's implicit "
-                "all-reduce", stacklevel=3)
+                f"grad_reduce mode={config.mode!r} disabled: mesh axes "
+                f"{blocked} are active non-data axes with no hybrid "
+                f"reduction path (only model-parallel axes {HYBRID_AXES} "
+                "can stay GSPMD-auto around the reduce region; "
+                "pipeline/expert axes nest their own shard_maps) — "
+                "falling back to XLA's implicit all-reduce", stacklevel=3)
         return None
-    return GradReducer(config, mesh, templates, data_axes)
+    if config.quantized and warn:
+        warnings.warn(
+            f"grad_reduce mode='quant' on a hybrid mesh (model axes "
+            f"{nondata}): quantized collectives need a fully-manual "
+            "shard_map, which model axes preclude on this build — "
+            f"downgrading to explicit fp32 psum over {data_axes} "
+            "(error feedback off)", stacklevel=3)
+    return GradReducer(config, mesh, templates, data_axes, hybrid=True)
 
 
 def make_tree_reducer(reducer: GradReducer):
@@ -274,7 +327,7 @@ def make_tree_reducer(reducer: GradReducer):
     replicated. The train step itself inlines reduce_local instead."""
     dax = reducer.data_axes
     mesh = reducer.mesh
-    manual = set(mesh.axis_names)
+    manual = set(reducer.manual_axes)
 
     def local(gstack, ef):
         g = {k: v[0] for k, v in gstack.items()}

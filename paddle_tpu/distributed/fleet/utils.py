@@ -231,11 +231,14 @@ class ShardedTrainStep:
         # ---- gradient-reduction strategy (distributed.comm_opt) ----
         # The explicit reducer replaces GSPMD's implicit grad all-reduce
         # with bucketed quantized/hierarchical collectives inside a
-        # fully-manual shard_map over the data axes. reducer_for_step
-        # returns None (implicit reduction stays) for mode="off", a
-        # single-device data world, or meshes with active non-data axes
-        # (incl. pp — partial-auto shard_map cannot host these
-        # collectives; see comm_opt.reduce).
+        # fully-manual shard_map over the data axes. On hybrid dp x mp
+        # meshes reducer_for_step hands back a hybrid reducer instead:
+        # the region below goes partial-auto (manual over the data axes
+        # only, reducer.manual_axes) and each model shard takes an
+        # explicit flat fp32 psum over its data replicas. reducer is
+        # None (implicit reduction stays) for mode="off", a single-device
+        # data world, or pp/sep meshes (those stages nest their own
+        # shard_maps; see comm_opt.reduce).
         self._grad_reduce = _comm_opt.normalize_grad_reduce(grad_reduce)
         bspec0 = (batch_sharding.spec[0] if len(batch_sharding.spec)
                   else None)
@@ -338,7 +341,7 @@ class ShardedTrainStep:
                 in_specs=(P(), P(), ef_specs, batch_sharding.spec,
                           batch_sharding.spec, P(), P()),
                 out_specs=(P(), P(), P(), ef_specs),
-                axis_names=set(mesh.axis_names), check_vma=False,
+                axis_names=set(reducer.manual_axes), check_vma=False,
             )(params, bufs, ef, x, y, seed, sc_in)
             return (loss, new_bufs), grads, new_ef
 
@@ -924,16 +927,19 @@ class ShardedTrainStep:
 
     def restore_from_checkpoint(self, tree):
         """Adopt a restored TrainState tree (from CheckpointManager.restore,
-        ideally with checkpoint_shardings()). Host-numpy leaves are placed
-        onto this step's mesh here, so a checkpoint saved under a different
-        topology restores cleanly."""
+        ideally with checkpoint_shardings()). Leaves still resident on a
+        mesh (e.g. state handed over across an elastic mesh re-form) move
+        device-to-device through the resharding planner; host-numpy leaves
+        are placed onto this step's mesh the ordinary way — either way a
+        checkpoint saved under a different topology restores cleanly."""
         from ...checkpoint import TrainState
+        from .. import resharding as _resharding
 
         ts = tree if isinstance(tree, TrainState) else TrainState.from_tree(tree)
-        self.params = {k: jax.device_put(v, self._p_shard[k])
+        self.params = {k: _resharding.reshard(v, self._p_shard[k])
                        for k, v in ts.params.items()}
         self.opt_state = jax.tree_util.tree_map(
-            lambda v, s: jax.device_put(v, s), ts.opt_state, self._s_shard)
+            lambda v, s: _resharding.reshard(v, s), ts.opt_state, self._s_shard)
         if ts.buffers is not None:
             self.buffers = jax.tree_util.tree_map(jnp.asarray, ts.buffers)
         if ts.extra and ts.extra.get("scaler_state") is not None:

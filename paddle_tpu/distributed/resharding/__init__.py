@@ -1,0 +1,17 @@
+"""Resharding compiler: portable NamedSharding -> NamedSharding moves.
+
+Plans (planner.py, pure python — previewable offline via
+tools/comm_plan.py --reshard) decompose arbitrary redistribution into
+all_gather / all_to_all / dynamic_slice / ppermute steps per mesh axis;
+the executor replays them inside a fully-manual shard_map, bitwise-equal
+to jax.device_put. Consumed by checkpoint topology-change restore,
+serving weight loads, and the comm_opt hybrid-mesh gradient reducer.
+Semantics: README.md here.
+"""
+
+from .spec import (MeshSpec, ShardingSpec, Unplannable,  # noqa: F401
+                   shard_index_map)
+from .planner import (ReshardPlan, ReshardStep, describe,  # noqa: F401
+                      plan_as_dict, plan_reshard, plan_sends)
+from .executor import (clear_caches, from_named_sharding,  # noqa: F401
+                       plan_for, reshard, reshard_tree)

@@ -258,6 +258,46 @@ class Engine:
         self._greedy = np.ones((B,), bool)
         self._exe: Dict = {}
 
+    # -- weight management --
+    def load_weights(self, params, shardings=None, allow_missing=False):
+        """Hot-swap serving weights from a live parameter tree — e.g. the
+        params of a training step on its OWN mesh — without a host round
+        trip: each leaf moves device-to-device through the resharding
+        planner (distributed.resharding) onto the serving layout, with
+        ``jax.device_put`` as the per-leaf fallback.
+
+        `shardings` (optional {name: NamedSharding}) selects the serving
+        layout per param; by default each current param's own sharding is
+        kept, so the AOT-compiled prefill/decode executables stay valid.
+        Shapes and dtypes must match the compiled params exactly."""
+        from ..distributed import resharding as _resharding
+
+        missing = [k for k in self.params if k not in params]
+        if missing and not allow_missing:
+            raise KeyError(f"load_weights: missing params {missing[:4]}"
+                           + ("..." if len(missing) > 4 else ""))
+        new = {}
+        for name, cur in self.params.items():
+            if name not in params:
+                new[name] = cur
+                continue
+            leaf = params[name]
+            leaf = getattr(leaf, "_value", leaf)  # unwrap Tensor
+            if (tuple(leaf.shape) != tuple(cur.shape)
+                    or str(leaf.dtype) != str(cur.dtype)):
+                raise ValueError(
+                    f"load_weights: param {name!r} is "
+                    f"{leaf.shape}/{leaf.dtype}, engine compiled for "
+                    f"{cur.shape}/{cur.dtype}")
+            dst = (shardings or {}).get(name, cur.sharding)
+            new[name] = _resharding.reshard(leaf, dst)
+        self.params = new
+        if shardings:
+            # layouts changed: the AOT executables were compiled against
+            # the old shardings — drop them so the next step recompiles
+            self._exe.clear()
+        return self
+
     # -- request API --
     def add_request(self, prompt_ids: Sequence[int],
                     sampling: Optional[SamplingParams] = None) -> Request:

@@ -34,7 +34,7 @@ def test_all_configs_registered():
 
     assert set(bench.CONFIGS) == {"bert_sst2", "gpt_dp", "ernie_mp4",
                                   "resnet50", "gpt_moe", "serving", "ckpt",
-                                  "data", "comm"}
+                                  "data", "comm", "reshard"}
 
 
 def test_bench_ckpt_row_contract(capsys):
@@ -111,6 +111,35 @@ def test_bench_comm_row_contract(capsys):
         assert tele["counters"]["comm.grad_reduce.steps"] > 0
         assert tele["counters"]["comm.grad_reduce.bytes{kind=wire}"] > 0
         assert tele["gauges"]["comm.grad_reduce.compression_ratio"] >= 3.5
+    # the row must not leave the global observability flag flipped on
+    assert not observability.enabled()
+
+
+def test_bench_reshard_row_contract(capsys):
+    """The reshard row's acceptance invariant: the planner-driven move
+    beats naive replicate-then-slice by >= 2.0x on the (2,2) -> (4,)
+    param move, with the comm.reshard.* metric series in the telemetry
+    sub-object and no device_put fallbacks."""
+    import bench
+    from paddle_tpu import observability
+
+    row = bench.bench_reshard()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(out)
+    assert parsed == row
+    assert parsed["config"] == "reshard"
+    assert parsed["value"] > 0 and np.isfinite(parsed["value"])
+    assert parsed["plan_ms"] > 0 and parsed["execute_ms"] > 0
+    assert 0 < parsed["bytes_wire"] < parsed["bytes_naive"]
+    assert parsed["reduction_ratio"] >= 2.0
+    assert parsed["steps"]  # a real plan, not the identity
+    tele = parsed["telemetry"]
+    assert tele["counters"]["comm.reshard.plans"] > 0
+    assert tele["counters"]["comm.reshard.bytes{kind=wire}"] > 0
+    assert tele["counters"]["comm.reshard.bytes{kind=naive}"] > 0
+    assert not any(k.startswith("comm.reshard.fallbacks")
+                   for k in tele["counters"])
+    assert tele["histograms"]["comm.reshard.execute_seconds"]["count"] > 0
     # the row must not leave the global observability flag flipped on
     assert not observability.enabled()
 

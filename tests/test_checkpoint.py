@@ -124,6 +124,71 @@ def test_reshard_on_restore_across_meshes(tmp_path):
     mgr.close()
 
 
+def test_live_restore_planner_bitwise_matches_file_restore(tmp_path):
+    """Topology-change restore with the source arrays still resident:
+    restore(live_state=...) moves them device-to-device through the
+    resharding planner (comm.reshard.plans ticks, no shard-file reads for
+    those leaves) and is BITWISE-identical to the file-based path. The
+    (2,4) -> (8,) regrid keeps the device set fixed — a growing set would
+    (correctly) fall back to files."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed import resharding as _rs
+
+    mesh24 = Mesh(np.array(jax.devices()).reshape(2, 4), ("x", "y"))
+    w = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    b = np.arange(8, dtype=np.float32)
+    live = {
+        "w": jax.device_put(w, NamedSharding(mesh24, P("x", "y"))),
+        "b": jax.device_put(b, NamedSharding(mesh24, P("x"))),
+        "step": 3,
+    }
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_=False)
+    mgr.save(3, live)
+
+    mesh8 = Mesh(np.array(jax.devices()), ("z",))
+    shardings = {
+        "w": NamedSharding(mesh8, P("z", None)),
+        "b": NamedSharding(mesh8, P("z")),
+    }
+    from_file = mgr.restore(shardings=shardings)
+
+    _rs.clear_caches()
+    obs.enable()
+    try:
+        obs.reset()
+        from_live = mgr.restore(shardings=shardings, live_state=live)
+        c = obs.snapshot()["counters"]
+        # both arrays went through the planner, none fell back
+        assert c["comm.reshard.plans"] == 2
+        assert not any(k.startswith("comm.reshard.fallbacks") for k in c)
+    finally:
+        obs.disable()
+        obs.reset()
+
+    for k in ("w", "b"):
+        assert from_live[k].sharding == shardings[k]
+        ours = {s.device.id: np.asarray(s.data)
+                for s in from_live[k].addressable_shards}
+        want = {s.device.id: np.asarray(s.data)
+                for s in from_file[k].addressable_shards}
+        assert ours.keys() == want.keys()
+        for dev in want:
+            np.testing.assert_array_equal(ours[dev], want[dev])
+    assert from_live["step"] == 3
+    np.testing.assert_array_equal(np.asarray(from_live["w"]), w)
+
+    # a live leaf whose shape no longer matches the manifest is ignored
+    # (file path restores it); extra live leaves are harmless
+    stale = dict(live, w=jax.device_put(
+        np.zeros((4, 4), np.float32), NamedSharding(mesh24, P("x", "y"))))
+    back = mgr.restore(shardings=shardings, live_state=stale)
+    np.testing.assert_array_equal(np.asarray(back["w"]), w)
+    mgr.close()
+
+
 # ---------------- manager.py: commit protocol + GC ----------------
 
 def test_manager_latest_and_already_committed(tmp_path):

@@ -222,16 +222,34 @@ def test_reducer_activation_rules():
     m1 = Mesh(np.array(jax.devices()[:1]), ("dp",))
     assert reducer_for_step(GradReduceConfig(mode="quant"), m1, ("dp",),
                             templates) is None
-    # active non-data axis: partial-auto shard_map is unsupported -> warn
-    # and fall back to the implicit reduction
+    # active mp axis: hybrid reducer — partial-auto region manual over the
+    # data axes only, quant downgraded to flat fp32 psum (with a warning)
     mmp = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
                ("dp", "mp", "sharding"))
-    with pytest.warns(UserWarning, match="non-data axes"):
-        assert reducer_for_step(GradReduceConfig(mode="quant"), mmp,
+    with pytest.warns(UserWarning, match="downgrading to explicit fp32"):
+        red = reducer_for_step(GradReduceConfig(mode="quant"), mmp,
+                               ("dp", "sharding"), templates)
+    assert red is not None and red.hybrid and red.world == 4
+    assert red.manual_axes == ("dp", "sharding")
+    assert red.config.mode == "fp32" and not red.has_ef
+    assert red._stages == [(("sharding", "dp"), 4)]  # flat single psum
+    # fp32 on the same mesh: hybrid without any downgrade warning
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        red = reducer_for_step(GradReduceConfig(mode="fp32"), mmp,
+                               ("dp", "sharding"), templates)
+    assert red is not None and red.hybrid
+    # active pp axis: no hybrid path (nested shard_maps) -> warn, naming
+    # the blocking axis, and fall back to the implicit reduction
+    mpp = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+               ("dp", "pp", "sharding"))
+    with pytest.warns(UserWarning, match=r"'pp': 2.*no hybrid"):
+        assert reducer_for_step(GradReduceConfig(mode="quant"), mpp,
                                 ("dp", "sharding"), templates) is None
     red = reducer_for_step(GradReduceConfig(mode="quant"), mesh,
                            ("dp", "sharding"), templates)
-    assert red is not None and red.world == 8
+    assert red is not None and red.world == 8 and not red.hybrid
+    assert red.manual_axes == ("dp", "sharding")
 
 
 # ---------------- end-to-end training parity (acceptance) ----------------
@@ -279,6 +297,63 @@ def test_explicit_fp32_matches_implicit():
     ex, st = _train("fp32", 6)
     assert st._reducer is not None and not st._reducer.has_ef
     np.testing.assert_allclose(ex, base, rtol=2e-5)
+
+
+def _train_hybrid(grad_reduce, steps, dp=2, mp=4, batch=16):
+    """Fresh tiny-GPT ShardedTrainStep on a dp x mp hybrid mesh (fleet
+    hybrid_configs: mp layers annotate their weights over "mp") -> loss
+    sequence. Same seeds every call."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import collective, mesh as _mesh, topology
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    collective.destroy_process_group()
+    _mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp}
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        m = gpt_tiny(dropout=0.0, num_layers=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        st = make_sharded_train_step(m, opt, grad_reduce=grad_reduce)
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, 128, size=(batch, 16))
+        y = np.roll(x, -1, axis=1)
+        return [float(st(x, y)) for _ in range(steps)], st
+    finally:
+        collective.destroy_process_group()
+        _mesh.reset_global_mesh()
+        topology.set_hybrid_communicate_group(None)
+
+
+def test_hybrid_mesh_explicit_reduce_activates_and_matches():
+    """ISSUE acceptance: on a dp=2 x mp=4 mesh the reducer ACTIVATES as
+    the hybrid flat-fp32 path (partial-auto region manual over the data
+    axes, mp stays GSPMD-auto) instead of warn-and-fall-back, and the
+    losses match the implicit reduction to float tolerance."""
+    base, st0 = _train_hybrid(None, 4)
+    assert st0._reducer is None
+    hyb, st = _train_hybrid("fp32", 4)
+    r = st._reducer
+    assert r is not None and r.hybrid and r.world == 2
+    assert r.manual_axes == ("dp", "sharding", "ep")
+    assert not r.has_ef and st.ef_state == {}
+    np.testing.assert_allclose(hyb, base, rtol=2e-5)
+    assert hyb[-1] < hyb[0] - 0.2  # it actually trained
+
+
+def test_hybrid_mesh_quant_downgrades_to_fp32():
+    with pytest.warns(UserWarning, match="downgrading to explicit fp32"):
+        q, st = _train_hybrid("int8", 2)
+    assert st._reducer is not None and st._reducer.hybrid
+    assert st._reducer.config.mode == "fp32"
+    base, _ = _train_hybrid(None, 2)
+    np.testing.assert_allclose(q, base, rtol=2e-5)
 
 
 def test_overlap_deterministic_and_matches_no_overlap():

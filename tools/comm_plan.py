@@ -1,22 +1,37 @@
 #!/usr/bin/env python
-"""Describe a gradient-reduction plan (distributed.comm_opt) offline.
+"""Describe a gradient-reduction or resharding plan offline.
 
-Prints the bucketed reduction schedule ShardedTrainStep would run for a
-given mesh + parameter set + grad_reduce config: buckets, axis order,
-and per-stage bytes on the wire before/after compression.
+Default mode prints the bucketed reduction schedule ShardedTrainStep
+would run for a given mesh + parameter set + grad_reduce config:
+buckets, axis order, and per-stage bytes on the wire before/after
+compression.
+
+--reshard mode prints the redistribution schedule the resharding
+compiler (distributed.resharding) emits for one array moving between
+two NamedShardings: the collective steps, per-step bytes on the wire,
+and the total against the naive replicate-then-slice baseline.
 
 Usage:
     python tools/comm_plan.py --mesh dp=4,sharding=2 --params 1.3e9
     python tools/comm_plan.py --mesh dp=8 --mode quant --dtype bf16 \
         --leaf embed=32000x1024 --leaf w1=1024x4096 --leaf b1=4096
     python tools/comm_plan.py --mesh dp=2,sharding=4 --flat --json
-    python tools/comm_plan.py --mesh dp=8 --params 350e6 --accum 4
+    python tools/comm_plan.py --reshard --shape 4096x1024 \
+        --src-mesh dp=2,mp=2 --src-spec mp,- \
+        --dst-mesh x=4 --dst-spec x,-
+    python tools/comm_plan.py --reshard --shape 1024x1024 --dtype bf16 \
+        --src-mesh dp=4 --src-spec dp --dst-mesh x=2 --dst-spec -,x --json
+
+Spec syntax: comma-separated per-array-dim entries; each entry is "-"
+(replicated) or "+"-joined mesh axis names ("dp+mp").
 
 Runs standalone — no paddle_tpu (or jax) import: comm_opt's config/plan
-modules are pure python and are loaded directly from
-paddle_tpu/distributed/comm_opt/, so the plan can be inspected on
+modules and resharding's spec/planner are pure python and are loaded
+directly from paddle_tpu/distributed/, so plans can be inspected on
 machines without an accelerator stack. Exit code 1 on a bad mesh/leaf
-spec or config. Semantics: paddle_tpu/distributed/comm_opt/README.md.
+spec, config, or unplannable move. Semantics:
+paddle_tpu/distributed/comm_opt/README.md and
+paddle_tpu/distributed/resharding/README.md.
 """
 
 from __future__ import annotations
@@ -28,16 +43,29 @@ import os
 import sys
 import types
 
-# Load comm_opt/{config,plan}.py as a synthetic package: executing
-# paddle_tpu/__init__.py would initialize jax, which this tool must not
-# require (and these modules do not).
-_COMM_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         os.pardir, "paddle_tpu", "distributed", "comm_opt")
+# Load comm_opt/{config,plan}.py and resharding/{spec,planner}.py as
+# synthetic packages: executing paddle_tpu/__init__.py would initialize
+# jax, which this tool must not require (and these modules do not).
+_DIST_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir, "paddle_tpu", "distributed")
 _pkg = types.ModuleType("_ptcomm")
-_pkg.__path__ = [_COMM_DIR]
+_pkg.__path__ = [os.path.join(_DIST_DIR, "comm_opt")]
 sys.modules.setdefault("_ptcomm", _pkg)
 config = importlib.import_module("_ptcomm.config")
 plan = importlib.import_module("_ptcomm.plan")
+_rpkg = types.ModuleType("_ptreshard")
+_rpkg.__path__ = [os.path.join(_DIST_DIR, "resharding")]
+sys.modules.setdefault("_ptreshard", _rpkg)
+rspec = importlib.import_module("_ptreshard.spec")
+rplanner = importlib.import_module("_ptreshard.planner")
+
+#: itemsize table for --reshard --dtype (kept local: no numpy needed)
+_ITEMSIZES = {
+    "float64": 8, "f64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "f32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "bf16": 2, "float16": 2, "f16": 2, "int16": 2,
+    "int8": 1, "uint8": 1, "bool": 1, "fp8": 1,
+}
 
 
 def parse_mesh(spec: str) -> dict:
@@ -70,6 +98,54 @@ def parse_leaf(spec: str):
     return name.strip(), shape
 
 
+def parse_spec(text: str):
+    """"mp,-" -> [("mp",), ()]; "dp+mp,x" -> [("dp","mp"),("x",)]."""
+    entries = []
+    for part in text.split(","):
+        part = part.strip()
+        if part in ("-", "", "none", "None"):
+            entries.append(())
+        else:
+            entries.append(tuple(a.strip() for a in part.split("+")))
+    return entries
+
+
+def run_reshard(args) -> int:
+    for req in ("shape", "src_mesh", "src_spec", "dst_mesh", "dst_spec"):
+        if getattr(args, req) is None:
+            print(f"comm_plan: --reshard needs --{req.replace('_', '-')}",
+                  file=sys.stderr)
+            return 1
+    try:
+        itemsize = _ITEMSIZES[args.dtype.lower()]
+    except KeyError:
+        print(f"comm_plan: unknown --dtype {args.dtype!r} "
+              f"(known: {', '.join(sorted(_ITEMSIZES))})", file=sys.stderr)
+        return 1
+    try:
+        shape = tuple(int(d) for d in args.shape.lower().split("x"))
+        if any(d < 1 for d in shape):
+            raise ValueError(f"bad --shape {args.shape!r}")
+        src_mesh = rspec.MeshSpec.make(parse_mesh(args.src_mesh))
+        dst_mesh = rspec.MeshSpec.make(parse_mesh(args.dst_mesh))
+        ndim = len(shape)
+        src = rspec.ShardingSpec.make(src_mesh, parse_spec(args.src_spec),
+                                      ndim)
+        dst = rspec.ShardingSpec.make(dst_mesh, parse_spec(args.dst_spec),
+                                      ndim)
+        p = rplanner.plan_reshard(shape, itemsize, src, dst,
+                                  dtype=args.dtype)
+    except (ValueError, TypeError) as exc:  # incl. Unplannable
+        print(f"comm_plan: {exc}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(rplanner.plan_as_dict(p), indent=1,
+                         sort_keys=True))
+    else:
+        print(rplanner.describe(p))
+    return 0
+
+
 def synthetic_leaves(n_params: int):
     """A GPT-ish leaf mix totalling ~n_params: one embedding-sized leaf,
     a run of square-matmul blocks, and small 1-D bias/norm leaves. The
@@ -95,7 +171,7 @@ def synthetic_leaves(n_params: int):
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--mesh", required=True,
+    ap.add_argument("--mesh", default=None,
                     help="data-axis sizes, e.g. dp=4,sharding=2")
     ap.add_argument("--params", type=float, default=None,
                     help="total parameter count (synthetic GPT-ish leaf "
@@ -105,7 +181,25 @@ def main(argv=None) -> int:
                     "(e.g. --leaf w1=1024x4096)")
     ap.add_argument("--mode", default="quant",
                     choices=["off", "fp32", "quant"])
-    ap.add_argument("--dtype", default="int8", choices=["int8", "bf16"])
+    ap.add_argument("--dtype", default=None,
+                    help="wire dtype: int8|bf16 for the reduce plan "
+                         "(default int8); any array dtype for --reshard "
+                         "(default float32)")
+    ap.add_argument("--reshard", action="store_true",
+                    help="plan a NamedSharding->NamedSharding move "
+                         "(distributed.resharding) instead of a grad "
+                         "reduction")
+    ap.add_argument("--shape", default=None, metavar="DxD",
+                    help="[--reshard] global array shape, e.g. 4096x1024")
+    ap.add_argument("--src-mesh", default=None, metavar="AXIS=N,...",
+                    help="[--reshard] source mesh, e.g. dp=2,mp=2")
+    ap.add_argument("--src-spec", default=None, metavar="ENT,...",
+                    help="[--reshard] source partition spec, e.g. mp,- "
+                         "('-' = replicated, '+' joins axes)")
+    ap.add_argument("--dst-mesh", default=None, metavar="AXIS=N,...",
+                    help="[--reshard] destination mesh, e.g. x=4")
+    ap.add_argument("--dst-spec", default=None, metavar="ENT,...",
+                    help="[--reshard] destination partition spec")
     ap.add_argument("--block-size", type=int, default=128)
     ap.add_argument("--bucket-mb", type=float, default=4.0,
                     help="bucket size in MiB of raw fp32 (default 4)")
@@ -122,6 +216,15 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", help="emit JSON")
     args = ap.parse_args(argv)
 
+    if args.reshard:
+        args.dtype = args.dtype or "float32"
+        return run_reshard(args)
+    args.dtype = args.dtype or "int8"
+
+    if args.mesh is None:
+        print("comm_plan: --mesh is required (reduce-plan mode)",
+              file=sys.stderr)
+        return 1
     try:
         mesh_axes = parse_mesh(args.mesh)
         if args.leaf:
