@@ -34,6 +34,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
 from . import arrays as _arrays
 from .async_writer import AsyncWriter
 
@@ -132,12 +133,15 @@ class CheckpointManager:
             _sync_processes(f"ckpt_overwrite_{step}")
 
         t0 = time.perf_counter()
-        flat = _arrays.flatten_tree(state)
-        snaps: Dict[str, Any] = {
-            path: _arrays.snapshot_array(leaf)
-            for path, leaf in flat.items() if _arrays._is_array_leaf(leaf)
-        }
-        structure = _arrays._structure(state, snaps)
+        # unlabelled: a step=N label would grow one registry series per step
+        with _tracing.span("ckpt.save.blocking"):
+            flat = _arrays.flatten_tree(state)
+            snaps: Dict[str, Any] = {
+                path: _arrays.snapshot_array(leaf)
+                for path, leaf in flat.items()
+                if _arrays._is_array_leaf(leaf)
+            }
+            structure = _arrays._structure(state, snaps)
         blocking = time.perf_counter() - t0
         _metrics.histogram("ckpt.save.blocking_seconds", blocking)
 
@@ -230,9 +234,13 @@ class CheckpointManager:
                 f"step {step} is not a committed checkpoint in "
                 f"{self.directory} (committed: {steps})")
         t0 = time.perf_counter()
-        tree = _arrays.load_tree(self.step_path(step), shardings=shardings,
-                                 validate=self.validate_on_restore,
-                                 live_state=live_state)
+        # span name distinct from the ckpt.restore.seconds histogram below
+        # (span() records a <name>.seconds histogram of its own)
+        with _tracing.span("ckpt.restore.load"):
+            tree = _arrays.load_tree(self.step_path(step),
+                                     shardings=shardings,
+                                     validate=self.validate_on_restore,
+                                     live_state=live_state)
         _metrics.histogram("ckpt.restore.seconds", time.perf_counter() - t0)
         return tree
 

@@ -129,6 +129,7 @@ class GlobalBatchFeeder(CheckpointableIterator):
             self.host_wait_s_total += wait
             if _metrics.enabled():
                 _metrics.histogram("data.host_wait_seconds", wait)
+                _metrics.counter("data.batches", 1)
             yield dev
 
     # ---------------- protocol ----------------

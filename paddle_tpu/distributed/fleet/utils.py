@@ -12,14 +12,16 @@ and the hapi/auto-parallel engines all compile through.
 from __future__ import annotations
 
 import time
-from typing import Callable, Optional
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...core import random as _random
+from ...observability import goodput as _obs_goodput
 from ...observability import instrument as _obs_instr
+from ...observability import memory as _obs_memory
 from ...observability import metrics as _obs_metrics
 from ...core.autograd import no_grad
 from ...core.tensor import Tensor
@@ -535,6 +537,27 @@ class ShardedTrainStep:
         self._multi = None
         # observability: first dispatch per compiled path = compile-cache miss
         self._obs_warm = {"step": False, "multi": False}
+        # AOT executables keyed by (path, batch shapes) — see _obs_executable
+        self._obs_exe: Dict[Any, Any] = {}
+        self._obs_nrecords = 0
+
+    def _obs_executable(self, path: str, site: str, jitted, args, key):
+        """With observability ON, route dispatch through an explicitly
+        AOT-compiled executable so ``memory_analysis()`` can be gauged
+        (mem.exe.*{site=...}). Compiled BEFORE any jit dispatch of this
+        path, so there is exactly one compile either way — harvesting via
+        ``jitted.lower().compile()`` AFTER a jit dispatch would recompile
+        (the dispatch cache and the AOT lru cache are separate)."""
+        full_key = (path,) + tuple(key)
+        exe = self._obs_exe.get(full_key)
+        if exe is None:
+            try:
+                exe = jitted.lower(*args).compile()
+                _obs_memory.record_executable(site, exe)
+            except Exception:
+                exe = False  # backend can't AOT here — fall back to jit
+            self._obs_exe[full_key] = exe
+        return exe if exe else jitted
 
     def _obs_record(self, site: str, path: str, seconds: float,
                     samples: Optional[int], steps: int = 1):
@@ -552,6 +575,13 @@ class ShardedTrainStep:
         if not first:
             _obs_metrics.histogram("train.step.dispatch_seconds",
                                    seconds / max(steps, 1))
+            # goodput attribution only for warm steps: the first dispatch's
+            # wall time is compile, not compute
+            _obs_goodput.observe_step(seconds, steps=steps)
+        self._obs_nrecords += 1
+        if first or self._obs_nrecords % 32 == 0:
+            _obs_memory.record_live_buffers()
+            _obs_memory.record_device_memory()
         if self._reducer is not None:
             # static schedule -> exact byte accounting per dispatched step
             _comm_opt.record_reduce_metrics(
@@ -788,14 +818,20 @@ class ShardedTrainStep:
         ss_in = self.scaler_state if scaled else jnp.zeros((), jnp.float32)
         obs = _obs_metrics.enabled()
         t0 = time.perf_counter() if obs else 0.0
-        with jax.set_mesh(self.mesh):
-            (self.params, self.opt_state, self.buffers, ss_out,
-             self.ef_state, losses) = self._multi(
-                self.params, self.opt_state, self.buffers, ss_in,
-                self.ef_state, jnp.asarray(xs), jnp.asarray(ys),
+        xg, yg = jnp.asarray(xs), jnp.asarray(ys)
+        args = (self.params, self.opt_state, self.buffers, ss_in,
+                self.ef_state, xg, yg,
                 # +1 so scanned step j draws seed (seed + prev_steps + 1 + j)
                 # — identical to the seeds K sequential __call__s would use
                 jnp.float32(lr), jnp.uint32(self._seed + self._step_i - K + 1))
+        with jax.set_mesh(self.mesh):
+            fn = self._multi
+            if obs:
+                fn = self._obs_executable(
+                    "multi", "sharded_train_step.run_steps", fn, args,
+                    (xg.shape, yg.shape))
+            (self.params, self.opt_state, self.buffers, ss_out,
+             self.ef_state, losses) = fn(*args)
         if obs:
             samples = None
             if hasattr(xs, "shape") and len(getattr(xs, "shape", ())) >= 2:
@@ -811,32 +847,27 @@ class ShardedTrainStep:
         self._step_i += 1
         obs = _obs_metrics.enabled()
         t0 = time.perf_counter() if obs else 0.0
+        xg, yg = self._to_global_batch(x), self._to_global_batch(y)
+        scaled = self.scaler_state is not None
+        if scaled:
+            args = (self.params, self.opt_state, self.buffers,
+                    self.scaler_state, self.ef_state, xg, yg,
+                    jnp.float32(lr), jnp.uint32(self._seed + self._step_i))
+        else:
+            args = (self.params, self.opt_state, self.buffers,
+                    self.ef_state, xg, yg,
+                    jnp.float32(lr), jnp.uint32(self._seed + self._step_i))
         with jax.set_mesh(self.mesh):
-            if self.scaler_state is not None:
+            fn = self._compiled
+            if obs:
+                fn = self._obs_executable("step", "sharded_train_step", fn,
+                                          args, (xg.shape, yg.shape))
+            if scaled:
                 (self.params, self.opt_state, self.buffers, self.ef_state,
-                 self.scaler_state, loss) = self._compiled(
-                    self.params,
-                    self.opt_state,
-                    self.buffers,
-                    self.scaler_state,
-                    self.ef_state,
-                    self._to_global_batch(x),
-                    self._to_global_batch(y),
-                    jnp.float32(lr),
-                    jnp.uint32(self._seed + self._step_i),
-                )
+                 self.scaler_state, loss) = fn(*args)
             else:
                 (self.params, self.opt_state, self.buffers, self.ef_state,
-                 loss) = self._compiled(
-                    self.params,
-                    self.opt_state,
-                    self.buffers,
-                    self.ef_state,
-                    self._to_global_batch(x),
-                    self._to_global_batch(y),
-                    jnp.float32(lr),
-                    jnp.uint32(self._seed + self._step_i),
-                )
+                 loss) = fn(*args)
         if obs:
             samples = None
             if hasattr(x, "shape") and len(getattr(x, "shape", ())) >= 1:

@@ -33,6 +33,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ...observability import memory as _obs_memory
 from ...observability import metrics as _metrics
 from .planner import ReshardPlan, Unplannable, plan_reshard
 from .spec import MeshSpec, ShardingSpec
@@ -211,7 +212,20 @@ def reshard(arr, dst_sharding, *, plan: Optional[ReshardPlan] = None):
         return _fallback(arr, dst_sharding, "unplannable")
     t0 = time.perf_counter()
     if plan.steps:
-        res = _compiled_executor(plan, arr.sharding.mesh)(arr)
+        fn = _compiled_executor(plan, arr.sharding.mesh)
+        res = None
+        if _metrics.enabled():
+            # AOT so the executable's memory_analysis() can be gauged;
+            # lower().compile() on the cached jit object is lru-cached, so
+            # repeat moves of the same plan pay ~nothing extra
+            try:
+                exe = fn.lower(arr).compile()
+                _obs_memory.record_executable("reshard", exe)
+                res = exe(arr)
+            except Exception:
+                res = None
+        if res is None:
+            res = fn(arr)
     else:
         res = arr  # layouts already agree device-for-device
     out = _rebind(res, plan.global_shape, dst_sharding)

@@ -13,8 +13,39 @@ scheme and the bench.py field mapping.
     paddle_tpu.observability.dump_jsonl("/tmp/metrics.jsonl")
 """
 
-from . import instrument, metrics, tracing, training  # noqa: F401
+from . import (  # noqa: F401
+    aggregate,
+    export,
+    flight_recorder,
+    goodput,
+    instrument,
+    memory,
+    metrics,
+    tracing,
+    training,
+)
+from .aggregate import fleet_report, render_report  # noqa: F401
+from .export import (  # noqa: F401
+    MetricsExporter,
+    get_exporter,
+    start_exporter,
+    stop_exporter,
+)
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    get_flight_recorder,
+    read_flight,
+    start_flight_recorder,
+    stop_flight_recorder,
+)
+from .goodput import GoodputMonitor  # noqa: F401
 from .instrument import record_collective, record_compile  # noqa: F401
+from .memory import (  # noqa: F401
+    record_device_memory,
+    record_executable,
+    record_kv_cache,
+    record_live_buffers,
+)
 from .metrics import (  # noqa: F401
     MetricsRegistry,
     counter,
@@ -24,18 +55,34 @@ from .metrics import (  # noqa: F401
     enabled,
     gauge,
     get_registry,
+    hist_totals,
     histogram,
     reset,
     snapshot,
     summary,
 )
-from .tracing import clear_spans, export_chrome_trace, span, spans  # noqa: F401
+from .tracing import (  # noqa: F401
+    add_span_sink,
+    clear_spans,
+    export_chrome_trace,
+    remove_span_sink,
+    set_max_spans,
+    span,
+    spans,
+)
 from .training import record_step, record_window  # noqa: F401
 
 __all__ = [
     "MetricsRegistry", "enabled", "enable", "disable",
     "counter", "gauge", "histogram", "snapshot", "reset", "get_registry",
-    "summary", "dump_jsonl",
+    "summary", "dump_jsonl", "hist_totals",
     "span", "spans", "clear_spans", "export_chrome_trace",
+    "add_span_sink", "remove_span_sink", "set_max_spans",
     "record_collective", "record_compile", "record_step", "record_window",
+    "MetricsExporter", "start_exporter", "stop_exporter", "get_exporter",
+    "FlightRecorder", "start_flight_recorder", "stop_flight_recorder",
+    "get_flight_recorder", "read_flight",
+    "record_executable", "record_live_buffers", "record_device_memory",
+    "record_kv_cache",
+    "GoodputMonitor", "fleet_report", "render_report",
 ]

@@ -1,0 +1,250 @@
+"""Fleet-wide merge of per-host telemetry dumps (the multi-host view).
+
+Pure stdlib on purpose: ``tools/telemetry_report.py`` imports THIS module
+standalone (synthetic-package trick, same as tools/comm_plan.py) so dumps
+copied off a TPU fleet merge on any laptop with no jax — which is why this
+file mirrors ``metrics._BUCKET_BOUNDS`` instead of importing it (a test
+pins the two constants equal) and uses no relative imports.
+
+Inputs: per-host files written by ``observability.export.MetricsExporter``
+(one cumulative-snapshot JSON line per flush, ``paddle_tpu.metrics.v1``)
+— or plain ``dump_jsonl`` files (one record per line), treated as a single
+flush. Merge semantics:
+
+    counters   — summed across hosts (cumulative totals add)
+    gauges     — fleet mean/min/max + per-host values (a gauge is a level)
+    histograms — bucket-wise count addition, min/max combined, fleet
+                 percentiles re-estimated from the merged buckets
+    stragglers — per-host ``train.step.seconds`` mean vs the fleet median
+                 (delta seconds + ratio), the "host 13 is 1.4x slower" row
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import statistics
+from typing import Any, Dict, List, Optional
+
+# mirrors paddle_tpu.observability.metrics._BUCKET_BOUNDS (decade bounds,
+# seconds); kept in sync by tests/test_telemetry.py
+BUCKET_BOUNDS = tuple(10.0 ** e for e in range(-7, 4))
+
+STEP_HIST = "train.step.seconds"
+
+
+def _render_key(name: str, labels: Dict[str, Any]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+def percentile_from_buckets(buckets: List[int], count: int,
+                            mn: float, mx: float, q: float) -> float:
+    """Same estimator as metrics._Hist.percentile, over merged buckets."""
+    if not count:
+        return 0.0
+    target = q * count
+    cum = 0
+    for i, n in enumerate(buckets):
+        if not n:
+            continue
+        if cum + n >= target:
+            lo = BUCKET_BOUNDS[i - 1] if i > 0 else 0.0
+            hi = BUCKET_BOUNDS[i] if i < len(BUCKET_BOUNDS) else mx
+            lo = max(lo, mn)
+            hi = min(hi, mx)
+            if hi < lo:
+                hi = lo
+            return lo + (hi - lo) * ((target - cum) / n)
+        cum += n
+    return mx
+
+
+def load_host_dump(path: str, default_host: int = 0) -> Dict[str, Any]:
+    """Parse one per-host file into {"host": int, "flushes": [...]} where
+    each flush is {"ts", "seq", "metrics": [records]}. Accepts exporter
+    flush lines and bare dump_jsonl record lines; tolerates a torn tail."""
+    host: Optional[int] = None
+    m = re.search(r"host(\d+)", os.path.basename(path))
+    if m:
+        host = int(m.group(1))
+    flushes: List[Dict[str, Any]] = []
+    bare: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn final line from a crash — earlier flushes hold
+            if "metrics" in obj:
+                if host is None and "host" in obj:
+                    host = int(obj["host"])
+                flushes.append({"ts": obj.get("ts"), "seq": obj.get("seq"),
+                                "metrics": obj["metrics"]})
+            elif "type" in obj:
+                bare.append(obj)
+    if bare:
+        flushes.append({"ts": bare[0].get("ts"), "seq": 0, "metrics": bare})
+    return {"host": default_host if host is None else host,
+            "flushes": flushes}
+
+
+def merge_histograms(dicts: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Bucket-wise merge of histogram records (counts add, extrema
+    combine); fleet percentiles re-estimated from the merged buckets."""
+    count = sum(int(d.get("count", 0)) for d in dicts)
+    total = sum(float(d.get("sum", 0.0)) for d in dicts)
+    nonempty = [d for d in dicts if d.get("count")]
+    mn = min((float(d["min"]) for d in nonempty), default=0.0)
+    mx = max((float(d["max"]) for d in nonempty), default=0.0)
+    out = {"count": count, "sum": total,
+           "avg": total / count if count else 0.0, "min": mn, "max": mx}
+    blists = [d.get("buckets") for d in nonempty]
+    if blists and all(b is not None for b in blists):
+        width = max(len(b) for b in blists)
+        merged = [0] * width
+        for b in blists:
+            for i, n in enumerate(b):
+                merged[i] += int(n)
+        out["buckets"] = merged
+        for q, k in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+            out[k] = percentile_from_buckets(merged, count, mn, mx, q)
+    return out
+
+
+def _host_step_mean(records: List[Dict[str, Any]]) -> Optional[float]:
+    total, count = 0.0, 0
+    for r in records:
+        if r.get("type") == "histogram" and r.get("name") == STEP_HIST:
+            total += float(r.get("sum", 0.0))
+            count += int(r.get("count", 0))
+    return total / count if count else None
+
+
+def fleet_report(paths: List[str]) -> Dict[str, Any]:
+    """Merge ≥1 per-host dumps into one fleet view: summed counters,
+    per-host gauges, merged histograms, time series, straggler deltas."""
+    hosts: Dict[int, Dict[str, Any]] = {}
+    for i, path in enumerate(sorted(paths)):
+        dump = load_host_dump(path, default_host=i)
+        h = dump["host"]
+        while h in hosts:  # two files claiming one host id — keep both
+            h += 1000
+        hosts[h] = dump
+
+    counters: Dict[str, Dict[str, Any]] = {}
+    gauges: Dict[str, Dict[str, Any]] = {}
+    hist_per_host: Dict[str, Dict[int, Dict[str, Any]]] = {}
+    series: Dict[str, List[Dict[str, Any]]] = {}
+    step_means: Dict[int, float] = {}
+
+    for h, dump in sorted(hosts.items()):
+        if not dump["flushes"]:
+            continue
+        # the LAST flush is the cumulative state; earlier ones feed series
+        for flush in dump["flushes"]:
+            for r in flush["metrics"]:
+                if r.get("type") in ("counter", "gauge"):
+                    key = _render_key(r.get("name", "?"), r.get("labels", {}))
+                    series.setdefault(key, []).append(
+                        {"host": h, "ts": flush.get("ts"),
+                         "seq": flush.get("seq"), "value": r.get("value")})
+        last = dump["flushes"][-1]["metrics"]
+        for r in last:
+            key = _render_key(r.get("name", "?"), r.get("labels", {}))
+            typ = r.get("type")
+            if typ == "counter":
+                c = counters.setdefault(key, {"total": 0, "per_host": {}})
+                c["total"] += r.get("value", 0)
+                c["per_host"][h] = r.get("value", 0)
+            elif typ == "gauge":
+                g = gauges.setdefault(key, {"per_host": {}})
+                g["per_host"][h] = r.get("value")
+            elif typ == "histogram":
+                hist_per_host.setdefault(key, {})[h] = {
+                    k: v for k, v in r.items()
+                    if k not in ("type", "name", "labels")}
+        mean = _host_step_mean(last)
+        if mean is not None:
+            step_means[h] = mean
+
+    for g in gauges.values():
+        vals = [v for v in g["per_host"].values() if v is not None]
+        if vals:
+            g["mean"] = sum(vals) / len(vals)
+            g["min"] = min(vals)
+            g["max"] = max(vals)
+
+    histograms = {key: {**merge_histograms(list(per.values())),
+                        "per_host": per}
+                  for key, per in hist_per_host.items()}
+
+    stragglers: List[Dict[str, Any]] = []
+    if step_means:
+        med = statistics.median(step_means.values())
+        for h, mean in sorted(step_means.items()):
+            stragglers.append({
+                "host": h, "mean_step_s": mean,
+                "delta_s": mean - med,
+                "ratio": mean / med if med > 0 else 1.0})
+        stragglers.sort(key=lambda s: -s["ratio"])
+
+    return {"hosts": sorted(hosts), "counters": counters, "gauges": gauges,
+            "histograms": histograms, "series": series,
+            "stragglers": stragglers}
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float) and v != int(v):
+        return f"{v:.6g}"
+    try:
+        return f"{int(v)}"
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def render_report(report: Dict[str, Any], grep: str = "") -> str:
+    """Text rendering of a fleet_report (tools/telemetry_report.py)."""
+    lines = [f"hosts: {', '.join(str(h) for h in report['hosts'])}"]
+    cs = {k: v for k, v in report["counters"].items()
+          if not grep or grep in k}
+    if cs:
+        lines += ["", f"{'Counter (fleet total)':<52}{'Total':>12}  per-host",
+                  "-" * 92]
+        for k in sorted(cs):
+            per = " ".join(f"{h}:{_fmt(v)}"
+                           for h, v in sorted(cs[k]["per_host"].items()))
+            lines.append(f"{k[:51]:<52}{_fmt(cs[k]['total']):>12}  {per}")
+    gs = {k: v for k, v in report["gauges"].items() if not grep or grep in k}
+    if gs:
+        lines += ["", f"{'Gauge':<44}{'Mean':>12}{'Min':>12}{'Max':>12}",
+                  "-" * 80]
+        for k in sorted(gs):
+            g = gs[k]
+            lines.append(f"{k[:43]:<44}{_fmt(g.get('mean')):>12}"
+                         f"{_fmt(g.get('min')):>12}{_fmt(g.get('max')):>12}")
+    hs = {k: v for k, v in report["histograms"].items()
+          if not grep or grep in k}
+    if hs:
+        lines += ["", f"{'Histogram (merged)':<40}{'Count':>8}{'Avg':>12}"
+                      f"{'p50':>12}{'p95':>12}{'p99':>12}", "-" * 96]
+        for k in sorted(hs):
+            h = hs[k]
+            lines.append(f"{k[:39]:<40}{_fmt(h['count']):>8}"
+                         f"{_fmt(h['avg']):>12}{_fmt(h.get('p50')):>12}"
+                         f"{_fmt(h.get('p95')):>12}{_fmt(h.get('p99')):>12}")
+    if report["stragglers"]:
+        lines += ["", f"{'Straggler view (train.step.seconds)':<40}"
+                      f"{'mean':>12}{'delta':>12}{'ratio':>8}", "-" * 72]
+        for s in report["stragglers"]:
+            lines.append(f"host {s['host']:<35}{_fmt(s['mean_step_s']):>12}"
+                         f"{_fmt(s['delta_s']):>12}{s['ratio']:>8.3f}")
+    return "\n".join(lines)

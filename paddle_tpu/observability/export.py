@@ -1,0 +1,151 @@
+"""Periodic per-host JSONL metric export — the multi-host aggregation feed.
+
+Each host runs one ``MetricsExporter``: a daemon thread that every
+``interval_s`` appends ONE JSON line (a "flush") to
+``<directory>/metrics-host<NNNNN>.jsonl``:
+
+    {"schema": "paddle_tpu.metrics.v1", "host": 3, "pid": 4711,
+     "ts": 1722841200.0, "seq": 17, "metrics": [<registry records>]}
+
+``metrics`` carries the full cumulative registry (counters/gauges and
+histograms with bucket counts), so any single line is a complete snapshot —
+the merge side (``aggregate.py`` / ``tools/telemetry_report.py``) takes the
+LAST line per host for fleet totals and the line sequence for time series.
+Append-only + one line per flush means a crash can lose at most the final
+partial line; every earlier flush stays readable.
+
+Self-accounting: ``obs.export.flushes`` / ``obs.export.bytes`` /
+``obs.export.errors`` counters and an ``obs.export.flush_seconds``
+histogram (the bench.py "export overhead" row reads the latter).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from . import metrics
+
+SCHEMA = "paddle_tpu.metrics.v1"
+
+
+def _default_host() -> int:
+    env = os.environ.get("PT_HOST_ID")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def host_dump_path(directory: str, host: int) -> str:
+    return os.path.join(directory, f"metrics-host{host:05d}.jsonl")
+
+
+class MetricsExporter:
+    """Append-only periodic JSONL flusher for one host's registry."""
+
+    def __init__(self, directory: str, interval_s: float = 30.0,
+                 host: Optional[int] = None):
+        self.directory = directory
+        self.interval_s = float(interval_s)
+        self.host = _default_host() if host is None else int(host)
+        self.path = host_dump_path(directory, self.host)
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one flush: serialize the whole registry as a single line --
+    def flush(self, reason: str = "interval") -> Optional[str]:
+        t0 = time.perf_counter()
+        try:
+            line = json.dumps({
+                "schema": SCHEMA,
+                "host": self.host,
+                "pid": os.getpid(),
+                "ts": time.time(),
+                "seq": self._seq,
+                "reason": reason,
+                "metrics": metrics.get_registry().records(),
+            })
+            os.makedirs(self.directory, exist_ok=True)
+            with self._lock:
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
+                self._seq += 1
+        except Exception:
+            metrics.counter("obs.export.errors", 1)
+            return None
+        metrics.counter("obs.export.flushes", 1)
+        metrics.counter("obs.export.bytes", len(line) + 1)
+        metrics.histogram("obs.export.flush_seconds",
+                          time.perf_counter() - t0)
+        return self.path
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            self.flush()
+
+    def start(self) -> "MetricsExporter":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="pt-metrics-exporter", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, final_flush: bool = True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final_flush:
+            self.flush(reason="final")
+
+
+_exporter: Optional[MetricsExporter] = None
+_atexit_registered = False
+
+
+def _atexit_flush():
+    exp = _exporter
+    if exp is not None:
+        exp.stop(final_flush=True)
+
+
+def start_exporter(directory: str, interval_s: float = 30.0,
+                   host: Optional[int] = None) -> Optional[MetricsExporter]:
+    """Start (or replace) this process's periodic exporter. Returns None —
+    starting nothing — when observability is off."""
+    global _exporter, _atexit_registered
+    if not metrics.enabled():
+        return None
+    if _exporter is not None:
+        _exporter.stop(final_flush=False)
+    _exporter = MetricsExporter(directory, interval_s, host).start()
+    if not _atexit_registered:
+        atexit.register(_atexit_flush)
+        _atexit_registered = True
+    return _exporter
+
+
+def stop_exporter(final_flush: bool = True):
+    global _exporter
+    if _exporter is not None:
+        _exporter.stop(final_flush=final_flush)
+        _exporter = None
+
+
+def get_exporter() -> Optional[MetricsExporter]:
+    return _exporter

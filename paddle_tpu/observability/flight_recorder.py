@@ -1,0 +1,249 @@
+"""Crash-safe flight recorder: the forensics a dead run leaves behind.
+
+Keeps a bounded in-memory ring of recent telemetry events and continuously
+persists it to ONE per-host file via atomic rewrite (tmp + fsync + rename —
+the checkpoint COMMIT idea applied to telemetry), then finalizes the file
+with a full metric snapshot on SIGTERM, fatal exception, or interpreter
+exit. A preempted v5e host therefore always leaves a readable "black box"
+with its last ``capacity`` spans and where its counters stood.
+
+File format (JSONL, ``paddle_tpu.flight.v1``):
+
+    {"kind": "header", "schema": "paddle_tpu.flight.v1", "host": 0,
+     "pid": ..., "started_ts": ..., "capacity": 512}
+    {"kind": "span", "name": "ckpt.save", "ts": <us>, "dur": <us>, ...}
+    {"kind": "metrics", "ts": ..., "counters_delta": {...}, "gauges": {...}}
+    ...
+    {"kind": "final", "ts": ..., "reason": "sigterm" | "fatal" | "atexit"
+     | <caller reason>, "snapshot": <full metrics snapshot>}
+
+Span events arrive through ``tracing.add_span_sink`` — every ``span()``
+lands in the ring with zero extra instrumentation at call sites. Each
+periodic flush also appends a ``metrics`` event carrying counter deltas
+since the previous flush plus current gauges, so the ring interleaves
+"what ran" with "what moved".
+
+Self-accounting: ``obs.flight.events`` / ``obs.flight.flushes`` /
+``obs.flight.finalized`` / ``obs.flight.errors`` counters and an
+``obs.flight.flush_seconds`` histogram.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+from . import metrics, tracing
+from .export import _default_host
+
+SCHEMA = "paddle_tpu.flight.v1"
+
+
+class FlightRecorder:
+    def __init__(self, path: Optional[str] = None, capacity: int = 512,
+                 flush_interval_s: float = 5.0, host: Optional[int] = None):
+        self.host = _default_host() if host is None else int(host)
+        self.path = path or os.path.join(
+            tempfile.gettempdir(),
+            f"pt-flight-host{self.host:05d}-{os.getpid()}.jsonl")
+        self.capacity = int(capacity)
+        self.flush_interval_s = float(flush_interval_s)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._finalized = False
+        self._final_event: Optional[Dict[str, Any]] = None
+        self._last_counters: Dict[str, float] = {}
+        self._started_ts = time.time()
+        self._prev_sigterm = None
+        self._prev_excepthook = None
+
+    # -- event intake --
+    def _on_span(self, event: Dict[str, Any]):
+        with self._lock:
+            self._ring.append({"kind": "span", **event})
+        metrics.counter("obs.flight.events", 1, kind="span")
+
+    def _metrics_event(self) -> Dict[str, Any]:
+        snap = metrics.snapshot()
+        deltas = {}
+        for k, v in snap["counters"].items():
+            d = v - self._last_counters.get(k, 0)
+            if d:
+                deltas[k] = d
+        self._last_counters = dict(snap["counters"])
+        return {"kind": "metrics", "ts": time.time(),
+                "counters_delta": deltas, "gauges": snap["gauges"]}
+
+    # -- persistence: atomic rewrite so a crash mid-flush never corrupts --
+    def _write(self, extra: Optional[Dict[str, Any]] = None):
+        header = {"kind": "header", "schema": SCHEMA, "host": self.host,
+                  "pid": os.getpid(), "started_ts": self._started_ts,
+                  "capacity": self.capacity}
+        with self._lock:
+            events = list(self._ring)
+        lines = [header] + events
+        if self._final_event is not None:
+            lines.append(self._final_event)
+        elif extra is not None:
+            lines.append(extra)
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            for ev in lines:
+                f.write(json.dumps(ev) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def flush(self) -> Optional[str]:
+        if self._finalized:
+            return self.path
+        t0 = time.perf_counter()
+        try:
+            ev = self._metrics_event()
+            with self._lock:
+                self._ring.append(ev)
+            self._write()
+        except Exception:
+            metrics.counter("obs.flight.errors", 1)
+            return None
+        metrics.counter("obs.flight.flushes", 1)
+        metrics.histogram("obs.flight.flush_seconds",
+                          time.perf_counter() - t0)
+        return self.path
+
+    def finalize(self, reason: str = "atexit") -> Optional[str]:
+        """Append the terminal record (full snapshot) and persist.
+        Idempotent: the first reason wins; later calls are no-ops."""
+        if self._finalized:
+            return self.path
+        self._finalized = True
+        metrics.counter("obs.flight.finalized", 1, reason=reason)
+        try:
+            self._final_event = {"kind": "final", "ts": time.time(),
+                                 "reason": reason,
+                                 "snapshot": metrics.snapshot()}
+            self._write()
+        except Exception:
+            return None
+        return self.path
+
+    # -- lifecycle + crash hooks --
+    def _run(self):
+        while not self._stop.wait(self.flush_interval_s):
+            self.flush()
+
+    def _on_sigterm(self, signum, frame):
+        self.finalize("sigterm")
+        prev = self._prev_sigterm
+        if callable(prev):
+            prev(signum, frame)
+        else:
+            # preserve kill-by-SIGTERM semantics (exit status 143)
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def _on_fatal(self, exc_type, exc, tb):
+        self.finalize("fatal")
+        hook = self._prev_excepthook or sys.__excepthook__
+        hook(exc_type, exc, tb)
+
+    def start(self) -> "FlightRecorder":
+        tracing.add_span_sink(self._on_span)
+        try:
+            if threading.current_thread() is threading.main_thread():
+                self._prev_sigterm = signal.signal(signal.SIGTERM,
+                                                   self._on_sigterm)
+        except (ValueError, OSError):
+            self._prev_sigterm = None
+        self._prev_excepthook = sys.excepthook
+        sys.excepthook = self._on_fatal
+        atexit.register(self.finalize, "atexit")
+        self.flush()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="pt-flight-recorder", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, reason: str = "stop"):
+        """Detach hooks, stop the flusher, finalize the file."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        tracing.remove_span_sink(self._on_span)
+        try:
+            if (self._prev_sigterm is not None
+                    and threading.current_thread()
+                    is threading.main_thread()):
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+        except (ValueError, OSError):
+            pass
+        if self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+            self._prev_excepthook = None
+        self.finalize(reason)
+
+
+_recorder: Optional[FlightRecorder] = None
+
+
+def start_flight_recorder(path: Optional[str] = None, capacity: int = 512,
+                          flush_interval_s: float = 5.0,
+                          host: Optional[int] = None
+                          ) -> Optional[FlightRecorder]:
+    """Start (or replace) this process's flight recorder. Returns None —
+    starting nothing — when observability is off."""
+    global _recorder
+    if not metrics.enabled():
+        return None
+    if _recorder is not None:
+        _recorder.stop(reason="replaced")
+    _recorder = FlightRecorder(path, capacity, flush_interval_s, host).start()
+    return _recorder
+
+
+def stop_flight_recorder(reason: str = "stop"):
+    global _recorder
+    if _recorder is not None:
+        _recorder.stop(reason=reason)
+        _recorder = None
+
+
+def get_flight_recorder() -> Optional[FlightRecorder]:
+    return _recorder
+
+
+def read_flight(path: str) -> Dict[str, Any]:
+    """Parse a flight-recorder file into {header, events, final} (events
+    excludes the header and final records). Tolerates a torn final line."""
+    header, final, events = None, None, []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            kind = ev.get("kind")
+            if kind == "header":
+                header = ev
+            elif kind == "final":
+                final = ev
+            else:
+                events.append(ev)
+    return {"header": header, "events": events, "final": final}

@@ -1,0 +1,114 @@
+"""Goodput / straggler monitor: where did the step time go?
+
+Classifies training wall time into buckets using deltas of instrumentation
+that already exists — no new probes in the hot path:
+
+    data_wait   <- ``data.host_wait_seconds``   (data/feed.py)
+    ckpt_block  <- ``ckpt.save.blocking_seconds`` (checkpoint/manager.py)
+    comm        <- ``dist.collective.seconds``  (eager-face collectives)
+    compute     <- step wall time minus the comm share (comm overlaps the
+                   dispatch; data/ckpt stalls happen BETWEEN dispatches)
+
+``ShardedTrainStep`` feeds ``observe_step`` once per dispatch. Outputs:
+
+    train.goodput.seconds{bucket=...}  counters (cumulative attribution)
+    train.goodput.fraction             gauge (compute / accounted wall)
+    train.goodput.step_ratio           gauge (recent mean / window median)
+    train.goodput.regression           counter (ratio crossed threshold)
+
+The per-host straggler view (this host's step-time mean vs the fleet
+median) lives in ``aggregate.py`` — it needs every host's dump, not one
+process's registry.
+"""
+
+from __future__ import annotations
+
+import statistics
+from collections import deque
+from typing import Dict, Optional
+
+from . import metrics
+
+_BUCKET_SOURCES = (
+    ("data_wait", "data.host_wait_seconds"),
+    ("ckpt_block", "ckpt.save.blocking_seconds"),
+    ("comm", "dist.collective.seconds"),
+)
+
+
+class GoodputMonitor:
+    """Rolling per-step classifier + step-time regression detector."""
+
+    def __init__(self, window: int = 64, recent: int = 8,
+                 regression_factor: float = 1.5):
+        self.window = int(window)
+        self.recent = max(1, int(recent))
+        self.regression_factor = float(regression_factor)
+        self._steps: deque = deque(maxlen=self.window)
+        self._last: Dict[str, float] = {}
+        self._totals: Dict[str, float] = {
+            "compute": 0.0, "data_wait": 0.0, "ckpt_block": 0.0, "comm": 0.0}
+        self._in_regression = False
+
+    def _delta(self, hist_name: str) -> float:
+        total, _ = metrics.hist_totals(hist_name)
+        d = total - self._last.get(hist_name, 0.0)
+        self._last[hist_name] = total
+        return max(d, 0.0)
+
+    def observe_step(self, seconds: float, steps: int = 1) -> Dict[str, float]:
+        """Attribute one dispatch's wall time; returns the bucket seconds."""
+        buckets = {name: self._delta(src) for name, src in _BUCKET_SOURCES}
+        # comm time is spent INSIDE the dispatch window; stalls feeding or
+        # checkpointing are extra wall time around it
+        buckets["compute"] = max(seconds - buckets["comm"], 0.0)
+        for name, v in buckets.items():
+            if v:
+                self._totals[name] += v
+                metrics.counter("train.goodput.seconds", v, bucket=name)
+        accounted = sum(self._totals.values())
+        if accounted > 0:
+            metrics.gauge("train.goodput.fraction",
+                          self._totals["compute"] / accounted)
+        self._observe_regression(seconds / max(steps, 1))
+        return buckets
+
+    def _observe_regression(self, per_step: float):
+        self._steps.append(per_step)
+        if len(self._steps) < max(self.recent * 2, 8):
+            return
+        baseline = statistics.median(self._steps)
+        recent = list(self._steps)[-self.recent:]
+        ratio = (sum(recent) / len(recent)) / baseline if baseline > 0 else 1.0
+        metrics.gauge("train.goodput.step_ratio", ratio)
+        regressed = ratio > self.regression_factor
+        if regressed and not self._in_regression:
+            # count edges, not samples: one slowdown event = one increment
+            metrics.counter("train.goodput.regression", 1)
+        self._in_regression = regressed
+
+    def goodput_fraction(self) -> Optional[float]:
+        accounted = sum(self._totals.values())
+        return self._totals["compute"] / accounted if accounted > 0 else None
+
+
+_monitor: Optional[GoodputMonitor] = None
+
+
+def get_monitor() -> GoodputMonitor:
+    global _monitor
+    if _monitor is None:
+        _monitor = GoodputMonitor()
+    return _monitor
+
+
+def reset_monitor():
+    global _monitor
+    _monitor = None
+
+
+def observe_step(seconds: float, steps: int = 1):
+    """Flag-gated module face ShardedTrainStep calls once per dispatch."""
+    if not metrics.enabled():
+        return
+    get_monitor().observe_step(seconds, steps=steps)

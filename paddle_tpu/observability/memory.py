@@ -1,0 +1,112 @@
+"""HBM / host memory accounting from compiled executables and live buffers.
+
+``record_executable(site, compiled)`` turns an AOT ``Compiled``'s
+``memory_analysis()`` (XLA's ``CompiledMemoryStats``) into per-site gauges —
+the compile-time answer to "will this step fit in HBM", available before the
+first real dispatch. ``record_live_buffers()`` sums every live ``jax.Array``
+on this host for the runtime answer. Both gate on ``metrics.enabled()`` and
+swallow backend gaps (CPU has no ``memory_stats``; pathways-style backends
+may omit ``memory_analysis``), so call sites stay one line.
+
+Gauges (all labelled ``site=`` where applicable):
+
+    mem.exe.temp_bytes / argument_bytes / output_bytes / code_bytes /
+    alias_bytes   — raw CompiledMemoryStats fields per executable
+    mem.exe.peak_bytes — arg + out + temp + code - alias (HBM high-water
+                         estimate for one dispatch of this executable)
+    mem.live.bytes / mem.live.count — live jax.Array payload on this host
+    mem.device.bytes_in_use{device=} — allocator stats where the backend
+                         exposes them (TPU yes, CPU no)
+    mem.kv_cache.bytes — serving KV-cache footprint
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from . import metrics
+
+# (gauge suffix, CompiledMemoryStats attribute)
+_EXE_FIELDS = (
+    ("temp", "temp_size_in_bytes"),
+    ("argument", "argument_size_in_bytes"),
+    ("output", "output_size_in_bytes"),
+    ("code", "generated_code_size_in_bytes"),
+    ("alias", "alias_size_in_bytes"),
+)
+
+
+def record_executable(site: str, compiled: Any, **labels) -> bool:
+    """Gauge the ``memory_analysis()`` of one AOT-compiled executable.
+
+    Returns True when stats were recorded (False: flag off or the backend
+    does not expose memory analysis)."""
+    if not metrics.enabled():
+        return False
+    try:
+        stats = compiled.memory_analysis()
+    except Exception:
+        return False
+    if stats is None:
+        return False
+    peak = 0.0
+    seen = False
+    for kind, attr in _EXE_FIELDS:
+        v = getattr(stats, attr, None)
+        if v is None:
+            continue
+        seen = True
+        metrics.gauge(f"mem.exe.{kind}_bytes", float(v), site=site, **labels)
+        peak += -float(v) if kind == "alias" else float(v)
+    if seen:
+        metrics.gauge("mem.exe.peak_bytes", max(peak, 0.0),
+                      site=site, **labels)
+    return seen
+
+
+def record_live_buffers() -> None:
+    """Gauge the count and summed bytes of every live jax.Array this host
+    can see (committed + uncommitted). O(live arrays) — call at step
+    granularity, not inside inner loops."""
+    if not metrics.enabled():
+        return
+    try:
+        import jax
+
+        count, nbytes = 0, 0
+        for a in jax.live_arrays():
+            count += 1
+            nbytes += int(getattr(a, "nbytes", 0) or 0)
+    except Exception:
+        return
+    metrics.gauge("mem.live.count", count)
+    metrics.gauge("mem.live.bytes", nbytes)
+
+
+def record_device_memory() -> None:
+    """Gauge allocator stats per local device where the backend exposes
+    them (``Device.memory_stats()`` — TPU/GPU; None on CPU)."""
+    if not metrics.enabled():
+        return
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            ms = d.memory_stats()
+            if not ms:
+                continue
+            for key, gname in (("bytes_in_use", "mem.device.bytes_in_use"),
+                               ("peak_bytes_in_use",
+                                "mem.device.peak_bytes_in_use"),
+                               ("bytes_limit", "mem.device.bytes_limit")):
+                if key in ms:
+                    metrics.gauge(gname, float(ms[key]), device=str(d.id))
+    except Exception:
+        return
+
+
+def record_kv_cache(nbytes: int, **labels) -> None:
+    """Serving KV-cache footprint (the dominant serving HBM consumer)."""
+    if not metrics.enabled():
+        return
+    metrics.gauge("mem.kv_cache.bytes", float(nbytes), **labels)

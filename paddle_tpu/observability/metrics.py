@@ -53,8 +53,11 @@ def disable() -> None:
 _LabelKey = Tuple[Tuple[str, str], ...]
 _MetricKey = Tuple[str, _LabelKey]
 
-# latency-oriented decade buckets (seconds): le-style upper bounds
+# latency-oriented decade buckets (seconds): le-style upper bounds.
+# aggregate.py (which must stay stdlib-only) mirrors this constant; a test
+# asserts the two stay equal.
 _BUCKET_BOUNDS = tuple(10.0 ** e for e in range(-7, 4))
+BUCKET_BOUNDS = _BUCKET_BOUNDS
 
 
 def _labels_key(labels: Dict[str, Any]) -> _LabelKey:
@@ -84,6 +87,29 @@ class _Hist:
         self.max = max(self.max, value)
         self.buckets[bisect.bisect_left(_BUCKET_BOUNDS, value)] += 1
 
+    def percentile(self, q: float) -> float:
+        """Estimate the q-quantile (0..1) from the decade buckets: find the
+        bucket the rank falls in, interpolate linearly inside it, clamp to
+        the observed [min, max] so single-bucket histograms stay exact-ish."""
+        if not self.count:
+            return 0.0
+        target = q * self.count
+        cum = 0
+        for i, n in enumerate(self.buckets):
+            if not n:
+                continue
+            if cum + n >= target:
+                lo = _BUCKET_BOUNDS[i - 1] if i > 0 else 0.0
+                hi = (_BUCKET_BOUNDS[i] if i < len(_BUCKET_BOUNDS)
+                      else self.max)
+                lo = max(lo, self.min)
+                hi = min(hi, self.max)
+                if hi < lo:
+                    hi = lo
+                return lo + (hi - lo) * ((target - cum) / n)
+            cum += n
+        return self.max
+
     def as_dict(self) -> Dict[str, Any]:
         return {
             "count": self.count,
@@ -91,6 +117,10 @@ class _Hist:
             "avg": self.total / self.count if self.count else 0.0,
             "min": self.min if self.count else 0.0,
             "max": self.max if self.count else 0.0,
+            "p50": self.percentile(0.50),
+            "p95": self.percentile(0.95),
+            "p99": self.percentile(0.99),
+            "buckets": list(self.buckets),
         }
 
 
@@ -158,6 +188,17 @@ class MetricsRegistry:
         return sorted(recs, key=lambda r: (r["type"], r["name"],
                                            sorted(r["labels"].items())))
 
+    def hist_totals(self, name: str) -> Tuple[float, int]:
+        """(sum, count) across every label set of one histogram name — the
+        cheap delta source goodput.py polls every step."""
+        total, count = 0.0, 0
+        with self._lock:
+            for (n, _), h in self._hists.items():
+                if n == name:
+                    total += h.total
+                    count += h.count
+        return total, count
+
     def reset(self):
         with self._lock:
             self._counters.clear()
@@ -194,6 +235,10 @@ def histogram(name: str, value: float, **labels):
 
 def snapshot(reset: bool = False) -> Dict[str, Dict[str, Any]]:
     return _registry.snapshot(reset=reset)
+
+
+def hist_totals(name: str) -> Tuple[float, int]:
+    return _registry.hist_totals(name)
 
 
 def reset():

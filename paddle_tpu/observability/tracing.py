@@ -23,7 +23,7 @@ import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Any, Dict, List
+from typing import Any, Callable, Dict, List
 
 from ..profiler.profiler import _recorder
 from . import metrics
@@ -31,6 +31,29 @@ from . import metrics
 _MAX_SPANS = 65536
 _spans: deque = deque(maxlen=_MAX_SPANS)
 _lock = threading.Lock()
+# consumers (the flight recorder) that want every finished span as it lands;
+# mutated only under _lock, iterated on a local copy
+_sinks: List[Callable[[Dict[str, Any]], None]] = []
+
+
+def add_span_sink(fn: Callable[[Dict[str, Any]], None]):
+    """Register a callable invoked with every finished span event dict."""
+    with _lock:
+        if fn not in _sinks:
+            _sinks.append(fn)
+
+
+def remove_span_sink(fn: Callable[[Dict[str, Any]], None]):
+    with _lock:
+        if fn in _sinks:
+            _sinks.remove(fn)
+
+
+def set_max_spans(n: int):
+    """Resize the bounded span ring (keeps the most recent entries)."""
+    global _spans
+    with _lock:
+        _spans = deque(_spans, maxlen=max(1, int(n)))
 
 
 def _span_name(name: str, labels: Dict[str, Any]) -> str:
@@ -55,13 +78,26 @@ def span(name: str, **labels):
         full = _span_name(name, labels)
         # no-ops unless a Profiler is in a RECORD state — the merge seam
         _recorder.record(full, t0, t1)
+        event = {
+            "name": full,
+            "ts": t0 * 1e6,
+            "dur": (t1 - t0) * 1e6,
+            "tid": threading.get_ident() % 100000,
+        }
         with _lock:
-            _spans.append({
-                "name": full,
-                "ts": t0 * 1e6,
-                "dur": (t1 - t0) * 1e6,
-                "tid": threading.get_ident() % 100000,
-            })
+            dropped = (_spans.maxlen is not None
+                       and len(_spans) == _spans.maxlen)
+            _spans.append(event)
+            sinks = list(_sinks)
+        if dropped:
+            # the ring silently evicted its oldest span — make the loss
+            # visible so long runs know the buffer undersized
+            metrics.counter("obs.trace.dropped", 1)
+        for sink in sinks:
+            try:
+                sink(event)
+            except Exception:
+                metrics.counter("obs.trace.sink_errors", 1)
 
 
 def spans() -> List[Dict[str, Any]]:

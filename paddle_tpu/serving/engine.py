@@ -38,6 +38,7 @@ from ..core import random as _random
 from ..core.autograd import no_grad
 from ..core.tensor import Tensor
 from ..observability import instrument as _obs
+from ..observability import memory as _obs_memory
 from ..observability import metrics as _metrics
 from . import sampling as _sampling
 from .kv_cache import KVCache
@@ -72,6 +73,7 @@ def _aot(cache: Dict, key, site: str, fn, args) -> "jax.stages.Compiled":
     exe = jax.jit(fn).lower(*args).compile()
     _obs.record_compile(site, seconds=time.perf_counter() - t0,
                         cache_hit=False)
+    _obs_memory.record_executable(site, exe)
     cache[key] = exe
     return exe
 
@@ -248,6 +250,7 @@ class Engine:
         self.cache = KVCache(cfg.num_layers, B, cfg.num_kv_heads, S_max,
                              cfg.head_dim, dt)
         _metrics.gauge("serving.kv_cache.bytes", self.cache.nbytes)
+        _obs_memory.record_kv_cache(self.cache.nbytes)
         self.scheduler = Scheduler(B)
         self._slots: List[_SlotState] = [_SlotState() for _ in range(B)]
         # vectorized per-slot decode state (device args rebuilt per step)

@@ -34,7 +34,7 @@ def test_all_configs_registered():
 
     assert set(bench.CONFIGS) == {"bert_sst2", "gpt_dp", "ernie_mp4",
                                   "resnet50", "gpt_moe", "serving", "ckpt",
-                                  "data", "comm", "reshard"}
+                                  "data", "comm", "reshard", "obs"}
 
 
 def test_bench_ckpt_row_contract(capsys):
@@ -140,6 +140,40 @@ def test_bench_reshard_row_contract(capsys):
     assert not any(k.startswith("comm.reshard.fallbacks")
                    for k in tele["counters"])
     assert tele["histograms"]["comm.reshard.execute_seconds"]["count"] > 0
+    # the row must not leave the global observability flag flipped on
+    assert not observability.enabled()
+
+
+def test_bench_obs_row_contract(capsys):
+    """The obs row's acceptance invariant: the full telemetry tier
+    (exporter + flight recorder + goodput monitor) reports its own service
+    latencies and HBM accounting, and with the flag off the bench step time
+    is unchanged within noise — the overhead value must be small relative
+    to the step itself."""
+    import bench
+    from paddle_tpu import observability
+
+    row = bench.bench_obs()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(out)
+    assert parsed == row
+    assert parsed["config"] == "obs"
+    assert np.isfinite(parsed["value"])
+    assert parsed["step_ms_off"] > 0 and parsed["step_ms_on"] > 0
+    # zero-overhead within noise: the tier may not cost more than half a
+    # step (CPU-CI timing is jittery; on real hardware this is ~0)
+    assert abs(parsed["value"]) <= 0.5 * parsed["step_ms_off"]
+    assert parsed["export_flush_ms"] > 0
+    assert parsed["flight_flush_ms"] > 0
+    assert 0.0 < parsed["goodput_fraction"] <= 1.0
+    assert parsed["hbm_peak_mb"] > 0  # train-step executable was gauged
+    tele = parsed["telemetry"]
+    assert tele["counters"]["obs.export.flushes"] > 0
+    assert tele["counters"]["obs.flight.flushes"] > 0
+    assert tele["counters"]["train.steps"] > 0
+    assert tele["gauges"]["mem.exe.peak_bytes{site=sharded_train_step}"] > 0
+    hist = tele["histograms"]["train.step.dispatch_seconds"]
+    assert hist["count"] > 0 and "p99" in hist and "p50" in hist
     # the row must not leave the global observability flag flipped on
     assert not observability.enabled()
 

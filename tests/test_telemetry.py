@@ -1,0 +1,548 @@
+"""Production telemetry tier: multi-host export/aggregation, the crash-safe
+flight recorder, HBM accounting, and the goodput/straggler monitor.
+
+Covers: histogram percentile summaries (p50/p95/p99 + bucket export), the
+span-ring drop counter, the per-host JSONL exporter, flight-recorder
+finalization on every exit path (including a real SIGTERM delivered to a
+subprocess mid-run), fleet-wide dump merging with straggler deltas,
+``memory_analysis()`` gauges at the train-step and serving AOT sites, the
+goodput bucket classifier + step-time regression detector, the no-jax CLI
+surfaces (telemetry_report, metrics_dump --format prom/jsonl), and the
+zero-overhead contract sweep across every new instrumented subsystem.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import aggregate as obs_aggregate
+from paddle_tpu.observability import goodput as obs_goodput
+from paddle_tpu.observability import metrics as obs_metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def telemetry():
+    """Flag on + clean registry/spans, restored to off+empty afterwards."""
+    obs.enable()
+    obs.reset()
+    obs.clear_spans()
+    obs_goodput.reset_monitor()
+    yield obs
+    obs.stop_exporter(final_flush=False)
+    obs.stop_flight_recorder()
+    obs_goodput.reset_monitor()
+    obs.disable()
+    obs.reset()
+    obs.clear_spans()
+
+
+# ---------------- histogram percentile summaries --------------------------
+class TestPercentiles:
+    def test_snapshot_carries_percentiles_and_buckets(self, telemetry):
+        for v in (0.001, 0.002, 0.003, 0.2):
+            obs.histogram("q.seconds", v)
+        h = obs.snapshot()["histograms"]["q.seconds"]
+        for k in ("p50", "p95", "p99", "buckets"):
+            assert k in h
+        # estimates stay within the observed range and are ordered
+        assert h["min"] <= h["p50"] <= h["p95"] <= h["p99"] <= h["max"]
+        assert sum(h["buckets"]) == h["count"] == 4
+
+    def test_single_value_percentiles_collapse(self, telemetry):
+        obs.histogram("one.seconds", 0.05)
+        h = obs.snapshot()["histograms"]["one.seconds"]
+        assert h["p50"] == h["p99"] == pytest.approx(0.05)
+
+    def test_bucket_bounds_mirrored_in_aggregate(self):
+        # aggregate.py is stdlib-only by contract, so it duplicates the
+        # bounds constant — this pins the two copies together
+        assert tuple(obs_aggregate.BUCKET_BOUNDS) == tuple(
+            obs_metrics.BUCKET_BOUNDS)
+
+    def test_hist_totals_sums_across_label_sets(self, telemetry):
+        obs.histogram("t.seconds", 1.0, op="a")
+        obs.histogram("t.seconds", 2.0, op="b")
+        total, count = obs.hist_totals("t.seconds")
+        assert total == pytest.approx(3.0)
+        assert count == 2
+        assert obs.hist_totals("missing") == (0.0, 0)
+
+
+# ---------------- span-ring drop accounting -------------------------------
+class TestSpanDrop:
+    def test_overflow_is_counted_not_silent(self, telemetry):
+        obs.set_max_spans(4)
+        try:
+            for _ in range(7):
+                with obs.span("ring.op"):
+                    pass
+            snap = obs.snapshot()
+            assert snap["counters"]["obs.trace.dropped"] == 3
+            assert len(obs.spans()) == 4
+        finally:
+            obs.set_max_spans(65536)
+
+    def test_no_drops_within_capacity(self, telemetry):
+        obs.set_max_spans(16)
+        try:
+            for _ in range(10):
+                with obs.span("ring.op"):
+                    pass
+            assert "obs.trace.dropped" not in obs.snapshot()["counters"]
+        finally:
+            obs.set_max_spans(65536)
+
+
+# ---------------- per-host exporter ---------------------------------------
+class TestExporter:
+    def test_flush_lines_are_complete_snapshots(self, telemetry, tmp_path):
+        exp = obs.start_exporter(str(tmp_path), interval_s=3600, host=3)
+        obs.counter("train.steps", 5)
+        exp.flush()
+        obs.counter("train.steps", 2)
+        exp.flush()
+        obs.stop_exporter(final_flush=False)
+        lines = [json.loads(l) for l in open(exp.path)]
+        assert os.path.basename(exp.path) == "metrics-host00003.jsonl"
+        assert [l["seq"] for l in lines] == [0, 1]
+        assert all(l["schema"] == "paddle_tpu.metrics.v1" for l in lines)
+        assert all(l["host"] == 3 for l in lines)
+        steps = [r for r in lines[-1]["metrics"]
+                 if r["name"] == "train.steps"]
+        assert steps[0]["value"] == 7  # cumulative, not delta
+        assert obs.snapshot()["counters"]["obs.export.flushes"] == 2
+
+    def test_background_thread_flushes(self, telemetry, tmp_path):
+        obs.counter("bg.ticks", 1)
+        exp = obs.start_exporter(str(tmp_path), interval_s=0.05, host=0)
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not (
+                os.path.exists(exp.path)
+                and os.path.getsize(exp.path) > 0):
+            time.sleep(0.02)
+        obs.stop_exporter(final_flush=False)
+        assert os.path.getsize(exp.path) > 0
+
+    def test_stop_writes_final_flush(self, telemetry, tmp_path):
+        exp = obs.start_exporter(str(tmp_path), interval_s=3600, host=0)
+        obs.counter("c.x", 1)
+        obs.stop_exporter(final_flush=True)
+        lines = [json.loads(l) for l in open(exp.path)]
+        assert lines[-1]["reason"] == "final"
+
+
+# ---------------- flight recorder -----------------------------------------
+class TestFlightRecorder:
+    def test_ring_bounded_and_finalized_with_snapshot(self, telemetry,
+                                                      tmp_path):
+        path = str(tmp_path / "flight.jsonl")
+        fr = obs.start_flight_recorder(path, capacity=8,
+                                       flush_interval_s=3600)
+        for i in range(20):
+            with obs.span("step.op"):
+                pass
+        obs.counter("train.steps", 20)
+        fr.finalize("test")
+        flight = obs.read_flight(path)
+        assert flight["header"]["schema"] == "paddle_tpu.flight.v1"
+        assert flight["header"]["capacity"] == 8
+        spans = [e for e in flight["events"] if e["kind"] == "span"]
+        assert 0 < len(spans) <= 8  # bounded ring, most recent retained
+        assert flight["final"]["reason"] == "test"
+        snap = flight["final"]["snapshot"]
+        assert snap["counters"]["train.steps"] == 20
+
+    def test_finalize_is_idempotent_first_reason_wins(self, telemetry,
+                                                      tmp_path):
+        path = str(tmp_path / "flight.jsonl")
+        fr = obs.start_flight_recorder(path, flush_interval_s=3600)
+        fr.finalize("preempted")
+        fr.finalize("atexit")
+        assert obs.read_flight(path)["final"]["reason"] == "preempted"
+
+    def test_flush_interleaves_metric_deltas(self, telemetry, tmp_path):
+        path = str(tmp_path / "flight.jsonl")
+        fr = obs.start_flight_recorder(path, flush_interval_s=3600)
+        obs.counter("work.items", 3)
+        fr.flush()
+        obs.counter("work.items", 4)
+        fr.flush()
+        fr.finalize("test")
+        deltas = [e["counters_delta"].get("work.items", 0)
+                  for e in obs.read_flight(path)["events"]
+                  if e["kind"] == "metrics"]
+        assert 3 in deltas and 4 in deltas  # deltas, not cumulative
+
+    def test_sigterm_mid_run_leaves_readable_file(self, tmp_path):
+        """The acceptance path: a real SIGTERM delivered to a training-ish
+        subprocess must leave a finalized flight file with the last spans
+        and a final metric snapshot."""
+        path = str(tmp_path / "flight.jsonl")
+        script = textwrap.dedent("""
+            import sys, time
+            import paddle_tpu.observability as obs
+            obs.enable()
+            obs.start_flight_recorder(sys.argv[1], capacity=32,
+                                      flush_interval_s=0.1)
+            i = 0
+            while True:
+                with obs.span("train.step"):
+                    obs.counter("train.steps", 1)
+                    time.sleep(0.01)
+                i += 1
+                if i == 5:
+                    print("READY", flush=True)
+        """)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen([sys.executable, "-c", script, path],
+                                stdout=subprocess.PIPE, text=True,
+                                cwd=REPO, env=env)
+        try:
+            assert proc.stdout.readline().strip() == "READY"
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert rc != 0  # SIGTERM semantics preserved after finalize
+        flight = obs.read_flight(path)
+        assert flight["final"] is not None
+        assert flight["final"]["reason"] == "sigterm"
+        assert flight["final"]["snapshot"]["counters"]["train.steps"] >= 5
+        assert any(e["kind"] == "span" and "train.step" in e["name"]
+                   for e in flight["events"])
+
+    def test_zz_reader_tolerates_torn_tail(self, tmp_path):
+        path = str(tmp_path / "torn.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps({"kind": "header", "schema":
+                                "paddle_tpu.flight.v1"}) + "\n")
+            f.write(json.dumps({"kind": "span", "name": "x"}) + "\n")
+            f.write('{"kind": "final", "reason": "sigt')  # torn mid-write
+        flight = obs.read_flight(path)
+        assert flight["header"] is not None
+        assert len(flight["events"]) == 1
+        assert flight["final"] is None
+
+
+# ---------------- multi-host aggregation ----------------------------------
+def _write_host_dump(tmp_path, host, steps, step_seconds):
+    obs.get_registry().reset()
+    obs.counter("train.steps", steps)
+    obs.gauge("train.mfu", 0.4 + host / 100.0)
+    for s in step_seconds:
+        obs.histogram("train.step.seconds", s)
+    exp = obs.MetricsExporter(str(tmp_path), interval_s=3600, host=host)
+    exp.flush()
+    exp.flush()  # two flushes -> a 2-point series per host
+    return exp.path
+
+
+class TestAggregate:
+    def test_merges_two_hosts_with_straggler_deltas(self, telemetry,
+                                                    tmp_path):
+        p0 = _write_host_dump(tmp_path, 0, steps=10,
+                              step_seconds=[0.10, 0.10, 0.10])
+        p1 = _write_host_dump(tmp_path, 1, steps=10,
+                              step_seconds=[0.30, 0.30, 0.30])
+        rep = obs_aggregate.fleet_report([p0, p1])
+        assert rep["hosts"] == [0, 1]
+        # counters sum across hosts; last flush is the cumulative state
+        assert rep["counters"]["train.steps"]["total"] == 20
+        assert rep["counters"]["train.steps"]["per_host"] == {0: 10, 1: 10}
+        # gauges keep per-host values + fleet stats
+        g = rep["gauges"]["train.mfu"]
+        assert g["min"] == pytest.approx(0.40)
+        assert g["max"] == pytest.approx(0.41)
+        # histograms merge bucket-wise with fleet percentiles
+        h = rep["histograms"]["train.step.seconds"]
+        assert h["count"] == 6
+        assert h["min"] == pytest.approx(0.10)
+        assert h["max"] == pytest.approx(0.30)
+        assert h["p50"] <= h["p99"] <= h["max"]
+        # straggler view: host 1 is 3x slower -> ratio > 1 vs fleet median
+        strag = {s["host"]: s for s in rep["stragglers"]}
+        assert strag[1]["ratio"] > 1.0 > strag[0]["ratio"]
+        assert strag[1]["delta_s"] > 0 > strag[0]["delta_s"]
+        assert rep["stragglers"][0]["host"] == 1  # sorted slowest-first
+        # per-flush series survived for both hosts
+        assert len(rep["series"]["train.mfu"]) == 4
+
+    def test_accepts_bare_dump_jsonl_files(self, telemetry, tmp_path):
+        obs.counter("train.steps", 4)
+        path = str(tmp_path / "bare-host00007.jsonl")
+        obs.dump_jsonl(path)
+        rep = obs_aggregate.fleet_report([path])
+        assert rep["hosts"] == [7]  # host parsed from the filename
+        assert rep["counters"]["train.steps"]["total"] == 4
+
+    def test_render_report_mentions_stragglers(self, telemetry, tmp_path):
+        p0 = _write_host_dump(tmp_path, 0, 1, [0.1])
+        p1 = _write_host_dump(tmp_path, 1, 1, [0.2])
+        text = obs_aggregate.render_report(
+            obs_aggregate.fleet_report([p0, p1]))
+        assert "Straggler view" in text
+        assert "host 1" in text
+
+
+# ---------------- HBM / memory accounting ---------------------------------
+class TestMemoryAccounting:
+    def test_record_executable_gauges_memory_analysis(self, telemetry):
+        import jax
+        import jax.numpy as jnp
+
+        exe = jax.jit(lambda a: a @ a).lower(
+            jnp.ones((32, 32), jnp.float32)).compile()
+        assert obs.record_executable("unit", exe)
+        gauges = obs.snapshot()["gauges"]
+        for kind in ("argument", "output", "temp", "code", "peak"):
+            assert f"mem.exe.{kind}_bytes{{site=unit}}" in gauges
+        assert gauges["mem.exe.argument_bytes{site=unit}"] >= 32 * 32 * 4
+
+    def test_train_step_site_populates_hbm_gauges(self, telemetry):
+        from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+        from paddle_tpu.models import gpt_tiny
+
+        paddle.seed(0)
+        m = gpt_tiny(dropout=0.0, num_layers=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        st = make_sharded_train_step(m, opt)
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, 128, size=(2, 16))
+        y = np.roll(x, -1, axis=1)
+        st(x, y)
+        st(x, y)
+        snap = obs.snapshot()
+        gauges = snap["gauges"]
+        assert gauges["mem.exe.peak_bytes{site=sharded_train_step}"] > 0
+        assert gauges["mem.exe.argument_bytes{site=sharded_train_step}"] > 0
+        # AOT-on-first-dispatch keeps the one-compile guarantee
+        assert snap["counters"][
+            "jit.compile.cache_miss{site=sharded_train_step}"] == 1
+        # live-buffer accounting rode along on the first record
+        assert gauges["mem.live.bytes"] > 0
+        assert gauges["mem.live.count"] > 0
+
+    def test_serving_prefill_decode_sites_and_kv_gauge(self, telemetry):
+        from paddle_tpu.models.gpt import gpt_tiny
+        from paddle_tpu.serving import Engine, SamplingParams
+
+        m = gpt_tiny(dropout=0.0, num_layers=2)
+        m.eval()
+        eng = Engine(m, max_batch_size=2, max_seq_len=32)
+        eng.generate([[5, 17, 3]], SamplingParams(max_new_tokens=4))
+        gauges = obs.snapshot()["gauges"]
+        assert gauges["mem.exe.peak_bytes{site=serving.prefill}"] > 0
+        assert gauges["mem.exe.peak_bytes{site=serving.decode}"] > 0
+        assert gauges["mem.kv_cache.bytes"] == eng.cache.nbytes
+        assert gauges["serving.kv_cache.bytes"] == eng.cache.nbytes
+
+    def test_record_executable_survives_backends_without_stats(
+            self, telemetry):
+        class NoStats:
+            def memory_analysis(self):
+                raise NotImplementedError
+
+        assert not obs.record_executable("x", NoStats())
+        assert len(obs.get_registry()) == 0
+
+
+# ---------------- goodput / straggler monitor -----------------------------
+class TestGoodput:
+    def test_buckets_attribute_wall_time(self, telemetry):
+        gm = obs_goodput.GoodputMonitor()
+        obs.histogram("data.host_wait_seconds", 0.05)
+        obs.histogram("ckpt.save.blocking_seconds", 0.02)
+        obs.histogram("dist.collective.seconds", 0.01)
+        b = gm.observe_step(0.2)
+        assert b["data_wait"] == pytest.approx(0.05)
+        assert b["ckpt_block"] == pytest.approx(0.02)
+        assert b["comm"] == pytest.approx(0.01)
+        assert b["compute"] == pytest.approx(0.19)  # step minus comm share
+        snap = obs.snapshot()
+        cs = snap["counters"]
+        assert cs["train.goodput.seconds{bucket=compute}"] == (
+            pytest.approx(0.19))
+        assert cs["train.goodput.seconds{bucket=data_wait}"] == (
+            pytest.approx(0.05))
+        frac = snap["gauges"]["train.goodput.fraction"]
+        assert frac == pytest.approx(0.19 / 0.27)
+
+    def test_deltas_not_cumulative_across_steps(self, telemetry):
+        gm = obs_goodput.GoodputMonitor()
+        obs.histogram("data.host_wait_seconds", 0.05)
+        gm.observe_step(0.1)
+        b = gm.observe_step(0.1)  # no new waits since last step
+        assert b["data_wait"] == 0.0
+
+    def test_regression_detector_fires_on_sustained_slowdown(
+            self, telemetry):
+        gm = obs_goodput.GoodputMonitor(window=32, recent=4,
+                                        regression_factor=1.3)
+        for _ in range(24):
+            gm.observe_step(0.010)
+        assert "train.goodput.regression" not in (
+            obs.snapshot()["counters"])
+        for _ in range(8):
+            gm.observe_step(0.050)  # 5x slowdown, sustained
+        snap = obs.snapshot()
+        assert snap["counters"]["train.goodput.regression"] == 1  # one edge
+        assert snap["gauges"]["train.goodput.step_ratio"] > 1.3
+
+    def test_train_step_feeds_monitor(self, telemetry):
+        from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+        from paddle_tpu.models import gpt_tiny
+
+        paddle.seed(0)
+        m = gpt_tiny(dropout=0.0, num_layers=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        st = make_sharded_train_step(m, opt)
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, 128, size=(2, 16))
+        y = np.roll(x, -1, axis=1)
+        st(x, y)  # first dispatch = compile, excluded from goodput
+        st(x, y)
+        cs = obs.snapshot()["counters"]
+        assert cs.get("train.goodput.seconds{bucket=compute}", 0) > 0
+
+
+# ---------------- zero-overhead contract ----------------------------------
+def _site_exporter(tmp_path):
+    assert obs.start_exporter(str(tmp_path)) is None
+    assert obs.get_exporter() is None
+
+
+def _site_flight(tmp_path):
+    assert obs.start_flight_recorder(str(tmp_path / "f.jsonl")) is None
+    assert obs.get_flight_recorder() is None
+
+
+def _site_memory(tmp_path):
+    class Exe:
+        def memory_analysis(self):  # must never even be called
+            raise AssertionError("memory_analysis called with flag off")
+
+    assert not obs.record_executable("off", Exe())
+    obs.record_live_buffers()
+    obs.record_device_memory()
+    obs.record_kv_cache(123)
+
+
+def _site_goodput(tmp_path):
+    obs_goodput.observe_step(0.5)
+
+
+def _site_span_ring(tmp_path):
+    with obs.span("off.op"):
+        pass
+
+
+@pytest.mark.parametrize("site", [_site_exporter, _site_flight,
+                                  _site_memory, _site_goodput,
+                                  _site_span_ring],
+                         ids=["exporter", "flight_recorder", "memory",
+                              "goodput", "span"])
+def test_flag_off_leaves_registry_empty(site, tmp_path):
+    """The zero-overhead contract: with FLAGS_observability off, every new
+    subsystem reduces to one flag check — nothing starts, nothing records,
+    the registry stays empty."""
+    obs.disable()
+    obs.reset()
+    obs.clear_spans()
+    obs_goodput.reset_monitor()
+    site(tmp_path)
+    assert len(obs.get_registry()) == 0
+    assert obs.spans() == []
+
+
+# ---------------- no-jax CLI surfaces -------------------------------------
+def _poisoned_env():
+    d = tempfile.mkdtemp()
+    with open(os.path.join(d, "jax.py"), "w") as f:
+        f.write("raise ImportError('telemetry CLIs must not import jax')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = d
+    return env
+
+
+class TestCLIs:
+    @pytest.fixture(scope="class")
+    def dumps(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("dumps")
+        obs.enable()
+        obs.reset()
+        try:
+            p0 = _write_host_dump(tmp, 0, steps=8, step_seconds=[0.1, 0.1])
+            p1 = _write_host_dump(tmp, 1, steps=8, step_seconds=[0.4, 0.4])
+            flat = str(tmp / "flat.jsonl")
+            obs.dump_jsonl(flat)
+        finally:
+            obs.disable()
+            obs.reset()
+        return p0, p1, flat
+
+    def test_telemetry_report_merges_without_jax(self, dumps):
+        p0, p1, _ = dumps
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "telemetry_report.py"), p0, p1],
+            capture_output=True, text=True, env=_poisoned_env(), cwd=REPO,
+            timeout=60)
+        assert r.returncode == 0, r.stderr
+        assert "hosts: 0, 1" in r.stdout
+        assert "Straggler view" in r.stdout
+
+    def test_telemetry_report_json_matches_library(self, dumps):
+        p0, p1, _ = dumps
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "telemetry_report.py"),
+             p0, p1, "--json"],
+            capture_output=True, text=True, env=_poisoned_env(), cwd=REPO,
+            timeout=60)
+        assert r.returncode == 0, r.stderr
+        out = json.loads(r.stdout)
+        ref = obs_aggregate.fleet_report([p0, p1])
+        assert out["counters"] == json.loads(
+            json.dumps(ref["counters"]))  # int keys -> str, like the CLI
+        assert out["hosts"] == ref["hosts"]
+
+    def test_metrics_dump_prom_format_without_jax(self, dumps):
+        _, _, flat = dumps
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "metrics_dump.py"),
+             flat, "--format", "prom"],
+            capture_output=True, text=True, env=_poisoned_env(), cwd=REPO,
+            timeout=60)
+        assert r.returncode == 0, r.stderr
+        assert "# TYPE train_steps counter" in r.stdout
+        assert "# TYPE train_step_seconds histogram" in r.stdout
+        assert 'train_step_seconds_bucket{le="+Inf"}' in r.stdout
+        assert "train_step_seconds_count 2" in r.stdout
+
+    def test_metrics_dump_jsonl_format_roundtrips(self, dumps):
+        _, _, flat = dumps
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "metrics_dump.py"),
+             flat, "--format", "jsonl", "--grep", "train.steps"],
+            capture_output=True, text=True, env=_poisoned_env(), cwd=REPO,
+            timeout=60)
+        assert r.returncode == 0, r.stderr
+        recs = [json.loads(l) for l in r.stdout.splitlines()]
+        assert len(recs) == 1
+        assert recs[0]["name"] == "train.steps"
+        assert recs[0]["value"] == 8

@@ -6,6 +6,8 @@ Usage:
     python tools/metrics_dump.py metrics.jsonl            # full table
     python tools/metrics_dump.py metrics.jsonl --grep ir. # filter by name
     python tools/metrics_dump.py metrics.jsonl --json     # re-emit merged JSON
+    python tools/metrics_dump.py metrics.jsonl --format prom   # Prometheus
+    python tools/metrics_dump.py metrics.jsonl --format jsonl  # re-emit lines
 
 Each input line is one metric record: {"type", "name", "labels", ...} with
 "value" for counters/gauges and count/sum/avg/min/max for histograms (see
@@ -17,7 +19,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
+
+# mirrors paddle_tpu.observability.metrics._BUCKET_BOUNDS (decade bounds,
+# seconds) for rendering histogram "buckets" arrays as le= series
+_BUCKET_BOUNDS = tuple(10.0 ** e for e in range(-7, 4))
 
 
 def _render_key(name: str, labels: dict) -> str:
@@ -84,6 +91,54 @@ def render(recs, grep: str = "") -> str:
     return "\n".join(lines) if lines else "(no metrics matched)"
 
 
+def _prom_name(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def _prom_labels(labels: dict, extra: str = "") -> str:
+    parts = [f'{_prom_name(k)}="{labels[k]}"' for k in sorted(labels)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_prom(recs, grep: str = "") -> str:
+    """Prometheus text exposition (histograms as cumulative _bucket/_sum/
+    _count series using the decade le bounds)."""
+    lines = []
+    typed = set()
+    for r in sorted(recs, key=lambda r: (r.get("name", "?"),
+                                         sorted(r.get("labels", {}).items()))):
+        name, labels = r.get("name", "?"), r.get("labels", {})
+        if grep and grep not in _render_key(name, labels):
+            continue
+        typ = r.get("type", "?")
+        pn = _prom_name(name)
+        if typ in ("counter", "gauge"):
+            if pn not in typed:
+                typed.add(pn)
+                lines.append(f"# TYPE {pn} {typ}")
+            lines.append(f"{pn}{_prom_labels(labels)} {_fmt(r.get('value'))}")
+        elif typ == "histogram":
+            if pn not in typed:
+                typed.add(pn)
+                lines.append(f"# TYPE {pn} histogram")
+            buckets = r.get("buckets")
+            if buckets:
+                cum = 0
+                for i, n in enumerate(buckets):
+                    cum += n
+                    le = (f"{_BUCKET_BOUNDS[i]:g}"
+                          if i < len(_BUCKET_BOUNDS) else "+Inf")
+                    lab = _prom_labels(labels, 'le="%s"' % le)
+                    lines.append(f"{pn}_bucket{lab} {cum}")
+            lines.append(f"{pn}_sum{_prom_labels(labels)} "
+                         f"{_fmt(r.get('sum'))}")
+            lines.append(f"{pn}_count{_prom_labels(labels)} "
+                         f"{_fmt(r.get('count'))}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="JSON-lines dump, or - for stdin")
@@ -91,8 +146,22 @@ def main(argv=None) -> int:
                     help="only show metrics whose rendered key contains this")
     ap.add_argument("--json", action="store_true",
                     help="emit one merged JSON object instead of the table")
+    ap.add_argument("--format", choices=("table", "prom", "jsonl"),
+                    default="table",
+                    help="output format: human table (default), Prometheus "
+                         "text exposition, or filtered JSON-lines re-emit")
     args = ap.parse_args(argv)
     recs = load(args.path)
+    if args.format == "prom":
+        print(render_prom(recs, args.grep))
+        return 0
+    if args.format == "jsonl":
+        for r in recs:
+            key = _render_key(r.get("name", "?"), r.get("labels", {}))
+            if args.grep and args.grep not in key:
+                continue
+            print(json.dumps(r, sort_keys=True))
+        return 0
     if args.json:
         merged = {}
         for r in recs:

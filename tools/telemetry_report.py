@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Merge per-host telemetry dumps into one fleet-wide report.
+
+Usage:
+    python tools/telemetry_report.py run/metrics-host*.jsonl        # table
+    python tools/telemetry_report.py a.jsonl b.jsonl --json         # report
+    python tools/telemetry_report.py a.jsonl --grep train.          # filter
+
+Inputs are the per-host JSONL files written by
+``paddle_tpu.observability.export.MetricsExporter`` (one cumulative flush
+per line) — or plain ``dump_jsonl`` files. Counters sum across hosts,
+gauges report fleet mean/min/max, histograms merge bucket-wise with fleet
+p50/p95/p99, and the straggler section compares each host's
+``train.step.seconds`` mean against the fleet median (delta + ratio).
+
+Runs standalone — no paddle_tpu (or jax) import — so dumps copied off a
+TPU fleet merge anywhere (same synthetic-package trick as comm_plan.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+import types
+
+# Load observability/aggregate.py as a synthetic package: executing
+# paddle_tpu/__init__.py would initialize jax, which this tool must not
+# require (and aggregate.py is stdlib-only by contract).
+_OBS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "paddle_tpu", "observability")
+_pkg = types.ModuleType("_ptobs")
+_pkg.__path__ = [_OBS_DIR]
+sys.modules.setdefault("_ptobs", _pkg)
+aggregate = importlib.import_module("_ptobs.aggregate")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="per-host metrics-host*.jsonl dump files")
+    ap.add_argument("--grep", default="",
+                    help="only show metrics whose rendered key contains this")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full merged report as JSON")
+    args = ap.parse_args(argv)
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"telemetry_report: {p}: no such file", file=sys.stderr)
+            return 2
+    report = aggregate.fleet_report(args.paths)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(aggregate.render_report(report, grep=args.grep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
