@@ -5,7 +5,7 @@ Default invocation (the driver contract) prints ONE JSON line:
 in-repo numbers (SURVEY §6); the driver-set north star is GPT pretrain
 MFU >= 0.40, so vs_baseline = model_flops_utilization / 0.40.
 
-`--config {bert_sst2,gpt_dp,ernie_mp4,resnet50,gpt_moe,serving,all}` runs the
+`--config {bert_sst2,gpt_dp,ernie_mp4,resnet50,gpt_moe,serving,...,all}` runs the
 BASELINE.json config rows instead (tools/ci_model_benchmark.sh role): each
 prints one JSON line with throughput + a measured step-time breakdown —
 compute fraction (model FLOPs / chip peak over the device-resident step),
@@ -1176,6 +1176,38 @@ def bench_obs():
     return out
 
 
+def bench_analysis():
+    """Static analyzer config: corpus size, rules run, analyze wall time.
+    The row's contract is the CI-gate budget — the whole program corpus
+    (train step, serving prefill/decode, grad-reduce schedule, reshard
+    executor, ir-optimized) must trace AND lint on CPU well inside the 60s
+    acceptance bound of tools/lint_programs.py."""
+    from paddle_tpu import analysis
+
+    t0 = time.perf_counter()
+    specs, skips = analysis.build_corpus()
+    build_ms = (time.perf_counter() - t0) * 1e3
+    t0 = time.perf_counter()
+    report, errors = analysis.analyze_corpus(specs)
+    analyze_ms = (time.perf_counter() - t0) * 1e3
+    out = {
+        "config": "analysis",
+        "metric": "analyze_ms",
+        "value": round(analyze_ms, 3),
+        "unit": "ms (jaxpr-trace + lint the full corpus, CPU-only)",
+        "corpus_programs": len(specs),
+        "skipped": [n for n, _ in skips],
+        "trace_errors": len(errors),
+        "rules_run": len(analysis.RULE_CATALOG),
+        "findings": report.counts(),
+        "build_ms": round(build_ms, 3),
+        "note": f"{len(specs)} programs x {len(analysis.RULE_CATALOG)} "
+                "rules; lint gate budget is 60s end-to-end",
+    }
+    print(json.dumps(out))
+    return out
+
+
 CONFIGS = {
     "bert_sst2": bench_bert_sst2,
     "gpt_dp": bench_gpt_dp,
@@ -1188,6 +1220,7 @@ CONFIGS = {
     "comm": bench_comm,
     "reshard": bench_reshard,
     "obs": bench_obs,
+    "analysis": bench_analysis,
 }
 
 

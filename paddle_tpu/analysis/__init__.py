@@ -1,0 +1,45 @@
+"""paddle_tpu.analysis — jaxpr-level static program analyzer.
+
+The lint tier for the invariants the rest of the stack only enforces
+dynamically: recompile hazards at one-compile sites, donation/HBM hygiene,
+collective well-formedness inside shard_map regions (axis existence,
+ppermute permutation validity, branch-uniform collective sequences,
+wire-byte reconciliation against comm_opt/resharding plan accounting), and
+dtype leaks (f64, f32-on-wire). Everything traces abstractly via
+``jax.make_jaxpr`` — no TPU, no execution — so the whole corpus lints on a
+CPU-only CI host (``tools/lint_programs.py``). See analysis/README.md for
+the rule catalog and the suppression/baseline workflow.
+"""
+
+from .analyzer import (  # noqa: F401
+    Context,
+    ProgramSpec,
+    Region,
+    SiteContract,
+    analyze_closed,
+    analyze_corpus,
+    analyze_fn,
+    analyze_spec,
+)
+from .baseline import (  # noqa: F401
+    add_suppressions,
+    baseline_fingerprints,
+    default_baseline_path,
+    load_baseline,
+    prune_stale,
+    save_baseline,
+)
+from .corpus import build_corpus  # noqa: F401
+from .findings import GATE_SEVERITY, SEVERITIES, Finding, Report  # noqa: F401
+from .fixtures import REQUIRED_FIXTURE_RULES, fixture_specs  # noqa: F401
+from .rules import RULE_CATALOG, Rule, default_rules  # noqa: F401
+
+__all__ = [
+    "Finding", "Report", "SEVERITIES", "GATE_SEVERITY",
+    "Rule", "default_rules", "RULE_CATALOG",
+    "SiteContract", "ProgramSpec", "Region", "Context",
+    "analyze_fn", "analyze_closed", "analyze_spec", "analyze_corpus",
+    "build_corpus", "fixture_specs", "REQUIRED_FIXTURE_RULES",
+    "default_baseline_path", "load_baseline", "save_baseline",
+    "baseline_fingerprints", "add_suppressions", "prune_stale",
+]

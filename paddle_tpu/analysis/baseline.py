@@ -1,0 +1,101 @@
+"""Committed-baseline handling for the lint gate.
+
+The baseline (``tools/analysis_baseline.json``) is the reviewable ledger of
+accepted findings: each suppression carries the finding's stable fingerprint
+plus a human rationale, and a ``history`` list records fixes/decisions so
+the next reader knows WHY the tree lints clean. The gate fails on any
+gating finding whose fingerprint is not suppressed — so a new hazard fails
+CI, while refactors that merely move code (fingerprints exclude jaxpr
+paths) do not churn the file.
+
+Workflow:
+- new legitimate finding you cannot fix now:
+  ``python tools/lint_programs.py --update-baseline --reason "..."``
+  (appends suppressions for every currently-new finding + a history entry)
+- fixed a previously-suppressed finding: delete its suppression, add a
+  history entry (``--update-baseline`` also prunes suppressions that no
+  longer match any finding).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Sequence
+
+from .findings import Finding
+
+__all__ = ["default_baseline_path", "load_baseline", "save_baseline",
+           "baseline_fingerprints", "add_suppressions", "prune_stale"]
+
+BASELINE_VERSION = 1
+
+
+def default_baseline_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "tools", "analysis_baseline.json")
+
+
+def _empty() -> Dict:
+    return {"version": BASELINE_VERSION, "suppressions": [], "history": []}
+
+
+def load_baseline(path: str) -> Dict:
+    if not os.path.exists(path):
+        return _empty()
+    with open(path) as f:
+        data = json.load(f)
+    data.setdefault("version", BASELINE_VERSION)
+    data.setdefault("suppressions", [])
+    data.setdefault("history", [])
+    return data
+
+
+def save_baseline(baseline: Dict, path: str):
+    with open(path, "w") as f:
+        json.dump(baseline, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def baseline_fingerprints(baseline: Dict) -> List[str]:
+    return [s["fingerprint"] for s in baseline.get("suppressions", [])]
+
+
+def add_suppressions(baseline: Dict, findings: Sequence[Finding],
+                     reason: str, date: str = "") -> int:
+    """Append one suppression per finding (skipping fingerprints already
+    present); returns how many were added."""
+    known = set(baseline_fingerprints(baseline))
+    added = 0
+    for f in findings:
+        if f.fingerprint in known:
+            continue
+        baseline["suppressions"].append({
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "site": f.site,
+            "reason": reason,
+        })
+        known.add(f.fingerprint)
+        added += 1
+    if added:
+        entry = {"action": "suppress", "count": added, "reason": reason}
+        if date:
+            entry["date"] = date
+        baseline["history"].append(entry)
+    return added
+
+
+def prune_stale(baseline: Dict, live_fingerprints: Sequence[str]) -> int:
+    """Drop suppressions whose fingerprint no longer matches any current
+    finding (the hazard was fixed); returns how many were pruned."""
+    live = set(live_fingerprints)
+    before = baseline.get("suppressions", [])
+    kept = [s for s in before if s["fingerprint"] in live]
+    pruned = len(before) - len(kept)
+    baseline["suppressions"] = kept
+    if pruned:
+        baseline["history"].append({"action": "prune", "count": pruned,
+                                    "reason": "finding no longer present"})
+    return pruned

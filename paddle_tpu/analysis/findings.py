@@ -1,0 +1,139 @@
+"""Typed findings: what the static analyzer emits.
+
+A Finding is one detected hazard: a rule id, the program (site) it was found
+in, a path locating the offending equation inside that program's jaxpr, a
+severity, and a stable fingerprint derived from the rule + site + the
+rule-chosen detail tuple (NOT the path: equation indices churn when unrelated
+code moves, fingerprints must survive that so baselines stay meaningful).
+
+A Report is the ordered collection for one analysis run, with the baseline
+diff (`new_against`) the CI gate keys on.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+SEVERITIES = ("info", "warning", "error")
+
+#: findings at or above this severity fail the lint gate (info findings are
+#: advisory: reported, never gating)
+GATE_SEVERITY = "warning"
+
+
+def _sev_rank(severity: str) -> int:
+    return SEVERITIES.index(severity)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One detected hazard."""
+
+    rule: str                       # e.g. "collective-ppermute-perm"
+    site: str                       # corpus program name, e.g. "train_step"
+    severity: str                   # info | warning | error
+    message: str                    # human-readable, with concrete values
+    path: str = ""                  # location inside the program's jaxpr
+    data: Tuple[str, ...] = ()      # stable detail tuple (fingerprint input)
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"severity {self.severity!r} not in {SEVERITIES}")
+
+    @property
+    def fingerprint(self) -> str:
+        text = "|".join((self.rule, self.site) + tuple(self.data))
+        return hashlib.sha256(text.encode()).hexdigest()[:12]
+
+    @property
+    def gating(self) -> bool:
+        return _sev_rank(self.severity) >= _sev_rank(GATE_SEVERITY)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule,
+            "site": self.site,
+            "severity": self.severity,
+            "message": self.message,
+            "path": self.path,
+            "fingerprint": self.fingerprint,
+        }
+
+    def render(self) -> str:
+        loc = f"{self.site}" + (f" @ {self.path}" if self.path else "")
+        return (f"[{self.severity:>7}] {self.rule:<28} {loc}\n"
+                f"          {self.message}  (fp {self.fingerprint})")
+
+
+@dataclass
+class Report:
+    """Findings from one analysis run (one program or a whole corpus)."""
+
+    findings: List[Finding] = field(default_factory=list)
+    programs: List[str] = field(default_factory=list)
+
+    def add(self, finding: Finding):
+        self.findings.append(finding)
+
+    def extend(self, findings: Iterable[Finding]):
+        for f in findings:
+            self.add(f)
+
+    def merge(self, other: "Report"):
+        self.findings.extend(other.findings)
+        self.programs.extend(p for p in other.programs
+                             if p not in self.programs)
+
+    def dedup(self) -> "Report":
+        """Collapse identical fingerprints (e.g. the same f64 constant used
+        by many equations) keeping first occurrence order."""
+        seen, out = set(), []
+        for f in self.findings:
+            if f.fingerprint in seen:
+                continue
+            seen.add(f.fingerprint)
+            out.append(f)
+        return Report(findings=out, programs=list(self.programs))
+
+    def by_rule(self, rule: str) -> List[Finding]:
+        return [f for f in self.findings if f.rule == rule]
+
+    def rules_hit(self) -> List[str]:
+        return sorted({f.rule for f in self.findings})
+
+    @property
+    def gating_findings(self) -> List[Finding]:
+        return [f for f in self.findings if f.gating]
+
+    def new_against(self, baseline_fingerprints: Sequence[str]
+                    ) -> List[Finding]:
+        """Gating findings whose fingerprint the committed baseline does not
+        suppress — the set that fails CI."""
+        known = set(baseline_fingerprints)
+        return [f for f in self.gating_findings
+                if f.fingerprint not in known]
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            out[f.severity] += 1
+        return out
+
+    def render(self, header: Optional[str] = None) -> str:
+        lines = []
+        if header:
+            lines.append(header)
+        if not self.findings:
+            lines.append("(no findings)")
+        for f in sorted(self.findings,
+                        key=lambda f: (-_sev_rank(f.severity), f.site,
+                                       f.rule)):
+            lines.append(f.render())
+        c = self.counts()
+        lines.append(f"-- {len(self.programs)} program(s), "
+                     f"{len(self.findings)} finding(s): "
+                     f"{c['error']} error / {c['warning']} warning / "
+                     f"{c['info']} info")
+        return "\n".join(lines)

@@ -1,0 +1,148 @@
+"""Seeded-violation fixture programs — one per rule class.
+
+Each fixture is a tiny self-contained ProgramSpec engineered to trip exactly
+one analyzer rule; the gate tool and tests assert the exact rule id fires
+(``tools/lint_programs.py --selftest``, tests/test_analysis.py). Every mesh
+fixture runs on a SINGLE device so the set traces identically on any host.
+
+Notes on environment sensitivity:
+- ``fixture_f64_leak`` only fires with ``jax_enable_x64`` on (the repo's
+  pytest conftest and the lint tool both enable it); without x64 the f64
+  input silently downcasts and there is nothing to find.
+- the weak-type fixture's python-scalar arg traces as a WEAK f64 under x64,
+  which is exactly the hazard class the rule exists for (each distinct
+  python scalar value re-specializes a one-compile jit signature).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from .analyzer import ProgramSpec, SiteContract
+
+__all__ = ["fixture_specs", "REQUIRED_FIXTURE_RULES"]
+
+#: the five seeded violations the acceptance criteria name
+REQUIRED_FIXTURE_RULES = (
+    "recompile-weak-type",
+    "donation-missing",
+    "collective-ppermute-perm",
+    "collective-branch-mismatch",
+    "dtype-f64",
+)
+
+
+def _one_device_mesh() -> Mesh:
+    return Mesh(np.array(jax.devices()[:1]), ("dp",))
+
+
+def _weak_type() -> Tuple[ProgramSpec, str]:
+    """A python-scalar leaf in a one-compile signature: every distinct value
+    of ``scale`` would compile a fresh executable."""
+
+    def fn(x, scale):
+        return x * scale
+
+    spec = ProgramSpec(
+        "fixture_weak_type", fn,
+        (jnp.ones((4, 4), jnp.float32), 0.5),
+        SiteContract(one_compile=True),
+        argnames=("x", "scale"))
+    return spec, "recompile-weak-type"
+
+
+def _dropped_donation() -> Tuple[ProgramSpec, str]:
+    """A large accumulator updated in place semantically but never donated:
+    the classic doubled-HBM hot-loop buffer."""
+
+    def fn(acc, upd):
+        return acc + upd, jnp.sum(upd)
+
+    big = jnp.zeros((128, 128), jnp.float32)  # 64 KiB, over the threshold
+    spec = ProgramSpec(
+        "fixture_dropped_donation", fn, (big, big),
+        SiteContract(donate_argnums=(), donation_threshold=1024),
+        argnames=("acc", "upd"))
+    return spec, "donation-missing"
+
+
+def _unaliased_donation() -> Tuple[ProgramSpec, str]:
+    """A donated arg no output can alias: the donation silently buys
+    nothing (XLA warns at compile time; this catches it statically)."""
+
+    def fn(dead, x):
+        return (x * jnp.float32(2.0),)
+
+    spec = ProgramSpec(
+        "fixture_unaliased_donation", fn,
+        (jnp.zeros((64, 64), jnp.float32), jnp.zeros((32,), jnp.float32)),
+        SiteContract(donate_argnums=(0,), donation_threshold=1024),
+        argnames=("dead", "x"))
+    return spec, "donation-unaliased"
+
+
+def _bad_ppermute() -> Tuple[ProgramSpec, str]:
+    """A ppermute whose perm names device 0 as source twice — XLA's
+    CollectivePermute would reject or misroute this at run time."""
+    mesh = _one_device_mesh()
+
+    def body(x):
+        return lax.ppermute(x, "dp", perm=[(0, 0), (0, 0)])
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                       axis_names={"dp"}, check_vma=False)
+    spec = ProgramSpec("fixture_bad_ppermute", fn,
+                       (jnp.zeros((8,), jnp.float32),), argnames=("x",))
+    return spec, "collective-ppermute-perm"
+
+
+def _branch_mismatch() -> Tuple[ProgramSpec, str]:
+    """cond branches with different collective sequences inside a manual
+    region: on real hardware, devices disagreeing on the predicate would
+    deadlock in the psum."""
+    mesh = _one_device_mesh()
+
+    def body(x):
+        return lax.cond(jnp.sum(x) > 0,
+                        lambda v: lax.psum(v, "dp"),
+                        lambda v: v, x)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                       axis_names={"dp"}, check_vma=False)
+    spec = ProgramSpec("fixture_branch_mismatch", fn,
+                       (jnp.ones((8,), jnp.float32),), argnames=("x",))
+    return spec, "collective-branch-mismatch"
+
+
+def _f64_leak() -> Tuple[ProgramSpec, str]:
+    """A strong float64 input flowing through compute — on TPU this silently
+    demotes (or doubles memory traffic on backends that honor it)."""
+
+    def fn(x):
+        return jnp.tanh(x) * x
+
+    spec = ProgramSpec(
+        "fixture_f64_leak", fn,
+        (jnp.asarray(np.linspace(0.0, 1.0, 16, dtype=np.float64)),),
+        argnames=("x",))
+    return spec, "dtype-f64"
+
+
+def fixture_specs() -> List[Tuple[ProgramSpec, str]]:
+    """[(spec, expected_rule_id)] — every seeded violation, deterministic
+    order."""
+    return [
+        _weak_type(),
+        _dropped_donation(),
+        _unaliased_donation(),
+        _bad_ppermute(),
+        _branch_mismatch(),
+        _f64_leak(),
+    ]

@@ -1,0 +1,479 @@
+"""Lint rules over closed jaxprs.
+
+Each rule is a small class with three hooks the analyzer drives while it
+walks a program:
+
+- ``check_program(closed, ctx)``     once, on the top-level jaxpr (signature
+  rules: weak types, donation);
+- ``check_eqn(eqn, ctx)``            per equation, with the enclosing
+  shard_map region (if any) on the context;
+- ``check_summary(ctx)``             once, after the walk (whole-program
+  reconciliations, e.g. wire bytes vs. the comm_opt plan).
+
+Rules never mutate; they yield Finding objects. The rule ids below are the
+public contract (tests assert them, baselines fingerprint them, the README
+catalogs them) — rename with care.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from .findings import Finding
+
+#: primitives that put bytes on the interconnect (axis-name collectives)
+COLLECTIVES = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pbroadcast",
+    "all_gather", "all_to_all", "reduce_scatter",
+})
+#: primitives that merely *reference* an axis (no wire traffic) but still
+#: need the axis to exist and be manual
+AXIS_REFS = COLLECTIVES | frozenset({"axis_index"})
+
+
+def collective_axes(eqn) -> Tuple[str, ...]:
+    """Named mesh axes an equation operates over (positional ints from
+    vmap-style psum are ignored — they are not mesh axes)."""
+    ax = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if isinstance(ax, (str,)):
+        ax = (ax,)
+    return tuple(a for a in ax if isinstance(a, str))
+
+
+def _np_dtype(dtype):
+    """np.dtype or None for jax extended dtypes (key<fry>, float8 wrappers)
+    numpy cannot interpret."""
+    try:
+        return np.dtype(dtype)
+    except TypeError:
+        return None
+
+
+def aval_nbytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = _np_dtype(getattr(aval, "dtype", None))
+    if shape is None or dtype is None:
+        return 0
+    return int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+
+
+def _aval_str(aval) -> str:
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return str(aval)
+    dtype = _np_dtype(getattr(aval, "dtype", None))
+    name = dtype.name if dtype is not None else str(
+        getattr(aval, "dtype", "?"))
+    return f"{name}[{','.join(map(str, shape))}]"
+
+
+def wire_bytes(eqn, axis_size: int) -> int:
+    """Per-device receive-side byte estimate for one collective — the same
+    convention comm_opt.plan uses (what lands on each chip's links), so the
+    two accountings reconcile directly."""
+    n = max(int(axis_size), 1)
+    prim = eqn.primitive.name
+    local = sum(aval_nbytes(v.aval) for v in eqn.invars
+                if hasattr(v, "aval"))
+    if n <= 1 or local == 0:
+        return 0
+    if prim in ("psum", "pmax", "pmin"):
+        # ring all-reduce: reduce-scatter + all-gather
+        return (2 * (n - 1) * local) // n
+    if prim == "reduce_scatter":
+        return ((n - 1) * local) // n
+    if prim == "all_gather":
+        return (n - 1) * local
+    if prim == "all_to_all":
+        return ((n - 1) * local) // n
+    if prim in ("ppermute", "pbroadcast"):
+        return local
+    return 0
+
+
+class Rule:
+    """Base lint rule; subclass and override the relevant hooks."""
+
+    rule_id = ""
+    severity = "warning"
+    description = ""
+
+    def check_program(self, closed, ctx) -> Iterable[Finding]:
+        return ()
+
+    def check_eqn(self, eqn, ctx) -> Iterable[Finding]:
+        return ()
+
+    def check_summary(self, ctx) -> Iterable[Finding]:
+        return ()
+
+    def _finding(self, ctx, message: str, data: Tuple[str, ...],
+                 path: str = "") -> Finding:
+        return Finding(rule=self.rule_id, site=ctx.site,
+                       severity=self.severity, message=message,
+                       path=path or ctx.path, data=data)
+
+
+# ---------------------------------------------------------------------------
+# (a) recompile hazards
+# ---------------------------------------------------------------------------
+
+class RecompileWeakTypeRule(Rule):
+    """Weak-typed leaves in a one-compile jit signature.
+
+    A python scalar (or any weak-typed array) traced into a jit argument
+    gives the executable a weak-typed signature; the same call site later
+    passing a strongly-typed array of the identical dtype/shape MISSES the
+    jit cache and recompiles. Sites that declare a one-compile contract
+    (serving decode, the train step) must take strongly-typed leaves
+    (``jnp.float32(lr)``, not ``lr``).
+    """
+
+    rule_id = "recompile-weak-type"
+    severity = "warning"
+    description = ("weak-typed leaf in a one-compile jit signature "
+                   "(recompile hazard)")
+
+    def check_program(self, closed, ctx):
+        if not ctx.contract.one_compile:
+            return
+        for i, var in enumerate(closed.jaxpr.invars):
+            aval = getattr(var, "aval", None)
+            if aval is None or not getattr(aval, "weak_type", False):
+                continue
+            name = ctx.arg_name(i)
+            yield self._finding(
+                ctx,
+                f"argument {name} is weak-typed {_aval_str(aval)}: a "
+                "strongly-typed caller later hits a different jit cache "
+                "key and recompiles; pass an explicit jnp dtype",
+                data=(name, _aval_str(aval)), path=f"invars[{i}]")
+
+
+# ---------------------------------------------------------------------------
+# (b) donation / HBM lint
+# ---------------------------------------------------------------------------
+
+class DonationRule(Rule):
+    """Donation lint for sites that declare a donation contract.
+
+    - ``donation-missing`` (warning): a large non-donated argument whose
+      aval exactly matches an output that no donated input already covers —
+      the executable allocates a second buffer for bytes the caller was
+      going to rebind anyway (2x transient HBM, the cost
+      observability/memory.py's ``mem.exe.*{site=}`` gauges surface).
+    - ``donation-unaliased`` (error): a donated argument matching NO output
+      aval — XLA silently ignores the donation, so the caller's arrays are
+      invalidated for nothing.
+    """
+
+    rule_id = "donation-missing"   # split per-finding below
+    severity = "warning"
+    description = "large rebound buffer not donated / donation not aliased"
+
+    def check_program(self, closed, ctx):
+        if ctx.donated is None:
+            return
+        jaxpr = closed.jaxpr
+        out_avals = [getattr(v, "aval", None) for v in jaxpr.outvars]
+        remaining: List = [a for a in out_avals if a is not None]
+
+        def _take(aval) -> bool:
+            for j, o in enumerate(remaining):
+                if (getattr(o, "shape", None) == aval.shape
+                        and getattr(o, "dtype", None) == aval.dtype):
+                    remaining.pop(j)
+                    return True
+            return False
+
+        # pass 1: donated args consume matching outputs; leftovers are
+        # unaliased donations (errors)
+        missing_candidates = []
+        for i, var in enumerate(jaxpr.invars):
+            aval = getattr(var, "aval", None)
+            if aval is None or getattr(aval, "shape", None) is None:
+                continue
+            if ctx.donated[i]:
+                if not _take(aval):
+                    name = ctx.arg_name(i)
+                    yield Finding(
+                        rule="donation-unaliased", site=ctx.site,
+                        severity="error", path=f"invars[{i}]",
+                        message=(f"donated argument {name} "
+                                 f"({_aval_str(aval)}) matches no output: "
+                                 "XLA drops the donation and the caller's "
+                                 "buffer is invalidated for nothing"),
+                        data=(name, _aval_str(aval)))
+            else:
+                missing_candidates.append((i, var, aval))
+        # pass 2: large non-donated args that still match a leftover output
+        for i, var, aval in missing_candidates:
+            if aval_nbytes(aval) < ctx.contract.donation_threshold:
+                continue
+            if not _take(aval):
+                continue
+            name = ctx.arg_name(i)
+            yield Finding(
+                rule="donation-missing", site=ctx.site,
+                severity="warning", path=f"invars[{i}]",
+                message=(f"argument {name} ({_aval_str(aval)}, "
+                         f"{aval_nbytes(aval)} B) is rebound as an output "
+                         "but not donated: the executable holds two copies "
+                         "(see mem.exe.* accounting); add it to "
+                         "donate_argnums"),
+                data=(name, _aval_str(aval)))
+
+
+# ---------------------------------------------------------------------------
+# (c) collective checker (shard_map regions)
+# ---------------------------------------------------------------------------
+
+class CollectiveAxisRule(Rule):
+    """Collective axis names must exist in the region's mesh and be manual
+    (an auto axis reference compiles into GSPMD-partitioned code where the
+    collective means something else entirely — or aborts)."""
+
+    rule_id = "collective-axis"
+    severity = "error"
+    description = "collective over an axis that is absent or not manual"
+
+    def check_eqn(self, eqn, ctx):
+        if eqn.primitive.name not in AXIS_REFS or ctx.region is None:
+            return
+        region = ctx.region
+        for a in collective_axes(eqn):
+            if a not in region.mesh_axes:
+                yield self._finding(
+                    ctx,
+                    f"{eqn.primitive.name} references axis {a!r} which is "
+                    f"not in the region's mesh {sorted(region.mesh_axes)}",
+                    data=(eqn.primitive.name, a, "absent"))
+            elif a not in region.manual:
+                yield self._finding(
+                    ctx,
+                    f"{eqn.primitive.name} references axis {a!r} which is "
+                    "auto (GSPMD) in this region, not manual — the "
+                    "collective does not mean what it says here",
+                    data=(eqn.primitive.name, a, "auto"))
+
+
+class PpermutePermRule(Rule):
+    """ppermute perms must be valid partial permutations: every src/dst in
+    range, no duplicated src (a device cannot send twice on one link pair)
+    and no duplicated dst (two sends into one receive race)."""
+
+    rule_id = "collective-ppermute-perm"
+    severity = "error"
+    description = "malformed ppermute permutation"
+
+    def check_eqn(self, eqn, ctx):
+        if eqn.primitive.name != "ppermute" or ctx.region is None:
+            return
+        axes = collective_axes(eqn)
+        size = 1
+        for a in axes:
+            size *= ctx.region.mesh_axes.get(a, 1)
+        perm = [(int(s), int(d)) for s, d in eqn.params.get("perm", ())]
+        problems = []
+        srcs = [s for s, _ in perm]
+        dsts = [d for _, d in perm]
+        oob = [(s, d) for s, d in perm
+               if not (0 <= s < size and 0 <= d < size)]
+        if oob:
+            problems.append(f"pairs {oob} out of range for axis size {size}")
+        if len(set(srcs)) != len(srcs):
+            dup = sorted({s for s in srcs if srcs.count(s) > 1})
+            problems.append(f"duplicate sources {dup}")
+        if len(set(dsts)) != len(dsts):
+            dup = sorted({d for d in dsts if dsts.count(d) > 1})
+            problems.append(f"duplicate destinations {dup}")
+        if problems:
+            yield self._finding(
+                ctx,
+                f"ppermute over {axes} (size {size}) is not a partial "
+                f"permutation: {'; '.join(problems)}",
+                data=(",".join(axes), str(perm), ";".join(problems)))
+
+
+def _collective_signature(jaxpr, out: Optional[List] = None) -> List:
+    """Ordered [(prim, axes)] of every collective under a jaxpr (recursing
+    through nested sub-jaxprs) — the deadlock-relevant trace shape."""
+    if out is None:
+        out = []
+    closed_jaxpr = getattr(jaxpr, "jaxpr", None)
+    if closed_jaxpr is not None and hasattr(jaxpr, "consts"):
+        jaxpr = closed_jaxpr
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in COLLECTIVES:
+            out.append((eqn.primitive.name, collective_axes(eqn)))
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (tuple, list)) else (v,)):
+                if hasattr(sub, "eqns") or (hasattr(sub, "jaxpr")
+                                            and hasattr(sub, "consts")):
+                    _collective_signature(sub, out)
+    return out
+
+
+class BranchCollectiveRule(Rule):
+    """cond branches inside a manual region must issue the SAME ordered
+    collective sequence: devices taking different branches otherwise post
+    mismatched collectives — the classic SPMD deadlock shape."""
+
+    rule_id = "collective-branch-mismatch"
+    severity = "error"
+    description = "cond branches disagree on their collective sequence"
+
+    def check_eqn(self, eqn, ctx):
+        if eqn.primitive.name != "cond" or ctx.region is None:
+            return
+        branches = eqn.params.get("branches", ())
+        sigs = [_collective_signature(b) for b in branches]
+        if not any(sigs):
+            return
+        if all(s == sigs[0] for s in sigs[1:]):
+            return
+        rendered = [" -> ".join(f"{p}@{','.join(ax)}" for p, ax in s)
+                    or "(none)" for s in sigs]
+        yield self._finding(
+            ctx,
+            "cond branches issue different collective sequences "
+            f"({' VS '.join(rendered)}): devices disagreeing on the "
+            "predicate deadlock",
+            data=tuple(rendered))
+
+
+class WireMismatchRule(Rule):
+    """Reconcile the analyzer's wire-byte estimate against the site's own
+    static accounting (comm_opt ReducePlan.bytes_wire_per_step, resharding
+    ReshardPlan.bytes_wire). A drift beyond the tolerance factor means one
+    of the two accountings is lying about what the program sends."""
+
+    rule_id = "collective-wire-mismatch"
+    severity = "warning"
+    description = "collective byte estimate disagrees with plan accounting"
+
+    def check_summary(self, ctx):
+        expected = ctx.contract.expected_wire_bytes
+        if expected is None:
+            return
+        est = sum(ctx.wire.values())
+        tol = ctx.contract.wire_tolerance
+        lo, hi = expected / tol, expected * tol
+        if expected == 0 and est == 0:
+            return
+        if lo <= est <= hi:
+            return
+        yield self._finding(
+            ctx,
+            f"analyzer estimates {est} wire bytes but the site's plan "
+            f"accounts {expected} (tolerance x{tol:g}): the schedule and "
+            "its accounting have diverged",
+            data=(str(est), str(expected)), path="(summary)")
+
+
+# ---------------------------------------------------------------------------
+# (d) dtype lint
+# ---------------------------------------------------------------------------
+
+class DtypeF64Rule(Rule):
+    """Strong float64 values in a program: on TPU f64 either fails or
+    silently demotes; on CPU it doubles bytes. Weak f64 scalars (python
+    literal artifacts under x64) are ignored — they fold away."""
+
+    rule_id = "dtype-f64"
+    severity = "warning"
+    description = "strong float64 value in a device program"
+
+    def _is_strong_f64(self, aval) -> bool:
+        dtype = _np_dtype(getattr(aval, "dtype", None))
+        return (dtype is not None and dtype == np.float64
+                and not getattr(aval, "weak_type", False))
+
+    def check_program(self, closed, ctx):
+        for i, var in enumerate(closed.jaxpr.invars):
+            aval = getattr(var, "aval", None)
+            if aval is not None and self._is_strong_f64(aval):
+                name = ctx.arg_name(i)
+                yield self._finding(
+                    ctx,
+                    f"argument {name} is {_aval_str(aval)}: f64 leaks into "
+                    "the program signature",
+                    data=("arg", name, _aval_str(aval)),
+                    path=f"invars[{i}]")
+
+    def check_eqn(self, eqn, ctx):
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            if aval is not None and self._is_strong_f64(aval):
+                yield self._finding(
+                    ctx,
+                    f"{eqn.primitive.name} produces strong "
+                    f"{_aval_str(aval)}: f64 compute leaked into the "
+                    "program",
+                    data=(eqn.primitive.name, _aval_str(aval)))
+                break
+
+
+class F32WireRule(Rule):
+    """Large f32 payloads on reduce-path collectives inside manual regions:
+    comm_opt exists to put int8/bf16 on the wire; a full-precision
+    all_to_all/all_gather/reduce_scatter above the threshold is leaving
+    bandwidth on the table. Advisory (info), never gates."""
+
+    rule_id = "dtype-f32-wire"
+    severity = "info"
+    description = "full-precision payload on a reduce-path collective"
+
+    def check_eqn(self, eqn, ctx):
+        if (ctx.region is None
+                or eqn.primitive.name not in
+                ("all_to_all", "all_gather", "reduce_scatter")):
+            return
+        for var in eqn.invars:
+            aval = getattr(var, "aval", None)
+            dtype = _np_dtype(getattr(aval, "dtype", None)) \
+                if aval is not None else None
+            if dtype is None:
+                continue
+            if (dtype == np.float32
+                    and aval_nbytes(aval) >= ctx.contract.wire_threshold):
+                yield self._finding(
+                    ctx,
+                    f"{eqn.primitive.name} moves {_aval_str(aval)} "
+                    f"({aval_nbytes(aval)} B) at full precision; consider "
+                    "the quantized reduce path (comm_opt)",
+                    data=(eqn.primitive.name, _aval_str(aval)))
+                break
+
+
+def default_rules() -> List[Rule]:
+    """Fresh instances of every registered rule, in catalog order."""
+    return [
+        RecompileWeakTypeRule(),
+        DonationRule(),
+        CollectiveAxisRule(),
+        PpermutePermRule(),
+        BranchCollectiveRule(),
+        WireMismatchRule(),
+        DtypeF64Rule(),
+        F32WireRule(),
+    ]
+
+
+#: the public catalog: rule id -> (severity, one-line description)
+RULE_CATALOG = {
+    "recompile-weak-type": ("warning", RecompileWeakTypeRule.description),
+    "donation-missing": ("warning",
+                         "large rebound buffer not in donate_argnums"),
+    "donation-unaliased": ("error",
+                           "donated argument aliases no output"),
+    "collective-axis": ("error", CollectiveAxisRule.description),
+    "collective-ppermute-perm": ("error", PpermutePermRule.description),
+    "collective-branch-mismatch": ("error",
+                                   BranchCollectiveRule.description),
+    "collective-wire-mismatch": ("warning", WireMismatchRule.description),
+    "dtype-f64": ("warning", DtypeF64Rule.description),
+    "dtype-f32-wire": ("info", F32WireRule.description),
+}

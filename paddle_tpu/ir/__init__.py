@@ -28,6 +28,11 @@ from .pass_manager import (  # noqa: F401
     PassRegistry,
     register_pass,
 )
+from .verifier import (  # noqa: F401
+    PassVerificationError,
+    verification_enabled,
+    verify_structure,
+)
 from . import passes  # noqa: F401  (registers the builtin passes)
 from .translator import translate_static  # noqa: F401
 
@@ -35,6 +40,7 @@ __all__ = [
     "IrContext", "Dialect", "Operation", "Value", "Type", "Attribute",
     "Program", "from_jaxpr", "trace",
     "Pass", "PassManager", "PassRegistry", "register_pass",
+    "PassVerificationError", "verification_enabled", "verify_structure",
     "optimize", "translate_static",
 ]
 

@@ -379,7 +379,7 @@ class Program:
             # opaque: conservative identity token (same object <=> equal)
             lib.ir_op_set_attr_i(h, op_id, f"py:{key}".encode(), self._py_token(v))
 
-    def add_constant(self, value) -> Operation:
+    def add_constant(self, value, before: Optional[Operation] = None) -> Operation:
         arr = np.asarray(value)
         t = self.ctx.tensor_type(arr.dtype.name, arr.shape)
         attrs: Dict[str, Any] = {}
@@ -387,7 +387,11 @@ class Program:
             attrs["value"] = arr.item()  # scalars unique natively -> CSE merges
         else:
             attrs["value_token"] = self._py_token(value)
-        op = self.create_op(CONSTANT_OP, [], [t], attrs)
+        # `before=` keeps def-before-use in program order when a pass feeds
+        # the constant to an already-existing op (to_callable hoists all
+        # constants so re-emission would mask the violation; the structural
+        # verifier does not)
+        op = self.create_op(CONSTANT_OP, [], [t], attrs, before=before)
         self.const_vals[op.id] = value
         return op
 

@@ -1,0 +1,174 @@
+"""Differential fuzz harness for the IR pass pipeline.
+
+Generates small random tensor programs (seeded — reproducible by seed),
+traces each into a Program, runs a pass pipeline with the structural
+verifier forced ON, and checks the optimized callable's numerics against
+the untraced original on the same inputs. A pass that miscompiles (wrong
+fold, bad rewire, dropped op) shows up either as a verifier violation or
+as a numeric mismatch; both are reported per seed.
+
+Used by tests/test_analysis.py (a handful of seeds per run) and available
+standalone::
+
+    python -m paddle_tpu.ir.fuzz --num 50 --seed 0
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["FuzzFailure", "random_program", "check_seed", "run_fuzz"]
+
+_SHAPE = (4, 4)  # uniform shape: every binary op / matmul composes
+
+
+@dataclasses.dataclass
+class FuzzFailure:
+    seed: int
+    stage: str      # "trace" | "passes" | "verify" | "emit" | "numerics"
+    detail: str
+
+    def __str__(self):
+        return f"[seed {self.seed}] {self.stage}: {self.detail}"
+
+
+def random_program(rng: np.random.Generator,
+                   n_inputs: int = 2,
+                   n_ops: int = 12) -> Tuple[Callable, Tuple[np.ndarray, ...]]:
+    """Build a random closed-over op recipe and example args.
+
+    The recipe is a static list (op name, operand indices, optional
+    constant), so calling the returned fn twice — once raw, once traced —
+    executes the identical computation.
+    """
+    import jax.numpy as jnp
+
+    n_vals = n_inputs
+    recipe = []
+    for _ in range(n_ops):
+        kind = rng.choice(["add", "sub", "mul", "maximum", "tanh", "neg",
+                           "matmul", "const_mul", "const_add"])
+        a = int(rng.integers(n_vals))
+        b = int(rng.integers(n_vals))
+        const = None
+        if kind in ("const_mul", "const_add"):
+            # scalars sometimes, tensors sometimes — both feed the
+            # constant-folding / affine-collapse paths
+            if rng.random() < 0.5:
+                const = np.float32(rng.normal())
+            else:
+                const = rng.normal(size=_SHAPE).astype(np.float32)
+        recipe.append((str(kind), a, b, const))
+        n_vals += 1
+    out_idx = [int(rng.integers(n_vals)) for _ in range(2)]
+
+    def fn(*xs):
+        vals = list(xs)
+        for kind, a, b, const in recipe:
+            va, vb = vals[a], vals[b]
+            if kind == "add":
+                v = va + vb
+            elif kind == "sub":
+                v = va - vb
+            elif kind == "mul":
+                v = va * vb
+            elif kind == "maximum":
+                v = jnp.maximum(va, vb)
+            elif kind == "tanh":
+                v = jnp.tanh(va)
+            elif kind == "neg":
+                v = -va
+            elif kind == "matmul":
+                v = va @ vb
+            elif kind == "const_mul":
+                v = va * const
+            else:  # const_add
+                v = va + const
+            vals.append(v)
+        return tuple(vals[i] for i in out_idx)
+
+    args = tuple(rng.normal(size=_SHAPE).astype(np.float32)
+                 for _ in range(n_inputs))
+    return fn, args
+
+
+def check_seed(seed: int, passes: Optional[Sequence[str]] = None,
+               n_ops: int = 12, rtol: float = 1e-4,
+               atol: float = 1e-5) -> Optional[FuzzFailure]:
+    """Trace/optimize/re-emit one random program; None means it passed."""
+    from ..core import flags as _flags
+    from . import trace
+    from .pass_manager import PassManager, PassVerificationError
+    from .verifier import verify_structure
+
+    rng = np.random.default_rng(seed)
+    fn, args = random_program(rng, n_ops=n_ops)
+    expected = fn(*args)
+
+    try:
+        prog = trace(fn, *args)
+    except Exception as e:  # generator bug, not a pass bug — still surface
+        return FuzzFailure(seed, "trace", repr(e))
+
+    prev = _flags.flag_value("ir_verify")
+    _flags.set_flags({"ir_verify": True})  # force verifier even outside pytest
+    try:
+        pm = PassManager(passes)
+        pm.run(prog)
+    except PassVerificationError as e:
+        return FuzzFailure(seed, "verify", str(e))
+    except Exception as e:
+        return FuzzFailure(seed, "passes", repr(e))
+    finally:
+        _flags.set_flags({"ir_verify": prev})
+
+    errs = verify_structure(prog)
+    if errs:
+        return FuzzFailure(seed, "verify", "; ".join(errs[:4]))
+
+    try:
+        got = prog.to_callable()(*args)
+    except Exception as e:
+        return FuzzFailure(seed, "emit", repr(e))
+
+    for i, (e, g) in enumerate(zip(expected, got)):
+        if not np.allclose(np.asarray(e), np.asarray(g), rtol=rtol, atol=atol):
+            delta = float(np.max(np.abs(np.asarray(e) - np.asarray(g))))
+            return FuzzFailure(seed, "numerics",
+                               f"output {i} max|delta|={delta:.3e}")
+    return None
+
+
+def run_fuzz(num: int = 20, seed0: int = 0,
+             passes: Optional[Sequence[str]] = None) -> List[FuzzFailure]:
+    """Check ``num`` consecutive seeds; returns the failures (empty = clean)."""
+    failures = []
+    for s in range(seed0, seed0 + num):
+        f = check_seed(s, passes=passes)
+        if f is not None:
+            failures.append(f)
+    return failures
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--num", type=int, default=20)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--pipeline", default=None,
+                   help="comma-separated pass names (default pipeline if unset)")
+    ns = p.parse_args(argv)
+    passes = ns.pipeline.split(",") if ns.pipeline else None
+    failures = run_fuzz(ns.num, ns.seed, passes)
+    for f in failures:
+        print(f)
+    print(f"{ns.num} seed(s), {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -14,6 +14,8 @@ from typing import Dict, List, Optional, Sequence, Type, Union
 from ..observability import metrics as _metrics
 from ..observability.tracing import span as _span
 from .core import Program
+from .verifier import (PassVerificationError, verification_enabled,
+                       verify_structure)
 
 
 class Pass:
@@ -27,6 +29,15 @@ class Pass:
     def __call__(self, program: Program) -> int:
         n = self.run(program)
         program.verify()
+        # structural verifier (def-before-use, dangling values, type
+        # agreement) — flag-gated, on by default under pytest
+        if verification_enabled():
+            errs = verify_structure(program)
+            if errs:
+                detail = "\n  ".join(errs[:8])
+                raise PassVerificationError(
+                    f"pass '{self.name}' left the program structurally "
+                    f"invalid ({len(errs)} violation(s)):\n  {detail}")
         return n
 
 

@@ -90,7 +90,10 @@ class ConstantFoldingPass(Pass):
             if not prim.multiple_results:
                 folded = [folded]
             for res, fv in zip(op.results, folded):
-                res.replace_all_uses_with(program.add_constant(np.asarray(fv)).result(0))
+                # insert at the folded op's slot: its users come later, so
+                # def-before-use survives (appending at program end would not)
+                res.replace_all_uses_with(
+                    program.add_constant(np.asarray(fv), before=op).result(0))
             op.erase()  # now dead; erasing here keeps re-runs convergent
             changed += 1
         return changed
@@ -282,10 +285,12 @@ class AffineChainCollapsePass(Pass):
             if mul_stage is None or last.name not in ("pd.add", "pd.sub"):
                 continue  # need a mul to repurpose and an additive tail
             dtype = rtype.dtype if hasattr(rtype, "dtype") else m.dtype
-            m_c = program.add_constant(m.astype(np.dtype(str(dtype)), copy=False))
+            m_c = program.add_constant(m.astype(np.dtype(str(dtype)), copy=False),
+                                       before=mul_stage)
             # B stage keeps `last`'s own opcode: add gets +B, sub gets -B
             b_v = b if last.name == "pd.add" else -b
-            b_c = program.add_constant(b_v.astype(np.dtype(str(dtype)), copy=False))
+            b_c = program.add_constant(b_v.astype(np.dtype(str(dtype)), copy=False),
+                                       before=mul_stage)
             mul_stage.set_operand(0, data)
             mul_stage.set_operand(1, m_c.result(0))
             last.set_operand(0, mul_stage.result(0))
@@ -380,7 +385,8 @@ class ConvBnFusePass(Pass):
             if W.shape[w_out_dim] != vec.shape[0]:
                 continue
             newW = (W * vec.reshape(bshape)).astype(W.dtype, copy=False)
-            prod.set_operand(w_idx, program.add_constant(newW).result(0))
+            prod.set_operand(w_idx,
+                             program.add_constant(newW, before=prod).result(0))
             op.result(0).replace_all_uses_with(prod.result(0))
             op.erase()
             changed += 1

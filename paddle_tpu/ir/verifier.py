@@ -1,0 +1,154 @@
+"""Structural verifier for ir.Program — runs after every pass.
+
+The native ``ir_verify`` covers the storage-level invariants (alive-id
+consistency); this module adds the PROGRAM-level invariants a rewriting
+pass can silently break while the native check still passes:
+
+- **def-before-use**: every operand's defining op appears earlier in
+  program order (``Program.ops()``); block arguments are position-free.
+  ``to_callable`` hoists constants before re-emission, which would MASK a
+  pass that appends a constant after its users — so the verifier enforces
+  strict program order for constants too (see the ``before=`` argument of
+  ``Program.add_constant``, added for exactly this).
+- **no dangling Values**: no operand or program output refers to an erased
+  op's result.
+- **operand/result type agreement**: for primitive-bound ops the declared
+  result types must match what the primitive abstract-evals to on the
+  operand types (``jax.eval_shape``); a pass that rewires operands without
+  recomputing result types is caught here, not at re-emission time.
+
+Gated by the ``ir_verify`` flag (``paddle_tpu.core.flags``): default is
+auto — ON under pytest (``PYTEST_CURRENT_TEST`` set), off otherwise so
+production pipelines don't pay the abstract-eval cost per pass. Set the
+flag to True/False to force either way.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from ..core import flags as _flags
+from ..observability import metrics as _metrics
+from .core import CONSTANT_OP, Program
+
+__all__ = ["PassVerificationError", "verification_enabled", "verify_structure"]
+
+_flags.register_flag(
+    "ir_verify", None,
+    "Run the structural IR verifier after every pass "
+    "(None = auto: on under pytest)")
+
+
+class PassVerificationError(RuntimeError):
+    """A pass left the program structurally invalid."""
+
+
+def verification_enabled() -> bool:
+    val = _flags.flag_value("ir_verify")
+    if val is None:
+        return "PYTEST_CURRENT_TEST" in os.environ
+    return bool(val)
+
+
+def _type_str(t) -> str:
+    try:
+        return f"{t.dtype}{list(t.shape)}"
+    except Exception:
+        return "<?>"
+
+
+def verify_structure(program: Program) -> List[str]:
+    """Check program-order/def-use/type invariants; returns human-readable
+    violation strings (empty list = clean). Never raises on malformed
+    programs — callers decide whether findings are fatal."""
+    errors: List[str] = []
+    ops = program.ops()
+    pos = {op.id: i for i, op in enumerate(ops)}
+    block_args = {v.id for v in program.inputs}
+
+    for i, op in enumerate(ops):
+        for j, operand in enumerate(op.operands):
+            d = operand.defining_op()
+            if d is None:
+                if operand.id not in block_args:
+                    errors.append(
+                        f"op {op.id} '{op.name}' operand {j}: value "
+                        f"%{operand.id} has no defining op and is not a "
+                        "block argument (dangling)")
+                continue
+            if d.id not in pos:
+                errors.append(
+                    f"op {op.id} '{op.name}' operand {j}: defined by "
+                    f"erased op {d.id} (dangling)")
+            elif pos[d.id] >= i:
+                errors.append(
+                    f"op {op.id} '{op.name}' operand {j}: defined by op "
+                    f"{d.id} '{d.name}' at position {pos[d.id]} >= {i} "
+                    "(def-before-use violated)")
+
+    for k, out in enumerate(program.outputs):
+        d = out.defining_op()
+        if d is None:
+            if out.id not in block_args:
+                errors.append(f"program output {k}: value %{out.id} is "
+                              "dangling (no defining op, not a block arg)")
+        elif d.id not in pos:
+            errors.append(f"program output {k}: defined by erased op "
+                          f"{d.id} (dangling)")
+
+    errors.extend(_check_types(program, ops))
+
+    _metrics.counter("ir.verify.runs")
+    if errors:
+        _metrics.counter("ir.verify.violations", len(errors))
+    return errors
+
+
+def _check_types(program: Program, ops) -> List[str]:
+    """Re-abstract-eval each primitive-bound op on its operand types and
+    compare against the declared result types. Primitives that refuse
+    abstract evaluation outside a trace (e.g. ones needing concrete
+    params) are skipped, not failed."""
+    import jax
+    import numpy as np
+
+    errors: List[str] = []
+    for op in ops:
+        if op.name == CONSTANT_OP or op.id not in program.op_bind:
+            continue
+        prim, params = program.op_bind[op.id]
+        try:
+            in_sds = [jax.ShapeDtypeStruct(o.type.shape, np.dtype(o.type.dtype))
+                      for o in op.operands]
+        except Exception:
+            continue  # extended/dynamic dtype — outside np coverage
+
+        def f(*xs, _prim=prim, _params=params):
+            subfuns, bind_params = _prim.get_bind_params(dict(_params))
+            return _prim.bind(*subfuns, *xs, **bind_params)
+
+        try:
+            out = jax.eval_shape(f, *in_sds)
+        except Exception:
+            continue  # primitive needs trace context — skip, don't fail
+        outs = list(out) if prim.multiple_results else [out]
+        results = op.results
+        if len(outs) != len(results):
+            errors.append(
+                f"op {op.id} '{op.name}': declares {len(results)} results "
+                f"but primitive abstract-evals to {len(outs)}")
+            continue
+        for k, (sds, res) in enumerate(zip(outs, results)):
+            declared = res.type
+            try:
+                decl_dtype = np.dtype(declared.dtype)
+            except Exception:
+                continue
+            if (tuple(sds.shape) != tuple(declared.shape)
+                    or np.dtype(sds.dtype) != decl_dtype):
+                errors.append(
+                    f"op {op.id} '{op.name}' result {k}: declared "
+                    f"{_type_str(declared)} but abstract eval gives "
+                    f"{sds.dtype}{list(sds.shape)} (type disagreement)")
+    return errors

@@ -25,6 +25,7 @@ regression test in tests/test_serving.py pins down.
 from __future__ import annotations
 
 import time
+import warnings
 import weakref
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple, Union
@@ -45,6 +46,15 @@ from .kv_cache import KVCache
 from .sampling import SamplingParams
 from .scheduler import Request, Scheduler
 
+#: every serving executable takes (params, k_cache, v_cache, ...) and
+#: returns fresh caches its caller rebinds — so the KV cache args are
+#: donated at compile time. Without this each prefill/decode step held TWO
+#: copies of the cache live (input + output), the exact non-donated
+#: hot-loop buffer the analysis donation rule flags (rule donation-missing
+#: on serving_prefill/serving_decode; fixed in the PR that added
+#: paddle_tpu/analysis — see tools/analysis_baseline.json history).
+KV_DONATE_ARGNUMS = (1, 2)
+
 _DUMMY_KEY = None
 
 
@@ -58,19 +68,27 @@ def _dummy_key():
     return _DUMMY_KEY
 
 
-def _aot(cache: Dict, key, site: str, fn, args) -> "jax.stages.Compiled":
+def _aot(cache: Dict, key, site: str, fn, args,
+         donate_argnums: Tuple[int, ...] = ()) -> "jax.stages.Compiled":
     """AOT compile-or-fetch with observability accounting: a dict hit bumps
     ``jit.compile.cache_hit{site=}``, a miss compiles (timed into
     ``jit.compile.seconds{site=}``) and bumps the miss counter. The
     compiled executable is shape-locked — drifting shapes raise rather
     than recompile, which is what makes the one-compile guarantee
-    testable."""
+    testable. ``donate_argnums`` marks input buffers the caller never
+    reuses (the KV caches) so XLA aliases them into the outputs."""
     exe = cache.get(key)
     if exe is not None:
         _obs.record_compile(site, cache_hit=True)
         return exe
     t0 = time.perf_counter()
-    exe = jax.jit(fn).lower(*args).compile()
+    with warnings.catch_warnings():
+        # CPU/interpreter backends may decline the aliasing; the donation
+        # contract is still correct (and active on TPU) — keep logs quiet
+        warnings.filterwarnings(
+            "ignore", message=".*donated buffers.*", category=UserWarning)
+        exe = jax.jit(fn, donate_argnums=tuple(donate_argnums)) \
+            .lower(*args).compile()
     _obs.record_compile(site, seconds=time.perf_counter() - t0,
                         cache_hit=False)
     _obs_memory.record_executable(site, exe)
@@ -133,7 +151,8 @@ def cached_generate(model, input_ids, *, max_new_tokens: int = 32,
 
     pkey = ("prefill", B, S, S_max, str(tok_dtype), str(dt))
     prefill = _aot(exe_cache, pkey, "serving.prefill", prefill_fn,
-                   (params, kc, vc, idsv))
+                   (params, kc, vc, idsv),
+                   donate_argnums=KV_DONATE_ARGNUMS)
 
     def decode_fn(p, kc, vc, tokens, positions, key):
         caches = [(kc[l], vc[l]) for l in range(L)]
@@ -153,7 +172,8 @@ def cached_generate(model, input_ids, *, max_new_tokens: int = 32,
     tok0 = jnp.zeros((B,), tok_dtype)
     pos0 = jnp.full((B,), S - 1, jnp.int32)
     decode = _aot(exe_cache, dkey, "serving.decode", decode_fn,
-                  (params, kc, vc, tok0, pos0, _dummy_key()))
+                  (params, kc, vc, tok0, pos0, _dummy_key()),
+                  donate_argnums=KV_DONATE_ARGNUMS)
 
     logits0, kc, vc = prefill(params, kc, vc, idsv)
     finished = np.zeros((B,), bool)
@@ -351,8 +371,13 @@ class Engine:
                 return b
         return self.config.max_seq_len
 
-    def _prefill_exe(self, T: int):
-        model, L = self.model, self.cache.num_layers
+    def prefill_program(self, T: int):
+        """(fn, example_args) for the T-token prefill bucket — the pure
+        program ``_prefill_exe`` compiles, exposed so the static analyzer
+        (paddle_tpu.analysis) can trace it without compiling/executing.
+        The KV-cache args (positions ``KV_DONATE_ARGNUMS``) are donated at
+        compile; callers must rebind from the outputs."""
+        model = self.model
 
         def prefill_fn(p, kc, vc, ids, slot, length):
             with no_grad():
@@ -369,10 +394,11 @@ class Engine:
 
         args = (self.params, self.cache.k, self.cache.v,
                 jnp.zeros((1, T), jnp.int32), jnp.int32(0), jnp.int32(1))
-        return _aot(self._exe, ("prefill", T), "serving.prefill",
-                    prefill_fn, args)
+        return prefill_fn, args
 
-    def _decode_exe(self):
+    def decode_program(self):
+        """(fn, example_args) for the batched decode step — see
+        ``prefill_program`` for the donation contract."""
         model, L = self.model, self.cache.num_layers
 
         def decode_fn(p, kc, vc, tokens, positions, temps, top_ks, greedy,
@@ -393,7 +419,17 @@ class Engine:
                 jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
                 jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.int32),
                 jnp.ones((B,), bool), _dummy_key())
-        return _aot(self._exe, ("decode",), "serving.decode", decode_fn, args)
+        return decode_fn, args
+
+    def _prefill_exe(self, T: int):
+        prefill_fn, args = self.prefill_program(T)
+        return _aot(self._exe, ("prefill", T), "serving.prefill",
+                    prefill_fn, args, donate_argnums=KV_DONATE_ARGNUMS)
+
+    def _decode_exe(self):
+        decode_fn, args = self.decode_program()
+        return _aot(self._exe, ("decode",), "serving.decode", decode_fn,
+                    args, donate_argnums=KV_DONATE_ARGNUMS)
 
     def _admit(self):
         while self.cache.free_slots and self.scheduler.waiting:

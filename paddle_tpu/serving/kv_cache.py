@@ -71,7 +71,10 @@ def decode_attend(q, k_cache, v_cache, positions):
     rep = q.shape[1] // k_cache.shape[1]
     k = _expand_kv_heads(k_cache, rep)
     v = _expand_kv_heads(v_cache, rep)
-    qf = (q * (1.0 / np.sqrt(D))).astype(q.dtype)
+    # scale as a q-dtype scalar: np.sqrt returns a STRONG f64 scalar, and
+    # under x64 `q * f64` upcasts the whole tensor to f64 before the cast
+    # back (found by the analysis dtype-f64 rule on serving_decode)
+    qf = q * jnp.asarray(1.0 / np.sqrt(D), q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", qf, k,
                    preferred_element_type=jnp.float32)
     pos = jnp.asarray(positions)

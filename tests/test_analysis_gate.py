@@ -1,0 +1,88 @@
+"""The CI lint gate: the real program corpus must lint clean against the
+committed baseline, and an introduced violation must fail the gate.
+
+This is the in-process twin of ``tools/lint_programs.py`` (same corpus,
+same baseline file, same new_against diff); the subprocess test exercises
+the actual CLI exit codes and is marked slow.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu import analysis
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def corpus_report():
+    specs, skips = analysis.build_corpus()
+    # on the 8-device CPU test host every builder must produce a spec —
+    # a skip here means corpus rot, not an acceptable degradation
+    assert not skips, f"corpus builders skipped: {skips}"
+    assert len(specs) >= 5
+    report, errors = analysis.analyze_corpus(specs)
+    return specs, report, errors
+
+
+def test_corpus_traces_without_errors(corpus_report):
+    _, report, errors = corpus_report
+    assert not errors, f"trace failures: {errors}\n{report.render()}"
+
+
+def test_corpus_covers_real_entry_points(corpus_report):
+    specs, _, _ = corpus_report
+    names = {s.name for s in specs}
+    assert {"train_step", "serving_prefill", "serving_decode",
+            "grad_reducer", "reshard", "ir_optimized"} <= names
+
+
+def test_corpus_clean_against_committed_baseline(corpus_report):
+    _, report, _ = corpus_report
+    baseline = analysis.load_baseline(analysis.default_baseline_path())
+    new = report.new_against(analysis.baseline_fingerprints(baseline))
+    assert not new, (
+        "new gating findings — fix them or suppress with rationale via "
+        "tools/lint_programs.py --update-baseline --reason '...':\n"
+        + "\n".join(f.render() for f in new))
+
+
+def test_injected_violation_fails_gate(corpus_report):
+    specs, _, _ = corpus_report
+    injected = [s for s, rule in analysis.fixture_specs()
+                if rule == "collective-ppermute-perm"]
+    report, errors = analysis.analyze_corpus(list(specs) + injected)
+    assert not errors
+    baseline = analysis.load_baseline(analysis.default_baseline_path())
+    new = report.new_against(analysis.baseline_fingerprints(baseline))
+    assert new, "seeded ppermute violation did not fail the gate"
+    assert {f.rule for f in new} == {"collective-ppermute-perm"}
+
+
+def test_wire_reconciliation_active(corpus_report):
+    # the grad_reducer and reshard contracts carry expected_wire_bytes; a
+    # clean report means the analyzer's collective wire model reconciled
+    # with the comm_opt / resharding plan accounting (within tolerance) —
+    # assert the contracts are actually wired so this can't silently rot
+    specs, _, _ = corpus_report
+    by_name = {s.name: s for s in specs}
+    assert by_name["grad_reducer"].contract.expected_wire_bytes
+    assert by_name["reshard"].contract.expected_wire_bytes
+
+
+@pytest.mark.slow
+def test_cli_exit_codes():
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    tool = os.path.join(_REPO, "tools", "lint_programs.py")
+    clean = subprocess.run([sys.executable, tool], env=env, cwd=_REPO,
+                           capture_output=True, text=True, timeout=300)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    bad = subprocess.run([sys.executable, tool, "--inject", "dtype-f64"],
+                         env=env, cwd=_REPO, capture_output=True, text=True,
+                         timeout=300)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "dtype-f64" in bad.stdout

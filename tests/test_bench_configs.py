@@ -34,7 +34,8 @@ def test_all_configs_registered():
 
     assert set(bench.CONFIGS) == {"bert_sst2", "gpt_dp", "ernie_mp4",
                                   "resnet50", "gpt_moe", "serving", "ckpt",
-                                  "data", "comm", "reshard", "obs"}
+                                  "data", "comm", "reshard", "obs",
+                                  "analysis"}
 
 
 def test_bench_ckpt_row_contract(capsys):
@@ -176,6 +177,26 @@ def test_bench_obs_row_contract(capsys):
     assert hist["count"] > 0 and "p99" in hist and "p50" in hist
     # the row must not leave the global observability flag flipped on
     assert not observability.enabled()
+
+
+def test_bench_analysis_row_contract(capsys):
+    """The analysis row's acceptance invariant: the full program corpus
+    traces and lints on CPU inside the 60s lint-gate budget, with no trace
+    errors and no skipped builders on the 8-device test host."""
+    import bench
+
+    row = bench.bench_analysis()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(out)
+    assert parsed == row
+    assert parsed["config"] == "analysis"
+    assert 0 < parsed["value"] < 60_000  # analyze_ms within the gate budget
+    assert 0 < parsed["build_ms"] < 60_000
+    assert parsed["corpus_programs"] >= 5
+    assert parsed["skipped"] == []
+    assert parsed["trace_errors"] == 0
+    assert parsed["rules_run"] >= 8
+    assert set(parsed["findings"]) == {"info", "warning", "error"}
 
 
 @pytest.mark.slow

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Static-lint the paddle_tpu program corpus against the committed baseline.
+
+CPU-only and trace-only (``jax.make_jaxpr`` — nothing executes), so this
+runs on any CI host in well under a minute. The corpus covers the real
+entry points: the sharded train step (with and without gradient-reduction
+collectives), serving prefill/decode, the GradReducer shard_map schedule,
+a resharding executor body, and an ir-pipeline-optimized program.
+
+Exit codes:
+  0  clean (no gating findings beyond the committed baseline)
+  1  NEW gating findings (warning or worse) — the CI gate
+  2  internal failure (corpus build or analysis crashed)
+
+Usage:
+  python tools/lint_programs.py                    # the CI gate
+  python tools/lint_programs.py --json             # machine-readable report
+  python tools/lint_programs.py --selftest         # fixture rules must fire
+  python tools/lint_programs.py --inject dtype-f64 # prove the gate trips
+  python tools/lint_programs.py --update-baseline --reason "why"
+
+See paddle_tpu/analysis/README.md for the rule catalog and the
+suppression/baseline workflow.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# trace-only CPU setup must precede any jax import; force (not default) the
+# platform — a remote-accelerator plugin pre-registered by sitecustomize
+# would otherwise turn this no-execution lint into tunnel round-trips
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # env alone loses to sitecustomize
+jax.config.update("jax_enable_x64", True)  # match the test environment
+
+from paddle_tpu import analysis  # noqa: E402
+
+
+def _selftest(verbose: bool) -> int:
+    """Every required fixture must fire exactly its seeded rule."""
+    failures = []
+    for spec, expected_rule in analysis.fixture_specs():
+        report = analysis.analyze_spec(spec)
+        hit = sorted(report.rules_hit())
+        status = "ok" if expected_rule in hit else "MISSING"
+        if verbose or status != "ok":
+            print(f"  fixture {spec.name}: expected {expected_rule}, "
+                  f"got {hit} [{status}]")
+        if expected_rule not in hit:
+            failures.append(spec.name)
+    required = set(analysis.REQUIRED_FIXTURE_RULES)
+    covered = {rule for _, rule in analysis.fixture_specs()}
+    missing_rules = required - covered
+    if missing_rules:
+        print(f"selftest: required rules with no fixture: {sorted(missing_rules)}")
+        return 1
+    if failures:
+        print(f"selftest FAILED: {failures}")
+        return 1
+    print(f"selftest ok: {len(analysis.fixture_specs())} fixtures, "
+          f"{len(required)} required rules covered")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=analysis.default_baseline_path())
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the full report as JSON on stdout")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="suppress all currently-new findings (needs --reason)")
+    ap.add_argument("--reason", default="",
+                    help="rationale recorded with --update-baseline")
+    ap.add_argument("--selftest", action="store_true",
+                    help="check every seeded fixture violation is detected")
+    ap.add_argument("--inject", metavar="RULE",
+                    help="add the fixture for RULE to the corpus (gate demo)")
+    ap.add_argument("--verbose", "-v", action="store_true")
+    ns = ap.parse_args(argv)
+
+    if ns.selftest:
+        return _selftest(ns.verbose)
+    if ns.update_baseline and not ns.reason:
+        ap.error("--update-baseline requires --reason")
+
+    t0 = time.monotonic()
+    try:
+        specs, skips = analysis.build_corpus()
+        if ns.inject:
+            injected = [s for s, rule in analysis.fixture_specs()
+                        if rule == ns.inject]
+            if not injected:
+                ap.error(f"--inject: no fixture for rule '{ns.inject}'; "
+                         f"have {sorted({r for _, r in analysis.fixture_specs()})}")
+            specs = list(specs) + injected
+        build_s = time.monotonic() - t0
+        report, errors = analysis.analyze_corpus(specs)
+    except Exception as e:  # corpus construction itself broke
+        print(f"lint_programs: internal failure: {e!r}", file=sys.stderr)
+        return 2
+    analyze_s = time.monotonic() - t0 - build_s
+
+    baseline = analysis.load_baseline(ns.baseline)
+    suppressed = set(analysis.baseline_fingerprints(baseline))
+    new = report.new_against(suppressed)
+
+    if ns.as_json:
+        print(json.dumps({
+            "programs": [s.name for s in specs],
+            "skipped": [{"name": n, "reason": r} for n, r in skips],
+            "build_seconds": round(build_s, 3),
+            "analyze_seconds": round(analyze_s, 3),
+            "counts": report.counts(),
+            "findings": [f.as_dict() for f in report.findings],
+            "new_gating": [f.as_dict() for f in new],
+        }, indent=2))
+    else:
+        print(f"lint_programs: {len(specs)} program(s) "
+              f"(build {build_s:.1f}s, analyze {analyze_s:.1f}s)"
+              + (f"; skipped: {[n for n, _ in skips]}" if skips else ""))
+        if ns.verbose or report.findings:
+            print(report.render())
+
+    if ns.update_baseline and new:
+        added = analysis.add_suppressions(baseline, new, ns.reason)
+        analysis.prune_stale(baseline, [f.fingerprint for f in report.findings])
+        analysis.save_baseline(baseline, ns.baseline)
+        print(f"baseline updated: {added} suppression(s) added "
+              f"-> {ns.baseline}")
+        return 0
+
+    if new:
+        print(f"\nFAIL: {len(new)} new gating finding(s) not in baseline "
+              f"({ns.baseline}):")
+        for f in new:
+            print("  " + f.render())
+        print("\nfix the hazard, or suppress with a rationale:\n"
+              "  python tools/lint_programs.py --update-baseline --reason '...'")
+        return 1
+
+    stale = suppressed - {f.fingerprint for f in report.findings}
+    if stale and not ns.as_json:
+        print(f"note: {len(stale)} stale suppression(s) in baseline "
+              "(finding fixed — run --update-baseline to prune)")
+    print("lint_programs: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
