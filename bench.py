@@ -1190,6 +1190,13 @@ def bench_analysis():
     t0 = time.perf_counter()
     report, errors = analysis.analyze_corpus(specs)
     analyze_ms = (time.perf_counter() - t0) * 1e3
+    t0 = time.perf_counter()
+    audits = analysis.audit_corpus(specs)
+    hlo_audit_ms = (time.perf_counter() - t0) * 1e3
+    hlo_collectives = {}
+    for a in audits:
+        for key, n in a.counts.items():
+            hlo_collectives[key] = hlo_collectives.get(key, 0) + n
     out = {
         "config": "analysis",
         "metric": "analyze_ms",
@@ -1201,8 +1208,13 @@ def bench_analysis():
         "rules_run": len(analysis.RULE_CATALOG),
         "findings": report.counts(),
         "build_ms": round(build_ms, 3),
+        "hlo_audit_ms": round(hlo_audit_ms, 3),
+        "hlo_collectives": dict(sorted(hlo_collectives.items())),
+        "hbm_peak_mb_by_site": {
+            a.site: round(a.hbm.get("peak", 0) / 1e6, 3) for a in audits},
         "note": f"{len(specs)} programs x {len(analysis.RULE_CATALOG)} "
-                "rules; lint gate budget is 60s end-to-end",
+                "rules + post-partition HLO audit; lint gate budget is "
+                "60s end-to-end",
     }
     print(json.dumps(out))
     return out

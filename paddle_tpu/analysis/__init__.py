@@ -20,6 +20,7 @@ from .analyzer import (  # noqa: F401
     analyze_corpus,
     analyze_fn,
     analyze_spec,
+    collect_wire,
 )
 from .baseline import (  # noqa: F401
     add_suppressions,
@@ -30,16 +31,53 @@ from .baseline import (  # noqa: F401
     save_baseline,
 )
 from .corpus import build_corpus  # noqa: F401
-from .findings import GATE_SEVERITY, SEVERITIES, Finding, Report  # noqa: F401
+from .findings import (  # noqa: F401
+    GATE_SEVERITY,
+    SEVERITIES,
+    Finding,
+    Report,
+    drain_ambient,
+    record_ambient,
+)
 from .fixtures import REQUIRED_FIXTURE_RULES, fixture_specs  # noqa: F401
+from .hlo_audit import (  # noqa: F401
+    HloDiff,
+    SiteAudit,
+    audit_corpus,
+    audit_spec,
+    audits_to_baseline,
+    default_hlo_baseline_path,
+    diff_against_baseline,
+    inject_replicated_arg,
+    load_hlo_baseline,
+    parse_hlo_collectives,
+    save_hlo_baseline,
+    unexplained_findings,
+)
 from .rules import RULE_CATALOG, Rule, default_rules  # noqa: F401
+from .sharding_flow import (  # noqa: F401
+    TIER2_RULE_IDS,
+    FlowEvent,
+    FlowResult,
+    ShardingContract,
+    flow_findings,
+    propagate_jaxpr,
+)
 
 __all__ = [
     "Finding", "Report", "SEVERITIES", "GATE_SEVERITY",
-    "Rule", "default_rules", "RULE_CATALOG",
+    "record_ambient", "drain_ambient",
+    "Rule", "default_rules", "RULE_CATALOG", "TIER2_RULE_IDS",
     "SiteContract", "ProgramSpec", "Region", "Context",
+    "ShardingContract", "FlowEvent", "FlowResult",
+    "flow_findings", "propagate_jaxpr", "collect_wire",
     "analyze_fn", "analyze_closed", "analyze_spec", "analyze_corpus",
     "build_corpus", "fixture_specs", "REQUIRED_FIXTURE_RULES",
     "default_baseline_path", "load_baseline", "save_baseline",
     "baseline_fingerprints", "add_suppressions", "prune_stale",
+    "SiteAudit", "HloDiff", "audit_spec", "audit_corpus",
+    "parse_hlo_collectives", "default_hlo_baseline_path",
+    "load_hlo_baseline", "save_hlo_baseline", "audits_to_baseline",
+    "diff_against_baseline", "inject_replicated_arg",
+    "unexplained_findings",
 ]

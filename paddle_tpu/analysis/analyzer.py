@@ -32,12 +32,14 @@ except ImportError:  # pragma: no cover - older jax
     from jax.core import ClosedJaxpr, Jaxpr
 
 from ..observability import metrics as _metrics
-from .findings import Finding, Report
+from .findings import Finding, Report, drain_ambient
 from .rules import (COLLECTIVES, Rule, collective_axes, default_rules,
                     wire_bytes)
+from .sharding_flow import ShardingContract, flow_findings
 
 __all__ = ["SiteContract", "ProgramSpec", "Region", "Context",
-           "analyze_fn", "analyze_closed", "analyze_corpus"]
+           "analyze_fn", "analyze_closed", "analyze_corpus",
+           "collect_wire"]
 
 
 @dataclass(frozen=True)
@@ -63,13 +65,19 @@ class SiteContract:
 
 @dataclass(frozen=True)
 class ProgramSpec:
-    """One corpus entry: a traceable entry point plus its contract."""
+    """One corpus entry: a traceable entry point plus its contract.
+
+    ``sharding`` (tier 2) declares the shardings the site's jit is built
+    with: the flow rules judge against it and hlo_audit compiles with it —
+    without it the partitioner sees unconstrained args and elides the very
+    collectives the audit exists to count."""
 
     name: str
     fn: Callable
     args: Tuple
     contract: SiteContract = SiteContract()
     argnames: Optional[Tuple[str, ...]] = None
+    sharding: Optional[ShardingContract] = None
 
 
 @dataclass(frozen=True)
@@ -201,18 +209,62 @@ def analyze_closed(name: str, closed: ClosedJaxpr, contract: SiteContract,
 def analyze_fn(name: str, fn: Callable, args: Tuple,
                contract: SiteContract = SiteContract(),
                argnames: Optional[Tuple[str, ...]] = None,
-               rules: Optional[Sequence[Rule]] = None) -> Report:
-    """Trace fn(*args) abstractly and lint the resulting program."""
-    closed = jax.make_jaxpr(fn)(*args)
+               rules: Optional[Sequence[Rule]] = None,
+               sharding: Optional[ShardingContract] = None) -> Report:
+    """Trace fn(*args) abstractly and lint the resulting program. With a
+    ShardingContract declared, the tier-2 sharding flow runs over the same
+    trace (spmd-* rules)."""
+    closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(*args)
     donated, names = _flat_donation(args, contract.donate_argnums, argnames)
-    return analyze_closed(name, closed, contract, donated=donated,
-                          arg_names=names, rules=rules)
+    report = analyze_closed(name, closed, contract, donated=donated,
+                            arg_names=names, rules=rules)
+    if sharding is not None:
+        _, findings = flow_findings(name, closed, sharding, args,
+                                    out_shape=out_shape)
+        report.extend(findings)
+        if _metrics.enabled():
+            for f in findings:
+                _metrics.counter("analysis.findings", 1, rule=f.rule,
+                                 severity=f.severity)
+    return report
 
 
 def analyze_spec(spec: ProgramSpec,
                  rules: Optional[Sequence[Rule]] = None) -> Report:
     return analyze_fn(spec.name, spec.fn, spec.args, spec.contract,
-                      argnames=spec.argnames, rules=rules)
+                      argnames=spec.argnames, rules=rules,
+                      sharding=spec.sharding)
+
+
+def collect_wire(closed: ClosedJaxpr) -> Dict[str, int]:
+    """Per-primitive receive-side wire-byte estimate for the collectives
+    inside the program's manual shard_map regions — the tier-1 model,
+    exposed for hlo_audit's prediction reconcile."""
+    wire: Dict[str, int] = {}
+
+    def walk(jaxpr, region: Optional[Region]):
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim in COLLECTIVES and region is not None:
+                n = 1
+                for a in collective_axes(eqn):
+                    n *= region.mesh_axes.get(a, 1)
+                b = wire_bytes(eqn, n)
+                if b:
+                    wire[prim] = wire.get(prim, 0) + b
+            if prim == "shard_map":
+                mesh = eqn.params.get("mesh")
+                auto = frozenset(eqn.params.get("auto", frozenset()))
+                sizes = _mesh_axis_sizes(mesh) if mesh is not None else {}
+                walk(_as_open(eqn.params["jaxpr"]),
+                     Region(mesh_axes=sizes,
+                            manual=frozenset(sizes) - auto, path=""))
+                continue
+            for _, sub in _sub_jaxprs(eqn):
+                walk(_as_open(sub), region)
+
+    walk(closed.jaxpr, None)
+    return wire
 
 
 def analyze_corpus(specs: Sequence[ProgramSpec],
@@ -221,8 +273,11 @@ def analyze_corpus(specs: Sequence[ProgramSpec],
     """Lint every spec; returns (merged deduped report, [(name, error)]
     for specs whose TRACE failed — a trace failure is surfaced as a
     finding too (rule ``trace-error``), since a corpus entry silently
-    dropping out would un-gate its rules)."""
+    dropping out would un-gate its rules). Ambient findings recorded
+    during corpus construction (``findings.record_ambient``, e.g.
+    comm-quant-downgrade) are folded in."""
     merged = Report()
+    merged.extend(drain_ambient())
     errors: List[Tuple[str, str]] = []
     for spec in specs:
         try:

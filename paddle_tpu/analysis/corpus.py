@@ -68,7 +68,7 @@ def _train_step_spec() -> ProgramSpec:
     return ProgramSpec(
         "train_step", st._compiled_step_fn, _step_args(st, 2 * mesh.size),
         SiteContract(one_compile=True, donate_argnums=(0, 1, 2, 3)),
-        argnames=_STEP_ARGNAMES)
+        argnames=_STEP_ARGNAMES, sharding=st.sharding_contract())
 
 
 def _train_step_grad_reduce_spec() -> ProgramSpec:
@@ -84,7 +84,7 @@ def _train_step_grad_reduce_spec() -> ProgramSpec:
             # ReducePlan counts per-device receive-side bytes per step —
             # the analyzer's own convention, so no rescaling
             expected_wire_bytes=st._reducer.plan.bytes_wire_per_step),
-        argnames=_STEP_ARGNAMES)
+        argnames=_STEP_ARGNAMES, sharding=st.sharding_contract())
 
 
 def _serving_specs() -> List[ProgramSpec]:
@@ -103,11 +103,13 @@ def _serving_specs() -> List[ProgramSpec]:
     return [
         ProgramSpec("serving_prefill", pre_fn, pre_args, contract,
                     argnames=("params", "k_cache", "v_cache", "ids",
-                              "slot", "length")),
+                              "slot", "length"),
+                    sharding=eng.sharding_contract(len(pre_args))),
         ProgramSpec("serving_decode", dec_fn, dec_args, contract,
                     argnames=("params", "k_cache", "v_cache", "tokens",
                               "positions", "temps", "top_ks", "greedy",
-                              "key")),
+                              "key"),
+                    sharding=eng.sharding_contract(len(dec_args))),
     ]
 
 
@@ -130,11 +132,13 @@ def _grad_reducer_spec() -> ProgramSpec:
     return ProgramSpec(
         "grad_reducer", fn, (gstack, ef),
         SiteContract(expected_wire_bytes=red.plan.bytes_wire_per_step),
-        argnames=("grads", "ef"))
+        argnames=("grads", "ef"),
+        sharding=red.sharding_contract(sorted(gstack), sorted(ef)))
 
 
 def _reshard_spec() -> ProgramSpec:
     from ..distributed.resharding.executor import (_compiled_executor,
+                                                   executor_contract,
                                                    plan_for)
 
     devs = jax.devices()
@@ -152,7 +156,7 @@ def _reshard_spec() -> ProgramSpec:
         # ReshardPlan.bytes_wire totals receive bytes ACROSS all devices;
         # the analyzer estimates per device
         SiteContract(expected_wire_bytes=plan.bytes_wire // plan.world),
-        argnames=("arr",))
+        argnames=("arr",), sharding=executor_contract(plan, src_mesh))
 
 
 def _ir_optimized_spec() -> ProgramSpec:

@@ -18,6 +18,24 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 SEVERITIES = ("info", "warning", "error")
 
+#: process-wide registry for findings raised OUTSIDE a jaxpr walk — e.g.
+#: comm_opt recording `comm-quant-downgrade` while a reducer is being
+#: CONSTRUCTED (the hazard exists before anything traces). analyze_corpus
+#: drains this into its report so configuration-time hazards reach the
+#: same gate/baseline machinery as traced ones.
+_AMBIENT: List["Finding"] = []
+
+
+def record_ambient(finding: "Finding"):
+    """Register a finding raised outside any trace (deduped on drain)."""
+    _AMBIENT.append(finding)
+
+
+def drain_ambient() -> List["Finding"]:
+    """Take (and clear) every ambient finding recorded so far."""
+    out, _AMBIENT[:] = list(_AMBIENT), []
+    return out
+
 #: findings at or above this severity fail the lint gate (info findings are
 #: advisory: reported, never gating)
 GATE_SEVERITY = "warning"

@@ -25,17 +25,26 @@ from jax import lax
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
+from jax.sharding import NamedSharding
+
 from .analyzer import ProgramSpec, SiteContract
+from .sharding_flow import ShardingContract
 
 __all__ = ["fixture_specs", "REQUIRED_FIXTURE_RULES"]
 
-#: the five seeded violations the acceptance criteria name
+#: the seeded violations the acceptance criteria name: PR 9's five plus
+#: the tier-2 sharding-flow rules. The spmd fixtures declare their mesh
+#: axes on the CONTRACT (axis_sizes) — the flow is pure python, so the
+#: fixtures still run single-device on any host.
 REQUIRED_FIXTURE_RULES = (
     "recompile-weak-type",
     "donation-missing",
     "collective-ppermute-perm",
     "collective-branch-mismatch",
     "dtype-f64",
+    "spmd-silent-replication",
+    "spmd-reshard-in-loop",
+    "spmd-contract-mismatch",
 )
 
 
@@ -135,6 +144,69 @@ def _f64_leak() -> Tuple[ProgramSpec, str]:
     return spec, "dtype-f64"
 
 
+def _silent_replication() -> Tuple[ProgramSpec, str]:
+    """A 2 MiB dp-sharded activation hits a replicating sharding
+    constraint: GSPMD must all-gather the whole tensor onto every
+    device — the silent-HBM classic."""
+    mesh = _one_device_mesh()
+
+    def fn(x):
+        y = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+        return y + jnp.float32(1.0)
+
+    spec = ProgramSpec(
+        "fixture_silent_replication", fn,
+        (jnp.ones((1024, 512), jnp.float32),),  # 2 MiB > 1 MiB threshold
+        argnames=("x",),
+        sharding=ShardingContract(in_shardings=(P("dp"),),
+                                  axis_sizes={"dp": 8}))
+    return spec, "spmd-silent-replication"
+
+
+def _reshard_in_loop() -> Tuple[ProgramSpec, str]:
+    """A scan whose body re-constrains the carry onto a different dim:
+    the carry sharding never reaches a fixpoint, so the partitioner
+    reshards it on every iteration."""
+    mesh = _one_device_mesh()
+    flip = NamedSharding(mesh, P(None, "dp"))
+
+    def fn(x):
+        def body(c, _):
+            c = jax.lax.with_sharding_constraint(c, flip)
+            return c * jnp.float32(1.5), ()
+
+        out, _ = lax.scan(body, x, None, length=3)
+        return out
+
+    spec = ProgramSpec(
+        "fixture_reshard_in_loop", fn, (jnp.ones((8, 8), jnp.float32),),
+        argnames=("x",),
+        sharding=ShardingContract(in_shardings=(P("dp"),),
+                                  axis_sizes={"dp": 8}))
+    return spec, "spmd-reshard-in-loop"
+
+
+def _contract_mismatch() -> Tuple[ProgramSpec, str]:
+    """A site that declares a dp-sharded output but computes a replicated
+    one: GSPMD must insert a final reshard the site never accounted for
+    (the tensor stays under the replication threshold so ONLY the
+    contract rule fires)."""
+    mesh = _one_device_mesh()
+
+    def fn(x):
+        y = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+        return y * jnp.float32(2.0)
+
+    spec = ProgramSpec(
+        "fixture_contract_mismatch", fn,
+        (jnp.ones((16, 4), jnp.float32),),
+        argnames=("x",),
+        sharding=ShardingContract(in_shardings=(P("dp"),),
+                                  out_shardings=P("dp"),
+                                  axis_sizes={"dp": 8}))
+    return spec, "spmd-contract-mismatch"
+
+
 def fixture_specs() -> List[Tuple[ProgramSpec, str]]:
     """[(spec, expected_rule_id)] — every seeded violation, deterministic
     order."""
@@ -145,4 +217,7 @@ def fixture_specs() -> List[Tuple[ProgramSpec, str]]:
         _bad_ppermute(),
         _branch_mismatch(),
         _f64_leak(),
+        _silent_replication(),
+        _reshard_in_loop(),
+        _contract_mismatch(),
     ]

@@ -476,4 +476,23 @@ RULE_CATALOG = {
     "collective-wire-mismatch": ("warning", WireMismatchRule.description),
     "dtype-f64": ("warning", DtypeF64Rule.description),
     "dtype-f32-wire": ("info", F32WireRule.description),
+    # tier 2 — sharding flow (sharding_flow.py; judged against declared
+    # ShardingContracts, not eqn-walk Rule classes)
+    "spmd-silent-replication": (
+        "warning", "tensor over the size threshold becomes fully "
+                   "replicated under GSPMD propagation"),
+    "spmd-reshard-in-loop": (
+        "warning", "predicted GSPMD reshard/gather inside a scan/while "
+                   "body — paid every iteration"),
+    "spmd-contract-mismatch": (
+        "error", "propagated output sharding disagrees with the site's "
+                 "declared ShardingContract"),
+    # tier 2 — ambient (recorded at configuration time, findings.py)
+    "comm-quant-downgrade": (
+        "warning", "quantized grad-reduce silently downgraded to fp32 "
+                   "psum on a hybrid mesh"),
+    # tier 2 — hlo audit reconcile (hlo_audit.py; advisory)
+    "spmd-predict-divergence": (
+        "info", "partitioned HLO carries collective traffic the static "
+                "tiers never predicted"),
 }

@@ -1,0 +1,800 @@
+"""Tier-2a: pure-python forward propagation of NamedSharding through a jaxpr.
+
+GSPMD's sharding propagation decides, per op, whether an operand keeps its
+sharding, gets resharded, or silently becomes fully replicated — and every
+one of those decisions inserts collectives and HBM the traced program never
+showed. This module re-runs a conservative model of that propagation in
+python (no compile, no devices): each value carries a per-dimension tuple
+of mesh axis names, handlers for dot/reshape/transpose/reduce/elementwise/
+scatter move specs forward, and anything the model does not understand
+degrades to *unknown* — unknown never produces an event, so every event the
+flow emits is backed by an explicit rule.
+
+Events feed three gating rules:
+
+- ``spmd-silent-replication``  a tensor over the contract's size threshold
+  loses all sharding (a replicating constraint, a sharding-destroying
+  reshape) — the partitioner will materialize the full array per device;
+- ``spmd-reshard-in-loop``     a predicted reshard/replication inside a
+  ``scan``/``while`` body, including a loop carry whose sharding does not
+  reach a fixpoint — paid every iteration, the classic silent MFU sink;
+- ``spmd-contract-mismatch``   the propagated output sharding disagrees
+  with the site's declared :class:`ShardingContract` (ShardedTrainStep,
+  GradReducer, serving prefill/decode, the resharding executor).
+
+Fully-manual shard_map regions are NOT entered: GSPMD does not act inside
+them, and the tier-1 collective rules already audit that code; the flow
+takes the region's declared ``out_names`` at face value.
+
+``hlo_audit`` reconciles the flow's predicted collective families against
+the post-partitioning HLO text (see hlo_audit.py / analysis/README.md).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+try:  # jax >= 0.4.35
+    from jax.extend.core import ClosedJaxpr, Jaxpr, Literal
+except ImportError:  # pragma: no cover - older jax
+    from jax.core import ClosedJaxpr, Jaxpr, Literal
+
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from .findings import Finding
+
+__all__ = ["ShardingContract", "FlowEvent", "FlowResult", "ShardSpec",
+           "propagate_jaxpr", "flow_findings", "spec_of", "flat_arg_specs",
+           "TIER2_RULE_IDS", "REPLICATED"]
+
+#: rule ids this tier contributes to the public catalog (rules.RULE_CATALOG)
+TIER2_RULE_IDS = ("spmd-silent-replication", "spmd-reshard-in-loop",
+                  "spmd-contract-mismatch")
+
+# A ShardSpec is one value's sharding: a tuple with one entry per array
+# dimension, each entry the tuple of mesh axis names that dimension is
+# split over (empty = replicated along that dim). ``None`` — not a tuple —
+# means the flow lost track (conservative unknown: no events downstream).
+ShardSpec = Optional[Tuple[Tuple[str, ...], ...]]
+
+#: canonical fully-replicated spec for an ndim-dimensional value
+def REPLICATED(ndim: int) -> Tuple[Tuple[str, ...], ...]:
+    return ((),) * ndim
+
+
+@dataclass(frozen=True, eq=False)
+class ShardingContract:
+    """What a site promises GSPMD: the shardings its jit is built with.
+
+    ``in_shardings``/``out_shardings`` hold exactly what the site passes to
+    ``jax.jit`` — per-argument entries that may be a NamedSharding, a bare
+    PartitionSpec, ``None`` (no constraint declared), or a pytree of those
+    matching the argument's structure. ``mesh`` present means the contract
+    is *compilable*: hlo_audit lowers the program with these shardings to
+    see the partitioned truth. Flow-only contracts (fixtures, single-host
+    declarations) may instead carry explicit ``axis_sizes``.
+    """
+
+    in_shardings: Tuple[Any, ...]
+    out_shardings: Any = None
+    mesh: Any = None                       # jax.sharding.Mesh | None
+    axis_sizes: Optional[Mapping[str, int]] = None
+    replication_threshold: int = 1 << 20   # bytes; spmd-silent-replication
+
+    def sizes(self) -> Dict[str, int]:
+        if self.mesh is not None:
+            return {str(a): int(s) for a, s in
+                    zip(self.mesh.axis_names, self.mesh.devices.shape)}
+        return dict(self.axis_sizes or {})
+
+    def _to_named(self, tree):
+        """Bare PartitionSpec leaves -> NamedShardings on the contract's
+        mesh (jit only takes bare specs under a mesh context)."""
+        mesh = self.mesh
+
+        def conv(x):
+            return NamedSharding(mesh, x) if isinstance(x, P) else x
+
+        return jax.tree_util.tree_map(conv, tree,
+                                      is_leaf=_is_leaf_sharding)
+
+    def jit_kwargs(self) -> Dict[str, Any]:
+        """kwargs for a faithful ``jax.jit`` of the site (hlo_audit)."""
+        if self.mesh is None:
+            return {}
+        kw: Dict[str, Any] = {
+            "in_shardings": self._to_named(self.in_shardings)}
+        if self.out_shardings is not None:
+            kw["out_shardings"] = self._to_named(self.out_shardings)
+        return kw
+
+
+@dataclass(frozen=True)
+class FlowEvent:
+    """One predicted GSPMD intervention."""
+
+    kind: str                 # replicate | reshard | all-reduce | all-gather
+    prim: str                 # the primitive that forces it
+    path: str                 # location inside the jaxpr
+    nbytes: int               # GLOBAL bytes of the affected tensor
+    dtype: str
+    shape: Tuple[int, ...]
+    in_loop: bool             # inside a scan/while body
+    detail: str = ""
+
+    def render(self) -> str:
+        loop = " [in loop]" if self.in_loop else ""
+        return (f"{self.kind}{loop} {self.dtype}{list(self.shape)} "
+                f"({self.nbytes} B) at {self.path}: {self.detail}")
+
+
+@dataclass
+class FlowResult:
+    events: List[FlowEvent] = field(default_factory=list)
+    out_specs: List[ShardSpec] = field(default_factory=list)
+
+    def predicted_kinds(self) -> Dict[str, int]:
+        """kind -> total global bytes, for hlo_audit reconciliation."""
+        out: Dict[str, int] = {}
+        for e in self.events:
+            out[e.kind] = out.get(e.kind, 0) + e.nbytes
+        return out
+
+
+# ---------------------------------------------------------------- spec utils
+
+def _pspec_tuple(pspec, ndim: int) -> ShardSpec:
+    """PartitionSpec -> ShardSpec, padded to ndim (None if impossible)."""
+    entries: List[Tuple[str, ...]] = []
+    for e in tuple(pspec):
+        if e is None:
+            entries.append(())
+        elif e is P.UNCONSTRAINED:
+            entries.append(())  # GSPMD chooses; model as replicated
+        elif isinstance(e, (tuple, list)):
+            entries.append(tuple(str(a) for a in e))
+        else:
+            entries.append((str(e),))
+    if len(entries) > ndim:
+        return None
+    entries.extend([()] * (ndim - len(entries)))
+    return tuple(entries)
+
+
+def spec_of(sharding, ndim: int) -> ShardSpec:
+    """NamedSharding | PartitionSpec | None -> ShardSpec (None = unknown)."""
+    if sharding is None:
+        return None
+    if isinstance(sharding, NamedSharding):
+        return _pspec_tuple(sharding.spec, ndim)
+    if isinstance(sharding, P):
+        return _pspec_tuple(sharding, ndim)
+    return None
+
+
+def _spec_str(spec: ShardSpec) -> str:
+    if spec is None:
+        return "?"
+    return "P(" + ",".join("+".join(e) if e else "_" for e in spec) + ")"
+
+
+def _is_sharded(spec: ShardSpec) -> bool:
+    return spec is not None and any(spec)
+
+
+def _is_leaf_sharding(x) -> bool:
+    return x is None or isinstance(x, (NamedSharding, P))
+
+
+def flat_arg_specs(args: Sequence[Any],
+                  in_shardings: Sequence[Any]) -> List[ShardSpec]:
+    """Per-leaf ShardSpecs aligned with make_jaxpr's flattened invars.
+
+    Mirrors analyzer._flat_donation's flattening (positional args, each
+    tree-flattened in order). A bare sharding entry broadcasts over every
+    leaf of its argument; a pytree entry is mapped leaf-for-leaf.
+    """
+    out: List[ShardSpec] = []
+    for ai, arg in enumerate(args):
+        entry = in_shardings[ai] if ai < len(in_shardings) else None
+        leaves = jax.tree_util.tree_leaves(arg)
+        if _is_leaf_sharding(entry):
+            for leaf in leaves:
+                out.append(spec_of(entry, np.ndim(leaf)))
+        else:
+            try:
+                entry_leaves = jax.tree_util.tree_leaves(
+                    entry, is_leaf=_is_leaf_sharding)
+            except Exception:
+                entry_leaves = []
+            if len(entry_leaves) == len(leaves):
+                for s, leaf in zip(entry_leaves, leaves):
+                    out.append(spec_of(s, np.ndim(leaf)))
+            else:  # structure mismatch: stay conservative
+                out.extend([None] * len(leaves))
+    return out
+
+
+def flat_out_specs(out_shape, out_shardings) -> List[ShardSpec]:
+    """Declared out_shardings -> per-flat-output ShardSpecs, aligned with
+    the jaxpr's outvars via the traced output shape pytree."""
+    leaves = jax.tree_util.tree_leaves(out_shape)
+    if _is_leaf_sharding(out_shardings):
+        return [spec_of(out_shardings, len(getattr(leaf, "shape", ())))
+                for leaf in leaves]
+    # out_shardings is a pytree whose top structure matches the output's:
+    # pair each output leaf with its sharding by broadcasting tree prefixes
+    try:
+        specs = _broadcast_prefix(out_shardings, out_shape)
+    except Exception:
+        return [None] * len(leaves)
+    return [spec_of(s, len(getattr(leaf, "shape", ())))
+            for s, leaf in zip(specs, leaves)]
+
+
+def _broadcast_prefix(prefix_tree, full_tree) -> List[Any]:
+    """Flatten ``prefix_tree`` against ``full_tree``: every leaf of the
+    prefix (a sharding) is repeated over the subtree of ``full_tree`` it
+    covers — the same broadcasting jit applies to in/out_shardings."""
+    out: List[Any] = []
+
+    def down(p, t):
+        if _is_leaf_sharding(p):
+            out.extend([p] * len(jax.tree_util.tree_leaves(t)))
+            return
+        pk, ptree = jax.tree_util.tree_flatten(
+            p, is_leaf=_is_leaf_sharding)
+        tchildren = ptree.flatten_up_to(t)
+        for pc, tc in zip(pk, tchildren):
+            down(pc, tc)
+
+    down(prefix_tree, full_tree)
+    return out
+
+
+def _aval_bytes(aval) -> int:
+    shape = tuple(int(d) for d in getattr(aval, "shape", ()))
+    dtype = getattr(aval, "dtype", None)
+    itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+    return int(np.prod(shape, dtype=np.int64)) * itemsize if shape \
+        else itemsize
+
+
+def _aval_dtype(aval) -> str:
+    return str(np.dtype(getattr(aval, "dtype", np.float32)).name)
+
+
+# ------------------------------------------------------------- propagation
+
+_REDUCE_PRIMS = ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+                 "reduce_and", "reduce_or", "reduce_xor", "argmax", "argmin")
+
+_CALL_PRIMS = ("pjit", "closed_call", "core_call", "remat2", "checkpoint",
+               "custom_jvp_call", "custom_vjp_call", "custom_vjp_call_jaxpr",
+               "custom_jvp_call_jaxpr")
+
+
+class _Flow:
+    """One propagation pass over one (possibly nested) jaxpr."""
+
+    def __init__(self, axis_sizes: Mapping[str, int]):
+        self.axis_sizes = dict(axis_sizes)
+        self.events: List[FlowEvent] = []
+
+    # -- event emission -----------------------------------------------------
+    def _event(self, kind, eqn, path, in_loop, aval, detail):
+        self.events.append(FlowEvent(
+            kind=kind, prim=eqn.primitive.name, path=path,
+            nbytes=_aval_bytes(aval), dtype=_aval_dtype(aval),
+            shape=tuple(int(d) for d in getattr(aval, "shape", ())),
+            in_loop=in_loop, detail=detail))
+
+    # -- env helpers --------------------------------------------------------
+    @staticmethod
+    def _read(env, var) -> ShardSpec:
+        if isinstance(var, Literal):
+            return REPLICATED(np.ndim(var.val))
+        return env.get(var, None)
+
+    @staticmethod
+    def _write(env, var, spec: ShardSpec):
+        env[var] = spec
+
+    def _merge(self, specs: List[ShardSpec], ndim: int
+               ) -> Tuple[ShardSpec, List[int]]:
+        """Dimwise merge for same-shape operands. Returns (merged spec,
+        dims where two different non-empty shardings met). Any unknown
+        operand makes the result unknown (conservative, no events)."""
+        known = [s for s in specs if s is not None]
+        if len(known) != len(specs) or not known:
+            return None, []
+        merged: List[Tuple[str, ...]] = []
+        conflicts: List[int] = []
+        for d in range(ndim):
+            axes = {s[d] for s in known if d < len(s) and s[d]}
+            if not axes:
+                merged.append(())
+            elif len(axes) == 1:
+                merged.append(next(iter(axes)))
+            else:
+                merged.append(sorted(axes)[0])
+                conflicts.append(d)
+        return tuple(merged), conflicts
+
+    # -- the walk -----------------------------------------------------------
+    def run(self, jaxpr: Jaxpr, in_specs: Sequence[ShardSpec],
+            path: str, in_loop: bool) -> List[ShardSpec]:
+        env: Dict[Any, ShardSpec] = {}
+        for var, spec in zip(jaxpr.invars, in_specs):
+            self._write(env, var, spec)
+        for var in jaxpr.constvars:
+            # closed-over constants are materialized replicated
+            self._write(env, var, REPLICATED(
+                len(getattr(getattr(var, "aval", None), "shape", ()))))
+        for i, eqn in enumerate(jaxpr.eqns):
+            epath = f"{path}/{i}:{eqn.primitive.name}"
+            self._eqn(env, eqn, epath, in_loop)
+        return [self._read(env, v) for v in jaxpr.outvars]
+
+    def _eqn(self, env, eqn, path: str, in_loop: bool):
+        prim = eqn.primitive.name
+        handler = getattr(self, "_h_" + prim, None)
+        if prim in _REDUCE_PRIMS:
+            handler = self._h_reduce
+        elif prim in _CALL_PRIMS:
+            handler = self._h_call
+        if handler is not None:
+            try:
+                handler(env, eqn, path, in_loop)
+                return
+            except Exception:
+                pass  # fall through to the conservative default
+        self._h_default(env, eqn, path, in_loop)
+
+    # -- handlers -----------------------------------------------------------
+    def _h_default(self, env, eqn, path, in_loop):
+        """Elementwise fallback: every operand shaped like the output feeds
+        its spec into a dimwise merge; anything else -> unknown."""
+        for out in eqn.outvars:
+            oshape = tuple(getattr(getattr(out, "aval", None), "shape", ()))
+            specs = []
+            ok = True
+            for var in eqn.invars:
+                ishape = tuple(getattr(getattr(var, "aval", None),
+                                       "shape", ()))
+                if ishape == oshape:
+                    specs.append(self._read(env, var))
+                elif ishape == ():  # scalar broadcast never constrains
+                    continue
+                else:
+                    ok = False
+                    break
+            if not ok or not specs:
+                self._write(env, out, None if oshape else REPLICATED(0))
+                continue
+            merged, conflicts = self._merge(specs, len(oshape))
+            if conflicts and merged is not None:
+                self._event("reshard", eqn, path, in_loop, out.aval,
+                            f"operand shardings disagree on dims "
+                            f"{conflicts}; one side must be resharded")
+            self._write(env, out, merged)
+
+    def _h_sharding_constraint(self, env, eqn, path, in_loop):
+        (var,), (out,) = eqn.invars, eqn.outvars
+        ndim = len(getattr(var.aval, "shape", ()))
+        in_spec = self._read(env, var)
+        target = spec_of(eqn.params.get("sharding"), ndim)
+        if target is None:
+            self._write(env, out, in_spec)
+            return
+        if _is_sharded(in_spec) and in_spec != target:
+            if not any(target):
+                self._event("replicate", eqn, path, in_loop, var.aval,
+                            f"constraint replicates a {_spec_str(in_spec)} "
+                            "tensor (full all-gather per device)")
+            else:
+                self._event("reshard", eqn, path, in_loop, var.aval,
+                            f"constraint moves {_spec_str(in_spec)} -> "
+                            f"{_spec_str(target)}")
+        self._write(env, out, target)
+
+    def _h_dot_general(self, env, eqn, path, in_loop):
+        (lhs, rhs), (out,) = eqn.invars, eqn.outvars
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        ls, rs = self._read(env, lhs), self._read(env, rhs)
+        if ls is None or rs is None:
+            self._write(env, out, None)
+            return
+        # contracted dims: sharded on both sides -> partial sums, GSPMD
+        # must all-reduce the product; sharded on one side only -> the
+        # other operand (or this one) gets gathered to align
+        contracted_sharded = False
+        for li, ri in zip(lc, rc):
+            a, b = ls[li], rs[ri]
+            if a and b and a == b:
+                contracted_sharded = True
+            elif a and not b:
+                self._event("all-gather", eqn, path, in_loop, lhs.aval,
+                            f"lhs contracting dim {li} sharded over "
+                            f"{a}, rhs replicated: one side is gathered")
+            elif b and not a:
+                self._event("all-gather", eqn, path, in_loop, rhs.aval,
+                            f"rhs contracting dim {ri} sharded over "
+                            f"{b}, lhs replicated: one side is gathered")
+            elif a and b and a != b:
+                self._event("reshard", eqn, path, in_loop, rhs.aval,
+                            f"contracting dims sharded over different "
+                            f"axes ({a} vs {b})")
+                contracted_sharded = True
+        if contracted_sharded:
+            self._event("all-reduce", eqn, path, in_loop, out.aval,
+                        "contraction over a sharded dimension leaves "
+                        "partial sums; GSPMD all-reduces the result")
+        # output spec: batch dims, then lhs free, then rhs free
+        used: set = set()
+        ospec: List[Tuple[str, ...]] = []
+
+        def take(axes: Tuple[str, ...]) -> Tuple[str, ...]:
+            if axes and not (set(axes) & used):
+                used.update(axes)
+                return axes
+            return ()
+
+        for li in lb:
+            ospec.append(take(ls[li]))
+        lfree = [d for d in range(len(ls)) if d not in lc and d not in lb]
+        rfree = [d for d in range(len(rs)) if d not in rc and d not in rb]
+        for d in lfree:
+            ospec.append(take(ls[d]))
+        for d in rfree:
+            ospec.append(take(rs[d]))
+        self._write(env, out, tuple(ospec))
+
+    def _h_reduce(self, env, eqn, path, in_loop):
+        (var,), (out,) = eqn.invars[:1], eqn.outvars
+        axes = tuple(eqn.params.get("axes", ()))
+        spec = self._read(env, var)
+        if spec is None:
+            self._write(env, out, None)
+            return
+        if any(spec[d] for d in axes if d < len(spec)):
+            self._event("all-reduce", eqn, path, in_loop, out.aval,
+                        "reduction over a sharded dimension")
+        self._write(env, out, tuple(s for d, s in enumerate(spec)
+                                    if d not in axes))
+
+    def _h_broadcast_in_dim(self, env, eqn, path, in_loop):
+        (var,), (out,) = eqn.invars, eqn.outvars
+        spec = self._read(env, var)
+        bdims = tuple(eqn.params["broadcast_dimensions"])
+        oshape = tuple(eqn.params["shape"])
+        ishape = tuple(getattr(var.aval, "shape", ()))
+        if spec is None:
+            self._write(env, out, None)
+            return
+        ospec = [()] * len(oshape)
+        for i, d in enumerate(bdims):
+            if ishape[i] == oshape[d]:
+                ospec[d] = spec[i]
+        self._write(env, out, tuple(ospec))
+
+    def _h_transpose(self, env, eqn, path, in_loop):
+        (var,), (out,) = eqn.invars, eqn.outvars
+        spec = self._read(env, var)
+        if spec is None:
+            self._write(env, out, None)
+            return
+        perm = tuple(eqn.params["permutation"])
+        self._write(env, out, tuple(spec[p] for p in perm))
+
+    def _h_reshape(self, env, eqn, path, in_loop):
+        (var,), (out,) = eqn.invars[:1], eqn.outvars
+        spec = self._read(env, var)
+        ishape = tuple(int(d) for d in getattr(var.aval, "shape", ()))
+        oshape = tuple(int(d) for d in getattr(out.aval, "shape", ()))
+        if spec is None:
+            self._write(env, out, None)
+            return
+        ospec, lost = _reshape_spec(ishape, oshape, spec)
+        if lost:
+            self._event("replicate", eqn, path, in_loop, var.aval,
+                        f"reshape {list(ishape)}->{list(oshape)} cannot "
+                        f"preserve sharding over {lost}; GSPMD gathers")
+        self._write(env, out, ospec)
+
+    def _h_squeeze(self, env, eqn, path, in_loop):
+        (var,), (out,) = eqn.invars, eqn.outvars
+        spec = self._read(env, var)
+        if spec is None:
+            self._write(env, out, None)
+            return
+        drop = set(eqn.params["dimensions"])
+        self._write(env, out, tuple(s for d, s in enumerate(spec)
+                                    if d not in drop))
+
+    def _h_expand_dims(self, env, eqn, path, in_loop):
+        (var,), (out,) = eqn.invars, eqn.outvars
+        spec = self._read(env, var)
+        if spec is None:
+            self._write(env, out, None)
+            return
+        ndim_out = len(getattr(out.aval, "shape", ()))
+        new = set(eqn.params["dimensions"])
+        it = iter(spec)
+        self._write(env, out, tuple(
+            () if d in new else next(it) for d in range(ndim_out)))
+
+    def _h_concatenate(self, env, eqn, path, in_loop):
+        (out,) = eqn.outvars
+        dim = int(eqn.params["dimension"])
+        ndim = len(getattr(out.aval, "shape", ()))
+        specs = [self._read(env, v) for v in eqn.invars]
+        if any(s is None for s in specs):
+            self._write(env, out, None)
+            return
+        ospec = []
+        for d in range(ndim):
+            axes = {s[d] for s in specs if s[d]}
+            ospec.append(next(iter(axes)) if len(axes) == 1 and d != dim
+                         else ())
+        self._write(env, out, tuple(ospec))
+
+    def _h_slice(self, env, eqn, path, in_loop):
+        self._shape_preserving_dims(env, eqn)
+
+    def _h_dynamic_slice(self, env, eqn, path, in_loop):
+        self._shape_preserving_dims(env, eqn)
+
+    def _h_pad(self, env, eqn, path, in_loop):
+        self._shape_preserving_dims(env, eqn)
+
+    def _shape_preserving_dims(self, env, eqn):
+        """Keep the spec on dims whose size survives, drop it elsewhere."""
+        var, out = eqn.invars[0], eqn.outvars[0]
+        spec = self._read(env, var)
+        if spec is None:
+            self._write(env, out, None)
+            return
+        ishape = tuple(getattr(var.aval, "shape", ()))
+        oshape = tuple(getattr(out.aval, "shape", ()))
+        if len(ishape) != len(oshape):
+            self._write(env, out, None)
+            return
+        self._write(env, out, tuple(
+            spec[d] if ishape[d] == oshape[d] else ()
+            for d in range(len(oshape))))
+
+    def _h_dynamic_update_slice(self, env, eqn, path, in_loop):
+        out = eqn.outvars[0]
+        self._write(env, out, self._read(env, eqn.invars[0]))
+
+    def _h_scatter(self, env, eqn, path, in_loop):
+        self._write(env, eqn.outvars[0], self._read(env, eqn.invars[0]))
+
+    _h_scatter_add = _h_scatter
+    _h_scatter_mul = _h_scatter
+    _h_scatter_min = _h_scatter
+    _h_scatter_max = _h_scatter
+
+    def _h_gather(self, env, eqn, path, in_loop):
+        # output indexing is data-dependent; conservative unknown
+        self._write(env, eqn.outvars[0], None)
+
+    def _h_iota(self, env, eqn, path, in_loop):
+        out = eqn.outvars[0]
+        self._write(env, out, REPLICATED(len(getattr(out.aval, "shape",
+                                                     ()))))
+
+    def _h_rev(self, env, eqn, path, in_loop):
+        self._write(env, eqn.outvars[0], self._read(env, eqn.invars[0]))
+
+    def _h_call(self, env, eqn, path, in_loop):
+        sub = None
+        for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+            cand = eqn.params.get(key)
+            if isinstance(cand, (Jaxpr, ClosedJaxpr)):
+                sub = cand
+                break
+        if sub is None:
+            self._h_default(env, eqn, path, in_loop)
+            return
+        inner = sub.jaxpr if isinstance(sub, ClosedJaxpr) else sub
+        if len(inner.invars) != len(eqn.invars):
+            self._h_default(env, eqn, path, in_loop)
+            return
+        in_specs = [self._read(env, v) for v in eqn.invars]
+        outs = self.run(inner, in_specs, path, in_loop)
+        for var, spec in zip(eqn.outvars, outs):
+            self._write(env, var, spec)
+
+    def _h_scan(self, env, eqn, path, in_loop):
+        closed = eqn.params["jaxpr"]
+        inner = closed.jaxpr if isinstance(closed, ClosedJaxpr) else closed
+        nc = int(eqn.params["num_consts"])
+        ncar = int(eqn.params["num_carry"])
+        in_specs = [self._read(env, v) for v in eqn.invars]
+        body_in = list(in_specs[:nc + ncar])
+        for spec in in_specs[nc + ncar:]:  # xs lose the leading scan dim
+            body_in.append(None if spec is None else tuple(spec[1:]))
+        outs = self.run(inner, body_in, path, True)
+        # carry fixpoint: a carry whose sharding changes across the body
+        # is resharded EVERY iteration
+        for ci in range(ncar):
+            cin, cout = in_specs[nc + ci], outs[ci]
+            if cin is not None and cout is not None and cin != cout:
+                self._event("reshard", eqn, path, True,
+                            eqn.invars[nc + ci].aval,
+                            f"scan carry {ci} sharding does not reach a "
+                            f"fixpoint ({_spec_str(cin)} -> "
+                            f"{_spec_str(cout)}); resharded per iteration")
+        carry_out = outs[:ncar]
+        ys = [None if s is None else ((),) + tuple(s)
+              for s in outs[ncar:]]
+        for var, spec in zip(eqn.outvars, list(carry_out) + ys):
+            self._write(env, var, spec)
+
+    def _h_while(self, env, eqn, path, in_loop):
+        body = eqn.params["body_jaxpr"]
+        inner = body.jaxpr if isinstance(body, ClosedJaxpr) else body
+        cn = int(eqn.params["cond_nconsts"])
+        bn = int(eqn.params["body_nconsts"])
+        in_specs = [self._read(env, v) for v in eqn.invars]
+        carry_in = in_specs[cn + bn:]
+        body_in = in_specs[cn:cn + bn] + carry_in
+        outs = self.run(inner, body_in, path, True)
+        for ci, (cin, cout) in enumerate(zip(carry_in, outs)):
+            if cin is not None and cout is not None and cin != cout:
+                self._event("reshard", eqn, path, True,
+                            eqn.invars[cn + bn + ci].aval,
+                            f"while carry {ci} sharding does not reach a "
+                            f"fixpoint ({_spec_str(cin)} -> "
+                            f"{_spec_str(cout)}); resharded per iteration")
+        for var, spec in zip(eqn.outvars, outs):
+            self._write(env, var, spec)
+
+    def _h_cond(self, env, eqn, path, in_loop):
+        branches = eqn.params["branches"]
+        op_specs = [self._read(env, v) for v in eqn.invars[1:]]
+        branch_outs = []
+        for bi, br in enumerate(branches):
+            inner = br.jaxpr if isinstance(br, ClosedJaxpr) else br
+            branch_outs.append(self.run(inner, op_specs,
+                                        f"{path}.branch[{bi}]", in_loop))
+        for oi, var in enumerate(eqn.outvars):
+            specs = {bo[oi] for bo in branch_outs}
+            self._write(env, var,
+                        next(iter(specs)) if len(specs) == 1 else None)
+
+    def _h_shard_map(self, env, eqn, path, in_loop):
+        """Manual region: GSPMD does not act inside; trust the declared
+        out_names (tier-1 rules audit the body's collectives)."""
+        out_names = eqn.params.get("out_names", ())
+        for var, names in zip(eqn.outvars, out_names):
+            ndim = len(getattr(getattr(var, "aval", None), "shape", ()))
+            spec = [()] * ndim
+            try:
+                for d, axes in dict(names).items():
+                    if int(d) < ndim:
+                        spec[int(d)] = tuple(str(a) for a in axes)
+                self._write(env, var, tuple(spec))
+            except Exception:
+                self._write(env, var, None)
+
+
+def _reshape_spec(ishape: Tuple[int, ...], oshape: Tuple[int, ...],
+                  spec: Tuple[Tuple[str, ...], ...]
+                  ) -> Tuple[ShardSpec, List[str]]:
+    """Map a spec through a reshape by factoring both shapes into blocks
+    of equal product. Sharding survives when its dim leads its block and
+    the matching output dim is divisible by it; otherwise it is lost."""
+    iblocks, oblocks = _factor_blocks(ishape, oshape)
+    if iblocks is None:
+        lost = sorted({a for e in spec for a in e})
+        return ((),) * len(oshape), lost
+    ospec: List[Tuple[str, ...]] = [()] * len(oshape)
+    lost: List[str] = []
+    for ib, ob in zip(iblocks, oblocks):
+        for k, d in enumerate(ib):
+            if not spec[d]:
+                continue
+            if k == 0 and ob:
+                ospec[ob[0]] = spec[d]
+            else:
+                lost.extend(spec[d])
+    return tuple(ospec), sorted(set(lost))
+
+
+def _factor_blocks(ishape, oshape):
+    """Greedy factorization of two shapes into aligned equal-product
+    blocks; (None, None) when the products cannot be aligned."""
+    iblocks, oblocks = [], []
+    i = j = 0
+    while i < len(ishape) or j < len(oshape):
+        ib, ob = [], []
+        pi = pj = 1
+        while True:
+            if pi == pj and (ib or ob):
+                break
+            if pi <= pj and i < len(ishape):
+                pi *= max(int(ishape[i]), 1)
+                ib.append(i)
+                i += 1
+            elif j < len(oshape):
+                pj *= max(int(oshape[j]), 1)
+                ob.append(j)
+                j += 1
+            else:
+                return None, None
+        if pi != pj:
+            return None, None
+        iblocks.append(ib)
+        oblocks.append(ob)
+    return iblocks, oblocks
+
+
+def propagate_jaxpr(closed: ClosedJaxpr, in_specs: Sequence[ShardSpec],
+                    axis_sizes: Mapping[str, int],
+                    path: str = "") -> FlowResult:
+    """Run the flow over one closed jaxpr. ``in_specs`` aligns with the
+    jaxpr's (flattened) invars; unknown entries may be None."""
+    flow = _Flow(axis_sizes)
+    specs = list(in_specs)
+    specs.extend([None] * (len(closed.jaxpr.invars) - len(specs)))
+    outs = flow.run(closed.jaxpr, specs, path, in_loop=False)
+    return FlowResult(events=flow.events, out_specs=outs)
+
+
+# ------------------------------------------------------------------- rules
+
+def flow_findings(site: str, closed: ClosedJaxpr,
+                  contract: ShardingContract,
+                  args: Sequence[Any],
+                  out_shape: Any = None) -> Tuple[FlowResult, List[Finding]]:
+    """Propagate and judge: the three tier-2 gating rules."""
+    in_specs = flat_arg_specs(args, contract.in_shardings)
+    result = propagate_jaxpr(closed, in_specs, contract.sizes(), path=site)
+    findings: List[Finding] = []
+    threshold = int(contract.replication_threshold)
+
+    for e in result.events:
+        if e.kind == "replicate" and e.nbytes >= threshold:
+            findings.append(Finding(
+                rule="spmd-silent-replication", site=site,
+                severity="warning", path=e.path,
+                message=(f"{e.prim} fully replicates "
+                         f"{e.dtype}{list(e.shape)} ({e.nbytes} B >= "
+                         f"threshold {threshold}): {e.detail}"),
+                data=(e.prim, e.dtype, "x".join(map(str, e.shape)))))
+        if e.in_loop and e.kind in ("reshard", "replicate", "all-gather"):
+            findings.append(Finding(
+                rule="spmd-reshard-in-loop", site=site,
+                severity="warning", path=e.path,
+                message=(f"predicted {e.kind} of {e.dtype}{list(e.shape)} "
+                         f"inside a loop body ({e.prim}): {e.detail}"),
+                data=(e.prim, e.kind, e.dtype,
+                      "x".join(map(str, e.shape)))))
+
+    if contract.out_shardings is not None and out_shape is not None:
+        declared = flat_out_specs(out_shape, contract.out_shardings)
+        got = result.out_specs
+        for oi, (d, g) in enumerate(zip(declared, got)):
+            if d is None or g is None:
+                continue  # undeclared or unknown: nothing to judge
+            if d != g and (any(d) or any(g)):
+                aval = getattr(closed.jaxpr.outvars[oi], "aval", None)
+                nbytes = _aval_bytes(aval) if aval is not None else 0
+                findings.append(Finding(
+                    rule="spmd-contract-mismatch", site=site,
+                    severity="error", path=f"outvars[{oi}]",
+                    message=(f"output {oi} propagates to {_spec_str(g)} "
+                             f"but the site's ShardingContract declares "
+                             f"{_spec_str(d)} ({nbytes} B): GSPMD must "
+                             "insert a final reshard the site never "
+                             "accounted for"),
+                    data=("out", str(oi), _spec_str(d), _spec_str(g))))
+    return result, findings

@@ -117,6 +117,22 @@ class GradReducer:
         axes stay auto so GSPMD keeps partitioning the fwd/bwd)."""
         return self.data_axes if self.hybrid else tuple(self.mesh.axis_names)
 
+    def sharding_contract(self, gstack_keys, ef_keys=()):
+        """Tier-2 analysis declaration for ``make_tree_reducer``'s
+        (gstack, ef) -> (reduced, new_ef) program: stacked grads and
+        residuals row-sharded over the data axes in, reduced tree
+        replicated out — exactly the shard_map's in/out specs, so a spec
+        drift there trips spmd-contract-mismatch."""
+        from ...analysis.sharding_flow import ShardingContract
+
+        dax = self.data_axes
+        return ShardingContract(
+            in_shardings=({k: P(dax) for k in gstack_keys},
+                          {k: P(dax) for k in ef_keys}),
+            out_shardings=({k: P() for k in gstack_keys},
+                           {k: P(dax) for k in ef_keys}),
+            mesh=self.mesh)
+
     # ---------------- error-feedback state ----------------
     @property
     def has_ef(self) -> bool:
@@ -308,13 +324,27 @@ def reducer_for_step(config: GradReduceConfig, mesh: Mesh,
                 "pipeline/expert axes nest their own shard_maps) — "
                 "falling back to XLA's implicit all-reduce", stacklevel=3)
         return None
-    if config.quantized and warn:
-        warnings.warn(
-            f"grad_reduce mode='quant' on a hybrid mesh (model axes "
-            f"{nondata}): quantized collectives need a fully-manual "
-            "shard_map, which model axes preclude on this build — "
-            f"downgrading to explicit fp32 psum over {data_axes} "
-            "(error feedback off)", stacklevel=3)
+    if config.quantized:
+        if warn:
+            warnings.warn(
+                f"grad_reduce mode='quant' on a hybrid mesh (model axes "
+                f"{nondata}): quantized collectives need a fully-manual "
+                "shard_map, which model axes preclude on this build — "
+                f"downgrading to explicit fp32 psum over {data_axes} "
+                "(error feedback off)", stacklevel=3)
+        # the analyzer-visible record of the same hazard: a warning
+        # scrolls past, an ambient finding reaches the gate/baseline
+        # ledger (rule comm-quant-downgrade, analysis/README.md)
+        from ...analysis.findings import Finding, record_ambient
+        record_ambient(Finding(
+            rule="comm-quant-downgrade",
+            site="comm_opt.reducer_for_step", severity="warning",
+            message=(f"grad_reduce mode='quant' silently downgraded to "
+                     f"fp32 psum on a hybrid mesh (model axes "
+                     f"{sorted(nondata)}): wire bytes are full precision "
+                     "and error feedback is off"),
+            data=("hybrid", ",".join(sorted(nondata)),
+                  ",".join(data_axes))))
     return GradReducer(config, mesh, templates, data_axes, hybrid=True)
 
 

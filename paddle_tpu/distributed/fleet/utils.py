@@ -541,6 +541,29 @@ class ShardedTrainStep:
         self._obs_exe: Dict[Any, Any] = {}
         self._obs_nrecords = 0
 
+    def sharding_contract(self):
+        """Tier-2 analysis declaration: exactly the in/out shardings
+        ``self._compiled`` is built with, so the sharding-flow rules judge
+        the step against what the jit actually promises GSPMD and
+        hlo_audit compiles the same partitioned program the step runs."""
+        from ...analysis.sharding_flow import ShardingContract
+
+        mesh = self._batch_sharding.mesh
+        b = self._batch_sharding
+        repl = NamedSharding(mesh, P())
+        if self.scaler_state is not None:
+            in_sh = (self._p_shard, self._s_shard, None, None,
+                     self._ef_shard, b, b, None, None)
+            out_sh = (self._p_shard, self._s_shard, None, self._ef_shard,
+                      None, repl)
+        else:
+            in_sh = (self._p_shard, self._s_shard, None, self._ef_shard,
+                     b, b, None, None)
+            out_sh = (self._p_shard, self._s_shard, None, self._ef_shard,
+                      repl)
+        return ShardingContract(in_shardings=in_sh, out_shardings=out_sh,
+                                mesh=mesh)
+
     def _obs_executable(self, path: str, site: str, jitted, args, key):
         """With observability ON, route dispatch through an explicitly
         AOT-compiled executable so ``memory_analysis()`` can be gauged

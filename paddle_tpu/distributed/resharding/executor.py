@@ -39,7 +39,7 @@ from .planner import ReshardPlan, Unplannable, plan_reshard
 from .spec import MeshSpec, ShardingSpec
 
 __all__ = ["from_named_sharding", "plan_for", "reshard", "reshard_tree",
-           "clear_caches"]
+           "executor_contract", "clear_caches"]
 
 _plan_cache: Dict[Tuple, ReshardPlan] = {}
 _exec_cache: Dict[Tuple, object] = {}
@@ -174,6 +174,24 @@ def _compiled_executor(plan: ReshardPlan, src_mesh: Mesh):
         axis_names=set(names), check_vma=False))
     _exec_cache[key] = fn
     return fn
+
+
+def executor_contract(plan: ReshardPlan, src_mesh: Mesh):
+    """Tier-2 analysis declaration for ``_compiled_executor``'s program:
+    the refined mesh with the plan's src/dst refined layouts. A plan whose
+    executed output sharding drifts from what the planner computed trips
+    spmd-contract-mismatch in the corpus lint."""
+    from ...analysis.sharding_flow import ShardingContract
+
+    names = tuple(n for n, _ in plan.refined_axes) or ("r0",)
+    sizes = tuple(s for _, s in plan.refined_axes) or (1,)
+    mesh = Mesh(np.asarray(src_mesh.devices).reshape(sizes), names)
+    return ShardingContract(
+        in_shardings=(NamedSharding(
+            mesh, _spec_from_refined(plan.src_refined)),),
+        out_shardings=NamedSharding(
+            mesh, _spec_from_refined(plan.dst_refined)),
+        mesh=mesh)
 
 
 def _rebind(res: jax.Array, shape, dst_sharding: NamedSharding) -> jax.Array:

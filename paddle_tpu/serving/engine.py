@@ -421,6 +421,18 @@ class Engine:
                 jnp.ones((B,), bool), _dummy_key())
         return decode_fn, args
 
+    def sharding_contract(self, nargs: int):
+        """Tier-2 analysis declaration for the prefill/decode programs:
+        the engine serves from device-local state, so every argument and
+        every output must stay fully replicated — if sharding ever leaks
+        into a serving program (a partitioned param tree wired in without
+        a serving-side mesh plan), spmd-contract-mismatch trips."""
+        from ..analysis.sharding_flow import ShardingContract
+        from jax.sharding import PartitionSpec as P
+
+        return ShardingContract(in_shardings=(P(),) * nargs,
+                                out_shardings=P(), axis_sizes={})
+
     def _prefill_exe(self, T: int):
         prefill_fn, args = self.prefill_program(T)
         return _aot(self._exe, ("prefill", T), "serving.prefill",

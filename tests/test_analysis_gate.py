@@ -1,14 +1,27 @@
 """The CI lint gate: the real program corpus must lint clean against the
-committed baseline, and an introduced violation must fail the gate.
+committed baselines, and an introduced violation must fail the gate.
 
-This is the in-process twin of ``tools/lint_programs.py`` (same corpus,
-same baseline file, same new_against diff); the subprocess test exercises
-the actual CLI exit codes and is marked slow.
+Two tiers, one contract:
+
+- tier 1 (trace): the jaxpr rules against ``tools/baseline.json`` — plus
+  the stale-suppression check (a suppression whose finding is gone fails
+  until pruned).
+- tier 2 (compile): every entry point lowered with its ShardingContract,
+  the partitioned HLO's collectives / wire bytes / memory peak diffed
+  against ``tools/hlo_baseline.json``, and every actual collective family
+  explained by the static prediction.
+
+Both tiers together must fit the 60s CPU budget of
+``tools/lint_programs.py --hlo``. This file is the in-process twin of the
+tool (same corpus, same baseline files, same diffs); the subprocess test
+exercises the actual CLI exit codes and is marked slow.
 """
 
+import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -16,16 +29,33 @@ from paddle_tpu import analysis
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+#: wall-clock budget for BOTH tiers end to end (the acceptance bound of
+#: tools/lint_programs.py --hlo on a CPU CI host)
+_GATE_BUDGET_S = 60.0
+
+_TIMINGS = {}
+
 
 @pytest.fixture(scope="module")
 def corpus_report():
+    t0 = time.monotonic()
     specs, skips = analysis.build_corpus()
     # on the 8-device CPU test host every builder must produce a spec —
     # a skip here means corpus rot, not an acceptable degradation
     assert not skips, f"corpus builders skipped: {skips}"
     assert len(specs) >= 5
     report, errors = analysis.analyze_corpus(specs)
+    _TIMINGS["tier1"] = time.monotonic() - t0
     return specs, report, errors
+
+
+@pytest.fixture(scope="module")
+def corpus_audits(corpus_report):
+    specs, _, _ = corpus_report
+    t0 = time.monotonic()
+    audits = analysis.audit_corpus(specs)
+    _TIMINGS["tier2"] = time.monotonic() - t0
+    return audits
 
 
 def test_corpus_traces_without_errors(corpus_report):
@@ -48,6 +78,18 @@ def test_corpus_clean_against_committed_baseline(corpus_report):
         "new gating findings — fix them or suppress with rationale via "
         "tools/lint_programs.py --update-baseline --reason '...':\n"
         + "\n".join(f.render() for f in new))
+
+
+def test_no_stale_suppressions_in_committed_baseline(corpus_report):
+    # the committed baseline must stay honest: every suppression must
+    # still match a live finding (the CLI fails on stale ones)
+    _, report, _ = corpus_report
+    baseline = analysis.load_baseline(analysis.default_baseline_path())
+    live = {f.fingerprint for f in report.findings}
+    stale = set(analysis.baseline_fingerprints(baseline)) - live
+    assert not stale, (
+        f"stale suppressions {sorted(stale)} — prune via "
+        "tools/lint_programs.py --update-baseline --reason '...'")
 
 
 def test_injected_violation_fails_gate(corpus_report):
@@ -73,8 +115,83 @@ def test_wire_reconciliation_active(corpus_report):
     assert by_name["reshard"].contract.expected_wire_bytes
 
 
+# --------------------------------------------------------------- tier 2
+
+def test_sharding_contracts_declared_on_spmd_sites(corpus_report):
+    # the HLO audit can only see real collectives when the site declares
+    # its shardings (plain jit of unsharded args partitions to a
+    # fully-replicated program with nothing on the wire)
+    specs, _, _ = corpus_report
+    by_name = {s.name: s for s in specs}
+    for name in ("train_step", "train_step_grad_reduce", "grad_reducer",
+                 "reshard", "serving_prefill", "serving_decode"):
+        assert by_name[name].sharding is not None, name
+
+
+def test_hlo_audit_compiles_every_site(corpus_audits):
+    errs = {a.site: a.error for a in corpus_audits if a.error}
+    assert not errs, errs
+
+
+def test_hlo_audit_sees_training_collectives(corpus_audits):
+    by_site = {a.site: a for a in corpus_audits}
+    # the dp train step's gradient reduction must be visible as actual
+    # f32 all-reduces in the partitioned program
+    assert any(k.startswith("all-reduce|f32")
+               for k in by_site["train_step"].counts), by_site["train_step"]
+    # the int8 reducer must put s8 payloads on the wire
+    assert any(k.endswith("|s8")
+               for k in by_site["grad_reducer"].counts), by_site["grad_reducer"]
+
+
+def test_hlo_audit_zero_unexplained_collectives(corpus_audits):
+    # acceptance: every actual collective family above the noise floor is
+    # predicted by the sharding flow or the tier-1 wire model
+    unexplained = {a.site: a.unexplained for a in corpus_audits
+                   if a.unexplained}
+    assert not unexplained, unexplained
+
+
+def test_hlo_audit_clean_against_committed_baseline(corpus_audits):
+    baseline = analysis.load_hlo_baseline()
+    assert baseline.get("sites"), (
+        "tools/hlo_baseline.json missing or empty — record it with "
+        "tools/lint_programs.py --hlo --update-hlo-baseline --reason '...'")
+    diffs = analysis.diff_against_baseline(corpus_audits, baseline)
+    assert not diffs, (
+        "partitioned HLO drifted from tools/hlo_baseline.json:\n"
+        + "\n".join(d.render() for d in diffs))
+
+
+def test_injected_replication_fails_hlo_gate(corpus_report):
+    # the acceptance demo: force grad_reducer's sharded gradient stack
+    # replicated; GSPMD must insert extra all-gathers and the diff must
+    # name the op, the dtype, and the site
+    specs, _, _ = corpus_report
+    by_name = {s.name: s for s in specs}
+    broken = analysis.inject_replicated_arg(by_name["grad_reducer"])
+    audit = analysis.audit_spec(broken)
+    assert audit.error is None, audit.error
+    diffs = analysis.diff_against_baseline(
+        [audit], analysis.load_hlo_baseline())
+    assert diffs, "forced replication did not move the partitioned program"
+    named = [d for d in diffs if d.kind == "collective-count"]
+    assert named, diffs
+    assert any(d.site == "grad_reducer" and d.op and d.dtype
+               for d in named), diffs
+
+
+def test_two_tier_gate_fits_cpu_budget(corpus_audits):
+    # corpus_audits depends on corpus_report, so both timings exist here
+    total = _TIMINGS["tier1"] + _TIMINGS["tier2"]
+    assert total < _GATE_BUDGET_S, (
+        f"two-tier gate took {total:.1f}s (tier1 "
+        f"{_TIMINGS['tier1']:.1f}s + tier2 {_TIMINGS['tier2']:.1f}s) — "
+        f"over the {_GATE_BUDGET_S:.0f}s CI budget")
+
+
 @pytest.mark.slow
-def test_cli_exit_codes():
+def test_cli_exit_codes(tmp_path):
     env = dict(os.environ)
     env.pop("PYTEST_CURRENT_TEST", None)
     tool = os.path.join(_REPO, "tools", "lint_programs.py")
@@ -86,3 +203,35 @@ def test_cli_exit_codes():
                          timeout=300)
     assert bad.returncode == 1, bad.stdout + bad.stderr
     assert "dtype-f64" in bad.stdout
+    # a stale suppression must fail until pruned
+    stale = analysis.load_baseline(analysis.default_baseline_path())
+    stale = dict(stale)
+    stale["suppressions"] = list(stale.get("suppressions", [])) + [
+        {"fingerprint": "feedfacedead", "rule": "dtype-f64",
+         "site": "gone", "reason": "test", "date": "2026-01-01"}]
+    p = tmp_path / "stale_baseline.json"
+    p.write_text(json.dumps(stale))
+    r = subprocess.run([sys.executable, tool, "--baseline", str(p)],
+                       env=env, cwd=_REPO, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "stale" in r.stdout
+
+
+@pytest.mark.slow
+def test_cli_hlo_exit_codes():
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    tool = os.path.join(_REPO, "tools", "lint_programs.py")
+    clean = subprocess.run([sys.executable, tool, "--hlo", "--json"],
+                          env=env, cwd=_REPO, capture_output=True,
+                          text=True, timeout=300)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    payload = json.loads(clean.stdout)
+    assert payload["hlo"]["diffs"] == []
+    assert len(payload["hlo"]["sites"]) >= 5
+    bad = subprocess.run(
+        [sys.executable, tool, "--hlo", "--inject-hlo", "grad_reducer"],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=300)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "grad_reducer" in bad.stdout and "all-gather" in bad.stdout

@@ -181,8 +181,8 @@ def test_bench_obs_row_contract(capsys):
 
 def test_bench_analysis_row_contract(capsys):
     """The analysis row's acceptance invariant: the full program corpus
-    traces and lints on CPU inside the 60s lint-gate budget, with no trace
-    errors and no skipped builders on the 8-device test host."""
+    traces, lints AND hlo-audits on CPU inside the 60s lint-gate budget,
+    with no trace errors and no skipped builders on the 8-device host."""
     import bench
 
     row = bench.bench_analysis()
@@ -197,6 +197,17 @@ def test_bench_analysis_row_contract(capsys):
     assert parsed["trace_errors"] == 0
     assert parsed["rules_run"] >= 8
     assert set(parsed["findings"]) == {"info", "warning", "error"}
+    # tier 2: both tiers together must stay inside the same gate budget
+    assert 0 < parsed["hlo_audit_ms"]
+    assert parsed["value"] + parsed["build_ms"] + parsed["hlo_audit_ms"] \
+        < 60_000
+    # the partitioned train step's gradient all-reduces are on the wire
+    assert any(k.startswith("all-reduce|f32")
+               for k in parsed["hlo_collectives"])
+    peaks = parsed["hbm_peak_mb_by_site"]
+    assert set(peaks) >= {"train_step", "serving_prefill", "serving_decode"}
+    assert all(v >= 0 for v in peaks.values())
+    assert peaks["train_step"] > 0
 
 
 @pytest.mark.slow

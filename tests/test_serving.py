@@ -10,6 +10,7 @@ termination, per-row batched sampling, and the flag-gated serving metrics
 (present under FLAGS_observability, zero registry writes when off).
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -92,6 +93,26 @@ class TestDecodeParity:
         np.testing.assert_allclose(
             np.asarray(decode_attend(q, poisoned_k, poisoned_v, pos)),
             np.asarray(base), rtol=1e-6)
+
+    @pytest.mark.parametrize("x64", [True, False], ids=["x64_on", "x64_off"])
+    def test_decode_attend_q_scale_stays_f32(self, x64):
+        """The 1/sqrt(D) scale is a q-dtype scalar, never a strong f64:
+        under x64 a bare `np.sqrt` scalar upcast the whole score tensor to
+        f64 before the cast back (doubled decode flops and wire — caught by
+        the analyzer's dtype-f64 rule, fixed by the jnp.asarray pin). Both
+        x64 modes must trace an f64-free program with an f32 result."""
+        from jax.experimental import disable_x64, enable_x64
+
+        with (enable_x64() if x64 else disable_x64()):
+            B, H, S_max, D = 2, 2, 8, 4
+            q = jnp.ones((B, H, 1, D), jnp.float32)
+            k = jnp.ones((B, H, S_max, D), jnp.float32)
+            v = jnp.ones((B, H, S_max, D), jnp.float32)
+            pos = jnp.asarray([2, 5], jnp.int32)
+            out = decode_attend(q, k, v, pos)
+            assert out.dtype == jnp.float32
+            jaxpr = jax.make_jaxpr(decode_attend)(q, k, v, pos)
+            assert "f64" not in str(jaxpr), str(jaxpr)
 
 
 # ---------------- generate(): parity + the one-compile regression ---------

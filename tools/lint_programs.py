@@ -7,17 +7,32 @@ entry points: the sharded train step (with and without gradient-reduction
 collectives), serving prefill/decode, the GradReducer shard_map schedule,
 a resharding executor body, and an ir-pipeline-optimized program.
 
+Two tiers share one exit status:
+
+- tier 1 (always): trace-level rules against the suppression baseline,
+  plus a stale-suppression check — a suppression whose finding is gone
+  FAILS the gate until pruned (``--update-baseline`` prunes).
+- tier 2 (``--hlo``): compile every corpus entry with its declared
+  ShardingContract, parse the partitioned HLO for actual collectives and
+  the executable memory peak, and diff against the committed
+  ``tools/hlo_baseline.json`` — any collective-count / wire-byte / HBM-peak
+  drift fails, naming the op, dtype, and site.
+
 Exit codes:
-  0  clean (no gating findings beyond the committed baseline)
-  1  NEW gating findings (warning or worse) — the CI gate
+  0  clean (no gating findings / HLO drift beyond the committed baselines)
+  1  NEW gating findings, stale suppressions, or HLO baseline diffs
   2  internal failure (corpus build or analysis crashed)
 
 Usage:
-  python tools/lint_programs.py                    # the CI gate
-  python tools/lint_programs.py --json             # machine-readable report
+  python tools/lint_programs.py                    # the tier-1 CI gate
+  python tools/lint_programs.py --hlo              # + the HLO audit tier
+  python tools/lint_programs.py --hlo --json       # machine-readable report
   python tools/lint_programs.py --selftest         # fixture rules must fire
-  python tools/lint_programs.py --inject dtype-f64 # prove the gate trips
+  python tools/lint_programs.py --inject dtype-f64 # prove tier 1 trips
+  python tools/lint_programs.py --hlo --inject-hlo grad_reducer
+                                                   # prove tier 2 trips
   python tools/lint_programs.py --update-baseline --reason "why"
+  python tools/lint_programs.py --hlo --update-hlo-baseline --reason "why"
 
 See paddle_tpu/analysis/README.md for the rule catalog and the
 suppression/baseline workflow.
@@ -86,6 +101,15 @@ def main(argv=None) -> int:
                     help="check every seeded fixture violation is detected")
     ap.add_argument("--inject", metavar="RULE",
                     help="add the fixture for RULE to the corpus (gate demo)")
+    ap.add_argument("--hlo", action="store_true",
+                    help="also run the post-partition HLO audit tier")
+    ap.add_argument("--hlo-baseline",
+                    default=analysis.default_hlo_baseline_path())
+    ap.add_argument("--update-hlo-baseline", action="store_true",
+                    help="re-record tools/hlo_baseline.json (needs --reason)")
+    ap.add_argument("--inject-hlo", metavar="SITE",
+                    help="force SITE's first sharded arg replicated before "
+                         "the audit (HLO gate demo)")
     ap.add_argument("--verbose", "-v", action="store_true")
     ns = ap.parse_args(argv)
 
@@ -93,17 +117,21 @@ def main(argv=None) -> int:
         return _selftest(ns.verbose)
     if ns.update_baseline and not ns.reason:
         ap.error("--update-baseline requires --reason")
+    if ns.update_hlo_baseline and not ns.reason:
+        ap.error("--update-hlo-baseline requires --reason")
+    run_hlo = ns.hlo or ns.update_hlo_baseline or bool(ns.inject_hlo)
 
     t0 = time.monotonic()
     try:
-        specs, skips = analysis.build_corpus()
+        corpus_specs, skips = analysis.build_corpus()
+        specs = list(corpus_specs)
         if ns.inject:
             injected = [s for s, rule in analysis.fixture_specs()
                         if rule == ns.inject]
             if not injected:
                 ap.error(f"--inject: no fixture for rule '{ns.inject}'; "
                          f"have {sorted({r for _, r in analysis.fixture_specs()})}")
-            specs = list(specs) + injected
+            specs = specs + injected
         build_s = time.monotonic() - t0
         report, errors = analysis.analyze_corpus(specs)
     except Exception as e:  # corpus construction itself broke
@@ -111,12 +139,38 @@ def main(argv=None) -> int:
         return 2
     analyze_s = time.monotonic() - t0 - build_s
 
+    # ---- tier 2: compile the real corpus (never the injected fixtures)
+    # and audit the partitioned HLO against tools/hlo_baseline.json
+    audits, hlo_diffs, audit_s = [], [], 0.0
+    if run_hlo:
+        t1 = time.monotonic()
+        try:
+            audit_specs = list(corpus_specs)
+            if ns.inject_hlo:
+                by_name = {s.name: i for i, s in enumerate(audit_specs)}
+                if ns.inject_hlo not in by_name:
+                    ap.error(f"--inject-hlo: no corpus site "
+                             f"'{ns.inject_hlo}'; have {sorted(by_name)}")
+                i = by_name[ns.inject_hlo]
+                audit_specs[i] = analysis.inject_replicated_arg(
+                    audit_specs[i])
+            audits = analysis.audit_corpus(audit_specs)
+        except Exception as e:
+            print(f"lint_programs: hlo audit failure: {e!r}",
+                  file=sys.stderr)
+            return 2
+        audit_s = time.monotonic() - t1
+        hlo_baseline = analysis.load_hlo_baseline(ns.hlo_baseline)
+        hlo_diffs = analysis.diff_against_baseline(audits, hlo_baseline)
+        report.findings.extend(analysis.unexplained_findings(audits))
+
     baseline = analysis.load_baseline(ns.baseline)
     suppressed = set(analysis.baseline_fingerprints(baseline))
     new = report.new_against(suppressed)
+    stale = sorted(suppressed - {f.fingerprint for f in report.findings})
 
     if ns.as_json:
-        print(json.dumps({
+        payload = {
             "programs": [s.name for s in specs],
             "skipped": [{"name": n, "reason": r} for n, r in skips],
             "build_seconds": round(build_s, 3),
@@ -124,22 +178,55 @@ def main(argv=None) -> int:
             "counts": report.counts(),
             "findings": [f.as_dict() for f in report.findings],
             "new_gating": [f.as_dict() for f in new],
-        }, indent=2))
+            "stale_suppressions": stale,
+        }
+        if run_hlo:
+            payload["hlo"] = {
+                "audit_seconds": round(audit_s, 3),
+                "sites": [a.as_dict() for a in audits],
+                "diffs": [d.render() for d in hlo_diffs],
+            }
+        print(json.dumps(payload, indent=2))
     else:
         print(f"lint_programs: {len(specs)} program(s) "
-              f"(build {build_s:.1f}s, analyze {analyze_s:.1f}s)"
+              f"(build {build_s:.1f}s, analyze {analyze_s:.1f}s"
+              + (f", hlo audit {audit_s:.1f}s" if run_hlo else "") + ")"
               + (f"; skipped: {[n for n, _ in skips]}" if skips else ""))
         if ns.verbose or report.findings:
             print(report.render())
+        if run_hlo and ns.verbose:
+            for a in audits:
+                print(f"  hlo {a.site}: {a.counts} "
+                      f"wire={a.wire_bytes} "
+                      f"peak={a.hbm.get('peak', 0)} "
+                      f"err={a.error}")
 
-    if ns.update_baseline and new:
+    if ns.update_baseline:
         added = analysis.add_suppressions(baseline, new, ns.reason)
-        analysis.prune_stale(baseline, [f.fingerprint for f in report.findings])
+        pruned = analysis.prune_stale(
+            baseline, [f.fingerprint for f in report.findings])
         analysis.save_baseline(baseline, ns.baseline)
-        print(f"baseline updated: {added} suppression(s) added "
-              f"-> {ns.baseline}")
-        return 0
+        print(f"baseline updated: {added} suppression(s) added, "
+              f"{pruned} stale pruned -> {ns.baseline}")
+        new, stale = [], []
 
+    if ns.update_hlo_baseline:
+        hlo_baseline = analysis.audits_to_baseline(
+            audits, ns.reason, analysis.load_hlo_baseline(ns.hlo_baseline))
+        analysis.save_hlo_baseline(hlo_baseline, ns.hlo_baseline)
+        print(f"hlo baseline updated: {len(hlo_baseline['sites'])} "
+              f"site(s) -> {ns.hlo_baseline}")
+        hlo_diffs = []
+
+    failed = False
+    if new:
+        failed = True
+    if stale:
+        failed = True
+    if hlo_diffs:
+        failed = True
+    if ns.as_json:  # machine output: the payload already carries the diffs
+        return 1 if failed else 0
     if new:
         print(f"\nFAIL: {len(new)} new gating finding(s) not in baseline "
               f"({ns.baseline}):")
@@ -147,13 +234,25 @@ def main(argv=None) -> int:
             print("  " + f.render())
         print("\nfix the hazard, or suppress with a rationale:\n"
               "  python tools/lint_programs.py --update-baseline --reason '...'")
+    if stale:
+        print(f"\nFAIL: {len(stale)} stale suppression(s) in baseline "
+              f"({ns.baseline}) — the suppressed finding no longer fires. "
+              "Prune them so the baseline stays honest:\n"
+              "  python tools/lint_programs.py --update-baseline "
+              "--reason 'prune fixed findings'")
+        for fp in stale:
+            print(f"  stale fingerprint: {fp}")
+    if hlo_diffs:
+        print(f"\nFAIL: partitioned HLO drifted from {ns.hlo_baseline} "
+              f"({len(hlo_diffs)} diff(s)):")
+        for d in hlo_diffs:
+            print("  " + d.render())
+        print("\nfix the sharding regression, or re-record with:\n"
+              "  python tools/lint_programs.py --hlo --update-hlo-baseline "
+              "--reason '...'")
+    if failed:
         return 1
-
-    stale = suppressed - {f.fingerprint for f in report.findings}
-    if stale and not ns.as_json:
-        print(f"note: {len(stale)} stale suppression(s) in baseline "
-              "(finding fixed — run --update-baseline to prune)")
-    print("lint_programs: clean")
+    print("lint_programs: clean" + (" (hlo audited)" if run_hlo else ""))
     return 0
 
 
