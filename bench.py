@@ -371,8 +371,19 @@ def _measure_scanned(step, x, y, iters, tokens_per_step, repeats=3):
             host_frac_mean)
 
 
+def _train_hbm_floor(n_params, master=False, moment_bytes=4):
+    """Analytic per-step HBM floor from the optimizer working set — the
+    row's attribution input (activations deliberately excluded; see
+    attribution.train_hbm_bytes_estimate)."""
+    from paddle_tpu.observability import attribution as _attr
+
+    return _attr.train_hbm_bytes_estimate(
+        n_params, param_bytes=2 if _on_tpu() else 4,
+        master=master, moment_bytes=moment_bytes)
+
+
 def _row(config, metric, value, unit, step_s, flops_per_step, host_frac,
-         collective_est=0.0, note=""):
+         collective_est=0.0, note="", hbm_bytes=None, wire_bytes=None):
     compute_frac = min(1.0, flops_per_step / (_peak_flops() * step_s))
     out = {
         "config": config,
@@ -393,15 +404,23 @@ def _row(config, metric, value, unit, step_s, flops_per_step, host_frac,
         "mfu": round(flops_per_step / (_peak_flops() * step_s), 3),
         "note": note,
     }
-    if _cpu_fallback():
-        out["backend"] = "cpu_fallback"
+    out["backend"] = "cpu_fallback" if _cpu_fallback() else _backend()
     from paddle_tpu import observability
+    from paddle_tpu.observability import attribution as _attr
 
+    # roofline attribution: per-resource step-time floors from the row's
+    # analytic cost inputs vs the measured device step (perf_report.py
+    # reconciles these against tools/hlo_baseline.json's audited bytes)
+    hw = _attr.hardware_for_backend(out["backend"])
+    out["attribution"] = _attr.attribute(
+        hw, measured_s=step_s, flops=flops_per_step,
+        hbm_bytes=hbm_bytes, wire_bytes=wire_bytes)
     if observability.enabled():
         observability.record_window(
             tokens_per_sec=value if metric.endswith("tokens_per_sec") else None,
             flops=flops_per_step, seconds=step_s, peak=_peak_flops(),
             config=config)
+        _attr.record_report({"sites": {config: out["attribution"]}})
         out["telemetry"] = observability.snapshot()
     print(json.dumps(out))
     return out
@@ -462,6 +481,7 @@ def bench_bert_sst2():
     flops = 6 * n * bsz * seq
     return _row("bert_sst2", "tokens_per_sec", tput, "tokens/sec/chip",
                 step_s, flops, host_frac,
+                hbm_bytes=_train_hbm_floor(n, master=on_tpu),
                 note=f"{n/1e6:.0f}M params, B={bsz} S={seq}, scanned dispatch")
 
 
@@ -506,6 +526,8 @@ def bench_gpt_dp():
         dict(batch=bsz * 8, zero_stage=1, moment_bytes=2), dp=4, sharding=2)
     return _row("gpt_dp", "tokens_per_sec", tput, "tokens/sec/chip",
                 step_s, flops, host_frac, collective_est=est,
+                hbm_bytes=_train_hbm_floor(
+                    n, moment_bytes=2 if on_tpu else 4),
                 note=f"{n/1e6:.0f}M params, B={bsz} S={seq}, "
                      "dp x zero1 est at 8 chips")
 
@@ -551,6 +573,7 @@ def bench_ernie_mp4():
         dict(batch=bsz * 4), mp=4)
     return _row("ernie_mp4", "tokens_per_sec", tput, "tokens/sec/chip",
                 step_s, flops, host_frac, collective_est=est,
+                hbm_bytes=_train_hbm_floor(n, master=on_tpu),
                 note=f"{n/1e6:.0f}M params, B={bsz} S={seq}, mp=4 est")
 
 
@@ -602,8 +625,11 @@ def bench_resnet50():
     # short-step config: scanned multi-step timing + prefetched infeed
     tput, step_s, host_frac, host_mean = _measure_scanned(step, x, y, iters, bsz)
     flops = 3 * fwd_flops * bsz  # fwd + bwd ~= 3x fwd
+    # LARS: one f32 momentum buffer, no fp32 master — moment_bytes=2
+    # approximates a single f32 moment (4*2 = one f32 read + write)
+    hbm = _train_hbm_floor(_n_params(wrapped), moment_bytes=2)
     return _row("resnet50", "images_per_sec", tput, "images/sec/chip",
-                step_s, flops, host_frac,
+                step_s, flops, host_frac, hbm_bytes=hbm,
                 note=f"B={bsz} {hw}x{hw}, LARS, uint8 infeed + device "
                      f"normalize, scanned steps + superbatch prefetch "
                      f"(host mean {host_mean:.3f} incl. tunnel-throttled "
@@ -660,6 +686,8 @@ def bench_gpt_moe():
     n_total = _n_params(model)
     return _row("gpt_moe", "tokens_per_sec", tput, "tokens/sec/chip",
                 step_s, flops, host_frac, collective_est=est,
+                hbm_bytes=_train_hbm_floor(
+                    n_total, moment_bytes=2 if on_tpu else 4),
                 note=f"{n_total/1e6:.0f}M total/{n_active/1e6:.0f}M active, "
                      f"E={E} top{k}, B={bsz} S={seq}, ep+zero3 est")
 
@@ -668,10 +696,13 @@ def bench_serving():
     """Serving config: offline Engine.generate over the static-shape decode
     core — TTFT / TPOT / throughput, the latency-side analog of the training
     rows (vLLM-style offline benchmark, one chip)."""
+    import tempfile
+
     import paddle_tpu as paddle
     from paddle_tpu import observability
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
-    from paddle_tpu.serving import Engine, SamplingParams
+    from paddle_tpu.serving import (Engine, EngineConfig, SamplingParams,
+                                    SLOConfig)
 
     on_tpu = _on_tpu()
     paddle.seed(0)
@@ -680,13 +711,22 @@ def bench_serving():
                         num_heads=16, num_kv_heads=4, max_seq_len=1024,
                         dropout=0.0)
         B, n_req, prompt_len, max_new = 8, 16, 128, 128
+        # steady-state targets with generous headroom (TTFT includes
+        # queueing behind the n_req > slots backlog): a healthy run
+        # records ~0 violations, a serving regression shows up as
+        # nonzero counts in the row's "slo" object
+        slo = SLOConfig(ttft_target_s=3.0, tpot_target_s=0.05)
     else:  # tiny on CPU so the harness still runs
         cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
                         num_heads=4, max_seq_len=64, dropout=0.0)
         B, n_req, prompt_len, max_new = 2, 4, 8, 8
+        slo = SLOConfig(ttft_target_s=60.0, tpot_target_s=10.0)
     model = GPTForCausalLM(cfg)
     model.eval()
-    engine = Engine(model, max_batch_size=B, max_seq_len=cfg.max_seq_len)
+    trace_dir = tempfile.mkdtemp(prefix="pt_requests_")
+    engine = Engine(model, EngineConfig(
+        max_batch_size=B, max_seq_len=cfg.max_seq_len,
+        request_trace_dir=trace_dir, trace_sample_every=2, slo=slo))
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
                for _ in range(n_req)]
@@ -718,9 +758,28 @@ def bench_serving():
         "note": f"{n_req} reqs, prompt={prompt_len}, max_new={max_new}, "
                 f"slots={B}",
     }
-    if _cpu_fallback():
-        out["backend"] = "cpu_fallback"
+    out["backend"] = "cpu_fallback" if _cpu_fallback() else _backend()
+    tstats = engine.tracer.stats()
+    out["slo"] = {
+        "ttft_target_ms": round(slo.ttft_target_s * 1e3, 1),
+        "tpot_target_ms": round(slo.tpot_target_s * 1e3, 1),
+        "violations": tstats["violations"],
+    }
+    out["request_trace"] = {"path": tstats["path"],
+                            "sampled": tstats["written"],
+                            "finished": tstats["finished"]}
+    # decode-step roofline: the batched decode reads every weight once per
+    # token (the classic HBM-bound regime); measured side = TPOT p50
+    from paddle_tpu.observability import attribution as _attr
+
+    n = _n_params(model)
+    param_bytes = 2 if on_tpu else 4
+    hw = _attr.hardware_for_backend(out["backend"])
+    out["attribution"] = _attr.attribute(
+        hw, measured_s=(tpots[len(tpots) // 2] if tpots else None),
+        flops=2 * n * B, hbm_bytes=n * param_bytes)
     if observability.enabled():
+        _attr.record_report({"sites": {"serving": out["attribution"]}})
         out["telemetry"] = observability.snapshot()
     print(json.dumps(out))
     return out

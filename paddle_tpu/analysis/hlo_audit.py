@@ -169,6 +169,7 @@ class SiteAudit:
     counts: Dict[str, int] = field(default_factory=dict)   # "op|dtype" -> n
     wire_bytes: int = 0
     hbm: Dict[str, int] = field(default_factory=dict)
+    cost: Dict[str, float] = field(default_factory=dict)   # cost_analysis
     compile_seconds: float = 0.0
     predicted: Dict[str, int] = field(default_factory=dict)  # family->bytes
     unexplained: List[str] = field(default_factory=list)     # families
@@ -180,6 +181,8 @@ class SiteAudit:
             "counts": dict(sorted(self.counts.items())),
             "wire_bytes": self.wire_bytes,
             "hbm_peak_bytes": self.hbm.get("peak", 0),
+            "flops": self.cost.get("flops", 0.0),
+            "bytes_accessed": self.cost.get("bytes_accessed", 0.0),
             "compile_seconds": round(self.compile_seconds, 3),
             "predicted": dict(sorted(self.predicted.items())),
             "unexplained": list(self.unexplained),
@@ -212,6 +215,30 @@ def _memory_analysis(compiled) -> Dict[str, int]:
         out["peak"] = (out.get("temp", 0) + out.get("argument", 0)
                        + out.get("output", 0) + out.get("code", 0)
                        - out.get("alias", 0))
+    return out
+
+
+def _cost_analysis(compiled) -> Dict[str, float]:
+    """Executable cost properties — the roofline attribution feed
+    (observability/attribution.py): per-device FLOPs and HBM bytes
+    accessed per execution. Empty when the backend declines."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not ca:
+        return {}
+    out: Dict[str, float] = {}
+    for key, name in (("flops", "flops"),
+                      ("bytes accessed", "bytes_accessed")):
+        try:
+            v = float(ca.get(key, 0.0))
+        except (TypeError, ValueError, AttributeError):
+            continue
+        if v:
+            out[name] = v
     return out
 
 
@@ -248,6 +275,7 @@ def audit_spec(spec: ProgramSpec) -> SiteAudit:
         audit.counts[c.key] = audit.counts.get(c.key, 0) + 1
         audit.wire_bytes += c.wire_bytes
     audit.hbm = _memory_analysis(compiled)
+    audit.cost = _cost_analysis(compiled)
 
     # static prediction: sharding-flow events + tier-1 manual-region wire
     predicted: Dict[str, int] = {}

@@ -15,6 +15,7 @@ scheme and the bench.py field mapping.
 
 from . import (  # noqa: F401
     aggregate,
+    attribution,
     export,
     flight_recorder,
     goodput,
@@ -23,8 +24,15 @@ from . import (  # noqa: F401
     metrics,
     tracing,
     training,
+    xplane,
 )
 from .aggregate import fleet_report, render_report  # noqa: F401
+from .attribution import (  # noqa: F401
+    HardwareSpec,
+    attribute,
+    hardware_for_backend,
+    site_report,
+)
 from .export import (  # noqa: F401
     MetricsExporter,
     get_exporter,
@@ -35,6 +43,7 @@ from .flight_recorder import (  # noqa: F401
     FlightRecorder,
     get_flight_recorder,
     read_flight,
+    record_event,
     start_flight_recorder,
     stop_flight_recorder,
 )
@@ -81,8 +90,9 @@ __all__ = [
     "record_collective", "record_compile", "record_step", "record_window",
     "MetricsExporter", "start_exporter", "stop_exporter", "get_exporter",
     "FlightRecorder", "start_flight_recorder", "stop_flight_recorder",
-    "get_flight_recorder", "read_flight",
+    "get_flight_recorder", "read_flight", "record_event",
     "record_executable", "record_live_buffers", "record_device_memory",
     "record_kv_cache",
     "GoodputMonitor", "fleet_report", "render_report",
+    "HardwareSpec", "attribute", "hardware_for_backend", "site_report",
 ]

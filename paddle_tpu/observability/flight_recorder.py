@@ -73,6 +73,18 @@ class FlightRecorder:
             self._ring.append({"kind": "span", **event})
         metrics.counter("obs.flight.events", 1, kind="span")
 
+    def record(self, event: Dict[str, Any]):
+        """Public intake for structured one-off events (the serving SLO
+        monitor drops per-request violation traces here). ``event`` should
+        carry a ``kind``; it lands in the ring like any span and persists
+        on the next flush/finalize."""
+        ev = dict(event)
+        ev.setdefault("kind", "event")
+        ev.setdefault("ts", time.time())
+        with self._lock:
+            self._ring.append(ev)
+        metrics.counter("obs.flight.events", 1, kind=ev["kind"])
+
     def _metrics_event(self) -> Dict[str, Any]:
         snap = metrics.snapshot()
         deltas = {}
@@ -224,6 +236,16 @@ def stop_flight_recorder(reason: str = "stop"):
 
 def get_flight_recorder() -> Optional[FlightRecorder]:
     return _recorder
+
+
+def record_event(event: Dict[str, Any]) -> bool:
+    """Drop one structured event into the live recorder's ring; False (a
+    no-op) when no recorder is running — callers never need to gate."""
+    r = _recorder
+    if r is None:
+        return False
+    r.record(event)
+    return True
 
 
 def read_flight(path: str) -> Dict[str, Any]:

@@ -1,0 +1,158 @@
+"""XPlane device profiling made reusable: trace collection + op table.
+
+Promoted from the one-off ``tools/xplane_op_profile.py`` (the resnet r4
+ceiling-analysis methodology) into a module the roofline attribution tier
+(``observability/attribution.py``) can consume: ``collect()`` runs a step
+function under ``jax.profiler.trace`` and returns the ``*.xplane.pb``
+paths, ``op_table()`` converts them into the per-op self-time table, and
+``device_time_seconds()`` reduces that to the measured device step time an
+attribution report reconciles its predicted floors against.
+
+Degradation contract (the satellite this module exists for): the XPlane
+converter lives in the optional ``xprof`` package, which production CI
+hosts do not install. Every entry point here degrades gracefully —
+``have_xprof()`` is False, ``op_table()`` returns None instead of raising
+ImportError, and callers fall back to the portable measured-time source
+(the goodput buckets / ``train.step.seconds`` histogram). Only
+``collect()`` needs jax (it drives the profiler); nothing here imports
+jax or xprof at module import time.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import tempfile
+from typing import Any, Dict, List, Optional, Sequence
+
+from . import metrics as _metrics
+
+#: the xprof tool name whose converted output is the per-op stats table
+OP_STATS_TOOL = "framework_op_stats"
+
+
+def have_xprof() -> bool:
+    """True when the optional ``xprof`` converter package is importable."""
+    try:
+        import importlib.util
+
+        return importlib.util.find_spec("xprof") is not None
+    except Exception:
+        return False
+
+
+def collect(step_fn, *args, iters: int = 3,
+            trace_dir: Optional[str] = None) -> List[str]:
+    """Run ``step_fn(*args)`` ``iters`` times under ``jax.profiler.trace``
+    (one warm call first, outside the trace, so compile time never pollutes
+    the op table) and return the produced ``*.xplane.pb`` paths."""
+    import jax
+
+    r = step_fn(*args)  # compile outside the trace
+    jax.block_until_ready(r if not hasattr(r, "_value") else r._value)
+    d = trace_dir or tempfile.mkdtemp(prefix="xplane_")
+    with jax.profiler.trace(d):
+        for _ in range(iters):
+            r = step_fn(*args)
+        jax.block_until_ready(r if not hasattr(r, "_value") else r._value)
+    paths = glob.glob(d + "/**/*.xplane.pb", recursive=True)
+    _metrics.counter("perf.xplane.collections", 1)
+    return paths
+
+
+def op_table(xplane_paths: Sequence[str],
+             tool: str = OP_STATS_TOOL) -> Optional[str]:
+    """Convert XPlane protos into the named tool's data (a JSON string for
+    ``framework_op_stats``). Returns None — degrading gracefully — when
+    ``xprof`` is not installed or the paths are empty."""
+    if not xplane_paths:
+        return None
+    try:
+        from xprof.convert import raw_to_tool_data
+    except ImportError:
+        _metrics.counter("perf.xplane.no_xprof", 1)
+        return None
+    data, _ = raw_to_tool_data.xspace_to_tool_data(
+        list(xplane_paths), tool, {})
+    return data if isinstance(data, str) else data.decode()
+
+
+def op_rows(table: Optional[str]) -> List[Dict[str, Any]]:
+    """Parse an ``op_table()`` result into row dicts. Handles both a plain
+    list of records and the gviz DataTable shape ({"cols": [...], "rows":
+    [{"c": [{"v": ...}]}]}) xprof's converters emit; returns [] on any
+    shape it does not recognize (the table is advisory, never gating)."""
+    if not table:
+        return []
+    try:
+        data = json.loads(table)
+    except (json.JSONDecodeError, TypeError):
+        return []
+    if isinstance(data, list) and all(isinstance(r, dict) for r in data):
+        return data
+    if isinstance(data, dict) and "rows" in data and "cols" in data:
+        labels = [c.get("label") or c.get("id") or f"col{i}"
+                  for i, c in enumerate(data["cols"])]
+        rows = []
+        for r in data["rows"]:
+            cells = r.get("c") or []
+            rows.append({labels[i]: (cell or {}).get("v")
+                         for i, cell in enumerate(cells)
+                         if i < len(labels)})
+        return rows
+    return []
+
+
+def _self_time_key(row: Dict[str, Any]) -> Optional[str]:
+    for k in row:
+        lk = str(k).lower()
+        if "self" in lk and "time" in lk and "%" not in lk:
+            return k
+    return None
+
+
+def top_ops(rows: List[Dict[str, Any]], n: int = 10) -> List[Dict[str, Any]]:
+    """The ``n`` largest rows by self time (row order preserved when no
+    self-time column is recognizable)."""
+    if not rows:
+        return []
+    key = _self_time_key(rows[0])
+    if key is None:
+        return rows[:n]
+    return sorted(rows, key=lambda r: float(r.get(key) or 0.0),
+                  reverse=True)[:n]
+
+
+def device_time_seconds(rows: List[Dict[str, Any]],
+                        iters: int = 1) -> Optional[float]:
+    """Total device self time per iteration, in seconds (op-stats report
+    microseconds). None when the rows carry no recognizable self-time
+    column — callers then fall back to goodput-bucket measured time."""
+    if not rows:
+        return None
+    key = _self_time_key(rows[0])
+    if key is None:
+        return None
+    total_us = 0.0
+    for r in rows:
+        try:
+            total_us += float(r.get(key) or 0.0)
+        except (TypeError, ValueError):
+            continue
+    return total_us * 1e-6 / max(int(iters), 1)
+
+
+def measure(step_fn, *args, iters: int = 3) -> Dict[str, Any]:
+    """collect + convert + reduce in one call: {"xplane_paths", "available",
+    "rows", "device_time_s"}. ``available`` is False (and the measured
+    fields None/[]) when xprof is absent — the caller keeps its portable
+    fallback; the trace files are still on disk for offline conversion."""
+    paths = collect(step_fn, *args, iters=iters)
+    table = op_table(paths)
+    rows = op_rows(table)
+    return {
+        "xplane_paths": paths,
+        "available": table is not None,
+        "rows": rows,
+        "device_time_s": device_time_seconds(rows, iters=iters),
+    }

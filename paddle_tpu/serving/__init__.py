@@ -6,6 +6,9 @@ Public surface:
   with slot-based continuous batching over a preallocated KV cache.
 - :class:`SamplingParams` — per-request decoding controls.
 - :class:`Request` / :class:`Scheduler` — FIFO queue + slot table.
+- :class:`RequestTracer` / :class:`SLOConfig` — per-request span traces
+  (queue→prefill→decode→finish, ``requests-host*.jsonl``) and the SLO
+  monitor (``serving.slo.violations{phase}``, flight-recorder forensics).
 - :class:`KVCache`, :func:`write_kv`, :func:`decode_attend` — the shared
   static-cache write/attend primitives (also used by
   ``incubate.nn.FusedMultiTransformer``'s ``time_step`` decode).
@@ -19,6 +22,12 @@ from __future__ import annotations
 
 from .engine import Engine, EngineConfig, cached_generate  # noqa: F401
 from .kv_cache import KVCache, decode_attend, write_kv  # noqa: F401
+from .request_trace import (  # noqa: F401
+    RequestTracer,
+    SLOConfig,
+    read_request_traces,
+    request_trace_path,
+)
 from .sampling import SamplingParams  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
 
@@ -27,9 +36,13 @@ __all__ = [
     "EngineConfig",
     "KVCache",
     "Request",
+    "RequestTracer",
+    "SLOConfig",
     "SamplingParams",
     "Scheduler",
     "cached_generate",
     "decode_attend",
+    "read_request_traces",
+    "request_trace_path",
     "write_kv",
 ]

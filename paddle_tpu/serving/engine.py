@@ -43,6 +43,7 @@ from ..observability import memory as _obs_memory
 from ..observability import metrics as _metrics
 from . import sampling as _sampling
 from .kv_cache import KVCache
+from .request_trace import RequestTracer, SLOConfig
 from .sampling import SamplingParams
 from .scheduler import Request, Scheduler
 
@@ -213,6 +214,13 @@ class EngineConfig:
     max_seq_len: int = 128       # per-slot prompt + generation budget (S_max)
     prefill_buckets: Optional[Tuple[int, ...]] = None  # default: pow2 <= S_max
     cache_dtype: Optional[str] = None  # default: the model's param dtype
+    # per-request tracing / SLO monitoring (request_trace.py): a directory
+    # enables the requests-host*.jsonl trace file; an SLOConfig enables the
+    # serving.slo.violations counters + flight-recorder violation traces
+    # (either works without the other)
+    request_trace_dir: Optional[str] = None
+    trace_sample_every: int = 1
+    slo: Optional["SLOConfig"] = None
 
     def __post_init__(self):
         if self.prefill_buckets is None:
@@ -272,6 +280,12 @@ class Engine:
         _metrics.gauge("serving.kv_cache.bytes", self.cache.nbytes)
         _obs_memory.record_kv_cache(self.cache.nbytes)
         self.scheduler = Scheduler(B)
+        self.tracer: Optional[RequestTracer] = None
+        if self.config.request_trace_dir or self.config.slo is not None:
+            self.tracer = RequestTracer(
+                self.config.request_trace_dir,
+                sample_every=self.config.trace_sample_every,
+                slo=self.config.slo)
         self._slots: List[_SlotState] = [_SlotState() for _ in range(B)]
         # vectorized per-slot decode state (device args rebuilt per step)
         self._tokens = np.zeros((B,), np.int32)
@@ -330,6 +344,8 @@ class Engine:
                 f"prompt of {len(req.prompt_ids)} tokens leaves no room to "
                 f"generate within max_seq_len={self.config.max_seq_len}")
         self.scheduler.add(req)
+        if self.tracer is not None:
+            self.tracer.on_queued(req)
         return req
 
     @property
@@ -467,6 +483,8 @@ class Engine:
             _metrics.histogram("serving.prefill.seconds", now - t0)
             _metrics.histogram("serving.ttft.seconds", now - req.arrival_time)
             _metrics.counter("serving.tokens.generated", 1)
+            if self.tracer is not None:
+                self.tracer.on_prefill(req, t0, now)
             self._slots[slot].request = req
             self._tokens[slot] = tok
             self._positions[slot] = n  # first generated token's index
@@ -490,8 +508,8 @@ class Engine:
             jnp.asarray(self._temps), jnp.asarray(self._top_ks),
             jnp.asarray(self._greedy), key)
         nxt = np.asarray(nxt)
-        _metrics.histogram("serving.decode.step.seconds",
-                           time.perf_counter() - t0)
+        step_s = time.perf_counter() - t0
+        _metrics.histogram("serving.decode.step.seconds", step_s)
         _metrics.counter("serving.tokens.generated", len(running))
         for req in running:
             slot = req.slot
@@ -499,6 +517,9 @@ class Engine:
             req.output_ids.append(tok)
             self._tokens[slot] = tok
             self._positions[slot] += 1
+            self.scheduler.observe_decode_step(req, step_s)
+            if self.tracer is not None:
+                self.tracer.on_decode_step(req, step_s)
             self._maybe_finish(req, tok)
 
     def _maybe_finish(self, req: Request, tok: int):
@@ -514,6 +535,8 @@ class Engine:
             return
         slot = req.slot
         self.scheduler.finish(req, reason)
+        if self.tracer is not None:
+            self.tracer.on_finish(req)
         self._slots[slot].request = None
         self._tokens[slot] = 0
         self._positions[slot] = 0

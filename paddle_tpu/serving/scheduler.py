@@ -93,6 +93,13 @@ class Scheduler:
             _metrics.histogram("serving.tpot.seconds", tpot)
         self._export_gauges()
 
+    def observe_decode_step(self, request: Request, seconds: float):
+        """Per-step inter-token latency for one RUNNING request — the
+        finish-time tpot averages a whole generation, so a mid-request
+        stall (one slow decode step) vanishes into it; this histogram is
+        what the SLO monitor's decode_step check reads."""
+        _metrics.histogram("serving.decode.token.seconds", seconds)
+
     @property
     def has_unfinished(self) -> bool:
         return bool(self.waiting or self.running)
@@ -101,6 +108,8 @@ class Scheduler:
         if not _metrics.enabled():
             return
         _metrics.gauge("serving.queue.depth", len(self.waiting))
+        _metrics.gauge("serving.requests.active",
+                       len(self.waiting) + len(self.running))
         _metrics.gauge("serving.slots.active", len(self.running))
         _metrics.gauge("serving.slots.occupancy",
                        len(self.running) / max(1, self.num_slots))

@@ -27,6 +27,18 @@ def test_bench_row_contract(capsys):
                 "host_input", "other"):
         assert 0.0 <= bd[key] <= 1.0, (key, bd)
     assert parsed["step_ms"] > 0
+    # every row names its backend (perf_report.py --check skips rows whose
+    # backend mismatches the committed baseline's)
+    assert parsed["backend"] in ("cpu", "tpu", "axon", "cpu_fallback")
+    # roofline attribution sub-object: per-resource floors, a binding
+    # resource, and the predicted-vs-measured gap
+    attr = parsed["attribution"]
+    assert set(attr["floors_ms"]) <= {"compute", "hbm", "ici"}
+    assert attr["binding"] in attr["floors_ms"]
+    assert attr["floor_ms"] == max(attr["floors_ms"].values())
+    assert attr["measured_ms"] == pytest.approx(parsed["step_ms"], rel=0.02)
+    assert attr["gap"] >= 1.0 or attr["gap"] is None
+    assert attr["inputs"]["flops"] > 0
 
 
 def test_all_configs_registered():
@@ -208,6 +220,59 @@ def test_bench_analysis_row_contract(capsys):
     assert set(peaks) >= {"train_step", "serving_prefill", "serving_decode"}
     assert all(v >= 0 for v in peaks.values())
     assert peaks["train_step"] > 0
+
+
+def test_bench_serving_row_contract(capsys):
+    """The serving row's new acceptance invariants: SLO-violation counts
+    under the row's targets, and a sampled per-request trace file on disk
+    with span-structured records."""
+    import bench
+    from paddle_tpu.serving import read_request_traces
+
+    row = bench.bench_serving()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(out)
+    assert parsed == row
+    assert parsed["config"] == "serving"
+    assert parsed["value"] > 0 and np.isfinite(parsed["value"])
+    slo = parsed["slo"]
+    assert slo["ttft_target_ms"] > 0 and slo["tpot_target_ms"] > 0
+    # generous CI targets: a healthy run records no violations, and the
+    # counts dict is how a serving regression would surface
+    assert isinstance(slo["violations"], dict)
+    tr = parsed["request_trace"]
+    assert os.path.exists(tr["path"])
+    records = read_request_traces(tr["path"])
+    assert len(records) == tr["sampled"] > 0
+    assert tr["finished"] >= tr["sampled"]  # sample_every=2 downsampling
+    for rec in records:
+        assert [s["name"] for s in rec["spans"]] == \
+            ["queue", "prefill", "decode", "finish"]
+        assert rec["request_id"] >= 0
+    # decode-step roofline rides on the row too (measured side = TPOT p50)
+    assert parsed["attribution"]["binding"] in ("compute", "hbm")
+
+
+@pytest.mark.slow
+def test_perf_report_inject_gate():
+    """The perf-regression gate trips deterministically: --inject
+    synthesizes a row degraded 2.5x past the config's tolerance from the
+    committed baseline itself, and the gate must exit 1 naming it (the
+    lint_programs.py --inject pattern). The clean report exits 0."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "perf_report.py")]
+    r = subprocess.run(cmd + ["--check", "--inject", "gpt_dp", "--json"],
+                       capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 1, r.stdout[-2000:] + r.stderr[-2000:]
+    payload = json.loads(r.stdout)
+    assert payload["failed"] is True
+    assert [x["config"] for x in payload["check"]["regressions"]] == ["gpt_dp"]
+
+    r = subprocess.run(cmd + ["--json"], capture_output=True, text=True,
+                       cwd=REPO, timeout=120)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    payload = json.loads(r.stdout)
+    assert payload["failed"] is False
+    assert payload["reconciliation"]["ok"] is True
 
 
 @pytest.mark.slow

@@ -371,5 +371,115 @@ class TestServingMetrics:
         g = obs.snapshot()["gauges"]
         assert g["serving.queue.depth"] == 2 and g["serving.slots.active"] == 1
         assert g["serving.slots.occupancy"] == 0.5
+        # satellite: waiting + running in one gauge
+        assert g["serving.requests.active"] == 3
         s.finish(r, "length")
         assert obs.snapshot()["gauges"]["serving.slots.active"] == 0
+
+    def test_decode_token_latency_histogram(self, telemetry):
+        """Satellite: the scheduler records per-step decode latency per
+        running request — mid-request stall visibility, where the
+        finish-time tpot histogram only sees completed requests."""
+        m = _tiny()
+        eng = Engine(m, max_batch_size=2, max_seq_len=32)
+        eng.generate([[5, 17, 3], [9, 2, 4]],
+                     SamplingParams(max_new_tokens=4))
+        h = obs.snapshot()["histograms"]["serving.decode.token.seconds"]
+        # 2 requests x 3 post-first decode steps
+        assert h["count"] == 6
+        assert h["avg"] > 0
+
+
+# ---------------- per-request traces + SLO monitor -------------------------
+class TestRequestTracer:
+    def test_trace_file_spans_and_request_ids(self, tmp_path):
+        from paddle_tpu.serving import (EngineConfig, read_request_traces,
+                                        request_trace_path)
+
+        m = _tiny()
+        eng = Engine(m, EngineConfig(
+            max_batch_size=2, max_seq_len=32,
+            request_trace_dir=str(tmp_path)))
+        reqs = [eng.add_request([5, 17, 3]), eng.add_request([9, 2])]
+        while eng.has_unfinished:
+            eng.step()
+        path = request_trace_path(str(tmp_path), eng.tracer.host)
+        records = read_request_traces(path)
+        assert len(records) == 2
+        # request_id propagates from the scheduler into the trace records
+        assert {r["request_id"] for r in records} == \
+            {rq.request_id for rq in reqs}
+        for rec in records:
+            assert rec["schema"] == "paddle_tpu.requests.v1"
+            spans = rec["spans"]
+            assert [s["name"] for s in spans] == \
+                ["queue", "prefill", "decode", "finish"]
+            # lifecycle order: each span starts at/after the previous
+            starts = [s["start_s"] for s in spans]
+            assert starts == sorted(starts) and starts[0] == 0.0
+            assert all(s["dur_s"] >= 0 for s in spans)
+            assert spans[2]["steps"] == rec["generated_tokens"] - 1
+            assert rec["finish_reason"] == "length"
+            assert rec["ttft_s"] > 0
+
+    def test_slo_violations_and_flight_forensics(self, telemetry, tmp_path):
+        """Absurdly tight targets make every phase violate: the counters
+        carry per-phase counts and the violating request's full trace
+        lands in the flight recorder."""
+        from paddle_tpu.serving import EngineConfig, SLOConfig
+
+        fdir = tmp_path / "flight"
+        rec = obs.start_flight_recorder(str(fdir), flush_interval_s=3600)
+        try:
+            m = _tiny()
+            eng = Engine(m, EngineConfig(
+                max_batch_size=2, max_seq_len=32,
+                slo=SLOConfig(ttft_target_s=1e-9, tpot_target_s=1e-9,
+                              decode_step_target_s=1e-9)))
+            eng.generate([[5, 17, 3]], SamplingParams(max_new_tokens=3))
+            snap = obs.snapshot()
+            c = snap["counters"]
+            assert c["serving.slo.violations{phase=ttft}"] == 1
+            assert c["serving.slo.violations{phase=tpot}"] == 1
+            assert c["serving.slo.violations{phase=decode_step}"] >= 1
+            assert snap["histograms"][
+                "serving.slo.excess_seconds{phase=ttft}"]["count"] == 1
+            assert eng.tracer.stats()["violations"] == {
+                "ttft": 1, "tpot": 1, "decode_step": 2}
+            # no trace dir configured: SLO accounting ran file-less
+            assert eng.tracer.path is None
+        finally:
+            obs.stop_flight_recorder()
+        flight = obs.read_flight(rec.path)
+        viol = [e for e in flight["events"]
+                if e.get("kind") == "slo_violation"]
+        assert len(viol) == 1
+        assert set(viol[0]["slo_violations"]) == \
+            {"ttft", "tpot", "decode_step"}
+        assert [s["name"] for s in viol[0]["spans"]][0] == "queue"
+
+    def test_sampling_writes_every_nth(self, tmp_path):
+        from paddle_tpu.serving import EngineConfig, read_request_traces
+
+        m = _tiny()
+        eng = Engine(m, EngineConfig(
+            max_batch_size=2, max_seq_len=32,
+            request_trace_dir=str(tmp_path), trace_sample_every=2))
+        eng.generate([[1, 2], [3, 4], [5, 6], [7, 8]],
+                     SamplingParams(max_new_tokens=2))
+        st = eng.tracer.stats()
+        assert st["finished"] == 4 and st["written"] == 2
+        records = read_request_traces(st["path"])
+        assert len(records) == 2  # 1st and 3rd finished requests
+
+    def test_healthy_run_has_no_violations(self, telemetry):
+        from paddle_tpu.serving import EngineConfig, SLOConfig
+
+        m = _tiny()
+        eng = Engine(m, EngineConfig(
+            max_batch_size=2, max_seq_len=32,
+            slo=SLOConfig(ttft_target_s=60.0, tpot_target_s=60.0)))
+        eng.generate([[5, 17, 3]], SamplingParams(max_new_tokens=3))
+        assert eng.tracer.stats()["violations"] == {}
+        assert not any(k.startswith("serving.slo.violations")
+                       for k in obs.snapshot()["counters"])

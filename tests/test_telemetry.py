@@ -296,6 +296,40 @@ class TestAggregate:
         assert "Straggler view" in text
         assert "host 1" in text
 
+    # -- degenerate fleets: the crash-forensics inputs ---------------------
+    def test_zero_row_host_file(self, telemetry, tmp_path):
+        """An empty dump (host died before its first flush) merges as a
+        present-but-empty host, not a crash."""
+        p0 = _write_host_dump(tmp_path, 0, steps=5, step_seconds=[0.1])
+        empty = str(tmp_path / "metrics-host00001.jsonl")
+        open(empty, "w").close()
+        rep = obs_aggregate.fleet_report([p0, empty])
+        assert rep["counters"]["train.steps"]["total"] == 5
+        assert rep["counters"]["train.steps"]["per_host"] == {0: 5}
+        text = obs_aggregate.render_report(rep)
+        assert "host" in text  # renders without raising
+
+    def test_all_torn_tail_host(self, telemetry, tmp_path):
+        """A host whose every line is torn (killed mid-write, tiny file)
+        contributes nothing but must not poison the fleet merge."""
+        p0 = _write_host_dump(tmp_path, 0, steps=3, step_seconds=[0.2])
+        torn = str(tmp_path / "metrics-host00002.jsonl")
+        with open(torn, "w") as f:
+            f.write('{"schema": "paddle_tpu.metrics.v1", "counters": {"tr')
+        rep = obs_aggregate.fleet_report([p0, torn])
+        assert rep["counters"]["train.steps"]["total"] == 3
+        assert 2 not in rep["counters"]["train.steps"]["per_host"]
+
+    def test_single_host_straggler_ratio_is_one(self, telemetry, tmp_path):
+        """One-host fleet: every host IS the median — ratio must be exactly
+        1.0 with no div-by-zero on the zero-spread percentiles."""
+        p0 = _write_host_dump(tmp_path, 0, steps=2,
+                              step_seconds=[0.1, 0.1, 0.1])
+        rep = obs_aggregate.fleet_report([p0])
+        strag = [s for s in rep["stragglers"] if s["host"] == 0]
+        assert strag and strag[0]["ratio"] == pytest.approx(1.0)
+        assert strag[0]["delta_s"] == pytest.approx(0.0)
+
 
 # ---------------- HBM / memory accounting ---------------------------------
 class TestMemoryAccounting:
