@@ -9,6 +9,11 @@ Real data:   --data 'shards/*.bin' feeds packed [B, S] batches from the
              --save-steps N the data position rides in the checkpoint, so
              a restarted run resumes mid-epoch on the exact next batch.
 Multi-chip:  set dp/mp degrees; shardings compile through GSPMD.
+Elastic:     --elastic wraps the loop in the preemption-tolerant
+             supervisor (distributed.elastic): heartbeat liveness under
+             --heartbeat-dir, mesh re-formation on host loss (dp shrinks,
+             mp never), live reshard of the train state, data shards
+             re-dealt with exactly-once coverage re-validated.
 """
 
 import argparse
@@ -16,6 +21,114 @@ import os
 import time
 
 import numpy as np
+
+
+def _run_elastic(args, cfg):
+    """The same pretrain loop under the elastic supervisor. The step is a
+    closure over the MESH (rebuilt per re-formation); the batch is a pure
+    function of the step index when synthetic, so the loss trajectory is
+    identical at any world size."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import elastic as E
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import GPTForCausalLM
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+
+    def build_step(mesh):
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+        if on_tpu:
+            model = model.astype("bfloat16")
+        opt = paddle.optimizer.AdamW(
+            learning_rate=args.lr, parameters=model.parameters(),
+            multi_precision=on_tpu,
+            moment_dtype="bfloat16" if on_tpu else None)
+        return make_sharded_train_step(
+            model, opt, mesh=mesh, grad_reduce=args.grad_reduce,
+            accumulate_steps=args.accum or None)
+
+    # logical hosts: contiguous blocks of the visible devices (on a real
+    # fleet: one block per process); losing a block shrinks dp
+    n_dev = len(jax.devices())
+    n_hosts = max(1, min(args.elastic_hosts, n_dev))
+    per, extra = divmod(n_dev, n_hosts)
+    hosts, at = {}, 0
+    for h in range(n_hosts):
+        size = per + (1 if h < extra else 0)
+        hosts[h] = list(range(at, at + size))
+        at += size
+
+    build_data = None
+    if args.data:
+        from paddle_tpu.data import build_pretrain_pipeline
+
+        class _ElasticData:
+            """Pipeline + its live iterator: reassign/set_state restart
+            iteration (prefetched batches belong to the old world)."""
+
+            def __init__(self, pi, pc):
+                self.pipe = build_pretrain_pipeline(
+                    args.data, args.batch, args.seq, eos_id=args.eos_id,
+                    seed=0, process_index=pi, process_count=pc,
+                    device_feed=False)
+                self._it = iter(self.pipe)
+
+            def reassign(self, pi, pc, peer_progress=None):
+                self.pipe.reassign(pi, pc, peer_progress=peer_progress)
+                self._it = iter(self.pipe)
+
+            def get_state(self):
+                return self.pipe.get_state()
+
+            def set_state(self, state):
+                self.pipe.set_state(state)
+                self._it = iter(self.pipe)
+
+            def next_tokens(self):
+                return np.asarray(next(self._it)["tokens"])
+
+        build_data = _ElasticData
+
+    rng_cache = {}
+
+    def next_batch(i, data):
+        if data is not None:
+            x = data.next_tokens()
+        else:
+            rng = rng_cache.setdefault(i, np.random.RandomState(1000 + i))
+            x = rng.randint(0, cfg.vocab_size,
+                            size=(args.batch, args.seq), dtype=np.int32)
+        return x, np.roll(x, -1, axis=1)
+
+    mgr = None
+    if args.ckpt_dir:
+        from paddle_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(args.ckpt_dir, keep_last_n=3, async_=True)
+
+    ecfg = E.ElasticConfig(
+        axes={"dp": args.dp, "mp": args.mp}, hosts=hosts,
+        heartbeat_dir=args.heartbeat_dir, deadline_s=args.deadline_s,
+        save_every_steps=args.save_steps)
+    t0 = time.perf_counter()
+    try:
+        with E.ElasticRunner(build_step, ecfg, next_batch=next_batch,
+                             build_data=build_data,
+                             checkpoint_manager=mgr) as runner:
+            losses = runner.run(args.steps)
+            s = runner.summary()
+    finally:
+        if mgr is not None:
+            mgr.wait_until_finished()
+            mgr.close()
+    dt = time.perf_counter() - t0
+    print(f"step {args.steps - 1}: loss {losses[-1]:.4f}", flush=True)
+    print(f"done: {args.steps * args.batch * args.seq / dt:.0f} tokens/sec "
+          f"(elastic: {s['restarts']} restart(s), {s['steps_lost']} step(s) "
+          f"lost, world {s['hosts']} host(s) x axes {s['axes']})")
 
 
 def main():
@@ -54,6 +167,18 @@ def main():
                          "optimizer, AND data position)")
     ap.add_argument("--save-steps", type=int, default=0,
                     help="save to --ckpt-dir every N steps")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run under the preemption-tolerant supervisor "
+                         "(distributed.elastic): host loss shrinks dp and "
+                         "the run continues")
+    ap.add_argument("--elastic-hosts", type=int, default=2,
+                    help="logical hosts the devices split into (elastic "
+                         "failure domains)")
+    ap.add_argument("--heartbeat-dir", default=None,
+                    help="shared dir for heartbeat liveness files "
+                         "(elastic failure detection)")
+    ap.add_argument("--deadline-s", type=float, default=5.0,
+                    help="heartbeat staleness after which a host is dead")
     args = ap.parse_args()
     if args.smoke:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -82,6 +207,10 @@ def main():
         num_heads=args.heads, max_seq_len=args.seq, dropout=0.0,
         use_recompute=not args.smoke, recompute_interval=2, loss_chunk=0 if args.smoke else 128,
     )
+    if args.elastic:
+        _run_elastic(args, cfg)
+        return
+
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     on_tpu = jax.default_backend() in ("tpu", "axon")

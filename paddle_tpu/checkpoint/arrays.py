@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 import zlib
 from typing import Any, Dict, Optional
 
@@ -289,6 +290,15 @@ def merge_manifests(parts) -> dict:
     return merged
 
 
+# transient-I/O policy for restore reads: a flaky network filesystem (the
+# production checkpoint home) fails reads that succeed moments later, and a
+# preempted run's replacement must not die on the first EIO of a 10k-shard
+# restore. Counted as ckpt.restore.retries; exhaustion re-raises with the
+# shard path. Tests monkeypatch these.
+RESTORE_READ_RETRIES = 2         # extra attempts after the first failure
+RESTORE_RETRY_BACKOFF_S = 0.05   # doubles per attempt
+
+
 class _ShardReader:
     """Lazy, checksum-validating access to one array's saved shards.
 
@@ -308,11 +318,7 @@ class _ShardReader:
         self.global_shape = tuple(entry["global_shape"])
         self._cache: Dict[str, np.ndarray] = {}
 
-    def _load(self, shard: dict) -> np.ndarray:
-        data = self._cache.get(shard["file"])
-        if data is not None:
-            return data
-        fpath = os.path.join(self.directory, shard["file"])
+    def _read_validated(self, fpath: str, shard: dict) -> bytes:
         with open(fpath, "rb") as f:
             raw = f.read()
         if self.validate:
@@ -322,6 +328,30 @@ class _ShardReader:
                     f"checksum mismatch for {self.path!r} shard "
                     f"{shard['file']}: manifest {shard['crc32']:#x}, "
                     f"file {crc:#x} — checkpoint is corrupt")
+        return raw
+
+    def _load(self, shard: dict) -> np.ndarray:
+        data = self._cache.get(shard["file"])
+        if data is not None:
+            return data
+        fpath = os.path.join(self.directory, shard["file"])
+        retries = max(0, int(RESTORE_READ_RETRIES))
+        for attempt in range(retries + 1):
+            try:
+                raw = self._read_validated(fpath, shard)
+                break
+            except (OSError, IOError) as e:
+                # covers both the open/read syscall failing and a checksum
+                # mismatch (a torn page-cache read heals the same way)
+                if attempt == retries:
+                    raise IOError(
+                        f"restore of {self.path!r} failed after "
+                        f"{retries + 1} attempt(s) on shard file {fpath}: "
+                        f"{e}") from e
+                from ..observability import metrics as _metrics
+
+                _metrics.counter("ckpt.restore.retries")
+                time.sleep(RESTORE_RETRY_BACKOFF_S * (2.0 ** attempt))
         data = np.frombuffer(raw, dtype=self.dtype).reshape(shard["shape"])
         self._cache[shard["file"]] = data
         return data

@@ -26,12 +26,14 @@ from .protocol import (  # noqa: F401
     restore_iterator,
 )
 from .sources import (  # noqa: F401
+    CoverageError,
     JsonlSource,
     ShardedFileSource,
     TextLineSource,
     TokenBinSource,
     expand_files,
     shard_assignment,
+    validate_coverage,
 )
 from .packing import SequencePacker  # noqa: F401
 from .feed import GlobalBatchFeeder, batch_sharding  # noqa: F401
@@ -41,7 +43,7 @@ __all__ = [
     "CheckpointableIterator", "iterator_state", "restore_iterator",
     "mix_seed",
     "ShardedFileSource", "TokenBinSource", "JsonlSource", "TextLineSource",
-    "expand_files", "shard_assignment",
+    "expand_files", "shard_assignment", "validate_coverage", "CoverageError",
     "SequencePacker",
     "GlobalBatchFeeder", "batch_sharding",
     "DataPipeline", "build_pretrain_pipeline",

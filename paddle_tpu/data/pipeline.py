@@ -87,6 +87,28 @@ class DataPipeline(CheckpointableIterator):
         else:
             self._restore_stages(state)
 
+    # ---------------- elastic re-assignment ----------------
+    def reassign(self, process_index: int, process_count: int,
+                 peer_progress=None) -> "DataPipeline":
+        """Adopt a new fleet identity mid-epoch (elastic shrink/grow):
+        delegates to the source's ``reassign`` (exactly-once coverage
+        re-validated there). The packer's in-flight carry is kept — those
+        records were already drawn from the old assignment. Restart
+        iteration (``iter(pipeline)``) after reassigning: any prefetched
+        batches in a live feeder generator belong to the old world."""
+        if not hasattr(self.source, "reassign"):
+            raise TypeError(
+                f"source {type(self.source).__name__} does not support "
+                "elastic reassignment")
+        self.source.reassign(process_index, process_count,
+                             peer_progress=peer_progress)
+        return self
+
+    def shard_progress(self):
+        if not hasattr(self.source, "shard_progress"):
+            return None
+        return self.source.shard_progress()
+
     # ---------------- stats passthrough ----------------
     @property
     def packing_efficiency(self) -> float:

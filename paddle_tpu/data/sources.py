@@ -91,6 +91,36 @@ def shard_assignment(files: Sequence[str], process_index: int,
     return [files[i] for i in order[process_index::process_count]]
 
 
+class CoverageError(ValueError):
+    """The fleet's shard assignment is not a partition of the file list
+    (a shard unowned, or owned twice)."""
+
+
+def validate_coverage(files: Sequence[str], process_count: int,
+                      seed: int = 0, epoch: int = 0,
+                      shuffle: bool = True) -> dict:
+    """Prove the whole-fleet property for one epoch at one world size:
+    every file owned by EXACTLY one process. Cheap (pure python over the
+    file list), so the elastic runner re-runs it after every re-assignment
+    rather than trusting the construction. Returns {file: owner}."""
+    owners: dict = {}
+    dups = {}
+    for pi in range(int(process_count)):
+        for f in shard_assignment(files, pi, process_count, seed=seed,
+                                  epoch=epoch, shuffle=shuffle):
+            if f in owners:
+                dups.setdefault(f, [owners[f]]).append(pi)
+            else:
+                owners[f] = pi
+    missing = [f for f in files if f not in owners]
+    if missing or dups:
+        raise CoverageError(
+            f"shard assignment at process_count={process_count} epoch="
+            f"{epoch} is not a partition: {len(missing)} unowned file(s) "
+            f"{missing[:3]}..., {len(dups)} multiply-owned {dict(list(dups.items())[:3])}")
+    return owners
+
+
 class ShardedFileSource(CheckpointableIterator):
     """Base class: epoch/shard/offset bookkeeping over per-host file shards.
 
@@ -133,6 +163,10 @@ class ShardedFileSource(CheckpointableIterator):
         self._records: Optional[list] = None  # current shard's record index
         self._exhausted = False
         self._empty_epochs = 0  # consecutive rollovers with no records
+        # elastic residue (reassign mid-epoch): shards already consumed this
+        # epoch under the OLD identity, and partial offsets to resume at
+        self._epoch_done: set = set()
+        self._partial_resume: dict = {}
 
     # ---------------- subclass surface ----------------
     def _read_shard(self, path: str) -> list:
@@ -150,20 +184,33 @@ class ShardedFileSource(CheckpointableIterator):
         return self._epoch
 
     # ---------------- iteration ----------------
-    def _record_order(self, n: int) -> np.ndarray:
+    def _record_order(self, n: int, path: str) -> np.ndarray:
         if self.shuffle_records:
+            # salted by the shard's GLOBAL index, not the local cursor: the
+            # intra-shard order must be a property of the shard itself so a
+            # partially-read shard adopted by another host (elastic
+            # reassign) resumes the same sequence
             return np.random.RandomState(
-                mix_seed(self.seed, self._epoch, self._shard_cursor, 1)
+                mix_seed(self.seed, self._epoch, self.files.index(path), 1)
             ).permutation(n)
         return np.arange(n)
 
     def _load_current_shard(self) -> bool:
         """Position _records on the cursor's shard; False when the epoch is
-        done (cursor past the local list)."""
+        done (cursor past the local list). Shards another identity already
+        consumed this epoch are skipped; partially-consumed ones resume at
+        their recorded offset."""
         shards = self.local_shards()
         while self._shard_cursor < len(shards):
-            recs = self._read_shard(shards[self._shard_cursor])
-            order = self._record_order(len(recs))
+            path = shards[self._shard_cursor]
+            if path in self._epoch_done:
+                self._shard_cursor += 1
+                self._offset = 0
+                continue
+            if self._offset == 0 and path in self._partial_resume:
+                self._offset = int(self._partial_resume.pop(path))
+            recs = self._read_shard(path)
+            order = self._record_order(len(recs), path)
             recs = [recs[i] for i in order]
             if self._offset < len(recs):
                 self._records = recs[self._offset:]
@@ -198,25 +245,108 @@ class ShardedFileSource(CheckpointableIterator):
                 self._shard_cursor = 0
                 self._offset = 0
                 self._records = None
+                self._epoch_done.clear()       # elastic residue is per-epoch
+                self._partial_resume.clear()
                 if not self.repeat:
                     self._exhausted = True
                     raise StopIteration
 
+    # ---------------- elastic re-assignment ----------------
+    def shard_progress(self) -> dict:
+        """This identity's consumption of the CURRENT epoch: shards fully
+        read (``done``) and in-flight offsets (``partial``) — the unit a
+        surviving host hands to ``reassign`` so a dead peer's work isn't
+        replayed and a partial shard resumes instead of restarting."""
+        shards = self.local_shards()
+        done = set(self._epoch_done)
+        done.update(shards[:self._shard_cursor])
+        partial = {p: int(o) for p, o in self._partial_resume.items()}
+        if self._shard_cursor < len(shards) and self._offset > 0:
+            partial[shards[self._shard_cursor]] = self._offset
+        partial = {p: o for p, o in partial.items() if p not in done}
+        return {"epoch": self._epoch, "done": sorted(done),
+                "partial": partial}
+
+    def reassign(self, process_index: int, process_count: int,
+                 peer_progress=None, validate: bool = True
+                 ) -> "ShardedFileSource":
+        """Adopt a new fleet identity mid-epoch (elastic shrink/grow).
+
+        Re-deals the file list at the new ``(process_index,
+        process_count)`` and folds in epoch progress — this source's own
+        plus any ``peer_progress`` (``shard_progress()`` dicts from OTHER
+        former identities, e.g. recovered from a dead host's checkpoint) —
+        so already-consumed shards are skipped and cursor-carrying shards
+        resume at their offset rather than restarting. With ``validate``
+        (default) the new assignment is proven to be a partition via
+        ``validate_coverage`` before the switch. Calling ``set_state``
+        across a world-size change instead of this raises (see there):
+        that path silently skips/double-reads shards."""
+        process_index, process_count = int(process_index), int(process_count)
+        if not 0 <= process_index < process_count:
+            raise ValueError(
+                f"process_index {process_index} out of range for "
+                f"process_count {process_count}")
+        if len(self.files) < process_count:
+            raise ValueError(
+                f"{len(self.files)} shard file(s) cannot feed "
+                f"{process_count} processes disjointly")
+        progress = [self.shard_progress()]
+        for p in (peer_progress or []):
+            if int(p.get("epoch", -1)) == self._epoch:
+                progress.append(p)  # stale-epoch peer state is meaningless
+        if validate:
+            validate_coverage(self.files, process_count, seed=self.seed,
+                              epoch=self._epoch, shuffle=self.shuffle_shards)
+        done: set = set()
+        partial: dict = {}
+        for p in progress:
+            done.update(p.get("done") or [])
+            for path, off in (p.get("partial") or {}).items():
+                partial[path] = max(int(off), partial.get(path, 0))
+        self.process_index = process_index
+        self.process_count = process_count
+        self._epoch_done = done
+        self._partial_resume = {p: o for p, o in partial.items()
+                                if p not in done and o > 0}
+        self._shard_cursor = 0
+        self._offset = 0
+        self._records = None
+        self._exhausted = False
+        return self
+
     # ---------------- protocol ----------------
     def get_state(self) -> dict:
-        return {
+        state = {
             "version": _STATE_VERSION,
             "epoch": self._epoch,
             "shard_cursor": self._shard_cursor,
             "offset": self._offset,
+            "process_index": self.process_index,
+            "process_count": self.process_count,
         }
+        if self._epoch_done:
+            state["done_shards"] = sorted(self._epoch_done)
+        if self._partial_resume:
+            state["partial_shards"] = dict(self._partial_resume)
+        return state
 
     def set_state(self, state: dict) -> None:
+        pc = state.get("process_count")
+        if pc is not None and int(pc) != self.process_count:
+            raise ValueError(
+                f"state was written at process_count {pc} but this source "
+                f"runs at {self.process_count} — a blind restore would "
+                "skip or double-read shards; use reassign() for elastic "
+                "world-size changes")
         self._epoch = int(state["epoch"])
         self._shard_cursor = int(state["shard_cursor"])
         self._offset = int(state["offset"])
         self._records = None
         self._exhausted = False
+        self._epoch_done = set(state.get("done_shards") or [])
+        self._partial_resume = {k: int(v) for k, v in
+                                (state.get("partial_shards") or {}).items()}
 
 
 class TokenBinSource(ShardedFileSource):

@@ -1,0 +1,35 @@
+"""paddle_tpu.distributed.elastic: preemption-tolerant supervised training.
+
+See README.md in this directory for the failure model and the recovery
+state machine. Public surface:
+
+* ``ElasticRunner`` / ``ElasticConfig`` — the supervisor loop;
+* ``Heartbeater`` / ``HeartbeatLedger`` — file-based liveness;
+* ``reform`` / ``plan_axes`` / ``Unrecoverable`` — mesh re-formation;
+* ``HostLost`` / ``RestartBudgetExhausted`` — the typed failure surface.
+
+The legacy fleet elastic controller (``distributed/fleet/elastic.py``,
+etcd-backed ElasticManager) is superseded by this package — see
+MIGRATION.md.
+"""
+
+from .heartbeat import (  # noqa: F401
+    Heartbeater,
+    HeartbeatLedger,
+    heartbeat_path,
+    read_heartbeats,
+)
+from .reform import (  # noqa: F401
+    SHRINKABLE_AXES,
+    ReformPlan,
+    Unrecoverable,
+    plan_axes,
+    reform,
+)
+from .runner import (  # noqa: F401
+    ElasticConfig,
+    ElasticRunner,
+    HostLost,
+    RestartBudgetExhausted,
+    backoff_delay,
+)

@@ -1,0 +1,192 @@
+"""Heartbeat ledger: file-based liveness for the elastic supervisor.
+
+Failure detection reuses the observability tier's per-host file
+convention (``observability/export.py`` writes ``metrics-host%05d.jsonl``;
+``aggregate.py`` merges them): each host appends one JSON line per beat to
+``heartbeat-host%05d.jsonl`` in a shared directory, and the supervisor's
+``HeartbeatLedger`` declares a host stale when NEITHER its heartbeat file
+NOR its metrics-exporter dump has advanced within ``deadline_s``. Liveness
+is read from file mtimes (one ``stat`` per host per poll — no parsing on
+the hot path), so a wedged host (process alive, loop hung) and a killed
+host (no process at all) look identical to the detector: the file stops
+moving. That is exactly the failure model we want — progress, not process
+existence.
+
+File format (JSONL, ``paddle_tpu.heartbeat.v1``)::
+
+    {"schema": "paddle_tpu.heartbeat.v1", "host": 1, "pid": 4242,
+     "seq": 17, "step": 203, "ts": 1754500000.123}
+
+Fault injection for tests: ``Heartbeater.wedge()`` keeps the thread alive
+but stops the file from advancing — a deterministic "hung host" — and
+killing the whole process (the chaos harness's SIGKILL) stops it the hard
+way. Both are detected by the same staleness rule.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Dict, Iterable, List, Optional
+
+from ...observability.export import _default_host
+
+SCHEMA = "paddle_tpu.heartbeat.v1"
+
+# both spellings count as liveness evidence: a host running the metrics
+# exporter but no explicit heartbeater is still visibly alive
+_HOST_FILE_RE = re.compile(r"^(?:heartbeat|metrics)-host(\d+)\.jsonl$")
+
+
+def heartbeat_path(directory: str, host: int) -> str:
+    return os.path.join(directory, f"heartbeat-host{int(host):05d}.jsonl")
+
+
+class Heartbeater:
+    """Appends liveness beats for ONE host; optionally self-driving.
+
+    ``beat(step)`` appends a line synchronously (the supervisor calls it
+    after every completed step); ``start()`` adds a daemon thread that
+    keeps beating every ``interval_s`` even while the host is busy inside
+    a long compile. ``wedge()`` is the fault-injection hook: the object
+    stays alive but the file stops advancing.
+    """
+
+    def __init__(self, directory: str, host: Optional[int] = None,
+                 interval_s: float = 1.0):
+        self.host = _default_host() if host is None else int(host)
+        self.directory = directory
+        self.path = heartbeat_path(directory, self.host)
+        self.interval_s = float(interval_s)
+        self._seq = 0
+        self._step: Optional[int] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._wedged = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(directory, exist_ok=True)
+
+    def beat(self, step: Optional[int] = None):
+        if self._wedged.is_set():
+            return
+        with self._lock:
+            if step is not None:
+                self._step = int(step)
+            self._seq += 1
+            line = json.dumps({
+                "schema": SCHEMA, "host": self.host, "pid": os.getpid(),
+                "seq": self._seq, "step": self._step, "ts": time.time()})
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+
+    # -- fault injection --
+    def wedge(self):
+        """Stop the file from advancing without stopping the thread: the
+        deterministic 'hung host' for tests and the elastic bench."""
+        self._wedged.set()
+
+    def unwedge(self):
+        self._wedged.clear()
+
+    @property
+    def wedged(self) -> bool:
+        return self._wedged.is_set()
+
+    # -- lifecycle --
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            self.beat()
+
+    def start(self) -> "Heartbeater":
+        self.beat()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"pt-heartbeat-host{self.host}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "Heartbeater":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def read_heartbeats(path: str) -> List[dict]:
+    """Parse one host's heartbeat file; tolerates a torn final line (the
+    same contract as aggregate.load_host_dump — a SIGKILL mid-append must
+    not poison the ledger)."""
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+class HeartbeatLedger:
+    """Stale-host detection over a directory of per-host liveness files.
+
+    A host's ``last_seen`` is the newest mtime across its heartbeat and
+    metrics-exporter files; a host with NO file yet is measured from the
+    ledger's own start (so a host that never comes up is detected after
+    one deadline, not never). ``deadline_s`` should comfortably exceed
+    the beat interval plus the longest legitimate stall (compile time) —
+    the supervisor owns that trade-off, not this class.
+    """
+
+    def __init__(self, directory: str, deadline_s: float = 10.0):
+        self.directory = directory
+        self.deadline_s = float(deadline_s)
+        self._t0 = time.time()
+        os.makedirs(directory, exist_ok=True)
+
+    def last_seen(self) -> Dict[int, float]:
+        seen: Dict[int, float] = {}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return seen
+        for name in names:
+            m = _HOST_FILE_RE.match(name)
+            if not m:
+                continue
+            host = int(m.group(1))
+            try:
+                mtime = os.stat(os.path.join(self.directory, name)).st_mtime
+            except OSError:
+                continue  # racing a cleanup
+            seen[host] = max(seen.get(host, 0.0), mtime)
+        return seen
+
+    def ages(self, expected: Iterable[int],
+             now: Optional[float] = None) -> Dict[int, float]:
+        """Seconds since each expected host was last seen moving."""
+        now = time.time() if now is None else now
+        seen = self.last_seen()
+        return {int(h): now - seen.get(int(h), self._t0) for h in expected}
+
+    def stale_hosts(self, expected: Iterable[int],
+                    now: Optional[float] = None) -> List[int]:
+        return sorted(h for h, age in self.ages(expected, now).items()
+                      if age >= self.deadline_s)
+
+    def alive_hosts(self, expected: Iterable[int],
+                    now: Optional[float] = None) -> List[int]:
+        return sorted(h for h, age in self.ages(expected, now).items()
+                      if age < self.deadline_s)

@@ -1,0 +1,92 @@
+"""Mesh re-formation: the largest valid mesh over the surviving devices.
+
+GSPMD makes the compiled train step a pure function of (mesh, shardings),
+so elasticity reduces to a planning problem: given the declared
+parallelism axes and whatever devices survive, pick new axis sizes that
+(a) keep every NON-shrinkable axis at its declared size — model-parallel
+and pipeline factors are baked into parameter shapes and stage splits, a
+run cannot "shrink mp" without a different program — and (b) shrink the
+shrinkable axes (data parallelism first) until the mesh fits. When even
+the rigid axes alone exceed the surviving device count, recovery is
+impossible at this parallelism and ``Unrecoverable`` says so with the
+arithmetic in the message.
+
+The resulting mesh feeds straight back into ``make_sharded_train_step``,
+which re-derives the ``ShardingContract`` for the new topology; state
+follows via the resharding planner or checkpoint restore (runner.py).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Sequence, Tuple
+
+from ..mesh import build_mesh
+
+# dp is the one axis whose size is invisible to the program semantics
+# (batch rows redistribute; replica count is a throughput knob)
+SHRINKABLE_AXES: Tuple[str, ...] = ("dp",)
+
+
+class Unrecoverable(RuntimeError):
+    """The surviving topology cannot satisfy the declared parallelism:
+    shrinking only the shrinkable axes (dp) cannot make the mesh fit the
+    devices left. The supervisor must give up — restarting cannot help."""
+
+
+@dataclass
+class ReformPlan:
+    axes: Dict[str, int]                       # new axis sizes
+    mesh: object                               # jax Mesh over survivors
+    shrunk: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    dropped_devices: int = 0                   # survivors left out of the mesh
+
+    @property
+    def device_count(self) -> int:
+        return math.prod(self.axes.values()) if self.axes else 1
+
+
+def plan_axes(axes: Dict[str, int], n_devices: int,
+              shrinkable: Sequence[str] = SHRINKABLE_AXES) -> Dict[str, int]:
+    """New {axis: size} fitting ``n_devices``, shrinking only ``shrinkable``
+    axes (in their listed order, dp first) and raising ``Unrecoverable``
+    when the rigid axes alone don't fit."""
+    axes = {a: int(s) for a, s in axes.items()}
+    if any(s < 1 for s in axes.values()):
+        raise ValueError(f"axis sizes must be >= 1: {axes}")
+    rigid = {a: s for a, s in axes.items() if a not in shrinkable}
+    rigid_n = math.prod(rigid.values()) if rigid else 1
+    if n_devices < rigid_n:
+        raise Unrecoverable(
+            f"{n_devices} surviving device(s) cannot hold the"
+            f" non-shrinkable axes {rigid or '{}'} (need {rigid_n});"
+            " mp/pp factors are baked into the program — recovery at this"
+            " parallelism is impossible")
+    budget = n_devices // rigid_n
+    new = dict(axes)
+    order = [a for a in axes if a in shrinkable]
+    for i, a in enumerate(order):
+        rest = math.prod(new[b] for b in order[i + 1:]) if order[i + 1:] else 1
+        new[a] = min(new[a], max(1, budget // max(rest, 1)))
+    # later shrinkable axes were capped against already-shrunk earlier ones;
+    # a second squeeze (first-listed first) guarantees the product fits
+    for a in order:
+        while math.prod(new[b] for b in order) > budget and new[a] > 1:
+            new[a] -= 1
+    return new
+
+
+def reform(axes: Dict[str, int], devices: Sequence,
+           shrinkable: Sequence[str] = SHRINKABLE_AXES) -> ReformPlan:
+    """Plan + build the new mesh over ``devices`` (the survivors)."""
+    devices = list(devices)
+    if not devices:
+        raise Unrecoverable("no surviving devices")
+    new_axes = plan_axes(axes, len(devices), shrinkable)
+    mesh = build_mesh(new_axes, devices=devices)
+    shrunk = {a: (int(axes[a]), new_axes[a])
+              for a in axes if new_axes[a] != int(axes[a])}
+    used = math.prod(new_axes.values()) if new_axes else 1
+    return ReformPlan(axes=new_axes, mesh=mesh, shrunk=shrunk,
+                      dropped_devices=len(devices) - used)

@@ -1,0 +1,443 @@
+"""Elastic supervisor: detect host loss, re-form, reshard, continue.
+
+The runner closes the loop over substrate that already exists piecewise:
+
+* **detect** — ``HeartbeatLedger`` staleness over the per-host liveness
+  files (heartbeat.py), plus a ``fault_hook`` / ``inject_failure`` test
+  surface so chaos is deterministic;
+* **re-form** — ``reform()`` picks the largest valid mesh over the
+  surviving devices (dp shrinks first, rigid axes raise ``Unrecoverable``);
+* **migrate** — when the old ``ShardedTrainStep``'s state is still
+  device-resident it regrids live through the resharding planner
+  (``restore_from_checkpoint`` on the new step reshards every leaf);
+  otherwise the latest committed checkpoint restores straight onto the new
+  mesh. Either way the data source re-deals its file shards at the new
+  ``(process_index, process_count)`` via ``reassign`` with exactly-once
+  coverage re-validated;
+* **supervise** — bounded retries with exponential backoff + deterministic
+  jitter, a restart budget over a sliding window (clean give-up with a
+  final flight-recorder snapshot), and ``elastic.*`` metrics for every
+  phase so the bench can report recovery-time-to-first-step.
+
+Single-controller scope: this process owns every device jax can see, so a
+"host" here is a *logical* host — a named slice of the device list plus a
+liveness file. Losing one models preemption of that slice: its devices
+leave the mesh and its data shards re-deal to the survivors. The live
+regrid path corresponds to graceful preemption (state still resident);
+``migrate="checkpoint"`` models the hard-kill case where device state is
+gone. On a real multi-host fleet the same supervisor runs on the
+controller with ``hosts`` mapping to per-process device blocks.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ...observability import flight_recorder as _flight
+from ...observability import metrics as _metrics
+from .heartbeat import Heartbeater, HeartbeatLedger
+from .reform import SHRINKABLE_AXES, ReformPlan, Unrecoverable, reform
+
+
+class HostLost(RuntimeError):
+    """Raised (by fault hooks or the step wrapper) to report dead hosts."""
+
+    def __init__(self, hosts, reason: str = "injected"):
+        self.hosts = sorted({int(h) for h in (
+            hosts if isinstance(hosts, (list, tuple, set, frozenset))
+            else [hosts])})
+        self.reason = reason
+        super().__init__(f"host(s) {self.hosts} lost: {reason}")
+
+
+class RestartBudgetExhausted(RuntimeError):
+    """Too many failures inside the restart window: the supervisor gave up
+    cleanly (final flight-recorder snapshot written) rather than thrash."""
+
+
+@dataclass
+class ElasticConfig:
+    """Knobs for the supervisor. ``axes`` is the DECLARED parallelism
+    ({"dp": 2, "mp": 1, ...}); only ``shrinkable_axes`` may shrink on
+    reform. ``hosts`` maps logical host id -> indices into jax.devices()
+    (default: one host owning every device)."""
+
+    axes: Dict[str, int]
+    hosts: Optional[Dict[int, Sequence[int]]] = None
+    shrinkable_axes: Sequence[str] = SHRINKABLE_AXES
+    self_host: int = 0
+    # failure detection
+    heartbeat_dir: Optional[str] = None
+    heartbeat_interval_s: float = 0.5
+    deadline_s: float = 5.0
+    # retry policy
+    max_restarts: int = 3
+    restart_window_s: float = 300.0
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    backoff_jitter: float = 0.25
+    seed: int = 0
+    # state migration: "auto" tries live regrid then checkpoint; "live" /
+    # "checkpoint" force one path (checkpoint = the hard-kill model)
+    migrate: str = "auto"
+    save_every_steps: int = 0
+
+
+def backoff_delay(cfg: ElasticConfig, attempt: int) -> float:
+    """Exponential backoff with deterministic jitter: attempt k sleeps
+    ``min(base * 2**k, max) * (1 + U[0, jitter))`` where U comes from an
+    rng seeded by (cfg.seed, k) — reproducible across reruns, decorrelated
+    across supervisors with different seeds."""
+    base = min(cfg.backoff_max_s, cfg.backoff_base_s * (2.0 ** attempt))
+    u = random.Random((cfg.seed * 1_000_003 + attempt) & 0xFFFFFFFF).random()
+    return base * (1.0 + u * cfg.backoff_jitter)
+
+
+class ElasticRunner:
+    """Supervised train loop over ``build_step(mesh) -> ShardedTrainStep``.
+
+    ``next_batch(step_index, data) -> (x, y)`` supplies the global batch;
+    making it a pure function of the step index keeps the loss trajectory
+    identical across world sizes (the chaos harness's acceptance check).
+    ``build_data(process_index, process_count)`` (optional) builds the
+    host's input pipeline; on reform it is re-dealt via ``reassign`` when
+    the object supports it, else rebuilt at the new identity.
+    """
+
+    def __init__(self, build_step: Callable[[Any], Any], config: ElasticConfig,
+                 *, next_batch: Callable[[int, Any], Tuple],
+                 build_data: Optional[Callable[[int, int], Any]] = None,
+                 checkpoint_manager=None,
+                 fault_hook: Optional[Callable[["ElasticRunner"], None]] = None):
+        import jax
+
+        self._jax = jax
+        self.build_step = build_step
+        self.cfg = config
+        self.next_batch = next_batch
+        self.build_data = build_data
+        self.manager = checkpoint_manager
+        self.fault_hook = fault_hook
+        hosts = config.hosts
+        if hosts is None:
+            hosts = {int(config.self_host): list(range(len(jax.devices())))}
+        self.hosts = {int(h): list(idx) for h, idx in hosts.items()}
+        if int(config.self_host) not in self.hosts:
+            raise ValueError(f"self_host {config.self_host} not in hosts "
+                             f"{sorted(self.hosts)}")
+        self.alive = set(self.hosts)
+        self.step = None
+        self.data = None
+        self.plan: Optional[ReformPlan] = None
+        self.losses: Dict[int, float] = {}
+        self.restarts = 0
+        self.steps_lost = 0
+        self._next_step = 0
+        self._pending_lost: Dict[int, str] = {}
+        self._failure_times: deque = deque()
+        self._recovery_t0: Optional[float] = None
+        self.last_detection_s: Optional[float] = None
+        self.last_recovery_s: Optional[float] = None
+        self.last_recovery_to_first_step_s: Optional[float] = None
+        self.heartbeater: Optional[Heartbeater] = None
+        self.ledger: Optional[HeartbeatLedger] = None
+        if config.heartbeat_dir:
+            self.ledger = HeartbeatLedger(config.heartbeat_dir,
+                                          deadline_s=config.deadline_s)
+            if config.heartbeat_interval_s > 0:
+                self.heartbeater = Heartbeater(
+                    config.heartbeat_dir, host=config.self_host,
+                    interval_s=config.heartbeat_interval_s).start()
+
+    # ---------------- world bookkeeping ----------------
+    @property
+    def world(self) -> Tuple[int, int]:
+        """(alive hosts, alive devices)."""
+        return len(self.alive), sum(len(self.hosts[h]) for h in self.alive)
+
+    def _alive_devices(self) -> List:
+        devs = self._jax.devices()
+        return [devs[i] for h in sorted(self.alive) for i in self.hosts[h]]
+
+    def _self_rank(self) -> int:
+        return sorted(self.alive).index(int(self.cfg.self_host))
+
+    def _gauges(self):
+        hosts, devices = self.world
+        _metrics.gauge("elastic.world.hosts", hosts)
+        _metrics.gauge("elastic.world.devices", devices)
+
+    # ---------------- failure intake ----------------
+    def inject_failure(self, *hosts: int, reason: str = "injected"):
+        """Deterministic fault injection: mark hosts dead as of the next
+        supervisor poll (tests and the chaos harness drive this)."""
+        for h in hosts:
+            self._pending_lost.setdefault(int(h), reason)
+
+    def _poll_failures(self) -> Dict[int, str]:
+        lost = {h: r for h, r in self._pending_lost.items() if h in self.alive}
+        self._pending_lost.clear()
+        if self.ledger is not None:
+            expected = [h for h in self.alive if h != int(self.cfg.self_host)]
+            ages = self.ledger.ages(expected)
+            for h, age in ages.items():
+                if age >= self.ledger.deadline_s and h not in lost:
+                    lost[h] = f"heartbeat stale {age:.2f}s"
+                    self.last_detection_s = age
+                    _metrics.histogram("elastic.detection_seconds", age)
+        return lost
+
+    # ---------------- retry policy ----------------
+    def _register_failure(self, cause: str):
+        now = time.monotonic()
+        self._failure_times.append(now)
+        window = self.cfg.restart_window_s
+        while self._failure_times and now - self._failure_times[0] > window:
+            self._failure_times.popleft()
+        if len(self._failure_times) > self.cfg.max_restarts:
+            n = len(self._failure_times)
+            _metrics.counter("elastic.budget.exhausted")
+            self._final_snapshot(
+                "elastic_budget_exhausted",
+                detail={"failures_in_window": n, "window_s": window,
+                        "max_restarts": self.cfg.max_restarts,
+                        "cause": cause})
+            raise RestartBudgetExhausted(
+                f"{n} failures within {window:.0f}s exceeds max_restarts="
+                f"{self.cfg.max_restarts} (last cause: {cause}) — giving up")
+
+    def _final_snapshot(self, reason: str, detail: Optional[dict] = None):
+        """The clean give-up: one structured event + finalize the flight
+        recorder so the dead run leaves its black box behind."""
+        _flight.record_event({
+            "kind": "elastic", "event": reason,
+            "restarts": self.restarts, "steps_lost": self.steps_lost,
+            "alive_hosts": sorted(self.alive), **(detail or {})})
+        rec = _flight.get_flight_recorder()
+        if rec is not None:
+            rec.finalize(reason)
+
+    # ---------------- build / migrate ----------------
+    def _make_data(self):
+        if self.build_data is None:
+            return None
+        return self.build_data(self._self_rank(), len(self.alive))
+
+    def _start(self):
+        plan = reform(self.cfg.axes, self._alive_devices(),
+                      self.cfg.shrinkable_axes)
+        self.step = self.build_step(plan.mesh)
+        self.data = self._make_data()
+        self.plan = plan
+        if self.manager is not None and self.manager.latest_step() is not None:
+            tree = self.manager.restore(
+                shardings=self.step.checkpoint_shardings())
+            self.step.restore_from_checkpoint(tree)
+            self._restore_data_position(tree)
+        self._next_step = int(self.step.step_index)
+        self._gauges()
+
+    def _restore_data_position(self, tree):
+        pos = tree.get("data_position") if isinstance(tree, dict) else None
+        if pos is None or self.data is None:
+            return
+        try:
+            self.data.set_state(pos)
+        except Exception:
+            # identity mismatch (checkpoint written at another world size):
+            # re-deal at the current identity instead of resuming blind
+            if hasattr(self.data, "reassign"):
+                self.data.reassign(self._self_rank(), len(self.alive))
+
+    def _rebuild(self):
+        """One recovery attempt: re-form mesh, rebuild step, migrate state
+        (live regrid first, checkpoint fallback), re-deal data shards."""
+        old_step, old_plan = self.step, self.plan
+        t0 = time.perf_counter()
+        plan = reform(self.cfg.axes, self._alive_devices(),
+                      self.cfg.shrinkable_axes)
+        new_step = self.build_step(plan.mesh)
+        _metrics.histogram("elastic.reform_seconds", time.perf_counter() - t0)
+
+        migrated = None
+        if self.cfg.migrate in ("auto", "live") and old_step is not None:
+            try:
+                t0 = time.perf_counter()
+                new_step.restore_from_checkpoint(
+                    old_step.state_for_checkpoint())
+                _metrics.histogram("elastic.reshard_seconds",
+                                   time.perf_counter() - t0)
+                migrated = "live"
+            except Exception:
+                if self.cfg.migrate == "live":
+                    raise
+                # donated-then-failed or device-gone state: fall through to
+                # the checkpoint path
+        tree = None
+        if migrated is None and self.manager is not None \
+                and self.manager.latest_step() is not None:
+            t0 = time.perf_counter()
+            tree = self.manager.restore(
+                shardings=new_step.checkpoint_shardings())
+            new_step.restore_from_checkpoint(tree)
+            _metrics.histogram("elastic.restore_seconds",
+                               time.perf_counter() - t0)
+            migrated = "checkpoint"
+        if migrated is None:
+            raise Unrecoverable(
+                "no live TrainState survives and no committed checkpoint "
+                "exists — nothing to migrate the run from")
+
+        lost = max(0, self._next_step - int(new_step.step_index))
+        if lost:
+            self.steps_lost += lost
+            _metrics.counter("elastic.lost_steps", lost)
+        for ax, (old, new) in plan.shrunk.items():
+            if old_plan is None or old_plan.axes.get(ax) != new:
+                _metrics.counter("elastic.shrink_events", 1, axis=ax)
+        self.step, self.plan = new_step, plan
+        self._next_step = int(new_step.step_index)
+
+        rank, count = self._self_rank(), len(self.alive)
+        if self.data is not None and hasattr(self.data, "reassign"):
+            # exactly-once coverage is re-validated inside reassign
+            self.data.reassign(rank, count)
+        elif self.build_data is not None:
+            self.data = self._make_data()
+        if migrated == "checkpoint":
+            self._restore_data_position(tree)
+        hosts, devices = self.world
+        _flight.record_event({
+            "kind": "elastic", "event": "recovered", "mode": migrated,
+            "axes": dict(plan.axes), "hosts": hosts, "devices": devices,
+            "resume_step": self._next_step, "steps_lost": lost})
+        self._gauges()
+
+    def _recover(self, lost: Dict[int, str]):
+        t_rec = time.perf_counter()
+        cause = "; ".join(f"host {h}: {r}" for h, r in sorted(lost.items())) \
+            or "step failure"
+        if lost:
+            self.alive -= set(lost)
+            _metrics.counter("elastic.hosts_lost", len(lost))
+            _flight.record_event({"kind": "elastic", "event": "host_lost",
+                                  "hosts": sorted(lost), "cause": cause})
+        if int(self.cfg.self_host) not in self.alive:
+            self._final_snapshot("elastic_self_host_lost")
+            raise Unrecoverable("the supervisor's own host is gone")
+        self._register_failure(cause)
+        attempt = 0
+        while True:
+            try:
+                self._rebuild()
+                break
+            except Unrecoverable:
+                self._final_snapshot("elastic_unrecoverable",
+                                     detail={"cause": cause})
+                raise
+            except (RestartBudgetExhausted, KeyboardInterrupt):
+                raise
+            except Exception as e:  # transient rebuild failure: back off
+                lost = self._poll_failures()
+                if lost:  # more hosts died while rebuilding
+                    self.alive -= set(lost)
+                    cause = "; ".join(
+                        f"host {h}: {r}" for h, r in sorted(lost.items()))
+                self._register_failure(f"rebuild failed: {e!r}")
+                delay = backoff_delay(self.cfg, attempt)
+                _metrics.histogram("elastic.backoff_seconds", delay)
+                time.sleep(delay)
+                attempt += 1
+        self.restarts += 1
+        _metrics.counter("elastic.restarts")
+        self.last_recovery_s = time.perf_counter() - t_rec
+        _metrics.histogram("elastic.recovery_seconds", self.last_recovery_s)
+        self._recovery_t0 = t_rec
+
+    # ---------------- checkpointing ----------------
+    def save(self, force: bool = False):
+        if self.manager is None or self.step is None:
+            return
+        ts = self.step.state_for_checkpoint()
+        if self.data is not None and hasattr(self.data, "get_state"):
+            ts.data_position = self.data.get_state()
+        self.manager.save(int(self.step.step_index), ts.to_tree(),
+                          force=force)
+
+    # ---------------- the supervised loop ----------------
+    def run(self, num_steps: int, lr: Optional[float] = None) -> List[float]:
+        """Run until ``num_steps`` optimizer steps are committed; returns
+        the per-step loss trajectory. Steps replayed after a checkpoint
+        restore overwrite their entries, so the returned list is the
+        final trajectory regardless of how many recoveries happened."""
+        if self.step is None:
+            self._start()
+        save_every = int(self.cfg.save_every_steps or 0)
+        while self._next_step < num_steps:
+            if self.fault_hook is not None:
+                try:
+                    self.fault_hook(self)
+                except HostLost as e:
+                    for h in e.hosts:
+                        self._pending_lost.setdefault(h, e.reason)
+            lost = self._poll_failures()
+            if lost:
+                self._recover(lost)
+                continue
+            i = self._next_step
+            x, y = self.next_batch(i, self.data)
+            try:
+                loss = self.step(x, y) if lr is None else self.step(x, y, lr)
+            except (Unrecoverable, RestartBudgetExhausted,
+                    KeyboardInterrupt):
+                raise
+            except HostLost as e:
+                for h in e.hosts:
+                    self._pending_lost.setdefault(h, e.reason)
+                continue
+            except Exception as e:
+                _flight.record_event({"kind": "elastic",
+                                      "event": "step_error", "step": i,
+                                      "error": repr(e)})
+                self._recover({})
+                continue
+            self.losses[i] = float(loss)
+            self._next_step = i + 1
+            if self.heartbeater is not None:
+                self.heartbeater.beat(i)
+            if self._recovery_t0 is not None:
+                self.last_recovery_to_first_step_s = (
+                    time.perf_counter() - self._recovery_t0)
+                _metrics.histogram("elastic.recovery_to_first_step_seconds",
+                                   self.last_recovery_to_first_step_s)
+                self._recovery_t0 = None
+            if save_every and self._next_step % save_every == 0:
+                self.save(force=True)
+        return [self.losses[i] for i in range(num_steps)]
+
+    def summary(self) -> Dict[str, Any]:
+        hosts, devices = self.world
+        return {
+            "restarts": self.restarts,
+            "steps_lost": self.steps_lost,
+            "hosts": hosts,
+            "devices": devices,
+            "axes": dict(self.plan.axes) if self.plan else None,
+            "detection_s": self.last_detection_s,
+            "recovery_s": self.last_recovery_s,
+            "recovery_to_first_step_s": self.last_recovery_to_first_step_s,
+        }
+
+    def close(self):
+        if self.heartbeater is not None:
+            self.heartbeater.stop()
+
+    def __enter__(self) -> "ElasticRunner":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -901,6 +901,17 @@ class ShardedTrainStep:
 
     step = __call__
 
+    @property
+    def step_index(self) -> int:
+        """Optimizer steps completed so far (checkpoint restore rewinds
+        this; the elastic supervisor resumes its loop from it)."""
+        return self._step_i
+
+    def axis_sizes(self) -> Dict[str, int]:
+        """{axis: size} of this step's mesh — the declared-parallelism
+        view mesh re-formation plans against."""
+        return dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+
     def loss_scaling(self) -> float:
         """Current dynamic loss scale (1.0 when no scaler is attached)."""
         if self.scaler_state is None:

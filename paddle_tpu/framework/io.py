@@ -178,10 +178,19 @@ _auto_ckpt_state = {}
 
 def enable_auto_checkpoint(path: str, state_fn=None, layer=None, optimizer=None,
                            every_n_steps: int = 0, keep_last_n: int = None,
-                           data_loader=None):
+                           data_loader=None, sigterm_deadline_s: float = None):
     """Install a SIGTERM handler that snapshots training state before the
     process dies (preemption on TPU VMs delivers SIGTERM), plus an optional
     step-driven periodic save via `auto_checkpoint_step()`.
+
+    ``sigterm_deadline_s`` bounds the SIGTERM save against the preemption
+    grace window (TPU spot VMs give ~30s between SIGTERM and the hard
+    kill): the collect+save+publish runs on a worker thread and, if it
+    hasn't committed inside the deadline, the handler abandons it — an
+    uncommitted step directory is invisible to restore, so the previous
+    committed step stays the resume point — finalizes the flight recorder
+    (the black box still lands) and exits. Without a deadline the save
+    blocks to completion, however long that takes.
 
     Target selection: a `path` WITH a file extension (``run/auto.pdparams``)
     keeps the legacy single-file pickle contract; a `path` without one is
@@ -220,7 +229,7 @@ def enable_auto_checkpoint(path: str, state_fn=None, layer=None, optimizer=None,
 
         mgr = CheckpointManager(path, keep_last_n=keep_last_n, async_=True)
 
-    def on_sigterm(signum, frame):
+    def publish_final():
         if mgr is not None:
             # publish the final state under the step counter, atomically
             mgr.save(_auto_ckpt_state.get("step", 0), collect(), force=True)
@@ -228,6 +237,35 @@ def enable_auto_checkpoint(path: str, state_fn=None, layer=None, optimizer=None,
         else:
             wait_async_saves()  # let in-flight periodic saves publish first
             save(collect(), path)
+
+    def on_sigterm(signum, frame):
+        if sigterm_deadline_s is None:
+            publish_final()
+        else:
+            import threading
+
+            from ..observability import flight_recorder as _flight
+            from ..observability import metrics as _metrics
+
+            done = threading.Event()
+
+            def worker():
+                try:
+                    publish_final()
+                finally:
+                    done.set()
+
+            t = threading.Thread(target=worker, daemon=True,
+                                 name="pt-sigterm-ckpt")
+            t.start()
+            if not done.wait(float(sigterm_deadline_s)):
+                # grace budget blown: abandon the save (no COMMIT marker ->
+                # the torn step dir is invisible to restore) and leave only
+                # the flight recorder's final snapshot behind
+                _metrics.counter("ckpt.sigterm.deadline_blown")
+                rec = _flight.get_flight_recorder()
+                if rec is not None:
+                    rec.finalize("sigterm_deadline")
         prev = _auto_ckpt_state.get("prev_handler")
         if callable(prev):
             prev(signum, frame)

@@ -1,0 +1,51 @@
+"""Subprocess worker for the sigterm_deadline_s tests (test_elastic.py).
+
+Enables auto-checkpoint with a deliberately slow/wedged state collector
+and a short SIGTERM deadline, starts a flight recorder, prints READY and
+waits to be SIGTERMed. The parent asserts: prompt exit 143, NO committed
+checkpoint step (the save was abandoned), and a finalized flight file.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu import observability  # noqa: E402
+from paddle_tpu.framework import io as fio  # noqa: E402
+from paddle_tpu.observability import flight_recorder as flight  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--flight", required=True)
+    ap.add_argument("--deadline-s", type=float, default=0.5)
+    ap.add_argument("--collect-s", type=float, default=60.0,
+                    help="how long state_fn wedges before returning")
+    args = ap.parse_args()
+
+    observability.enable()
+    flight.start_flight_recorder(args.flight, flush_interval_s=60.0)
+    flight.record_event({"kind": "test", "event": "worker_up",
+                         "pid": os.getpid()})
+
+    def slow_state():
+        time.sleep(args.collect_s)  # models a wedged device->host snapshot
+        return {"w": np.arange(4.0)}
+
+    fio.enable_auto_checkpoint(args.ckpt_dir, state_fn=slow_state,
+                               sigterm_deadline_s=args.deadline_s)
+    fio._auto_ckpt_state["step"] = 7
+    print("READY", flush=True)
+    time.sleep(120)  # parent SIGTERMs long before this
+    print("TIMEOUT_NO_SIGNAL", flush=True)
+    sys.exit(99)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Victim "host" for the chaos harness (test_elastic_chaos.py).
+
+Stdlib-only (fast startup, nothing to import but json): appends heartbeat
+lines in the paddle_tpu.heartbeat.v1 format until killed. SIGKILL stops
+the file cold (the hard-preemption model); SIGTERM writes one final
+goodbye beat and exits 143 (the graceful-preemption model). Either way
+the supervisor's HeartbeatLedger sees the same thing — the file stops
+moving — which is exactly the failure signal under test.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def _beat(path, host, seq, **extra):
+    line = {"schema": "paddle_tpu.heartbeat.v1", "host": host,
+            "pid": os.getpid(), "seq": seq, "step": None,
+            "ts": time.time(), **extra}
+    with open(path, "a") as f:
+        f.write(json.dumps(line) + "\n")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--host", type=int, required=True)
+    ap.add_argument("--interval-s", type=float, default=0.05)
+    args = ap.parse_args()
+    os.makedirs(args.dir, exist_ok=True)
+    path = os.path.join(args.dir, f"heartbeat-host{args.host:05d}.jsonl")
+    state = {"seq": 0}
+
+    def on_term(signum, frame):
+        state["seq"] += 1
+        _beat(path, args.host, state["seq"], final=True)
+        sys.exit(143)
+
+    signal.signal(signal.SIGTERM, on_term)
+    _beat(path, args.host, state["seq"])
+    print("READY", flush=True)
+    while True:
+        state["seq"] += 1
+        _beat(path, args.host, state["seq"])
+        time.sleep(args.interval_s)
+
+
+if __name__ == "__main__":
+    main()

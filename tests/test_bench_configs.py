@@ -47,7 +47,7 @@ def test_all_configs_registered():
     assert set(bench.CONFIGS) == {"bert_sst2", "gpt_dp", "ernie_mp4",
                                   "resnet50", "gpt_moe", "serving", "ckpt",
                                   "data", "comm", "reshard", "obs",
-                                  "analysis"}
+                                  "analysis", "elastic"}
 
 
 def test_bench_ckpt_row_contract(capsys):
@@ -251,6 +251,41 @@ def test_bench_serving_row_contract(capsys):
         assert rec["request_id"] >= 0
     # decode-step roofline rides on the row too (measured side = TPOT p50)
     assert parsed["attribution"]["binding"] in ("compute", "hbm")
+
+
+def test_bench_elastic_row_contract(capsys):
+    """The elastic row's acceptance invariant (ISSUE 12): a host dies
+    mid-run and the row reports the recovery pipeline phase by phase —
+    detection via heartbeat staleness (>= the 300ms deadline), mesh
+    re-formation, live reshard, and the headline recovery time to the
+    first completed step at the shrunk world — with exactly one restart
+    and the elastic.* series in the telemetry sub-object."""
+    import bench
+    from paddle_tpu import observability
+
+    row = bench.bench_elastic()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(out)
+    assert parsed == row
+    assert parsed["config"] == "elastic"
+    assert parsed["metric"] == "recovery_time_to_first_step_ms"
+    assert parsed["value"] > 0 and np.isfinite(parsed["value"])
+    assert parsed["detection_ms"] >= 300.0  # found by staleness, not luck
+    assert parsed["reform_ms"] > 0 and parsed["reshard_ms"] > 0
+    assert parsed["recovery_ms"] > 0
+    assert parsed["value"] >= parsed["recovery_ms"]  # + first-step compile
+    assert parsed["restarts"] == 1
+    assert parsed["steps_lost"] == 0  # live regrid loses nothing
+    assert parsed["world"]["hosts"] == 1
+    tele = parsed["telemetry"]
+    assert tele["counters"]["elastic.restarts"] == 1
+    assert tele["counters"]["elastic.hosts_lost"] == 1
+    assert tele["histograms"]["elastic.detection_seconds"]["count"] >= 1
+    assert tele["histograms"]["elastic.recovery_to_first_step_seconds"][
+        "count"] == 1
+    assert tele["gauges"]["elastic.world.hosts"] == 1
+    # the row must not leave the global observability flag flipped on
+    assert not observability.enabled()
 
 
 @pytest.mark.slow
