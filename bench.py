@@ -768,6 +768,33 @@ def bench_serving():
     out["request_trace"] = {"path": tstats["path"],
                             "sampled": tstats["written"],
                             "finished": tstats["finished"]}
+    # capacity at a FIXED HBM budget (the dense cache's bytes for this
+    # envelope): dense reserves B_max * S_max rows up front so it admits
+    # exactly B_max concurrent requests; the paged pool admits by live
+    # tokens — count real admissions through the page allocator until it
+    # backpressures. This is the row the paged-KV tentpole is judged by.
+    from paddle_tpu.serving.scheduler import PageAllocator
+
+    pc = engine.cache
+    ps = pc.page_size
+    itemsize = pc.k.dtype.itemsize
+    dense_bytes = (pc.num_layers * B * pc.num_kv_heads * cfg.max_seq_len
+                   * pc.head_dim * itemsize * 2)
+    page_bytes = pc.num_layers * pc.num_kv_heads * ps * pc.head_dim \
+        * itemsize * 2  # one page id spans every layer's pools
+    tokens_per_req = prompt_len + max_new
+    pages_per_req = -(-tokens_per_req // ps)
+    alloc = PageAllocator(max(2, dense_bytes // page_bytes))
+    paged_capacity = 0
+    while alloc.alloc(pages_per_req) is not None:
+        paged_capacity += 1
+    out["concurrent_requests_per_chip"] = {
+        "hbm_budget_bytes": dense_bytes,
+        "tokens_per_request": tokens_per_req,
+        "page_size": ps,
+        "dense": B,
+        "paged": paged_capacity,
+    }
     # decode-step roofline: the batched decode reads every weight once per
     # token (the classic HBM-bound regime); measured side = TPOT p50
     from paddle_tpu.observability import attribution as _attr

@@ -98,17 +98,21 @@ def _serving_specs() -> List[ProgramSpec]:
     contract = SiteContract(one_compile=True,
                             donate_argnums=KV_DONATE_ARGNUMS,
                             donation_threshold=4096)
+    # the engine defaults to the block-paged KV layout: prefill scatters
+    # the prompt into the slot's pages (page_row replaces the dense slot
+    # index) and decode carries the [B, num_blocks] page table as runtime
+    # data — same one-compile + donation contract as the dense layout had
     pre_fn, pre_args = eng.prefill_program(8)
     dec_fn, dec_args = eng.decode_program()
     return [
         ProgramSpec("serving_prefill", pre_fn, pre_args, contract,
-                    argnames=("params", "k_cache", "v_cache", "ids",
-                              "slot", "length"),
+                    argnames=("params", "k_pages", "v_pages", "ids",
+                              "page_row", "length"),
                     sharding=eng.sharding_contract(len(pre_args))),
         ProgramSpec("serving_decode", dec_fn, dec_args, contract,
-                    argnames=("params", "k_cache", "v_cache", "tokens",
-                              "positions", "temps", "top_ks", "greedy",
-                              "key"),
+                    argnames=("params", "k_pages", "v_pages", "page_table",
+                              "tokens", "positions", "temps", "top_ks",
+                              "greedy", "key"),
                     sharding=eng.sharding_contract(len(dec_args))),
     ]
 

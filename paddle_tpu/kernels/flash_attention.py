@@ -32,6 +32,14 @@ def _interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
+def _compiler_params(pltpu, **kw):
+    """jax 0.4.x ships the params class as ``TPUCompilerParams``; newer
+    releases renamed it ``CompilerParams``. Accept either spelling."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
 # ---------------- forward ----------------
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, num_kb: int, block_q: int, block_k: int, causal: bool, scale: float):
@@ -122,7 +130,8 @@ def _fwd(q, k, v, causal: bool, scale: float, block_q: int, block_k: int):
             pltpu.VMEM((block_q, LANES), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             # the 2048x1024 fp32 score tile + bf16 p + double-buffered K/V
             # brush past the 16 MiB default scoped-vmem cap; v5e has 128 MiB
@@ -238,7 +247,7 @@ def _bwd(causal, scale, block_q, block_k, res, g):
         out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), qt.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(dimension_semantics=seq_par),
+        compiler_params=_compiler_params(pltpu, dimension_semantics=seq_par),
         interpret=_interpret(),
     )(qt, kt, vt, do, lse, delta)
 
@@ -265,7 +274,7 @@ def _bwd(causal, scale, block_q, block_k, res, g):
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(dimension_semantics=seq_par),
+        compiler_params=_compiler_params(pltpu, dimension_semantics=seq_par),
         interpret=_interpret(),
     )(qt, kt, vt, do, lse, delta)
 

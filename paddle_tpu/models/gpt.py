@@ -235,6 +235,28 @@ class GPTAttention(Layer):
             out = out.reshape([B, S, cfg.hidden_size])
             return self.dropout(self.proj(out)), tuple(kv)
 
+        if len(kv_cache) == 3:
+            # block-paged cache: (k_pool, v_pool, page_table) — the table
+            # routes this slot's token to its page; the paged attend reads
+            # only live pages (serving/kv_cache.py dispatch: oracle einsum
+            # on CPU, Pallas ragged kernel on TPU)
+            kc, vc, table = kv_cache
+
+            def _decode_paged(qv, kv_, vv, kcv, vcv, tblv, posv):
+                qT = qv.transpose(0, 2, 1, 3)   # [B, Hq, 1, D]
+                kc2 = _kvc.paged_write_kv(kcv, kv_.transpose(0, 2, 1, 3),
+                                          tblv, posv)
+                vc2 = _kvc.paged_write_kv(vcv, vv.transpose(0, 2, 1, 3),
+                                          tblv, posv)
+                o = _kvc.paged_decode_attend(qT, kc2, vc2, tblv, posv)
+                return o.transpose(0, 2, 1, 3), kc2, vc2
+
+            o, kc2, vc2 = apply("serving_decode_attn", _decode_paged, q, k,
+                                v, as_tensor(kc), as_tensor(vc),
+                                as_tensor(table), as_tensor(cache_positions))
+            out = o.reshape([B, S, cfg.hidden_size])
+            return self.dropout(self.proj(out)), (kc2, vc2)
+
         kc, vc = kv_cache
 
         def _decode(qv, kv_, vv, kcv, vcv, posv):
@@ -581,11 +603,15 @@ class GPTForCausalLM(Layer):
 
     def decode_step(self, tokens, kv_caches, positions):
         """One static-shape cached decode step: ``tokens`` ``[B]`` (or
-        ``[B, 1]``) int ids, ``kv_caches`` a per-layer list of ``(k, v)``
-        each ``[B, H_kv, S_max, D]``, ``positions`` ``[B]`` — the sequence
-        index each row's token is written at. Returns
-        ``(logits [B, V], new_caches)``; functionally pure, so the serving
-        engine jit-compiles it once and reuses the executable every token."""
+        ``[B, 1]``) int ids, ``kv_caches`` a per-layer list of either
+        dense ``(k, v)`` entries (each ``[B, H_kv, S_max, D]``) or paged
+        ``(k_pool, v_pool, page_table)`` triples (pools
+        ``[P, H_kv, ps, D]``, table ``[B, num_blocks]`` int32),
+        ``positions`` ``[B]`` — the sequence index each row's token is
+        written at. Returns ``(logits [B, V], new_caches)`` (new ``(k, v)``
+        per layer; a paged table is host-managed and passes through
+        unchanged); functionally pure, so the serving engine jit-compiles
+        it once and reuses the executable every token."""
         from ..ops._dispatch import as_tensor
 
         idv = as_tensor(tokens)._value
@@ -597,7 +623,7 @@ class GPTForCausalLM(Layer):
         # position embedding indices clamp at the table edge, matching
         # jnp's clamping gather the grown-prefix path relied on implicitly
         position_ids = Tensor(jnp.clip(pos, 0, self.cfg.max_seq_len - 1)[:, None])
-        caches = [(as_tensor(k), as_tensor(v)) for k, v in kv_caches]
+        caches = [tuple(as_tensor(c) for c in entry) for entry in kv_caches]
         h, new = self.gpt(Tensor(idv), position_ids=position_ids,
                           kv_caches=caches, cache_positions=Tensor(pos))
         logits = self._logits(h)  # [B, 1, V]

@@ -12,6 +12,13 @@ Public surface:
 - :class:`KVCache`, :func:`write_kv`, :func:`decode_attend` — the shared
   static-cache write/attend primitives (also used by
   ``incubate.nn.FusedMultiTransformer``'s ``time_step`` decode).
+- :class:`PagedKVCache` / :class:`PageAllocator` — the block-paged cache
+  (fixed-size pages + per-slot page table, the engine's default layout)
+  and the exact-cover free-list allocator the scheduler drives.
+- :func:`paged_write_kv` / :func:`paged_gather` /
+  :func:`paged_decode_attend` — the paged twins of the primitives above;
+  :func:`use_paged_attention_impl` pins the attend tier
+  (``oracle`` | ``interpret`` | ``pallas``) for traces entered under it.
 - :func:`cached_generate` — the static-shape decode loop
   ``models.gpt.GPTForCausalLM.generate`` delegates to.
 
@@ -21,7 +28,17 @@ See ``paddle_tpu/serving/README.md`` for the design and metric names.
 from __future__ import annotations
 
 from .engine import Engine, EngineConfig, cached_generate  # noqa: F401
-from .kv_cache import KVCache, decode_attend, write_kv  # noqa: F401
+from .kv_cache import (  # noqa: F401
+    PAGE_SENTINEL,
+    KVCache,
+    PagedKVCache,
+    decode_attend,
+    paged_decode_attend,
+    paged_gather,
+    paged_write_kv,
+    use_paged_attention_impl,
+    write_kv,
+)
 from .request_trace import (  # noqa: F401
     RequestTracer,
     SLOConfig,
@@ -29,12 +46,15 @@ from .request_trace import (  # noqa: F401
     request_trace_path,
 )
 from .sampling import SamplingParams  # noqa: F401
-from .scheduler import Request, Scheduler  # noqa: F401
+from .scheduler import PageAllocator, Request, Scheduler  # noqa: F401
 
 __all__ = [
     "Engine",
     "EngineConfig",
     "KVCache",
+    "PAGE_SENTINEL",
+    "PageAllocator",
+    "PagedKVCache",
     "Request",
     "RequestTracer",
     "SLOConfig",
@@ -42,7 +62,11 @@ __all__ = [
     "Scheduler",
     "cached_generate",
     "decode_attend",
+    "paged_decode_attend",
+    "paged_gather",
+    "paged_write_kv",
     "read_request_traces",
     "request_trace_path",
+    "use_paged_attention_impl",
     "write_kv",
 ]

@@ -42,10 +42,11 @@ from ..observability import instrument as _obs
 from ..observability import memory as _obs_memory
 from ..observability import metrics as _metrics
 from . import sampling as _sampling
-from .kv_cache import KVCache
+from .kv_cache import (KVCache, PAGE_SENTINEL, PagedKVCache,
+                       use_paged_attention_impl)
 from .request_trace import RequestTracer, SLOConfig
 from .sampling import SamplingParams
-from .scheduler import Request, Scheduler
+from .scheduler import PageAllocator, Request, Scheduler
 
 #: every serving executable takes (params, k_cache, v_cache, ...) and
 #: returns fresh caches its caller rebinds — so the KV cache args are
@@ -221,8 +222,23 @@ class EngineConfig:
     request_trace_dir: Optional[str] = None
     trace_sample_every: int = 1
     slo: Optional["SLOConfig"] = None
+    # KV cache layout: "paged" (default) stores K/V in fixed-size pages
+    # routed by a per-slot page table, so HBM scales with LIVE tokens and a
+    # smaller ``kv_pages`` pool serves the same (B_max, S_max) envelope;
+    # "dense" keeps the legacy [L, B_max, H_kv, S_max, D] block for A/B.
+    kv_layout: str = "paged"
+    page_size: int = 16          # tokens per KV page (shrunk to divide S_max)
+    kv_pages: Optional[int] = None  # pool size; default = full budget + trash
+    # paged-attend tier override for tests ("oracle"|"interpret"|"pallas");
+    # None = pick by backend (kv_cache.default_paged_impl)
+    paged_attention_impl: Optional[str] = None
 
     def __post_init__(self):
+        if self.kv_layout not in ("paged", "dense"):
+            raise ValueError(f"kv_layout {self.kv_layout!r}; "
+                             "want 'paged' or 'dense'")
+        while self.page_size > 1 and self.max_seq_len % self.page_size:
+            self.page_size //= 2
         if self.prefill_buckets is None:
             buckets = []
             b = 8
@@ -275,8 +291,19 @@ class Engine:
         dt = (self.config.cache_dtype if self.config.cache_dtype is not None
               else _param_dtype(self.params))
         B, S_max = self.config.max_batch_size, self.config.max_seq_len
-        self.cache = KVCache(cfg.num_layers, B, cfg.num_kv_heads, S_max,
-                             cfg.head_dim, dt)
+        if self.config.kv_layout == "paged":
+            ps = self.config.page_size
+            num_pages = self.config.kv_pages
+            if num_pages is None:
+                num_pages = B * (S_max // ps) + 1  # full budget + trash page
+            self.cache = PagedKVCache(cfg.num_layers, B, cfg.num_kv_heads,
+                                      S_max, cfg.head_dim, dt,
+                                      page_size=ps, num_pages=num_pages)
+            self.page_alloc: Optional[PageAllocator] = PageAllocator(num_pages)
+        else:
+            self.cache = KVCache(cfg.num_layers, B, cfg.num_kv_heads, S_max,
+                                 cfg.head_dim, dt)
+            self.page_alloc = None
         _metrics.gauge("serving.kv_cache.bytes", self.cache.nbytes)
         _obs_memory.record_kv_cache(self.cache.nbytes)
         self.scheduler = Scheduler(B)
@@ -392,8 +419,42 @@ class Engine:
         program ``_prefill_exe`` compiles, exposed so the static analyzer
         (paddle_tpu.analysis) can trace it without compiling/executing.
         The KV-cache args (positions ``KV_DONATE_ARGNUMS``) are donated at
-        compile; callers must rebind from the outputs."""
+        compile; callers must rebind from the outputs.
+
+        Paged layout: the slot's table row (``page_row [num_blocks]``
+        int32, runtime data) replaces the dense slot index — the prompt's
+        K/V scatter page-by-page into the pools (a static loop over the
+        bucket's blocks; the bucket tail past the allocated pages clamps
+        to the trash page, exactly like bucket padding wrote garbage past
+        ``length`` in the dense layout)."""
         model = self.model
+        if self.config.kv_layout == "paged":
+            ps, nb = self.cache.page_size, self.cache.num_blocks
+
+            def paged_prefill_fn(p, kc, vc, ids, page_row, length):
+                with no_grad():
+                    (logits, kvs), _ = model.functional_call(
+                        p, {}, Tensor(ids), method="prefill_with_cache",
+                        lengths=Tensor(length[None]))
+                knew = jnp.stack([k._value for k, _ in kvs])  # [L,1,Hkv,T,D]
+                vnew = jnp.stack([v._value for _, v in kvs])
+                zero = jnp.zeros((), jnp.int32)
+                for j in range((T + ps - 1) // ps):
+                    w = min(ps, T - j * ps)  # last bucket block may be partial
+                    pid = jnp.maximum(page_row[j], 0)
+                    start = (zero, pid, zero, zero, zero)
+                    kc = lax.dynamic_update_slice(
+                        kc, knew[:, 0, :, j * ps:j * ps + w, :][:, None]
+                        .astype(kc.dtype), start)
+                    vc = lax.dynamic_update_slice(
+                        vc, vnew[:, 0, :, j * ps:j * ps + w, :][:, None]
+                        .astype(vc.dtype), start)
+                return logits._value, kc, vc
+
+            args = (self.params, self.cache.k, self.cache.v,
+                    jnp.zeros((1, T), jnp.int32), jnp.zeros((nb,), jnp.int32),
+                    jnp.int32(1))
+            return paged_prefill_fn, args
 
         def prefill_fn(p, kc, vc, ids, slot, length):
             with no_grad():
@@ -414,8 +475,36 @@ class Engine:
 
     def decode_program(self):
         """(fn, example_args) for the batched decode step — see
-        ``prefill_program`` for the donation contract."""
+        ``prefill_program`` for the donation contract.
+
+        Paged layout: the page table rides as one extra ``[B, num_blocks]``
+        int32 operand. Its CONTENTS change every admission/finish but the
+        shape never does — the decode executable stays ONE compile for the
+        engine lifetime (tests pin the compile counter), and the paged
+        attend gathers each slot's live pages out of the pools."""
         model, L = self.model, self.cache.num_layers
+        if self.config.kv_layout == "paged":
+            B, nb = self.config.max_batch_size, self.cache.num_blocks
+
+            def paged_decode_fn(p, kc, vc, page_table, tokens, positions,
+                                temps, top_ks, greedy, key):
+                caches = [(kc[l], vc[l], page_table) for l in range(L)]
+                with no_grad():
+                    (logits, new), _ = model.functional_call(
+                        p, {}, Tensor(tokens), caches, Tensor(positions),
+                        method="decode_step")
+                kc2 = jnp.stack([k._value for k, _ in new])
+                vc2 = jnp.stack([v._value for _, v in new])
+                nxt = _sampling.sample_batched(logits._value, key, temps,
+                                               top_ks, greedy)
+                return nxt.astype(jnp.int32), kc2, vc2
+
+            args = (self.params, self.cache.k, self.cache.v,
+                    jnp.zeros((B, nb), jnp.int32),
+                    jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
+                    jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.int32),
+                    jnp.ones((B,), bool), _dummy_key())
+            return paged_decode_fn, args
 
         def decode_fn(p, kc, vc, tokens, positions, temps, top_ks, greedy,
                       key):
@@ -442,7 +531,10 @@ class Engine:
         the engine serves from device-local state, so every argument and
         every output must stay fully replicated — if sharding ever leaks
         into a serving program (a partitioned param tree wired in without
-        a serving-side mesh plan), spmd-contract-mismatch trips."""
+        a serving-side mesh plan), spmd-contract-mismatch trips. Covers
+        both layouts: the paged programs' page pools and page table are
+        device-local replicated state exactly like the dense caches
+        (``nargs`` follows whichever program signature is active)."""
         from ..analysis.sharding_flow import ShardingContract
         from jax.sharding import PartitionSpec as P
 
@@ -456,24 +548,50 @@ class Engine:
 
     def _decode_exe(self):
         decode_fn, args = self.decode_program()
-        return _aot(self._exe, ("decode",), "serving.decode", decode_fn,
-                    args, donate_argnums=KV_DONATE_ARGNUMS)
+        # the paged-attend tier is baked in while tracing (compiled
+        # executables never re-dispatch); no-op for the dense layout
+        with use_paged_attention_impl(self.config.paged_attention_impl):
+            return _aot(self._exe, ("decode",), "serving.decode", decode_fn,
+                        args, donate_argnums=KV_DONATE_ARGNUMS)
+
+    def _pages_needed(self, prompt_len: int) -> int:
+        """Pages covering positions [0, prompt_len] — prompt plus the slot
+        the first decode step writes into."""
+        return prompt_len // self.cache.page_size + 1
 
     def _admit(self):
         while self.cache.free_slots and self.scheduler.waiting:
-            req = self.scheduler.next_waiting()
+            # PEEK before committing: paged admission can backpressure on
+            # the page pool, leaving the head request queued until a finish
+            # frees pages (dense admission never backpressures — a free
+            # slot IS the whole reservation)
+            req = self.scheduler.waiting[0]
+            n = len(req.prompt_ids)
+            pages = None
+            if self.page_alloc is not None:
+                pages = self.page_alloc.alloc(self._pages_needed(n))
+                if pages is None:
+                    break
+            self.scheduler.next_waiting()  # pops the peeked head
             slot = self.cache.alloc_slot()
             req.slot = slot
+            if pages is not None:
+                self.cache.assign_pages(slot, pages)
             sp = req.sampling
             t0 = time.perf_counter()
-            n = len(req.prompt_ids)
             T = self._bucket(n)
             ids = np.zeros((1, T), np.int32)
             ids[0, :n] = req.prompt_ids
             exe = self._prefill_exe(T)
-            logits, self.cache.k, self.cache.v = exe(
-                self.params, self.cache.k, self.cache.v, jnp.asarray(ids),
-                jnp.int32(slot), jnp.int32(n))
+            if self.page_alloc is not None:
+                logits, self.cache.k, self.cache.v = exe(
+                    self.params, self.cache.k, self.cache.v,
+                    jnp.asarray(ids), jnp.asarray(self.cache.page_table[slot]),
+                    jnp.int32(n))
+            else:
+                logits, self.cache.k, self.cache.v = exe(
+                    self.params, self.cache.k, self.cache.v, jnp.asarray(ids),
+                    jnp.int32(slot), jnp.int32(n))
             key = _random.next_key() if sp.do_sample else _dummy_key()
             tok = int(np.asarray(_sampling.sample_static(
                 logits, key, do_sample=sp.do_sample,
@@ -494,7 +612,27 @@ class Engine:
             req.output_ids.append(tok)
             self._maybe_finish(req, tok)
 
+    def _grow_pages(self):
+        """Before a decode step, make sure every running slot has a page
+        mapped for the position it is about to write. A slot that can't
+        grow finishes ``cache_full`` (its generated prefix is intact) —
+        the pages it frees may already unblock the next waiting request."""
+        for slot, st in enumerate(self._slots):
+            req = st.request
+            if req is None:
+                continue
+            block = int(self._positions[slot]) // self.cache.page_size
+            if self.cache.page_table[slot, block] != PAGE_SENTINEL:
+                continue
+            pages = self.page_alloc.alloc(1)
+            if pages is None:
+                self._finish(req, "cache_full")
+                continue
+            self.cache.assign_pages(slot, pages, start_block=block)
+
     def _decode(self):
+        if self.page_alloc is not None:
+            self._grow_pages()
         running = [s.request for s in self._slots if s.request is not None]
         if not running:
             return
@@ -502,11 +640,19 @@ class Engine:
         any_sampled = not bool(self._greedy.all())
         key = _random.next_key() if any_sampled else _dummy_key()
         exe = self._decode_exe()
-        nxt, self.cache.k, self.cache.v = exe(
-            self.params, self.cache.k, self.cache.v,
-            jnp.asarray(self._tokens), jnp.asarray(self._positions),
-            jnp.asarray(self._temps), jnp.asarray(self._top_ks),
-            jnp.asarray(self._greedy), key)
+        if self.page_alloc is not None:
+            nxt, self.cache.k, self.cache.v = exe(
+                self.params, self.cache.k, self.cache.v,
+                self.cache.table_device(),
+                jnp.asarray(self._tokens), jnp.asarray(self._positions),
+                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                jnp.asarray(self._greedy), key)
+        else:
+            nxt, self.cache.k, self.cache.v = exe(
+                self.params, self.cache.k, self.cache.v,
+                jnp.asarray(self._tokens), jnp.asarray(self._positions),
+                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                jnp.asarray(self._greedy), key)
         nxt = np.asarray(nxt)
         step_s = time.perf_counter() - t0
         _metrics.histogram("serving.decode.step.seconds", step_s)
@@ -533,6 +679,9 @@ class Engine:
             reason = "cache_full"  # next token would fall off the cache
         if reason is None:
             return
+        self._finish(req, reason)
+
+    def _finish(self, req: Request, reason: str):
         slot = req.slot
         self.scheduler.finish(req, reason)
         if self.tracer is not None:
@@ -543,4 +692,9 @@ class Engine:
         self._temps[slot] = 1.0
         self._top_ks[slot] = 0
         self._greedy[slot] = True
+        if self.page_alloc is not None:
+            # every page the slot mapped goes back to the pool — the
+            # allocator raises on double-free, so leaks/corruption can't
+            # pass silently
+            self.page_alloc.free(self.cache.clear_slot(slot))
         self.cache.free_slot(slot)

@@ -19,6 +19,8 @@ tests/test_serving.py.
 
 from __future__ import annotations
 
+import contextlib
+import os
 from typing import List, Optional, Tuple
 
 import jax
@@ -27,6 +29,12 @@ import numpy as np
 from jax import lax
 
 _NEG_INF = jnp.float32(-1e30)
+
+#: page-table entry marking an unallocated block. Device code never branches
+#: on it — lookups clamp sentinels to page 0, the reserved TRASH page the
+#: allocator never hands out, so gathers/scatters stay in-bounds and the
+#: decode mask (``key_pos <= position``) keeps trash bytes out of the math.
+PAGE_SENTINEL = -1
 
 
 def write_kv(cache, new, positions):
@@ -139,3 +147,188 @@ class KVCache:
         k = self.k if k is None else k
         v = self.v if v is None else v
         return [(k[l], v[l]) for l in range(self.num_layers)]
+
+
+# ---------------------------------------------------------------------------
+# Block-paged cache (vLLM PagedAttention layout, static-shape edition)
+# ---------------------------------------------------------------------------
+
+_PAGED_IMPL = None  # process-wide override (use_paged_attention_impl)
+_PAGED_IMPLS = ("oracle", "interpret", "pallas")
+
+
+def default_paged_impl() -> str:
+    """Which paged-attend implementation a trace should bake in:
+    ``pallas`` (compiled Mosaic kernel) on TPU-class backends, the
+    ``oracle`` (gather + dense ``decode_attend`` einsum) elsewhere, with
+    ``interpret`` (the same kernel under ``pallas_call(interpret=True)``)
+    reachable via override so CPU tests exercise the kernel's numerics.
+    Resolution: ``use_paged_attention_impl`` context > the
+    ``PADDLE_TPU_PAGED_ATTENTION_IMPL`` env var > backend default."""
+    if _PAGED_IMPL is not None:
+        return _PAGED_IMPL
+    env = os.environ.get("PADDLE_TPU_PAGED_ATTENTION_IMPL")
+    if env:
+        if env not in _PAGED_IMPLS:
+            raise ValueError(
+                f"PADDLE_TPU_PAGED_ATTENTION_IMPL={env!r}; want one of "
+                f"{_PAGED_IMPLS}")
+        return env
+    return "pallas" if jax.default_backend() in ("tpu", "axon") else "oracle"
+
+
+@contextlib.contextmanager
+def use_paged_attention_impl(impl: Optional[str]):
+    """Pin the paged-attend implementation for traces entered under the
+    context (``None`` = keep the backend default). The choice is baked in
+    at TRACE time — the serving engine wraps its AOT ``.lower().compile()``
+    in this, so already-compiled executables are unaffected."""
+    global _PAGED_IMPL
+    if impl is not None and impl not in _PAGED_IMPLS:
+        raise ValueError(f"paged impl {impl!r}; want one of {_PAGED_IMPLS}")
+    prev, _PAGED_IMPL = _PAGED_IMPL, impl
+    try:
+        yield
+    finally:
+        _PAGED_IMPL = prev
+
+
+def paged_write_kv(pool, new, page_table, positions):
+    """Scatter one token's K (or V) per slot into a ``[P, H_kv, ps, D]``
+    page pool: row ``b`` of ``new [B, H_kv, 1, D]`` lands in page
+    ``page_table[b, positions[b] // ps]`` at offset ``positions[b] % ps``.
+    Sentinel entries clamp to the trash page (slots without a live request
+    all write identical token-0 state there, so the race is benign)."""
+    ps = pool.shape[2]
+    pos = jnp.asarray(positions)
+    B = new.shape[0]
+    pages = jnp.maximum(page_table[jnp.arange(B), pos // ps], 0)
+    return pool.at[pages, :, pos % ps, :].set(new[:, :, 0, :].astype(pool.dtype))
+
+
+def paged_gather(pool, page_table):
+    """Materialize the dense ``[B, H_kv, num_blocks*ps, D]`` view of a page
+    pool under a table — the oracle path's cache reconstruction (sentinels
+    clamp to trash, so dense position ``j`` of an unallocated block holds
+    trash bytes that the decode mask never admits)."""
+    g = pool[jnp.maximum(page_table, 0)]        # [B, nb, Hkv, ps, D]
+    B, nb, Hkv, ps, D = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, nb * ps, D)
+
+
+def paged_decode_attend(q, k_pool, v_pool, page_table, positions,
+                        impl: Optional[str] = None):
+    """Single-position cached attention over block-paged pools — the paged
+    twin of ``decode_attend`` behind ONE dispatch switch. ``oracle``
+    reconstructs the dense caches (``paged_gather``) and runs the einsum
+    oracle; ``interpret``/``pallas`` run the Pallas ragged kernel
+    (kernels/paged_attention.py) which touches only live pages. All tiers
+    read the identical pool bytes, so they agree within float tolerance on
+    ragged batches, GQA, and empty slots (tests/test_paged_kv.py)."""
+    impl = impl or default_paged_impl()
+    if impl == "oracle":
+        k = paged_gather(k_pool, page_table)
+        v = paged_gather(v_pool, page_table)
+        return decode_attend(q, k, v, positions)
+    from ..kernels.paged_attention import paged_attention
+
+    return paged_attention(q, k_pool, v_pool, page_table, positions,
+                           interpret=(impl == "interpret"))
+
+
+class PagedKVCache:
+    """Block-paged K/V pools ``[L, num_pages, H_kv, page_size, D]`` plus the
+    per-slot page table and the same slot bookkeeping as ``KVCache``.
+
+    The pools are functional device buffers exactly like the dense cache's
+    (the engine rebinds ``.k``/``.v`` after every compiled step, donation
+    included). The page table is HOST state (numpy): the scheduler's
+    allocator mutates it between steps and the engine ships a snapshot
+    (``table_device()``) into each executable as runtime data — table
+    CONTENTS change every admission/finish, but its ``[B_max, num_blocks]``
+    int32 shape never does, which is what keeps decode at one compile.
+
+    Page 0 is reserved as the trash page (see ``PAGE_SENTINEL``); a
+    default-sized pool therefore holds ``B_max * S_max/page_size + 1``
+    pages — capacity identical to the dense cache. Serving the same
+    envelope at a FRACTION of that HBM is the point: pass a smaller
+    ``num_pages`` and admission backpressure + ragged allocation take over.
+    """
+
+    def __init__(self, num_layers: int, max_batch_size: int,
+                 num_kv_heads: int, max_seq_len: int, head_dim: int,
+                 dtype="float32", page_size: int = 16,
+                 num_pages: Optional[int] = None):
+        if max_seq_len % page_size:
+            raise ValueError(
+                f"max_seq_len {max_seq_len} not divisible by page_size "
+                f"{page_size}")
+        self.num_layers = num_layers
+        self.max_batch_size = max_batch_size
+        self.num_kv_heads = num_kv_heads
+        self.max_seq_len = max_seq_len
+        self.head_dim = head_dim
+        self.page_size = page_size
+        self.num_blocks = max_seq_len // page_size
+        if num_pages is None:
+            num_pages = max_batch_size * self.num_blocks + 1
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (trash page + 1)")
+        self.num_pages = num_pages
+        shape = (num_layers, num_pages, num_kv_heads, page_size, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.page_table = np.full((max_batch_size, self.num_blocks),
+                                  PAGE_SENTINEL, np.int32)
+        self._free: List[int] = list(range(max_batch_size))[::-1]
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.k.size * self.k.dtype.itemsize * 2)
+
+    def table_device(self) -> jax.Array:
+        """Snapshot the host page table as the device operand the compiled
+        prefill/decode executables consume."""
+        return jnp.asarray(self.page_table)
+
+    # -- host-side table bookkeeping (the scheduler's allocator owns page
+    #    ids; the cache only records who maps where) --
+    def assign_pages(self, slot: int, pages: List[int], start_block: int = 0):
+        for j, p in enumerate(pages):
+            self.page_table[slot, start_block + j] = p
+
+    def slot_pages(self, slot: int) -> List[int]:
+        row = self.page_table[slot]
+        return [int(p) for p in row if p != PAGE_SENTINEL]
+
+    def clear_slot(self, slot: int) -> List[int]:
+        """Reset a slot's table row to sentinels; returns the page ids the
+        caller must hand back to the allocator."""
+        pages = self.slot_pages(slot)
+        self.page_table[slot, :] = PAGE_SENTINEL
+        return pages
+
+    # -- same slot free-list API as KVCache --
+    def alloc_slot(self) -> Optional[int]:
+        return self._free.pop() if self._free else None
+
+    def free_slot(self, slot: int):
+        self._free.append(slot)
+        self._free.sort(reverse=True)
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_slots(self) -> int:
+        return self.max_batch_size - len(self._free)
+
+    def layer_caches(self, k=None, v=None, table=None):
+        """Per-layer ``(k_pool, v_pool, page_table)`` triples — the pytree
+        shape the paged ``decode_step`` consumes (the table is shared by
+        every layer; static indexing, free under a trace)."""
+        k = self.k if k is None else k
+        v = self.v if v is None else v
+        table = self.table_device() if table is None else table
+        return [(k[l], v[l], table) for l in range(self.num_layers)]

@@ -55,6 +55,75 @@ class Request:
                 f"generated={self.num_generated})")
 
 
+class PageAllocator:
+    """Free-list allocator over the paged KV cache's page pool.
+
+    Page ids run ``[1, num_pages)`` — page 0 is the reserved trash page
+    that sentinel table entries clamp to (kv_cache.PAGE_SENTINEL) and is
+    never handed out. ``alloc`` is all-or-nothing: a request either gets
+    every page it asked for or the pool state is untouched and the caller
+    backpressures (leaves the request queued / finishes it ``cache_full``).
+    Double-allocation and double-free are hard errors, not best-effort —
+    the exact-cover invariant (every page is free XOR allocated) is what
+    tests/test_paged_kv.py pins.
+
+    Occupancy is exported through ``serving.kv.pages.{allocated,free}`` and
+    ``serving.kv.page_utilization`` when FLAGS_observability is on.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (trash page + 1)")
+        self.num_pages = num_pages
+        # pop() from the tail hands out the lowest free id first
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._allocated = set()
+        self._export_gauges()
+
+    @property
+    def num_allocatable(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return len(self._allocated)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh page ids, or None (pool unchanged) if fewer than
+        ``n`` are free."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._allocated.update(pages)
+        self._export_gauges()
+        return pages
+
+    def free(self, pages: List[int]):
+        for p in pages:
+            if p not in self._allocated:
+                raise ValueError(
+                    f"free of page {p} which is not allocated (double-free "
+                    "or never handed out)")
+            self._allocated.remove(p)
+            self._free.append(p)
+        self._free.sort(reverse=True)
+        self._export_gauges()
+
+    def _export_gauges(self):
+        if not _metrics.enabled():
+            return
+        _metrics.gauge("serving.kv.pages.allocated", len(self._allocated))
+        _metrics.gauge("serving.kv.pages.free", len(self._free))
+        _metrics.gauge("serving.kv.page_utilization",
+                       len(self._allocated) / max(1, self.num_allocatable))
+
+
 class Scheduler:
     """FIFO waiting queue + fixed slot table of size ``num_slots``."""
 

@@ -251,6 +251,15 @@ def test_bench_serving_row_contract(capsys):
         assert rec["request_id"] >= 0
     # decode-step roofline rides on the row too (measured side = TPOT p50)
     assert parsed["attribution"]["binding"] in ("compute", "hbm")
+    # paged-KV capacity row (ISSUE 13 acceptance): at the dense cache's
+    # exact HBM budget the paged pool must admit STRICTLY more concurrent
+    # requests than the dense layout's B_max slots
+    cap = parsed["concurrent_requests_per_chip"]
+    assert cap["hbm_budget_bytes"] > 0
+    assert cap["page_size"] > 0
+    assert cap["tokens_per_request"] > 0
+    assert cap["dense"] > 0
+    assert cap["paged"] > cap["dense"]
 
 
 def test_bench_elastic_row_contract(capsys):

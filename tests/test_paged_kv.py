@@ -1,0 +1,287 @@
+"""Block-paged KV cache + ragged paged-decode kernel (ISSUE 13).
+
+Covers: PageAllocator exact-cover invariants (every page free XOR
+allocated, all-or-nothing allocation, double-free raises, trash page never
+handed out), paged write/gather parity with the dense cache primitives,
+paged-vs-oracle decode-attend parity across ragged lengths / GQA / empty
+slots (the Pallas kernel under ``interpret=True`` so CPU exercises its
+numerics), engine-level A/B parity (paged vs dense layout, oracle vs
+interpret tier, mid-run admission), page-pool admission backpressure and
+decode-growth ``cache_full``, the one-compile decode guarantee with the
+page table riding as runtime data, and the new page-occupancy gauges.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models.gpt import gpt_tiny
+from paddle_tpu.serving import Engine, EngineConfig, SamplingParams
+from paddle_tpu.serving import kv_cache as kvc
+from paddle_tpu.serving.scheduler import PageAllocator
+
+
+@pytest.fixture
+def telemetry():
+    obs.enable()
+    obs.reset()
+    yield obs
+    obs.disable()
+    obs.reset()
+
+
+def _tiny(**kw):
+    m = gpt_tiny(dropout=0.0, num_layers=2, **kw)
+    m.eval()
+    return m
+
+
+def _prompt(b, t, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, 50, (b, t)).astype(np.int32)
+
+
+# ---------------- allocator invariants ------------------------------------
+class TestPageAllocator:
+    def test_exact_cover_of_pool(self):
+        """Every allocatable page is handed out exactly once, page 0 (the
+        trash page) never, and freeing returns the pool to full."""
+        a = PageAllocator(9)
+        assert a.num_allocatable == 8
+        seen = []
+        while True:
+            got = a.alloc(1)
+            if got is None:
+                break
+            seen += got
+        assert sorted(seen) == list(range(1, 9))  # all pages, 0 excluded
+        assert len(set(seen)) == len(seen)        # no double-allocation
+        assert a.num_free == 0 and a.num_allocated == 8
+        a.free(seen)
+        assert a.num_free == 8 and a.num_allocated == 0
+        # pool is whole again: the same exact cover is available
+        assert sorted(a.alloc(8)) == list(range(1, 9))
+
+    def test_alloc_is_all_or_nothing(self):
+        a = PageAllocator(5)  # 4 allocatable
+        first = a.alloc(3)
+        assert len(first) == 3
+        assert a.alloc(2) is None        # only 1 free: nothing handed out
+        assert a.num_free == 1           # pool untouched by the failure
+        assert len(a.alloc(1)) == 1
+
+    def test_double_free_and_foreign_free_raise(self):
+        a = PageAllocator(4)
+        pages = a.alloc(2)
+        a.free(pages)
+        with pytest.raises(ValueError, match="not allocated"):
+            a.free(pages[:1])            # double-free
+        with pytest.raises(ValueError, match="not allocated"):
+            a.free([3])                  # never handed out
+        with pytest.raises(ValueError):
+            PageAllocator(1)             # no room for trash + 1
+
+
+# ---------------- paged primitives ----------------------------------------
+class TestPagedPrimitives:
+    def _pool_and_dense(self, B=3, L=1, Hkv=2, ps=4, nb=3, D=8, seed=0):
+        """A random page pool + table and the dense cache holding the SAME
+        bytes at the table's mapping (sentinels clamp to the trash page in
+        both, so even unallocated blocks agree)."""
+        rng = np.random.RandomState(seed)
+        P = B * nb + 1
+        kp = jnp.asarray(rng.randn(P, Hkv, ps, D).astype(np.float32))
+        vp = jnp.asarray(rng.randn(P, Hkv, ps, D).astype(np.float32))
+        table = np.full((B, nb), kvc.PAGE_SENTINEL, np.int32)
+        table[0, :2] = [1, 2]      # 2 live pages
+        table[1, :1] = [5]         # 1 live page
+        # row 2 stays all-sentinel: an empty slot
+        tbl = jnp.asarray(table)
+        kd = kvc.paged_gather(kp, tbl)
+        vd = kvc.paged_gather(vp, tbl)
+        return kp, vp, tbl, kd, vd
+
+    def test_paged_gather_reconstructs_dense_layout(self):
+        kp, _, tbl, kd, _ = self._pool_and_dense()
+        B, nb, ps = tbl.shape[0], tbl.shape[1], kp.shape[2]
+        assert kd.shape == (B, kp.shape[1], nb * ps, kp.shape[3])
+        # dense position j holds page table[b, j//ps] offset j%ps
+        assert np.allclose(np.asarray(kd)[0, :, 5, :],
+                           np.asarray(kp)[2, :, 1, :])
+        # sentinel blocks clamp to the trash page
+        assert np.allclose(np.asarray(kd)[2, :, 0, :],
+                           np.asarray(kp)[0, :, 0, :])
+
+    def test_paged_write_matches_dense_write(self):
+        kp, _, tbl, kd, _ = self._pool_and_dense()
+        B, Hkv, ps, D = tbl.shape[0], kp.shape[1], kp.shape[2], kp.shape[3]
+        rng = np.random.RandomState(7)
+        new = jnp.asarray(rng.randn(B, Hkv, 1, D).astype(np.float32))
+        pos = jnp.asarray([5, 2, 0], jnp.int32)  # ragged, row 2 empty slot
+        kp2 = kvc.paged_write_kv(kp, new, tbl, pos)
+        kd2 = kvc.write_kv(kd, new, pos)
+        got = np.asarray(kvc.paged_gather(kp2, tbl))
+        want = np.asarray(kd2)
+        # compare the LIVE prefix of each row (row 0 has 2 pages, row 1 has
+        # 1): past it the paged view re-gathers the shared trash page, which
+        # row 2's clamped write just touched — exactly the bytes the decode
+        # mask never admits
+        assert np.allclose(got[0, :, :2 * ps], want[0, :, :2 * ps])
+        assert np.allclose(got[1, :, :ps], want[1, :, :ps])
+        # row 2 (empty slot) really did clamp to the trash page at offset 0
+        assert np.allclose(got[2, :, 0, :], want[2, :, 0, :])
+
+    @pytest.mark.parametrize("rep", [1, 2])
+    def test_kernel_matches_oracle_ragged_gqa_empty(self, rep):
+        """interpret-mode Pallas kernel vs the gather+einsum oracle on the
+        identical pool bytes: ragged positions, GQA head grouping, a
+        full slot, and an all-sentinel empty slot."""
+        kp, vp, tbl, kd, vd = self._pool_and_dense()
+        B, Hkv, ps, D = tbl.shape[0], kp.shape[1], kp.shape[2], kp.shape[3]
+        rng = np.random.RandomState(3)
+        q = jnp.asarray(rng.randn(B, Hkv * rep, 1, D).astype(np.float32))
+        pos = jnp.asarray([6, 3, 0], jnp.int32)  # mid-page, page-0-only, empty
+        want = kvc.paged_decode_attend(q, kp, vp, tbl, pos, impl="oracle")
+        got = kvc.paged_decode_attend(q, kp, vp, tbl, pos, impl="interpret")
+        assert got.shape == want.shape == (B, Hkv * rep, 1, D)
+        assert np.allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+        # oracle == the dense decode_attend it wraps
+        ref = kvc.decode_attend(q, kd, vd, pos)
+        assert np.allclose(np.asarray(want), np.asarray(ref), atol=1e-6)
+
+    def test_impl_dispatch_and_override(self):
+        assert kvc.default_paged_impl() in ("oracle", "pallas")
+        with kvc.use_paged_attention_impl("interpret"):
+            assert kvc.default_paged_impl() == "interpret"
+        assert kvc.default_paged_impl() in ("oracle", "pallas")
+        with pytest.raises(ValueError):
+            kvc.use_paged_attention_impl("nope").__enter__()
+
+
+# ---------------- engine: paged layout ------------------------------------
+class TestPagedEngine:
+    def test_paged_matches_dense_layout_with_midrun_admission(self):
+        """A/B at the engine level: 3 ragged greedy requests through 2
+        slots (so the third is admitted mid-run) produce identical tokens
+        under the paged and dense layouts — GQA model, page smaller than
+        the prefill bucket so prefill exercises partial/multi-page
+        scatter."""
+        prompts = [[5, 17, 3], [9, 2, 11, 4, 8, 1, 7, 12, 6], [7, 7, 7]]
+        sp = SamplingParams(max_new_tokens=5)
+        paddle.seed(0)
+        m = _tiny(num_kv_heads=2)
+        dense = Engine(m, EngineConfig(max_batch_size=2, max_seq_len=32,
+                                       kv_layout="dense")).generate(
+            prompts, sp)
+        paged = Engine(m, EngineConfig(max_batch_size=2, max_seq_len=32,
+                                       kv_layout="paged",
+                                       page_size=4)).generate(prompts, sp)
+        assert paged == dense
+
+    def test_interpret_kernel_engine_matches_oracle_engine(self):
+        """End-to-end decode through the Pallas kernel (interpret tier)
+        equals the oracle tier — including the empty slot the 1-request
+        batch leaves in the B=2 decode."""
+        paddle.seed(0)
+        m = _tiny()
+        prompts = [[5, 17, 3, 9, 2]]
+        sp = SamplingParams(max_new_tokens=4)
+        oracle = Engine(m, EngineConfig(
+            max_batch_size=2, max_seq_len=32,
+            paged_attention_impl="oracle")).generate(prompts, sp)
+        kern = Engine(m, EngineConfig(
+            max_batch_size=2, max_seq_len=32,
+            paged_attention_impl="interpret")).generate(prompts, sp)
+        assert kern == oracle
+
+    def test_paged_decode_compiles_once(self, telemetry):
+        """The page table is runtime data: admissions, finishes, and table
+        rewrites between steps never change the decode signature — ONE
+        decode compile for the engine lifetime (two prompt lengths share
+        one bucket here, so prefill is one compile too)."""
+        m = _tiny()
+        eng = Engine(m, EngineConfig(max_batch_size=2, max_seq_len=32,
+                                     page_size=8))
+        outs = eng.generate([[5, 17, 3], [9, 2, 4, 1, 6], [8, 3]],
+                            SamplingParams(max_new_tokens=6))
+        assert all(len(o) == 6 for o in outs)
+        c = obs.snapshot()["counters"]
+        assert c["jit.compile.cache_miss{site=serving.decode}"] == 1
+        assert c["jit.compile.cache_miss{site=serving.prefill}"] == 1
+
+    def test_admission_backpressure_then_midrun_admit(self, telemetry):
+        """kv_pages below the envelope: the second request backpressures in
+        the queue (slots are free — PAGES are not), gets admitted when the
+        first finishes and frees its pages, and the pool ends exactly
+        covered (everything back on the free list)."""
+        m = _tiny()
+        # 1 allocatable page of 8 tokens: exactly one request in flight
+        eng = Engine(m, EngineConfig(max_batch_size=2, max_seq_len=32,
+                                     page_size=8, kv_pages=2))
+        r1 = eng.add_request([5, 17, 3], SamplingParams(max_new_tokens=2))
+        r2 = eng.add_request([9, 2, 4], SamplingParams(max_new_tokens=2))
+        eng.step()  # r1 admitted; r2 must wait for pages, not slots
+        assert r1.state == "finished" and r1.finish_reason == "length"
+        assert r2.state == "queued"
+        assert eng.cache.free_slots == 2  # both slots idle: pages were the
+        assert eng.page_alloc.num_allocated == 0     # binding constraint
+        eng.step()  # r1's pages are back -> r2 admitted
+        while eng.has_unfinished:
+            eng.step()
+        assert r2.finish_reason == "length" and len(r2.output_ids) == 2
+        # exact cover restored
+        assert eng.page_alloc.num_allocated == 0
+        assert eng.page_alloc.num_free == eng.page_alloc.num_allocatable
+        assert (eng.cache.page_table == kvc.PAGE_SENTINEL).all()
+        g = obs.snapshot()["gauges"]
+        assert g["serving.kv.pages.allocated"] == 0
+        assert g["serving.kv.pages.free"] == 1
+        assert g["serving.kv.page_utilization"] == 0.0
+
+    def test_decode_growth_exhaustion_finishes_cache_full(self):
+        """A generation that outgrows the pool finishes ``cache_full`` at
+        the step whose page can't be mapped; its generated prefix is
+        intact and every page returns to the allocator."""
+        m = _tiny()
+        eng = Engine(m, EngineConfig(max_batch_size=1, max_seq_len=32,
+                                     page_size=4, kv_pages=2))
+        r = eng.add_request([5, 17, 3], SamplingParams(max_new_tokens=10))
+        while eng.has_unfinished:
+            eng.step()
+        # admission mapped page 0 (positions 0..3); position 4 needed a
+        # second page the pool doesn't have
+        assert r.finish_reason == "cache_full"
+        assert len(r.output_ids) == 2
+        assert eng.page_alloc.num_allocated == 0
+
+    def test_kv_gauges_and_pool_bytes(self, telemetry):
+        """Paged gauges ride next to mem.kv_cache.bytes, and a half-size
+        pool really is half the dense HBM for the same envelope."""
+        m = _tiny()
+        eng = Engine(m, EngineConfig(max_batch_size=2, max_seq_len=32,
+                                     page_size=8))
+        eng.generate([[5, 17, 3]], SamplingParams(max_new_tokens=2))
+        g = obs.snapshot()["gauges"]
+        assert g["mem.kv_cache.bytes"] == eng.cache.nbytes
+        assert g["serving.kv_cache.bytes"] == eng.cache.nbytes
+        for name in ("serving.kv.pages.allocated", "serving.kv.pages.free",
+                     "serving.kv.page_utilization"):
+            assert name in g
+        # same envelope at kv_pages = half the budget -> ~half the bytes
+        full = eng.cache.nbytes
+        half = Engine(m, EngineConfig(max_batch_size=2, max_seq_len=32,
+                                      page_size=8, kv_pages=5))
+        assert half.cache.nbytes < full * 0.6
+
+    def test_config_validation(self):
+        m = _tiny()
+        with pytest.raises(ValueError, match="kv_layout"):
+            Engine(m, EngineConfig(max_batch_size=2, max_seq_len=32,
+                                   kv_layout="sparse"))
+        # page_size shrinks to divide S_max instead of failing
+        eng = Engine(m, EngineConfig(max_batch_size=1, max_seq_len=24,
+                                     page_size=16))
+        assert eng.cache.page_size == 8
+        assert 24 % eng.cache.page_size == 0
