@@ -1404,6 +1404,130 @@ def bench_elastic():
     return out
 
 
+def bench_health():
+    """Training-numerics health config: what the in-graph stat pass +
+    HealthMonitor cost, and how fast an injected fault is caught. The
+    row's contract is twofold: flag-on step-time overhead < 5% (the stat
+    pass is fused reductions riding the compiled step, same cost class as
+    the existing grad-norm clip), and an injected-NaN detection row — one
+    param group's grads poisoned inside the compiled step, detector must
+    name that exact group (steps-to-detect is the pipelined observation
+    latency, by construction 1)."""
+    import math
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability
+    from paddle_tpu.observability import health as obs_health
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=8,
+                        num_heads=16, max_seq_len=512, dropout=0.0)
+        bsz, seq, iters = 8, 512, 30
+    else:
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64, dropout=0.0)
+        bsz, seq, iters = 2, 32, 12
+
+    def build(health):
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        return make_sharded_train_step(model, opt, health_stats=health)
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, size=(bsz, seq), dtype=np.int32)
+    y = np.roll(x, -1, axis=1)
+
+    # flag-off baseline (per-step float(loss) on both sides — the realistic
+    # loop shape, and it keeps the host pipelining identical)
+    step = build(False)
+    for _i in range(2):
+        _ = float(step(x, y))
+    t0 = time.perf_counter()
+    for _i in range(iters):
+        _ = float(step(x, y))
+    off_ms = (time.perf_counter() - t0) / iters * 1e3
+
+    was_enabled = observability.enabled()
+    observability.enable()
+    # the row's one-compile claim reads the global cache_miss counter, so
+    # start from a clean registry (earlier configs in the same process
+    # compile their own train steps against the same counter)
+    observability.reset()
+    try:
+        step = build(True)
+        monitor = step.attach_health_monitor(obs_health.HealthMonitor(
+            obs_health.HealthConfig(warmup_steps=4)))
+        for _i in range(2):
+            _ = float(step(x, y))
+        t0 = time.perf_counter()
+        for _i in range(iters):
+            _ = float(step(x, y))
+        step.health_flush()
+        on_ms = (time.perf_counter() - t0) / iters * 1e3
+        overhead_pct = (on_ms - off_ms) / off_ms * 100.0
+
+        # injected-NaN detection latency: poison one group mid-run and
+        # count steps until an anomaly names it
+        target = step.health_groups[len(step.health_groups) // 2]
+        step.set_grad_poison(target)
+        named, steps_to_detect = None, 0
+        t0 = time.perf_counter()
+        for _i in range(5):
+            _ = step(x, y)
+            steps_to_detect += 1
+            hits = [a for a in step.health_flush()
+                    if a["anomaly"] == "nonfinite"]
+            if hits:
+                named = hits[0]["group"]
+                break
+        detect_ms = (time.perf_counter() - t0) * 1e3
+
+        def jsonsafe(v):
+            # post-injection gauges are legitimately NaN; null keeps the
+            # row strict-JSON round-trippable (NaN != NaN breaks equality)
+            if isinstance(v, dict):
+                return {k: jsonsafe(x) for k, x in v.items()}
+            if isinstance(v, list):
+                return [jsonsafe(x) for x in v]
+            if isinstance(v, float) and not math.isfinite(v):
+                return None
+            return v
+        snap = jsonsafe(observability.snapshot())
+        out = {
+            "config": "health",
+            "metric": "health_overhead_pct",
+            "value": round(overhead_pct, 2),
+            "unit": "% step time (stat pass + monitor on vs off)",
+            "step_ms_off": round(off_ms, 3),
+            "step_ms_on": round(on_ms, 3),
+            "overhead_ms": round(on_ms - off_ms, 3),
+            "groups": len(step.health_groups),
+            "detect_target_group": target,
+            "detect_named_group": named,
+            "detect_steps": steps_to_detect,
+            "detect_ms": round(detect_ms, 3),
+            "anomalies": monitor.summary()["kinds"],
+            "note": f"GPT {_n_params(step.model)/1e6:.1f}M params, "
+                    f"B={bsz} S={seq}, {iters} steps; acceptance: "
+                    f"overhead < 5%, named == target",
+            "telemetry": snap,
+        }
+        if _cpu_fallback():
+            out["backend"] = "cpu_fallback"
+    finally:
+        if not was_enabled:
+            observability.disable()
+    print(json.dumps(out))
+    return out
+
+
 CONFIGS = {
     "bert_sst2": bench_bert_sst2,
     "gpt_dp": bench_gpt_dp,
@@ -1418,6 +1542,7 @@ CONFIGS = {
     "obs": bench_obs,
     "analysis": bench_analysis,
     "elastic": bench_elastic,
+    "health": bench_health,
 }
 
 

@@ -48,7 +48,8 @@ def _run_elastic(args, cfg):
             moment_dtype="bfloat16" if on_tpu else None)
         return make_sharded_train_step(
             model, opt, mesh=mesh, grad_reduce=args.grad_reduce,
-            accumulate_steps=args.accum or None)
+            accumulate_steps=args.accum or None,
+            health_stats=args.health or None)
 
     # logical hosts: contiguous blocks of the visible devices (on a real
     # fleet: one block per process); losing a block shrinks dp
@@ -109,6 +110,16 @@ def _run_elastic(args, cfg):
 
         mgr = CheckpointManager(args.ckpt_dir, keep_last_n=3, async_=True)
 
+    monitor = None
+    if args.health:
+        from paddle_tpu import observability
+        from paddle_tpu.observability import health as obs_health
+
+        observability.enable()
+        monitor = obs_health.HealthMonitor(on_anomaly=lambda r: print(
+            f"health: {r['anomaly']} at step {r['step']}"
+            + (f" in {r['group']}" if r.get("group") else ""), flush=True))
+
     ecfg = E.ElasticConfig(
         axes={"dp": args.dp, "mp": args.mp}, hosts=hosts,
         heartbeat_dir=args.heartbeat_dir, deadline_s=args.deadline_s,
@@ -117,7 +128,8 @@ def _run_elastic(args, cfg):
     try:
         with E.ElasticRunner(build_step, ecfg, next_batch=next_batch,
                              build_data=build_data,
-                             checkpoint_manager=mgr) as runner:
+                             checkpoint_manager=mgr,
+                             health_monitor=monitor) as runner:
             losses = runner.run(args.steps)
             s = runner.summary()
     finally:
@@ -129,6 +141,8 @@ def _run_elastic(args, cfg):
     print(f"done: {args.steps * args.batch * args.seq / dt:.0f} tokens/sec "
           f"(elastic: {s['restarts']} restart(s), {s['steps_lost']} step(s) "
           f"lost, world {s['hosts']} host(s) x axes {s['axes']})")
+    if monitor is not None:
+        print(f"health: {monitor.summary()}", flush=True)
 
 
 def main():
@@ -179,6 +193,12 @@ def main():
                          "(elastic failure detection)")
     ap.add_argument("--deadline-s", type=float, default=5.0,
                     help="heartbeat staleness after which a host is dead")
+    ap.add_argument("--health", action="store_true",
+                    help="training-numerics health: in-graph per-param-group "
+                         "stat pass + HealthMonitor (NaN provenance, spike "
+                         "detectors, forensic anomaly capture); anomalies "
+                         "print as they fire and, with --ckpt-dir, the "
+                         "first one checkpoints the pre-divergence state")
     args = ap.parse_args()
     if args.smoke:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -221,7 +241,8 @@ def main():
         multi_precision=on_tpu, moment_dtype="bfloat16" if on_tpu else None)
     step = make_sharded_train_step(
         model, opt, grad_reduce=args.grad_reduce,
-        accumulate_steps=args.accum or None)
+        accumulate_steps=args.accum or None,
+        health_stats=args.health or None)
 
     pipe = data_it = None
     if args.data:
@@ -248,6 +269,32 @@ def main():
             print(f"resumed from step {start}"
                   + (" (data position restored)" if pipe is not None else ""))
 
+    monitor = None
+    if args.health:
+        from paddle_tpu import observability
+        from paddle_tpu.observability import health as obs_health
+
+        observability.enable()
+
+        def _ckpt_before_divergence(record):
+            # detection is pipelined one step behind, so the live train
+            # state is still the last pre-anomaly params — save it
+            if mgr is not None:
+                st = step.state_for_checkpoint()
+                if pipe is not None:
+                    st.data_position = pipe.get_state()
+                mgr.save(int(record["step"]), st.to_tree(), force=True)
+                print(f"health: pre-divergence checkpoint at step "
+                      f"{record['step']}", flush=True)
+
+        monitor = step.attach_health_monitor(obs_health.HealthMonitor(
+            on_anomaly=lambda r: print(
+                f"health: {r['anomaly']} at step {r['step']}"
+                + (f" in {r['group']}" if r.get("group") else ""),
+                flush=True),
+            checkpoint_hook=_ckpt_before_divergence,
+            data_position=(pipe.get_state if pipe is not None else None)))
+
     rng = np.random.RandomState(0)
     t0 = time.perf_counter()
     for i in range(start, args.steps):
@@ -265,6 +312,9 @@ def main():
             if pipe is not None:
                 st.data_position = pipe.get_state()
             mgr.save(i + 1, st.to_tree(), force=True)
+    if monitor is not None:
+        step.health_flush()
+        print(f"health: {monitor.summary()}", flush=True)
     dt = time.perf_counter() - t0
     done = max(args.steps - start, 1)
     print(f"done: {done * args.batch * args.seq / dt:.0f} tokens/sec"

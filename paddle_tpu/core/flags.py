@@ -86,6 +86,12 @@ register_flag("observability", False,
               "span tracer, paddle_tpu.observability). Off by default: "
               "instrumented sites reduce to one flag check and the registry "
               "stays empty, so tier-1 timing is unaffected")
+register_flag("health_stats", False,
+              "Compute in-graph per-param-group numerics stats (grad/param/"
+              "update norms + nonfinite counts) inside the compiled train "
+              "step and stream them to observability.health.HealthMonitor. "
+              "Off by default: the step's traced program (and the analyzer "
+              "corpus / HLO baselines) is unchanged unless enabled")
 register_flag("eval_no_record", False,
               "Layers in eval() mode skip tape recording entirely: closes "
               "the chained-forward tape growth hazard (h = m(h) inference "

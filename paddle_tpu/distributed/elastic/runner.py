@@ -106,13 +106,18 @@ class ElasticRunner:
     ``build_data(process_index, process_count)`` (optional) builds the
     host's input pipeline; on reform it is re-dealt via ``reassign`` when
     the object supports it, else rebuilt at the new identity.
+    ``health_monitor`` (optional HealthMonitor) is re-attached to every
+    rebuilt step — detector state, NaN provenance, and the anomaly record
+    survive mesh re-formation, so a fault that recurs after recovery is
+    still attributed to its first occurrence.
     """
 
     def __init__(self, build_step: Callable[[Any], Any], config: ElasticConfig,
                  *, next_batch: Callable[[int, Any], Tuple],
                  build_data: Optional[Callable[[int, int], Any]] = None,
                  checkpoint_manager=None,
-                 fault_hook: Optional[Callable[["ElasticRunner"], None]] = None):
+                 fault_hook: Optional[Callable[["ElasticRunner"], None]] = None,
+                 health_monitor=None):
         import jax
 
         self._jax = jax
@@ -122,6 +127,7 @@ class ElasticRunner:
         self.build_data = build_data
         self.manager = checkpoint_manager
         self.fault_hook = fault_hook
+        self.health_monitor = health_monitor
         hosts = config.hosts
         if hosts is None:
             hosts = {int(config.self_host): list(range(len(jax.devices())))}
@@ -227,10 +233,18 @@ class ElasticRunner:
             return None
         return self.build_data(self._self_rank(), len(self.alive))
 
+    def _attach_health(self, step):
+        """Re-attach the shared HealthMonitor when the step was built with
+        the in-graph stat pass; the same group list re-binds as a no-op,
+        so detector/provenance state persists across re-formations."""
+        if self.health_monitor is not None and getattr(step, "_health", False):
+            step.attach_health_monitor(self.health_monitor)
+
     def _start(self):
         plan = reform(self.cfg.axes, self._alive_devices(),
                       self.cfg.shrinkable_axes)
         self.step = self.build_step(plan.mesh)
+        self._attach_health(self.step)
         self.data = self._make_data()
         self.plan = plan
         if self.manager is not None and self.manager.latest_step() is not None:
@@ -261,6 +275,7 @@ class ElasticRunner:
         plan = reform(self.cfg.axes, self._alive_devices(),
                       self.cfg.shrinkable_axes)
         new_step = self.build_step(plan.mesh)
+        self._attach_health(new_step)
         _metrics.histogram("elastic.reform_seconds", time.perf_counter() - t0)
 
         migrated = None
@@ -417,11 +432,14 @@ class ElasticRunner:
                 self._recovery_t0 = None
             if save_every and self._next_step % save_every == 0:
                 self.save(force=True)
+        if self.health_monitor is not None and self.step is not None \
+                and getattr(self.step, "_health", False):
+            self.step.health_flush()  # deliver the final step's stats
         return [self.losses[i] for i in range(num_steps)]
 
     def summary(self) -> Dict[str, Any]:
         hosts, devices = self.world
-        return {
+        out = {
             "restarts": self.restarts,
             "steps_lost": self.steps_lost,
             "hosts": hosts,
@@ -431,6 +449,9 @@ class ElasticRunner:
             "recovery_s": self.last_recovery_s,
             "recovery_to_first_step_s": self.last_recovery_to_first_step_s,
         }
+        if self.health_monitor is not None:
+            out["health"] = self.health_monitor.summary()
+        return out
 
     def close(self):
         if self.heartbeater is not None:

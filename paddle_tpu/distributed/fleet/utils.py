@@ -108,6 +108,7 @@ class ShardedTrainStep:
         pp_schedule: str = "1f1b",
         scaler=None,
         grad_reduce=None,
+        health_stats: Optional[bool] = None,
     ):
         from ..topology import get_hybrid_communicate_group
 
@@ -189,6 +190,38 @@ class ShardedTrainStep:
 
         batch_sharding = NamedSharding(mesh, resolve_spec(batch_spec, mesh))
         self._batch_sharding = batch_sharding
+
+        # ---- in-graph numerics health (observability.health) ----
+        # When on, the compiled step takes one extra [G] f32 input (the
+        # grad-poison vector, all-ones in normal operation — the fault
+        # injector bench/tests use) and returns one extra small replicated
+        # pytree of per-param-group stats. Donation and the one-compile
+        # contract are untouched: the poison vector is never donated and
+        # its shape/dtype are fixed at build time.
+        from ...observability import health as _obs_health
+        self._health = (_obs_health.stats_enabled() if health_stats is None
+                        else bool(health_stats))
+        self._health_monitor = None
+        self._health_pending = None
+        self.health_state = None
+        if self._health:
+            import numpy as _np
+            groups, gidx = _obs_health.group_index_map(list(params0))
+            self._health_groups = groups
+            self._health_poison = _np.ones(len(groups), _np.float32)
+            _nG = len(groups)
+
+            def _poison(grads, hp):
+                return {k: g * hp[gidx[k]].astype(g.dtype)
+                        for k, g in grads.items()}
+
+            def _health_stats_of(params, grads, new_params):
+                return _obs_health.in_graph_stats(gidx, _nG, params, grads,
+                                                  new_params)
+        else:
+            self._health_groups = None
+            self._health_poison = None
+        health = self._health
         clip = optimizer._grad_clip if isinstance(optimizer._grad_clip, ClipGradByGlobalNorm) else None
         clip_norm = clip.clip_norm if clip is not None else None
         loss_fn_ = self.loss_fn
@@ -453,7 +486,8 @@ class ShardedTrainStep:
             incr_every, decr_every = sc._incr_every, sc._decr_every
             incr_ratio, decr_ratio = sc._incr_ratio, sc._decr_ratio
 
-            def step(params, opt_state, bufs, sstate, ef, x, y, lr, seed):
+            def step(params, opt_state, bufs, sstate, ef, x, y, lr, seed,
+                     hp=None):
                 scale, good, bad = sstate
                 (scaled_loss, new_bufs), grads, new_ef = grads_with_reduce(
                     params, bufs, ef, x, y, seed, loss_scale=scale)
@@ -461,6 +495,11 @@ class ShardedTrainStep:
                 dts = {k: g.dtype for k, g in grads.items()}
                 grads = {k: g.astype(jnp.float32) * inv
                          for k, g in grads.items()}
+                if health:
+                    # fault injection BEFORE the overflow check, so poisoned
+                    # grads flow through it exactly like a real overflow
+                    grads = _poison(grads, hp)
+                hgrads = grads  # unscaled f32 — what the stat pass reads
                 found = jnp.zeros((), bool)
                 for g in grads.values():
                     found = found | ~jnp.all(jnp.isfinite(g))
@@ -491,38 +530,60 @@ class ShardedTrainStep:
                 # loss reported unscaled (inf stays inf on overflow steps);
                 # buffer updates (BN stats) keep even on skipped updates —
                 # eager forward updates them before overflow is known
-                return (new_params, new_state, new_bufs, new_ef,
-                        (new_scale, good2, bad2), scaled_loss * inv)
+                out = (new_params, new_state, new_bufs, new_ef,
+                       (new_scale, good2, bad2), scaled_loss * inv)
+                if health:
+                    # update_norm from the POST-keep params: truthfully
+                    # zero on overflow-skipped steps
+                    out = out + (_health_stats_of(params, hgrads,
+                                                  new_params),)
+                return out
 
             self.scaler_state = (jnp.float32(sc._scale),
                                  jnp.int32(sc._good_steps),
                                  jnp.int32(sc._bad_steps))
             donate_args = (0, 1, 2, 3, 4) if donate else ()
+            hp_in = (None,) if health else ()
+            h_out = (None,) if health else ()
+            self._in_sh = (p_shard, s_shard, None, None, self._ef_shard,
+                           batch_sharding, batch_sharding, None,
+                           None) + hp_in
+            self._out_sh = (p_shard, s_shard, None, self._ef_shard, None,
+                            NamedSharding(mesh, P())) + h_out
             self._compiled = jax.jit(
                 step,
-                in_shardings=(p_shard, s_shard, None, None, self._ef_shard,
-                              batch_sharding, batch_sharding, None, None),
-                out_shardings=(p_shard, s_shard, None, self._ef_shard, None,
-                               NamedSharding(mesh, P())),
+                in_shardings=self._in_sh,
+                out_shardings=self._out_sh,
                 donate_argnums=donate_args,
             )
         else:
             self.scaler_state = None
 
-            def step(params, opt_state, bufs, ef, x, y, lr, seed):
+            def step(params, opt_state, bufs, ef, x, y, lr, seed, hp=None):
                 (loss, new_bufs), grads, new_ef = grads_with_reduce(
                     params, bufs, ef, x, y, seed)
+                if health:
+                    grads = _poison(grads, hp)
                 new_params, new_state = _clip_and_update(
                     params, opt_state, grads, lr)
-                return new_params, new_state, new_bufs, new_ef, loss
+                out = (new_params, new_state, new_bufs, new_ef, loss)
+                if health:
+                    out = out + (_health_stats_of(params, grads,
+                                                  new_params),)
+                return out
 
             donate_args = (0, 1, 2, 3) if donate else ()
+            hp_in = (None,) if health else ()
+            h_out = (None,) if health else ()
+            self._in_sh = (p_shard, s_shard, None, self._ef_shard,
+                           batch_sharding, batch_sharding, None,
+                           None) + hp_in
+            self._out_sh = (p_shard, s_shard, None, self._ef_shard,
+                            NamedSharding(mesh, P())) + h_out
             self._compiled = jax.jit(
                 step,
-                in_shardings=(p_shard, s_shard, None, self._ef_shard,
-                              batch_sharding, batch_sharding, None, None),
-                out_shardings=(p_shard, s_shard, None, self._ef_shard,
-                               NamedSharding(mesh, P())),
+                in_shardings=self._in_sh,
+                out_shardings=self._out_sh,
                 donate_argnums=donate_args,
             )
         # buffers are step STATE (device-resident like params/opt state).
@@ -548,21 +609,9 @@ class ShardedTrainStep:
         hlo_audit compiles the same partitioned program the step runs."""
         from ...analysis.sharding_flow import ShardingContract
 
-        mesh = self._batch_sharding.mesh
-        b = self._batch_sharding
-        repl = NamedSharding(mesh, P())
-        if self.scaler_state is not None:
-            in_sh = (self._p_shard, self._s_shard, None, None,
-                     self._ef_shard, b, b, None, None)
-            out_sh = (self._p_shard, self._s_shard, None, self._ef_shard,
-                      None, repl)
-        else:
-            in_sh = (self._p_shard, self._s_shard, None, self._ef_shard,
-                     b, b, None, None)
-            out_sh = (self._p_shard, self._s_shard, None, self._ef_shard,
-                      repl)
-        return ShardingContract(in_shardings=in_sh, out_shardings=out_sh,
-                                mesh=mesh)
+        return ShardingContract(in_shardings=self._in_sh,
+                                out_shardings=self._out_sh,
+                                mesh=self._batch_sharding.mesh)
 
     def _obs_executable(self, path: str, site: str, jitted, args, key):
         """With observability ON, route dispatch through an explicitly
@@ -807,33 +856,43 @@ class ShardedTrainStep:
         scaled = self.scaler_state is not None
         if self._multi is None:
             base = self._compiled_step_fn
+            health = self._health
 
-            def multi(params, opt_state, bufs, sstate, ef, xs, ys, lr, seed):
+            def multi(params, opt_state, bufs, sstate, ef, xs, ys, lr, seed,
+                      hp=None):
                 def body(carry, xy):
                     p, s, b, ss, e = carry
                     xk, yk, k = xy
+                    extra = (hp,) if health else ()
                     if scaled:
-                        p, s, b, e, ss, loss = base(p, s, b, ss, e, xk, yk,
-                                                    lr, seed + k)
+                        out = base(p, s, b, ss, e, xk, yk, lr, seed + k,
+                                   *extra)
+                        p, s, b, e, ss = out[:5]
                     else:
-                        p, s, b, e, loss = base(p, s, b, e, xk, yk, lr,
-                                                seed + k)
-                    return (p, s, b, ss, e), loss
+                        out = base(p, s, b, e, xk, yk, lr, seed + k, *extra)
+                        p, s, b, e = out[:4]
+                    # per-step stream: (loss,) or (loss, health stats) —
+                    # scan stacks the stats to [K, G] so every scanned
+                    # step stays individually observable
+                    return (p, s, b, ss, e), out[5 if scaled else 4:]
 
-                (params, opt_state, bufs, sstate, ef), losses = jax.lax.scan(
+                (params, opt_state, bufs, sstate, ef), ys_out = jax.lax.scan(
                     body, (params, opt_state, bufs, sstate, ef),
                     (xs, ys, jnp.arange(xs.shape[0], dtype=jnp.uint32)))
-                return params, opt_state, bufs, sstate, ef, losses
+                return (params, opt_state, bufs, sstate, ef) + tuple(ys_out)
 
             bspec = self._batch_sharding.spec
             stacked = NamedSharding(self.mesh, P(None, *bspec))
+            hp_in = (None,) if health else ()
+            h_out = (None,) if health else ()
             self._multi = jax.jit(
                 multi,
                 in_shardings=(self._p_shard, self._s_shard, None, None,
-                              self._ef_shard, stacked, stacked, None, None),
+                              self._ef_shard, stacked, stacked, None,
+                              None) + hp_in,
                 out_shardings=(self._p_shard, self._s_shard, None, None,
                                self._ef_shard,
-                               NamedSharding(self.mesh, P())),
+                               NamedSharding(self.mesh, P())) + h_out,
                 donate_argnums=(0, 1, 2, 3, 4) if self._donate else (),
             )
         K = xs.shape[0] if hasattr(xs, "shape") else len(xs)
@@ -842,19 +901,24 @@ class ShardedTrainStep:
         obs = _obs_metrics.enabled()
         t0 = time.perf_counter() if obs else 0.0
         xg, yg = jnp.asarray(xs), jnp.asarray(ys)
+        if self._health:
+            self.health_flush()
         args = (self.params, self.opt_state, self.buffers, ss_in,
                 self.ef_state, xg, yg,
                 # +1 so scanned step j draws seed (seed + prev_steps + 1 + j)
                 # — identical to the seeds K sequential __call__s would use
                 jnp.float32(lr), jnp.uint32(self._seed + self._step_i - K + 1))
+        if self._health:
+            args = args + (jnp.asarray(self._health_poison),)
         with jax.set_mesh(self.mesh):
             fn = self._multi
             if obs:
                 fn = self._obs_executable(
                     "multi", "sharded_train_step.run_steps", fn, args,
                     (xg.shape, yg.shape))
+            out = fn(*args)
             (self.params, self.opt_state, self.buffers, ss_out,
-             self.ef_state, losses) = fn(*args)
+             self.ef_state, losses) = out[:6]
         if obs:
             samples = None
             if hasattr(xs, "shape") and len(getattr(xs, "shape", ())) >= 2:
@@ -863,6 +927,8 @@ class ShardedTrainStep:
                              time.perf_counter() - t0, samples, steps=K)
         if scaled:
             self.scaler_state = ss_out
+        if self._health:
+            self._health_observe_multi(out[6], losses, K, scaled)
         return losses
 
     def __call__(self, x, y, lr: Optional[float] = None):
@@ -872,6 +938,11 @@ class ShardedTrainStep:
         t0 = time.perf_counter() if obs else 0.0
         xg, yg = self._to_global_batch(x), self._to_global_batch(y)
         scaled = self.scaler_state is not None
+        if self._health:
+            # deliver the PREVIOUS step's stats first (they are already
+            # computed on device — observing one step behind costs no
+            # dispatch stall; detection latency is one step)
+            self.health_flush()
         if scaled:
             args = (self.params, self.opt_state, self.buffers,
                     self.scaler_state, self.ef_state, xg, yg,
@@ -880,17 +951,25 @@ class ShardedTrainStep:
             args = (self.params, self.opt_state, self.buffers,
                     self.ef_state, xg, yg,
                     jnp.float32(lr), jnp.uint32(self._seed + self._step_i))
+        if self._health:
+            args = args + (jnp.asarray(self._health_poison),)
         with jax.set_mesh(self.mesh):
             fn = self._compiled
             if obs:
                 fn = self._obs_executable("step", "sharded_train_step", fn,
                                           args, (xg.shape, yg.shape))
+            out = fn(*args)
+            hstats = None
+            if self._health:
+                out, hstats = out[:-1], out[-1]
             if scaled:
                 (self.params, self.opt_state, self.buffers, self.ef_state,
-                 self.scaler_state, loss) = fn(*args)
+                 self.scaler_state, loss) = out
             else:
                 (self.params, self.opt_state, self.buffers, self.ef_state,
-                 loss) = fn(*args)
+                 loss) = out
+        if self._health:
+            self._health_observe(loss, hstats)
         if obs:
             samples = None
             if hasattr(x, "shape") and len(getattr(x, "shape", ())) >= 1:
@@ -926,6 +1005,80 @@ class ShardedTrainStep:
         self._scaler._scale = float(self.scaler_state[0])
         self._scaler._good_steps = int(self.scaler_state[1])
         self._scaler._bad_steps = int(self.scaler_state[2])
+
+    # ---------- training-numerics health (observability.health) ----------
+    @property
+    def health_groups(self):
+        """Ordered param-group names of the in-graph stat pass ([] when
+        health stats are off)."""
+        return list(self._health_groups) if self._health else []
+
+    def attach_health_monitor(self, monitor):
+        """Bind a HealthMonitor: each step's in-graph stats reach
+        ``monitor.observe()`` at the START of the next step (pipelined —
+        the device values are ready by then, so observation never stalls
+        a dispatch). Call ``health_flush()`` after the last step of a
+        loop to deliver the final pending stats. Returns the monitor."""
+        if not self._health:
+            raise ValueError(
+                "health stats are off for this step; build with "
+                "health_stats=True (or FLAGS_health_stats=1 / "
+                "set_flags({'health_stats': True}) before construction)")
+        monitor.bind_groups(self._health_groups)
+        self._health_monitor = monitor
+        return monitor
+
+    def health_flush(self):
+        """Deliver any pending stats to the attached monitor (blocks on
+        the device values). Returns the anomaly records raised."""
+        pending, self._health_pending = self._health_pending, None
+        if pending is None or self._health_monitor is None:
+            return []
+        return self._health_monitor.observe(**pending)
+
+    def set_grad_poison(self, group=None, value=float("nan")):
+        """Fault injector (tests/bench): from the next step on, multiply
+        GROUP's gradients by VALUE inside the compiled step (the poison
+        vector is a traced input — no recompile). ``group=None`` resets
+        to the all-ones healthy vector."""
+        if not self._health:
+            raise ValueError("health stats are off for this step")
+        import numpy as _np
+
+        vec = _np.ones(len(self._health_groups), _np.float32)
+        if group is not None:
+            vec[self._health_groups.index(group)] = value
+        self._health_poison = vec
+
+    def _health_observe(self, loss, stats):
+        """Stash one dispatched step's device stats for the next flush."""
+        self.health_state = stats
+        mon = self._health_monitor
+        if mon is None:
+            return
+        self._health_pending = {
+            "step": self._step_i, "loss": loss, "stats": stats,
+            "loss_scale": (self.scaler_state[0]
+                           if self.scaler_state is not None else None),
+            "data_position": mon.data_position(),
+        }
+
+    def _health_observe_multi(self, hstack, losses, K, scaled):
+        """run_steps: observe all K scanned steps from the stacked [K, G]
+        stats. The scaler automaton is scan carry, so only the final
+        scale is visible — passed with the last step's observation."""
+        tm = jax.tree_util.tree_map
+        self.health_state = tm(lambda v: v[-1], hstack)
+        mon = self._health_monitor
+        if mon is None:
+            return
+        pos = mon.data_position()
+        ls = self.scaler_state[0] if scaled else None
+        for k in range(K):
+            mon.observe(step=self._step_i - K + k + 1, loss=losses[k],
+                        stats=tm(lambda v, _k=k: v[_k], hstack),
+                        loss_scale=ls if k == K - 1 else None,
+                        data_position=pos)
 
     def sync_to_model(self):
         """Write the step's device state (params + buffers) back into the
@@ -1031,15 +1184,16 @@ class ShardedTrainStep:
 
     def lower_compiled(self, x, y):
         """AOT-lower (for compile checks without executing)."""
+        hp = ((jnp.asarray(self._health_poison),) if self._health else ())
         if self.scaler_state is not None:
             return self._compiled.lower(
                 self.params, self.opt_state, self.buffers,
                 self.scaler_state, self.ef_state, jnp.asarray(x),
-                jnp.asarray(y), jnp.float32(1e-3), jnp.uint32(0))
+                jnp.asarray(y), jnp.float32(1e-3), jnp.uint32(0), *hp)
         return self._compiled.lower(
             self.params, self.opt_state, self.buffers, self.ef_state,
             jnp.asarray(x), jnp.asarray(y), jnp.float32(1e-3),
-            jnp.uint32(0))
+            jnp.uint32(0), *hp)
 
 
 def make_sharded_train_step(model, optimizer, loss_fn=None, mesh=None, **kwargs) -> ShardedTrainStep:

@@ -19,6 +19,7 @@ from . import (  # noqa: F401
     export,
     flight_recorder,
     goodput,
+    health,
     instrument,
     memory,
     metrics,
@@ -48,6 +49,13 @@ from .flight_recorder import (  # noqa: F401
     stop_flight_recorder,
 )
 from .goodput import GoodputMonitor  # noqa: F401
+from .health import (  # noqa: F401
+    EwmaDetector,
+    HealthConfig,
+    HealthMonitor,
+    NonfiniteProvenance,
+    param_group,
+)
 from .instrument import record_collective, record_compile  # noqa: F401
 from .memory import (  # noqa: F401
     record_device_memory,
@@ -94,5 +102,7 @@ __all__ = [
     "record_executable", "record_live_buffers", "record_device_memory",
     "record_kv_cache",
     "GoodputMonitor", "fleet_report", "render_report",
+    "HealthMonitor", "HealthConfig", "EwmaDetector", "NonfiniteProvenance",
+    "param_group",
     "HardwareSpec", "attribute", "hardware_for_backend", "site_report",
 ]

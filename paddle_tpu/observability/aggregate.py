@@ -17,11 +17,19 @@ flush. Merge semantics:
                  percentiles re-estimated from the merged buckets
     stragglers — per-host ``train.step.seconds`` mean vs the fleet median
                  (delta seconds + ratio), the "host 13 is 1.4x slower" row
+    divergence — per-host ``health.grad_norm{group=_global}`` vs the fleet
+                 median plus per-host ``health.anomaly`` totals: one host's
+                 numerics drifting (stale data shard, flaky HBM) shows as
+                 a skew row before it shows as a NaN
+    serving_health — per-replica ``serving.requests.active`` /
+                 ``serving.kv.page_utilization`` levels (the multi-replica
+                 routing view)
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
 import re
 import statistics
@@ -32,6 +40,15 @@ from typing import Any, Dict, List, Optional
 BUCKET_BOUNDS = tuple(10.0 ** e for e in range(-7, 4))
 
 STEP_HIST = "train.step.seconds"
+
+# the divergence-skew view keys on the global grad-norm gauge emitted by
+# observability.health.HealthMonitor
+HEALTH_GRAD_GLOBAL = "health.grad_norm{group=_global}"
+HEALTH_ANOMALY = "health.anomaly"
+
+# per-replica serving levels folded into the fleet view
+SERVING_HEALTH_GAUGES = ("serving.requests.active",
+                         "serving.kv.page_utilization")
 
 
 def _render_key(name: str, labels: Dict[str, Any]) -> str:
@@ -195,14 +212,52 @@ def fleet_report(paths: List[str]) -> Dict[str, Any]:
                 "ratio": mean / med if med > 0 else 1.0})
         stragglers.sort(key=lambda s: -s["ratio"])
 
+    # per-host numerics skew: global grad-norm gauge vs fleet median +
+    # anomaly totals. A non-finite norm (a host mid-divergence) sorts first.
+    anomaly_totals: Dict[int, int] = {}
+    for key, c in counters.items():
+        if key.split("{", 1)[0] == HEALTH_ANOMALY:
+            for h, v in c["per_host"].items():
+                anomaly_totals[h] = anomaly_totals.get(h, 0) + int(v or 0)
+    divergence: List[Dict[str, Any]] = []
+    gnorms = {h: v for h, v in
+              gauges.get(HEALTH_GRAD_GLOBAL, {}).get("per_host", {}).items()
+              if v is not None}
+    if gnorms or anomaly_totals:
+        finite = [v for v in gnorms.values()
+                  if isinstance(v, (int, float)) and v == v
+                  and abs(v) != float("inf")]
+        med = statistics.median(finite) if finite else None
+        for h in sorted(set(gnorms) | set(anomaly_totals)):
+            v = gnorms.get(h)
+            nonfin = v is not None and not (
+                isinstance(v, (int, float)) and v == v
+                and abs(v) != float("inf"))
+            row = {"host": h, "grad_norm": v,
+                   "anomalies": anomaly_totals.get(h, 0),
+                   "nonfinite": nonfin}
+            if med is not None and v is not None and not nonfin and med > 0:
+                row["delta"] = v - med
+                row["ratio"] = v / med
+            divergence.append(row)
+        divergence.sort(key=lambda r: (not r["nonfinite"],
+                                       -r.get("ratio", 1.0),
+                                       -r["anomalies"]))
+
+    serving_health = {key: gauges[key] for key in sorted(gauges)
+                      if key.split("{", 1)[0] in SERVING_HEALTH_GAUGES}
+
     return {"hosts": sorted(hosts), "counters": counters, "gauges": gauges,
             "histograms": histograms, "series": series,
-            "stragglers": stragglers}
+            "stragglers": stragglers, "divergence": divergence,
+            "serving_health": serving_health}
 
 
 def _fmt(v) -> str:
     if v is None:
         return "-"
+    if isinstance(v, float) and not math.isfinite(v):
+        return str(v)  # a mid-divergence host's gauge IS nan/inf
     if isinstance(v, float) and v != int(v):
         return f"{v:.6g}"
     try:
@@ -247,4 +302,21 @@ def render_report(report: Dict[str, Any], grep: str = "") -> str:
         for s in report["stragglers"]:
             lines.append(f"host {s['host']:<35}{_fmt(s['mean_step_s']):>12}"
                          f"{_fmt(s['delta_s']):>12}{s['ratio']:>8.3f}")
+    if report.get("divergence"):
+        lines += ["", f"{'Divergence view (health.grad_norm _global)':<44}"
+                      f"{'grad_norm':>12}{'ratio':>8}{'anomalies':>10}",
+                  "-" * 74]
+        for d in report["divergence"]:
+            ratio = (f"{d['ratio']:.3f}" if "ratio" in d
+                     else ("NONFIN" if d["nonfinite"] else "-"))
+            lines.append(f"host {d['host']:<39}{_fmt(d['grad_norm']):>12}"
+                         f"{ratio:>8}{d['anomalies']:>10}")
+    sv = report.get("serving_health") or {}
+    if sv:
+        lines += ["", f"{'Serving health (per replica)':<44}{'Mean':>12}"
+                      f"{'Min':>12}{'Max':>12}", "-" * 80]
+        for k in sorted(sv):
+            g = sv[k]
+            lines.append(f"{k[:43]:<44}{_fmt(g.get('mean')):>12}"
+                         f"{_fmt(g.get('min')):>12}{_fmt(g.get('max')):>12}")
     return "\n".join(lines)

@@ -1,0 +1,502 @@
+"""Training-numerics health: in-graph stat pass + host-side detectors.
+
+The observability tier explains where time goes; this module watches
+whether the model is HEALTHY. Two halves:
+
+- ``in_graph_stats`` — a fused reduction computed INSIDE the compiled
+  train step (ShardedTrainStep wires it behind ``FLAGS_health_stats``):
+  per-param-group grad norm, param norm, update norm, and nonfinite
+  counts ride out of the step as a small replicated pytree next to the
+  loss. Per GSPMD the reductions partition under the step's own sharding,
+  so the monitor costs fused reduce ops, not host round-trips — the
+  capability the reference ships as FLAGS_check_nan_inf/nan_inf_utils,
+  rebuilt without per-op host checks.
+- ``HealthMonitor`` — host-side consumer: EWMA/z-score loss-spike and
+  grad-norm-spike detectors, a nonfinite-provenance resolver that names
+  the FIRST param group to go NaN/Inf (loss-scaler backoffs are
+  attributed to it instead of being silently eaten), loss-scale event
+  tracking, ``health.*`` metrics, and forensic capture — each anomaly is
+  recorded to the flight recorder with the full per-group stat table and
+  the offending batch's ``data_position``.
+
+Wiring (see examples/gpt_pretrain.py --health)::
+
+    step = make_sharded_train_step(model, opt, health_stats=True)
+    mon = step.attach_health_monitor(HealthMonitor(
+        on_anomaly=print, data_position=pipe.get_state))
+    for x, y in batches:
+        loss = step(x, y)      # stats observed one step later (no stall)
+    step.health_flush()        # deliver the final step's stats
+    print(mon.summary())
+
+Imports of jax and the metrics registry are lazy: detectors and parsing
+stay importable from the no-jax tools (health_report.py) via the same
+synthetic-package trick as aggregate.py.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+SCHEMA = "paddle_tpu.health.v1"
+GLOBAL_GROUP = "_global"
+
+ANOMALY_KINDS = ("nonfinite", "loss_nonfinite", "loss_spike",
+                 "grad_norm_spike", "overflow_skip")
+
+
+def _metrics():
+    """The metrics registry, or None outside the package (no-jax tools)."""
+    try:
+        from . import metrics
+        return metrics
+    except Exception:
+        return None
+
+
+def _flight():
+    try:
+        from . import flight_recorder
+        return flight_recorder
+    except Exception:
+        return None
+
+
+def stats_enabled() -> bool:
+    """FLAGS_health_stats — gates the in-graph stat pass (default off, so
+    the analyzer corpus / HLO baselines see the unchanged step)."""
+    try:
+        from ..core.flags import flag_value
+        return bool(flag_value("health_stats"))
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# param grouping
+# ---------------------------------------------------------------------------
+
+def param_group(name: str) -> str:
+    """Top-level group of a dotted param name.
+
+    The leaf component (weight/bias/...) is dropped, then the group is the
+    prefix up to and including the first numeric component — so every
+    param of one transformer block lands in one group
+    (``gpt.layers.0.attn.qkv.weight`` -> ``gpt.layers.0``) — else the
+    first two components (``gpt.embeddings``, ``gpt.final_ln``). Handles
+    pipeline-stacked names (``...__stacked__...`` has no numeric layer
+    index: the whole stack is one group).
+    """
+    parts = name.split(".")
+    base = parts[:-1] if len(parts) > 1 else parts
+    for i, comp in enumerate(base):
+        if comp.isdigit():
+            return ".".join(base[: i + 1])
+    return ".".join(base[:2]) if len(base) >= 2 else base[0]
+
+
+def group_index_map(names: Sequence[str],
+                    group_fn: Callable[[str], str] = param_group,
+                    ) -> Tuple[List[str], Dict[str, int]]:
+    """(ordered group list, {param name: group index}). Group order is
+    first-appearance order of ``names`` — model declaration order — so
+    "first group to go nonfinite" ties break toward earlier layers."""
+    groups: List[str] = []
+    index: Dict[str, int] = {}
+    by_group: Dict[str, int] = {}
+    for name in names:
+        g = group_fn(name)
+        if g not in by_group:
+            by_group[g] = len(groups)
+            groups.append(g)
+        index[name] = by_group[g]
+    return groups, index
+
+
+# ---------------------------------------------------------------------------
+# the in-graph stat pass (traced inside the compiled step)
+# ---------------------------------------------------------------------------
+
+def in_graph_stats(gidx: Dict[str, int], n_groups: int,
+                   params: Dict[str, Any], grads: Dict[str, Any],
+                   new_params: Dict[str, Any]) -> Dict[str, Any]:
+    """Fused per-group reductions, traced into the caller's jit.
+
+    Returns ``{"grad_norm","param_norm","update_norm": [G] f32,
+    "nonfinite": [G] i32}``. Each entry is a sum-of-squares (or count)
+    over the group's params, reduced in f32 — the same cost class as the
+    step's existing global-norm clip. Global values derive host-side
+    (sqrt of the summed squares), so nothing extra crosses the wire.
+    """
+    import jax.numpy as jnp
+
+    gsq = [jnp.zeros((), jnp.float32) for _ in range(n_groups)]
+    psq = [jnp.zeros((), jnp.float32) for _ in range(n_groups)]
+    usq = [jnp.zeros((), jnp.float32) for _ in range(n_groups)]
+    nonf = [jnp.zeros((), jnp.int32) for _ in range(n_groups)]
+    for name, g in grads.items():
+        i = gidx[name]
+        g32 = g.astype(jnp.float32)
+        gsq[i] = gsq[i] + jnp.sum(jnp.square(g32))
+        nonf[i] = nonf[i] + jnp.sum((~jnp.isfinite(g32)).astype(jnp.int32))
+        p32 = params[name].astype(jnp.float32)
+        psq[i] = psq[i] + jnp.sum(jnp.square(p32))
+        u32 = new_params[name].astype(jnp.float32) - p32
+        usq[i] = usq[i] + jnp.sum(jnp.square(u32))
+    return {
+        "grad_norm": jnp.sqrt(jnp.stack(gsq)),
+        "param_norm": jnp.sqrt(jnp.stack(psq)),
+        "update_norm": jnp.sqrt(jnp.stack(usq)),
+        "nonfinite": jnp.stack(nonf),
+    }
+
+
+# ---------------------------------------------------------------------------
+# host-side detectors
+# ---------------------------------------------------------------------------
+
+class HealthConfig:
+    """Detector knobs (all host-side — never traced, safe to tune per run).
+
+    - ``ewma_alpha``: smoothing of the running mean/variance.
+    - ``z_threshold``: |z| above which a spike fires.
+    - ``warmup_steps``: observations before a detector may fire.
+    - ``noise_floor``: relative std floor — a signal must move by at least
+      ``z_threshold * noise_floor * |mean|`` to fire, so near-constant
+      signals don't alarm on numeric dust.
+    - ``capture``: write flight-recorder ``anomaly`` events.
+    - ``max_anomalies``: ring bound on the kept anomaly records.
+    """
+
+    __slots__ = ("ewma_alpha", "z_threshold", "warmup_steps", "noise_floor",
+                 "capture", "max_anomalies")
+
+    def __init__(self, ewma_alpha: float = 0.05, z_threshold: float = 6.0,
+                 warmup_steps: int = 10, noise_floor: float = 0.01,
+                 capture: bool = True, max_anomalies: int = 256):
+        self.ewma_alpha = float(ewma_alpha)
+        self.z_threshold = float(z_threshold)
+        self.warmup_steps = int(warmup_steps)
+        self.noise_floor = float(noise_floor)
+        self.capture = bool(capture)
+        self.max_anomalies = int(max_anomalies)
+
+
+class EwmaDetector:
+    """EWMA mean/variance spike detector: z = (x - mean) / max(std, floor).
+
+    One-sided: only UPWARD excursions fire (for loss and grad norm a fast
+    drop is healthy — early training would otherwise alarm constantly).
+    The z-score is computed against the state BEFORE absorbing x, and a
+    firing-grade sample is excluded from the state update (a spike must
+    not vouch for itself); downward moves always absorb so the tracker
+    follows a fast-improving signal. Non-finite samples neither score nor
+    poison the state — the nonfinite path owns those.
+    """
+
+    __slots__ = ("alpha", "z_threshold", "warmup", "noise_floor",
+                 "mean", "var", "n")
+
+    def __init__(self, alpha: float = 0.05, z_threshold: float = 6.0,
+                 warmup: int = 10, noise_floor: float = 0.01):
+        self.alpha, self.z_threshold = float(alpha), float(z_threshold)
+        self.warmup, self.noise_floor = int(warmup), float(noise_floor)
+        self.mean = 0.0
+        self.var = 0.0
+        self.n = 0
+
+    def observe(self, x: float) -> Optional[float]:
+        """Feed one sample; returns its z-score (None for non-finite x).
+        ``fired(z)`` decides whether it counts as a spike."""
+        x = float(x)
+        if not math.isfinite(x):
+            return None
+        if self.n == 0:
+            self.mean, self.var, self.n = x, 0.0, 1
+            return 0.0
+        diff = x - self.mean
+        floor = self.noise_floor * abs(self.mean)
+        std = max(math.sqrt(self.var), floor, 1e-12)
+        z = diff / std
+        if self.n < self.warmup or z < self.z_threshold:
+            self.mean += self.alpha * diff
+            self.var = (1.0 - self.alpha) * (
+                self.var + self.alpha * diff * diff)
+        self.n += 1
+        return z
+
+    def fired(self, z: Optional[float]) -> bool:
+        return (z is not None and self.n > self.warmup
+                and z >= self.z_threshold)
+
+
+class NonfiniteProvenance:
+    """Sticky record of WHICH param group went NaN/Inf first.
+
+    ``update(step, counts)`` returns the groups that newly turned
+    non-finite this step (ordered by model declaration order). The first
+    such event is pinned as ``.first`` — the forensic answer to "where did
+    the NaN start" even after it propagates everywhere next step.
+    """
+
+    __slots__ = ("first", "bad", "_prev")
+
+    def __init__(self):
+        self.first: Optional[Dict[str, Any]] = None
+        self.bad: set = set()
+        self._prev: set = set()
+
+    def update(self, step: int, groups: Sequence[str],
+               counts: Sequence[int]) -> List[str]:
+        now = [g for g, c in zip(groups, counts) if c]
+        new = [g for g in now if g not in self._prev]
+        self._prev = set(now)
+        self.bad |= set(now)
+        if new and self.first is None:
+            self.first = {"step": int(step), "group": new[0],
+                          "groups": list(new)}
+        return new
+
+
+# ---------------------------------------------------------------------------
+# the monitor
+# ---------------------------------------------------------------------------
+
+class HealthMonitor:
+    """Consumes per-step (loss, in-graph stats) and raises anomalies.
+
+    - ``on_anomaly(record)`` — caller hook (print, alert, abort...).
+    - ``checkpoint_hook(record)`` — fired ONCE, on the first anomaly: the
+      checkpoint-before-divergence escape hatch (state is still the
+      pre-anomaly params when detection is pipelined one step behind).
+    - ``data_position`` — zero-arg provider (e.g. ``pipe.get_state``)
+      sampled at dispatch time so each anomaly names the offending batch.
+
+    All emission is via ``health.*`` metrics plus flight-recorder
+    ``anomaly`` events carrying the full per-group stat table.
+    """
+
+    def __init__(self, config: Optional[HealthConfig] = None,
+                 groups: Optional[Sequence[str]] = None,
+                 on_anomaly: Optional[Callable[[Dict[str, Any]], Any]] = None,
+                 checkpoint_hook: Optional[Callable[[Dict[str, Any]], Any]] = None,
+                 data_position: Optional[Callable[[], Any]] = None):
+        self.cfg = config if config is not None else HealthConfig()
+        self.groups: Optional[List[str]] = list(groups) if groups else None
+        self.on_anomaly = on_anomaly
+        self.checkpoint_hook = checkpoint_hook
+        self._data_position_fn = data_position
+        c = self.cfg
+        det = lambda: EwmaDetector(c.ewma_alpha, c.z_threshold,
+                                   c.warmup_steps, c.noise_floor)
+        self.loss_detector = det()
+        self.grad_detector = det()
+        self.provenance = NonfiniteProvenance()
+        self.anomalies: List[Dict[str, Any]] = []
+        self.last_stats: Optional[Dict[str, Dict[str, float]]] = None
+        self.steps_observed = 0
+        self._prev_scale: Optional[float] = None
+        self._checkpointed = False
+        self._kind_counts: Dict[str, int] = {}
+
+    # -- wiring ------------------------------------------------------------
+    def bind_groups(self, groups: Sequence[str]):
+        """Adopt the step's group list (ShardedTrainStep calls this from
+        attach_health_monitor). Re-binding the SAME list is a no-op so the
+        elastic runner can re-attach across mesh re-forms; a different
+        model is a caller bug."""
+        groups = list(groups)
+        if self.groups is None:
+            self.groups = groups
+        elif self.groups != groups:
+            raise ValueError(
+                f"HealthMonitor bound to {len(self.groups)} group(s); "
+                f"re-bind with {len(groups)} differing group(s) — one "
+                "monitor per model")
+
+    def data_position(self):
+        if self._data_position_fn is None:
+            return None
+        try:
+            return self._data_position_fn()
+        except Exception:
+            return None
+
+    # -- the observation path ---------------------------------------------
+    def observe(self, step: int, loss, stats=None, loss_scale=None,
+                data_position=None) -> List[Dict[str, Any]]:
+        """Feed one training step's outputs. ``stats`` is the in-graph
+        pytree (device or host arrays); returns the anomaly records this
+        step raised (possibly empty)."""
+        step = int(step)
+        loss_f = float(loss)
+        table = self._stat_table(stats)
+        scale_f = None if loss_scale is None else float(loss_scale)
+        if data_position is None:
+            data_position = self.data_position()
+
+        anomalies: List[Dict[str, Any]] = []
+
+        # nonfinite provenance (needs per-group counts from the stat pass)
+        new_bad: List[str] = []
+        if table is not None and self.groups:
+            counts = [table[g]["nonfinite"] for g in self.groups]
+            new_bad = self.provenance.update(step, self.groups, counts)
+            for g in new_bad:
+                anomalies.append({"anomaly": "nonfinite", "group": g,
+                                  "groups": new_bad,
+                                  "nonfinite": table[g]["nonfinite"]})
+        elif not math.isfinite(loss_f):
+            # no stat pass wired: the loss itself is the only witness
+            if self.provenance.first is None:
+                self.provenance.first = {"step": step, "group": None,
+                                         "groups": []}
+                anomalies.append({"anomaly": "loss_nonfinite", "group": None})
+
+        # loss-scale events (dynamic fp16 scaling)
+        if scale_f is not None:
+            m = _metrics()
+            if self._prev_scale is not None and scale_f != self._prev_scale:
+                event = "backoff" if scale_f < self._prev_scale else "growth"
+                if m is not None:
+                    m.counter("health.loss_scale.events", 1, event=event)
+                if event == "backoff":
+                    # the scaler skipped the update: attribute the overflow
+                    # to the group(s) the provenance resolver caught
+                    blame = (new_bad[0] if new_bad else
+                             (self.provenance.first or {}).get("group"))
+                    anomalies.append({"anomaly": "overflow_skip",
+                                      "group": blame,
+                                      "scale": scale_f,
+                                      "prev_scale": self._prev_scale})
+            self._prev_scale = scale_f
+
+        # spike detectors (EWMA z-score; non-finite samples skip — the
+        # provenance path above already owns them)
+        z_loss = self.loss_detector.observe(loss_f)
+        if self.loss_detector.fired(z_loss):
+            anomalies.append({"anomaly": "loss_spike", "group": None,
+                              "z": round(z_loss, 3)})
+        gnorm = self._global_grad_norm(table)
+        z_grad = self.grad_detector.observe(gnorm) if gnorm is not None else None
+        if self.grad_detector.fired(z_grad):
+            blame = self._max_grad_group(table)
+            anomalies.append({"anomaly": "grad_norm_spike", "group": blame,
+                              "z": round(z_grad, 3)})
+
+        self._emit_gauges(loss_f, scale_f, gnorm, table, z_loss, z_grad)
+        records = [self._raise(a, step, loss_f, scale_f, table,
+                               data_position) for a in anomalies]
+        self.last_stats = table
+        self.steps_observed += 1
+        return records
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "steps_observed": self.steps_observed,
+            "anomalies": len(self.anomalies),
+            "kinds": dict(self._kind_counts),
+            "first_nonfinite": self.provenance.first,
+            "loss_scale": self._prev_scale,
+        }
+
+    # -- internals ---------------------------------------------------------
+    def _stat_table(self, stats) -> Optional[Dict[str, Dict[str, float]]]:
+        """Device pytree -> {group: {stat: float}} (adds update_ratio)."""
+        if stats is None or not self.groups:
+            return None
+
+        def tolist(v):
+            try:
+                import numpy as np
+                return np.asarray(v).tolist()  # one host transfer
+            except Exception:
+                return list(v)
+        host = {k: tolist(v) for k, v in dict(stats).items()}
+        host["nonfinite"] = [int(x) for x in host["nonfinite"]]
+        table = {}
+        for i, g in enumerate(self.groups):
+            pn = host["param_norm"][i]
+            un = host["update_norm"][i]
+            table[g] = {
+                "grad_norm": host["grad_norm"][i],
+                "param_norm": pn,
+                "update_norm": un,
+                "update_ratio": (un / pn) if pn > 0 else 0.0,
+                "nonfinite": host["nonfinite"][i],
+            }
+        return table
+
+    def _global_grad_norm(self, table) -> Optional[float]:
+        if table is None:
+            return None
+        return math.fsum(r["grad_norm"] ** 2 for r in table.values()) ** 0.5
+
+    def _max_grad_group(self, table) -> Optional[str]:
+        if not table:
+            return None
+        finite = {g: r["grad_norm"] for g, r in table.items()
+                  if math.isfinite(r["grad_norm"])}
+        src = finite or {g: r["nonfinite"] for g, r in table.items()}
+        return max(src, key=src.get)
+
+    def _emit_gauges(self, loss_f, scale_f, gnorm, table, z_loss, z_grad):
+        m = _metrics()
+        if m is None or not m.enabled():
+            return
+        m.gauge("health.loss", loss_f)
+        if scale_f is not None:
+            m.gauge("health.loss_scale", scale_f)
+        if z_loss is not None:
+            m.histogram("health.detector.z", abs(z_loss), signal="loss")
+        if z_grad is not None:
+            m.histogram("health.detector.z", abs(z_grad), signal="grad_norm")
+        if gnorm is not None:
+            m.gauge("health.grad_norm", gnorm, group=GLOBAL_GROUP)
+        if table:
+            for g, row in table.items():
+                m.gauge("health.grad_norm", row["grad_norm"], group=g)
+                m.gauge("health.param_norm", row["param_norm"], group=g)
+                m.gauge("health.update_ratio", row["update_ratio"], group=g)
+
+    def _raise(self, anomaly: Dict[str, Any], step: int, loss_f: float,
+               scale_f, table, data_position) -> Dict[str, Any]:
+        record = {
+            "kind": "anomaly",
+            "schema": SCHEMA,
+            "step": step,
+            "loss": loss_f,
+            "loss_scale": scale_f,
+            "data_position": data_position,
+            "stats": table,
+            **anomaly,
+        }
+        kind = record["anomaly"]
+        self._kind_counts[kind] = self._kind_counts.get(kind, 0) + 1
+        self.anomalies.append(record)
+        if len(self.anomalies) > self.cfg.max_anomalies:
+            del self.anomalies[0]
+        m = _metrics()
+        if m is not None:
+            m.counter("health.anomaly", 1, kind=kind,
+                      group=record.get("group") or GLOBAL_GROUP)
+        if self.cfg.capture:
+            fl = _flight()
+            if fl is not None:
+                try:
+                    fl.record_event(record)
+                except Exception:
+                    pass
+        if self.checkpoint_hook is not None and not self._checkpointed:
+            self._checkpointed = True
+            try:
+                self.checkpoint_hook(record)
+            except Exception:
+                pass
+        if self.on_anomaly is not None:
+            try:
+                self.on_anomaly(record)
+            except Exception:
+                pass
+        return record

@@ -10,11 +10,14 @@ from __future__ import annotations
 from typing import Optional
 
 from . import metrics
+from .attribution import hardware_for_backend
 
 
 def peak_flops(backend: Optional[str] = None) -> float:
-    """Per-chip peak FLOP/s the MFU denominator uses (v5e bf16 peak on TPU;
-    the nominal 1e12 used for CPU smoke numbers elsewhere in the repo)."""
+    """Per-chip peak FLOP/s the MFU denominator uses — read from
+    ``attribution.HW_SPECS`` (the roofline table), so MFU and the
+    roofline floors can never quote different peaks for one backend
+    (a pin test in tests/test_attribution.py holds them equal)."""
     if backend is None:
         try:
             import jax
@@ -22,7 +25,7 @@ def peak_flops(backend: Optional[str] = None) -> float:
             backend = jax.default_backend()
         except Exception:
             backend = "cpu"
-    return 197e12 if backend in ("tpu", "axon") else 1e12
+    return hardware_for_backend(backend).peak_flops
 
 
 def record_step(*, seconds: Optional[float] = None,

@@ -1,0 +1,60 @@
+"""Victim process for the health forensic-capture chaos test.
+
+Runs a HealthMonitor against a synthetic stat stream with an injected
+NaN, writing real flight-recorder ``anomaly`` records (short flush
+interval so they hit disk), then prints READY and keeps observing until
+killed. SIGKILL mid-write is the hard-crash model: the parent asserts
+the flight file still parses (torn tail tolerated), carries the anomaly
+records with their per-group stat tables and data_position, and has NO
+final record (nobody got to finalize).
+
+Stats are plain python lists — HealthMonitor accepts any array-likes —
+so the victim never touches jax and starts fast.
+"""
+
+import argparse
+import math
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--flight", required=True)
+    args = ap.parse_args()
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import health
+
+    obs.enable()
+    obs.start_flight_recorder(args.flight, flush_interval_s=0.02)
+
+    groups = ["gpt.embeddings", "gpt.layers.0", "gpt.layers.1"]
+    mon = health.HealthMonitor(
+        groups=groups,
+        data_position=lambda: {"shard": 3, "offset": 4096})
+
+    def stats(poison):
+        nan = float("nan")
+        return {
+            "grad_norm": [1.0, nan if poison else 1.0, 1.0],
+            "param_norm": [10.0, 10.0, 10.0],
+            "update_norm": [0.01, 0.01, 0.01],
+            "nonfinite": [0, 7 if poison else 0, 0],
+        }
+
+    step = 0
+    for step in range(3):
+        mon.observe(step, loss=4.0 - 0.1 * step, stats=stats(False))
+    mon.observe(3, loss=math.nan, stats=stats(True))
+    obs.get_flight_recorder().flush()
+    print("READY", flush=True)
+    while True:  # keep the anomaly stream hot until SIGKILL lands
+        step += 1
+        # alternate poison so each poisoned step is NEWLY bad and raises
+        # (and flight-writes) a fresh anomaly record
+        mon.observe(step, loss=4.0, stats=stats(step % 2 == 1))
+        obs.get_flight_recorder().flush()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

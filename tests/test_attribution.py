@@ -75,6 +75,13 @@ def test_tpu_peak_pinned_to_training_tier():
 
     assert attribution.HW_SPECS["tpu"].peak_flops == \
         training.peak_flops("tpu")
+    # ...and it IS the Hardware table now, across every backend row —
+    # including the fallbacks (cpu spec), so the two can't drift again
+    for backend in ("tpu", "axon", "cpu", "cpu_fallback", "???"):
+        assert training.peak_flops(backend) == \
+            attribution.hardware_for_backend(backend).peak_flops
+    assert training.peak_flops("cpu") == \
+        attribution.HW_SPECS["cpu"].peak_flops
 
 
 def test_tolerances_pinned_to_hlo_audit():

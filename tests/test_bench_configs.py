@@ -47,7 +47,7 @@ def test_all_configs_registered():
     assert set(bench.CONFIGS) == {"bert_sst2", "gpt_dp", "ernie_mp4",
                                   "resnet50", "gpt_moe", "serving", "ckpt",
                                   "data", "comm", "reshard", "obs",
-                                  "analysis", "elastic"}
+                                  "analysis", "elastic", "health"}
 
 
 def test_bench_ckpt_row_contract(capsys):
@@ -293,6 +293,41 @@ def test_bench_elastic_row_contract(capsys):
     assert tele["histograms"]["elastic.recovery_to_first_step_seconds"][
         "count"] == 1
     assert tele["gauges"]["elastic.world.hosts"] == 1
+    # the row must not leave the global observability flag flipped on
+    assert not observability.enabled()
+
+
+def test_bench_health_row_contract(capsys):
+    """The health row's acceptance invariants (ISSUE 15): the in-graph
+    stat pass + HealthMonitor stay within noise of the flag-off step
+    (<5% is the hardware acceptance; CPU-CI gets the same jitter bound
+    as the obs row), and the injected-NaN sub-row names the EXACT
+    poisoned param group at the pipelined one-step detection latency —
+    all without a second compile of the train step."""
+    import bench
+    from paddle_tpu import observability
+
+    row = bench.bench_health()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(out)
+    assert parsed == row
+    assert parsed["config"] == "health"
+    assert np.isfinite(parsed["value"])
+    assert parsed["step_ms_off"] > 0 and parsed["step_ms_on"] > 0
+    # zero-overhead within noise: same jitter bound as the obs row —
+    # the stat pass may not cost more than half a step on CPU CI
+    assert abs(parsed["overhead_ms"]) <= 0.5 * parsed["step_ms_off"]
+    assert parsed["groups"] >= 3  # embeddings + layers + final_ln
+    # the injected fault is caught, named exactly, one step later
+    assert parsed["detect_named_group"] == parsed["detect_target_group"]
+    assert parsed["detect_steps"] == 1
+    assert parsed["anomalies"].get("nonfinite", 0) >= 1
+    tele = parsed["telemetry"]
+    # one-compile contract with health stats on (poison is a traced input)
+    assert tele["counters"][
+        "jit.compile.cache_miss{site=sharded_train_step}"] == 1
+    assert any(k.startswith("health.anomaly{") for k in tele["counters"])
+    assert "health.grad_norm{group=_global}" in tele["gauges"]
     # the row must not leave the global observability flag flipped on
     assert not observability.enabled()
 

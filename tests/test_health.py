@@ -1,0 +1,567 @@
+"""Training-numerics observatory (health.py + the ShardedTrainStep stat
+pass): detector units, NaN provenance naming the exact poisoned group,
+forensic flight capture with per-group stats + data_position, the
+one-compile contract with the stat pass on, scaler overflow attribution,
+the fleet divergence/serving-health views, the no-jax health_report CLI,
+the metrics-doc drift gate, and the SIGKILL-mid-anomaly crash model.
+"""
+
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import health
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    from paddle_tpu.distributed import collective, mesh, topology
+
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+    yield
+    collective.destroy_process_group()
+    mesh.reset_global_mesh()
+    topology.set_hybrid_communicate_group(None)
+
+
+@pytest.fixture
+def telemetry():
+    obs.enable()
+    obs.reset()
+    yield obs
+    obs.stop_flight_recorder()
+    obs.disable()
+    obs.reset()
+
+
+def _build(scaler=None, health_stats=True, num_layers=2):
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    paddle.seed(0)
+    model = gpt_tiny(dropout=0.0, num_layers=num_layers)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters(),
+                                 multi_precision=scaler is not None)
+    step = make_sharded_train_step(model, opt, scaler=scaler,
+                                   health_stats=health_stats)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 128, size=(8, 16))
+    y = np.roll(x, -1, axis=1)
+    return step, x, y
+
+
+# ---------------------------------------------------------------- grouping
+
+def test_param_group_heuristics():
+    # per-block grouping: prefix through the first numeric component
+    assert health.param_group("gpt.layers.0.attn.qkv.weight") == \
+        "gpt.layers.0"
+    assert health.param_group("gpt.layers.11.mlp.fc1.bias") == \
+        "gpt.layers.11"
+    # no layer index: first two components (leaf dropped)
+    assert health.param_group("gpt.embeddings.word_embeddings.weight") == \
+        "gpt.embeddings"
+    assert health.param_group("gpt.final_ln.weight") == "gpt.final_ln"
+    # pipeline-stacked names carry no per-layer index: one group per stack
+    assert health.param_group("gpt.layers.__stacked__.attn.weight") == \
+        "gpt.layers"
+    assert health.param_group("scale") == "scale"
+
+
+def test_group_index_map_declaration_order():
+    names = ["gpt.embeddings.w", "gpt.layers.0.a.w", "gpt.layers.0.b.w",
+             "gpt.layers.1.a.w", "gpt.final_ln.w"]
+    groups, gidx = health.group_index_map(names)
+    assert groups == ["gpt.embeddings", "gpt.layers.0", "gpt.layers.1",
+                      "gpt.final_ln"]
+    assert gidx["gpt.layers.0.b.w"] == 1
+    assert gidx["gpt.final_ln.w"] == 3
+
+
+# --------------------------------------------------------------- detectors
+
+def test_ewma_detector_fires_on_upward_spike_only():
+    det = health.EwmaDetector(alpha=0.1, z_threshold=6.0, warmup=5)
+    for _ in range(20):
+        det.observe(1.0)
+    # downward excursion: healthy (loss dropping), must not fire
+    assert not det.fired(det.observe(0.0))
+    # upward spike: fires, and the spike must not vouch for itself —
+    # the tracked mean stays near the pre-spike level
+    z = det.observe(100.0)
+    assert det.fired(z) and z > 6.0
+    assert det.mean < 2.0
+    # ...so an identical second spike still fires
+    assert det.fired(det.observe(100.0))
+
+
+def test_ewma_detector_warmup_and_nonfinite():
+    det = health.EwmaDetector(alpha=0.1, z_threshold=3.0, warmup=10)
+    det.observe(1.0)
+    assert not det.fired(det.observe(50.0))  # inside warmup: never fires
+    assert det.observe(math.nan) is None     # non-finite: no score,
+    assert det.n == 2                        # no state poisoning
+
+
+def test_ewma_detector_tracks_improving_signal():
+    # a fast-dropping loss must keep absorbing: no alarm on recovery steps
+    det = health.EwmaDetector(alpha=0.2, z_threshold=6.0, warmup=3,
+                              noise_floor=0.01)
+    fired = [det.fired(det.observe(10.0 * 0.7 ** i)) for i in range(30)]
+    assert not any(fired)
+
+
+def test_nonfinite_provenance_pins_first_group():
+    prov = health.NonfiniteProvenance()
+    groups = ["a", "b", "c"]
+    assert prov.update(1, groups, [0, 0, 0]) == []
+    assert prov.update(2, groups, [0, 3, 0]) == ["b"]
+    # next step everything is NaN — but the first-event pin holds
+    assert prov.update(3, groups, [9, 9, 9]) == ["a", "c"]
+    assert prov.first == {"step": 2, "group": "b", "groups": ["b"]}
+    # a group that stays bad is not re-reported
+    assert prov.update(4, groups, [9, 9, 9]) == []
+
+
+def test_in_graph_stats_values_match_numpy():
+    names = ["m.embeddings.w", "m.layers.0.w", "m.layers.0.b"]
+    _, gidx = health.group_index_map(names)
+    params = {"m.embeddings.w": jnp.arange(4, dtype=jnp.float32),
+              "m.layers.0.w": jnp.ones((2, 2), jnp.float32) * 2,
+              "m.layers.0.b": jnp.zeros((3,), jnp.float32)}
+    grads = {"m.embeddings.w": jnp.ones((4,), jnp.float32),
+             "m.layers.0.w": jnp.full((2, 2), jnp.nan, jnp.float32),
+             "m.layers.0.b": jnp.ones((3,), jnp.float32) * 3}
+    new_params = {k: v + 0.5 for k, v in params.items()}
+    st = jax.jit(lambda p, g, n: health.in_graph_stats(gidx, 2, p, g, n))(
+        params, grads, new_params)
+    np.testing.assert_allclose(
+        st["grad_norm"][0], np.linalg.norm(np.ones(4)), rtol=1e-6)
+    assert not np.isfinite(float(st["grad_norm"][1]))  # NaN group
+    np.testing.assert_allclose(
+        st["param_norm"][0], np.linalg.norm(np.arange(4)), rtol=1e-6)
+    # update norm: +0.5 on every element of the group
+    np.testing.assert_allclose(
+        st["update_norm"][1], np.linalg.norm(np.full(7, 0.5)), rtol=1e-6)
+    assert list(np.asarray(st["nonfinite"])) == [0, 4]
+
+
+def test_monitor_grad_spike_blames_hot_group():
+    mon = health.HealthMonitor(
+        health.HealthConfig(warmup_steps=3, z_threshold=6.0),
+        groups=["a", "b"])
+
+    def stats(gb):
+        return {"grad_norm": [1.0, gb], "param_norm": [10.0, 10.0],
+                "update_norm": [0.1, 0.1], "nonfinite": [0, 0]}
+
+    for i in range(10):
+        assert mon.observe(i, loss=2.0, stats=stats(1.0)) == []
+    recs = mon.observe(10, loss=2.0, stats=stats(500.0))
+    assert [r["anomaly"] for r in recs] == ["grad_norm_spike"]
+    assert recs[0]["group"] == "b"
+    assert recs[0]["stats"]["b"]["grad_norm"] == 500.0
+
+
+# --------------------------------------------- the wired step (integration)
+
+@pytest.mark.slow
+def test_one_compile_contract_with_health_on(telemetry):
+    """Regression pin: the poison vector is a TRACED input, so N steps
+    (including a poison flip) compile the step exactly once.
+
+    Slow tier: the fast suite pins the same contract via the bench health
+    row's cache_miss assert and the analyzer re-trace test."""
+    step, x, y = _build()
+    for _ in range(3):
+        step(x, y)
+    step.set_grad_poison(step.health_groups[0])
+    step(x, y)
+    c = obs.snapshot()["counters"]
+    assert c["jit.compile.cache_miss{site=sharded_train_step}"] == 1
+    assert c["jit.compile.cache_hit{site=sharded_train_step}"] == 3
+
+
+def test_injected_nan_names_exact_group_with_forensics(telemetry, tmp_path):
+    """The headline acceptance: poison ONE group's grads inside the
+    compiled step; the monitor must name exactly that group, and the
+    flight-recorder anomaly record must carry the full per-group stat
+    table and the batch data_position."""
+    fpath = str(tmp_path / "flight.jsonl")
+    rec = obs.start_flight_recorder(fpath, flush_interval_s=3600)
+    step, x, y = _build()
+    position = {"shard": 7, "offset": 12288}
+    seen = []
+    mon = step.attach_health_monitor(health.HealthMonitor(
+        on_anomaly=seen.append, data_position=lambda: dict(position)))
+    for _ in range(3):
+        step(x, y)
+    assert step.health_flush() == []  # clean steps raise nothing
+
+    target = "gpt.layers.1"
+    assert target in step.health_groups
+    step.set_grad_poison(target)
+    step(x, y)
+    anomalies = step.health_flush()
+    assert [a["anomaly"] for a in anomalies] == ["nonfinite"]
+    assert anomalies[0]["group"] == target          # the EXACT group
+    assert mon.provenance.first["group"] == target
+    assert anomalies[0]["data_position"] == position
+    table = anomalies[0]["stats"]
+    assert set(table) == set(step.health_groups)    # full stat table
+    assert table[target]["nonfinite"] > 0
+    # provenance precision: ONLY the poisoned group is non-finite so far
+    clean = [g for g in step.health_groups if g != target]
+    assert all(table[g]["nonfinite"] == 0 for g in clean), table
+    assert seen == anomalies
+
+    rec.flush()
+    flight = obs.read_flight(fpath)
+    fevs = [e for e in flight["events"] if e.get("kind") == "anomaly"]
+    assert len(fevs) == 1
+    assert fevs[0]["schema"] == "paddle_tpu.health.v1"
+    assert fevs[0]["group"] == target
+    assert fevs[0]["data_position"] == position
+    assert fevs[0]["stats"][target]["nonfinite"] > 0
+
+
+def test_checkpoint_hook_fires_once_on_first_anomaly(telemetry):
+    step, x, y = _build()
+    saved = []
+    step.attach_health_monitor(health.HealthMonitor(
+        health.HealthConfig(capture=False), checkpoint_hook=saved.append))
+    step(x, y)
+    step.set_grad_poison(step.health_groups[0])
+    step(x, y)  # poisoned — cascades from here on
+    step(x, y)
+    step(x, y)
+    step.health_flush()
+    assert len(saved) == 1  # once, at the first anomaly
+    assert saved[0]["group"] == step.health_groups[0]
+
+
+def test_scaler_overflow_attributed_and_update_skipped(telemetry):
+    """ISSUE acceptance: with fp16 dynamic scaling, a poisoned step trips
+    the scaler's overflow skip; the monitor attributes the backoff to the
+    provenance-blamed group and the stat pass proves the update was a
+    no-op (update_norm == 0)."""
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0,
+                                   decr_every_n_nan_or_inf=1)
+    step, x, y = _build(scaler=scaler)
+    mon = step.attach_health_monitor(health.HealthMonitor(
+        health.HealthConfig(capture=False)))
+    step(x, y)
+    before = {k: np.asarray(v) for k, v in step.params.items()}
+    target = step.health_groups[-1]
+    step.set_grad_poison(target)
+    step(x, y)
+    step.set_grad_poison(None)
+    kinds = {a["anomaly"]: a for a in step.health_flush()}
+    assert set(kinds) == {"nonfinite", "overflow_skip"}
+    assert kinds["nonfinite"]["group"] == target
+    assert kinds["overflow_skip"]["group"] == target
+    assert step.loss_scaling() == 512.0  # backed off
+    for k, v in step.params.items():     # skipped update: params untouched
+        np.testing.assert_array_equal(np.asarray(v), before[k])
+    assert mon.last_stats[target]["update_norm"] == 0.0
+    c = obs.snapshot()["counters"]
+    assert c["health.loss_scale.events{event=backoff}"] == 1
+    # training resumes clean
+    step(x, y)
+    assert step.health_flush() == []
+    assert math.isfinite(float(step(x, y)))
+
+
+def test_run_steps_observes_every_scanned_step(telemetry):
+    step, x, y = _build()
+    mon = step.attach_health_monitor(health.HealthMonitor())
+    K = 3
+    xs = np.stack([x] * K)
+    ys = np.stack([y] * K)
+    step.run_steps(xs, ys)
+    step.run_steps(xs, ys)
+    step.health_flush()
+    assert mon.steps_observed == 2 * K
+    c = obs.snapshot()["counters"]
+    assert c["jit.compile.cache_miss{site=sharded_train_step.run_steps}"] \
+        == 1
+
+
+def test_flag_off_step_unchanged():
+    """Default-off: no stat output rides the step, attach refuses, and the
+    flag registry gates construction-time default."""
+    from paddle_tpu.core.flags import flag_value
+
+    assert flag_value("health_stats") is False
+    step, x, y = _build(health_stats=False)
+    assert not step._health
+    assert step.health_groups == []
+    with pytest.raises(ValueError, match="health stats are off"):
+        step.attach_health_monitor(health.HealthMonitor())
+    assert math.isfinite(float(step(x, y)))
+    assert step.health_flush() == []
+
+
+def test_analyzer_retrace_health_step_no_hazards():
+    """The tentpole's no-recompile-hazard proof: the health-enabled step
+    (its poison vector a ninth traced arg) re-traces through the analyzer
+    under the same one-compile + donation contract as the corpus
+    train_step, with zero gating findings."""
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis.analyzer import ProgramSpec, SiteContract
+
+    step, x, y = _build()
+    args = (step.params, step.opt_state, step.buffers, step.ef_state,
+            jnp.asarray(x), jnp.asarray(y), jnp.float32(1e-3),
+            jnp.uint32(0),
+            jnp.asarray(np.ones(len(step.health_groups), np.float32)))
+    spec = ProgramSpec(
+        "train_step_health", step._compiled_step_fn, args,
+        SiteContract(one_compile=True, donate_argnums=(0, 1, 2, 3)),
+        argnames=("params", "opt_state", "buffers", "ef", "x", "y",
+                  "lr", "seed", "hp"),
+        sharding=step.sharding_contract())
+    report = analysis.analyze_spec(spec)
+    hit = set(report.rules_hit())
+    assert not any(r.startswith(("recompile", "donation")) for r in hit), \
+        report.render()
+    assert report.new_against([]) == [], report.render()
+
+
+# ------------------------------------------------- fleet views + CLI tools
+
+def _write_dump(path, host, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps({"host": host, **r}) + "\n")
+
+
+def _gauge(name, value, **labels):
+    return {"type": "gauge", "name": name, "value": value, "labels": labels}
+
+
+def _counter(name, value, **labels):
+    return {"type": "counter", "name": name, "value": value, "labels": labels}
+
+
+def _host_records(gnorm, anomalies=0, active=None):
+    recs = [_gauge("health.grad_norm", gnorm, group="_global"),
+            _gauge("health.grad_norm", gnorm / 2, group="gpt.layers.0"),
+            _gauge("health.param_norm", 10.0, group="gpt.layers.0"),
+            _gauge("health.update_ratio", 0.01, group="gpt.layers.0"),
+            _gauge("health.loss", 2.5)]
+    if anomalies:
+        recs.append(_counter("health.anomaly", anomalies,
+                             kind="nonfinite", group="gpt.layers.0"))
+    if active is not None:
+        recs += [_gauge("serving.requests.active", active),
+                 _gauge("serving.kv.page_utilization", 0.5 + active / 100)]
+    return recs
+
+
+def test_fleet_report_divergence_skew_view(tmp_path):
+    from paddle_tpu.observability import aggregate
+
+    p0 = str(tmp_path / "metrics-host00000.jsonl")
+    p1 = str(tmp_path / "metrics-host00001.jsonl")
+    p2 = str(tmp_path / "metrics-host00002.jsonl")
+    _write_dump(p0, 0, _host_records(1.0))
+    _write_dump(p1, 1, _host_records(1.1))
+    _write_dump(p2, 2, _host_records(float("nan"), anomalies=3))
+    report = aggregate.fleet_report([p0, p1, p2])
+    div = report["divergence"]
+    assert [d["host"] for d in div][0] == 2      # nonfinite host sorts first
+    assert div[0]["nonfinite"] and div[0]["anomalies"] == 3
+    healthy = {d["host"]: d for d in div[1:]}
+    assert healthy[1]["ratio"] > healthy[0]["ratio"]
+    assert "delta" in healthy[0]
+    rendered = aggregate.render_report(report)
+    assert "Divergence view" in rendered and "NONFIN" in rendered
+
+
+def test_fleet_report_serving_health_view(tmp_path):
+    from paddle_tpu.observability import aggregate
+
+    p0 = str(tmp_path / "metrics-host00000.jsonl")
+    p1 = str(tmp_path / "metrics-host00001.jsonl")
+    _write_dump(p0, 0, _host_records(1.0, active=4))
+    _write_dump(p1, 1, _host_records(1.0, active=10))
+    report = aggregate.fleet_report([p0, p1])
+    sv = report["serving_health"]
+    assert sv["serving.requests.active"]["per_host"] == {0: 4, 1: 10}
+    assert sv["serving.requests.active"]["mean"] == 7
+    assert "serving.kv.page_utilization" in sv
+    assert "Serving health (per replica)" in aggregate.render_report(report)
+
+
+def test_health_report_cli(tmp_path):
+    """tools/health_report.py runs with no jax on crafted dumps + a flight
+    file (with a torn tail) and renders every section."""
+    dump = str(tmp_path / "metrics-host00000.jsonl")
+    _write_dump(dump, 0, _host_records(1.25, anomalies=2))
+    flight = str(tmp_path / "flight-host0.jsonl")
+    anomaly = {"kind": "anomaly", "schema": "paddle_tpu.health.v1",
+               "step": 41, "loss": float("inf"), "anomaly": "nonfinite",
+               "group": "gpt.layers.0",
+               "data_position": {"shard": 2, "offset": 512},
+               "stats": {"gpt.layers.0": {"grad_norm": None,
+                                          "nonfinite": 12}}}
+    with open(flight, "w") as f:
+        f.write(json.dumps({"kind": "header"}) + "\n")
+        f.write(json.dumps(anomaly) + "\n")
+        f.write('{"kind": "anomaly", "step": 42, "tor')  # torn mid-crash
+    cmd = [sys.executable, os.path.join(REPO, "tools", "health_report.py"),
+           dump, "--flight", flight]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "gpt.layers.0" in r.stdout
+    assert "Anomaly timeline" in r.stdout
+    assert "step     41" in r.stdout and "nonfinite" in r.stdout
+    assert "shard" in r.stdout  # data_position rendered
+
+    r = subprocess.run(cmd + ["--json"], capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, r.stderr
+    payload = json.loads(r.stdout)
+    assert len(payload["anomalies"]) == 1       # torn tail dropped
+    assert payload["anomalies"][0]["step"] == 41
+    assert payload["anomaly_counters"][
+        "health.anomaly{group=gpt.layers.0,kind=nonfinite}"] == 2
+
+    r = subprocess.run(cmd[:-2] + ["--flight", str(tmp_path / "nope")],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2
+
+
+def test_lint_metrics_gate_repo_clean():
+    """The committed tree passes its own drift gate: every emitted metric
+    name is documented in observability/README.md or baselined."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint_metrics.py")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_lint_metrics_gate_trips_on_undocumented(tmp_path):
+    root = tmp_path
+    (root / "paddle_tpu" / "observability").mkdir(parents=True)
+    (root / "paddle_tpu" / "x.py").write_text(
+        'metrics.counter("sneaky.metric", 1)\n'
+        'm.gauge("documented.metric", 2)\n')
+    readme = root / "paddle_tpu" / "observability" / "README.md"
+    readme.write_text("| `documented.metric` | gauge | fine |\n")
+    cmd = [sys.executable, os.path.join(REPO, "tools", "lint_metrics.py"),
+           "--root", str(root)]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "sneaky.metric" in r.stdout
+    assert "documented.metric" not in r.stdout.split("FAIL", 1)[1]
+
+    # baselining with a rationale makes it pass...
+    r = subprocess.run(cmd + ["--update-baseline", "--reason", "test"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout
+
+    # ...until the gap is documented: the entry goes STALE and fails
+    readme.write_text("| `documented.metric` | gauge | fine |\n"
+                      "| `sneaky.metric` | counter | now documented |\n")
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "stale" in r.stdout
+
+
+def test_sigkill_mid_anomaly_leaves_forensic_flight(tmp_path):
+    """The hard-crash model: SIGKILL lands while anomaly records are being
+    written. The flight file must still parse (torn tail tolerated), carry
+    anomaly records with stats + data_position, and have NO final record."""
+    fpath = str(tmp_path / "flight.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests",
+                                      "health_anomaly_victim.py"),
+         "--flight", fpath],
+        stdout=subprocess.PIPE, text=True, cwd=REPO, env=env)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGKILL)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == -9  # killed cold: no atexit, no finalize
+    flight = obs.read_flight(fpath)
+    assert flight["final"] is None
+    anomalies = [e for e in flight["events"] if e.get("kind") == "anomaly"]
+    assert anomalies, "no anomaly records survived the crash"
+    first = anomalies[0]
+    assert first["anomaly"] == "nonfinite"
+    assert first["group"] == "gpt.layers.0"
+    assert first["data_position"] == {"shard": 3, "offset": 4096}
+    assert first["stats"]["gpt.layers.0"]["nonfinite"] == 7
+
+
+@pytest.mark.slow
+def test_elastic_runner_reattaches_monitor():
+    """The monitor (detector state + provenance) survives a mesh re-form:
+    the runner re-binds it to every rebuilt step.
+
+    Slow tier with the rest of the elastic chaos harness: it builds and
+    rebuilds full GPT steps across a simulated host loss."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    from paddle_tpu.distributed import elastic as E
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    def build_step(mesh):
+        paddle.seed(0)
+        model = gpt_tiny(dropout=0.0, num_layers=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        return make_sharded_train_step(model, opt, mesh=mesh,
+                                       health_stats=True)
+
+    rng = np.random.RandomState(0)
+
+    def next_batch(i, data):
+        x = rng.randint(0, 128, size=(8, 16))
+        return x, np.roll(x, -1, axis=1)
+
+    n = len(jax.devices())
+    hosts = {0: list(range(n // 2)), 1: list(range(n // 2, n))}
+    mon = health.HealthMonitor()
+    cfg = E.ElasticConfig(axes={"dp": 2}, hosts=hosts)
+    with E.ElasticRunner(build_step, cfg, next_batch=next_batch,
+                         health_monitor=mon) as runner:
+        runner.run(2)
+        first_step = runner.step
+        assert first_step._health_monitor is mon
+        runner.inject_failure(1)
+        losses = runner.run(5)
+        assert runner.step is not first_step      # rebuilt after host loss
+        assert runner.step._health_monitor is mon  # re-attached
+        s = runner.summary()
+    assert len(losses) == 5 and all(np.isfinite(losses))
+    assert s["restarts"] == 1
+    assert s["health"]["steps_observed"] >= 4
+    assert s["health"]["anomalies"] == 0

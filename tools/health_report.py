@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Render training-numerics health from telemetry dumps + flight files.
+
+Usage:
+    python tools/health_report.py run/metrics-host*.jsonl
+    python tools/health_report.py m.jsonl --flight run/flight-*.jsonl
+    python tools/health_report.py m.jsonl --json
+
+Inputs are the per-host JSONL metrics files written by
+``observability.export.MetricsExporter`` (or plain ``dump_jsonl`` dumps)
+and, optionally, flight-recorder files whose ``anomaly`` events carry the
+forensic per-group stat tables (``paddle_tpu.health.v1`` records from
+observability.health.HealthMonitor). Sections:
+
+- norm trajectory — the ``health.grad_norm{group=_global}`` series per
+  host as a sparkline (``!`` marks a non-finite sample) + last value
+- per-group stats — last grad/param norm and update ratio per param group
+- anomaly counters — ``health.anomaly{kind,group}`` fleet totals
+- divergence view — per-host global grad norm vs the fleet median
+- anomaly timeline — flight-recorder anomaly records: step, kind, the
+  group the provenance resolver blamed, loss, and the batch data_position
+
+Runs standalone — no paddle_tpu (or jax) import — via the same
+synthetic-package trick as telemetry_report.py; aggregate.py is
+stdlib-only by contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import math
+import os
+import sys
+import types
+
+_OBS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "paddle_tpu", "observability")
+_pkg = types.ModuleType("_ptobs")
+_pkg.__path__ = [_OBS_DIR]
+sys.modules.setdefault("_ptobs", _pkg)
+aggregate = importlib.import_module("_ptobs.aggregate")
+
+_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _finite(v) -> bool:
+    return isinstance(v, (int, float)) and math.isfinite(v)
+
+
+def sparkline(values, width: int = 48) -> str:
+    """Unicode sparkline; non-finite samples render as '!'."""
+    if len(values) > width:  # downsample, keeping the tail
+        stride = len(values) / width
+        values = [values[min(int(i * stride), len(values) - 1)]
+                  for i in range(width)]
+    finite = [v for v in values if _finite(v)]
+    lo = min(finite) if finite else 0.0
+    hi = max(finite) if finite else 0.0
+    span = (hi - lo) or 1.0
+    out = []
+    for v in values:
+        if not _finite(v):
+            out.append("!")
+        else:
+            out.append(_BLOCKS[int((v - lo) / span * (len(_BLOCKS) - 1))])
+    return "".join(out)
+
+
+def read_anomalies(paths):
+    """Flight-recorder anomaly events, torn-tail tolerant, step-ordered."""
+    out = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail mid-crash — earlier lines hold
+                ev = obj.get("event", obj)
+                if ev.get("kind") == "anomaly":
+                    ev.setdefault("_file", os.path.basename(path))
+                    out.append(ev)
+    out.sort(key=lambda e: (e.get("step") or 0))
+    return out
+
+
+def _metric_name(key: str) -> str:
+    return key.split("{", 1)[0]
+
+
+def _group_of(key: str):
+    if "group=" not in key:
+        return None
+    return key.split("group=", 1)[1].rstrip("}").split(",")[0]
+
+
+def health_payload(report, anomalies):
+    """The --json payload: the health slice of the fleet report."""
+    gauges = report["gauges"]
+    per_group = {}
+    for key, g in gauges.items():
+        name = _metric_name(key)
+        grp = _group_of(key)
+        if name not in ("health.grad_norm", "health.param_norm",
+                        "health.update_ratio") or grp in (None, "_global"):
+            continue
+        per_group.setdefault(grp, {})[name.split(".", 1)[1]] = g.get("mean")
+    counters = {k: v["total"] for k, v in report["counters"].items()
+                if _metric_name(k) in ("health.anomaly",
+                                       "health.loss_scale.events")}
+    trajectory = {}
+    for key, points in report["series"].items():
+        if key != aggregate.HEALTH_GRAD_GLOBAL:
+            continue
+        for p in points:
+            trajectory.setdefault(p["host"], []).append(p["value"])
+    return {
+        "loss": gauges.get("health.loss", {}).get("mean"),
+        "loss_scale": gauges.get("health.loss_scale", {}).get("mean"),
+        "grad_norm_global": gauges.get(aggregate.HEALTH_GRAD_GLOBAL, {}),
+        "per_group": per_group,
+        "anomaly_counters": counters,
+        "divergence": report.get("divergence", []),
+        "trajectory": trajectory,
+        "anomalies": anomalies,
+    }
+
+
+def render(payload) -> str:
+    lines = []
+    traj = payload["trajectory"]
+    if traj:
+        lines += ["Norm trajectory (health.grad_norm _global)", "-" * 72]
+        for h in sorted(traj):
+            vals = traj[h]
+            last = vals[-1] if vals else None
+            last_s = (f"{last:.6g}" if _finite(last)
+                      else ("-" if last is None else str(last)))
+            lines.append(f"  host {h:<4} {sparkline(vals)}  last={last_s}")
+        lines.append("")
+    if payload["per_group"]:
+        lines += [f"{'Param group':<32}{'grad_norm':>12}{'param_norm':>12}"
+                  f"{'upd_ratio':>12}", "-" * 68]
+        for g in sorted(payload["per_group"]):
+            row = payload["per_group"][g]
+            fm = lambda v: (f"{v:.4g}" if _finite(v)
+                            else ("-" if v is None else str(v)))
+            lines.append(f"{g[:31]:<32}{fm(row.get('grad_norm')):>12}"
+                         f"{fm(row.get('param_norm')):>12}"
+                         f"{fm(row.get('update_ratio')):>12}")
+        lines.append("")
+    if payload["anomaly_counters"]:
+        lines += [f"{'Anomaly counter':<56}{'Total':>8}", "-" * 64]
+        for k in sorted(payload["anomaly_counters"]):
+            lines.append(f"{k[:55]:<56}{payload['anomaly_counters'][k]:>8}")
+        lines.append("")
+    if payload["divergence"]:
+        lines += [f"{'Divergence (vs fleet median)':<32}{'grad_norm':>12}"
+                  f"{'ratio':>8}{'anomalies':>10}", "-" * 62]
+        for d in payload["divergence"]:
+            ratio = (f"{d['ratio']:.3f}" if "ratio" in d
+                     else ("NONFIN" if d.get("nonfinite") else "-"))
+            gn = d.get("grad_norm")
+            gn_s = f"{gn:.6g}" if _finite(gn) else str(gn)
+            lines.append(f"host {d['host']:<27}{gn_s:>12}{ratio:>8}"
+                         f"{d['anomalies']:>10}")
+        lines.append("")
+    if payload["anomalies"]:
+        lines += ["Anomaly timeline (flight recorder)", "-" * 72]
+        for ev in payload["anomalies"]:
+            pos = ev.get("data_position")
+            pos_s = "" if pos is None else f"  data={json.dumps(pos)}"
+            loss = ev.get("loss")
+            loss_s = f"{loss:.6g}" if _finite(loss) else str(loss)
+            lines.append(f"  step {ev.get('step'):>6}  "
+                         f"{ev.get('anomaly', '?'):<16} "
+                         f"group={ev.get('group') or '-':<20} "
+                         f"loss={loss_s}{pos_s}")
+    if not lines:
+        lines = ["no health.* metrics in the given dumps "
+                 "(train with FLAGS_health_stats=1 + a HealthMonitor)"]
+    return "\n".join(lines).rstrip()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="per-host metrics-host*.jsonl dump files")
+    ap.add_argument("--flight", nargs="*", default=[],
+                    help="flight-recorder files (anomaly timeline source)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the health payload as JSON")
+    args = ap.parse_args(argv)
+    for p in list(args.paths) + list(args.flight):
+        if not os.path.exists(p):
+            print(f"health_report: {p}: no such file", file=sys.stderr)
+            return 2
+    report = aggregate.fleet_report(args.paths)
+    payload = health_payload(report, read_anomalies(args.flight))
+    if args.json:
+        print(json.dumps(payload, indent=2, sort_keys=True, default=str))
+    else:
+        print(render(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

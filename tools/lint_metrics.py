@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Metrics-doc drift gate: every emitted metric name must be documented.
+
+Scans the package (plus bench.py) for ``metrics.counter/gauge/histogram``
+call sites with a string-literal metric name and checks each name appears
+in ``paddle_tpu/observability/README.md`` — the metric catalog operators
+read. A new metric without a doc row fails the gate; a baselined gap that
+gets documented (or removed) goes STALE and fails until pruned, so the
+baseline only ever shrinks.
+
+Call sites whose first argument is not a string literal (f-strings,
+variables) are outside the scanner's reach by design — the repo's metric
+names are literal at the call site, and the gate exists to keep them so.
+
+Exit codes:
+  0  clean (all emitted names documented or baselined)
+  1  undocumented metrics not in baseline, or stale baseline entries
+  2  internal failure
+
+Usage:
+  python tools/lint_metrics.py                    # the CI gate
+  python tools/lint_metrics.py --list             # every name + call site
+  python tools/lint_metrics.py --update-baseline --reason "why"
+
+Stdlib-only (no jax, no package import): pure text scan.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_README = os.path.join(REPO, "paddle_tpu", "observability",
+                              "README.md")
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "metrics_doc_baseline.json")
+
+# <receiver>.counter/gauge/histogram("literal.name", ...) — receivers are
+# the module's import aliases around the repo
+CALL_RE = re.compile(
+    r"\b(?:metrics|_metrics|_obs_metrics|m|_m)\s*\."
+    r"(?:counter|gauge|histogram)\s*\(\s*"
+    r"(?P<q>['\"])(?P<name>[A-Za-z0-9_.]+)(?P=q)")
+
+
+def scan_sources(root: str):
+    """{metric name: [file:line, ...]} over paddle_tpu/**.py + bench.py."""
+    found = {}
+    targets = []
+    pkg = os.path.join(root, "paddle_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        targets += [os.path.join(dirpath, f) for f in filenames
+                    if f.endswith(".py")]
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        targets.append(bench)
+    for path in sorted(targets):
+        rel = os.path.relpath(path, root)
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                for m in CALL_RE.finditer(line):
+                    found.setdefault(m.group("name"), []).append(
+                        f"{rel}:{lineno}")
+    return found
+
+
+def load_baseline(path: str):
+    if not os.path.exists(path):
+        return {"undocumented": {}}
+    with open(path) as f:
+        data = json.load(f)
+    data.setdefault("undocumented", {})
+    return data
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=REPO,
+                    help="repo root to scan (tests point this at fixtures)")
+    ap.add_argument("--readme", default=None,
+                    help="metric catalog (default: observability/README.md "
+                         "under --root)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline json (default: tools/"
+                         "metrics_doc_baseline.json under --root)")
+    ap.add_argument("--list", action="store_true",
+                    help="print every emitted name + call sites and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="record current gaps / prune stale (needs --reason)")
+    ap.add_argument("--reason", default="")
+    ns = ap.parse_args(argv)
+    if ns.update_baseline and not ns.reason:
+        ap.error("--update-baseline requires --reason")
+    readme_path = ns.readme or os.path.join(
+        ns.root, "paddle_tpu", "observability", "README.md")
+    baseline_path = ns.baseline or os.path.join(
+        ns.root, "tools", "metrics_doc_baseline.json")
+
+    try:
+        found = scan_sources(ns.root)
+        with open(readme_path, encoding="utf-8") as f:
+            readme = f.read()
+    except OSError as e:
+        print(f"lint_metrics: internal failure: {e}", file=sys.stderr)
+        return 2
+
+    if ns.list:
+        for name in sorted(found):
+            print(f"{name}: {', '.join(found[name])}")
+        return 0
+
+    baseline = load_baseline(baseline_path)
+    suppressed = baseline["undocumented"]
+    documented = {n for n in found if n in readme}
+    undocumented = sorted(set(found) - documented)
+    new = [n for n in undocumented if n not in suppressed]
+    stale = sorted(n for n in suppressed
+                   if n not in found or n in documented)
+
+    if ns.update_baseline:
+        for n in new:
+            suppressed[n] = {"reason": ns.reason,
+                             "sites": found[n][:4]}
+        for n in stale:
+            del suppressed[n]
+        baseline["undocumented"] = dict(sorted(suppressed.items()))
+        os.makedirs(os.path.dirname(baseline_path) or ".", exist_ok=True)
+        with open(baseline_path, "w") as f:
+            json.dump(baseline, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"baseline updated: {len(new)} gap(s) recorded, "
+              f"{len(stale)} stale pruned -> {baseline_path}")
+        return 0
+
+    if ns.as_json:
+        print(json.dumps({
+            "emitted": {n: found[n] for n in sorted(found)},
+            "documented": sorted(documented),
+            "new_undocumented": new,
+            "stale_baseline": stale,
+        }, indent=2))
+        return 1 if (new or stale) else 0
+
+    print(f"lint_metrics: {len(found)} metric name(s) emitted, "
+          f"{len(documented)} documented, {len(suppressed)} baselined")
+    if new:
+        print(f"\nFAIL: {len(new)} emitted metric(s) missing from "
+              f"{os.path.relpath(readme_path, ns.root)}:")
+        for n in new:
+            print(f"  {n}  ({found[n][0]})")
+        print("\nadd a doc row, or baseline with a rationale:\n"
+              "  python tools/lint_metrics.py --update-baseline "
+              "--reason '...'")
+    if stale:
+        print(f"\nFAIL: {len(stale)} stale baseline entr(ies) — the gap "
+              "is documented or gone. Prune so the baseline stays honest:\n"
+              "  python tools/lint_metrics.py --update-baseline "
+              "--reason 'prune'")
+        for n in stale:
+            print(f"  stale: {n}")
+    if new or stale:
+        return 1
+    print("lint_metrics: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,11 @@ per line) — or plain ``dump_jsonl`` files. Counters sum across hosts,
 gauges report fleet mean/min/max, histograms merge bucket-wise with fleet
 p50/p95/p99, and the straggler section compares each host's
 ``train.step.seconds`` mean against the fleet median (delta + ratio).
+Training-numerics (``health.*``) dumps add a divergence-skew section
+(per-host global grad norm vs fleet median + anomaly totals) and serving
+dumps a per-replica ``serving.requests.active`` /
+``serving.kv.page_utilization`` health table; the deeper rendering of
+both lives in tools/health_report.py.
 
 Runs standalone — no paddle_tpu (or jax) import — so dumps copied off a
 TPU fleet merge anywhere (same synthetic-package trick as comm_plan.py).
