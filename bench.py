@@ -1528,6 +1528,157 @@ def bench_health():
     return out
 
 
+def bench_anatomy():
+    """Step-anatomy config: the per-scope gap-attribution table for the
+    GPT train step (observability/anatomy.py). The row's contract is the
+    tier's acceptance:
+    - Σ per-scope floors reconcile with the whole-step roofline floor
+      (scope walker vs a scope-blind walk over the same jaxpr, within
+      anatomy.FLOOR_SUM_TOLERANCE) and the unattributed bucket stays
+      under its <5% budget — the scope-coverage guarantee;
+    - an injected slowdown (one block's MLP forced to do 8x the work,
+      param tree unchanged) is named as the #1 gap contributor;
+    - with xprof absent (production CI hosts) the row still lands, every
+      per-scope ``measured_ms`` null — the static-only path."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability
+    from paddle_tpu.observability import anatomy, xplane
+    from paddle_tpu.observability import attribution as _attr
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.nn.layer.layers import Layer
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=8,
+                        num_heads=16, max_seq_len=512, dropout=0.0)
+        bsz, seq, iters = 8, 512, 6
+    else:
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64, dropout=0.0)
+        bsz, seq, iters = 2, 32, 2
+
+    class _SlowMLP(Layer):
+        """The injected culprit: k x the inner MLP's compute and traffic
+        with the SAME param tree, so the slowdown lands in block_NN/mlp
+        alone (a bigger intermediate_size would also grow opt/update)."""
+
+        def __init__(self, inner, k=8):
+            super().__init__()
+            self.inner = inner
+            self.k = k
+
+        def forward(self, x):
+            out = self.inner(x)
+            for _ in range(self.k - 1):
+                out = out + self.inner(x)
+            return out
+
+    def build(slow_block=None):
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+        if slow_block is not None:
+            blk = model.gpt.layers[slow_block]
+            blk.mlp = _SlowMLP(blk.mlp)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        return model, make_sharded_train_step(model, opt)
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, size=(bsz, seq), dtype=np.int32)
+    y = np.roll(x, -1, axis=1)
+    hw = _attr.hardware_for_backend(
+        "cpu" if _cpu_fallback() else _backend())
+
+    _model, step = build()
+    t0 = time.perf_counter()
+    jaxpr = step.step_jaxpr(x, y)
+    costs = anatomy.scope_costs(jaxpr)
+    flat = anatomy.flat_costs(jaxpr)
+    walk_ms = (time.perf_counter() - t0) * 1e3
+
+    # measured self time per scope rides only where the xprof converter
+    # exists; its absence is the static-only degradation path
+    measured = None
+    if xplane.have_xprof():
+        meas = xplane.measure(lambda: step(x, y), iters=iters)
+        if meas["available"]:
+            measured = anatomy.measured_by_scope(meas["rows"],
+                                                 iters=iters) or None
+
+    # XLA's own flop count for the compiled step, as an external
+    # cross-check on the walker's totals (advisory: CPU backends may not
+    # report it, and XLA counts transcendentals the walker skips)
+    xla_flops = None
+    try:
+        ca = step.lower_compiled(x, y).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        xla_flops = float(ca.get("flops")) if ca.get("flops") else None
+    except Exception:
+        pass
+
+    # injected slowdown: re-trace with block 1's MLP doing 8x the work;
+    # its per-scope floors stand in for "measured" so the gap table has a
+    # known culprit to name even on hosts with no profiler
+    _slow_model, slow_step = build(slow_block=1)
+    slow_costs = anatomy.scope_costs(slow_step.step_jaxpr(x, y))
+    slow_floor_s = {
+        r["scope"]: r["floor_ms"] * 1e-3
+        for r in anatomy.report(hw, slow_costs)["scopes"]}
+    injected = anatomy.report(hw, costs, measured=slow_floor_s, flat=flat)
+    injected_top = anatomy.top_gap_scope(injected)
+
+    was_enabled = observability.enabled()
+    observability.enable()
+    # the row's telemetry should carry only its own perf.anatomy.* series
+    # (earlier configs in the same process can leave NaN gauges —
+    # bench_health's injected poison — that break JSON round-tripping)
+    observability.reset()
+    try:
+        rep = anatomy.report(hw, costs, measured=measured, flat=flat)
+        anatomy.record_report(rep)
+        snap = observability.snapshot()
+    finally:
+        if not was_enabled:
+            observability.disable()
+
+    totals = rep["totals"]
+    out = {
+        "config": "anatomy",
+        "metric": "floor_sum_ratio",
+        "value": totals["floor_sum_ratio"],
+        "unit": "Σ per-scope floors / whole-step floor (reconciles "
+                f"within {anatomy.FLOOR_SUM_TOLERANCE:.0%})",
+        "hardware": hw.name,
+        "scopes": len(rep["scopes"]),
+        "measured_available": rep["measured"],
+        "floor_sum_ms": totals["floor_sum_ms"],
+        "whole_floor_ms": totals["whole_floor_ms"],
+        "floor_sum_ok": totals["floor_sum_ok"],
+        "unattributed_fraction": totals["unattributed_fraction"],
+        "unattributed_ok": totals["unattributed_ok"],
+        "injected_top_scope": injected_top,
+        "injected_ok": injected_top == "block_01/mlp",
+        "xla_flops": xla_flops,
+        "walker_flops": flat["flops"],
+        "walk_ms": round(walk_ms, 3),
+        "anatomy": rep,
+        "note": f"GPT B={bsz} S={seq} L={cfg.num_layers}; floors from the "
+                "scope-annotated step jaxpr; injected 8x-MLP slowdown in "
+                "block 1 must top the gap table"
+                + ("" if rep["measured"] else
+                   "; static-only (no xprof): measured_ms null per scope"),
+        "telemetry": snap,
+    }
+    if _cpu_fallback():
+        out["backend"] = "cpu_fallback"
+    print(json.dumps(out))
+    return out
+
+
 CONFIGS = {
     "bert_sst2": bench_bert_sst2,
     "gpt_dp": bench_gpt_dp,
@@ -1543,6 +1694,7 @@ CONFIGS = {
     "analysis": bench_analysis,
     "elastic": bench_elastic,
     "health": bench_health,
+    "anatomy": bench_anatomy,
 }
 
 

@@ -126,6 +126,7 @@ class FlowEvent:
     shape: Tuple[int, ...]
     in_loop: bool             # inside a scan/while body
     detail: str = ""
+    scope: str = ""           # canonical anatomy scope (observability/anatomy)
 
     def render(self) -> str:
         loop = " [in loop]" if self.in_loop else ""
@@ -285,14 +286,44 @@ class _Flow:
     def __init__(self, axis_sizes: Mapping[str, int]):
         self.axis_sizes = dict(axis_sizes)
         self.events: List[FlowEvent] = []
+        # enclosing equations' cleaned name-stack segments: nested jaxprs
+        # carry RELATIVE name stacks, so the anatomy scope of an event
+        # inside a scan/remat body needs the outer eqn's scope prepended
+        self._scope_prefix: List[str] = []
 
     # -- event emission -----------------------------------------------------
+    def _eqn_scope(self, eqn) -> str:
+        from ..observability.anatomy import clean_scope_path, scope_of_path
+
+        stack = clean_scope_path(
+            getattr(getattr(eqn, "source_info", None), "name_stack", ""))
+        parts = [p for p in self._scope_prefix if p]
+        if stack:
+            parts.append(stack)
+        return scope_of_path("/".join(parts))
+
     def _event(self, kind, eqn, path, in_loop, aval, detail):
+        try:
+            scope = self._eqn_scope(eqn)
+        except Exception:
+            scope = ""
         self.events.append(FlowEvent(
             kind=kind, prim=eqn.primitive.name, path=path,
             nbytes=_aval_bytes(aval), dtype=_aval_dtype(aval),
             shape=tuple(int(d) for d in getattr(aval, "shape", ())),
-            in_loop=in_loop, detail=detail))
+            in_loop=in_loop, detail=detail, scope=scope))
+
+    def _run_nested(self, eqn, inner, in_specs, path, in_loop):
+        """run() a sub-jaxpr with the enclosing eqn's scope pushed, so
+        events inside it resolve their relative name stacks correctly."""
+        from ..observability.anatomy import clean_scope_path
+
+        self._scope_prefix.append(clean_scope_path(
+            getattr(getattr(eqn, "source_info", None), "name_stack", "")))
+        try:
+            return self.run(inner, in_specs, path, in_loop)
+        finally:
+            self._scope_prefix.pop()
 
     # -- env helpers --------------------------------------------------------
     @staticmethod
@@ -608,7 +639,7 @@ class _Flow:
             self._h_default(env, eqn, path, in_loop)
             return
         in_specs = [self._read(env, v) for v in eqn.invars]
-        outs = self.run(inner, in_specs, path, in_loop)
+        outs = self._run_nested(eqn, inner, in_specs, path, in_loop)
         for var, spec in zip(eqn.outvars, outs):
             self._write(env, var, spec)
 
@@ -621,7 +652,7 @@ class _Flow:
         body_in = list(in_specs[:nc + ncar])
         for spec in in_specs[nc + ncar:]:  # xs lose the leading scan dim
             body_in.append(None if spec is None else tuple(spec[1:]))
-        outs = self.run(inner, body_in, path, True)
+        outs = self._run_nested(eqn, inner, body_in, path, True)
         # carry fixpoint: a carry whose sharding changes across the body
         # is resharded EVERY iteration
         for ci in range(ncar):
@@ -646,7 +677,7 @@ class _Flow:
         in_specs = [self._read(env, v) for v in eqn.invars]
         carry_in = in_specs[cn + bn:]
         body_in = in_specs[cn:cn + bn] + carry_in
-        outs = self.run(inner, body_in, path, True)
+        outs = self._run_nested(eqn, inner, body_in, path, True)
         for ci, (cin, cout) in enumerate(zip(carry_in, outs)):
             if cin is not None and cout is not None and cin != cout:
                 self._event("reshard", eqn, path, True,
@@ -663,8 +694,8 @@ class _Flow:
         branch_outs = []
         for bi, br in enumerate(branches):
             inner = br.jaxpr if isinstance(br, ClosedJaxpr) else br
-            branch_outs.append(self.run(inner, op_specs,
-                                        f"{path}.branch[{bi}]", in_loop))
+            branch_outs.append(self._run_nested(
+                eqn, inner, op_specs, f"{path}.branch[{bi}]", in_loop))
         for oi, var in enumerate(eqn.outvars):
             specs = {bo[oi] for bo in branch_outs}
             self._write(env, var,

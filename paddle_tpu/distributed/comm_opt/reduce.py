@@ -172,6 +172,7 @@ class GradReducer:
         return got == want
 
     # ---------------- the in-shard_map reduction ----------------
+    @jax.named_scope("comm/grad_reduce")
     def reduce_local(self, grads, ef_local, inv_scale=None):
         """(local grads, local residuals) -> (reduced grads, new residuals).
 

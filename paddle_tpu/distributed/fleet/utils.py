@@ -342,8 +342,9 @@ class ShardedTrainStep:
 
                         (l_, nb_), g_ = jax.value_and_grad(
                             micro_loss, has_aux=True)(params_l)
-                        g_, ef_c = reducer.reduce_local(g_, ef_c,
-                                                        inv_scale=inv)
+                        with jax.named_scope("comm/grad_reduce"):
+                            g_, ef_c = reducer.reduce_local(
+                                g_, ef_c, inv_scale=inv)
                         return (acc_l + l_,
                                 jax.tree_util.tree_map(jnp.add, acc_g, g_),
                                 nb_, ef_c), None
@@ -359,8 +360,9 @@ class ShardedTrainStep:
                 else:
                     (l, new_bufs), g = value_and_grad_accum(
                         params_l, bufs_l, x_l, y_l, seed_l, loss_scale=ls)
-                    g, ef_loc = reducer.reduce_local(g, ef_loc,
-                                                     inv_scale=inv)
+                    with jax.named_scope("comm/grad_reduce"):
+                        g, ef_loc = reducer.reduce_local(g, ef_loc,
+                                                         inv_scale=inv)
                 l = jax.lax.pmean(l, dax)
                 new_bufs = jax.tree_util.tree_map(
                     lambda t: (jax.lax.pmean(t, dax)
@@ -461,6 +463,7 @@ class ShardedTrainStep:
             for name, s in p_shard.items()
         }
 
+        @jax.named_scope("opt/update")
         def _clip_and_update(params, opt_state, grads, lr):
             grads = {
                 k: jax.lax.with_sharding_constraint(g, g_shard[k])
@@ -1181,6 +1184,22 @@ class ShardedTrainStep:
         if ts.rng and "seed" in ts.rng:
             self._seed = int(ts.rng["seed"])
         return self
+
+    def step_jaxpr(self, x, y):
+        """Trace the raw (pre-pjit) step into a ClosedJaxpr — the input
+        the step-anatomy tier's per-scope cost walker consumes
+        (``observability/anatomy.scope_costs``). Trace-only: nothing is
+        lowered or compiled."""
+        hp = ((jnp.asarray(self._health_poison),) if self._health else ())
+        if self.scaler_state is not None:
+            args = (self.params, self.opt_state, self.buffers,
+                    self.scaler_state, self.ef_state, jnp.asarray(x),
+                    jnp.asarray(y), jnp.float32(1e-3), jnp.uint32(0), *hp)
+        else:
+            args = (self.params, self.opt_state, self.buffers,
+                    self.ef_state, jnp.asarray(x), jnp.asarray(y),
+                    jnp.float32(1e-3), jnp.uint32(0), *hp)
+        return jax.make_jaxpr(self._compiled_step_fn)(*args)
 
     def lower_compiled(self, x, y):
         """AOT-lower (for compile checks without executing)."""

@@ -22,6 +22,7 @@ from dataclasses import dataclass
 
 from jax.sharding import PartitionSpec as P
 
+import jax
 import jax.numpy as jnp
 
 from .. import nn
@@ -355,16 +356,23 @@ class GPTBlock(Layer):
         self.mlp = GPTMoEMLP(cfg) if use_moe else GPTMLP(cfg)
 
     def forward(self, x, kv_cache=None, cache_positions=None, return_kv=False):
+        # anatomy scope convention: attn / mlp / moe nest under the
+        # enclosing block_NN scope (observability/anatomy.py)
+        mlp_scope = "moe" if isinstance(self.mlp, GPTMoEMLP) else "mlp"
         x = maybe_shard(x, _seq_spec(self.cfg))
         if return_kv or kv_cache is not None:
-            a, kv = self.attn(self.ln1(x), kv_cache=kv_cache,
-                              cache_positions=cache_positions,
-                              return_kv=return_kv)
-            x = x + a
-            x = x + self.mlp(self.ln2(x))
+            with jax.named_scope("attn"):
+                a, kv = self.attn(self.ln1(x), kv_cache=kv_cache,
+                                  cache_positions=cache_positions,
+                                  return_kv=return_kv)
+                x = x + a
+            with jax.named_scope(mlp_scope):
+                x = x + self.mlp(self.ln2(x))
             return maybe_shard(x, _seq_spec(self.cfg)), kv
-        x = x + self.attn(self.ln1(x))
-        x = x + self.mlp(self.ln2(x))
+        with jax.named_scope("attn"):
+            x = x + self.attn(self.ln1(x))
+        with jax.named_scope(mlp_scope):
+            x = x + self.mlp(self.ln2(x))
         return maybe_shard(x, _seq_spec(self.cfg))
 
 
@@ -424,33 +432,40 @@ class GPTModel(Layer):
             # (prefill returns the prompt's K/V; decode updates the static
             # cache). Inference-only — recompute/MoE-aux machinery is the
             # training loop's concern.
-            h = self.embeddings(input_ids, position_ids)
+            with jax.named_scope("embed"):
+                h = self.embeddings(input_ids, position_ids)
             kvs = []
             for i, block in enumerate(self.layers):
                 cache_i = kv_caches[i] if kv_caches is not None else None
-                h, kv = block(h, kv_cache=cache_i,
-                              cache_positions=cache_positions,
-                              return_kv=return_kv)
+                with jax.named_scope("block_%02d" % i):
+                    h, kv = block(h, kv_cache=cache_i,
+                                  cache_positions=cache_positions,
+                                  return_kv=return_kv)
                 kvs.append(kv)
-            return self.final_ln(h), kvs
-        h = self.embeddings(input_ids, position_ids)
+            with jax.named_scope("final_ln"):
+                h = self.final_ln(h)
+            return h, kvs
+        with jax.named_scope("embed"):
+            h = self.embeddings(input_ids, position_ids)
         aux = None
         for i, block in enumerate(self.layers):
             # MoE blocks run outside recompute: their aux_loss is read by
             # the loss path this trace, and smuggling it out of a
             # jax.checkpoint region would leak tracers
-            if self.cfg.use_recompute and self.training \
-                    and i % max(self.cfg.recompute_interval, 1) == 0 \
-                    and not isinstance(block.mlp, GPTMoEMLP):
-                from ..distributed.fleet.recompute import recompute
+            with jax.named_scope("block_%02d" % i):
+                if self.cfg.use_recompute and self.training \
+                        and i % max(self.cfg.recompute_interval, 1) == 0 \
+                        and not isinstance(block.mlp, GPTMoEMLP):
+                    from ..distributed.fleet.recompute import recompute
 
-                h = recompute(block, h, policy=self.cfg.recompute_policy)
-            else:
-                h = block(h)
+                    h = recompute(block, h, policy=self.cfg.recompute_policy)
+                else:
+                    h = block(h)
             if isinstance(block.mlp, GPTMoEMLP) and block.mlp.aux_loss is not None:
                 aux = block.mlp.aux_loss if aux is None else aux + block.mlp.aux_loss
         self.moe_aux_loss = aux
-        return self.final_ln(h)
+        with jax.named_scope("final_ln"):
+            return self.final_ln(h)
 
 
 class GPTForCausalLM(Layer):
@@ -464,11 +479,14 @@ class GPTForCausalLM(Layer):
             self.lm_head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size, has_bias=False, gather_output=False)
 
     def _logits(self, h):
-        """LM head over final hidden states (tied or separate)."""
-        if self.cfg.tie_word_embeddings:
-            logits = h.matmul(self.gpt.embeddings.word_embeddings.weight, transpose_y=True)
-            return maybe_shard(logits, P(_batch_axes(), None, "mp"))
-        return self.lm_head(h)
+        """LM head over final hidden states (tied or separate). The head
+        matmul attributes to the ``loss`` anatomy scope — the chunked CE
+        path fuses it with the loss, so both paths agree."""
+        with jax.named_scope("loss"):
+            if self.cfg.tie_word_embeddings:
+                logits = h.matmul(self.gpt.embeddings.word_embeddings.weight, transpose_y=True)
+                return maybe_shard(logits, P(_batch_axes(), None, "mp"))
+            return self.lm_head(h)
 
     def forward(self, input_ids, position_ids=None):
         return self._logits(self.gpt(input_ids, position_ids))
@@ -486,7 +504,9 @@ class GPTForCausalLM(Layer):
         For MoE configs the gate aux loss is added by forward_with_loss
         (this method sees only logits)."""
         V = logits.shape[-1]
-        return F.cross_entropy(logits.reshape([-1, V]), labels.reshape([-1])).mean()
+        with jax.named_scope("loss"):
+            return F.cross_entropy(
+                logits.reshape([-1, V]), labels.reshape([-1])).mean()
 
     def forward_with_loss(self, input_ids, labels):
         """Fused trunk->loss path. With cfg.loss_chunk set, the LM-head matmul
@@ -536,7 +556,9 @@ class GPTForCausalLM(Layer):
             h_c, y_c = xy
             return acc + ckpt_ce(h_c, y_c, Wv), None
 
-        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ys))
+        with jax.named_scope("loss"):
+            total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                    (hs, ys))
         loss = Tensor(total / (B * S))
         aux = self._moe_aux()
         return loss if aux is None else loss + aux

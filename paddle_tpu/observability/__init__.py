@@ -15,6 +15,7 @@ scheme and the bench.py field mapping.
 
 from . import (  # noqa: F401
     aggregate,
+    anatomy,
     attribution,
     export,
     flight_recorder,

@@ -1,0 +1,598 @@
+"""Step-anatomy tier: per-scope time attribution for the training step.
+
+The roofline tier (``attribution.py``, PR 11) answers *whether* a step
+runs above its floor; this tier answers *which scope owns the gap* — the
+missing input for sharding auto-search. The contract has three layers:
+
+1. **Scope naming convention** — the model and training stack annotate
+   themselves with ``jax.named_scope`` using a stable vocabulary
+   (``block_NN/attn``, ``block_NN/mlp``, ``block_NN/moe``, ``embed``,
+   ``final_ln``, ``loss``, ``opt/update``, ``comm/grad_reduce``,
+   ``serving/prefill``, ``serving/decode``). The names survive into HLO
+   op metadata (and into ``eqn.source_info.name_stack`` at trace time),
+   wrapped in transform frames (``jvp(...)``/``transpose(...)``) that
+   :func:`clean_scope_path` strips.
+
+2. **Per-scope cost split** — :func:`scope_costs` walks a step jaxpr
+   (including nested scan/remat/pjit bodies, whose name stacks are
+   *relative* to the enclosing equation) and accumulates flops, HBM
+   bytes, and explicit-collective wire bytes per canonical scope;
+   :func:`wire_from_flow` merges GSPMD-implicit wire predicted by
+   ``analysis.sharding_flow`` FlowEvents (which carry a ``scope`` field).
+   :func:`attribution.floors` turns each scope's costs into time floors.
+
+3. **Gap table** — :func:`report` joins the floors against measured
+   per-scope self time from ``xplane.op_rows()`` (when xprof is
+   installed) and emits the sorted measured-minus-floor table. Without
+   xprof the same report lands with ``measured_ms: null`` per scope —
+   the static-only degradation path, same contract as
+   ``xplane.have_xprof()``.
+
+Stdlib-only at import time (the synthetic-package contract shared with
+``attribution.py``): ``tools/anatomy_report.py`` renders reports on
+hosts with no jax. Only :func:`scope_costs` touches jax, lazily.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
+
+from . import attribution
+from . import xplane
+
+SCHEMA = "paddle_tpu.anatomy.v1"
+
+#: the catch-all bucket for device work outside every annotated scope;
+#: budgeted at <5% of step time in the bench row (scope-coverage lint)
+UNATTRIBUTED = "unattributed"
+UNATTRIBUTED_BUDGET = 0.05
+
+#: Σ per-scope floors must land within this of the whole-step floor
+FLOOR_SUM_TOLERANCE = 0.10
+
+#: recognized sub-scopes inside a transformer block
+BLOCK_SUBSCOPES = ("attn", "mlp", "moe")
+#: roots whose canonical scope keeps two path components (opt/update,
+#: comm/grad_reduce, serving/prefill|decode, obs/…, data/…)
+TWO_LEVEL_ROOTS = ("opt", "comm", "serving", "obs", "data")
+#: roots whose canonical scope is the single component
+SINGLE_ROOTS = ("embed", "final_ln", "loss")
+
+_BLOCK_RE = re.compile(r"^block_(\d+)$")
+#: transform frames jax wraps around scope names: ``jvp(block_00)``,
+#: ``transpose(jvp(block_00))``, ``jit(step)``, ``remat(...)``
+_TRANSFORM_CALL_RE = re.compile(r"[A-Za-z0-9_.\-]+\(")
+_GROUP_LAYER_RE = re.compile(r"\.layers?\.(\d+)$")
+
+
+# -- scope naming ----------------------------------------------------------
+
+def clean_scope_path(raw: Any) -> str:
+    """Strip jax transform frames from a name-stack/op-name string:
+    ``transpose(jvp(block_00))/mlp`` -> ``block_00/mlp``."""
+    s = _TRANSFORM_CALL_RE.sub("", str(raw or "")).replace(")", "")
+    return "/".join(p for p in s.split("/") if p)
+
+
+def scope_of_path(path: Any) -> str:
+    """The canonical scope a raw scope path / HLO op name belongs to.
+
+    Scans the cleaned path components for the first recognized scope
+    root (skipping transform artifacts like ``jit``/``step``):
+    ``block_\\d+`` keeps its first recognized sub-scope
+    (``block_03/mlp``), two-level roots keep the next component
+    (``opt/update``), single roots stand alone (``loss``). Anything
+    without a recognized root lands in :data:`UNATTRIBUTED`.
+    """
+    parts = clean_scope_path(path).split("/")
+    for i, comp in enumerate(parts):
+        m = _BLOCK_RE.match(comp)
+        if m:
+            base = "block_%02d" % int(m.group(1))
+            sub = next((p for p in parts[i + 1:] if p in BLOCK_SUBSCOPES),
+                       None)
+            return f"{base}/{sub}" if sub else base
+        if comp in TWO_LEVEL_ROOTS:
+            if i + 1 < len(parts):
+                return f"{comp}/{parts[i + 1]}"
+            return comp
+        if comp in SINGLE_ROOTS:
+            return comp
+    return UNATTRIBUTED
+
+
+def scope_for_param_group(group: str) -> Optional[str]:
+    """Map a ``health.param_group()`` name onto its anatomy scope
+    (``gpt.layers.3`` -> ``block_03``); None when the group has no
+    annotated scope — the scope-coverage lint fails on those."""
+    m = _GROUP_LAYER_RE.search(group)
+    if m:
+        return "block_%02d" % int(m.group(1))
+    leaf = group.split(".")[-1]
+    if leaf in ("embeddings", "embedding", "embed", "word_embeddings",
+                "position_embeddings"):
+        return "embed"
+    if leaf in ("final_ln", "ln_f", "final_layernorm", "final_norm"):
+        return "final_ln"
+    return None
+
+
+# -- per-scope cost split (jax only here, lazily) --------------------------
+
+#: explicit cross-chip collectives a jaxpr can carry (the shard_map /
+#: manual-mesh path); GSPMD-implicit wire comes from sharding_flow events
+_COLLECTIVE_FACTORS = {
+    # all-reduce moves ~2·(n-1)/n of the buffer per chip (ring)
+    "psum": lambda n: 2.0 * (n - 1) / n,
+    "pmin": lambda n: 2.0 * (n - 1) / n,
+    "pmax": lambda n: 2.0 * (n - 1) / n,
+    # gather/scatter move (n-1)/n shards of the full buffer
+    "all_gather": lambda n: float(n - 1) / n,
+    "reduce_scatter": lambda n: float(n - 1) / n,
+    "all_to_all": lambda n: float(n - 1) / n,
+    "ppermute": lambda n: 1.0 if n > 1 else 0.0,
+}
+
+
+def _aval_bytes(aval: Any) -> int:
+    size = 1
+    for d in getattr(aval, "shape", ()):
+        try:
+            size *= int(d)
+        except (TypeError, ValueError):
+            return 0
+    itemsize = getattr(getattr(aval, "dtype", None), "itemsize", None)
+    return size * int(itemsize) if itemsize else 0
+
+
+def _prod(it: Iterable[int]) -> int:
+    out = 1
+    for x in it:
+        out *= int(x)
+    return out
+
+
+def _dot_flops(eqn: Any) -> float:
+    """2·batch·M·N·K for a ``dot_general`` from its dimension numbers."""
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    ls = eqn.invars[0].aval.shape
+    rs = eqn.invars[1].aval.shape
+    batch = _prod(ls[d] for d in lb)
+    k = _prod(ls[d] for d in lc)
+    m = _prod(ls[d] for d in range(len(ls)) if d not in lc and d not in lb)
+    n = _prod(rs[d] for d in range(len(rs)) if d not in rc and d not in rb)
+    return 2.0 * batch * m * n * k
+
+
+def _axis_product(params: Mapping[str, Any],
+                  axis_sizes: Mapping[str, int]) -> int:
+    names = params.get("axes") or params.get("axis_name") or ()
+    if not isinstance(names, (tuple, list)):
+        names = (names,)
+    return _prod(axis_sizes.get(a, 1) for a in names) or 1
+
+
+def _eqn_costs(eqn: Any, axis_sizes: Mapping[str, int]
+               ) -> Tuple[float, float, float]:
+    """(flops, hbm_bytes, wire_bytes) for one leaf equation. The flops
+    model counts MXU work (dot_general) only — elementwise flops are
+    bandwidth-shadowed and would just add noise to compute floors; every
+    equation's operand+result bytes count toward the HBM floor."""
+    in_b = sum(_aval_bytes(v.aval) for v in eqn.invars
+               if hasattr(v, "aval"))
+    out_b = sum(_aval_bytes(v.aval) for v in eqn.outvars
+                if hasattr(v, "aval"))
+    prim = eqn.primitive.name
+    flops = _dot_flops(eqn) if prim == "dot_general" else 0.0
+    wire = 0.0
+    factor = _COLLECTIVE_FACTORS.get(prim)
+    if factor is not None:
+        n = _axis_product(eqn.params, axis_sizes)
+        if n > 1:
+            wire = in_b * factor(n)
+    return flops, float(in_b + out_b), wire
+
+
+def _sub_jaxprs(eqn: Any) -> List[Tuple[Any, int]]:
+    """(inner jaxpr, iteration multiplier) pairs for a higher-order
+    equation; [] for leaves. remat2 carries a raw Jaxpr where pjit/scan
+    carry a ClosedJaxpr — ``getattr(item, "jaxpr", item)`` covers both."""
+    prim = eqn.primitive.name
+    params = eqn.params
+    if prim == "scan":
+        inner = getattr(params["jaxpr"], "jaxpr", params["jaxpr"])
+        return [(inner, int(params.get("length") or 1))]
+    if prim == "while":
+        # trip count is dynamic; one iteration is the honest static floor
+        return [(getattr(params[k], "jaxpr", params[k]), 1)
+                for k in ("cond_jaxpr", "body_jaxpr") if k in params]
+    if prim == "cond":
+        return [(getattr(b, "jaxpr", b), 1)
+                for b in params.get("branches", ())]
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        if key in params:
+            inner = getattr(params[key], "jaxpr", params[key])
+            if hasattr(inner, "eqns"):
+                return [(inner, 1)]
+    return []
+
+
+def _zero() -> Dict[str, float]:
+    return {"flops": 0.0, "hbm_bytes": 0.0, "wire_bytes": 0.0}
+
+
+def scope_costs(jaxpr: Any,
+                axis_sizes: Optional[Mapping[str, int]] = None
+                ) -> Dict[str, Dict[str, float]]:
+    """Walk a (closed) jaxpr and split costs per canonical scope.
+
+    Nested jaxprs (scan/remat/pjit bodies) carry name stacks *relative*
+    to their enclosing equation, so the walker threads the enclosing
+    equation's cleaned scope path down as a prefix; scan bodies multiply
+    by the trace-time ``length``.
+    """
+    sizes = dict(axis_sizes or {})
+    costs: Dict[str, Dict[str, float]] = {}
+
+    def add(scope: str, f: float, h: float, w: float, mult: int) -> None:
+        d = costs.setdefault(scope, _zero())
+        d["flops"] += f * mult
+        d["hbm_bytes"] += h * mult
+        d["wire_bytes"] += w * mult
+
+    def walk(jx: Any, prefix: str, mult: int) -> None:
+        for eqn in jx.eqns:
+            stack = clean_scope_path(
+                getattr(eqn.source_info, "name_stack", ""))
+            full = f"{prefix}/{stack}" if prefix and stack else (
+                stack or prefix)
+            subs = _sub_jaxprs(eqn)
+            if subs:
+                # inner equations carry the bytes; counting the call
+                # frame's operands too would double every boundary
+                for sub, m in subs:
+                    walk(sub, full, mult * m)
+                continue
+            f, h, w = _eqn_costs(eqn, sizes)
+            add(scope_of_path(full), f, h, w, mult)
+
+    walk(getattr(jaxpr, "jaxpr", jaxpr), "", 1)
+    return costs
+
+
+def flat_costs(jaxpr: Any,
+               axis_sizes: Optional[Mapping[str, int]] = None
+               ) -> Dict[str, float]:
+    """Whole-step cost totals from an independent scope-blind walk — the
+    reconciliation reference :func:`report` checks the per-scope split
+    against (a split that dropped equations cannot sum back to this)."""
+    sizes = dict(axis_sizes or {})
+    total = _zero()
+
+    def walk(jx: Any, mult: int) -> None:
+        for eqn in jx.eqns:
+            subs = _sub_jaxprs(eqn)
+            if subs:
+                for sub, m in subs:
+                    walk(sub, mult * m)
+                continue
+            f, h, w = _eqn_costs(eqn, sizes)
+            total["flops"] += f * mult
+            total["hbm_bytes"] += h * mult
+            total["wire_bytes"] += w * mult
+
+    walk(getattr(jaxpr, "jaxpr", jaxpr), 1)
+    return total
+
+
+def wire_from_flow(events: Iterable[Any],
+                   costs: Optional[Dict[str, Dict[str, float]]] = None
+                   ) -> Dict[str, Dict[str, float]]:
+    """Merge sharding_flow FlowEvents' predicted wire bytes into a
+    per-scope cost table (GSPMD inserts these collectives after tracing,
+    so the jaxpr walker can never see them). Events carry the ``scope``
+    field sharding_flow threads from the same name stacks."""
+    out = {k: dict(v) for k, v in (costs or {}).items()}
+    for ev in events:
+        kind = getattr(ev, "kind", "")
+        if not kind.startswith(("all-", "reduce-", "point-to-point")):
+            continue
+        scope = scope_of_path(getattr(ev, "scope", "") or
+                              getattr(ev, "path", ""))
+        d = out.setdefault(scope, _zero())
+        d["wire_bytes"] += float(getattr(ev, "nbytes", 0) or 0)
+    return out
+
+
+# -- measured self time per scope ------------------------------------------
+
+def _op_name_key(row: Mapping[str, Any]) -> Optional[str]:
+    for k in row:
+        lk = str(k).lower().replace(" ", "_")
+        if lk in ("op_name", "name", "operation", "operation_name"):
+            return k
+    return None
+
+
+def measured_by_scope(rows: List[Dict[str, Any]],
+                      iters: int = 1) -> Dict[str, float]:
+    """Aggregate ``xplane.op_rows()`` self time (microseconds) per scope,
+    in seconds per iteration. {} when the rows carry no recognizable
+    op-name or self-time column (static-only path takes over)."""
+    tkey = xplane.self_time_key(rows)
+    nkey = None
+    for row in rows:
+        nkey = _op_name_key(row)
+        if nkey is not None:
+            break
+    if tkey is None or nkey is None:
+        return {}
+    out: Dict[str, float] = {}
+    for r in rows:
+        try:
+            us = float(r.get(tkey) or 0.0)
+        except (TypeError, ValueError):
+            continue
+        scope = scope_of_path(str(r.get(nkey) or ""))
+        out[scope] = out.get(scope, 0.0) + us
+    return {k: v * 1e-6 / max(int(iters), 1) for k, v in out.items()}
+
+
+# -- the gap-attribution report --------------------------------------------
+
+def report(hw: "attribution.HardwareSpec",
+           costs: Mapping[str, Mapping[str, float]],
+           measured: Optional[Mapping[str, float]] = None,
+           flat: Optional[Mapping[str, float]] = None) -> Dict[str, Any]:
+    """Join per-scope floors with (optional) measured self time into the
+    gap-attribution table. ``measured`` maps scope -> seconds; None is
+    the static-only path — every ``measured_ms``/``gap_ms`` is null and
+    rows sort by floor instead of gap. ``flat`` (scope-blind totals)
+    drives the Σ-floors-vs-whole-step reconciliation."""
+    measured = dict(measured or {})
+    rows: List[Dict[str, Any]] = []
+    for scope in sorted(costs):
+        c = costs[scope]
+        row = attribution.attribute(
+            hw, measured_s=measured.get(scope),
+            flops=c.get("flops") or None,
+            hbm_bytes=c.get("hbm_bytes") or None,
+            wire_bytes=c.get("wire_bytes") or None)
+        row["scope"] = scope
+        row["gap_ms"] = (round(row["measured_ms"] - row["floor_ms"], 4)
+                         if row["measured_ms"] is not None else None)
+        rows.append(row)
+    have_measured = any(r["measured_ms"] is not None for r in rows)
+    if have_measured:
+        rows.sort(key=lambda r: (r["gap_ms"] is None,
+                                 -(r["gap_ms"] or 0.0), r["scope"]))
+    else:
+        rows.sort(key=lambda r: (-r["floor_ms"], r["scope"]))
+
+    floor_sum_ms = round(sum(r["floor_ms"] for r in rows), 4)
+    measured_sum_ms = (round(sum(r["measured_ms"] or 0.0 for r in rows), 4)
+                       if have_measured else None)
+    flat = dict(flat) if flat else {
+        k: sum(c.get(k, 0.0) for c in costs.values())
+        for k in ("flops", "hbm_bytes", "wire_bytes")}
+    whole = attribution.attribute(
+        hw, measured_s=None, flops=flat.get("flops") or None,
+        hbm_bytes=flat.get("hbm_bytes") or None,
+        wire_bytes=flat.get("wire_bytes") or None)
+    ratio = (round(floor_sum_ms / whole["floor_ms"], 4)
+             if whole["floor_ms"] else None)
+
+    # the unattributed bucket's share of step time: measured share when a
+    # profile exists, floor share on the static-only path
+    share_of = ("measured_ms" if have_measured else "floor_ms")
+    total_share = sum(r[share_of] or 0.0 for r in rows)
+    unattr = next((r for r in rows if r["scope"] == UNATTRIBUTED), None)
+    unattributed_fraction = (
+        round((unattr[share_of] or 0.0) / total_share, 4)
+        if unattr and total_share else 0.0)
+
+    return {
+        "schema": SCHEMA,
+        "hardware": hw.as_dict(),
+        "measured": have_measured,
+        "scopes": rows,
+        "whole_step": whole,
+        "totals": {
+            "floor_sum_ms": floor_sum_ms,
+            "measured_sum_ms": measured_sum_ms,
+            "whole_floor_ms": whole["floor_ms"],
+            "floor_sum_ratio": ratio,
+            "floor_sum_ok": (ratio is not None and
+                             abs(ratio - 1.0) <= FLOOR_SUM_TOLERANCE),
+            "unattributed_fraction": unattributed_fraction,
+            "unattributed_ok":
+                unattributed_fraction < UNATTRIBUTED_BUDGET,
+        },
+    }
+
+
+def top_gap_scope(rep: Mapping[str, Any]) -> Optional[str]:
+    """The scope owning the largest measured-minus-floor gap (falls back
+    to the largest floor on the static-only path)."""
+    rows = rep.get("scopes") or []
+    if not rows:
+        return None
+    if rep.get("measured"):
+        best = max(rows, key=lambda r: (r.get("gap_ms") or float("-inf")))
+    else:
+        best = max(rows, key=lambda r: r.get("floor_ms") or 0.0)
+    return best.get("scope")
+
+
+def render(rep: Mapping[str, Any]) -> str:
+    """Text table of a report (the CLI and bench --verbose share this)."""
+    hw = rep.get("hardware", {})
+    lines = [
+        "step anatomy (%s)%s" % (
+            hw.get("name", "?"),
+            "" if rep.get("measured") else
+            "  [static-only: no xprof, measured column absent]"),
+        "%-22s %-8s %10s %10s %10s" % (
+            "scope", "bound", "floor_ms", "meas_ms", "gap_ms"),
+    ]
+    for r in rep.get("scopes", []):
+        lines.append("%-22s %-8s %10.4f %10s %10s" % (
+            r["scope"], r.get("binding") or "-", r["floor_ms"],
+            "-" if r["measured_ms"] is None else "%.4f" % r["measured_ms"],
+            "-" if r["gap_ms"] is None else "%+.4f" % r["gap_ms"]))
+    t = rep.get("totals", {})
+    lines.append(
+        "Σ floors %.4f ms vs whole-step floor %.4f ms (ratio %s, %s); "
+        "unattributed %.2f%% (%s)" % (
+            t.get("floor_sum_ms", 0.0), t.get("whole_floor_ms", 0.0),
+            t.get("floor_sum_ratio"),
+            "ok" if t.get("floor_sum_ok") else "OUT OF TOLERANCE",
+            100.0 * (t.get("unattributed_fraction") or 0.0),
+            "ok" if t.get("unattributed_ok") else "over budget"))
+    return "\n".join(lines)
+
+
+def record_report(rep: Mapping[str, Any]) -> None:
+    """Flag-gated export into the metrics registry (``perf.anatomy.*``)
+    plus a flight-recorder snapshot. Lazy imports keep the module
+    importable standalone; a dead registry makes this a no-op."""
+    try:
+        from . import metrics
+    except Exception:
+        return
+    if not metrics.enabled():
+        return
+    metrics.counter("perf.anatomy.reports", 1)
+    for r in rep.get("scopes", []):
+        metrics.gauge("perf.anatomy.floor_ms", r["floor_ms"],
+                      scope=r["scope"])
+        if r["measured_ms"] is not None:
+            metrics.gauge("perf.anatomy.measured_ms", r["measured_ms"],
+                          scope=r["scope"])
+        if r["gap_ms"] is not None:
+            metrics.gauge("perf.anatomy.gap_ms", r["gap_ms"],
+                          scope=r["scope"])
+    t = rep.get("totals", {})
+    if t.get("floor_sum_ratio") is not None:
+        metrics.gauge("perf.anatomy.floor_sum_ratio",
+                      t["floor_sum_ratio"])
+    metrics.gauge("perf.anatomy.unattributed_fraction",
+                  t.get("unattributed_fraction") or 0.0)
+    try:
+        from .flight_recorder import record_event
+        record_event({"kind": "anatomy", "schema": rep.get("schema"),
+                      "totals": dict(t),
+                      "top_gap_scope": top_gap_scope(rep)})
+    except Exception:
+        pass
+
+
+# -- offline loaders (the no-jax CLI renders from these) -------------------
+
+def report_from_obj(obj: Any) -> Optional[Dict[str, Any]]:
+    """Recover a report from parsed JSON: a report itself, a bench row
+    carrying one under ``"anatomy"``, or a list of either."""
+    if isinstance(obj, Mapping):
+        if obj.get("schema") == SCHEMA:
+            return dict(obj)
+        inner = obj.get("anatomy")
+        if isinstance(inner, Mapping) and inner.get("schema") == SCHEMA:
+            return dict(inner)
+        return None
+    if isinstance(obj, list):
+        for item in reversed(obj):
+            rep = report_from_obj(item)
+            if rep is not None:
+                return rep
+    return None
+
+
+def report_from_jsonl(path: str) -> Optional[Dict[str, Any]]:
+    """Last recoverable report from a JSON/JSONL file (bench rows,
+    flight-recorder files, or a bare report dump)."""
+    found = None
+    with open(path) as f:
+        text = f.read()
+    try:
+        found = report_from_obj(json.loads(text))
+        if found is not None:
+            return found
+    except json.JSONDecodeError:
+        pass
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rep = report_from_obj(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+        if rep is not None:
+            found = rep
+    return found
+
+
+def report_from_metrics_dump(paths: Iterable[str]) -> Optional[Dict[str, Any]]:
+    """Rebuild a (floors/measured/gap only) report from ``perf.anatomy.*``
+    gauges in ``metrics.dump_jsonl`` files. Cost inputs are not exported,
+    so the rebuilt rows carry times only — enough for the table."""
+    floors: Dict[str, float] = {}
+    meas: Dict[str, float] = {}
+    gaps: Dict[str, float] = {}
+    totals: Dict[str, Any] = {}
+    seen = False
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                name = rec.get("name", "")
+                if not name.startswith("perf.anatomy."):
+                    continue
+                seen = True
+                scope = (rec.get("labels") or {}).get("scope")
+                val = rec.get("value")
+                if name.endswith(".floor_ms") and scope:
+                    floors[scope] = val
+                elif name.endswith(".measured_ms") and scope:
+                    meas[scope] = val
+                elif name.endswith(".gap_ms") and scope:
+                    gaps[scope] = val
+                elif name.endswith(".floor_sum_ratio"):
+                    totals["floor_sum_ratio"] = val
+                elif name.endswith(".unattributed_fraction"):
+                    totals["unattributed_fraction"] = val
+    if not seen:
+        return None
+    rows = []
+    for scope in sorted(floors):
+        rows.append({
+            "scope": scope, "binding": None, "floors_ms": {},
+            "floor_ms": floors[scope],
+            "measured_ms": meas.get(scope),
+            "gap_ms": gaps.get(scope),
+        })
+    have_measured = any(r["measured_ms"] is not None for r in rows)
+    if have_measured:
+        rows.sort(key=lambda r: (r["gap_ms"] is None,
+                                 -(r["gap_ms"] or 0.0), r["scope"]))
+    else:
+        rows.sort(key=lambda r: (-r["floor_ms"], r["scope"]))
+    totals.setdefault("floor_sum_ms",
+                      round(sum(r["floor_ms"] for r in rows), 4))
+    totals.setdefault("whole_floor_ms", 0.0)
+    totals.setdefault("floor_sum_ok", True)
+    totals.setdefault("unattributed_fraction", 0.0)
+    totals.setdefault(
+        "unattributed_ok",
+        totals["unattributed_fraction"] < UNATTRIBUTED_BUDGET)
+    return {"schema": SCHEMA, "hardware": {"name": "from-metrics"},
+            "measured": have_measured, "scopes": rows,
+            "whole_step": None, "totals": totals}

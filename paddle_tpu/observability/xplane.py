@@ -25,7 +25,17 @@ import json
 import tempfile
 from typing import Any, Dict, List, Optional, Sequence
 
-from . import metrics as _metrics
+try:
+    from . import metrics as _metrics
+except ImportError:  # synthetic-package hosts (tools/anatomy_report.py):
+    # metrics drags in core.flags, which is not stdlib-standalone — the
+    # counters here are advisory, so degrade to a no-op sink
+    class _NullMetrics:
+        @staticmethod
+        def counter(*args, **kwargs):
+            return None
+
+    _metrics = _NullMetrics()  # type: ignore[assignment]
 
 #: the xprof tool name whose converted output is the per-op stats table
 OP_STATS_TOOL = "framework_op_stats"
@@ -41,6 +51,17 @@ def have_xprof() -> bool:
         return False
 
 
+def _block_until_ready(result) -> None:
+    """Block on every array inside ``result``. ``jax.block_until_ready``
+    walks pytrees itself, but framework ``Tensor`` wrappers are opaque
+    leaves to it — unwrap ``._value`` per leaf so a tuple of Tensors
+    blocks on every member instead of silently skipping them all."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(result)
+    jax.block_until_ready([getattr(leaf, "_value", leaf) for leaf in leaves])
+
+
 def collect(step_fn, *args, iters: int = 3,
             trace_dir: Optional[str] = None) -> List[str]:
     """Run ``step_fn(*args)`` ``iters`` times under ``jax.profiler.trace``
@@ -48,13 +69,13 @@ def collect(step_fn, *args, iters: int = 3,
     the op table) and return the produced ``*.xplane.pb`` paths."""
     import jax
 
-    r = step_fn(*args)  # compile outside the trace
-    jax.block_until_ready(r if not hasattr(r, "_value") else r._value)
+    _block_until_ready(step_fn(*args))  # compile outside the trace
     d = trace_dir or tempfile.mkdtemp(prefix="xplane_")
     with jax.profiler.trace(d):
+        r = None
         for _ in range(iters):
             r = step_fn(*args)
-        jax.block_until_ready(r if not hasattr(r, "_value") else r._value)
+        _block_until_ready(r)
     paths = glob.glob(d + "/**/*.xplane.pb", recursive=True)
     _metrics.counter("perf.xplane.collections", 1)
     return paths
@@ -111,12 +132,22 @@ def _self_time_key(row: Dict[str, Any]) -> Optional[str]:
     return None
 
 
+def self_time_key(rows: List[Dict[str, Any]]) -> Optional[str]:
+    """The self-time column name, scanning every row until one carries it —
+    gviz rows with null leading cells must not blind the whole table."""
+    for row in rows:
+        key = _self_time_key(row)
+        if key is not None:
+            return key
+    return None
+
+
 def top_ops(rows: List[Dict[str, Any]], n: int = 10) -> List[Dict[str, Any]]:
     """The ``n`` largest rows by self time (row order preserved when no
     self-time column is recognizable)."""
     if not rows:
         return []
-    key = _self_time_key(rows[0])
+    key = self_time_key(rows)
     if key is None:
         return rows[:n]
     return sorted(rows, key=lambda r: float(r.get(key) or 0.0),
@@ -130,7 +161,7 @@ def device_time_seconds(rows: List[Dict[str, Any]],
     column — callers then fall back to goodput-bucket measured time."""
     if not rows:
         return None
-    key = _self_time_key(rows[0])
+    key = self_time_key(rows)
     if key is None:
         return None
     total_us = 0.0

@@ -431,6 +431,7 @@ class Engine:
         if self.config.kv_layout == "paged":
             ps, nb = self.cache.page_size, self.cache.num_blocks
 
+            @jax.named_scope("serving/prefill")
             def paged_prefill_fn(p, kc, vc, ids, page_row, length):
                 with no_grad():
                     (logits, kvs), _ = model.functional_call(
@@ -456,6 +457,7 @@ class Engine:
                     jnp.int32(1))
             return paged_prefill_fn, args
 
+        @jax.named_scope("serving/prefill")
         def prefill_fn(p, kc, vc, ids, slot, length):
             with no_grad():
                 (logits, kvs), _ = model.functional_call(
@@ -486,6 +488,7 @@ class Engine:
         if self.config.kv_layout == "paged":
             B, nb = self.config.max_batch_size, self.cache.num_blocks
 
+            @jax.named_scope("serving/decode")
             def paged_decode_fn(p, kc, vc, page_table, tokens, positions,
                                 temps, top_ks, greedy, key):
                 caches = [(kc[l], vc[l], page_table) for l in range(L)]
@@ -506,6 +509,7 @@ class Engine:
                     jnp.ones((B,), bool), _dummy_key())
             return paged_decode_fn, args
 
+        @jax.named_scope("serving/decode")
         def decode_fn(p, kc, vc, tokens, positions, temps, top_ks, greedy,
                       key):
             caches = [(kc[l], vc[l]) for l in range(L)]

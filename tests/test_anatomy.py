@@ -1,0 +1,402 @@
+"""Step-anatomy tier (ISSUE 16): per-scope time attribution.
+
+Covers the tentpole — scope naming convention, jaxpr cost walker,
+per-scope floors, gap table, static-only degradation — and the satellite
+fixes: xplane.collect() pytree readiness, self-time column scan past
+row 0, gviz parsing with null/ragged cells, scope-coverage lint against
+health.param_group(), and the no-jax CLI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu.observability import anatomy, attribution, xplane  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CPU_HW = attribution.HW_SPECS["cpu"]
+
+
+# ------------------------------------------------------- scope convention
+
+def test_scope_of_path_convention():
+    cases = {
+        # transform frames strip; block keeps its first recognized sub
+        "jit(step)/jvp(block_00)/attn": "block_00/attn",
+        "transpose(jvp(block_01))/mlp/fc2": "block_01/mlp",
+        "rematted_computation(block_03)/moe/experts": "block_03/moe",
+        "jvp(block_02)": "block_02",
+        # two-level roots keep the next component, dropping deeper names
+        "jit(step)/opt/update/optimizer_step": "opt/update",
+        "comm/grad_reduce/bucket_0": "comm/grad_reduce",
+        "serving/decode/block_00/attn": "serving/decode",
+        # single roots stand alone
+        "jvp(embed)": "embed",
+        "loss": "loss",
+        "final_ln": "final_ln",
+        # nothing recognized -> the budgeted catch-all
+        "jit(step)/convert_element_type": "unattributed",
+        "": "unattributed",
+    }
+    for raw, want in cases.items():
+        assert anatomy.scope_of_path(raw) == want, raw
+
+
+def test_clean_scope_path_strips_transform_frames():
+    assert anatomy.clean_scope_path(
+        "transpose(jvp(block_00))/mlp") == "block_00/mlp"
+    assert anatomy.clean_scope_path("jit(step)//x") == "step/x"
+    assert anatomy.clean_scope_path(None) == ""
+
+
+def test_scope_for_param_group():
+    assert anatomy.scope_for_param_group("gpt.layers.3") == "block_03"
+    assert anatomy.scope_for_param_group("gpt.layers.12") == "block_12"
+    assert anatomy.scope_for_param_group("gpt.embeddings") == "embed"
+    assert anatomy.scope_for_param_group("gpt.final_ln") == "final_ln"
+    assert anatomy.scope_for_param_group("totally.unknown") is None
+
+
+# ------------------------------------------------------- the cost walker
+
+def test_scope_costs_forward_and_grad():
+    def f(x, w):
+        with jax.named_scope("block_00"):
+            with jax.named_scope("mlp"):
+                h = x @ w
+        with jax.named_scope("loss"):
+            return jnp.sum(h * h)
+
+    closed = jax.make_jaxpr(jax.grad(f))(
+        jnp.ones((8, 16), jnp.float32), jnp.ones((16, 4), jnp.float32))
+    costs = anatomy.scope_costs(closed)
+    assert "block_00/mlp" in costs and "loss" in costs
+    # forward matmul plus its transpose(s): at least 2x the fwd flops,
+    # all attributed through the transform-wrapped name stacks
+    fwd = 2.0 * 8 * 16 * 4
+    assert costs["block_00/mlp"]["flops"] >= 2 * fwd
+    assert costs["block_00/mlp"]["hbm_bytes"] > 0
+    # the split must sum back to the scope-blind walk exactly
+    flat = anatomy.flat_costs(closed)
+    for key in ("flops", "hbm_bytes", "wire_bytes"):
+        total = sum(c[key] for c in costs.values())
+        assert total == pytest.approx(flat[key]), key
+
+
+def test_scope_costs_scan_multiplier():
+    def f(c, xs):
+        def body(carry, x):
+            with jax.named_scope("block_01"):
+                with jax.named_scope("mlp"):
+                    return carry + x @ x, ()
+        out, _ = jax.lax.scan(body, c, xs)
+        return out
+
+    closed = jax.make_jaxpr(f)(
+        jnp.zeros((4, 4), jnp.float32), jnp.ones((5, 4, 4), jnp.float32))
+    costs = anatomy.scope_costs(closed)
+    # 5 iterations x 2*4*4*4 matmul flops, scope threaded through the
+    # scan body's RELATIVE name stack
+    assert costs["block_01/mlp"]["flops"] == pytest.approx(5 * 2 * 4 ** 3)
+
+
+def test_scope_costs_explicit_collective_wire():
+    def f(x):
+        with jax.named_scope("comm/grad_reduce"):
+            return jax.lax.psum(x, "i")
+
+    closed = jax.make_jaxpr(jax.pmap(f, axis_name="i"))(
+        jnp.ones((1, 8), jnp.float32))
+    # axis size comes from the caller's mesh declaration, not the trace
+    costs = anatomy.scope_costs(closed, axis_sizes={"i": 4})
+    assert costs["comm/grad_reduce"]["wire_bytes"] > 0
+    # one device -> no wire
+    costs1 = anatomy.scope_costs(closed, axis_sizes={"i": 1})
+    assert costs1["comm/grad_reduce"]["wire_bytes"] == 0
+
+
+def test_wire_from_flow_merges_by_scope():
+    class Ev:
+        def __init__(self, kind, scope, nbytes):
+            self.kind, self.scope, self.nbytes = kind, scope, nbytes
+            self.path = ""
+
+    costs = {"block_00/attn": {"flops": 10.0, "hbm_bytes": 5.0,
+                               "wire_bytes": 0.0}}
+    merged = anatomy.wire_from_flow(
+        [Ev("all-reduce", "jvp(block_00)/attn", 100),
+         Ev("all-gather", "opt/update", 40),
+         Ev("reshard", "block_00/attn", 7)],  # reshard is not wire
+        costs)
+    assert merged["block_00/attn"]["wire_bytes"] == 100
+    assert merged["opt/update"]["wire_bytes"] == 40
+    # input table is not mutated
+    assert costs["block_00/attn"]["wire_bytes"] == 0.0
+
+
+def test_flow_events_carry_anatomy_scope():
+    from paddle_tpu import analysis
+
+    def f(x, w):
+        with jax.named_scope("block_00"):
+            with jax.named_scope("attn"):
+                return x @ w
+
+    closed = jax.make_jaxpr(f)(jnp.ones((8, 16), jnp.float32),
+                               jnp.ones((16, 4), jnp.float32))
+    # both sides sharded on the contraction dim -> predicted all-reduce,
+    # and the event names the anatomy scope it happens inside
+    res = analysis.propagate_jaxpr(
+        closed, [((), ("dp",)), (("dp",), ())], {"dp": 8})
+    ev = [e for e in res.events if e.kind == "all-reduce"]
+    assert ev, res.events
+    assert ev[0].scope == "block_00/attn"
+
+
+# ------------------------------------------------------------ the report
+
+def _toy_costs():
+    # uniformly hbm-bound on the cpu-nominal spec, so the per-scope
+    # floors sum exactly to the whole-step floor (the reconcile gate)
+    return {
+        "block_00/mlp": {"flops": 1e9, "hbm_bytes": 2e8, "wire_bytes": 0},
+        "opt/update": {"flops": 0, "hbm_bytes": 5e7, "wire_bytes": 0},
+        "unattributed": {"flops": 0, "hbm_bytes": 1e5, "wire_bytes": 0},
+    }
+
+
+def test_report_static_only_path():
+    rep = anatomy.report(CPU_HW, _toy_costs())
+    assert rep["schema"] == anatomy.SCHEMA
+    assert rep["measured"] is False
+    assert all(r["measured_ms"] is None for r in rep["scopes"])
+    assert all(r["gap_ms"] is None for r in rep["scopes"])
+    # static path sorts by floor, descending
+    floors = [r["floor_ms"] for r in rep["scopes"]]
+    assert floors == sorted(floors, reverse=True)
+    t = rep["totals"]
+    assert t["floor_sum_ok"] is True
+    assert t["measured_sum_ms"] is None
+    assert t["unattributed_ok"] is True
+    assert anatomy.top_gap_scope(rep) == rep["scopes"][0]["scope"]
+    text = anatomy.render(rep)
+    assert "static-only" in text and "block_00/mlp" in text
+
+
+def test_report_measured_gap_table():
+    costs = _toy_costs()
+    # block_00/mlp floor = 2e8/5e10 = 4ms; measure it at 9ms -> 5ms gap;
+    # opt/update floor = 5e7/5e10 = 1ms; measured at 1.5ms -> 0.5ms gap
+    measured = {"block_00/mlp": 9e-3, "opt/update": 1.5e-3}
+    rep = anatomy.report(CPU_HW, costs, measured=measured)
+    assert rep["measured"] is True
+    assert rep["scopes"][0]["scope"] == "block_00/mlp"
+    assert rep["scopes"][0]["gap_ms"] == pytest.approx(5.0, abs=0.01)
+    assert anatomy.top_gap_scope(rep) == "block_00/mlp"
+    # the unmeasured scope keeps a null measured column even here
+    unattr = [r for r in rep["scopes"] if r["scope"] == "unattributed"][0]
+    assert unattr["measured_ms"] is None
+
+
+def test_report_reconciliation_catches_dropped_scopes():
+    costs = _toy_costs()
+    flat = {"flops": 4e9, "hbm_bytes": 4e8, "wire_bytes": 0}  # 2.6x hbm
+    rep = anatomy.report(CPU_HW, costs, flat=flat)
+    assert rep["totals"]["floor_sum_ok"] is False
+
+
+def test_record_report_gated_and_standalone_safe():
+    from paddle_tpu import observability
+    from paddle_tpu.observability import metrics
+
+    rep = anatomy.report(CPU_HW, _toy_costs())
+    if not observability.enabled():
+        anatomy.record_report(rep)  # disabled -> no-op, must not raise
+        assert not any(k.startswith("perf.anatomy.")
+                       for k in metrics.snapshot()["gauges"])
+    was_enabled = observability.enabled()
+    observability.enable()
+    try:
+        anatomy.record_report(rep)
+        snap = metrics.snapshot()
+        assert "perf.anatomy.floor_ms{scope=block_00/mlp}" in snap["gauges"]
+        assert "perf.anatomy.unattributed_fraction" in snap["gauges"]
+        assert snap["counters"].get("perf.anatomy.reports", 0) >= 1
+    finally:
+        if not was_enabled:
+            observability.disable()
+
+
+# ------------------------------------------- measured self time per scope
+
+def test_measured_by_scope_scans_past_bad_first_row():
+    rows = [
+        # first row carries NO self-time column: the key sniff must scan on
+        {"op_name": "warmup"},
+        {"op_name": "jit_step/jvp(block_00)/attn/fusion.1",
+         "total_self_time_us": 10.0},
+        {"op_name": "transpose(jvp(block_00))/mlp/dot.2",
+         "total_self_time_us": 30.0},
+        {"op_name": "copy.3", "total_self_time_us": 2.0},
+    ]
+    out = anatomy.measured_by_scope(rows, iters=2)
+    assert out["block_00/attn"] == pytest.approx(5e-6)
+    assert out["block_00/mlp"] == pytest.approx(15e-6)
+    assert out["unattributed"] == pytest.approx(1e-6)
+    # no recognizable columns -> {} (static-only path takes over)
+    assert anatomy.measured_by_scope([{"x": 1}]) == {}
+
+
+def test_self_time_key_scans_rows():
+    # satellite: device_time_seconds/top_ops used to sniff only rows[0]
+    rows = [{"Op": "headerless"},
+            {"Op": "real", "self_time_us": 5.0},
+            {"Op": "other", "self_time_us": 3.0}]
+    assert xplane.self_time_key(rows) == "self_time_us"
+    assert xplane.device_time_seconds(rows) == pytest.approx(8e-6)
+    assert xplane.top_ops(rows, n=1)[0]["Op"] == "real"
+    assert xplane.self_time_key([{"Op": "x"}]) is None
+
+
+def test_op_rows_gviz_null_and_ragged_cells():
+    gviz = {
+        "cols": [{"label": "op_name"}, {"label": "self_time_us"},
+                 {"id": "c2"}],
+        "rows": [
+            {"c": [None, {"v": 3.0}]},                   # null cell, short
+            {"c": [{"v": "a"}, None, {"v": 1}, {"v": "extra"}]},  # ragged
+            {},                                          # no cells at all
+        ],
+    }
+    rows = xplane.op_rows(json.dumps(gviz))
+    assert rows[0] == {"op_name": None, "self_time_us": 3.0}
+    assert rows[1]["op_name"] == "a" and rows[1]["self_time_us"] is None
+    assert "extra" not in rows[1].values()
+    assert rows[2] == {}
+    # and the self-time reduction still works over the mess
+    assert xplane.device_time_seconds(rows) == pytest.approx(3e-6)
+
+
+def test_collect_blocks_on_tuple_outputs(tmp_path):
+    # satellite: the old hasattr(r, "_value") probe silently skipped
+    # blocking for tuple outputs; collect must handle any pytree of
+    # Tensor wrappers and raw arrays
+    from paddle_tpu.core.tensor import Tensor
+
+    def step():
+        a = jnp.ones((4,), jnp.float32)
+        return (Tensor(a), Tensor(a + 1)), 3
+
+    paths = xplane.collect(step, iters=1, trace_dir=str(tmp_path))
+    assert isinstance(paths, list)
+    for p in paths:
+        assert p.endswith(".xplane.pb")
+
+
+# ------------------------------------------------- scope-coverage lint
+
+def test_scope_coverage_every_param_group_maps_to_a_scope():
+    """Satellite: new layers cannot silently fall into `unattributed` —
+    every health.param_group() of the tiny GPT (dense and MoE) must map
+    to an anatomy scope, and for the dense model those scopes must be
+    present in the annotated step jaxpr's own table."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_moe_tiny, gpt_tiny
+    from paddle_tpu.observability import health
+
+    paddle.seed(0)
+    model = gpt_tiny(dropout=0.0)
+    for m in (model, gpt_moe_tiny(dropout=0.0)):
+        groups = sorted({health.param_group(n)
+                         for n, _ in m.named_parameters()})
+        for g in groups:
+            assert anatomy.scope_for_param_group(g) is not None, (
+                f"param group {g!r} has no anatomy scope — annotate the "
+                "layer or extend scope_for_param_group")
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = make_sharded_train_step(model, opt)
+    x = np.zeros((2, 16), np.int32)
+    costs = anatomy.scope_costs(step.step_jaxpr(x, x))
+    annotated = set(costs)
+    for n, _ in model.named_parameters():
+        scope = anatomy.scope_for_param_group(health.param_group(n))
+        assert any(s == scope or s.startswith(scope + "/")
+                   for s in annotated), (scope, sorted(annotated))
+    # and the unattributed bucket stays within its budgeted share
+    rep = anatomy.report(CPU_HW, costs)
+    assert rep["totals"]["unattributed_ok"], rep["totals"]
+
+
+# ------------------------------------------------------------- the CLI
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "anatomy_report.py"),
+         *args],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+
+
+def test_anatomy_report_cli_renders_saved_report(tmp_path):
+    rep = anatomy.report(CPU_HW, _toy_costs())
+    path = tmp_path / "report.json"
+    path.write_text(json.dumps(rep))
+    r = _run_cli(str(path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "block_00/mlp" in r.stdout and "static-only" in r.stdout
+    # --json round-trips the report
+    r = _run_cli(str(path), "--json")
+    assert r.returncode == 0
+    assert json.loads(r.stdout)["schema"] == anatomy.SCHEMA
+
+
+def test_anatomy_report_cli_reads_bench_rows_and_gates(tmp_path):
+    rep = anatomy.report(CPU_HW, _toy_costs())
+    rows = tmp_path / "rows.jsonl"
+    rows.write_text(json.dumps({"config": "other"}) + "\n" +
+                    json.dumps({"config": "anatomy", "anatomy": rep}) + "\n")
+    assert _run_cli(str(rows)).returncode == 0
+    # a report failing its own reconciliation exits 1
+    bad = dict(rep)
+    bad["totals"] = {**rep["totals"], "floor_sum_ok": False}
+    bad_path = tmp_path / "bad.json"
+    bad_path.write_text(json.dumps(bad))
+    assert _run_cli(str(bad_path)).returncode == 1
+    # nothing recoverable exits 2
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps({"config": "other"}) + "\n")
+    assert _run_cli(str(empty)).returncode == 2
+
+
+def test_anatomy_report_cli_from_metrics_dump(tmp_path):
+    recs = [
+        {"type": "gauge", "name": "perf.anatomy.floor_ms",
+         "labels": {"scope": "block_00/mlp"}, "value": 4.0},
+        {"type": "gauge", "name": "perf.anatomy.measured_ms",
+         "labels": {"scope": "block_00/mlp"}, "value": 9.0},
+        {"type": "gauge", "name": "perf.anatomy.gap_ms",
+         "labels": {"scope": "block_00/mlp"}, "value": 5.0},
+        {"type": "gauge", "name": "perf.anatomy.floor_ms",
+         "labels": {"scope": "opt/update"}, "value": 1.0},
+        {"type": "gauge", "name": "perf.anatomy.unattributed_fraction",
+         "labels": {}, "value": 0.01},
+    ]
+    dump = tmp_path / "metrics.jsonl"
+    dump.write_text("\n".join(json.dumps(r) for r in recs))
+    r = _run_cli("--metrics", str(dump))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "block_00/mlp" in r.stdout
+    # gap-sorted: the measured scope with the 5ms gap leads the table
+    body = [ln for ln in r.stdout.splitlines() if "block_00/mlp" in ln]
+    assert body and r.stdout.index("block_00/mlp") < r.stdout.index(
+        "opt/update")

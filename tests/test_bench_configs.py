@@ -47,7 +47,8 @@ def test_all_configs_registered():
     assert set(bench.CONFIGS) == {"bert_sst2", "gpt_dp", "ernie_mp4",
                                   "resnet50", "gpt_moe", "serving", "ckpt",
                                   "data", "comm", "reshard", "obs",
-                                  "analysis", "elastic", "health"}
+                                  "analysis", "elastic", "health",
+                                  "anatomy"}
 
 
 def test_bench_ckpt_row_contract(capsys):
@@ -328,6 +329,51 @@ def test_bench_health_row_contract(capsys):
         "jit.compile.cache_miss{site=sharded_train_step}"] == 1
     assert any(k.startswith("health.anomaly{") for k in tele["counters"])
     assert "health.grad_norm{group=_global}" in tele["gauges"]
+    # the row must not leave the global observability flag flipped on
+    assert not observability.enabled()
+
+
+def test_bench_anatomy_row_contract(capsys):
+    """The anatomy row's acceptance invariants (ISSUE 16): the per-scope
+    roofline floors from the annotated step jaxpr sum to the whole-step
+    floor within tolerance; the unattributed bucket stays under budget;
+    the injected slowdown (one block's MLP run 8x) is named as the top
+    gap contributor by scope; and with xprof absent (this host) the row
+    still lands, static-only, with the measured column null."""
+    import bench
+    from paddle_tpu import observability
+
+    row = bench.bench_anatomy()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(out)
+    assert parsed == row
+    assert parsed["config"] == "anatomy"
+    assert parsed["metric"] == "floor_sum_ratio"
+    # Σ per-scope floors reconciles against the whole-step floor
+    assert 0.9 <= parsed["value"] <= 1.1
+    assert parsed["floor_sum_ok"] is True
+    assert parsed["unattributed_ok"] is True
+    assert parsed["unattributed_fraction"] < 0.05
+    # the scope table covers the full training-step anatomy
+    scopes = {r["scope"] for r in parsed["anatomy"]["scopes"]}
+    assert {"embed", "loss", "opt/update"} <= scopes
+    assert any(s.startswith("block_00/") for s in scopes)
+    # injected-slowdown acceptance: the 8x MLP in block 1 is named #1
+    assert parsed["injected_top_scope"] == "block_01/mlp"
+    assert parsed["injected_ok"] is True
+    # static-only degradation on hosts without the xprof converter
+    from paddle_tpu.observability import xplane
+    if not xplane.have_xprof():
+        assert parsed["measured_available"] is False
+        assert all(r["measured_ms"] is None
+                   for r in parsed["anatomy"]["scopes"])
+    # the walker's flop count agrees with XLA's own cost analysis
+    if parsed["xla_flops"]:
+        assert parsed["walker_flops"] == pytest.approx(
+            parsed["xla_flops"], rel=0.25)
+    # flag-gated telemetry rode along
+    assert any(k.startswith("perf.anatomy.floor_ms")
+               for k in parsed["telemetry"]["gauges"])
     # the row must not leave the global observability flag flipped on
     assert not observability.enabled()
 

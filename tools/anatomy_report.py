@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Step-anatomy report: render the per-scope gap-attribution table.
+
+The operator-facing face of ``observability/anatomy.py`` — the table
+that names which scope (block_NN/attn, block_NN/mlp, opt/update,
+comm/grad_reduce, ...) owns the measured-vs-floor gap. Reads COMMITTED
+artifacts, no jax required (the synthetic-package import shared with
+``perf_report.py``; ``anatomy.py``/``attribution.py``/``xplane.py`` are
+stdlib-only at import by contract):
+
+- a saved anatomy report (``paddle_tpu.anatomy.v1`` JSON), or bench rows
+  (JSONL) whose ``anatomy`` field carries one — the last row wins;
+- ``--metrics``: ``metrics.dump_jsonl`` files, rebuilding the table from
+  the ``perf.anatomy.*`` gauges (times only — cost inputs are not
+  exported);
+- ``--trace``: a ``jax.profiler.trace`` directory of ``*.xplane.pb``
+  files, reduced to measured self time per scope. Needs the optional
+  ``xprof`` converter (still no jax); absent -> exit 2 with a message,
+  the same degradation contract as ``xplane.have_xprof()``.
+
+Exit codes (the lint_programs convention):
+  0  clean (report renders and reconciles)
+  1  the report fails its own acceptance (floor-sum out of tolerance or
+     unattributed bucket over budget)
+  2  internal failure (no report recoverable, xprof missing for --trace)
+
+Usage:
+  python tools/anatomy_report.py rows.jsonl
+  python tools/anatomy_report.py report.json --json
+  python tools/anatomy_report.py --metrics run/metrics-host*.jsonl
+  python tools/anatomy_report.py --trace /tmp/xplane_dir --iters 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import importlib
+import json
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_OBS_DIR = os.path.join(_REPO, "paddle_tpu", "observability")
+_pkg = types.ModuleType("_ptobs")
+_pkg.__path__ = [_OBS_DIR]
+sys.modules.setdefault("_ptobs", _pkg)
+anatomy = importlib.import_module("_ptobs.anatomy")
+xplane = importlib.import_module("_ptobs.xplane")
+
+
+def _render_measured_only(measured, iters):
+    lines = ["step anatomy (measured self time only — no floor inputs "
+             "in a raw trace)",
+             "%-22s %12s" % ("scope", "self_ms/iter")]
+    for scope, sec in sorted(measured.items(), key=lambda kv: -kv[1]):
+        lines.append("%-22s %12.4f" % (scope, sec * 1e3))
+    lines.append("total %12.4f ms over %d scope(s), %d iter(s)" % (
+        sum(measured.values()) * 1e3, len(measured), iters))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="report JSON / bench rows JSONL / metric dumps")
+    ap.add_argument("--metrics", action="store_true",
+                    help="treat paths as metrics.dump_jsonl files")
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="profiler trace dir of *.xplane.pb (needs xprof)")
+    ap.add_argument("--iters", type=int, default=1,
+                    help="trace iterations to divide self time by")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ns = ap.parse_args(argv)
+
+    if ns.trace:
+        paths = glob.glob(os.path.join(ns.trace, "**", "*.xplane.pb"),
+                          recursive=True)
+        if not paths:
+            print(f"anatomy_report: no *.xplane.pb under {ns.trace}",
+                  file=sys.stderr)
+            return 2
+        table = xplane.op_table(paths)
+        if table is None:
+            print("anatomy_report: xprof converter not installed — "
+                  "cannot read traces (static-only hosts render floors "
+                  "from a saved report instead)", file=sys.stderr)
+            return 2
+        measured = anatomy.measured_by_scope(xplane.op_rows(table),
+                                             iters=ns.iters)
+        if ns.as_json:
+            print(json.dumps({"measured_s": measured}, indent=2))
+        else:
+            print(_render_measured_only(measured, ns.iters))
+        return 0
+
+    if not ns.paths:
+        ap.error("a report/rows file (or --trace DIR) is required")
+    try:
+        if ns.metrics:
+            rep = anatomy.report_from_metrics_dump(ns.paths)
+        else:
+            rep = None
+            for p in ns.paths:
+                rep = anatomy.report_from_jsonl(p) or rep
+    except OSError as e:
+        print(f"anatomy_report: internal failure: {e}", file=sys.stderr)
+        return 2
+    if rep is None:
+        print("anatomy_report: no anatomy report recoverable from "
+              f"{ns.paths} (bench.py --config anatomy writes one per "
+              "row; metrics dumps need perf.anatomy.* gauges)",
+              file=sys.stderr)
+        return 2
+    if ns.as_json:
+        print(json.dumps(rep, indent=2))
+    else:
+        print(anatomy.render(rep))
+    t = rep.get("totals", {})
+    ok = bool(t.get("floor_sum_ok", True)) and \
+        bool(t.get("unattributed_ok", True))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
