@@ -1679,6 +1679,164 @@ def bench_anatomy():
     return out
 
 
+def bench_autoshard():
+    """Autoshard config: baseline-vs-searched A/B for the GPT train step
+    (paddle_tpu/autoshard). The layout search runs against the seed
+    step's jaxpr (no compiles), then BOTH the hand-written seed layout
+    and the searched winner execute end-to-end. The row's contract:
+    - the searched winner's predicted floor <= the seed's predicted
+      floor (ranking construction: the seed is always in the table, so
+      the searched layout is never predicted-worse);
+    - floors are floors: each layout's predicted floor (cpu-nominal /
+      tpu hw profile) <= its measured step time;
+    - guarded adoption: the winner replaces the seed only when its
+      MEASURED step time is also no worse than the seed's (x 1 + the
+      perf_report default tolerance) — an auto-tuned layout never ships
+      on prediction alone, so the adopted layout is never worse than
+      the hand-written seed by measurement either."""
+    import jax
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability
+    from paddle_tpu.autoshard import search as _autoshard_search
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import attribution as _attr
+
+    on_tpu = _on_tpu()
+    paddle.seed(0)
+    devs = np.asarray(jax.devices())
+    # greedy split into dp x sharding x mp (8 -> 2x2x2) so the search has
+    # a hybrid seed to beat and the dp x mp space to roam
+    dp, sh, mp = devs.size, 1, 1
+    if dp % 2 == 0:
+        dp //= 2
+        mp *= 2
+    if dp % 2 == 0:
+        dp //= 2
+        sh *= 2
+    mesh = Mesh(devs.reshape(dp, sh, mp), ("dp", "sharding", "mp"))
+    world = devs.size
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=8,
+                        num_heads=16, max_seq_len=512, dropout=0.0)
+        bsz, seq, iters = 8 * world, 512, 6
+    else:
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64, dropout=0.0)
+        bsz, seq, iters = 2 * world, 32, 4
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, size=(bsz, seq), dtype=np.int32)
+    y = np.roll(x, -1, axis=1)
+    hw = _attr.hardware_for_backend(
+        "cpu" if _cpu_fallback() else _backend())
+    tol = 0.10  # perf_report default tolerance
+
+    def build(mesh_, param_specs=None):
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        return make_sharded_train_step(model, opt, mesh=mesh_,
+                                       param_specs=param_specs)
+
+    def measure(step):
+        loss = float(step(x, y))  # compile + warm
+        t0 = time.perf_counter()
+        for _i in range(iters):
+            loss = float(step(x, y))
+        return (time.perf_counter() - t0) / iters * 1e3, loss
+
+    was_enabled = observability.enabled()
+    observability.enable()
+    observability.reset()
+    try:
+        seed_step = build(mesh)
+        result = _autoshard_search.search_train_step(
+            probe=seed_step, batch_shape=(bsz, seq), hw=hw)
+        win, seed_rc = result.winner, result.seed
+
+        seed_ms, seed_loss = measure(seed_step)
+        if win.is_seed:
+            searched_ms, searched_loss = seed_ms, seed_loss
+        else:
+            searched_step = build(
+                _autoshard_search.winner_mesh(win.candidate),
+                _autoshard_search.winner_param_specs(win.candidate))
+            searched_ms, searched_loss = measure(searched_step)
+
+        # guarded adoption: predicted-better is necessary, measured
+        # no-worse is sufficient — the incumbent seed stays otherwise
+        # (host-emulated collectives especially don't follow the ici
+        # model, so CPU A/B must not ship a predicted-only win)
+        adopt = searched_ms <= seed_ms * (1 + tol)
+        adopted_ms = searched_ms if adopt else seed_ms
+        ab = {
+            "seed": {
+                "layout": seed_rc.candidate.name,
+                "predicted_floor_ms": round(seed_rc.cost.floor_ms, 6),
+                "binding": seed_rc.cost.binding,
+                "wire_bytes_per_device":
+                    round(seed_rc.cost.wire_bytes_per_device, 1),
+                "measured_step_ms": round(seed_ms, 3),
+            },
+            "searched": {
+                "layout": win.candidate.name,
+                "predicted_floor_ms": round(win.cost.floor_ms, 6),
+                "binding": win.cost.binding,
+                "wire_bytes_per_device":
+                    round(win.cost.wire_bytes_per_device, 1),
+                "measured_step_ms": round(searched_ms, 3),
+            },
+        }
+        out = {
+            "config": "autoshard",
+            "metric": "ab_step_ratio",
+            "value": round(adopted_ms / max(seed_ms, 1e-9), 4),
+            "unit": "adopted step_ms / seed step_ms (<= 1 + tolerance "
+                    "by guarded adoption)",
+            "step_ms": round(adopted_ms, 3),
+            "hardware": hw.name,
+            "mesh": f"dp={dp} x sharding={sh} x mp={mp}",
+            "candidates": len(result.ranked),
+            "rejected": len(result.rejected),
+            "search_seconds": round(result.search_seconds, 3),
+            "ab": ab,
+            "adopted": ("searched" if adopt and not win.is_seed
+                        else "seed"),
+            "predicted_not_worse":
+                win.cost.floor_ms <= seed_rc.cost.floor_ms + 1e-9,
+            "floor_is_floor_seed":
+                seed_rc.cost.floor_ms <= seed_ms * (1 + tol),
+            "floor_is_floor_searched":
+                win.cost.floor_ms <= searched_ms * (1 + tol),
+            "measured_not_worse": adopted_ms <= seed_ms * (1 + tol),
+            "loss": round(searched_loss, 5),
+            "loss_seed": round(seed_loss, 5),
+            "loss_agrees": abs(searched_loss - seed_loss)
+                <= 1e-2 * max(1.0, abs(seed_loss)),
+            "note": f"GPT {_n_params(GPTForCausalLM(cfg))/1e6:.1f}M params "
+                    f"B={bsz} S={seq}; search scores "
+                    f"{len(result.ranked)} layouts with no compile; "
+                    f"winner {win.candidate.name}"
+                    + (" == seed" if win.is_seed else
+                       (f" adopted over seed {seed_rc.candidate.name}"
+                        if adopt else
+                        f" NOT adopted (measured worse than seed "
+                        f"{seed_rc.candidate.name} under emulation)")),
+            "telemetry": observability.snapshot(),
+        }
+        if _cpu_fallback():
+            out["backend"] = "cpu_fallback"
+    finally:
+        if not was_enabled:
+            observability.disable()
+    print(json.dumps(out))
+    return out
+
+
 CONFIGS = {
     "bert_sst2": bench_bert_sst2,
     "gpt_dp": bench_gpt_dp,
@@ -1695,6 +1853,7 @@ CONFIGS = {
     "elastic": bench_elastic,
     "health": bench_health,
     "anatomy": bench_anatomy,
+    "autoshard": bench_autoshard,
 }
 
 

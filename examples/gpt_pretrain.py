@@ -23,6 +23,28 @@ import time
 import numpy as np
 
 
+def _log_autoshard(step, top=5):
+    """Print the search's ranked table (attached by make_sharded_train_step
+    when --autoshard ran)."""
+    res = getattr(step, "autoshard_result", None)
+    if res is None:
+        return
+    print(f"autoshard: {len(res.ranked)} layout(s) scored in "
+          f"{res.search_seconds:.2f}s on {res.device_count} device(s)",
+          flush=True)
+    for rc in res.ranked[:top]:
+        r = rc.row()
+        print(f"  #{r['rank']} {r['layout']}"
+              + (" (seed)" if r["seed"] else "")
+              + f": floor {r['floor_ms']:.4f}ms ({r['binding']}-bound), "
+                f"wire {r['wire_bytes_per_device']:.0f} B/dev, "
+                f"hbm fit {r['hbm_fit_bytes']} B", flush=True)
+    w = res.winner
+    print(f"autoshard: training under "
+          + ("the seed layout" if w.is_seed else f"{w.candidate.name}"),
+          flush=True)
+
+
 def _run_elastic(args, cfg):
     """The same pretrain loop under the elastic supervisor. The step is a
     closure over the MESH (rebuilt per re-formation); the batch is a pure
@@ -46,10 +68,18 @@ def _run_elastic(args, cfg):
             learning_rate=args.lr, parameters=model.parameters(),
             multi_precision=on_tpu,
             moment_dtype="bfloat16" if on_tpu else None)
-        return make_sharded_train_step(
+        # --autoshard composes with --elastic: every mesh re-formation
+        # rebuilds the step, so the layout is re-searched for the shrunk
+        # mesh (fixed_mesh: the supervisor owns the factorization, the
+        # search owns the param table)
+        step = make_sharded_train_step(
             model, opt, mesh=mesh, grad_reduce=args.grad_reduce,
             accumulate_steps=args.accum or None,
-            health_stats=args.health or None)
+            health_stats=args.health or None,
+            autoshard=args.autoshard, autoshard_fixed_mesh=True)
+        if args.autoshard:
+            _log_autoshard(step)
+        return step
 
     # logical hosts: contiguous blocks of the visible devices (on a real
     # fleet: one block per process); losing a block shrinks dp
@@ -181,6 +211,11 @@ def main():
                          "optimizer, AND data position)")
     ap.add_argument("--save-steps", type=int, default=0,
                     help="save to --ckpt-dir every N steps")
+    ap.add_argument("--autoshard", action="store_true",
+                    help="search the sharding layout at startup "
+                         "(paddle_tpu.autoshard): log the ranked table and "
+                         "train under the winning layout; with --elastic "
+                         "the search re-runs on every mesh re-formation")
     ap.add_argument("--elastic", action="store_true",
                     help="run under the preemption-tolerant supervisor "
                          "(distributed.elastic): host loss shrinks dp and "
@@ -242,7 +277,10 @@ def main():
     step = make_sharded_train_step(
         model, opt, grad_reduce=args.grad_reduce,
         accumulate_steps=args.accum or None,
-        health_stats=args.health or None)
+        health_stats=args.health or None,
+        autoshard=args.autoshard)
+    if args.autoshard:
+        _log_autoshard(step)
 
     pipe = data_it = None
     if args.data:

@@ -246,6 +246,16 @@ def _cost_analysis(compiled) -> Dict[str, float]:
 #: and moves small bookkeeping collectives (loop counters, rng keys)
 _UNEXPLAINED_MIN_BYTES = 256 * 1024
 
+#: the SPMD partitioner may lower a predicted collective as a ring of a
+#: different family (windowed einsum turns a matmul all-reduce into a
+#: collective-permute chain; an all-reduce splits into reduce-scatter +
+#: all-gather). An emitted family with no direct prediction is still
+#: explained when any of its possible source families was predicted.
+_DECOMPOSED_FAMILIES = {
+    "collective-permute": ("all-reduce", "all-gather", "reduce-scatter"),
+    "reduce-scatter": ("all-reduce",),
+}
+
 
 def audit_spec(spec: ProgramSpec) -> SiteAudit:
     """Lower-and-compile one corpus entry with its contract's shardings,
@@ -303,7 +313,9 @@ def audit_spec(spec: ProgramSpec) -> SiteAudit:
         by_family[c.op] = by_family.get(c.op, 0) + c.wire_bytes
     audit.unexplained = sorted(
         fam for fam, b in by_family.items()
-        if b >= _UNEXPLAINED_MIN_BYTES and predicted.get(fam, 0) == 0)
+        if b >= _UNEXPLAINED_MIN_BYTES and predicted.get(fam, 0) == 0
+        and not any(predicted.get(src, 0)
+                    for src in _DECOMPOSED_FAMILIES.get(fam, ())))
 
     if _metrics.enabled():
         _metrics.histogram("analysis.hlo.audit_seconds",

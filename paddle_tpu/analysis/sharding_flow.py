@@ -127,6 +127,7 @@ class FlowEvent:
     in_loop: bool             # inside a scan/while body
     detail: str = ""
     scope: str = ""           # canonical anatomy scope (observability/anatomy)
+    axes: Tuple[str, ...] = ()  # mesh axes forming the collective group
 
     def render(self) -> str:
         loop = " [in loop]" if self.in_loop else ""
@@ -138,6 +139,11 @@ class FlowEvent:
 class FlowResult:
     events: List[FlowEvent] = field(default_factory=list)
     out_specs: List[ShardSpec] = field(default_factory=list)
+    #: eqn paths where the flow gave up (wrote an unknown output even
+    #: though at least one operand spec was known) — each entry is a
+    #: missing propagation rule, and a hole the autoshard cost model
+    #: cannot see through
+    unknown: List[str] = field(default_factory=list)
 
     def predicted_kinds(self) -> Dict[str, int]:
         """kind -> total global bytes, for hlo_audit reconciliation."""
@@ -280,12 +286,25 @@ _CALL_PRIMS = ("pjit", "closed_call", "core_call", "remat2", "checkpoint",
                "custom_jvp_call_jaxpr")
 
 
+def _broadcasts_to(ishape: Tuple[int, ...], oshape: Tuple[int, ...]) -> bool:
+    """numpy broadcast compatibility with right alignment (the implicit
+    broadcasting jax elementwise primitives allow)."""
+    if len(ishape) > len(oshape):
+        return False
+    pad = len(oshape) - len(ishape)
+    return all(i == o or i == 1
+               for i, o in zip(ishape, oshape[pad:]))
+
+
 class _Flow:
     """One propagation pass over one (possibly nested) jaxpr."""
 
     def __init__(self, axis_sizes: Mapping[str, int]):
         self.axis_sizes = dict(axis_sizes)
         self.events: List[FlowEvent] = []
+        # paths where known operand specs degraded to an unknown output:
+        # every entry names a primitive with no propagation rule
+        self.unknown: List[str] = []
         # enclosing equations' cleaned name-stack segments: nested jaxprs
         # carry RELATIVE name stacks, so the anatomy scope of an event
         # inside a scan/remat body needs the outer eqn's scope prepended
@@ -302,7 +321,7 @@ class _Flow:
             parts.append(stack)
         return scope_of_path("/".join(parts))
 
-    def _event(self, kind, eqn, path, in_loop, aval, detail):
+    def _event(self, kind, eqn, path, in_loop, aval, detail, axes=()):
         try:
             scope = self._eqn_scope(eqn)
         except Exception:
@@ -311,7 +330,8 @@ class _Flow:
             kind=kind, prim=eqn.primitive.name, path=path,
             nbytes=_aval_bytes(aval), dtype=_aval_dtype(aval),
             shape=tuple(int(d) for d in getattr(aval, "shape", ())),
-            in_loop=in_loop, detail=detail, scope=scope))
+            in_loop=in_loop, detail=detail, scope=scope,
+            axes=tuple(sorted(set(axes)))))
 
     def _run_nested(self, eqn, inner, in_specs, path, in_loop):
         """run() a sub-jaxpr with the enclosing eqn's scope pushed, so
@@ -374,7 +394,9 @@ class _Flow:
 
     def _eqn(self, env, eqn, path: str, in_loop: bool):
         prim = eqn.primitive.name
-        handler = getattr(self, "_h_" + prim, None)
+        # hyphenated primitive names (scatter-add, ...) map to underscore
+        # handler names — getattr on the raw name can never hit them
+        handler = getattr(self, "_h_" + prim.replace("-", "_"), None)
         if prim in _REDUCE_PRIMS:
             handler = self._h_reduce
         elif prim in _CALL_PRIMS:
@@ -389,8 +411,12 @@ class _Flow:
 
     # -- handlers -----------------------------------------------------------
     def _h_default(self, env, eqn, path, in_loop):
-        """Elementwise fallback: every operand shaped like the output feeds
-        its spec into a dimwise merge; anything else -> unknown."""
+        """Elementwise fallback: every operand whose shape broadcasts to
+        the output (equal, or numpy-style size-1 / missing leading dims —
+        the rank-preserving broadcast jax elementwise ops carry without
+        an explicit broadcast_in_dim) feeds its spec into a dimwise
+        merge, contributing no constraint on broadcast dims; anything
+        else -> unknown."""
         for out in eqn.outvars:
             oshape = tuple(getattr(getattr(out, "aval", None), "shape", ()))
             specs = []
@@ -402,17 +428,35 @@ class _Flow:
                     specs.append(self._read(env, var))
                 elif ishape == ():  # scalar broadcast never constrains
                     continue
+                elif _broadcasts_to(ishape, oshape):
+                    spec = self._read(env, var)
+                    if spec is None:
+                        specs.append(None)
+                        continue
+                    # right-align, broadcast dims carry no sharding
+                    pad = len(oshape) - len(ishape)
+                    specs.append(tuple(
+                        spec[d - pad] if (d >= pad
+                                          and ishape[d - pad] == oshape[d])
+                        else ()
+                        for d in range(len(oshape))))
                 else:
                     ok = False
                     break
             if not ok or not specs:
+                if oshape and any(self._read(env, v) is not None
+                                  for v in eqn.invars):
+                    self.unknown.append(path)
                 self._write(env, out, None if oshape else REPLICATED(0))
                 continue
             merged, conflicts = self._merge(specs, len(oshape))
             if conflicts and merged is not None:
+                axes = {a for s in specs if s is not None
+                        for d in conflicts if d < len(s) for a in s[d]}
                 self._event("reshard", eqn, path, in_loop, out.aval,
                             f"operand shardings disagree on dims "
-                            f"{conflicts}; one side must be resharded")
+                            f"{conflicts}; one side must be resharded",
+                            axes=axes)
             self._write(env, out, merged)
 
     def _h_sharding_constraint(self, env, eqn, path, in_loop):
@@ -424,14 +468,17 @@ class _Flow:
             self._write(env, out, in_spec)
             return
         if _is_sharded(in_spec) and in_spec != target:
+            in_axes = {a for e in in_spec for a in e}
             if not any(target):
                 self._event("replicate", eqn, path, in_loop, var.aval,
                             f"constraint replicates a {_spec_str(in_spec)} "
-                            "tensor (full all-gather per device)")
+                            "tensor (full all-gather per device)",
+                            axes=in_axes)
             else:
                 self._event("reshard", eqn, path, in_loop, var.aval,
                             f"constraint moves {_spec_str(in_spec)} -> "
-                            f"{_spec_str(target)}")
+                            f"{_spec_str(target)}",
+                            axes=in_axes | {a for e in target for a in e})
         self._write(env, out, target)
 
     def _h_dot_general(self, env, eqn, path, in_loop):
@@ -444,28 +491,31 @@ class _Flow:
         # contracted dims: sharded on both sides -> partial sums, GSPMD
         # must all-reduce the product; sharded on one side only -> the
         # other operand (or this one) gets gathered to align
-        contracted_sharded = False
+        ar_axes: set = set()
         for li, ri in zip(lc, rc):
             a, b = ls[li], rs[ri]
             if a and b and a == b:
-                contracted_sharded = True
+                ar_axes.update(a)
             elif a and not b:
                 self._event("all-gather", eqn, path, in_loop, lhs.aval,
                             f"lhs contracting dim {li} sharded over "
-                            f"{a}, rhs replicated: one side is gathered")
+                            f"{a}, rhs replicated: one side is gathered",
+                            axes=a)
             elif b and not a:
                 self._event("all-gather", eqn, path, in_loop, rhs.aval,
                             f"rhs contracting dim {ri} sharded over "
-                            f"{b}, lhs replicated: one side is gathered")
+                            f"{b}, lhs replicated: one side is gathered",
+                            axes=b)
             elif a and b and a != b:
                 self._event("reshard", eqn, path, in_loop, rhs.aval,
                             f"contracting dims sharded over different "
-                            f"axes ({a} vs {b})")
-                contracted_sharded = True
-        if contracted_sharded:
+                            f"axes ({a} vs {b})", axes=set(a) | set(b))
+                ar_axes.update(a)
+        if ar_axes:
             self._event("all-reduce", eqn, path, in_loop, out.aval,
                         "contraction over a sharded dimension leaves "
-                        "partial sums; GSPMD all-reduces the result")
+                        "partial sums; GSPMD all-reduces the result",
+                        axes=ar_axes)
         # output spec: batch dims, then lhs free, then rhs free
         used: set = set()
         ospec: List[Tuple[str, ...]] = []
@@ -493,9 +543,11 @@ class _Flow:
         if spec is None:
             self._write(env, out, None)
             return
-        if any(spec[d] for d in axes if d < len(spec)):
+        red_axes = {a for d in axes if d < len(spec) for a in spec[d]}
+        if red_axes:
             self._event("all-reduce", eqn, path, in_loop, out.aval,
-                        "reduction over a sharded dimension")
+                        "reduction over a sharded dimension",
+                        axes=red_axes)
         self._write(env, out, tuple(s for d, s in enumerate(spec)
                                     if d not in axes))
 
@@ -535,7 +587,8 @@ class _Flow:
         if lost:
             self._event("replicate", eqn, path, in_loop, var.aval,
                         f"reshape {list(ishape)}->{list(oshape)} cannot "
-                        f"preserve sharding over {lost}; GSPMD gathers")
+                        f"preserve sharding over {lost}; GSPMD gathers",
+                        axes=lost)
         self._write(env, out, ospec)
 
     def _h_squeeze(self, env, eqn, path, in_loop):
@@ -605,7 +658,34 @@ class _Flow:
         self._write(env, out, self._read(env, eqn.invars[0]))
 
     def _h_scatter(self, env, eqn, path, in_loop):
-        self._write(env, eqn.outvars[0], self._read(env, eqn.invars[0]))
+        """Result follows the operand's placement. For the combining
+        scatters (scatter-add: the gather transpose, i.e. the embedding
+        backward) updates sharded over a scatter/batch dim leave partial
+        per-shard contributions in an operand-shaped buffer — GSPMD
+        combines them with an all-reduce over those axes before the
+        result can honour the operand sharding."""
+        operand, out = eqn.invars[0], eqn.outvars[0]
+        op_spec = self._read(env, operand)
+        if (eqn.primitive.name in ("scatter-add", "scatter-mul")
+                and len(eqn.invars) > 2):
+            upd = eqn.invars[2]
+            u_spec = self._read(env, upd)
+            dnums = eqn.params.get("dimension_numbers")
+            window = set(getattr(dnums, "update_window_dims", ()))
+            if u_spec is not None:
+                scat_axes = {a for d, e in enumerate(u_spec)
+                             if d not in window and e for a in e}
+                op_axes = (set() if op_spec is None
+                           else {a for e in op_spec for a in e})
+                pend = scat_axes - op_axes
+                if pend:
+                    self._event(
+                        "all-reduce", eqn, path, in_loop, out.aval,
+                        f"{eqn.primitive.name} updates sharded over "
+                        f"{tuple(sorted(pend))} scatter into an operand "
+                        "not sharded the same way; per-shard partial "
+                        "contributions are all-reduced", axes=pend)
+        self._write(env, out, op_spec)
 
     _h_scatter_add = _h_scatter
     _h_scatter_mul = _h_scatter
@@ -613,8 +693,70 @@ class _Flow:
     _h_scatter_max = _h_scatter
 
     def _h_gather(self, env, eqn, path, in_loop):
-        # output indexing is data-dependent; conservative unknown
-        self._write(env, eqn.outvars[0], None)
+        """Embedding-lookup pattern: out = operand[indices]. Batch dims
+        of the output inherit the index sharding (each shard looks up
+        rows for its own batch); offset dims that take an operand dim
+        whole inherit the operand sharding on that dim. Indexing INTO a
+        sharded operand dim is the real transfer: GSPMD gathers the
+        table to every shard before indexing (all-gather)."""
+        operand, idx = eqn.invars[0], eqn.invars[1]
+        out = eqn.outvars[0]
+        dnums = eqn.params["dimension_numbers"]
+        op_spec = self._read(env, operand)
+        ix_spec = self._read(env, idx)
+        if op_spec is None or ix_spec is None:
+            self._write(env, out, None)
+            return
+        op_shape = tuple(int(d) for d in operand.aval.shape)
+        slice_sizes = tuple(int(d) for d in eqn.params["slice_sizes"])
+        offset_dims = tuple(dnums.offset_dims)
+        collapsed = set(dnums.collapsed_slice_dims)
+        indexed = set(dnums.start_index_map)
+        obd = tuple(getattr(dnums, "operand_batching_dims", ()))
+        sbd = tuple(getattr(dnums, "start_indices_batching_dims", ()))
+        pair = dict(zip(sbd, obd))  # index batch dim -> operand batch dim
+        # operand dims that survive into the output (offset dims), in order
+        passthrough = [d for d in range(len(op_shape))
+                       if d not in collapsed and d not in obd]
+        for d in sorted(indexed):
+            if d < len(op_spec) and op_spec[d]:
+                self._event(
+                    "all-gather", eqn, path, in_loop, operand.aval,
+                    f"gather indexes operand dim {d} sharded over "
+                    f"{op_spec[d]}; the table is gathered to every shard "
+                    "before indexing", axes=op_spec[d])
+        # index batch dims, minus the trailing index-vector dim
+        batch_src = list(range(max(len(ix_spec) - 1, 0)))
+        ospec: List[Tuple[str, ...]] = []
+        oi = bi = 0
+        for d in range(len(getattr(out.aval, "shape", ()))):
+            if d in offset_dims:
+                src = passthrough[oi]
+                oi += 1
+                full = (slice_sizes[src] == op_shape[src]
+                        and src not in indexed)
+                ospec.append(op_spec[src] if full else ())
+                continue
+            src = batch_src[bi] if bi < len(batch_src) else None
+            bi += 1
+            if src is None:
+                ospec.append(())
+                continue
+            got = ix_spec[src]
+            if src in pair:  # vmapped gather: operand batch dim rides along
+                ob = pair[src]
+                op_b = op_spec[ob] if ob < len(op_spec) else ()
+                if op_b and got and op_b != got:
+                    self._event(
+                        "reshard", eqn, path, in_loop, operand.aval,
+                        f"batched gather: operand batch dim {ob} sharded "
+                        f"over {op_b} but indices batch dim {src} over "
+                        f"{got}; operand realigned",
+                        axes=set(op_b) | set(got))
+                elif op_b and not got:
+                    got = op_b
+            ospec.append(got)
+        self._write(env, out, tuple(ospec))
 
     def _h_iota(self, env, eqn, path, in_loop):
         out = eqn.outvars[0]
@@ -623,6 +765,19 @@ class _Flow:
 
     def _h_rev(self, env, eqn, path, in_loop):
         self._write(env, eqn.outvars[0], self._read(env, eqn.invars[0]))
+
+    def _h_random_unwrap(self, env, eqn, path, in_loop):
+        # opaque PRNG key -> uint32 key data: one extra trailing dim,
+        # never sharded (key payload is 2 words)
+        spec = self._read(env, eqn.invars[0])
+        self._write(env, eqn.outvars[0],
+                    None if spec is None else tuple(spec) + ((),))
+
+    def _h_random_wrap(self, env, eqn, path, in_loop):
+        # uint32 key data -> opaque PRNG key: drops the trailing dim
+        spec = self._read(env, eqn.invars[0])
+        self._write(env, eqn.outvars[0],
+                    None if spec is None else tuple(spec[:-1]))
 
     def _h_call(self, env, eqn, path, in_loop):
         sub = None
@@ -662,7 +817,8 @@ class _Flow:
                             eqn.invars[nc + ci].aval,
                             f"scan carry {ci} sharding does not reach a "
                             f"fixpoint ({_spec_str(cin)} -> "
-                            f"{_spec_str(cout)}); resharded per iteration")
+                            f"{_spec_str(cout)}); resharded per iteration",
+                            axes={a for e in cin + cout for a in e})
         carry_out = outs[:ncar]
         ys = [None if s is None else ((),) + tuple(s)
               for s in outs[ncar:]]
@@ -684,7 +840,8 @@ class _Flow:
                             eqn.invars[cn + bn + ci].aval,
                             f"while carry {ci} sharding does not reach a "
                             f"fixpoint ({_spec_str(cin)} -> "
-                            f"{_spec_str(cout)}); resharded per iteration")
+                            f"{_spec_str(cout)}); resharded per iteration",
+                            axes={a for e in cin + cout for a in e})
         for var, spec in zip(eqn.outvars, outs):
             self._write(env, var, spec)
 
@@ -777,7 +934,8 @@ def propagate_jaxpr(closed: ClosedJaxpr, in_specs: Sequence[ShardSpec],
     specs = list(in_specs)
     specs.extend([None] * (len(closed.jaxpr.invars) - len(specs)))
     outs = flow.run(closed.jaxpr, specs, path, in_loop=False)
-    return FlowResult(events=flow.events, out_specs=outs)
+    return FlowResult(events=flow.events, out_specs=outs,
+                      unknown=flow.unknown)
 
 
 # ------------------------------------------------------------------- rules

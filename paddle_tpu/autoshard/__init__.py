@@ -1,0 +1,26 @@
+"""Sharding auto-search: enumerate candidate layouts, score them without
+compiling, rank, and validate the winners through the HLO audit.
+
+Lazy imports keep ``space``/``cost`` importable without jax (the CLI's
+synthetic-package mode); the jax-touching stages live in ``search`` and
+``validate``.
+"""
+
+from . import cost, space
+
+__all__ = ["cost", "search", "search_train_step", "space", "validate",
+           "validate_top_k"]
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in ("search", "search_train_step", "winner_mesh",
+                "winner_param_specs", "seed_candidate", "SearchResult",
+                "RankedCandidate"):
+        mod = importlib.import_module(".search", __name__)
+        return mod if name == "search" else getattr(mod, name)
+    if name in ("validate", "validate_top_k", "CandidateValidation"):
+        mod = importlib.import_module(".validate", __name__)
+        return mod if name == "validate" else getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,252 @@
+"""Compile-free candidate scoring.
+
+Three ingredients, all reused from the analyzers rather than re-derived:
+
+- **wire bytes** — ``analysis.sharding_flow`` propagates the candidate's
+  arg specs through the (layout-independent) train-step jaxpr once per
+  candidate; every FlowEvent converts to per-device receive-side bytes
+  with ``hlo_audit``'s own ring conventions (all-reduce ``2(n-1)b/n``,
+  all-gather/replicate ``(n-1)b/n``, reshard modeled as an all-to-all of
+  the per-device shard). The group size ``n`` is the product of the
+  event's mesh axes (``FlowEvent.axes``).
+- **roofline floors** — per-device FLOPs and HBM traffic are the
+  jaxpr's flat totals (``observability.anatomy.flat_costs``) divided by
+  the candidate's compute split (the data-axis product, times ``mp``
+  when the table actually shards matmul weights over it), then run
+  through ``observability.attribution.floors``.
+- **HBM fit** — an analytic per-device residency estimate: params +
+  grads + fp32 master + optimizer moments (each divided by its spec's
+  shard degree) + the activation working set (global activation traffic
+  scaled by ``ACT_RESIDENT_FRACTION`` and the compute split). A
+  candidate whose estimate exceeds the device HBM capacity is rejected
+  outright, never ranked.
+
+Scores are fully deterministic: same jaxpr + same candidate -> same
+floors, which is what lets the bench A/B row and the contract tests
+reconcile against the search exactly on the cpu-nominal profile.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
+
+from ..observability import attribution
+
+__all__ = [
+    "ACT_RESIDENT_FRACTION", "CandidateCost", "HBM_CAPACITY_BYTES",
+    "compute_split", "event_wire_bytes", "hbm_fit_bytes", "score_candidate",
+    "shard_degree",
+]
+
+#: per-device HBM capacity by attribution.HardwareSpec name; the
+#: cpu-nominal figure is a stand-in host budget so tiny CPU corpora
+#: never reject, v5e is the real 16G part
+HBM_CAPACITY_BYTES: Dict[str, float] = {
+    "tpu-v5e": 16e9,
+    "cpu-nominal": 64e9,
+}
+
+#: fraction of the (already compute-split) activation HBM traffic
+#: assumed live at the peak — a documented modeling constant, not a
+#: measurement; the validate stage reconciles it against the compiled
+#: program's true peak
+ACT_RESIDENT_FRACTION = 0.25
+
+
+@dataclass
+class CandidateCost:
+    """Everything the ranker and the bench row need about one candidate."""
+
+    flops_per_device: float
+    hbm_bytes_per_device: float
+    wire_bytes_per_device: float
+    wire_by_scope: Dict[str, float]
+    floors_ms: Dict[str, float]
+    floor_ms: float
+    binding: str
+    compute_split: int
+    hbm_fit_bytes: float
+    hbm_capacity_bytes: Optional[float]
+    fits: bool
+    n_events: int
+    predicted_families: Dict[str, int]  # family -> global bytes (audit conv)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "flops_per_device": self.flops_per_device,
+            "hbm_bytes_per_device": self.hbm_bytes_per_device,
+            "wire_bytes_per_device": round(self.wire_bytes_per_device, 1),
+            "wire_by_scope": {k: round(v, 1)
+                              for k, v in sorted(self.wire_by_scope.items())},
+            "floors_ms": {k: round(v, 6)
+                          for k, v in self.floors_ms.items()},
+            "floor_ms": round(self.floor_ms, 6),
+            "binding": self.binding,
+            "compute_split": self.compute_split,
+            "hbm_fit_bytes": int(self.hbm_fit_bytes),
+            "fits": self.fits,
+            "n_events": self.n_events,
+            "predicted_families": dict(sorted(
+                self.predicted_families.items())),
+        }
+
+
+def _group(axes: Iterable[str], axis_sizes: Mapping[str, int],
+           world: int) -> int:
+    n = 1
+    for a in axes:
+        n *= int(axis_sizes.get(a, 1))
+    if n <= 1:
+        # events recorded before axes were threaded through (or an axis
+        # the mesh doesn't size): conservatively the whole mesh
+        return max(int(world), 1)
+    return n
+
+
+def event_wire_bytes(event: Any, axis_sizes: Mapping[str, int],
+                     world: Optional[int] = None) -> float:
+    """Per-device receive-side bytes for one FlowEvent — the repo's plan
+    convention, mirroring ``hlo_audit.HloCollective.wire_bytes``."""
+    if world is None:
+        world = 1
+        for n in axis_sizes.values():
+            world *= int(n)
+    b = float(event.nbytes)
+    n = _group(getattr(event, "axes", ()), axis_sizes, world)
+    if n <= 1:
+        return 0.0
+    if event.kind == "all-reduce":
+        return 2.0 * (n - 1) * b / n
+    if event.kind in ("all-gather", "replicate"):
+        return (n - 1) * b / n
+    if event.kind == "reshard":  # all-to-all of the per-device shard
+        return (n - 1) * b / (n * n)
+    return b
+
+
+#: FlowEvent kind -> HLO collective family (hlo_audit's own mapping)
+KIND_FAMILY = {
+    "all-reduce": "all-reduce",
+    "all-gather": "all-gather",
+    "replicate": "all-gather",
+    "reshard": "all-to-all",
+}
+
+
+def shard_degree(spec: Optional[Tuple[Tuple[str, ...], ...]],
+                 axis_sizes: Mapping[str, int]) -> int:
+    """How many ways a tensor with this canonical spec is split."""
+    if not spec:
+        return 1
+    deg = 1
+    for entry in spec:
+        for a in entry:
+            deg *= int(axis_sizes.get(a, 1))
+    return max(deg, 1)
+
+
+def compute_split(param_specs: Iterable[Tuple[str, Tuple]],
+                  batch_axes: Iterable[str],
+                  axis_sizes: Mapping[str, int],
+                  model_axes: Tuple[str, ...] = ("mp",)) -> int:
+    """How many ways the step's FLOPs divide: the data-axis product
+    always (the batch is split), times each model axis the table
+    actually shards a >=2-dim param over (tensor parallelism splits the
+    matmuls; the fsdp axis does NOT split compute — params are gathered
+    back for the mathmuls, which the wire model charges for)."""
+    split = 1
+    for a in batch_axes:
+        split *= int(axis_sizes.get(a, 1))
+    used_model = set()
+    for _name, spec in param_specs:
+        if spec and len(spec) >= 2:
+            for entry in spec:
+                used_model.update(a for a in entry if a in model_axes)
+    for a in used_model:
+        split *= int(axis_sizes.get(a, 1))
+    return max(split, 1)
+
+
+def hbm_fit_bytes(param_bytes: Mapping[str, int],
+                  param_specs: Mapping[str, Tuple],
+                  state_bytes: Mapping[str, int],
+                  state_degrees: Mapping[str, int],
+                  axis_sizes: Mapping[str, int],
+                  act_bytes_global: float,
+                  split: int,
+                  master_bytes_per_elem: float = 0.0,
+                  ) -> float:
+    """Analytic per-device residency: params + grads (same placement) +
+    optional fp32 master + moments + the activation working set."""
+    total = 0.0
+    for name, nbytes in param_bytes.items():
+        deg = shard_degree(param_specs.get(name), axis_sizes)
+        per = nbytes / deg
+        total += 2.0 * per  # param + grad
+        if master_bytes_per_elem:
+            total += per * master_bytes_per_elem
+    for name, nbytes in state_bytes.items():
+        total += nbytes / max(int(state_degrees.get(name, 1)), 1)
+    total += ACT_RESIDENT_FRACTION * act_bytes_global / max(split, 1)
+    return total
+
+
+def score_candidate(closed: Any,
+                    in_specs: List,
+                    candidate: Any,
+                    hw: "attribution.HardwareSpec",
+                    flat_totals: Mapping[str, float],
+                    param_bytes: Mapping[str, int],
+                    state_bytes: Mapping[str, int],
+                    state_degrees: Mapping[str, int],
+                    path: str = "autoshard") -> CandidateCost:
+    """Score one candidate against the traced step. ``in_specs`` are the
+    flat canonical arg specs for THIS candidate; ``flat_totals`` the
+    layout-independent jaxpr totals ({flops, hbm_bytes})."""
+    from ..analysis import sharding_flow as _sf
+
+    axis_sizes = candidate.axis_sizes()
+    world = 1
+    for _a, n in candidate.mesh_axes:
+        world *= int(n)
+
+    result = _sf.propagate_jaxpr(closed, in_specs, axis_sizes, path)
+
+    wire = 0.0
+    by_scope: Dict[str, float] = {}
+    families: Dict[str, int] = {}
+    for ev in result.events:
+        w = event_wire_bytes(ev, axis_sizes, world)
+        wire += w
+        scope = ev.scope or "unattributed"
+        by_scope[scope] = by_scope.get(scope, 0.0) + w
+        fam = KIND_FAMILY.get(ev.kind)
+        if fam:
+            families[fam] = families.get(fam, 0) + int(ev.nbytes)
+
+    split = compute_split(candidate.param_specs, candidate.batch_axes,
+                          axis_sizes)
+    flops_dev = float(flat_totals.get("flops", 0.0)) / split
+    hbm_dev = float(flat_totals.get("hbm_bytes", 0.0)) / split
+
+    floors_s = attribution.floors(hw, flops_dev, hbm_dev, wire)
+    floors = {r: s * 1e3 for r, s in floors_s.items()}
+    binding, floor_ms = "compute", 0.0
+    for r in attribution.RESOURCES:  # deterministic tie-break
+        if r in floors and floors[r] > floor_ms:
+            binding, floor_ms = r, floors[r]
+
+    fit = hbm_fit_bytes(param_bytes, dict(candidate.param_specs),
+                        state_bytes, state_degrees, axis_sizes,
+                        float(flat_totals.get("hbm_bytes", 0.0)), split)
+    cap = HBM_CAPACITY_BYTES.get(hw.name)
+    fits = True if cap is None else fit <= cap
+
+    return CandidateCost(
+        flops_per_device=flops_dev, hbm_bytes_per_device=hbm_dev,
+        wire_bytes_per_device=wire, wire_by_scope=by_scope,
+        floors_ms=floors, floor_ms=floor_ms, binding=binding,
+        compute_split=split, hbm_fit_bytes=fit, hbm_capacity_bytes=cap,
+        fits=fits, n_events=len(result.events),
+        predicted_families=families)

@@ -1,0 +1,272 @@
+"""Candidate layout enumeration for the sharding auto-search.
+
+Stdlib-only by design (no jax import): the search space is pure data —
+mesh factorizations of the physical device count into the hybrid axes
+(dp / sharding / mp), and regex rule tables mapping parameter names to
+partition specs (the ``match_partition_rules`` idiom). Specs use the
+analyzer's canonical ShardSpec form — one tuple of mesh-axis names per
+tensor dim, ``()`` meaning replicated on that dim — so candidates can be
+scored by ``analysis.sharding_flow`` without materializing a single
+``NamedSharding``. ``search.py`` converts the winner to jax types.
+
+Families:
+
+- ``replicated``    pure data parallelism — every param replicated
+- ``megatron``      tensor parallelism over ``mp`` (column/row splits +
+                    vocab-parallel embedding, the models' own dist_spec
+                    convention)
+- ``fsdp``          ZeRO-3 style — every param sharded over the
+                    ``sharding`` axis on its first divisible dim
+- ``megatron_fsdp`` both: mp splits first, the sharding axis takes the
+                    first remaining free divisible dim
+
+Resolution sanitizes every spec against the candidate's axis sizes: an
+axis of size 1 disappears, a dim not divisible by its axis degree falls
+back to replicated, and two rules can never place the same axis twice.
+Dedup is by ``Candidate.signature()`` — the resolved table plus the
+sizes of the axes it actually uses — so e.g. ``megatron`` on an mp=1
+factorization collapses into ``replicated`` and is emitted once.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+__all__ = [
+    "AXIS_NAMES", "Candidate", "LayoutRule", "RULE_FAMILIES",
+    "enumerate_candidates", "match_partition_rules", "mesh_factorizations",
+    "resolve_table",
+]
+
+#: the hybrid-parallel axes the search factorizes the device count over;
+#: ``sharding`` is the ZeRO/fsdp axis and also a data axis (fleet
+#: convention: the batch is sharded over dp AND sharding AND ep)
+AXIS_NAMES: Tuple[str, ...] = ("dp", "sharding", "mp")
+
+#: data axes (batch dim 0) — mirror of sharding_utils.DATA_AXES minus ep
+DATA_AXES: Tuple[str, ...] = ("dp", "sharding")
+
+#: sentinel spec: shard the first free divisible dim over the fsdp axis
+FSDP_AUTO = "fsdp-auto"
+
+Spec = Tuple[Tuple[str, ...], ...]
+
+
+@dataclass(frozen=True)
+class LayoutRule:
+    """One regex row of a rule table: first match wins."""
+
+    pattern: str
+    #: a canonical Spec, or FSDP_AUTO
+    spec: object
+
+    def matches(self, name: str) -> bool:
+        return re.search(self.pattern, name) is not None
+
+
+def _meg(*entries) -> Spec:
+    return tuple(tuple(e) if isinstance(e, (tuple, list)) else
+                 ((e,) if e else ()) for e in entries)
+
+
+#: family name -> rule table (regexes follow the models' naming:
+#: VocabParallelEmbedding / ColumnParallel qkv+fc1 / RowParallel proj+fc2)
+RULE_FAMILIES: Dict[str, Tuple[LayoutRule, ...]] = {
+    "replicated": (
+        LayoutRule(r".*", ()),
+    ),
+    "megatron": (
+        LayoutRule(r"word_embeddings\.weight$", _meg("mp", None)),
+        LayoutRule(r"(qkv|fc1)\.weight$", _meg(None, "mp")),
+        LayoutRule(r"(qkv|fc1)\.bias$", _meg("mp")),
+        LayoutRule(r"(proj|fc2)\.weight$", _meg("mp", None)),
+        LayoutRule(r".*", ()),
+    ),
+    "fsdp": (
+        LayoutRule(r".*", FSDP_AUTO),
+    ),
+    "megatron_fsdp": (
+        LayoutRule(r"word_embeddings\.weight$", _meg("mp", None)),
+        LayoutRule(r"(qkv|fc1)\.weight$", _meg(None, "mp")),
+        LayoutRule(r"(qkv|fc1)\.bias$", _meg("mp")),
+        LayoutRule(r"(proj|fc2)\.weight$", _meg("mp", None)),
+        LayoutRule(r".*", FSDP_AUTO),
+    ),
+}
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One fully resolved layout candidate."""
+
+    name: str                              # "dp2.sharding2.mp2/megatron"
+    family: str
+    mesh_axes: Tuple[Tuple[str, int], ...]  # ordered (axis, size), all axes
+    param_specs: Tuple[Tuple[str, Spec], ...]  # sorted (name, spec)
+    batch_axes: Tuple[str, ...]            # axes sharding batch dim 0
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return dict(self.mesh_axes)
+
+    def spec_of(self, name: str) -> Optional[Spec]:
+        for n, s in self.param_specs:
+            if n == name:
+                return s
+        return None
+
+    def used_axes(self) -> Tuple[str, ...]:
+        used = set(self.batch_axes)
+        for _n, spec in self.param_specs:
+            for entry in spec:
+                used.update(entry)
+        return tuple(sorted(used))
+
+    def signature(self) -> Tuple:
+        """Canonical dedup key: the resolved table + batch placement +
+        the sizes of the axes actually used. Everything the cost model
+        can see; factorizations differing only in unused axes collapse."""
+        sizes = self.axis_sizes()
+        return (self.param_specs, self.batch_axes,
+                tuple((a, sizes[a]) for a in self.used_axes()))
+
+
+def mesh_factorizations(ndev: int,
+                        axis_names: Sequence[str] = AXIS_NAMES
+                        ) -> List[Tuple[Tuple[str, int], ...]]:
+    """Every ordered factorization of ``ndev`` over ``axis_names``."""
+    names = tuple(axis_names)
+    out: List[Tuple[Tuple[str, int], ...]] = []
+
+    def rec(i: int, rest: int, acc: Tuple[int, ...]):
+        if i == len(names) - 1:
+            out.append(tuple(zip(names, acc + (rest,))))
+            return
+        d = 1
+        while d <= rest:
+            if rest % d == 0:
+                rec(i + 1, rest // d, acc + (d,))
+            d += 1
+
+    rec(0, max(int(ndev), 1), ())
+    return out
+
+
+def match_partition_rules(rules: Sequence[LayoutRule], name: str):
+    """First matching rule's spec (the SNIPPETS idiom); no match raises."""
+    for rule in rules:
+        if rule.matches(name):
+            return rule.spec
+    raise ValueError(f"no partition rule matches parameter {name!r}")
+
+
+def _sanitize(spec: Spec, shape: Tuple[int, ...],
+              sizes: Mapping[str, int]) -> Spec:
+    """Clamp a spec template to a shape under concrete axis sizes: axes
+    of size 1 vanish, non-divisible placements fall back to replicated,
+    and no axis is used twice."""
+    entries: List[Tuple[str, ...]] = []
+    used: set = set()
+    for d in range(len(shape)):
+        entry = spec[d] if d < len(spec) else ()
+        kept: List[str] = []
+        deg = 1
+        for a in entry:
+            n = int(sizes.get(a, 1))
+            if n <= 1 or a in used:
+                continue
+            if shape[d] % (deg * n) == 0:
+                kept.append(a)
+                used.add(a)
+                deg *= n
+        entries.append(tuple(kept))
+    return tuple(entries)
+
+
+def resolve_table(rules: Sequence[LayoutRule],
+                  shapes: Mapping[str, Tuple[int, ...]],
+                  sizes: Mapping[str, int],
+                  fsdp_axis: str = "sharding"
+                  ) -> Dict[str, Spec]:
+    """Resolve a rule table against concrete shapes and axis sizes."""
+    return {name: _resolve_param(rules, name, shape, sizes, fsdp_axis)
+            for name, shape in shapes.items()}
+
+
+def _place_fsdp(spec: Spec, shape: Tuple[int, ...], fsdp_axis: str,
+                deg: int) -> Spec:
+    """Add the fsdp axis on the first free dim divisible by its degree
+    (mirror of fleet's ``_state_sharding_like`` placement)."""
+    if deg <= 1:
+        return spec
+    used = {a for e in spec for a in e}
+    if fsdp_axis in used:
+        return spec
+    entries = list(spec)
+    for i, e in enumerate(entries):
+        if not e and shape[i] % deg == 0 and shape[i] >= deg:
+            entries[i] = (fsdp_axis,)
+            break
+    return tuple(entries)
+
+
+def _resolve_param(rules: Sequence[LayoutRule], name: str,
+                   shape: Tuple[int, ...], sizes: Mapping[str, int],
+                   fsdp_axis: str) -> Spec:
+    shape = tuple(int(d) for d in shape)
+    if not shape:
+        return ()
+    template = match_partition_rules(rules, name)
+    if template == FSDP_AUTO:
+        base: Spec = tuple(() for _ in shape)
+        fsdp = True
+    else:
+        base = _sanitize(tuple(template), shape, sizes)
+        fsdp = any(r.spec == FSDP_AUTO for r in rules if r.matches(name))
+    if fsdp:
+        base = _place_fsdp(base, shape, fsdp_axis,
+                           int(sizes.get(fsdp_axis, 1)))
+    return base
+
+
+def enumerate_candidates(shapes: Mapping[str, Tuple[int, ...]],
+                         ndev: int,
+                         axis_names: Sequence[str] = AXIS_NAMES,
+                         families: Optional[Sequence[str]] = None,
+                         fsdp_axis: str = "sharding",
+                         batch_divisor: Optional[int] = None
+                         ) -> List[Candidate]:
+    """The deduped candidate list: every mesh factorization x every rule
+    family, resolved against the param shapes. ``batch_divisor`` (the
+    global batch size) prunes factorizations whose data-axis product
+    cannot divide the batch."""
+    fams = tuple(families) if families else tuple(RULE_FAMILIES)
+    seen: Dict[Tuple, str] = {}
+    out: List[Candidate] = []
+    for mesh_axes in mesh_factorizations(ndev, axis_names):
+        sizes = dict(mesh_axes)
+        data_deg = 1
+        for a in DATA_AXES:
+            data_deg *= int(sizes.get(a, 1))
+        if batch_divisor is not None and data_deg > 0 \
+                and batch_divisor % data_deg != 0:
+            continue
+        batch_axes = tuple(a for a in DATA_AXES
+                           if int(sizes.get(a, 1)) > 1)
+        for fam in fams:
+            rules = RULE_FAMILIES[fam]
+            table = tuple(sorted(
+                (name, _resolve_param(rules, name, shape, sizes, fsdp_axis))
+                for name, shape in shapes.items()))
+            mesh_name = ".".join(f"{a}{n}" for a, n in mesh_axes if n > 1) \
+                or "single"
+            cand = Candidate(name=f"{mesh_name}/{fam}", family=fam,
+                             mesh_axes=tuple(mesh_axes),
+                             param_specs=table, batch_axes=batch_axes)
+            sig = cand.signature()
+            if sig in seen:
+                continue
+            seen[sig] = cand.name
+            out.append(cand)
+    return out

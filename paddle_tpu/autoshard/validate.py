@@ -1,0 +1,156 @@
+"""Compile the top-k searched layouts through ``analysis.hlo_audit`` and
+reconcile the cost model against the partitioned program.
+
+The search never compiles; this stage is where its predictions meet XLA.
+For each of the top-k ranked candidates a real ``ShardedTrainStep`` is
+built under the candidate's mesh + param table, wrapped in the same
+``ProgramSpec`` shape the analysis corpus uses for ``train_step``, and
+run through ``hlo_audit.audit_spec``. A candidate validates when:
+
+- the audit compiles clean (no error),
+- **zero unexplained collective families** — every family XLA emitted at
+  >=256 KiB was predicted by the flow model under the candidate's specs
+  (hlo_audit's own threshold),
+- the predicted per-device wire bytes agree with the audited per-device
+  wire bytes within ``WIRE_FACTOR``x — the same 2.0x factor the
+  analyzer's ``SiteContract.wire_tolerance`` uses for model-vs-plan
+  reconciliation,
+- the compiled peak HBM fits the device capacity the cost model gated on
+  (the analytic fit estimate exists to reject OOM layouts; the compiled
+  peak is the truth it is calibrated against, reported as a ratio).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from . import cost as _cost
+from .search import SearchResult, winner_mesh, winner_param_specs
+
+__all__ = ["CandidateValidation", "WIRE_FACTOR", "WIRE_MIN_BYTES",
+           "validate_top_k"]
+
+#: multiplicative agreement factor for predicted vs audited wire bytes —
+#: analyzer SiteContract.wire_tolerance's convention
+WIRE_FACTOR = 2.0
+
+#: below this, both accountings are in fusion-noise territory — agree
+#: trivially (hlo_audit's unexplained threshold)
+WIRE_MIN_BYTES = 256 * 1024
+
+
+@dataclass
+class CandidateValidation:
+    layout: str
+    rank: int
+    is_seed: bool
+    error: Optional[str] = None
+    unexplained: List[str] = field(default_factory=list)
+    predicted_wire: float = 0.0
+    actual_wire: int = 0
+    wire_ratio: Optional[float] = None
+    wire_ok: bool = False
+    predicted_families: Dict[str, int] = field(default_factory=dict)
+    actual_counts: Dict[str, int] = field(default_factory=dict)
+    hbm_fit_bytes: float = 0.0
+    hbm_peak_bytes: int = 0
+    hbm_ratio: Optional[float] = None
+    hbm_ok: bool = False
+    compile_seconds: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return (self.error is None and not self.unexplained
+                and self.wire_ok and self.hbm_ok)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "layout": self.layout, "rank": self.rank, "seed": self.is_seed,
+            "ok": self.ok, "error": self.error,
+            "unexplained": list(self.unexplained),
+            "predicted_wire": round(self.predicted_wire, 1),
+            "actual_wire": self.actual_wire,
+            "wire_ratio": (round(self.wire_ratio, 3)
+                           if self.wire_ratio is not None else None),
+            "wire_ok": self.wire_ok,
+            "actual_counts": dict(sorted(self.actual_counts.items())),
+            "hbm_fit_bytes": int(self.hbm_fit_bytes),
+            "hbm_peak_bytes": self.hbm_peak_bytes,
+            "hbm_ratio": (round(self.hbm_ratio, 3)
+                          if self.hbm_ratio is not None else None),
+            "hbm_ok": self.hbm_ok,
+            "compile_seconds": round(self.compile_seconds, 3),
+        }
+
+
+def _step_for(candidate, probe, ranked):
+    if ranked.is_seed:
+        return probe
+    from ..distributed.fleet.utils import make_sharded_train_step
+
+    return make_sharded_train_step(
+        probe.model, probe.optimizer,
+        mesh=winner_mesh(candidate),
+        param_specs=winner_param_specs(candidate))
+
+
+def validate_top_k(result: SearchResult, probe, k: int = 3
+                   ) -> List[CandidateValidation]:
+    """Audit the top-k ranked candidates. ``probe`` is the seed
+    ShardedTrainStep the search traced (reused for the seed row so it is
+    audited exactly as built)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ..analysis.analyzer import ProgramSpec, SiteContract
+    from ..analysis import hlo_audit as _hlo
+
+    bsz, seq = result.batch_shape
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 16, size=(bsz, seq))
+    y = np.roll(x, -1, axis=1)
+
+    out: List[CandidateValidation] = []
+    for rc in result.ranked[:max(int(k), 1)]:
+        v = CandidateValidation(layout=rc.candidate.name, rank=rc.rank,
+                                is_seed=rc.is_seed,
+                                predicted_families=dict(
+                                    rc.cost.predicted_families),
+                                hbm_fit_bytes=rc.cost.hbm_fit_bytes)
+        try:
+            st = _step_for(rc.candidate, probe, rc)
+        except Exception as e:  # noqa: BLE001 - recorded on the row
+            v.error = f"{type(e).__name__}: {e}"
+            out.append(v)
+            continue
+        spec = ProgramSpec(
+            f"autoshard/{rc.candidate.name}", st._compiled_step_fn,
+            (st.params, st.opt_state, st.buffers, st.ef_state,
+             jnp.asarray(x), jnp.asarray(y), jnp.float32(1e-3),
+             jnp.uint32(0)),
+            SiteContract(one_compile=True, donate_argnums=(0, 1, 2, 3)),
+            sharding=st.sharding_contract())
+        audit = _hlo.audit_spec(spec)
+        v.error = audit.error
+        v.unexplained = list(audit.unexplained)
+        v.actual_wire = int(audit.wire_bytes)
+        v.actual_counts = dict(audit.counts)
+        v.compile_seconds = audit.compile_seconds
+        v.predicted_wire = float(rc.cost.wire_bytes_per_device)
+
+        lo = min(v.predicted_wire, float(v.actual_wire))
+        hi = max(v.predicted_wire, float(v.actual_wire))
+        if hi < WIRE_MIN_BYTES:
+            v.wire_ok, v.wire_ratio = True, None
+        else:
+            v.wire_ratio = hi / max(lo, 1.0)
+            v.wire_ok = v.wire_ratio <= WIRE_FACTOR
+
+        v.hbm_peak_bytes = int(audit.hbm.get("peak", 0))
+        cap = rc.cost.hbm_capacity_bytes
+        if v.hbm_peak_bytes and v.hbm_fit_bytes:
+            v.hbm_ratio = v.hbm_peak_bytes / v.hbm_fit_bytes
+        v.hbm_ok = (cap is None or v.hbm_peak_bytes <= cap)
+        out.append(v)
+    return out

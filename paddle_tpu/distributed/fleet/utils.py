@@ -109,6 +109,7 @@ class ShardedTrainStep:
         scaler=None,
         grad_reduce=None,
         health_stats: Optional[bool] = None,
+        param_specs: Optional[Dict[str, P]] = None,
     ):
         from ..topology import get_hybrid_communicate_group
 
@@ -174,6 +175,18 @@ class ShardedTrainStep:
                 p_shard[skey(sfx)] = NamedSharding(mesh, P(*lead, *entries))
         else:
             p_shard = param_shardings(model, mesh)
+            if param_specs:
+                # autoshard (or any caller) overrides the models' dist_spec
+                # layout wholesale — partial tables keep the default for
+                # params they don't name
+                p_shard = {
+                    name: (NamedSharding(mesh, param_specs[name])
+                           if name in param_specs else sh)
+                    for name, sh in p_shard.items()}
+        if param_specs and pp > 1:
+            raise ValueError("param_specs overrides are not supported with "
+                             "pipeline parallelism (pp>1): block params are "
+                             "restacked with a pp leading dim")
 
         opt_state0 = optimizer.init_state_pytree(params0)
         shard_axis = getattr(optimizer, "_shard_state_axis", None)
@@ -1215,5 +1228,34 @@ class ShardedTrainStep:
             jnp.uint32(0), *hp)
 
 
-def make_sharded_train_step(model, optimizer, loss_fn=None, mesh=None, **kwargs) -> ShardedTrainStep:
-    return ShardedTrainStep(model, optimizer, loss_fn=loss_fn, mesh=mesh, **kwargs)
+def make_sharded_train_step(model, optimizer, loss_fn=None, mesh=None,
+                            autoshard: bool = False,
+                            autoshard_fixed_mesh: bool = False,
+                            **kwargs) -> ShardedTrainStep:
+    """Build a ShardedTrainStep; with ``autoshard=True`` the layout search
+    (``paddle_tpu.autoshard``) runs first over a probe step under the
+    hand-written seed layout, and the returned step is rebuilt on the
+    winning mesh/param table (a seed win returns the probe itself). The
+    search result is attached as ``step.autoshard_result``.
+    ``autoshard_fixed_mesh=True`` keeps the given mesh and searches only
+    the param layout (elastic re-formation: the supervisor owns the mesh)."""
+    if not autoshard:
+        return ShardedTrainStep(model, optimizer, loss_fn=loss_fn, mesh=mesh, **kwargs)
+
+    from ...autoshard import search as _autoshard
+
+    probe = ShardedTrainStep(model, optimizer, loss_fn=loss_fn, mesh=mesh, **kwargs)
+    result = _autoshard.search_train_step(probe=probe,
+                                          fixed_mesh=autoshard_fixed_mesh)
+    win = result.winner
+    if win is None or win.is_seed:
+        probe.autoshard_result = result
+        return probe
+    step = ShardedTrainStep(
+        model, optimizer, loss_fn=loss_fn,
+        mesh=(probe.mesh if autoshard_fixed_mesh
+              else _autoshard.winner_mesh(win.candidate)),
+        param_specs=_autoshard.winner_param_specs(win.candidate),
+        **kwargs)
+    step.autoshard_result = result
+    return step

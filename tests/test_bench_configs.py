@@ -48,7 +48,7 @@ def test_all_configs_registered():
                                   "resnet50", "gpt_moe", "serving", "ckpt",
                                   "data", "comm", "reshard", "obs",
                                   "analysis", "elastic", "health",
-                                  "anatomy"}
+                                  "anatomy", "autoshard"}
 
 
 def test_bench_ckpt_row_contract(capsys):

@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""Offline sharding-layout search: rank candidate layouts for the GPT
+train step without compiling, optionally validate the top-k through the
+HLO audit, and gate the committed winner against drift.
+
+CPU-only with 8 synthetic host devices (same forced-platform preamble as
+``lint_programs.py``), so the ranked table reproduces bit-identically on
+any CI host: the cost model is deterministic — jaxpr flat costs +
+flow-predicted wire bytes + analytic HBM fit, nothing measured.
+
+Usage:
+  python tools/autoshard.py                      # ranked layout table
+  python tools/autoshard.py --json               # machine-readable
+  python tools/autoshard.py --validate-top 3     # + compile top-k through
+                                                 #   hlo_audit (slow)
+  python tools/autoshard.py --check              # CI gate: committed
+                                                 #   winner re-searched +
+                                                 #   re-audited; drift or
+                                                 #   reconcile failure -> 1
+  python tools/autoshard.py --update-baseline --reason "why"
+
+Exit codes:
+  0  clean (table emitted / winner matches tools/autoshard_baseline.json)
+  1  validation failure or baseline drift
+  2  internal failure
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "autoshard_baseline.json")
+
+#: relative drift the --check gate allows on the recorded winner numbers
+#: (the model is deterministic; slack only absorbs cost-model tuning)
+CHECK_TOLERANCE = 0.10
+
+
+def _build_probe():
+    """The corpus' tiny-GPT train step on the dp x sharding x mp test
+    mesh — the same site ``train_step`` audits, searched instead."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    paddle.seed(0)
+    model = gpt_tiny(dropout=0.0, num_layers=2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    devs = np.array(jax.devices())
+    if devs.size >= 8:
+        mesh = Mesh(devs[:8].reshape(2, 2, 2), ("dp", "sharding", "mp"))
+    else:
+        mesh = Mesh(devs.reshape(devs.size), ("dp",))
+    return make_sharded_train_step(model, opt, mesh=mesh)
+
+
+def _run_search():
+    from paddle_tpu.autoshard import search as _search
+
+    probe = _build_probe()
+    return probe, _search.search_train_step(probe=probe)
+
+
+def _print_table(result) -> None:
+    print(f"autoshard: {len(result.ranked)} candidate(s) on "
+          f"{result.device_count} device(s), batch {result.batch_shape}, "
+          f"hw {result.hw_name}, search {result.search_seconds:.2f}s")
+    hdr = (f"{'#':>3} {'layout':32} {'floor_ms':>9} {'bind':>7} "
+           f"{'compute':>9} {'hbm':>9} {'ici':>9} {'wire_B/dev':>11} "
+           f"{'hbm_fit':>9} {'split':>5}")
+    print(hdr)
+    for rc in result.ranked:
+        r = rc.row()
+        f = r["floors_ms"]
+        tag = " (seed)" if r["seed"] else ""
+        print(f"{r['rank']:>3} {(r['layout'] + tag):32} "
+              f"{r['floor_ms']:>9.4f} {r['binding']:>7} "
+              f"{f.get('compute', 0.0):>9.4f} {f.get('hbm', 0.0):>9.4f} "
+              f"{f.get('ici', 0.0):>9.4f} "
+              f"{r['wire_bytes_per_device']:>11.0f} "
+              f"{r['hbm_fit_bytes']:>9} {r['compute_split']:>5}")
+    for name, reason in result.rejected:
+        print(f"  rejected {name}: {reason}")
+
+
+def _winner_record(result) -> dict:
+    w = result.winner.row()
+    return {
+        "layout": w["layout"],
+        "family": w["family"],
+        "floor_ms": w["floor_ms"],
+        "binding": w["binding"],
+        "wire_bytes_per_device": w["wire_bytes_per_device"],
+        "hbm_fit_bytes": w["hbm_fit_bytes"],
+        "predicted_families": w["predicted_families"],
+        "candidates": len(result.ranked),
+        "device_count": result.device_count,
+        "batch_shape": list(result.batch_shape),
+    }
+
+
+def _rel_drift(a: float, b: float) -> float:
+    if a == b:
+        return 0.0
+    return abs(a - b) / max(abs(a), abs(b), 1e-12)
+
+
+def _check(result, baseline_path: str, validations) -> int:
+    if not os.path.exists(baseline_path):
+        print(f"autoshard --check: no baseline at {baseline_path}; record "
+              "one with --update-baseline --reason '...'")
+        return 1
+    with open(baseline_path) as f:
+        baseline = json.load(f)
+    rec, cur = baseline.get("winner", {}), _winner_record(result)
+    failures = []
+    if rec.get("layout") != cur["layout"]:
+        failures.append(f"winner layout drifted: committed "
+                        f"{rec.get('layout')!r}, searched {cur['layout']!r}")
+    for key in ("floor_ms", "wire_bytes_per_device", "hbm_fit_bytes"):
+        d = _rel_drift(float(rec.get(key, 0.0)), float(cur[key]))
+        if d > CHECK_TOLERANCE:
+            failures.append(f"winner {key} drifted {d:.1%}: committed "
+                            f"{rec.get(key)}, searched {cur[key]}")
+    if rec.get("candidates") and len(result.ranked) < int(rec["candidates"]):
+        failures.append(f"candidate space shrank: committed "
+                        f"{rec['candidates']}, searched {len(result.ranked)}")
+    for v in validations:
+        if not v.ok:
+            failures.append(f"winner failed the HLO audit reconcile: "
+                            f"{json.dumps(v.as_dict())}")
+    if failures:
+        print(f"autoshard --check FAIL against {baseline_path}:")
+        for msg in failures:
+            print("  " + msg)
+        print("\nfix the layout/cost regression, or re-record with:\n"
+              "  python tools/autoshard.py --update-baseline --reason '...'")
+        return 1
+    print(f"autoshard --check: winner {cur['layout']!r} matches "
+          f"{baseline_path}" +
+          (" (hlo reconciled)" if validations else ""))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the ranked table as JSON on stdout")
+    ap.add_argument("--validate-top", type=int, metavar="K", default=0,
+                    help="compile the top-K layouts through hlo_audit and "
+                         "reconcile wire/HBM (slow: one compile per layout)")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: re-search and diff the winner against "
+                         "the committed baseline (+ audit it)")
+    ap.add_argument("--no-audit", action="store_true",
+                    help="with --check: skip the winner compile/audit and "
+                         "gate on the search table only")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="re-record the winning layout (needs --reason)")
+    ap.add_argument("--reason", default="",
+                    help="rationale recorded with --update-baseline")
+    ns = ap.parse_args(argv)
+
+    if ns.update_baseline and not ns.reason:
+        ap.error("--update-baseline requires --reason")
+
+    try:
+        probe, result = _run_search()
+    except Exception as e:  # noqa: BLE001 - tool boundary
+        print(f"autoshard: internal failure: {e!r}", file=sys.stderr)
+        return 2
+    if result.winner is None:
+        print("autoshard: no feasible candidate", file=sys.stderr)
+        return 2
+
+    k = ns.validate_top
+    if ns.check and not ns.no_audit and k <= 0:
+        k = 1  # the gate audits at least the winner
+    validations = []
+    if k > 0:
+        from paddle_tpu.autoshard import validate as _validate
+
+        validations = _validate.validate_top_k(result, probe, k=k)
+
+    if ns.as_json:
+        payload = result.as_dict()
+        if validations:
+            payload["validations"] = [v.as_dict() for v in validations]
+        print(json.dumps(payload, indent=2))
+    else:
+        _print_table(result)
+        for v in validations:
+            d = v.as_dict()
+            print(f"  validate {d['layout']}: ok={d['ok']} "
+                  f"unexplained={d['unexplained']} "
+                  f"wire pred/act={d['predicted_wire']:.0f}/"
+                  f"{d['actual_wire']} (ratio {d['wire_ratio']}) "
+                  f"hbm peak/fit={d['hbm_peak_bytes']}/"
+                  f"{d['hbm_fit_bytes']}"
+                  + (f" error={d['error']}" if d["error"] else ""))
+
+    if ns.update_baseline:
+        baseline = {"version": 1, "winner": _winner_record(result),
+                    "history": []}
+        if os.path.exists(ns.baseline):
+            with open(ns.baseline) as f:
+                old = json.load(f)
+            baseline["history"] = list(old.get("history", []))
+        baseline["history"].append({
+            "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "reason": ns.reason,
+            "winner": baseline["winner"]["layout"],
+            "floor_ms": baseline["winner"]["floor_ms"],
+        })
+        with open(ns.baseline, "w") as f:
+            json.dump(baseline, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"autoshard baseline updated -> {ns.baseline}")
+        return 0
+
+    if ns.check:
+        return _check(result, ns.baseline, validations)
+    if validations and not all(v.ok for v in validations):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
