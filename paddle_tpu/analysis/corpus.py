@@ -8,7 +8,8 @@ invariants the last eight PRs only enforced dynamically:
 - ``train_step_grad_reduce`` same, with the int8 quantized GradReducer
   inlined — its contract carries the reducer plan's own wire-byte
   accounting for the analyzer to reconcile against
-- ``serving_prefill`` / ``serving_decode``  the Engine's AOT programs,
+- ``serving_prefill`` / ``serving_decode`` / ``serving_verify``  the
+  Engine's AOT programs (verify = the speculative [B, k+1] decode step),
   with the KV-cache donation contract the engine compiles with
 - ``grad_reducer``          the standalone comm_opt tree reducer schedule
 - ``reshard``               a resharding executor body ((2,2)->(4,) move)
@@ -104,6 +105,11 @@ def _serving_specs() -> List[ProgramSpec]:
     # data — same one-compile + donation contract as the dense layout had
     pre_fn, pre_args = eng.prefill_program(8)
     dec_fn, dec_args = eng.decode_program()
+    # speculative verify-k: the decode step widened to [B, k+1] — same
+    # one-compile + donation contract; traced here WITHOUT enabling
+    # speculation on the engine (verify_program takes k explicitly), so
+    # building the corpus never compiles anything
+    ver_fn, ver_args = eng.verify_program(k=2)
     return [
         ProgramSpec("serving_prefill", pre_fn, pre_args, contract,
                     argnames=("params", "k_pages", "v_pages", "ids",
@@ -114,6 +120,11 @@ def _serving_specs() -> List[ProgramSpec]:
                               "tokens", "positions", "temps", "top_ks",
                               "greedy", "key"),
                     sharding=eng.sharding_contract(len(dec_args))),
+        ProgramSpec("serving_verify", ver_fn, ver_args, contract,
+                    argnames=("params", "k_pages", "v_pages", "page_table",
+                              "tokens", "positions", "temps", "top_ks",
+                              "greedy", "key"),
+                    sharding=eng.sharding_contract(len(ver_args))),
     ]
 
 

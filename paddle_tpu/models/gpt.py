@@ -238,18 +238,24 @@ class GPTAttention(Layer):
 
         if len(kv_cache) == 3:
             # block-paged cache: (k_pool, v_pool, page_table) — the table
-            # routes this slot's token to its page; the paged attend reads
+            # routes this slot's token(s) to pages; the paged attend reads
             # only live pages (serving/kv_cache.py dispatch: oracle einsum
-            # on CPU, Pallas ragged kernel on TPU)
+            # on CPU, Pallas ragged kernel on TPU). S is static: S=1 is the
+            # plain decode step, S>1 the multi-token extend (suffix prefill
+            # after a prefix-cache splice / speculative verify-k), where
+            # query t of row b sits at cache_positions[b] + t.
             kc, vc, table = kv_cache
 
             def _decode_paged(qv, kv_, vv, kcv, vcv, tblv, posv):
-                qT = qv.transpose(0, 2, 1, 3)   # [B, Hq, 1, D]
+                qT = qv.transpose(0, 2, 1, 3)   # [B, Hq, S, D]
                 kc2 = _kvc.paged_write_kv(kcv, kv_.transpose(0, 2, 1, 3),
                                           tblv, posv)
                 vc2 = _kvc.paged_write_kv(vcv, vv.transpose(0, 2, 1, 3),
                                           tblv, posv)
-                o = _kvc.paged_decode_attend(qT, kc2, vc2, tblv, posv)
+                if S == 1:
+                    o = _kvc.paged_decode_attend(qT, kc2, vc2, tblv, posv)
+                else:
+                    o = _kvc.paged_extend_attend(qT, kc2, vc2, tblv, posv)
                 return o.transpose(0, 2, 1, 3), kc2, vc2
 
             o, kc2, vc2 = apply("serving_decode_attn", _decode_paged, q, k,
@@ -258,6 +264,11 @@ class GPTAttention(Layer):
             out = o.reshape([B, S, cfg.hidden_size])
             return self.dropout(self.proj(out)), (kc2, vc2)
 
+        if S > 1:
+            raise NotImplementedError(
+                "multi-token cached decode (extend_step / speculative "
+                "verify) requires the paged KV layout; the dense cache "
+                "only decodes one token per step")
         kc, vc = kv_cache
 
         def _decode(qv, kv_, vv, kcv, vcv, posv):
@@ -650,6 +661,32 @@ class GPTForCausalLM(Layer):
                           kv_caches=caches, cache_positions=Tensor(pos))
         logits = self._logits(h)  # [B, 1, V]
         return Tensor(logits._value[:, -1]), new
+
+    def extend_step(self, tokens, kv_caches, positions):
+        """Multi-token cached decode: ``tokens`` ``[B, T]`` int ids where
+        row ``b``'s token ``t`` extends the cache at sequence position
+        ``positions[b] + t`` (``T`` is static — the speculative verify
+        width ``k+1``, or a suffix-prefill bucket after a prefix-cache
+        splice). Requires the paged cache layout. Returns
+        ``(logits [B, T, V], new_caches)`` — logits at EVERY position, so
+        the caller can read the model's next-token choice after each draft
+        token. Functionally pure like ``decode_step``; the engine compiles
+        one executable per static ``T``."""
+        from ..ops._dispatch import as_tensor
+
+        idv = as_tensor(tokens)._value
+        if idv.ndim == 1:
+            idv = idv[:, None]
+        B, T = idv.shape
+        pos = as_tensor(positions)._value.astype(jnp.int32)
+        if pos.ndim == 0:
+            pos = jnp.broadcast_to(pos, (B,))
+        qpos = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        position_ids = Tensor(jnp.clip(qpos, 0, self.cfg.max_seq_len - 1))
+        caches = [tuple(as_tensor(c) for c in entry) for entry in kv_caches]
+        h, new = self.gpt(Tensor(idv), position_ids=position_ids,
+                          kv_caches=caches, cache_positions=Tensor(pos))
+        return self._logits(h), new  # [B, T, V]
 
     def generate(self, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
                  temperature: float = 1.0, top_k: int = 0, eos_token_id=None):

@@ -21,6 +21,15 @@ Public surface:
   (``oracle`` | ``interpret`` | ``pallas``) for traces entered under it.
 - :func:`cached_generate` — the static-shape decode loop
   ``models.gpt.GPTForCausalLM.generate`` delegates to.
+- :class:`PrefixCache` — radix trie from block-aligned token prefixes to
+  physical page ids: cache-hit prompts splice shared (refcounted,
+  copy-on-write) pages and prefill only their suffix
+  (``EngineConfig(prefix_cache=True)``).
+- :class:`SpeculativeConfig` / :func:`propose_ngram` /
+  :func:`accept_greedy` — n-gram-draft speculative decoding over the
+  one-compile verify-k program (``EngineConfig(speculative=k)``).
+- :func:`extend_attend` / :func:`paged_extend_attend` — the multi-query
+  cached-attention primitives suffix prefill and verify ride on.
 
 See ``paddle_tpu/serving/README.md`` for the design and metric names.
 """
@@ -33,12 +42,15 @@ from .kv_cache import (  # noqa: F401
     KVCache,
     PagedKVCache,
     decode_attend,
+    extend_attend,
     paged_decode_attend,
+    paged_extend_attend,
     paged_gather,
     paged_write_kv,
     use_paged_attention_impl,
     write_kv,
 )
+from .prefix_cache import PrefixCache  # noqa: F401
 from .request_trace import (  # noqa: F401
     RequestTracer,
     SLOConfig,
@@ -47,6 +59,11 @@ from .request_trace import (  # noqa: F401
 )
 from .sampling import SamplingParams  # noqa: F401
 from .scheduler import PageAllocator, Request, Scheduler  # noqa: F401
+from .speculative import (  # noqa: F401
+    SpeculativeConfig,
+    accept_greedy,
+    propose_ngram,
+)
 
 __all__ = [
     "Engine",
@@ -55,16 +72,22 @@ __all__ = [
     "PAGE_SENTINEL",
     "PageAllocator",
     "PagedKVCache",
+    "PrefixCache",
     "Request",
     "RequestTracer",
     "SLOConfig",
     "SamplingParams",
     "Scheduler",
+    "SpeculativeConfig",
+    "accept_greedy",
     "cached_generate",
     "decode_attend",
+    "extend_attend",
     "paged_decode_attend",
+    "paged_extend_attend",
     "paged_gather",
     "paged_write_kv",
+    "propose_ngram",
     "read_request_traces",
     "request_trace_path",
     "use_paged_attention_impl",

@@ -44,9 +44,11 @@ from ..observability import metrics as _metrics
 from . import sampling as _sampling
 from .kv_cache import (KVCache, PAGE_SENTINEL, PagedKVCache,
                        use_paged_attention_impl)
+from .prefix_cache import PrefixCache
 from .request_trace import RequestTracer, SLOConfig
 from .sampling import SamplingParams
-from .scheduler import PageAllocator, Request, Scheduler
+from .scheduler import FINISHED, PageAllocator, Request, Scheduler
+from .speculative import SpeculativeConfig, accept_greedy, propose_ngram
 
 #: every serving executable takes (params, k_cache, v_cache, ...) and
 #: returns fresh caches its caller rebinds — so the KV cache args are
@@ -232,11 +234,39 @@ class EngineConfig:
     # paged-attend tier override for tests ("oracle"|"interpret"|"pallas");
     # None = pick by backend (kv_cache.default_paged_impl)
     paged_attention_impl: Optional[str] = None
+    # radix prefix cache (prefix_cache.py): finished prompts' full KV
+    # blocks stay indexed by token content, and a new request whose prompt
+    # shares a block-aligned prefix splices the SAME physical pages into
+    # its table (refcounted, copy-on-write) and prefills only the suffix.
+    # Requires the paged layout.
+    prefix_cache: bool = False
+    # speculative decoding (speculative.py): True / an int k / a
+    # SpeculativeConfig. When on, the engine's decode step is the verify-k
+    # program — [B, k+1] static shape, compiled ONCE at construction — fed
+    # by the n-gram draft proposer; greedy rows emit up to k+1 tokens per
+    # step with output identical to one-at-a-time greedy decode. Requires
+    # the paged layout.
+    speculative: Optional[Union[bool, int, "SpeculativeConfig"]] = None
 
     def __post_init__(self):
         if self.kv_layout not in ("paged", "dense"):
             raise ValueError(f"kv_layout {self.kv_layout!r}; "
                              "want 'paged' or 'dense'")
+        if isinstance(self.speculative, bool):
+            self.speculative = SpeculativeConfig() if self.speculative else None
+        elif isinstance(self.speculative, int):
+            self.speculative = SpeculativeConfig(k=int(self.speculative))
+        if (self.speculative is not None
+                and not isinstance(self.speculative, SpeculativeConfig)):
+            raise ValueError(
+                f"speculative={self.speculative!r}; want True, an int k, or "
+                "a SpeculativeConfig")
+        if ((self.prefix_cache or self.speculative is not None)
+                and self.kv_layout != "paged"):
+            raise ValueError(
+                "prefix_cache / speculative require kv_layout='paged' "
+                "(page-table splices and trash-routed draft writes have no "
+                "dense equivalent)")
         while self.page_size > 1 and self.max_seq_len % self.page_size:
             self.page_size //= 2
         if self.prefill_buckets is None:
@@ -321,6 +351,22 @@ class Engine:
         self._top_ks = np.zeros((B,), np.int32)
         self._greedy = np.ones((B,), bool)
         self._exe: Dict = {}
+        self.prefix_cache: Optional[PrefixCache] = None
+        if self.config.prefix_cache:
+            self.prefix_cache = PrefixCache(self.cache.page_size,
+                                            self.page_alloc)
+        self.spec: Optional[SpeculativeConfig] = self.config.speculative
+        # cumulative speculation accounting (greedy rows only — sampled
+        # rows ignore drafts and always emit 1 token from position 0)
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_emitted = 0
+        self._spec_slots = 0
+        if self.spec is not None:
+            # with speculation on, the verify-k program IS the engine's
+            # decode step — compile it here so the serving.decode lifetime
+            # compile count is sealed at exactly one
+            self._verify_exe()
 
     # -- weight management --
     def load_weights(self, params, shardings=None, allow_missing=False):
@@ -530,6 +576,90 @@ class Engine:
                 jnp.ones((B,), bool), _dummy_key())
         return decode_fn, args
 
+    def extend_program(self, T: int):
+        """(fn, example_args) for the T-token suffix prefill a prefix-cache
+        hit runs instead of a full prefill: the matched blocks' pages are
+        already spliced into the slot's table row, so only the suffix
+        (padded to bucket ``T``) flows through the forward — K/V scatter at
+        positions ``start..start+T-1`` through the SAME page-table routing
+        as decode (bucket padding past the allocated pages lands on the
+        trash page), attention covers cached prefix + suffix, and the last
+        real suffix token's logits come back for the first sampled token.
+        Paged layout only."""
+        if self.config.kv_layout != "paged":
+            raise ValueError("extend_program requires kv_layout='paged'")
+        model, L = self.model, self.cache.num_layers
+        nb = self.cache.num_blocks
+
+        @jax.named_scope("serving/extend")
+        def extend_fn(p, kc, vc, ids, page_row, start, length):
+            caches = [(kc[l], vc[l], page_row[None, :]) for l in range(L)]
+            with no_grad():
+                (logits, new), _ = model.functional_call(
+                    p, {}, Tensor(ids), caches, Tensor(start[None]),
+                    method="extend_step")
+            kc2 = jnp.stack([k._value for k, _ in new])
+            vc2 = jnp.stack([v._value for _, v in new])
+            lv = logits._value  # [1, T, V]
+            idx = jnp.clip(length - 1, 0, T - 1)
+            last = lax.dynamic_index_in_dim(lv[0], idx, keepdims=False)
+            return last[None], kc2, vc2  # [1, V], like prefill
+
+        args = (self.params, self.cache.k, self.cache.v,
+                jnp.zeros((1, T), jnp.int32), jnp.zeros((nb,), jnp.int32),
+                jnp.int32(0), jnp.int32(1))
+        return extend_fn, args
+
+    def verify_program(self, k: Optional[int] = None):
+        """(fn, example_args) for the speculative verify step — the decode
+        program widened to a static ``[B, k+1]`` token block: row ``b``
+        carries its pending token plus ``k`` n-gram drafts, the forward
+        writes their K/V at positions ``positions[b]..positions[b]+k``
+        (writes past the sequence budget route to the trash page) and
+        attends each with its own causal mask. Returns per-position argmax
+        targets ``[B, k+1]`` (the greedy acceptance oracle), a sampled
+        token from position 0 (what non-greedy rows emit), and the caches.
+        Rollback of rejected drafts costs nothing here: their K/V lies at
+        positions the NEXT verify step rewrites before any attend reads
+        them, so the host just advances positions by the accepted count.
+
+        ``k`` defaults to the engine's SpeculativeConfig; passing it
+        explicitly lets the analyzer trace the program on an engine without
+        speculation enabled (analysis/corpus.py's serving_verify entry)."""
+        if self.config.kv_layout != "paged":
+            raise ValueError("verify_program requires kv_layout='paged'")
+        if k is None:
+            if self.spec is None:
+                raise ValueError("verify_program(k=None) needs "
+                                 "EngineConfig(speculative=...)")
+            k = self.spec.k
+        model, L = self.model, self.cache.num_layers
+        B, nb = self.config.max_batch_size, self.cache.num_blocks
+
+        @jax.named_scope("serving/verify")
+        def verify_fn(p, kc, vc, page_table, tokens, positions, temps,
+                      top_ks, greedy, key):
+            caches = [(kc[l], vc[l], page_table) for l in range(L)]
+            with no_grad():
+                (logits, new), _ = model.functional_call(
+                    p, {}, Tensor(tokens), caches, Tensor(positions),
+                    method="extend_step")
+            kc2 = jnp.stack([kl._value for kl, _ in new])
+            vc2 = jnp.stack([vl._value for _, vl in new])
+            lv = logits._value  # [B, k+1, V]
+            targets = jnp.argmax(lv, axis=-1).astype(jnp.int32)
+            sampled0 = _sampling.sample_batched(lv[:, 0], key, temps,
+                                                top_ks, greedy)
+            return targets, sampled0.astype(jnp.int32), kc2, vc2
+
+        args = (self.params, self.cache.k, self.cache.v,
+                jnp.zeros((B, nb), jnp.int32),
+                jnp.zeros((B, k + 1), jnp.int32),
+                jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32),
+                jnp.zeros((B,), jnp.int32), jnp.ones((B,), bool),
+                _dummy_key())
+        return verify_fn, args
+
     def sharding_contract(self, nargs: int):
         """Tier-2 analysis declaration for the prefill/decode programs:
         the engine serves from device-local state, so every argument and
@@ -558,6 +688,21 @@ class Engine:
             return _aot(self._exe, ("decode",), "serving.decode", decode_fn,
                         args, donate_argnums=KV_DONATE_ARGNUMS)
 
+    def _extend_exe(self, T: int):
+        extend_fn, args = self.extend_program(T)
+        with use_paged_attention_impl(self.config.paged_attention_impl):
+            return _aot(self._exe, ("extend", T), "serving.prefill",
+                        extend_fn, args, donate_argnums=KV_DONATE_ARGNUMS)
+
+    def _verify_exe(self):
+        verify_fn, args = self.verify_program()
+        # the verify program REPLACES the plain decode step while
+        # speculation is on, so it accounts under the same serving.decode
+        # site — the one-compile-per-lifetime counter covers both modes
+        with use_paged_attention_impl(self.config.paged_attention_impl):
+            return _aot(self._exe, ("verify",), "serving.decode", verify_fn,
+                        args, donate_argnums=KV_DONATE_ARGNUMS)
+
     def _pages_needed(self, prompt_len: int) -> int:
         """Pages covering positions [0, prompt_len] — prompt plus the slot
         the first decode step writes into."""
@@ -571,31 +716,77 @@ class Engine:
             # slot IS the whole reservation)
             req = self.scheduler.waiting[0]
             n = len(req.prompt_ids)
+            owner = f"req{req.request_id}"
+            hit_blocks, hit_pages = 0, []
+            if self.prefix_cache is not None:
+                hit_blocks, hit_pages = self.prefix_cache.match(req.prompt_ids)
             pages = None
             if self.page_alloc is not None:
-                pages = self.page_alloc.alloc(self._pages_needed(n))
+                need = self._pages_needed(n) - hit_blocks
+                pages = self.page_alloc.alloc(need, owner=owner)
+                if pages is None and self.prefix_cache is not None:
+                    # pool short: reclaim cold cached prefixes, then retry
+                    self.prefix_cache.evict_lru(need)
+                    pages = self.page_alloc.alloc(need, owner=owner)
                 if pages is None:
                     break
             self.scheduler.next_waiting()  # pops the peeked head
             slot = self.cache.alloc_slot()
             req.slot = slot
-            if pages is not None:
-                self.cache.assign_pages(slot, pages)
-            sp = req.sampling
             t0 = time.perf_counter()
-            T = self._bucket(n)
-            ids = np.zeros((1, T), np.int32)
-            ids[0, :n] = req.prompt_ids
-            exe = self._prefill_exe(T)
-            if self.page_alloc is not None:
+            if pages is not None:
+                if hit_pages:
+                    # the SPLICE: this request becomes one more sharer of
+                    # the matched blocks' physical pages — a refcount bump
+                    # and a table-row write, no device work for the prefix
+                    self.page_alloc.retain(hit_pages, owner=owner)
+                    self.cache.assign_pages(slot, hit_pages)
+                    req.prefix_hit_blocks = hit_blocks
+                self.cache.assign_pages(slot, pages, start_block=hit_blocks)
+            if self.prefix_cache is not None:
+                if hit_blocks:
+                    _metrics.counter("serving.prefix.hits", 1)
+                    _metrics.histogram("serving.prefix.splice_seconds",
+                                       time.perf_counter() - t0)
+                else:
+                    _metrics.counter("serving.prefix.misses", 1)
+            sp = req.sampling
+            ps = self.cache.page_size if self.page_alloc is not None else 0
+            if hit_blocks:
+                # suffix-only prefill through the bucketed extend program
+                # (>= 1 token by construction: matching is capped at
+                # (n-1)//ps blocks)
+                start = hit_blocks * ps
+                m = n - start
+                T = self._bucket(m)
+                ids = np.zeros((1, T), np.int32)
+                ids[0, :m] = req.prompt_ids[start:]
+                exe = self._extend_exe(T)
                 logits, self.cache.k, self.cache.v = exe(
                     self.params, self.cache.k, self.cache.v,
                     jnp.asarray(ids), jnp.asarray(self.cache.page_table[slot]),
-                    jnp.int32(n))
+                    jnp.int32(start), jnp.int32(m))
             else:
-                logits, self.cache.k, self.cache.v = exe(
-                    self.params, self.cache.k, self.cache.v, jnp.asarray(ids),
-                    jnp.int32(slot), jnp.int32(n))
+                T = self._bucket(n)
+                ids = np.zeros((1, T), np.int32)
+                ids[0, :n] = req.prompt_ids
+                exe = self._prefill_exe(T)
+                if self.page_alloc is not None:
+                    logits, self.cache.k, self.cache.v = exe(
+                        self.params, self.cache.k, self.cache.v,
+                        jnp.asarray(ids),
+                        jnp.asarray(self.cache.page_table[slot]),
+                        jnp.int32(n))
+                else:
+                    logits, self.cache.k, self.cache.v = exe(
+                        self.params, self.cache.k, self.cache.v,
+                        jnp.asarray(ids), jnp.int32(slot), jnp.int32(n))
+            if self.prefix_cache is not None:
+                # index this prompt's FULL blocks (shared ones are already
+                # nodes; fresh ones take a trie-owned reference and become
+                # matchable the moment the next prompt agrees)
+                self.prefix_cache.insert(req.prompt_ids,
+                                         self.cache.slot_pages(slot)[:n // ps])
             key = _random.next_key() if sp.do_sample else _dummy_key()
             tok = int(np.asarray(_sampling.sample_static(
                 logits, key, do_sample=sp.do_sample,
@@ -616,25 +807,65 @@ class Engine:
             req.output_ids.append(tok)
             self._maybe_finish(req, tok)
 
-    def _grow_pages(self):
-        """Before a decode step, make sure every running slot has a page
-        mapped for the position it is about to write. A slot that can't
-        grow finishes ``cache_full`` (its generated prefix is intact) —
-        the pages it frees may already unblock the next waiting request."""
+    def _ensure_writable(self, slot: int, block: int, owner: str) -> bool:
+        """Copy-on-write guard: a slot about to WRITE ``block`` must own its
+        page exclusively. By construction the engine never maps a shared
+        page at a position it writes (prefix matching is capped below the
+        suffix, and decode/draft writes land strictly after the prompt),
+        so this is a defensive invariant-keeper — but if a shared page IS
+        in the write path, the slot gets a private byte-copy first and
+        drops its reference on the original, so the other sharers never
+        observe the write. False = no page free for the copy."""
+        page = int(self.cache.page_table[slot, block])
+        if page == PAGE_SENTINEL or not self.page_alloc.is_shared(page):
+            return True
+        fresh = self.page_alloc.alloc(1, owner=owner)
+        if fresh is None and self.prefix_cache is not None:
+            self.prefix_cache.evict_lru(1)
+            fresh = self.page_alloc.alloc(1, owner=owner)
+        if fresh is None:
+            return False
+        self.cache.copy_page(page, fresh[0])
+        self.cache.page_table[slot, block] = fresh[0]
+        self.page_alloc.free([page], owner=owner)
+        return True
+
+    def _grow_pages(self, width: int = 1):
+        """Before a decode step, make sure every running slot has private
+        writable pages mapped for the ``width`` positions it may write
+        (1 for plain decode, ``k+1`` for speculative verify — positions
+        past the sequence budget route to the trash page in-graph and need
+        no mapping). A slot that can't grow finishes ``cache_full`` (its
+        generated prefix is intact) — the pages it frees may already
+        unblock the next waiting request."""
+        ps, S_max = self.cache.page_size, self.config.max_seq_len
         for slot, st in enumerate(self._slots):
             req = st.request
             if req is None:
                 continue
-            block = int(self._positions[slot]) // self.cache.page_size
-            if self.cache.page_table[slot, block] != PAGE_SENTINEL:
-                continue
-            pages = self.page_alloc.alloc(1)
-            if pages is None:
+            owner = f"req{req.request_id}"
+            p = int(self._positions[slot])
+            last = min(p + width - 1, S_max - 1)
+            ok = True
+            for block in range(p // ps, last // ps + 1):
+                if self.cache.page_table[slot, block] == PAGE_SENTINEL:
+                    pages = self.page_alloc.alloc(1, owner=owner)
+                    if pages is None and self.prefix_cache is not None:
+                        self.prefix_cache.evict_lru(1)
+                        pages = self.page_alloc.alloc(1, owner=owner)
+                    if pages is None:
+                        ok = False
+                        break
+                    self.cache.assign_pages(slot, pages, start_block=block)
+                elif not self._ensure_writable(slot, block, owner):
+                    ok = False
+                    break
+            if not ok:
                 self._finish(req, "cache_full")
-                continue
-            self.cache.assign_pages(slot, pages, start_block=block)
 
     def _decode(self):
+        if self.spec is not None:
+            return self._decode_speculative()
         if self.page_alloc is not None:
             self._grow_pages()
         running = [s.request for s in self._slots if s.request is not None]
@@ -672,6 +903,81 @@ class Engine:
                 self.tracer.on_decode_step(req, step_s)
             self._maybe_finish(req, tok)
 
+    def _decode_speculative(self):
+        """One verify-k step for every running slot: propose ``k`` n-gram
+        drafts per row, run the ONE verify executable over the static
+        ``[B, k+1]`` block, then settle per row on the host — greedy rows
+        keep the longest draft prefix the model's argmax agrees with plus
+        the model's own token at the divergence (1..k+1 tokens, exactly
+        the one-at-a-time greedy stream), sampled rows emit position 0's
+        sampled token. Rejected drafts cost nothing: their K/V sits at
+        positions the next verify step overwrites before attending, so
+        rollback is just NOT advancing ``_positions`` past the kept
+        tokens."""
+        spec = self.spec
+        k = spec.k
+        self._grow_pages(width=k + 1)
+        running = [s.request for s in self._slots if s.request is not None]
+        if not running:
+            return
+        t0 = time.perf_counter()
+        B = self.config.max_batch_size
+        block = np.zeros((B, k + 1), np.int32)
+        drafts: Dict[int, List[int]] = {}
+        for req in running:
+            slot = req.slot
+            d = propose_ngram(req.prompt_ids + req.output_ids, k, spec.ngram)
+            drafts[slot] = d
+            block[slot, 0] = self._tokens[slot]
+            block[slot, 1:] = d
+        any_sampled = not bool(self._greedy.all())
+        key = _random.next_key() if any_sampled else _dummy_key()
+        exe = self._verify_exe()
+        targets, sampled0, self.cache.k, self.cache.v = exe(
+            self.params, self.cache.k, self.cache.v,
+            self.cache.table_device(), jnp.asarray(block),
+            jnp.asarray(self._positions), jnp.asarray(self._temps),
+            jnp.asarray(self._top_ks), jnp.asarray(self._greedy), key)
+        targets = np.asarray(targets)
+        sampled0 = np.asarray(sampled0)
+        step_s = time.perf_counter() - t0
+        _metrics.histogram("serving.decode.step.seconds", step_s)
+        emitted_total = 0
+        drafted_now = accepted_now = 0
+        for req in running:
+            slot = req.slot
+            if self._greedy[slot]:
+                a, emitted = accept_greedy(drafts[slot], targets[slot])
+                req.draft_tokens += k
+                req.accepted_tokens += a
+                drafted_now += k
+                accepted_now += a
+                self._spec_slots += k + 1
+                self._spec_emitted += len(emitted)
+            else:
+                emitted = [int(sampled0[slot])]
+            for tok in emitted:
+                tok = int(tok)
+                req.output_ids.append(tok)
+                self._tokens[slot] = tok
+                self._positions[slot] += 1
+                emitted_total += 1
+                self._maybe_finish(req, tok)
+                if req.state == FINISHED:
+                    break
+            self.scheduler.observe_decode_step(req, step_s)
+            if self.tracer is not None:
+                self.tracer.on_decode_step(req, step_s)
+        self._spec_drafted += drafted_now
+        self._spec_accepted += accepted_now
+        _metrics.counter("serving.tokens.generated", emitted_total)
+        if drafted_now:
+            _metrics.counter("serving.spec.draft_tokens", drafted_now)
+            _metrics.counter("serving.spec.accepted_tokens", accepted_now)
+        if self._spec_slots:
+            _metrics.gauge("serving.spec.accept_rate",
+                           self._spec_emitted / self._spec_slots)
+
     def _maybe_finish(self, req: Request, tok: int):
         sp = req.sampling
         reason = None
@@ -697,8 +1003,12 @@ class Engine:
         self._top_ks[slot] = 0
         self._greedy[slot] = True
         if self.page_alloc is not None:
-            # every page the slot mapped goes back to the pool — the
-            # allocator raises on double-free, so leaks/corruption can't
-            # pass silently
-            self.page_alloc.free(self.cache.clear_slot(slot))
+            # drop this request's reference on every page its slot mapped —
+            # pages the prefix cache (or another sharer) still references
+            # stay live; the rest return to the pool. The allocator raises
+            # on double-free (naming page ids and owners), so leaks and
+            # corruption can't pass silently. clear_slot is idempotent: a
+            # second call returns [] and frees nothing.
+            self.page_alloc.free(self.cache.clear_slot(slot),
+                                 owner=f"req{req.request_id}")
         self.cache.free_slot(slot)

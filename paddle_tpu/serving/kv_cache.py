@@ -194,16 +194,29 @@ def use_paged_attention_impl(impl: Optional[str]):
 
 
 def paged_write_kv(pool, new, page_table, positions):
-    """Scatter one token's K (or V) per slot into a ``[P, H_kv, ps, D]``
-    page pool: row ``b`` of ``new [B, H_kv, 1, D]`` lands in page
-    ``page_table[b, positions[b] // ps]`` at offset ``positions[b] % ps``.
-    Sentinel entries clamp to the trash page (slots without a live request
-    all write identical token-0 state there, so the race is benign)."""
+    """Scatter ``T`` tokens' K (or V) per slot into a ``[P, H_kv, ps, D]``
+    page pool: token ``t`` of row ``b`` of ``new [B, H_kv, T, D]`` lands in
+    page ``page_table[b, (positions[b]+t) // ps]`` at offset
+    ``(positions[b]+t) % ps``. ``T`` is static (1 for plain decode, ``k+1``
+    for speculative verify) so the scatters unroll at trace time. Sentinel
+    entries clamp to the trash page (slots without a live request all write
+    identical token-0 state there, so the race is benign), and writes past
+    the table's capacity ``num_blocks * ps`` route to the trash page too —
+    a verify step near the end of a sequence can draft past ``S_max``
+    without going out of bounds; the host caps how many of those tokens it
+    accepts."""
     ps = pool.shape[2]
+    nb = page_table.shape[1]
     pos = jnp.asarray(positions)
-    B = new.shape[0]
-    pages = jnp.maximum(page_table[jnp.arange(B), pos // ps], 0)
-    return pool.at[pages, :, pos % ps, :].set(new[:, :, 0, :].astype(pool.dtype))
+    B, T = new.shape[0], new.shape[2]
+    new = new.astype(pool.dtype)
+    for t in range(T):
+        p = pos + t
+        block = jnp.minimum(p // ps, nb - 1)
+        pages = jnp.maximum(page_table[jnp.arange(B), block], 0)
+        pages = jnp.where(p < nb * ps, pages, 0)
+        pool = pool.at[pages, :, p % ps, :].set(new[:, :, t, :])
+    return pool
 
 
 def paged_gather(pool, page_table):
@@ -234,6 +247,45 @@ def paged_decode_attend(q, k_pool, v_pool, page_table, positions,
 
     return paged_attention(q, k_pool, v_pool, page_table, positions,
                            interpret=(impl == "interpret"))
+
+
+def extend_attend(q, k_cache, v_cache, positions):
+    """Multi-query cached attention: q ``[B, H_q, T, D]`` where query ``t``
+    of row ``b`` sits at absolute position ``positions[b] + t`` and may
+    attend to ``key_pos <= positions[b] + t`` — the suffix-prefill /
+    speculative-verify generalization of ``decode_attend`` (T=1 reduces to
+    it exactly). Same _sdpa_ref numerics: q pre-scaled in its own dtype,
+    f32 scores, -1e30 mask, f32 softmax."""
+    D = q.shape[-1]
+    rep = q.shape[1] // k_cache.shape[1]
+    k = _expand_kv_heads(k_cache, rep)
+    v = _expand_kv_heads(v_cache, rep)
+    qf = q * jnp.asarray(1.0 / np.sqrt(D), q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, k,
+                   preferred_element_type=jnp.float32)
+    T = q.shape[2]
+    qpos = jnp.asarray(positions)[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    key_pos = jnp.arange(k_cache.shape[2])
+    valid = key_pos[None, None, None, :] <= qpos[:, None, :, None]
+    s = jnp.where(valid, s, _NEG_INF)
+    probs = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def paged_extend_attend(q, k_pool, v_pool, page_table, positions,
+                        impl: Optional[str] = None):
+    """Multi-query cached attention over block-paged pools — the paged twin
+    of ``extend_attend``. The Pallas ragged kernel is single-query, so ALL
+    impl tiers currently reconstruct the dense view (``paged_gather``) and
+    run the einsum path; the ``impl`` argument is accepted so call sites
+    stay uniform with ``paged_decode_attend`` and a future multi-query
+    kernel can slot in without touching them. Verify steps are rare next
+    to decode steps (one per k+1 emitted tokens), so the gather cost is
+    amortized."""
+    del impl  # single implementation today; see docstring
+    k = paged_gather(k_pool, page_table)
+    v = paged_gather(v_pool, page_table)
+    return extend_attend(q, k, v, positions)
 
 
 class PagedKVCache:
@@ -296,6 +348,15 @@ class PagedKVCache:
     def assign_pages(self, slot: int, pages: List[int], start_block: int = 0):
         for j, p in enumerate(pages):
             self.page_table[slot, start_block + j] = p
+
+    def copy_page(self, src: int, dst: int):
+        """Copy-on-write: duplicate page ``src``'s bytes into page ``dst``
+        across every layer of both pools (one sliced device update per
+        pool). The caller then repoints its table entry at ``dst`` and
+        drops its reference on ``src`` — the sharer still mapping ``src``
+        never observes the write that motivated the copy."""
+        self.k = self.k.at[:, dst].set(self.k[:, src])
+        self.v = self.v.at[:, dst].set(self.v[:, src])
 
     def slot_pages(self, slot: int) -> List[int]:
         row = self.page_table[slot]

@@ -1,0 +1,192 @@
+"""Radix prefix cache: block-granular KV reuse across requests.
+
+Serving traffic from many users repeats itself — system prompts, few-shot
+preambles, multi-turn histories. With the KV cache block-paged (PR 13),
+that repetition has a physical unit: two requests whose prompts agree on
+the first ``page_size * b`` tokens can map the SAME ``b`` physical pages
+and prefill only the differing suffix. This module is the index that finds
+the agreement: a radix trie keyed on page-sized token blocks whose nodes
+hold page ids (the SGLang RadixAttention idea, reduced to the static-shape
+engine's host-side page table).
+
+Sharing is safe because of two invariants enforced elsewhere:
+
+* ``PageAllocator`` refcounts pages — the trie holds one reference per
+  cached node, every splice adds one per shared page, and a page returns
+  to the free list only when its LAST reference drops (scheduler.py).
+* The engine never writes a shared page: matching is FULL blocks only and
+  capped at ``(len(prompt) - 1) // page_size``, so the suffix prefill is
+  always >= 1 token and starts exactly at a block boundary; decode then
+  appends strictly after the prompt. A defensive copy-on-write hook
+  (``Engine._ensure_writable`` + ``PagedKVCache.copy_page``) backs the
+  invariant up: any write that WOULD land on a shared page gets a private
+  copy first.
+
+Eviction is LRU over trie leaves: releasing a leaf drops only the trie's
+reference, so a page still spliced into a live request survives eviction
+and is reclaimed when that request finishes.
+
+Flag-gated metrics: the engine counts ``serving.prefix.hits`` /
+``serving.prefix.misses`` per ADMISSION (a blocked head request peeks the
+trie every step; counting in ``match`` would inflate hits), and this
+module gauges ``serving.prefix.pages_shared`` — how many physical pages
+currently have more than one reference.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..observability import metrics as _metrics
+from .scheduler import PageAllocator
+
+_OWNER = "prefix-cache"
+
+
+class _Node:
+    """One cached block: ``key`` (its page_size-token tuple, kept for
+    repr/debugging), the physical ``page`` holding that block's K/V, and an
+    LRU stamp. Children are keyed by the NEXT block's token tuple."""
+
+    __slots__ = ("key", "page", "last_used", "children", "parent")
+
+    def __init__(self, key: Tuple[int, ...], page: int, parent: "_Node"):
+        self.key = key
+        self.page = page
+        self.last_used = 0
+        self.children: Dict[Tuple[int, ...], _Node] = {}
+        self.parent = parent
+
+
+class PrefixCache:
+    """Radix/trie index from block-aligned token prefixes to page ids.
+
+    The trie owns one allocator reference per node (taken at ``insert``,
+    dropped at eviction/``clear``); callers own their own references per
+    splice (``match`` returns page ids, the engine ``retain``s them for the
+    admitted slot). Block granularity means partial-block matches are
+    ignored — a block is shareable only if ALL ``page_size`` of its tokens
+    match, which is exactly the unit the page table can splice.
+    """
+
+    def __init__(self, page_size: int, allocator: PageAllocator):
+        if page_size < 1:
+            raise ValueError(f"page_size {page_size}")
+        self.page_size = page_size
+        self.allocator = allocator
+        self._root = _Node((), -1, None)  # sentinel; holds no page
+        self._clock = itertools.count(1)
+        self.num_nodes = 0
+
+    # ------------------------------------------------------------- lookup
+
+    def _blocks(self, tokens: Sequence[int]) -> List[Tuple[int, ...]]:
+        ps = self.page_size
+        nfull = len(tokens) // ps
+        return [tuple(int(t) for t in tokens[j * ps:(j + 1) * ps])
+                for j in range(nfull)]
+
+    def match(self, prompt: Sequence[int]) -> Tuple[int, List[int]]:
+        """Longest shareable prefix of ``prompt`` already in the cache:
+        ``(hit_blocks, pages)`` where ``pages[j]`` backs block ``j``.
+
+        Capped at ``(len(prompt) - 1) // page_size`` blocks — when the
+        prompt is block-aligned and FULLY cached, the last block is
+        deliberately left to the suffix prefill so the engine always has
+        >= 1 suffix token to run (the prefill programs produce the first
+        token's logits) and never maps a shared page it would write.
+        """
+        cap = max(0, (len(prompt) - 1) // self.page_size)
+        node, pages = self._root, []
+        stamp = next(self._clock)
+        for key in self._blocks(prompt)[:cap]:
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.last_used = stamp
+            pages.append(child.page)
+            node = child
+        return len(pages), pages
+
+    # ------------------------------------------------------------- insert
+
+    def insert(self, prompt: Sequence[int], pages: Sequence[int]) -> int:
+        """Record that ``pages[j]`` holds block ``j`` of ``prompt``'s K/V.
+        Blocks already present keep their existing page (the inserting
+        request's duplicate stays private to it and frees at its finish);
+        new nodes take a trie-owned reference on their page. Returns the
+        number of NEW nodes created."""
+        blocks = self._blocks(prompt)
+        n = min(len(blocks), len(pages))
+        node, created = self._root, 0
+        stamp = next(self._clock)
+        for j in range(n):
+            key = blocks[j]
+            child = node.children.get(key)
+            if child is None:
+                page = int(pages[j])
+                self.allocator.retain([page], owner=_OWNER)
+                child = _Node(key, page, node)
+                node.children[key] = child
+                self.num_nodes += 1
+                created += 1
+            child.last_used = stamp
+            node = child
+        self._export_gauges()
+        return created
+
+    # ----------------------------------------------------------- eviction
+
+    def _leaves(self) -> List[_Node]:
+        out, stack = [], list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            else:
+                out.append(n)
+        return out
+
+    def _evict_node(self, node: _Node):
+        del node.parent.children[node.key]
+        self.num_nodes -= 1
+        self.allocator.free([node.page], owner=_OWNER)
+
+    def evict_lru(self, need_free: int) -> int:
+        """Release least-recently-used leaves until the allocator has
+        ``need_free`` free pages or nothing evictable remains. Evicting a
+        node drops only the TRIE's reference — a page still mapped by a
+        live request stays allocated until that request finishes — so this
+        keeps going past still-shared pages. Returns nodes evicted."""
+        evicted = 0
+        while self.allocator.num_free < need_free:
+            leaves = self._leaves()
+            if not leaves:
+                break
+            self._evict_node(min(leaves, key=lambda n: n.last_used))
+            evicted += 1
+        if evicted:
+            self._export_gauges()
+        return evicted
+
+    def clear(self) -> int:
+        """Drop every node (and the trie's page references). Pages spliced
+        into live requests stay allocated; everything else returns to the
+        free list. Returns nodes dropped."""
+        dropped = 0
+        for leaf in sorted(self._leaves(), key=lambda n: -n.last_used):
+            node = leaf
+            while node is not self._root and not node.children:
+                parent = node.parent
+                self._evict_node(node)
+                dropped += 1
+                node = parent
+        self._export_gauges()
+        return dropped
+
+    def _export_gauges(self):
+        if not _metrics.enabled():
+            return
+        _metrics.gauge("serving.prefix.pages_shared",
+                       self.allocator.num_shared)

@@ -191,6 +191,14 @@ class RequestTracer:
             "prompt_tokens": tr["prompt_tokens"],
             "generated_tokens": req.num_generated,
             "finish_reason": req.finish_reason,
+            # serving-tier attribution: how much of TTFT the prefix cache
+            # saved (blocks spliced instead of prefilled) and how much of
+            # the decode the verifier batched (drafted vs accepted). Old
+            # readers ignore the extra keys; read_request_traces tolerates
+            # old-schema lines without them.
+            "prefix_hit_blocks": int(getattr(req, "prefix_hit_blocks", 0)),
+            "draft_tokens": int(getattr(req, "draft_tokens", 0)),
+            "accepted_tokens": int(getattr(req, "accepted_tokens", 0)),
             "ttft_s": round(first - t0, 6),
             "tpot_s": round(tpot, 6) if tpot is not None else None,
             "spans": spans,

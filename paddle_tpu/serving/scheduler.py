@@ -13,7 +13,7 @@ from __future__ import annotations
 import itertools
 import time
 from collections import deque
-from typing import Deque, List, Optional
+from typing import Deque, Dict, List, Optional
 
 from ..observability import metrics as _metrics
 from .sampling import SamplingParams
@@ -40,6 +40,11 @@ class Request:
         self.state = QUEUED
         self.finish_reason: Optional[str] = None
         self.slot: Optional[int] = None
+        # serving-tier bookkeeping (prefix cache / speculative decoding);
+        # rides into the request-trace records for TTFT attribution
+        self.prefix_hit_blocks = 0
+        self.draft_tokens = 0
+        self.accepted_tokens = 0
         # timing (host clocks; feed the ttft/tpot histograms)
         self.arrival_time = time.perf_counter()
         self.first_token_time: Optional[float] = None
@@ -56,7 +61,7 @@ class Request:
 
 
 class PageAllocator:
-    """Free-list allocator over the paged KV cache's page pool.
+    """Refcounted free-list allocator over the paged KV cache's page pool.
 
     Page ids run ``[1, num_pages)`` — page 0 is the reserved trash page
     that sentinel table entries clamp to (kv_cache.PAGE_SENTINEL) and is
@@ -64,8 +69,16 @@ class PageAllocator:
     every page it asked for or the pool state is untouched and the caller
     backpressures (leaves the request queued / finishes it ``cache_full``).
     Double-allocation and double-free are hard errors, not best-effort —
-    the exact-cover invariant (every page is free XOR allocated) is what
-    tests/test_paged_kv.py pins.
+    the exact-cover invariant (every page is free XOR referenced, and a
+    page returns to the free list exactly when its last reference drops)
+    is what tests/test_paged_kv.py and tests/test_prefix_spec.py pin.
+
+    Copy-on-write sharing rides the refcounts: the prefix cache ``retain``s
+    a page per sharer (trie leaf, each splice), each sharer ``free``s its
+    own reference at finish, and the page stays live until the count hits
+    zero. A writer must never touch a page with ``is_shared()`` true — it
+    allocates a private copy first (PagedKVCache.copy_page) and frees its
+    reference on the shared original.
 
     Occupancy is exported through ``serving.kv.pages.{allocated,free}`` and
     ``serving.kv.page_utilization`` when FLAGS_observability is on.
@@ -77,7 +90,8 @@ class PageAllocator:
         self.num_pages = num_pages
         # pop() from the tail hands out the lowest free id first
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
-        self._allocated = set()
+        self._refs: Dict[int, int] = {}
+        self._owners: Dict[int, List[str]] = {}
         self._export_gauges()
 
     @property
@@ -90,38 +104,78 @@ class PageAllocator:
 
     @property
     def num_allocated(self) -> int:
-        return len(self._allocated)
+        return len(self._refs)
 
-    def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` fresh page ids, or None (pool unchanged) if fewer than
-        ``n`` are free."""
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
+    def is_shared(self, page: int) -> bool:
+        return self._refs.get(page, 0) > 1
+
+    @property
+    def num_shared(self) -> int:
+        return sum(1 for c in self._refs.values() if c > 1)
+
+    def alloc(self, n: int, owner: Optional[str] = None) -> Optional[List[int]]:
+        """``n`` fresh page ids at refcount 1, or None (pool unchanged) if
+        fewer than ``n`` are free. ``owner`` is a debug label (slot/request)
+        echoed back by double-free errors."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
-        self._allocated.update(pages)
+        for p in pages:
+            self._refs[p] = 1
+            self._owners[p] = [owner] if owner is not None else []
         self._export_gauges()
         return pages
 
-    def free(self, pages: List[int]):
+    def retain(self, pages: List[int], owner: Optional[str] = None):
+        """Add one reference per page (a new sharer of already-live pages —
+        a prefix-cache splice or trie insertion). Retaining a page that was
+        never handed out is the same class of bug as double-free."""
         for p in pages:
-            if p not in self._allocated:
+            if p not in self._refs:
                 raise ValueError(
-                    f"free of page {p} which is not allocated (double-free "
-                    "or never handed out)")
-            self._allocated.remove(p)
-            self._free.append(p)
+                    f"retain of page {p} which is not allocated"
+                    + (f" (by {owner})" if owner is not None else ""))
+        for p in pages:
+            self._refs[p] += 1
+            if owner is not None:
+                self._owners[p].append(owner)
+        self._export_gauges()
+
+    def free(self, pages: List[int], owner: Optional[str] = None):
+        """Drop one reference per page; a page rejoins the free list only
+        when its last reference goes. Freeing an unreferenced page raises
+        with the full offender list and the owners on record, so a
+        double-free names who it collided with instead of just failing."""
+        bad = [p for p in pages if p not in self._refs]
+        if bad:
+            known = {p: list(self._owners.get(p, [])) for p in bad}
+            raise ValueError(
+                f"free of page(s) {bad} not allocated (double-free "
+                f"or never handed out); freed by {owner!r}, last known "
+                f"owners: {known}")
+        for p in pages:
+            self._refs[p] -= 1
+            if owner is not None and owner in self._owners[p]:
+                self._owners[p].remove(owner)
+            if self._refs[p] == 0:
+                del self._refs[p]
+                del self._owners[p]
+                self._free.append(p)
         self._free.sort(reverse=True)
         self._export_gauges()
 
     def _export_gauges(self):
         if not _metrics.enabled():
             return
-        _metrics.gauge("serving.kv.pages.allocated", len(self._allocated))
+        _metrics.gauge("serving.kv.pages.allocated", len(self._refs))
         _metrics.gauge("serving.kv.pages.free", len(self._free))
         _metrics.gauge("serving.kv.page_utilization",
-                       len(self._allocated) / max(1, self.num_allocatable))
+                       len(self._refs) / max(1, self.num_allocatable))
 
 
 class Scheduler:

@@ -1,0 +1,91 @@
+"""Speculative decoding: n-gram drafts verified k-at-a-time, zero recompiles.
+
+The static-shape decode core makes classic speculative decoding almost
+free on the TPU side: a "verify" program that scores ``k+1`` positions per
+slot is just the decode program widened to a static ``[B, k+1]`` token
+block — compiled ONCE at engine construction, gated by the analyzer corpus
+(``serving_verify``) like every other executable. What this module owns is
+the HOST half: proposing drafts and deciding how many verified tokens to
+keep.
+
+Drafts come from prompt-lookup / n-gram matching (Saxena's "prompt lookup
+decoding", the draft-model-free scheme): find the most recent earlier
+occurrence of the last ``ngram`` context tokens and propose whatever
+followed it. No extra parameters, no second model, and on the repetitive
+traffic serving actually sees (code, few-shot scaffolds, multi-turn chat)
+acceptance is high; on incompressible text it degrades to ~1 token/step —
+never below the non-speculative rate, because the verify program's
+position-0 logits always yield one guaranteed-correct token.
+
+Greedy acceptance keeps OUTPUT EXACTNESS: token ``j`` of the draft is
+accepted iff it equals the argmax the model produced at position ``j-1``
+of the verify block; the first rejection is replaced by that argmax
+(the "bonus" token). By induction the emitted stream is token-identical
+to one-at-a-time greedy decode — pinned by tests/test_prefix_spec.py.
+Rejection costs NOTHING on device: rolled-back draft K/V lies at
+positions the next verify step rewrites before any attend reads them, so
+rollback is pure host position arithmetic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class SpeculativeConfig:
+    """``k``: draft tokens verified per step (verify block is ``k+1`` wide).
+    ``ngram``: longest context suffix the proposer tries to match (it backs
+    off to shorter matches, then to repeating the last token)."""
+    k: int = 3
+    ngram: int = 3
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"speculative k must be >= 1, got {self.k}")
+        if self.ngram < 1:
+            raise ValueError(f"ngram must be >= 1, got {self.ngram}")
+
+
+def propose_ngram(context: Sequence[int], k: int, ngram: int) -> List[int]:
+    """``k`` draft tokens for ``context`` by prompt lookup: the longest
+    suffix (length <= ``ngram``) that recurs earlier in the context
+    nominates its continuation; repeats of the last token pad or fall back
+    when nothing matches (a cheap always-valid draft — worst case it is
+    simply rejected). Always returns exactly ``k`` tokens."""
+    ctx = [int(t) for t in context]
+    n = len(ctx)
+    for g in range(min(ngram, n - 1), 0, -1):
+        suffix = ctx[n - g:]
+        # most recent earlier occurrence wins (recency beats frequency for
+        # locally-repetitive text)
+        for i in range(n - g - 1, -1, -1):
+            if ctx[i:i + g] == suffix:
+                cont = ctx[i + g:i + g + k]
+                if cont:
+                    while len(cont) < k:
+                        cont.append(cont[-1])
+                    return cont
+                break  # suffix only recurs at the very end; try shorter g
+    return [ctx[-1]] * k if ctx else [0] * k
+
+
+def accept_greedy(drafts: Sequence[int],
+                  greedy_targets: Sequence[int]) -> Tuple[int, List[int]]:
+    """Greedy acceptance: ``drafts`` is the ``k`` proposed tokens,
+    ``greedy_targets[j]`` the model's argmax at verify position ``j``
+    (i.e. its next-token choice after seeing everything up to and
+    including verify input ``j``). Returns ``(accepted, emitted)`` where
+    ``emitted`` is the accepted prefix plus the model's own token at the
+    first divergence — between 1 and ``k+1`` tokens, always exactly what
+    one-at-a-time greedy decode would have produced."""
+    a = 0
+    emitted: List[int] = []
+    for j, d in enumerate(drafts):
+        if int(d) != int(greedy_targets[j]):
+            break
+        emitted.append(int(d))
+        a += 1
+    emitted.append(int(greedy_targets[a]))
+    return a, emitted

@@ -67,7 +67,8 @@ def test_corpus_covers_real_entry_points(corpus_report):
     specs, _, _ = corpus_report
     names = {s.name for s in specs}
     assert {"train_step", "serving_prefill", "serving_decode",
-            "grad_reducer", "reshard", "ir_optimized"} <= names
+            "serving_verify", "grad_reducer", "reshard",
+            "ir_optimized"} <= names
 
 
 def test_corpus_clean_against_committed_baseline(corpus_report):
@@ -124,7 +125,8 @@ def test_sharding_contracts_declared_on_spmd_sites(corpus_report):
     specs, _, _ = corpus_report
     by_name = {s.name: s for s in specs}
     for name in ("train_step", "train_step_grad_reduce", "grad_reducer",
-                 "reshard", "serving_prefill", "serving_decode"):
+                 "reshard", "serving_prefill", "serving_decode",
+                 "serving_verify"):
         assert by_name[name].sharding is not None, name
 
 

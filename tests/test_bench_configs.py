@@ -261,6 +261,26 @@ def test_bench_serving_row_contract(capsys):
     assert cap["tokens_per_request"] > 0
     assert cap["dense"] > 0
     assert cap["paged"] > cap["dense"]
+    # prefix sharing (ISSUE 19 acceptance): splicing the common prefix's
+    # pages once must admit strictly more concurrent requests than the
+    # private-pages paged baseline at the same HBM budget
+    assert cap["shared_prefix_blocks"] >= 1
+    assert cap["paged_prefix_shared"] > cap["paged"]
+    # cached-prefix TTFT: a hit (splice + suffix prefill through a smaller
+    # bucket) must beat a cold full prefill of the same prompt
+    px = parsed["prefix_cache"]
+    assert px["hit_blocks"] >= 1
+    assert 0 < px["shared_prefix_tokens"] < px["prompt_tokens"]
+    assert 0 < px["ttft_ms"]["hit"] < px["ttft_ms"]["miss"]
+    # speculative decoding: accepted-tokens-per-step rides the row, the
+    # accept rate (emitted / verify slots) is a true rate in (0, 1] — its
+    # floor is 1/(k+1), the guaranteed bonus token per verify step
+    spec = parsed["speculative"]
+    assert spec["k"] >= 1
+    assert 0 <= spec["accepted_tokens"] <= spec["draft_tokens"]
+    assert spec["accepted_tokens_per_step"] >= 0.0
+    assert 1.0 <= spec["tokens_per_step"] <= spec["k"] + 1
+    assert 0.0 < spec["accept_rate"] <= 1.0
 
 
 def test_bench_elastic_row_contract(capsys):
