@@ -1043,7 +1043,14 @@ def bench_comm():
     bytes-on-wire is an identity, not a measurement. The comm.* rows in
     the telemetry sub-object are the row's contract; the headline
     acceptance is compression_ratio >= 3.5 (int8 block-128 is 4 /
-    (1 + 4/128) ~= 3.88x over fp32)."""
+    (1 + 4/128) ~= 3.88x over fp32).
+
+    Two sub-rows ride along: "hybrid" times the two-region reducer on a
+    dp x mp mesh (the model axis stays GSPMD-auto around the reduce; one
+    independent compressed reduction per mp shard, acceptance
+    compression_ratio >= 3.0), and "moe_dispatch" reports the compressed
+    MoE token-exchange accounting quant vs raw on a dp x ep mesh
+    (incubate .../moe/dispatch.py, same >= 3.0 floor)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding
@@ -1137,6 +1144,93 @@ def bench_comm():
             reduce_ms = (time.perf_counter() - t0) / reps * 1e3
             mesh_note = "1 device (plan estimated at dp=8)"
 
+        # --- dp x mp hybrid sub-row: the two-region reducer ---
+        gcfg = comm_opt.GradReduceConfig(mode="quant", dtype="int8")
+        if world >= 4:
+            hdp, hmp = world // 2, 2
+            hmesh = Mesh(devs.reshape(hdp, hmp), ("dp", "mp"))
+            hred = comm_opt.reducer_for_step(gcfg, hmesh, ("dp",), templates)
+            hf = comm_opt.make_tree_reducer(hred)
+            gstack_h = {k: jax.device_put(
+                            rng.randn(hdp, *shp).astype(np.float32),
+                            NamedSharding(hmesh, hred.stack_spec(k)))
+                        for k, (shp, _d) in templates.items()}
+            ef_h = {k: jax.device_put(v, s) for (k, v), s in
+                    zip(hred.init_ef().items(),
+                        hred.ef_shardings().values())}
+            outh, ef_h = hf(gstack_h, ef_h)  # compile
+            jax.block_until_ready(outh)
+            reps_h = 5
+            t0 = time.perf_counter()
+            for _i in range(reps_h):
+                outh, ef_h = hf(gstack_h, ef_h)
+            jax.block_until_ready(outh)
+            h_ms = (time.perf_counter() - t0) / reps_h * 1e3
+            hplan, h_note = hred.plan, f"dp={hdp} x mp={hmp}"
+        else:
+            # too few devices for a real mp axis: report the plan alone
+            h_ms = None
+            hplan = comm_opt.build_plan(
+                {k: shp for k, (shp, _d) in templates.items()},
+                {"dp": 4}, gcfg, group_axes={"mp": 2})
+            h_note = f"{world} device(s) (plan estimated at dp=4 x mp=2)"
+        hybrid = {
+            "mesh": h_note,
+            "reduce_ms": round(h_ms, 3) if h_ms is not None else None,
+            "groups": hplan.groups,
+            "bytes_wire_per_reduction": hplan.bytes_wire_per_step,
+            "bytes_raw_per_reduction": hplan.bytes_raw_per_step,
+            "compression_ratio": round(hplan.compression_ratio, 4),
+        }
+
+        # --- MoE dispatch sub-row: compressed token exchanges quant vs
+        # raw (static receive-side accounting, like the grad rows) ---
+        from paddle_tpu.distributed import mesh as dist_mesh
+        from paddle_tpu.incubate.distributed.models.moe.dispatch import (
+            plan_quant_dispatch)
+        from paddle_tpu.kernels.quant import fit_block_size
+
+        n_experts = 8
+        T = bsz * seq
+        mcap = max(1, int(1.25 * T / n_experts))
+        ep = 1
+        while (ep * 2 <= min(world, n_experts)
+               and world % (ep * 2) == 0 and n_experts % (ep * 2) == 0):
+            ep *= 2
+        if ep > 1:
+            mmesh = Mesh(devs.reshape(world // ep, ep), ("dp", "ep"))
+            prev = dist_mesh.current_mesh()
+            dist_mesh.set_global_mesh(mmesh)
+            try:
+                mplan = plan_quant_dispatch(T, n_experts, mcap,
+                                            cfg.hidden_size)
+            finally:
+                if prev is not None:
+                    dist_mesh.set_global_mesh(prev)
+                else:
+                    dist_mesh.reset_global_mesh()
+            moe = {
+                "mesh": f"dp={world // ep} x ep={ep}",
+                "experts": n_experts,
+                "capacity": mcap,
+                "block": mplan.block,
+                "bytes_wire_per_step": mplan.bytes_wire_train_step,
+                "bytes_raw_per_step": 2 * mplan.bytes_raw,
+                "compression_ratio": round(mplan.compression_ratio, 4),
+            }
+        else:
+            # no ep exchange on this host: the wire-format ratio alone
+            blk = fit_block_size(cfg.hidden_size, 128)
+            moe = {
+                "mesh": f"{world} device(s) (no ep axis; format ratio only)",
+                "experts": n_experts,
+                "capacity": mcap,
+                "block": blk,
+                "bytes_wire_per_step": None,
+                "bytes_raw_per_step": None,
+                "compression_ratio": round(4.0 / (1.0 + 4.0 / blk), 4),
+            }
+
         reductions = step._reductions_per_step
         out = {
             "config": "comm",
@@ -1150,6 +1244,8 @@ def bench_comm():
             "compression_ratio": round(plan.compression_ratio, 4),
             "mesh": mesh_note,
             "buckets": len(plan.buckets),
+            "hybrid": hybrid,
+            "moe_dispatch": moe,
             "note": f"GPT {_n_params(model)/1e6:.1f}M params, B={bsz} "
                     f"S={seq}, grad_reduce=int8, {len(plan.stages)} stages",
             "telemetry": observability.snapshot(),

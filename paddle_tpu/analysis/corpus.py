@@ -8,6 +8,9 @@ invariants the last eight PRs only enforced dynamically:
 - ``train_step_grad_reduce`` same, with the int8 quantized GradReducer
   inlined — its contract carries the reducer plan's own wire-byte
   accounting for the analyzer to reconcile against
+- ``train_step_moe``        GPT-MoE step on a dp x ep mesh with
+  ``moe_dispatch="quant"`` — the token exchanges are explicit int8
+  all-to-alls whose DispatchPlan accounting the analyzer reconciles
 - ``serving_prefill`` / ``serving_decode`` / ``serving_verify``  the
   Engine's AOT programs (verify = the speculative [B, k+1] decode step),
   with the KV-cache donation contract the engine compiles with
@@ -85,6 +88,60 @@ def _train_step_grad_reduce_spec() -> ProgramSpec:
             # ReducePlan counts per-device receive-side bytes per step —
             # the analyzer's own convention, so no rescaling
             expected_wire_bytes=st._reducer.plan.bytes_wire_per_step),
+        argnames=_STEP_ARGNAMES, sharding=st.sharding_contract())
+
+
+def _train_step_moe_spec() -> ProgramSpec:
+    """GPT-MoE train step on a dp x ep mesh with moe_dispatch='quant': the
+    token dispatch/combine exchanges are explicit block-scaled int8
+    all-to-alls (incubate .../moe/dispatch.py), so the site carries the
+    DispatchPlan's own wire accounting for the analyzer to reconcile —
+    the only jaxpr-level collectives in the program are the quantized
+    exchanges (grads stay on GSPMD's implicit path)."""
+    import paddle_tpu as paddle
+    from ..distributed import mesh as _mesh
+    from ..distributed.fleet.utils import make_sharded_train_step
+    from ..incubate.distributed.models.moe.dispatch import plan_quant_dispatch
+    from ..models import gpt_moe_tiny
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs[:8]).reshape(2, 4), ("dp", "ep"))
+    prev = _mesh.current_mesh()
+    _mesh.set_global_mesh(mesh)  # moe_route resolves its plan from here
+    try:
+        paddle.seed(0)
+        model = gpt_moe_tiny(dropout=0.0, moe_dispatch="quant")
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        st = make_sharded_train_step(model, opt, mesh=mesh)
+        args = _step_args(st, 2 * mesh.size)
+        # one MoE block (every_k=2 over 2 layers); T = batch * seq
+        T = int(args[4].shape[0] * args[4].shape[1])
+        E = model.cfg.moe_num_experts
+        cap = max(1, int(model.cfg.moe_capacity_factor * T / E))
+        plan = plan_quant_dispatch(T, E, cap, model.cfg.hidden_size)
+        if plan is None:
+            raise RuntimeError("quant dispatch plan inactive on this mesh")
+    finally:
+        if prev is not None:
+            _mesh.set_global_mesh(prev)
+        else:
+            _mesh.reset_global_mesh()
+
+    step_fn = st._compiled_step_fn
+
+    def fn(*a):
+        # the analyzer traces lazily, after the builder restored the global
+        # mesh — re-enter the mesh context so moe_route resolves the quant
+        # plan exactly as the product step does (utils.py traces under
+        # jax.set_mesh(self.mesh) too)
+        with jax.set_mesh(mesh):
+            return step_fn(*a)
+
+    return ProgramSpec(
+        "train_step_moe", fn, args,
+        SiteContract(one_compile=True, donate_argnums=(0, 1, 2, 3),
+                     expected_wire_bytes=plan.bytes_wire_train_step),
         argnames=_STEP_ARGNAMES, sharding=st.sharding_contract())
 
 
@@ -195,6 +252,7 @@ def build_corpus() -> Tuple[List[ProgramSpec], List[Tuple[str, str]]]:
     builders = [
         ("train_step", 1, _train_step_spec),
         ("train_step_grad_reduce", 2, _train_step_grad_reduce_spec),
+        ("train_step_moe", 8, _train_step_moe_spec),
         ("serving", 1, _serving_specs),
         ("grad_reducer", 2, _grad_reducer_spec),
         ("reshard", 4, _reshard_spec),

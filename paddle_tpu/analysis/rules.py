@@ -489,8 +489,11 @@ RULE_CATALOG = {
                  "declared ShardingContract"),
     # tier 2 — ambient (recorded at configuration time, findings.py)
     "comm-quant-downgrade": (
-        "warning", "quantized grad-reduce silently downgraded to fp32 "
-                   "psum on a hybrid mesh"),
+        "warning", "quantized grad-reduce silently downgraded to the "
+                   "implicit fp32 all-reduce (active pp/sep axes)"),
+    "moe-dispatch-downgrade": (
+        "warning", "moe_dispatch='quant' silently fell back to dense "
+                   "routing (full-precision token exchanges)"),
     # tier 2 — hlo audit reconcile (hlo_audit.py; advisory)
     "spmd-predict-divergence": (
         "info", "partitioned HLO carries collective traffic the static "

@@ -6,8 +6,9 @@ gradient collectives with error feedback, selected by ShardedTrainStep's
 loads them without jax); reduce is the jax execution layer.
 """
 
-from .config import (DATA_AXES, GradReduceConfig,  # noqa: F401
-                     from_fleet_strategy, normalize_grad_reduce)
+from .config import (DATA_AXES, QUANT_COMPATIBLE_AXES,  # noqa: F401
+                     GradReduceConfig, from_fleet_strategy,
+                     normalize_grad_reduce)
 from .plan import ReducePlan, build_plan, describe, plan_as_dict  # noqa: F401
 from .reduce import (GradReducer, make_tree_reducer,  # noqa: F401
                      record_reduce_metrics, reducer_for_step)

@@ -17,6 +17,15 @@ from typing import Optional, Tuple
 DATA_AXES = ("dp", "sharding", "ep")
 DEFAULT_AXIS_ORDER = ("sharding", "ep", "dp")
 
+#: Non-data mesh axes the hybrid reducer can reduce AROUND: each model
+#: shard's data-axis device group runs the schedule independently while
+#: traffic over these axes is left to GSPMD. Tensor/model parallelism
+#: (`mp`) and a non-batch `sharding` (fsdp weight-shard) axis qualify;
+#: `pp`/`sep` do not — their stages nest shard_maps of their own, which
+#: the reduce region cannot wrap. (Distinct from distributed.mesh's
+#: HYBRID_AXES, which lists the fleet mesh axis ORDER.)
+QUANT_COMPATIBLE_AXES = ("mp", "sharding")
+
 _MODES = ("off", "fp32", "quant")
 _DTYPES = ("int8", "bf16")
 

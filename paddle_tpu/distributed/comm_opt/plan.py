@@ -9,6 +9,14 @@ All byte counts are PER DEVICE PER REDUCTION, using the receive-side
 convention (what lands on each chip's ICI links). The fp32 baseline uses
 the same stage structure at 4 B/value, so `compression_ratio` is exactly
 the wire-format ratio (~3.88x for int8 block 128, 2x for bf16).
+
+On hybrid meshes the reduction runs independently inside each model
+shard's data-axis device group (HiCCL-style composition: compress within
+the dp group, leave mp traffic untouched). The caller then passes the
+LOCAL (model-shard) leaf shapes plus ``groups`` = the number of
+concurrent groups; per-device numbers keep their meaning unchanged and
+the group/global aggregates come from the ``bytes_*_group/global``
+properties.
 """
 
 from __future__ import annotations
@@ -35,7 +43,7 @@ class LeafSlot:
 class Bucket:
     index: int
     leaves: Tuple[LeafSlot, ...]
-    length: int         # sum of leaf sizes
+    length: int         # packed length (leaf sizes + alignment gaps)
     padded_length: int  # rounded up to world * granule
 
 
@@ -61,6 +69,11 @@ class ReducePlan:
     bytes_raw_per_step: int
     bytes_wire_per_step: int
     compression_ratio: float
+    #: independent reduction groups running this schedule concurrently
+    #: (one per model shard on hybrid meshes); 1 on pure-data meshes
+    groups: int = 1
+    #: the model axes that slice the mesh into groups, (name, size)
+    group_axes: Tuple[Tuple[str, int], ...] = ()
 
     @property
     def total_elements(self) -> int:
@@ -70,12 +83,39 @@ class ReducePlan:
     def padded_elements(self) -> int:
         return sum(b.padded_length for b in self.buckets)
 
+    @property
+    def bytes_wire_group_per_step(self) -> int:
+        """Wire bytes summed over ONE group's devices per reduction."""
+        return self.bytes_wire_per_step * self.world
 
-def _build_buckets(leaves, world: int, granule: int,
-                   bucket_bytes: int) -> Tuple[Bucket, ...]:
+    @property
+    def bytes_raw_group_per_step(self) -> int:
+        return self.bytes_raw_per_step * self.world
+
+    @property
+    def bytes_wire_global_per_step(self) -> int:
+        """Wire bytes summed over every device on the mesh (all groups)."""
+        return self.bytes_wire_group_per_step * self.groups
+
+    @property
+    def bytes_raw_global_per_step(self) -> int:
+        return self.bytes_raw_group_per_step * self.groups
+
+
+def _build_buckets(leaves, world: int, granule: int, bucket_bytes: int,
+                   leaf_align: int = 1) -> Tuple[Bucket, ...]:
     """Name-sorted greedy packing: deterministic across processes (every
-    rank must flatten identically) and insensitive to dict order."""
+    rank must flatten identically) and insensitive to dict order.
+
+    ``leaf_align`` > 1 starts every leaf on that boundary (zero-filled
+    gaps). Hybrid quantized plans NEED block-aligned leaves: each model
+    shard's group quantizes its own bucket, and a scale block spanning a
+    group-REPLICATED leaf and a group-local (model-sharded) one would get
+    group-dependent scales — the "replicated" reduced grad then differs
+    per group and the replicas silently drift apart over steps.
+    """
     align = max(world, 1) * max(granule, 1)
+    la = max(int(leaf_align), 1)
     items = sorted((str(n), tuple(int(d) for d in shape))
                    for n, shape in leaves)
     buckets: List[Bucket] = []
@@ -92,10 +132,12 @@ def _build_buckets(leaves, world: int, granule: int,
 
     for name, shape in items:
         size = int(math.prod(shape)) if shape else 1
-        if cur and (cur_len + size) * 4 > bucket_bytes:
+        offset = -(-cur_len // la) * la
+        if cur and (offset + size) * 4 > bucket_bytes:
             flush()
-        cur.append(LeafSlot(name, shape, size, cur_len))
-        cur_len += size
+            offset = 0
+        cur.append(LeafSlot(name, shape, size, offset))
+        cur_len = offset + size
     flush()
     return tuple(buckets)
 
@@ -132,9 +174,13 @@ def _stage_volumes(padded_lengths: Sequence[int],
 
 
 def build_plan(leaves, mesh_axes: Dict[str, int],
-               config: GradReduceConfig) -> ReducePlan:
+               config: GradReduceConfig,
+               group_axes: Dict[str, int] = None) -> ReducePlan:
     """leaves: {name: shape} or [(name, shape)]; mesh_axes: {axis: size}
-    restricted by the caller to the data axes the reduction runs over."""
+    restricted by the caller to the data axes the reduction runs over.
+    group_axes: {axis: size} of the model axes slicing the mesh into
+    independent reduction groups (hybrid meshes) — leaves must then be
+    the LOCAL per-model-shard shapes."""
     if isinstance(leaves, dict):
         leaves = list(leaves.items())
     order = config.resolved_axis_order(tuple(mesh_axes))
@@ -142,7 +188,13 @@ def build_plan(leaves, mesh_axes: Dict[str, int],
                  if int(mesh_axes.get(a, 1)) > 1)
     world = math.prod(n for _, n in axes) if axes else 1
     granule = config.block_size if config.quantized and config.dtype == "int8" else 1
-    buckets = _build_buckets(leaves, world, granule, config.bucket_bytes)
+    gaxes = tuple((a, int(n)) for a, n in (group_axes or {}).items()
+                  if int(n) > 1)
+    # hybrid + block-scaled: leaves must own whole scale blocks (see
+    # _build_buckets) so group-replicated leaves quantize identically
+    # in every group
+    buckets = _build_buckets(leaves, world, granule, config.bucket_bytes,
+                             leaf_align=granule if gaxes else 1)
 
     wire_cost = config.wire_bytes_per_value
     stages = tuple(
@@ -158,6 +210,8 @@ def build_plan(leaves, mesh_axes: Dict[str, int],
         buckets=buckets, stages=stages,
         bytes_raw_per_step=raw, bytes_wire_per_step=wire,
         compression_ratio=4.0 / wire_cost,
+        groups=math.prod(n for _, n in gaxes) if gaxes else 1,
+        group_axes=gaxes,
     )
 
 
@@ -170,6 +224,11 @@ def describe(plan: ReducePlan) -> str:
                  f"hierarchical={cfg.hierarchical} overlap={cfg.overlap}")
     ax = " x ".join(f"{a}={n}" for a, n in plan.axes) or "(single device)"
     lines.append(f"reduction axes: {ax}  (world={plan.world})")
+    if plan.groups > 1:
+        gx = " x ".join(f"{a}={n}" for a, n in plan.group_axes)
+        lines.append(f"hybrid groups: {plan.groups} independent "
+                     f"{plan.world}-device groups (model axes {gx}); "
+                     "leaf shapes below are per-model-shard LOCAL shapes")
     lines.append(f"buckets: {len(plan.buckets)} "
                  f"(<= {cfg.bucket_bytes / 2**20:.1f} MiB raw each, "
                  f"align {plan.world}*{plan.granule})")
@@ -190,6 +249,13 @@ def describe(plan: ReducePlan) -> str:
             f"total: {plan.bytes_raw_per_step / 2**20:.2f} MiB raw -> "
             f"{plan.bytes_wire_per_step / 2**20:.2f} MiB wire  "
             f"(compression {plan.compression_ratio:.2f}x)")
+        if plan.groups > 1:
+            lines.append(
+                f"group-local wire: "
+                f"{plan.bytes_wire_group_per_step / 2**20:.2f} MiB "
+                f"({plan.world} devices/group); global wire: "
+                f"{plan.bytes_wire_global_per_step / 2**20:.2f} MiB "
+                f"over {plan.groups} groups")
     else:
         lines.append("no collective stages (world=1); format compression "
                      f"{plan.compression_ratio:.2f}x")
@@ -224,4 +290,8 @@ def plan_as_dict(plan: ReducePlan) -> dict:
         "bytes_raw_per_step": plan.bytes_raw_per_step,
         "bytes_wire_per_step": plan.bytes_wire_per_step,
         "compression_ratio": round(plan.compression_ratio, 4),
+        "groups": plan.groups,
+        "group_axes": [[a, n] for a, n in plan.group_axes],
+        "bytes_wire_group_per_step": plan.bytes_wire_group_per_step,
+        "bytes_wire_global_per_step": plan.bytes_wire_global_per_step,
     }

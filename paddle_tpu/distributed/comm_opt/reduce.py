@@ -9,25 +9,35 @@ explicit per-bucket schedule the step controls (EQuARX/HiCCL shape):
   -> quantize the owned shard once -> all_gather payload+scales
      back up the axes in reverse -> dequant -> unflatten.
 
-`reduce_local` normally runs INSIDE a fully-manual shard_map region
-(every mesh axis named manual). That is a hard constraint on this
-jax/XLA build: partial-auto shard_map (manual over the data axes while
-mp/pp stay auto) compiles psum but ABORTS the process in the SPMD
-partitioner for psum_scatter/all_to_all. `reducer_for_step` therefore
-activates the full quantized/hierarchical path only when every non-data
-mesh axis has degree 1 — the dp/sharding(/ep) topologies where the grad
-reduce dominates.
+`reduce_local` runs INSIDE a shard_map region that names every axis it
+reduces over manual. A hard constraint on this jax/XLA build shapes how
+that region is hosted: partial-auto shard_map (manual over the data axes
+while mp/pp stay auto) compiles psum but ABORTS the process in the SPMD
+partitioner for psum_scatter/all_to_all/all_gather. On pure-data meshes
+(every non-data axis degree 1) the step hosts one fully-manual region
+and everything — quant, hierarchical, EF — runs inside it.
 
-Hybrid meshes (active model-parallel axes, e.g. dp x mp) get the HYBRID
-reducer instead of the old warn-and-fall-back: the step hosts the region
-as a partial-auto shard_map manual over only the data axes
-(`manual_axes`), mp stays auto/GSPMD, and the reduction is restricted to
-the one collective that survives partial-auto — a single flat fp32 psum
-per bucket over the data-axis tuple, i.e. an explicit mean over the data
-replicas within each model shard. Quant/hierarchical requests downgrade
-(with a warning) and error feedback is off. Pipeline/expert-style axes
-still fall back to implicit GSPMD: their stages nest shard_maps of their
-own, which the hybrid region cannot wrap.
+Hybrid meshes (active model-parallel axes, e.g. dp x mp or
+dp x sharding x mp) split by mode:
+
+- mode="fp32": one partial-auto region manual over the data axes only
+  (`manual_axes`), mp stays auto/GSPMD, and each model shard takes a
+  single flat fp32 psum per bucket over the data-axis tuple — psum is
+  the one collective that survives partial-auto.
+- mode="quant": a TWO-REGION schedule (`two_region`). Region A is the
+  same partial-auto fwd/bwd region, but instead of reducing it emits the
+  per-data-rank local grads stacked on a leading data axis. The step
+  pins each stacked leaf to its model-parallel layout
+  (`with_sharding_constraint`) and hands it to `reduce_stacked`: a
+  fully-manual region over ALL mesh axes where the model axes are
+  manual-but-inactive, so the existing quantize -> all_to_all -> dequant
+  -> sum chain runs independently inside each model shard's data-axis
+  group (HiCCL composition: compress within the dp group, leave mp
+  traffic untouched). Error feedback stays on — residual rows become
+  per-device over the whole mesh (see below).
+
+Pipeline/expert-style axes still fall back to implicit GSPMD: their
+stages nest shard_maps of their own, which neither region can wrap.
 
 Error-feedback semantics (EF14/DGC): each device keeps an f32 residual per
 bucket, in LOCAL-GRADIENT units, added to its local gradient before
@@ -35,6 +45,12 @@ compression on the next step. Stage-k compression errors enter the total
 sum with weight 1 (so they are stored 1:1); the final broadcast error is
 in mean units and is stored scaled by `world`. Residuals are train state:
 they ride in TrainState.extra and are donated through the compiled step.
+On pure-data meshes a bucket's residual is [world, padded] rows sharded
+over the data axes; on hybrid meshes it is [world * groups, padded_local]
+— one row per device over data axes THEN model axes (`ef_axes`), with
+padded_local laid out from the model-shard-local leaf shapes — and it
+survives checkpoint/elastic restore through the same `ef_matches` shape
+test as today.
 """
 
 from __future__ import annotations
@@ -50,17 +66,11 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...kernels.quant import dequantize_block_scaled, quantize_block_scaled
-from .config import GradReduceConfig
+from .config import QUANT_COMPATIBLE_AXES, GradReduceConfig
 from .plan import ReducePlan, build_plan
 
 __all__ = ["GradReducer", "reducer_for_step", "make_tree_reducer",
-           "HYBRID_AXES"]
-
-#: Non-data mesh axes the hybrid (partial-auto) reducer can leave to
-#: GSPMD. Tensor/model parallelism is plain within-layer GSPMD sharding;
-#: pp/sep stages nest their own shard_maps, which the hybrid region
-#: cannot wrap on this build.
-HYBRID_AXES = ("mp",)
+           "QUANT_COMPATIBLE_AXES"]
 
 
 def _axis_index(ax):
@@ -85,22 +95,36 @@ class GradReducer:
 
     def __init__(self, config: GradReduceConfig, mesh: Mesh,
                  templates: Dict[str, Tuple[Tuple[int, ...], np.dtype]],
-                 data_axes: Tuple[str, ...], hybrid: bool = False):
-        if hybrid and (config.quantized or config.hierarchical):
-            # hybrid regions are partial-auto shard_map: psum compiles
-            # there but psum_scatter/all_to_all abort the process (module
-            # docstring), so the hybrid reducer is always one flat fp32
-            # psum per bucket
-            config = _replace(config, mode="fp32", hierarchical=False)
+                 data_axes: Tuple[str, ...], hybrid: bool = False,
+                 grad_specs: Optional[Dict[str, Tuple]] = None):
+        if hybrid and not config.quantized and config.hierarchical:
+            # the fp32 hybrid region is partial-auto shard_map: psum
+            # compiles there but psum_scatter/all_to_all abort the
+            # process (module docstring), so it is always one flat fp32
+            # psum per bucket. The quant hybrid path avoids the problem
+            # structurally (two_region) and keeps its configuration.
+            config = _replace(config, hierarchical=False)
         self.hybrid = bool(hybrid)
         self.config = config
         self.mesh = mesh
         self.data_axes = tuple(data_axes)
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        self.model_axes: Tuple[str, ...] = tuple(
+            a for a in mesh.axis_names
+            if a not in self.data_axes and sizes[a] > 1) if hybrid else ()
+        # per-leaf partition entries over the MODEL axes (two_region
+        # only): the leaf's grad layout minus any data-axis placement,
+        # used to localize plan shapes and to pin region-B in/out specs
+        self._grad_specs: Dict[str, Tuple] = {}
+        shapes = {n: shape for n, (shape, _) in templates.items()}
+        if self.two_region:
+            shapes = {n: self._localize(n, shape, grad_specs)
+                      for n, shape in shapes.items()}
         self.plan: ReducePlan = build_plan(
-            {n: shape for n, (shape, _) in templates.items()},
-            {a: sizes[a] for a in self.data_axes}, config)
+            shapes, {a: sizes[a] for a in self.data_axes}, config,
+            group_axes={a: sizes[a] for a in self.model_axes})
         self.world = self.plan.world
+        self.groups = self.plan.groups
         self._dtypes = {n: jnp.dtype(dt) for n, (_, dt) in templates.items()}
         # phase-1 reduction stages: per-axis (hierarchical) or one flat
         # stage over the combined axis tuple
@@ -110,27 +134,88 @@ class GradReducer:
         else:
             self._stages = [(tuple(a for a, _ in axes), self.world)]
 
+    def _localize(self, name, shape, grad_specs):
+        """Model-shard-local leaf shape: each dim divided by the degree
+        of the model axes its grad spec entry names (data-axis entries
+        are dropped — the reduce treats each leaf whole across the data
+        axes, exactly like the fully-manual path). Records the retained
+        entries in _grad_specs for the region-B specs."""
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        raw = tuple((grad_specs or {}).get(name) or ())
+        entries, local = [], []
+        for i, d in enumerate(shape):
+            e = raw[i] if i < len(raw) else None
+            names = e if isinstance(e, tuple) else ((e,) if e else ())
+            kept = tuple(a for a in names if a in self.model_axes)
+            deg = int(np.prod([sizes[a] for a in kept], dtype=np.int64)) \
+                if kept else 1
+            if d % deg:
+                raise ValueError(
+                    f"grad leaf {name!r} dim {i} ({d}) not divisible by "
+                    f"its model-axis shard degree {deg} ({kept})")
+            entries.append(kept if len(kept) > 1 else
+                           (kept[0] if kept else None))
+            local.append(d // deg)
+        while entries and entries[-1] is None:
+            entries.pop()
+        self._grad_specs[name] = tuple(entries)
+        return tuple(local)
+
+    @property
+    def two_region(self) -> bool:
+        """Whether the step must host the A/B two-region schedule
+        (partial-auto fwd/bwd emitting stacked grads + `reduce_stacked`)
+        instead of reducing inline via `reduce_local`."""
+        return self.hybrid and self.config.quantized
+
     @property
     def manual_axes(self) -> Tuple[str, ...]:
-        """Mesh axes the hosting shard_map must name manual: every axis
-        for the fully-manual path, only the data axes for hybrid (model
-        axes stay auto so GSPMD keeps partitioning the fwd/bwd)."""
+        """Mesh axes the step's fwd/bwd shard_map must name manual: every
+        axis for the fully-manual path, only the data axes for hybrid
+        (model axes stay auto so GSPMD keeps partitioning the fwd/bwd)."""
         return self.data_axes if self.hybrid else tuple(self.mesh.axis_names)
+
+    @property
+    def reduce_axes(self) -> Tuple[str, ...]:
+        """Mesh axes manual in the region hosting `reduce_local`: the
+        data axes for the fp32 hybrid (the reduce runs inline in the
+        partial-auto fwd/bwd region), ALL axes otherwise (fully-manual —
+        model axes manual-but-inactive for two_region)."""
+        if self.hybrid and not self.config.quantized:
+            return self.data_axes
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def ef_axes(self) -> Tuple[str, ...]:
+        """Axis tuple the EF row dimension is sharded over (row = one
+        device: data axes, then model axes on hybrid meshes)."""
+        return self.data_axes + self.model_axes
+
+    def stack_spec(self, name: str) -> P:
+        """Region-B in_spec for one stacked grad leaf [world, *shape]:
+        data-axis stack on dim 0, then the leaf's model-axis layout."""
+        return P(self.data_axes, *self._grad_specs.get(name, ()))
+
+    def leaf_spec(self, name: str) -> P:
+        """Region-B out_spec for one reduced leaf: the model-axis layout
+        alone (the result is replicated over the data axes)."""
+        return P(*self._grad_specs.get(name, ()))
 
     def sharding_contract(self, gstack_keys, ef_keys=()):
         """Tier-2 analysis declaration for ``make_tree_reducer``'s
-        (gstack, ef) -> (reduced, new_ef) program: stacked grads and
-        residuals row-sharded over the data axes in, reduced tree
-        replicated out — exactly the shard_map's in/out specs, so a spec
-        drift there trips spmd-contract-mismatch."""
+        (gstack, ef) -> (reduced, new_ef) program: stacked grads row-
+        sharded over the data axes (plus each leaf's model-axis layout on
+        hybrid meshes) in, reduced tree data-replicated out, residuals
+        row-sharded per device — exactly the shard_map's in/out specs, so
+        a spec drift there trips spmd-contract-mismatch."""
         from ...analysis.sharding_flow import ShardingContract
 
-        dax = self.data_axes
+        efx = self.ef_axes
         return ShardingContract(
-            in_shardings=({k: P(dax) for k in gstack_keys},
-                          {k: P(dax) for k in ef_keys}),
-            out_shardings=({k: P() for k in gstack_keys},
-                           {k: P(dax) for k in ef_keys}),
+            in_shardings=({k: self.stack_spec(k) for k in gstack_keys},
+                          {k: P(efx) for k in ef_keys}),
+            out_shardings=({k: self.leaf_spec(k) for k in gstack_keys},
+                           {k: P(efx) for k in ef_keys}),
             mesh=self.mesh)
 
     # ---------------- error-feedback state ----------------
@@ -143,19 +228,21 @@ class GradReducer:
         return f"bucket{bucket_index:03d}"
 
     def init_ef(self) -> Dict[str, jnp.ndarray]:
-        """Zero residuals, one [world, padded_length] f32 array per bucket
-        (row i = device i's residual; sharded over the data axes)."""
+        """Zero residuals, one [world * groups, padded_length] f32 array
+        per bucket (row i = device i's residual; sharded over ef_axes —
+        groups=1 and ef_axes=data_axes on pure-data meshes)."""
         if not self.has_ef:
             return {}
-        return {self._ef_key(b.index): np.zeros((self.world, b.padded_length),
-                                                np.float32)
+        return {self._ef_key(b.index):
+                np.zeros((self.world * self.groups, b.padded_length),
+                         np.float32)
                 for b in self.plan.buckets}
 
     def ef_shardings(self):
         """{bucket: NamedSharding} matching init_ef (row-sharded)."""
         if not self.has_ef:
             return {}
-        s = NamedSharding(self.mesh, P(self.data_axes))
+        s = NamedSharding(self.mesh, P(self.ef_axes))
         return {self._ef_key(b.index): s for b in self.plan.buckets}
 
     def ef_matches(self, ef) -> bool:
@@ -163,7 +250,8 @@ class GradReducer:
         mesh or bucket-layout change invalidates residuals: reset them)."""
         if not self.has_ef:
             return not ef
-        want = {self._ef_key(b.index): (self.world, b.padded_length)
+        want = {self._ef_key(b.index):
+                (self.world * self.groups, b.padded_length)
                 for b in self.plan.buckets}
         try:
             got = {k: tuple(np.shape(v)) for k, v in dict(ef).items()}
@@ -187,8 +275,12 @@ class GradReducer:
         out = dict(grads)
         new_ef = dict(ef_local)
         for b in self.plan.buckets:
-            parts = [jnp.ravel(grads[s.name]).astype(jnp.float32)
-                     for s in b.leaves]
+            parts, pos = [], 0
+            for s in b.leaves:
+                if s.offset > pos:  # leaf-alignment gap (hybrid plans)
+                    parts.append(jnp.zeros((s.offset - pos,), jnp.float32))
+                parts.append(jnp.ravel(grads[s.name]).astype(jnp.float32))
+                pos = s.offset + s.size
             v = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
             pad = b.padded_length - b.length
             if pad:
@@ -284,23 +376,74 @@ class GradReducer:
                 s = lax.all_gather(s, ax, axis=0, tiled=True)
         return dequantize_block_scaled(q, s, cfg.block_size), err
 
+    # ---------------- the two-region hybrid reduce (region B) ----------
+    @jax.named_scope("comm/grad_reduce")
+    def reduce_stacked(self, gstack, ef, inv_scale=None):
+        """(stacked local grads, residuals) -> (reduced grads, new
+        residuals), for the two-region hybrid schedule. Call OUTSIDE any
+        shard_map (jit scope): `gstack` is {name: [world, *global_shape]}
+        — each data rank's local gradient on a leading data-axis stack,
+        as the step's partial-auto region A emits it. Each leaf is pinned
+        to its model-parallel layout first (so region B opens with no
+        implicit resharding), then a fully-manual region over ALL mesh
+        axes runs the quantized chain over the data axes only: the model
+        axes are manual-but-inactive, i.e. one independent reduction per
+        model shard's device group."""
+        if not self.two_region:
+            raise ValueError("reduce_stacked is the two-region hybrid "
+                             "path; use reduce_local inside the step's "
+                             "manual region instead")
+        mesh = self.mesh
+        gstack = {k: lax.with_sharding_constraint(
+            v, NamedSharding(mesh, self.stack_spec(k)))
+            for k, v in gstack.items()}
+        scaled = inv_scale is not None
+
+        def local(gs, ef_blk, inv):
+            g = {k: v[0] for k, v in gs.items()}
+            ef_loc = {k: v[0] for k, v in ef_blk.items()}
+            red, new_ef = self.reduce_local(
+                g, ef_loc, inv_scale=inv if scaled else None)
+            return red, {k: v[None] for k, v in new_ef.items()}
+
+        ef_spec = {k: P(self.ef_axes) for k in ef}
+        red, new_ef = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=({k: self.stack_spec(k) for k in gstack},
+                      ef_spec, P()),
+            out_specs=({k: self.leaf_spec(k) for k in gstack}, ef_spec),
+            axis_names=set(mesh.axis_names), check_vma=False,
+        )(gstack, ef, inv_scale if scaled else jnp.float32(1.0))
+        return red, new_ef
+
 
 def reducer_for_step(config: GradReduceConfig, mesh: Mesh,
                      data_axes: Tuple[str, ...],
                      templates: Dict[str, Tuple[Tuple[int, ...], np.dtype]],
-                     warn: bool = True) -> Optional[GradReducer]:
+                     warn: bool = True,
+                     grad_specs: Optional[Dict[str, Tuple]] = None
+                     ) -> Optional[GradReducer]:
     """The activation rules: a GradReducer, or None meaning "leave the
     reduction to GSPMD".
 
     - mode off or single-device data world: None.
     - all non-data axes degree 1: full reducer (quant/hierarchical as
       configured, fully-manual region).
-    - non-data axes all in HYBRID_AXES (e.g. dp x mp): HYBRID reducer —
-      flat fp32 psum over the data axes inside a partial-auto region;
-      quant requests downgrade with a warning.
+    - non-data axes all in QUANT_COMPATIBLE_AXES (e.g. dp x mp,
+      dp x sharding x mp): HYBRID reducer — quant runs the two-region
+      schedule (per-model-shard compressed groups, EF on), fp32 a flat
+      psum over the data axes inside a partial-auto region.
     - any other active non-data axis (pp, sep, ...): None with a warning
       naming the blocking axes (their stages nest their own shard_maps,
-      which the hybrid region cannot wrap — see the module docstring).
+      which the reduce region cannot wrap — see the module docstring);
+      quant requests additionally record the ambient
+      `comm-quant-downgrade` finding, since their wire bytes silently
+      revert to full precision.
+
+    grad_specs: {name: partition entries} of each gradient leaf's
+    compute layout (model axes only are honored) — lets the hybrid plan
+    account model-shard-LOCAL bytes and pin region-B specs. Leaves
+    missing from it are treated as replicated over the model axes.
     """
     if not config.active:
         return None
@@ -314,51 +457,54 @@ def reducer_for_step(config: GradReduceConfig, mesh: Mesh,
                if a not in data_axes and n > 1}
     if not nondata:
         return GradReducer(config, mesh, templates, data_axes)
-    blocked = {a: n for a, n in nondata.items() if a not in HYBRID_AXES}
+    blocked = {a: n for a, n in nondata.items()
+               if a not in QUANT_COMPATIBLE_AXES}
     if blocked:
         if warn:
             warnings.warn(
                 f"grad_reduce mode={config.mode!r} disabled: mesh axes "
                 f"{blocked} are active non-data axes with no hybrid "
-                f"reduction path (only model-parallel axes {HYBRID_AXES} "
-                "can stay GSPMD-auto around the reduce region; "
-                "pipeline/expert axes nest their own shard_maps) — "
-                "falling back to XLA's implicit all-reduce", stacklevel=3)
+                "reduction path (only model-parallel axes "
+                f"{QUANT_COMPATIBLE_AXES} can stay GSPMD-auto around the "
+                "reduce region; pipeline/expert axes nest their own "
+                "shard_maps) — falling back to XLA's implicit "
+                "all-reduce", stacklevel=3)
+        if config.quantized:
+            # the analyzer-visible record of the same hazard: a warning
+            # scrolls past, an ambient finding reaches the gate/baseline
+            # ledger (rule comm-quant-downgrade, analysis/README.md)
+            from ...analysis.findings import Finding, record_ambient
+            record_ambient(Finding(
+                rule="comm-quant-downgrade",
+                site="comm_opt.reducer_for_step", severity="warning",
+                message=(f"grad_reduce mode='quant' silently fell back "
+                         f"to XLA's implicit fp32 all-reduce: mesh axes "
+                         f"{sorted(blocked)} block the explicit reduce "
+                         "region (wire bytes are full precision and "
+                         "error feedback is off)"),
+                data=("blocked", ",".join(sorted(blocked)),
+                      ",".join(data_axes))))
         return None
-    if config.quantized:
-        if warn:
-            warnings.warn(
-                f"grad_reduce mode='quant' on a hybrid mesh (model axes "
-                f"{nondata}): quantized collectives need a fully-manual "
-                "shard_map, which model axes preclude on this build — "
-                f"downgrading to explicit fp32 psum over {data_axes} "
-                "(error feedback off)", stacklevel=3)
-        # the analyzer-visible record of the same hazard: a warning
-        # scrolls past, an ambient finding reaches the gate/baseline
-        # ledger (rule comm-quant-downgrade, analysis/README.md)
-        from ...analysis.findings import Finding, record_ambient
-        record_ambient(Finding(
-            rule="comm-quant-downgrade",
-            site="comm_opt.reducer_for_step", severity="warning",
-            message=(f"grad_reduce mode='quant' silently downgraded to "
-                     f"fp32 psum on a hybrid mesh (model axes "
-                     f"{sorted(nondata)}): wire bytes are full precision "
-                     "and error feedback is off"),
-            data=("hybrid", ",".join(sorted(nondata)),
-                  ",".join(data_axes))))
-    return GradReducer(config, mesh, templates, data_axes, hybrid=True)
+    return GradReducer(config, mesh, templates, data_axes, hybrid=True,
+                       grad_specs=grad_specs)
 
 
 def make_tree_reducer(reducer: GradReducer):
     """Standalone jit-compiled (stacked_grads, ef) -> (reduced, new_ef).
 
-    For tests and bench: `stacked_grads` carries each device's local
+    For tests and bench: `stacked_grads` carries each data rank's local
     gradient tree on a leading world axis ({name: [world, *shape]},
-    sharded over the data axes); the result is the reduced (mean) tree,
-    replicated. The train step itself inlines reduce_local instead."""
+    sharded over the data axes; on hybrid meshes *shape is global and
+    each leaf additionally carries its model-axis layout — the two-region
+    `reduce_stacked` path). The result is the reduced (mean) tree,
+    data-replicated. The train step itself inlines the reduction."""
     dax = reducer.data_axes
     mesh = reducer.mesh
-    manual = set(reducer.manual_axes)
+
+    if reducer.two_region:
+        return jax.jit(reducer.reduce_stacked)
+
+    manual = set(reducer.reduce_axes)
 
     def local(gstack, ef):
         g = {k: v[0] for k, v in gstack.items()}
@@ -395,3 +541,6 @@ def record_reduce_metrics(reducer: GradReducer, steps: int = 1,
     _m.counter("comm.grad_reduce.bytes", p.bytes_raw_per_step * k,
                kind="raw")
     _m.gauge("comm.grad_reduce.compression_ratio", p.compression_ratio)
+    # hybrid meshes: how many independent per-model-shard groups run the
+    # schedule concurrently (1 on pure-data meshes)
+    _m.gauge("comm.grad_reduce.groups", p.groups)

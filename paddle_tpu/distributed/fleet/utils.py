@@ -276,17 +276,49 @@ class ShardedTrainStep:
         M_acc = self._accum
         pp_mode = pp > 1
 
+        # Grad compute sharding = param storage sharding minus the ZeRO axis:
+        # under ZeRO-3 the stored param (hence, by propagation, its grad) is
+        # sharded over `sharding`, and letting that reach the weight-grad dot
+        # makes the partitioner reshard the ACTIVATION operand to match
+        # (involuntary full rematerialization). Constraining the grad to the
+        # compute spec keeps the dot local-partials + allreduce; the slice
+        # down to the storage shard happens at the optimizer update, exactly
+        # like ZeRO-1/2 grads (reference GroupShardedStage3's
+        # reduce-then-keep-own-slice, group_sharded_stage3.py:486).
+        zero_axis = getattr(optimizer, "_shard_state_axis", None) or "sharding"
+
+        def _strip_axis(spec: P, axis: str) -> P:
+            out = []
+            for e in spec:
+                if e == axis:
+                    out.append(None)
+                elif isinstance(e, tuple):
+                    kept = tuple(a for a in e if a != axis)
+                    out.append(kept if kept else None)
+                else:
+                    out.append(e)
+            while out and out[-1] is None:
+                out.pop()
+            return P(*out)
+
+        g_shard = {
+            name: NamedSharding(mesh, _strip_axis(s.spec, zero_axis))
+            for name, s in p_shard.items()
+        }
+
         # ---- gradient-reduction strategy (distributed.comm_opt) ----
         # The explicit reducer replaces GSPMD's implicit grad all-reduce
         # with bucketed quantized/hierarchical collectives inside a
         # fully-manual shard_map over the data axes. On hybrid dp x mp
         # meshes reducer_for_step hands back a hybrid reducer instead:
-        # the region below goes partial-auto (manual over the data axes
-        # only, reducer.manual_axes) and each model shard takes an
-        # explicit flat fp32 psum over its data replicas. reducer is
-        # None (implicit reduction stays) for mode="off", a single-device
-        # data world, or pp/sep meshes (those stages nest their own
-        # shard_maps; see comm_opt.reduce).
+        # fp32 reduces inline (flat psum in a partial-auto region manual
+        # over reducer.manual_axes); quant runs the two-region schedule —
+        # the partial-auto region emits stacked per-rank grads and
+        # reducer.reduce_stacked compresses them per model shard (the
+        # grad specs below localize its plan). reducer is None (implicit
+        # reduction stays) for mode="off", a single-device data world, or
+        # pp/sep meshes (those stages nest their own shard_maps; see
+        # comm_opt.reduce).
         self._grad_reduce = _comm_opt.normalize_grad_reduce(grad_reduce)
         bspec0 = (batch_sharding.spec[0] if len(batch_sharding.spec)
                   else None)
@@ -294,7 +326,8 @@ class ShardedTrainStep:
                      else (bspec0,)) if bspec0 else ()
         reducer = _comm_opt.reducer_for_step(
             self._grad_reduce, mesh, data_axes,
-            {k: (tuple(v.shape), v.dtype) for k, v in params0.items()})
+            {k: (tuple(v.shape), v.dtype) for k, v in params0.items()},
+            grad_specs={k: tuple(g_shard[k].spec) for k in params0})
         self._reducer = reducer
         self._ef_shard = reducer.ef_shardings() if reducer else {}
         self.ef_state = {} if reducer is None else {
@@ -302,10 +335,12 @@ class ShardedTrainStep:
             for k, v in reducer.init_ef().items()}
         # with overlap, every accumulation microbatch issues its own
         # bucket reductions (they hide under the next microbatch's
-        # backward) — the per-step wire volume scales by M_acc
+        # backward) — the per-step wire volume scales by M_acc. The
+        # two-region hybrid cannot overlap: its reduce region sits
+        # OUTSIDE the fwd/bwd region, after accumulation.
         self._reductions_per_step = (
             M_acc if (reducer is not None and self._grad_reduce.overlap
-                      and M_acc > 1) else 1)
+                      and M_acc > 1 and not reducer.two_region) else 1)
         overlap_reduce = reducer is not None and self._reductions_per_step > 1
 
         def grads_with_reduce(params, bufs, ef, x, y, seed, loss_scale=None):
@@ -325,6 +360,40 @@ class ShardedTrainStep:
 
             dax = reducer.data_axes
             scaled_in = loss_scale is not None
+
+            if reducer.two_region:
+                # Region A: partial-auto fwd/bwd (manual over the data
+                # axes only; model axes stay GSPMD-auto), emitting each
+                # data rank's local grads stacked on a leading data axis.
+                # Region B (reduce_stacked, outside this shard_map) pins
+                # the model-parallel layouts and runs the quantized
+                # chain per model shard. Loss scaling composes the same
+                # way as inline: grads leave region A scaled, region B
+                # unscales before compression and rescales after, so EF
+                # residuals stay in unscaled units.
+                def local_a(params_l, bufs_l, x_l, y_l, seed_l, sc_l):
+                    ls = sc_l if scaled_in else None
+                    (l, new_bufs), g = value_and_grad_accum(
+                        params_l, bufs_l, x_l, y_l, seed_l, loss_scale=ls)
+                    l = jax.lax.pmean(l, dax)
+                    new_bufs = jax.tree_util.tree_map(
+                        lambda t: (jax.lax.pmean(t, dax)
+                                   if jnp.issubdtype(t.dtype, jnp.floating)
+                                   else t), new_bufs)
+                    return l, new_bufs, {k: v[None] for k, v in g.items()}
+
+                sc_in2 = (loss_scale if scaled_in else jnp.float32(1.0))
+                loss, new_bufs, gstack = jax.shard_map(
+                    local_a, mesh=mesh,
+                    in_specs=(P(), P(), batch_sharding.spec,
+                              batch_sharding.spec, P(), P()),
+                    out_specs=(P(), P(), P(dax)),
+                    axis_names=set(reducer.manual_axes), check_vma=False,
+                )(params, bufs, x, y, seed, sc_in2)
+                inv = (1.0 / sc_in2) if scaled_in else None
+                grads, new_ef = reducer.reduce_stacked(gstack, ef,
+                                                       inv_scale=inv)
+                return (loss, new_bufs), grads, new_ef
 
             def local(params_l, bufs_l, ef_blk, x_l, y_l, seed_l, sc_l):
                 ef_loc = {k: v[0] for k, v in ef_blk.items()}
@@ -445,36 +514,6 @@ class ShardedTrainStep:
             inv = 1.0 / M_acc
             return ((l * inv, new_bufs),
                     jax.tree_util.tree_map(lambda t: t * inv, g))
-
-        # Grad compute sharding = param storage sharding minus the ZeRO axis:
-        # under ZeRO-3 the stored param (hence, by propagation, its grad) is
-        # sharded over `sharding`, and letting that reach the weight-grad dot
-        # makes the partitioner reshard the ACTIVATION operand to match
-        # (involuntary full rematerialization). Constraining the grad to the
-        # compute spec keeps the dot local-partials + allreduce; the slice
-        # down to the storage shard happens at the optimizer update, exactly
-        # like ZeRO-1/2 grads (reference GroupShardedStage3's
-        # reduce-then-keep-own-slice, group_sharded_stage3.py:486).
-        zero_axis = getattr(optimizer, "_shard_state_axis", None) or "sharding"
-
-        def _strip_axis(spec: P, axis: str) -> P:
-            out = []
-            for e in spec:
-                if e == axis:
-                    out.append(None)
-                elif isinstance(e, tuple):
-                    kept = tuple(a for a in e if a != axis)
-                    out.append(kept if kept else None)
-                else:
-                    out.append(e)
-            while out and out[-1] is None:
-                out.pop()
-            return P(*out)
-
-        g_shard = {
-            name: NamedSharding(mesh, _strip_axis(s.spec, zero_axis))
-            for name, s in p_shard.items()
-        }
 
         @jax.named_scope("opt/update")
         def _clip_and_update(params, opt_state, grads, lr):

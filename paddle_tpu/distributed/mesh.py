@@ -51,6 +51,13 @@ def get_global_mesh() -> Mesh:
     return _global_mesh
 
 
+def current_mesh() -> Optional[Mesh]:
+    """The process-global mesh if one was set, else None — a peek that,
+    unlike get_global_mesh, never lazily builds the 1-D world mesh (callers
+    that only want to *inspect* ambient axes must not mint one)."""
+    return _global_mesh
+
+
 def reset_global_mesh():
     global _global_mesh
     _global_mesh = None

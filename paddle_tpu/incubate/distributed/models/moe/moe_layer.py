@@ -24,23 +24,51 @@ from .gate import GShardGate, SwitchGate, gshard_gating, switch_gating
 EP_AXIS = "ep"
 
 
-def moe_route(xt, gate_weight, gate_type: str, capacity: int, run_experts):
+def moe_route(xt, gate_weight, gate_type: str, capacity: int, run_experts,
+              dispatch_mode: str = "dense", quant_block: int = 128):
     """Shared dense-routing core (GShard/Switch): gate -> dispatch einsum ->
     run_experts([E, C, d] -> [E, C, d'], ep-sharded) -> combine einsum.
     Both MoELayer and models.gpt.GPTMoEMLP route through here, so capacity/
-    overflow/gating semantics cannot diverge. Returns (out [T, d'], aux)."""
+    overflow/gating semantics cannot diverge. Returns (out [T, d'], aux).
+
+    dispatch_mode "quant" compresses the two cross-ep token exchanges to
+    block-scaled int8 (dispatch.py); gating, capacity assignment and the
+    aux loss stay full precision, so routing is identical to dense and
+    outputs differ only by the wire format's quantization noise. Contexts
+    that cannot host the compressed all-to-all fall back to dense and
+    record the `moe-dispatch-downgrade` ambient finding."""
+    if dispatch_mode not in ("dense", "quant"):
+        raise ValueError(
+            f"dispatch_mode must be 'dense' or 'quant', got {dispatch_mode!r}")
     logits = xt.matmul(gate_weight)  # [T, E]
     gating = gshard_gating if gate_type == "gshard" else switch_gating
     dispatch, combine, aux = apply(
         "moe_gating", lambda lg: gating(lg, capacity), logits)
 
-    def dispatch_fn(dv, xv):
-        return jnp.einsum("tec,td->ecd", dv,
-                          xv.astype(jnp.float32)).astype(xv.dtype)
+    plan = None
+    if dispatch_mode == "quant":
+        from .dispatch import plan_quant_dispatch, quant_combine, quant_dispatch
 
-    ein = apply("moe_dispatch", dispatch_fn, dispatch, xt)  # [E, C, d]
+        plan = plan_quant_dispatch(int(xt.shape[0]),
+                                   int(gate_weight.shape[-1]), capacity,
+                                   int(xt.shape[-1]), block=quant_block)
+
+    if plan is not None:
+        ein = apply("moe_dispatch_quant",
+                    lambda dv, xv: quant_dispatch(plan, dv, xv), dispatch, xt)
+    else:
+        def dispatch_fn(dv, xv):
+            return jnp.einsum("tec,td->ecd", dv,
+                              xv.astype(jnp.float32)).astype(xv.dtype)
+
+        ein = apply("moe_dispatch", dispatch_fn, dispatch, xt)  # [E, C, d]
     ein = maybe_shard(ein, P(EP_AXIS, None, None))
     eout = maybe_shard(run_experts(ein), P(EP_AXIS, None, None))
+
+    if plan is not None:
+        return apply("moe_combine_quant",
+                     lambda cv, ev: quant_combine(plan, cv, ev),
+                     combine, eout), aux
 
     def combine_fn(cv, ev):
         return jnp.einsum("tec,ecd->td", cv,
@@ -66,9 +94,11 @@ class MoELayer(Layer):
         capacity_factor: float = 1.25,
         group=None,
         recompute_interval: int = 0,
+        dispatch: str = "dense",
         name=None,
     ):
         super().__init__()
+        self.dispatch_mode = dispatch
         self.d_model = d_model
         self.num_experts = len(experts)
         self.experts = experts
@@ -147,7 +177,8 @@ class MoELayer(Layer):
 
                 return _ops.stack([e(expert_in[i]) for i, e in enumerate(self.experts)], axis=0)
 
-        out, aux = moe_route(xt, self.gate_weight, self.gate_type, capacity, run_experts)
+        out, aux = moe_route(xt, self.gate_weight, self.gate_type, capacity,
+                             run_experts, dispatch_mode=self.dispatch_mode)
         self.aux_loss = aux
         return out.reshape(orig_shape[:-1] + [out.shape[-1]])
 

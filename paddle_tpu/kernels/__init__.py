@@ -14,4 +14,4 @@ from .paged_attention import paged_attention  # noqa: F401
 from .norms import fused_layer_norm, fused_rms_norm  # noqa: F401
 from .fused_optim import fused_adamw_update  # noqa: F401
 from .quant import (dequantize_block_scaled,  # noqa: F401
-                    quantize_block_scaled)
+                    fit_block_size, quantize_block_scaled)

@@ -21,11 +21,25 @@ whole block's dequantized values.
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
 
-__all__ = ["quantize_block_scaled", "dequantize_block_scaled"]
+__all__ = ["quantize_block_scaled", "dequantize_block_scaled",
+           "fit_block_size"]
+
+
+def fit_block_size(C: int, block_size: int = 128) -> int:
+    """Largest block that divides C and the requested block_size (their gcd).
+
+    Grad buckets pad themselves to a granule, but activation exchanges (MoE
+    token dispatch) quantize a model dim that may be smaller than the default
+    block — e.g. d_model 64 under block 128 fits at 64 with double the scale
+    overhead. The degenerate gcd (< 8: more than half the wire is scales)
+    means the dim is not worth compressing; callers should fall back.
+    """
+    return math.gcd(int(C), int(block_size))
 
 
 def quantize_block_scaled(

@@ -71,6 +71,9 @@ class GPTConfig:
     moe_top_k: int = 2            # 2 = GShard gate, 1 = Switch gate
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01  # load-balance aux-loss weight
+    moe_dispatch: str = "dense"   # 'quant' = block-scaled int8 token
+    #                               exchanges over ep (incubate .../moe/
+    #                               dispatch.py); routing stays fp32
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -352,7 +355,7 @@ class GPTMoEMLP(Layer):
 
         out, aux = moe_route(
             xt, self.gate_weight, "gshard" if cfg.moe_top_k == 2 else "switch",
-            capacity, run_experts)
+            capacity, run_experts, dispatch_mode=cfg.moe_dispatch)
         self.aux_loss = aux
         return self.dropout(out.reshape([B, S, d]))
 

@@ -81,7 +81,8 @@ def test_rule_catalog_documents_every_default_rule():
     # HLO reconciliation, not the default jaxpr walk — but the catalog
     # is the single ledger for all of them
     ids.update(analysis.TIER2_RULE_IDS)
-    ids.update({"comm-quant-downgrade", "spmd-predict-divergence"})
+    ids.update({"comm-quant-downgrade", "moe-dispatch-downgrade",
+                "spmd-predict-divergence"})
     assert ids == set(analysis.RULE_CATALOG)
 
 
@@ -302,15 +303,24 @@ def test_ambient_quant_downgrade_reaches_report():
 
     if jax.device_count() < 8:
         pytest.skip("needs the 8-device CPU mesh")
-    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "mp"))
     templates = {"w": ((8, 4), np.dtype(np.float32))}
     analysis.drain_ambient()  # isolate from other tests
+    # dp x mp is quant-compatible since the two-region schedule: a real
+    # hybrid reducer comes back and NO downgrade is recorded.
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "mp"))
     red = reducer_for_step(GradReduceConfig(mode="quant", dtype="int8"),
                            mesh, ("dp",), templates, warn=False)
     assert red is not None and red.hybrid
+    assert analysis.drain_ambient() == []
+    # an active pp axis still blocks the explicit region entirely
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "pp"))
+    red = reducer_for_step(GradReduceConfig(mode="quant", dtype="int8"),
+                           mesh, ("dp",), templates, warn=False)
+    assert red is None
     pending = analysis.drain_ambient()
     assert [f.rule for f in pending] == ["comm-quant-downgrade"]
     assert pending[0].severity == "warning"
+    assert "pp" in pending[0].data
     assert analysis.drain_ambient() == []  # drained exactly once
 
 

@@ -114,6 +114,9 @@ def test_wire_reconciliation_active(corpus_report):
     by_name = {s.name: s for s in specs}
     assert by_name["grad_reducer"].contract.expected_wire_bytes
     assert by_name["reshard"].contract.expected_wire_bytes
+    # the MoE site carries the DispatchPlan's quant-exchange accounting
+    if "train_step_moe" in by_name:  # 8-device corpus only
+        assert by_name["train_step_moe"].contract.expected_wire_bytes
 
 
 # --------------------------------------------------------------- tier 2
@@ -144,6 +147,12 @@ def test_hlo_audit_sees_training_collectives(corpus_audits):
     # the int8 reducer must put s8 payloads on the wire
     assert any(k.endswith("|s8")
                for k in by_site["grad_reducer"].counts), by_site["grad_reducer"]
+    # ISSUE 20 acceptance: the quant MoE dispatch/combine token exchanges
+    # are s8 all-to-alls (plus the combine's s8 all-gather) at the MoE site
+    moe = by_site.get("train_step_moe")  # 8-device corpus only
+    if moe is not None:
+        assert any(k.startswith("all-to-all|s8") for k in moe.counts), moe
+        assert any(k.startswith("all-gather|s8") for k in moe.counts), moe
 
 
 def test_hlo_audit_zero_unexplained_collectives(corpus_audits):

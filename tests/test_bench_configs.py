@@ -125,6 +125,18 @@ def test_bench_comm_row_contract(capsys):
         assert tele["counters"]["comm.grad_reduce.steps"] > 0
         assert tele["counters"]["comm.grad_reduce.bytes{kind=wire}"] > 0
         assert tele["gauges"]["comm.grad_reduce.compression_ratio"] >= 3.5
+    # hybrid dp x mp sub-row: per-mp-shard compressed groups, >= 3.0x
+    hy = parsed["hybrid"]
+    assert hy["groups"] >= 2
+    assert hy["compression_ratio"] >= 3.0
+    assert 0 < hy["bytes_wire_per_reduction"] < hy["bytes_raw_per_reduction"]
+    # compressed MoE dispatch sub-row: quant vs raw token-exchange bytes
+    moe = parsed["moe_dispatch"]
+    assert moe["block"] >= 8
+    assert moe["compression_ratio"] >= 3.0
+    if moe["bytes_wire_per_step"] is not None:  # multi-device run
+        assert 0 < moe["bytes_wire_per_step"] < moe["bytes_raw_per_step"]
+        assert tele["gauges"]["moe.dispatch.compression_ratio"] >= 3.0
     # the row must not leave the global observability flag flipped on
     assert not observability.enabled()
 

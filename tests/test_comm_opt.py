@@ -122,6 +122,30 @@ def test_plan_deterministic_and_buckets():
     assert "compression" in describe(p1)
 
 
+def test_hybrid_plan_block_aligns_leaves():
+    """Group plans start every leaf on a scale-block boundary: a block
+    spanning a group-replicated leaf and a model-sharded one would get
+    group-dependent scales, and the "replicated" reduced grad would drift
+    apart across model-shard groups (caught by the bitwise-resume test)."""
+    cfg = GradReduceConfig(mode="quant")
+    leaves = {"a": (100,), "b": (300, 3), "c": (7, 11)}
+    grp = build_plan(leaves, {"dp": 2}, cfg, group_axes={"mp": 4})
+    assert grp.groups == 4
+    for b in grp.buckets:
+        for s in b.leaves:
+            assert s.offset % cfg.block_size == 0, s
+    # length counts alignment gaps so pad/EF row sizing stays consistent
+    last = grp.buckets[-1].leaves[-1]
+    assert grp.buckets[-1].length == last.offset + last.size
+    # pure-data plans keep contiguous packing (byte accounting unchanged)
+    flat = build_plan(leaves, {"dp": 2}, cfg)
+    offs = [s.offset for b in flat.buckets for s in b.leaves]
+    sizes = [s.size for b in flat.buckets for s in b.leaves]
+    for i in range(1, len(offs)):
+        if offs[i] != 0:  # same bucket: contiguous
+            assert offs[i] == offs[i - 1] + sizes[i - 1]
+
+
 def test_plan_flat_and_formats():
     leaves = {"w": (1000,)}
     flat = build_plan(leaves, {"dp": 2, "sharding": 4},
@@ -214,6 +238,8 @@ def test_quant_multibucket_and_bf16():
 
 
 def test_reducer_activation_rules():
+    from paddle_tpu.analysis import findings as _findings
+
     templates = {"w": ((8,), np.dtype(np.float32))}
     mesh = _mesh24()
     assert reducer_for_step(GradReduceConfig(mode="off"), mesh,
@@ -222,30 +248,54 @@ def test_reducer_activation_rules():
     m1 = Mesh(np.array(jax.devices()[:1]), ("dp",))
     assert reducer_for_step(GradReduceConfig(mode="quant"), m1, ("dp",),
                             templates) is None
-    # active mp axis: hybrid reducer — partial-auto region manual over the
-    # data axes only, quant downgraded to flat fp32 psum (with a warning)
+    # active mp axis: hybrid reducer — quant now ACTIVATES (two-region
+    # schedule, EF on) instead of the old downgrade-with-warning
     mmp = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
                ("dp", "mp", "sharding"))
-    with pytest.warns(UserWarning, match="downgrading to explicit fp32"):
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
         red = reducer_for_step(GradReduceConfig(mode="quant"), mmp,
                                ("dp", "sharding"), templates)
-    assert red is not None and red.hybrid and red.world == 4
+    assert red is not None and red.hybrid and red.two_region
+    assert red.world == 4 and red.groups == 2
     assert red.manual_axes == ("dp", "sharding")
-    assert red.config.mode == "fp32" and not red.has_ef
-    assert red._stages == [(("sharding", "dp"), 4)]  # flat single psum
-    # fp32 on the same mesh: hybrid without any downgrade warning
+    assert red.reduce_axes == ("dp", "mp", "sharding")
+    assert red.config.mode == "quant" and red.has_ef
+    assert red.ef_axes == ("dp", "sharding", "mp")
+    (ef_rows,) = {v.shape[0] for v in red.init_ef().values()}
+    assert ef_rows == 8  # one residual row per device over the whole mesh
+    assert not _findings.drain_ambient()  # activation records no downgrade
+    # a non-data `sharding` axis (fsdp weight shard outside the batch
+    # spec) is quant-compatible too: dp-only data world, hybrid activates
+    msh = _mesh24()
+    red = reducer_for_step(GradReduceConfig(mode="quant"), msh, ("dp",),
+                           templates)
+    assert red is not None and red.two_region and red.world == 2
+    assert red.model_axes == ("sharding",) and red.groups == 4
+    # fp32 on the hybrid mesh: single partial-auto region, flat psum
     with warnings.catch_warnings():
         warnings.simplefilter("error")
         red = reducer_for_step(GradReduceConfig(mode="fp32"), mmp,
                                ("dp", "sharding"), templates)
-    assert red is not None and red.hybrid
+    assert red is not None and red.hybrid and not red.two_region
+    assert red._stages == [(("sharding", "dp"), 4)]  # flat single psum
+    assert red.reduce_axes == ("dp", "sharding")
     # active pp axis: no hybrid path (nested shard_maps) -> warn, naming
-    # the blocking axis, and fall back to the implicit reduction
+    # the blocking axis, fall back to the implicit reduction, and record
+    # the ambient comm-quant-downgrade finding for the analyzers
     mpp = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
                ("dp", "pp", "sharding"))
     with pytest.warns(UserWarning, match=r"'pp': 2.*no hybrid"):
         assert reducer_for_step(GradReduceConfig(mode="quant"), mpp,
                                 ("dp", "sharding"), templates) is None
+    amb = _findings.drain_ambient()
+    assert [f.rule for f in amb] == ["comm-quant-downgrade"]
+    assert "pp" in amb[0].message
+    # ...but an fp32 request on blocked axes is not a quant downgrade
+    with pytest.warns(UserWarning, match="no hybrid"):
+        assert reducer_for_step(GradReduceConfig(mode="fp32"), mpp,
+                                ("dp", "sharding"), templates) is None
+    assert not _findings.drain_ambient()
     red = reducer_for_step(GradReduceConfig(mode="quant"), mesh,
                            ("dp", "sharding"), templates)
     assert red is not None and red.world == 8 and not red.hybrid
@@ -299,36 +349,47 @@ def test_explicit_fp32_matches_implicit():
     np.testing.assert_allclose(ex, base, rtol=2e-5)
 
 
-def _train_hybrid(grad_reduce, steps, dp=2, mp=4, batch=16):
-    """Fresh tiny-GPT ShardedTrainStep on a dp x mp hybrid mesh (fleet
-    hybrid_configs: mp layers annotate their weights over "mp") -> loss
-    sequence. Same seeds every call."""
-    import paddle_tpu as paddle
+def _reset_fleet():
     from paddle_tpu.distributed import collective, mesh as _mesh, topology
-    from paddle_tpu.distributed import fleet
-    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
-    from paddle_tpu.models import gpt_tiny
 
     collective.destroy_process_group()
     _mesh.reset_global_mesh()
     topology.set_hybrid_communicate_group(None)
+
+
+def _build_hybrid_step(grad_reduce, dp=2, mp=4, sharding=1, batch=16):
+    """Fresh tiny-GPT ShardedTrainStep on a fleet hybrid mesh (mp layers
+    annotate their weights over "mp"; sharding>1 turns on ZeRO param
+    sharding). Caller owns fleet-state cleanup (_reset_fleet)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.utils import make_sharded_train_step
+    from paddle_tpu.models import gpt_tiny
+
+    _reset_fleet()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                               "sharding_degree": sharding}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    m = gpt_tiny(dropout=0.0, num_layers=2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+    st = make_sharded_train_step(m, opt, grad_reduce=grad_reduce)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 128, size=(batch, 16))
+    return st, x, np.roll(x, -1, axis=1)
+
+
+def _train_hybrid(grad_reduce, steps, dp=2, mp=4, sharding=1, batch=16):
+    """_build_hybrid_step -> loss sequence. Same seeds every call: runs
+    differ only in the gradient-reduction strategy."""
     try:
-        strategy = fleet.DistributedStrategy()
-        strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp}
-        fleet.init(is_collective=True, strategy=strategy)
-        paddle.seed(0)
-        m = gpt_tiny(dropout=0.0, num_layers=2)
-        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
-                                     parameters=m.parameters())
-        st = make_sharded_train_step(m, opt, grad_reduce=grad_reduce)
-        rng = np.random.RandomState(0)
-        x = rng.randint(0, 128, size=(batch, 16))
-        y = np.roll(x, -1, axis=1)
+        st, x, y = _build_hybrid_step(grad_reduce, dp=dp, mp=mp,
+                                      sharding=sharding, batch=batch)
         return [float(st(x, y)) for _ in range(steps)], st
     finally:
-        collective.destroy_process_group()
-        _mesh.reset_global_mesh()
-        topology.set_hybrid_communicate_group(None)
+        _reset_fleet()
 
 
 def test_hybrid_mesh_explicit_reduce_activates_and_matches():
@@ -347,13 +408,95 @@ def test_hybrid_mesh_explicit_reduce_activates_and_matches():
     assert hyb[-1] < hyb[0] - 0.2  # it actually trained
 
 
-def test_hybrid_mesh_quant_downgrades_to_fp32():
-    with pytest.warns(UserWarning, match="downgrading to explicit fp32"):
-        q, st = _train_hybrid("int8", 2)
-    assert st._reducer is not None and st._reducer.hybrid
-    assert st._reducer.config.mode == "fp32"
-    base, _ = _train_hybrid(None, 2)
-    np.testing.assert_allclose(q, base, rtol=2e-5)
+def test_hybrid_mesh_quant_activates_two_region():
+    """ISSUE acceptance: mode='quant' on a dp x mp mesh no longer
+    downgrades — the two-region schedule runs the block-scaled int8
+    chain per model shard's dp group with error feedback on, and the
+    losses stay within quantization noise of the implicit reduction."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        q, st = _train_hybrid("int8", 4)
+    r = st._reducer
+    assert r is not None and r.hybrid and r.two_region
+    assert r.config.mode == "quant" and r.has_ef
+    assert r.model_axes == ("mp",) and r.groups == 4 and r.world == 2
+    # EF rows: one per device over data axes THEN model axes
+    ndev = len(jax.devices())
+    assert all(v.shape[0] == ndev for v in st.ef_state.values())
+    assert st._reductions_per_step == 1  # no in-scan overlap outside A
+    base, _ = _train_hybrid(None, 4)
+    for a, b in zip(q, base):
+        assert abs(a - b) / abs(b) < 2e-3, (a, b)
+
+
+@pytest.mark.slow
+def test_hybrid_int8_ef_tracks_fp32_within_1pct():
+    """ISSUE acceptance: 50 steps on a dp=2 x mp=2 hybrid mesh — the
+    two-region int8+EF reduce stays within 1% of the implicit
+    full-precision loss at every one of the last 10 steps."""
+    base, _ = _train_hybrid(None, 50, dp=2, mp=2)
+    quant, st = _train_hybrid("int8", 50, dp=2, mp=2)
+    r = st._reducer
+    assert r is not None and r.two_region and r.has_ef
+    assert r.world == 2 and r.groups == 2
+    for b, q in zip(base[-10:], quant[-10:]):
+        assert abs(q - b) / abs(b) < 0.01, (b, q)
+    assert quant[-1] < quant[0] - 0.3  # and it actually trained
+
+
+@pytest.mark.slow
+def test_hybrid_zero_int8_ef_tracks_fp32_within_1pct():
+    """ISSUE acceptance, dp x sharding flavor: ZeRO param sharding makes
+    `sharding` a second DATA axis, so the reducer takes the flat
+    fully-manual quant path over one 4-device group — still within 1%
+    of fp32 over 50 steps."""
+    base, _ = _train_hybrid(None, 50, dp=2, mp=1, sharding=2)
+    quant, st = _train_hybrid("int8", 50, dp=2, mp=1, sharding=2)
+    r = st._reducer
+    assert r is not None and r.has_ef
+    assert not r.two_region and r.world == 4 and r.groups == 1
+    for b, q in zip(base[-10:], quant[-10:]):
+        assert abs(q - b) / abs(b) < 0.01, (b, q)
+    assert quant[-1] < quant[0] - 0.3
+
+
+def test_hybrid_ef_bitwise_resume(tmp_path):
+    """EF bitwise-resume on the hybrid plan: the [world * groups, padded]
+    residuals ride in TrainState.extra, survive a CheckpointManager
+    round-trip into a FRESH two-region step, and the resumed run replays
+    the exact loss sequence (dropping them would re-apply one step's
+    compression error per model-shard group and fork the trajectory)."""
+    from paddle_tpu.checkpoint import CheckpointManager
+
+    try:
+        mgr = CheckpointManager(str(tmp_path / "ck"), async_=False)
+        st, x, y = _build_hybrid_step("int8", dp=2, mp=2)
+        r = st._reducer
+        assert r is not None and r.two_region and r.has_ef
+        for _ in range(3):
+            st(x, y)
+        tree = st.state_for_checkpoint().to_tree()
+        ef = tree["extra"]["grad_reduce_ef"]
+        rows = r.world * r.groups
+        assert all(np.asarray(v).shape[0] == rows for v in ef.values())
+        # after 3 quantized steps the residuals are live, not zeros
+        assert any(np.abs(np.asarray(v)).max() > 0 for v in ef.values())
+        mgr.save(st._step_i, tree)
+        cont_losses = [float(st(x, y)) for _ in range(3)]
+
+        st2, x2, y2 = _build_hybrid_step("int8", dp=2, mp=2)
+        st2.restore_from_checkpoint(mgr.restore(
+            shardings=st2.checkpoint_shardings()))
+        assert st2._step_i == 3
+        resume_losses = [float(st2(x2, y2)) for _ in range(3)]
+        assert resume_losses == cont_losses  # bitwise, not approx
+        for name in st.params:
+            np.testing.assert_array_equal(np.asarray(st.params[name]),
+                                          np.asarray(st2.params[name]),
+                                          err_msg=name)
+        mgr.close()
+    finally:
+        _reset_fleet()
 
 
 def test_overlap_deterministic_and_matches_no_overlap():
@@ -441,7 +584,31 @@ def test_comm_plan_cli_describe_without_jax():
     assert "world=8" in r.stdout
     assert "reduce_scatter" in r.stdout and "all_gather" in r.stdout
     assert "compression 3.88x" in r.stdout
-    assert "mp" in r.stdout  # the ignored non-data axis is called out
+    # the hybrid mp axis now forms reduction groups instead of being
+    # ignored, with group-local vs global wire totals
+    assert "hybrid groups: 2" in r.stdout
+    assert "group-local wire" in r.stdout and "global wire" in r.stdout
+
+
+def test_comm_plan_cli_hybrid_json_and_blocked():
+    r = _run_cli("--mesh", "dp=4,mp=2", "--leaf", "w=1024x512", "--json")
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["groups"] == 2 and out["group_axes"] == [["mp", 2]]
+    assert out["bytes_wire_group_per_step"] == \
+        4 * out["bytes_wire_per_step"]
+    assert out["bytes_wire_global_per_step"] == \
+        8 * out["bytes_wire_per_step"]
+    # library parity: the CLI plan is exactly build_plan(group_axes=...)
+    p = build_plan({"w": (1024, 512)}, {"dp": 4},
+                   GradReduceConfig(mode="quant"), group_axes={"mp": 2})
+    assert out["stages"] == plan_as_dict(p)["stages"]
+    assert out["groups"] == p.groups
+    # pp blocks the explicit path and the tool says so
+    r = _run_cli("--mesh", "dp=4,pp=2", "--params", "1e5")
+    assert r.returncode == 0, r.stderr
+    assert "no hybrid reduction path" in r.stdout
+    assert "implicit" in r.stdout
 
 
 def test_comm_plan_cli_json_matches_library():

@@ -11,8 +11,16 @@ compiler (distributed.resharding) emits for one array moving between
 two NamedShardings: the collective steps, per-step bytes on the wire,
 and the total against the naive replicate-then-slice baseline.
 
+Hybrid meshes are accepted: quant-compatible non-data axes (`mp`, a
+non-batch `sharding`) become independent reduction GROUPS — the plan is
+then the per-group schedule (pass per-model-shard LOCAL leaf shapes) and
+the output adds group-local vs global wire bytes. Axes with no hybrid
+path (`pp`, `sep`) are reported as blocking: ShardedTrainStep would fall
+back to the implicit reduction there.
+
 Usage:
     python tools/comm_plan.py --mesh dp=4,sharding=2 --params 1.3e9
+    python tools/comm_plan.py --mesh dp=4,mp=2 --params 6.5e8
     python tools/comm_plan.py --mesh dp=8 --mode quant --dtype bf16 \
         --leaf embed=32000x1024 --leaf w1=1024x4096 --leaf b1=4096
     python tools/comm_plan.py --mesh dp=2,sharding=4 --flat --json
@@ -172,7 +180,10 @@ def synthetic_leaves(n_params: int):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mesh", default=None,
-                    help="data-axis sizes, e.g. dp=4,sharding=2")
+                    help="mesh axis sizes, e.g. dp=4,sharding=2 or the "
+                         "hybrid dp=4,mp=2 (mp/non-batch-sharding axes "
+                         "become per-model-shard reduction groups; pass "
+                         "per-shard LOCAL leaf shapes then)")
     ap.add_argument("--params", type=float, default=None,
                     help="total parameter count (synthetic GPT-ish leaf "
                          "mix); alternative to --leaf")
@@ -243,8 +254,17 @@ def main(argv=None) -> int:
             bucket_bytes=int(args.bucket_mb * 2 ** 20))
         data_axes = {a: n for a, n in mesh_axes.items()
                      if a in config.DATA_AXES}
-        ignored = sorted(set(mesh_axes) - set(data_axes))
-        p = plan.build_plan(leaves, data_axes, cfg)
+        # hybrid: quant-compatible non-data axes slice the mesh into
+        # independent per-model-shard reduction groups (leaves are then
+        # the per-shard LOCAL shapes); anything else with degree > 1
+        # would block the explicit reduction entirely
+        group_axes = {a: n for a, n in mesh_axes.items()
+                      if a not in data_axes and n > 1
+                      and a in config.QUANT_COMPATIBLE_AXES}
+        blocked = sorted(a for a, n in mesh_axes.items()
+                         if a not in data_axes and a not in group_axes
+                         and n > 1)
+        p = plan.build_plan(leaves, data_axes, cfg, group_axes=group_axes)
     except (ValueError, TypeError) as exc:
         print(f"comm_plan: {exc}", file=sys.stderr)
         return 1
@@ -255,15 +275,21 @@ def main(argv=None) -> int:
         out["reductions_per_step"] = reductions
         out["bytes_wire_per_step"] = p.bytes_wire_per_step * reductions
         out["bytes_raw_per_step"] = p.bytes_raw_per_step * reductions
-        if ignored:
-            out["ignored_axes"] = ignored
+        out["bytes_wire_group_per_step"] = \
+            p.bytes_wire_group_per_step * reductions
+        out["bytes_wire_global_per_step"] = \
+            p.bytes_wire_global_per_step * reductions
+        if blocked:
+            out["blocked_axes"] = blocked
         print(json.dumps(out, indent=1, sort_keys=True))
         return 0
 
     print(plan.describe(p))
-    if ignored:
-        print(f"note: non-data mesh axes ignored: {', '.join(ignored)} "
-              f"(reduction runs over data axes only)")
+    if blocked:
+        print(f"note: mesh axes {', '.join(blocked)} have no hybrid "
+              "reduction path (pp/sep stages nest their own shard_maps):"
+              " ShardedTrainStep would fall back to the implicit "
+              "full-precision reduction on this mesh")
     if reductions > 1:
         print(f"with accum={args.accum} overlap: {reductions} reductions/"
               f"step = {p.bytes_wire_per_step * reductions / 2**20:.2f} "
